@@ -1,0 +1,30 @@
+"""loongcollector_tpu — a TPU-native observability data collector.
+
+A brand-new framework with the capabilities of alibaba/loongcollector
+(reference: /root/reference): it discovers and tails logs, collects metrics,
+traces and events, parses and transforms them in-process, and ships them to
+pluggable sinks with batching, back-pressure, checkpointing and exactly-once
+support.  Unlike the reference (per-event boost::regex on CPU threads,
+core/plugin/processor/ProcessorParseRegexNative.cpp), the parsing data plane
+here runs as batched kernels on TPU via JAX/XLA: event groups are accumulated
+into fixed-width device batches, the zero-copy SourceBuffer arena is
+transferred to HBM, and per-event field (offset,len) spans are returned into
+the same string-view event model.
+
+Package layout:
+  models/    — arena-backed zero-copy event model (reference: core/models/)
+  ops/       — TPU compute: regex/grok/delimiter/JSON kernels + compilers
+  pipeline/  — queues, plugin registry, batcher, router, serializers
+               (reference: core/collection_pipeline/)
+  processor/ — processor plugins, TPU + CPU implementations
+               (reference: core/plugin/processor/)
+  flusher/   — sink plugins (reference: core/plugin/flusher/)
+  input/     — input plugins, file tailing (reference: core/file_server/)
+  runner/    — thread engines (reference: core/runner/)
+  config/    — config loading/watching (reference: core/config/)
+  monitor/   — self metrics and alarms (reference: core/monitor/)
+  parallel/  — device mesh / sharding of the parse data plane across chips
+  utils/     — flags, logging, string views (reference: core/common/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from .event_group import ColumnarLogs, EventGroupMetaKey, PipelineEventGroup
+from .event_pool import EventPool, g_thread_event_pool
+from .events import (EventType, LogEvent, MetricEvent, MetricValue,
+                     PipelineEvent, RawEvent, SpanEvent)
+from .source_buffer import SourceBuffer

@@ -1,0 +1,317 @@
+"""PipelineEventGroup — the unit that flows through pipelines.
+
+Reference: core/models/PipelineEventGroup.h:80-158 — metadata map + tags +
+vector<PipelineEventPtr> + shared SourceBuffer; plus the test-only JSON
+round-trip (PipelineEventGroup.h:140-146) which we keep as a first-class
+fixture format (SURVEY.md §4).
+
+TPU-first redesign: groups additionally carry a **columnar** representation
+(`ColumnarLogs`): per-event (offset, length, timestamp) numpy arrays over the
+shared arena, plus parsed field span columns.  The device data plane operates
+exclusively on columns — per-event Python objects are materialised only on
+demand (tests, per-event plugins, JSON serialization).  Columnar groups are
+what gets packed into fixed-width device batches.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.stringview import AnyStr, StringView, as_bytes
+from .events import EventType, LogEvent, MetricEvent, PipelineEvent, RawEvent, SpanEvent
+from .source_buffer import SourceBuffer
+
+
+class EventGroupMetaKey(enum.Enum):
+    """Reference: PipelineEventGroup.h metadata keys."""
+
+    LOG_FILE_PATH = "log.file.path"
+    LOG_FILE_PATH_RESOLVED = "log.file.path_resolved"
+    LOG_FILE_INODE = "log.file.inode"
+    LOG_FILE_OFFSET = "log.file.offset"
+    SOURCE_ID = "source_id"
+    TOPIC = "topic"
+    HOST_NAME = "host.name"
+    HOST_IP = "host.ip"
+    INTERNAL_DATA_TYPE = "internal.data.type"
+    CONTAINER_INFO = "container.info"
+
+
+class ColumnarLogs:
+    """Columnar log events over a shared arena.
+
+    offsets/lengths: int32 [N] — raw content span of each event in the arena.
+    timestamps:      int64 [N]
+    fields:          name -> (offsets int32 [N], lengths int32 [N]) parsed
+                     field spans (device kernel output).  Length -1 marks
+                     "field absent" (parse failed for that event).
+    """
+
+    __slots__ = ("offsets", "lengths", "timestamps", "fields", "parse_ok")
+
+    def __init__(self, offsets: np.ndarray, lengths: np.ndarray,
+                 timestamps: Optional[np.ndarray] = None):
+        self.offsets = np.asarray(offsets, dtype=np.int32)
+        self.lengths = np.asarray(lengths, dtype=np.int32)
+        if timestamps is None:
+            timestamps = np.zeros(len(self.offsets), dtype=np.int64)
+        self.timestamps = np.asarray(timestamps, dtype=np.int64)
+        self.fields: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self.parse_ok: Optional[np.ndarray] = None  # bool [N]
+
+    def __len__(self) -> int:
+        return int(self.offsets.shape[0])
+
+    @property
+    def total_bytes(self) -> int:
+        return int(self.lengths.sum())
+
+    def set_field(self, name: str, offsets: np.ndarray, lengths: np.ndarray) -> None:
+        self.fields[name] = (np.asarray(offsets, dtype=np.int32),
+                             np.asarray(lengths, dtype=np.int32))
+
+
+class PipelineEventGroup:
+    __slots__ = ("_source_buffer", "_metadata", "_tags", "_events", "_columns",
+                 "_exactly_once_checkpoint")
+
+    def __init__(self, source_buffer: Optional[SourceBuffer] = None):
+        self._source_buffer = source_buffer if source_buffer is not None else SourceBuffer()
+        self._metadata: Dict[EventGroupMetaKey, StringView] = {}
+        self._tags: Dict[bytes, StringView] = {}
+        self._events: List[PipelineEvent] = []
+        self._columns: Optional[ColumnarLogs] = None
+        self._exactly_once_checkpoint = None
+
+    # -- buffer -------------------------------------------------------------
+
+    @property
+    def source_buffer(self) -> SourceBuffer:
+        return self._source_buffer
+
+    # -- metadata / tags ----------------------------------------------------
+
+    def set_metadata(self, key: EventGroupMetaKey, value: AnyStr) -> None:
+        vv = value if isinstance(value, StringView) else self._source_buffer.copy_string(value)
+        self._metadata[key] = vv
+
+    def get_metadata(self, key: EventGroupMetaKey) -> Optional[StringView]:
+        return self._metadata.get(key)
+
+    def has_metadata(self, key: EventGroupMetaKey) -> bool:
+        return key in self._metadata
+
+    def del_metadata(self, key: EventGroupMetaKey) -> None:
+        self._metadata.pop(key, None)
+
+    @property
+    def metadata(self) -> Dict[EventGroupMetaKey, StringView]:
+        return self._metadata
+
+    def set_tag(self, key: AnyStr, value: AnyStr) -> None:
+        vv = value if isinstance(value, StringView) else self._source_buffer.copy_string(value)
+        self._tags[as_bytes(key)] = vv
+
+    def get_tag(self, key: AnyStr) -> Optional[StringView]:
+        return self._tags.get(as_bytes(key))
+
+    def del_tag(self, key: AnyStr) -> None:
+        self._tags.pop(as_bytes(key), None)
+
+    @property
+    def tags(self) -> Dict[bytes, StringView]:
+        return self._tags
+
+    # -- events (row representation) ---------------------------------------
+
+    @property
+    def events(self) -> List[PipelineEvent]:
+        if self._columns is not None and not self._events:
+            self.materialize()
+        return self._events
+
+    def add_event(self, event: PipelineEvent) -> None:
+        self._events.append(event)
+
+    def add_log_event(self, timestamp: int = 0) -> LogEvent:
+        ev = LogEvent(timestamp)
+        self._events.append(ev)
+        return ev
+
+    def add_metric_event(self, timestamp: int = 0) -> MetricEvent:
+        ev = MetricEvent(timestamp)
+        self._events.append(ev)
+        return ev
+
+    def add_span_event(self, timestamp: int = 0) -> SpanEvent:
+        ev = SpanEvent(timestamp)
+        self._events.append(ev)
+        return ev
+
+    def add_raw_event(self, timestamp: int = 0) -> RawEvent:
+        ev = RawEvent(timestamp)
+        self._events.append(ev)
+        return ev
+
+    def __len__(self) -> int:
+        if self._columns is not None and not self._events:
+            return len(self._columns)
+        return len(self._events)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+    def event_type(self) -> EventType:
+        if self._columns is not None and not self._events:
+            return EventType.LOG
+        return self._events[0].type if self._events else EventType.NONE
+
+    # -- columnar representation (TPU fast path) ----------------------------
+
+    @property
+    def columns(self) -> Optional[ColumnarLogs]:
+        return self._columns
+
+    def set_columns(self, columns: ColumnarLogs) -> None:
+        self._columns = columns
+        self._events = []
+
+    def is_columnar(self) -> bool:
+        return self._columns is not None
+
+    def materialize(self) -> List[PipelineEvent]:
+        """Expand columns into per-event LogEvent objects (slow path)."""
+        cols = self._columns
+        if cols is None:
+            return self._events
+        sb = self._source_buffer
+        events: List[PipelineEvent] = []
+        field_items = list(cols.fields.items())
+        offs = cols.offsets
+        lens = cols.lengths
+        tss = cols.timestamps
+        for i in range(len(cols)):
+            ev = LogEvent(int(tss[i]))
+            if not field_items:
+                ev.set_content(b"content", sb.view(int(offs[i]), int(lens[i])))
+            else:
+                for name, (foffs, flens) in field_items:
+                    flen = int(flens[i])
+                    if flen >= 0:
+                        ev.set_content(name.encode() if isinstance(name, str) else name,
+                                       sb.view(int(foffs[i]), flen))
+            events.append(ev)
+        self._events = events
+        return events
+
+    def data_size(self) -> int:
+        if self._columns is not None and not self._events:
+            return self._columns.total_bytes
+        total = 0
+        for ev in self._events:
+            if isinstance(ev, LogEvent):
+                for k, v in ev.contents:
+                    total += len(k) + len(v)
+            elif isinstance(ev, RawEvent) and ev.content is not None:
+                total += len(ev.content)
+            else:
+                total += 64  # metric/span rough estimate
+        return total
+
+    # -- JSON round-trip (test fixture format, SURVEY.md §4) ----------------
+
+    def to_json(self) -> str:
+        out: dict = {
+            "metadata": {k.value: str(v) for k, v in self._metadata.items()},
+            "tags": {k.decode("utf-8", "replace"): str(v) for k, v in self._tags.items()},
+            "events": [],
+        }
+        for ev in self.events:
+            if isinstance(ev, LogEvent):
+                out["events"].append({
+                    "type": "log",
+                    "timestamp": ev.timestamp,
+                    "contents": {str(k): str(v) for k, v in ev.contents},
+                })
+            elif isinstance(ev, MetricEvent):
+                item = {
+                    "type": "metric",
+                    "timestamp": ev.timestamp,
+                    "name": str(ev.name) if ev.name else "",
+                    "tags": {k.decode("utf-8", "replace"): str(v) for k, v in ev.tags.items()},
+                }
+                if ev.value.is_multi():
+                    item["values"] = {k.decode("utf-8", "replace"): v
+                                      for k, v in ev.value.values.items()}
+                else:
+                    item["value"] = ev.value.value
+                out["events"].append(item)
+            elif isinstance(ev, SpanEvent):
+                out["events"].append({
+                    "type": "span",
+                    "timestamp": ev.timestamp,
+                    "traceId": ev.trace_id.decode("utf-8", "replace"),
+                    "spanId": ev.span_id.decode("utf-8", "replace"),
+                    "name": ev.name.decode("utf-8", "replace"),
+                    "kind": int(ev.kind),
+                    "startTimeNs": ev.start_time_ns,
+                    "endTimeNs": ev.end_time_ns,
+                    "attributes": {k.decode("utf-8", "replace"): str(v)
+                                   for k, v in ev.attributes.items()},
+                })
+            elif isinstance(ev, RawEvent):
+                out["events"].append({
+                    "type": "raw",
+                    "timestamp": ev.timestamp,
+                    "content": str(ev.content) if ev.content else "",
+                })
+        return json.dumps(out, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "PipelineEventGroup":
+        data = json.loads(text)
+        group = cls()
+        sb = group.source_buffer
+        for k, v in data.get("metadata", {}).items():
+            group.set_metadata(EventGroupMetaKey(k), v)
+        for k, v in data.get("tags", {}).items():
+            group.set_tag(k, v)
+        for item in data.get("events", []):
+            typ = item.get("type", "log")
+            if typ == "log":
+                ev = group.add_log_event(item.get("timestamp", 0))
+                for k, v in item.get("contents", {}).items():
+                    ev.set_content(sb.copy_string(k), sb.copy_string(v))
+            elif typ == "metric":
+                ev = group.add_metric_event(item.get("timestamp", 0))
+                ev.set_name(sb.copy_string(item.get("name", "")))
+                if "values" in item:
+                    ev.set_multi_value(item["values"])
+                else:
+                    ev.set_value(item.get("value", 0.0))
+                for k, v in item.get("tags", {}).items():
+                    ev.set_tag(k, sb.copy_string(v))
+            elif typ == "span":
+                ev = group.add_span_event(item.get("timestamp", 0))
+                ev.trace_id = item.get("traceId", "").encode()
+                ev.span_id = item.get("spanId", "").encode()
+                ev.name = item.get("name", "").encode()
+                ev.kind = SpanEvent.Kind(item.get("kind", 0))
+                ev.start_time_ns = item.get("startTimeNs", 0)
+                ev.end_time_ns = item.get("endTimeNs", 0)
+                for k, v in item.get("attributes", {}).items():
+                    ev.set_attribute(k, sb.copy_string(v))
+            elif typ == "raw":
+                ev = group.add_raw_event(item.get("timestamp", 0))
+                ev.set_content(sb.copy_string(item.get("content", "")))
+        return group
+
+    def copy_meta_to(self, other: "PipelineEventGroup") -> None:
+        for k, v in self._metadata.items():
+            other.set_metadata(k, v.to_bytes())
+        for k, v in self._tags.items():
+            other.set_tag(k, v.to_bytes())

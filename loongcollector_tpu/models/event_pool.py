@@ -1,0 +1,74 @@
+"""Event object recycling.
+
+Reference: core/models/EventPool.h:117 — same-thread lock-free pool +
+double-buffered cross-thread pool, GC'd from processor threads
+(runner/ProcessorRunner.cpp:188).  In Python the win is smaller, but the
+pool still avoids re-allocating LogEvent shells on the materialise path and
+keeps API parity for plugins written against the reference semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .events import LogEvent
+
+_POOL_GC_INTERVAL_S = 60.0
+
+
+class EventPool:
+    def __init__(self, enable_lock: bool = True):
+        self._enable_lock = enable_lock
+        self._pool: List[LogEvent] = []
+        self._swap_pool: List[LogEvent] = []  # cross-thread returns land here
+        self._lock = threading.Lock()
+        self._last_gc = time.monotonic()
+        self._min_unused = len(self._pool)
+
+    def acquire_log_event(self, timestamp: int = 0) -> LogEvent:
+        ev: Optional[LogEvent] = None
+        if self._pool:
+            ev = self._pool.pop()
+        elif self._swap_pool:
+            if self._enable_lock:
+                with self._lock:
+                    self._pool, self._swap_pool = self._swap_pool, self._pool
+            else:
+                self._pool, self._swap_pool = self._swap_pool, self._pool
+            if self._pool:
+                ev = self._pool.pop()
+        if ev is None:
+            return LogEvent(timestamp)
+        ev._contents.clear()
+        ev._index.clear()
+        ev.timestamp = timestamp
+        ev.timestamp_ns = None
+        return ev
+
+    def release(self, ev: LogEvent) -> None:
+        if self._enable_lock:
+            with self._lock:
+                self._swap_pool.append(ev)
+        else:
+            self._pool.append(ev)
+
+    def check_gc(self) -> None:
+        """Shrink to the high-water mark of unused objects (reference
+        EventPool.cpp:257 CheckGC)."""
+        now = time.monotonic()
+        if now - self._last_gc < _POOL_GC_INTERVAL_S:
+            return
+        self._last_gc = now
+        with self._lock:
+            keep = len(self._pool) - self._min_unused
+            if keep > 0:
+                del self._pool[keep:]
+            self._min_unused = len(self._pool)
+
+    def size(self) -> int:
+        return len(self._pool) + len(self._swap_pool)
+
+
+g_thread_event_pool = EventPool(enable_lock=True)

@@ -1,0 +1,4 @@
+from .flags import (DEFINE_FLAG_BOOL, DEFINE_FLAG_DOUBLE, DEFINE_FLAG_INT32,
+                    DEFINE_FLAG_INT64, DEFINE_FLAG_STRING, get_flag, set_flag)
+from .logger import get_logger
+from .stringview import StringView

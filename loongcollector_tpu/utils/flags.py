@@ -1,0 +1,90 @@
+"""Process-wide flag registry.
+
+Equivalent of the reference's gflags macro layer (core/common/Flags.h:21-55):
+compile-time defaults, overridable from the environment (``LOONG_<NAME>``) and
+at runtime (AppConfig hot-reload callbacks re-set flags).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List
+
+
+@dataclass
+class _Flag:
+    name: str
+    value: Any
+    default: Any
+    typ: type
+    help: str
+    callbacks: List[Callable[[Any], None]]
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.Lock()
+
+
+def _define(name: str, default: Any, typ: type, help_: str) -> None:
+    with _lock:
+        if name in _registry:
+            return
+        value = default
+        env = os.environ.get("LOONG_" + name.upper())
+        if env is not None:
+            if typ is bool:
+                value = env.lower() in ("1", "true", "yes", "on")
+            else:
+                value = typ(env)
+        _registry[name] = _Flag(name, value, default, typ, help_, [])
+
+
+def DEFINE_FLAG_INT32(name: str, help_: str, default: int) -> None:
+    _define(name, int(default), int, help_)
+
+
+def DEFINE_FLAG_INT64(name: str, help_: str, default: int) -> None:
+    _define(name, int(default), int, help_)
+
+
+def DEFINE_FLAG_BOOL(name: str, help_: str, default: bool) -> None:
+    _define(name, bool(default), bool, help_)
+
+
+def DEFINE_FLAG_DOUBLE(name: str, help_: str, default: float) -> None:
+    _define(name, float(default), float, help_)
+
+
+def DEFINE_FLAG_STRING(name: str, help_: str, default: str) -> None:
+    _define(name, str(default), str, help_)
+
+
+def get_flag(name: str) -> Any:
+    return _registry[name].value
+
+
+def has_flag(name: str) -> bool:
+    return name in _registry
+
+
+def set_flag(name: str, value: Any) -> None:
+    with _lock:
+        flag = _registry[name]
+        flag.value = flag.typ(value)
+        callbacks = list(flag.callbacks)
+    for cb in callbacks:
+        cb(value)
+
+
+def on_flag_change(name: str, callback: Callable[[Any], None]) -> None:
+    """Register a hot-reload callback (reference: AppConfig callback registry,
+    core/app_config/AppConfig.cpp + runner/FlusherRunner.cpp:43-44)."""
+    with _lock:
+        _registry[name].callbacks.append(callback)
+
+
+def all_flags() -> Dict[str, Any]:
+    with _lock:
+        return {k: f.value for k, f in _registry.items()}

@@ -1,0 +1,166 @@
+"""Event model tests.
+
+Mirrors the reference's core/unittest/models/ surface: content ordering,
+zero-copy views, JSON round-trip fixtures (PipelineEventGroup.h:140-146),
+columnar materialisation.
+"""
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import (ColumnarLogs, EventGroupMetaKey,
+                                       EventType, LogEvent, PipelineEventGroup,
+                                       SourceBuffer)
+from loongcollector_tpu.models.event_pool import EventPool
+from loongcollector_tpu.utils.stringview import StringView
+
+
+class TestSourceBuffer:
+    def test_copy_string_roundtrip(self):
+        sb = SourceBuffer()
+        v = sb.copy_string(b"hello world")
+        assert v.to_bytes() == b"hello world"
+        assert len(v) == 11
+
+    def test_views_survive_growth(self):
+        sb = SourceBuffer(capacity=16)
+        v1 = sb.copy_string(b"first")
+        sb.copy_string(b"x" * 10000)  # forces reallocation
+        assert v1.to_bytes() == b"first"
+
+    def test_as_array_zero_copy(self):
+        sb = SourceBuffer()
+        sb.copy_string(b"abc")
+        arr = sb.as_array()
+        assert arr.dtype == np.uint8
+        assert bytes(arr.tobytes()) == b"abc"
+
+    def test_substr(self):
+        sb = SourceBuffer()
+        v = sb.copy_string(b"hello world")
+        assert v.substr(6).to_bytes() == b"world"
+        assert v.substr(0, 5).to_bytes() == b"hello"
+
+
+class TestLogEvent:
+    def test_content_order_preserved(self):
+        ev = LogEvent(123)
+        ev.set_content(b"b", b"2")
+        ev.set_content(b"a", b"1")
+        ev.set_content(b"c", b"3")
+        keys = [k.to_bytes() for k, _ in ev.contents]
+        assert keys == [b"b", b"a", b"c"]
+
+    def test_overwrite_keeps_position(self):
+        ev = LogEvent()
+        ev.set_content(b"a", b"1")
+        ev.set_content(b"b", b"2")
+        ev.set_content(b"a", b"changed")
+        assert [k.to_bytes() for k, _ in ev.contents] == [b"a", b"b"]
+        assert ev.get_content(b"a") == b"changed"
+
+    def test_del_content(self):
+        ev = LogEvent()
+        ev.set_content(b"a", b"1")
+        ev.set_content(b"b", b"2")
+        ev.set_content(b"c", b"3")
+        ev.del_content(b"b")
+        assert not ev.has_content(b"b")
+        assert ev.get_content(b"c") == b"3"
+
+
+class TestPipelineEventGroup:
+    def test_add_events_and_type(self):
+        g = PipelineEventGroup()
+        g.add_log_event(1)
+        assert g.event_type() == EventType.LOG
+        assert len(g) == 1
+
+    def test_tags_metadata(self):
+        g = PipelineEventGroup()
+        g.set_tag(b"host", b"node-1")
+        g.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, "/var/log/app.log")
+        assert g.get_tag(b"host") == b"node-1"
+        assert g.get_metadata(EventGroupMetaKey.LOG_FILE_PATH) == "/var/log/app.log"
+
+    def test_json_roundtrip_log(self):
+        g = PipelineEventGroup()
+        g.set_tag(b"t", b"v")
+        ev = g.add_log_event(42)
+        sb = g.source_buffer
+        ev.set_content(sb.copy_string(b"k1"), sb.copy_string(b"v1"))
+        ev.set_content(sb.copy_string(b"k2"), sb.copy_string(b"v2"))
+        g2 = PipelineEventGroup.from_json(g.to_json())
+        assert g2.to_json() == g.to_json()
+
+    def test_json_roundtrip_metric_span(self):
+        g = PipelineEventGroup()
+        m = g.add_metric_event(10)
+        m.set_name(b"cpu")
+        m.set_value(0.5)
+        m.set_tag(b"core", b"0")
+        s = g.add_span_event(11)
+        s.trace_id = b"t" * 16
+        s.span_id = b"s" * 8
+        s.name = b"op"
+        g2 = PipelineEventGroup.from_json(g.to_json())
+        assert g2.to_json() == g.to_json()
+
+    def test_columnar_materialize(self):
+        sb = SourceBuffer()
+        data = b"line-one\nline-two2\n"
+        sb.copy_string(data)
+        cols = ColumnarLogs(offsets=np.array([0, 9]), lengths=np.array([8, 9]),
+                            timestamps=np.array([100, 101]))
+        g = PipelineEventGroup(sb)
+        g.set_columns(cols)
+        assert len(g) == 2
+        events = g.materialize()
+        assert events[0].get_content(b"content") == b"line-one"
+        assert events[1].get_content(b"content") == b"line-two2"
+        assert events[1].timestamp == 101
+
+    def test_columnar_with_fields(self):
+        sb = SourceBuffer()
+        sb.copy_string(b"GET /idx 200")
+        cols = ColumnarLogs(offsets=np.array([0]), lengths=np.array([12]))
+        cols.set_field("method", np.array([0]), np.array([3]))
+        cols.set_field("url", np.array([4]), np.array([4]))
+        cols.set_field("status", np.array([9]), np.array([3]))
+        g = PipelineEventGroup(sb)
+        g.set_columns(cols)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"method") == b"GET"
+        assert ev.get_content(b"url") == b"/idx"
+        assert ev.get_content(b"status") == b"200"
+
+    def test_columnar_absent_field(self):
+        sb = SourceBuffer()
+        sb.copy_string(b"xy")
+        cols = ColumnarLogs(offsets=np.array([0]), lengths=np.array([2]))
+        cols.set_field("f", np.array([0]), np.array([-1]))
+        g = PipelineEventGroup(sb)
+        g.set_columns(cols)
+        ev = g.materialize()[0]
+        assert not ev.has_content(b"f")
+
+
+class TestEventPool:
+    def test_acquire_release_reuse(self):
+        pool = EventPool()
+        ev = pool.acquire_log_event(5)
+        ev.set_content(b"k", b"v")
+        pool.release(ev)
+        ev2 = pool.acquire_log_event(9)
+        assert ev2.timestamp == 9
+        assert ev2.empty()
+
+
+class TestStringView:
+    def test_eq_and_hash(self):
+        a = StringView(b"abc")
+        b = StringView(bytearray(b"xabc"), 1, 3)
+        assert a == b
+        assert hash(a) == hash(b)
+        assert a == "abc"
+        assert a == b"abc"
