@@ -39,12 +39,15 @@ class EventPool:
                 self._pool, self._swap_pool = self._swap_pool, self._pool
             if self._pool:
                 ev = self._pool.pop()
+        self._min_unused = min(self._min_unused, len(self._pool))
         if ev is None:
             return LogEvent(timestamp)
         ev._contents.clear()
         ev._index.clear()
         ev.timestamp = timestamp
         ev.timestamp_ns = None
+        ev.level = None
+        ev.file_offset = 0
         return ev
 
     def release(self, ev: LogEvent) -> None:
@@ -62,9 +65,13 @@ class EventPool:
             return
         self._last_gc = now
         with self._lock:
-            keep = len(self._pool) - self._min_unused
-            if keep > 0:
-                del self._pool[keep:]
+            # fold cross-thread returns in, then shrink by the interval's
+            # low-water mark of unused objects (reference EventPool CheckGC)
+            self._pool.extend(self._swap_pool)
+            self._swap_pool.clear()
+            if self._min_unused > 0:
+                keep = len(self._pool) - self._min_unused
+                del self._pool[max(keep, 0):]
             self._min_unused = len(self._pool)
 
     def size(self) -> int:

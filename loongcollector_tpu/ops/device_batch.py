@@ -1,0 +1,92 @@
+"""Device batch assembly: variable-length events → fixed-geometry tensors.
+
+The hard part of putting a log parser on fixed-shape hardware (SURVEY.md §5.7,
+§7): events have arbitrary lengths, XLA wants static shapes.  Strategy:
+
+* row width L is quantised into LENGTH_BUCKETS; an event group picks the
+  smallest bucket ≥ its longest event (overlong events are separated out for
+  the CPU fallback path);
+* batch size B is rounded up to a power of two (≥ MIN_BATCH) with zero-length
+  padding rows, so each compiled kernel geometry (program, B, L) is reused;
+* packing the arena into [B, L] rows is one vectorised numpy gather — the
+  host-side analogue of the reference's single pread into the arena
+  (reader/LogFileReader.cpp:1518); spans returned by the kernel are
+  row-relative and are mapped back to arena offsets by adding row origins.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+LENGTH_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
+MIN_BATCH = 256
+MAX_BATCH = 65536
+
+
+def pick_length_bucket(max_len: int) -> Optional[int]:
+    for b in LENGTH_BUCKETS:
+        if max_len <= b:
+            return b
+    return None  # overlong → CPU fallback
+
+
+def pad_batch(n: int) -> int:
+    """Power-of-two batch size ≥ n, capped at MAX_BATCH (callers must chunk
+    inputs larger than MAX_BATCH)."""
+    b = MIN_BATCH
+    while b < n:
+        b *= 2
+    return min(b, MAX_BATCH)
+
+
+@dataclass
+class DeviceBatch:
+    """A packed batch plus the bookkeeping to map results back."""
+
+    rows: np.ndarray          # uint8 [B, L]
+    lengths: np.ndarray       # int32 [B] (0 for padding rows)
+    origins: np.ndarray       # int32 [B] arena offset of each row's byte 0
+    n_real: int               # number of non-padding rows
+
+
+def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
+              L: int, B: Optional[int] = None) -> DeviceBatch:
+    """Gather per-event byte rows out of the flat arena.
+
+    arena: uint8 [N]; offsets/lengths: int32 [n].  Events longer than L must
+    be filtered out by the caller beforehand.
+    """
+    n = len(offsets)
+    if B is None:
+        B = pad_batch(n)
+    assert n <= B
+    offsets = np.asarray(offsets, dtype=np.int64)
+    lengths32 = np.asarray(lengths, dtype=np.int32)
+    # index matrix [n, L], clipped so OOB reads land on a valid byte
+    idx = offsets[:, None] + np.arange(L, dtype=np.int64)[None, :]
+    np.clip(idx, 0, len(arena) - 1 if len(arena) else 0, out=idx)
+    rows = arena[idx] if len(arena) else np.zeros((n, L), np.uint8)
+    # zero out tail so padding bytes are deterministic
+    mask = np.arange(L, dtype=np.int32)[None, :] < lengths32[:, None]
+    rows &= mask.astype(np.uint8) * np.uint8(255)
+    if B > n:
+        rows = np.concatenate([rows, np.zeros((B - n, L), np.uint8)], axis=0)
+        lengths32 = np.concatenate([lengths32, np.zeros(B - n, np.int32)])
+        origins = np.concatenate(
+            [offsets.astype(np.int32), np.zeros(B - n, np.int32)])
+    else:
+        origins = offsets.astype(np.int32)
+    return DeviceBatch(rows=rows, lengths=lengths32, origins=origins, n_real=n)
+
+
+def split_by_length(offsets: np.ndarray, lengths: np.ndarray,
+                    max_bucket: int = LENGTH_BUCKETS[-1]
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (device_idx, overlong_idx) index arrays."""
+    lengths = np.asarray(lengths)
+    over = lengths > max_bucket
+    idx = np.arange(len(lengths))
+    return idx[~over], idx[over]

@@ -1,0 +1,90 @@
+"""Batched DFA execution on device (Tier-2 match kernel).
+
+For regular patterns that don't segment-compile (alternation, overlapping
+classes), all events advance a shared DFA in lockstep over byte columns.
+
+TPU mapping: gathers from a [S,K] table are per-element and slow, so the
+state is carried ONE-HOT [B, S] in bfloat16 and each step contracts
+(state ⊗ byte-class one-hot) with a dense [K·S, S] transition matrix on the
+MXU:
+
+    z[b, k·S+s] = cls_onehot[b,k] · state[b,s]       (VPU outer product)
+    state'      = z @ T                               (MXU matmul)
+
+Byte classes for all positions are precomputed with interval compares
+(no LUT gather).  The scan over positions is a lax.scan compiled once per
+(dfa, B, L) geometry.  Used by processor_filter and as the match-gate for
+capture-free paths; capture-needing Tier-2 patterns go to CPU (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..regex.dfa import DFA
+
+
+def build_dfa_match_fn(dfa: DFA):
+    """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) -> ok bool [B]."""
+    S = dfa.num_states
+    K = dfa.num_classes
+    # dense transition tensor T[k*S+s, s'] = 1 iff δ(s, k) = s'
+    T = np.zeros((K * S, S), dtype=np.float32)
+    for s in range(S):
+        for k in range(K):
+            T[k * S + s, int(dfa.transitions[s, k])] = 1.0
+    T_dev = jnp.asarray(T, dtype=jnp.bfloat16)
+    class_intervals = dfa.byte_class_intervals()
+    accepting = jnp.asarray(dfa.accepting)
+
+    def byte_classes(rows: jnp.ndarray) -> jnp.ndarray:
+        """uint8 [B, L] -> int32 [B, L] class ids via interval compares."""
+        cls = jnp.zeros(rows.shape, dtype=jnp.int32)
+        for k in range(1, K):  # class 0 is the default
+            m = jnp.zeros(rows.shape, dtype=bool)
+            for lo, hi in class_intervals[k]:
+                if lo == hi:
+                    m = m | (rows == lo)
+                else:
+                    m = m | ((rows >= lo) & (rows <= hi))
+            cls = jnp.where(m, k, cls)
+        return cls
+
+    def match(rows: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        B, L = rows.shape
+        cls = byte_classes(rows)                                   # [B, L]
+        pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+        # past-the-end positions freeze the state: encode as class K (identity)
+        cls = jnp.where(pos_valid, cls, K)
+        # extend T with an identity block for the freeze class
+        T_ext = jnp.concatenate(
+            [T_dev, jnp.tile(jnp.eye(S, dtype=jnp.bfloat16), (1, 1))], axis=0)
+
+        state0 = jax.nn.one_hot(dfa.start, S, dtype=jnp.bfloat16)
+        state0 = jnp.broadcast_to(state0, (B, S))
+
+        def step(state, cls_t):
+            # cls_t: [B] int32
+            coh = jax.nn.one_hot(cls_t, K + 1, dtype=jnp.bfloat16)  # [B, K+1]
+            z = (coh[:, :, None] * state[:, None, :]).reshape(B, (K + 1) * S)
+            nxt = jnp.dot(z, T_ext, preferred_element_type=jnp.bfloat16)
+            return nxt, None
+
+        final, _ = jax.lax.scan(step, state0, cls.T)               # scan over L
+        final_state = jnp.argmax(final, axis=1)
+        return jnp.take(accepting, final_state)
+
+    return match
+
+
+class DFAMatchKernel:
+    def __init__(self, dfa: DFA):
+        self.dfa = dfa
+        self._fn = jax.jit(build_dfa_match_fn(dfa))
+
+    def __call__(self, rows, lengths) -> np.ndarray:
+        return self._fn(rows, lengths)

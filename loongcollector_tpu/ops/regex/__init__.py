@@ -1,0 +1,3 @@
+from .charclass import CharClass
+from .program import (SegmentProgram, Tier1Unsupported, compile_tier1,
+                      classify_pattern, PatternTier)

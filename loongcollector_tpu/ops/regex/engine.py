@@ -1,0 +1,153 @@
+"""Unified regex engine: tier dispatch + batch orchestration.
+
+The single entry point processors use.  Given a pattern, picks the execution
+tier (segment kernel / DFA kernel / CPU `re`), owns geometry bucketing and
+row packing, and returns arena-absolute capture spans so downstream stays
+zero-copy (SURVEY.md §7 step 4: spans must index the ORIGINAL arena).
+
+Oversize events (> largest length bucket) and CPU-tier patterns run through
+the Python `re` fallback with identical semantics — the reference's
+"route unsupported patterns to CPU" contract.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows,
+                            pick_length_bucket)
+
+
+def _chunks(idx: np.ndarray, size: int):
+    for i in range(0, len(idx), size):
+        yield idx[i : i + size]
+from ..kernels.dfa_scan import DFAMatchKernel
+from ..kernels.field_extract import ExtractKernel
+from .dfa import DFAUnsupported, compile_dfa
+from .program import PatternTier, Tier1Unsupported, compile_tier1
+
+
+class BatchParseResult:
+    """ok: bool [N]; cap_off/cap_len: int32 [N, C] arena-absolute spans
+    (len -1 ⇒ no capture / failed parse)."""
+
+    __slots__ = ("ok", "cap_off", "cap_len")
+
+    def __init__(self, ok, cap_off, cap_len):
+        self.ok = ok
+        self.cap_off = cap_off
+        self.cap_len = cap_len
+
+
+class RegexEngine:
+    def __init__(self, pattern: str, force_tier: Optional[PatternTier] = None):
+        if isinstance(pattern, bytes):
+            pattern = pattern.decode("latin-1")
+        self.pattern = pattern
+        self._re = re.compile(pattern.encode("latin-1"))
+        self.num_caps = self._re.groups
+        self.group_names = {v - 1: k for k, v in self._re.groupindex.items()}
+        self._segment_kernel: Optional[ExtractKernel] = None
+        self._dfa_kernel: Optional[DFAMatchKernel] = None
+        self.tier = PatternTier.CPU
+        if force_tier in (None, PatternTier.SEGMENT):
+            try:
+                self._segment_kernel = ExtractKernel(compile_tier1(pattern))
+                self.tier = PatternTier.SEGMENT
+            except Tier1Unsupported:
+                pass
+        if self.tier is PatternTier.CPU and force_tier in (None, PatternTier.DFA):
+            try:
+                self._dfa_kernel = DFAMatchKernel(compile_dfa(pattern))
+                self.tier = PatternTier.DFA
+            except DFAUnsupported:
+                pass
+        if force_tier is not None and self.tier is not force_tier \
+                and force_tier is not PatternTier.CPU:
+            raise ValueError(f"pattern {pattern!r} cannot run at {force_tier}")
+
+    # ------------------------------------------------------------------
+
+    def parse_batch(self, arena: np.ndarray, offsets: np.ndarray,
+                    lengths: np.ndarray) -> BatchParseResult:
+        """Full-match + captures for N events over a shared arena."""
+        offsets = np.asarray(offsets, dtype=np.int64)
+        lengths = np.asarray(lengths, dtype=np.int32)
+        n = len(offsets)
+        C = max(self.num_caps, 1)
+        ok = np.zeros(n, dtype=bool)
+        cap_off = np.zeros((n, C), dtype=np.int32)
+        cap_len = np.full((n, C), -1, dtype=np.int32)
+        if n == 0:
+            return BatchParseResult(ok, cap_off, cap_len)
+
+        max_bucket = LENGTH_BUCKETS[-1]
+        over = lengths > max_bucket
+        device_idx = np.nonzero(~over)[0]
+        cpu_idx = np.nonzero(over)[0]
+
+        if self.tier is PatternTier.CPU or self._segment_kernel is None:
+            cpu_idx = np.arange(n)
+            device_idx = np.array([], dtype=np.int64)
+
+        for chunk in _chunks(device_idx, MAX_BATCH):
+            d_off = offsets[chunk]
+            d_len = lengths[chunk]
+            L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) or max_bucket
+            batch = pack_rows(arena, d_off, d_len, L)
+            k_ok, k_off, k_len = self._segment_kernel(batch.rows, batch.lengths)
+            k_ok = np.asarray(k_ok)[: batch.n_real]
+            k_off = np.asarray(k_off)[: batch.n_real]
+            k_len = np.asarray(k_len)[: batch.n_real]
+            ok[chunk] = k_ok
+            # row-relative → arena-absolute
+            cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
+            cap_len[chunk] = k_len
+
+        for i in cpu_idx:
+            o, ln = int(offsets[i]), int(lengths[i])
+            m = self._re.fullmatch(bytes(arena[o : o + ln].tobytes()))
+            if m is not None:
+                ok[i] = True
+                for g in range(self.num_caps):
+                    s, e = m.span(g + 1)
+                    if s >= 0:
+                        cap_off[i, g] = o + s
+                        cap_len[i, g] = e - s
+        return BatchParseResult(ok, cap_off, cap_len)
+
+    def match_batch(self, arena: np.ndarray, offsets: np.ndarray,
+                    lengths: np.ndarray) -> np.ndarray:
+        """Full-match boolean only (filtering) — can use the DFA tier."""
+        offsets = np.asarray(offsets, dtype=np.int64)
+        lengths = np.asarray(lengths, dtype=np.int32)
+        n = len(offsets)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if self.tier is PatternTier.SEGMENT:
+            return self.parse_batch(arena, offsets, lengths).ok
+        if self.tier is PatternTier.DFA:
+            ok = np.zeros(n, dtype=bool)
+            max_bucket = LENGTH_BUCKETS[-1]
+            over = lengths > max_bucket
+            device_idx = np.nonzero(~over)[0]
+            for chunk in _chunks(device_idx, MAX_BATCH):
+                d_off = offsets[chunk]
+                d_len = lengths[chunk]
+                L = pick_length_bucket(int(d_len.max())) or max_bucket
+                batch = pack_rows(arena, d_off, d_len, L)
+                k_ok = np.asarray(self._dfa_kernel(batch.rows, batch.lengths))
+                ok[chunk] = k_ok[: batch.n_real]
+            for i in np.nonzero(over)[0]:
+                o, ln = int(offsets[i]), int(lengths[i])
+                ok[i] = self._re.fullmatch(bytes(arena[o : o + ln].tobytes())) is not None
+            return ok
+        # CPU tier
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            o, ln = int(offsets[i]), int(lengths[i])
+            ok[i] = self._re.fullmatch(bytes(arena[o : o + ln].tobytes())) is not None
+        return ok

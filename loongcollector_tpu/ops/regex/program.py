@@ -1,0 +1,329 @@
+"""Regex → Tier-1 "segment program" compiler.
+
+The reference parses each event with boost::regex full-match on a CPU thread
+(core/plugin/processor/ProcessorParseRegexNative.cpp:186-253, RegexLogLineParser).
+Log-parsing regexes are overwhelmingly *anchored sequences of character-class
+runs separated by literal delimiters* — e.g. Apache/nginx access patterns,
+grok expansions, delimiter formats.  Such patterns need no general automaton:
+they compile to a **segment program** whose device execution is pure
+vectorised arithmetic (interval compares, suffix scans, cursor gathers) over a
+[batch, length] byte tensor — the TPU-idiomatic replacement for the per-event
+NFA loop.
+
+Tiers (SURVEY.md §7 step 4):
+  Tier 1  segment program      → field_extract kernel (this module)
+  Tier 2  general DFA (no captures, no backrefs/lookaround) → dfa_scan kernel
+  Tier 3  anything else        → CPU fallback (Python `re`)
+
+Semantics contract: FULL match of the event content (the reference uses
+regex_match, i.e. anchored both ends), greedy quantifiers, captures as byte
+(offset, length) spans.  The compiler REJECTS (raises Tier1Unsupported) any
+pattern whose greedy semantics could require backtracking, so every accepted
+program is exactly equivalent to the backtracking engine on all inputs —
+enforced by differential tests (tests/test_regex_program.py).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+try:  # Python 3.11+
+    from re import _constants as sre_c
+    from re import _parser as sre_parse
+except ImportError:  # pragma: no cover
+    import sre_constants as sre_c
+    import sre_parse
+
+from .charclass import CharClass
+
+MAXREPEAT = sre_c.MAXREPEAT
+INF = 1 << 30
+
+
+class Tier1Unsupported(Exception):
+    """Pattern cannot be compiled to a backtracking-free segment program."""
+
+
+class PatternTier(enum.IntEnum):
+    SEGMENT = 1  # field_extract kernel
+    DFA = 2      # dfa_scan kernel (match only)
+    CPU = 3      # Python re fallback
+
+
+# ---------------------------------------------------------------------------
+# Program ops
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Lit:
+    """Match a literal byte string at the cursor."""
+
+    data: bytes
+
+
+@dataclass
+class Span:
+    """Greedy run of `cls` bytes, min_len ≤ run ≤ max_len (max_len may be INF).
+
+    Compiled only when maximal-munch is provably equivalent to backtracking
+    semantics (the follow set is disjoint from `cls`), so the kernel can take
+    the full run unconditionally.
+    """
+
+    class_id: int
+    min_len: int
+    max_len: int
+
+
+@dataclass
+class FixedSpan:
+    """Exactly n bytes, all members of `cls` — validated via membership
+    prefix-sums, so no disjointness requirement (e.g. `(\\d{4})(\\d{2})`)."""
+
+    class_id: int
+    n: int
+
+
+@dataclass
+class CapStart:
+    cap_id: int
+
+
+@dataclass
+class CapEnd:
+    cap_id: int
+
+
+Op = Union[Lit, Span, FixedSpan, CapStart, CapEnd]
+
+
+@dataclass
+class SegmentProgram:
+    pattern: str
+    ops: List[Op] = field(default_factory=list)
+    classes: List[CharClass] = field(default_factory=list)
+    num_caps: int = 0
+    group_names: Dict[int, str] = field(default_factory=dict)
+
+    def class_id(self, cls: CharClass) -> int:
+        for i, c in enumerate(self.classes):
+            if c == cls:
+                return i
+        self.classes.append(cls)
+        return len(self.classes) - 1
+
+    # which classes need which auxiliary scans (kernel planning)
+    def scan_requirements(self) -> Tuple[set, set]:
+        """Returns (next_non_classes, cumsum_classes)."""
+        next_non, cumsum = set(), set()
+        for op in self.ops:
+            if isinstance(op, Span):
+                next_non.add(op.class_id)
+            elif isinstance(op, FixedSpan):
+                cumsum.add(op.class_id)
+        return next_non, cumsum
+
+    def max_reach(self) -> int:
+        """Minimum event length that could possibly match (for bucketing)."""
+        n = 0
+        for op in self.ops:
+            if isinstance(op, Lit):
+                n += len(op.data)
+            elif isinstance(op, (Span,)):
+                n += op.min_len
+            elif isinstance(op, FixedSpan):
+                n += op.n
+        return n
+
+
+# ---------------------------------------------------------------------------
+# sre AST → flat item list
+# ---------------------------------------------------------------------------
+
+
+def _flatten(tokens, prog: SegmentProgram, ops: List[Op]) -> None:
+    """Recursively translate an sre token sequence into ops (no validation of
+    backtracking-freedom yet — that's the second pass)."""
+    pending_lit = bytearray()
+
+    def flush_lit():
+        if pending_lit:
+            ops.append(Lit(bytes(pending_lit)))
+            pending_lit.clear()
+
+    for tok_op, av in tokens:
+        if tok_op is sre_c.LITERAL:
+            if av > 255:
+                raise Tier1Unsupported("non-byte literal")
+            pending_lit.append(av)
+        elif tok_op is sre_c.NOT_LITERAL:
+            flush_lit()
+            cid = prog.class_id(CharClass.single(av).negated())
+            ops.append(FixedSpan(cid, 1))
+        elif tok_op is sre_c.IN:
+            flush_lit()
+            cid = prog.class_id(CharClass.from_sre_in(av))
+            ops.append(FixedSpan(cid, 1))
+        elif tok_op is sre_c.ANY:
+            flush_lit()
+            cid = prog.class_id(CharClass.dot())
+            ops.append(FixedSpan(cid, 1))
+        elif tok_op is sre_c.CATEGORY:
+            flush_lit()
+            cid = prog.class_id(CharClass.from_category(av))
+            ops.append(FixedSpan(cid, 1))
+        elif tok_op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+            flush_lit()
+            lo, hi, sub = av
+            hi = INF if hi is MAXREPEAT else int(hi)
+            lo = int(lo)
+            cls = _single_class(sub)
+            if cls is None:
+                raise Tier1Unsupported("repeat of non-class subpattern")
+            cid = prog.class_id(cls)
+            if lo == hi:
+                ops.append(FixedSpan(cid, lo))
+            else:
+                # Lazy repeats compile identically to greedy ones: both are
+                # only accepted when the class is disjoint from the follow
+                # set, in which case the run is forced and lazy ≡ greedy.
+                ops.append(Span(cid, lo, hi))
+        elif tok_op is sre_c.SUBPATTERN:
+            flush_lit()
+            group, add_flags, del_flags, sub = av
+            if add_flags or del_flags:
+                raise Tier1Unsupported("inline flags")
+            if group is not None:
+                cap = group - 1
+                prog.num_caps = max(prog.num_caps, group)
+                ops.append(CapStart(cap))
+                _flatten(sub, prog, ops)
+                ops.append(CapEnd(cap))
+            else:
+                _flatten(sub, prog, ops)
+        elif tok_op is sre_c.AT:
+            # Edge anchors are stripped at top level by compile_tier1 before
+            # flattening; any AT surviving to here (interior ^/$, \b, \B)
+            # has position-dependent semantics the segment walk can't model.
+            raise Tier1Unsupported(f"assertion {av}")
+        elif tok_op is sre_c.BRANCH:
+            raise Tier1Unsupported("alternation")
+        else:
+            raise Tier1Unsupported(f"op {tok_op}")
+    flush_lit()
+
+
+def _single_class(sub) -> Optional[CharClass]:
+    """If an sre subpattern is a single char-class-like token, return it."""
+    toks = list(sub)
+    if len(toks) != 1:
+        return None
+    tok_op, av = toks[0]
+    if tok_op is sre_c.LITERAL:
+        return CharClass.single(av)
+    if tok_op is sre_c.NOT_LITERAL:
+        return CharClass.single(av).negated()
+    if tok_op is sre_c.IN:
+        return CharClass.from_sre_in(av)
+    if tok_op is sre_c.ANY:
+        return CharClass.dot()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Validation: maximal munch ≡ backtracking
+# ---------------------------------------------------------------------------
+
+
+def _first_set(ops: Sequence[Op], i: int, prog: SegmentProgram) -> Tuple[CharClass, bool]:
+    """Set of bytes that can begin the match of ops[i:]; bool = 'can be empty'
+    (end of pattern reachable without consuming)."""
+    mask = CharClass.from_bytes(b"")
+    j = i
+    while j < len(ops):
+        op = ops[j]
+        if isinstance(op, (CapStart, CapEnd)):
+            j += 1
+            continue
+        if isinstance(op, Lit):
+            return mask.union(CharClass.single(op.data[0])), False
+        if isinstance(op, FixedSpan):
+            if op.n == 0:
+                j += 1
+                continue
+            return mask.union(prog.classes[op.class_id]), False
+        if isinstance(op, Span):
+            mask = mask.union(prog.classes[op.class_id])
+            if op.min_len > 0:
+                return mask, False
+            j += 1
+            continue
+        raise AssertionError(op)
+    return mask, True
+
+
+def _validate_and_bind(prog: SegmentProgram) -> None:
+    ops = prog.ops
+    for i, op in enumerate(ops):
+        if isinstance(op, Span):
+            # maximal munch (plus the {m,n} length check) is equivalent to
+            # backtracking only when the follow set is disjoint from the class
+            follow, can_end = _first_set(ops, i + 1, prog)
+            cls = prog.classes[op.class_id]
+            if cls.intersects(follow):
+                raise Tier1Unsupported(
+                    f"greedy class {cls} overlaps follow set {follow}")
+            # can_end: span runs to end of line — fine (full-match checks len)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def _strip_edge_anchors(tokens):
+    """Remove a leading ^ and trailing $ (redundant under full-match
+    semantics).  Interior/boundary assertions are rejected in _flatten."""
+    at_begin = (sre_c.AT_BEGINNING, sre_c.AT_BEGINNING_STRING)
+    at_end = (sre_c.AT_END, sre_c.AT_END_STRING)
+    while tokens and tokens[0][0] is sre_c.AT and tokens[0][1] in at_begin:
+        tokens = tokens[1:]
+    while tokens and tokens[-1][0] is sre_c.AT and tokens[-1][1] in at_end:
+        tokens = tokens[:-1]
+    return tokens
+
+
+def compile_tier1(pattern: Union[str, bytes]) -> SegmentProgram:
+    if isinstance(pattern, bytes):
+        pattern = pattern.decode("latin-1")
+    try:
+        tree = sre_parse.parse(pattern)
+    except Exception as e:  # noqa: BLE001
+        raise Tier1Unsupported(f"parse error: {e}") from e
+    prog = SegmentProgram(pattern=pattern)
+    try:
+        names = tree.state.groupdict
+        prog.group_names = {v - 1: k for k, v in names.items()}
+    except AttributeError:
+        pass
+    tokens = _strip_edge_anchors(list(tree))
+    _flatten(tokens, prog, prog.ops)
+    _validate_and_bind(prog)
+    return prog
+
+
+def classify_pattern(pattern: Union[str, bytes]) -> PatternTier:
+    try:
+        compile_tier1(pattern)
+        return PatternTier.SEGMENT
+    except Tier1Unsupported:
+        pass
+    from .dfa import compile_dfa, DFAUnsupported
+    try:
+        compile_dfa(pattern)
+        return PatternTier.DFA
+    except DFAUnsupported:
+        return PatternTier.CPU

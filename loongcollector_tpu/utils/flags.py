@@ -72,6 +72,8 @@ def has_flag(name: str) -> bool:
 def set_flag(name: str, value: Any) -> None:
     with _lock:
         flag = _registry[name]
+        if flag.typ is bool and isinstance(value, str):
+            value = value.lower() in ("1", "true", "yes", "on")
         flag.value = flag.typ(value)
         callbacks = list(flag.callbacks)
     for cb in callbacks:

