@@ -1,0 +1,4 @@
+from .application import main
+import sys
+
+sys.exit(main())

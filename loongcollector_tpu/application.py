@@ -1,0 +1,131 @@
+"""Application: the agent process.
+
+Reference: core/application/Application.cpp — Init (:96: identity, dirs,
+app_info), Start (:222: monitors → config providers → runners sink-to-source
+→ registry → 1 Hz supervision loop :313-398), Exit (:417: ordered stop with
+a flush-out budget); core/logtail.cpp:154 (main: flags, signal handlers).
+
+Run: python -m loongcollector_tpu --config <dir> [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+from .config.watcher import PipelineConfigWatcher
+from .input.file.file_server import FileServer
+from .monitor.metrics import WriteMetrics
+from .pipeline.batch.timeout_flush_manager import TimeoutFlushManager
+from .pipeline.pipeline_manager import CollectionPipelineManager
+from .pipeline.queue.process_queue_manager import ProcessQueueManager
+from .pipeline.queue.sender_queue import SenderQueueManager
+from .runner.flusher_runner import FlusherRunner
+from .runner.http_sink import HttpSink
+from .runner.processor_runner import ProcessorRunner
+from .utils import flags
+from .utils.logger import get_logger
+
+log = get_logger("application")
+
+flags.DEFINE_FLAG_INT32("process_thread_count", "processor runner threads", 1)
+flags.DEFINE_FLAG_INT32("config_scan_interval", "config rescan seconds", 10)
+flags.DEFINE_FLAG_INT32("checkpoint_dump_interval", "checkpoint dump seconds", 5)
+flags.DEFINE_FLAG_DOUBLE("exit_flush_timeout", "flush-out budget on exit (s)", 20.0)
+
+
+class Application:
+    def __init__(self, config_dir: str, data_dir: str = ""):
+        self.config_dir = config_dir
+        self.data_dir = data_dir or os.path.join(
+            os.path.expanduser("~"), ".loongcollector_tpu")
+        self.process_queue_manager = ProcessQueueManager()
+        self.sender_queue_manager = SenderQueueManager()
+        self.pipeline_manager = CollectionPipelineManager(
+            self.process_queue_manager, self.sender_queue_manager)
+        self.http_sink = HttpSink()
+        self.flusher_runner = FlusherRunner(self.sender_queue_manager,
+                                            self.http_sink)
+        self.processor_runner = ProcessorRunner(
+            self.process_queue_manager, self.pipeline_manager,
+            thread_count=flags.get_flag("process_thread_count"))
+        self.config_watcher = PipelineConfigWatcher()
+        self._sig_stop = threading.Event()
+
+    def init(self) -> None:
+        os.makedirs(self.data_dir, exist_ok=True)
+        fs = FileServer.instance()
+        fs.process_queue_manager = self.process_queue_manager
+        fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
+        self.config_watcher.add_source(self.config_dir)
+
+    def start(self, once: bool = False) -> None:
+        # sink-to-source: network sink → flusher runner → processor runner →
+        # config/pipelines (which start inputs)
+        self.http_sink.init()
+        self.flusher_runner.init()
+        self.processor_runner.init()
+        log.info("runners started; watching %s", self.config_dir)
+        scan_interval = flags.get_flag("config_scan_interval")
+        last_scan = 0.0
+        while not self._sig_stop.is_set():
+            now = time.monotonic()
+            if now - last_scan >= (0 if last_scan == 0 else scan_interval):
+                last_scan = now
+                diff = self.config_watcher.check_config_diff()
+                if not diff.empty():
+                    self.pipeline_manager.update_pipelines(diff)
+            if once:
+                # drain mode for one-shot runs: wait until queues idle
+                time.sleep(1.0)
+                if (self.process_queue_manager.all_empty()
+                        and self.sender_queue_manager.all_empty()):
+                    break
+            else:
+                self._sig_stop.wait(1.0)
+        self.exit()
+
+    def exit(self) -> None:
+        """Ordered source-to-sink shutdown (reference Application::Exit +
+        CollectionPipeline::Stop :491-532): inputs stop first, the processor
+        runner drains the process queues THROUGH the pipelines, and only then
+        are batchers final-flushed and the send path drained."""
+        log.info("exiting: stopping inputs and draining")
+        FileServer.instance().stop()
+        self.processor_runner.stop()          # drains process queues
+        self.pipeline_manager.stop_all()      # flush batchers, stop flushers
+        TimeoutFlushManager.instance().flush_timeout_batches()
+        self.flusher_runner.stop(
+            drain=True, timeout=flags.get_flag("exit_flush_timeout"))
+        self.http_sink.stop()
+        log.info("exit complete")
+
+    def handle_signal(self, signum, frame) -> None:  # noqa: ARG002
+        log.info("signal %d received", signum)
+        self._sig_stop.set()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="loongcollector_tpu")
+    parser.add_argument("--config", required=True,
+                        help="pipeline config directory")
+    parser.add_argument("--data-dir", default="",
+                        help="checkpoint/state directory")
+    parser.add_argument("--once", action="store_true",
+                        help="process available data then exit")
+    args = parser.parse_args(argv)
+
+    app = Application(args.config, args.data_dir)
+    signal.signal(signal.SIGTERM, app.handle_signal)
+    signal.signal(signal.SIGINT, app.handle_signal)
+    app.init()
+    app.start(once=args.once)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Pipeline config directory watcher.
+
+Reference: core/config/watcher/PipelineConfigWatcher.cpp — scans watched
+directories every poll round, diffs by mtime+size, and emits a ConfigDiff
+{added, modified, removed} that the pipeline manager applies atomically
+(application/Application.cpp:323-331).
+
+Config files: one pipeline per YAML or JSON file; the stem is the pipeline
+name.  YAML is parsed when PyYAML exists (baked in transformers deps),
+JSON always.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..pipeline.pipeline_manager import ConfigDiff
+from ..utils.logger import get_logger
+
+log = get_logger("config_watcher")
+
+try:
+    import yaml as _yaml
+except ImportError:  # pragma: no cover
+    _yaml = None
+
+
+def load_config_file(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    if path.endswith((".yaml", ".yml")):
+        if _yaml is None:
+            log.error("PyYAML unavailable; cannot load %s", path)
+            return None
+        try:
+            return _yaml.safe_load(text)
+        except _yaml.YAMLError as e:
+            log.error("bad yaml %s: %s", path, e)
+            return None
+    try:
+        return json.loads(text)
+    except ValueError as e:
+        log.error("bad json %s: %s", path, e)
+        return None
+
+
+class PipelineConfigWatcher:
+    def __init__(self) -> None:
+        self._dirs: List[str] = []
+        self._state: Dict[str, Tuple[float, int]] = {}  # path -> (mtime, size)
+
+    def add_source(self, directory: str) -> None:
+        if directory not in self._dirs:
+            self._dirs.append(directory)
+
+    def check_config_diff(self) -> ConfigDiff:
+        diff = ConfigDiff()
+        seen: Dict[str, str] = {}  # name -> path
+        for d in self._dirs:
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                if not fn.endswith((".json", ".yaml", ".yml")):
+                    continue
+                path = os.path.join(d, fn)
+                name = os.path.splitext(fn)[0]
+                if name in seen:
+                    continue
+                seen[name] = path
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                sig = (st.st_mtime, st.st_size)
+                old = self._state.get(path)
+                if old == sig:
+                    continue
+                cfg = load_config_file(path)
+                if cfg is None:
+                    continue
+                self._state[path] = sig
+                if old is None:
+                    diff.added[name] = cfg
+                else:
+                    diff.modified[name] = cfg
+        # removals: tracked paths whose file vanished
+        for path in list(self._state):
+            if not os.path.exists(path):
+                del self._state[path]
+                name = os.path.splitext(os.path.basename(path))[0]
+                if name not in seen:
+                    diff.removed.append(name)
+        return diff

@@ -1,0 +1,15 @@
+"""Flusher plugins (reference: core/plugin/flusher/, SURVEY.md §2.4)."""
+
+
+def register_all(registry) -> None:
+    from .blackhole import FlusherBlackHole
+    from .file import FlusherFile
+    from .stdout import FlusherStdout
+    from .http import FlusherHTTP
+    from .sls import FlusherSLS
+
+    registry.register_flusher("flusher_stdout", FlusherStdout)
+    registry.register_flusher("flusher_file", FlusherFile)
+    registry.register_flusher("flusher_blackhole", FlusherBlackHole)
+    registry.register_flusher("flusher_http", FlusherHTTP)
+    registry.register_flusher("flusher_sls", FlusherSLS)

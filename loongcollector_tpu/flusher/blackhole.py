@@ -1,0 +1,35 @@
+"""flusher_blackhole — perf-testing sink (reference
+core/plugin/flusher/blackhole/FlusherBlackHole.cpp): serializes then drops,
+counting bytes."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Flusher, PluginContext
+from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
+
+
+class FlusherBlackHole(Flusher):
+    name = "flusher_blackhole"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.serializer = SLSEventGroupSerializer()
+        self.total_bytes = 0
+        self.total_events = 0
+        self.serialize = True
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.serialize = bool(config.get("Serialize", True))
+        return True
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        self.total_events += len(group)
+        if self.serialize:
+            self.total_bytes += len(self.serializer.serialize([group]))
+        else:
+            self.total_bytes += group.data_size()
+        return True

@@ -1,0 +1,107 @@
+"""flusher_http — generic HTTP sink through the sender-queue path.
+
+Reference: the HttpFlusher interface (collection_pipeline/plugin/interface/
+HttpFlusher.h): BuildRequest produces the request for the sink thread;
+OnSendDone handles the response.  Payloads are serialized + compressed, then
+queued as SenderQueueItems for FlusherRunner → HttpSink dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from ..models import PipelineEventGroup
+from ..pipeline.batch.batcher import Batcher
+from ..pipeline.batch.flush_strategy import FlushStrategy
+from ..pipeline.compression import create_compressor
+from ..pipeline.plugin.interface import Flusher, PluginContext
+from ..pipeline.queue.sender_queue import SenderQueueItem
+from ..pipeline.serializer.json_serializer import JsonSerializer
+from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
+
+
+class HttpRequest:
+    __slots__ = ("method", "url", "headers", "body", "timeout")
+
+    def __init__(self, method: str, url: str, headers: Dict[str, str],
+                 body: bytes, timeout: float = 15.0):
+        self.method = method
+        self.url = url
+        self.headers = headers
+        self.body = body
+        self.timeout = timeout
+
+
+class FlusherHTTP(Flusher):
+    name = "flusher_http"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.remote_url = ""
+        self.headers: Dict[str, str] = {}
+        self.serializer = None
+        self.compressor = None
+        self.batcher: Batcher = None  # type: ignore
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.remote_url = config.get("RemoteURL", "")
+        if not self.remote_url:
+            return False
+        self.headers = dict(config.get("Headers", {}))
+        fmt = config.get("Format", "json")
+        self.serializer = (SLSEventGroupSerializer() if fmt == "sls_pb"
+                           else JsonSerializer())
+        self.compressor = create_compressor(config.get("Compression"))
+        strategy = FlushStrategy(
+            min_cnt=int(config.get("MinCnt", 0)),
+            min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),
+            max_size_bytes=int(config.get("MaxSizeBytes", 5 * 1024 * 1024)),
+            timeout_secs=float(config.get("TimeoutSecs", 1.0)))
+        self.batcher = Batcher(strategy, on_flush=self._serialize_and_push,
+                               flusher_id=self.name,
+                               pipeline_name=context.pipeline_name)
+        return True
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        self.batcher.add(group)
+        return True
+
+    def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        data = self.serializer.serialize(groups)
+        raw_size = len(data)
+        payload = self.compressor.compress(data)
+        item = SenderQueueItem(payload, raw_size, flusher=self,
+                               queue_key=self.queue_key)
+        if self.sender_queue is not None:
+            self.sender_queue.push(item)
+
+    def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        headers = dict(self.headers)
+        headers.setdefault("Content-Type",
+                           "application/x-protobuf"
+                           if isinstance(self.serializer, SLSEventGroupSerializer)
+                           else "application/json")
+        if self.compressor.name != "none":
+            headers["Content-Encoding"] = self.compressor.name
+            headers["x-log-bodyrawsize"] = str(item.raw_size)
+        return HttpRequest("POST", self.remote_url, headers, item.data)
+
+    def on_send_done(self, item: SenderQueueItem, status: int,
+                     body: bytes) -> str:
+        """Returns 'ok' | 'retry' | 'drop' (reference OnSendDone semantics)."""
+        if 200 <= status < 300:
+            return "ok"
+        if status in (429, 500, 502, 503, 504) or status <= 0:
+            return "retry"
+        return "drop"
+
+    def flush_all(self) -> bool:
+        self.batcher.flush_all()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self.batcher.flush_all()
+        self.batcher.close()
+        return True

@@ -1,0 +1,8 @@
+"""Input plugins (reference: core/plugin/input/ + core/file_server/)."""
+
+
+def register_all(registry) -> None:
+    from .file.input_file import InputFile, InputStaticFile
+
+    registry.register_input("input_file", InputFile)
+    registry.register_input("input_static_file_onetime", InputStaticFile)

@@ -1,0 +1,77 @@
+"""File read checkpoints (v1): JSON dump of per-file offsets.
+
+Reference: core/file_server/checkpoint/CheckPointManager.{h,cpp} (h:99-140) —
+dev/inode + signature + offset per file, dumped periodically
+(application/Application.cpp:384) and restored on start.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from .reader import ReaderCheckpoint
+
+
+class CheckPointManager:
+    def __init__(self, path: str = ""):
+        self.path = path
+        self._checkpoints: Dict[str, ReaderCheckpoint] = {}
+        self._lock = threading.Lock()
+        self.last_dump = 0.0
+
+    def update(self, cp: ReaderCheckpoint) -> None:
+        with self._lock:
+            self._checkpoints[cp.path] = cp
+
+    def get(self, path: str) -> Optional[ReaderCheckpoint]:
+        with self._lock:
+            return self._checkpoints.get(path)
+
+    def remove(self, path: str) -> None:
+        with self._lock:
+            self._checkpoints.pop(path, None)
+
+    def dump(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            data = {
+                "version": 1,
+                "check_point": {
+                    p: {
+                        "offset": cp.offset, "dev": cp.dev, "inode": cp.inode,
+                        "sig": cp.signature, "sig_size": cp.signature_size,
+                        "update_time": cp.update_time,
+                    } for p, cp in self._checkpoints.items()
+                },
+            }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.path)
+        self.last_dump = time.monotonic()
+
+    def load(self) -> None:
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for p, d in data.get("check_point", {}).items():
+                self._checkpoints[p] = ReaderCheckpoint(
+                    path=p, offset=d.get("offset", 0), dev=d.get("dev", 0),
+                    inode=d.get("inode", 0), signature=d.get("sig", ""),
+                    signature_size=d.get("sig_size", 0),
+                    update_time=d.get("update_time", 0.0))
+
+    def dump_periodically(self, interval: float = 5.0) -> None:
+        if time.monotonic() - self.last_dump >= interval:
+            self.dump()

@@ -1,0 +1,218 @@
+"""FileServer: the file-input singleton runner.
+
+Reference: core/file_server/FileServer.cpp facade +
+file_server/event_handler/LogInput.cpp:357 (ProcessLoop — the single event
+thread driving discovery, modify events and reader reads, with CPU-adaptive
+flow control :156-203) and BlockedEventManager (requeue on back-pressure).
+
+One thread: each round it (1) runs discovery for every registered config on
+its interval, (2) stats known files for modification, (3) drains readers of
+changed files into the process queues, honouring watermark back-pressure —
+a blocked read retries next round without losing the reader's offset.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...utils.logger import get_logger
+from .checkpoint import CheckPointManager
+from .polling import FileDiscoveryConfig, PollingDirFile
+from .reader import LogFileReader
+
+log = get_logger("file_server")
+
+DISCOVERY_INTERVAL_S = 1.0
+IDLE_SLEEP_S = 0.05
+
+
+class _ConfigState:
+    def __init__(self, name: str, discovery: FileDiscoveryConfig,
+                 queue_key: int, tail_existing: bool):
+        self.name = name
+        self.poller = PollingDirFile(discovery)
+        self.queue_key = queue_key
+        self.readers: Dict[str, LogFileReader] = {}
+        self.rotated: List[LogFileReader] = []  # old inodes still draining
+        self.last_discovery = 0.0
+        self.known: List[str] = []
+        self.tail_existing = tail_existing
+        self.first_round = True
+
+
+class FileServer:
+    _instance: Optional["FileServer"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._configs: Dict[str, _ConfigState] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.process_queue_manager = None
+        self.checkpoints = CheckPointManager()
+        self._paused = False
+
+    @classmethod
+    def instance(cls) -> "FileServer":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # -- config registration (from InputFile plugins) -----------------------
+
+    def add_config(self, name: str, discovery: FileDiscoveryConfig,
+                   queue_key: int, tail_existing: bool = False) -> None:
+        with self._lock:
+            self._configs[name] = _ConfigState(name, discovery, queue_key,
+                                               tail_existing)
+
+    def remove_config(self, name: str) -> None:
+        with self._lock:
+            st = self._configs.pop(name, None)
+        if st:
+            for r in st.readers.values():
+                self.checkpoints.update(r.checkpoint())
+                r.close()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self.checkpoints.load()
+        self._thread = threading.Thread(target=self._run, name="file-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # final flush of partial lines + checkpoints
+        with self._lock:
+            states = list(self._configs.values())
+        for st in states:
+            for r in st.readers.values():
+                self._drain_reader(st, r, force_flush=True)
+                self.checkpoints.update(r.checkpoint())
+                r.close()
+        self.checkpoints.dump()
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    # -- main loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while self._running:
+            if self._paused:
+                time.sleep(IDLE_SLEEP_S)
+                continue
+            try:
+                busy = self._round()
+                self.checkpoints.dump_periodically()
+            except Exception:  # noqa: BLE001 - never kill the event thread
+                log.exception("file server round failed")
+                busy = False
+            if not busy:
+                time.sleep(IDLE_SLEEP_S)
+
+    def _round(self) -> bool:
+        with self._lock:
+            states = list(self._configs.values())
+        busy = False
+        now = time.monotonic()
+        for st in states:
+            if now - st.last_discovery >= DISCOVERY_INTERVAL_S or st.first_round:
+                st.last_discovery = now
+                st.known = st.poller.poll()
+                for path in st.known:
+                    if path not in st.readers:
+                        self._open_reader(st, path)
+                    else:
+                        self._check_rotation(st, path)
+                st.first_round = False
+            # drain any reader with unread bytes — back-pressured or
+            # burst-capped files retry here next round (never stall on stat)
+            for r in list(st.readers.values()):
+                if r.has_more():
+                    busy |= self._drain_reader(st, r)
+            for r in list(st.rotated):
+                busy |= self._drain_reader(st, r, force_flush=True)
+                if not r.has_more():
+                    self.checkpoints.remove(r.path)
+                    r.close()
+                    st.rotated.remove(r)
+        return busy
+
+    def _check_rotation(self, st: _ConfigState, path: str) -> None:
+        """rename+recreate rotation: the path's inode changed — finish the
+        old inode via the rotated list, open a fresh reader at offset 0
+        (reference: rotation via DevInode tracking, SURVEY.md §2.2)."""
+        from .reader import get_dev_inode
+        r = st.readers.get(path)
+        if r is None:
+            return
+        cur = get_dev_inode(path)
+        if cur.valid() and cur.inode != r.dev_inode.inode:
+            st.rotated.append(r)
+            new = LogFileReader(path)
+            if new.open():
+                st.readers[path] = new
+            else:
+                del st.readers[path]
+
+    def _open_reader(self, st: _ConfigState, path: str) -> None:
+        r = LogFileReader(path)
+        if not r.open():
+            return
+        cp = self.checkpoints.get(path)
+        if cp is not None and cp.inode == r.dev_inode.inode:
+            r.restore(cp)
+        elif not st.tail_existing and not st.first_round:
+            pass  # new file appears later: read from 0
+        elif not st.tail_existing and st.first_round:
+            # skip history on first sight (reference TailExisted=false):
+            import os
+            try:
+                r.offset = os.fstat(r._fd).st_size
+            except OSError:
+                pass
+        st.readers[path] = r
+
+    def _drain_reader(self, st: _ConfigState, reader: LogFileReader,
+                      force_flush: bool = False) -> bool:
+        """Read until empty or back-pressure; returns True if data moved."""
+        moved = False
+        pqm = self.process_queue_manager
+        for _ in range(64):  # bounded burst per round
+            if pqm is not None and not pqm.is_valid_to_push(st.queue_key):
+                break  # watermark high: retry next round (BlockedEventManager)
+            try:
+                group = reader.read(force_flush=force_flush)
+            except OSError:
+                break  # reader closed concurrently (config removal)
+            if group is None or not reader.is_open:
+                break
+            if pqm is not None:
+                if not pqm.push_queue(st.queue_key, group):
+                    # queue rejected after read: roll the offset back
+                    raw = group.events[0].content
+                    reader.offset -= len(raw)
+                    break
+            moved = True
+            self.checkpoints.update(reader.checkpoint())
+        return moved

@@ -1,0 +1,102 @@
+"""input_file — binds FileServer discovery to this pipeline and supplies the
+line-split / multiline inner processors.
+
+Reference: core/plugin/input/InputFile.cpp:213-250 — the input creates the
+inner split processors (split_log_string or split_multiline per Multiline
+config) and registers its discovery options with the file server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ...pipeline.plugin.interface import Input, PluginContext
+from .file_server import FileServer
+from .polling import FileDiscoveryConfig
+from .reader import LogFileReader
+
+
+class InputFile(Input):
+    name = "input_file"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.discovery: FileDiscoveryConfig = None  # type: ignore
+        self.multiline: Dict[str, Any] = {}
+        self.tail_existing = False
+        self.config_name = ""
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        paths = config.get("FilePaths", [])
+        if not paths:
+            return False
+        self.discovery = FileDiscoveryConfig(
+            file_paths=list(paths),
+            exclude_file_paths=config.get("ExcludeFilePaths"),
+            exclude_files=config.get("ExcludeFiles"),
+            exclude_dirs=config.get("ExcludeDirs"))
+        self.multiline = config.get("Multiline", {}) or {}
+        self.tail_existing = bool(config.get("TailingAllMatchedFiles",
+                                             config.get("TailExisted", True)))
+        # unique key per plugin instance: a pipeline may hold several
+        # input_file plugins and each owns its own discovery registration
+        self.config_name = f"{context.pipeline_name}#{id(self)}"
+        return True
+
+    def inner_processor_configs(self) -> List[Dict[str, Any]]:
+        out = [{"Type": "processor_split_log_string_native"}]
+        if self.multiline.get("StartPattern") or self.multiline.get("EndPattern"):
+            out.append({"Type": "processor_split_multiline_log_string_native",
+                        "Multiline": self.multiline})
+        return out
+
+    def start(self) -> bool:
+        fs = FileServer.instance()
+        fs.add_config(self.config_name, self.discovery,
+                      self.context.process_queue_key,
+                      tail_existing=self.tail_existing)
+        fs.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        FileServer.instance().remove_config(self.config_name)
+        return True
+
+
+class InputStaticFile(Input):
+    """One-shot read of matching files (reference InputStaticFile — onetime
+    jobs with checkpointed progress, core/file_server/StaticFileServer)."""
+
+    name = "input_static_file_onetime"
+    is_onetime = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.paths: List[str] = []
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.paths = list(config.get("FilePaths", []))
+        return bool(self.paths)
+
+    def start(self) -> bool:
+        import glob
+        from ...runner.processor_runner import ProcessorRunner
+        fs = FileServer.instance()
+        for pattern in self.paths:
+            for path in glob.glob(pattern, recursive="**" in pattern):
+                reader = LogFileReader(path)
+                if not reader.open():
+                    continue
+                while True:
+                    group = reader.read(force_flush=not reader.has_more())
+                    if group is None:
+                        break
+                    if fs.process_queue_manager is not None:
+                        while not fs.process_queue_manager.push_queue(
+                                self.context.process_queue_key, group):
+                            import time
+                            time.sleep(0.01)
+                reader.close()
+        return True

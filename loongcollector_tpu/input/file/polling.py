@@ -1,0 +1,86 @@
+"""File discovery: glob-pattern directory polling.
+
+Reference: core/file_server/polling/PollingDirFile.cpp (directory/file
+discovery round) + PollingModify.cpp (stat-based modify detection).  The
+reference also merges inotify (EventListener_Linux.h); polling alone is
+sufficient and portable — the FileServer loop stats registered files each
+round (the reference's modify-poll interval defaults to comparable rates).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Set, Tuple
+
+
+@dataclass
+class FileDiscoveryConfig:
+    """Reference FileDiscoveryOptions: FilePaths (glob), MaxDirSearchDepth,
+    ExcludeFilePaths/ExcludeFiles/ExcludeDirs."""
+
+    file_paths: List[str]
+    exclude_file_paths: List[str] = None
+    exclude_files: List[str] = None
+    exclude_dirs: List[str] = None
+
+    def __post_init__(self):
+        self.exclude_file_paths = self.exclude_file_paths or []
+        self.exclude_files = self.exclude_files or []
+        self.exclude_dirs = self.exclude_dirs or []
+
+
+class PollingDirFile:
+    def __init__(self, config: FileDiscoveryConfig):
+        self.config = config
+
+    def poll(self) -> List[str]:
+        """One discovery round: resolve glob patterns → matching file paths."""
+        found: List[str] = []
+        seen: Set[str] = set()
+        for pattern in self.config.file_paths:
+            for path in glob.glob(pattern, recursive="**" in pattern):
+                if path in seen or not os.path.isfile(path):
+                    continue
+                if self._excluded(path):
+                    continue
+                seen.add(path)
+                found.append(path)
+        return found
+
+    def _excluded(self, path: str) -> bool:
+        import fnmatch
+        base = os.path.basename(path)
+        d = os.path.dirname(path)
+        for pat in self.config.exclude_file_paths:
+            if fnmatch.fnmatch(path, pat):
+                return True
+        for pat in self.config.exclude_files:
+            if fnmatch.fnmatch(base, pat):
+                return True
+        for pat in self.config.exclude_dirs:
+            if fnmatch.fnmatch(d, pat):
+                return True
+        return False
+
+
+class PollingModify:
+    """Stat-based change detection over a registered file set."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, Tuple[int, float]] = {}
+
+    def changed(self, paths: Iterable[str]) -> List[str]:
+        out = []
+        for path in paths:
+            try:
+                st = os.stat(path)
+            except OSError:
+                self._stats.pop(path, None)
+                continue
+            sig = (st.st_size, st.st_mtime)
+            if self._stats.get(path) != sig:
+                self._stats[path] = sig
+                out.append(path)
+        return out

@@ -1,0 +1,130 @@
+"""Agent self-metrics.
+
+Reference: core/monitor/MetricManager.h:33-94 — WriteMetrics holds a chain of
+MetricsRecords (created by every queue/runner/plugin/pipeline); ReadMetrics
+snapshots them for export.  Categories follow monitor/metric_constants/:
+agent / runner / pipeline / component / plugin.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def collect(self) -> int:
+        """Read and reset (delta semantics for export)."""
+        with self._lock:
+            v = self._value
+            self._value = 0
+            return v
+
+
+class Gauge:
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class MetricsRecord:
+    _ids = itertools.count()
+
+    def __init__(self, category: str = "component",
+                 labels: Optional[Dict[str, str]] = None):
+        self.id = next(MetricsRecord._ids)
+        self.category = category
+        self.labels = labels or {}
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._deleted = False
+        WriteMetrics.instance().register(self)
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = Counter(name)
+            self._counters[name] = c
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = Gauge(name)
+            self._gauges[name] = g
+        return g
+
+    def mark_deleted(self) -> None:
+        self._deleted = True
+
+    def snapshot(self, reset_counters: bool = False) -> dict:
+        return {
+            "category": self.category,
+            "labels": dict(self.labels),
+            "counters": {n: (c.collect() if reset_counters else c.value)
+                         for n, c in self._counters.items()},
+            "gauges": {n: g.value for n, g in self._gauges.items()},
+            "time": int(time.time()),
+        }
+
+
+class WriteMetrics:
+    _instance: Optional["WriteMetrics"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._records: List[MetricsRecord] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "WriteMetrics":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, record: MetricsRecord) -> None:
+        with self._lock:
+            self._records.append(record)
+
+    def gc_deleted(self) -> None:
+        with self._lock:
+            self._records = [r for r in self._records if not r._deleted]
+
+    def records(self) -> List[MetricsRecord]:
+        with self._lock:
+            return [r for r in self._records if not r._deleted]
+
+
+class ReadMetrics:
+    """Snapshot side (reference ReadMetrics::UpdateMetrics)."""
+
+    @staticmethod
+    def snapshot(reset_counters: bool = False) -> List[dict]:
+        return [r.snapshot(reset_counters) for r in WriteMetrics.instance().records()]

@@ -1,0 +1,3 @@
+from .batcher import Batcher
+from .flush_strategy import FlushStrategy
+from .timeout_flush_manager import TimeoutFlushManager

@@ -1,0 +1,100 @@
+"""Per-flusher event batching.
+
+Reference: core/collection_pipeline/batch/Batcher.h:42-44 — a templated
+two-stage (event batch → group batch) accumulator keyed by the group's
+(source, topic, tags) so merged batches stay homogeneous; flush strategy from
+FlushStrategy.h; timeout flushing driven centrally (TimeoutFlushManager,
+pumped by processor thread 0 — runner/ProcessorRunner.cpp:109-112).
+
+TPU-first note: batches keep groups whole (columnar groups are already
+batched tensors); merging concatenates group lists, not per-event copies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...models import PipelineEventGroup
+from .flush_strategy import FlushStrategy
+from .timeout_flush_manager import TimeoutFlushManager
+
+
+class _BatchState:
+    __slots__ = ("groups", "event_cnt", "size_bytes", "create_time")
+
+    def __init__(self) -> None:
+        self.groups: List[PipelineEventGroup] = []
+        self.event_cnt = 0
+        self.size_bytes = 0
+        self.create_time = time.monotonic()
+
+
+def _group_key(group: PipelineEventGroup) -> Tuple:
+    tags = tuple(sorted((k, v.to_bytes()) for k, v in group.tags.items()))
+    return tags
+
+
+class Batcher:
+    """Accumulates groups per key; emits batches (lists of groups) to the
+    flusher's SerializeAndPush callback."""
+
+    def __init__(self, strategy: Optional[FlushStrategy] = None,
+                 on_flush: Optional[Callable[[List[PipelineEventGroup]], None]] = None,
+                 flusher_id: str = "", pipeline_name: str = ""):
+        self.strategy = strategy or FlushStrategy()
+        self.on_flush = on_flush
+        self._batches: Dict[Tuple, _BatchState] = {}
+        self._lock = threading.Lock()
+        self.flusher_id = flusher_id
+        self.pipeline_name = pipeline_name
+        TimeoutFlushManager.instance().register(self)
+
+    def add(self, group: PipelineEventGroup) -> None:
+        size = group.data_size()
+        cnt = len(group)
+        to_flush: List[List[PipelineEventGroup]] = []
+        with self._lock:
+            key = _group_key(group)
+            st = self._batches.get(key)
+            if st is None:
+                st = _BatchState()
+                self._batches[key] = st
+            if st.groups and self.strategy.size_would_exceed(st.size_bytes, size):
+                to_flush.append(st.groups)
+                self._batches[key] = st = _BatchState()
+            st.groups.append(group)
+            st.event_cnt += cnt
+            st.size_bytes += size
+            if (self.strategy.need_flush_by_count(st.event_cnt)
+                    or self.strategy.need_flush_by_size(st.size_bytes)):
+                to_flush.append(st.groups)
+                del self._batches[key]
+        for groups in to_flush:
+            self._emit(groups)
+
+    def flush_timeout(self) -> None:
+        to_flush = []
+        with self._lock:
+            for key in list(self._batches):
+                st = self._batches[key]
+                if st.groups and self.strategy.need_flush_by_time(st.create_time):
+                    to_flush.append(st.groups)
+                    del self._batches[key]
+        for groups in to_flush:
+            self._emit(groups)
+
+    def flush_all(self) -> None:
+        with self._lock:
+            pending = [st.groups for st in self._batches.values() if st.groups]
+            self._batches.clear()
+        for groups in pending:
+            self._emit(groups)
+
+    def _emit(self, groups: List[PipelineEventGroup]) -> None:
+        if self.on_flush is not None and groups:
+            self.on_flush(groups)
+
+    def close(self) -> None:
+        TimeoutFlushManager.instance().unregister(self)

@@ -1,0 +1,82 @@
+"""Payload compression for the send path.
+
+Reference: core/common/compression/ — Compressor interface + LZ4/ZSTD impls,
+CompressorFactory per flusher config.  The image bakes zlib/lzma in the
+stdlib; LZ4/ZSTD are used when the optional modules exist, with zlib as the
+always-available fallback (sinks negotiate the algorithm via config).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+try:
+    import lz4.frame as _lz4  # pragma: no cover - optional
+except ImportError:
+    _lz4 = None
+
+try:
+    import zstandard as _zstd  # pragma: no cover - optional
+except ImportError:
+    _zstd = None
+
+
+class Compressor:
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
+        return data
+
+
+class ZlibCompressor(Compressor):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
+        return zlib.decompress(data)
+
+
+class LZ4Compressor(Compressor):
+    name = "lz4"
+
+    def compress(self, data: bytes) -> bytes:
+        return _lz4.compress(data)
+
+    def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
+        return _lz4.decompress(data)
+
+
+class ZstdCompressor(Compressor):
+    name = "zstd"
+
+    def __init__(self, level: int = 1):
+        self._c = _zstd.ZstdCompressor(level=level)
+        self._d = _zstd.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
+        return self._d.decompress(data)
+
+
+def create_compressor(kind: Optional[str]) -> Compressor:
+    kind = (kind or "none").lower()
+    if kind in ("none", ""):
+        return Compressor()
+    if kind == "zlib" or (kind == "lz4" and _lz4 is None) or (kind == "zstd" and _zstd is None):
+        return ZlibCompressor()
+    if kind == "lz4":
+        return LZ4Compressor()
+    if kind == "zstd":
+        return ZstdCompressor()
+    return Compressor()

@@ -1,0 +1,185 @@
+"""CollectionPipeline: config → plugin chain → runtime wiring.
+
+Reference: core/collection_pipeline/CollectionPipeline.cpp —
+Init (:77): build inputs/processors/flushers from the registry (:109-204),
+wire inner processors supplied by inputs (:236-256), create the process
+queue + feedback + sender queues (:306-358), build the router (:453-480).
+Start (:393) brings plugins up sink-to-source so no data drops;
+Stop (:491) is source-to-sink with a drain wait (:659-677).
+Process (:419) runs inner then user processors; Send routes to flushers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..monitor.metrics import MetricsRecord
+from .plugin.instance import FlusherInstance, InputInstance, ProcessorInstance
+from .plugin.interface import PluginContext
+from .plugin.registry import PluginRegistry
+from .route.router import Router
+
+_queue_keys = itertools.count(1)
+
+
+def next_queue_key() -> int:
+    return next(_queue_keys)
+
+
+class CollectionPipeline:
+    def __init__(self) -> None:
+        self.name = ""
+        self.config: Dict[str, Any] = {}
+        self.context = PluginContext()
+        self.inputs: List[InputInstance] = []
+        self.inner_processors: List[ProcessorInstance] = []
+        self.processors: List[ProcessorInstance] = []
+        self.flushers: List[FlusherInstance] = []
+        self.router = Router()
+        self.process_queue_key = 0
+        self._in_process_cnt = 0
+        self._in_process_zero = threading.Condition()
+        self.metrics = None
+
+    # ------------------------------------------------------------------
+
+    def init(self, name: str, config: Dict[str, Any],
+             process_queue_manager=None, sender_queue_manager=None,
+             reuse_queue_key: Optional[int] = None) -> bool:
+        self.name = name
+        self.config = config
+        self.context = PluginContext(pipeline_name=name, config=config)
+        self.context.pipeline = self
+        self.metrics = MetricsRecord(category="pipeline",
+                                     labels={"pipeline_name": name})
+        registry = PluginRegistry.instance()
+        registry.load_static_plugins()
+
+        global_cfg = config.get("global", {})
+        self.context.global_config = global_cfg
+
+        # inputs
+        for i, icfg in enumerate(config.get("inputs", [])):
+            typ = icfg.get("Type", "")
+            plugin = registry.create_input(typ)
+            if plugin is None:
+                return False
+            inst = InputInstance(plugin, plugin_id=f"{typ}/{i}")
+            if not inst.init(icfg, self.context):
+                return False
+            self.inputs.append(inst)
+            # inputs may supply inner processors (reference :236-256, e.g.
+            # InputFile creates the split/multiline processors)
+            for pcfg in getattr(plugin, "inner_processor_configs", lambda: [])():
+                ptyp = pcfg.get("Type", "")
+                pplugin = registry.create_processor(ptyp)
+                if pplugin is None:
+                    return False
+                pinst = ProcessorInstance(pplugin, plugin_id=f"{ptyp}/inner")
+                if not pinst.init(pcfg, self.context):
+                    return False
+                self.inner_processors.append(pinst)
+
+        # user processors
+        for i, pcfg in enumerate(config.get("processors", [])):
+            typ = pcfg.get("Type", "")
+            plugin = registry.create_processor(typ)
+            if plugin is None:
+                return False
+            inst = ProcessorInstance(plugin, plugin_id=f"{typ}/{i}")
+            if not inst.init(pcfg, self.context):
+                return False
+            self.processors.append(inst)
+
+        # flushers + router
+        route_configs = []
+        for i, fcfg in enumerate(config.get("flushers", [])):
+            typ = fcfg.get("Type", "")
+            plugin = registry.create_flusher(typ)
+            if plugin is None:
+                return False
+            inst = FlusherInstance(plugin, plugin_id=f"{typ}/{i}")
+            plugin.queue_key = next_queue_key()
+            if sender_queue_manager is not None:
+                plugin.sender_queue = sender_queue_manager.create_or_reuse_queue(
+                    plugin.queue_key, pipeline_name=name)
+            if not inst.init(fcfg, self.context):
+                return False
+            self.flushers.append(inst)
+            route_configs.append((i, fcfg.get("Match")))
+        self.router.init(route_configs)
+
+        # process queue: a modified pipeline keeps its key so queued groups
+        # survive the swap (reference ExactlyOnceQueueManager/QueueKeyManager
+        # keep keys stable per config name)
+        self.process_queue_key = (reuse_queue_key if reuse_queue_key
+                                  else next_queue_key())
+        self.context.process_queue_key = self.process_queue_key
+        if process_queue_manager is not None:
+            priority = int(global_cfg.get("Priority", 1))
+            capacity = int(global_cfg.get("ProcessQueueCapacity", 20))
+            circular = bool(global_cfg.get("CircularProcessQueue", False))
+            q = process_queue_manager.create_or_reuse_queue(
+                self.process_queue_key, priority, capacity, name,
+                circular=circular)
+        return True
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Sink-to-source order (reference :393-417)."""
+        for f in self.flushers:
+            f.start()
+        for i in self.inputs:
+            i.start()
+
+    def stop(self, is_removing: bool = False) -> None:
+        """Source-to-sink with drain (reference :491-532,659-677)."""
+        for i in self.inputs:
+            i.stop(is_removing)
+        self.wait_all_items_in_process_finished()
+        self.flush_batch()
+        for f in self.flushers:
+            f.stop(is_removing)
+
+    # ------------------------------------------------------------------
+
+    def process(self, groups: List[PipelineEventGroup]) -> None:
+        with self._in_process_zero:
+            self._in_process_cnt += 1
+        try:
+            for inst in self.inner_processors:
+                inst.process(groups)
+            for inst in self.processors:
+                inst.process(groups)
+        finally:
+            with self._in_process_zero:
+                self._in_process_cnt -= 1
+                if self._in_process_cnt == 0:
+                    self._in_process_zero.notify_all()
+
+    def send(self, groups: List[PipelineEventGroup]) -> bool:
+        ok = True
+        for group in groups:
+            if group.empty():
+                continue
+            for idx in self.router.route(group):
+                ok = self.flushers[idx].send(group) and ok
+        return ok
+
+    def flush_batch(self) -> None:
+        for f in self.flushers:
+            f.plugin.flush_all()
+
+    def wait_all_items_in_process_finished(self, timeout: float = 10.0) -> bool:
+        with self._in_process_zero:
+            if self._in_process_cnt == 0:
+                return True
+            return self._in_process_zero.wait_for(
+                lambda: self._in_process_cnt == 0, timeout)
+
+    def has_go_pipeline(self) -> bool:
+        return False

@@ -1,0 +1,2 @@
+from .interface import Flusher, Input, Processor, PluginContext
+from .registry import PluginRegistry

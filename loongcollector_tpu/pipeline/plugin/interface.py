@@ -1,0 +1,94 @@
+"""Plugin interfaces: Input / Processor / Flusher.
+
+Reference: core/collection_pipeline/plugin/interface/{Input,Processor,
+Flusher}.h — Init(config, context), Start/Stop for inputs, Process(group) for
+processors, Send(group)/FlushAll for flushers.  Flusher::Send serializes into
+its own sender queue (interface/Flusher.cpp:57).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ...models import PipelineEventGroup
+
+
+class PluginContext:
+    """Per-pipeline context handed to every plugin instance (reference
+    CollectionPipelineContext)."""
+
+    def __init__(self, pipeline_name: str = "", config: Optional[dict] = None):
+        self.pipeline_name = pipeline_name
+        self.config = config or {}
+        self.process_queue_key: int = 0
+        self.global_config: Dict[str, Any] = {}
+        self.logger = None
+        self.metrics = None
+        self.pipeline = None  # set by CollectionPipeline.init
+
+
+class Plugin:
+    name: str = "plugin_base"
+
+    def __init__(self) -> None:
+        self.context: Optional[PluginContext] = None
+        self.metrics_record = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        self.context = context
+        return True
+
+
+class Input(Plugin):
+    """Inputs register with their singleton runner on start (reference
+    Input::Start registers with e.g. FileServer / PrometheusInputRunner)."""
+
+    name = "input_base"
+    is_singleton = False   # singleton inputs: one instance across pipelines
+    is_onetime = False     # onetime inputs: finite jobs with expiry
+
+    def start(self) -> bool:  # pragma: no cover - interface
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        return True
+
+    def supported_event_types(self) -> List[str]:
+        return ["log"]
+
+
+class Processor(Plugin):
+    """Process mutates the group in place (reference Processor.h:28-37)."""
+
+    name = "processor_base"
+
+    def process(self, group: PipelineEventGroup) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def process_many(self, groups: List[PipelineEventGroup]) -> None:
+        for g in groups:
+            self.process(g)
+
+
+class Flusher(Plugin):
+    name = "flusher_base"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.queue_key: int = 0
+        self.sender_queue = None
+
+    def send(self, group: PipelineEventGroup) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def flush(self, key: int = 0) -> bool:
+        return True
+
+    def flush_all(self) -> bool:
+        return True
+
+    def start(self) -> bool:
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        return True

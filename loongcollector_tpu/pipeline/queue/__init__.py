@@ -1,0 +1,4 @@
+from .bounded_queue import BoundedProcessQueue, CircularProcessQueue, QueueStatus
+from .limiter import ConcurrencyLimiter, RateLimiter
+from .process_queue_manager import ProcessQueueManager
+from .sender_queue import SenderQueue, SenderQueueItem, SenderQueueManager

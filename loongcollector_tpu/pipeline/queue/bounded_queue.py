@@ -1,0 +1,139 @@
+"""Bounded process queues with high/low watermark back-pressure.
+
+Reference: core/collection_pipeline/queue/BoundedProcessQueue.cpp:34,53,89-93
+and QueueParam.h:23-33 (high watermark = capacity, low = cap*2/3 by default).
+Push fails above the high watermark; popping below the low watermark fires the
+upstream FeedbackInterface so blocked inputs resume — the same contract the
+TPU device queue honours (SURVEY.md §5.8: the host↔device boundary lives
+behind these watermarks).
+
+CircularProcessQueue (drop-oldest) serves streaming inputs that must never
+block the producer (eBPF perf buffers, Prometheus streams — reference
+queue/CircularProcessQueue.cpp).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+from ...models import PipelineEventGroup
+
+DEFAULT_CAPACITY = 20
+LOW_WATERMARK_RATIO = 2 / 3
+
+
+class QueueStatus(enum.Enum):
+    OK = 0
+    FULL = 1
+    EMPTY = 2
+
+
+class FeedbackInterface:
+    """Upstream wakeup hook (reference queue/FeedbackInterface.h)."""
+
+    def feedback(self, key: int) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class BoundedProcessQueue:
+    """Count-bounded MPSC queue with watermark feedback.
+
+    Thread-safe; producers are input threads, the consumer is a processor
+    runner.  `set_pop_enabled(False)` supports the drain-before-stop pipeline
+    swap semantics (reference CollectionPipeline.cpp:659-677).
+    """
+
+    def __init__(self, key: int, priority: int = 1,
+                 capacity: int = DEFAULT_CAPACITY,
+                 pipeline_name: str = ""):
+        self.key = key
+        self.priority = priority
+        self.pipeline_name = pipeline_name
+        self._cap_high = max(capacity, 1)
+        self._cap_low = max(int(capacity * LOW_WATERMARK_RATIO), 1)
+        self._items: Deque[PipelineEventGroup] = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._valid_to_push = True
+        self._pop_enabled = True
+        self._feedback: List[FeedbackInterface] = []
+        # metrics
+        self.total_pushed = 0
+        self.total_popped = 0
+        self.total_rejected = 0
+
+    # -- producer side ------------------------------------------------------
+
+    def push(self, group: PipelineEventGroup) -> bool:
+        with self._lock:
+            if not self._valid_to_push:
+                self.total_rejected += 1
+                return False
+            self._items.append(group)
+            self.total_pushed += 1
+            if len(self._items) >= self._cap_high:
+                self._valid_to_push = False
+            self._not_empty.notify()
+            return True
+
+    def is_valid_to_push(self) -> bool:
+        with self._lock:
+            return self._valid_to_push
+
+    # -- consumer side ------------------------------------------------------
+
+    def pop(self) -> Optional[PipelineEventGroup]:
+        with self._lock:
+            if not self._pop_enabled or not self._items:
+                return None
+            item = self._items.popleft()
+            self.total_popped += 1
+            if not self._valid_to_push and len(self._items) <= self._cap_low:
+                self._valid_to_push = True
+                feedbacks = list(self._feedback)
+            else:
+                feedbacks = []
+        for fb in feedbacks:
+            fb.feedback(self.key)
+        return item
+
+    def set_pop_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._pop_enabled = enabled
+
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def set_feedback(self, *feedbacks: FeedbackInterface) -> None:
+        with self._lock:
+            self._feedback = list(feedbacks)
+
+
+class CircularProcessQueue(BoundedProcessQueue):
+    """Drop-oldest variant: push never fails; over capacity the oldest group
+    is discarded (reference queue/CircularProcessQueue.cpp)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.total_dropped = 0
+
+    def push(self, group: PipelineEventGroup) -> bool:
+        with self._lock:
+            self._items.append(group)
+            self.total_pushed += 1
+            while len(self._items) > self._cap_high:
+                self._items.popleft()
+                self.total_dropped += 1
+            self._not_empty.notify()
+            return True
+
+    def is_valid_to_push(self) -> bool:
+        return True

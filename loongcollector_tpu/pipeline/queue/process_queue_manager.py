@@ -1,0 +1,107 @@
+"""Process-queue manager: per-pipeline queues, 3 priorities, round-robin pop.
+
+Reference: core/collection_pipeline/queue/ProcessQueueManager.{h,cpp}
+(PushQueue :148, priorities + round-robin within priority :45,91).  The
+consumer side blocks on a shared condition until any queue has data.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ...models import PipelineEventGroup
+from .bounded_queue import BoundedProcessQueue, CircularProcessQueue
+
+PRIORITY_COUNT = 3  # 0 = highest
+
+
+class ProcessQueueManager:
+    def __init__(self) -> None:
+        self._queues: Dict[int, BoundedProcessQueue] = {}
+        self._lock = threading.Lock()
+        self._data_cv = threading.Condition(self._lock)
+        self._rr_cursor: Dict[int, int] = {p: 0 for p in range(PRIORITY_COUNT)}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def create_or_reuse_queue(self, key: int, priority: int = 1,
+                              capacity: int = 20, pipeline_name: str = "",
+                              circular: bool = False) -> BoundedProcessQueue:
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None or isinstance(q, CircularProcessQueue) != circular:
+                cls = CircularProcessQueue if circular else BoundedProcessQueue
+                q = cls(key, priority, capacity, pipeline_name)
+                q._manager_cv = self._data_cv
+                self._queues[key] = q
+            return q
+
+    def delete_queue(self, key: int) -> None:
+        with self._lock:
+            self._queues.pop(key, None)
+
+    def get_queue(self, key: int) -> Optional[BoundedProcessQueue]:
+        with self._lock:
+            return self._queues.get(key)
+
+    # -- producer -----------------------------------------------------------
+
+    def push_queue(self, key: int, group: PipelineEventGroup) -> bool:
+        with self._lock:
+            q = self._queues.get(key)
+        if q is None:
+            return False
+        pushed = q.push(group)
+        if pushed:
+            with self._data_cv:
+                self._data_cv.notify()
+        return pushed
+
+    def is_valid_to_push(self, key: int) -> bool:
+        q = self.get_queue(key)
+        return q is not None and q.is_valid_to_push()
+
+    # -- consumer -----------------------------------------------------------
+
+    def pop_item(self, timeout: float = 0.2
+                 ) -> Optional[Tuple[int, PipelineEventGroup]]:
+        """Priority-ordered, round-robin within each priority level
+        (reference ProcessQueueManager.h:91)."""
+        item = self._try_pop()
+        if item is not None:
+            return item
+        with self._data_cv:
+            self._data_cv.wait(timeout)
+        return self._try_pop()
+
+    def _try_pop(self) -> Optional[Tuple[int, PipelineEventGroup]]:
+        with self._lock:
+            queues = list(self._queues.values())
+            cursors = dict(self._rr_cursor)
+        for prio in range(PRIORITY_COUNT):
+            level = [q for q in queues if q.priority == prio]
+            if not level:
+                continue
+            start = cursors.get(prio, 0) % len(level)
+            for i in range(len(level)):
+                q = level[(start + i) % len(level)]
+                group = q.pop()
+                if group is not None:
+                    with self._lock:
+                        self._rr_cursor[prio] = (start + i + 1) % len(level)
+                    return q.key, group
+        return None
+
+    def all_empty(self) -> bool:
+        with self._lock:
+            queues = list(self._queues.values())
+        return all(q.empty() for q in queues)
+
+    def wake_up(self) -> None:
+        with self._data_cv:
+            self._data_cv.notify_all()
+
+    def queue_names(self):
+        with self._lock:
+            return {k: q.pipeline_name for k, q in self._queues.items()}

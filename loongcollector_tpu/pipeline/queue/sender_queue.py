@@ -1,0 +1,165 @@
+"""Sender queues: serialized payloads awaiting network dispatch.
+
+Reference: core/collection_pipeline/queue/SenderQueue*.cpp and
+SenderQueueItem.h — per-flusher bounded queues of compressed payloads with
+retry state; GetAvailableItems consults per-destination rate and AIMD
+concurrency limiters (SenderQueueManager.cpp:112-135); draining feeds back
+to process queues.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from .limiter import ConcurrencyLimiter, RateLimiter
+
+
+class SendingStatus(enum.Enum):
+    IDLE = 0
+    SENDING = 1
+
+
+class SenderQueueItem:
+    __slots__ = ("data", "raw_size", "flusher", "queue_key", "status",
+                 "enqueue_time", "try_count", "last_send_time", "tag")
+
+    def __init__(self, data: bytes, raw_size: int, flusher=None,
+                 queue_key: int = 0, tag: Optional[dict] = None):
+        self.data = data
+        self.raw_size = raw_size
+        self.flusher = flusher
+        self.queue_key = queue_key
+        self.status = SendingStatus.IDLE
+        self.enqueue_time = time.monotonic()
+        self.try_count = 0
+        self.last_send_time = 0.0
+        self.tag = tag or {}
+
+
+class SenderQueue:
+    def __init__(self, key: int, capacity: int = 10, pipeline_name: str = ""):
+        self.key = key
+        self.pipeline_name = pipeline_name
+        self._cap_high = max(capacity, 1)
+        self._cap_low = max(int(capacity * 2 / 3), 1)
+        self._items: Deque[SenderQueueItem] = deque()
+        self._lock = threading.Lock()
+        self._valid_to_push = True
+        self._feedback = []
+        self.rate_limiter: Optional[RateLimiter] = None
+        self.concurrency_limiters: List[ConcurrencyLimiter] = []
+        self.total_pushed = 0
+        self.total_removed = 0
+
+    def push(self, item: SenderQueueItem) -> bool:
+        with self._lock:
+            # Sender queues accept beyond the watermark (data already left the
+            # process stage and must not be lost); validity flag throttles the
+            # upstream instead (reference BoundedSenderQueueInterface).
+            self._items.append(item)
+            self.total_pushed += 1
+            if len(self._items) >= self._cap_high:
+                self._valid_to_push = False
+            return True
+
+    def is_valid_to_push(self) -> bool:
+        with self._lock:
+            return self._valid_to_push
+
+    def get_available_items(self, limit: int) -> List[SenderQueueItem]:
+        out: List[SenderQueueItem] = []
+        with self._lock:
+            for item in self._items:
+                if len(out) >= limit:
+                    break
+                if item.status is not SendingStatus.IDLE:
+                    continue
+                if self.rate_limiter and not self.rate_limiter.is_valid_to_pop():
+                    break
+                if any(not cl.is_valid_to_pop() for cl in self.concurrency_limiters):
+                    break
+                item.status = SendingStatus.SENDING
+                item.try_count += 1
+                item.last_send_time = time.monotonic()
+                if self.rate_limiter:
+                    self.rate_limiter.post_pop(len(item.data))
+                for cl in self.concurrency_limiters:
+                    cl.post_pop()
+                out.append(item)
+        return out
+
+    def remove(self, item: SenderQueueItem) -> bool:
+        feedbacks = []
+        with self._lock:
+            try:
+                self._items.remove(item)
+            except ValueError:
+                return False
+            self.total_removed += 1
+            if not self._valid_to_push and len(self._items) <= self._cap_low:
+                self._valid_to_push = True
+                feedbacks = list(self._feedback)
+        for fb in feedbacks:
+            fb.feedback(self.key)
+        return True
+
+    def reset_item_status(self, item: SenderQueueItem) -> None:
+        with self._lock:
+            item.status = SendingStatus.IDLE
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._items
+
+    def set_feedback(self, *feedbacks) -> None:
+        with self._lock:
+            self._feedback = list(feedbacks)
+
+
+class SenderQueueManager:
+    def __init__(self) -> None:
+        self._queues: Dict[int, SenderQueue] = {}
+        self._lock = threading.Lock()
+
+    def create_or_reuse_queue(self, key: int, capacity: int = 10,
+                              pipeline_name: str = "") -> SenderQueue:
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = SenderQueue(key, capacity, pipeline_name)
+                self._queues[key] = q
+            return q
+
+    def get_queue(self, key: int) -> Optional[SenderQueue]:
+        with self._lock:
+            return self._queues.get(key)
+
+    def delete_queue(self, key: int) -> None:
+        with self._lock:
+            self._queues.pop(key, None)
+
+    def get_available_items(self, limit_per_queue: int = 10
+                            ) -> List[SenderQueueItem]:
+        with self._lock:
+            queues = list(self._queues.values())
+        out: List[SenderQueueItem] = []
+        for q in queues:
+            out.extend(q.get_available_items(limit_per_queue))
+        return out
+
+    def remove_item(self, item: SenderQueueItem) -> bool:
+        q = self.get_queue(item.queue_key)
+        return q.remove(item) if q else False
+
+    def all_empty(self) -> bool:
+        with self._lock:
+            queues = list(self._queues.values())
+        return all(q.empty() for q in queues)

@@ -1,0 +1,2 @@
+from .json_serializer import JsonSerializer
+from .sls_serializer import SLSEventGroupSerializer

@@ -1,0 +1,71 @@
+"""JSON line serializer for JSON sinks (reference
+core/collection_pipeline/serializer/JsonSerializer.cpp — one JSON object per
+event with group tags folded in).
+
+Columnar fast path: serializes straight from the field span columns without
+materialising per-event objects.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ...models import (EventType, LogEvent, MetricEvent, PipelineEventGroup,
+                       RawEvent, SpanEvent)
+
+
+class JsonSerializer:
+    name = "json"
+
+    def serialize(self, groups: List[PipelineEventGroup]) -> bytes:
+        out: List[str] = []
+        for group in groups:
+            tags = {k.decode("utf-8", "replace"): str(v)
+                    for k, v in group.tags.items()}
+            cols = group.columns
+            if cols is not None and cols.fields and not group._events:
+                self._serialize_columnar(group, tags, out)
+                continue
+            for ev in group.events:
+                obj = dict(tags)
+                if isinstance(ev, LogEvent):
+                    obj["__time__"] = ev.timestamp
+                    for k, v in ev.contents:
+                        obj[k.to_str()] = v.to_str()
+                elif isinstance(ev, MetricEvent):
+                    obj["__time__"] = ev.timestamp
+                    obj["__name__"] = str(ev.name) if ev.name else ""
+                    if ev.value.is_multi():
+                        obj["__values__"] = {k.decode(): v for k, v in ev.value.values.items()}
+                    else:
+                        obj["__value__"] = ev.value.value
+                    obj["__labels__"] = {k.decode(): str(v) for k, v in ev.tags.items()}
+                elif isinstance(ev, SpanEvent):
+                    obj["traceId"] = ev.trace_id.decode("utf-8", "replace")
+                    obj["spanId"] = ev.span_id.decode("utf-8", "replace")
+                    obj["name"] = ev.name.decode("utf-8", "replace")
+                    obj["startTimeNs"] = ev.start_time_ns
+                    obj["endTimeNs"] = ev.end_time_ns
+                elif isinstance(ev, RawEvent):
+                    obj["__time__"] = ev.timestamp
+                    obj["content"] = str(ev.content) if ev.content else ""
+                out.append(json.dumps(obj, ensure_ascii=False))
+        return ("\n".join(out) + "\n").encode("utf-8") if out else b""
+
+    def _serialize_columnar(self, group: PipelineEventGroup, tags: dict,
+                            out: List[str]) -> None:
+        cols = group.columns
+        raw = group.source_buffer.raw
+        names = list(cols.fields.keys())
+        spans = [cols.fields[n] for n in names]
+        tss = cols.timestamps
+        for i in range(len(cols)):
+            obj = dict(tags)
+            obj["__time__"] = int(tss[i])
+            for name, (offs, lens) in zip(names, spans):
+                ln = int(lens[i])
+                if ln >= 0:
+                    o = int(offs[i])
+                    obj[name] = raw[o : o + ln].decode("utf-8", "replace")
+            out.append(json.dumps(obj, ensure_ascii=False))

@@ -1,0 +1,40 @@
+"""Processor plugins.
+
+Reference inventory: core/plugin/processor/ (SURVEY.md §2.3).  Names keep the
+reference's `_native` suffix for drop-in config compatibility; the regex /
+json / delimiter parsers execute on TPU via ops/ kernels with transparent
+CPU fallback (the `_tpu` aliases are also registered).
+"""
+
+
+def register_all(registry) -> None:
+    from .split_log_string import ProcessorSplitLogString
+    from .parse_regex import ProcessorParseRegex
+    from .parse_json import ProcessorParseJson
+    from .parse_delimiter import ProcessorParseDelimiter
+    from .parse_timestamp import ProcessorParseTimestamp
+    from .filter import ProcessorFilter
+    from .desensitize import ProcessorDesensitize
+    from .tag import ProcessorTag
+    from .merge_multiline import ProcessorMergeMultilineLog
+    from .split_multiline import ProcessorSplitMultilineLogString
+
+    registry.register_processor("processor_split_log_string_native",
+                                ProcessorSplitLogString)
+    registry.register_processor("processor_split_multiline_log_string_native",
+                                ProcessorSplitMultilineLogString)
+    registry.register_processor("processor_merge_multiline_log_native",
+                                ProcessorMergeMultilineLog)
+    registry.register_processor("processor_parse_regex_native", ProcessorParseRegex)
+    registry.register_processor("processor_parse_regex_tpu", ProcessorParseRegex)
+    registry.register_processor("processor_parse_json_native", ProcessorParseJson)
+    registry.register_processor("processor_parse_json_tpu", ProcessorParseJson)
+    registry.register_processor("processor_parse_delimiter_native",
+                                ProcessorParseDelimiter)
+    registry.register_processor("processor_parse_delimiter_tpu",
+                                ProcessorParseDelimiter)
+    registry.register_processor("processor_parse_timestamp_native",
+                                ProcessorParseTimestamp)
+    registry.register_processor("processor_filter_native", ProcessorFilter)
+    registry.register_processor("processor_desensitize_native", ProcessorDesensitize)
+    registry.register_processor("processor_tag_native", ProcessorTag)

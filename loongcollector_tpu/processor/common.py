@@ -1,0 +1,72 @@
+"""Shared processor helpers: columnar source extraction.
+
+The data plane keeps groups columnar; processors that parse a source field
+need (arena, offsets, lengths) triples.  For columnar groups that's free;
+for per-event groups the sources are packed into a scratch arena first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..models import ColumnarLogs, LogEvent, PipelineEventGroup, RawEvent
+
+DEFAULT_CONTENT_KEY = b"content"
+RAW_LOG_KEY = "rawLog"
+
+
+@dataclass
+class SourceColumns:
+    arena: np.ndarray            # uint8 flat
+    offsets: np.ndarray          # int64 [N]
+    lengths: np.ndarray          # int32 [N]
+    columnar: bool               # True → spans index the group's arena
+    present: np.ndarray          # bool [N] source field existed
+
+
+def extract_source(group: PipelineEventGroup,
+                   source_key: bytes = DEFAULT_CONTENT_KEY
+                   ) -> Optional[SourceColumns]:
+    """Returns the source field of every event as span columns."""
+    cols = group.columns
+    if cols is not None and not group._events:
+        skey = source_key.decode() if isinstance(source_key, bytes) else source_key
+        if cols.fields:
+            if skey not in cols.fields:
+                return None
+            offs, lens = cols.fields[skey]
+            present = lens >= 0
+        else:
+            offs, lens = cols.offsets, cols.lengths
+            present = np.ones(len(cols), dtype=bool)
+        arena = group.source_buffer.as_array()
+        return SourceColumns(arena, offs.astype(np.int64), lens, True, present)
+
+    # row path: pack source values into a scratch arena
+    values: List[bytes] = []
+    present: List[bool] = []
+    for ev in group.events:
+        if isinstance(ev, LogEvent):
+            v = ev.get_content(source_key)
+        elif isinstance(ev, RawEvent):
+            v = ev.content
+        else:
+            v = None
+        if v is None:
+            values.append(b"")
+            present.append(False)
+        else:
+            values.append(v.to_bytes())
+            present.append(True)
+    if not values:
+        return None
+    blob = b"".join(values)
+    arena = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(0, np.uint8)
+    lengths = np.array([len(v) for v in values], dtype=np.int32)
+    offsets = np.concatenate([[0], np.cumsum(lengths[:-1], dtype=np.int64)]) \
+        if len(values) else np.zeros(0, np.int64)
+    return SourceColumns(arena, offsets.astype(np.int64), lengths, False,
+                         np.array(present, dtype=bool))

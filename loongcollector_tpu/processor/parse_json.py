@@ -1,0 +1,128 @@
+"""processor_parse_json — expand a JSON-object field into event fields.
+
+Reference: core/plugin/processor/ProcessorParseJsonNative.cpp (rapidjson
+parse of one key into fields, keep/discard semantics shared with regex
+parser).
+
+Current execution: columnar host parse writing values into the group arena
+(so downstream stays span-based).  A simdjson-style structural device kernel
+(quote/escape parity via cumsum) is the planned Tier-1 upgrade —
+ops/kernels/json_structural.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from ..models import ColumnarLogs, PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext, Processor
+from .common import RAW_LOG_KEY, extract_source
+
+
+class ProcessorParseJson(Processor):
+    name = "processor_parse_json_tpu"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.source_key = b"content"
+        self.keep_source_on_fail = True
+        self.keep_source_on_success = False
+        self.renamed_source_key = RAW_LOG_KEY
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = config.get("SourceKey", "content").encode()
+        self.keep_source_on_fail = bool(config.get("KeepingSourceWhenParseFail", True))
+        self.keep_source_on_success = bool(config.get("KeepingSourceWhenParseSucceed", False))
+        self.renamed_source_key = config.get("RenamedSourceKey", RAW_LOG_KEY)
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        src = extract_source(group, self.source_key)
+        if src is None:
+            return
+        n = len(src.offsets)
+        if src.columnar:
+            sb = group.source_buffer
+            cols = group.columns
+            ok = np.zeros(n, dtype=bool)
+            field_offs: Dict[str, np.ndarray] = {}
+            field_lens: Dict[str, np.ndarray] = {}
+            raw = src.arena
+            for i in range(n):
+                if not src.present[i]:
+                    continue
+                o, ln = int(src.offsets[i]), int(src.lengths[i])
+                try:
+                    obj = json.loads(raw[o : o + ln].tobytes())
+                    if not isinstance(obj, dict):
+                        raise ValueError
+                except Exception:  # noqa: BLE001
+                    continue
+                ok[i] = True
+                for k, v in obj.items():
+                    if k not in field_offs:
+                        field_offs[k] = np.zeros(n, dtype=np.int32)
+                        field_lens[k] = np.full(n, -1, dtype=np.int32)
+                    if isinstance(v, str):
+                        vb = v.encode("utf-8")
+                    elif isinstance(v, (dict, list)):
+                        vb = json.dumps(v, ensure_ascii=False).encode("utf-8")
+                    elif isinstance(v, bool):
+                        vb = b"true" if v else b"false"
+                    elif v is None:
+                        vb = b"null"
+                    else:
+                        vb = str(v).encode("utf-8")
+                    view = sb.copy_string(vb)
+                    field_offs[k][i] = view.offset
+                    field_lens[k][i] = view.length
+            for k in field_offs:
+                cols.set_field(k, field_offs[k], field_lens[k])
+            self._retain_source(cols, src, ok)
+            cols.parse_ok = ok
+            return
+
+        sb = group.source_buffer
+        for ev in group.events:
+            if not hasattr(ev, "get_content"):
+                continue
+            v = ev.get_content(self.source_key)
+            if v is None:
+                continue
+            try:
+                obj = json.loads(v.to_bytes())
+                if not isinstance(obj, dict):
+                    raise ValueError
+            except Exception:  # noqa: BLE001
+                if self.keep_source_on_fail:
+                    if self.renamed_source_key.encode() != self.source_key:
+                        ev.set_content(self.renamed_source_key.encode(), v)
+                        ev.del_content(self.source_key)
+                continue
+            for k, val in obj.items():
+                if not isinstance(val, str):
+                    val = json.dumps(val, ensure_ascii=False) \
+                        if isinstance(val, (dict, list)) else \
+                        ("true" if val is True else "false" if val is False
+                         else "null" if val is None else str(val))
+                ev.set_content(sb.copy_string(k), sb.copy_string(val))
+            if not self.keep_source_on_success:
+                ev.del_content(self.source_key)
+
+    def _retain_source(self, cols: ColumnarLogs, src, ok: np.ndarray) -> None:
+        if self.keep_source_on_fail and self.keep_source_on_success:
+            keep = src.present
+        elif self.keep_source_on_fail:
+            keep = (~ok) & src.present
+        elif self.keep_source_on_success:
+            keep = ok & src.present
+        else:
+            keep = np.zeros(len(ok), dtype=bool)
+        if keep.any():
+            cols.set_field(self.renamed_source_key,
+                           src.offsets.astype(np.int32),
+                           np.where(keep, src.lengths, -1).astype(np.int32))

@@ -1,0 +1,160 @@
+"""Inner processor: multiline log assembly (stacktrace merging) — columnar.
+
+Reference: core/plugin/processor/inner/ProcessorSplitMultilineLogStringNative
+.cpp with MultilineOptions (file_server/MultilineOptions.h:38-47):
+start/continue/end regexes group physical lines into logical events;
+UnmatchedContentTreatment = discard | single_line.
+
+TPU-first: this is the framework's "long-context" problem (SURVEY.md §5.7).
+With a StartPattern, line classification runs as ONE device match batch, and
+because split lines are contiguous slices of the same arena, merging a block
+of lines is pure span arithmetic — the merged event is the arena span from
+the first line's offset to the last line's end, newlines included, zero-copy.
+Continue/End patterns run the same batched classification with a host-side
+block-boundary pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..models import ColumnarLogs, PipelineEventGroup
+from ..ops.regex.engine import RegexEngine
+from ..pipeline.plugin.interface import PluginContext, Processor
+
+
+class ProcessorSplitMultilineLogString(Processor):
+    name = "processor_split_multiline_log_string_native"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.start: Optional[RegexEngine] = None
+        self.cont: Optional[RegexEngine] = None
+        self.end: Optional[RegexEngine] = None
+        self.unmatched = "single_line"  # or "discard"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        mcfg = config.get("Multiline", config)
+        sp = mcfg.get("StartPattern")
+        cp = mcfg.get("ContinuePattern")
+        ep = mcfg.get("EndPattern")
+        self.start = RegexEngine(self._fullmatchify(sp)) if sp else None
+        self.cont = RegexEngine(self._fullmatchify(cp)) if cp else None
+        self.end = RegexEngine(self._fullmatchify(ep)) if ep else None
+        self.unmatched = mcfg.get("UnmatchedContentTreatment", "single_line")
+        return self.start is not None or self.end is not None
+
+    @staticmethod
+    def _fullmatchify(pattern: str) -> str:
+        """Reference multiline patterns are full-line matches; users commonly
+        write prefixes ending in `.*` — keep as-is (engine is full-match)."""
+        return pattern
+
+    def process(self, group: PipelineEventGroup) -> None:
+        cols = group.columns
+        if cols is None or group._events:
+            return  # expects the line-split columnar form
+        n = len(cols)
+        if n == 0:
+            return
+        arena = group.source_buffer.as_array()
+        offs = cols.offsets.astype(np.int64)
+        lens = cols.lengths
+
+        is_start = (self.start.match_batch(arena, offs, lens)
+                    if self.start else np.zeros(n, dtype=bool))
+        is_end = (self.end.match_batch(arena, offs, lens)
+                  if self.end else None)
+        is_cont = (self.cont.match_batch(arena, offs, lens)
+                   if self.cont else None)
+
+        # block id per line
+        if self.start is not None:
+            block = np.cumsum(is_start)          # 0 for leading unmatched
+            starts_idx = np.nonzero(is_start)[0]
+            if is_end is not None:
+                # start..end blocks: lines after an end and before next start
+                # are unmatched
+                blocks = []
+                unmatched = []
+                i = 0
+                while i < n:
+                    if is_start[i]:
+                        j = i
+                        while j < n and not is_end[j]:
+                            j += 1
+                        if j < n:
+                            blocks.append((i, j))
+                            i = j + 1
+                        else:
+                            blocks.append((i, n - 1))
+                            i = n
+                    else:
+                        unmatched.append(i)
+                        i += 1
+                self._emit(group, cols, arena, blocks, unmatched)
+                return
+            if is_cont is not None:
+                blocks = []
+                unmatched = []
+                i = 0
+                while i < n:
+                    if is_start[i]:
+                        j = i
+                        while j + 1 < n and is_cont[j + 1]:
+                            j += 1
+                        blocks.append((i, j))
+                        i = j + 1
+                    else:
+                        unmatched.append(i)
+                        i += 1
+                self._emit(group, cols, arena, blocks, unmatched)
+                return
+            # start-only: vectorised — block k spans starts_idx[k] ..
+            # (starts_idx[k+1] - 1); leading lines are unmatched
+            if len(starts_idx) == 0:
+                if self.unmatched == "discard":
+                    group.set_columns(ColumnarLogs(
+                        np.zeros(0, np.int32), np.zeros(0, np.int32)))
+                return
+            block_first = starts_idx
+            block_last = np.concatenate([starts_idx[1:] - 1, [n - 1]])
+            blocks = list(zip(block_first.tolist(), block_last.tolist()))
+            unmatched = list(range(int(starts_idx[0])))
+            self._emit(group, cols, arena, blocks, unmatched)
+            return
+
+        # end-only mode: block closes at each end-match
+        blocks = []
+        unmatched = []
+        i = 0
+        start_i = 0
+        for i in range(n):
+            if is_end[i]:
+                blocks.append((start_i, i))
+                start_i = i + 1
+        for j in range(start_i, n):
+            unmatched.append(j)
+        self._emit(group, cols, arena, blocks, unmatched)
+
+    def _emit(self, group, cols, arena, blocks, unmatched) -> None:
+        offs = cols.offsets.astype(np.int64)
+        lens = cols.lengths.astype(np.int64)
+        tss = cols.timestamps
+        records = []  # (first_idx, merged_off, merged_len)
+        for first, last in blocks:
+            mo = int(offs[first])
+            ml = int(offs[last] + lens[last]) - mo
+            records.append((first, mo, ml))
+        if self.unmatched != "discard":
+            for i in unmatched:
+                records.append((i, int(offs[i]), int(lens[i])))
+        records.sort(key=lambda r: r[0])
+        out = ColumnarLogs(
+            offsets=np.array([r[1] for r in records], dtype=np.int32),
+            lengths=np.array([r[2] for r in records], dtype=np.int32),
+            timestamps=np.array([tss[r[0]] for r in records], dtype=np.int64))
+        group.set_columns(out)

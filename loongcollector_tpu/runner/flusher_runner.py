@@ -1,0 +1,157 @@
+"""FlusherRunner: drains sender queues into the HTTP sink.
+
+Reference: core/runner/FlusherRunner.cpp — single thread (:168); pops
+available items (rate + AIMD gates consulted inside the queues), dispatches
+by sink type (:219), exponential backoff on failure (100 ms → 10 s,
+:133-141), global send-byte rate limit (:202-204).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import heapq
+
+from ..monitor.metrics import MetricsRecord
+from ..pipeline.queue.limiter import RateLimiter
+from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
+                                           SendingStatus)
+from ..utils.logger import get_logger
+from .http_sink import HttpSink
+
+log = get_logger("flusher_runner")
+
+RETRY_BASE_S = 0.1
+RETRY_MAX_S = 10.0
+
+
+class FlusherRunner:
+    def __init__(self, sender_queue_manager: SenderQueueManager,
+                 http_sink: Optional[HttpSink] = None,
+                 max_bytes_per_sec: int = 0):
+        self.sqm = sender_queue_manager
+        self.http_sink = http_sink
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.rate_limiter = RateLimiter(max_bytes_per_sec)
+        self._retry_heap = []
+        self._retry_lock = threading.Lock()
+        self._retry_thread: Optional[threading.Thread] = None
+        self.metrics = MetricsRecord(category="runner",
+                                     labels={"runner": "flusher"})
+        self.out_items = self.metrics.counter("out_items_total")
+        self.out_bytes = self.metrics.counter("out_size_bytes")
+
+    def init(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="flusher-runner",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 5.0) -> None:
+        if drain:
+            deadline = time.monotonic() + timeout
+            while not self.sqm.all_empty() and time.monotonic() < deadline:
+                time.sleep(0.05)
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while self._running:
+            items = self.sqm.get_available_items()
+            if not items:
+                time.sleep(0.02)
+                continue
+            for item in items:
+                if not self.rate_limiter.is_valid_to_pop():
+                    self._requeue_later(item)
+                    continue
+                self.rate_limiter.post_pop(len(item.data))
+                self._dispatch(item)
+
+    def _release_limiters(self, item: SenderQueueItem) -> None:
+        q = self.sqm.get_queue(item.queue_key)
+        if q is not None:
+            for cl in q.concurrency_limiters:
+                cl.on_done()
+
+    def _requeue_later(self, item: SenderQueueItem) -> None:
+        self._release_limiters(item)
+        q = self.sqm.get_queue(item.queue_key)
+        if q is not None:
+            q.reset_item_status(item)
+
+    def _dispatch(self, item: SenderQueueItem) -> None:
+        flusher = item.flusher
+        if flusher is None or self.http_sink is None:
+            self._release_limiters(item)
+            self.sqm.remove_item(item)
+            return
+        try:
+            request = flusher.build_request(item)
+        except Exception:  # noqa: BLE001
+            log.exception("build_request failed; backing off")
+            self._release_limiters(item)
+            self._backoff_retry(item)
+            return
+        self.http_sink.add_request(
+            request, lambda status, body, it=item: self._on_done(it, status, body))
+
+    def _on_done(self, item: SenderQueueItem, status: int, body: bytes) -> None:
+        flusher = item.flusher
+        q = self.sqm.get_queue(item.queue_key)
+        verdict = "drop"
+        try:
+            verdict = flusher.on_send_done(item, status, body)
+        except Exception:  # noqa: BLE001
+            log.exception("on_send_done failed")
+        if q is not None:
+            for cl in q.concurrency_limiters:
+                cl.on_done()
+                if verdict == "ok":
+                    cl.on_success()
+                elif verdict == "retry":
+                    cl.on_fail(slow=(status == 429))
+        elif verdict != "retry":
+            pass  # queue deleted: item dropped below
+        if verdict == "retry":
+            self._backoff_retry(item)
+            return
+        self.out_items.add(1)
+        self.out_bytes.add(len(item.data))
+        self.sqm.remove_item(item)
+
+    def _backoff_retry(self, item: SenderQueueItem) -> None:
+        """Exponential backoff (100 ms → 10 s, reference FlusherRunner.cpp
+        :133-141) via a single shared timer heap — no thread per retry."""
+        delay = min(RETRY_BASE_S * (2 ** min(item.try_count, 8)), RETRY_MAX_S)
+        with self._retry_lock:
+            heapq.heappush(self._retry_heap,
+                           (time.monotonic() + delay, id(item), item))
+            if self._retry_thread is None or not self._retry_thread.is_alive():
+                self._retry_thread = threading.Thread(
+                    target=self._retry_loop, name="flusher-retry", daemon=True)
+                self._retry_thread.start()
+
+    def _retry_loop(self) -> None:
+        while True:
+            with self._retry_lock:
+                if not self._retry_heap:
+                    return
+                due, _, item = self._retry_heap[0]
+                now = time.monotonic()
+                if due <= now:
+                    heapq.heappop(self._retry_heap)
+                else:
+                    item = None
+                    wait = due - now
+            if item is None:
+                time.sleep(min(wait, 0.5))
+                continue
+            q = self.sqm.get_queue(item.queue_key)
+            if q is not None:
+                q.reset_item_status(item)

@@ -1,0 +1,85 @@
+"""HttpSink: the network dispatch thread.
+
+Reference: core/runner/sink/http/HttpSink.cpp — a dedicated thread around a
+curl_multi event loop (:91,124); completed responses dispatch back to the
+flusher's OnSendDone, decrement in-flight counts and feed queues.
+
+Implementation: a small worker pool over http.client (stdlib; the image has
+no external HTTP deps) with the same completion contract.
+"""
+
+from __future__ import annotations
+
+import http.client
+import queue as _queue
+import threading
+from typing import Callable, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..utils.logger import get_logger
+
+log = get_logger("http_sink")
+
+
+class HttpSink:
+    def __init__(self, workers: int = 4):
+        self.workers = workers
+        self._queue: _queue.Queue = _queue.Queue()
+        self._threads = []
+        self._running = False
+
+    def init(self) -> None:
+        self._running = True
+        for i in range(self.workers):
+            t = threading.Thread(target=self._run, name=f"http-sink-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        for _ in self._threads:
+            self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def add_request(self, request, on_done: Callable[[int, bytes], None]) -> None:
+        """request: flusher.HttpRequest; on_done(status, body) runs on a sink
+        worker thread (status 0 ⇒ network error)."""
+        self._queue.put((request, on_done))
+
+    def pending(self) -> int:
+        return self._queue.qsize()
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            request, on_done = item
+            status, body = self._execute(request)
+            try:
+                on_done(status, body)
+            except Exception:  # noqa: BLE001
+                log.exception("on_done callback failed")
+
+    @staticmethod
+    def _execute(request) -> Tuple[int, bytes]:
+        try:
+            u = urlparse(request.url)
+            conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                        else http.client.HTTPConnection)
+            conn = conn_cls(u.netloc, timeout=request.timeout)
+            path = u.path or "/"
+            if u.query:
+                path += "?" + u.query
+            conn.request(request.method, path, body=request.body,
+                         headers=request.headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            status = resp.status
+            conn.close()
+            return status, body
+        except Exception as e:  # noqa: BLE001 - any transport failure = retryable
+            return 0, str(e).encode()

@@ -1,0 +1,107 @@
+"""ProcessorRunner: the processing thread engine.
+
+Reference: core/runner/ProcessorRunner.cpp — N worker threads (default 1,
+app_config/AppConfig.cpp:58) pop from the process-queue manager (priority RR),
+find the owning pipeline, run Process then Send (:90-189); thread 0 also
+pumps batch timeout flushes (:109-112); producer API PushQueue with bounded
+retries (:72-88).
+
+TPU note: one runner thread per device keeps the device queue full while
+host pre/post-processing of the NEXT batch overlaps with device execution
+(the jax dispatch is async until results are read).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..models import PipelineEventGroup
+from ..monitor.metrics import MetricsRecord
+from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
+from ..pipeline.queue.process_queue_manager import ProcessQueueManager
+from ..utils.logger import get_logger
+
+log = get_logger("processor_runner")
+
+BATCH_FLUSH_INTERVAL_S = 1.0
+
+
+class ProcessorRunner:
+    def __init__(self, process_queue_manager: ProcessQueueManager,
+                 pipeline_manager, thread_count: int = 1):
+        self.pqm = process_queue_manager
+        self.pipeline_manager = pipeline_manager
+        self.thread_count = thread_count
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self.metrics = MetricsRecord(category="runner",
+                                     labels={"runner": "processor"})
+        self.in_groups = self.metrics.counter("in_event_groups_total")
+        self.in_events = self.metrics.counter("in_events_total")
+        self.in_bytes = self.metrics.counter("in_size_bytes")
+        self.last_flush = time.monotonic()
+
+    # -- producer API -------------------------------------------------------
+
+    def push_queue(self, key: int, group: PipelineEventGroup,
+                   retry_times: int = 10) -> bool:
+        for _ in range(retry_times):
+            if self.pqm.push_queue(key, group):
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self) -> None:
+        self._running = True
+        for i in range(self.thread_count):
+            t = threading.Thread(target=self._run, args=(i,),
+                                 name=f"processor-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        self.pqm.wake_up()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self, thread_no: int) -> None:
+        while self._running:
+            if thread_no == 0:
+                now = time.monotonic()
+                if now - self.last_flush >= BATCH_FLUSH_INTERVAL_S:
+                    self.last_flush = now
+                    TimeoutFlushManager.instance().flush_timeout_batches()
+            item = self.pqm.pop_item(timeout=0.2)
+            if item is None:
+                continue
+            key, group = item
+            self._process_one(key, group)
+        # drain remaining items on stop
+        while True:
+            item = self.pqm.pop_item(timeout=0)
+            if item is None:
+                break
+            self._process_one(*item)
+
+    def _process_one(self, key: int, group: PipelineEventGroup) -> None:
+        pipeline = self.pipeline_manager.find_pipeline_by_queue_key(key)
+        if pipeline is None:
+            log.warning("no pipeline for queue key %d; dropping group", key)
+            return
+        self.in_groups.add(1)
+        self.in_events.add(len(group))
+        self.in_bytes.add(group.data_size())
+        groups = [group]
+        try:
+            pipeline.process(groups)
+            pipeline.send(groups)
+        except Exception:  # noqa: BLE001
+            log.exception("pipeline %s processing failed", pipeline.name)

@@ -1,0 +1,183 @@
+"""End-to-end slice: file tail → split → TPU regex parse → flusher.
+
+Mirrors the reference quick-start scenario (SURVEY.md §7 step 3,
+example_config/quick_start/config/file_simple.yaml) plus pipeline hot-swap
+under load (reference PipelineUpdateUnittest.cpp).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from loongcollector_tpu.input.file.file_server import FileServer
+from loongcollector_tpu.pipeline.pipeline_manager import (
+    CollectionPipelineManager, ConfigDiff)
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
+from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+
+
+def wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    pqm = ProcessQueueManager()
+    sqm = SenderQueueManager()
+    mgr = CollectionPipelineManager(pqm, sqm)
+    runner = ProcessorRunner(pqm, mgr, thread_count=1)
+    runner.init()
+    fs = FileServer.instance()
+    fs.process_queue_manager = pqm
+    fs.checkpoints.path = str(tmp_path / "checkpoints.json")
+    yield pqm, sqm, mgr, runner, fs, tmp_path
+    mgr.stop_all()
+    runner.stop()
+    fs.stop()
+    FileServer._instance = None
+
+
+def test_file_to_flusher_file(stack):
+    pqm, sqm, mgr, runner, fs, tmp_path = stack
+    log_path = tmp_path / "app.log"
+    out_path = tmp_path / "out.json"
+    log_path.write_text("")
+
+    diff = ConfigDiff()
+    diff.added["e2e-test"] = {
+        "inputs": [{"Type": "input_file",
+                    "FilePaths": [str(log_path)],
+                    "TailingAllMatchedFiles": True}],
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": r"(\S+) (\w+) (.*)",
+                        "Keys": ["ip", "method", "msg"]}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out_path),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+    mgr.update_pipelines(diff)
+
+    with open(log_path, "a") as f:
+        f.write("1.2.3.4 GET hello world\n")
+        f.write("5.6.7.8 POST bye\n")
+
+    assert wait_for(lambda: out_path.exists()
+                    and out_path.read_text().count("\n") >= 2)
+    lines = [json.loads(l) for l in out_path.read_text().splitlines()]
+    assert lines[0]["ip"] == "1.2.3.4"
+    assert lines[0]["msg"] == "hello world"
+    assert lines[1]["method"] == "POST"
+
+
+def test_tail_appends_and_checkpoint(stack):
+    pqm, sqm, mgr, runner, fs, tmp_path = stack
+    log_path = tmp_path / "tail.log"
+    out_path = tmp_path / "out2.json"
+    log_path.write_text("old line skipped? no - TailingAllMatchedFiles\n")
+
+    diff = ConfigDiff()
+    diff.added["tail-test"] = {
+        "inputs": [{"Type": "input_file", "FilePaths": [str(log_path)],
+                    "TailingAllMatchedFiles": True}],
+        "processors": [],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out_path),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+    mgr.update_pipelines(diff)
+    assert wait_for(lambda: out_path.exists()
+                    and "old line" in out_path.read_text())
+
+    with open(log_path, "a") as f:
+        f.write("appended later\n")
+    assert wait_for(lambda: "appended later" in out_path.read_text())
+    # partial line is not shipped until completed
+    with open(log_path, "a") as f:
+        f.write("incomplete")
+    time.sleep(0.3)
+    assert "incomplete" not in out_path.read_text()
+    with open(log_path, "a") as f:
+        f.write(" now done\n")
+    assert wait_for(lambda: "incomplete now done" in out_path.read_text())
+
+
+def test_hot_swap_under_load(stack):
+    pqm, sqm, mgr, runner, fs, tmp_path = stack
+    log_path = tmp_path / "swap.log"
+    out1 = tmp_path / "swap_out1.json"
+    out2 = tmp_path / "swap_out2.json"
+    log_path.write_text("")
+
+    cfg = {
+        "inputs": [{"Type": "input_file", "FilePaths": [str(log_path)],
+                    "TailingAllMatchedFiles": True}],
+        "processors": [],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out1),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+    diff = ConfigDiff()
+    diff.added["swap"] = cfg
+    mgr.update_pipelines(diff)
+    with open(log_path, "a") as f:
+        f.write("before swap\n")
+    assert wait_for(lambda: out1.exists() and "before swap" in out1.read_text())
+
+    # swap flusher target
+    cfg2 = dict(cfg)
+    cfg2["flushers"] = [{"Type": "flusher_file", "FilePath": str(out2),
+                         "MinCnt": 1, "MinSizeBytes": 1}]
+    diff2 = ConfigDiff()
+    diff2.modified["swap"] = cfg2
+    mgr.update_pipelines(diff2)
+    with open(log_path, "a") as f:
+        f.write("after swap\n")
+    assert wait_for(lambda: out2.exists() and "after swap" in out2.read_text())
+    assert "after swap" not in out1.read_text()
+
+
+def test_sls_serializer_wire_format(tmp_path):
+    """Decode the hand-rolled wire bytes with a minimal PB reader."""
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.models import PipelineEventGroup
+
+    g = PipelineEventGroup()
+    sb = g.source_buffer
+    g.set_tag(b"host", b"h1")
+    ev = g.add_log_event(1700000000)
+    ev.set_content(sb.copy_string(b"k"), sb.copy_string(b"v"))
+    data = SLSEventGroupSerializer(topic=b"t").serialize([g])
+
+    def read_varint(buf, i):
+        shift = v = 0
+        while True:
+            b = buf[i]
+            i += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v, i
+            shift += 7
+
+    # walk top-level fields
+    i = 0
+    fields = {}
+    while i < len(data):
+        tag, i = read_varint(data, i)
+        fno, wt = tag >> 3, tag & 7
+        assert wt == 2
+        ln, i = read_varint(data, i)
+        fields.setdefault(fno, []).append(data[i:i+ln])
+        i += ln
+    assert 1 in fields     # Logs
+    assert 6 in fields     # LogTags
+    assert fields[3] == [b"t"]  # Topic
+    log = fields[1][0]
+    t, j = read_varint(log, 1)  # skip 0x08 tag byte
+    assert t == 1700000000

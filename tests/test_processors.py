@@ -1,0 +1,241 @@
+"""Processor tests: split, regex parse (columnar+row), json, delimiter,
+timestamp, filter, desensitize, multiline — per-feature + fail-path, after
+the reference's unittest style (core/unittest/processor/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import (ColumnarLogs, PipelineEventGroup,
+                                       SourceBuffer)
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.processor.desensitize import ProcessorDesensitize
+from loongcollector_tpu.processor.filter import ProcessorFilter
+from loongcollector_tpu.processor.parse_delimiter import ProcessorParseDelimiter
+from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+from loongcollector_tpu.processor.parse_regex import ProcessorParseRegex
+from loongcollector_tpu.processor.parse_timestamp import ProcessorParseTimestamp
+from loongcollector_tpu.processor.split_log_string import ProcessorSplitLogString
+from loongcollector_tpu.processor.split_multiline import \
+    ProcessorSplitMultilineLogString
+
+CTX = PluginContext("test")
+
+
+def raw_group(data: bytes) -> PipelineEventGroup:
+    sb = SourceBuffer()
+    view = sb.copy_string(data)
+    g = PipelineEventGroup(sb)
+    ev = g.add_raw_event(100)
+    ev.set_content(view)
+    return g
+
+
+def split_group(data: bytes) -> PipelineEventGroup:
+    g = raw_group(data)
+    p = ProcessorSplitLogString()
+    p.init({}, CTX)
+    p.process(g)
+    return g
+
+
+class TestSplitLogString:
+    def test_basic_lines(self):
+        g = split_group(b"one\ntwo\nthree\n")
+        assert len(g) == 3
+        events = g.materialize()
+        assert events[0].get_content(b"content") == b"one"
+        assert events[2].get_content(b"content") == b"three"
+
+    def test_no_trailing_newline(self):
+        g = split_group(b"one\ntwo")
+        assert len(g) == 2
+
+    def test_empty_interior_lines_kept(self):
+        g = split_group(b"a\n\nb\n")
+        assert len(g) == 3
+        assert g.materialize()[1].get_content(b"content") == b""
+
+
+class TestParseRegexColumnar:
+    def test_parse_fields(self):
+        g = split_group(b"1.2.3.4 GET /x\n9.9.9.9 POST /y\nbadline\n")
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"(\S+) (\S+) (\S+)",
+                "Keys": ["ip", "method", "url"]}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"ip") == b"1.2.3.4"
+        assert events[1].get_content(b"method") == b"POST"
+        # failed line keeps raw under rawLog (KeepingSourceWhenParseFail)
+        assert events[2].get_content(b"rawLog") == b"badline"
+        assert not events[2].has_content(b"ip")
+
+    def test_discard_unmatch(self):
+        g = split_group(b"ok 1\nbad\n")
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"(\w+) (\d+)", "Keys": ["w", "d"],
+                "KeepingSourceWhenParseFail": False}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert not events[1].has_content(b"rawLog")
+        assert len(events[1]) == 0
+
+    def test_row_path(self):
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        ev = g.add_log_event(1)
+        ev.set_content(sb.copy_string(b"content"), sb.copy_string(b"k=v"))
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"([^=]+)=(\S+)", "Keys": ["k", "v"]}, CTX)
+        p.process(g)
+        assert g.events[0].get_content(b"k") == b"k"
+        assert g.events[0].get_content(b"v") == b"v"
+        assert not g.events[0].has_content(b"content")
+
+
+class TestParseJson:
+    def test_columnar(self):
+        g = split_group(b'{"a": 1, "b": "x"}\nnot json\n')
+        p = ProcessorParseJson()
+        p.init({}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"a") == b"1"
+        assert events[0].get_content(b"b") == b"x"
+        assert events[1].get_content(b"rawLog") == b"not json"
+
+    def test_nested_value_reserialized(self):
+        g = split_group(b'{"o": {"x": 1}}\n')
+        p = ProcessorParseJson()
+        p.init({}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert json.loads(ev.get_content(b"o").to_bytes()) == {"x": 1}
+
+
+class TestParseDelimiter:
+    def test_columnar_tpu_path(self):
+        g = split_group(b"a,b,c\n1,2,3\nshort\n")
+        p = ProcessorParseDelimiter()
+        p.init({"Separator": ",", "Keys": ["f1", "f2", "f3"]}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"f2") == b"b"
+        assert events[1].get_content(b"f3") == b"3"
+        assert events[2].get_content(b"rawLog") == b"short"
+
+    def test_extra_columns_merge_into_last(self):
+        g = split_group(b"a,b,c,d,e\n")
+        p = ProcessorParseDelimiter()
+        p.init({"Separator": ",", "Keys": ["f1", "f2"]}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"f1") == b"a"
+        assert ev.get_content(b"f2") == b"b,c,d,e"
+
+    def test_quote_mode(self):
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        ev = g.add_log_event(1)
+        ev.set_content(sb.copy_string(b"content"),
+                       sb.copy_string(b'"x,y",2,"he said ""hi"""'))
+        p = ProcessorParseDelimiter()
+        p.init({"Separator": ",", "Quote": '"', "Keys": ["a", "b", "c"]}, CTX)
+        p.process(g)
+        assert g.events[0].get_content(b"a") == b"x,y"
+        assert g.events[0].get_content(b"c") == b'he said "hi"'
+
+
+class TestParseTimestamp:
+    def test_rewrites_event_time(self):
+        g = split_group(b"x\ny\n")
+        cols = g.columns
+        sb = g.source_buffer
+        v1 = sb.copy_string(b"2024-01-02 03:04:05")
+        cols.set_field("time", np.array([v1.offset, 0]),
+                       np.array([v1.length, -1]))
+        p = ProcessorParseTimestamp()
+        p.init({"SourceKey": "time", "SourceFormat": "%Y-%m-%d %H:%M:%S",
+                "SourceTimezone": "GMT+00:00"}, CTX)
+        p.process(g)
+        import calendar, time as _t
+        want = calendar.timegm(_t.strptime("2024-01-02 03:04:05",
+                                           "%Y-%m-%d %H:%M:%S"))
+        assert g.columns.timestamps[0] == want
+        assert g.columns.timestamps[1] == 100  # untouched
+
+
+class TestFilter:
+    def test_include_exclude_columnar(self):
+        g = split_group(b"ERROR x\nINFO y\nERROR z\n")
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"(\w+) (\S+)", "Keys": ["level", "msg"]}, CTX)
+        p.process(g)
+        f = ProcessorFilter()
+        f.init({"Include": {"level": "ERROR"}}, CTX)
+        f.process(g)
+        assert len(g) == 2
+        events = g.materialize()
+        assert events[1].get_content(b"msg") == b"z"
+
+
+class TestDesensitize:
+    def test_const_mask(self):
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        ev = g.add_log_event(1)
+        ev.set_content(sb.copy_string(b"content"),
+                       sb.copy_string(b"password=hunter2,other=x"))
+        p = ProcessorDesensitize()
+        p.init({"Regex": r"(password=)([^,]+)", "Method": "const",
+                "ReplacingString": "***"}, CTX)
+        p.process(g)
+        assert g.events[0].get_content(b"content") == b"password=***,other=x"
+
+    def test_columnar_mask(self):
+        g = split_group(b"card=1234 end\nno secret\n")
+        p = ProcessorDesensitize()
+        p.init({"Regex": r"(card=)(\d+)", "Method": "const",
+                "ReplacingString": "X"}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"content") == b"card=X end"
+        assert events[1].get_content(b"content") == b"no secret"
+
+
+class TestSplitMultiline:
+    def test_start_pattern_java_stacktrace(self):
+        data = (b"2024-01-01 ERROR boom\n"
+                b"  at com.example.Foo(Foo.java:1)\n"
+                b"  at com.example.Bar(Bar.java:2)\n"
+                b"2024-01-01 INFO ok\n")
+        g = split_group(data)
+        p = ProcessorSplitMultilineLogString()
+        p.init({"Multiline": {"StartPattern": r"\d{4}-\d{2}-\d{2} .*"}}, CTX)
+        p.process(g)
+        assert len(g) == 2
+        events = g.materialize()
+        first = events[0].get_content(b"content").to_bytes()
+        assert first.startswith(b"2024-01-01 ERROR boom\n  at")
+        assert events[1].get_content(b"content") == b"2024-01-01 INFO ok"
+
+    def test_leading_unmatched_single_line(self):
+        data = b"orphan\n2024-01-01 start\ncont\n"
+        g = split_group(data)
+        p = ProcessorSplitMultilineLogString()
+        p.init({"Multiline": {"StartPattern": r"\d{4}.*",
+                              "UnmatchedContentTreatment": "single_line"}}, CTX)
+        p.process(g)
+        assert len(g) == 2
+        assert g.materialize()[0].get_content(b"content") == b"orphan"
+
+    def test_leading_unmatched_discard(self):
+        data = b"orphan\n2024-01-01 start\n"
+        g = split_group(data)
+        p = ProcessorSplitMultilineLogString()
+        p.init({"Multiline": {"StartPattern": r"\d{4}.*",
+                              "UnmatchedContentTreatment": "discard"}}, CTX)
+        p.process(g)
+        assert len(g) == 1

@@ -1,0 +1,119 @@
+"""Queue state machine tests (reference: core/unittest/queue/)."""
+
+import numpy as np
+
+from loongcollector_tpu.models import PipelineEventGroup
+from loongcollector_tpu.pipeline.queue.bounded_queue import (
+    BoundedProcessQueue, CircularProcessQueue, FeedbackInterface)
+from loongcollector_tpu.pipeline.queue.limiter import (ConcurrencyLimiter,
+                                                       RateLimiter)
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import (SenderQueue,
+                                                            SenderQueueItem)
+
+
+def make_group():
+    g = PipelineEventGroup()
+    g.add_log_event(1)
+    return g
+
+
+class _Feedback(FeedbackInterface):
+    def __init__(self):
+        self.calls = []
+
+    def feedback(self, key):
+        self.calls.append(key)
+
+
+class TestBoundedQueue:
+    def test_watermark_state_machine(self):
+        q = BoundedProcessQueue(key=1, capacity=3)
+        fb = _Feedback()
+        q.set_feedback(fb)
+        assert q.push(make_group())
+        assert q.push(make_group())
+        assert q.is_valid_to_push()
+        assert q.push(make_group())          # reaches high watermark
+        assert not q.is_valid_to_push()
+        assert not q.push(make_group())      # rejected
+        q.pop()                              # 2 left = low watermark (3*2/3)
+        assert q.is_valid_to_push()
+        assert fb.calls == [1]
+
+    def test_pop_disabled(self):
+        q = BoundedProcessQueue(key=1)
+        q.push(make_group())
+        q.set_pop_enabled(False)
+        assert q.pop() is None
+        q.set_pop_enabled(True)
+        assert q.pop() is not None
+
+    def test_circular_drops_oldest(self):
+        q = CircularProcessQueue(key=1, capacity=2)
+        for _ in range(5):
+            assert q.push(make_group())
+        assert q.size() == 2
+        assert q.total_dropped == 3
+
+
+class TestProcessQueueManager:
+    def test_priority_ordering(self):
+        m = ProcessQueueManager()
+        m.create_or_reuse_queue(1, priority=2)
+        m.create_or_reuse_queue(2, priority=0)
+        m.push_queue(1, make_group())
+        m.push_queue(2, make_group())
+        key, _ = m.pop_item(timeout=0)
+        assert key == 2  # higher priority first
+
+    def test_round_robin_within_priority(self):
+        m = ProcessQueueManager()
+        for k in (1, 2):
+            m.create_or_reuse_queue(k, priority=1)
+            m.push_queue(k, make_group())
+            m.push_queue(k, make_group())
+        keys = [m.pop_item(timeout=0)[0] for _ in range(4)]
+        assert keys in ([1, 2, 1, 2], [2, 1, 2, 1])
+
+
+class TestLimiters:
+    def test_aimd(self):
+        cl = ConcurrencyLimiter("ep", max_concurrency=10)
+        assert cl.current_limit == 10
+        cl.on_fail()
+        assert cl.current_limit == 5
+        cl.on_fail(slow=True)
+        assert cl.current_limit == 4
+        cl.on_success()
+        assert cl.current_limit == 5
+
+    def test_concurrency_gate(self):
+        cl = ConcurrencyLimiter("ep", max_concurrency=1)
+        assert cl.is_valid_to_pop()
+        cl.post_pop()
+        assert not cl.is_valid_to_pop()
+        cl.on_done()
+        assert cl.is_valid_to_pop()
+
+    def test_rate_limiter_window(self):
+        rl = RateLimiter(max_bytes_per_sec=100)
+        assert rl.is_valid_to_pop()
+        rl.post_pop(150)
+        assert not rl.is_valid_to_pop()
+
+
+class TestSenderQueue:
+    def test_available_items_respects_limiters(self):
+        q = SenderQueue(key=1)
+        cl = ConcurrencyLimiter("ep", max_concurrency=1)
+        q.concurrency_limiters = [cl]
+        q.push(SenderQueueItem(b"a", 1, queue_key=1))
+        q.push(SenderQueueItem(b"b", 1, queue_key=1))
+        items = q.get_available_items(10)
+        assert len(items) == 1  # concurrency gate
+        cl.on_done()
+        q.remove(items[0])
+        items2 = q.get_available_items(10)
+        assert len(items2) == 1
