@@ -79,6 +79,8 @@ class Application:
                 diff = self.config_watcher.check_config_diff()
                 if not diff.empty():
                     self.pipeline_manager.update_pipelines(diff)
+                self.sender_queue_manager.gc_marked()
+                WriteMetrics.instance().gc_deleted()
             if once:
                 # drain mode for one-shot runs: wait until queues idle
                 time.sleep(1.0)

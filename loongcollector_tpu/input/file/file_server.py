@@ -144,6 +144,15 @@ class FileServer:
                         self._open_reader(st, path)
                     else:
                         self._check_rotation(st, path)
+                # prune readers whose file left the glob or was deleted —
+                # otherwise open fds pin deleted files' disk space forever
+                known_set = set(st.known)
+                for path in list(st.readers):
+                    if path not in known_set:
+                        r = st.readers.pop(path)
+                        self._drain_reader(st, r, force_flush=True)
+                        self.checkpoints.remove(path)
+                        r.close()
                 st.first_round = False
             # drain any reader with unread bytes — back-pressured or
             # burst-capped files retry here next round (never stall on stat)

@@ -90,9 +90,12 @@ class InputStaticFile(Input):
                 if not reader.open():
                     continue
                 while True:
-                    group = reader.read(force_flush=not reader.has_more())
+                    group = reader.read()
                     if group is None:
-                        break
+                        # ship the final partial line (no trailing newline)
+                        group = reader.read(force_flush=True)
+                        if group is None:
+                            break
                     if fs.process_queue_manager is not None:
                         while not fs.process_queue_manager.push_queue(
                                 self.context.process_queue_key, group):

@@ -35,7 +35,14 @@ class SourceBuffer:
         if need > cap:
             while cap < need:
                 cap *= 2
-            self._data.extend(bytes(cap - len(self._data)))
+            # Reallocate into a NEW bytearray rather than extending in place:
+            # live numpy exports (as_array views held by columnar processors)
+            # keep the old buffer alive and valid, so arena growth can never
+            # raise BufferError mid-batch.  StringViews resolve through
+            # `self._data` and see the new buffer.
+            new = bytearray(cap)
+            new[: self._size] = self._data[: self._size]
+            self._data = new
 
     def allocate(self, n: int) -> int:
         """Bump-allocate n bytes; returns the offset."""

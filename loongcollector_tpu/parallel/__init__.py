@@ -1,0 +1,1 @@
+from .mesh import ShardedParsePlane, make_mesh

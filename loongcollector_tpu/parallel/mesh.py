@@ -1,0 +1,89 @@
+"""Multi-chip scale-out of the parse data plane.
+
+Reference reality (SURVEY.md §2.7, §5.8): LoongCollector agents are
+independent processes — no NCCL/MPI; its parallelism is pipelined threads +
+per-pipeline queues.  The TPU-native equivalent for one host owning multiple
+chips: **data-parallel sharding of event batches over an ICI-connected
+device mesh**.  Events are embarrassingly parallel, so the batch dimension
+shards cleanly; the only cross-chip communication is tiny psum'd telemetry
+(match counts / byte counts for the self-monitor), which rides ICI.
+
+Design: `shard_map` over a 1-D ('dp',) mesh; each chip runs the same
+gather-free extraction kernel on its batch shard; jax.lax.psum aggregates
+stats.  Multi-host (DCN) follows the same SPMD program — jax.distributed
+initialises the global mesh and the batch dimension spans hosts; no code
+change in the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.regex.program import SegmentProgram
+from ..ops.kernels.field_extract import build_extract_fn
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+class ShardedParsePlane:
+    """The parse step jitted over a device mesh.
+
+    step(rows [B,L], lengths [B]) ->
+        ok [B] bool, cap_off [B,C] i32, cap_len [B,C] i32,
+        stats {matched, events, bytes} — psum-replicated across the mesh.
+
+    B must be divisible by the mesh size (the batch builder pads to powers
+    of two, so any power-of-two mesh divides it).
+    """
+
+    def __init__(self, program: SegmentProgram, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.program = program
+        extract = build_extract_fn(program)
+        axis = self.mesh.axis_names[0]
+
+        def _local_step(rows, lengths):
+            ok, off, length = extract(rows, lengths)
+            stats = {
+                "matched": jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis),
+                "events": jax.lax.psum(
+                    jnp.sum((lengths > 0).astype(jnp.int32)), axis),
+                "bytes": jax.lax.psum(jnp.sum(lengths), axis),
+            }
+            return ok, off, length, stats
+
+        from jax.experimental.shard_map import shard_map
+        sharded = shard_map(
+            _local_step, mesh=self.mesh,
+            in_specs=(P(axis, None), P(axis)),
+            out_specs=(P(axis), P(axis, None), P(axis, None),
+                       {"matched": P(), "events": P(), "bytes": P()}),
+            check_rep=False)
+        self._fn = jax.jit(sharded)
+        ax = axis
+        self._in_shardings = (NamedSharding(self.mesh, P(ax, None)),
+                              NamedSharding(self.mesh, P(ax)))
+
+    def put(self, rows: np.ndarray, lengths: np.ndarray):
+        """Device-put host arrays with the mesh sharding (one shard per
+        chip's HBM)."""
+        return (jax.device_put(rows, self._in_shardings[0]),
+                jax.device_put(lengths, self._in_shardings[1]))
+
+    def __call__(self, rows, lengths):
+        return self._fn(rows, lengths)
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size

@@ -43,6 +43,7 @@ class CollectionPipeline:
         self._in_process_cnt = 0
         self._in_process_zero = threading.Condition()
         self.metrics = None
+        self._metric_records = []
 
     # ------------------------------------------------------------------
 
@@ -55,6 +56,7 @@ class CollectionPipeline:
         self.context.pipeline = self
         self.metrics = MetricsRecord(category="pipeline",
                                      labels={"pipeline_name": name})
+        self._metric_records.append(self.metrics)
         registry = PluginRegistry.instance()
         registry.load_static_plugins()
 
@@ -66,10 +68,11 @@ class CollectionPipeline:
             typ = icfg.get("Type", "")
             plugin = registry.create_input(typ)
             if plugin is None:
-                return False
+                return self._abort_init()
             inst = InputInstance(plugin, plugin_id=f"{typ}/{i}")
+            self._metric_records.append(inst.metrics)
             if not inst.init(icfg, self.context):
-                return False
+                return self._abort_init()
             self.inputs.append(inst)
             # inputs may supply inner processors (reference :236-256, e.g.
             # InputFile creates the split/multiline processors)
@@ -77,10 +80,11 @@ class CollectionPipeline:
                 ptyp = pcfg.get("Type", "")
                 pplugin = registry.create_processor(ptyp)
                 if pplugin is None:
-                    return False
+                    return self._abort_init()
                 pinst = ProcessorInstance(pplugin, plugin_id=f"{ptyp}/inner")
+                self._metric_records.append(pinst.metrics)
                 if not pinst.init(pcfg, self.context):
-                    return False
+                    return self._abort_init()
                 self.inner_processors.append(pinst)
 
         # user processors
@@ -88,10 +92,11 @@ class CollectionPipeline:
             typ = pcfg.get("Type", "")
             plugin = registry.create_processor(typ)
             if plugin is None:
-                return False
+                return self._abort_init()
             inst = ProcessorInstance(plugin, plugin_id=f"{typ}/{i}")
+            self._metric_records.append(inst.metrics)
             if not inst.init(pcfg, self.context):
-                return False
+                return self._abort_init()
             self.processors.append(inst)
 
         # flushers + router
@@ -100,14 +105,17 @@ class CollectionPipeline:
             typ = fcfg.get("Type", "")
             plugin = registry.create_flusher(typ)
             if plugin is None:
-                return False
+                return self._abort_init()
             inst = FlusherInstance(plugin, plugin_id=f"{typ}/{i}")
+            self._metric_records.append(inst.metrics)
             plugin.queue_key = next_queue_key()
+            self._sender_queue_manager = sender_queue_manager
             if sender_queue_manager is not None:
                 plugin.sender_queue = sender_queue_manager.create_or_reuse_queue(
                     plugin.queue_key, pipeline_name=name)
             if not inst.init(fcfg, self.context):
-                return False
+                self.flushers.append(inst)  # ensure _abort_init stops it
+                return self._abort_init()
             self.flushers.append(inst)
             route_configs.append((i, fcfg.get("Match")))
         self.router.init(route_configs)
@@ -126,6 +134,27 @@ class CollectionPipeline:
                 self.process_queue_key, priority, capacity, name,
                 circular=circular)
         return True
+
+    def _abort_init(self) -> bool:
+        """Failed init: release everything already constructed (batchers
+        registered with TimeoutFlushManager, sender queues, metric records)."""
+        self.release()
+        return False
+
+    def release(self) -> None:
+        """Free pipeline-owned global registrations.  Called on failed init
+        and after stop() by the manager."""
+        for f in self.flushers:
+            try:
+                f.plugin.stop(True)
+            except Exception:  # noqa: BLE001
+                pass
+        sqm = getattr(self, "_sender_queue_manager", None)
+        if sqm is not None:
+            for f in self.flushers:
+                sqm.mark_for_deletion(f.plugin.queue_key)
+        for rec in self._metric_records:
+            rec.mark_deleted()
 
     # ------------------------------------------------------------------
 

@@ -38,6 +38,7 @@ class CollectionPipelineManager:
             old = self._pipelines.get(name)
             if old is not None:
                 old.stop(is_removing=True)
+                old.release()
                 if self.process_queue_manager is not None:
                     self.process_queue_manager.delete_queue(old.process_queue_key)
                 with self._lock:
@@ -47,6 +48,7 @@ class CollectionPipelineManager:
             old = self._pipelines.get(name)
             if old is not None:
                 old.stop(is_removing=False)
+                old.release()
             p = CollectionPipeline()
             if not p.init(name, cfg, self.process_queue_manager,
                           self.sender_queue_manager,

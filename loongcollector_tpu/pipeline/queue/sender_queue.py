@@ -127,7 +127,23 @@ class SenderQueue:
 class SenderQueueManager:
     def __init__(self) -> None:
         self._queues: Dict[int, SenderQueue] = {}
+        self._marked: set = set()
         self._lock = threading.Lock()
+
+    def mark_for_deletion(self, key: int) -> None:
+        """Queue is deleted once its in-flight items drain (reference
+        SenderQueueManager GC semantics — data already serialized must not
+        be lost on pipeline swap)."""
+        with self._lock:
+            self._marked.add(key)
+
+    def gc_marked(self) -> None:
+        with self._lock:
+            for key in list(self._marked):
+                q = self._queues.get(key)
+                if q is None or q.empty():
+                    self._queues.pop(key, None)
+                    self._marked.discard(key)
 
     def create_or_reuse_queue(self, key: int, capacity: int = 10,
                               pipeline_name: str = "") -> SenderQueue:

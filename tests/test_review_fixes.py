@@ -75,3 +75,52 @@ class TestBatchCap:
     def test_pad_batch_capped(self):
         assert pad_batch(70000) == MAX_BATCH
         assert pad_batch(100) == 256
+
+
+class TestArenaGrowthWithLiveExports:
+    def test_copy_string_during_numpy_export(self):
+        """Arena growth must not raise BufferError while a view is live
+        (columnar processors hold as_array() across copy_string calls)."""
+        sb = SourceBuffer(capacity=32)
+        sb.copy_string(b"x" * 24)
+        view = sb.as_array()          # live export
+        for i in range(50):
+            sb.copy_string(b"grow" * 32)   # forces repeated reallocation
+        assert bytes(view[:5].tobytes()) == b"xxxxx"  # old view still valid
+
+    def test_json_parse_growing_arena(self):
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        from loongcollector_tpu.models import PipelineEventGroup
+        data = b'\n'.join(
+            b'{"k%d": "%s"}' % (i, b"v" * 50) for i in range(20)) + b"\n"
+        sb = SourceBuffer(capacity=len(data) + 8)
+        view = sb.copy_string(data)
+        g = PipelineEventGroup(sb)
+        ev = g.add_raw_event(1)
+        ev.set_content(view)
+        ctx = PluginContext("t")
+        sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        pj = ProcessorParseJson(); pj.init({}, ctx)
+        pj.process(g)  # must not raise BufferError
+        evs = g.materialize()
+        assert evs[3].get_content(b"k3") == b"v" * 50
+
+
+class TestStaticFileLastLine:
+    def test_no_trailing_newline_shipped(self, tmp_path):
+        from loongcollector_tpu.input.file.reader import LogFileReader
+        p = tmp_path / "s.log"
+        p.write_bytes(b"line1\nline2_no_newline")
+        r = LogFileReader(str(p))
+        groups = []
+        while True:
+            g = r.read()
+            if g is None:
+                g = r.read(force_flush=True)
+                if g is None:
+                    break
+            groups.append(g.events[0].content.to_bytes())
+        assert groups == [b"line1\n", b"line2_no_newline"]
