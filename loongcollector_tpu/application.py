@@ -19,7 +19,11 @@ import time
 
 from .config.watcher import PipelineConfigWatcher
 from .input.file.file_server import FileServer
+from .input.host_monitor import HostMonitorInputRunner
+from .monitor.alarms import AlarmManager
 from .monitor.metrics import WriteMetrics
+from .monitor.self_monitor import SelfMonitorServer
+from .monitor.watchdog import LoongCollectorMonitor
 from .pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from .pipeline.pipeline_manager import CollectionPipelineManager
 from .pipeline.queue.process_queue_manager import ProcessQueueManager
@@ -54,6 +58,8 @@ class Application:
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))
         self.config_watcher = PipelineConfigWatcher()
+        self.watchdog = LoongCollectorMonitor(
+            on_limit_breach=self._on_limit_breach)
         self._sig_stop = threading.Event()
 
     def init(self) -> None:
@@ -61,6 +67,10 @@ class Application:
         fs = FileServer.instance()
         fs.process_queue_manager = self.process_queue_manager
         fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
+        HostMonitorInputRunner.instance().process_queue_manager = \
+            self.process_queue_manager
+        SelfMonitorServer.instance().process_queue_manager = \
+            self.process_queue_manager
         self.config_watcher.add_source(self.config_dir)
 
     def start(self, once: bool = False) -> None:
@@ -69,6 +79,7 @@ class Application:
         self.http_sink.init()
         self.flusher_runner.init()
         self.processor_runner.init()
+        self.watchdog.start()
         log.info("runners started; watching %s", self.config_dir)
         scan_interval = flags.get_flag("config_scan_interval")
         last_scan = 0.0
@@ -97,6 +108,9 @@ class Application:
         runner drains the process queues THROUGH the pipelines, and only then
         are batchers final-flushed and the send path drained."""
         log.info("exiting: stopping inputs and draining")
+        self.watchdog.stop()
+        SelfMonitorServer.instance().stop()
+        HostMonitorInputRunner.instance().stop()
         FileServer.instance().stop()
         self.processor_runner.stop()          # drains process queues
         self.pipeline_manager.stop_all()      # flush batchers, stop flushers
@@ -105,6 +119,12 @@ class Application:
             drain=True, timeout=flags.get_flag("exit_flush_timeout"))
         self.http_sink.stop()
         log.info("exit complete")
+
+    def _on_limit_breach(self, reason: str) -> None:
+        """Sustained resource breach: log critically and exit for the
+        supervisor to restart (reference watchdog suicide-and-restart)."""
+        log.critical("resource limit breached: %s — exiting for restart", reason)
+        self._sig_stop.set()
 
     def handle_signal(self, signum, frame) -> None:  # noqa: ARG002
         log.info("signal %d received", signum)

@@ -1,0 +1,289 @@
+"""input_host_monitor — host metrics collectors.
+
+Reference: core/host_monitor/ (8.5k LoC) — timer-scheduled collectors
+(CPU/Mem/Disk/Net/Process/System) reading /proc via LinuxSystemInterface,
+assembling metric events pushed through HostMonitorInputRunner
+(HostMonitorInputRunner.cpp:285-339).
+
+One runner thread schedules registered collectors on their intervals and
+pushes MetricEvent groups into the owning pipeline's process queue.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("host_monitor")
+
+
+# ---------------------------------------------------------------------------
+# collectors: each returns {metric_name: (value, {tag: val})}
+# ---------------------------------------------------------------------------
+
+
+class CpuCollector:
+    name = "cpu"
+
+    def __init__(self) -> None:
+        self._last: Optional[List[int]] = None
+
+    def collect(self) -> List[Tuple[str, float, Dict[str, str]]]:
+        with open("/proc/stat") as f:
+            line = f.readline().split()
+        vals = [int(x) for x in line[1:9]]
+        out = []
+        if self._last is not None:
+            deltas = [a - b for a, b in zip(vals, self._last)]
+            total = sum(deltas) or 1
+            names = ["user", "nice", "system", "idle", "iowait", "irq",
+                     "softirq", "steal"]
+            for n, d in zip(names, deltas):
+                out.append((f"cpu_{n}_percent", 100.0 * d / total, {}))
+        self._last = vals
+        return out
+
+
+class MemCollector:
+    name = "mem"
+
+    def collect(self):
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0]) * 1024
+        total = info.get("MemTotal", 0)
+        avail = info.get("MemAvailable", 0)
+        used = total - avail
+        out = [("memory_total_bytes", float(total), {}),
+               ("memory_used_bytes", float(used), {}),
+               ("memory_available_bytes", float(avail), {})]
+        if total:
+            out.append(("memory_used_percent", 100.0 * used / total, {}))
+        return out
+
+
+class DiskCollector:
+    name = "disk"
+
+    def collect(self):
+        out = []
+        seen = set()
+        with open("/proc/mounts") as f:
+            for line in f:
+                dev, mnt, fstype = line.split()[:3]
+                if not dev.startswith("/dev/") or mnt in seen:
+                    continue
+                seen.add(mnt)
+                try:
+                    st = os.statvfs(mnt)
+                except OSError:
+                    continue
+                total = st.f_blocks * st.f_frsize
+                free = st.f_bavail * st.f_frsize
+                if total == 0:
+                    continue
+                tags = {"device": dev, "mount": mnt, "fstype": fstype}
+                out.append(("disk_total_bytes", float(total), tags))
+                out.append(("disk_free_bytes", float(free), tags))
+                out.append(("disk_used_percent",
+                            100.0 * (total - free) / total, tags))
+        return out
+
+
+class NetCollector:
+    name = "net"
+
+    def __init__(self) -> None:
+        self._last: Dict[str, Tuple[int, int]] = {}
+        self._last_t = 0.0
+
+    def collect(self):
+        out = []
+        now = time.monotonic()
+        dt = now - self._last_t if self._last_t else 0
+        with open("/proc/net/dev") as f:
+            lines = f.readlines()[2:]
+        for line in lines:
+            iface, _, rest = line.partition(":")
+            iface = iface.strip()
+            vals = rest.split()
+            rx, tx = int(vals[0]), int(vals[8])
+            if iface in self._last and dt > 0:
+                lrx, ltx = self._last[iface]
+                tags = {"interface": iface}
+                out.append(("net_rx_bytes_per_sec", (rx - lrx) / dt, tags))
+                out.append(("net_tx_bytes_per_sec", (tx - ltx) / dt, tags))
+            self._last[iface] = (rx, tx)
+        self._last_t = now
+        return out
+
+
+class SystemCollector:
+    name = "system"
+
+    def collect(self):
+        la1, la5, la15 = os.getloadavg()
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        return [("system_load_1m", la1, {}),
+                ("system_load_5m", la5, {}),
+                ("system_load_15m", la15, {}),
+                ("system_uptime_seconds", uptime, {})]
+
+
+class ProcessCollector:
+    """Top-N processes by CPU ticks (reference ProcessCollector)."""
+
+    name = "process"
+
+    def __init__(self, top_n: int = 10):
+        self.top_n = top_n
+
+    def collect(self):
+        procs = []
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    data = f.read()
+                # comm may contain spaces/parens: field 2 ends at last ')'
+                rp = data.rindex(")")
+                comm = data[data.index("(") + 1 : rp]
+                rest = data[rp + 2 :].split()
+                ticks = int(rest[11]) + int(rest[12])   # utime+stime
+                rss = int(rest[21]) * os.sysconf("SC_PAGE_SIZE")
+                procs.append((ticks, comm, pid, rss))
+            except (OSError, IndexError, ValueError):
+                continue
+        procs.sort(reverse=True)
+        out = []
+        for ticks, comm, pid, rss in procs[: self.top_n]:
+            tags = {"pid": pid, "comm": comm}
+            out.append(("process_cpu_ticks", float(ticks), tags))
+            out.append(("process_rss_bytes", float(rss), tags))
+        return out
+
+
+COLLECTORS: Dict[str, Callable] = {
+    "cpu": CpuCollector,
+    "mem": MemCollector,
+    "disk": DiskCollector,
+    "net": NetCollector,
+    "system": SystemCollector,
+    "process": ProcessCollector,
+}
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+class HostMonitorInputRunner:
+    _instance: Optional["HostMonitorInputRunner"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._registrations: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.process_queue_manager = None
+
+    @classmethod
+    def instance(cls) -> "HostMonitorInputRunner":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, name: str, collectors: List[str], interval_s: float,
+                 queue_key: int) -> None:
+        insts = [COLLECTORS[c]() for c in collectors if c in COLLECTORS]
+        with self._lock:
+            self._registrations[name] = (insts, interval_s, queue_key, [0.0])
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._registrations.pop(name, None)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._run, name="host-monitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while self._running:
+            time.sleep(0.2)
+            with self._lock:
+                regs = dict(self._registrations)
+            now = time.monotonic()
+            for name, (insts, interval, queue_key, last) in regs.items():
+                if now - last[0] < interval:
+                    continue
+                last[0] = now
+                try:
+                    self.collect_once(insts, queue_key)
+                except Exception:  # noqa: BLE001
+                    log.exception("host monitor collect failed: %s", name)
+
+    def collect_once(self, insts, queue_key: int) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ts = int(time.time())
+        for coll in insts:
+            for metric, value, tags in coll.collect():
+                ev = group.add_metric_event(ts)
+                ev.set_name(sb.copy_string(metric))
+                ev.set_value(value)
+                for k, v in tags.items():
+                    ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+        if not group.empty() and self.process_queue_manager is not None:
+            self.process_queue_manager.push_queue(queue_key, group)
+
+
+class InputHostMonitor(Input):
+    name = "input_host_monitor"
+    is_singleton = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.collectors: List[str] = []
+        self.interval_s = 60.0
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.collectors = list(config.get(
+            "Collectors", ["cpu", "mem", "disk", "net", "system"]))
+        self.interval_s = float(config.get("IntervalSeconds", 60))
+        return True
+
+    def start(self) -> bool:
+        runner = HostMonitorInputRunner.instance()
+        runner.register(self.context.pipeline_name, self.collectors,
+                        self.interval_s, self.context.process_queue_key)
+        runner.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        HostMonitorInputRunner.instance().unregister(self.context.pipeline_name)
+        return True

@@ -1,0 +1,54 @@
+"""Internal self-monitor inputs.
+
+Reference: core/plugin/input/InputInternalMetrics.cpp / InputInternalAlarms
+.cpp — singleton inputs that bind the SelfMonitorServer's converted event
+groups to a normal pipeline (SURVEY.md §2.6 self-monitor pipelines).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..monitor.self_monitor import SelfMonitorServer
+from ..pipeline.plugin.interface import Input, PluginContext
+
+
+class InputInternalMetrics(Input):
+    name = "input_internal_metrics"
+    is_singleton = True
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        interval = config.get("IntervalSeconds")
+        if interval:
+            SelfMonitorServer.instance().interval_s = float(interval)
+        return True
+
+    def start(self) -> bool:
+        server = SelfMonitorServer.instance()
+        server.set_metrics_pipeline(self.context.process_queue_key)
+        server.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        SelfMonitorServer.instance().set_metrics_pipeline(None)
+        return True
+
+
+class InputInternalAlarms(Input):
+    name = "input_internal_alarms"
+    is_singleton = True
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        return True
+
+    def start(self) -> bool:
+        server = SelfMonitorServer.instance()
+        server.set_alarms_pipeline(self.context.process_queue_key)
+        server.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        SelfMonitorServer.instance().set_alarms_pipeline(None)
+        return True

@@ -51,7 +51,8 @@ class ColumnarLogs:
                      "field absent" (parse failed for that event).
     """
 
-    __slots__ = ("offsets", "lengths", "timestamps", "fields", "parse_ok")
+    __slots__ = ("offsets", "lengths", "timestamps", "fields", "parse_ok",
+                 "content_consumed")
 
     def __init__(self, offsets: np.ndarray, lengths: np.ndarray,
                  timestamps: Optional[np.ndarray] = None):
@@ -62,6 +63,10 @@ class ColumnarLogs:
         self.timestamps = np.asarray(timestamps, dtype=np.int64)
         self.fields: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         self.parse_ok: Optional[np.ndarray] = None  # bool [N]
+        # False until a parse processor replaces the raw content span with
+        # extracted fields; until then `content` remains a live column even
+        # when auxiliary fields exist (e.g. container stream tags)
+        self.content_consumed = False
 
     def __len__(self) -> int:
         return int(self.offsets.shape[0])
@@ -194,16 +199,16 @@ class PipelineEventGroup:
         offs = cols.offsets
         lens = cols.lengths
         tss = cols.timestamps
+        emit_content = not field_items or not cols.content_consumed
         for i in range(len(cols)):
             ev = LogEvent(int(tss[i]))
-            if not field_items:
+            if emit_content:
                 ev.set_content(b"content", sb.view(int(offs[i]), int(lens[i])))
-            else:
-                for name, (foffs, flens) in field_items:
-                    flen = int(flens[i])
-                    if flen >= 0:
-                        ev.set_content(name.encode() if isinstance(name, str) else name,
-                                       sb.view(int(foffs[i]), flen))
+            for name, (foffs, flens) in field_items:
+                flen = int(flens[i])
+                if flen >= 0:
+                    ev.set_content(name.encode() if isinstance(name, str) else name,
+                                   sb.view(int(foffs[i]), flen))
             events.append(ev)
         self._events = events
         return events

@@ -1,0 +1,100 @@
+"""Leveled, aggregated alarms.
+
+Reference: core/monitor/AlarmManager.h:137-188 — alarms keyed by AlarmType
+with warning/error/critical levels, aggregated (count per key) between
+flushes, shipped through internal pipelines.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class AlarmLevel(enum.IntEnum):
+    WARNING = 0
+    ERROR = 1
+    CRITICAL = 2
+
+
+class AlarmType(str, enum.Enum):
+    """Subset of the reference's 60+ alarm types, extensible."""
+
+    CONFIG_LOAD_FAIL = "CONFIG_LOAD_FAIL_ALARM"
+    PROCESS_QUEUE_FULL = "PROCESS_QUEUE_FULL_ALARM"
+    SEND_FAIL = "SEND_FAIL_ALARM"
+    SEND_QUOTA_EXCEED = "SEND_QUOTA_EXCEED_ALARM"
+    PARSE_LOG_FAIL = "PARSE_LOG_FAIL_ALARM"
+    FILE_READ_FAIL = "READ_LOG_FAIL_ALARM"
+    CHECKPOINT_FAIL = "CHECKPOINT_ALARM"
+    DISCARD_DATA = "DISCARD_DATA_ALARM"
+    CPU_LIMIT = "CPU_EXCEED_LIMIT_ALARM"
+    MEM_LIMIT = "MEM_EXCEED_LIMIT_ALARM"
+    INPUT_COLLECT_FAIL = "INPUT_COLLECT_ALARM"
+    DEVICE_PARSE_FALLBACK = "DEVICE_PARSE_FALLBACK_ALARM"  # TPU-specific
+    AGENT_RESTART = "LOGTAIL_CRASH_ALARM"
+
+
+class _AlarmRecord:
+    __slots__ = ("type", "level", "message", "count", "first_time", "last_time",
+                 "pipeline")
+
+    def __init__(self, typ: AlarmType, level: AlarmLevel, message: str,
+                 pipeline: str):
+        self.type = typ
+        self.level = level
+        self.message = message
+        self.count = 0
+        self.first_time = time.time()
+        self.last_time = self.first_time
+        self.pipeline = pipeline
+
+
+class AlarmManager:
+    _instance: Optional["AlarmManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._records: Dict[Tuple[str, str, str], _AlarmRecord] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "AlarmManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def send_alarm(self, typ: AlarmType, message: str,
+                   level: AlarmLevel = AlarmLevel.WARNING,
+                   pipeline: str = "") -> None:
+        key = (typ.value, message[:128], pipeline)
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                rec = _AlarmRecord(typ, level, message, pipeline)
+                self._records[key] = rec
+            rec.count += 1
+            rec.last_time = time.time()
+
+    def flush(self) -> List[dict]:
+        """Drain aggregated alarms as event dicts for the self-monitor
+        pipeline."""
+        with self._lock:
+            records = list(self._records.values())
+            self._records.clear()
+        return [{
+            "alarm_type": r.type.value,
+            "alarm_level": r.level.name.lower(),
+            "alarm_message": r.message,
+            "alarm_count": str(r.count),
+            "pipeline": r.pipeline,
+            "first_time": str(int(r.first_time)),
+            "last_time": str(int(r.last_time)),
+        } for r in records]
+
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._records

@@ -1,0 +1,119 @@
+"""Grok pattern expansion.
+
+Reference behaviour: the Go grok processor compiles a pattern library and
+expands %{NAME:field} references into one regex (SURVEY.md §2.5; reference
+semantics at plugins/processor/grok/processor_grok.go — library + expansion,
+then regex match).  Expansion output feeds the tiered RegexEngine, so common
+grok expressions run on the Tier-1 device kernel.
+
+The default library below is the standard public grok vocabulary
+(logstash-style names), written kernel-friendly: field-shaped patterns use
+negated-class forms (`[^ ]`-style) rather than lazy dots wherever the
+standard semantics allow, because those compile to backtracking-free segment
+programs (ops/regex/program.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+# Standard grok vocabulary (public, logstash-compatible names).
+DEFAULT_PATTERNS: Dict[str, str] = {
+    "USERNAME": r"[a-zA-Z0-9._-]+",
+    "USER": r"%{USERNAME}",
+    "INT": r"[+-]?\d+",
+    "BASE10NUM": r"[+-]?(?:\d+(?:\.\d+)?|\.\d+)",
+    "NUMBER": r"%{BASE10NUM}",
+    "BASE16NUM": r"(?:0[xX])?[0-9a-fA-F]+",
+    "POSINT": r"\d+",
+    "NONNEGINT": r"\d+",
+    "WORD": r"\w+",
+    "NOTSPACE": r"\S+",
+    "SPACE": r"\s*",
+    "DATA": r".*?",
+    "GREEDYDATA": r".*",
+    "QUOTEDSTRING": r"\"[^\"]*\"",
+    "UUID": r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}",
+    "IPV4": r"(?:\d{1,3}\.){3}\d{1,3}",
+    "IPV6": r"[0-9a-fA-F:.]+",
+    "IP": r"%{IPV4}",
+    "HOSTNAME": r"[a-zA-Z0-9._-]+",
+    "IPORHOST": r"%{HOSTNAME}",
+    "HOSTPORT": r"%{IPORHOST}:%{POSINT}",
+    "PATH": r"(?:/[^ ]*)+",
+    "UNIXPATH": r"(?:/[^ ]*)+",
+    "URIPROTO": r"[A-Za-z]+(?:\+[A-Za-z+]+)?",
+    "URIHOST": r"%{IPORHOST}(?::%{POSINT})?",
+    "URIPATH": r"(?:/[^? ]*)+",
+    "URIPARAM": r"\?[^ ]*",
+    "URIPATHPARAM": r"%{URIPATH}(?:%{URIPARAM})?",
+    "URI": r"%{URIPROTO}://(?:%{USER}(?::[^@]*)?@)?(?:%{URIHOST})?(?:%{URIPATHPARAM})?",
+    "MONTH": r"\b(?:Jan(?:uary)?|Feb(?:ruary)?|Mar(?:ch)?|Apr(?:il)?|May|Jun(?:e)?|Jul(?:y)?|Aug(?:ust)?|Sep(?:tember)?|Oct(?:ober)?|Nov(?:ember)?|Dec(?:ember)?)\b",
+    "MONTHNUM": r"(?:0?[1-9]|1[0-2])",
+    "MONTHDAY": r"(?:(?:0[1-9])|(?:[12][0-9])|(?:3[01])|[1-9])",
+    "DAY": r"(?:Mon(?:day)?|Tue(?:sday)?|Wed(?:nesday)?|Thu(?:rsday)?|Fri(?:day)?|Sat(?:urday)?|Sun(?:day)?)",
+    "YEAR": r"(?:\d\d){1,2}",
+    "HOUR": r"(?:2[0123]|[01]?[0-9])",
+    "MINUTE": r"(?:[0-5][0-9])",
+    "SECOND": r"(?:[0-5][0-9]|60)(?:[:.,][0-9]+)?",
+    "TIME": r"%{HOUR}:%{MINUTE}(?::%{SECOND})?",
+    "DATE_US": r"%{MONTHNUM}[/-]%{MONTHDAY}[/-]%{YEAR}",
+    "DATE_EU": r"%{MONTHDAY}[./-]%{MONTHNUM}[./-]%{YEAR}",
+    "ISO8601_TIMEZONE": r"(?:Z|[+-]%{HOUR}(?::?%{MINUTE}))",
+    "ISO8601_SECOND": r"%{SECOND}",
+    "TIMESTAMP_ISO8601": r"%{YEAR}-%{MONTHNUM}-%{MONTHDAY}[T ]%{HOUR}:?%{MINUTE}(?::?%{SECOND})?%{ISO8601_TIMEZONE}?",
+    "DATE": r"%{DATE_US}|%{DATE_EU}",
+    "DATESTAMP": r"%{DATE}[- ]%{TIME}",
+    "TZ": r"[A-Z]{3,4}",
+    "HTTPDATE": r"%{MONTHDAY}/%{MONTH}/%{YEAR}:%{TIME} %{INT}",
+    "SYSLOGTIMESTAMP": r"%{MONTH} +%{MONTHDAY} %{TIME}",
+    "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|[Nn]otice|NOTICE|[Ii]nfo?(?:rmation)?|INFO?(?:RMATION)?|[Ww]arn?(?:ing)?|WARN?(?:ING)?|[Ee]rr?(?:or)?|ERR?(?:OR)?|[Cc]rit?(?:ical)?|CRIT?(?:ICAL)?|[Ff]atal|FATAL|[Ss]evere|SEVERE|EMERG(?:ENCY)?|[Ee]merg(?:ency)?)",
+    # composite access-log patterns, kernel-friendly field classes
+    "COMMONAPACHELOG": (
+        r'%{NOTSPACE:clientip} %{NOTSPACE:ident} %{NOTSPACE:auth} '
+        r'\[%{HTTPDATE:timestamp}\] "%{WORD:verb} %{NOTSPACE:request}'
+        r'(?: HTTP/%{NUMBER:httpversion})?" %{INT:response} '
+        r'(?:%{INT:bytes}|-)'),
+    "COMBINEDAPACHELOG": (
+        r'%{COMMONAPACHELOG} "%{DATA:referrer}" "%{DATA:agent}"'),
+    "NGINXACCESS": (
+        r'%{NOTSPACE:remote_addr} - %{NOTSPACE:remote_user} '
+        r'\[%{HTTPDATE:time_local}\] "%{WORD:method} %{NOTSPACE:request} '
+        r'HTTP/%{NUMBER:http_version}" %{INT:status} %{INT:body_bytes_sent} '
+        r'"([^"]*)" "([^"]*)"'),
+}
+
+_REF = re.compile(r"%\{(\w+)(?::([\w.\[\]@-]+))?\}")
+MAX_DEPTH = 16
+
+
+class GrokError(Exception):
+    pass
+
+
+def expand(pattern: str,
+           custom: Optional[Dict[str, str]] = None,
+           _depth: int = 0) -> str:
+    """Expand %{NAME} / %{NAME:field} references into a plain regex with
+    named capture groups."""
+    if _depth > MAX_DEPTH:
+        raise GrokError("grok expansion too deep (recursive pattern?)")
+    library = DEFAULT_PATTERNS if not custom else {**DEFAULT_PATTERNS, **custom}
+    out = []
+    pos = 0
+    for m in _REF.finditer(pattern):
+        out.append(pattern[pos : m.start()])
+        name, field = m.group(1), m.group(2)
+        body = library.get(name)
+        if body is None:
+            raise GrokError(f"unknown grok pattern %{{{name}}}")
+        body = expand(body, custom, _depth + 1)
+        if field:
+            safe = re.sub(r"\W", "_", field)
+            out.append(f"(?P<{safe}>{body})")
+        else:
+            out.append(f"(?:{body})")
+        pos = m.end()
+    out.append(pattern[pos:])
+    return "".join(out)

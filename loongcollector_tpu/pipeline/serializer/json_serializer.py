@@ -57,8 +57,11 @@ class JsonSerializer:
                             out: List[str]) -> None:
         cols = group.columns
         raw = group.source_buffer.raw
-        names = list(cols.fields.keys())
+        names = [n for n in cols.fields if n != "_partial_"]
         spans = [cols.fields[n] for n in names]
+        if not cols.content_consumed and "content" not in cols.fields:
+            names.insert(0, "content")
+            spans.insert(0, (cols.offsets, cols.lengths))
         tss = cols.timestamps
         for i in range(len(cols)):
             obj = dict(tags)

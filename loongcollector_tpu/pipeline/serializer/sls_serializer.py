@@ -83,8 +83,12 @@ class SLSEventGroupSerializer:
     def _logs_from_columns(self, group: PipelineEventGroup, out: bytearray) -> None:
         cols = group.columns
         raw = group.source_buffer.raw
-        names = [(n.encode() if isinstance(n, str) else n) for n in cols.fields]
-        spans = list(cols.fields.values())
+        names = [(n.encode() if isinstance(n, str) else n)
+                 for n in cols.fields if n != "_partial_"]
+        spans = [cols.fields[n] for n in cols.fields if n != "_partial_"]
+        if not cols.content_consumed and b"content" not in names:
+            names.insert(0, b"content")
+            spans.insert(0, (cols.offsets, cols.lengths))
         key_prefix = [b"\x0a" + _varint(len(n)) + n for n in names]
         tss = cols.timestamps
         for i in range(len(cols)):

@@ -18,6 +18,10 @@ def register_all(registry) -> None:
     from .tag import ProcessorTag
     from .merge_multiline import ProcessorMergeMultilineLog
     from .split_multiline import ProcessorSplitMultilineLogString
+    from .grok import ProcessorGrok
+    from .parse_apsara import ProcessorParseApsara
+    from .parse_container_log import ProcessorParseContainerLog
+    from .timestamp_filter import ProcessorTimestampFilter
 
     registry.register_processor("processor_split_log_string_native",
                                 ProcessorSplitLogString)
@@ -38,3 +42,10 @@ def register_all(registry) -> None:
     registry.register_processor("processor_filter_native", ProcessorFilter)
     registry.register_processor("processor_desensitize_native", ProcessorDesensitize)
     registry.register_processor("processor_tag_native", ProcessorTag)
+    registry.register_processor("processor_grok", ProcessorGrok)
+    registry.register_processor("processor_parse_apsara_native",
+                                ProcessorParseApsara)
+    registry.register_processor("processor_parse_container_log_native",
+                                ProcessorParseContainerLog)
+    registry.register_processor("processor_timestamp_filter_native",
+                                ProcessorTimestampFilter)

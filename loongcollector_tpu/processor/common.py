@@ -25,6 +25,7 @@ class SourceColumns:
     lengths: np.ndarray          # int32 [N]
     columnar: bool               # True → spans index the group's arena
     present: np.ndarray          # bool [N] source field existed
+    from_content: bool = False   # True → spans are the raw content column
 
 
 def extract_source(group: PipelineEventGroup,
@@ -34,16 +35,19 @@ def extract_source(group: PipelineEventGroup,
     cols = group.columns
     if cols is not None and not group._events:
         skey = source_key.decode() if isinstance(source_key, bytes) else source_key
-        if cols.fields:
-            if skey not in cols.fields:
-                return None
+        from_content = False
+        if skey in cols.fields:
             offs, lens = cols.fields[skey]
             present = lens >= 0
-        else:
+        elif (skey == "content" and not cols.content_consumed) or not cols.fields:
             offs, lens = cols.offsets, cols.lengths
             present = np.ones(len(cols), dtype=bool)
+            from_content = True
+        else:
+            return None
         arena = group.source_buffer.as_array()
-        return SourceColumns(arena, offs.astype(np.int64), lens, True, present)
+        return SourceColumns(arena, offs.astype(np.int64), lens, True, present,
+                             from_content)
 
     # row path: pack source values into a scratch arena
     values: List[bytes] = []

@@ -27,6 +27,7 @@ def compact_columns(cols: ColumnarLogs, keep: np.ndarray) -> ColumnarLogs:
         out.set_field(name, offs[keep], lens[keep])
     if cols.parse_ok is not None:
         out.parse_ok = cols.parse_ok[keep]
+    out.content_consumed = cols.content_consumed
     return out
 
 

@@ -1,0 +1,124 @@
+"""processor_grok — grok pattern field extraction.
+
+Reference: plugins/processor/grok/ (Go) — pattern library + %{NAME:field}
+expansion; multiple Match patterns are tried IN ORDER per event until one
+fully matches.  Expansion feeds the tiered RegexEngine, so kernel-friendly
+grok runs on the Tier-1 device kernel; each fallback pattern runs as its own
+device batch over the still-unmatched subset.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..models import PipelineEventGroup
+from ..ops.regex.engine import RegexEngine
+from ..ops.regex.grok import GrokError, expand
+from ..pipeline.plugin.interface import PluginContext, Processor
+from .common import RAW_LOG_KEY, extract_source
+
+
+class ProcessorGrok(Processor):
+    name = "processor_grok"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.source_key = b"content"
+        self.keep_source_on_fail = True
+        self.renamed_source_key = RAW_LOG_KEY
+        self._engines: List[Tuple[RegexEngine, List[str]]] = []
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        match = config.get("Match", [])
+        if isinstance(match, str):
+            match = [match]
+        if not match:
+            return False
+        custom = config.get("CustomPatterns", {}) or {}
+        self.source_key = config.get("SourceKey", "content").encode()
+        self.keep_source_on_fail = bool(
+            config.get("KeepingSourceWhenParseFail", True))
+        for pattern in match:
+            try:
+                regex = expand(pattern, custom)
+            except GrokError:
+                return False
+            engine = RegexEngine(regex)
+            # only NAMED groups become fields (grok semantics)
+            keys = [engine.group_names.get(i, "") for i in range(engine.num_caps)]
+            self._engines.append((engine, keys))
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        src = extract_source(group, self.source_key)
+        if src is None:
+            return
+        n = len(src.offsets)
+        if n == 0:
+            return
+        if src.columnar:
+            cols = group.columns
+            remaining = src.present.copy()
+            matched = np.zeros(n, dtype=bool)
+            field_offs: Dict[str, np.ndarray] = {}
+            field_lens: Dict[str, np.ndarray] = {}
+            for engine, keys in self._engines:
+                if not remaining.any():
+                    break
+                idx = np.nonzero(remaining)[0]
+                res = engine.parse_batch(src.arena, src.offsets[idx],
+                                         src.lengths[idx])
+                hit = idx[res.ok]
+                if not len(hit):
+                    continue
+                for g, key in enumerate(keys):
+                    if not key:
+                        continue
+                    if key not in field_offs:
+                        field_offs[key] = np.zeros(n, dtype=np.int32)
+                        field_lens[key] = np.full(n, -1, dtype=np.int32)
+                    field_offs[key][hit] = res.cap_off[res.ok, g]
+                    field_lens[key][hit] = res.cap_len[res.ok, g]
+                matched[hit] = True
+                remaining[hit] = False
+            for key in field_offs:
+                cols.set_field(key, field_offs[key], field_lens[key])
+            if self.keep_source_on_fail:
+                fail = (~matched) & src.present
+                if fail.any():
+                    cols.set_field(self.renamed_source_key,
+                                   src.offsets.astype(np.int32),
+                                   np.where(fail, src.lengths, -1).astype(np.int32))
+            cols.parse_ok = matched
+            if src.from_content:
+                cols.content_consumed = True
+            return
+
+        # row path
+        sb = group.source_buffer
+        for i, ev in enumerate(group.events):
+            if not hasattr(ev, "get_content"):
+                continue
+            v = ev.get_content(self.source_key)
+            if v is None:
+                continue
+            data = v.to_bytes()
+            hit = False
+            for engine, keys in self._engines:
+                m = engine._re.fullmatch(data)
+                if m is None:
+                    continue
+                hit = True
+                for g, key in enumerate(keys):
+                    if key and m.group(g + 1) is not None:
+                        ev.set_content(key.encode(),
+                                       sb.copy_string(m.group(g + 1)))
+                ev.del_content(self.source_key)
+                break
+            if not hit and self.keep_source_on_fail:
+                if self.renamed_source_key.encode() != self.source_key:
+                    ev.set_content(self.renamed_source_key.encode(), v)
+                    ev.del_content(self.source_key)

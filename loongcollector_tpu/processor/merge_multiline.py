@@ -49,6 +49,8 @@ class ProcessorMergeMultilineLog(Processor):
         n = len(cols)
         offs = cols.offsets.astype(np.int64)
         lens = cols.lengths.astype(np.int64)
+        sb = group.source_buffer
+        arena = group.source_buffer.as_array()
         records = []
         i = 0
         while i < n:
@@ -56,9 +58,15 @@ class ProcessorMergeMultilineLog(Processor):
             while j < n and partial[j]:
                 j += 1
             last = min(j, n - 1)
-            mo = int(offs[i])
-            ml = int(offs[last] + lens[last]) - mo
-            records.append((i, mo, ml))
+            if last == i:
+                records.append((i, int(offs[i]), int(lens[i])))
+            else:
+                # copy-concatenate the partial pieces (they are separated by
+                # CRI prefixes in the arena, so span arithmetic cannot apply)
+                parts = [arena[int(offs[k]): int(offs[k] + lens[k])].tobytes()
+                         for k in range(i, last + 1)]
+                view = sb.copy_string(b"".join(parts))
+                records.append((i, view.offset, view.length))
             i = last + 1
         out = ColumnarLogs(
             offsets=np.array([r[1] for r in records], dtype=np.int32),

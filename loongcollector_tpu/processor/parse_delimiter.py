@@ -116,6 +116,8 @@ class ProcessorParseDelimiter(Processor):
                                src.offsets.astype(np.int32),
                                np.where(keep, src.lengths, -1).astype(np.int32))
             cols.parse_ok = ok
+            if src.from_content:
+                cols.content_consumed = True
             return
 
         # host path: quote-mode FSM or row groups

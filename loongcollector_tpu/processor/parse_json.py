@@ -84,6 +84,8 @@ class ProcessorParseJson(Processor):
                 cols.set_field(k, field_offs[k], field_lens[k])
             self._retain_source(cols, src, ok)
             cols.parse_ok = ok
+            if src.from_content:
+                cols.content_consumed = True
             return
 
         sb = group.source_buffer

@@ -86,6 +86,8 @@ class ProcessorParseRegex(Processor):
                 cols.set_field(self.renamed_source_key, src_off,
                                np.where(keep, src_len, -1).astype(np.int32))
             cols.parse_ok = ok
+            if src.from_content:
+                cols.content_consumed = True
             return
 
         # row path (non-columnar groups)

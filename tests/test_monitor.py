@@ -1,0 +1,102 @@
+"""Monitor subsystem tests: metrics, alarms, self-monitor conversion,
+host-monitor collectors, watchdog sampling."""
+
+import time
+
+import pytest
+
+from loongcollector_tpu.input.host_monitor import (COLLECTORS,
+                                                   HostMonitorInputRunner)
+from loongcollector_tpu.models import EventType
+from loongcollector_tpu.monitor.alarms import (AlarmLevel, AlarmManager,
+                                               AlarmType)
+from loongcollector_tpu.monitor.metrics import (MetricsRecord, ReadMetrics,
+                                                WriteMetrics)
+from loongcollector_tpu.monitor.self_monitor import SelfMonitorServer
+from loongcollector_tpu.monitor.watchdog import _read_self_stat
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+
+class TestMetrics:
+    def test_counter_collect_resets(self):
+        rec = MetricsRecord(category="test", labels={"x": "1"})
+        c = rec.counter("events")
+        c.add(5)
+        snap = rec.snapshot(reset_counters=True)
+        assert snap["counters"]["events"] == 5
+        assert rec.snapshot()["counters"]["events"] == 0
+
+    def test_gc_deleted(self):
+        rec = MetricsRecord(category="gc_test")
+        n_before = len(WriteMetrics.instance().records())
+        rec.mark_deleted()
+        WriteMetrics.instance().gc_deleted()
+        assert len(WriteMetrics.instance().records()) == n_before - 1
+
+
+class TestAlarms:
+    def test_aggregation(self):
+        mgr = AlarmManager()
+        for _ in range(5):
+            mgr.send_alarm(AlarmType.SEND_FAIL, "endpoint down",
+                           AlarmLevel.ERROR, pipeline="p1")
+        out = mgr.flush()
+        assert len(out) == 1
+        assert out[0]["alarm_count"] == "5"
+        assert out[0]["alarm_level"] == "error"
+        assert mgr.empty()
+
+
+class TestSelfMonitor:
+    def test_metrics_and_alarms_to_groups(self):
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(101)
+        pqm.create_or_reuse_queue(102)
+        server = SelfMonitorServer()
+        server.process_queue_manager = pqm
+        server.set_metrics_pipeline(101)
+        server.set_alarms_pipeline(102)
+        rec = MetricsRecord(category="pipeline", labels={"pipeline_name": "x"})
+        rec.counter("in_events_total").add(7)
+        AlarmManager.instance().send_alarm(AlarmType.PARSE_LOG_FAIL, "boom")
+        server.send_once()
+        key, mgroup = pqm.pop_item(timeout=0)
+        assert key == 101
+        assert mgroup.event_type() == EventType.METRIC
+        key, agroup = pqm.pop_item(timeout=0)
+        assert key == 102
+        contents = {k.to_bytes(): v.to_bytes()
+                    for k, v in agroup.events[0].contents}
+        assert contents[b"alarm_type"] == b"PARSE_LOG_FAIL_ALARM"
+
+
+class TestHostMonitor:
+    @pytest.mark.parametrize("name", ["cpu", "mem", "disk", "net", "system",
+                                      "process"])
+    def test_collectors_produce_metrics(self, name):
+        coll = COLLECTORS[name]()
+        coll.collect()
+        time.sleep(0.02)
+        out = coll.collect()  # rate collectors need two samples
+        if name in ("mem", "disk", "system", "process"):
+            assert out, name
+        for metric, value, tags in out:
+            assert isinstance(metric, str) and isinstance(value, float)
+
+    def test_runner_pushes_group(self):
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(7)
+        runner = HostMonitorInputRunner()
+        runner.process_queue_manager = pqm
+        runner.collect_once([COLLECTORS["mem"]()], 7)
+        key, group = pqm.pop_item(timeout=0)
+        assert key == 7
+        names = {str(ev.name) for ev in group.events}
+        assert "memory_total_bytes" in names
+
+
+class TestWatchdog:
+    def test_self_stat_readable(self):
+        ticks, rss = _read_self_stat()
+        assert ticks >= 0 and rss > 0

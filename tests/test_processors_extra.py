@@ -1,0 +1,185 @@
+"""Tests: grok, apsara, container log unwrap, timestamp filter."""
+
+import re
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.ops.regex.grok import DEFAULT_PATTERNS, GrokError, expand
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.processor.grok import ProcessorGrok
+from loongcollector_tpu.processor.merge_multiline import ProcessorMergeMultilineLog
+from loongcollector_tpu.processor.parse_apsara import ProcessorParseApsara
+from loongcollector_tpu.processor.parse_container_log import \
+    ProcessorParseContainerLog
+from loongcollector_tpu.processor.timestamp_filter import ProcessorTimestampFilter
+
+from test_processors import CTX, raw_group, split_group
+
+
+class TestGrokExpand:
+    def test_simple_expansion(self):
+        rx = expand("%{IPV4:ip} %{WORD:verb}")
+        m = re.fullmatch(rx, "1.2.3.4 GET")
+        assert m.group("ip") == "1.2.3.4"
+        assert m.group("verb") == "GET"
+
+    def test_nested_patterns(self):
+        rx = expand("%{NUMBER:n}")
+        assert re.fullmatch(rx, "-3.25").group("n") == "-3.25"
+
+    def test_unknown_pattern_raises(self):
+        with pytest.raises(GrokError):
+            expand("%{NO_SUCH_THING}")
+
+    def test_custom_patterns(self):
+        rx = expand("%{MYID:id}", {"MYID": r"[A-Z]{3}\d{4}"})
+        assert re.fullmatch(rx, "ABC1234").group("id") == "ABC1234"
+
+    def test_all_default_patterns_compile(self):
+        for name in DEFAULT_PATTERNS:
+            re.compile(expand(f"%{{{name}}}"))
+
+
+class TestProcessorGrok:
+    def test_common_apache(self):
+        line = (b'10.0.0.1 - frank [10/Oct/2000:13:55:36 -0700] '
+                b'"GET /index.html HTTP/1.0" 200 2326')
+        g = split_group(line + b"\n")
+        p = ProcessorGrok()
+        assert p.init({"Match": "%{COMMONAPACHELOG}"}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"clientip") == b"10.0.0.1"
+        assert ev.get_content(b"verb") == b"GET"
+        assert ev.get_content(b"response") == b"200"
+        # unnamed/positional groups are not emitted
+        assert not any(k.to_bytes().startswith(b"__g") for k, _ in ev.contents)
+
+    def test_kv_grok(self):
+        g = split_group(b"took 35ms in step7\n")
+        p = ProcessorGrok()
+        assert p.init({"Match": r"took %{INT:ms}ms in %{WORD:step}"}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"ms") == b"35"
+        assert ev.get_content(b"step") == b"step7"
+
+
+class TestParseApsara:
+    def test_full_line(self):
+        line = (b"[2024-01-02 03:04:05.123456]\t[ERROR]\t[12345]\t"
+                b"/build/Worker.cpp:88\tquery:select 1\tlatency:42")
+        g = split_group(line + b"\n")
+        p = ProcessorParseApsara()
+        p.init({"SourceTimezone": "GMT+00:00"}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"__LEVEL__") == b"ERROR"
+        assert ev.get_content(b"__THREAD__") == b"12345"
+        assert ev.get_content(b"query") == b"select 1"
+        assert ev.get_content(b"latency") == b"42"
+        import calendar, time as _t
+        want = calendar.timegm(_t.strptime("2024-01-02 03:04:05",
+                                           "%Y-%m-%d %H:%M:%S"))
+        assert g.columns.timestamps[0] == want
+
+    def test_bad_line_keeps_raw(self):
+        g = split_group(b"not apsara\n")
+        p = ProcessorParseApsara()
+        p.init({}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"rawLog") == b"not apsara"
+
+
+class TestContainerLog:
+    def test_cri_unwrap_and_partial_merge(self):
+        data = (b"2024-01-02T03:04:05.9Z stdout P part1 \n"
+                b"2024-01-02T03:04:05.9Z stdout F part2\n"
+                b"2024-01-02T03:04:06.0Z stderr F whole line\n")
+        g = split_group(data)
+        p = ProcessorParseContainerLog()
+        p.init({"Format": "containerd_text"}, CTX)
+        p.process(g)
+        m = ProcessorMergeMultilineLog()
+        m.init({"MergeType": "flag"}, CTX)
+        m.process(g)
+        assert len(g) == 2
+        events = g.materialize()
+        merged = events[0].get_content(b"content").to_bytes()
+        assert merged.startswith(b"part1")
+        assert merged.endswith(b"part2")
+
+    def test_cri_ignore_stderr(self):
+        data = (b"2024-01-02T03:04:05Z stdout F keep\n"
+                b"2024-01-02T03:04:05Z stderr F drop\n")
+        g = split_group(data)
+        p = ProcessorParseContainerLog()
+        p.init({"Format": "containerd_text", "IgnoringStderr": True}, CTX)
+        p.process(g)
+        assert len(g) == 1
+        assert g.materialize()[0].get_content(b"content") == b"keep"
+
+    def test_docker_json(self):
+        data = (b'{"log":"hello\\n","stream":"stdout","time":"2024-01-02T03:04:05Z"}\n'
+                b'{"log":"oops\\n","stream":"stderr","time":"2024-01-02T03:04:05Z"}\n')
+        g = split_group(data)
+        p = ProcessorParseContainerLog()
+        p.init({"Format": "docker_json-file"}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"content") == b"hello"
+        assert events[1].get_content(b"_source_") == b"stderr"
+
+
+class TestTimestampFilter:
+    def test_absolute_window(self):
+        g = split_group(b"a\nb\nc\n")
+        g.columns.timestamps[:] = [100, 200, 300]
+        p = ProcessorTimestampFilter()
+        p.init({"StartTime": 150, "EndTime": 250}, CTX)
+        p.process(g)
+        assert len(g) == 1
+        assert g.columns.timestamps[0] == 200
+
+
+class TestGrokMultiPattern:
+    def test_fallback_chain(self):
+        g = split_group(b"1.2.3.4 GET /x\nERROR something bad\nno match\n")
+        p = ProcessorGrok()
+        assert p.init({"Match": [
+            r"%{IPV4:ip} %{WORD:verb} %{NOTSPACE:path}",
+            r"%{LOGLEVEL:level} %{GREEDYDATA:msg}",
+        ]}, CTX)
+        p.process(g)
+        events = g.materialize()
+        assert events[0].get_content(b"ip") == b"1.2.3.4"
+        assert events[1].get_content(b"level") == b"ERROR"
+        assert events[1].get_content(b"msg") == b"something bad"
+        assert events[2].get_content(b"rawLog") == b"no match"
+
+
+class TestContainerKeepTime:
+    def test_cri_keep_timestamp(self):
+        data = b"2024-01-02T03:04:05.9Z stdout F hello\n"
+        g = split_group(data)
+        p = ProcessorParseContainerLog()
+        p.init({"Format": "containerd_text", "KeepTimestamp": True}, CTX)
+        p.process(g)
+        ev = g.materialize()[0]
+        assert ev.get_content(b"_time_") == b"2024-01-02T03:04:05.9Z"
+        assert ev.get_content(b"content") == b"hello"
+
+    def test_partial_marker_not_serialized(self):
+        from loongcollector_tpu.pipeline.serializer.json_serializer import \
+            JsonSerializer
+        data = b"2024-01-02T03:04:05.9Z stdout P piece\n"
+        g = split_group(data)
+        p = ProcessorParseContainerLog()
+        p.init({"Format": "containerd_text"}, CTX)
+        p.process(g)
+        out = JsonSerializer().serialize([g]).decode()
+        assert "_partial_" not in out
