@@ -67,6 +67,7 @@ class Application:
         fs = FileServer.instance()
         fs.process_queue_manager = self.process_queue_manager
         fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
+        fs.cpu_level_provider = lambda: self.watchdog.cpu_level
         HostMonitorInputRunner.instance().process_queue_manager = \
             self.process_queue_manager
         SelfMonitorServer.instance().process_queue_manager = \

@@ -54,6 +54,10 @@ class FileServer:
         self.process_queue_manager = None
         self.checkpoints = CheckPointManager()
         self._paused = False
+        # CPU-adaptive flow control (reference LogInput::FlowControl,
+        # event_handler/LogInput.cpp:156-203): 0..1 fraction of the agent's
+        # CPU budget in use; high levels stretch the poll sleep
+        self.cpu_level_provider = None
 
     @classmethod
     def instance(cls) -> "FileServer":
@@ -127,8 +131,14 @@ class FileServer:
             except Exception:  # noqa: BLE001 - never kill the event thread
                 log.exception("file server round failed")
                 busy = False
-            if not busy:
-                time.sleep(IDLE_SLEEP_S)
+            sleep = IDLE_SLEEP_S
+            level = self.cpu_level_provider() if self.cpu_level_provider else 0.0
+            if level > 0.9:
+                sleep = IDLE_SLEEP_S * 8     # heavy throttle near the limit
+            elif level > 0.7:
+                sleep = IDLE_SLEEP_S * 3
+            if not busy or level > 0.9:
+                time.sleep(sleep)
 
     def _round(self) -> bool:
         with self._lock:

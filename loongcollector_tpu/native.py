@@ -1,0 +1,159 @@
+"""ctypes bridge to the C++ native data plane (native/).
+
+Loads libloongcollector_native.so if present (building it once with the
+repo's Makefile when a toolchain is available); every entry point has a
+pure-numpy/Python fallback so the framework runs without the library.
+
+Reference parity: the reference's equivalents are C++ (LogFileReader line
+alignment, the batch staging copy, core/protobuf/sls/LogGroupSerializer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .utils.logger import get_logger
+
+log = get_logger("native")
+
+_lib = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libloongcollector_native.so")
+
+
+def _try_build() -> bool:
+    makefile = os.path.join(_NATIVE_DIR, "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       timeout=120, capture_output=True)
+        return os.path.exists(_SO_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    with _load_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("LOONG_DISABLE_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH) and not _try_build():
+            log.info("native library unavailable; using python fallbacks")
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("failed to load native library: %s", e)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.lct_split_lines.restype = ctypes.c_int64
+        lib.lct_split_lines.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint8,
+                                        ctypes.c_int64, i32p, i32p]
+        lib.lct_pack_rows.restype = None
+        lib.lct_pack_rows.argtypes = [u8p, ctypes.c_int64, i64p, i32p,
+                                      ctypes.c_int64, ctypes.c_int64, u8p]
+        lib.lct_sls_serialize.restype = ctypes.c_int64
+        lib.lct_sls_serialize.argtypes = [u8p, ctypes.c_int64, i64p,
+                                          ctypes.c_int64, ctypes.c_int64,
+                                          u8p, i32p, i32p, i32p,
+                                          u8p, ctypes.c_int64]
+        _lib = lib
+        log.info("native library loaded: %s", _SO_PATH)
+        return _lib
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+# ---------------------------------------------------------------------------
+# wrappers (None return ⇒ caller should use its fallback)
+# ---------------------------------------------------------------------------
+
+
+def split_lines(seg: np.ndarray, sep: int, base_offset: int
+                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = get_lib()
+    if lib is None or len(seg) == 0:
+        return None
+    seg = np.ascontiguousarray(seg)
+    cap = len(seg) + 1
+    offs = np.empty(cap, dtype=np.int32)
+    lens = np.empty(cap, dtype=np.int32)
+    n = lib.lct_split_lines(_u8(seg), len(seg), sep, base_offset,
+                            _i32(offs), _i32(lens))
+    return offs[:n], lens[:n]
+
+
+def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
+              L: int, B: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    arena = np.ascontiguousarray(arena)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    n = len(offsets)
+    rows = np.zeros((B, L), dtype=np.uint8)
+    lib.lct_pack_rows(_u8(arena), len(arena), _i64(offsets), _i32(lengths),
+                      n, L, _u8(rows))
+    return rows
+
+
+def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
+                  keys: list, field_offs: np.ndarray, field_lens: np.ndarray
+                  ) -> Optional[bytes]:
+    """keys: list[bytes] (≤64); field_offs/field_lens: int32 [F, n]."""
+    lib = get_lib()
+    if lib is None or len(keys) > 64:
+        return None
+    arena = np.ascontiguousarray(arena)
+    timestamps = np.ascontiguousarray(timestamps, dtype=np.int64)
+    field_offs = np.ascontiguousarray(field_offs, dtype=np.int32)
+    field_lens = np.ascontiguousarray(field_lens, dtype=np.int32)
+    keys_blob = np.frombuffer(b"".join(keys) or b"\0", dtype=np.uint8).copy()
+    key_lens = np.array([len(k) for k in keys], dtype=np.int32)
+    n = len(timestamps)
+    cap = int(field_lens.clip(min=0).sum()
+              + n * (int(key_lens.sum()) + 12 * len(keys) + 16) + 64)
+    out = np.empty(cap, dtype=np.uint8)
+    written = lib.lct_sls_serialize(_u8(arena), len(arena), _i64(timestamps),
+                                    n, len(keys), _u8(keys_blob),
+                                    _i32(key_lens), _i32(field_offs),
+                                    _i32(field_lens), _u8(out), cap)
+    if written < 0:
+        out = np.empty(-written, dtype=np.uint8)
+        written = lib.lct_sls_serialize(_u8(arena), len(arena),
+                                        _i64(timestamps), n, len(keys),
+                                        _u8(keys_blob), _i32(key_lens),
+                                        _i32(field_offs), _i32(field_lens),
+                                        _u8(out), -written)
+        if written < 0:
+            return None
+    return out[:written].tobytes()

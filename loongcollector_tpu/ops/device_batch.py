@@ -65,15 +65,21 @@ def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
     assert n <= B
     offsets = np.asarray(offsets, dtype=np.int64)
     lengths32 = np.asarray(lengths, dtype=np.int32)
-    # index matrix [n, L], clipped so OOB reads land on a valid byte
-    idx = offsets[:, None] + np.arange(L, dtype=np.int64)[None, :]
-    np.clip(idx, 0, len(arena) - 1 if len(arena) else 0, out=idx)
-    rows = arena[idx] if len(arena) else np.zeros((n, L), np.uint8)
-    # zero out tail so padding bytes are deterministic
-    mask = np.arange(L, dtype=np.int32)[None, :] < lengths32[:, None]
-    rows &= mask.astype(np.uint8) * np.uint8(255)
+
+    from ..native import pack_rows as native_pack
+    rows = native_pack(arena, offsets, lengths32, L, B)
+    if rows is None:
+        # numpy fallback: index matrix [n, L], clipped so OOB reads land on
+        # a valid byte, then tail-zeroed for deterministic padding
+        idx = offsets[:, None] + np.arange(L, dtype=np.int64)[None, :]
+        np.clip(idx, 0, len(arena) - 1 if len(arena) else 0, out=idx)
+        rows = arena[idx] if len(arena) else np.zeros((n, L), np.uint8)
+        mask = np.arange(L, dtype=np.int32)[None, :] < lengths32[:, None]
+        rows &= mask.astype(np.uint8) * np.uint8(255)
+        if B > n:
+            rows = np.concatenate([rows, np.zeros((B - n, L), np.uint8)],
+                                  axis=0)
     if B > n:
-        rows = np.concatenate([rows, np.zeros((B - n, L), np.uint8)], axis=0)
         lengths32 = np.concatenate([lengths32, np.zeros(B - n, np.int32)])
         origins = np.concatenate(
             [offsets.astype(np.int32), np.zeros(B - n, np.int32)])

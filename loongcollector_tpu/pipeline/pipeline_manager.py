@@ -50,10 +50,16 @@ class CollectionPipelineManager:
                 old.stop(is_removing=False)
                 old.release()
             p = CollectionPipeline()
-            if not p.init(name, cfg, self.process_queue_manager,
-                          self.sender_queue_manager,
-                          reuse_queue_key=(old.process_queue_key
-                                           if old else None)):
+            try:
+                ok = p.init(name, cfg, self.process_queue_manager,
+                            self.sender_queue_manager,
+                            reuse_queue_key=(old.process_queue_key
+                                             if old else None))
+            except Exception:  # noqa: BLE001 - a bad config must not kill the agent
+                log.exception("pipeline %s init raised", name)
+                p.release()
+                ok = False
+            if not ok:
                 log.error("pipeline %s failed to init; keeping none", name)
                 with self._lock:
                     self._pipelines.pop(name, None)

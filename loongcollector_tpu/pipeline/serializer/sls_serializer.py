@@ -80,15 +80,24 @@ class SLSEventGroupSerializer:
             body += _len_delim(2, _kv(k.to_bytes(), v.to_bytes()))
         return bytes(body)
 
-    def _logs_from_columns(self, group: PipelineEventGroup, out: bytearray) -> None:
-        cols = group.columns
-        raw = group.source_buffer.raw
+    @staticmethod
+    def _columnar_spans(cols):
         names = [(n.encode() if isinstance(n, str) else n)
                  for n in cols.fields if n != "_partial_"]
         spans = [cols.fields[n] for n in cols.fields if n != "_partial_"]
         if not cols.content_consumed and b"content" not in names:
             names.insert(0, b"content")
             spans.insert(0, (cols.offsets, cols.lengths))
+        return names, spans
+
+    def _logs_from_columns(self, group: PipelineEventGroup, out: bytearray) -> None:
+        cols = group.columns
+        data = self._native_logs(group, cols)
+        if data is not None:
+            out += data
+            return
+        raw = group.source_buffer.raw
+        names, spans = self._columnar_spans(cols)
         key_prefix = [b"\x0a" + _varint(len(n)) + n for n in names]
         tss = cols.timestamps
         for i in range(len(cols)):
@@ -101,3 +110,19 @@ class SLSEventGroupSerializer:
                     content = kp + b"\x12" + _varint(ln) + val
                     body += b"\x12" + _varint(len(content)) + content
             out += b"\x0a" + _varint(len(body)) + body
+
+    @classmethod
+    def _native_logs(cls, group: PipelineEventGroup, cols):
+        import numpy as _np
+
+        from ... import native as _native
+        if _native.get_lib() is None:
+            return None
+        names, spans = cls._columnar_spans(cols)
+        if not names:
+            return None
+        field_offs = _np.stack([s[0] for s in spans])
+        field_lens = _np.stack([s[1] for s in spans])
+        return _native.sls_serialize(group.source_buffer.as_array(),
+                                     cols.timestamps, names,
+                                     field_offs, field_lens)

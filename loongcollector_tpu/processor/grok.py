@@ -41,12 +41,13 @@ class ProcessorGrok(Processor):
         self.source_key = config.get("SourceKey", "content").encode()
         self.keep_source_on_fail = bool(
             config.get("KeepingSourceWhenParseFail", True))
+        import re as _re
         for pattern in match:
             try:
                 regex = expand(pattern, custom)
-            except GrokError:
+                engine = RegexEngine(regex)
+            except (GrokError, _re.error):
                 return False
-            engine = RegexEngine(regex)
             # only NAMED groups become fields (grok semantics)
             keys = [engine.group_names.get(i, "") for i in range(engine.num_caps)]
             self._engines.append((engine, keys))

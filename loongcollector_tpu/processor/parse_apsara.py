@@ -13,6 +13,8 @@ import calendar
 import time
 from typing import Any, Dict
 
+import numpy as np
+
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import RAW_LOG_KEY, extract_source
@@ -107,7 +109,6 @@ class ProcessorParseApsara(Processor):
             return
         sb = group.source_buffer
         if src.columnar:
-            import numpy as np
             cols = group.columns
             n = len(src.offsets)
             raw = src.arena
@@ -135,7 +136,6 @@ class ProcessorParseApsara(Processor):
                 cols.set_field(k.decode("utf-8", "replace"),
                                field_offs[k], field_lens[k])
             if self.keep_source_on_fail and (~ok & src.present).any():
-                import numpy as np2
                 cols.set_field(self.renamed_source_key,
                                src.offsets.astype("int32"),
                                np.where(~ok & src.present, src.lengths,

@@ -52,6 +52,15 @@ class ProcessorSplitLogString(Processor):
                 continue
             start, ln = sv.offset, sv.length
             seg = arena[start : start + ln]
+            from ..native import split_lines as native_split
+            spans = native_split(seg, self.split_char, start)
+            if spans is not None:
+                offs, lens = spans
+                all_offsets.append(offs.astype(np.int64))
+                all_lengths.append(lens)
+                ts = ev.timestamp if ev.timestamp else now
+                all_ts.append(np.full(len(offs), ts, dtype=np.int64))
+                continue
             nl = np.nonzero(seg == self.split_char)[0].astype(np.int64)
             # line starts: 0 and nl+1; line ends: nl and ln (if trailing bytes)
             starts = np.concatenate([[0], nl + 1])

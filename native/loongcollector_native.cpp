@@ -1,0 +1,170 @@
+// Native host-side data plane for loongcollector_tpu.
+//
+// The reference implements these paths in C++ (SURVEY.md §2.1/§2.3):
+//   - chunk → line spans         (LogFileReader / ProcessorSplitLogString)
+//   - arena → fixed device rows  (the TPU batch staging copy)
+//   - columnar spans → SLS protobuf wire bytes
+//     (hand-rolled LogGroupSerializer, core/protobuf/sls/)
+//
+// Python loads this via ctypes (loongcollector_tpu/native.py) and falls back
+// to numpy/pure-Python implementations when the library is absent.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Line splitting: returns number of line spans written.
+// Keeps empty interior lines; drops the empty tail after a trailing sep.
+// out_offsets/out_lengths must hold at least (count of sep)+1 entries.
+// ---------------------------------------------------------------------------
+int64_t lct_split_lines(const uint8_t* data, int64_t len, uint8_t sep,
+                        int64_t base_offset, int32_t* out_offsets,
+                        int32_t* out_lengths) {
+    int64_t n = 0;
+    int64_t start = 0;
+    const uint8_t* p = data;
+    while (start < len) {
+        const uint8_t* hit =
+            static_cast<const uint8_t*>(memchr(p + start, sep, len - start));
+        int64_t end = hit ? (hit - p) : len;
+        out_offsets[n] = static_cast<int32_t>(base_offset + start);
+        out_lengths[n] = static_cast<int32_t>(end - start);
+        ++n;
+        start = end + 1;
+    }
+    // interior empty lines between consecutive separators
+    // (handled naturally: start==end gives length 0)
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// Row packing: gather event byte ranges into a zero-padded [B, L] matrix.
+// Rows beyond n are zeroed by the caller (numpy allocates zeroed).
+// ---------------------------------------------------------------------------
+void lct_pack_rows(const uint8_t* arena, int64_t arena_len,
+                   const int64_t* offsets, const int32_t* lengths, int64_t n,
+                   int64_t L, uint8_t* out_rows) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t off = offsets[i];
+        int64_t len = lengths[i];
+        if (len < 0) len = 0;  // absent field spans (-1) pack as empty rows
+        if (len > L) len = L;
+        if (off < 0 || off >= arena_len) len = 0;
+        if (off + len > arena_len) len = arena_len - off;
+        uint8_t* dst = out_rows + i * L;
+        if (len > 0) memcpy(dst, arena + off, static_cast<size_t>(len));
+        if (len < L) memset(dst + len, 0, static_cast<size_t>(L - len));
+    }
+}
+
+// ---------------------------------------------------------------------------
+// SLS LogGroup wire serialization from columnar spans.
+//
+// Wire schema (public sls_logs.proto):
+//   Log      { uint32 Time = 1; repeated Content Contents = 2; }
+//   Content  { string Key = 1; string Value = 2; }
+//   LogGroup { repeated Log Logs = 1; ... }
+//
+// Inputs: shared arena; per-event timestamps; F fields, each with a key
+// (concatenated in keys_blob with key_lens) and per-event (offset,len)
+// spans (len < 0 ⇒ absent).
+// Returns bytes written, or -(needed) if out_cap is too small (caller
+// reallocates and retries; needed is exact).
+// ---------------------------------------------------------------------------
+
+static inline int varint_size(uint64_t v) {
+    int s = 1;
+    while (v >= 0x80) { v >>= 7; ++s; }
+    return s;
+}
+
+static inline uint8_t* put_varint(uint8_t* p, uint64_t v) {
+    while (v >= 0x80) { *p++ = static_cast<uint8_t>(v) | 0x80; v >>= 7; }
+    *p++ = static_cast<uint8_t>(v);
+    return p;
+}
+
+int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
+                          const int64_t* timestamps, int64_t n,
+                          int64_t F,
+                          const uint8_t* keys_blob, const int32_t* key_lens,
+                          const int32_t* field_offs,  // [F * n]
+                          const int32_t* field_lens,  // [F * n]
+                          uint8_t* out, int64_t out_cap) {
+    // key prefix offsets into keys_blob
+    int64_t key_starts[64];
+    if (F > 64) return -1;
+    int64_t acc = 0;
+    for (int64_t f = 0; f < F; ++f) { key_starts[f] = acc; acc += key_lens[f]; }
+
+    // a span is emitted iff it passes BOTH the absence and bounds checks —
+    // the predicate must be identical in the size and write passes or the
+    // length prefixes desynchronise from the written bytes
+    auto span_ok = [&](int64_t f, int64_t i) -> bool {
+        int32_t vlen = field_lens[f * n + i];
+        if (vlen < 0) return false;
+        int32_t voff = field_offs[f * n + i];
+        return voff >= 0 && static_cast<int64_t>(voff) + vlen <= arena_len;
+    };
+
+    // pass 1: size
+    int64_t total = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
+        int64_t body = 1 + varint_size(ts);
+        for (int64_t f = 0; f < F; ++f) {
+            if (!span_ok(f, i)) continue;
+            int32_t vlen = field_lens[f * n + i];
+            int32_t klen = key_lens[f];
+            int64_t content = 1 + varint_size(klen) + klen +
+                              1 + varint_size(vlen) + vlen;
+            body += 1 + varint_size(content) + content;
+        }
+        total += 1 + varint_size(body) + body;
+    }
+    if (total > out_cap) return -total;
+
+    // pass 2: write
+    uint8_t* p = out;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
+        int64_t body = 1 + varint_size(ts);
+        for (int64_t f = 0; f < F; ++f) {
+            if (!span_ok(f, i)) continue;
+            int32_t vlen = field_lens[f * n + i];
+            int32_t klen = key_lens[f];
+            int64_t content = 1 + varint_size(klen) + klen +
+                              1 + varint_size(vlen) + vlen;
+            body += 1 + varint_size(content) + content;
+        }
+        *p++ = 0x0a;                       // LogGroup.Logs
+        p = put_varint(p, body);
+        *p++ = 0x08;                       // Log.Time
+        p = put_varint(p, ts);
+        for (int64_t f = 0; f < F; ++f) {
+            if (!span_ok(f, i)) continue;
+            int32_t vlen = field_lens[f * n + i];
+            int32_t voff = field_offs[f * n + i];
+            int32_t klen = key_lens[f];
+            int64_t content = 1 + varint_size(klen) + klen +
+                              1 + varint_size(vlen) + vlen;
+            *p++ = 0x12;                   // Log.Contents
+            p = put_varint(p, content);
+            *p++ = 0x0a;                   // Content.Key
+            p = put_varint(p, klen);
+            memcpy(p, keys_blob + key_starts[f], klen);
+            p += klen;
+            *p++ = 0x12;                   // Content.Value
+            p = put_varint(p, vlen);
+            memcpy(p, arena + voff, vlen);
+            p += vlen;
+        }
+    }
+    return p - out;
+}
+
+}  // extern "C"
