@@ -17,9 +17,11 @@ import sys
 import threading
 import time
 
+from .config.common_provider import CommonConfigProvider
 from .config.watcher import PipelineConfigWatcher
 from .input.file.file_server import FileServer
 from .input.host_monitor import HostMonitorInputRunner
+from .input.prometheus.scraper import PrometheusInputRunner
 from .monitor.alarms import AlarmManager
 from .monitor.metrics import WriteMetrics
 from .monitor.self_monitor import SelfMonitorServer
@@ -40,6 +42,7 @@ flags.DEFINE_FLAG_INT32("process_thread_count", "processor runner threads", 1)
 flags.DEFINE_FLAG_INT32("config_scan_interval", "config rescan seconds", 10)
 flags.DEFINE_FLAG_INT32("checkpoint_dump_interval", "checkpoint dump seconds", 5)
 flags.DEFINE_FLAG_DOUBLE("exit_flush_timeout", "flush-out budget on exit (s)", 20.0)
+flags.DEFINE_FLAG_STRING("config_server_address", "remote ConfigServer endpoint", "")
 
 
 class Application:
@@ -58,21 +61,35 @@ class Application:
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))
         self.config_watcher = PipelineConfigWatcher()
+        self.remote_provider = None
+        endpoint = flags.get_flag("config_server_address")
+        if endpoint:
+            self.remote_provider = CommonConfigProvider(
+                endpoint, os.path.join(self.data_dir, "remote_config"))
         self.watchdog = LoongCollectorMonitor(
             on_limit_breach=self._on_limit_breach)
         self._sig_stop = threading.Event()
 
     def init(self) -> None:
         os.makedirs(self.data_dir, exist_ok=True)
+        # warm the native library (and its one-shot build) here so the first
+        # data batch never stalls behind a compiler invocation
+        from . import native as _native
+        _native.get_lib()
         fs = FileServer.instance()
         fs.process_queue_manager = self.process_queue_manager
         fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
         fs.cpu_level_provider = lambda: self.watchdog.cpu_level
         HostMonitorInputRunner.instance().process_queue_manager = \
             self.process_queue_manager
+        PrometheusInputRunner.instance().process_queue_manager = \
+            self.process_queue_manager
         SelfMonitorServer.instance().process_queue_manager = \
             self.process_queue_manager
         self.config_watcher.add_source(self.config_dir)
+        if self.remote_provider is not None:
+            self.config_watcher.add_source(self.remote_provider.config_dir)
+            self.remote_provider.start()
 
     def start(self, once: bool = False) -> None:
         # sink-to-source: network sink → flusher runner → processor runner →
@@ -109,9 +126,12 @@ class Application:
         runner drains the process queues THROUGH the pipelines, and only then
         are batchers final-flushed and the send path drained."""
         log.info("exiting: stopping inputs and draining")
+        if self.remote_provider is not None:
+            self.remote_provider.stop()
         self.watchdog.stop()
         SelfMonitorServer.instance().stop()
         HostMonitorInputRunner.instance().stop()
+        PrometheusInputRunner.instance().stop()
         FileServer.instance().stop()
         self.processor_runner.stop()          # drains process queues
         self.pipeline_manager.stop_all()      # flush batchers, stop flushers

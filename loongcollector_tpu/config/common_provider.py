@@ -1,0 +1,175 @@
+"""Remote config provider: agent ↔ ConfigServer heartbeat protocol.
+
+Reference: core/config/common_provider/CommonConfigProvider.{h,cpp}
+(h:57-78) + config_server/protocol/v2 — periodic Heartbeat carrying
+capabilities + running status, response carries pipeline/instance config
+updates which are materialised into the watched config directory; apply
+status feeds back via ConfigFeedbackReceiver.
+
+Transport: HTTP POST with the v2 message shapes as JSON (field-compatible
+with the reference's protobuf schema: request_id, sequence_num, capabilities,
+instance_id, agent_type, startup_time, pipeline_configs[{name, version,
+detail}], ...).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+from urllib.parse import urlparse
+
+from ..utils.logger import get_logger
+
+
+def _safe_name(name: str) -> bool:
+    """Remote config names become file names — reject separators/traversal."""
+    return bool(name) and "/" not in name and "\\" not in name \
+        and ".." not in name and not name.startswith(".")
+
+log = get_logger("config_provider")
+
+# capability bits (reference config_server/protocol/v2 AgentCapabilities)
+CAPA_ACCEPTS_PIPELINE_CONFIG = 1
+CAPA_ACCEPTS_INSTANCE_CONFIG = 2
+CAPA_REPORTS_FULL_STATE = 4
+
+
+class CommonConfigProvider:
+    def __init__(self, endpoint: str, config_dir: str,
+                 interval_s: float = 10.0, agent_type: str = "loongcollector-tpu"):
+        self.endpoint = endpoint
+        self.config_dir = config_dir
+        self.interval_s = interval_s
+        self.agent_type = agent_type
+        self.instance_id = str(uuid.uuid4())
+        self.startup_time = int(time.time())
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        # name -> version we currently hold
+        self._versions: Dict[str, int] = {}
+        # name -> (status, message) pending feedback
+        self._feedback: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        os.makedirs(self.config_dir, exist_ok=True)
+        self._thread = threading.Thread(target=self._run, name="config-provider",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=3)
+            self._thread = None
+
+    def feedback(self, config_name: str, status: str, message: str = "") -> None:
+        """ConfigFeedbackReceiver: apply status reported on next heartbeat."""
+        with self._lock:
+            self._feedback[config_name] = (status, message)
+
+    # -- protocol -----------------------------------------------------------
+
+    def _heartbeat_request(self) -> dict:
+        self._seq += 1
+        with self._lock:
+            feedback = [{"name": n, "status": s, "message": m}
+                        for n, (s, m) in self._feedback.items()]
+            self._feedback.clear()
+            versions = [{"name": n, "version": v}
+                        for n, v in self._versions.items()]
+        return {
+            "request_id": str(uuid.uuid4()),
+            "sequence_num": self._seq,
+            "capabilities": (CAPA_ACCEPTS_PIPELINE_CONFIG
+                             | CAPA_REPORTS_FULL_STATE),
+            "instance_id": self.instance_id,
+            "agent_type": self.agent_type,
+            "startup_time": self.startup_time,
+            "running_status": "running",
+            "pipeline_configs": versions,
+            "config_feedback": feedback,
+        }
+
+    def _run(self) -> None:
+        while self._running:
+            try:
+                self.heartbeat_once()
+            except Exception:  # noqa: BLE001
+                log.exception("heartbeat failed")
+            for _ in range(int(self.interval_s * 10)):
+                if not self._running:
+                    return
+                time.sleep(0.1)
+
+    def heartbeat_once(self) -> bool:
+        resp = self._post("/v2/Agent/Heartbeat", self._heartbeat_request())
+        if resp is None:
+            return False
+        self._apply_response(resp)
+        return True
+
+    def _apply_response(self, resp: dict) -> None:
+        for cfg in resp.get("pipeline_config_updates", []):
+            name = cfg.get("name")
+            version = int(cfg.get("version", 1))
+            detail = cfg.get("detail")
+            if not name or detail is None:
+                continue
+            if not _safe_name(name):
+                log.warning("rejecting unsafe remote config name %r", name)
+                continue
+            if self._versions.get(name) == version:
+                continue
+            path = os.path.join(self.config_dir, f"{name}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                if isinstance(detail, str):
+                    f.write(detail)
+                else:
+                    json.dump(detail, f)
+            os.replace(tmp, path)
+            with self._lock:
+                self._versions[name] = version
+            log.info("materialized remote config %s v%d", name, version)
+        for name in resp.get("removed_configs", []):
+            if not _safe_name(name):
+                log.warning("rejecting unsafe remote config name %r", name)
+                continue
+            path = os.path.join(self.config_dir, f"{name}.json")
+            if os.path.exists(path):
+                os.remove(path)
+            with self._lock:
+                self._versions.pop(name, None)
+            log.info("removed remote config %s", name)
+
+    def _post(self, path: str, payload: dict) -> Optional[dict]:
+        conn = None
+        try:
+            u = urlparse(self.endpoint)
+            conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                        else http.client.HTTPConnection)
+            conn = conn_cls(u.netloc, timeout=10)
+            conn.request("POST", path, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(body)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            if conn is not None:
+                conn.close()

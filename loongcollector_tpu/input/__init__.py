@@ -5,9 +5,11 @@ def register_all(registry) -> None:
     from .file.input_file import InputFile, InputStaticFile
     from .host_monitor import InputHostMonitor
     from .internal import InputInternalAlarms, InputInternalMetrics
+    from .prometheus.scraper import InputPrometheus
 
     registry.register_input("input_file", InputFile)
     registry.register_input("input_static_file_onetime", InputStaticFile)
     registry.register_input("input_host_monitor", InputHostMonitor)
     registry.register_input("input_internal_metrics", InputInternalMetrics)
     registry.register_input("input_internal_alarms", InputInternalAlarms)
+    registry.register_input("input_prometheus", InputPrometheus)

@@ -1,0 +1,153 @@
+"""Checkpoint v2 + exactly-once range checkpoints.
+
+Reference: core/file_server/checkpoint/CheckpointManagerV2.h:26-173 (leveldb
+store) and RangeCheckpoint.h (PB-persisted per-send-concurrency ranges),
+wired by ExactlyOnceQueueManager (collection_pipeline/queue/ExactlyOnce*).
+
+Store: sqlite3 (stdlib, durable, transactional) replaces leveldb.  Semantics:
+an exactly-once sender slot persists the (file, read-offset range) BEFORE
+dispatch; on restart, uncommitted ranges replay and groups are marked
+IsReplay so downstream can dedupe (PipelineEventGroup replay flag).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class RangeCheckpoint:
+    key: str = ""              # pipeline + concurrency slot
+    inode: int = 0
+    dev: int = 0
+    file_path: str = ""
+    read_offset: int = 0
+    read_length: int = 0
+    committed: bool = False
+    sequence_id: int = 0
+    update_time: float = 0.0
+
+
+class CheckpointManagerV2:
+    def __init__(self, db_path: str):
+        self.db_path = db_path
+        os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._conn.execute("""
+            CREATE TABLE IF NOT EXISTS range_checkpoints (
+                key TEXT PRIMARY KEY,
+                inode INTEGER, dev INTEGER, file_path TEXT,
+                read_offset INTEGER, read_length INTEGER,
+                committed INTEGER, sequence_id INTEGER, update_time REAL
+            )""")
+        self._conn.commit()
+
+    def save(self, cp: RangeCheckpoint) -> None:
+        cp.update_time = time.time()
+        with self._lock:
+            self._conn.execute(
+                """INSERT OR REPLACE INTO range_checkpoints
+                   VALUES (?,?,?,?,?,?,?,?,?)""",
+                (cp.key, cp.inode, cp.dev, cp.file_path, cp.read_offset,
+                 cp.read_length, int(cp.committed), cp.sequence_id,
+                 cp.update_time))
+            self._conn.commit()
+
+    def commit(self, key: str, sequence_id: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE range_checkpoints SET committed=1, update_time=? "
+                "WHERE key=? AND sequence_id=?",
+                (time.time(), key, sequence_id))
+            self._conn.commit()
+
+    def get(self, key: str) -> Optional[RangeCheckpoint]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM range_checkpoints WHERE key=?", (key,)
+            ).fetchone()
+        return self._row_to_cp(row) if row else None
+
+    def uncommitted(self, prefix: str = "") -> List[RangeCheckpoint]:
+        """Ranges persisted but not acknowledged — replayed on restart."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM range_checkpoints WHERE committed=0 "
+                "AND key LIKE ?", (prefix + "%",)).fetchall()
+        return [self._row_to_cp(r) for r in rows]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM range_checkpoints WHERE key=?",
+                               (key,))
+            self._conn.commit()
+
+    def gc(self, max_age_s: float = 86400.0) -> int:
+        cutoff = time.time() - max_age_s
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM range_checkpoints WHERE committed=1 "
+                "AND update_time < ?", (cutoff,))
+            self._conn.commit()
+            return cur.rowcount
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    @staticmethod
+    def _row_to_cp(row) -> RangeCheckpoint:
+        return RangeCheckpoint(key=row[0], inode=row[1], dev=row[2],
+                               file_path=row[3], read_offset=row[4],
+                               read_length=row[5], committed=bool(row[6]),
+                               sequence_id=row[7], update_time=row[8])
+
+
+class ExactlyOnceSender:
+    """Per-pipeline exactly-once send slots.
+
+    Reference semantics (ExactlyOnceQueueManager): N concurrency slots, each
+    carrying one in-flight range; a slot persists its range before dispatch
+    and commits after sink ack.  `pending_replays()` exposes crashed-in-
+    flight ranges at startup.
+    """
+
+    def __init__(self, manager: CheckpointManagerV2, pipeline: str,
+                 concurrency: int = 8):
+        self.manager = manager
+        self.pipeline = pipeline
+        self.concurrency = concurrency
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._free = list(range(concurrency))
+
+    def acquire_slot(self, file_path: str, dev: int, inode: int,
+                     read_offset: int, read_length: int
+                     ) -> Optional[RangeCheckpoint]:
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._seq += 1
+            seq = self._seq
+        cp = RangeCheckpoint(
+            key=f"{self.pipeline}/{slot}", file_path=file_path, dev=dev,
+            inode=inode, read_offset=read_offset, read_length=read_length,
+            sequence_id=seq)
+        self.manager.save(cp)
+        return cp
+
+    def commit_slot(self, cp: RangeCheckpoint) -> None:
+        self.manager.commit(cp.key, cp.sequence_id)
+        slot = int(cp.key.rsplit("/", 1)[1])
+        with self._lock:
+            self._free.append(slot)
+
+    def pending_replays(self) -> List[RangeCheckpoint]:
+        return self.manager.uncommitted(self.pipeline + "/")

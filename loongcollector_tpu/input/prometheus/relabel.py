@@ -1,0 +1,98 @@
+"""Prometheus relabel_config semantics.
+
+Reference: core/prometheus/labels/Relabel.cpp — full relabel actions:
+replace, keep, drop, keepequal, dropequal, hashmod, labelmap, labeldrop,
+labelkeep.  Applied to scrape-discovery targets and to sample labels
+(ProcessorPromRelabelMetricNative).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Dict, List, Optional
+
+
+class RelabelRule:
+    def __init__(self, config: dict):
+        self.source_labels: List[str] = list(config.get("source_labels", []))
+        self.separator: str = config.get("separator", ";")
+        self.target_label: str = config.get("target_label", "")
+        self.regex = re.compile(config.get("regex", "(.*)"))
+        self.modulus: int = int(config.get("modulus", 0) or 0)
+        self.replacement: str = config.get("replacement", "$1")
+        self.action: str = config.get("action", "replace").lower()
+
+    def _concat(self, labels: Dict[str, str]) -> str:
+        return self.separator.join(labels.get(k, "") for k in self.source_labels)
+
+    def apply(self, labels: Dict[str, str]) -> Optional[Dict[str, str]]:
+        """Returns updated labels, or None if the target is dropped."""
+        val = self._concat(labels)
+        act = self.action
+        if act == "keep":
+            return labels if self.regex.fullmatch(val) else None
+        if act == "drop":
+            return None if self.regex.fullmatch(val) else labels
+        if act == "keepequal":
+            return labels if val == labels.get(self.target_label, "") else None
+        if act == "dropequal":
+            return None if val == labels.get(self.target_label, "") else labels
+        if act == "replace":
+            m = self.regex.fullmatch(val)
+            if m is None:
+                return labels
+            target = _expand(self.target_label or "$0", m)
+            replacement = _expand(self.replacement, m)
+            out = dict(labels)
+            if target:
+                if replacement:
+                    out[target] = replacement
+                else:
+                    out.pop(target, None)
+            return out
+        if act == "hashmod":
+            if self.modulus <= 0:
+                return labels
+            h = int.from_bytes(
+                hashlib.md5(val.encode()).digest()[-8:], "big")
+            out = dict(labels)
+            out[self.target_label] = str(h % self.modulus)
+            return out
+        if act == "labelmap":
+            out = dict(labels)
+            for k, v in labels.items():
+                m = self.regex.fullmatch(k)
+                if m:
+                    out[_expand(self.replacement, m)] = v
+            return out
+        if act == "labeldrop":
+            return {k: v for k, v in labels.items()
+                    if not self.regex.fullmatch(k)}
+        if act == "labelkeep":
+            return {k: v for k, v in labels.items()
+                    if self.regex.fullmatch(k)}
+        return labels
+
+
+def _expand(template: str, m: "re.Match") -> str:
+    """$1 / ${1} style expansion."""
+    def sub(mm):
+        idx = mm.group(1) or mm.group(2)
+        try:
+            return m.group(int(idx)) or ""
+        except (IndexError, ValueError):
+            return ""
+    return re.sub(r"\$(?:(\d+)|\{(\d+)\})", sub, template)
+
+
+class RelabelConfigList:
+    def __init__(self, configs: List[dict]):
+        self.rules = [RelabelRule(c) for c in (configs or [])]
+
+    def process(self, labels: Dict[str, str]) -> Optional[Dict[str, str]]:
+        for rule in self.rules:
+            labels = rule.apply(labels)
+            if labels is None:
+                return None
+        return labels

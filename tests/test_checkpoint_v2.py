@@ -1,0 +1,46 @@
+"""Checkpoint v2 / exactly-once range checkpoints (reference
+CheckpointManagerV2 + ExactlyOnceQueueManager semantics)."""
+
+from loongcollector_tpu.input.file.checkpoint_v2 import (CheckpointManagerV2,
+                                                         ExactlyOnceSender,
+                                                         RangeCheckpoint)
+
+
+class TestCheckpointV2:
+    def test_save_commit_roundtrip(self, tmp_path):
+        mgr = CheckpointManagerV2(str(tmp_path / "v2.db"))
+        cp = RangeCheckpoint(key="p/0", inode=7, file_path="/var/a.log",
+                             read_offset=100, read_length=50, sequence_id=1)
+        mgr.save(cp)
+        assert len(mgr.uncommitted("p/")) == 1
+        mgr.commit("p/0", 1)
+        assert mgr.uncommitted("p/") == []
+        got = mgr.get("p/0")
+        assert got.committed and got.read_offset == 100
+        mgr.close()
+
+    def test_replay_after_crash(self, tmp_path):
+        path = str(tmp_path / "v2.db")
+        mgr = CheckpointManagerV2(path)
+        sender = ExactlyOnceSender(mgr, "pipe", concurrency=2)
+        cp1 = sender.acquire_slot("/a.log", 1, 2, 0, 100)
+        cp2 = sender.acquire_slot("/a.log", 1, 2, 100, 100)
+        assert sender.acquire_slot("/a.log", 1, 2, 200, 100) is None  # full
+        sender.commit_slot(cp1)
+        mgr.close()
+        # "restart": uncommitted ranges must replay
+        mgr2 = CheckpointManagerV2(path)
+        sender2 = ExactlyOnceSender(mgr2, "pipe", concurrency=2)
+        replays = sender2.pending_replays()
+        assert len(replays) == 1
+        assert replays[0].read_offset == 100
+        mgr2.close()
+
+    def test_gc_committed(self, tmp_path):
+        mgr = CheckpointManagerV2(str(tmp_path / "v2.db"))
+        cp = RangeCheckpoint(key="x/0", sequence_id=1)
+        mgr.save(cp)
+        mgr.commit("x/0", 1)
+        assert mgr.gc(max_age_s=-1) == 1
+        assert mgr.get("x/0") is None
+        mgr.close()

@@ -1,0 +1,97 @@
+"""Remote config provider: heartbeat protocol against a fake ConfigServer."""
+
+import http.server
+import json
+import os
+import threading
+
+from loongcollector_tpu.config.common_provider import CommonConfigProvider
+from loongcollector_tpu.pipeline.task_pipeline import (Task,
+                                                       TaskPipelineManager,
+                                                       TaskRegistry)
+
+
+class _FakeServer(http.server.BaseHTTPRequestHandler):
+    requests = []
+    response = {}
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        _FakeServer.requests.append((self.path, body))
+        out = json.dumps(_FakeServer.response).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *args):
+        pass
+
+
+class TestCommonConfigProvider:
+    def test_heartbeat_materializes_configs(self, tmp_path):
+        _FakeServer.requests = []
+        _FakeServer.response = {
+            "pipeline_config_updates": [
+                {"name": "remote-pipe", "version": 3,
+                 "detail": {"inputs": [], "processors": [], "flushers": []}},
+            ],
+        }
+        server = http.server.HTTPServer(("127.0.0.1", 0), _FakeServer)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            provider = CommonConfigProvider(
+                f"http://127.0.0.1:{port}", str(tmp_path / "remote"))
+            os.makedirs(provider.config_dir, exist_ok=True)
+            provider.feedback("old-cfg", "applied")
+            assert provider.heartbeat_once()
+            path, body = _FakeServer.requests[0]
+            assert path == "/v2/Agent/Heartbeat"
+            assert body["agent_type"] == "loongcollector-tpu"
+            assert body["config_feedback"][0]["name"] == "old-cfg"
+            cfg_path = tmp_path / "remote" / "remote-pipe.json"
+            assert cfg_path.exists()
+            assert json.loads(cfg_path.read_text())["inputs"] == []
+            # version tracking: same version not re-materialized
+            cfg_path.unlink()
+            assert provider.heartbeat_once()
+            assert not cfg_path.exists()
+            # removal
+            _FakeServer.response = {"removed_configs": ["remote-pipe"]}
+            assert provider.heartbeat_once()
+            with provider._lock:
+                assert "remote-pipe" not in provider._versions
+        finally:
+            server.shutdown()
+
+
+class TestTaskPipelines:
+    def test_task_lifecycle(self):
+        events = []
+
+        class MyTask(Task):
+            name = "task_test"
+
+            def start(self):
+                events.append("start")
+                return True
+
+            def stop(self):
+                events.append("stop")
+                return True
+
+        TaskRegistry.instance().register("task_test", MyTask)
+        mgr = TaskPipelineManager()
+
+        from loongcollector_tpu.pipeline.pipeline_manager import ConfigDiff
+        diff = ConfigDiff()
+        diff.added["t1"] = {"task": {"Type": "task_test"}}
+        mgr.update_tasks(diff)
+        assert events == ["start"]
+        assert mgr.find("t1") is not None
+        diff2 = ConfigDiff()
+        diff2.removed.append("t1")
+        mgr.update_tasks(diff2)
+        assert events == ["start", "stop"]

@@ -1,0 +1,121 @@
+"""Prometheus input: text parser, relabel semantics, scrape e2e against a
+local HTTP server (mirrors reference core/unittest/prometheus/)."""
+
+import http.server
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.input.prometheus.relabel import (RelabelConfigList,
+                                                         RelabelRule)
+from loongcollector_tpu.input.prometheus.scraper import (PrometheusInputRunner,
+                                                         ScrapeJob)
+from loongcollector_tpu.input.prometheus.text_parser import parse_exposition
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+EXPO = b"""# HELP http_requests_total Total requests
+# TYPE http_requests_total counter
+http_requests_total{method="get",code="200"} 1027 1395066363000
+http_requests_total{method="post",code="400"} 3
+no_labels_metric 42.5
+escaped{path="C:\\\\dir",msg="say \\"hi\\""} 1
+bad_value{x="1"} notanumber
+nan_metric NaN
+neg_inf -Inf
+"""
+
+
+class TestTextParser:
+    def test_parse_samples(self):
+        g = parse_exposition(EXPO, default_ts=1000)
+        events = g.events
+        names = [str(ev.name) for ev in events]
+        assert "http_requests_total" in names
+        assert "no_labels_metric" in names
+        ev0 = events[0]
+        assert ev0.get_tag(b"method") == b"get"
+        assert ev0.value.value == 1027
+        assert ev0.timestamp == 1395066363  # ms -> s
+        assert events[1].timestamp == 1000  # default
+        # escapes
+        esc = [e for e in events if str(e.name) == "escaped"][0]
+        assert esc.get_tag(b"path") == b"C:\\dir"
+        assert esc.get_tag(b"msg") == b'say "hi"'
+        # bad value skipped
+        assert "bad_value" not in names
+        import math
+        nanev = [e for e in events if str(e.name) == "nan_metric"][0]
+        assert math.isnan(nanev.value.value)
+
+
+class TestRelabel:
+    def test_keep_drop(self):
+        rules = RelabelConfigList([
+            {"source_labels": ["job"], "regex": "web.*", "action": "keep"}])
+        assert rules.process({"job": "web-1"}) is not None
+        assert rules.process({"job": "db-1"}) is None
+
+    def test_replace_with_capture(self):
+        rules = RelabelConfigList([
+            {"source_labels": ["addr"], "regex": r"([^:]+):(\d+)",
+             "target_label": "host", "replacement": "$1", "action": "replace"}])
+        out = rules.process({"addr": "node1:9100"})
+        assert out["host"] == "node1"
+
+    def test_labelmap_and_labeldrop(self):
+        rules = RelabelConfigList([
+            {"regex": r"__meta_(.+)", "replacement": "$1", "action": "labelmap"},
+            {"regex": r"__meta_.*", "action": "labeldrop"}])
+        out = rules.process({"__meta_pod": "p1", "keep_me": "x"})
+        assert out == {"pod": "p1", "keep_me": "x"}
+
+    def test_hashmod(self):
+        rules = RelabelConfigList([
+            {"source_labels": ["i"], "modulus": 4, "target_label": "shard",
+             "action": "hashmod"}])
+        out = rules.process({"i": "abc"})
+        assert out["shard"] in {"0", "1", "2", "3"}
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b'up_metric{instance="x"} 1\n'
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+class TestScrapeE2E:
+    def test_scrape_pushes_group(self):
+        server = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            pqm = ProcessQueueManager()
+            pqm.create_or_reuse_queue(55)
+            runner = PrometheusInputRunner()
+            runner.process_queue_manager = pqm
+            job = ScrapeJob("testjob", {
+                "StaticTargets": [f"127.0.0.1:{port}"],
+                "MetricRelabelConfigs": [
+                    {"source_labels": ["instance"], "regex": "x",
+                     "target_label": "instance", "replacement": "renamed",
+                     "action": "replace"}],
+            }, queue_key=55)
+            runner.scrape_one(job, job.targets[0])
+            key, group = pqm.pop_item(timeout=0)
+            assert key == 55
+            ev = group.events[0]
+            assert str(ev.name) == "up_metric"
+            assert ev.get_tag(b"instance") == b"renamed"
+            assert group.get_tag(b"job") == b"testjob"
+            assert job.targets[0].up
+        finally:
+            server.shutdown()
