@@ -21,6 +21,8 @@ from .config.common_provider import CommonConfigProvider
 from .config.watcher import PipelineConfigWatcher
 from .input.file.file_server import FileServer
 from .input.host_monitor import HostMonitorInputRunner
+from .input.ebpf.server import EBPFServer
+from .input.forward import GrpcInputManager
 from .input.prometheus.scraper import PrometheusInputRunner
 from .monitor.alarms import AlarmManager
 from .monitor.metrics import WriteMetrics
@@ -84,6 +86,10 @@ class Application:
             self.process_queue_manager
         PrometheusInputRunner.instance().process_queue_manager = \
             self.process_queue_manager
+        EBPFServer.instance().process_queue_manager = \
+            self.process_queue_manager
+        GrpcInputManager.instance().process_queue_manager = \
+            self.process_queue_manager
         SelfMonitorServer.instance().process_queue_manager = \
             self.process_queue_manager
         self.config_watcher.add_source(self.config_dir)
@@ -132,6 +138,8 @@ class Application:
         SelfMonitorServer.instance().stop()
         HostMonitorInputRunner.instance().stop()
         PrometheusInputRunner.instance().stop()
+        EBPFServer.instance().stop()
+        GrpcInputManager.instance().stop_all()
         FileServer.instance().stop()
         self.processor_runner.stop()          # drains process queues
         self.pipeline_manager.stop_all()      # flush batchers, stop flushers

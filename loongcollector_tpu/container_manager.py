@@ -1,0 +1,255 @@
+"""Container discovery & metadata.
+
+Reference: core/container_manager/ (discovery diffing; pushes matched-
+container info, triggers FileServer pause/resume on changes,
+ContainerManager.cpp:325) and core/metadata/ (K8sMetadata pod/service cache).
+
+Discovery sources:
+  * Docker Engine API over /var/run/docker.sock (stdlib HTTP over AF_UNIX)
+  * CRI log directory layout (/var/log/pods/<ns>_<pod>_<uid>/<container>/)
+  * static container info files (the reference's mounted containerInfo)
+
+The FileServer consumes discovery results as extra glob roots; label/env
+filters follow the reference's ContainerFilters config shape.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .utils.logger import get_logger
+
+log = get_logger("container_manager")
+
+DOCKER_SOCK = "/var/run/docker.sock"
+CRI_POD_LOG_DIR = "/var/log/pods"
+
+
+@dataclass
+class ContainerInfo:
+    id: str = ""
+    name: str = ""
+    image: str = ""
+    log_path: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    k8s_namespace: str = ""
+    k8s_pod: str = ""
+    k8s_container: str = ""
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, sock_path: str, timeout: float = 5.0):
+        super().__init__("localhost", timeout=timeout)
+        self._sock_path = sock_path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._sock_path)
+        self.sock = s
+
+
+class DockerDiscovery:
+    """List running containers via the Docker Engine API."""
+
+    def __init__(self, sock_path: str = DOCKER_SOCK):
+        self.sock_path = sock_path
+
+    def available(self) -> bool:
+        return os.path.exists(self.sock_path)
+
+    def list_containers(self) -> List[ContainerInfo]:
+        if not self.available():
+            return []
+        try:
+            conn = _UnixHTTPConnection(self.sock_path)
+            conn.request("GET", "/containers/json")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return []
+            data = json.loads(body)
+        except (OSError, ValueError, http.client.HTTPException):
+            return []
+        if not isinstance(data, list):
+            return []
+        out = []
+        for c in data:
+            cid = c.get("Id", "")
+            info = ContainerInfo(
+                id=cid,
+                name=(c.get("Names") or [""])[0].lstrip("/"),
+                image=c.get("Image", ""),
+                labels=c.get("Labels") or {},
+                log_path=f"/var/lib/docker/containers/{cid}/{cid}-json.log")
+            labels = info.labels
+            info.k8s_namespace = labels.get("io.kubernetes.pod.namespace", "")
+            info.k8s_pod = labels.get("io.kubernetes.pod.name", "")
+            info.k8s_container = labels.get("io.kubernetes.container.name", "")
+            out.append(info)
+        return out
+
+
+class CRIDiscovery:
+    """Discover container stdout logs from the kubelet pod-log layout."""
+
+    def __init__(self, root: str = CRI_POD_LOG_DIR):
+        self.root = root
+
+    def available(self) -> bool:
+        return os.path.isdir(self.root)
+
+    def list_containers(self) -> List[ContainerInfo]:
+        out = []
+        if not self.available():
+            return out
+        try:
+            pods = os.listdir(self.root)
+        except OSError:
+            return out
+        for pod_dir in pods:
+            parts = pod_dir.split("_")
+            if len(parts) != 3:
+                continue
+            ns, pod, uid = parts
+            pod_path = os.path.join(self.root, pod_dir)
+            try:
+                containers = os.listdir(pod_path)
+            except OSError:
+                continue
+            for cname in containers:
+                cdir = os.path.join(pod_path, cname)
+                if not os.path.isdir(cdir):
+                    continue
+                out.append(ContainerInfo(
+                    id=f"{uid}/{cname}", name=cname,
+                    log_path=os.path.join(cdir, "*.log"),
+                    k8s_namespace=ns, k8s_pod=pod, k8s_container=cname))
+        return out
+
+
+class ContainerFilters:
+    """Reference ContainerFilters: include/exclude by label/env/k8s names."""
+
+    def __init__(self, config: Optional[dict] = None):
+        cfg = config or {}
+        self.include_labels = cfg.get("IncludeContainerLabel", {})
+        self.exclude_labels = cfg.get("ExcludeContainerLabel", {})
+        self.k8s_namespace_regex = cfg.get("K8sNamespaceRegex", "")
+        self.k8s_pod_regex = cfg.get("K8sPodRegex", "")
+
+    def match(self, info: ContainerInfo) -> bool:
+        import re
+        for k, v in self.include_labels.items():
+            if not fnmatch.fnmatch(info.labels.get(k, ""), v):
+                return False
+        for k, v in self.exclude_labels.items():
+            if k in info.labels and fnmatch.fnmatch(info.labels[k], v):
+                return False
+        if self.k8s_namespace_regex and not re.fullmatch(
+                self.k8s_namespace_regex, info.k8s_namespace):
+            return False
+        if self.k8s_pod_regex and not re.fullmatch(
+                self.k8s_pod_regex, info.k8s_pod):
+            return False
+        return True
+
+
+class ContainerManager:
+    _instance: Optional["ContainerManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.docker = DockerDiscovery()
+        self.cri = CRIDiscovery()
+        self._last: Dict[str, ContainerInfo] = {}
+        self._lock = threading.Lock()
+        self.on_diff = None  # callback(added, removed)
+
+    @classmethod
+    def instance(cls) -> "ContainerManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def discover(self) -> List[ContainerInfo]:
+        found = self.docker.list_containers() + self.cri.list_containers()
+        return found
+
+    def diff_round(self) -> tuple:
+        """One discovery diff (reference: container diff each supervision
+        round, Application.cpp:386-392)."""
+        found = {c.id: c for c in self.discover()}
+        with self._lock:
+            added = [c for cid, c in found.items() if cid not in self._last]
+            removed = [c for cid, c in self._last.items() if cid not in found]
+            self._last = found
+        if (added or removed) and self.on_diff is not None:
+            self.on_diff(added, removed)
+        return added, removed
+
+
+class K8sMetadata:
+    """Pod metadata cache (reference core/metadata/K8sMetadata) — resolves
+    from the kube-apiserver when in-cluster credentials exist."""
+
+    def __init__(self) -> None:
+        self.token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+        self.ca_path = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+        self._cache: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def available(self) -> bool:
+        return os.path.exists(self.token_path) and \
+            bool(os.environ.get("KUBERNETES_SERVICE_HOST"))
+
+    def pod_metadata(self, namespace: str, pod: str) -> Optional[dict]:
+        key = f"{namespace}/{pod}"
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        if not self.available():
+            return None
+        import ssl
+        if not os.path.exists(self.ca_path):
+            log.warning("in-cluster CA bundle missing; refusing unverified "
+                        "apiserver connection")
+            return None
+        try:
+            with open(self.token_path) as f:
+                token = f.read().strip()
+            host = os.environ["KUBERNETES_SERVICE_HOST"]
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            ctx = ssl.create_default_context(cafile=self.ca_path)
+            conn = http.client.HTTPSConnection(host, int(port), timeout=5,
+                                               context=ctx)
+            conn.request("GET", f"/api/v1/namespaces/{namespace}/pods/{pod}",
+                         headers={"Authorization": f"Bearer {token}"})
+            resp = conn.getresponse()
+            data = json.loads(resp.read()) if resp.status == 200 else None
+            conn.close()
+        except (OSError, ValueError, KeyError):
+            return None
+        if data is not None:
+            meta = {
+                "labels": data.get("metadata", {}).get("labels", {}),
+                "node": data.get("spec", {}).get("nodeName", ""),
+                "ip": data.get("status", {}).get("podIP", ""),
+            }
+            with self._lock:
+                if len(self._cache) > 4096:
+                    self._cache.clear()
+                self._cache[key] = meta
+            return meta
+        return None

@@ -1,0 +1,90 @@
+"""input_container_stdio — tail container stdout/stderr logs.
+
+Reference: core/plugin/input/InputContainerStdio.cpp — binds container
+discovery to file tailing with the container-log unwrap + partial-merge
+inner processors (ProcessorParseContainerLogNative →
+ProcessorMergeMultilineLogNative flag mode).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+from ..container_manager import ContainerFilters, ContainerManager
+from ..pipeline.plugin.interface import Input, PluginContext
+from .file.file_server import FileServer
+from .file.polling import FileDiscoveryConfig
+
+
+class InputContainerStdio(Input):
+    name = "input_container_stdio"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.filters = ContainerFilters()
+        self.fmt = "containerd_text"
+        self.multiline: Dict[str, Any] = {}
+        self.config_name = ""
+        self._refresh_thread = None
+        self._running = False
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.filters = ContainerFilters(config.get("ContainerFilters", config))
+        self.fmt = config.get("Format", "containerd_text")
+        self.multiline = config.get("Multiline", {}) or {}
+        self.config_name = f"{context.pipeline_name}#stdio{id(self)}"
+        return True
+
+    def inner_processor_configs(self) -> List[Dict[str, Any]]:
+        out = [
+            {"Type": "processor_split_log_string_native"},
+            {"Type": "processor_parse_container_log_native",
+             "Format": self.fmt,
+             "IgnoringStdout": bool(self.config.get("IgnoringStdout", False)),
+             "IgnoringStderr": bool(self.config.get("IgnoringStderr", False))},
+            {"Type": "processor_merge_multiline_log_native",
+             "MergeType": "flag"},
+        ]
+        if self.multiline.get("StartPattern"):
+            out.append({"Type": "processor_split_multiline_log_string_native",
+                        "Multiline": self.multiline})
+        return out
+
+    def _matched_paths(self) -> List[str]:
+        mgr = ContainerManager.instance()
+        paths = []
+        for info in mgr.discover():
+            if self.filters.match(info):
+                paths.append(info.log_path)
+        return paths
+
+    def start(self) -> bool:
+        paths = self._matched_paths()
+        fs = FileServer.instance()
+        fs.add_config(self.config_name,
+                      FileDiscoveryConfig(file_paths=paths or ["/nonexistent"]),
+                      self.context.process_queue_key, tail_existing=True)
+        fs.start()
+        # periodic re-discovery updates the glob set (container churn)
+        self._running = True
+        self._refresh_thread = threading.Thread(
+            target=self._refresh, name="stdio-discovery", daemon=True)
+        self._refresh_thread.start()
+        return True
+
+    def _refresh(self) -> None:
+        while self._running:
+            time.sleep(5.0)
+            try:
+                FileServer.instance().update_config_paths(
+                    self.config_name, self._matched_paths())
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        FileServer.instance().remove_config(self.config_name)
+        return True

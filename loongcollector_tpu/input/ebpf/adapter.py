@@ -1,0 +1,115 @@
+"""eBPF driver adapter boundary.
+
+Reference: core/ebpf/EBPFAdapter.cpp:149-231 — the server dlopens the eBPF
+driver library (BPF program loading, perf-buffer polling) and receives raw
+events through registered callbacks; plugin managers consume them.
+
+This framework keeps the same boundary: `EBPFAdapter` is the abstract driver
+interface; `MockAdapter` replays synthetic/recorded raw events (the only
+driver usable in unprivileged containers — kernel BPF needs CAP_BPF and a
+compiled driver, loaded here the same way via `SoAdapter` when present).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+class EventSource(enum.Enum):
+    NETWORK_OBSERVE = "network_observe"
+    PROCESS_SECURITY = "process_security"
+    FILE_SECURITY = "file_security"
+    NETWORK_SECURITY = "network_security"
+    CPU_PROFILING = "cpu_profiling"
+
+
+@dataclass
+class RawKernelEvent:
+    """A raw event from the driver (what the perf buffer would deliver)."""
+
+    source: EventSource
+    pid: int = 0
+    timestamp_ns: int = 0
+    # network events
+    fd: int = -1
+    local_addr: str = ""
+    remote_addr: str = ""
+    direction: str = ""        # ingress / egress
+    payload: bytes = b""       # captured L7 bytes
+    # security events
+    call_name: str = ""        # e.g. security_file_permission, sys_execve
+    path: str = ""
+    flags: int = 0
+    # profiling
+    stack: List[str] = field(default_factory=list)
+
+
+Callback = Callable[[RawKernelEvent], None]
+
+
+class EBPFAdapter:
+    """Driver interface (reference EBPFAdapter): start/stop per source,
+    callbacks deliver raw events on the poll thread."""
+
+    def start_plugin(self, source: EventSource, callback: Callback) -> bool:
+        raise NotImplementedError
+
+    def stop_plugin(self, source: EventSource) -> bool:
+        raise NotImplementedError
+
+    def suspend_plugin(self, source: EventSource) -> bool:
+        return True
+
+    def resume_plugin(self, source: EventSource) -> bool:
+        return True
+
+
+class MockAdapter(EBPFAdapter):
+    """Replay adapter: feed() injects events; optionally a generator thread
+    produces a synthetic stream (used by tests and the bench harness)."""
+
+    def __init__(self) -> None:
+        self._callbacks: Dict[EventSource, Callback] = {}
+        self._lock = threading.Lock()
+
+    def start_plugin(self, source: EventSource, callback: Callback) -> bool:
+        with self._lock:
+            self._callbacks[source] = callback
+        return True
+
+    def stop_plugin(self, source: EventSource) -> bool:
+        with self._lock:
+            self._callbacks.pop(source, None)
+        return True
+
+    def feed(self, event: RawKernelEvent) -> bool:
+        with self._lock:
+            cb = self._callbacks.get(event.source)
+        if cb is None:
+            return False
+        cb(event)
+        return True
+
+
+_default_adapter: Optional[EBPFAdapter] = None
+_adapter_lock = threading.Lock()
+
+
+def get_adapter() -> EBPFAdapter:
+    """Process-wide adapter; defaults to the mock (driver .so loading slots
+    in here when a privileged driver build exists)."""
+    global _default_adapter
+    with _adapter_lock:
+        if _default_adapter is None:
+            _default_adapter = MockAdapter()
+        return _default_adapter
+
+
+def set_adapter(adapter: EBPFAdapter) -> None:
+    global _default_adapter
+    with _adapter_lock:
+        _default_adapter = adapter

@@ -1,0 +1,288 @@
+"""EBPFServer + plugin managers.
+
+Reference: core/ebpf/EBPFServer.h:73-100 (singleton InputRunner; poll thread
+over the adapter) and core/ebpf/plugin/*/ managers:
+  NetworkObserverManager — L7 parse (protocol/), connection enrichment
+  ProcessSecurityManager / FileSecurityManager / NetworkSecurityManager
+  (FileSecurityManager.cpp:217 pushes groups into process queues)
+plus ProcessCacheManager enriching events with the process tree.
+
+Events are batched per (source, pipeline): the manager accumulates raw
+events briefly and flushes one event group — the columnar-friendly unit.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ...models import PipelineEventGroup
+from ...pipeline.plugin.interface import Input, PluginContext
+from ...utils.logger import get_logger
+from .adapter import (EBPFAdapter, EventSource, RawKernelEvent, get_adapter)
+from .protocol_http import parse_http
+
+log = get_logger("ebpf")
+
+FLUSH_INTERVAL_S = 0.5
+MAX_BATCH_EVENTS = 1024
+
+
+class ProcessCacheManager:
+    """pid → (comm, cmdline) cache with TTL (reference ProcessCacheManager
+    invalidates on exec events; without a kernel driver a short TTL bounds
+    mis-attribution across pid reuse)."""
+
+    TTL_S = 30.0
+    MAX_ENTRIES = 8192
+
+    def __init__(self) -> None:
+        self._cache: Dict[int, tuple] = {}   # pid -> (comm, cmdline, expiry)
+        self._lock = threading.Lock()
+
+    def lookup(self, pid: int) -> tuple:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(pid)
+            if hit is not None and hit[2] > now:
+                return hit[0], hit[1]
+        comm = cmdline = ""
+        try:
+            with open(f"/proc/{pid}/comm") as f:
+                comm = f.read().strip()
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+        except OSError:
+            pass
+        with self._lock:
+            if len(self._cache) >= self.MAX_ENTRIES:
+                # evict expired first; if none, drop the soonest-to-expire half
+                expired = [k for k, v in self._cache.items() if v[2] <= now]
+                for k in expired:
+                    del self._cache[k]
+                if len(self._cache) >= self.MAX_ENTRIES:
+                    by_exp = sorted(self._cache.items(), key=lambda kv: kv[1][2])
+                    for k, _ in by_exp[: self.MAX_ENTRIES // 2]:
+                        del self._cache[k]
+            self._cache[pid] = (comm, cmdline, now + self.TTL_S)
+        return comm, cmdline
+
+
+class _SourceManager:
+    """Per-source accumulation + flush (base of the reference's per-source
+    plugin managers)."""
+
+    def __init__(self, source: EventSource, server: "EBPFServer"):
+        self.source = source
+        self.server = server
+        self.queue_key: Optional[int] = None
+        self._pending: List[RawKernelEvent] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    def on_raw_event(self, ev: RawKernelEvent) -> None:
+        with self._lock:
+            self._pending.append(ev)
+            should_flush = len(self._pending) >= MAX_BATCH_EVENTS
+        if should_flush:
+            self.flush()
+
+    def maybe_flush(self) -> None:
+        if time.monotonic() - self._last_flush >= FLUSH_INTERVAL_S:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+            self._last_flush = time.monotonic()
+        if not pending or self.queue_key is None:
+            return
+        group = self.build_group(pending)
+        if group is not None and not group.empty():
+            pqm = self.server.process_queue_manager
+            if pqm is not None:
+                pqm.push_queue(self.queue_key, group)
+
+    def build_group(self, events: List[RawKernelEvent]
+                    ) -> Optional[PipelineEventGroup]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class NetworkObserverManager(_SourceManager):
+    """L7 parse of captured payloads → LogEvents (reference
+    NetworkObserverManager + protocol parsers)."""
+
+    def build_group(self, events):
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        cache = self.server.process_cache
+        for raw in events:
+            rec = parse_http(raw.payload) if raw.payload else None
+            ev = group.add_log_event(raw.timestamp_ns // 1_000_000_000
+                                     or int(time.time()))
+            comm, _ = cache.lookup(raw.pid)
+            ev.set_content(b"pid", sb.copy_string(str(raw.pid)))
+            if comm:
+                ev.set_content(b"comm", sb.copy_string(comm))
+            ev.set_content(b"local_addr", sb.copy_string(raw.local_addr))
+            ev.set_content(b"remote_addr", sb.copy_string(raw.remote_addr))
+            ev.set_content(b"direction", sb.copy_string(raw.direction))
+            if rec is None:
+                ev.set_content(b"protocol", sb.copy_string(b"raw"))
+                continue
+            ev.set_content(b"protocol", sb.copy_string(b"http"))
+            if rec.kind == "request":
+                ev.set_content(b"method", sb.copy_string(rec.method))
+                ev.set_content(b"path", sb.copy_string(rec.path))
+                if rec.host:
+                    ev.set_content(b"host", sb.copy_string(rec.host))
+            else:
+                ev.set_content(b"status", sb.copy_string(str(rec.status)))
+            if rec.version:
+                ev.set_content(b"http_version", sb.copy_string(rec.version))
+        group.set_tag(b"__source__", b"ebpf_network_observer")
+        return group
+
+
+class SecurityManager(_SourceManager):
+    """Process/file/network security events (reference
+    {Process,File,Network}SecurityManager)."""
+
+    def build_group(self, events):
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        cache = self.server.process_cache
+        for raw in events:
+            ev = group.add_log_event(raw.timestamp_ns // 1_000_000_000
+                                     or int(time.time()))
+            comm, cmdline = cache.lookup(raw.pid)
+            ev.set_content(b"pid", sb.copy_string(str(raw.pid)))
+            ev.set_content(b"call_name", sb.copy_string(raw.call_name))
+            if comm:
+                ev.set_content(b"comm", sb.copy_string(comm))
+            if cmdline:
+                ev.set_content(b"cmdline", sb.copy_string(cmdline))
+            if raw.path:
+                ev.set_content(b"path", sb.copy_string(raw.path))
+            if raw.remote_addr:
+                ev.set_content(b"remote_addr", sb.copy_string(raw.remote_addr))
+        group.set_tag(b"__source__", b"ebpf_" + self.source.value.encode())
+        return group
+
+
+class EBPFServer:
+    _instance: Optional["EBPFServer"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.adapter: EBPFAdapter = get_adapter()
+        self.process_queue_manager = None
+        self.process_cache = ProcessCacheManager()
+        self._managers: Dict[EventSource, _SourceManager] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    @classmethod
+    def instance(cls) -> "EBPFServer":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def enable_plugin(self, source: EventSource, queue_key: int) -> bool:
+        """Singleton per source: a reloaded pipeline reuses its queue key; a
+        second pipeline claiming an active source is a config error."""
+        mgr = self._managers.get(source)
+        if mgr is not None and mgr.queue_key not in (None, queue_key):
+            log.error("ebpf source %s already bound to another pipeline",
+                      source.value)
+            return False
+        if mgr is None:
+            cls = (NetworkObserverManager
+                   if source is EventSource.NETWORK_OBSERVE else SecurityManager)
+            mgr = cls(source, self)
+            self._managers[source] = mgr
+        mgr.queue_key = queue_key
+        ok = self.adapter.start_plugin(source, mgr.on_raw_event)
+        self._ensure_thread()
+        return ok
+
+    def disable_plugin(self, source: EventSource,
+                       queue_key: Optional[int] = None) -> bool:
+        mgr = self._managers.get(source)
+        if mgr is None:
+            return True
+        if queue_key is not None and mgr.queue_key != queue_key:
+            return True  # someone else owns the source now
+        self._managers.pop(source, None)
+        mgr.flush()
+        return self.adapter.stop_plugin(source)
+
+    def _ensure_thread(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="ebpf-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        # stop driver delivery FIRST so no events arrive after the flush
+        for source in list(self._managers):
+            self.adapter.stop_plugin(source)
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+        for mgr in self._managers.values():
+            mgr.flush()
+
+    def _run(self) -> None:
+        while self._running:
+            time.sleep(0.1)
+            for mgr in list(self._managers.values()):
+                try:
+                    mgr.maybe_flush()
+                except Exception:  # noqa: BLE001
+                    log.exception("ebpf flush failed")
+
+
+# ---------------------------------------------------------------------------
+# input plugin shims (reference plugin/input/Input{NetworkObserver,...}.cpp)
+# ---------------------------------------------------------------------------
+
+
+class _EBPFInputBase(Input):
+    source: EventSource = EventSource.NETWORK_OBSERVE
+    is_singleton = True
+
+    def start(self) -> bool:
+        server = EBPFServer.instance()
+        return server.enable_plugin(self.source, self.context.process_queue_key)
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        return EBPFServer.instance().disable_plugin(
+            self.source, self.context.process_queue_key)
+
+
+class InputNetworkObserver(_EBPFInputBase):
+    name = "input_network_observer"
+    source = EventSource.NETWORK_OBSERVE
+
+
+class InputProcessSecurity(_EBPFInputBase):
+    name = "input_process_security"
+    source = EventSource.PROCESS_SECURITY
+
+
+class InputFileSecurity(_EBPFInputBase):
+    name = "input_file_security"
+    source = EventSource.FILE_SECURITY
+
+
+class InputNetworkSecurity(_EBPFInputBase):
+    name = "input_network_security"
+    source = EventSource.NETWORK_SECURITY

@@ -74,6 +74,15 @@ class FileServer:
             self._configs[name] = _ConfigState(name, discovery, queue_key,
                                                tail_existing)
 
+    def update_config_paths(self, name: str, file_paths) -> None:
+        """Replace a registered config's discovery globs (container churn);
+        an empty list drains and prunes all current readers next round."""
+        with self._lock:
+            st = self._configs.get(name)
+            if st is not None:
+                st.poller.config.file_paths = list(file_paths)
+                st.last_discovery = 0.0  # force rediscovery next round
+
     def remove_config(self, name: str) -> None:
         with self._lock:
             st = self._configs.pop(name, None)

@@ -1,0 +1,160 @@
+"""input_forward — gRPC ingest.
+
+Reference: core/forward/GrpcInputManager.h:37,92-108 — per-listen-address
+grpc::Server ownership with refcounting; LoongSuiteForwardService receives
+agent payloads and feeds pipelines.
+
+Service: generic byte-payload forward (method /loongsuite.Forward/Forward)
+accepting either JSON event-group fixtures or raw line payloads; gated on
+grpcio availability (baked into this image).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("forward")
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover
+    grpc = None
+
+
+class _ForwardHandler:
+    """Generic method handler: bytes in → push to the bound queue."""
+
+    def __init__(self, manager: "GrpcInputManager"):
+        self.manager = manager
+
+    def handle(self, data: bytes, pipeline_key: Optional[int]) -> bool:
+        group = self._decode(data)
+        if group is None or pipeline_key is None:
+            return False
+        pqm = self.manager.process_queue_manager
+        return pqm is not None and pqm.push_queue(pipeline_key, group)
+
+    @staticmethod
+    def _decode(data: bytes) -> Optional[PipelineEventGroup]:
+        # JSON fixture groups or newline-delimited raw lines
+        if data[:1] == b"{":
+            try:
+                return PipelineEventGroup.from_json(data.decode("utf-8"))
+            except (ValueError, KeyError):
+                return None
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_raw_event(int(time.time()))
+        ev.set_content(sb.copy_string(data))
+        return group
+
+
+class GrpcInputManager:
+    _instance: Optional["GrpcInputManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._servers: Dict[str, tuple] = {}  # addr -> (server, refcount)
+        self._routes: Dict[str, int] = {}     # addr -> queue key
+        self._lock = threading.Lock()
+        self.process_queue_manager = None
+
+    @classmethod
+    def instance(cls) -> "GrpcInputManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add_listen_input(self, address: str, queue_key: int) -> bool:
+        """One queue key per address: a reloaded pipeline reuses its key and
+        just bumps the refcount; a DIFFERENT pipeline claiming a bound
+        address is a config error (the reference shares servers per address
+        but routes per service — this framework routes per address)."""
+        if grpc is None:
+            log.error("grpcio unavailable; input_forward disabled")
+            return False
+        with self._lock:
+            if address in self._servers:
+                if self._routes.get(address) != queue_key:
+                    log.error("grpc address %s already bound to another "
+                              "pipeline", address)
+                    return False
+                server, ref = self._servers[address]
+                self._servers[address] = (server, ref + 1)
+                return True
+            handler = _ForwardHandler(self)
+
+            def unary(request: bytes, context) -> bytes:
+                ok = handler.handle(request, self._routes.get(address))
+                return b'{"accepted": true}' if ok else b'{"accepted": false}'
+
+            method = grpc.unary_unary_rpc_method_handler(
+                unary,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)
+            service = grpc.method_handlers_generic_handler(
+                "loongsuite.Forward", {"Forward": method})
+            server = grpc.server(
+                thread_pool=__import__("concurrent.futures", fromlist=["f"])
+                .ThreadPoolExecutor(max_workers=4))
+            server.add_generic_rpc_handlers((service,))
+            bound = server.add_insecure_port(address)
+            if bound == 0:
+                log.error("failed to bind grpc address %s", address)
+                return False
+            self._routes[address] = queue_key
+            server.start()
+            self._servers[address] = (server, 1)
+        log.info("grpc forward listening on %s", address)
+        return True
+
+    def remove_listen_input(self, address: str) -> None:
+        with self._lock:
+            entry = self._servers.get(address)
+            if entry is None:
+                return
+            server, ref = entry
+            if ref > 1:
+                self._servers[address] = (server, ref - 1)
+                return
+            del self._servers[address]
+            self._routes.pop(address, None)
+        server.stop(grace=1)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            servers = [s for s, _ in self._servers.values()]
+            self._servers.clear()
+            self._routes.clear()
+        for s in servers:
+            s.stop(grace=1)
+
+
+class InputForward(Input):
+    name = "input_forward"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.address = ""
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.address = config.get("Address", "127.0.0.1:7899")
+        return bool(self.address)
+
+    def start(self) -> bool:
+        mgr = GrpcInputManager.instance()
+        return mgr.add_listen_input(self.address,
+                                    self.context.process_queue_key)
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        GrpcInputManager.instance().remove_listen_input(self.address)
+        return True

@@ -33,9 +33,11 @@ class Plugin:
     def __init__(self) -> None:
         self.context: Optional[PluginContext] = None
         self.metrics_record = None
+        self.config: Dict[str, Any] = {}
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         self.context = context
+        self.config = config
         return True
 
 

@@ -22,6 +22,7 @@ def register_all(registry) -> None:
     from .parse_apsara import ProcessorParseApsara
     from .parse_container_log import ProcessorParseContainerLog
     from .timestamp_filter import ProcessorTimestampFilter
+    from .classify_url import ProcessorClassifyUrl
 
     registry.register_processor("processor_split_log_string_native",
                                 ProcessorSplitLogString)
@@ -49,3 +50,5 @@ def register_all(registry) -> None:
                                 ProcessorParseContainerLog)
     registry.register_processor("processor_timestamp_filter_native",
                                 ProcessorTimestampFilter)
+    registry.register_processor("processor_classify_url_tpu",
+                                ProcessorClassifyUrl)
