@@ -32,6 +32,7 @@ from .pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from .pipeline.pipeline_manager import CollectionPipelineManager
 from .pipeline.queue.process_queue_manager import ProcessQueueManager
 from .pipeline.queue.sender_queue import SenderQueueManager
+from .runner.disk_buffer import DiskBufferWriter
 from .runner.flusher_runner import FlusherRunner
 from .runner.http_sink import HttpSink
 from .runner.processor_runner import ProcessorRunner
@@ -57,8 +58,11 @@ class Application:
         self.pipeline_manager = CollectionPipelineManager(
             self.process_queue_manager, self.sender_queue_manager)
         self.http_sink = HttpSink()
+        self.disk_buffer = DiskBufferWriter(
+            os.path.join(self.data_dir, "buffer"))
         self.flusher_runner = FlusherRunner(self.sender_queue_manager,
-                                            self.http_sink)
+                                            self.http_sink,
+                                            disk_buffer=self.disk_buffer)
         self.processor_runner = ProcessorRunner(
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))
@@ -116,6 +120,7 @@ class Application:
                     self.pipeline_manager.update_pipelines(diff)
                 self.sender_queue_manager.gc_marked()
                 WriteMetrics.instance().gc_deleted()
+                self.disk_buffer.replay(self._resolve_buffered_flusher)
             if once:
                 # drain mode for one-shot runs: wait until queues idle
                 time.sleep(1.0)
@@ -148,6 +153,22 @@ class Application:
             drain=True, timeout=flags.get_flag("exit_flush_timeout"))
         self.http_sink.stop()
         log.info("exit complete")
+
+    def _resolve_buffered_flusher(self, identity: dict):
+        """Find the live flusher matching a spilled payload's identity
+        (plugin_id disambiguates same-type flushers in one pipeline)."""
+        p = self.pipeline_manager.find_pipeline(identity.get("pipeline", ""))
+        if p is None:
+            return None
+        want_id = identity.get("plugin_id", "")
+        for f in p.flushers:
+            if want_id and f.plugin_id == want_id:
+                return f.plugin
+        if not want_id:  # legacy buffers without plugin_id
+            for f in p.flushers:
+                if f.plugin.name == identity.get("flusher_type"):
+                    return f.plugin
+        return None
 
     def _on_limit_breach(self, reason: str) -> None:
         """Sustained resource breach: log critically and exit for the

@@ -107,6 +107,7 @@ class CollectionPipeline:
             if plugin is None:
                 return self._abort_init()
             inst = FlusherInstance(plugin, plugin_id=f"{typ}/{i}")
+            plugin.plugin_id = inst.plugin_id
             self._metric_records.append(inst.metrics)
             plugin.queue_key = next_queue_key()
             self._sender_queue_manager = sender_queue_manager

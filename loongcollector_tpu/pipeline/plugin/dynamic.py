@@ -1,0 +1,148 @@
+"""Dynamic out-of-tree plugins.
+
+Reference: the PluginRegistry loads dynamic C processors via dlopen with a
+versioned `processor_interface_t` vtable (PluginRegistry.cpp:233-290,
+plugin/creator/CProcessor.h) — the cheap generality mechanism replacing the
+reference's Go plugin runtime for long-tail needs (SURVEY.md §7 step 7).
+
+Two loaders:
+  * Python module plugins: `{"Type": "dynamic", "Module": "my_pkg.my_mod",
+    "Class": "MyProcessor"}` — the class implements the Processor interface.
+  * C ABI plugins: a shared library exporting the versioned vtable
+        int  lct_processor_interface_version(void);
+        void* lct_processor_create(const char* json_config);
+        int  lct_processor_process(void* inst, const uint8_t* in, int64_t len,
+                                   uint8_t** out, int64_t* out_len);
+        void lct_processor_free_result(uint8_t* out);
+        void lct_processor_destroy(void* inst);
+    Process I/O is the JSON event-group fixture format (the stable ABI the
+    test hooks already use), loaded with ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import importlib
+import json
+from typing import Any, Dict, Optional
+
+from ...models import PipelineEventGroup
+from ...utils.logger import get_logger
+from .interface import PluginContext, Processor
+
+log = get_logger("dynamic_plugin")
+
+C_ABI_VERSION = 1
+
+
+class DynamicPythonProcessor(Processor):
+    """Wraps a user-provided Processor class from an importable module."""
+
+    name = "processor_dynamic"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inner: Optional[Processor] = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        module_name = config.get("Module", "")
+        class_name = config.get("Class", "")
+        if not module_name or not class_name:
+            return False
+        try:
+            module = importlib.import_module(module_name)
+            cls = getattr(module, class_name)
+            self._inner = cls()
+        except (ImportError, AttributeError) as e:
+            log.error("dynamic plugin %s.%s failed to load: %s",
+                      module_name, class_name, e)
+            return False
+        return self._inner.init(config.get("PluginConfig", {}), context)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        if self._inner is not None:
+            self._inner.process(group)
+
+
+class DynamicCProcessor(Processor):
+    """dlopen'd C-ABI processor (reference DynamicCProcessorProxy)."""
+
+    name = "processor_dynamic_c"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._lib = None
+        self._inst = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        lib_path = config.get("Library", "")
+        if not lib_path:
+            return False
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError as e:
+            log.error("failed to load %s: %s", lib_path, e)
+            return False
+        try:
+            lib.lct_processor_interface_version.restype = ctypes.c_int
+            version = lib.lct_processor_interface_version()
+        except AttributeError:
+            log.error("%s does not export the processor vtable", lib_path)
+            return False
+        if version != C_ABI_VERSION:
+            log.error("%s ABI version %d != %d", lib_path, version,
+                      C_ABI_VERSION)
+            return False
+        lib.lct_processor_create.restype = ctypes.c_void_p
+        lib.lct_processor_create.argtypes = [ctypes.c_char_p]
+        lib.lct_processor_process.restype = ctypes.c_int
+        lib.lct_processor_process.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.lct_processor_free_result.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.lct_processor_destroy.argtypes = [ctypes.c_void_p]
+        cfg_json = json.dumps(config.get("PluginConfig", {})).encode()
+        inst = lib.lct_processor_create(cfg_json)
+        if not inst:
+            return False
+        self._lib = lib
+        self._inst = inst
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        if self._lib is None:
+            return
+        data = group.to_json().encode()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        out_ptr = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_int64(0)
+        rc = self._lib.lct_processor_process(
+            self._inst, buf, len(data), ctypes.byref(out_ptr),
+            ctypes.byref(out_len))
+        if rc != 0 or not out_ptr:
+            return
+        try:
+            out = bytes(bytearray(out_ptr[: out_len.value]))
+            new_group = PipelineEventGroup.from_json(out.decode("utf-8"))
+        except (ValueError, KeyError, UnicodeDecodeError):
+            return
+        finally:
+            self._lib.lct_processor_free_result(out_ptr)
+        # splice the full transformed group back in (events + tags +
+        # metadata — the ABI contract is the whole fixture document)
+        group._events = new_group.events
+        group._columns = None
+        group._source_buffer = new_group.source_buffer
+        group._tags = new_group._tags
+        group._metadata = new_group._metadata
+
+    def __del__(self):
+        if self._lib is not None and self._inst:
+            try:
+                self._lib.lct_processor_destroy(self._inst)
+            except Exception:  # noqa: BLE001
+                pass

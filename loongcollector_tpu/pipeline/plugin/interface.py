@@ -79,6 +79,16 @@ class Flusher(Plugin):
         super().__init__()
         self.queue_key: int = 0
         self.sender_queue = None
+        self.plugin_id: str = ""  # set by the pipeline: "<type>/<index>"
+
+    def spill_identity(self) -> Dict[str, str]:
+        """Identity persisted with disk-buffered payloads; must uniquely
+        address this flusher instance within its pipeline."""
+        return {
+            "pipeline": getattr(self.context, "pipeline_name", ""),
+            "flusher_type": self.name,
+            "plugin_id": self.plugin_id,
+        }
 
     def send(self, group: PipelineEventGroup) -> bool:  # pragma: no cover
         raise NotImplementedError

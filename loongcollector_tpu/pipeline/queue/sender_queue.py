@@ -25,7 +25,8 @@ class SendingStatus(enum.Enum):
 
 class SenderQueueItem:
     __slots__ = ("data", "raw_size", "flusher", "queue_key", "status",
-                 "enqueue_time", "try_count", "last_send_time", "tag")
+                 "enqueue_time", "try_count", "last_send_time", "tag",
+                 "in_flight")
 
     def __init__(self, data: bytes, raw_size: int, flusher=None,
                  queue_key: int = 0, tag: Optional[dict] = None):
@@ -38,6 +39,7 @@ class SenderQueueItem:
         self.try_count = 0
         self.last_send_time = 0.0
         self.tag = tag or {}
+        self.in_flight = False
 
 
 class SenderQueue:

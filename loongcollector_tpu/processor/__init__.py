@@ -23,6 +23,8 @@ def register_all(registry) -> None:
     from .parse_container_log import ProcessorParseContainerLog
     from .timestamp_filter import ProcessorTimestampFilter
     from .classify_url import ProcessorClassifyUrl
+    from ..pipeline.plugin.dynamic import (DynamicCProcessor,
+                                           DynamicPythonProcessor)
 
     registry.register_processor("processor_split_log_string_native",
                                 ProcessorSplitLogString)
@@ -52,3 +54,5 @@ def register_all(registry) -> None:
                                 ProcessorTimestampFilter)
     registry.register_processor("processor_classify_url_tpu",
                                 ProcessorClassifyUrl)
+    registry.register_processor("processor_dynamic", DynamicPythonProcessor)
+    registry.register_processor("processor_dynamic_c", DynamicCProcessor)

@@ -1,0 +1,142 @@
+"""Disk buffering of failed/exiting send payloads.
+
+Reference: core/plugin/flusher/sls/DiskBufferWriter.h:56,92 — serialized
+payloads that cannot be sent (endpoint down, agent exiting) spill to disk
+and replay on recovery; FlusherRunner spills SLS items at exit
+(FlusherRunner.cpp:223-227, enable_full_drain_mode).
+
+Format: one file per payload under <dir>/buffer_<ts>_<seq>.lcb with a JSON
+header line (flusher identity + raw size + metadata) followed by the
+compressed payload bytes.  Replay re-enqueues through the live flusher of
+the same pipeline/plugin identity when it exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, List, Optional, Tuple
+
+from ..pipeline.queue.sender_queue import SenderQueueItem
+from ..utils.logger import get_logger
+
+log = get_logger("disk_buffer")
+
+MAX_BUFFER_BYTES = 512 * 1024 * 1024
+
+
+class DiskBufferWriter:
+    def __init__(self, directory: str,
+                 max_bytes: int = MAX_BUFFER_BYTES):
+        self.directory = directory
+        self.max_bytes = max_bytes
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._run_id = uuid.uuid4().hex[:8]  # filenames unique across restarts
+        self._total = None  # lazily-initialized running byte total
+
+    # -- write --------------------------------------------------------------
+
+    def spill(self, item: SenderQueueItem, identity: dict) -> bool:
+        """Persist one sender item.  identity: whatever the flusher needs to
+        reclaim the payload (pipeline name, flusher type, plugin id...)."""
+        os.makedirs(self.directory, exist_ok=True)
+        with self._lock:
+            if self._total is None:
+                self._total = self._scan_size()
+            if self._total + len(item.data) > self.max_bytes:
+                log.warning("disk buffer full; dropping payload (%d bytes)",
+                            len(item.data))
+                return False
+            self._total += len(item.data)
+            self._seq += 1
+            name = (f"buffer_{int(time.time())}_{self._run_id}"
+                    f"_{self._seq}.lcb")
+        header = dict(identity)
+        header["raw_size"] = item.raw_size
+        header["enqueue_time"] = time.time()
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(item.data)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.error("disk buffer write failed: %s", e)
+            with self._lock:
+                if self._total is not None:
+                    self._total -= len(item.data)
+            return False
+        return True
+
+    # -- read / replay ------------------------------------------------------
+
+    def pending(self) -> List[str]:
+        try:
+            return sorted(os.path.join(self.directory, f)
+                          for f in os.listdir(self.directory)
+                          if f.endswith(".lcb"))
+        except OSError:
+            return []
+
+    def read(self, path: str) -> Optional[Tuple[dict, bytes]]:
+        try:
+            with open(path, "rb") as f:
+                header = json.loads(f.readline())
+                payload = f.read()
+            return header, payload
+        except (OSError, ValueError):
+            return None
+
+    def replay(self, resolve: Callable[[dict], Optional[object]],
+               limit: int = 100) -> int:
+        """Re-enqueue up to `limit` buffered payloads.  `resolve(identity)`
+        returns the live flusher (with .sender_queue and .queue_key) or None
+        if the pipeline no longer exists (payload is kept for later)."""
+        count = 0
+        # scan ALL pending files but count only replayed ones toward the
+        # limit — otherwise >limit unresolvable old files would starve every
+        # newer payload forever
+        for path in self.pending():
+            if count >= limit:
+                break
+            entry = self.read(path)
+            if entry is None:
+                self._remove(path)  # corrupt file
+                continue
+            header, payload = entry
+            flusher = resolve(header)
+            if flusher is None or flusher.sender_queue is None:
+                continue
+            item = SenderQueueItem(payload, header.get("raw_size", len(payload)),
+                                   flusher=flusher,
+                                   queue_key=flusher.queue_key)
+            flusher.sender_queue.push(item)
+            self._remove(path)
+            count += 1
+        if count:
+            log.info("replayed %d buffered payloads", count)
+        return count
+
+    def _remove(self, path: str) -> None:
+        try:
+            size = os.path.getsize(path)
+            os.remove(path)
+        except OSError:
+            return
+        with self._lock:
+            if self._total is not None:
+                self._total = max(0, self._total - size)
+
+    def _scan_size(self) -> int:
+        total = 0
+        for path in self.pending():
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total

@@ -25,14 +25,16 @@ log = get_logger("flusher_runner")
 
 RETRY_BASE_S = 0.1
 RETRY_MAX_S = 10.0
+MAX_TRY_BEFORE_SPILL = 20  # persistent failure → disk buffer (if configured)
 
 
 class FlusherRunner:
     def __init__(self, sender_queue_manager: SenderQueueManager,
                  http_sink: Optional[HttpSink] = None,
-                 max_bytes_per_sec: int = 0):
+                 max_bytes_per_sec: int = 0, disk_buffer=None):
         self.sqm = sender_queue_manager
         self.http_sink = http_sink
+        self.disk_buffer = disk_buffer
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self.rate_limiter = RateLimiter(max_bytes_per_sec)
@@ -59,6 +61,21 @@ class FlusherRunner:
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        # exit spill: whatever could not drain in the budget persists to disk
+        # (reference FlusherRunner.cpp:223-227 full-drain/spill on exit).
+        # Items still in-flight in the HTTP sink are skipped — their pending
+        # send may yet succeed, and spilling them would double-deliver.
+        if self.disk_buffer is not None:
+            for q in list(self.sqm._queues.values()):
+                with q._lock:
+                    items = [i for i in q._items
+                             if not getattr(i, "in_flight", False)]
+                for item in items:
+                    flusher = item.flusher
+                    if flusher is None:
+                        continue
+                    if self.disk_buffer.spill(item, flusher.spill_identity()):
+                        q.remove(item)
 
     def _run(self) -> None:
         while self._running:
@@ -98,10 +115,12 @@ class FlusherRunner:
             self._release_limiters(item)
             self._backoff_retry(item)
             return
+        item.in_flight = True
         self.http_sink.add_request(
             request, lambda status, body, it=item: self._on_done(it, status, body))
 
     def _on_done(self, item: SenderQueueItem, status: int, body: bytes) -> None:
+        item.in_flight = False
         flusher = item.flusher
         q = self.sqm.get_queue(item.queue_key)
         verdict = "drop"
@@ -119,6 +138,14 @@ class FlusherRunner:
         elif verdict != "retry":
             pass  # queue deleted: item dropped below
         if verdict == "retry":
+            if (self.disk_buffer is not None
+                    and item.try_count >= MAX_TRY_BEFORE_SPILL
+                    and flusher is not None):
+                # persistent failure: spill to disk and free the queue slot
+                # (reference DiskBufferWriter semantics)
+                if self.disk_buffer.spill(item, flusher.spill_identity()):
+                    self.sqm.remove_item(item)
+                    return
             self._backoff_retry(item)
             return
         self.out_items.add(1)

@@ -95,3 +95,50 @@ class TestTaskPipelines:
         diff2.removed.append("t1")
         mgr.update_tasks(diff2)
         assert events == ["start", "stop"]
+
+
+class TestDiskBuffer:
+    def test_spill_and_replay(self, tmp_path):
+        from loongcollector_tpu.pipeline.queue.sender_queue import (
+            SenderQueue, SenderQueueItem)
+        from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+
+        buf = DiskBufferWriter(str(tmp_path / "buffer"))
+        item = SenderQueueItem(b"payload-bytes", raw_size=100)
+        assert buf.spill(item, {"pipeline": "p1", "flusher_type": "flusher_sls"})
+        assert len(buf.pending()) == 1
+
+        class FakeFlusher:
+            name = "flusher_sls"
+            queue_key = 5
+            sender_queue = SenderQueue(5)
+
+        flusher = FakeFlusher()
+
+        def resolve(identity):
+            assert identity["pipeline"] == "p1"
+            return flusher
+
+        assert buf.replay(resolve) == 1
+        assert buf.pending() == []
+        items = flusher.sender_queue.get_available_items(10)
+        assert items[0].data == b"payload-bytes"
+        assert items[0].raw_size == 100
+
+    def test_replay_keeps_unresolvable(self, tmp_path):
+        from loongcollector_tpu.pipeline.queue.sender_queue import \
+            SenderQueueItem
+        from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+        buf = DiskBufferWriter(str(tmp_path / "buffer"))
+        buf.spill(SenderQueueItem(b"x", 1), {"pipeline": "gone"})
+        assert buf.replay(lambda i: None) == 0
+        assert len(buf.pending()) == 1  # kept for later
+
+    def test_corrupt_file_removed(self, tmp_path):
+        from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+        d = tmp_path / "buffer"
+        d.mkdir()
+        (d / "buffer_1_1.lcb").write_bytes(b"not json\xff")
+        buf = DiskBufferWriter(str(d))
+        buf.replay(lambda i: None)
+        assert buf.pending() == []

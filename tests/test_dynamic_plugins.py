@@ -1,0 +1,99 @@
+"""Dynamic plugin loading: Python module plugins + the versioned C ABI."""
+
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from loongcollector_tpu.pipeline.plugin.dynamic import (DynamicCProcessor,
+                                                        DynamicPythonProcessor)
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+
+from test_processors import CTX, split_group
+
+
+class TestDynamicPython:
+    def test_load_and_process(self, tmp_path, monkeypatch):
+        mod_dir = tmp_path / "userplugins"
+        mod_dir.mkdir()
+        (mod_dir / "my_plugin.py").write_text(textwrap.dedent("""
+            from loongcollector_tpu.pipeline.plugin.interface import Processor
+
+            class Upper(Processor):
+                name = "upper"
+
+                def process(self, group):
+                    sb = group.source_buffer
+                    for ev in group.events:
+                        v = ev.get_content(b"content")
+                        if v is not None:
+                            ev.set_content(b"content",
+                                           sb.copy_string(v.to_bytes().upper()))
+        """))
+        monkeypatch.syspath_prepend(str(mod_dir))
+        p = DynamicPythonProcessor()
+        assert p.init({"Module": "my_plugin", "Class": "Upper"}, CTX)
+        g = split_group(b"hello\n")
+        g.materialize()
+        p.process(g)
+        assert g.events[0].get_content(b"content") == b"HELLO"
+
+    def test_missing_module_fails_cleanly(self):
+        p = DynamicPythonProcessor()
+        assert not p.init({"Module": "no.such.module", "Class": "X"}, CTX)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+class TestDynamicCABI:
+    def test_c_plugin_roundtrip(self, tmp_path):
+        src = tmp_path / "plugin.cpp"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            #include <cstring>
+            #include <cstdlib>
+            #include <string>
+
+            extern "C" {
+            int lct_processor_interface_version() { return 1; }
+
+            void* lct_processor_create(const char* cfg) {
+                return new std::string(cfg ? cfg : "");
+            }
+
+            // naive transform: replace "error" with "ERROR" in the group json
+            int lct_processor_process(void* inst, const uint8_t* in,
+                                      int64_t len, uint8_t** out,
+                                      int64_t* out_len) {
+                std::string s(reinterpret_cast<const char*>(in), len);
+                size_t pos = 0;
+                while ((pos = s.find("error", pos)) != std::string::npos) {
+                    s.replace(pos, 5, "ERROR");
+                    pos += 5;
+                }
+                *out = static_cast<uint8_t*>(malloc(s.size()));
+                memcpy(*out, s.data(), s.size());
+                *out_len = static_cast<int64_t>(s.size());
+                return 0;
+            }
+
+            void lct_processor_free_result(uint8_t* out) { free(out); }
+            void lct_processor_destroy(void* inst) {
+                delete static_cast<std::string*>(inst);
+            }
+            }
+        """))
+        so = tmp_path / "libplugin.so"
+        subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
+                        str(src)], check=True)
+        p = DynamicCProcessor()
+        assert p.init({"Library": str(so)}, CTX)
+        g = split_group(b"an error occurred\n")
+        g.materialize()
+        p.process(g)
+        assert g.events[0].get_content(b"content") == b"an ERROR occurred"
+
+    def test_bad_library_rejected(self, tmp_path):
+        p = DynamicCProcessor()
+        assert not p.init({"Library": "/nonexistent.so"}, CTX)
