@@ -11,6 +11,7 @@ Run: python -m loongcollector_tpu --config <dir> [--once]
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import sys
@@ -18,6 +19,7 @@ import threading
 import time
 
 from .config.common_provider import CommonConfigProvider
+from .config.onetime import OnetimeConfigInfoManager
 from .config.watcher import PipelineConfigWatcher
 from .input.file.file_server import FileServer
 from .input.host_monitor import HostMonitorInputRunner
@@ -37,6 +39,7 @@ from .runner.flusher_runner import FlusherRunner
 from .runner.http_sink import HttpSink
 from .runner.processor_runner import ProcessorRunner
 from .utils import flags
+from .utils.crash_backtrace import check_previous_crash, init_crash_backtrace
 from .utils.logger import get_logger
 
 log = get_logger("application")
@@ -53,6 +56,9 @@ class Application:
         self.config_dir = config_dir
         self.data_dir = data_dir or os.path.join(
             os.path.expanduser("~"), ".loongcollector_tpu")
+        # app-level config overrides flags and must load BEFORE any
+        # component reads them (thread counts, config server address...)
+        self._load_app_config()
         self.process_queue_manager = ProcessQueueManager()
         self.sender_queue_manager = SenderQueueManager()
         self.pipeline_manager = CollectionPipelineManager(
@@ -76,8 +82,34 @@ class Application:
             on_limit_breach=self._on_limit_breach)
         self._sig_stop = threading.Event()
 
+    def _load_app_config(self) -> None:
+        """Agent-level config file (reference loongcollector_config.json +
+        AppConfig): a flat dict of flag overrides in the data or config
+        dir."""
+        for d in (self.data_dir, self.config_dir):
+            path = os.path.join(d, "loongcollector_config.json")
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path) as f:
+                    overrides = json.load(f)
+            except (OSError, ValueError) as e:
+                log.error("bad app config %s: %s", path, e)
+                continue
+            for k, v in overrides.items():
+                if flags.has_flag(k):
+                    flags.set_flag(k, v)
+                    log.info("app config: %s = %r", k, v)
+            return
+
     def init(self) -> None:
         os.makedirs(self.data_dir, exist_ok=True)
+        check_previous_crash(self.data_dir)
+        init_crash_backtrace(self.data_dir)
+        self.onetime_manager = OnetimeConfigInfoManager(
+            os.path.join(self.data_dir, "onetime_state.json"))
+        self.onetime_manager.load()
+        self.pipeline_manager.onetime_manager = self.onetime_manager
         # warm the native library (and its one-shot build) here so the first
         # data batch never stalls behind a compiler invocation
         from . import native as _native
@@ -121,6 +153,8 @@ class Application:
                 self.sender_queue_manager.gc_marked()
                 WriteMetrics.instance().gc_deleted()
                 self.disk_buffer.replay(self._resolve_buffered_flusher)
+                self.pipeline_manager.check_onetime_completion(
+                    self.process_queue_manager, self.sender_queue_manager)
             if once:
                 # drain mode for one-shot runs: wait until queues idle
                 time.sleep(1.0)

@@ -1,0 +1,75 @@
+"""Onetime config lifecycle.
+
+Reference: core/config/OnetimeConfigInfoManager.cpp + Application.cpp:309-321
+— one-shot jobs (static file imports) are tracked by config content hash
+with an expiry; finished/expired configs are not re-run on restart and are
+eventually dropped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+DEFAULT_TTL_S = 24 * 3600.0
+
+
+def config_hash(config: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(config, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class OnetimeConfigInfoManager:
+    def __init__(self, state_path: str = "", ttl_s: float = DEFAULT_TTL_S):
+        self.state_path = state_path
+        self.ttl_s = ttl_s
+        self._done: Dict[str, float] = {}  # hash -> completion time
+        self._lock = threading.Lock()
+
+    def load(self) -> None:
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path) as f:
+                self._done = {k: float(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            self._done = {}
+
+    def dump(self) -> None:
+        if not self.state_path:
+            return
+        with self._lock:
+            data = dict(self._done)
+        tmp = self.state_path + ".tmp"
+        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.state_path)
+
+    def already_ran(self, config: dict) -> bool:
+        h = config_hash(config)
+        with self._lock:
+            return h in self._done
+
+    def mark_done(self, config: dict) -> None:
+        with self._lock:
+            self._done[config_hash(config)] = time.time()
+        self.dump()
+
+    def gc_expired(self) -> int:
+        """Drops completion records older than the TTL.  NOT called at
+        startup: a record must outlive any copy of its config file on disk,
+        or a restart would re-run the import (duplicate data).  Intended for
+        explicit cleanup once the config files themselves are gone."""
+        cutoff = time.time() - self.ttl_s
+        with self._lock:
+            stale = [h for h, t in self._done.items() if t < cutoff]
+            for h in stale:
+                del self._done[h]
+        if stale:
+            self.dump()
+        return len(stale)

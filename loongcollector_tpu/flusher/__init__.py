@@ -7,9 +7,11 @@ def register_all(registry) -> None:
     from .stdout import FlusherStdout
     from .http import FlusherHTTP
     from .sls import FlusherSLS
+    from .kafka import FlusherKafka
 
     registry.register_flusher("flusher_stdout", FlusherStdout)
     registry.register_flusher("flusher_file", FlusherFile)
     registry.register_flusher("flusher_blackhole", FlusherBlackHole)
     registry.register_flusher("flusher_http", FlusherHTTP)
     registry.register_flusher("flusher_sls", FlusherSLS)
+    registry.register_flusher("flusher_kafka", FlusherKafka)

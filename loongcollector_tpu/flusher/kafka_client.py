@@ -1,0 +1,373 @@
+"""Minimal Kafka wire-protocol producer (no external client library).
+
+Reference: core/plugin/flusher/kafka/KafkaProducer.cpp uses librdkafka; this
+image has no Kafka client, so the producer speaks the public wire protocol
+directly: Metadata (v1) for leader discovery and Produce (v3) with record
+batches (magic v2, varint-framed records, CRC32C over the batch body).
+
+Scope: plaintext brokers, acks=all/1, gzip-free (compression handled at the
+payload level by the pipeline when desired), single in-flight request per
+connection.  CRC32C comes from the native library when present, else a
+Python table fallback.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logger import get_logger
+
+log = get_logger("kafka")
+
+API_PRODUCE = 0
+API_METADATA = 3
+
+
+# ---------------------------------------------------------------------------
+# CRC32C
+# ---------------------------------------------------------------------------
+
+_crc_table: Optional[List[int]] = None
+
+
+def _crc32c_py(data: bytes, seed: int = 0) -> int:
+    global _crc_table
+    if _crc_table is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+            table.append(crc)
+        _crc_table = table
+    crc = seed ^ 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _crc_table[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes) -> int:
+    try:
+        import ctypes
+
+        import numpy as np
+
+        from ..native import get_lib
+        lib = get_lib()
+        if lib is not None:
+            if not hasattr(lib, "_crc_configured"):
+                lib.lct_crc32c.restype = ctypes.c_uint32
+                lib.lct_crc32c.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                           ctypes.c_int64, ctypes.c_uint32]
+                lib._crc_configured = True
+            arr = np.frombuffer(data, dtype=np.uint8)
+            return int(lib.lct_crc32c(
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                len(arr), 0))
+    except Exception:  # noqa: BLE001
+        pass
+    return _crc32c_py(data)
+
+
+# ---------------------------------------------------------------------------
+# wire helpers
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    """Kafka zigzag varint."""
+    z = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    data = s.encode()
+    return struct.pack(">h", len(data)) + data
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def i16(self) -> int:
+        v = struct.unpack_from(">h", self.data, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def i32(self) -> int:
+        v = struct.unpack_from(">i", self.data, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from(">q", self.data, self.pos)[0]
+        self.pos += 8
+        return v
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        v = self.data[self.pos : self.pos + n].decode()
+        self.pos += n
+        return v
+
+    def array(self, fn):
+        return [fn() for _ in range(self.i32())]
+
+
+# ---------------------------------------------------------------------------
+# record batch v2
+# ---------------------------------------------------------------------------
+
+
+def build_record_batch(records: List[Tuple[Optional[bytes], bytes]],
+                       base_ts_ms: Optional[int] = None) -> bytes:
+    """records: [(key, value)] → one magic-v2 record batch."""
+    now = base_ts_ms if base_ts_ms is not None else int(time.time() * 1000)
+    body = bytearray()
+    for i, (key, value) in enumerate(records):
+        rec = bytearray()
+        rec += b"\x00"                      # attributes
+        rec += _varint(0)                   # timestamp delta
+        rec += _varint(i)                   # offset delta
+        if key is None:
+            rec += _varint(-1)
+        else:
+            rec += _varint(len(key)) + key
+        rec += _varint(len(value)) + value
+        rec += _varint(0)                   # headers count
+        body += _varint(len(rec)) + rec
+
+    n = len(records)
+    # batch body after the CRC field
+    after_crc = bytearray()
+    after_crc += struct.pack(">h", 0)       # attributes (no compression)
+    after_crc += struct.pack(">i", n - 1)   # last offset delta
+    after_crc += struct.pack(">q", now)     # first timestamp
+    after_crc += struct.pack(">q", now)     # max timestamp
+    after_crc += struct.pack(">q", -1)      # producer id
+    after_crc += struct.pack(">h", -1)      # producer epoch
+    after_crc += struct.pack(">i", -1)      # base sequence
+    after_crc += struct.pack(">i", n)       # record count
+    after_crc += body
+
+    crc = crc32c(bytes(after_crc))
+    batch = bytearray()
+    batch += struct.pack(">q", 0)           # base offset
+    batch_len = 4 + 1 + 4 + len(after_crc)  # partition leader epoch..end
+    batch += struct.pack(">i", batch_len)
+    batch += struct.pack(">i", -1)          # partition leader epoch
+    batch += struct.pack(">b", 2)           # magic
+    batch += struct.pack(">I", crc)
+    batch += after_crc
+    return bytes(batch)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class KafkaError(Exception):
+    pass
+
+
+class KafkaProducer:
+    def __init__(self, brokers: List[str], client_id: str = "loongcollector-tpu",
+                 acks: int = -1, timeout_ms: int = 10000):
+        self.brokers = brokers
+        self.client_id = client_id
+        self.acks = acks
+        self.timeout_ms = timeout_ms
+        self._corr = 0
+        self._conns: Dict[str, socket.socket] = {}
+        # topic -> [(partition, leader "host:port")]
+        self._topic_meta: Dict[str, List[Tuple[int, str]]] = {}
+        self._rr: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- transport ----------------------------------------------------------
+
+    def _connect(self, addr: str) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is not None:
+            return sock
+        host, _, port = addr.rpartition(":")
+        sock = socket.create_connection((host, int(port or 9092)), timeout=10)
+        self._conns[addr] = sock
+        return sock
+
+    def _drop(self, addr: str) -> None:
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _request(self, addr: str, api_key: int, api_version: int,
+                 payload: bytes, expect_response: bool = True
+                 ) -> Optional[bytes]:
+        self._corr += 1
+        header = (struct.pack(">hhi", api_key, api_version, self._corr)
+                  + _str(self.client_id))
+        msg = header + payload
+        sock = self._connect(addr)
+        try:
+            sock.sendall(struct.pack(">i", len(msg)) + msg)
+            if not expect_response:
+                return None
+            raw = self._read_exact(sock, 4)
+            size = struct.unpack(">i", raw)[0]
+            resp = self._read_exact(sock, size)
+        except OSError as e:
+            self._drop(addr)
+            raise KafkaError(f"broker {addr}: {e}") from e
+        corr = struct.unpack(">i", resp[:4])[0]
+        if corr != self._corr:
+            self._drop(addr)
+            raise KafkaError("correlation id mismatch")
+        return resp[4:]
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("connection closed")
+            buf += chunk
+        return bytes(buf)
+
+    # -- metadata -----------------------------------------------------------
+
+    def refresh_metadata(self, topic: str) -> None:
+        payload = struct.pack(">i", 1) + _str(topic)
+        last_err = None
+        for addr in self.brokers:
+            try:
+                resp = self._request(addr, API_METADATA, 1, payload)
+            except KafkaError as e:
+                last_err = e
+                continue
+            r = _Reader(resp)
+            brokers = {}
+            for _ in range(r.i32()):
+                node = r.i32()
+                host = r.string()
+                port = r.i32()
+                r.string()  # rack
+                brokers[node] = f"{host}:{port}"
+            r.i32()  # controller id (v1 layout: brokers, controller, topics)
+            parts: List[Tuple[int, str]] = []
+            for _ in range(r.i32()):
+                r.i16()          # topic error
+                r.string()       # topic name
+                r.data[r.pos]    # is_internal (bool)
+                r.pos += 1
+                for _ in range(r.i32()):
+                    r.i16()      # partition error
+                    pid = r.i32()
+                    leader = r.i32()
+                    r.array(r.i32)   # replicas
+                    r.array(r.i32)   # isr
+                    if leader in brokers:
+                        parts.append((pid, brokers[leader]))
+            if parts:
+                with self._lock:
+                    self._topic_meta[topic] = sorted(parts)
+                return
+        raise last_err or KafkaError("no brokers reachable")
+
+    # -- produce ------------------------------------------------------------
+
+    def _pick_partition(self, topic: str, key: Optional[bytes],
+                        nparts: int) -> int:
+        """Keyed records hash to a stable partition (per-key ordering);
+        unkeyed records round-robin."""
+        if key:
+            import hashlib
+            return int.from_bytes(
+                hashlib.md5(key).digest()[:4], "big") % nparts
+        idx = self._rr.get(topic, 0)
+        self._rr[topic] = idx + 1
+        return idx % nparts
+
+    def send(self, topic: str,
+             records: List[Tuple[Optional[bytes], bytes]]) -> None:
+        with self._lock:
+            parts = self._topic_meta.get(topic)
+        if not parts:
+            self.refresh_metadata(topic)
+            with self._lock:
+                parts = self._topic_meta.get(topic, [])
+        if not parts:
+            raise KafkaError(f"no partitions for topic {topic}")
+        leaders = dict(parts)
+        nparts = len(parts)
+        by_partition: Dict[int, List[Tuple[Optional[bytes], bytes]]] = {}
+        for key, value in records:
+            pid = self._pick_partition(topic, key, nparts)
+            by_partition.setdefault(pid, []).append((key, value))
+        for partition, recs in by_partition.items():
+            leader = leaders.get(partition)
+            if leader is None:
+                raise KafkaError(f"no leader for {topic}/{partition}")
+            self._send_one(topic, partition, leader, recs)
+
+    def _send_one(self, topic: str, partition: int, leader: str,
+                  records) -> None:
+        batch = build_record_batch(records)
+        # ProduceRequest v3: transactional_id, acks, timeout, topic_data
+        payload = (_str(None)
+                   + struct.pack(">h", self.acks)
+                   + struct.pack(">i", self.timeout_ms)
+                   + struct.pack(">i", 1) + _str(topic)
+                   + struct.pack(">i", 1) + struct.pack(">i", partition)
+                   + _bytes(batch))
+        try:
+            resp = self._request(leader, API_PRODUCE, 3, payload,
+                                 expect_response=(self.acks != 0))
+        except KafkaError:
+            with self._lock:
+                self._topic_meta.pop(topic, None)  # stale leader: refetch
+            raise
+        if resp is None:  # acks=0: fire and forget
+            return
+        r = _Reader(resp)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()          # partition
+                err = r.i16()
+                r.i64()          # base offset
+                if err != 0:
+                    with self._lock:
+                        self._topic_meta.pop(topic, None)
+                    raise KafkaError(f"produce error code {err}")
+
+    def close(self) -> None:
+        for addr in list(self._conns):
+            self._drop(addr)

@@ -32,6 +32,8 @@ class CollectionPipelineManager:
         self._lock = threading.Lock()
         self.process_queue_manager = process_queue_manager
         self.sender_queue_manager = sender_queue_manager
+        self.onetime_manager = None  # OnetimeConfigInfoManager when wired
+        self._pending_onetime: Dict[str, dict] = {}
 
     def update_pipelines(self, diff: ConfigDiff) -> None:
         for name in diff.removed:
@@ -45,6 +47,10 @@ class CollectionPipelineManager:
                     del self._pipelines[name]
                 log.info("pipeline %s removed", name)
         for name, cfg in list(diff.modified.items()) + list(diff.added.items()):
+            if self._is_onetime(cfg) and self.onetime_manager is not None \
+                    and self.onetime_manager.already_ran(cfg):
+                log.info("onetime config %s already completed; skipping", name)
+                continue
             old = self._pipelines.get(name)
             if old is not None:
                 old.stop(is_removing=False)
@@ -70,6 +76,42 @@ class CollectionPipelineManager:
                 self._pipelines[name] = p
             p.start()
             log.info("pipeline %s %s", name, "updated" if old else "started")
+            if self._is_onetime(cfg) and self.onetime_manager is not None:
+                # ingestion finished inside start(), but completion is only
+                # durable once the data has drained through the pipeline —
+                # check_onetime_completion() marks it then
+                self._pending_onetime[name] = cfg
+
+    def check_onetime_completion(self, process_queue_manager,
+                                 sender_queue_manager=None) -> None:
+        """Marks pending onetime configs done once their queues drained
+        (at-least-once: a crash before this point re-runs the import)."""
+        if not self._pending_onetime or self.onetime_manager is None:
+            return
+        for name, cfg in list(self._pending_onetime.items()):
+            p = self.find_pipeline(name)
+            if p is None:
+                del self._pending_onetime[name]
+                continue
+            q = (process_queue_manager.get_queue(p.process_queue_key)
+                 if process_queue_manager else None)
+            if q is not None and not q.empty():
+                continue
+            if not p.wait_all_items_in_process_finished(timeout=0):
+                continue
+            p.flush_batch()
+            if sender_queue_manager is not None and \
+                    not sender_queue_manager.all_empty():
+                continue
+            self.onetime_manager.mark_done(cfg)
+            del self._pending_onetime[name]
+            log.info("onetime config %s completed and recorded", name)
+
+    @staticmethod
+    def _is_onetime(cfg: dict) -> bool:
+        inputs = cfg.get("inputs", [])
+        return bool(inputs) and all(
+            str(i.get("Type", "")).endswith("_onetime") for i in inputs)
 
     def find_pipeline(self, name: str) -> Optional[CollectionPipeline]:
         with self._lock:

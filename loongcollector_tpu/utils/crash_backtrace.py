@@ -1,0 +1,51 @@
+"""Crash backtrace capture & restart reporting.
+
+Reference: core/common/CrashBackTraceUtil.cpp + Application.cpp:146-154 —
+a crash writes the backtrace to a file; the next start finds it, raises the
+restart alarm with the trace, and archives it.
+
+Python implementation: `faulthandler` streams fatal-signal tracebacks into
+<data_dir>/backtrace.log; `check_previous_crash` runs at startup.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+from typing import Optional
+
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from .logger import get_logger
+
+log = get_logger("crash")
+
+_trace_file = None  # keep the fd alive for faulthandler
+
+
+def init_crash_backtrace(data_dir: str) -> None:
+    global _trace_file
+    path = os.path.join(data_dir, "backtrace.log")
+    os.makedirs(data_dir, exist_ok=True)
+    _trace_file = open(path, "w")
+    faulthandler.enable(file=_trace_file)
+
+
+def check_previous_crash(data_dir: str) -> Optional[str]:
+    """If the last run crashed, report it and archive the trace."""
+    path = os.path.join(data_dir, "backtrace.log")
+    try:
+        with open(path) as f:
+            trace = f.read().strip()
+    except OSError:
+        return None
+    if not trace:
+        return None
+    log.error("previous run crashed:\n%s", trace[:2000])
+    AlarmManager.instance().send_alarm(
+        AlarmType.AGENT_RESTART, "agent restarted after crash",
+        AlarmLevel.CRITICAL)
+    try:
+        os.replace(path, path + ".last")
+    except OSError:
+        pass
+    return trace

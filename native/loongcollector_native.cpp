@@ -171,3 +171,32 @@ int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli) — required by Kafka record-batch v2 framing.
+// Table-driven; table built on first use.
+// ---------------------------------------------------------------------------
+static uint32_t crc32c_table[256];
+static bool crc32c_ready = false;
+
+static void crc32c_init() {
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; ++j)
+            crc = (crc >> 1) ^ (0x82F63B78u & (~(crc & 1) + 1));
+        crc32c_table[i] = crc;
+    }
+    crc32c_ready = true;
+}
+
+uint32_t lct_crc32c(const uint8_t* data, int64_t len, uint32_t seed) {
+    if (!crc32c_ready) crc32c_init();
+    uint32_t crc = seed ^ 0xFFFFFFFFu;
+    for (int64_t i = 0; i < len; ++i)
+        crc = (crc >> 8) ^ crc32c_table[(crc ^ data[i]) & 0xFF];
+    return crc ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"
