@@ -110,6 +110,44 @@ class Application:
             os.path.join(self.data_dir, "onetime_state.json"))
         self.onetime_manager.load()
         self.pipeline_manager.onetime_manager = self.onetime_manager
+        from .input.file.checkpoint_v2 import get_default_manager
+        eo_mgr = get_default_manager(
+            os.path.join(self.data_dir, "checkpoint_v2.db"))
+        # snapshot uncommitted ranges NOW — before any pipeline starts and
+        # new sends INSERT OR REPLACE over the same slot keys
+        self._eo_pending = list(eo_mgr.uncommitted()) if eo_mgr else []
+        # EO ranges subsume reader offsets: bump v1 checkpoints past every
+        # uncommitted range BEFORE any reader opens, so the normal tail path
+        # never re-reads bytes the EO replay will re-inject (that overlap
+        # would double-deliver after a hard crash).
+        if self._eo_pending:
+            from .input.file.reader import ReaderCheckpoint, SIGNATURE_SIZE
+            fs = FileServer.instance()
+            fs.checkpoints.path = os.path.join(self.data_dir,
+                                               "checkpoints.json")
+            fs.checkpoints.load()
+            bumped = False
+            for cp in self._eo_pending:
+                if not cp.file_path or cp.read_length <= 0:
+                    continue
+                end = cp.read_offset + cp.read_length
+                v1 = fs.checkpoints.get(cp.file_path)
+                if v1 is None or v1.offset < end:
+                    sig = v1.signature if v1 is not None else ""
+                    if not sig:
+                        # capture the current head as the rotation signature
+                        try:
+                            with open(cp.file_path, "rb") as f:
+                                sig = f.read(SIGNATURE_SIZE).hex()
+                        except OSError:
+                            sig = ""
+                    fs.checkpoints.update(ReaderCheckpoint(
+                        path=cp.file_path, offset=end,
+                        dev=cp.dev, inode=cp.inode,
+                        signature=sig, signature_size=len(sig) // 2))
+                    bumped = True
+            if bumped:
+                fs.checkpoints.dump()
         # warm the native library (and its one-shot build) here so the first
         # data batch never stalls behind a compiler invocation
         from . import native as _native
@@ -155,6 +193,8 @@ class Application:
                 self.disk_buffer.replay(self._resolve_buffered_flusher)
                 self.pipeline_manager.check_onetime_completion(
                     self.process_queue_manager, self.sender_queue_manager)
+                if self._eo_pending:
+                    self._replay_exactly_once()
             if once:
                 # drain mode for one-shot runs: wait until queues idle
                 time.sleep(1.0)
@@ -187,6 +227,63 @@ class Application:
             drain=True, timeout=flags.get_flag("exit_flush_timeout"))
         self.http_sink.stop()
         log.info("exit complete")
+
+    def _replay_exactly_once(self) -> None:
+        """Re-read and re-inject file ranges whose send never committed
+        (crash between serialize and ack), from the snapshot taken at init.
+        Entries wait until their pipeline loads (remote configs arrive
+        asynchronously) and survive full queues; deletes are sequence-
+        conditioned so a fresh in-flight range reusing the key is never
+        clobbered.  Groups are marked IS_REPLAY so downstream may dedupe."""
+        from .input.file.checkpoint_v2 import get_default_manager
+        from .models import EventGroupMetaKey, PipelineEventGroup, SourceBuffer
+        mgr = get_default_manager()
+        if mgr is None:
+            self._eo_pending = []
+            return
+        for cp in list(self._eo_pending):
+            if not cp.file_path or cp.read_length <= 0:
+                mgr.delete_if_sequence(cp.key, cp.sequence_id)
+                self._eo_pending.remove(cp)
+                continue
+            pipeline_name = cp.key.split(":", 1)[0]
+            p = self.pipeline_manager.find_pipeline(pipeline_name)
+            if p is None:
+                continue  # pipeline may still be loading (remote config)
+            try:
+                fd = os.open(cp.file_path, os.O_RDONLY)
+                st = os.fstat(fd)
+                if cp.inode and st.st_ino != cp.inode:
+                    os.close(fd)
+                    mgr.delete_if_sequence(cp.key, cp.sequence_id)
+                    self._eo_pending.remove(cp)  # rotated: unrecoverable
+                    continue
+                data = os.pread(fd, cp.read_length, cp.read_offset)
+                os.close(fd)
+            except OSError:
+                mgr.delete_if_sequence(cp.key, cp.sequence_id)
+                self._eo_pending.remove(cp)
+                continue
+            sb = SourceBuffer(len(data) + 256)
+            view = sb.copy_string(data)
+            group = PipelineEventGroup(sb)
+            ev = group.add_raw_event(int(time.time()))
+            ev.set_content(view)
+            group.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, cp.file_path)
+            group.set_metadata(EventGroupMetaKey.LOG_FILE_INODE,
+                               str(cp.inode))
+            group.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET,
+                               str(cp.read_offset))
+            group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH,
+                               str(cp.read_length))
+            group.set_metadata(EventGroupMetaKey.IS_REPLAY, "true")
+            if not self.process_queue_manager.push_queue(
+                    p.process_queue_key, group):
+                continue  # queue full: retry next supervision round
+            mgr.delete_if_sequence(cp.key, cp.sequence_id)
+            self._eo_pending.remove(cp)
+            log.info("exactly-once replay: %s [%d,+%d)", cp.file_path,
+                     cp.read_offset, cp.read_length)
 
     def _resolve_buffered_flusher(self, identity: dict):
         """Find the live flusher matching a spilled payload's identity

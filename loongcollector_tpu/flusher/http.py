@@ -11,7 +11,9 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
-from ..models import PipelineEventGroup
+import time
+
+from ..models import EventGroupMetaKey, PipelineEventGroup
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
 from ..pipeline.compression import create_compressor
@@ -43,6 +45,8 @@ class FlusherHTTP(Flusher):
         self.serializer = None
         self.compressor = None
         self.batcher: Batcher = None  # type: ignore
+        self.eo_sender = None  # ExactlyOnceSender when ExactlyOnce configured
+        self._eo_stop = False
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -54,6 +58,7 @@ class FlusherHTTP(Flusher):
         self.serializer = (SLSEventGroupSerializer() if fmt == "sls_pb"
                            else JsonSerializer())
         self.compressor = create_compressor(config.get("Compression"))
+        self._init_exactly_once(config, context)
         strategy = FlushStrategy(
             min_cnt=int(config.get("MinCnt", 0)),
             min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),
@@ -64,8 +69,57 @@ class FlusherHTTP(Flusher):
                                pipeline_name=context.pipeline_name)
         return True
 
+    def _init_exactly_once(self, config, context) -> None:
+        eo_cfg = config.get("ExactlyOnce")
+        if not eo_cfg:
+            return
+        from ..input.file.checkpoint_v2 import (ExactlyOnceSender,
+                                                get_default_manager)
+        mgr = get_default_manager()
+        if mgr is not None:
+            self.eo_sender = ExactlyOnceSender(
+                mgr, f"{context.pipeline_name}:{self.plugin_id or self.name}",
+                concurrency=int(eo_cfg.get("Concurrency", 8)))
+
     def send(self, group: PipelineEventGroup) -> bool:
+        if self.eo_sender is not None:
+            return self._send_exactly_once(group)
         self.batcher.add(group)
+        return True
+
+    def _send_exactly_once(self, group: PipelineEventGroup) -> bool:
+        """Exactly-once path: one payload per group, range checkpoint
+        persisted BEFORE enqueue, committed on sink ack (reference
+        ExactlyOnceQueueManager; batching is bypassed so each payload maps
+        to one file range)."""
+        def _meta_int(key):
+            v = group.get_metadata(key)
+            try:
+                return int(str(v)) if v is not None else 0
+            except ValueError:
+                return 0
+        path = group.get_metadata(EventGroupMetaKey.LOG_FILE_PATH)
+        cp = None
+        # slot back-pressure caps in-flight EO sends; the wait breaks on
+        # flusher stop so shutdown never spins a processor thread forever
+        while not self._eo_stop:
+            cp = self.eo_sender.acquire_slot(
+                str(path) if path is not None else "",
+                0, _meta_int(EventGroupMetaKey.LOG_FILE_INODE),
+                _meta_int(EventGroupMetaKey.LOG_FILE_OFFSET),
+                _meta_int(EventGroupMetaKey.LOG_FILE_LENGTH))
+            if cp is not None:
+                break
+            time.sleep(0.005)
+        if cp is None:
+            return False  # shutting down; range stays uncommitted → replay
+        data = self.serializer.serialize([group])
+        payload = self.compressor.compress(data)
+        item = SenderQueueItem(payload, len(data), flusher=self,
+                               queue_key=self.queue_key,
+                               tag={"eo_cp": cp})
+        if self.sender_queue is not None:
+            self.sender_queue.push(item)
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
@@ -91,10 +145,18 @@ class FlusherHTTP(Flusher):
     def on_send_done(self, item: SenderQueueItem, status: int,
                      body: bytes) -> str:
         """Returns 'ok' | 'retry' | 'drop' (reference OnSendDone semantics)."""
+        cp = item.tag.get("eo_cp")
         if 200 <= status < 300:
+            if cp is not None and self.eo_sender is not None:
+                self.eo_sender.commit_slot(cp)
             return "ok"
         if status in (429, 500, 502, 503, 504) or status <= 0:
             return "retry"
+        # non-retryable rejection: the sink refused the data permanently —
+        # commit the range (discard-ack) so the slot frees and the range is
+        # not replayed forever
+        if cp is not None and self.eo_sender is not None:
+            self.eo_sender.commit_slot(cp)
         return "drop"
 
     def flush_all(self) -> bool:
@@ -102,6 +164,7 @@ class FlusherHTTP(Flusher):
         return True
 
     def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._eo_stop = True
         self.batcher.flush_all()
         self.batcher.close()
         return True

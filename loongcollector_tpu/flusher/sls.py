@@ -57,6 +57,7 @@ class FlusherSLS(FlusherHTTP):
             min_size_bytes=int(config.get("MinSizeBytes", 512 * 1024)),
             max_size_bytes=int(config.get("MaxSizeBytes", 5 * 1024 * 1024)),
             timeout_secs=float(config.get("TimeoutSecs", 1.0)))
+        self._init_exactly_once(config, context)
         self.batcher = Batcher(strategy, on_flush=self._serialize_and_push,
                                flusher_id=self.name,
                                pipeline_name=context.pipeline_name)
@@ -93,8 +94,13 @@ class FlusherSLS(FlusherHTTP):
 
     def on_send_done(self, item: SenderQueueItem, status: int,
                      body: bytes) -> str:
+        cp = item.tag.get("eo_cp")
         if 200 <= status < 300:
+            if cp is not None and self.eo_sender is not None:
+                self.eo_sender.commit_slot(cp)
             return "ok"
         if status in (403, 429, 500, 502, 503) or status <= 0:
             return "retry"  # quota/server errors back off (reference semantics)
+        if cp is not None and self.eo_sender is not None:
+            self.eo_sender.commit_slot(cp)  # discard-ack frees the slot
         return "drop"

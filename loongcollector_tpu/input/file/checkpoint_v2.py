@@ -88,6 +88,15 @@ class CheckpointManagerV2:
                                (key,))
             self._conn.commit()
 
+    def delete_if_sequence(self, key: str, sequence_id: int) -> None:
+        """Delete only if the row still belongs to the given attempt — a
+        fresh in-flight range that reused the key is left untouched."""
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM range_checkpoints WHERE key=? AND sequence_id=?",
+                (key, sequence_id))
+            self._conn.commit()
+
     def gc(self, max_age_s: float = 86400.0) -> int:
         cutoff = time.time() - max_age_s
         with self._lock:
@@ -107,6 +116,21 @@ class CheckpointManagerV2:
                                file_path=row[3], read_offset=row[4],
                                read_length=row[5], committed=bool(row[6]),
                                sequence_id=row[7], update_time=row[8])
+
+
+_default_manager: Optional[CheckpointManagerV2] = None
+_default_lock = threading.Lock()
+
+
+def get_default_manager(db_path: Optional[str] = None
+                        ) -> Optional[CheckpointManagerV2]:
+    """Process-wide checkpoint-v2 store; first caller with a path creates it
+    (the Application does this at init)."""
+    global _default_manager
+    with _default_lock:
+        if _default_manager is None and db_path:
+            _default_manager = CheckpointManagerV2(db_path)
+        return _default_manager
 
 
 class ExactlyOnceSender:

@@ -173,4 +173,5 @@ class LogFileReader:
         group.set_metadata(EventGroupMetaKey.LOG_FILE_INODE,
                            str(self.dev_inode.inode))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET, str(read_offset))
+        group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH, str(len(aligned)))
         return group

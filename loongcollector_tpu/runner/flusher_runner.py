@@ -69,7 +69,8 @@ class FlusherRunner:
             for q in list(self.sqm._queues.values()):
                 with q._lock:
                     items = [i for i in q._items
-                             if not getattr(i, "in_flight", False)]
+                             if not getattr(i, "in_flight", False)
+                             and "eo_cp" not in i.tag]
                 for item in items:
                     flusher = item.flusher
                     if flusher is None:
@@ -140,7 +141,8 @@ class FlusherRunner:
         if verdict == "retry":
             if (self.disk_buffer is not None
                     and item.try_count >= MAX_TRY_BEFORE_SPILL
-                    and flusher is not None):
+                    and flusher is not None
+                    and "eo_cp" not in item.tag):
                 # persistent failure: spill to disk and free the queue slot
                 # (reference DiskBufferWriter semantics)
                 if self.disk_buffer.spill(item, flusher.spill_identity()):

@@ -44,3 +44,59 @@ class TestCheckpointV2:
         assert mgr.gc(max_age_s=-1) == 1
         assert mgr.get("x/0") is None
         mgr.close()
+
+
+class TestExactlyOnceEndToEnd:
+    def test_crash_between_send_and_ack_replays_range(self, tmp_path):
+        """Uncommitted range at 'crash' → application replay re-injects the
+        exact file range marked IS_REPLAY."""
+        import os
+        from loongcollector_tpu.input.file import checkpoint_v2 as cv2
+        from loongcollector_tpu.models import EventGroupMetaKey
+
+        # isolate the process-wide default manager
+        old = cv2._default_manager
+        cv2._default_manager = None
+        try:
+            mgr = cv2.get_default_manager(str(tmp_path / "v2.db"))
+            log_path = tmp_path / "eo.log"
+            log_path.write_bytes(b"range line A\nrange line B\n")
+            sender = cv2.ExactlyOnceSender(mgr, "eopipe:flusher_http/0",
+                                           concurrency=2)
+            cp = sender.acquire_slot(str(log_path),
+                                     0, os.stat(log_path).st_ino, 0, 26)
+            assert cp is not None
+            # crash: no commit. Simulate the application replay logic.
+            from loongcollector_tpu.application import Application
+            app = Application.__new__(Application)
+
+            class FakePipe:
+                process_queue_key = 42
+
+            class FakeMgr:
+                def find_pipeline(self, name):
+                    return FakePipe() if name == "eopipe" else None
+
+            pushed = []
+
+            class FakePQM:
+                def push_queue(self, key, group):
+                    pushed.append((key, group))
+                    return True
+
+            app.pipeline_manager = FakeMgr()
+            app.process_queue_manager = FakePQM()
+            app._eo_pending = mgr.uncommitted()
+            app._replay_exactly_once()
+            assert app._eo_pending == []
+            assert len(pushed) == 1
+            key, group = pushed[0]
+            assert key == 42
+            assert group.events[0].content.to_bytes() == \
+                b"range line A\nrange line B\n"
+            assert group.get_metadata(EventGroupMetaKey.IS_REPLAY) == "true"
+            assert mgr.uncommitted() == []  # consumed
+        finally:
+            if cv2._default_manager is not None:
+                cv2._default_manager.close()
+            cv2._default_manager = old
