@@ -84,17 +84,13 @@ def bench_regex(n=32768):
 
 
 def bench_grok(n=16384):
-    """Kernel-friendly grok: NOTSPACE/negated-class fields run Tier-1; the
-    full COMMONAPACHELOG (optional groups) currently runs the CPU tier and
-    is reported as-is."""
+    """The full %{COMMONAPACHELOG} composite — optional HTTP-version group,
+    bytes-or-dash alternation — compiled to the Tier-1 device kernel."""
     import jax
 
     from loongcollector_tpu.ops.regex.engine import RegexEngine
     from loongcollector_tpu.ops.regex.grok import expand
-    pattern = expand(
-        r'%{NOTSPACE:clientip} %{NOTSPACE:ident} %{NOTSPACE:auth} '
-        r'\[%{HTTPDATE:timestamp}\] "%{WORD:verb} %{NOTSPACE:request} '
-        r'HTTP/%{NUMBER:httpversion}" %{INT:response} %{INT:bytes}')
+    pattern = expand("%{COMMONAPACHELOG}")
     eng = RegexEngine(pattern)
     lines = [l for l in gen_lines(n)]
     arena, offsets, lengths, batch, total = pack(lines)

@@ -15,11 +15,12 @@ loops:
     run count    Σ_l   { m_c[b,l] ∧ cur ≤ l < cur+n }        (sum-reduce)
     literal ok   any_l { l = cur[b] ∧ lit_c[b,l] }           (or-reduce)
 
-with lit_c precomputed by statically-shifted compares.  The cursor walk is
-a dependency chain of ~#segments such reductions — each one pass over the
-[B, L] tile.  Everything is static-shape, jit-compiled once per
-(program, B, L) geometry; the batch builder quantises B and L into buckets
-to avoid recompilation storms (SURVEY.md §7 hard parts).
+with lit_c precomputed by statically-shifted compares.  Composite ops
+(optional groups, alternation) evaluate their bodies vectorised over ALL
+rows and COMMIT per-row with masks — the branchless analogue of leftmost
+/ greedy-preference semantics.  Everything is static-shape, jit-compiled
+once per (program, B, L) geometry; the batch builder quantises B and L into
+buckets to avoid recompilation storms (SURVEY.md §7 hard parts).
 """
 
 from __future__ import annotations
@@ -30,8 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..regex.program import (INF, CapEnd, CapStart, FixedSpan, Lit,
-                             SegmentProgram, Span)
+from ..regex.program import (INF, Alt, CapEnd, CapStart, FixedSpan, Lit,
+                             Optional_, SegmentProgram, Span)
 
 
 def _membership(rows: jnp.ndarray, intervals, complement_intervals) -> jnp.ndarray:
@@ -48,88 +49,179 @@ def _membership(rows: jnp.ndarray, intervals, complement_intervals) -> jnp.ndarr
     return ~m if negate else m
 
 
+class _WalkState:
+    """Per-row cursor/match/capture state threaded through the emitter.
+    Capture columns are concrete default vectors from the start (offset 0,
+    length -1 = absent), so branch merging is a pure element-wise select."""
+
+    __slots__ = ("cur", "ok", "cap_off", "cap_len", "cap_start")
+
+    def __init__(self, cur, ok, ncaps, init_caps: bool = True):
+        self.cur = cur
+        self.ok = ok
+        if init_caps:
+            B = cur.shape[0]
+            zero = jnp.zeros(B, jnp.int32)
+            absent = jnp.full(B, -1, jnp.int32)
+            self.cap_off = [zero] * ncaps
+            self.cap_len = [absent] * ncaps
+            self.cap_start = [zero] * ncaps
+        else:
+            self.cap_off = []
+            self.cap_len = []
+            self.cap_start = []
+
+    def copy(self) -> "_WalkState":
+        st = _WalkState(self.cur, self.ok, 0, init_caps=False)
+        st.cap_off = list(self.cap_off)
+        st.cap_len = list(self.cap_len)
+        st.cap_start = list(self.cap_start)
+        return st
+
+    def select(self, mask, taken: "_WalkState", other: "_WalkState") -> None:
+        """self := taken where mask else other (element-wise per row)."""
+        self.cur = jnp.where(mask, taken.cur, other.cur)
+        self.ok = jnp.where(mask, taken.ok, other.ok)
+        self.cap_off = [jnp.where(mask, a, b)
+                        for a, b in zip(taken.cap_off, other.cap_off)]
+        self.cap_len = [jnp.where(mask, a, b)
+                        for a, b in zip(taken.cap_len, other.cap_len)]
+        self.cap_start = [jnp.where(mask, a, b)
+                          for a, b in zip(taken.cap_start, other.cap_start)]
+
+
 def build_extract_fn(program: SegmentProgram):
     """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) ->
     (ok bool [B], cap_off i32 [B,C], cap_len i32 [B,C])."""
 
     ncaps = max(program.num_caps, 1)
-    # freeze python-side structures used at trace time
     intervals = [c.intervals() for c in program.classes]
     comp_intervals = [c.negated().intervals() for c in program.classes]
-    ops = list(program.ops)
-    span_classes = {op.class_id for op in ops if isinstance(op, Span)}
-    count_classes = {op.class_id for op in ops if isinstance(op, FixedSpan)}
-    literals = sorted({op.data for op in ops if isinstance(op, Lit)})
+    top_ops = list(program.ops)
+
+    span_classes: set = set()
+    count_classes: set = set()
+    literals: set = set()
+
+    def collect(ops):
+        for op in ops:
+            if isinstance(op, Span):
+                span_classes.add(op.class_id)
+            elif isinstance(op, FixedSpan):
+                count_classes.add(op.class_id)
+            elif isinstance(op, Lit):
+                literals.add(op.data)
+            elif isinstance(op, Optional_):
+                collect(op.body)
+            elif isinstance(op, Alt):
+                for b in op.branches:
+                    collect(b)
+    collect(top_ops)
 
     def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
         B, L = rows.shape
         i32 = jnp.int32
         pos = jnp.broadcast_to(jnp.arange(L, dtype=i32)[None, :], (B, L))
-        valid = pos < lengths[:, None]                     # [B, L]
+        valid = pos < lengths[:, None]
+        L32 = jnp.int32(L)
 
-        # memberships, masked to the live span of each row
         member: Dict[int, jnp.ndarray] = {}
         for cid in sorted(span_classes | count_classes):
-            member[cid] = _membership(rows, intervals[cid], comp_intervals[cid]) & valid
+            member[cid] = _membership(rows, intervals[cid],
+                                      comp_intervals[cid]) & valid
 
-        # literal-match-at-position maps: lit_ok[b,l] ⇔ rows[b, l:l+k] == lit
         lit_ok: Dict[bytes, jnp.ndarray] = {}
-        for lit in literals:
+        for lit in sorted(literals):
             data = np.frombuffer(lit, dtype=np.uint8)
             m = jnp.ones((B, L), dtype=bool)
             for i, ch in enumerate(data):
-                if i == 0:
-                    shifted = rows
-                else:
-                    # static shift: compare rows[:, l+i] at position l
-                    shifted = jnp.concatenate(
-                        [rows[:, i:], jnp.zeros((B, i), rows.dtype)], axis=1)
+                shifted = rows if i == 0 else jnp.concatenate(
+                    [rows[:, i:], jnp.zeros((B, i), rows.dtype)], axis=1)
                 m = m & (shifted == ch)
             lit_ok[lit] = m
 
-        cur = jnp.zeros(B, i32)
-        ok = jnp.ones(B, bool)
-        cap_off = [jnp.zeros(B, i32) for _ in range(ncaps)]
-        cap_len = [jnp.full(B, -1, i32) for _ in range(ncaps)]
-        cap_start = [None] * ncaps
-        L32 = jnp.int32(L)
+        def emit(ops, st: _WalkState, active) -> None:
+            """Apply ops to st for rows where `active` (bool [B])."""
+            for op in ops:
+                if isinstance(op, Lit):
+                    k = len(op.data)
+                    hit = jnp.any((pos == st.cur[:, None]) & lit_ok[op.data],
+                                  axis=1)
+                    new_ok = st.ok & hit & (st.cur + k <= lengths)
+                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.cur = jnp.where(active,
+                                       jnp.minimum(st.cur + k, L32), st.cur)
+                elif isinstance(op, Span):
+                    m = member[op.class_id]
+                    cand = jnp.where(~m & (pos >= st.cur[:, None]), pos, L32)
+                    end = jnp.min(cand, axis=1)
+                    end = jnp.maximum(jnp.minimum(end, lengths), st.cur)
+                    run = end - st.cur
+                    new_ok = st.ok & (run >= op.min_len)
+                    if op.max_len != INF:
+                        new_ok = new_ok & (run <= op.max_len)
+                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.cur = jnp.where(active, end, st.cur)
+                elif isinstance(op, FixedSpan):
+                    new_ok = st.ok & (st.cur + op.n <= lengths)
+                    if op.n > 0:
+                        inside = ((pos >= st.cur[:, None])
+                                  & (pos < (st.cur + op.n)[:, None]))
+                        cnt = jnp.sum((member[op.class_id] & inside)
+                                      .astype(i32), axis=1)
+                        new_ok = new_ok & (cnt == op.n)
+                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.cur = jnp.where(active,
+                                       jnp.minimum(st.cur + op.n, L32), st.cur)
+                elif isinstance(op, CapStart):
+                    st.cap_start[op.cap_id] = jnp.where(
+                        active, st.cur, st.cap_start[op.cap_id])
+                elif isinstance(op, CapEnd):
+                    start = st.cap_start[op.cap_id]
+                    st.cap_off[op.cap_id] = jnp.where(
+                        active, start, st.cap_off[op.cap_id])
+                    st.cap_len[op.cap_id] = jnp.where(
+                        active, st.cur - start, st.cap_len[op.cap_id])
+                elif isinstance(op, Optional_):
+                    before = st.copy()
+                    emit(op.body, st, active)
+                    take = active & st.ok
+                    # greedy preference: keep the body where it matched,
+                    # revert (skip the group) where it failed
+                    merged = _WalkState(st.cur, st.ok, 0, init_caps=False)
+                    merged.select(take, st, before)
+                    st.cur, st.ok = merged.cur, merged.ok
+                    st.cap_off, st.cap_len = merged.cap_off, merged.cap_len
+                    st.cap_start = merged.cap_start
+                elif isinstance(op, Alt):
+                    before = st.copy()
+                    chosen_any = jnp.zeros_like(st.ok)
+                    result = before.copy()
+                    remaining = active & st.ok
+                    for branch in op.branches:
+                        trial = before.copy()
+                        emit(branch, trial, remaining)
+                        chosen = remaining & trial.ok
+                        merged = _WalkState(result.cur, result.ok, 0,
+                                            init_caps=False)
+                        merged.select(chosen, trial, result)
+                        result = merged
+                        chosen_any = chosen_any | chosen
+                        remaining = remaining & ~chosen
+                    st.cur = jnp.where(active, result.cur, before.cur)
+                    st.ok = jnp.where(active, chosen_any, before.ok)
+                    st.cap_off = result.cap_off
+                    st.cap_len = result.cap_len
+                    st.cap_start = result.cap_start
+                else:  # pragma: no cover
+                    raise AssertionError(op)
 
-        for op in ops:
-            if isinstance(op, Lit):
-                k = len(op.data)
-                ok = ok & (cur + k <= lengths)
-                hit = jnp.any((pos == cur[:, None]) & lit_ok[op.data], axis=1)
-                ok = ok & hit
-                cur = jnp.minimum(cur + k, L32)
-            elif isinstance(op, Span):
-                m = member[op.class_id]
-                cand = jnp.where(~m & (pos >= cur[:, None]), pos, L32)
-                end = jnp.min(cand, axis=1)
-                end = jnp.minimum(end, lengths)   # run cannot pass end of row
-                end = jnp.maximum(end, cur)
-                run = end - cur
-                ok = ok & (run >= op.min_len)
-                if op.max_len != INF:
-                    ok = ok & (run <= op.max_len)
-                cur = end
-            elif isinstance(op, FixedSpan):
-                ok = ok & (cur + op.n <= lengths)
-                if op.n > 0:
-                    inside = (pos >= cur[:, None]) & (pos < (cur + op.n)[:, None])
-                    cnt = jnp.sum((member[op.class_id] & inside).astype(i32), axis=1)
-                    ok = ok & (cnt == op.n)
-                cur = jnp.minimum(cur + op.n, L32)
-            elif isinstance(op, CapStart):
-                cap_start[op.cap_id] = cur
-            elif isinstance(op, CapEnd):
-                cap_off[op.cap_id] = cap_start[op.cap_id]
-                cap_len[op.cap_id] = cur - cap_start[op.cap_id]
-            else:  # pragma: no cover
-                raise AssertionError(op)
+        st = _WalkState(jnp.zeros(B, i32), jnp.ones(B, bool), ncaps)
+        emit(top_ops, st, jnp.ones(B, bool))
 
-        ok = ok & (cur == lengths)
-        off = jnp.stack(cap_off, axis=1)
-        length = jnp.stack(cap_len, axis=1)
+        ok = st.ok & (st.cur == lengths)
+        off = jnp.stack(st.cap_off, axis=1)
+        length = jnp.stack(st.cap_len, axis=1)
         length = jnp.where(ok[:, None], length, -1)
         off = jnp.where(ok[:, None], off, 0)
         return ok, off, length

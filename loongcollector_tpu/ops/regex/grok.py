@@ -49,34 +49,44 @@ DEFAULT_PATTERNS: Dict[str, str] = {
     "URIPARAM": r"\?[^ ]*",
     "URIPATHPARAM": r"%{URIPATH}(?:%{URIPARAM})?",
     "URI": r"%{URIPROTO}://(?:%{USER}(?::[^@]*)?@)?(?:%{URIHOST})?(?:%{URIPATHPARAM})?",
-    "MONTH": r"\b(?:Jan(?:uary)?|Feb(?:ruary)?|Mar(?:ch)?|Apr(?:il)?|May|Jun(?:e)?|Jul(?:y)?|Aug(?:ust)?|Sep(?:tember)?|Oct(?:ober)?|Nov(?:ember)?|Dec(?:ember)?)\b",
-    "MONTHNUM": r"(?:0?[1-9]|1[0-2])",
+    "MONTH3": r"(?:Jan|Feb|Mar|Apr|May|Jun|Jul|Aug|Sep|Oct|Nov|Dec)",
+    "MONTH": r"(?:Jan(?:uary)?|Feb(?:ruary)?|Mar(?:ch)?|Apr(?:il)?|May|Jun(?:e)?|Jul(?:y)?|Aug(?:ust)?|Sep(?:tember)?|Oct(?:ober)?|Nov(?:ember)?|Dec(?:ember)?)",
+    "MONTHNUM": r"(?:1[0-2]|0[1-9]|[1-9])",
+    "MONTHNUM2": r"(?:1[0-2]|0[1-9])",
     "MONTHDAY": r"(?:(?:0[1-9])|(?:[12][0-9])|(?:3[01])|[1-9])",
+    "MONTHDAY2": r"(?:3[01]|[12][0-9]|0[1-9])",
     "DAY": r"(?:Mon(?:day)?|Tue(?:sday)?|Wed(?:nesday)?|Thu(?:rsday)?|Fri(?:day)?|Sat(?:urday)?|Sun(?:day)?)",
     "YEAR": r"(?:\d\d){1,2}",
-    "HOUR": r"(?:2[0123]|[01]?[0-9])",
+    "HOUR": r"(?:2[0-3]|[01][0-9]|[0-9])",
+    "HOUR2": r"(?:2[0-3]|[01][0-9])",
     "MINUTE": r"(?:[0-5][0-9])",
     "SECOND": r"(?:[0-5][0-9]|60)(?:[:.,][0-9]+)?",
-    "TIME": r"%{HOUR}:%{MINUTE}(?::%{SECOND})?",
+    "TIME": r"%{HOUR2}:%{MINUTE}(?::%{SECOND})?",
     "DATE_US": r"%{MONTHNUM}[/-]%{MONTHDAY}[/-]%{YEAR}",
     "DATE_EU": r"%{MONTHDAY}[./-]%{MONTHNUM}[./-]%{YEAR}",
-    "ISO8601_TIMEZONE": r"(?:Z|[+-]%{HOUR}(?::?%{MINUTE}))",
+    "ISO8601_TIMEZONE": r"(?:Z|[+-]%{HOUR2}(?::?%{MINUTE}))",
     "ISO8601_SECOND": r"%{SECOND}",
-    "TIMESTAMP_ISO8601": r"%{YEAR}-%{MONTHNUM}-%{MONTHDAY}[T ]%{HOUR}:?%{MINUTE}(?::?%{SECOND})?%{ISO8601_TIMEZONE}?",
+    "TIMESTAMP_ISO8601": r"%{YEAR}-%{MONTHNUM2}-%{MONTHDAY2}[T ]%{HOUR2}:?%{MINUTE}(?::?%{SECOND})?%{ISO8601_TIMEZONE}?",
     "DATE": r"%{DATE_US}|%{DATE_EU}",
     "DATESTAMP": r"%{DATE}[- ]%{TIME}",
     "TZ": r"[A-Z]{3,4}",
-    "HTTPDATE": r"%{MONTHDAY}/%{MONTH}/%{YEAR}:%{TIME} %{INT}",
+    "HTTPDATE": r"%{MONTHDAY2}/%{MONTH3}/%{YEAR}:%{TIME} %{INT}",
     "SYSLOGTIMESTAMP": r"%{MONTH} +%{MONTHDAY} %{TIME}",
     "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|[Nn]otice|NOTICE|[Ii]nfo?(?:rmation)?|INFO?(?:RMATION)?|[Ww]arn?(?:ing)?|WARN?(?:ING)?|[Ee]rr?(?:or)?|ERR?(?:OR)?|[Cc]rit?(?:ical)?|CRIT?(?:ICAL)?|[Ff]atal|FATAL|[Ss]evere|SEVERE|EMERG(?:ENCY)?|[Ee]merg(?:ency)?)",
-    # composite access-log patterns, kernel-friendly field classes
+    # composite access-log patterns, kernel-friendly field classes: the
+    # request field uses [^ "] (not \S) so the optional HTTP-version group
+    # and closing quote never need backtracking — same semantics for
+    # well-formed access logs, Tier-1 on device
+    "NOTSPACEQ": r'[^ "]+',
     "COMMONAPACHELOG": (
         r'%{NOTSPACE:clientip} %{NOTSPACE:ident} %{NOTSPACE:auth} '
-        r'\[%{HTTPDATE:timestamp}\] "%{WORD:verb} %{NOTSPACE:request}'
+        r'\[%{HTTPDATE:timestamp}\] "%{WORD:verb} %{NOTSPACEQ:request}'
         r'(?: HTTP/%{NUMBER:httpversion})?" %{INT:response} '
-        r'(?:%{INT:bytes}|-)'),
+        r'(?:%{POSINT:bytes}|-)'),
+    # referrer/agent as [^"]* (not DATA=.*?): identical for well-formed
+    # logs, backtracking-free on device
     "COMBINEDAPACHELOG": (
-        r'%{COMMONAPACHELOG} "%{DATA:referrer}" "%{DATA:agent}"'),
+        r'%{COMMONAPACHELOG} "(?P<referrer>[^"]*)" "(?P<agent>[^"]*)"'),
     "NGINXACCESS": (
         r'%{NOTSPACE:remote_addr} - %{NOTSPACE:remote_user} '
         r'\[%{HTTPDATE:time_local}\] "%{WORD:method} %{NOTSPACE:request} '

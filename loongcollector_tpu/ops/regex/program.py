@@ -88,6 +88,24 @@ class FixedSpan:
 
 
 @dataclass
+class Optional_:
+    """(?:...)?  — the body is evaluated in full (vectorised) and committed
+    where it matches; rows where it fails skip the group.  This mirrors the
+    greedy preference of the backtracking engine (take if takeable)."""
+
+    body: List["Op"]
+
+
+@dataclass
+class Alt:
+    """(a|b|c) — alternatives tried in order, committing to the first whose
+    WHOLE branch matches at the cursor (leftmost-match).  Each branch must
+    itself be backtracking-free w.r.t. the group's follow set."""
+
+    branches: List[List["Op"]]
+
+
+@dataclass
 class CapStart:
     cap_id: int
 
@@ -97,7 +115,7 @@ class CapEnd:
     cap_id: int
 
 
-Op = Union[Lit, Span, FixedSpan, CapStart, CapEnd]
+Op = Union[Lit, Span, FixedSpan, "Optional_", "Alt", CapStart, CapEnd]
 
 
 @dataclass
@@ -119,11 +137,19 @@ class SegmentProgram:
     def scan_requirements(self) -> Tuple[set, set]:
         """Returns (next_non_classes, cumsum_classes)."""
         next_non, cumsum = set(), set()
-        for op in self.ops:
-            if isinstance(op, Span):
-                next_non.add(op.class_id)
-            elif isinstance(op, FixedSpan):
-                cumsum.add(op.class_id)
+
+        def walk(ops):
+            for op in ops:
+                if isinstance(op, Span):
+                    next_non.add(op.class_id)
+                elif isinstance(op, FixedSpan):
+                    cumsum.add(op.class_id)
+                elif isinstance(op, Optional_):
+                    walk(op.body)
+                elif isinstance(op, Alt):
+                    for b in op.branches:
+                        walk(b)
+        walk(self.ops)
         return next_non, cumsum
 
     def max_reach(self) -> int:
@@ -182,6 +208,25 @@ def _flatten(tokens, prog: SegmentProgram, ops: List[Op]) -> None:
             lo = int(lo)
             cls = _single_class(sub)
             if cls is None:
+                if lo == 0 and hi == 1:
+                    body: List[Op] = []
+                    _flatten(sub, prog, body)
+                    ops.append(Optional_(body))
+                    continue
+                if hi != INF and lo <= 8 and hi - lo <= 8:
+                    # counted repeat of a group: lo mandatory copies, then
+                    # nested optionals (greedy: outer optional contains the
+                    # next, preferring more copies)
+                    for _ in range(lo):
+                        _flatten(sub, prog, ops)
+                    tail: List[Op] = []
+                    for _ in range(hi - lo):
+                        body2: List[Op] = []
+                        _flatten(sub, prog, body2)
+                        body2.extend(tail)
+                        tail = [Optional_(body2)]
+                    ops.extend(tail)
+                    continue
                 raise Tier1Unsupported("repeat of non-class subpattern")
             cid = prog.class_id(cls)
             if lo == hi:
@@ -210,7 +255,14 @@ def _flatten(tokens, prog: SegmentProgram, ops: List[Op]) -> None:
             # has position-dependent semantics the segment walk can't model.
             raise Tier1Unsupported(f"assertion {av}")
         elif tok_op is sre_c.BRANCH:
-            raise Tier1Unsupported("alternation")
+            flush_lit()
+            _, alts = av
+            branches: List[List[Op]] = []
+            for alt in alts:
+                b: List[Op] = []
+                _flatten(list(alt), prog, b)
+                branches.append(b)
+            ops.append(Alt(branches))
         else:
             raise Tier1Unsupported(f"op {tok_op}")
     flush_lit()
@@ -261,22 +313,144 @@ def _first_set(ops: Sequence[Op], i: int, prog: SegmentProgram) -> Tuple[CharCla
                 return mask, False
             j += 1
             continue
+        if isinstance(op, Optional_):
+            sub, _ = _first_set(op.body, 0, prog)
+            mask = mask.union(sub)
+            j += 1
+            continue
+        if isinstance(op, Alt):
+            can_empty = False
+            for b in op.branches:
+                sub, e = _first_set(b, 0, prog)
+                mask = mask.union(sub)
+                can_empty = can_empty or e
+            if not can_empty:
+                return mask, False
+            j += 1
+            continue
         raise AssertionError(op)
     return mask, True
 
 
-def _validate_and_bind(prog: SegmentProgram) -> None:
-    ops = prog.ops
+def _fixed_len(ops: Sequence[Op]) -> Optional[int]:
+    """Total consumed length if statically fixed, else None."""
+    total = 0
+    for op in ops:
+        if isinstance(op, (CapStart, CapEnd)):
+            continue
+        if isinstance(op, Lit):
+            total += len(op.data)
+        elif isinstance(op, FixedSpan):
+            total += op.n
+        elif isinstance(op, Span):
+            if op.min_len != op.max_len:
+                return None
+            total += op.min_len
+        elif isinstance(op, Alt):
+            lens = [_fixed_len(b) for b in op.branches]
+            if any(l is None for l in lens) or len(set(lens)) != 1:
+                return None
+            total += lens[0]
+        else:  # Optional_ is never fixed
+            return None
+    return total
+
+
+def _follow_of(ops: Sequence[Op], i: int, prog: SegmentProgram,
+               outer: CharClass) -> CharClass:
+    """First set of what can follow ops[i] (the rest of this sequence, or the
+    outer follow when the tail can match empty)."""
+    mask, can_empty = _first_set(ops, i + 1, prog)
+    if can_empty:
+        mask = mask.union(outer)
+    return mask
+
+
+def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
+                  outer_follow: CharClass) -> None:
     for i, op in enumerate(ops):
         if isinstance(op, Span):
             # maximal munch (plus the {m,n} length check) is equivalent to
             # backtracking only when the follow set is disjoint from the class
-            follow, can_end = _first_set(ops, i + 1, prog)
+            follow = _follow_of(ops, i, prog, outer_follow)
             cls = prog.classes[op.class_id]
             if cls.intersects(follow):
                 raise Tier1Unsupported(
                     f"greedy class {cls} overlaps follow set {follow}")
-            # can_end: span runs to end of line — fine (full-match checks len)
+        elif isinstance(op, Optional_):
+            follow = _follow_of(ops, i, prog, outer_follow)
+            first, can_empty = _first_set(op.body, 0, prog)
+            if can_empty:
+                raise Tier1Unsupported("optional group can match empty")
+            # greedy take/skip commits on body success; that equals
+            # backtracking only when the body can never "absorb" what the
+            # continuation needs — first(body) must not overlap follow
+            # (counterexample otherwise: (?:ab)?abc on "abc")
+            if first.intersects(follow):
+                raise Tier1Unsupported(
+                    "optional body first set overlaps follow set")
+            _validate_ops(op.body, prog, follow)
+        elif isinstance(op, Alt):
+            follow = _follow_of(ops, i, prog, outer_follow)
+            firsts = []
+            flens = []
+            empties = []
+            for bi, b in enumerate(op.branches):
+                _validate_ops(b, prog, follow)
+                f, can_empty = _first_set(b, 0, prog)
+                # commit-on-branch-success prefers earlier branches; an
+                # empty-matchable branch always succeeds, so anywhere but
+                # LAST it would shadow later branches the backtracking
+                # engine could still reach (sre factors "GET|GETX" into
+                # GET(?:|X) — empty-first — which must be rejected)
+                if can_empty and bi != len(op.branches) - 1:
+                    raise Tier1Unsupported(
+                        "empty-matchable alternation branch before the last")
+                firsts.append(f)
+                flens.append(_fixed_len(b))
+                empties.append(can_empty)
+            # commit equals leftmost-with-backtracking only when, for every
+            # branch pair, either at most one branch can apply (disjoint
+            # first sets) or both consume the same fixed length (identical
+            # continuation, so a continuation failure fails under both).
+            # Counterexample otherwise: HOUR (2[0-3]|[0-9]) on "230"
+            # followed by MINUTE.
+            n_br = len(op.branches)
+            lits = [b[0].data if len(b) == 1 and isinstance(b[0], Lit)
+                    else None for b in op.branches]
+            for a in range(n_br):
+                for b2 in range(a + 1, n_br):
+                    if empties[a] or empties[b2]:
+                        continue  # empty last branch handled below
+                    if lits[a] is not None and lits[b2] is not None:
+                        # distinct literals: local matches are mutually
+                        # exclusive unless one prefixes the other — and the
+                        # dangerous ordering is shorter-prefix-first (re
+                        # would backtrack into the longer: "GET|GETX")
+                        if lits[b2].startswith(lits[a]) and lits[a] != lits[b2]:
+                            raise Tier1Unsupported(
+                                "alternation literal is a prefix of a later "
+                                "branch (reorder longest-first)")
+                        continue
+                    if firsts[a].intersects(firsts[b2]) and (
+                            flens[a] is None or flens[a] != flens[b2]):
+                        raise Tier1Unsupported(
+                            "ambiguous alternation branches (overlapping "
+                            "first sets, unequal lengths)")
+            # an empty-matchable LAST branch makes the Alt optional-like:
+            # the other branches must not absorb the continuation
+            if empties and empties[-1]:
+                union = CharClass.from_bytes(b"")
+                for f, e in zip(firsts, empties):
+                    if not e:
+                        union = union.union(f)
+                if union.intersects(follow):
+                    raise Tier1Unsupported(
+                        "alternation with empty branch overlaps follow set")
+
+
+def _validate_and_bind(prog: SegmentProgram) -> None:
+    _validate_ops(prog.ops, prog, CharClass.from_bytes(b""))
 
 
 # ---------------------------------------------------------------------------

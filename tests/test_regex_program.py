@@ -51,16 +51,22 @@ def assert_matches_re(pattern, lines):
         if m:
             for g in range(rx.groups):
                 s, e = m.span(g + 1)
-                assert coff[i, g] == s, f"line {i} group {g} offset"
-                assert clen[i, g] == e - s, f"line {i} group {g} len"
+                if s < 0:  # group not matched (e.g. skipped optional)
+                    assert clen[i, g] == -1, f"line {i} group {g} absent"
+                else:
+                    assert coff[i, g] == s, f"line {i} group {g} offset"
+                    assert clen[i, g] == e - s, f"line {i} group {g} len"
 
 
 class TestTierClassification:
     def test_apache_is_tier1(self):
         assert classify_pattern(APACHE) == PatternTier.SEGMENT
 
-    def test_alternation_is_dfa(self):
-        assert classify_pattern(r"(?:GET|POST|PUT) /\S*") == PatternTier.DFA
+    def test_simple_alternation_is_tier1(self):
+        assert classify_pattern(r"(?:GET|POST|PUT) /\S*") == PatternTier.SEGMENT
+
+    def test_repeat_group_is_dfa(self):
+        assert classify_pattern(r"(?:ab)+x") == PatternTier.DFA
 
     def test_backref_is_cpu(self):
         assert classify_pattern(r"(a+)b\1") == PatternTier.CPU
@@ -184,3 +190,117 @@ class TestRandomDifferential:
         lines += [APACHE_LINE, b"2024-01-31T09:15:59", b'"q" t',
                   b"a=b", b"[x] w: rest", b"deadbeef-cafe", b"1.2.3.4"]
         assert_matches_re(pattern, lines)
+
+
+class TestOptionalAndAlternation:
+    def test_optional_group_http_version(self):
+        # note [^ "] for the request: \S would need backtracking out of the
+        # closing quote, which Tier-1 correctly rejects
+        pattern = r'"(\w+) ([^ "]+)(?: HTTP/(\d\.\d))?" (\d{3})'
+        assert_matches_re(pattern, [
+            b'"GET /x HTTP/1.1" 200',
+            b'"GET /x" 404',
+            b'"GET /x HTTP/9" 200',      # malformed version -> no match
+            b'"GET /x HTTP/1.1" 99',
+        ])
+
+    def test_alternation_literals(self):
+        pattern = r"(GET|POST|DELETE) (\S+)"
+        assert_matches_re(pattern, [
+            b"GET /a", b"POST /b", b"DELETE /c", b"PATCH /d", b"GE /x",
+        ])
+
+    def test_alternation_class_and_literal(self):
+        pattern = r"(\d+|-) (\w+)"
+        assert_matches_re(pattern, [
+            b"123 abc", b"- xyz", b"12- q", b" x",
+        ])
+
+    def test_literal_prefix_order_rejected(self):
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(GET|GETX) .*")
+
+    def test_literal_prefix_longest_first_ok(self):
+        assert_matches_re(r"(GETX|GET) (\S+)", [
+            b"GETX /a", b"GET /b", b"GETXY /c",
+        ])
+
+    def test_nested_optional(self):
+        pattern = r"(\w+)(?:\.(\w+)(?:\.(\w+))?)? (\d+)"
+        assert_matches_re(pattern, [
+            b"a 1", b"a.b 2", b"a.b.c 3", b"a.b.c.d 4", b"a. 5",
+        ])
+
+    def test_capture_inside_alternation(self):
+        pattern = r"(?:level=(\w+)|lvl:(\w+)) (.*)"
+        assert_matches_re(pattern, [
+            b"level=info started", b"lvl:warn hot", b"nope x",
+        ])
+
+    def test_common_apache_log_grok_shape(self):
+        # the full COMMONAPACHELOG shape with optional HTTP version and
+        # bytes-or-dash alternation — previously CPU tier, now Tier-1
+        pattern = (r'(\S+) (\S+) (\S+) \[([^\]]+)\] '
+                   r'"(\w+) ([^ "]+)(?: HTTP/([0-9.]+))?" (\d{3}) (\d+|-)')
+        assert classify_pattern(pattern) == PatternTier.SEGMENT
+        assert_matches_re(pattern, [
+            APACHE_LINE,
+            b'1.2.3.4 - - [t] "GET /x" 200 -',
+            b'1.2.3.4 - - [t] "GET /x HTTP/1.1" 200 -',
+            b'1.2.3.4 - - [t] "GET /x HTTP/1.1" 200 77',
+        ])
+
+    def test_fuzz_optional_alternation(self):
+        import numpy as _np
+        rng = _np.random.default_rng(7)
+        alphabet = b'GETPOSDL -/19."x'
+        patterns = [
+            r"(GET|POST|DELETE) (\S+)",
+            r"(\d+|-)",
+            r'"(\w+)(?: ([^ "]+))?"',
+            r"(\w+)(?:-(\d+))? end",
+        ]
+        for pattern in patterns:
+            lines = [bytes(alphabet[i] for i in
+                           rng.integers(0, len(alphabet), int(rng.integers(0, 24))))
+                     for _ in range(400)]
+            lines += [b"GET /a", b"-", b"9", b'"x y"', b'"x"', b"ab-1 end",
+                   b"ab end"]
+            assert_matches_re(pattern, lines)
+
+
+class TestGrokCompositesTier1:
+    def test_commonapachelog_differential(self):
+        from loongcollector_tpu.ops.regex.grok import expand
+        pattern = expand("%{COMMONAPACHELOG}")
+        assert classify_pattern(pattern) == PatternTier.SEGMENT
+        rng = np.random.default_rng(11)
+        lines = []
+        for i in range(300):
+            ip = f"{rng.integers(1,255)}.{rng.integers(256)}.{rng.integers(256)}.{rng.integers(255)}"
+            ver = ["", " HTTP/1.0", " HTTP/1.1", " HTTP/2"][int(rng.integers(4))]
+            size = ["-", str(int(rng.integers(0, 10**6)))][int(rng.integers(2))]
+            ln = (f'{ip} - u{i} [{int(rng.integers(1,32))}/Oct/2000:13:55:36 -0700] '
+                  f'"GET /p{i}{ver}" {int(rng.integers(100,600))} {size}').encode()
+            if i % 5 == 0:
+                ln = ln.replace(b"Oct", b"Xxx")     # bad month
+            if i % 7 == 0:
+                ln = ln.replace(b'"GET', b'"GET WITH SPACE', 1)
+            lines.append(ln)
+        assert_matches_re(pattern, lines)
+
+    def test_timestamp_iso8601_differential(self):
+        from loongcollector_tpu.ops.regex.grok import expand
+        pattern = expand("%{TIMESTAMP_ISO8601}")
+        assert classify_pattern(pattern) == PatternTier.SEGMENT
+        assert_matches_re(pattern, [
+            b"2024-01-31T09:15:59Z", b"2024-01-31 09:15:59",
+            b"2024-1-31T09:15:59+08:00", b"2024-13-31T09:15:59",
+            b"2024-01-31T24:15:59", b"2024-01-31T9:15", b"garbage",
+            b"99-01-31T09:15:59.123Z",
+        ])
+
+    def test_counted_group_repeat(self):
+        assert_matches_re(r"((?:\d\d){1,2})x", [
+            b"12x", b"1234x", b"123x", b"x", b"123456x",
+        ])
