@@ -161,6 +161,28 @@ def bench_json(n=8192):
     return len(data) / dt / 1e6
 
 
+def bench_latency(n_iters=200, batch=256):
+    """p99 per-batch parse latency at interactive batch sizes (the
+    BASELINE target budgets <10 ms added p99 vs the CPU path)."""
+    import jax
+
+    from loongcollector_tpu.ops.regex.engine import RegexEngine
+    eng = RegexEngine(APACHE)
+    lines = gen_lines(batch)
+    arena, offsets, lengths, b, total = pack(lines)
+    rows_dev = jax.device_put(b.rows)
+    lens_dev = jax.device_put(b.lengths)
+    kern = eng._segment_kernel
+    jax.block_until_ready(kern(rows_dev, lens_dev))  # compile
+    samples = []
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(kern(rows_dev, lens_dev))
+        samples.append((time.perf_counter() - t0) * 1000)
+    samples.sort()
+    return samples[len(samples) // 2], samples[int(len(samples) * 0.99)]
+
+
 def main():
     import jax
     if "--cpu" in sys.argv:
@@ -175,6 +197,9 @@ def main():
         "json_parse_MBps": round(bench_json(), 1),
         "device": str(jax.devices()[0]),
     }
+    p50, p99 = bench_latency()
+    extra["batch_latency_ms_p50"] = round(p50, 2)
+    extra["batch_latency_ms_p99"] = round(p99, 2)
     print(json.dumps({
         "metric": "regex_parse_throughput",
         "value": round(mbps, 1),

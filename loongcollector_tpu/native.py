@@ -70,6 +70,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.lct_pack_rows.restype = None
         lib.lct_pack_rows.argtypes = [u8p, ctypes.c_int64, i64p, i32p,
                                       ctypes.c_int64, ctypes.c_int64, u8p]
+        lib.lct_json_extract.restype = None
+        lib.lct_json_extract.argtypes = [u8p, ctypes.c_int64, i64p, i32p,
+                                         ctypes.c_int64, u8p, i32p,
+                                         ctypes.c_int64, i32p, i32p,
+                                         u8p, u8p]
         lib.lct_sls_serialize.restype = ctypes.c_int64
         lib.lct_sls_serialize.argtypes = [u8p, ctypes.c_int64, i64p,
                                           ctypes.c_int64, ctypes.c_int64,
@@ -124,6 +129,32 @@ def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
     lib.lct_pack_rows(_u8(arena), len(arena), _i64(offsets), _i32(lengths),
                       n, L, _u8(rows))
     return rows
+
+
+def json_extract(arena: np.ndarray, offsets: np.ndarray,
+                 lengths: np.ndarray, keys: list):
+    """Flat-schema JSON field extraction.  keys: list[bytes] (≤128).
+    Returns (offs [F,n] i32, lens [F,n] i32, ok [n] bool, fallback [n] bool)
+    or None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None or len(keys) > 128:
+        return None
+    arena = np.ascontiguousarray(arena)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    keys_blob = np.frombuffer(b"".join(keys) or b"\0", dtype=np.uint8).copy()
+    key_lens = np.array([len(k) for k in keys], dtype=np.int32)
+    n = len(offsets)
+    F = len(keys)
+    out_offs = np.zeros((F, n), dtype=np.int32)
+    out_lens = np.full((F, n), -1, dtype=np.int32)
+    ok = np.zeros(n, dtype=np.uint8)
+    fallback = np.zeros(n, dtype=np.uint8)
+    lib.lct_json_extract(_u8(arena), len(arena), _i64(offsets), _i32(lengths),
+                         n, _u8(keys_blob), _i32(key_lens), F,
+                         _i32(out_offs), _i32(out_lens), _u8(ok),
+                         _u8(fallback))
+    return out_offs, out_lens, ok.astype(bool), fallback.astype(bool)
 
 
 def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,

@@ -4,10 +4,12 @@ Reference: core/plugin/processor/ProcessorParseJsonNative.cpp (rapidjson
 parse of one key into fields, keep/discard semantics shared with regex
 parser).
 
-Current execution: columnar host parse writing values into the group arena
-(so downstream stays span-based).  A simdjson-style structural device kernel
-(quote/escape parity via cumsum) is the planned Tier-1 upgrade —
-ops/kernels/json_structural.py.
+Execution: stable-schema events extract in one native C pass with zero-copy
+value spans (raw source tokens: numbers/bools keep their source spelling);
+events with escaped strings, schema drift or malformed JSON fall back to the
+host json parser, whose values are canonicalised (str()/json.dumps) — the
+two representations differ only in number/whitespace spelling of unusual
+inputs.
 """
 
 from __future__ import annotations
@@ -52,9 +54,26 @@ class ProcessorParseJson(Processor):
             field_offs: Dict[str, np.ndarray] = {}
             field_lens: Dict[str, np.ndarray] = {}
             raw = src.arena
-            for i in range(n):
-                if not src.present[i]:
-                    continue
+
+            # native fast path: discover the schema from the first parseable
+            # event, then extract all stable-schema events in one C pass;
+            # escaped strings / unknown keys / malformed events fall back
+            # per-event below
+            todo = np.nonzero(src.present)[0]
+            keys = self._discover_schema(raw, src, todo)
+            if keys is not None:
+                from .. import native as _native
+                res = _native.json_extract(raw, src.offsets, src.lengths, keys)
+                if res is not None:
+                    f_offs, f_lens, c_ok, _ = res
+                    c_ok = c_ok & src.present
+                    for fi, k in enumerate(keys):
+                        name = k.decode("utf-8", "replace")
+                        field_offs[name] = f_offs[fi].copy()
+                        field_lens[name] = np.where(c_ok, f_lens[fi], -1)
+                    ok |= c_ok
+                    todo = np.nonzero(src.present & ~c_ok)[0]
+            for i in todo:
                 o, ln = int(src.offsets[i]), int(src.lengths[i])
                 try:
                     obj = json.loads(raw[o : o + ln].tobytes())
@@ -114,6 +133,18 @@ class ProcessorParseJson(Processor):
                 ev.set_content(sb.copy_string(k), sb.copy_string(val))
             if not self.keep_source_on_success:
                 ev.del_content(self.source_key)
+
+    @staticmethod
+    def _discover_schema(raw, src, candidates):
+        for i in candidates[:4]:
+            o, ln = int(src.offsets[i]), int(src.lengths[i])
+            try:
+                obj = json.loads(raw[o : o + ln].tobytes())
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and obj and len(obj) <= 128:
+                return [k.encode("utf-8") for k in obj.keys()]
+        return None
 
     def _retain_source(self, cols: ColumnarLogs, src, ok: np.ndarray) -> None:
         if self.keep_source_on_fail and self.keep_source_on_success:

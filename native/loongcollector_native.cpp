@@ -200,3 +200,199 @@ uint32_t lct_crc32c(const uint8_t* data, int64_t len, uint32_t seed) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Columnar JSON field extraction for flat-schema log events.
+//
+// For each event (a JSON object), extracts the values of F known keys as
+// (offset, len) spans into the arena — zero copies:
+//   * strings WITHOUT escapes  → span of the content between the quotes
+//   * numbers / true/false/null → span of the raw token
+//   * nested objects/arrays     → span of the raw JSON slice
+// Events that don't fit the fast path (escaped strings, unknown keys,
+// malformed JSON) get fallback_mask=1 and are handled by the host.
+// out_offs/out_lens are [F * n] (field-major), len -1 = absent.
+// ok[i]=1 iff the event parsed as an object on the fast path.
+// ---------------------------------------------------------------------------
+
+static inline int64_t jskip_ws(const uint8_t* a, int64_t p, int64_t end) {
+    while (p < end && (a[p] == ' ' || a[p] == '\t' || a[p] == '\n' ||
+                       a[p] == '\r'))
+        ++p;
+    return p;
+}
+
+// scan a string starting AFTER the opening quote; returns position of the
+// closing quote or -1; sets *had_escape
+static inline int64_t jscan_string(const uint8_t* a, int64_t p, int64_t end,
+                                   bool* had_escape) {
+    while (p < end) {
+        uint8_t c = a[p];
+        if (c == '\\') { *had_escape = true; p += 2; continue; }
+        if (c == '"') return p;
+        ++p;
+    }
+    return -1;
+}
+
+// strict JSON scalar token: number | true | false | null
+static bool json_scalar_valid(const uint8_t* t, int64_t n) {
+    if (n == 4 && memcmp(t, "true", 4) == 0) return true;
+    if (n == 4 && memcmp(t, "null", 4) == 0) return true;
+    if (n == 5 && memcmp(t, "false", 5) == 0) return true;
+    int64_t i = 0;
+    if (i < n && t[i] == '-') ++i;
+    if (i >= n) return false;
+    if (t[i] == '0') { ++i; }
+    else if (t[i] >= '1' && t[i] <= '9') {
+        while (i < n && t[i] >= '0' && t[i] <= '9') ++i;
+    } else return false;
+    if (i < n && t[i] == '.') {
+        ++i;
+        if (i >= n || t[i] < '0' || t[i] > '9') return false;
+        while (i < n && t[i] >= '0' && t[i] <= '9') ++i;
+    }
+    if (i < n && (t[i] == 'e' || t[i] == 'E')) {
+        ++i;
+        if (i < n && (t[i] == '+' || t[i] == '-')) ++i;
+        if (i >= n || t[i] < '0' || t[i] > '9') return false;
+        while (i < n && t[i] >= '0' && t[i] <= '9') ++i;
+    }
+    return i == n;
+}
+
+void lct_json_extract(const uint8_t* arena, int64_t arena_len,
+                      const int64_t* offsets, const int32_t* lengths,
+                      int64_t n,
+                      const uint8_t* keys_blob, const int32_t* key_lens,
+                      int64_t F,
+                      int32_t* out_offs, int32_t* out_lens,
+                      uint8_t* ok, uint8_t* fallback_mask) {
+    int64_t key_starts[128];
+    if (F > 128) F = 128;
+    {
+        int64_t acc = 0;
+        for (int64_t f = 0; f < F; ++f) { key_starts[f] = acc; acc += key_lens[f]; }
+    }
+    for (int64_t f = 0; f < F; ++f)
+        for (int64_t i = 0; i < n; ++i) out_lens[f * n + i] = -1;
+
+    for (int64_t i = 0; i < n; ++i) {
+        ok[i] = 0;
+        fallback_mask[i] = 0;
+        int64_t p = offsets[i];
+        int64_t end = p + lengths[i];
+        if (p < 0 || end > arena_len) { fallback_mask[i] = 1; continue; }
+        p = jskip_ws(arena, p, end);
+        if (p >= end || arena[p] != '{') { fallback_mask[i] = 1; continue; }
+        ++p;
+        bool bad = false, fellback = false;
+        p = jskip_ws(arena, p, end);
+        if (p < end && arena[p] == '}') {
+            // empty object: still only whitespace may follow
+            int64_t q = jskip_ws(arena, p + 1, end);
+            if (q == end) ok[i] = 1; else fallback_mask[i] = 1;
+            continue;
+        }
+        while (p < end) {
+            p = jskip_ws(arena, p, end);
+            if (p >= end || arena[p] != '"') { bad = true; break; }
+            bool kesc = false;
+            int64_t kstart = p + 1;
+            int64_t kq = jscan_string(arena, kstart, end, &kesc);
+            if (kq < 0 || kesc) { fellback = true; break; }
+            int64_t klen = kq - kstart;
+            p = jskip_ws(arena, kq + 1, end);
+            if (p >= end || arena[p] != ':') { bad = true; break; }
+            p = jskip_ws(arena, p + 1, end);
+            if (p >= end) { bad = true; break; }
+            int64_t voff, vlen;
+            uint8_t c = arena[p];
+            if (c == '"') {
+                bool vesc = false;
+                int64_t vstart = p + 1;
+                int64_t vq = jscan_string(arena, vstart, end, &vesc);
+                if (vq < 0) { bad = true; break; }
+                if (vesc) { fellback = true; break; }
+                voff = vstart; vlen = vq - vstart;
+                p = vq + 1;
+            } else if (c == '{' || c == '[') {
+                // bracket stack so mismatched nesting ({]}) is rejected
+                uint8_t stack[64];
+                int depth = 0;
+                int64_t q = p;
+                bool nested_bad = false;
+                while (q < end) {
+                    uint8_t d = arena[q];
+                    if (d == '"') {
+                        bool e2 = false;
+                        int64_t sq = jscan_string(arena, q + 1, end, &e2);
+                        if (sq < 0) { nested_bad = true; break; }
+                        q = sq + 1;
+                        continue;
+                    }
+                    if (d == '{' || d == '[') {
+                        if (depth >= 64) { nested_bad = true; break; }
+                        stack[depth++] = d;
+                    } else if (d == '}' || d == ']') {
+                        uint8_t want = (d == '}') ? '{' : '[';
+                        if (depth == 0 || stack[depth - 1] != want) {
+                            nested_bad = true;
+                            break;
+                        }
+                        if (--depth == 0) { ++q; break; }
+                    }
+                    ++q;
+                }
+                if (nested_bad || depth != 0) { bad = true; break; }
+                voff = p; vlen = q - p;
+                p = q;
+            } else {
+                // number / true / false / null: scan then validate the token
+                int64_t q = p;
+                while (q < end && arena[q] != ',' && arena[q] != '}' &&
+                       arena[q] != ' ' && arena[q] != '\t' &&
+                       arena[q] != '\n' && arena[q] != '\r')
+                    ++q;
+                voff = p; vlen = q - p;
+                if (vlen == 0 || !json_scalar_valid(arena + voff, vlen)) {
+                    bad = true;
+                    break;
+                }
+                p = q;
+            }
+            // match against known keys
+            bool known = false;
+            for (int64_t f = 0; f < F; ++f) {
+                if (key_lens[f] == klen &&
+                    memcmp(keys_blob + key_starts[f], arena + kstart,
+                           static_cast<size_t>(klen)) == 0) {
+                    out_offs[f * n + i] = static_cast<int32_t>(voff);
+                    out_lens[f * n + i] = static_cast<int32_t>(vlen);
+                    known = true;
+                    break;
+                }
+            }
+            if (!known) { fellback = true; break; }
+            p = jskip_ws(arena, p, end);
+            if (p < end && arena[p] == ',') { ++p; continue; }
+            if (p < end && arena[p] == '}') {
+                p = jskip_ws(arena, p + 1, end);
+                if (p == end) ok[i] = 1;
+                else bad = true;
+                break;
+            }
+            bad = true;
+            break;
+        }
+        if (fellback || bad) {
+            fallback_mask[i] = 1;
+            ok[i] = 0;
+            for (int64_t f = 0; f < F; ++f) out_lens[f * n + i] = -1;
+        }
+    }
+}
+
+}  // extern "C"

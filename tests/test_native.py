@@ -82,3 +82,79 @@ class TestSlsSerialize:
         monkeypatch.setattr(native, "sls_serialize", lambda *a, **k: None)
         assert native_bytes == ser.serialize([g])
         assert b"line-one" in native_bytes
+
+
+class TestNativeJsonExtract:
+    def _run(self, lines, keys):
+        blob = b"".join(lines)
+        arena = np.frombuffer(blob, np.uint8)
+        lens = np.array([len(l) for l in lines], np.int32)
+        offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+        return native.json_extract(arena, offs, lens, keys), arena
+
+    def test_scalar_spans(self):
+        lines = [b'{"a": 1, "b": "x", "c": true, "d": null, "e": -1.5e3}']
+        (offs, lens, ok, fb), arena = self._run(lines, [b"a", b"b", b"c",
+                                                        b"d", b"e"])
+        assert ok[0] and not fb[0]
+        def val(f):
+            return bytes(arena[offs[f,0]:offs[f,0]+lens[f,0]].tobytes())
+        assert val(0) == b"1"
+        assert val(1) == b"x"
+        assert val(2) == b"true"
+        assert val(3) == b"null"
+        assert val(4) == b"-1.5e3"
+
+    def test_nested_raw_span(self):
+        lines = [b'{"o": {"x": [1, "}"]}, "t": "y"}']
+        (offs, lens, ok, fb), arena = self._run(lines, [b"o", b"t"])
+        assert ok[0]
+        raw = bytes(arena[offs[0,0]:offs[0,0]+lens[0,0]].tobytes())
+        assert raw == b'{"x": [1, "}"]}'
+
+    def test_escape_falls_back(self):
+        lines = [b'{"a": "has \\" quote"}', b'{"a": "plain"}']
+        (offs, lens, ok, fb), arena = self._run(lines, [b"a"])
+        assert fb[0] and not ok[0]
+        assert ok[1] and not fb[1]
+
+    def test_unknown_key_falls_back(self):
+        lines = [b'{"a": 1, "zz": 2}']
+        (offs, lens, ok, fb), _ = self._run(lines, [b"a"])
+        assert fb[0]
+
+    def test_malformed_falls_back(self):
+        lines = [b'{"a": }', b'not json', b'[1,2]', b'{}']
+        (offs, lens, ok, fb), _ = self._run(lines, [b"a"])
+        assert fb[0] and fb[1] and fb[2]
+        assert ok[3]  # empty object is fine
+
+    def test_processor_mixed_fastpath_and_fallback(self):
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        data = (b'{"k": "v1", "n": 1}\n'
+                b'{"k": "esc\\"aped", "n": 2}\n'     # fallback (escape)
+                b'{"k": "v3", "n": 3, "extra": 9}\n'  # fallback (new key)
+                b'broken\n')
+        sb = SourceBuffer(len(data) + 64)
+        view = sb.copy_string(data)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(view)
+        ctx = PluginContext("t")
+        sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        pj = ProcessorParseJson(); pj.init({}, ctx); pj.process(g)
+        evs = g.materialize()
+        assert evs[0].get_content(b"k") == b"v1"
+        assert evs[1].get_content(b"k") == b'esc"aped'   # unescaped via host
+        assert evs[2].get_content(b"extra") == b"9"
+        assert evs[3].get_content(b"rawLog") == b"broken"
+
+    def test_strict_rejections(self):
+        lines = [b'{} trailing', b'{"a": truX}', b'{"a": {]}}',
+                 b'{"a": 01}', b'{"a": 1.}', b'{"a": 1e}', b'{"a": -0.5e+2}']
+        (offs, lens, ok, fb), _ = self._run(lines, [b"a"])
+        assert fb[0] and fb[1] and fb[2] and fb[3] and fb[4] and fb[5]
+        assert ok[6]  # valid exotic number stays fast-path
