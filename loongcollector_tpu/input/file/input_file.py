@@ -75,6 +75,11 @@ class InputStaticFile(Input):
         super().__init__()
         self.paths: List[str] = []
 
+    def inner_processor_configs(self) -> List[Dict[str, Any]]:
+        # static imports read raw chunks; they need the same line split the
+        # tailing input gets
+        return [{"Type": "processor_split_log_string_native"}]
+
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
         self.paths = list(config.get("FilePaths", []))

@@ -25,6 +25,7 @@ def register_all(registry) -> None:
     from .classify_url import ProcessorClassifyUrl
     from ..pipeline.plugin.dynamic import (DynamicCProcessor,
                                            DynamicPythonProcessor)
+    from .spl import ProcessorSPL
 
     registry.register_processor("processor_split_log_string_native",
                                 ProcessorSplitLogString)
@@ -56,3 +57,4 @@ def register_all(registry) -> None:
                                 ProcessorClassifyUrl)
     registry.register_processor("processor_dynamic", DynamicPythonProcessor)
     registry.register_processor("processor_dynamic_c", DynamicCProcessor)
+    registry.register_processor("processor_spl", ProcessorSPL)

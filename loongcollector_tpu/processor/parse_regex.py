@@ -93,8 +93,8 @@ class ProcessorParseRegex(Processor):
         # row path (non-columnar groups)
         sb = group.source_buffer
         for i, ev in enumerate(group.events):
-            if not hasattr(ev, "set_content"):
-                continue
+            if not hasattr(ev, "get_content"):
+                continue  # RawEvent/metric/span rows don't carry fields
             if ok[i]:
                 for g in range(min(self.engine.num_caps, len(self.keys))):
                     ln = int(res.cap_len[i, g])

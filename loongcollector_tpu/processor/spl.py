@@ -1,0 +1,335 @@
+"""processor_spl — pipeline query language over event groups.
+
+Reference: core/plugin/processor/ProcessorSPL.cpp bridges the (closed) SLS
+SPL engine; this framework implements the practically-used core of the
+language natively, columnar-first:
+
+    * | where level = 'ERROR'
+      | where msg matches 'timeout.*'        (device regex tier)
+      | where latency > 100
+      | parse content with regex '(?P<ip>\\S+) .*'
+      | extend combo = concat(host, ':', level)
+      | rename old as new
+      | project a, b, c          /  project-away x, y
+      | limit 100
+
+Stages execute left to right on the whole group; `where matches` runs the
+tiered device engine; `parse with regex` is the Tier-1 extraction kernel.
+Unsupported constructs fail init (surfaced at config load), never silently.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..models import PipelineEventGroup
+from ..ops.regex.engine import RegexEngine
+from ..pipeline.plugin.interface import PluginContext, Processor
+from .common import extract_source
+from .filter import compact_columns
+
+
+class SPLError(Exception):
+    pass
+
+
+_WHERE_RE = re.compile(
+    r"where\s+(\w+)\s*(>=|<=|!=|=|>|<|contains|matches)\s*(.+)", re.S)
+_PARSE_RE = re.compile(r"parse\s+(\w+)\s+with\s+regex\s+(.+)", re.S)
+_EXTEND_RE = re.compile(r"extend\s+(\w+)\s*=\s*(.+)", re.S)
+_RENAME_RE = re.compile(r"rename\s+(\w+)\s+as\s+(\w+)")
+_PROJECT_RE = re.compile(r"project(-away)?\s+(.+)")
+_LIMIT_RE = re.compile(r"limit\s+(\d+)")
+
+
+def _split_quote_aware(text: str, sep: str) -> List[str]:
+    """Split on sep outside single/double-quoted spans (quotes may contain
+    the separator — regex alternation pipes, literal commas)."""
+    out: List[str] = []
+    cur: List[str] = []
+    quote = ""
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if quote:
+            cur.append(c)
+            if c == quote:
+                quote = ""
+        elif c in "'\"":
+            quote = c
+            cur.append(c)
+        elif c == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unquote(v: str) -> str:
+    v = v.strip()
+    if len(v) >= 2 and v[0] == v[-1] and v[0] in "'\"":
+        return v[1:-1]
+    return v
+
+
+class _Stage:
+    def apply(self, group: PipelineEventGroup) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class _Where(_Stage):
+    def __init__(self, field: str, op: str, value: str):
+        self.field = field.encode()
+        self.op = op
+        self.value = _unquote(value)
+        self.engine: Optional[RegexEngine] = None
+        if op == "matches":
+            self.engine = RegexEngine(self.value)
+        self.num: Optional[float] = None
+        if op in (">", ">=", "<", "<="):
+            try:
+                self.num = float(self.value)
+            except ValueError:
+                raise SPLError(f"numeric comparison with non-number "
+                               f"{self.value!r}")
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        src = extract_source(group, self.field)
+        n = len(group)
+        if src is None:
+            keep = np.zeros(n, dtype=bool)
+        elif self.op == "matches":
+            keep = self.engine.match_batch(src.arena, src.offsets,
+                                           src.lengths) & src.present
+        else:
+            keep = np.zeros(n, dtype=bool)
+            want = self.value.encode()
+            raw = src.arena
+            for i in range(n):
+                if not src.present[i]:
+                    continue
+                o, ln = int(src.offsets[i]), int(src.lengths[i])
+                val = raw[o : o + ln].tobytes()
+                if self.op == "=":
+                    keep[i] = val == want
+                elif self.op == "!=":
+                    keep[i] = val != want
+                elif self.op == "contains":
+                    keep[i] = want in val
+                else:
+                    try:
+                        x = float(val)
+                    except ValueError:
+                        continue
+                    keep[i] = ((self.op == ">" and x > self.num)
+                               or (self.op == ">=" and x >= self.num)
+                               or (self.op == "<" and x < self.num)
+                               or (self.op == "<=" and x <= self.num))
+        _apply_keep(group, keep)
+
+
+class _Parse(_Stage):
+    def __init__(self, field: str, pattern: str):
+        self.field = field
+        self.engine = RegexEngine(_unquote(pattern))
+        if not self.engine.group_names:
+            raise SPLError("parse regex needs named groups (?P<name>...)")
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        src = extract_source(group, self.field.encode())
+        if src is None:
+            return
+        res = self.engine.parse_batch(src.arena, src.offsets, src.lengths)
+        cols = group.columns
+        ok = res.ok & src.present
+        for g in range(self.engine.num_caps):
+            name = self.engine.group_names.get(g)
+            if not name:
+                continue
+            lens = np.where(ok, res.cap_len[:, g], -1).astype(np.int32)
+            if cols is not None and not group._events:
+                cols.set_field(name, res.cap_off[:, g], lens)
+            else:
+                sb = group.source_buffer
+                for i, ev in enumerate(group.events):
+                    if lens[i] >= 0 and hasattr(ev, "get_content"):
+                        o = int(res.cap_off[i, g])
+                        ev.set_content(name.encode(), sb.copy_string(
+                            bytes(src.arena[o : o + lens[i]].tobytes())))
+
+
+class _Extend(_Stage):
+    """extend dst = concat(args...) | 'literal' | field"""
+
+    def __init__(self, dst: str, expr: str):
+        self.dst = dst
+        expr = expr.strip()
+        m = re.fullmatch(r"concat\((.+)\)", expr, re.S)
+        if m:
+            self.parts = [a.strip()
+                          for a in _split_quote_aware(m.group(1), ",")]
+        else:
+            self.parts = [expr]
+
+    def _value(self, part: str, fields: Dict[str, bytes]) -> bytes:
+        if part and part[0] in "'\"":
+            return _unquote(part).encode()
+        return fields.get(part, b"")
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        cols = group.columns
+        if cols is not None and not group._events:
+            n = len(cols)
+            raw = group.source_buffer.as_array()
+            offs = np.zeros(n, dtype=np.int32)
+            lens = np.full(n, -1, dtype=np.int32)
+            span_cols = {name: cols.fields[name] for name in cols.fields}
+            for i in range(n):
+                fields = {}
+                for name, (fo, fl) in span_cols.items():
+                    if fl[i] >= 0:
+                        o = int(fo[i])
+                        fields[name] = raw[o : o + int(fl[i])].tobytes()
+                if not cols.content_consumed:
+                    o, l = int(cols.offsets[i]), int(cols.lengths[i])
+                    fields["content"] = raw[o : o + l].tobytes()
+                out = b"".join(self._value(p, fields) for p in self.parts)
+                view = sb.copy_string(out)
+                offs[i] = view.offset
+                lens[i] = view.length
+            cols.set_field(self.dst, offs, lens)
+            return
+        for ev in group.events:
+            if not hasattr(ev, "contents"):
+                continue
+            fields = {k.to_str(): v.to_bytes() for k, v in ev.contents}
+            out = b"".join(self._value(p, fields) for p in self.parts)
+            ev.set_content(self.dst.encode(), sb.copy_string(out))
+
+
+class _Rename(_Stage):
+    def __init__(self, old: str, new: str):
+        self.old, self.new = old, new
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        cols = group.columns
+        if cols is not None and not group._events:
+            if self.old in cols.fields:
+                cols.fields[self.new] = cols.fields.pop(self.old)
+            return
+        for ev in group.events:
+            if hasattr(ev, "get_content"):
+                v = ev.get_content(self.old.encode())
+                if v is not None:
+                    ev.set_content(self.new.encode(), v)
+                    ev.del_content(self.old.encode())
+
+
+class _Project(_Stage):
+    def __init__(self, fields: List[str], away: bool):
+        self.fields = fields
+        self.away = away
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        cols = group.columns
+        if cols is not None and not group._events:
+            if self.away:
+                for f in self.fields:
+                    cols.fields.pop(f, None)
+            else:
+                cols.fields = {k: v for k, v in cols.fields.items()
+                               if k in self.fields}
+                if "content" not in self.fields:
+                    cols.content_consumed = True
+            return
+        keep = set(self.fields)
+        for ev in group.events:
+            if not hasattr(ev, "contents"):
+                continue
+            names = [k.to_bytes() for k, _ in ev.contents]
+            for name in names:
+                present = name.decode("utf-8", "replace") in keep
+                if self.away == present:
+                    ev.del_content(name)
+
+
+class _Limit(_Stage):
+    def __init__(self, n: int):
+        self.n = n
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        n = len(group)
+        if n <= self.n:
+            return
+        keep = np.zeros(n, dtype=bool)
+        keep[: self.n] = True
+        _apply_keep(group, keep)
+
+
+def _apply_keep(group: PipelineEventGroup, keep: np.ndarray) -> None:
+    if keep.all():
+        return
+    cols = group.columns
+    if cols is not None and not group._events:
+        group.set_columns(compact_columns(cols, keep))
+    else:
+        group._events = [ev for i, ev in enumerate(group.events) if keep[i]]
+
+
+def compile_spl(script: str) -> List[_Stage]:
+    stages: List[_Stage] = []
+    parts = [p.strip() for p in _split_quote_aware(script.strip(), "|")]
+    if parts and parts[0].strip() in ("*", ""):
+        parts = parts[1:]
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        if m := _WHERE_RE.fullmatch(part):
+            stages.append(_Where(m.group(1), m.group(2), m.group(3)))
+        elif m := _PARSE_RE.fullmatch(part):
+            stages.append(_Parse(m.group(1), m.group(2)))
+        elif m := _EXTEND_RE.fullmatch(part):
+            stages.append(_Extend(m.group(1), m.group(2)))
+        elif m := _RENAME_RE.fullmatch(part):
+            stages.append(_Rename(m.group(1), m.group(2)))
+        elif m := _PROJECT_RE.fullmatch(part):
+            fields = [f.strip() for f in m.group(2).split(",")]
+            stages.append(_Project(fields, away=bool(m.group(1))))
+        elif m := _LIMIT_RE.fullmatch(part):
+            stages.append(_Limit(int(m.group(1))))
+        else:
+            raise SPLError(f"unsupported SPL stage: {part!r}")
+    return stages
+
+
+class ProcessorSPL(Processor):
+    name = "processor_spl"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.stages: List[_Stage] = []
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        script = config.get("Script", "")
+        if not script:
+            return False
+        try:
+            self.stages = compile_spl(script)
+        except (SPLError, re.error) as e:
+            from ..utils.logger import get_logger
+            get_logger("spl").error("SPL compile failed: %s", e)
+            return False
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        for stage in self.stages:
+            stage.apply(group)

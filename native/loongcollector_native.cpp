@@ -232,6 +232,9 @@ static inline int64_t jscan_string(const uint8_t* a, int64_t p, int64_t end,
         uint8_t c = a[p];
         if (c == '\\') { *had_escape = true; p += 2; continue; }
         if (c == '"') return p;
+        if (c < 0x20) { *had_escape = true; ++p; continue; }  // strict JSON:
+        // raw control chars are invalid — flag so the event falls back to
+        // the host parser, keeping both paths' accept/reject identical
         ++p;
     }
     return -1;

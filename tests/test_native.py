@@ -158,3 +158,9 @@ class TestNativeJsonExtract:
         (offs, lens, ok, fb), _ = self._run(lines, [b"a"])
         assert fb[0] and fb[1] and fb[2] and fb[3] and fb[4] and fb[5]
         assert ok[6]  # valid exotic number stays fast-path
+
+    def test_control_char_falls_back(self):
+        lines = [b'{"a": "x\x01y"}', b'{"a": "clean"}']
+        (offs, lens, ok, fb), _ = self._run(lines, [b"a"])
+        assert fb[0] and not ok[0]  # host json.loads also rejects this
+        assert ok[1]

@@ -1,0 +1,96 @@
+"""processor_spl: query-language stages over columnar groups."""
+
+import pytest
+
+from loongcollector_tpu.processor.spl import ProcessorSPL, SPLError, compile_spl
+
+from test_processors import CTX, split_group
+
+
+def run_spl(script, data):
+    g = split_group(data)
+    p = ProcessorSPL()
+    assert p.init({"Script": script}, CTX), script
+    p.process(g)
+    return g
+
+
+class TestSPL:
+    def test_parse_where_project(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<level>\w+) (?P<msg>.*)'"
+            r" | where level = 'ERROR' | project level, msg",
+            b"ERROR disk full\nINFO fine\nERROR cpu hot\n")
+        events = g.materialize()
+        assert len(events) == 2
+        assert events[0].get_content(b"msg") == b"disk full"
+        assert not events[0].has_content(b"content")
+
+    def test_where_matches_device(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<path>\S+) (?P<code>\d+)'"
+            r" | where path matches '/api/.*'",
+            b"/api/users 200\n/static/x 200\n/api/pay 500\n")
+        assert len(g) == 2
+
+    def test_numeric_comparison(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<name>\w+)=(?P<ms>\d+)'"
+            r" | where ms > 100",
+            b"a=250\nb=50\nc=101\n")
+        events = g.materialize()
+        assert [e.get_content(b"name").to_bytes() for e in events] == [b"a", b"c"]
+
+    def test_extend_concat_and_rename(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<h>\w+):(?P<l>\w+)'"
+            r" | extend combo = concat(h, '-', l) | rename combo as id"
+            r" | project id",
+            b"n1:ERROR\nn2:WARN\n")
+        events = g.materialize()
+        assert events[0].get_content(b"id") == b"n1-ERROR"
+        assert events[1].get_content(b"id") == b"n2-WARN"
+
+    def test_limit(self):
+        g = run_spl("* | limit 2", b"a\nb\nc\nd\n")
+        assert len(g) == 2
+
+    def test_contains(self):
+        g = run_spl("* | where content contains 'needle'",
+                    b"has needle here\nnothing\nneedle again\n")
+        assert len(g) == 2
+
+    def test_unsupported_stage_fails_init(self):
+        p = ProcessorSPL()
+        assert not p.init({"Script": "* | frobnicate x"}, CTX)
+
+    def test_bad_regex_fails_init(self):
+        p = ProcessorSPL()
+        assert not p.init({"Script": "* | parse content with regex '('"}, CTX)
+
+
+class TestSPLReviewFixes:
+    def test_pipe_inside_regex_literal(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<m>GET|POST) (?P<p>\S+)'"
+            r" | where m = 'POST'",
+            b"GET /a\nPOST /b\n")
+        assert len(g) == 1
+        assert g.materialize()[0].get_content(b"p") == b"/b"
+
+    def test_gte_lte_operators(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<n>\d+)' | where n >= 100",
+            b"99\n100\n101\n")
+        assert len(g) == 2
+        g2 = run_spl(
+            r"* | parse content with regex '(?P<n>\d+)' | where n <= 100",
+            b"99\n100\n101\n")
+        assert len(g2) == 2
+
+    def test_concat_with_comma_literal(self):
+        g = run_spl(
+            r"* | parse content with regex '(?P<a>\w+) (?P<b>\w+)'"
+            r" | extend x = concat(a, ', ', b) | project x",
+            b"hello world\n")
+        assert g.materialize()[0].get_content(b"x") == b"hello, world"
