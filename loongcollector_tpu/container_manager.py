@@ -174,7 +174,9 @@ class ContainerManager:
         self.cri = CRIDiscovery()
         self._last: Dict[str, ContainerInfo] = {}
         self._lock = threading.Lock()
-        self.on_diff = None  # callback(added, removed)
+        self.on_diff = None  # callback(added, removed) -> bool (delivered)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
 
     @classmethod
     def instance(cls) -> "ContainerManager":
@@ -189,15 +191,53 @@ class ContainerManager:
 
     def diff_round(self) -> tuple:
         """One discovery diff (reference: container diff each supervision
-        round, Application.cpp:386-392)."""
+        round, Application.cpp:386-392).  The diff baseline only advances
+        when delivery succeeds, so a full queue re-emits next round rather
+        than losing the add/remove events."""
         found = {c.id: c for c in self.discover()}
         with self._lock:
             added = [c for cid, c in found.items() if cid not in self._last]
             removed = [c for cid, c in self._last.items() if cid not in found]
-            self._last = found
+        delivered = True
         if (added or removed) and self.on_diff is not None:
-            self.on_diff(added, removed)
+            try:
+                delivered = self.on_diff(added, removed) is not False
+            except Exception:  # noqa: BLE001
+                log.exception("container diff delivery failed")
+                delivered = False
+        if delivered:
+            with self._lock:
+                self._last = found
         return added, removed
+
+    def set_on_diff(self, callback) -> bool:
+        """Install the (single) diff consumer and run discovery on an owned
+        thread — discovery does blocking socket/FS I/O and must not ride the
+        application supervision loop.  Returns False if already claimed."""
+        with self._lock:
+            if callback is not None and self.on_diff is not None:
+                return False
+            self.on_diff = callback
+            start = callback is not None and not self._running
+            if callback is None:
+                self._running = False
+        if start:
+            self._running = True
+            self._thread = threading.Thread(target=self._run,
+                                            name="container-diff", daemon=True)
+            self._thread.start()
+        return True
+
+    def _run(self) -> None:
+        while self._running:
+            try:
+                self.diff_round()
+            except Exception:  # noqa: BLE001
+                log.exception("container diff failed")
+            for _ in range(100):
+                if not self._running:
+                    return
+                time.sleep(0.1)
 
 
 class K8sMetadata:

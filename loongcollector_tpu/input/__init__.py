@@ -3,23 +3,30 @@
 
 def register_all(registry) -> None:
     from .file.input_file import InputFile, InputStaticFile
-    from .host_monitor import InputHostMonitor
-    from .internal import InputInternalAlarms, InputInternalMetrics
+    from .host_monitor import InputHostMeta, InputHostMonitor
+    from .internal import (InputInternalAlarms,
+                           InputInternalMatchedContainerInfo,
+                           InputInternalMetrics)
     from .prometheus.scraper import InputPrometheus
-    from .ebpf.server import (InputFileSecurity, InputNetworkObserver,
-                              InputNetworkSecurity, InputProcessSecurity)
+    from .ebpf.server import (InputCpuProfiling, InputFileSecurity,
+                              InputNetworkObserver, InputNetworkSecurity,
+                              InputProcessSecurity)
     from .forward import InputForward
     from .container_stdio import InputContainerStdio
 
     registry.register_input("input_file", InputFile)
     registry.register_input("input_static_file_onetime", InputStaticFile)
     registry.register_input("input_host_monitor", InputHostMonitor)
+    registry.register_input("input_host_meta", InputHostMeta)
     registry.register_input("input_internal_metrics", InputInternalMetrics)
     registry.register_input("input_internal_alarms", InputInternalAlarms)
+    registry.register_input("input_internal_matched_container_info",
+                            InputInternalMatchedContainerInfo)
     registry.register_input("input_prometheus", InputPrometheus)
     registry.register_input("input_network_observer", InputNetworkObserver)
     registry.register_input("input_process_security", InputProcessSecurity)
     registry.register_input("input_file_security", InputFileSecurity)
     registry.register_input("input_network_security", InputNetworkSecurity)
+    registry.register_input("input_cpu_profiling", InputCpuProfiling)
     registry.register_input("input_forward", InputForward)
     registry.register_input("input_container_stdio", InputContainerStdio)

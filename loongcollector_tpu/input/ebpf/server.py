@@ -173,6 +173,32 @@ class SecurityManager(_SourceManager):
         return group
 
 
+class CpuProfilingManager(_SourceManager):
+    """On-CPU stack samples → profile events (reference CpuProfiler +
+    cpu_profiling plugin manager): one LogEvent per aggregated
+    (pid, stack) with a sample count per flush window."""
+
+    def build_group(self, events):
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        cache = self.server.process_cache
+        agg: Dict[tuple, int] = {}
+        for raw in events:
+            key = (raw.pid, tuple(raw.stack))
+            agg[key] = agg.get(key, 0) + 1
+        now = int(time.time())
+        for (pid, stack), count in agg.items():
+            ev = group.add_log_event(now)
+            comm, _ = cache.lookup(pid)
+            ev.set_content(b"pid", sb.copy_string(str(pid)))
+            if comm:
+                ev.set_content(b"comm", sb.copy_string(comm))
+            ev.set_content(b"stack", sb.copy_string(";".join(stack)))
+            ev.set_content(b"count", sb.copy_string(str(count)))
+        group.set_tag(b"__source__", b"ebpf_cpu_profiling")
+        return group
+
+
 class EBPFServer:
     _instance: Optional["EBPFServer"] = None
     _instance_lock = threading.Lock()
@@ -201,8 +227,12 @@ class EBPFServer:
                       source.value)
             return False
         if mgr is None:
-            cls = (NetworkObserverManager
-                   if source is EventSource.NETWORK_OBSERVE else SecurityManager)
+            if source is EventSource.NETWORK_OBSERVE:
+                cls = NetworkObserverManager
+            elif source is EventSource.CPU_PROFILING:
+                cls = CpuProfilingManager
+            else:
+                cls = SecurityManager
             mgr = cls(source, self)
             self._managers[source] = mgr
         mgr.queue_key = queue_key
@@ -286,3 +316,8 @@ class InputFileSecurity(_EBPFInputBase):
 class InputNetworkSecurity(_EBPFInputBase):
     name = "input_network_security"
     source = EventSource.NETWORK_SECURITY
+
+
+class InputCpuProfiling(_EBPFInputBase):
+    name = "input_cpu_profiling"
+    source = EventSource.CPU_PROFILING

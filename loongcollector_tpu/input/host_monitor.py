@@ -212,6 +212,16 @@ class HostMonitorInputRunner:
         with self._lock:
             self._registrations[name] = (insts, interval_s, queue_key, [0.0])
 
+    def register_group_collector(self, name: str, fn, interval_s: float,
+                                 queue_key: int,
+                                 immediate: bool = False) -> None:
+        """Schedule an arbitrary group-producing callable (entity snapshots
+        etc.); fn() -> Optional[PipelineEventGroup]."""
+        with self._lock:
+            self._registrations[name] = (
+                fn, interval_s, queue_key,
+                [0.0 if immediate else time.monotonic()])
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._registrations.pop(name, None)
@@ -242,7 +252,14 @@ class HostMonitorInputRunner:
                     continue
                 last[0] = now
                 try:
-                    self.collect_once(insts, queue_key)
+                    if callable(insts):
+                        group = insts()
+                        if group is not None and not group.empty() \
+                                and self.process_queue_manager is not None:
+                            self.process_queue_manager.push_queue(queue_key,
+                                                                  group)
+                    else:
+                        self.collect_once(insts, queue_key)
                 except Exception:  # noqa: BLE001
                     log.exception("host monitor collect failed: %s", name)
 
@@ -259,6 +276,96 @@ class HostMonitorInputRunner:
                     ev.set_tag(sb.copy_string(k), sb.copy_string(v))
         if not group.empty() and self.process_queue_manager is not None:
             self.process_queue_manager.push_queue(queue_key, group)
+
+
+class HostMetaCollector:
+    """Entity snapshots (reference InputHostMeta): one host entity plus one
+    entity per running process, shaped as log events with entity fields."""
+
+    name = "host_meta"
+
+    def collect_entities(self):
+        import socket
+        entities = []
+        host = {
+            "__entity_type__": "host",
+            "hostname": socket.gethostname(),
+            "os": "linux",
+        }
+        try:
+            with open("/proc/sys/kernel/osrelease") as f:
+                host["kernel"] = f.read().strip()
+        except OSError:
+            pass
+        entities.append(host)
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/comm") as f:
+                    comm = f.read().strip()
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace").strip()
+                st = os.stat(f"/proc/{pid}")
+            except OSError:
+                continue
+            entities.append({
+                "__entity_type__": "process",
+                "pid": pid,
+                "comm": comm,
+                "cmdline": cmdline[:512],
+                "uid": str(st.st_uid),
+            })
+        return entities
+
+
+class InputHostMeta(Input):
+    """Periodic host/process entity snapshots, scheduled through the shared
+    HostMonitorInputRunner (one timer thread for all host collectors)."""
+
+    name = "input_host_meta"
+    is_singleton = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.interval_s = 300.0
+
+    def init(self, config, context) -> bool:
+        super().init(config, context)
+        self.interval_s = float(config.get("IntervalSeconds", 300))
+        return True
+
+    def _build_group(self):
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ts = int(time.time())
+        for entity in HostMetaCollector().collect_entities():
+            ev = group.add_log_event(ts)
+            for k, v in entity.items():
+                ev.set_content(sb.copy_string(k), sb.copy_string(v))
+        group.set_tag(b"__source__", b"host_meta")
+        return group
+
+    def collect_once(self) -> None:
+        runner = HostMonitorInputRunner.instance()
+        if runner.process_queue_manager is None:
+            return
+        runner.process_queue_manager.push_queue(
+            self.context.process_queue_key, self._build_group())
+
+    def start(self) -> bool:
+        runner = HostMonitorInputRunner.instance()
+        runner.register_group_collector(
+            f"{self.context.pipeline_name}#hostmeta", self._build_group,
+            self.interval_s, self.context.process_queue_key, immediate=True)
+        runner.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        HostMonitorInputRunner.instance().unregister(
+            f"{self.context.pipeline_name}#hostmeta")
+        return True
 
 
 class InputHostMonitor(Input):

@@ -7,8 +7,11 @@ groups to a normal pipeline (SURVEY.md §2.6 self-monitor pipelines).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict
 
+from ..container_manager import ContainerManager
+from ..models import PipelineEventGroup
 from ..monitor.self_monitor import SelfMonitorServer
 from ..pipeline.plugin.interface import Input, PluginContext
 
@@ -32,6 +35,57 @@ class InputInternalMetrics(Input):
 
     def stop(self, is_pipeline_removing: bool = False) -> bool:
         SelfMonitorServer.instance().set_metrics_pipeline(None)
+        return True
+
+
+class InputInternalMatchedContainerInfo(Input):
+    """Ships container discovery diffs as events (reference
+    InputInternalMatchedContainerInfo + ContainerManager.cpp:325)."""
+
+    name = "input_internal_matched_container_info"
+    is_singleton = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._callback = None
+
+    def start(self) -> bool:
+        mgr = ContainerManager.instance()
+        queue_key = self.context.process_queue_key
+
+        def on_diff(added, removed) -> bool:
+            group = PipelineEventGroup()
+            sb = group.source_buffer
+            now = int(time.time())
+            for info, action in ([(c, "added") for c in added]
+                                 + [(c, "removed") for c in removed]):
+                ev = group.add_log_event(now)
+                ev.set_content(b"action", sb.copy_string(action))
+                ev.set_content(b"container_id", sb.copy_string(info.id))
+                ev.set_content(b"container_name", sb.copy_string(info.name))
+                if info.k8s_pod:
+                    ev.set_content(b"pod", sb.copy_string(info.k8s_pod))
+                    ev.set_content(b"namespace",
+                                   sb.copy_string(info.k8s_namespace))
+            group.set_tag(b"__source__", b"matched_container_info")
+            server = SelfMonitorServer.instance()
+            if server.process_queue_manager is None or group.empty():
+                return True
+            return server.process_queue_manager.push_queue(queue_key, group)
+
+        self._callback = on_diff
+        if not mgr.set_on_diff(on_diff):
+            from ..utils.logger import get_logger
+            get_logger("internal").error(
+                "matched_container_info already bound to another pipeline")
+            return False
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        mgr = ContainerManager.instance()
+        # only the owning pipeline clears the consumer slot
+        if mgr.on_diff is self._callback:
+            mgr.set_on_diff(None)
         return True
 
 

@@ -228,3 +228,28 @@ class TestContainerStdioE2E:
             runner.stop()
             fs.stop()
             FileServer._instance = None
+
+
+class TestCpuProfiling:
+    def test_stack_aggregation(self):
+        adapter = MockAdapter()
+        server = EBPFServer()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(79)
+        server.process_queue_manager = pqm
+        server.adapter = adapter
+        assert server.enable_plugin(EventSource.CPU_PROFILING, 79)
+        for _ in range(3):
+            adapter.feed(RawKernelEvent(
+                source=EventSource.CPU_PROFILING, pid=1,
+                stack=["main", "work", "hot_loop"]))
+        adapter.feed(RawKernelEvent(
+            source=EventSource.CPU_PROFILING, pid=1,
+            stack=["main", "idle"]))
+        server._managers[EventSource.CPU_PROFILING].flush()
+        _, group = pqm.pop_item(timeout=0)
+        by_stack = {str(ev.get_content(b"stack")): str(ev.get_content(b"count"))
+                    for ev in group.events}
+        assert by_stack["main;work;hot_loop"] == "3"
+        assert by_stack["main;idle"] == "1"
+        server.stop()

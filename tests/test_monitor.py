@@ -100,3 +100,28 @@ class TestWatchdog:
     def test_self_stat_readable(self):
         ticks, rss = _read_self_stat()
         assert ticks >= 0 and rss > 0
+
+
+class TestHostMeta:
+    def test_entities(self):
+        from loongcollector_tpu.input.host_monitor import HostMetaCollector
+        ents = HostMetaCollector().collect_entities()
+        assert ents[0]["__entity_type__"] == "host"
+        procs = [e for e in ents if e["__entity_type__"] == "process"]
+        assert procs and any(e["pid"] == "1" for e in procs)
+
+    def test_input_pushes_group(self):
+        from loongcollector_tpu.input.host_monitor import (
+            HostMonitorInputRunner, InputHostMeta)
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(88)
+        HostMonitorInputRunner.instance().process_queue_manager = pqm
+        inp = InputHostMeta()
+        ctx = PluginContext("hm")
+        ctx.process_queue_key = 88
+        inp.init({}, ctx)
+        inp.collect_once()
+        key, group = pqm.pop_item(timeout=0)
+        assert key == 88
+        assert group.get_tag(b"__source__") == b"host_meta"
