@@ -17,17 +17,19 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+import threading
+
 from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows,
                             pick_length_bucket)
+from ..kernels.dfa_scan import DFAMatchKernel
+from ..kernels.field_extract import ExtractKernel
+from .dfa import DFAUnsupported, compile_dfa
+from .program import PatternTier, Tier1Unsupported, compile_tier1
 
 
 def _chunks(idx: np.ndarray, size: int):
     for i in range(0, len(idx), size):
         yield idx[i : i + size]
-from ..kernels.dfa_scan import DFAMatchKernel
-from ..kernels.field_extract import ExtractKernel
-from .dfa import DFAUnsupported, compile_dfa
-from .program import PatternTier, Tier1Unsupported, compile_tier1
 
 
 class BatchParseResult:
@@ -40,6 +42,36 @@ class BatchParseResult:
         self.ok = ok
         self.cap_off = cap_off
         self.cap_len = cap_len
+
+
+from collections import OrderedDict
+
+_engine_cache: "OrderedDict" = OrderedDict()
+_engine_cache_lock = threading.Lock()
+_ENGINE_CACHE_MAX = 512
+
+
+def get_engine(pattern: str,
+               force_tier: Optional[PatternTier] = None) -> "RegexEngine":
+    """Process-wide engine cache: pipeline reloads and same-pattern plugins
+    reuse compiled kernels instead of re-jitting (compilation is the
+    dominant cost of a pipeline swap)."""
+    if isinstance(pattern, bytes):
+        pattern = pattern.decode("latin-1")
+    key = (pattern, force_tier)
+    with _engine_cache_lock:
+        eng = _engine_cache.get(key)
+        if eng is not None:
+            _engine_cache.move_to_end(key)  # LRU touch
+            return eng
+    # compile outside the lock (jit can take seconds); races build the same
+    # engine twice at worst
+    eng = RegexEngine(pattern, force_tier)
+    with _engine_cache_lock:
+        _engine_cache[key] = eng
+        while len(_engine_cache) > _ENGINE_CACHE_MAX:
+            _engine_cache.popitem(last=False)  # evict least-recently used
+    return eng
 
 
 class RegexEngine:

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 from ..models import PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import extract_source
 
@@ -39,7 +39,7 @@ class ProcessorClassifyUrl(Processor):
             pattern = rule.get("Regex", "")
             if not name or not pattern:
                 return False
-            self.rules.append((name.encode(), RegexEngine(pattern)))
+            self.rules.append((name.encode(), get_engine(pattern)))
         return bool(self.rules)
 
     def process(self, group: PipelineEventGroup) -> None:

@@ -15,7 +15,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..models import ColumnarLogs, PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import extract_source
 
@@ -42,9 +42,9 @@ class ProcessorFilter(Processor):
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
         for k, pattern in (config.get("Include") or {}).items():
-            self.include.append((k.encode(), RegexEngine(pattern)))
+            self.include.append((k.encode(), get_engine(pattern)))
         for k, pattern in (config.get("Exclude") or {}).items():
-            self.exclude.append((k.encode(), RegexEngine(pattern)))
+            self.exclude.append((k.encode(), get_engine(pattern)))
         return True
 
     def _match_field(self, group: PipelineEventGroup, key: bytes,

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 from ..models import PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..ops.regex.grok import GrokError, expand
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import RAW_LOG_KEY, extract_source
@@ -45,7 +45,7 @@ class ProcessorGrok(Processor):
         for pattern in match:
             try:
                 regex = expand(pattern, custom)
-                engine = RegexEngine(regex)
+                engine = get_engine(regex)
             except (GrokError, _re.error):
                 return False
             # only NAMED groups become fields (grok semantics)

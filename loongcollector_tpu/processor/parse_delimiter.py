@@ -18,7 +18,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..models import PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import RAW_LOG_KEY, extract_source
 
@@ -92,7 +92,7 @@ class ProcessorParseDelimiter(Processor):
                 parts = [f"({neg}*)"] * (len(self.keys) - 1) + ["(.*)"] \
                     if len(self.keys) > 1 else ["(.*)"]
                 pattern = esc.join(parts)
-                self.engine = RegexEngine(pattern)
+                self.engine = get_engine(pattern)
         return True
 
     def process(self, group: PipelineEventGroup) -> None:

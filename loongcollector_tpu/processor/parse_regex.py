@@ -21,7 +21,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ..models import ColumnarLogs, PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import RAW_LOG_KEY, extract_source
 
@@ -51,7 +51,7 @@ class ProcessorParseRegex(Processor):
             config.get("KeepingSourceWhenParseSucceed", False))
         self.renamed_source_key = config.get("RenamedSourceKey", RAW_LOG_KEY)
         self.discard_unmatch = not self.keep_source_on_fail
-        self.engine = RegexEngine(self.regex)
+        self.engine = get_engine(self.regex)
         # name capture groups: config Keys win; else named groups; else g{N}
         if not self.keys:
             self.keys = [self.engine.group_names.get(i, f"g{i+1}")

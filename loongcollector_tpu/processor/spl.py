@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..models import PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import extract_source
 from .filter import compact_columns
@@ -90,7 +90,7 @@ class _Where(_Stage):
         self.value = _unquote(value)
         self.engine: Optional[RegexEngine] = None
         if op == "matches":
-            self.engine = RegexEngine(self.value)
+            self.engine = get_engine(self.value)
         self.num: Optional[float] = None
         if op in (">", ">=", "<", "<="):
             try:
@@ -137,7 +137,7 @@ class _Where(_Stage):
 class _Parse(_Stage):
     def __init__(self, field: str, pattern: str):
         self.field = field
-        self.engine = RegexEngine(_unquote(pattern))
+        self.engine = get_engine(_unquote(pattern))
         if not self.engine.group_names:
             raise SPLError("parse regex needs named groups (?P<name>...)")
 

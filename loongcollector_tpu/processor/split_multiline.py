@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..models import ColumnarLogs, PipelineEventGroup
-from ..ops.regex.engine import RegexEngine
+from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 
 
@@ -41,9 +41,9 @@ class ProcessorSplitMultilineLogString(Processor):
         sp = mcfg.get("StartPattern")
         cp = mcfg.get("ContinuePattern")
         ep = mcfg.get("EndPattern")
-        self.start = RegexEngine(self._fullmatchify(sp)) if sp else None
-        self.cont = RegexEngine(self._fullmatchify(cp)) if cp else None
-        self.end = RegexEngine(self._fullmatchify(ep)) if ep else None
+        self.start = get_engine(self._fullmatchify(sp)) if sp else None
+        self.cont = get_engine(self._fullmatchify(cp)) if cp else None
+        self.end = get_engine(self._fullmatchify(ep)) if ep else None
         self.unmatched = mcfg.get("UnmatchedContentTreatment", "single_line")
         return self.start is not None or self.end is not None
 
