@@ -98,25 +98,34 @@ def build_extract_fn(program: SegmentProgram):
     intervals = [c.intervals() for c in program.classes]
     comp_intervals = [c.negated().intervals() for c in program.classes]
     top_ops = list(program.ops)
+    suffix_ops = list(program.suffix_ops) if program.suffix_ops else None
+    pivot = program.pivot
+    split_caps = list(program.split_caps)
 
     span_classes: set = set()
     count_classes: set = set()
     literals: set = set()
 
-    def collect(ops):
+    def collect(ops, reverse=False):
         for op in ops:
             if isinstance(op, Span):
                 span_classes.add(op.class_id)
             elif isinstance(op, FixedSpan):
                 count_classes.add(op.class_id)
             elif isinstance(op, Lit):
-                literals.add(op.data)
+                # reverse-walk literals are stored reversed; the lit_ok map
+                # is keyed by the forward spelling (match starting at l)
+                literals.add(op.data[::-1] if reverse else op.data)
             elif isinstance(op, Optional_):
-                collect(op.body)
+                collect(op.body, reverse)
             elif isinstance(op, Alt):
                 for b in op.branches:
-                    collect(b)
+                    collect(b, reverse)
     collect(top_ops)
+    if suffix_ops:
+        collect(suffix_ops, reverse=True)
+    if pivot is not None:
+        count_classes.add(pivot.class_id)  # membership mask for the span check
 
     def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
         B, L = rows.shape
@@ -216,8 +225,130 @@ def build_extract_fn(program: SegmentProgram):
                 else:  # pragma: no cover
                     raise AssertionError(op)
 
+        def emit_reverse(ops, st: _WalkState, active, floor) -> None:
+            """Right-to-left walk: st.cur is the EXCLUSIVE end boundary and
+            moves toward 0.  Ops arrive pre-reversed (literal bytes too);
+            the original CapEnd (seen first) records the group's right edge
+            into cap_start, and CapStart closes it."""
+            for op in ops:
+                if isinstance(op, Lit):
+                    k = len(op.data)
+                    # match the (already reversed) literal ENDING at cur:
+                    # forward bytes start at cur-k
+                    fwd = op.data[::-1]
+                    start = st.cur - k
+                    hit = jnp.any((pos == start[:, None]) & lit_ok[fwd],
+                                  axis=1) & (start >= 0)
+                    st.ok = jnp.where(active, st.ok & hit, st.ok)
+                    st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
+                elif isinstance(op, Span):
+                    m = member[op.class_id]
+                    # last non-member strictly below cur → run starts after it
+                    cand = jnp.where(~m & (pos < st.cur[:, None]), pos,
+                                     jnp.int32(-1))
+                    start = jnp.max(cand, axis=1) + 1
+                    if op.max_len != INF:
+                        # bounded-maximal: a finite repeat takes at most
+                        # max_len — the bytes below the clamp belong to
+                        # whatever precedes (pivot or earlier suffix ops),
+                        # whose own checks cascade a genuine mismatch
+                        start = jnp.maximum(start, st.cur - op.max_len)
+                    # the suffix may not reach below the pivot's minimal end:
+                    # bytes under the floor belong to the prefix + pivot
+                    start = jnp.maximum(start, floor)
+                    start = jnp.minimum(jnp.maximum(start, 0), st.cur)
+                    run = st.cur - start
+                    new_ok = st.ok & (run >= op.min_len)
+                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.cur = jnp.where(active, start, st.cur)
+                elif isinstance(op, FixedSpan):
+                    start = st.cur - op.n
+                    new_ok = st.ok & (start >= 0)
+                    if op.n > 0:
+                        inside = ((pos >= start[:, None])
+                                  & (pos < st.cur[:, None]))
+                        cnt = jnp.sum((member[op.class_id] & inside)
+                                      .astype(i32), axis=1)
+                        new_ok = new_ok & (cnt == op.n)
+                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
+                elif isinstance(op, CapEnd):
+                    # right edge of the group (encountered first in reverse)
+                    st.cap_start[op.cap_id] = jnp.where(
+                        active, st.cur, st.cap_start[op.cap_id])
+                elif isinstance(op, CapStart):
+                    end = st.cap_start[op.cap_id]
+                    st.cap_off[op.cap_id] = jnp.where(
+                        active, st.cur, st.cap_off[op.cap_id])
+                    st.cap_len[op.cap_id] = jnp.where(
+                        active, end - st.cur, st.cap_len[op.cap_id])
+                elif isinstance(op, Optional_):
+                    before = st.copy()
+                    emit_reverse(op.body, st, active, floor)
+                    take = active & st.ok
+                    merged = _WalkState(st.cur, st.ok, 0, init_caps=False)
+                    merged.select(take, st, before)
+                    st.cur, st.ok = merged.cur, merged.ok
+                    st.cap_off, st.cap_len = merged.cap_off, merged.cap_len
+                    st.cap_start = merged.cap_start
+                elif isinstance(op, Alt):
+                    before = st.copy()
+                    chosen_any = jnp.zeros_like(st.ok)
+                    result = before.copy()
+                    remaining = active & st.ok
+                    for branch in op.branches:
+                        trial = before.copy()
+                        emit_reverse(branch, trial, remaining, floor)
+                        chosen = remaining & trial.ok
+                        merged = _WalkState(result.cur, result.ok, 0,
+                                            init_caps=False)
+                        merged.select(chosen, trial, result)
+                        result = merged
+                        chosen_any = chosen_any | chosen
+                        remaining = remaining & ~chosen
+                    st.cur = jnp.where(active, result.cur, before.cur)
+                    st.ok = jnp.where(active, chosen_any, before.ok)
+                    st.cap_off = result.cap_off
+                    st.cap_len = result.cap_len
+                    st.cap_start = result.cap_start
+                else:  # pragma: no cover
+                    raise AssertionError(op)
+
         st = _WalkState(jnp.zeros(B, i32), jnp.ones(B, bool), ncaps)
         emit(top_ops, st, jnp.ones(B, bool))
+
+        if pivot is not None:
+            # snapshot the forward left edges of split captures BEFORE the
+            # reverse walk (its CapEnd reuses cap_start for right edges)
+            fwd_starts = {k: st.cap_start[k] for k in split_caps}
+            # reverse walk from the line end shares the capture state
+            rst = st.copy()
+            rst.cur = lengths
+            emit_reverse(suffix_ops, rst, jnp.ones(B, bool),
+                         st.cur + pivot.min_len)
+            # pivot covers [st.cur, rst.cur): must be all pivot-class bytes
+            # within the span's length bounds (masked sum — no gathers)
+            lo = st.cur
+            hi = rst.cur
+            run = hi - lo
+            inside = (pos >= lo[:, None]) & (pos < hi[:, None])
+            cnt = jnp.sum((member[pivot.class_id] & inside).astype(i32),
+                          axis=1)
+            ok = st.ok & rst.ok & (rst.cur >= st.cur) & (cnt == run)
+            ok = ok & (run >= pivot.min_len)
+            if pivot.max_len != INF:
+                ok = ok & (run <= pivot.max_len)
+            # merge captures: split groups open where the FORWARD walk put
+            # their CapStart and close at the reverse walk's right edge
+            final = rst
+            for k in split_caps:
+                final.cap_off[k] = fwd_starts[k]
+                final.cap_len[k] = rst.cap_start[k] - fwd_starts[k]
+            off = jnp.stack(final.cap_off, axis=1)
+            length = jnp.stack(final.cap_len, axis=1)
+            length = jnp.where(ok[:, None], length, -1)
+            off = jnp.where(ok[:, None], off, 0)
+            return ok, off, length
 
         ok = st.ok & (st.cur == lengths)
         off = jnp.stack(st.cap_off, axis=1)

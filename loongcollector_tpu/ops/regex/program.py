@@ -76,6 +76,7 @@ class Span:
     class_id: int
     min_len: int
     max_len: int
+    lazy: bool = False
 
 
 @dataclass
@@ -125,6 +126,13 @@ class SegmentProgram:
     classes: List[CharClass] = field(default_factory=list)
     num_caps: int = 0
     group_names: Dict[int, str] = field(default_factory=dict)
+    # bidirectional split (set when one ambiguous span pivots the pattern):
+    # `ops` is then the forward PREFIX; the suffix executes right-to-left
+    # from the line end; the pivot span covers whatever lies between the two
+    # cursors (validated for membership/min/max via prefix sums).
+    pivot: Optional["Span"] = None
+    suffix_ops: Optional[List[Op]] = None      # stored pre-reversed
+    split_caps: List[int] = field(default_factory=list)
 
     def class_id(self, cls: CharClass) -> int:
         for i, c in enumerate(self.classes):
@@ -150,6 +158,10 @@ class SegmentProgram:
                     for b in op.branches:
                         walk(b)
         walk(self.ops)
+        if self.suffix_ops is not None:
+            walk(self.suffix_ops)
+        if self.pivot is not None:
+            cumsum.add(self.pivot.class_id)
         return next_non, cumsum
 
     def max_reach(self) -> int:
@@ -232,10 +244,12 @@ def _flatten(tokens, prog: SegmentProgram, ops: List[Op]) -> None:
             if lo == hi:
                 ops.append(FixedSpan(cid, lo))
             else:
-                # Lazy repeats compile identically to greedy ones: both are
-                # only accepted when the class is disjoint from the follow
-                # set, in which case the run is forced and lazy ≡ greedy.
-                ops.append(Span(cid, lo, hi))
+                # Lazy repeats compile identically to greedy ones on the
+                # strict path (the run is forced when the class is disjoint
+                # from the follow set); laziness matters only when the span
+                # becomes a bidirectional pivot.
+                ops.append(Span(cid, lo, hi,
+                               lazy=tok_op is sre_c.MIN_REPEAT))
         elif tok_op is sre_c.SUBPATTERN:
             flush_lit()
             group, add_flags, del_flags, sub = av
@@ -366,17 +380,58 @@ def _follow_of(ops: Sequence[Op], i: int, prog: SegmentProgram,
     return mask
 
 
+def _guaranteed_nonabsorber(ops: Sequence[Op], prog: SegmentProgram,
+                            absorber: CharClass) -> bool:
+    """True if EVERY possible match of ops must contain at least one byte
+    the absorber (pivot) class cannot consume — then the pivot can never
+    swallow this content and take/skip decisions are forced."""
+    for op in ops:
+        if isinstance(op, Lit):
+            if any(not absorber.contains(b) for b in op.data):
+                return True
+        elif isinstance(op, FixedSpan):
+            if op.n >= 1 and not prog.classes[op.class_id].intersects(absorber):
+                return True
+        elif isinstance(op, Span):
+            if op.min_len >= 1 and                     not prog.classes[op.class_id].intersects(absorber):
+                return True
+        elif isinstance(op, Alt):
+            if all(_guaranteed_nonabsorber(b, prog, absorber)
+                   for b in op.branches):
+                return True
+        # Optional_ is not mandatory; CapStart/End consume nothing
+    return False
+
+
 def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
-                  outer_follow: CharClass) -> None:
+                  outer_follow: CharClass,
+                  absorber: "Optional[CharClass]" = None,
+                  pivot_lazy: bool = False) -> None:
+    """Backtracking-equivalence validation.  In bidirectional (reverse
+    suffix) mode, `absorber` is the pivot span's class: content the pivot
+    could alternatively consume.  Boundary-shifting ambiguity against the
+    absorber is allowed only when the pivot is lazy (reverse maximal munch
+    IS the lazy answer) or the content is guaranteed non-absorbable."""
     for i, op in enumerate(ops):
         if isinstance(op, Span):
             # maximal munch (plus the {m,n} length check) is equivalent to
             # backtracking only when the follow set is disjoint from the class
-            follow = _follow_of(ops, i, prog, outer_follow)
+            follow_inner, reaches_end = _first_set(ops, i + 1, prog)
             cls = prog.classes[op.class_id]
-            if cls.intersects(follow):
+            if cls.intersects(follow_inner):
                 raise Tier1Unsupported(
-                    f"greedy class {cls} overlaps follow set {follow}")
+                    f"greedy class {cls} overlaps follow set {follow_inner}")
+            if reaches_end:
+                # outer_follow is the enclosing continuation (nested Alt
+                # branches still have one in absorber mode)
+                if cls.intersects(outer_follow):
+                    raise Tier1Unsupported(
+                        f"greedy class {cls} overlaps follow set "
+                        f"{outer_follow}")
+                if absorber is not None and cls.intersects(absorber) \
+                        and not pivot_lazy:
+                    raise Tier1Unsupported(
+                        "suffix span can trade bytes with a greedy pivot")
         elif isinstance(op, Optional_):
             follow = _follow_of(ops, i, prog, outer_follow)
             first, can_empty = _first_set(op.body, 0, prog)
@@ -389,14 +444,24 @@ def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
             if first.intersects(follow):
                 raise Tier1Unsupported(
                     "optional body first set overlaps follow set")
-            _validate_ops(op.body, prog, follow)
+            # reverse-suffix mode: a greedy pivot prefers to absorb the
+            # body's text (skipping the optional); taking-on-body-match is
+            # only re-equivalent when the body is guaranteed to contain a
+            # byte the pivot cannot consume, or the pivot is lazy
+            if absorber is not None and not pivot_lazy and \
+                    not _guaranteed_nonabsorber(op.body, prog, absorber):
+                raise Tier1Unsupported(
+                    "optional body could be absorbed by a greedy pivot")
+            _validate_ops(op.body, prog, follow, absorber, pivot_lazy)
         elif isinstance(op, Alt):
-            follow = _follow_of(ops, i, prog, outer_follow)
+            follow_inner, reaches_end = _first_set(ops, i + 1, prog)
+            follow = (follow_inner.union(outer_follow) if reaches_end
+                      else follow_inner)
             firsts = []
             flens = []
             empties = []
             for bi, b in enumerate(op.branches):
-                _validate_ops(b, prog, follow)
+                _validate_ops(b, prog, follow, absorber, pivot_lazy)
                 f, can_empty = _first_set(b, 0, prog)
                 # commit-on-branch-success prefers earlier branches; an
                 # empty-matchable branch always succeeds, so anywhere but
@@ -431,6 +496,15 @@ def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
                             raise Tier1Unsupported(
                                 "alternation literal is a prefix of a later "
                                 "branch (reorder longest-first)")
+                        if (absorber is not None and not pivot_lazy
+                                and len(lits[a]) != len(lits[b2])
+                                and not (_guaranteed_nonabsorber(
+                                    [Lit(lits[a])], prog, absorber)
+                                    and _guaranteed_nonabsorber(
+                                        [Lit(lits[b2])], prog, absorber))):
+                            raise Tier1Unsupported(
+                                "unequal literal branches could trade bytes "
+                                "with a greedy pivot")
                         continue
                     if firsts[a].intersects(firsts[b2]) and (
                             flens[a] is None or flens[a] != flens[b2]):
@@ -447,6 +521,13 @@ def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
                 if union.intersects(follow):
                     raise Tier1Unsupported(
                         "alternation with empty branch overlaps follow set")
+                if absorber is not None and not pivot_lazy:
+                    for b, e in zip(op.branches, empties):
+                        if not e and not _guaranteed_nonabsorber(b, prog,
+                                                                 absorber):
+                            raise Tier1Unsupported(
+                                "optional-like branch could be absorbed by "
+                                "a greedy pivot")
 
 
 def _validate_and_bind(prog: SegmentProgram) -> None:
@@ -470,6 +551,85 @@ def _strip_edge_anchors(tokens):
     return tokens
 
 
+def _reverse_ops(ops: Sequence[Op]) -> List[Op]:
+    """Mirror an op sequence for right-to-left execution.  Literal bytes
+    reverse; composites reverse their bodies; CapStart/CapEnd swap roles is
+    handled by the emitter (original CapEnd, encountered first in reverse,
+    records the group's right edge)."""
+    out: List[Op] = []
+    for op in reversed(list(ops)):
+        if isinstance(op, Lit):
+            out.append(Lit(op.data[::-1]))
+        elif isinstance(op, Optional_):
+            out.append(Optional_(_reverse_ops(op.body)))
+        elif isinstance(op, Alt):
+            out.append(Alt([_reverse_ops(b) for b in op.branches]))
+        else:
+            out.append(op)
+    return out
+
+
+def _try_pivot_split(prog: SegmentProgram) -> bool:
+    """Attempt the bidirectional rescue for a pattern that failed strict
+    validation: exactly one top-level ambiguous Span becomes the pivot; the
+    prefix must validate forward, the suffix (reversed, anchored at the line
+    end) must validate in reverse.  Covers `"(.*?)"`-style fields.
+
+    The suffix match is then UNIQUE (its reversed form is backtracking-
+    free), so both greedy and lazy pivots take the same span — equal to the
+    backtracking engine's answer."""
+    ops = prog.ops
+    for i, op in enumerate(ops):
+        if not isinstance(op, Span):
+            continue
+        prefix = ops[:i]
+        suffix = ops[i + 1 :]
+        if not suffix:
+            continue  # span-at-end is the strict path's job
+        # follow of the prefix = pivot class (∪ first(suffix) if pivot may
+        # be empty)
+        follow = prog.classes[op.class_id]
+        if op.min_len == 0:
+            sf, _ = _first_set(suffix, 0, prog)
+            follow = follow.union(sf)
+        rev = _reverse_ops(suffix)
+        try:
+            _validate_ops(prefix, prog, follow)
+            _validate_ops(rev, prog, CharClass.from_bytes(b""),
+                          absorber=prog.classes[op.class_id],
+                          pivot_lazy=op.lazy)
+        except Tier1Unsupported:
+            continue
+        # captures spanning the split: CapStart in prefix whose CapEnd sits
+        # in the suffix
+        def cap_ids(seq, cls):
+            found = set()
+
+            def walk(oo):
+                for o in oo:
+                    if isinstance(o, cls):
+                        found.add(o.cap_id)
+                    elif isinstance(o, Optional_):
+                        walk(o.body)
+                    elif isinstance(o, Alt):
+                        for b in o.branches:
+                            walk(b)
+            walk(seq)
+            return found
+        starts_prefix = cap_ids(prefix, CapStart)
+        ends_suffix = cap_ids(suffix, CapEnd)
+        split = sorted(starts_prefix & ends_suffix)
+        # a capture OPENING in the suffix but closing... cannot happen
+        # (well-formed nesting), and captures fully inside either side are
+        # handled by their own walk
+        prog.ops = prefix
+        prog.pivot = op
+        prog.suffix_ops = rev
+        prog.split_caps = split
+        return True
+    return False
+
+
 def compile_tier1(pattern: Union[str, bytes]) -> SegmentProgram:
     if isinstance(pattern, bytes):
         pattern = pattern.decode("latin-1")
@@ -485,7 +645,11 @@ def compile_tier1(pattern: Union[str, bytes]) -> SegmentProgram:
         pass
     tokens = _strip_edge_anchors(list(tree))
     _flatten(tokens, prog, prog.ops)
-    _validate_and_bind(prog)
+    try:
+        _validate_and_bind(prog)
+    except Tier1Unsupported:
+        if not _try_pivot_split(prog):
+            raise
     return prog
 
 

@@ -75,9 +75,12 @@ class TestTierClassification:
         with pytest.raises(Tier1Unsupported):
             compile_tier1(r"(\d+)(\d+)")
 
-    def test_dot_star_then_contained_literal_rejected(self):
-        with pytest.raises(Tier1Unsupported):
-            compile_tier1(r"(.*)x")
+    def test_dot_star_then_literal_via_pivot(self):
+        # previously rejected; the bidirectional pivot anchors the literal
+        # at the line end, making the boundary unique — full re equivalence
+        prog = compile_tier1(r"(.*)x")
+        assert prog.pivot is not None
+        assert_matches_re(r"(.*)x", [b"axbx", b"xx", b"x", b"", b"abc"])
 
     def test_fixed_repeat_same_class_ok(self):
         compile_tier1(r"(\d{4})(\d{2})")
@@ -132,11 +135,17 @@ class TestProgramFeatures:
             b'"hello" x', b'"a"b" c', b'"" y',
         ])
 
-    def test_ambiguous_lazy_rejected(self):
-        # .*? before a quote can backtrack past quotes (`"a" "b" c`) — must
-        # NOT be Tier-1 (stop-at-first-occurrence would be wrong)
-        with pytest.raises(Tier1Unsupported):
-            compile_tier1(r'"(.*?)" (\S+)')
+    def test_ambiguous_lazy_via_pivot(self):
+        # .*? before a quote can backtrack past quotes (`"a" "b" c`);
+        # the bidirectional pivot resolves it exactly: the suffix matches
+        # right-to-left from the line end, so the kernel returns the same
+        # span re's backtracking finds
+        prog = compile_tier1(r'"(.*?)" (\S+)')
+        assert prog.pivot is not None
+        assert_matches_re(r'"(.*?)" (\S+)', [
+            b'"hello world" tail', b'"" t', b'"a" "b" c', b'no quotes',
+            b'"x" ', b'"x" y z', b'"a" b" c',
+        ])
 
     def test_not_literal_class(self):
         assert_matches_re(r"([^:]+):(.*)", [
@@ -269,6 +278,47 @@ class TestOptionalAndAlternation:
             assert_matches_re(pattern, lines)
 
 
+class TestBidirectionalPivot:
+    def test_greedy_span_before_optional_quote(self):
+        # \S can eat the closing quote; the pivot + reverse suffix resolves
+        pattern = r'"(\w+) (\S+)(?: HTTP/(\d\.\d))?" (\d{3})'
+        prog = compile_tier1(pattern)
+        assert prog.pivot is not None
+        assert_matches_re(pattern, [
+            b'"GET /x HTTP/1.1" 200',
+            b'"GET /x" 404',
+            b'"GET /x HTTP/9" 200',
+            b'"GET /x.y" 301',
+        ])
+
+    def test_lazy_dot_with_digit_suffix(self):
+        assert_matches_re(r"(.*?)(\d+)x", [
+            b"ab123x", b"x", b"9x", b"abx", b"12x34x", b"xx9x",
+        ])
+
+    def test_greedy_pivot_trading_rejected(self):
+        # greedy pivot + absorbable suffix span genuinely diverges — reject
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(.*)(\d+)x")
+
+    def test_split_capture_spans_pivot(self):
+        assert_matches_re(r"\[(.*?)\] (\w+)", [
+            b"[a] b", b"[a] [b] c", b"[] x", b"nope", b"[a][b] c",
+        ])
+
+    def test_pivot_fuzz(self):
+        rng = np.random.default_rng(42)
+        alphabet = b'ab1 "x[]/.'
+        for pattern in [r'"(.*?)" (\S+)', r"(.*?)(\d+)x",
+                        r"\[(.*?)\] (\w+)", r'(\S+) "(.*?)"']:
+            lines = [bytes(alphabet[i] for i in
+                           rng.integers(0, len(alphabet),
+                                        int(rng.integers(0, 24))))
+                     for _ in range(500)]
+            lines += [b'"a" b', b'1x', b'[q] w', b'z "y"']
+            assert_matches_re(pattern, lines)
+
+
 class TestGrokCompositesTier1:
     def test_commonapachelog_differential(self):
         from loongcollector_tpu.ops.regex.grok import expand
@@ -304,3 +354,20 @@ class TestGrokCompositesTier1:
         assert_matches_re(r"((?:\d\d){1,2})x", [
             b"12x", b"1234x", b"123x", b"x", b"123456x",
         ])
+
+
+class TestPivotReviewRegressions:
+    def test_split_capture_keeps_prefix_content(self):
+        # (a.*)x: the capture opens BEFORE the pivot — its left edge is the
+        # forward CapStart position, not the pivot start
+        assert_matches_re(r"(a.*)x", [b"abx", b"ax", b"aXYZx", b"bx"])
+
+    def test_nested_branch_span_respects_continuation(self):
+        # a+ at a branch tail must not steal the 'a' of the preceding '!a'
+        for pat in [r"(.*?)!a(?:a+x|y)", r"(.*?)!a(?:(?:a|)x|y)"]:
+            import re as _re
+            try:
+                prog = compile_tier1(pat)
+            except Tier1Unsupported:
+                continue  # rejection is also sound
+            assert_matches_re(pat, [b"!aax", b"!ax", b"!ay", b"z!aax", b"!a"])
