@@ -39,7 +39,8 @@ from .runner.flusher_runner import FlusherRunner
 from .runner.http_sink import HttpSink
 from .runner.processor_runner import ProcessorRunner
 from .utils import flags
-from .utils.crash_backtrace import check_previous_crash, init_crash_backtrace
+from .utils.crash_backtrace import (check_previous_crash,
+                                    init_crash_backtrace, record_crash)
 from .utils.logger import get_logger
 
 log = get_logger("application")
@@ -326,7 +327,20 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, app.handle_signal)
     signal.signal(signal.SIGINT, app.handle_signal)
     app.init()
-    app.start(once=args.once)
+    try:
+        app.start(once=args.once)
+    except Exception:  # noqa: BLE001 - persist the trace for restart report
+        import traceback
+        trace = traceback.format_exc()
+        log.critical("unhandled exception in main loop:\n%s", trace)
+        record_crash(app.data_dir, trace)
+        try:
+            # the orderly drain is still possible — flush what we can before
+            # the supervisor restarts us
+            app.exit()
+        except Exception:  # noqa: BLE001
+            log.exception("drain after crash failed")
+        return 1
     return 0
 
 

@@ -30,6 +30,16 @@ def init_crash_backtrace(data_dir: str) -> None:
     faulthandler.enable(file=_trace_file)
 
 
+def record_crash(data_dir: str, trace: str) -> None:
+    """Persist a Python-level crash trace for the next start's report (the
+    same file faulthandler streams fatal signals into)."""
+    try:
+        with open(os.path.join(data_dir, "backtrace.log"), "w") as f:
+            f.write(trace)
+    except OSError:
+        pass
+
+
 def check_previous_crash(data_dir: str) -> Optional[str]:
     """If the last run crashed, report it and archive the trace."""
     path = os.path.join(data_dir, "backtrace.log")

@@ -1,0 +1,74 @@
+"""Container discovery over the Docker Engine unix-socket API."""
+
+import pytest
+
+
+class TestDockerDiscoveryUnixSocket:
+    def test_list_containers_over_socket(self, tmp_path):
+        """DockerDiscovery against a fake Engine API on an AF_UNIX socket."""
+        import http.server
+        import json as _json
+        import socketserver
+        import threading
+
+        sock_path = str(tmp_path / "docker.sock")
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = _json.dumps([{
+                    "Id": "abc123", "Names": ["/web-1"],
+                    "Image": "nginx:latest",
+                    "Labels": {"io.kubernetes.pod.name": "web-1",
+                               "io.kubernetes.pod.namespace": "prod",
+                               "io.kubernetes.container.name": "nginx"},
+                }]).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = socketserver.UnixStreamServer(sock_path, Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            from loongcollector_tpu.container_manager import DockerDiscovery
+            disc = DockerDiscovery(sock_path)
+            found = disc.list_containers()
+            assert len(found) == 1
+            info = found[0]
+            assert info.name == "web-1"
+            assert info.k8s_namespace == "prod"
+            assert info.log_path.endswith("abc123-json.log")
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_error_body_returns_empty(self, tmp_path):
+        import http.server
+        import socketserver
+        import threading
+
+        sock_path = str(tmp_path / "docker.sock")
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b'{"message": "daemon restarting"}'
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = socketserver.UnixStreamServer(sock_path, Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            from loongcollector_tpu.container_manager import DockerDiscovery
+            assert DockerDiscovery(sock_path).list_containers() == []
+        finally:
+            server.shutdown()
+            server.server_close()
