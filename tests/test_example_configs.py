@@ -1,0 +1,27 @@
+"""Every shipped example config must load and init a pipeline."""
+
+import glob
+import os
+
+import pytest
+
+from loongcollector_tpu.config.watcher import load_config_file
+from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
+
+CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "example_config", "quick_start")
+
+
+@pytest.mark.parametrize("path", sorted(glob.glob(CONFIG_DIR + "/*.yaml")))
+def test_example_config_inits(path, tmp_path):
+    cfg = load_config_file(path)
+    assert cfg is not None, path
+    p = CollectionPipeline()
+    ok = p.init(os.path.basename(path), cfg,
+                ProcessQueueManager(), SenderQueueManager())
+    assert ok, f"{path} failed to init"
+    assert p.inputs and p.flushers
+    p.release()
