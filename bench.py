@@ -215,10 +215,13 @@ def bench_pipeline_e2e(n_lines=60000):
     lines = gen_lines(4096)
     chunk = b"\n".join(lines) + b"\n"
     # warm-up: compile the kernel geometry outside the timed window
-    sbw = SourceBuffer(len(chunk) + 64)
-    gw = PipelineEventGroup(sbw)
-    gw.add_raw_event(1).set_content(sbw.copy_string(chunk))
-    pqm.push_queue(p.process_queue_key, gw)
+    def _mk(payload: bytes):
+        sb0 = SourceBuffer(len(payload) + 64)
+        g0 = PipelineEventGroup(sb0)
+        g0.add_raw_event(1).set_content(sb0.copy_string(payload))
+        return g0
+
+    pqm.push_queue(p.process_queue_key, _mk(chunk))
     bh = p.flushers[0].plugin
     deadline = time.monotonic() + 120
     # queue emptiness ≠ processed: wait until the warm-up group reached the
@@ -231,52 +234,55 @@ def bench_pipeline_e2e(n_lines=60000):
     pushed_bytes = 0
     push_deadline = time.monotonic() + 120
     while pushed_bytes < n_lines * 90:
-        sb = SourceBuffer(len(chunk) + 64)
-        view = sb.copy_string(chunk)
-        g = PipelineEventGroup(sb)
-        g.add_raw_event(1).set_content(view)
+        g = _mk(chunk)
         while not pqm.push_queue(p.process_queue_key, g):
             if time.monotonic() > push_deadline:
                 raise RuntimeError("pipeline stopped draining during bench")
             time.sleep(0.001)
         pushed_bytes += len(chunk)
+    make_group = _mk
+
     want_events = 4096 * (pushed_bytes // len(chunk)) + 4096
     deadline = time.monotonic() + 120
     while bh.total_events < want_events and time.monotonic() < deadline:
         time.sleep(0.005)
     dt = time.perf_counter() - t0
+    # the throughput drain must be complete BEFORE the sojourn pushes add
+    # events, or an incomplete drain slips past the guard and corrupts the
+    # latency samples with backlog arrivals
+    if bh.total_events < want_events:
+        raise RuntimeError(
+            f"drain incomplete: {bh.total_events}/{want_events} events")
     # event→flush sojourn: push single-chunk groups one at a time and time
     # arrival at the sink (the BASELINE p99 latency metric)
     sojourns = []
     small = b"\n".join(lines[:256]) + b"\n"
     # warm the small-batch geometry (its first parse jit-compiles)
-    sbw2 = SourceBuffer(len(small) + 64)
-    gw2 = PipelineEventGroup(sbw2)
-    gw2.add_raw_event(1).set_content(sbw2.copy_string(small))
     warm_base = bh.total_events
-    pqm.push_queue(p.process_queue_key, gw2)
+    if not pqm.push_queue(p.process_queue_key, make_group(small)):
+        raise RuntimeError("small warm-up push rejected")
     warm_deadline = time.monotonic() + 120
     while bh.total_events < warm_base + 256 and \
             time.monotonic() < warm_deadline:
         time.sleep(0.002)
+    if bh.total_events < warm_base + 256:
+        raise RuntimeError("small warm-up never completed")
     for _ in range(50):
         base_events = bh.total_events
-        sb = SourceBuffer(len(small) + 64)
-        g = PipelineEventGroup(sb)
-        g.add_raw_event(1).set_content(sb.copy_string(small))
+        g = make_group(small)
         t1 = time.perf_counter()
-        pqm.push_queue(p.process_queue_key, g)
+        if not pqm.push_queue(p.process_queue_key, g):
+            raise RuntimeError("sojourn push rejected (queue full)")
         lat_deadline = time.monotonic() + 10
         while bh.total_events < base_events + 256 and \
                 time.monotonic() < lat_deadline:
             time.sleep(0.0005)
+        if bh.total_events < base_events + 256:
+            raise RuntimeError("sojourn group never reached the sink")
         sojourns.append((time.perf_counter() - t1) * 1000)
     sojourns.sort()
     runner.stop()
     mgr.stop_all()
-    if bh.total_events < want_events:
-        raise RuntimeError(
-            f"drain incomplete: {bh.total_events}/{want_events} events")
     return (pushed_bytes / dt / 1e6,
             sojourns[len(sojourns) // 2],
             sojourns[int(len(sojourns) * 0.99)])

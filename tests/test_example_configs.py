@@ -16,7 +16,7 @@ CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 
 @pytest.mark.parametrize("path", sorted(glob.glob(CONFIG_DIR + "/*.yaml")))
-def test_example_config_inits(path, tmp_path):
+def test_example_config_inits(path):
     cfg = load_config_file(path)
     assert cfg is not None, path
     p = CollectionPipeline()
