@@ -13,6 +13,7 @@ def register_all(registry) -> None:
                               InputProcessSecurity)
     from .forward import InputForward
     from .container_stdio import InputContainerStdio
+    from .syslog import InputSyslog
 
     registry.register_input("input_file", InputFile)
     registry.register_input("input_static_file_onetime", InputStaticFile)
@@ -30,3 +31,4 @@ def register_all(registry) -> None:
     registry.register_input("input_cpu_profiling", InputCpuProfiling)
     registry.register_input("input_forward", InputForward)
     registry.register_input("input_container_stdio", InputContainerStdio)
+    registry.register_input("input_syslog", InputSyslog)

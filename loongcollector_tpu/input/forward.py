@@ -43,12 +43,20 @@ class _ForwardHandler:
 
     @staticmethod
     def _decode(data: bytes) -> Optional[PipelineEventGroup]:
-        # JSON fixture groups or newline-delimited raw lines
+        # JSON fixture groups, SLS LogGroup wire bytes, or raw lines
         if data[:1] == b"{":
             try:
                 return PipelineEventGroup.from_json(data.decode("utf-8"))
             except (ValueError, KeyError):
                 return None
+        if data[:1] == b"\x0a":  # LogGroup.Logs field header
+            from ..pipeline.serializer.sls_serializer import parse_loggroup
+            try:
+                group = parse_loggroup(data)
+                if not group.empty():
+                    return group
+            except (IndexError, ValueError, KeyError):
+                pass  # not valid / truncated PB: fall through to raw
         group = PipelineEventGroup()
         sb = group.source_buffer
         ev = group.add_raw_event(int(time.time()))

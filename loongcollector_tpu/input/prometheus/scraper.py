@@ -37,6 +37,7 @@ class ScrapeTarget:
         self.jitter = int(hashlib.md5(url.encode()).hexdigest()[:4], 16) / 0xFFFF
         self.last_scrape = 0.0
         self.up = False
+        self.discovered = False  # came from HTTP SD (replaced on refresh)
 
     def due(self, now: float, interval: float) -> bool:
         if self.last_scrape == 0.0:
@@ -55,6 +56,10 @@ class ScrapeJob:
         self.timeout = float(config.get("ScrapeTimeoutSeconds", 10))
         self.metric_relabel = RelabelConfigList(
             config.get("MetricRelabelConfigs", []))
+        # target relabeling applies at discovery time (reference
+        # TargetSubscriberScheduler + Relabel.cpp)
+        self.target_relabel = RelabelConfigList(
+            config.get("RelabelConfigs", []))
         self.targets: List[ScrapeTarget] = []
         for t in config.get("StaticTargets", config.get("Targets", [])):
             if isinstance(t, str):
@@ -63,6 +68,55 @@ class ScrapeJob:
                 self.targets.append(ScrapeTarget(
                     _normalize_url(t.get("url", t.get("Host", ""))),
                     t.get("labels", {})))
+        # HTTP service discovery (http_sd format: a JSON list of
+        # {"targets": [...], "labels": {...}} groups)
+        self.sd_url: str = config.get("HttpSDUrl", "")
+        self.sd_interval = float(config.get("HttpSDIntervalSeconds", 60))
+        self.last_sd = 0.0
+
+    def refresh_sd(self, fetch) -> None:
+        """Re-pull discovery targets; static targets are kept, discovered
+        ones replaced (keyed by URL so jitter/last_scrape state persists)."""
+        import json as _json
+        body, ok = fetch(self.sd_url, self.timeout)
+        if not ok:
+            return
+        try:
+            groups = _json.loads(body)
+        except ValueError:
+            log.warning("bad http_sd payload from %s", self.sd_url)
+            return
+        def target_key(url, labels):
+            return (url, tuple(sorted(labels.items())))
+
+        existing = {target_key(t.url, t.labels): t
+                    for t in self.targets if t.discovered}
+        fresh: List[ScrapeTarget] = []
+        seen = set()
+        for grp in groups if isinstance(groups, list) else []:
+            labels = {str(k): str(v)
+                      for k, v in (grp.get("labels") or {}).items()}
+            for addr in grp.get("targets", []):
+                labels2 = dict(labels)
+                # the per-target address overrides any group-level
+                # __address__ (prometheus semantics); relabel may rewrite it
+                labels2["__address__"] = str(addr)
+                out = self.target_relabel.process(labels2)
+                if out is None:
+                    continue  # dropped by relabel
+                url = _normalize_url(out.pop("__address__", str(addr)))
+                # internal __meta_* / __* labels never reach sample output
+                out = {k: v for k, v in out.items() if not k.startswith("__")}
+                key = target_key(url, out)
+                if key in seen:
+                    continue  # exact duplicate (same address AND labelset)
+                seen.add(key)
+                t = existing.get(key)
+                if t is None:
+                    t = ScrapeTarget(url, out)
+                    t.discovered = True
+                fresh.append(t)
+        self.targets = [t for t in self.targets if not t.discovered] + fresh
 
 
 def _normalize_url(t: str) -> str:
@@ -119,7 +173,13 @@ class PrometheusInputRunner:
                 jobs = list(self._jobs.values())
             now = time.monotonic()
             for job in jobs:
-                for target in job.targets:
+                if job.sd_url and now - job.last_sd >= job.sd_interval:
+                    job.last_sd = now
+                    try:
+                        job.refresh_sd(self._fetch)
+                    except Exception:  # noqa: BLE001
+                        log.exception("http_sd refresh failed: %s", job.sd_url)
+                for target in list(job.targets):
                     if target.due(now, job.interval):
                         target.last_scrape = now
                         try:
@@ -191,7 +251,7 @@ class InputPrometheus(Input):
         self.job = ScrapeJob(
             scrape_config.get("job_name", context.pipeline_name),
             scrape_config, context.process_queue_key)
-        return bool(self.job.targets)
+        return bool(self.job.targets or self.job.sd_url)
 
     def start(self) -> bool:
         runner = PrometheusInputRunner.instance()

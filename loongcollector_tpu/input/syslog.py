@@ -1,0 +1,254 @@
+"""input_syslog — UDP/TCP syslog ingest (RFC3164 + RFC5424).
+
+Reference: plugins/input/syslog/ (Go service input).  Listens on UDP
+datagrams and/or TCP newline-framed streams; each message parses into
+priority (facility/severity), timestamp, hostname, tag/app and content
+fields, with raw retention on parse failure.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("syslog")
+
+_FACILITIES = ["kern", "user", "mail", "daemon", "auth", "syslog", "lpr",
+               "news", "uucp", "cron", "authpriv", "ftp", "ntp", "audit",
+               "alert", "clock", "local0", "local1", "local2", "local3",
+               "local4", "local5", "local6", "local7"]
+_SEVERITIES = ["emerg", "alert", "crit", "err", "warning", "notice", "info",
+               "debug"]
+
+# RFC3164: <PRI>MMM dd HH:MM:SS host tag[pid]: msg
+_RFC3164 = re.compile(
+    rb"<(\d{1,3})>([A-Z][a-z]{2} [ \d]\d \d{2}:\d{2}:\d{2}) (\S+) "
+    rb"([^:\[\s]+)(?:\[(\d+)\])?:? ?(.*)", re.S)
+# RFC5424: <PRI>1 TIMESTAMP HOST APP PROCID MSGID SD MSG
+_RFC5424 = re.compile(
+    rb"<(\d{1,3})>1 (\S+) (\S+) (\S+) (\S+) (\S+) "
+    rb"(-|(?:\[(?:[^\]\\]|\\.)*\])+) ?(.*)", re.S)
+
+
+def parse_syslog(data: bytes) -> Optional[Dict[bytes, bytes]]:
+    m = _RFC5424.fullmatch(data)
+    if m:
+        pri = int(m.group(1))
+        return {
+            b"facility": _FACILITIES[min(pri >> 3, 23)].encode(),
+            b"severity": _SEVERITIES[pri & 7].encode(),
+            b"timestamp": m.group(2),
+            b"hostname": m.group(3),
+            b"program": m.group(4),
+            b"procid": m.group(5),
+            b"msgid": m.group(6),
+            b"content": m.group(8),
+        }
+    m = _RFC3164.fullmatch(data)
+    if m:
+        pri = int(m.group(1))
+        out = {
+            b"facility": _FACILITIES[min(pri >> 3, 23)].encode(),
+            b"severity": _SEVERITIES[pri & 7].encode(),
+            b"timestamp": m.group(2),
+            b"hostname": m.group(3),
+            b"program": m.group(4),
+            b"content": m.group(6),
+        }
+        if m.group(5):
+            out[b"pid"] = m.group(5)
+        return out
+    return None
+
+
+class SyslogServer:
+    def __init__(self, address: str, protocol: str, queue_key: int,
+                 process_queue_manager, max_batch: int = 512):
+        host, _, port = address.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self.protocol = protocol
+        self.queue_key = queue_key
+        self.pqm = process_queue_manager
+        self.max_batch = max_batch
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._udp_sock: Optional[socket.socket] = None
+        self._tcp_sock: Optional[socket.socket] = None
+        self._pending: List[bytes] = []
+        self._pending_lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    def start(self) -> bool:
+        self._running = True
+        try:
+            if self.protocol in ("udp", "both"):
+                self._udp_sock = socket.socket(socket.AF_INET,
+                                               socket.SOCK_DGRAM)
+                self._udp_sock.bind((self.host, self.port))
+                self._udp_sock.settimeout(0.2)
+                t = threading.Thread(target=self._udp_loop, daemon=True,
+                                     name="syslog-udp")
+                t.start()
+                self._threads.append(t)
+            if self.protocol in ("tcp", "both"):
+                self._tcp_sock = socket.socket(socket.AF_INET,
+                                               socket.SOCK_STREAM)
+                self._tcp_sock.setsockopt(socket.SOL_SOCKET,
+                                          socket.SO_REUSEADDR, 1)
+                self._tcp_sock.bind((self.host, self.port))
+                self._tcp_sock.listen(16)
+                self._tcp_sock.settimeout(0.2)
+                t = threading.Thread(target=self._tcp_loop, daemon=True,
+                                     name="syslog-tcp")
+                t.start()
+                self._threads.append(t)
+        except OSError as e:
+            log.error("syslog bind %s:%d failed: %s", self.host, self.port, e)
+            self.stop()
+            return False
+        t = threading.Thread(target=self._flush_loop, daemon=True,
+                             name="syslog-flush")
+        t.start()
+        self._threads.append(t)
+        return True
+
+    def stop(self) -> None:
+        self._running = False
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        for sock in (self._udp_sock, self._tcp_sock):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._flush()
+
+    # -- receive ------------------------------------------------------------
+
+    def _udp_loop(self) -> None:
+        while self._running:
+            try:
+                data, _ = self._udp_sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if data:
+                self._enqueue(data.rstrip(b"\n"))
+
+    def _tcp_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._tcp_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._tcp_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _tcp_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(1.0)
+        buf = bytearray()
+        try:
+            while self._running:
+                try:
+                    chunk = conn.recv(65536)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                while True:
+                    nl = buf.find(b"\n")
+                    if nl < 0:
+                        break
+                    line = bytes(buf[:nl])
+                    del buf[: nl + 1]
+                    if line:
+                        self._enqueue(line)
+        except OSError:
+            pass
+        finally:
+            if buf:
+                self._enqueue(bytes(buf))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- batching -----------------------------------------------------------
+
+    def _enqueue(self, message: bytes) -> None:
+        with self._pending_lock:
+            self._pending.append(message)
+            full = len(self._pending) >= self.max_batch
+        if full:
+            self._flush()
+
+    def _flush_loop(self) -> None:
+        while self._running:
+            time.sleep(0.2)
+            if time.monotonic() - self._last_flush >= 1.0:
+                self._flush()
+
+    def _flush(self) -> None:
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+            self._last_flush = time.monotonic()
+        if not pending or self.pqm is None:
+            return
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        now = int(time.time())
+        for raw in pending:
+            ev = group.add_log_event(now)
+            fields = parse_syslog(raw)
+            if fields is None:
+                ev.set_content(b"content", sb.copy_string(raw))
+            else:
+                for k, v in fields.items():
+                    ev.set_content(sb.copy_string(k), sb.copy_string(v))
+        group.set_tag(b"__source__", b"syslog")
+        self.pqm.push_queue(self.queue_key, group)
+
+
+class InputSyslog(Input):
+    name = "input_syslog"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.server: Optional[SyslogServer] = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self._address = config.get("Address", "0.0.0.0:5140")
+        self._protocol = config.get("Protocol", "udp").lower()
+        host, sep, port = self._address.rpartition(":")
+        if not sep or not port.isdigit():
+            log.error("input_syslog Address must be host:port, got %r",
+                      self._address)
+            return False
+        return self._protocol in ("udp", "tcp", "both")
+
+    def start(self) -> bool:
+        self.server = SyslogServer(self._address, self._protocol,
+                                   self.context.process_queue_key,
+                                   self.context.process_queue_manager)
+        return self.server.start()
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self.server:
+            self.server.stop()
+            self.server = None
+        return True

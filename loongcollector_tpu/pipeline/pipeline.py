@@ -127,6 +127,7 @@ class CollectionPipeline:
         self.process_queue_key = (reuse_queue_key if reuse_queue_key
                                   else next_queue_key())
         self.context.process_queue_key = self.process_queue_key
+        self.context.process_queue_manager = process_queue_manager
         if process_queue_manager is not None:
             priority = int(global_cfg.get("Priority", 1))
             capacity = int(global_cfg.get("ProcessQueueCapacity", 20))

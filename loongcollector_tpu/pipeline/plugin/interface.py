@@ -21,6 +21,7 @@ class PluginContext:
         self.pipeline_name = pipeline_name
         self.config = config or {}
         self.process_queue_key: int = 0
+        self.process_queue_manager = None  # set by CollectionPipeline.init
         self.global_config: Dict[str, Any] = {}
         self.logger = None
         self.metrics = None

@@ -126,3 +126,83 @@ class SLSEventGroupSerializer:
         return _native.sls_serialize(group.source_buffer.as_array(),
                                      cols.timestamps, names,
                                      field_offs, field_lens)
+
+
+def parse_loggroup(data: bytes) -> PipelineEventGroup:
+    """Decode LogGroup wire bytes back into an event group (the ingest-side
+    mirror of the serializer; reference ProcessorParseFromPBNative decodes
+    PB-transferred groups on the forward path)."""
+
+    def read_varint(buf: bytes, i: int):
+        shift = v = 0
+        while True:
+            b = buf[i]
+            i += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v, i
+            shift += 7
+
+    def read_delim(buf: bytes, i: int):
+        ln, i = read_varint(buf, i)
+        if i + ln > len(buf):
+            raise ValueError("truncated length-delimited field")
+        return buf[i : i + ln], i + ln
+
+    def parse_kv(buf: bytes):
+        """{Key=1, Value=2} message (Content / LogTag share the shape)."""
+        k = v = b""
+        c = 0
+        while c < len(buf):
+            t3, c = read_varint(buf, c)
+            payload, c = read_delim(buf, c)
+            if t3 >> 3 == 1:
+                k = payload
+            elif t3 >> 3 == 2:
+                v = payload
+        return k, v
+
+    group = PipelineEventGroup()
+    sb = group.source_buffer
+    i = 0
+    n = len(data)
+    while i < n:
+        tag, i = read_varint(data, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 2:
+            payload, i = read_delim(data, i)
+            if fno == 1:        # Log
+                ev = group.add_log_event(0)
+                j = 0
+                while j < len(payload):
+                    t2, j = read_varint(payload, j)
+                    f2, w2 = t2 >> 3, t2 & 7
+                    if f2 == 1 and w2 == 0:       # Time
+                        ts, j = read_varint(payload, j)
+                        ev.timestamp = ts
+                    elif f2 == 2 and w2 == 2:     # Content
+                        content, j = read_delim(payload, j)
+                        k, v = parse_kv(content)
+                        ev.set_content(sb.copy_string(k), sb.copy_string(v))
+                    elif w2 == 2:
+                        _, j = read_delim(payload, j)
+                    elif w2 == 0:
+                        _, j = read_varint(payload, j)
+                    elif w2 == 5:
+                        j += 4
+                    else:
+                        j += 8
+            elif fno == 3:      # Topic
+                group.set_tag(b"__topic__", payload)
+            elif fno == 4:      # Source
+                group.set_tag(b"__source__", payload)
+            elif fno == 6:      # LogTag
+                k, v = parse_kv(payload)
+                group.set_tag(k, v)
+        elif wt == 0:
+            _, i = read_varint(data, i)
+        elif wt == 5:
+            i += 4
+        else:
+            i += 8
+    return group

@@ -1,0 +1,204 @@
+"""Syslog input (UDP/TCP), Prometheus HTTP SD, PB forward decode."""
+
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.input.syslog import SyslogServer, parse_syslog
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+
+class TestSyslogParse:
+    def test_rfc3164(self):
+        f = parse_syslog(b"<34>Oct 11 22:14:15 mymachine su[123]: "
+                         b"'su root' failed on /dev/pts/8")
+        assert f[b"facility"] == b"auth"
+        assert f[b"severity"] == b"crit"
+        assert f[b"hostname"] == b"mymachine"
+        assert f[b"program"] == b"su"
+        assert f[b"pid"] == b"123"
+        assert f[b"content"] == b"'su root' failed on /dev/pts/8"
+
+    def test_rfc5424(self):
+        f = parse_syslog(b"<165>1 2024-01-02T03:04:05.003Z host app 1234 "
+                         b"ID47 - An application event")
+        assert f[b"facility"] == b"local4"
+        assert f[b"severity"] == b"notice"
+        assert f[b"program"] == b"app"
+        assert f[b"content"] == b"An application event"
+
+    def test_garbage_returns_none(self):
+        assert parse_syslog(b"not syslog at all") is None
+
+
+class TestSyslogServer:
+    def _mk(self, protocol):
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(11)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        server = SyslogServer(f"127.0.0.1:{port}", protocol, 11, pqm)
+        assert server.start()
+        return pqm, server, port
+
+    def test_udp_roundtrip(self):
+        pqm, server, port = self._mk("udp")
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.sendto(b"<13>Oct 11 22:14:15 h prog: hello udp", 
+                        ("127.0.0.1", port))
+            sock.close()
+            deadline = time.monotonic() + 5
+            item = None
+            while item is None and time.monotonic() < deadline:
+                item = pqm.pop_item(timeout=0.2)
+            assert item is not None
+            _, group = item
+            ev = group.events[0]
+            assert ev.get_content(b"content") == b"hello udp"
+            assert ev.get_content(b"severity") == b"notice"
+        finally:
+            server.stop()
+
+    def test_tcp_framing(self):
+        pqm, server, port = self._mk("tcp")
+        try:
+            sock = socket.create_connection(("127.0.0.1", port))
+            sock.sendall(b"<13>Oct 11 22:14:15 h p: line one\n"
+                         b"<13>Oct 11 22:14:15 h p: line two\nnot syslog\n")
+            sock.close()
+            deadline = time.monotonic() + 5
+            events = []
+            while len(events) < 3 and time.monotonic() < deadline:
+                item = pqm.pop_item(timeout=0.2)
+                if item:
+                    events.extend(item[1].events)
+            assert events[0].get_content(b"content") == b"line one"
+            assert events[1].get_content(b"content") == b"line two"
+            assert events[2].get_content(b"content") == b"not syslog"
+        finally:
+            server.stop()
+
+
+class TestPrometheusHttpSD:
+    def test_sd_refresh_and_relabel(self):
+        class SD(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps([
+                    {"targets": ["127.0.0.1:9100", "127.0.0.1:9101"],
+                     "labels": {"env": "prod"}},
+                    {"targets": ["127.0.0.1:9102"],
+                     "labels": {"env": "staging"}},
+                ]).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), SD)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            from loongcollector_tpu.input.prometheus.scraper import (
+                PrometheusInputRunner, ScrapeJob)
+            job = ScrapeJob("sd-job", {
+                "HttpSDUrl": f"http://127.0.0.1:{port}/sd",
+                "RelabelConfigs": [
+                    {"source_labels": ["env"], "regex": "prod",
+                     "action": "keep"}],
+            }, queue_key=1)
+            assert job.sd_url
+            job.refresh_sd(PrometheusInputRunner._fetch)
+            urls = sorted(t.url for t in job.targets)
+            assert urls == ["http://127.0.0.1:9100/metrics",
+                            "http://127.0.0.1:9101/metrics"]  # staging dropped
+            assert all(t.labels.get("env") == "prod" for t in job.targets)
+            # second refresh preserves target objects (scrape state)
+            before = {t.url: id(t) for t in job.targets}
+            job.refresh_sd(PrometheusInputRunner._fetch)
+            after = {t.url: id(t) for t in job.targets}
+            assert before == after
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestPBForwardDecode:
+    def test_loggroup_roundtrip(self):
+        from loongcollector_tpu.input.forward import _ForwardHandler
+        from loongcollector_tpu.models import PipelineEventGroup
+        from loongcollector_tpu.pipeline.serializer.sls_serializer import (
+            SLSEventGroupSerializer, parse_loggroup)
+
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        g.set_tag(b"host", b"n1")
+        ev = g.add_log_event(1700000123)
+        ev.set_content(sb.copy_string(b"level"), sb.copy_string(b"warn"))
+        ev.set_content(sb.copy_string(b"msg"), sb.copy_string(b"hello pb"))
+        wire = SLSEventGroupSerializer().serialize([g])
+
+        g2 = parse_loggroup(wire)
+        ev2 = g2.events[0]
+        assert ev2.timestamp == 1700000123
+        assert ev2.get_content(b"msg") == b"hello pb"
+        assert g2.get_tag(b"host") == b"n1"
+
+        # and through the forward handler's decoder
+        decoded = _ForwardHandler._decode(wire)
+        assert decoded.events[0].get_content(b"level") == b"warn"
+
+
+class TestReviewRegressions:
+    def test_rfc5424_multiple_sd_elements(self):
+        f = parse_syslog(b'<165>1 2024-01-02T03:04:05Z host app 123 ID47 '
+                         b'[a@1 k="v"][b@2 x="y"] hello')
+        assert f[b"content"] == b"hello"
+
+    def test_truncated_pb_falls_to_raw(self):
+        from loongcollector_tpu.input.forward import _ForwardHandler
+        from loongcollector_tpu.models import PipelineEventGroup
+        from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+            SLSEventGroupSerializer
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        ev = g.add_log_event(1)
+        ev.set_content(sb.copy_string(b"k"),
+                       sb.copy_string(b"a long value that gets cut off"))
+        wire = SLSEventGroupSerializer().serialize([g])
+        truncated = wire[:-10]
+        decoded = _ForwardHandler._decode(truncated)
+        # not silently-corrupted structured data: retained as a raw event
+        assert decoded.events[0].content is not None
+
+    def test_bad_syslog_address_fails_init(self):
+        from loongcollector_tpu.input.syslog import InputSyslog
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        p = InputSyslog()
+        assert not p.init({"Address": "0.0.0.0"}, PluginContext("t"))
+
+    def test_sd_meta_labels_stripped_and_distinct_labelsets_kept(self):
+        from loongcollector_tpu.input.prometheus.scraper import ScrapeJob
+        job = ScrapeJob("j", {"HttpSDUrl": "http://x/sd"}, 1)
+        import json as _json
+        payload = _json.dumps([
+            {"targets": ["a:1"], "labels": {"__meta_dc": "dc1", "env": "p"}},
+            {"targets": ["a:1"], "labels": {"env": "q"}},
+            {"targets": ["a:1"], "labels": {"env": "q"}},  # exact dup
+        ]).encode()
+        job.refresh_sd(lambda url, t: (payload, True))
+        assert len(job.targets) == 2  # two distinct labelsets, dup dropped
+        labelsets = sorted(tuple(sorted(t.labels.items()))
+                           for t in job.targets)
+        assert labelsets == [(("env", "p"),), (("env", "q"),)]
+        assert all("__meta_dc" not in t.labels for t in job.targets)
