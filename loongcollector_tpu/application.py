@@ -132,7 +132,9 @@ class Application:
                 if not cp.file_path or cp.read_length <= 0:
                     continue
                 end = cp.read_offset + cp.read_length
-                v1 = fs.checkpoints.get(cp.file_path)
+                v1 = (fs.checkpoints.get(cp.dev, cp.inode)
+                      if cp.inode else
+                      fs.checkpoints.get_by_path(cp.file_path))
                 if v1 is None or v1.offset < end:
                     sig = v1.signature if v1 is not None else ""
                     if not sig:

@@ -9,6 +9,7 @@ event, matching the reference's default converter).
 
 from __future__ import annotations
 
+import collections
 import json
 import queue as _queue
 import threading
@@ -104,11 +105,25 @@ class FlusherKafka(Flusher):
             self._send_queue.put((topic, records, 0))
 
     def _send_loop(self) -> None:
-        while self._running or not self._send_queue.empty():
-            try:
-                topic, records, attempt = self._send_queue.get(timeout=0.2)
-            except _queue.Empty:
-                continue
+        # Failed batches go to a consumer-local retry deque, drained before
+        # the main queue. The consumer must NEVER block putting back into its
+        # own bounded queue: under a sustained broker outage producers can
+        # fill the freed slot first, deadlocking the only consumer.
+        retry: collections.deque = collections.deque()
+        while self._running or retry or not self._send_queue.empty():
+            if retry and retry[0][3] <= time.monotonic():
+                topic, records, attempt, _ = retry.popleft()
+            else:
+                try:
+                    timeout = 0.2
+                    if retry:
+                        timeout = max(0.0, min(
+                            timeout, retry[0][3] - time.monotonic()))
+                    topic, records, attempt = self._send_queue.get(
+                        timeout=timeout) if timeout > 0 else \
+                        self._send_queue.get_nowait()
+                except _queue.Empty:
+                    continue
             try:
                 self.producer.send(topic, records)
             except KafkaError as e:
@@ -117,8 +132,8 @@ class FlusherKafka(Flusher):
                               "dropping %d records: %s",
                               topic, attempt + 1, len(records), e)
                     continue
-                time.sleep(min(0.1 * (2 ** attempt), 5.0))
-                self._send_queue.put((topic, records, attempt + 1))
+                not_before = time.monotonic() + min(0.1 * (2 ** attempt), 5.0)
+                retry.append((topic, records, attempt + 1, not_before))
 
     def flush_all(self) -> bool:
         self.batcher.flush_all()

@@ -363,10 +363,12 @@ class KafkaProducer:
                 r.i32()          # partition
                 err = r.i16()
                 r.i64()          # base offset
+                r.i64()          # log_append_time (v2+)
                 if err != 0:
                     with self._lock:
                         self._topic_meta.pop(topic, None)
                     raise KafkaError(f"produce error code {err}")
+        r.i32()                  # throttle_time_ms (v1+ trailer)
 
     def close(self) -> None:
         for addr in list(self._conns):

@@ -1,8 +1,10 @@
 """File read checkpoints (v1): JSON dump of per-file offsets.
 
 Reference: core/file_server/checkpoint/CheckPointManager.{h,cpp} (h:99-140) —
-dev/inode + signature + offset per file, dumped periodically
-(application/Application.cpp:384) and restored on start.
+entries are keyed by DevInode (not path), carrying path + signature + offset,
+dumped periodically (application/Application.cpp:384) and restored on start.
+Keying by (dev, inode) is what makes rename+recreate rotation safe: the
+rotated reader and the new reader at the same path own distinct entries.
 """
 
 from __future__ import annotations
@@ -11,7 +13,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from .reader import ReaderCheckpoint
 
@@ -19,34 +21,51 @@ from .reader import ReaderCheckpoint
 class CheckPointManager:
     def __init__(self, path: str = ""):
         self.path = path
-        self._checkpoints: Dict[str, ReaderCheckpoint] = {}
+        self._checkpoints: Dict[Tuple[int, int], ReaderCheckpoint] = {}
         self._lock = threading.Lock()
         self.last_dump = 0.0
 
+    @staticmethod
+    def _key(cp: ReaderCheckpoint) -> Tuple[int, int]:
+        return (cp.dev, cp.inode)
+
     def update(self, cp: ReaderCheckpoint) -> None:
         with self._lock:
-            self._checkpoints[cp.path] = cp
+            self._checkpoints[self._key(cp)] = cp
 
-    def get(self, path: str) -> Optional[ReaderCheckpoint]:
+    def get(self, dev: int, inode: int) -> Optional[ReaderCheckpoint]:
         with self._lock:
-            return self._checkpoints.get(path)
+            return self._checkpoints.get((dev, inode))
 
-    def remove(self, path: str) -> None:
+    def get_by_path(self, path: str) -> Optional[ReaderCheckpoint]:
+        """Path lookup for callers that only know the path (e.g. status
+        introspection). Reads prefer dev/inode: with rotation several
+        entries may share a path; returns the most recently updated."""
         with self._lock:
-            self._checkpoints.pop(path, None)
+            best = None
+            for cp in self._checkpoints.values():
+                if cp.path == path and (
+                        best is None or cp.update_time > best.update_time):
+                    best = cp
+            return best
+
+    def remove(self, dev: int, inode: int) -> None:
+        with self._lock:
+            self._checkpoints.pop((dev, inode), None)
 
     def dump(self) -> None:
         if not self.path:
             return
         with self._lock:
             data = {
-                "version": 1,
+                "version": 2,
                 "check_point": {
-                    p: {
-                        "offset": cp.offset, "dev": cp.dev, "inode": cp.inode,
+                    f"{dev}:{ino}": {
+                        "path": cp.path, "offset": cp.offset,
+                        "dev": cp.dev, "inode": cp.inode,
                         "sig": cp.signature, "sig_size": cp.signature_size,
                         "update_time": cp.update_time,
-                    } for p, cp in self._checkpoints.items()
+                    } for (dev, ino), cp in self._checkpoints.items()
                 },
             }
         tmp = self.path + ".tmp"
@@ -64,13 +83,18 @@ class CheckPointManager:
                 data = json.load(f)
         except (OSError, ValueError):
             return
+        version = data.get("version", 1)
         with self._lock:
-            for p, d in data.get("check_point", {}).items():
-                self._checkpoints[p] = ReaderCheckpoint(
-                    path=p, offset=d.get("offset", 0), dev=d.get("dev", 0),
+            for key, d in data.get("check_point", {}).items():
+                # v1 files keyed entries by path; the entry body always
+                # carried dev/inode, so both versions key the same way here
+                path = d.get("path", key if version == 1 else "")
+                cp = ReaderCheckpoint(
+                    path=path, offset=d.get("offset", 0), dev=d.get("dev", 0),
                     inode=d.get("inode", 0), signature=d.get("sig", ""),
                     signature_size=d.get("sig_size", 0),
                     update_time=d.get("update_time", 0.0))
+                self._checkpoints[self._key(cp)] = cp
 
     def dump_periodically(self, interval: float = 5.0) -> None:
         if time.monotonic() - self.last_dump >= interval:

@@ -170,7 +170,8 @@ class FileServer:
                     if path not in known_set:
                         r = st.readers.pop(path)
                         self._drain_reader(st, r, force_flush=True)
-                        self.checkpoints.remove(path)
+                        self.checkpoints.remove(r.dev_inode.dev,
+                                                r.dev_inode.inode)
                         r.close()
                 st.first_round = False
             # drain any reader with unread bytes — back-pressured or
@@ -181,7 +182,10 @@ class FileServer:
             for r in list(st.rotated):
                 busy |= self._drain_reader(st, r, force_flush=True)
                 if not r.has_more():
-                    self.checkpoints.remove(r.path)
+                    # remove only this reader's own inode entry — the live
+                    # reader at the same path owns a different (dev, inode)
+                    self.checkpoints.remove(r.dev_inode.dev,
+                                            r.dev_inode.inode)
                     r.close()
                     st.rotated.remove(r)
         return busy
@@ -207,8 +211,8 @@ class FileServer:
         r = LogFileReader(path)
         if not r.open():
             return
-        cp = self.checkpoints.get(path)
-        if cp is not None and cp.inode == r.dev_inode.inode:
+        cp = self.checkpoints.get(r.dev_inode.dev, r.dev_inode.inode)
+        if cp is not None:
             r.restore(cp)
         elif not st.tail_existing and not st.first_round:
             pass  # new file appears later: read from 0

@@ -101,6 +101,11 @@ class LogFileReader:
             self.signature = self._read_signature()
             self.offset = 0
             return False
+        if len(self.signature) < SIGNATURE_SIZE:
+            # Prefix still matches but the file was first seen short: extend
+            # the signature as the file grows, so copytruncate rotation of
+            # files sharing a short common prefix is still detected.
+            self.signature = self._read_signature()
         return True
 
     def restore(self, cp: ReaderCheckpoint) -> None:

@@ -56,9 +56,10 @@ class TestReader:
         mgr.dump()
         mgr2 = CheckPointManager(str(tmp_path / "cp.json"))
         mgr2.load()
-        got = mgr2.get(str(p))
+        got = mgr2.get(cp.dev, cp.inode)
         assert got.offset == cp.offset
         assert got.signature == cp.signature
+        assert mgr2.get_by_path(str(p)).offset == cp.offset
 
 
 class TestRotation:

@@ -124,3 +124,118 @@ class TestStaticFileLastLine:
                     break
             groups.append(g.events[0].content.to_bytes())
         assert groups == [b"line1\n", b"line2_no_newline"]
+
+
+class TestAdviceRound1:
+    """Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+    def test_checkpoint_keyed_by_dev_inode_rotation(self, tmp_path):
+        """high: rename+recreate rotation must give the rotated and the new
+        reader DISTINCT checkpoint entries (reference CheckPointManager keys
+        by dev/inode, CheckPointManager.h:99)."""
+        import os
+
+        from loongcollector_tpu.input.file.checkpoint import CheckPointManager
+        from loongcollector_tpu.input.file.reader import LogFileReader
+
+        p = tmp_path / "rot.log"
+        p.write_bytes(b"old line\n")
+        mgr = CheckPointManager(str(tmp_path / "cp.json"))
+        r_old = LogFileReader(str(p))
+        assert r_old.read() is not None
+        mgr.update(r_old.checkpoint())
+        old_ino = r_old.dev_inode.inode
+
+        # logrotate: rename away, recreate at the same path
+        os.rename(str(p), str(tmp_path / "rot.log.1"))
+        p.write_bytes(b"new line\n")
+        r_new = LogFileReader(str(p))
+        assert r_new.read() is not None
+        mgr.update(r_new.checkpoint())
+        new_ino = r_new.dev_inode.inode
+        assert old_ino != new_ino
+
+        # both entries coexist; removing the rotated one keeps the live one
+        assert mgr.get(r_old.dev_inode.dev, old_ino).offset == 9
+        assert mgr.get(r_new.dev_inode.dev, new_ino).offset == 9
+        mgr.remove(r_old.dev_inode.dev, old_ino)
+        assert mgr.get(r_old.dev_inode.dev, old_ino) is None
+        live = mgr.get(r_new.dev_inode.dev, new_ino)
+        assert live is not None and live.offset == 9
+
+        # round-trips through the v2 dump format
+        mgr.dump()
+        mgr2 = CheckPointManager(str(tmp_path / "cp.json"))
+        mgr2.load()
+        assert mgr2.get(r_new.dev_inode.dev, new_ino).offset == 9
+
+    def test_checkpoint_v1_format_load(self, tmp_path):
+        """v1 dumps (path-keyed) still load, keyed by their dev/inode."""
+        import json
+
+        from loongcollector_tpu.input.file.checkpoint import CheckPointManager
+        f = tmp_path / "cp.json"
+        f.write_text(json.dumps({
+            "version": 1,
+            "check_point": {"/var/log/a.log": {
+                "offset": 42, "dev": 7, "inode": 99, "sig": "",
+                "sig_size": 0, "update_time": 1.0}},
+        }))
+        mgr = CheckPointManager(str(f))
+        mgr.load()
+        got = mgr.get(7, 99)
+        assert got is not None and got.offset == 42
+        assert got.path == "/var/log/a.log"
+
+    def test_short_signature_extends_as_file_grows(self, tmp_path):
+        """low: a file first seen under SIGNATURE_SIZE bytes must extend its
+        signature as it grows (reader.py check_signature)."""
+        from loongcollector_tpu.input.file.reader import (LogFileReader,
+                                                          SIGNATURE_SIZE)
+        p = tmp_path / "s.log"
+        p.write_bytes(b"tiny\n")
+        r = LogFileReader(str(p))
+        assert r.read() is not None
+        assert len(r.signature) == 5
+        # grow past the signature window; prefix unchanged
+        p.open("ab").write(b"x" * (SIGNATURE_SIZE * 2) + b"\n")
+        assert r.read() is not None
+        assert len(r.signature) == SIGNATURE_SIZE
+
+    def test_kafka_send_loop_never_blocks_on_own_queue(self):
+        """medium: under sustained broker failure with a FULL send queue the
+        consumer must keep consuming (retry deque), not deadlock in put()."""
+        import queue as _queue
+        import threading
+        import time
+
+        from loongcollector_tpu.flusher.kafka import FlusherKafka
+        from loongcollector_tpu.flusher.kafka_client import KafkaError
+
+        fl = FlusherKafka.__new__(FlusherKafka)
+        fl._send_queue = _queue.Queue(maxsize=2)
+        fl._running = True
+        fl.max_retries = 100
+
+        sent, fails = [], [8]  # fail the first 8 sends
+
+        class P:
+            def send(self, topic, records):
+                if fails[0] > 0:
+                    fails[0] -= 1
+                    raise KafkaError("down")
+                sent.append((topic, records))
+        fl.producer = P()
+
+        t = threading.Thread(target=fl._send_loop, daemon=True)
+        t.start()
+        # keep the bounded queue saturated from the producer side
+        for i in range(6):
+            fl._send_queue.put((f"t{i}", [(None, b"v")], 0), timeout=5)
+        deadline = time.monotonic() + 20
+        while len(sent) < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        fl._running = False
+        t.join(timeout=10)
+        assert not t.is_alive(), "send loop deadlocked"
+        assert len(sent) == 6
