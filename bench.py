@@ -297,38 +297,17 @@ def _safe(fn, default=-1.0):
         return default
 
 
-def _probe_backend(timeout=90.0):
-    """Check that the default jax backend can actually run an op.
-
-    Runs in a SUBPROCESS because a wedged TPU tunnel makes the first jax op
-    HANG (PJRT client dialing a dead relay), not fail — an in-process probe
-    would take the whole bench down with it. Returns True iff the default
-    backend completed a real op within the deadline.
-    """
-    import subprocess
-    code = ("import jax, jax.numpy as jnp;"
-            "d = jax.devices()[0];"
-            "jnp.zeros(8).block_until_ready();"
-            "print('OK', d.platform)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, timeout=timeout, text=True)
-        return r.returncode == 0 and "OK" in r.stdout
-    except Exception as e:  # noqa: BLE001  (incl. TimeoutExpired)
-        print(f"# backend probe failed: {e!r}", file=sys.stderr)
-        return False
-
-
 def main():
     import jax
     degraded = False
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
-    elif not _probe_backend():
+    else:
         # Fail-soft (driver contract: the ONE JSON line must always print).
-        # TPU/axon backend unreachable — fall back to CPU and mark degraded.
-        jax.config.update("jax_platforms", "cpu")
-        degraded = True
+        # A wedged TPU tunnel HANGS the first jax op, so the probe runs in a
+        # subprocess with a deadline; on failure fall back to CPU + mark it.
+        from loongcollector_tpu.utils.backend import ensure_live_backend
+        degraded = ensure_live_backend()
 
     try:
         mbps, e2e, ok_frac = bench_regex()

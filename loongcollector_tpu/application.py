@@ -132,9 +132,17 @@ class Application:
                 if not cp.file_path or cp.read_length <= 0:
                     continue
                 end = cp.read_offset + cp.read_length
-                v1 = (fs.checkpoints.get(cp.dev, cp.inode)
-                      if cp.inode else
-                      fs.checkpoints.get_by_path(cp.file_path))
+                # Find the live v1 entry. Legacy EO records carry dev=0 (the
+                # reader only exported inode then), so (cp.dev, cp.inode) may
+                # not be a real key — fall back to path lookup, and reject a
+                # path hit whose inode disagrees (file was rotated since).
+                v1 = None
+                if cp.dev and cp.inode:
+                    v1 = fs.checkpoints.get(cp.dev, cp.inode)
+                if v1 is None:
+                    v1 = fs.checkpoints.get_by_path(cp.file_path)
+                    if v1 is not None and cp.inode and v1.inode != cp.inode:
+                        v1 = None
                 if v1 is None or v1.offset < end:
                     sig = v1.signature if v1 is not None else ""
                     if not sig:
@@ -144,9 +152,20 @@ class Application:
                                 sig = f.read(SIGNATURE_SIZE).hex()
                         except OSError:
                             sig = ""
+                    # bump IN PLACE: keep the found entry's real (dev, inode)
+                    # key — keying by the EO record's possibly-zero dev would
+                    # write a dead entry the reader never restores
+                    dev, inode = ((v1.dev, v1.inode) if v1 is not None
+                                  else (cp.dev, cp.inode))
+                    if not inode:
+                        try:
+                            st = os.stat(cp.file_path)
+                            dev, inode = st.st_dev, st.st_ino
+                        except OSError:
+                            continue  # file gone: nothing to protect
                     fs.checkpoints.update(ReaderCheckpoint(
                         path=cp.file_path, offset=end,
-                        dev=cp.dev, inode=cp.inode,
+                        dev=dev, inode=inode,
                         signature=sig, signature_size=len(sig) // 2))
                     bumped = True
             if bumped:
@@ -323,12 +342,29 @@ def main(argv=None) -> int:
                         help="checkpoint/state directory")
     parser.add_argument("--once", action="store_true",
                         help="process available data then exit")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (skip the device probe)")
     args = parser.parse_args(argv)
+
+    # A wedged TPU tunnel hangs the first jax op; degrade to CPU rather than
+    # wedging the whole agent (SURVEY.md §5.3: backend outage must cost
+    # throughput, never liveness). The probe overlaps with init() — nothing
+    # before start() touches jax — so a healthy agent doesn't pay for it.
+    probe = None
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from .utils.backend import ensure_live_backend
+        probe = threading.Thread(target=ensure_live_backend, daemon=True)
+        probe.start()
 
     app = Application(args.config, args.data_dir)
     signal.signal(signal.SIGTERM, app.handle_signal)
     signal.signal(signal.SIGINT, app.handle_signal)
     app.init()
+    if probe is not None:
+        probe.join()  # backend decision must land before the first jax op
     try:
         app.start(once=args.once)
     except Exception:  # noqa: BLE001 - persist the trace for restart report

@@ -105,7 +105,8 @@ class FlusherHTTP(Flusher):
         while not self._eo_stop:
             cp = self.eo_sender.acquire_slot(
                 str(path) if path is not None else "",
-                0, _meta_int(EventGroupMetaKey.LOG_FILE_INODE),
+                _meta_int(EventGroupMetaKey.LOG_FILE_DEV),
+                _meta_int(EventGroupMetaKey.LOG_FILE_INODE),
                 _meta_int(EventGroupMetaKey.LOG_FILE_OFFSET),
                 _meta_int(EventGroupMetaKey.LOG_FILE_LENGTH))
             if cp is not None:

@@ -177,6 +177,8 @@ class LogFileReader:
         group.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, self.path)
         group.set_metadata(EventGroupMetaKey.LOG_FILE_INODE,
                            str(self.dev_inode.inode))
+        group.set_metadata(EventGroupMetaKey.LOG_FILE_DEV,
+                           str(self.dev_inode.dev))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET, str(read_offset))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH, str(len(aligned)))
         return group

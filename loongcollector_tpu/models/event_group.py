@@ -32,6 +32,7 @@ class EventGroupMetaKey(enum.Enum):
     LOG_FILE_PATH = "log.file.path"
     LOG_FILE_PATH_RESOLVED = "log.file.path_resolved"
     LOG_FILE_INODE = "log.file.inode"
+    LOG_FILE_DEV = "log.file.dev"
     LOG_FILE_OFFSET = "log.file.offset"
     LOG_FILE_LENGTH = "log.file.length"
     IS_REPLAY = "internal.is.replay"

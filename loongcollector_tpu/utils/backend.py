@@ -1,0 +1,60 @@
+"""Device-backend probing and fail-soft CPU fallback.
+
+The TPU backend in this deployment rides an experimental `axon` platform
+over a network tunnel. When that tunnel is wedged, the first jax op HANGS
+(the PJRT client blocks dialing a dead relay) rather than raising — so any
+in-process check would wedge with it. The probe therefore runs a real op in
+a SUBPROCESS with a deadline and the caller downgrades to CPU on failure.
+
+Reference analogue: the agent must keep collecting when a sink/backend is
+unreachable (SURVEY.md §5.3 failure recovery); a parse accelerator outage
+degrades throughput, never liveness.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+from .logger import get_logger
+
+log = get_logger("backend")
+
+_probe_result: bool | None = None
+
+
+def probe_default_backend(timeout: float = 90.0) -> bool:
+    """True iff the default jax backend completes a real op in time.
+
+    Result is cached for the process lifetime (the probe costs a subprocess
+    interpreter start + possible 20-40 s first compile).
+    """
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices()[0];"
+            "jnp.zeros(8).block_until_ready();"
+            "print('OK', d.platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout, text=True)
+        _probe_result = r.returncode == 0 and "OK" in r.stdout
+    except Exception as e:  # noqa: BLE001  (incl. TimeoutExpired)
+        log.warning("backend probe failed: %r", e)
+        _probe_result = False
+    return _probe_result
+
+
+def ensure_live_backend(timeout: float = 90.0) -> bool:
+    """Downgrade jax to CPU if the default backend is unreachable.
+
+    Returns True when running degraded (CPU fallback), False when the
+    default backend is healthy. Must run BEFORE the first jax op.
+    """
+    if probe_default_backend(timeout):
+        return False
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    log.warning("device backend unreachable; running degraded on CPU")
+    return True
