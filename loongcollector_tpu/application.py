@@ -144,25 +144,30 @@ class Application:
                     if v1 is not None and cp.inode and v1.inode != cp.inode:
                         v1 = None
                 if v1 is None or v1.offset < end:
-                    sig = v1.signature if v1 is not None else ""
-                    if not sig:
-                        # capture the current head as the rotation signature
-                        try:
-                            with open(cp.file_path, "rb") as f:
-                                sig = f.read(SIGNATURE_SIZE).hex()
-                        except OSError:
-                            sig = ""
                     # bump IN PLACE: keep the found entry's real (dev, inode)
                     # key — keying by the EO record's possibly-zero dev would
                     # write a dead entry the reader never restores
                     dev, inode = ((v1.dev, v1.inode) if v1 is not None
                                   else (cp.dev, cp.inode))
-                    if not inode:
+                    if not inode or not dev:
                         try:
                             st = os.stat(cp.file_path)
                             dev, inode = st.st_dev, st.st_ino
                         except OSError:
                             continue  # file gone: nothing to protect
+                    sig = v1.signature if v1 is not None else ""
+                    if not sig:
+                        # capture the head as the rotation signature — but
+                        # only if the path still IS this (dev, inode); after
+                        # rotation the path holds a different file whose head
+                        # would poison the entry's signature check
+                        try:
+                            st = os.stat(cp.file_path)
+                            if (st.st_dev, st.st_ino) == (dev, inode):
+                                with open(cp.file_path, "rb") as f:
+                                    sig = f.read(SIGNATURE_SIZE).hex()
+                        except OSError:
+                            sig = ""
                     fs.checkpoints.update(ReaderCheckpoint(
                         path=cp.file_path, offset=end,
                         dev=dev, inode=inode,

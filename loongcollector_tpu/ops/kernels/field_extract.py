@@ -21,6 +21,12 @@ rows and COMMIT per-row with masks — the branchless analogue of leftmost
 / greedy-preference semantics.  Everything is static-shape, jit-compiled
 once per (program, B, L) geometry; the batch builder quantises B and L into
 buckets to avoid recompilation storms (SURVEY.md §7 hard parts).
+
+All per-row state is kept as [B, 1] columns (keepdims reductions) rather
+than [B] vectors: the layout maps directly onto the VPU's (sublane, lane)
+vregs, which lets the SAME walk body serve as the Pallas kernel body
+(field_extract_pallas.py) where a [bB, L] tile is VMEM-resident and every
+program op reads it without another HBM pass.
 """
 
 from __future__ import annotations
@@ -51,8 +57,9 @@ def _membership(rows: jnp.ndarray, intervals, complement_intervals) -> jnp.ndarr
 
 class _WalkState:
     """Per-row cursor/match/capture state threaded through the emitter.
-    Capture columns are concrete default vectors from the start (offset 0,
-    length -1 = absent), so branch merging is a pure element-wise select."""
+    Everything is a [B, 1] column; capture columns are concrete default
+    vectors from the start (offset 0, length -1 = absent), so branch
+    merging is a pure element-wise select."""
 
     __slots__ = ("cur", "ok", "cap_off", "cap_len", "cap_start")
 
@@ -61,8 +68,8 @@ class _WalkState:
         self.ok = ok
         if init_caps:
             B = cur.shape[0]
-            zero = jnp.zeros(B, jnp.int32)
-            absent = jnp.full(B, -1, jnp.int32)
+            zero = jnp.zeros((B, 1), jnp.int32)
+            absent = jnp.full((B, 1), -1, jnp.int32)
             self.cap_off = [zero] * ncaps
             self.cap_len = [absent] * ncaps
             self.cap_start = [zero] * ncaps
@@ -90,18 +97,9 @@ class _WalkState:
                           for a, b in zip(taken.cap_start, other.cap_start)]
 
 
-def build_extract_fn(program: SegmentProgram):
-    """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) ->
-    (ok bool [B], cap_off i32 [B,C], cap_len i32 [B,C])."""
-
-    ncaps = max(program.num_caps, 1)
-    intervals = [c.intervals() for c in program.classes]
-    comp_intervals = [c.negated().intervals() for c in program.classes]
-    top_ops = list(program.ops)
-    suffix_ops = list(program.suffix_ops) if program.suffix_ops else None
-    pivot = program.pivot
-    split_caps = list(program.split_caps)
-
+def walk_masks(program: SegmentProgram):
+    """Static analysis shared by both builders: which class masks and
+    literal-shift masks the walk needs."""
     span_classes: set = set()
     count_classes: set = set()
     literals: set = set()
@@ -121,17 +119,37 @@ def build_extract_fn(program: SegmentProgram):
             elif isinstance(op, Alt):
                 for b in op.branches:
                     collect(b, reverse)
-    collect(top_ops)
-    if suffix_ops:
-        collect(suffix_ops, reverse=True)
-    if pivot is not None:
-        count_classes.add(pivot.class_id)  # membership mask for the span check
+    collect(list(program.ops))
+    if program.suffix_ops:
+        collect(list(program.suffix_ops), reverse=True)
+    if program.pivot is not None:
+        count_classes.add(program.pivot.class_id)
+    return span_classes, count_classes, literals
 
-    def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
+
+def build_extract_core(program: SegmentProgram):
+    """Returns core(rows u8 [B,L], lens i32 [B,1]) ->
+    (ok bool [B,1], cap_off i32 [B,C], cap_len i32 [B,C]).
+
+    Pure jnp on the block it is given — usable directly under jit (XLA
+    fuses the per-op reductions) or as a Pallas kernel body (the [B, L]
+    tile stays VMEM-resident across ALL ops)."""
+
+    ncaps = max(program.num_caps, 1)
+    intervals = [c.intervals() for c in program.classes]
+    comp_intervals = [c.negated().intervals() for c in program.classes]
+    top_ops = list(program.ops)
+    suffix_ops = list(program.suffix_ops) if program.suffix_ops else None
+    pivot = program.pivot
+    split_caps = list(program.split_caps)
+    span_classes, count_classes, literals = walk_masks(program)
+
+    def core(rows: jnp.ndarray, lens: jnp.ndarray):
         B, L = rows.shape
         i32 = jnp.int32
-        pos = jnp.broadcast_to(jnp.arange(L, dtype=i32)[None, :], (B, L))
-        valid = pos < lengths[:, None]
+        # 2D iota: required inside Pallas/Mosaic, equivalent under XLA
+        pos = jax.lax.broadcasted_iota(i32, (B, L), 1)
+        valid = pos < lens
         L32 = jnp.int32(L)
 
         member: Dict[int, jnp.ndarray] = {}
@@ -150,21 +168,21 @@ def build_extract_fn(program: SegmentProgram):
             lit_ok[lit] = m
 
         def emit(ops, st: _WalkState, active) -> None:
-            """Apply ops to st for rows where `active` (bool [B])."""
+            """Apply ops to st for rows where `active` (bool [B,1])."""
             for op in ops:
                 if isinstance(op, Lit):
                     k = len(op.data)
-                    hit = jnp.any((pos == st.cur[:, None]) & lit_ok[op.data],
-                                  axis=1)
-                    new_ok = st.ok & hit & (st.cur + k <= lengths)
+                    hit = jnp.any((pos == st.cur) & lit_ok[op.data],
+                                  axis=1, keepdims=True)
+                    new_ok = st.ok & hit & (st.cur + k <= lens)
                     st.ok = jnp.where(active, new_ok, st.ok)
                     st.cur = jnp.where(active,
                                        jnp.minimum(st.cur + k, L32), st.cur)
                 elif isinstance(op, Span):
                     m = member[op.class_id]
-                    cand = jnp.where(~m & (pos >= st.cur[:, None]), pos, L32)
-                    end = jnp.min(cand, axis=1)
-                    end = jnp.maximum(jnp.minimum(end, lengths), st.cur)
+                    cand = jnp.where(~m & (pos >= st.cur), pos, L32)
+                    end = jnp.min(cand, axis=1, keepdims=True)
+                    end = jnp.maximum(jnp.minimum(end, lens), st.cur)
                     run = end - st.cur
                     new_ok = st.ok & (run >= op.min_len)
                     if op.max_len != INF:
@@ -172,12 +190,11 @@ def build_extract_fn(program: SegmentProgram):
                     st.ok = jnp.where(active, new_ok, st.ok)
                     st.cur = jnp.where(active, end, st.cur)
                 elif isinstance(op, FixedSpan):
-                    new_ok = st.ok & (st.cur + op.n <= lengths)
+                    new_ok = st.ok & (st.cur + op.n <= lens)
                     if op.n > 0:
-                        inside = ((pos >= st.cur[:, None])
-                                  & (pos < (st.cur + op.n)[:, None]))
+                        inside = (pos >= st.cur) & (pos < st.cur + op.n)
                         cnt = jnp.sum((member[op.class_id] & inside)
-                                      .astype(i32), axis=1)
+                                      .astype(i32), axis=1, keepdims=True)
                         new_ok = new_ok & (cnt == op.n)
                     st.ok = jnp.where(active, new_ok, st.ok)
                     st.cur = jnp.where(active,
@@ -237,16 +254,15 @@ def build_extract_fn(program: SegmentProgram):
                     # forward bytes start at cur-k
                     fwd = op.data[::-1]
                     start = st.cur - k
-                    hit = jnp.any((pos == start[:, None]) & lit_ok[fwd],
-                                  axis=1) & (start >= 0)
+                    hit = jnp.any((pos == start) & lit_ok[fwd],
+                                  axis=1, keepdims=True) & (start >= 0)
                     st.ok = jnp.where(active, st.ok & hit, st.ok)
                     st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
                 elif isinstance(op, Span):
                     m = member[op.class_id]
                     # last non-member strictly below cur → run starts after it
-                    cand = jnp.where(~m & (pos < st.cur[:, None]), pos,
-                                     jnp.int32(-1))
-                    start = jnp.max(cand, axis=1) + 1
+                    cand = jnp.where(~m & (pos < st.cur), pos, jnp.int32(-1))
+                    start = jnp.max(cand, axis=1, keepdims=True) + 1
                     if op.max_len != INF:
                         # bounded-maximal: a finite repeat takes at most
                         # max_len — the bytes below the clamp belong to
@@ -265,10 +281,9 @@ def build_extract_fn(program: SegmentProgram):
                     start = st.cur - op.n
                     new_ok = st.ok & (start >= 0)
                     if op.n > 0:
-                        inside = ((pos >= start[:, None])
-                                  & (pos < st.cur[:, None]))
+                        inside = (pos >= start) & (pos < st.cur)
                         cnt = jnp.sum((member[op.class_id] & inside)
-                                      .astype(i32), axis=1)
+                                      .astype(i32), axis=1, keepdims=True)
                         new_ok = new_ok & (cnt == op.n)
                     st.ok = jnp.where(active, new_ok, st.ok)
                     st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
@@ -314,8 +329,9 @@ def build_extract_fn(program: SegmentProgram):
                 else:  # pragma: no cover
                     raise AssertionError(op)
 
-        st = _WalkState(jnp.zeros(B, i32), jnp.ones(B, bool), ncaps)
-        emit(top_ops, st, jnp.ones(B, bool))
+        all_rows = jnp.ones((B, 1), bool)
+        st = _WalkState(jnp.zeros((B, 1), i32), all_rows, ncaps)
+        emit(top_ops, st, all_rows)
 
         if pivot is not None:
             # snapshot the forward left edges of split captures BEFORE the
@@ -323,18 +339,17 @@ def build_extract_fn(program: SegmentProgram):
             fwd_starts = {k: st.cap_start[k] for k in split_caps}
             # reverse walk from the line end shares the capture state
             rst = st.copy()
-            rst.cur = lengths
-            emit_reverse(suffix_ops, rst, jnp.ones(B, bool),
-                         st.cur + pivot.min_len)
+            rst.cur = lens
+            emit_reverse(suffix_ops, rst, all_rows, st.cur + pivot.min_len)
             # pivot covers [st.cur, rst.cur): must be all pivot-class bytes
             # within the span's length bounds (masked sum — no gathers)
             lo = st.cur
             hi = rst.cur
             run = hi - lo
-            inside = (pos >= lo[:, None]) & (pos < hi[:, None])
+            inside = (pos >= lo) & (pos < hi)
             cnt = jnp.sum((member[pivot.class_id] & inside).astype(i32),
-                          axis=1)
-            ok = st.ok & rst.ok & (rst.cur >= st.cur) & (cnt == run)
+                          axis=1, keepdims=True)
+            ok = st.ok & rst.ok & (hi >= lo) & (cnt == run)
             ok = ok & (run >= pivot.min_len)
             if pivot.max_len != INF:
                 ok = ok & (run <= pivot.max_len)
@@ -344,18 +359,30 @@ def build_extract_fn(program: SegmentProgram):
             for k in split_caps:
                 final.cap_off[k] = fwd_starts[k]
                 final.cap_len[k] = rst.cap_start[k] - fwd_starts[k]
-            off = jnp.stack(final.cap_off, axis=1)
-            length = jnp.stack(final.cap_len, axis=1)
-            length = jnp.where(ok[:, None], length, -1)
-            off = jnp.where(ok[:, None], off, 0)
+            off = jnp.concatenate(final.cap_off, axis=1)
+            length = jnp.concatenate(final.cap_len, axis=1)
+            length = jnp.where(ok, length, -1)
+            off = jnp.where(ok, off, 0)
             return ok, off, length
 
-        ok = st.ok & (st.cur == lengths)
-        off = jnp.stack(st.cap_off, axis=1)
-        length = jnp.stack(st.cap_len, axis=1)
-        length = jnp.where(ok[:, None], length, -1)
-        off = jnp.where(ok[:, None], off, 0)
+        ok = st.ok & (st.cur == lens)
+        off = jnp.concatenate(st.cap_off, axis=1)
+        length = jnp.concatenate(st.cap_len, axis=1)
+        length = jnp.where(ok, length, -1)
+        off = jnp.where(ok, off, 0)
         return ok, off, length
+
+    return core
+
+
+def build_extract_fn(program: SegmentProgram):
+    """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) ->
+    (ok bool [B], cap_off i32 [B,C], cap_len i32 [B,C])."""
+    core = build_extract_core(program)
+
+    def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
+        ok, off, length = core(rows, lengths.astype(jnp.int32)[:, None])
+        return ok[:, 0], off, length
 
     return extract
 

@@ -1,0 +1,117 @@
+"""Pallas-fused Tier-1 field extraction.
+
+The XLA-path kernel (field_extract.py) expresses the segment walk as ~#ops
+masked reductions over the full [B, L] tensor; whether they collapse into
+one HBM pass depends on XLA's fuser.  This wrapper removes that bet: the
+batch is gridded into [bB, L] row blocks, each block is DMA'd into VMEM
+ONCE, and the ENTIRE program — membership masks, literal shift-compares,
+forward walk, pivot check, reverse walk — runs on the resident tile.  HBM
+traffic drops from O(#ops · B · L) worst-case to exactly one read of the
+rows plus the tiny span outputs, which is the round-2 VERDICT's ask
+("turn ~30 passes into 1").
+
+The kernel BODY is the same `build_extract_core` walk used by the XLA path,
+so every differential-fuzz guarantee transfers; the suite runs both paths
+against each other (tests/test_pallas_kernel.py).
+
+Reference hot loop being replaced: ProcessorParseRegexNative.cpp:186-253.
+Mosaic constraints honoured (pallas_guide.md): 2D iota, [B,1] state
+columns, u8 tiles with sublane-32 blocks, lane dim = L (multiple of 128
+via device_batch LENGTH_BUCKETS), scalar-free control flow.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..regex.program import SegmentProgram
+from .field_extract import build_extract_core, walk_masks
+
+# VMEM working-set budget per block: the u8 tile + per-class/per-literal
+# bool masks + a few i32 temps, all [bB, L].
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _pick_block_rows(B: int, L: int, n_masks: int) -> int:
+    """Largest power-of-two row block whose working set fits the budget.
+
+    Working set ≈ bB·L·(1 u8 + n_masks bool + ~8 i32-equivalent temps).
+    Both B (≥256) and the result are powers of two, so the block always
+    divides the batch exactly — no ragged edge to mask.
+    """
+    per_row = L * (1 + n_masks + 32)
+    bB = 512
+    while bB > 32 and bB * per_row > _VMEM_BUDGET:
+        bB //= 2
+    return min(bB, B)
+
+
+def build_extract_fn_pallas(program: SegmentProgram,
+                            interpret: Optional[bool] = None):
+    """Returns f(rows u8 [B,L], lengths i32 [B]) ->
+    (ok bool [B], cap_off i32 [B,C], cap_len i32 [B,C]).
+
+    interpret=None auto-selects: compiled Mosaic on TPU, interpreter
+    elsewhere (CPU tests / differential fuzzing)."""
+    core = build_extract_core(program)
+    ncaps = max(program.num_caps, 1)
+    span_c, count_c, lits = walk_masks(program)
+    n_masks = len(span_c | count_c) + len(lits)
+
+    def kernel(rows_ref, len_ref, ok_ref, off_ref, cl_ref):
+        rows = rows_ref[...]
+        lens = len_ref[...]
+        ok, off, length = core(rows, lens)
+        ok_ref[...] = ok.astype(jnp.int32)
+        off_ref[...] = off
+        cl_ref[...] = length
+
+    @functools.partial(jax.jit, static_argnums=())
+    def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
+        B, L = rows.shape
+        use_interpret = interpret
+        if use_interpret is None:
+            use_interpret = jax.default_backend() != "tpu"
+        bB = _pick_block_rows(B, L, n_masks)
+        grid = (B // bB,)
+        row_block = pl.BlockSpec((bB, L), lambda i: (i, 0))
+        col1 = pl.BlockSpec((bB, 1), lambda i: (i, 0))
+        cap_block = pl.BlockSpec((bB, ncaps), lambda i: (i, 0))
+        ok2, off, length = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[row_block, col1],
+            out_specs=[col1, cap_block, cap_block],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                jax.ShapeDtypeStruct((B, ncaps), jnp.int32),
+                jax.ShapeDtypeStruct((B, ncaps), jnp.int32),
+            ],
+            interpret=use_interpret,
+        )(rows, lengths.astype(jnp.int32)[:, None])
+        return ok2[:, 0] != 0, off, length
+
+    return extract
+
+
+class PallasExtractKernel:
+    """Drop-in sibling of ExtractKernel running the fused Pallas path."""
+
+    def __init__(self, program: SegmentProgram,
+                 interpret: Optional[bool] = None):
+        self.program = program
+        self._fn = build_extract_fn_pallas(program, interpret=interpret)
+
+    def __call__(self, rows, lengths
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return self._fn(rows, lengths)
+
+    @property
+    def num_caps(self) -> int:
+        return self.program.num_caps

@@ -12,6 +12,7 @@ the Python `re` fallback with identical semantics — the reference's
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Optional, Tuple
 
@@ -25,6 +26,16 @@ from ..kernels.dfa_scan import DFAMatchKernel
 from ..kernels.field_extract import ExtractKernel
 from .dfa import DFAUnsupported, compile_dfa
 from .program import PatternTier, Tier1Unsupported, compile_tier1
+
+
+def _pallas_enabled() -> Optional[bool]:
+    """LOONG_PALLAS=1 forces the fused Pallas path, =0 forces the XLA
+    path; unset → auto (Pallas on real TPU, XLA elsewhere — the Pallas
+    interpreter is a debugging tool, not a fast CPU path)."""
+    env = os.environ.get("LOONG_PALLAS")
+    if env is not None:
+        return env == "1"
+    return None
 
 
 def _chunks(idx: np.ndarray, size: int):
@@ -83,6 +94,8 @@ class RegexEngine:
         self.num_caps = self._re.groups
         self.group_names = {v - 1: k for k, v in self._re.groupindex.items()}
         self._segment_kernel: Optional[ExtractKernel] = None
+        self._pallas_kernel = None          # built lazily on first use
+        self._use_pallas: Optional[bool] = None
         self._dfa_kernel: Optional[DFAMatchKernel] = None
         self.tier = PatternTier.CPU
         if force_tier in (None, PatternTier.SEGMENT):
@@ -102,6 +115,25 @@ class RegexEngine:
             raise ValueError(f"pattern {pattern!r} cannot run at {force_tier}")
 
     # ------------------------------------------------------------------
+
+    def _device_kernel(self):
+        """Segment-tier kernel selection: fused Pallas on TPU (one VMEM
+        pass per row block), XLA fusion elsewhere. Resolved once per
+        engine; both paths are differentially fuzzed against each other."""
+        if self._use_pallas is None:
+            forced = _pallas_enabled()
+            if forced is not None:
+                self._use_pallas = forced
+            else:
+                import jax
+                self._use_pallas = jax.default_backend() == "tpu"
+        if self._use_pallas:
+            if self._pallas_kernel is None:
+                from ..kernels.field_extract_pallas import PallasExtractKernel
+                self._pallas_kernel = PallasExtractKernel(
+                    self._segment_kernel.program)
+            return self._pallas_kernel
+        return self._segment_kernel
 
     def parse_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> BatchParseResult:
@@ -125,12 +157,26 @@ class RegexEngine:
             cpu_idx = np.arange(n)
             device_idx = np.array([], dtype=np.int64)
 
+        kern = self._device_kernel() if len(device_idx) else None
         for chunk in _chunks(device_idx, MAX_BATCH):
             d_off = offsets[chunk]
             d_len = lengths[chunk]
             L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) or max_bucket
             batch = pack_rows(arena, d_off, d_len, L)
-            k_ok, k_off, k_len = self._segment_kernel(batch.rows, batch.lengths)
+            try:
+                k_ok, k_off, k_len = kern(batch.rows, batch.lengths)
+            except Exception:  # noqa: BLE001
+                if kern is self._segment_kernel:
+                    raise
+                # Mosaic lowering failure must cost throughput, never
+                # liveness: pin this engine to the proven XLA path
+                from ...utils.logger import get_logger
+                get_logger("regex").exception(
+                    "pallas kernel failed for %r; falling back to XLA path",
+                    self.pattern)
+                self._use_pallas = False
+                kern = self._segment_kernel
+                k_ok, k_off, k_len = kern(batch.rows, batch.lengths)
             k_ok = np.asarray(k_ok)[: batch.n_real]
             k_off = np.asarray(k_off)[: batch.n_real]
             k_len = np.asarray(k_len)[: batch.n_real]
