@@ -149,23 +149,24 @@ class Application:
                     # write a dead entry the reader never restores
                     dev, inode = ((v1.dev, v1.inode) if v1 is not None
                                   else (cp.dev, cp.inode))
+                    try:
+                        pst = os.stat(cp.file_path)
+                    except OSError:
+                        pst = None
                     if not inode or not dev:
-                        try:
-                            st = os.stat(cp.file_path)
-                            dev, inode = st.st_dev, st.st_ino
-                        except OSError:
+                        if pst is None:
                             continue  # file gone: nothing to protect
+                        dev, inode = pst.st_dev, pst.st_ino
                     sig = v1.signature if v1 is not None else ""
-                    if not sig:
+                    if not sig and pst is not None and \
+                            (pst.st_dev, pst.st_ino) == (dev, inode):
                         # capture the head as the rotation signature — but
-                        # only if the path still IS this (dev, inode); after
-                        # rotation the path holds a different file whose head
-                        # would poison the entry's signature check
+                        # only while the path still IS this (dev, inode);
+                        # after rotation the path holds a different file
+                        # whose head would poison the signature check
                         try:
-                            st = os.stat(cp.file_path)
-                            if (st.st_dev, st.st_ino) == (dev, inode):
-                                with open(cp.file_path, "rb") as f:
-                                    sig = f.read(SIGNATURE_SIZE).hex()
+                            with open(cp.file_path, "rb") as f:
+                                sig = f.read(SIGNATURE_SIZE).hex()
                         except OSError:
                             sig = ""
                     fs.checkpoints.update(ReaderCheckpoint(

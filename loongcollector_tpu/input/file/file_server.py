@@ -30,7 +30,9 @@ IDLE_SLEEP_S = 0.05
 
 class _ConfigState:
     def __init__(self, name: str, discovery: FileDiscoveryConfig,
-                 queue_key: int, tail_existing: bool):
+                 queue_key: int, tail_existing: bool,
+                 multiline_start: Optional[str] = None,
+                 multiline_end: Optional[str] = None):
         self.name = name
         self.poller = PollingDirFile(discovery)
         self.queue_key = queue_key
@@ -40,6 +42,12 @@ class _ConfigState:
         self.known: List[str] = []
         self.tail_existing = tail_existing
         self.first_round = True
+        self.multiline_start = multiline_start
+        self.multiline_end = multiline_end
+
+    def new_reader(self, path: str) -> LogFileReader:
+        return LogFileReader(path, multiline_start=self.multiline_start,
+                             multiline_end=self.multiline_end)
 
 
 class FileServer:
@@ -69,10 +77,13 @@ class FileServer:
     # -- config registration (from InputFile plugins) -----------------------
 
     def add_config(self, name: str, discovery: FileDiscoveryConfig,
-                   queue_key: int, tail_existing: bool = False) -> None:
+                   queue_key: int, tail_existing: bool = False,
+                   multiline_start: Optional[str] = None,
+                   multiline_end: Optional[str] = None) -> None:
         with self._lock:
-            self._configs[name] = _ConfigState(name, discovery, queue_key,
-                                               tail_existing)
+            self._configs[name] = _ConfigState(
+                name, discovery, queue_key, tail_existing,
+                multiline_start=multiline_start, multiline_end=multiline_end)
 
     def update_config_paths(self, name: str, file_paths) -> None:
         """Replace a registered config's discovery globs (container churn);
@@ -201,14 +212,14 @@ class FileServer:
         cur = get_dev_inode(path)
         if cur.valid() and cur.inode != r.dev_inode.inode:
             st.rotated.append(r)
-            new = LogFileReader(path)
+            new = st.new_reader(path)
             if new.open():
                 st.readers[path] = new
             else:
                 del st.readers[path]
 
     def _open_reader(self, st: _ConfigState, path: str) -> None:
-        r = LogFileReader(path)
+        r = st.new_reader(path)
         if not r.open():
             return
         cp = self.checkpoints.get(r.dev_inode.dev, r.dev_inode.inode)

@@ -55,7 +55,9 @@ class InputFile(Input):
         fs = FileServer.instance()
         fs.add_config(self.config_name, self.discovery,
                       self.context.process_queue_key,
-                      tail_existing=self.tail_existing)
+                      tail_existing=self.tail_existing,
+                      multiline_start=self.multiline.get("StartPattern"),
+                      multiline_end=self.multiline.get("EndPattern"))
         fs.start()
         return True
 

@@ -1,16 +1,25 @@
-"""Log file reader: chunked reads, rollback to last complete line, rotation
-tracking by (dev, inode) + content signature.
+"""Log file reader: chunked reads, rollback to last complete line (or last
+complete multiline RECORD), rotation tracking by (dev, inode) + signature.
 
 Reference: core/file_server/reader/LogFileReader.cpp — ReadLog :964,
 GetRawData/ReadUTF8 :1518,1647 (pread into an arena StringBuffer, align to
-the last complete line and roll back the rest), GenerateEventGroup :2726
-(ONE zero-copy RawEvent per chunk); signature-based rotation detection
+the last complete line and roll back the rest), multiline-aware rollback to
+the last complete record :2128-2180, GenerateEventGroup :2726 (ONE
+zero-copy RawEvent per chunk); signature-based rotation detection
 (CheckFileSignature); DevInode tracking (common/DevInode.h).
+
+Multiline rollback is the cheap way to carry state across read chunks: the
+held-back partial record simply STAYS IN THE FILE (offset doesn't advance),
+so the next read re-delivers it intact — no buffer copies, no processor
+state. Only when a record cannot be held (chunk-sized record, flush
+timeout) does the reader ship a broken record, marking the group so
+split_multiline's carry can stitch it downstream (SURVEY.md §5.7).
 """
 
 from __future__ import annotations
 
 import os
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
@@ -19,6 +28,7 @@ from ...models import EventGroupMetaKey, PipelineEventGroup, SourceBuffer
 
 DEFAULT_CHUNK = 512 * 1024
 SIGNATURE_SIZE = 1024
+ML_FLUSH_TIMEOUT_S = 5.0
 
 
 @dataclass
@@ -53,7 +63,10 @@ class ReaderCheckpoint:
 
 
 class LogFileReader:
-    def __init__(self, path: str, chunk_size: int = DEFAULT_CHUNK):
+    def __init__(self, path: str, chunk_size: int = DEFAULT_CHUNK,
+                 multiline_start: Optional[str] = None,
+                 multiline_end: Optional[str] = None,
+                 ml_flush_timeout: float = ML_FLUSH_TIMEOUT_S):
         self.path = path
         self.chunk_size = chunk_size
         self.offset = 0
@@ -61,6 +74,15 @@ class LogFileReader:
         self.signature = b""
         self._fd: Optional[int] = None
         self.last_read_time = 0.0
+        # multiline-aware rollback (start- or end-pattern anchored)
+        self._ml_start = (re.compile(multiline_start.encode("latin-1"))
+                          if multiline_start else None)
+        self._ml_end = (re.compile(multiline_end.encode("latin-1"))
+                        if multiline_end else None)
+        self._ml_flush_timeout = ml_flush_timeout
+        self._ml_hold_since = 0.0   # first time the current tail was held
+        self._ml_hold_size = -1     # file size at that moment
+        self._prev_partial = False  # last shipped chunk broke mid-record
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -100,6 +122,10 @@ class LogFileReader:
         if cur != self.signature:
             self.signature = self._read_signature()
             self.offset = 0
+            # replaced content: any held multiline state belonged to the OLD
+            # file — the first new chunk must not be marked as a continuation
+            self._prev_partial = False
+            self._ml_hold_size = -1
             return False
         if len(self.signature) < SIGNATURE_SIZE:
             # Prefix still matches but the file was first seen short: extend
@@ -151,6 +177,8 @@ class LogFileReader:
             return None
         if size < self.offset:       # truncated
             self.offset = 0
+            self._prev_partial = False
+            self._ml_hold_size = -1
         want = min(self.chunk_size, size - self.offset)
         if want <= 0:
             return None
@@ -165,6 +193,44 @@ class LogFileReader:
             aligned = data                # oversized single line / final flush
         else:
             return None                   # wait for the line to complete
+
+        # multiline-aware rollback: hold the trailing INCOMPLETE record in
+        # the file (reference LogFileReader.cpp:2128-2180) so records never
+        # split across chunks on the normal path
+        partial_tail = False
+        if (self._ml_start or self._ml_end) and not force_flush:
+            ship = self._ml_align(aligned)
+            if ship == 0 and filled:
+                # a single record larger than a whole chunk: holding is
+                # impossible, ship it broken and let the carry stitch it
+                partial_tail = True
+            elif ship < len(aligned):
+                if filled:
+                    # backlog catch-up: more bytes follow immediately; hold
+                    # the open tail in the file (zero-copy carry), no clock
+                    aligned = aligned[:ship]
+                else:
+                    now = time.monotonic()
+                    if size != self._ml_hold_size:
+                        # new bytes arrived since we started holding —
+                        # restart the flush clock
+                        self._ml_hold_size = size
+                        self._ml_hold_since = now
+                    if now - self._ml_hold_since >= self._ml_flush_timeout:
+                        partial_tail = True   # flush the open record anyway
+                    else:
+                        aligned = aligned[:ship]
+                        if not aligned:
+                            return None
+            else:
+                self._ml_hold_size = -1
+                if self._prev_partial and self._ml_end is None:
+                    # start-mode chunk with no start line at all: these
+                    # lines still continue the broken record — keep the
+                    # stitch chain open for the carry downstream
+                    partial_tail = True
+        if partial_tail or force_flush:
+            self._ml_hold_size = -1
         read_offset = self.offset
         self.offset += len(aligned)
         self.last_read_time = time.monotonic()
@@ -181,4 +247,38 @@ class LogFileReader:
                            str(self.dev_inode.dev))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET, str(read_offset))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH, str(len(aligned)))
+        # stitch markers for split_multiline's cross-group carry: this chunk
+        # ends mid-record / continues the previous chunk's open record
+        if partial_tail:
+            group.set_metadata(EventGroupMetaKey.ML_PARTIAL_TAIL, "1")
+        if self._prev_partial:
+            group.set_metadata(EventGroupMetaKey.ML_CONTINUE, "1")
+        self._prev_partial = partial_tail
         return group
+
+    def _ml_align(self, data: bytes) -> int:
+        """Bytes of `data` that form COMPLETE multiline records.
+
+        End-pattern mode: a record closes at each end-matching line — ship
+        through the last one. Start-pattern mode: the last start-matching
+        line opens a still-growing record — ship everything before it.
+        Scans backward so the common case (open record = a few tail lines)
+        touches only those lines. Returns len(data) when nothing anchors
+        (leading unmatched content ships and is handled downstream).
+        """
+        e = len(data)                 # exclusive end of the current line
+        if self._ml_end is not None:
+            while e > 0:
+                s = data.rfind(b"\n", 0, e - 1) + 1
+                line = data[s:e - 1] if data[e - 1:e] == b"\n" else data[s:e]
+                if self._ml_end.fullmatch(line):
+                    return e          # record closed here; tail is open
+                e = s
+            return 0                  # no closed record yet
+        while e > 0:
+            s = data.rfind(b"\n", 0, e - 1) + 1
+            line = data[s:e - 1] if data[e - 1:e] == b"\n" else data[s:e]
+            if self._ml_start.fullmatch(line):
+                return s              # this start opens the (open) tail record
+            e = s
+        return len(data)

@@ -33,6 +33,9 @@ class EventGroupMetaKey(enum.Enum):
     LOG_FILE_PATH_RESOLVED = "log.file.path_resolved"
     LOG_FILE_INODE = "log.file.inode"
     LOG_FILE_DEV = "log.file.dev"
+    # multiline stitch markers (reader ↔ split_multiline carry contract)
+    ML_PARTIAL_TAIL = "log.file.ml_partial_tail"
+    ML_CONTINUE = "log.file.ml_continue"
     LOG_FILE_OFFSET = "log.file.offset"
     LOG_FILE_LENGTH = "log.file.length"
     IS_REPLAY = "internal.is.replay"

@@ -165,6 +165,11 @@ class RegexEngine:
             batch = pack_rows(arena, d_off, d_len, L)
             try:
                 k_ok, k_off, k_len = kern(batch.rows, batch.lengths)
+                # materialise INSIDE the guard: async device execution
+                # surfaces runtime faults here, not at dispatch
+                k_ok = np.asarray(k_ok)
+                k_off = np.asarray(k_off)
+                k_len = np.asarray(k_len)
             except Exception:  # noqa: BLE001
                 if kern is self._segment_kernel:
                     raise
@@ -176,10 +181,11 @@ class RegexEngine:
                     self.pattern)
                 self._use_pallas = False
                 kern = self._segment_kernel
-                k_ok, k_off, k_len = kern(batch.rows, batch.lengths)
-            k_ok = np.asarray(k_ok)[: batch.n_real]
-            k_off = np.asarray(k_off)[: batch.n_real]
-            k_len = np.asarray(k_len)[: batch.n_real]
+                k_ok, k_off, k_len = (np.asarray(a) for a in
+                                      kern(batch.rows, batch.lengths))
+            k_ok = k_ok[: batch.n_real]
+            k_off = k_off[: batch.n_real]
+            k_len = k_len[: batch.n_real]
             ok[chunk] = k_ok
             # row-relative → arena-absolute
             cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
