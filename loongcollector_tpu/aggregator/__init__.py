@@ -1,0 +1,13 @@
+"""Aggregator stage (reference: pkg/pipeline/aggregator.go:24-51 + the Go
+aggregator plugins, plugins/aggregator/*)."""
+
+
+def register_all(registry) -> None:
+    from .base import (AggregatorBase, AggregatorContext,
+                       AggregatorMetadataGroup, AggregatorShardHash)
+
+    registry.register_aggregator("aggregator_base", AggregatorBase)
+    registry.register_aggregator("aggregator_context", AggregatorContext)
+    registry.register_aggregator("aggregator_metadata_group",
+                                 AggregatorMetadataGroup)
+    registry.register_aggregator("aggregator_shardhash", AggregatorShardHash)

@@ -1,0 +1,184 @@
+"""Aggregator implementations.
+
+Reference: pkg/pipeline/aggregator.go:24-51 (Add/Flush contract between the
+processor and flusher stages) and the Go plugins it hosts —
+plugins/aggregator/baseagg (pack logs into capped groups per logstore/topic),
+aggregator/context (per-source grouping preserving order), shardhash
+(SLS shard routing hash), metadatagroup (regroup by metadata keys).
+
+TPU-native shape: aggregators regroup EVENTS across incoming groups into
+output groups keyed by a per-event or per-group key. Output groups SHARE the
+input group's SourceBuffer (the arena is refcounted), so regrouping is span
+bookkeeping, never a byte copy. Columnar groups are keyed by group-level
+tags/metadata only — splitting a columnar batch row-wise would defeat the
+device-batch geometry, and per-row keys on the device path belong to the
+router's device-side filter instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import EventGroupMetaKey, PipelineEventGroup
+from ..pipeline.plugin.interface import Plugin, PluginContext
+
+
+class Aggregator(Plugin):
+    """add() may buffer; returns completed groups. flush() drains all."""
+
+    def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
+        raise NotImplementedError
+
+    def flush(self) -> List[PipelineEventGroup]:
+        return []
+
+
+class _Bucket:
+    __slots__ = ("group", "count", "born")
+
+    def __init__(self, group: PipelineEventGroup):
+        self.group = group
+        self.count = 0
+        self.born = time.monotonic()
+
+
+class AggregatorBase(Aggregator):
+    """Pack events into groups capped at MaxLogCount, keyed by topic tag
+    (reference plugins/aggregator/baseagg: MaxLogCount=1024 per group)."""
+
+    name = "aggregator_base"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.max_count = 1024
+        self.timeout_s = 3.0
+        self._buckets: Dict[Tuple, _Bucket] = {}
+        # add() runs on processor threads, flush_timeout() on thread 0's
+        # timeout tick — same contract as Batcher, same lock discipline
+        self._lock = threading.Lock()
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.max_count = int(config.get("MaxLogCount", 1024))
+        self.timeout_s = float(config.get("TimeoutSecs", 3.0))
+        return True
+
+    def _key(self, group: PipelineEventGroup, ev) -> Tuple:
+        return (group.get_tag(b"__topic__") or b"",)
+
+    def _group_meta(self, out: PipelineEventGroup, key: Tuple,
+                    src: PipelineEventGroup) -> None:
+        for k, v in src.tags.items():
+            out.set_tag(k, v)
+
+    def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
+        cols = group.columns
+        if cols is not None and not group._events:
+            # columnar batches pass through intact (see module docstring)
+            return [group]
+        done: List[PipelineEventGroup] = []
+        with self._lock:
+            for ev in group.events:
+                key = self._key(group, ev)
+                b = self._buckets.get(key)
+                if b is None:
+                    out = PipelineEventGroup(group.source_buffer)
+                    self._group_meta(out, key, group)
+                    b = self._buckets[key] = _Bucket(out)
+                elif b.group.source_buffer is not group.source_buffer:
+                    # events reference THEIR arena: a bucket can only hold
+                    # events of one arena — rotate the bucket out
+                    done.append(b.group)
+                    out = PipelineEventGroup(group.source_buffer)
+                    self._group_meta(out, key, group)
+                    b = self._buckets[key] = _Bucket(out)
+                b.group.events.append(ev)
+                b.count += 1
+                if b.count >= self.max_count:
+                    done.append(b.group)
+                    del self._buckets[key]
+        return done
+
+    def flush(self) -> List[PipelineEventGroup]:
+        with self._lock:
+            out = [b.group for b in self._buckets.values() if b.count]
+            self._buckets.clear()
+        return out
+
+    def flush_timeout(self) -> List[PipelineEventGroup]:
+        """Buckets older than the timeout complete (driven by the pipeline's
+        timeout-flush hook, same cadence as batchers)."""
+        now = time.monotonic()
+        out: List[PipelineEventGroup] = []
+        with self._lock:
+            for key in list(self._buckets):
+                b = self._buckets[key]
+                if b.count and now - b.born >= self.timeout_s:
+                    out.append(b.group)
+                    del self._buckets[key]
+        return out
+
+
+class AggregatorContext(AggregatorBase):
+    """Per-source grouping preserving order (plugins/aggregator/context)."""
+
+    name = "aggregator_context"
+
+    def _key(self, group: PipelineEventGroup, ev) -> Tuple:
+        return (group.get_metadata(EventGroupMetaKey.LOG_FILE_PATH) or "",
+                group.get_metadata(EventGroupMetaKey.LOG_FILE_INODE) or "")
+
+
+class AggregatorMetadataGroup(AggregatorBase):
+    """Regroup by event-field values (plugins/aggregator/metadatagroup):
+    GroupMetadataKeys name LogEvent fields whose values key the output
+    group and land in its tags."""
+
+    name = "aggregator_metadata_group"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.keys = [k.encode() if isinstance(k, str) else k
+                     for k in config.get("GroupMetadataKeys", [])]
+        return bool(self.keys)
+
+    def _key(self, group: PipelineEventGroup, ev) -> Tuple:
+        vals = []
+        get = getattr(ev, "get_content", None)
+        for k in self.keys:
+            v = get(k) if get is not None else None
+            vals.append(bytes(v) if v is not None else b"")
+        return tuple(vals)
+
+    def _group_meta(self, out, key, src) -> None:
+        super()._group_meta(out, key, src)
+        for k, v in zip(self.keys, key):
+            out.set_tag(k, v)
+
+
+class AggregatorShardHash(Aggregator):
+    """Set the SLS shard-hash metadata from key field/tag values
+    (plugins/aggregator/shardhash; FlusherSLS's shard routing)."""
+
+    name = "aggregator_shardhash"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.keys = [k.encode() if isinstance(k, str) else k
+                     for k in config.get("ShardHashKeys", [])]
+        return bool(self.keys)
+
+    def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
+        parts = []
+        for k in self.keys:
+            v = group.get_tag(k)
+            parts.append(bytes(v) if v is not None else b"")
+        digest = hashlib.md5(b"_".join(parts)).hexdigest()
+        group.set_metadata(EventGroupMetaKey.SOURCE_ID, digest)
+        return [group]
+
+    def flush(self) -> List[PipelineEventGroup]:
+        return []

@@ -3,11 +3,16 @@
 
 def register_all(registry) -> None:
     from .blackhole import FlusherBlackHole
+    from .clickhouse import FlusherClickHouse
+    from .elasticsearch import FlusherElasticsearch
     from .file import FlusherFile
-    from .stdout import FlusherStdout
     from .http import FlusherHTTP
-    from .sls import FlusherSLS
     from .kafka import FlusherKafka
+    from .loki import FlusherLoki
+    from .otlp import FlusherOTLP
+    from .prometheus_rw import FlusherPrometheus
+    from .sls import FlusherSLS
+    from .stdout import FlusherStdout
 
     registry.register_flusher("flusher_stdout", FlusherStdout)
     registry.register_flusher("flusher_file", FlusherFile)
@@ -15,3 +20,8 @@ def register_all(registry) -> None:
     registry.register_flusher("flusher_http", FlusherHTTP)
     registry.register_flusher("flusher_sls", FlusherSLS)
     registry.register_flusher("flusher_kafka", FlusherKafka)
+    registry.register_flusher("flusher_elasticsearch", FlusherElasticsearch)
+    registry.register_flusher("flusher_loki", FlusherLoki)
+    registry.register_flusher("flusher_clickhouse", FlusherClickHouse)
+    registry.register_flusher("flusher_otlp", FlusherOTLP)
+    registry.register_flusher("flusher_prometheus", FlusherPrometheus)

@@ -1,0 +1,45 @@
+"""flusher_clickhouse — HTTP-interface INSERT sink.
+
+Reference: plugins/flusher/clickhouse/flusher_clickhouse.go — the Go
+flusher drives clickhouse-go; the HTTP interface (`POST /?query=INSERT INTO
+db.table FORMAT JSONEachRow`) carries identical rows without a client
+library, which is the idiomatic shape for this framework's sender path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import quote
+
+from ..models import PipelineEventGroup
+from ..pipeline.serializer.event_dicts import iter_event_dicts
+from .http_base import AddressRotator, HttpSinkFlusher, basic_auth_header
+
+
+class FlusherClickHouse(HttpSinkFlusher):
+    name = "flusher_clickhouse"
+    content_type = "application/x-ndjson"
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        self.rotator = AddressRotator(config.get("Addresses", []))
+        self.table = config.get("Table", "")
+        self.database = config.get("Database", "default")
+        self.auth = basic_auth_header(config)
+        return bool(self.rotator) and bool(self.table)
+
+    def build_payload(self, groups: List[PipelineEventGroup]
+                      ) -> Optional[Tuple[bytes, Dict[str, str]]]:
+        rows: List[bytes] = []
+        for g in groups:
+            for ts, obj in iter_event_dicts(g):
+                obj.setdefault("_timestamp", ts)
+                rows.append(json.dumps(obj, ensure_ascii=False).encode())
+        if not rows:
+            return None
+        return b"\n".join(rows) + b"\n", self.auth
+
+    def endpoint_url(self, item) -> str:
+        q = quote(f"INSERT INTO {self.database}.{self.table} "
+                  f"FORMAT JSONEachRow")
+        return f"{self.rotator.next()}/?query={q}"

@@ -1,0 +1,61 @@
+"""flusher_elasticsearch — bulk NDJSON sink.
+
+Reference: plugins/flusher/elasticsearch/flusher_elasticsearch.go — config
+Addresses, Index (dynamic %{field} patterns), Authentication.PlainText;
+events ship as `_bulk` action/source line pairs.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.serializer.event_dicts import iter_event_dicts
+from .http_base import AddressRotator, HttpSinkFlusher, basic_auth_header
+
+_PATTERN = re.compile(r"%\{([^}]+)\}")
+
+
+def resolve_dynamic(template: str, obj: Dict[str, object]) -> str:
+    """%{content.key} / %{tag.key} / %{key} → value from the event dict
+    (the Go flusher's dynamic index convention)."""
+    def sub(m):
+        key = m.group(1)
+        for k in (key, key.split(".", 1)[-1]):
+            v = obj.get(k)
+            if v is not None:
+                return str(v)
+        return "unknown"
+    return _PATTERN.sub(sub, template)
+
+
+class FlusherElasticsearch(HttpSinkFlusher):
+    name = "flusher_elasticsearch"
+    content_type = "application/x-ndjson"
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        self.rotator = AddressRotator(config.get("Addresses", []))
+        self.index = config.get("Index", "")
+        self.auth = basic_auth_header(config)
+        return bool(self.rotator) and bool(self.index)
+
+    def build_payload(self, groups: List[PipelineEventGroup]
+                      ) -> Optional[Tuple[bytes, Dict[str, str]]]:
+        lines: List[bytes] = []
+        dynamic = "%{" in self.index
+        for g in groups:
+            for ts, obj in iter_event_dicts(g):
+                idx = resolve_dynamic(self.index, obj) if dynamic \
+                    else self.index
+                obj.setdefault("@timestamp", ts)
+                lines.append(json.dumps(
+                    {"index": {"_index": idx}}).encode())
+                lines.append(json.dumps(obj, ensure_ascii=False).encode())
+        if not lines:
+            return None
+        return b"\n".join(lines) + b"\n", self.auth
+
+    def endpoint_url(self, item) -> str:
+        return f"{self.rotator.next()}/_bulk"

@@ -1,0 +1,140 @@
+"""Shared machinery for HTTP-family sinks (ES, Loki, ClickHouse, OTLP,
+Prometheus remote-write): batch → build payload → compress → sender queue →
+FlusherRunner → HttpSink.
+
+Reference shape: the Go flusher long tail (plugins/flusher/*) all follow
+converter + HTTP client; here each sink is just `build_payload` (+ URL and
+static headers) on top of the same native sender path the SLS flusher uses
+(SenderQueueItem retry state, AIMD + rate gates, drain-on-exit).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.batch.batcher import Batcher
+from ..pipeline.batch.flush_strategy import FlushStrategy
+from ..pipeline.compression import create_compressor
+from ..pipeline.plugin.interface import Flusher, PluginContext
+from ..pipeline.queue.sender_queue import SenderQueueItem
+from .http import HttpRequest
+
+
+class HttpSinkFlusher(Flusher):
+    """Subclasses implement `_init_sink` and `build_payload`; optionally
+    override `endpoint_url` (e.g. address rotation) and `extra_headers`."""
+
+    default_compression: Optional[str] = None
+    content_type = "application/json"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.headers: Dict[str, str] = {}
+        self.compressor = None
+        self.batcher: Batcher = None  # type: ignore
+
+    # -- subclass surface ---------------------------------------------------
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def build_payload(self, groups: List[PipelineEventGroup]
+                      ) -> Optional[Tuple[bytes, Dict[str, str]]]:
+        """Returns (body, per-item headers) or None to skip the batch."""
+        raise NotImplementedError
+
+    def endpoint_url(self, item: SenderQueueItem) -> str:
+        raise NotImplementedError
+
+    # -- framework ----------------------------------------------------------
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        if not self._init_sink(config):
+            return False
+        self.headers = dict(config.get("Headers", {}))
+        self.compressor = create_compressor(
+            config.get("Compression", self.default_compression))
+        strategy = FlushStrategy(
+            min_cnt=int(config.get("MinCnt", 0)),
+            min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),
+            max_size_bytes=int(config.get("MaxSizeBytes", 5 * 1024 * 1024)),
+            timeout_secs=float(config.get("TimeoutSecs", 1.0)))
+        self.batcher = Batcher(strategy, on_flush=self._serialize_and_push,
+                               flusher_id=self.name,
+                               pipeline_name=context.pipeline_name)
+        return True
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        self.batcher.add(group)
+        return True
+
+    def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        built = self.build_payload(groups)
+        if built is None:
+            return
+        body, item_headers = built
+        raw_size = len(body)
+        payload = self.compressor.compress(body)
+        item = SenderQueueItem(payload, raw_size, flusher=self,
+                               queue_key=self.queue_key,
+                               tag={"headers": item_headers})
+        if self.sender_queue is not None:
+            self.sender_queue.push(item)
+
+    def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        headers = dict(self.headers)
+        headers.setdefault("Content-Type", self.content_type)
+        headers.update(item.tag.get("headers") or {})
+        if self.compressor is not None and self.compressor.name != "none":
+            enc = {"zlib": "deflate"}.get(self.compressor.name,
+                                          self.compressor.name)
+            headers["Content-Encoding"] = enc
+        return HttpRequest("POST", self.endpoint_url(item), headers,
+                           item.data)
+
+    def on_send_done(self, item: SenderQueueItem, status: int,
+                     body: bytes) -> str:
+        if 200 <= status < 300:
+            return "ok"
+        if status in (429, 500, 502, 503, 504) or status <= 0:
+            return "retry"
+        return "drop"
+
+    def flush_all(self) -> bool:
+        self.batcher.flush_all()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self.batcher.flush_all()
+        self.batcher.close()
+        return True
+
+
+class AddressRotator:
+    """Round-robin across sink addresses (the Go flushers' host pools)."""
+
+    def __init__(self, addresses: List[str]):
+        self.addresses = [a.rstrip("/") for a in addresses if a]
+        self._it = itertools.cycle(self.addresses) if self.addresses else None
+
+    def __bool__(self) -> bool:
+        return self._it is not None
+
+    def next(self) -> str:
+        return next(self._it)
+
+
+def basic_auth_header(config: Dict[str, Any]) -> Dict[str, str]:
+    """Authentication.PlainText.{Username,Password} → Authorization header
+    (the Go flushers' shared auth extension shape)."""
+    auth = (config.get("Authentication") or {}).get("PlainText") or {}
+    user = auth.get("Username") or config.get("Username")
+    pwd = auth.get("Password") or config.get("Password")
+    if not user:
+        return {}
+    import base64
+    token = base64.b64encode(f"{user}:{pwd or ''}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}

@@ -1,0 +1,81 @@
+"""flusher_otlp — OTLP/HTTP logs export (JSON encoding).
+
+Reference: plugins/flusher/otlp/flusher_otlp.go (gRPC exporter). This sink
+speaks OTLP/HTTP with the official JSON mapping of ExportLogsServiceRequest
+(`POST {endpoint}/v1/logs`): resourceLogs → scopeLogs → logRecords with
+timeUnixNano, body.stringValue, and attributes. JSON is a first-class OTLP
+encoding, and it keeps the sink dependency-free.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.serializer.event_dicts import iter_event_dicts
+from .http_base import HttpSinkFlusher, basic_auth_header
+
+
+def _attr(key: str, value: object) -> Dict[str, object]:
+    if isinstance(value, bool):
+        v: Dict[str, object] = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+class FlusherOTLP(HttpSinkFlusher):
+    name = "flusher_otlp"
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        self.endpoint = (config.get("Endpoint") or "").rstrip("/")
+        self.resource_attrs = {
+            str(k): str(v)
+            for k, v in (config.get("ResourceAttributes") or {}).items()}
+        self.auth = basic_auth_header(config)
+        return bool(self.endpoint)
+
+    def build_payload(self, groups: List[PipelineEventGroup]
+                      ) -> Optional[Tuple[bytes, Dict[str, str]]]:
+        records = []
+        for g in groups:
+            for ts, obj in iter_event_dicts(g):
+                body = obj.pop("content", None)
+                sev = obj.pop("level", None)
+                if sev is None:
+                    sev = obj.pop("severity", "")
+                rec: Dict[str, object] = {
+                    "timeUnixNano": str(ts * 1_000_000_000),
+                    "body": {"stringValue":
+                             str(body) if body is not None
+                             else json.dumps(obj, ensure_ascii=False)},
+                }
+                if sev:
+                    rec["severityText"] = str(sev)
+                attrs = [_attr(k, v) for k, v in obj.items()
+                         if body is not None]
+                if attrs:
+                    rec["attributes"] = attrs
+                records.append(rec)
+        if not records:
+            return None
+        payload = {
+            "resourceLogs": [{
+                "resource": {"attributes": [
+                    _attr(k, v) for k, v in self.resource_attrs.items()]},
+                "scopeLogs": [{
+                    "scope": {"name": "loongcollector_tpu"},
+                    "logRecords": records,
+                }],
+            }],
+        }
+        return (json.dumps(payload, ensure_ascii=False).encode(),
+                dict(self.auth))
+
+    def endpoint_url(self, item) -> str:
+        return f"{self.endpoint}/v1/logs"

@@ -61,6 +61,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.warning("failed to load native library: %s", e)
             return None
+        if not hasattr(lib, "lct_snappy_decompress"):
+            # stale build from before the codecs: rebuild and reload once
+            if _try_build():
+                try:
+                    lib = ctypes.CDLL(_SO_PATH)
+                except OSError:
+                    pass
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -80,6 +87,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                           ctypes.c_int64, ctypes.c_int64,
                                           u8p, i32p, i32p, i32p,
                                           u8p, ctypes.c_int64]
+        for fn in ("lct_lz4_bound", "lct_lz4_compress", "lct_lz4_decompress",
+                   "lct_snappy_bound", "lct_snappy_compress",
+                   "lct_snappy_uncompressed_len", "lct_snappy_decompress"):
+            f = getattr(lib, fn, None)
+            if f is None:      # stale .so predating the codecs: rebuild once
+                continue
+            f.restype = ctypes.c_int64
+            f.argtypes = ([ctypes.c_int64] if fn.endswith("bound")
+                          else [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+                          if not fn.endswith("uncompressed_len")
+                          else [u8p, ctypes.c_int64])
         _lib = lib
         log.info("native library loaded: %s", _SO_PATH)
         return _lib
@@ -188,3 +206,53 @@ def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
         if written < 0:
             return None
     return out[:written].tobytes()
+
+
+def _codec(fn_c, fn_bound, data: bytes) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None or not hasattr(lib, fn_c):
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)
+    cap = int(getattr(lib, fn_bound)(len(src)))
+    out = np.empty(max(cap, 16), dtype=np.uint8)
+    n = getattr(lib, fn_c)(_u8(src), len(src), _u8(out), len(out))
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    """LZ4 block format (raw, no frame) — SLS's default wire codec."""
+    return _codec("lct_lz4_compress", "lct_lz4_bound", data)
+
+
+def lz4_decompress(data: bytes, raw_size: int) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_lz4_decompress"):
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(max(raw_size, 1), dtype=np.uint8)
+    n = lib.lct_lz4_decompress(_u8(src), len(src), _u8(out), raw_size)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def snappy_compress(data: bytes) -> Optional[bytes]:
+    """Snappy block format — required by Prometheus remote-write."""
+    return _codec("lct_snappy_compress", "lct_snappy_bound", data)
+
+
+def snappy_decompress(data: bytes) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_snappy_decompress"):
+        return None
+    src = np.frombuffer(data, dtype=np.uint8)
+    raw = lib.lct_snappy_uncompressed_len(_u8(src), len(src))
+    if raw < 0:
+        return None
+    out = np.empty(max(int(raw), 1), dtype=np.uint8)
+    n = lib.lct_snappy_decompress(_u8(src), len(src), _u8(out), int(raw))
+    if n != raw:
+        return None
+    return out[:n].tobytes()

@@ -12,11 +12,6 @@ import zlib
 from typing import Optional
 
 try:
-    import lz4.frame as _lz4  # pragma: no cover - optional
-except ImportError:
-    _lz4 = None
-
-try:
     import zstandard as _zstd  # pragma: no cover - optional
 except ImportError:
     _zstd = None
@@ -46,13 +41,45 @@ class ZlibCompressor(Compressor):
 
 
 class LZ4Compressor(Compressor):
+    """LZ4 BLOCK format via the native lib — SLS's default wire codec
+    sends raw lz4 blocks with x-log-bodyrawsize carrying the raw size
+    (FlusherSLS.h:124-159), not the frame format."""
+
     name = "lz4"
 
     def compress(self, data: bytes) -> bytes:
-        return _lz4.compress(data)
+        from .. import native
+        out = native.lz4_compress(data)
+        if out is None:
+            raise RuntimeError("lz4 codec unavailable (native lib missing)")
+        return out
 
     def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
-        return _lz4.decompress(data)
+        from .. import native
+        out = native.lz4_decompress(data, raw_size)
+        if out is None:
+            raise RuntimeError("lz4 decompress failed")
+        return out
+
+
+class SnappyCompressor(Compressor):
+    """Snappy block format via the native lib (Prometheus remote-write)."""
+
+    name = "snappy"
+
+    def compress(self, data: bytes) -> bytes:
+        from .. import native
+        out = native.snappy_compress(data)
+        if out is None:
+            raise RuntimeError("snappy codec unavailable")
+        return out
+
+    def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
+        from .. import native
+        out = native.snappy_decompress(data)
+        if out is None:
+            raise RuntimeError("snappy decompress failed")
+        return out
 
 
 class ZstdCompressor(Compressor):
@@ -69,14 +96,22 @@ class ZstdCompressor(Compressor):
         return self._d.decompress(data)
 
 
+def _native_codecs_available() -> bool:
+    from .. import native
+    lib = native.get_lib()
+    return lib is not None and hasattr(lib, "lct_lz4_compress")
+
+
 def create_compressor(kind: Optional[str]) -> Compressor:
     kind = (kind or "none").lower()
     if kind in ("none", ""):
         return Compressor()
-    if kind == "zlib" or (kind == "lz4" and _lz4 is None) or (kind == "zstd" and _zstd is None):
-        return ZlibCompressor()
-    if kind == "lz4":
+    if kind == "lz4" and _native_codecs_available():
         return LZ4Compressor()
-    if kind == "zstd":
+    if kind == "snappy" and _native_codecs_available():
+        return SnappyCompressor()
+    if kind == "zstd" and _zstd is not None:
         return ZstdCompressor()
+    if kind in ("zlib", "lz4", "zstd", "snappy"):
+        return ZlibCompressor()   # last-resort fallback
     return Compressor()

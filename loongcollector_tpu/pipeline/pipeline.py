@@ -29,6 +29,39 @@ def next_queue_key() -> int:
     return next(_queue_keys)
 
 
+class _AggTimeoutHook:
+    """Adapter letting the aggregator ride TimeoutFlushManager's cadence
+    (processor thread 0 drives it, ProcessorRunner.cpp:109-112)."""
+
+    def __init__(self, pipeline: "CollectionPipeline"):
+        self._pipeline = pipeline
+
+    def flush_timeout(self) -> None:
+        agg = self._pipeline.aggregator
+        if agg is None:
+            return
+        hook = getattr(agg, "flush_timeout", None)
+        if hook is not None:
+            self._pipeline._send_direct(hook())
+
+
+class _ProcDrainHook:
+    """Periodic drain for processors holding cross-group state (e.g.
+    split_multiline's carried open records): groups they release run
+    through the REST of the processor chain and the normal send path."""
+
+    def __init__(self, pipeline: "CollectionPipeline", chain_idx: int,
+                 inst: ProcessorInstance):
+        self._pipeline = pipeline
+        self._chain_idx = chain_idx
+        self._inst = inst
+
+    def flush_timeout(self) -> None:
+        fn = getattr(self._inst.plugin, "flush_timeout_groups", None)
+        if fn is not None:
+            self._pipeline.drain_from(self._chain_idx, fn())
+
+
 class CollectionPipeline:
     def __init__(self) -> None:
         self.name = ""
@@ -39,6 +72,8 @@ class CollectionPipeline:
         self.processors: List[ProcessorInstance] = []
         self.flushers: List[FlusherInstance] = []
         self.router = Router()
+        self.aggregator = None
+        self._agg_timeout_hook = _AggTimeoutHook(self)
         self.process_queue_key = 0
         self._in_process_cnt = 0
         self._in_process_zero = threading.Condition()
@@ -99,6 +134,20 @@ class CollectionPipeline:
                 return self._abort_init()
             self.processors.append(inst)
 
+        # aggregator stage (reference pkg/pipeline/aggregator.go:24-51 —
+        # at most one per pipeline, between processors and flushers)
+        agg_cfgs = config.get("aggregators", [])
+        if agg_cfgs:
+            acfg = agg_cfgs[0]
+            atyp = acfg.get("Type", "")
+            self.aggregator = registry.create_aggregator(atyp)
+            if self.aggregator is None or \
+                    not self.aggregator.init(acfg, self.context):
+                return self._abort_init()
+            from ..pipeline.batch.timeout_flush_manager import \
+                TimeoutFlushManager
+            TimeoutFlushManager.instance().register(self._agg_timeout_hook)
+
         # flushers + router
         route_configs = []
         for i, fcfg in enumerate(config.get("flushers", [])):
@@ -120,6 +169,17 @@ class CollectionPipeline:
             self.flushers.append(inst)
             route_configs.append((i, fcfg.get("Match")))
         self.router.init(route_configs)
+
+        # processors holding cross-group state get a timeout-drain hook so
+        # their held records flush on idle pipelines too
+        from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
+        chain = self.inner_processors + self.processors
+        self._drain_hooks = []
+        for idx, inst in enumerate(chain):
+            if hasattr(inst.plugin, "flush_timeout_groups"):
+                hook = _ProcDrainHook(self, idx, inst)
+                self._drain_hooks.append(hook)
+                TimeoutFlushManager.instance().register(hook)
 
         # process queue: a modified pipeline keeps its key so queued groups
         # survive the swap (reference ExactlyOnceQueueManager/QueueKeyManager
@@ -146,6 +206,11 @@ class CollectionPipeline:
     def release(self) -> None:
         """Free pipeline-owned global registrations.  Called on failed init
         and after stop() by the manager."""
+        from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
+        if self.aggregator is not None:
+            TimeoutFlushManager.instance().unregister(self._agg_timeout_hook)
+        for hook in getattr(self, "_drain_hooks", []):
+            TimeoutFlushManager.instance().unregister(hook)
         for f in self.flushers:
             try:
                 f.plugin.stop(True)
@@ -172,9 +237,28 @@ class CollectionPipeline:
         for i in self.inputs:
             i.stop(is_removing)
         self.wait_all_items_in_process_finished()
+        # release processor-held state (carried multiline records) through
+        # the rest of the chain before the final batch flush
+        chain = self.inner_processors + self.processors
+        for idx, inst in enumerate(chain):
+            drain = getattr(inst.plugin, "drain_groups", None)
+            if drain is not None:
+                self.drain_from(idx, drain())
         self.flush_batch()
         for f in self.flushers:
             f.stop(is_removing)
+
+    def drain_from(self, chain_idx: int,
+                   groups: List[PipelineEventGroup]) -> None:
+        """Run released groups through processors AFTER chain_idx, then the
+        normal send path (aggregator + router + flushers)."""
+        if not groups:
+            return
+        chain = self.inner_processors + self.processors
+        for g in groups:
+            for inst in chain[chain_idx + 1:]:
+                inst.process([g])
+        self.send(groups)
 
     # ------------------------------------------------------------------
 
@@ -193,6 +277,11 @@ class CollectionPipeline:
                     self._in_process_zero.notify_all()
 
     def send(self, groups: List[PipelineEventGroup]) -> bool:
+        if self.aggregator is not None:
+            staged: List[PipelineEventGroup] = []
+            for g in groups:
+                staged.extend(self.aggregator.add(g))
+            groups = staged
         ok = True
         for group in groups:
             if group.empty():
@@ -201,7 +290,16 @@ class CollectionPipeline:
                 ok = self.flushers[idx].send(group) and ok
         return ok
 
+    def _send_direct(self, groups: List[PipelineEventGroup]) -> None:
+        for group in groups:
+            if group.empty():
+                continue
+            for idx in self.router.route(group):
+                self.flushers[idx].send(group)
+
     def flush_batch(self) -> None:
+        if self.aggregator is not None:
+            self._send_direct(self.aggregator.flush())
         for f in self.flushers:
             f.plugin.flush_all()
 

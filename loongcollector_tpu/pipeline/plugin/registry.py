@@ -23,6 +23,7 @@ class PluginRegistry:
         self._inputs: Dict[str, Callable[[], Input]] = {}
         self._processors: Dict[str, Callable[[], Processor]] = {}
         self._flushers: Dict[str, Callable[[], Flusher]] = {}
+        self._aggregators: Dict[str, Callable[[], Plugin]] = {}
         self._loaded = False
 
     @classmethod
@@ -43,17 +44,23 @@ class PluginRegistry:
     def register_flusher(self, name: str, creator: Callable[[], Flusher]) -> None:
         self._flushers[name] = creator
 
+    def register_aggregator(self, name: str,
+                            creator: Callable[[], Plugin]) -> None:
+        self._aggregators[name] = creator
+
     def load_static_plugins(self) -> None:
         """Registers all built-in plugins (idempotent)."""
         if self._loaded:
             return
         self._loaded = True
+        from ... import aggregator as _aggregator_pkg
         from ... import flusher as _flusher_pkg
         from ... import input as _input_pkg
         from ... import processor as _processor_pkg
         _processor_pkg.register_all(self)
         _flusher_pkg.register_all(self)
         _input_pkg.register_all(self)
+        _aggregator_pkg.register_all(self)
 
     # -- creation -----------------------------------------------------------
 
@@ -67,6 +74,10 @@ class PluginRegistry:
 
     def create_flusher(self, name: str) -> Optional[Flusher]:
         c = self._flushers.get(name)
+        return c() if c else None
+
+    def create_aggregator(self, name: str) -> Optional[Plugin]:
+        c = self._aggregators.get(name)
         return c() if c else None
 
     def is_valid_input(self, name: str) -> bool:

@@ -34,6 +34,7 @@ from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 
 CARRY_CAP_BYTES = 1 << 20   # give up stitching records larger than this
+CARRY_FLUSH_S = 5.0         # idle carries flush via the pipeline timeout tick
 CARRY_TTL_S = 30.0          # orphaned stashes flush through the next group
 
 
@@ -266,6 +267,45 @@ class ProcessorSplitMultilineLogString(Processor):
             self._carry[key] = (data, ts, time.monotonic())
         else:
             injected.append((1 << 30, data, ts))  # too big: emit as-is, last
+
+    # -- pipeline drain hooks (idle/shutdown delivery of held records) ------
+
+    def _carry_group(self, key: str, data: bytes,
+                     ts: int) -> PipelineEventGroup:
+        from ..models import SourceBuffer
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        view = sb.copy_string(data)
+        g.set_columns(ColumnarLogs(
+            offsets=np.array([view.offset], np.int32),
+            lengths=np.array([len(data)], np.int32),
+            timestamps=np.array([ts or int(time.time())], np.int64)))
+        path, _, ino = key.rpartition(":")
+        if path:
+            g.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, path)
+        if ino:
+            g.set_metadata(EventGroupMetaKey.LOG_FILE_INODE, ino)
+        return g
+
+    def flush_timeout_groups(self) -> List[PipelineEventGroup]:
+        """Carried records whose continuation never arrived flush on the
+        pipeline's timeout tick, so an idle source still delivers its last
+        record (reference flush-timeout semantics)."""
+        now = time.monotonic()
+        out: List[PipelineEventGroup] = []
+        for key in list(self._carry):
+            data, ts, at = self._carry[key]
+            if now - at >= CARRY_FLUSH_S:
+                del self._carry[key]
+                out.append(self._carry_group(key, data, ts))
+        return out
+
+    def drain_groups(self) -> List[PipelineEventGroup]:
+        """Shutdown: every held record ships (pipeline stop drain)."""
+        out = [self._carry_group(k, d, t)
+               for k, (d, t, _) in self._carry.items()]
+        self._carry.clear()
+        return out
 
     def _emit(self, group, records, injected, tss=None) -> None:
         sb = group.source_buffer

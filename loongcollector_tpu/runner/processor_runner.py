@@ -78,7 +78,11 @@ class ProcessorRunner:
                 now = time.monotonic()
                 if now - self.last_flush >= BATCH_FLUSH_INTERVAL_S:
                     self.last_flush = now
-                    TimeoutFlushManager.instance().flush_timeout_batches()
+                    try:
+                        TimeoutFlushManager.instance().flush_timeout_batches()
+                    except Exception:  # noqa: BLE001 — a bad hook must not
+                        # kill thread 0 (all timeout flushing agent-wide)
+                        log.exception("timeout flush failed")
             item = self.pqm.pop_item(timeout=0.2)
             if item is None:
                 continue

@@ -399,3 +399,272 @@ void lct_json_extract(const uint8_t* arena, int64_t arena_len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Block codecs: LZ4 block + Snappy block, written to the PUBLIC formats
+// (lz4 block spec; google/snappy format description). The reference links
+// liblz4 (core/common/compression/Lz4Compressor.cpp) — this image has no
+// lz4/snappy Python modules, and SLS's DEFAULT codec is LZ4
+// (FlusherSLS.h:124-159) while Prometheus remote-write REQUIRES snappy,
+// so the codecs live here behind ctypes.
+// ---------------------------------------------------------------------------
+extern "C" {
+
+int64_t lct_lz4_bound(int64_t n) { return n + n / 255 + 16; }
+
+int64_t lct_lz4_compress(const uint8_t* src, int64_t n,
+                         uint8_t* dst, int64_t cap) {
+    if (n < 0) return -1;
+    if (n == 0) return 0;
+    enum { HB = 16 };
+    static thread_local uint32_t htab[1u << HB];
+    memset(htab, 0, sizeof(htab));
+    auto hash = [](uint32_t v) { return (v * 2654435761u) >> (32 - HB); };
+    auto rd32 = [&](int64_t p) {
+        uint32_t v; memcpy(&v, src + p, 4); return v;
+    };
+    int64_t ip = 0, anchor = 0, op = 0;
+    const int64_t mflimit = n - 12;   // spec: no match may start after this
+    const int64_t matchlimit = n - 5; // spec: last 5 bytes are literals
+    while (ip < mflimit) {
+        uint32_t h = hash(rd32(ip));
+        int64_t ref = (int64_t)htab[h] - 1;
+        htab[h] = (uint32_t)(ip + 1);
+        if (ref < 0 || ip - ref > 65535 || rd32(ref) != rd32(ip)) {
+            ip++;
+            continue;
+        }
+        int64_t mlen = 4;
+        while (ip + mlen < matchlimit && src[ref + mlen] == src[ip + mlen])
+            mlen++;
+        int64_t litlen = ip - anchor;
+        if (op + litlen + litlen / 255 + mlen / 255 + 12 > cap) return -1;
+        uint8_t* tok = dst + op++;
+        if (litlen >= 15) {
+            *tok = 0xF0;
+            int64_t rest = litlen - 15;
+            while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+            dst[op++] = (uint8_t)rest;
+        } else {
+            *tok = (uint8_t)(litlen << 4);
+        }
+        memcpy(dst + op, src + anchor, litlen);
+        op += litlen;
+        uint16_t off = (uint16_t)(ip - ref);
+        dst[op++] = off & 0xFF;
+        dst[op++] = off >> 8;
+        int64_t mrem = mlen - 4;
+        if (mrem >= 15) {
+            *tok |= 0x0F;
+            mrem -= 15;
+            while (mrem >= 255) { dst[op++] = 255; mrem -= 255; }
+            dst[op++] = (uint8_t)mrem;
+        } else {
+            *tok |= (uint8_t)mrem;
+        }
+        ip += mlen;
+        anchor = ip;
+    }
+    int64_t litlen = n - anchor;
+    if (op + litlen + litlen / 255 + 2 > cap) return -1;
+    uint8_t* tok = dst + op++;
+    if (litlen >= 15) {
+        *tok = 0xF0;
+        int64_t rest = litlen - 15;
+        while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+        dst[op++] = (uint8_t)rest;
+    } else {
+        *tok = (uint8_t)(litlen << 4);
+    }
+    memcpy(dst + op, src + anchor, litlen);
+    op += litlen;
+    return op;
+}
+
+int64_t lct_lz4_decompress(const uint8_t* src, int64_t n,
+                           uint8_t* dst, int64_t cap) {
+    int64_t ip = 0, op = 0;
+    while (ip < n) {
+        uint8_t tok = src[ip++];
+        int64_t litlen = tok >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                litlen += b;
+            } while (b == 255);
+        }
+        if (ip + litlen > n || op + litlen > cap) return -1;
+        memcpy(dst + op, src + ip, litlen);
+        ip += litlen;
+        op += litlen;
+        if (ip >= n) break;  // last sequence has no match
+        if (ip + 2 > n) return -1;
+        int64_t off = src[ip] | (src[ip + 1] << 8);
+        ip += 2;
+        if (off == 0 || off > op) return -1;
+        int64_t mlen = (tok & 0x0F);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += 4;
+        if (op + mlen > cap) return -1;
+        // overlapping copy must run byte-wise
+        for (int64_t i = 0; i < mlen; i++) dst[op + i] = dst[op + i - off];
+        op += mlen;
+    }
+    return op;
+}
+
+int64_t lct_snappy_bound(int64_t n) { return 32 + n + n / 6; }
+
+int64_t lct_snappy_compress(const uint8_t* src, int64_t n,
+                            uint8_t* dst, int64_t cap) {
+    if (n < 0) return -1;
+    int64_t op = 0;
+    // preamble: uncompressed length varint
+    uint64_t v = (uint64_t)n;
+    while (v >= 0x80) {
+        if (op >= cap) return -1;
+        dst[op++] = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    if (op >= cap) return -1;
+    dst[op++] = (uint8_t)v;
+    auto emit_literal = [&](int64_t from, int64_t len) -> bool {
+        while (len > 0) {
+            int64_t take = len;
+            if (op + take + 6 > cap) return false;
+            if (take <= 60) {
+                dst[op++] = (uint8_t)((take - 1) << 2);
+            } else if (take - 1 <= 0xFF) {
+                dst[op++] = 60 << 2;
+                dst[op++] = (uint8_t)(take - 1);
+            } else if (take - 1 <= 0xFFFF) {
+                dst[op++] = 61 << 2;
+                dst[op++] = (uint8_t)((take - 1) & 0xFF);
+                dst[op++] = (uint8_t)((take - 1) >> 8);
+            } else {
+                take = 0x10000;  // chunk very long literals
+                dst[op++] = 61 << 2;
+                dst[op++] = 0xFF;
+                dst[op++] = 0xFF;
+            }
+            memcpy(dst + op, src + from, take);
+            op += take;
+            from += take;
+            len -= take;
+        }
+        return true;
+    };
+    enum { HB = 14 };
+    static thread_local uint32_t htab[1u << HB];
+    memset(htab, 0, sizeof(htab));
+    auto hash = [](uint32_t x) { return (x * 0x1e35a7bd) >> (32 - HB); };
+    auto rd32 = [&](int64_t p) {
+        uint32_t x; memcpy(&x, src + p, 4); return x;
+    };
+    int64_t ip = 0, anchor = 0;
+    while (ip + 4 <= n) {
+        uint32_t h = hash(rd32(ip));
+        int64_t ref = (int64_t)htab[h] - 1;
+        htab[h] = (uint32_t)(ip + 1);
+        if (ref < 0 || ip - ref > 65535 || rd32(ref) != rd32(ip)) {
+            ip++;
+            continue;
+        }
+        int64_t mlen = 4;
+        while (ip + mlen < n && src[ref + mlen] == src[ip + mlen]) mlen++;
+        if (!emit_literal(anchor, ip - anchor)) return -1;
+        int64_t off = ip - ref;
+        int64_t rem = mlen;
+        while (rem > 0) {
+            int64_t take = rem > 64 ? 64 : rem;
+            if (take < 4) break;  // tail shorter than a copy: literal it
+            if (op + 3 > cap) return -1;
+            dst[op++] = (uint8_t)(((take - 1) << 2) | 2);  // 2-byte copy
+            dst[op++] = (uint8_t)(off & 0xFF);
+            dst[op++] = (uint8_t)(off >> 8);
+            rem -= take;
+        }
+        ip += mlen - rem;
+        if (rem > 0) {  // leftover (<4) emitted as literal with what follows
+            anchor = ip;
+            continue;
+        }
+        anchor = ip;
+    }
+    if (!emit_literal(anchor, n - anchor)) return -1;
+    return op;
+}
+
+int64_t lct_snappy_uncompressed_len(const uint8_t* src, int64_t n) {
+    uint64_t len = 0;
+    int shift = 0;
+    for (int64_t i = 0; i < n && i < 10; i++) {
+        len |= (uint64_t)(src[i] & 0x7F) << shift;
+        if (!(src[i] & 0x80)) return (int64_t)len;
+        shift += 7;
+    }
+    return -1;
+}
+
+int64_t lct_snappy_decompress(const uint8_t* src, int64_t n,
+                              uint8_t* dst, int64_t cap) {
+    int64_t ip = 0;
+    // skip preamble
+    while (ip < n && (src[ip] & 0x80)) ip++;
+    if (ip++ >= n) return -1;
+    int64_t op = 0;
+    while (ip < n) {
+        uint8_t tag = src[ip++];
+        uint8_t type = tag & 3;
+        if (type == 0) {  // literal
+            int64_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int extra = (int)len - 60;
+                if (ip + extra > n) return -1;
+                len = 0;
+                for (int i = 0; i < extra; i++)
+                    len |= (int64_t)src[ip + i] << (8 * i);
+                len += 1;
+                ip += extra;
+            }
+            if (ip + len > n || op + len > cap) return -1;
+            memcpy(dst + op, src + ip, len);
+            ip += len;
+            op += len;
+        } else {
+            int64_t len, off;
+            if (type == 1) {  // 1-byte offset copy
+                if (ip >= n) return -1;
+                len = ((tag >> 2) & 7) + 4;
+                off = ((int64_t)(tag >> 5) << 8) | src[ip++];
+            } else if (type == 2) {
+                if (ip + 2 > n) return -1;
+                len = (tag >> 2) + 1;
+                off = src[ip] | ((int64_t)src[ip + 1] << 8);
+                ip += 2;
+            } else {
+                if (ip + 4 > n) return -1;
+                len = (tag >> 2) + 1;
+                off = (int64_t)src[ip] | ((int64_t)src[ip + 1] << 8) |
+                      ((int64_t)src[ip + 2] << 16) |
+                      ((int64_t)src[ip + 3] << 24);
+                ip += 4;
+            }
+            if (off == 0 || off > op || op + len > cap) return -1;
+            for (int64_t i = 0; i < len; i++) dst[op + i] = dst[op + i - off];
+            op += len;
+        }
+    }
+    return op;
+}
+
+}  // extern "C"

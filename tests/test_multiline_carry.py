@@ -234,3 +234,48 @@ class TestEndModeCarry:
         # the orphaned stash (source /gone never returned) flushed via g2
         assert b"2024-01-02 03:04:05 open" in records
         assert not ml._carry
+
+
+class TestCarryDrain:
+    """Held records must flush on idle (timeout tick) and at shutdown
+    (round-2 review finding: an idle pipeline's last record was lost)."""
+
+    def test_drain_groups_ships_all_carries(self):
+        ctx = PluginContext("t")
+        ml = ProcessorSplitMultilineLogString()
+        ml.init({"Multiline": {"StartPattern": START}}, ctx)
+        ml._stash("/var/x:7", b"2024-01-02 03:04:05 held", 42, [])
+        out = ml.drain_groups()
+        assert len(out) == 1 and not ml._carry
+        g = out[0]
+        assert _records(g) == [b"2024-01-02 03:04:05 held"]
+        assert str(g.get_metadata(EventGroupMetaKey.LOG_FILE_PATH)) == "/var/x"
+        assert int(g.columns.timestamps[0]) == 42
+
+    def test_flush_timeout_groups_respects_age(self, monkeypatch):
+        import loongcollector_tpu.processor.split_multiline as sm
+        ctx = PluginContext("t")
+        ml = ProcessorSplitMultilineLogString()
+        ml.init({"Multiline": {"StartPattern": START}}, ctx)
+        ml._stash("/a:1", b"fresh", 1, [])
+        assert ml.flush_timeout_groups() == []          # too young
+        monkeypatch.setattr(sm, "CARRY_FLUSH_S", 0.0)
+        out = ml.flush_timeout_groups()
+        assert len(out) == 1 and not ml._carry
+
+    def test_pipeline_stop_drains_carry_to_sink(self):
+        from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+        p = CollectionPipeline()
+        assert p.init("ml-drain", {
+            "inputs": [{"Type": "input_file", "FilePaths": ["/nonexistent"],
+                        "Multiline": {"StartPattern": START}}],
+            "processors": [],
+            "flushers": [{"Type": "flusher_blackhole"}],
+        })
+        ml = next(i.plugin for i in p.inner_processors
+                  if isinstance(i.plugin, ProcessorSplitMultilineLogString))
+        ml._stash("/var/y:9", b"2024-01-02 03:04:05 last record", 7, [])
+        bh = p.flushers[0].plugin
+        p.stop(is_removing=True)
+        assert bh.total_events == 1
+        p.release()
