@@ -66,13 +66,22 @@ class AggregatorBase(Aggregator):
         self.timeout_s = float(config.get("TimeoutSecs", 3.0))
         return True
 
+    @staticmethod
+    def _tag_fingerprint(group: PipelineEventGroup) -> Tuple:
+        """Groups with different tag sets must never merge — their events
+        would ship under the first group's labels."""
+        return tuple(sorted((bytes(k), bytes(v))
+                            for k, v in group.tags.items()))
+
     def _key(self, group: PipelineEventGroup, ev) -> Tuple:
-        return (group.get_tag(b"__topic__") or b"",)
+        return (self._tag_fingerprint(group),)
 
     def _group_meta(self, out: PipelineEventGroup, key: Tuple,
                     src: PipelineEventGroup) -> None:
         for k, v in src.tags.items():
             out.set_tag(k, v)
+        for k, v in src._metadata.items():
+            out.set_metadata(k, v)
 
     def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
         cols = group.columns
@@ -128,8 +137,11 @@ class AggregatorContext(AggregatorBase):
     name = "aggregator_context"
 
     def _key(self, group: PipelineEventGroup, ev) -> Tuple:
-        return (group.get_metadata(EventGroupMetaKey.LOG_FILE_PATH) or "",
-                group.get_metadata(EventGroupMetaKey.LOG_FILE_INODE) or "")
+        return (str(group.get_metadata(EventGroupMetaKey.LOG_FILE_PATH)
+                    or ""),
+                str(group.get_metadata(EventGroupMetaKey.LOG_FILE_INODE)
+                    or ""),
+                self._tag_fingerprint(group))
 
 
 class AggregatorMetadataGroup(AggregatorBase):

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import re
+from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
@@ -49,7 +50,10 @@ class FlusherElasticsearch(HttpSinkFlusher):
             for ts, obj in iter_event_dicts(g):
                 idx = resolve_dynamic(self.index, obj) if dynamic \
                     else self.index
-                obj.setdefault("@timestamp", ts)
+                # ISO-8601: ES date fields parse bare ints as epoch_MILLIS,
+                # which would land epoch-seconds logs in January 1970
+                obj.setdefault("@timestamp", datetime.fromtimestamp(
+                    ts, tz=timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"))
                 lines.append(json.dumps(
                     {"index": {"_index": idx}}).encode())
                 lines.append(json.dumps(obj, ensure_ascii=False).encode())

@@ -8,11 +8,21 @@ pairs grouped by label set.
 from __future__ import annotations
 
 import json
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
 from ..pipeline.serializer.event_dicts import iter_event_dicts
 from .http_base import HttpSinkFlusher, basic_auth_header
+
+
+def _label_name(key: str) -> str:
+    """Loki label names must match [a-zA-Z_:][a-zA-Z0-9_:]* — anything else
+    gets the batch 400'd (and dropped) at the push endpoint."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", key)
+    if not name or not re.match(r"[a-zA-Z_:]", name[0]):
+        name = "_" + name
+    return name
 
 
 class FlusherLoki(HttpSinkFlusher):
@@ -38,7 +48,7 @@ class FlusherLoki(HttpSinkFlusher):
                 for key in self.dynamic_labels:
                     v = obj.pop(key, None)
                     if v is not None:
-                        labels[key.replace(".", "_")] = str(v)
+                        labels[_label_name(key)] = str(v)
                 if "content" in obj and len(obj) == 1:
                     line = str(obj["content"])
                 else:

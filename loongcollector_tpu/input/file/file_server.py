@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ...utils.logger import get_logger
 from .checkpoint import CheckPointManager
+from .event_listener import create_listener
 from .polling import FileDiscoveryConfig, PollingDirFile
 from .reader import LogFileReader
 
@@ -26,6 +27,9 @@ log = get_logger("file_server")
 
 DISCOVERY_INTERVAL_S = 1.0
 IDLE_SLEEP_S = 0.05
+# with inotify the thread sleeps ON the fd, so the poll interval can relax:
+# events wake it instantly and polling is only the discovery/rotation net
+IDLE_SLEEP_INOTIFY_S = 0.25
 
 
 class _ConfigState:
@@ -44,6 +48,7 @@ class _ConfigState:
         self.first_round = True
         self.multiline_start = multiline_start
         self.multiline_end = multiline_end
+        self.pending: set = set()   # paths with bytes left after a drain
 
     def new_reader(self, path: str) -> LogFileReader:
         return LogFileReader(path, multiline_start=self.multiline_start,
@@ -66,6 +71,13 @@ class FileServer:
         # event_handler/LogInput.cpp:156-203): 0..1 fraction of the agent's
         # CPU budget in use; high levels stretch the poll sleep
         self.cpu_level_provider = None
+        # inotify merged with polling (EventListener_Linux.h); None on
+        # non-Linux or when LOONG_DISABLE_INOTIFY is set
+        self._listener = None
+        self._dirty_paths: set = set()
+        # False when any watch failed (max_user_watches, permission): the
+        # poll interval stays tight so unwatched paths aren't slow-tailed
+        self._watch_complete = False
 
     @classmethod
     def instance(cls) -> "FileServer":
@@ -110,6 +122,7 @@ class FileServer:
                 return
             self._running = True
         self.checkpoints.load()
+        self._listener = create_listener()
         self._thread = threading.Thread(target=self._run, name="file-server",
                                         daemon=True)
         self._thread.start()
@@ -151,22 +164,44 @@ class FileServer:
             except Exception:  # noqa: BLE001 - never kill the event thread
                 log.exception("file server round failed")
                 busy = False
-            sleep = IDLE_SLEEP_S
+            base = (IDLE_SLEEP_INOTIFY_S
+                    if self._listener is not None and self._watch_complete
+                    else IDLE_SLEEP_S)
+            sleep = base
             level = self.cpu_level_provider() if self.cpu_level_provider else 0.0
             if level > 0.9:
-                sleep = IDLE_SLEEP_S * 8     # heavy throttle near the limit
+                sleep = base * 8             # heavy throttle near the limit
             elif level > 0.7:
-                sleep = IDLE_SLEEP_S * 3
-            if not busy or level > 0.9:
+                sleep = base * 3
+            if busy and level <= 0.9:
+                continue
+            if self._listener is not None:
+                # sleep ON the inotify fd: an append wakes the thread now,
+                # not at the next poll tick (sub-poll-interval tail latency)
+                for path, needs_discovery in self._listener.wait(sleep):
+                    self._dirty_paths.add(path)
+                    if needs_discovery:
+                        with self._lock:
+                            for st in self._configs.values():
+                                st.last_discovery = 0.0
+            else:
                 time.sleep(sleep)
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None
 
     def _round(self) -> bool:
         with self._lock:
             states = list(self._configs.values())
+        dirty = self._dirty_paths
+        self._dirty_paths = set()
         busy = False
         now = time.monotonic()
+        live_dirs: set = set()
         for st in states:
+            ran_discovery = False
             if now - st.last_discovery >= DISCOVERY_INTERVAL_S or st.first_round:
+                ran_discovery = True
                 st.last_discovery = now
                 st.known = st.poller.poll()
                 for path in st.known:
@@ -185,11 +220,27 @@ class FileServer:
                                                 r.dev_inode.inode)
                         r.close()
                 st.first_round = False
-            # drain any reader with unread bytes — back-pressured or
-            # burst-capped files retry here next round (never stall on stat)
-            for r in list(st.readers.values()):
+            # drain readers with unread bytes. With complete inotify
+            # coverage, off-discovery rounds only stat files that fired an
+            # event or still had bytes after the last burst — THE idle-CPU
+            # win of the listener; the periodic discovery pass remains the
+            # safety net for inotify-silent filesystems.
+            if self._listener is not None and self._watch_complete \
+                    and not ran_discovery:
+                targets = [st.readers[p]
+                           for p in (dirty | st.pending) if p in st.readers]
+            else:
+                targets = list(st.readers.values())
+            for r in targets:
                 if r.has_more():
-                    busy |= self._drain_reader(st, r)
+                    moved = self._drain_reader(st, r)
+                    busy |= moved
+                    if r.has_more():
+                        st.pending.add(r.path)   # burst cap / back-pressure
+                    else:
+                        st.pending.discard(r.path)
+                else:
+                    st.pending.discard(r.path)
             for r in list(st.rotated):
                 busy |= self._drain_reader(st, r, force_flush=True)
                 if not r.has_more():
@@ -199,6 +250,23 @@ class FileServer:
                                             r.dev_inode.inode)
                     r.close()
                     st.rotated.remove(r)
+            if self._listener is not None:
+                import os as _os
+                for path in st.known:
+                    live_dirs.add(_os.path.dirname(path) or ".")
+                for pattern in st.poller.config.file_paths:
+                    # static prefix of each glob: catches files created later
+                    d = _os.path.dirname(pattern)
+                    while any(c in d for c in "*?["):
+                        d = _os.path.dirname(d)
+                    if d and _os.path.isdir(d):
+                        live_dirs.add(d)
+        if self._listener is not None:
+            complete = True
+            for d in live_dirs:
+                complete = self._listener.watch_dir(d) and complete
+            self._listener.unwatch_missing(live_dirs)
+            self._watch_complete = complete
         return busy
 
     def _check_rotation(self, st: _ConfigState, path: str) -> None:

@@ -179,6 +179,13 @@ class LogFileReader:
             self.offset = 0
             self._prev_partial = False
             self._ml_hold_size = -1
+        if (not force_flush and self._ml_hold_size == size
+                and time.monotonic() - self._ml_hold_since
+                < self._ml_flush_timeout):
+            # still holding the same open record and nothing new arrived:
+            # skip the pread + backward scan (the hold would re-run on the
+            # same bytes every poll round otherwise)
+            return None
         want = min(self.chunk_size, size - self.offset)
         if want <= 0:
             return None

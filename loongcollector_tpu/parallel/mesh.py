@@ -63,13 +63,18 @@ class ShardedParsePlane:
             }
             return ok, off, length, stats
 
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map  # jax ≥ 0.8 (check_rep retired)
+            kw = {}
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+            kw = {"check_rep": False}
         sharded = shard_map(
             _local_step, mesh=self.mesh,
             in_specs=(P(axis, None), P(axis)),
             out_specs=(P(axis), P(axis, None), P(axis, None),
                        {"matched": P(), "events": P(), "bytes": P()}),
-            check_rep=False)
+            **kw)
         self._fn = jax.jit(sharded)
         ax = axis
         self._in_shardings = (NamedSharding(self.mesh, P(ax, None)),

@@ -24,6 +24,7 @@ read chunks still yields ONE event (round-2 VERDICT item 3).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -47,8 +48,11 @@ class ProcessorSplitMultilineLogString(Processor):
         self.cont: Optional[RegexEngine] = None
         self.end: Optional[RegexEngine] = None
         self.unmatched = "single_line"  # or "discard"
-        # per-source open-record stash: key → (bytes, event_ts, stashed_at)
+        # per-source open-record stash: key → (bytes, event_ts, stashed_at);
+        # locked: _finish runs on processor threads, flush_timeout_groups on
+        # thread 0's timeout tick (same contract as Batcher)
         self._carry: Dict[str, Tuple[bytes, int, float]] = {}
+        self._carry_lock = threading.Lock()
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -158,7 +162,8 @@ class ProcessorSplitMultilineLogString(Processor):
         ml_continue = group.get_metadata(EventGroupMetaKey.ML_CONTINUE) == "1"
         ml_partial = group.get_metadata(
             EventGroupMetaKey.ML_PARTIAL_TAIL) == "1"
-        carried = self._carry.pop(key, None)
+        with self._carry_lock:
+            carried = self._carry.pop(key, None)
 
         # records: (order, arena_off, arena_len) — order keeps input order;
         # injected: (order, bytes, ts) — carried records copied into the
@@ -170,11 +175,12 @@ class ProcessorSplitMultilineLogString(Processor):
         # back): deliver their bytes through THIS group rather than losing
         # them — content intact, group-level source meta may differ
         now = time.monotonic()
-        for k in list(self._carry):
-            b, t, at = self._carry[k]
-            if now - at > CARRY_TTL_S:
-                del self._carry[k]
-                injected.append((-2, b, t))
+        with self._carry_lock:
+            for k in list(self._carry):
+                b, t, at = self._carry[k]
+                if now - at > CARRY_TTL_S:
+                    del self._carry[k]
+                    injected.append((-2, b, t))
 
         # leading run of unmatched lines (contiguous from line 0) — the
         # lines a carried open record can continue into
@@ -264,7 +270,8 @@ class ProcessorSplitMultilineLogString(Processor):
 
     def _stash(self, key, data: bytes, ts: int, injected) -> None:
         if len(data) <= CARRY_CAP_BYTES:
-            self._carry[key] = (data, ts, time.monotonic())
+            with self._carry_lock:
+                self._carry[key] = (data, ts, time.monotonic())
         else:
             injected.append((1 << 30, data, ts))  # too big: emit as-is, last
 
@@ -292,20 +299,21 @@ class ProcessorSplitMultilineLogString(Processor):
         pipeline's timeout tick, so an idle source still delivers its last
         record (reference flush-timeout semantics)."""
         now = time.monotonic()
-        out: List[PipelineEventGroup] = []
-        for key in list(self._carry):
-            data, ts, at = self._carry[key]
-            if now - at >= CARRY_FLUSH_S:
-                del self._carry[key]
-                out.append(self._carry_group(key, data, ts))
-        return out
+        expired: List[Tuple[str, bytes, int]] = []
+        with self._carry_lock:
+            for key in list(self._carry):
+                data, ts, at = self._carry[key]
+                if now - at >= CARRY_FLUSH_S:
+                    del self._carry[key]
+                    expired.append((key, data, ts))
+        return [self._carry_group(k, d, t) for k, d, t in expired]
 
     def drain_groups(self) -> List[PipelineEventGroup]:
         """Shutdown: every held record ships (pipeline stop drain)."""
-        out = [self._carry_group(k, d, t)
-               for k, (d, t, _) in self._carry.items()]
-        self._carry.clear()
-        return out
+        with self._carry_lock:
+            held = list(self._carry.items())
+            self._carry.clear()
+        return [self._carry_group(k, d, t) for k, (d, t, _) in held]
 
     def _emit(self, group, records, injected, tss=None) -> None:
         sb = group.source_buffer

@@ -1,0 +1,48 @@
+"""Tail-latency with inotify: pickup must beat the (stretched) poll tick."""
+import os, time, threading
+import pytest
+from loongcollector_tpu.input.file import file_server as fsmod
+from loongcollector_tpu.input.file.file_server import FileServer, _ConfigState
+from loongcollector_tpu.input.file.polling import FileDiscoveryConfig
+
+
+class _StubPQM:
+    def __init__(self):
+        self.groups = []
+        self.times = []
+    def is_valid_to_push(self, key): return True
+    def push_queue(self, key, group):
+        self.groups.append(group); self.times.append(time.monotonic())
+        return True
+
+
+def test_inotify_pickup_beats_poll_interval(tmp_path, monkeypatch):
+    # stretch the poll tick to 2s: only the inotify wake can deliver fast
+    monkeypatch.setattr(fsmod, "IDLE_SLEEP_INOTIFY_S", 2.0)
+    p = tmp_path / "t.log"
+    p.write_bytes(b"first\n")
+    fs = FileServer()
+    pqm = _StubPQM()
+    fs.process_queue_manager = pqm
+    fs.add_config("t", FileDiscoveryConfig([str(p)]), queue_key=1,
+                  tail_existing=True)
+    fs.start()
+    try:
+        assert fs._listener is not None, "inotify unavailable on this host"
+        deadline = time.monotonic() + 10
+        while not pqm.groups and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pqm.groups, "initial content never arrived"
+        # let the thread settle into its 2s fd sleep
+        time.sleep(0.8)
+        t0 = time.monotonic()
+        with p.open("ab") as f:
+            f.write(b"appended-line\n")
+        while len(pqm.groups) < 2 and time.monotonic() < t0 + 10:
+            time.sleep(0.005)
+        assert len(pqm.groups) >= 2, "append never arrived"
+        latency = pqm.times[-1] - t0
+        # sub-poll-interval pickup (poll tick is 2s here; inotify wakes in ms)
+        assert latency < 1.0, f"pickup took {latency:.3f}s (poll-bound)"
+    finally:
+        fs.stop()

@@ -116,7 +116,9 @@ class TestElasticsearch:
         action0 = json.loads(lines[0])
         assert action0["index"]["_index"] == "logs-web"
         doc0 = json.loads(lines[1])
-        assert doc0["msg"] == "hi" and doc0["@timestamp"] == 1700000001
+        assert doc0["msg"] == "hi"
+        # ISO-8601, not epoch seconds (ES would read a bare int as millis)
+        assert doc0["@timestamp"] == "2023-11-14T22:13:21Z"
         assert json.loads(lines[2])["index"]["_index"] == "logs-api"
 
 
@@ -327,3 +329,40 @@ class TestAggregators:
         p.flush_batch()
         assert bh.total_events == 2
         p.release()
+
+
+class TestSinkReviewFixes:
+    """Round-2 review regressions: label sanitization, tag-keyed buckets."""
+
+    def test_loki_label_names_sanitized(self):
+        from loongcollector_tpu.flusher.loki import _label_name
+        assert _label_name("app-name") == "app_name"
+        assert _label_name("k8s.pod/name") == "k8s_pod_name"
+        assert _label_name("0bad") == "_0bad"
+        assert _label_name("ok_name:x") == "ok_name:x"
+
+    def test_aggregator_never_merges_differing_tags(self):
+        reg = PluginRegistry.instance()
+        reg.load_static_plugins()
+        agg = reg.create_aggregator("aggregator_base")
+        agg.init({"MaxLogCount": 100}, PluginContext("t"))
+        g1 = _log_group([(1, {"m": "a"})])
+        g1.set_tag(b"host", b"h1")
+        g2 = _log_group([(1, {"m": "b"})])
+        g2.set_tag(b"host", b"h2")
+        agg.add(g1)
+        agg.add(g2)
+        out = agg.flush()
+        hosts = sorted(bytes(o.get_tag(b"host")) for o in out)
+        assert hosts == [b"h1", b"h2"]
+
+    def test_aggregator_context_copies_source_metadata(self):
+        reg = PluginRegistry.instance()
+        agg = reg.create_aggregator("aggregator_context")
+        agg.init({}, PluginContext("t"))
+        g = _log_group([(1, {"m": "a"})])
+        g.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, "/var/log/a")
+        agg.add(g)
+        out = agg.flush()
+        assert str(out[0].get_metadata(EventGroupMetaKey.LOG_FILE_PATH)) \
+            == "/var/log/a"
