@@ -29,6 +29,9 @@ class InputContainerStdio(Input):
         self.config_name = ""
         self._refresh_thread = None
         self._running = False
+        self._tag_map: Dict[str, Dict[bytes, bytes]] = {}
+        self._resolved: Dict[str, Any] = {}
+        self._tag_lock = threading.Lock()
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -56,17 +59,58 @@ class InputContainerStdio(Input):
     def _matched_paths(self) -> List[str]:
         mgr = ContainerManager.instance()
         paths = []
+        tag_map = {}
         for info in mgr.discover():
+            if not info.log_path:
+                continue  # no tailable path (e.g. non-K8s CRI container)
             if self.filters.match(info):
                 paths.append(info.log_path)
+                tags = {b"_container_name_": info.name.encode(),
+                        b"_container_id_": info.id.encode()[:12]}
+                if info.image:
+                    tags[b"_image_name_"] = info.image.encode()
+                if info.k8s_pod:
+                    tags[b"_namespace_"] = info.k8s_namespace.encode()
+                    tags[b"_pod_name_"] = info.k8s_pod.encode()
+                for lk, lv in info.labels.items():
+                    if lk.startswith("pod.label."):
+                        tags[lk.encode()] = lv.encode()
+                tag_map[info.log_path] = tags
+        with self._tag_lock:
+            self._tag_map = tag_map
+            self._resolved.clear()   # concrete-path cache keys old patterns
         return paths
+
+    def _tags_for(self, path: str):
+        """Reader paths are concrete files; discovery paths may be globs —
+        match either exactly or by pattern (reference external k8s tags:
+        _namespace_/_pod_name_/_container_name_/_image_name_). Resolution
+        is cached per concrete path: this runs on the FileServer drain hot
+        path, once per chunk."""
+        import fnmatch
+        with self._tag_lock:
+            if path in self._resolved:
+                return self._resolved[path]
+            tag_map = self._tag_map
+        hit = tag_map.get(path)
+        if hit is None:
+            for pattern, tags in tag_map.items():
+                if fnmatch.fnmatch(path, pattern):
+                    hit = tags
+                    break
+        with self._tag_lock:
+            if len(self._resolved) > 8192:
+                self._resolved.clear()
+            self._resolved[path] = hit
+        return hit
 
     def start(self) -> bool:
         paths = self._matched_paths()
         fs = FileServer.instance()
         fs.add_config(self.config_name,
                       FileDiscoveryConfig(file_paths=paths or ["/nonexistent"]),
-                      self.context.process_queue_key, tail_existing=True)
+                      self.context.process_queue_key, tail_existing=True,
+                      tag_provider=self._tags_for)
         fs.start()
         # periodic re-discovery updates the glob set (container churn)
         self._running = True

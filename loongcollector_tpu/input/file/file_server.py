@@ -49,6 +49,9 @@ class _ConfigState:
         self.multiline_start = multiline_start
         self.multiline_end = multiline_end
         self.pending: set = set()   # paths with bytes left after a drain
+        # optional per-path group tags (container meta on stdio inputs):
+        # callable(path) -> Dict[bytes, bytes] | None
+        self.tag_provider = None
 
     def new_reader(self, path: str) -> LogFileReader:
         return LogFileReader(path, multiline_start=self.multiline_start,
@@ -91,11 +94,14 @@ class FileServer:
     def add_config(self, name: str, discovery: FileDiscoveryConfig,
                    queue_key: int, tail_existing: bool = False,
                    multiline_start: Optional[str] = None,
-                   multiline_end: Optional[str] = None) -> None:
+                   multiline_end: Optional[str] = None,
+                   tag_provider=None) -> None:
         with self._lock:
-            self._configs[name] = _ConfigState(
+            st = _ConfigState(
                 name, discovery, queue_key, tail_existing,
                 multiline_start=multiline_start, multiline_end=multiline_end)
+            st.tag_provider = tag_provider
+            self._configs[name] = st
 
     def update_config_paths(self, name: str, file_paths) -> None:
         """Replace a registered config's discovery globs (container churn);
@@ -318,6 +324,14 @@ class FileServer:
                 break  # reader closed concurrently (config removal)
             if group is None or not reader.is_open:
                 break
+            if st.tag_provider is not None:
+                try:
+                    tags = st.tag_provider(reader.path)
+                except Exception:  # noqa: BLE001
+                    tags = None
+                if tags:
+                    for k, v in tags.items():
+                        group.set_tag(k, v)
             if pqm is not None:
                 if not pqm.push_queue(st.queue_key, group):
                     # queue rejected after read: roll the offset back

@@ -1,0 +1,244 @@
+"""K8s metadata + CRI discovery (round-2 VERDICT #6): CRI runtime API over
+a fake gRPC endpoint, pod/service metadata against a fake apiserver (TTL +
+watch), and container meta tags landing on stdio-input events.
+"""
+
+import http.server
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.container_manager import (CRISocketDiscovery,
+                                                  K8sMetadata, pb_fields)
+
+
+def _varint(v):
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _ld(field, payload):
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(field, v):
+    return _varint(field << 3) + _varint(v)
+
+
+def _cri_container(cid, name, image, labels, state=1):
+    body = _ld(1, cid.encode())
+    body += _ld(3, _ld(1, name.encode()))          # metadata.name
+    body += _ld(4, _ld(1, image.encode()))         # image.image
+    body += _vi(6, state)                          # state
+    for k, v in labels.items():
+        body += _ld(8, _ld(1, k.encode()) + _ld(2, v.encode()))
+    return _ld(1, body)
+
+
+@pytest.fixture
+def fake_cri(tmp_path):
+    """gRPC server answering runtime.v1.RuntimeService/ListContainers with
+    a hand-encoded ListContainersResponse."""
+    grpc = pytest.importorskip("grpc")
+
+    labels = {"io.kubernetes.pod.namespace": "prod",
+              "io.kubernetes.pod.name": "web-abc",
+              "io.kubernetes.pod.uid": "u-123",
+              "io.kubernetes.container.name": "app"}
+    response = (_cri_container("c1", "app", "nginx:1.25", labels)
+                + _cri_container("c2", "dead", "img", {}, state=2))
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, details):
+            if details.method.endswith("/ListContainers"):
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: response,
+                    request_deserializer=lambda x: x,
+                    response_serializer=lambda x: x)
+            return None
+
+    server = grpc.server(
+        __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"])
+        .ThreadPoolExecutor(max_workers=2))
+    sock = str(tmp_path / "cri.sock")
+    server.add_generic_rpc_handlers((Handler(),))
+    server.add_insecure_port(f"unix:{sock}")
+    server.start()
+    yield sock
+    server.stop(0)
+
+
+class TestCRISocketDiscovery:
+    def test_lists_running_containers(self, fake_cri):
+        d = CRISocketDiscovery()
+        d.socket_override = fake_cri
+        out = d.list_containers()
+        assert len(out) == 1                       # non-running filtered out
+        c = out[0]
+        assert c.id == "c1" and c.name == "app"
+        assert c.image == "nginx:1.25"
+        assert (c.k8s_namespace, c.k8s_pod, c.k8s_container) == \
+            ("prod", "web-abc", "app")
+        assert c.log_path.endswith("prod_web-abc_u-123/app/*.log")
+
+    def test_pb_roundtrip_map(self):
+        raw = _ld(8, _ld(1, b"k") + _ld(2, b"v"))
+        f = pb_fields(raw)
+        inner = pb_fields(f[8][0])
+        assert inner[1][0] == b"k" and inner[2][0] == b"v"
+
+
+class _FakeApiserver(http.server.BaseHTTPRequestHandler):
+    pods = {}
+    services = {}
+    watch_events = []
+    hits = []
+
+    def do_GET(self):
+        _FakeApiserver.hits.append(self.path)
+        if "watch=1" in self.path:
+            self.send_response(200)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for ev in _FakeApiserver.watch_events:
+                data = (json.dumps(ev) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                 + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            return
+        # /api/v1/namespaces/<ns>/pods/<name> | /api/v1/namespaces/<ns>/services
+        parts = self.path.strip("/").split("/")
+        body = None
+        if len(parts) >= 6 and parts[4] == "pods":
+            body = _FakeApiserver.pods.get(f"{parts[3]}/{parts[5]}")
+        elif len(parts) >= 5 and parts[4] == "services":
+            body = {"items": _FakeApiserver.services.get(parts[3], [])}
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def fake_apiserver():
+    _FakeApiserver.pods = {"prod/web-abc": {
+        "metadata": {"labels": {"app": "web", "tier": "fe"}},
+        "spec": {"nodeName": "n1"},
+        "status": {"podIP": "10.0.0.5"},
+    }}
+    _FakeApiserver.services = {"prod": [
+        {"metadata": {"name": "web-svc"},
+         "spec": {"selector": {"app": "web"}, "clusterIP": "10.96.0.1"}},
+        {"metadata": {"name": "other"},
+         "spec": {"selector": {"app": "db"}, "clusterIP": "10.96.0.2"}},
+    ]}
+    _FakeApiserver.watch_events = []
+    _FakeApiserver.hits = []
+    server = http.server.HTTPServer(("127.0.0.1", 0), _FakeApiserver)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_port
+    server.shutdown()
+
+
+class TestK8sMetadata:
+    def test_pod_metadata_ttl_cache(self, fake_apiserver):
+        k = K8sMetadata()
+        k.configure("http", "127.0.0.1", fake_apiserver, token="t")
+        meta = k.pod_metadata("prod", "web-abc")
+        assert meta["labels"] == {"app": "web", "tier": "fe"}
+        assert meta["node"] == "n1" and meta["ip"] == "10.0.0.5"
+        n_hits = len(_FakeApiserver.hits)
+        assert k.pod_metadata("prod", "web-abc") == meta   # cache hit
+        assert len(_FakeApiserver.hits) == n_hits          # no new request
+
+    def test_services_for_pod(self, fake_apiserver):
+        k = K8sMetadata()
+        k.configure("http", "127.0.0.1", fake_apiserver, token="t")
+        assert k.services_for_pod("prod", "web-abc") == ["web-svc"]
+
+    def test_watch_updates_cache(self, fake_apiserver):
+        k = K8sMetadata()
+        k.configure("http", "127.0.0.1", fake_apiserver, token="t")
+        _FakeApiserver.watch_events = [
+            {"type": "ADDED", "object": {
+                "metadata": {"namespace": "prod", "name": "new-pod",
+                             "labels": {"x": "1"}},
+                "spec": {"nodeName": "n1"}, "status": {"podIP": "10.0.0.9"}}},
+        ]
+        assert k.start_watch()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with k._lock:
+                if "prod/new-pod" in k._cache:
+                    break
+            time.sleep(0.02)
+        k.stop_watch()
+        with k._lock:
+            assert "prod/new-pod" in k._cache
+            assert k._cache["prod/new-pod"][0]["labels"] == {"x": "1"}
+
+
+class TestContainerTagsOnEvents:
+    def test_stdio_groups_carry_container_tags(self, tmp_path, monkeypatch):
+        """End-to-end through FileServer: a CRI-log-dir container's chunks
+        arrive tagged with _namespace_/_pod_name_/_container_name_."""
+        from loongcollector_tpu.container_manager import (ContainerManager,
+                                                          CRIDiscovery)
+        from loongcollector_tpu.input.container_stdio import \
+            InputContainerStdio
+        from loongcollector_tpu.input.file.file_server import FileServer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+
+        pod_dir = tmp_path / "pods" / "prod_web-abc_u-1" / "app"
+        pod_dir.mkdir(parents=True)
+        (pod_dir / "0.log").write_bytes(
+            b"2024-01-02T03:04:05.0Z stdout F hello\n")
+
+        mgr = ContainerManager()
+        mgr.cri = CRIDiscovery(str(tmp_path / "pods"))
+        mgr.cri_socket.socket_override = "/nonexistent.sock"
+        mgr.docker.sock_path = "/nonexistent-docker.sock"
+        monkeypatch.setattr(ContainerManager, "_instance", mgr)
+
+        fs = FileServer()
+        monkeypatch.setattr(FileServer, "_instance", fs)
+        pushed = []
+
+        class _PQM:
+            def is_valid_to_push(self, key): return True
+            def push_queue(self, key, group):
+                pushed.append(group); return True
+        fs.process_queue_manager = _PQM()
+
+        inp = InputContainerStdio()
+        ctx = PluginContext("t")
+        ctx.process_queue_key = 1
+        assert inp.init({}, ctx)
+        assert inp.start()
+        try:
+            deadline = time.monotonic() + 10
+            while not pushed and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            inp.stop()
+            fs.stop()
+        assert pushed, "container log chunk never arrived"
+        g = pushed[0]
+        assert bytes(g.get_tag(b"_namespace_")) == b"prod"
+        assert bytes(g.get_tag(b"_pod_name_")) == b"web-abc"
+        assert bytes(g.get_tag(b"_container_name_")) == b"app"
