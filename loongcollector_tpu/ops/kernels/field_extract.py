@@ -122,8 +122,12 @@ def walk_masks(program: SegmentProgram):
     collect(list(program.ops))
     if program.suffix_ops:
         collect(list(program.suffix_ops), reverse=True)
+    if program.mid_ops:
+        collect(list(program.mid_ops))
     if program.pivot is not None:
         count_classes.add(program.pivot.class_id)
+    if program.pivot2 is not None:
+        count_classes.add(program.pivot2.class_id)
     return span_classes, count_classes, literals
 
 
@@ -141,8 +145,14 @@ def build_extract_core(program: SegmentProgram):
     top_ops = list(program.ops)
     suffix_ops = list(program.suffix_ops) if program.suffix_ops else None
     pivot = program.pivot
+    pivot2 = program.pivot2
+    mid_ops = list(program.mid_ops) if program.mid_ops else None
+    mid_end_caps = list(program.mid_end_caps)
     split_caps = list(program.split_caps)
     span_classes, count_classes, literals = walk_masks(program)
+    if mid_ops is not None:
+        mid_lit = next(op for op in mid_ops if isinstance(op, Lit))
+        mid_fixed = len(mid_lit.data)
 
     def core(rows: jnp.ndarray, lens: jnp.ndarray):
         B, L = rows.shape
@@ -332,6 +342,68 @@ def build_extract_core(program: SegmentProgram):
         all_rows = jnp.ones((B, 1), bool)
         st = _WalkState(jnp.zeros((B, 1), i32), all_rows, ncaps)
         emit(top_ops, st, all_rows)
+
+        if pivot2 is not None:
+            # double-pivot: prefix | pivot1 | MID-LITERAL | pivot2 | suffix.
+            # Locate the boundary literal inside the gap with a min/max
+            # reduce, then verify both pivot regions by masked counts
+            # (soundness conditions enforced by _try_double_pivot).
+            fwd_starts = {k: st.cap_start[k] for k in split_caps}
+            rst = st.copy()
+            rst.cur = lens
+            floor = (st.cur + pivot.min_len + mid_fixed + pivot2.min_len)
+            emit_reverse(suffix_ops, rst, all_rows, floor)
+            lo1 = st.cur                  # pivot1 start
+            hi2 = rst.cur                 # pivot2 exclusive end
+            p_lo = lo1 + pivot.min_len
+            p_hi = hi2 - mid_fixed - pivot2.min_len
+            feasible = (lit_ok[mid_lit.data] & (pos >= p_lo)
+                        & (pos <= p_hi))
+            if pivot.lazy:                # both lazy: first occurrence
+                cand = jnp.where(feasible, pos, L32)
+                p = jnp.min(cand, axis=1, keepdims=True)
+                found = p < L32
+            else:                         # both greedy: last occurrence
+                cand = jnp.where(feasible, pos, jnp.int32(-1))
+                p = jnp.max(cand, axis=1, keepdims=True)
+                found = p >= 0
+            p = jnp.clip(p, 0, L32)
+            # middle ops run on the shared forward state at cur = p: the
+            # literal advances the cursor, cap markers record edges
+            st.cur = jnp.where(found, p, lo1)
+            st.ok = st.ok & found
+            emit(mid_ops, st, all_rows)
+            lo2 = st.cur                  # pivot2 start (= p + |L|)
+            run1 = p - lo1
+            inside1 = (pos >= lo1) & (pos < p)
+            cnt1 = jnp.sum((member[pivot.class_id] & inside1).astype(i32),
+                           axis=1, keepdims=True)
+            run2 = hi2 - lo2
+            inside2 = (pos >= lo2) & (pos < hi2)
+            cnt2 = jnp.sum((member[pivot2.class_id] & inside2).astype(i32),
+                           axis=1, keepdims=True)
+            ok = (st.ok & rst.ok & found & (hi2 >= lo2)
+                  & (cnt1 == run1) & (run1 >= pivot.min_len)
+                  & (cnt2 == run2) & (run2 >= pivot2.min_len))
+            final = rst
+            # caps closed in prefix already live in rst (copied after the
+            # prefix walk); caps closed in the MIDDLE were recorded into st
+            # after that copy — pull them over
+            for k in mid_end_caps:
+                final.cap_off[k] = st.cap_off[k]
+                final.cap_len[k] = st.cap_len[k]
+            # split caps: open in prefix/middle (forward left edge), close
+            # in the suffix (reverse right edge)
+            for k in split_caps:
+                left = jnp.where(
+                    found, st.cap_start[k], fwd_starts[k])
+                final.cap_off[k] = left
+                final.cap_len[k] = rst.cap_start[k] - left
+            off = jnp.concatenate(final.cap_off, axis=1)
+            length = jnp.concatenate(final.cap_len, axis=1)
+            length = jnp.where(ok, length, -1)
+            off = jnp.where(ok, off, 0)
+            return ok, off, length
 
         if pivot is not None:
             # snapshot the forward left edges of split captures BEFORE the

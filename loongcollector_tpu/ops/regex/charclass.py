@@ -108,6 +108,9 @@ class CharClass:
     def intersects(self, other: "CharClass") -> bool:
         return bool((self.mask & other.mask).any())
 
+    def issubset(self, other: "CharClass") -> bool:
+        return bool((self.mask & ~other.mask).sum() == 0)
+
     def contains(self, byte: int) -> bool:
         return bool(self.mask[byte])
 

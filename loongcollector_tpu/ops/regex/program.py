@@ -133,6 +133,13 @@ class SegmentProgram:
     pivot: Optional["Span"] = None
     suffix_ops: Optional[List[Op]] = None      # stored pre-reversed
     split_caps: List[int] = field(default_factory=list)
+    # double-pivot form (two ambiguous spans separated by a literal):
+    # ops = prefix | pivot | mid_ops (one Lit + cap markers) | pivot2 |
+    # suffix_ops. The boundary literal is located by a min- (both lazy) or
+    # max-reduce (both greedy); soundness conditions in _try_double_pivot.
+    pivot2: Optional["Span"] = None
+    mid_ops: Optional[List[Op]] = None
+    mid_end_caps: List[int] = field(default_factory=list)
 
     def class_id(self, cls: CharClass) -> int:
         for i, c in enumerate(self.classes):
@@ -160,8 +167,12 @@ class SegmentProgram:
         walk(self.ops)
         if self.suffix_ops is not None:
             walk(self.suffix_ops)
+        if self.mid_ops is not None:
+            walk(self.mid_ops)
         if self.pivot is not None:
             cumsum.add(self.pivot.class_id)
+        if self.pivot2 is not None:
+            cumsum.add(self.pivot2.class_id)
         return next_non, cumsum
 
     def max_reach(self) -> int:
@@ -602,22 +613,8 @@ def _try_pivot_split(prog: SegmentProgram) -> bool:
             continue
         # captures spanning the split: CapStart in prefix whose CapEnd sits
         # in the suffix
-        def cap_ids(seq, cls):
-            found = set()
-
-            def walk(oo):
-                for o in oo:
-                    if isinstance(o, cls):
-                        found.add(o.cap_id)
-                    elif isinstance(o, Optional_):
-                        walk(o.body)
-                    elif isinstance(o, Alt):
-                        for b in o.branches:
-                            walk(b)
-            walk(seq)
-            return found
-        starts_prefix = cap_ids(prefix, CapStart)
-        ends_suffix = cap_ids(suffix, CapEnd)
+        starts_prefix = _cap_ids(prefix, CapStart)
+        ends_suffix = _cap_ids(suffix, CapEnd)
         split = sorted(starts_prefix & ends_suffix)
         # a capture OPENING in the suffix but closing... cannot happen
         # (well-formed nesting), and captures fully inside either side are
@@ -627,6 +624,94 @@ def _try_pivot_split(prog: SegmentProgram) -> bool:
         prog.suffix_ops = rev
         prog.split_caps = split
         return True
+    return False
+
+
+def _cap_ids(seq, cls) -> set:
+    found = set()
+
+    def walk(oo):
+        for o in oo:
+            if isinstance(o, cls):
+                found.add(o.cap_id)
+            elif isinstance(o, Optional_):
+                walk(o.body)
+            elif isinstance(o, Alt):
+                for b in o.branches:
+                    walk(b)
+    walk(seq)
+    return found
+
+
+def _try_double_pivot(prog: SegmentProgram) -> bool:
+    """Two ambiguous spans separated by a boundary literal — the common
+    `%{DATA}` × 2 grok shape (processor_grok.go:55-56 semantics).
+
+    Structure: prefix | pivot1 | middle | pivot2 | suffix, where middle is
+    ONE literal L (plus capture markers). The kernel walks prefix forward,
+    suffix in reverse, then locates L inside the gap with a min-reduce
+    (both pivots lazy → first feasible occurrence) or max-reduce (both
+    greedy → last), and validates both pivot regions by masked counts.
+
+    Commit-to-first is equivalent to the backtracking engine iff a failure
+    of the chosen occurrence implies failure of every later one. That holds
+    when any byte pivot2 cannot absorb also cannot be re-assigned to a
+    later boundary's pivot1 region or L match:
+        class(pivot1) ⊆ class(pivot2)  and  bytes(L) ⊆ class(pivot2).
+    Commit-to-last (greedy) mirrors:  class2 ⊆ class1 and bytes(L) ⊆ class1.
+    Unbounded maxima are required — a max-length bound could force the
+    engine to a different occurrence the reduce would skip."""
+    ops = prog.ops
+    span_idx = [k for k, op in enumerate(ops) if isinstance(op, Span)]
+    for ii in range(len(span_idx)):
+        for jj in range(ii + 1, len(span_idx)):
+            i, j = span_idx[ii], span_idx[jj]
+            p1, p2 = ops[i], ops[j]
+            middle = ops[i + 1:j]
+            lits = [o for o in middle if isinstance(o, Lit)]
+            if len(lits) != 1 or not all(
+                    isinstance(o, (Lit, CapStart, CapEnd)) for o in middle):
+                continue
+            lit = lits[0]
+            c1 = prog.classes[p1.class_id]
+            c2 = prog.classes[p2.class_id]
+            if p1.max_len != INF or p2.max_len != INF:
+                continue
+            if p1.lazy and p2.lazy:
+                if not (c1.issubset(c2)
+                        and all(c2.contains(b) for b in lit.data)):
+                    continue
+            elif not p1.lazy and not p2.lazy:
+                if not (c2.issubset(c1)
+                        and all(c1.contains(b) for b in lit.data)):
+                    continue
+            else:
+                continue  # mixed greedy/lazy: no sound commit order
+            prefix = ops[:i]
+            suffix = ops[j + 1:]
+            if not suffix:
+                continue  # pivot2-at-end belongs to the single-pivot path
+            follow1 = c1
+            if p1.min_len == 0:
+                follow1 = follow1.union(CharClass.from_bytes(lit.data[:1]))
+            rev = _reverse_ops(suffix)
+            try:
+                _validate_ops(prefix, prog, follow1)
+                _validate_ops(rev, prog, CharClass.from_bytes(b""),
+                              absorber=c2, pivot_lazy=p2.lazy)
+            except Tier1Unsupported:
+                continue
+            starts_fwd = _cap_ids(prefix, CapStart) | _cap_ids(middle,
+                                                               CapStart)
+            ends_suffix = _cap_ids(suffix, CapEnd)
+            prog.ops = prefix
+            prog.pivot = p1
+            prog.mid_ops = list(middle)
+            prog.mid_end_caps = sorted(_cap_ids(middle, CapEnd))
+            prog.pivot2 = p2
+            prog.suffix_ops = rev
+            prog.split_caps = sorted(starts_fwd & ends_suffix)
+            return True
     return False
 
 
@@ -648,7 +733,7 @@ def compile_tier1(pattern: Union[str, bytes]) -> SegmentProgram:
     try:
         _validate_and_bind(prog)
     except Tier1Unsupported:
-        if not _try_pivot_split(prog):
+        if not _try_pivot_split(prog) and not _try_double_pivot(prog):
             raise
     return prog
 

@@ -20,12 +20,13 @@ Unsupported constructs fail init (surfaced at config load), never silently.
 
 from __future__ import annotations
 
+import math
 import re
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..models import PipelineEventGroup
+from ..models import ColumnarLogs, PipelineEventGroup
 from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import extract_source
@@ -43,6 +44,8 @@ _EXTEND_RE = re.compile(r"extend\s+(\w+)\s*=\s*(.+)", re.S)
 _RENAME_RE = re.compile(r"rename\s+(\w+)\s+as\s+(\w+)")
 _PROJECT_RE = re.compile(r"project(-away)?\s+(.+)")
 _LIMIT_RE = re.compile(r"limit\s+(\d+)")
+_STATS_RE = re.compile(r"stats\s+(.+?)(?:\s+by\s+([\w,\s]+))?\s*$", re.S)
+_SORT_RE = re.compile(r"sort\s+by\s+(.+)", re.S)
 
 
 def _split_quote_aware(text: str, sep: str) -> List[str]:
@@ -186,20 +189,11 @@ class _Extend(_Stage):
         sb = group.source_buffer
         cols = group.columns
         if cols is not None and not group._events:
-            n = len(cols)
-            raw = group.source_buffer.as_array()
+            rows = _row_fields(group)
+            n = len(rows)
             offs = np.zeros(n, dtype=np.int32)
             lens = np.full(n, -1, dtype=np.int32)
-            span_cols = {name: cols.fields[name] for name in cols.fields}
-            for i in range(n):
-                fields = {}
-                for name, (fo, fl) in span_cols.items():
-                    if fl[i] >= 0:
-                        o = int(fo[i])
-                        fields[name] = raw[o : o + int(fl[i])].tobytes()
-                if not cols.content_consumed:
-                    o, l = int(cols.offsets[i]), int(cols.lengths[i])
-                    fields["content"] = raw[o : o + l].tobytes()
+            for i, fields in enumerate(rows):
                 out = b"".join(self._value(p, fields) for p in self.parts)
                 view = sb.copy_string(out)
                 offs[i] = view.offset
@@ -260,6 +254,218 @@ class _Project(_Stage):
                     ev.del_content(name)
 
 
+def _row_fields(group: PipelineEventGroup) -> List[Dict[str, bytes]]:
+    """Per-event field dicts (shared by the aggregation verbs)."""
+    cols = group.columns
+    rows: List[Dict[str, bytes]] = []
+    if cols is not None and not group._events:
+        raw = group.source_buffer.as_array()
+        n = len(cols)
+        for i in range(n):
+            fields: Dict[str, bytes] = {}
+            for name, (fo, fl) in cols.fields.items():
+                if fl[i] >= 0:
+                    o = int(fo[i])
+                    fields[name] = raw[o:o + int(fl[i])].tobytes()
+            if not cols.content_consumed:
+                o, ln = int(cols.offsets[i]), int(cols.lengths[i])
+                fields.setdefault("content", raw[o:o + ln].tobytes())
+            rows.append(fields)
+        return rows
+    for ev in group.events:
+        if hasattr(ev, "contents"):
+            rows.append({k.to_str(): v.to_bytes() for k, v in ev.contents})
+        else:
+            rows.append({})
+    return rows
+
+
+def _num(v: Optional[bytes]) -> Optional[float]:
+    if v is None:
+        return None
+    try:
+        x = float(v)
+    except ValueError:
+        return None
+    # 'nan' poisons sorted() ordering and min/max; 'inf' breaks formatting
+    return x if math.isfinite(x) else None
+
+
+def _fmt(x: float) -> bytes:
+    return (b"%d" % int(x)) if float(x).is_integer() else (
+        repr(x).encode())
+
+
+class _Stats(_Stage):
+    """stats count(), sum(f), avg(f), min(f), max(f) [as alias], ...
+    [by k1, k2] — the aggregation verbs the reference SPL engine exposes
+    (ProcessorSPL.cpp:69-80); replaces the group's events with one event
+    per key combination."""
+
+    def __init__(self, aggs_src: str, by_src: Optional[str]):
+        self.aggs: List[Tuple[str, Optional[str], str]] = []  # (fn, field, out)
+        for part in _split_quote_aware(aggs_src, ","):
+            part = part.strip()
+            m = re.fullmatch(
+                r"(count|sum|avg|min|max)\s*\(\s*(\w*)\s*\)"
+                r"(?:\s+as\s+(\w+))?", part)
+            if not m:
+                raise SPLError(f"bad stats aggregate: {part!r}")
+            fn, fieldname, alias = m.group(1), m.group(2) or None, m.group(3)
+            if fn != "count" and not fieldname:
+                raise SPLError(f"{fn}() needs a field")
+            out = alias or (fn if fn == "count" and not fieldname
+                            else f"{fn}_{fieldname}" if fieldname else fn)
+            self.aggs.append((fn, fieldname, out))
+        self.by = [k.strip() for k in (by_src or "").split(",") if k.strip()]
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        rows = _row_fields(group)
+        cols = group.columns
+        tss = (cols.timestamps if cols is not None and not group._events
+               else np.array([getattr(ev, "timestamp", 0)
+                              for ev in group.events], dtype=np.int64))
+        buckets: Dict[Tuple, Dict] = {}
+        for i, fields in enumerate(rows):
+            key = tuple(fields.get(k, b"") for k in self.by)
+            b = buckets.get(key)
+            if b is None:
+                b = buckets[key] = {"n": 0, "vals": {}, "ts": 0}
+            b["n"] += 1
+            b["ts"] = max(b["ts"], int(tss[i]) if i < len(tss) else 0)
+            for fn, fieldname, out in self.aggs:
+                if fn == "count":
+                    # count(field) counts rows where the field is present
+                    # (SQL semantics); bare count() counts all rows
+                    if fieldname:
+                        b["vals"].setdefault(out, []).append(
+                            1.0 if fieldname in fields else 0.0)
+                    continue
+                v = _num(fields.get(fieldname))
+                if v is None:
+                    continue
+                acc = b["vals"].setdefault(out, [])
+                acc.append(v)
+        out_rows: List[Tuple[int, Dict[str, bytes]]] = []
+        for key, b in buckets.items():
+            fields: Dict[str, bytes] = {}
+            for k, v in zip(self.by, key):
+                fields[k] = v
+            for fn, fieldname, out in self.aggs:
+                if fn == "count":
+                    if fieldname:
+                        fields[out] = b"%d" % int(sum(b["vals"].get(out, [])))
+                    else:
+                        fields[out] = b"%d" % b["n"]
+                    continue
+                acc = b["vals"].get(out, [])
+                if not acc:
+                    fields[out] = b""
+                elif fn == "sum":
+                    fields[out] = _fmt(sum(acc))
+                elif fn == "avg":
+                    fields[out] = _fmt(sum(acc) / len(acc))
+                elif fn == "min":
+                    fields[out] = _fmt(min(acc))
+                elif fn == "max":
+                    fields[out] = _fmt(max(acc))
+            out_rows.append((b["ts"], fields))
+        self._rebuild(group, out_rows)
+
+    @staticmethod
+    def _rebuild(group: PipelineEventGroup,
+                 out_rows: List[Tuple[int, Dict[str, bytes]]]) -> None:
+        sb = group.source_buffer
+        if group.columns is not None and not group._events:
+            n = len(out_rows)
+            new = ColumnarLogs(np.zeros(n, np.int32), np.zeros(n, np.int32),
+                               np.array([r[0] for r in out_rows], np.int64))
+            new.content_consumed = True
+            names: List[str] = []
+            for _, fields in out_rows:
+                for name in fields:
+                    if name not in names:
+                        names.append(name)
+            for name in names:
+                offs = np.zeros(n, np.int32)
+                lens = np.full(n, -1, np.int32)
+                for i, (_, fields) in enumerate(out_rows):
+                    v = fields.get(name)
+                    if v is not None:
+                        view = sb.copy_string(v)
+                        offs[i], lens[i] = view.offset, view.length
+                new.set_field(name, offs, lens)
+            group.set_columns(new)
+            return
+        group._events = []
+        for ts, fields in out_rows:
+            ev = group.add_log_event(ts)
+            for k, v in fields.items():
+                ev.set_content(sb.copy_string(k.encode()), sb.copy_string(v))
+
+
+class _Sort(_Stage):
+    """sort by f1 [desc], f2, ... — numeric when every value parses as a
+    number, else bytewise; stable across keys (right-to-left passes)."""
+
+    def __init__(self, keys_src: str):
+        self.keys: List[Tuple[str, bool]] = []
+        for part in keys_src.split(","):
+            part = part.strip()
+            desc = False
+            if part.startswith("-"):
+                desc, part = True, part[1:].strip()
+            m = re.fullmatch(r"(\w+)(?:\s+(asc|desc))?", part)
+            if not m:
+                raise SPLError(f"bad sort key: {part!r}")
+            self.keys.append((m.group(1), desc or m.group(2) == "desc"))
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        n = len(group)
+        if n <= 1:
+            return
+        cols = group.columns
+        columnar = cols is not None and not group._events
+        if columnar:
+            # extract ONLY the key columns — materialising every field of
+            # every row just to sort defeats the columnar layout
+            raw = group.source_buffer.as_array()
+
+            def get_col(name):
+                spans = cols.fields.get(name)
+                if spans is None and name == "content" \
+                        and not cols.content_consumed:
+                    spans = (cols.offsets, cols.lengths)
+                if spans is None:
+                    return [None] * n
+                fo, fl = spans
+                return [bytes(raw[int(fo[i]):int(fo[i]) + int(fl[i])]
+                              .tobytes()) if fl[i] >= 0 else None
+                        for i in range(n)]
+        else:
+            rows = _row_fields(group)
+
+            def get_col(name):
+                return [r.get(name) for r in rows]
+        order = list(range(n))
+        for name, desc in reversed(self.keys):
+            col = get_col(name)
+            vals = [col[i] for i in order]
+            nums = [_num(v) for v in vals]
+            if all(x is not None for x in nums):
+                keyed = nums
+            else:
+                keyed = [v if v is not None else b"" for v in vals]
+            idx = sorted(range(len(order)), key=lambda k: keyed[k],
+                         reverse=desc)
+            order = [order[k] for k in idx]
+        perm = np.array(order, dtype=np.int64)
+        if columnar:
+            group.set_columns(compact_columns(cols, perm))
+        else:
+            group._events = [group.events[i] for i in order]
+
+
 class _Limit(_Stage):
     def __init__(self, n: int):
         self.n = n
@@ -305,6 +511,10 @@ def compile_spl(script: str) -> List[_Stage]:
             stages.append(_Project(fields, away=bool(m.group(1))))
         elif m := _LIMIT_RE.fullmatch(part):
             stages.append(_Limit(int(m.group(1))))
+        elif m := _STATS_RE.fullmatch(part):
+            stages.append(_Stats(m.group(1), m.group(2)))
+        elif m := _SORT_RE.fullmatch(part):
+            stages.append(_Sort(m.group(1)))
         else:
             raise SPLError(f"unsupported SPL stage: {part!r}")
     return stages

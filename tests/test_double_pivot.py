@@ -1,0 +1,128 @@
+"""Double-pivot Tier-1 (round-2 VERDICT #8): two ambiguous spans separated
+by a boundary literal run on device, bit-exact vs `re`.
+
+Soundness conditions under test (program.py:_try_double_pivot): lazy-lazy
+commits to the FIRST feasible boundary (requires class1 ⊆ class2 and
+lit ⊆ class2), greedy-greedy to the LAST (mirrored). Mixed or bounded
+repeats stay off this path.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.ops.device_batch import pack_rows, pick_length_bucket
+from loongcollector_tpu.ops.kernels.field_extract import ExtractKernel
+from loongcollector_tpu.ops.regex.program import (Tier1Unsupported,
+                                                  compile_tier1)
+
+LAZY_LAZY = r"pre (.*?) mid (.*?) post"
+GREEDY_GREEDY = r"a=(.*);b=(.*);end"
+DATA2 = r"\[(.*?)\] \[(.*?)\] tail"
+
+
+def _diff(pattern, lines):
+    prog = compile_tier1(pattern)
+    assert prog.pivot2 is not None, "should take the double-pivot path"
+    kern = ExtractKernel(prog)
+    lines = [l for l in lines if l]
+    arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+    lens = np.array([len(l) for l in lines], np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+    L = pick_length_bucket(int(lens.max()))
+    batch = pack_rows(arena, offs, lens, L)
+    ok, coff, clen = (np.asarray(a) for a in
+                      kern(batch.rows, batch.lengths))
+    rx = re.compile(pattern.encode())
+    for i, ln in enumerate(lines):
+        m = rx.fullmatch(ln)
+        assert bool(ok[i]) == (m is not None), (pattern, ln, bool(ok[i]))
+        if m:
+            for g in range(rx.groups):
+                s, e = m.span(g + 1)
+                assert (coff[i, g], clen[i, g]) == (s, e - s), (
+                    pattern, ln, g, (coff[i, g], clen[i, g]), (s, e - s))
+
+
+class TestDoublePivot:
+    def test_lazy_lazy_first_occurrence(self):
+        _diff(LAZY_LAZY, [
+            b"pre A mid B post",
+            b"pre  mid  post",                      # both empty
+            b"pre x mid y mid z post",              # extra ' mid ' inside 2nd
+            b"pre a mid b midway post",
+            b"pre mid mid post",                    # boundary ambiguity
+            b"nope",
+            b"pre only post",                       # no ' mid '
+            b"pre a mid b post extra",              # suffix mismatch
+        ])
+
+    def test_greedy_greedy_last_occurrence(self):
+        _diff(GREEDY_GREEDY, [
+            b"a=1;b=2;end",
+            b"a=x;b=y;b=z;end",                     # greedy: LAST ';b='
+            b"a=;b=;end",
+            b"a=1;b=2;end!",                        # trailing junk
+            b"a=1;end",
+            b"a=1;b=2;3;end",
+        ])
+
+    def test_grok_two_data_fields(self):
+        from loongcollector_tpu.ops.regex.grok import expand
+        pattern = expand("%{DATA:first} %{DATA:second} %{INT:n}")
+        prog = compile_tier1(pattern)
+        rx = re.compile(pattern.encode())
+        assert rx.fullmatch(b"hello world 42")
+        _diff(pattern, [
+            b"hello world 42",
+            b"a b 1",
+            b"one two three 7",                    # first DATA absorbs space?
+            b"x 9",
+        ])
+
+    def test_bracketed_two_data(self):
+        _diff(DATA2, [
+            b"[a] [b] tail",
+            b"[] [] tail",
+            b"[x] [y] [z] tail"[:20],
+            b"[a [b] tail",
+            b"[a] [b]tail",
+        ])
+
+    def test_mixed_greedy_lazy_rejected(self):
+        with pytest.raises(Tier1Unsupported):
+            prog = compile_tier1(r"p (.*) m (.*?) s")
+            assert prog.pivot2 is None
+            raise Tier1Unsupported("took some other path")  # pragma: no cover
+
+    def test_bounded_repeat_rejected_from_double(self):
+        try:
+            prog = compile_tier1(r"p (.{1,5}) m (.*) s")
+            assert prog.pivot2 is None
+        except Tier1Unsupported:
+            pass  # CPU tier is fine too — just never the unsound commit
+
+    def test_fuzz_lazy_lazy(self):
+        rng = np.random.default_rng(17)
+        lines = []
+        alphabet = b"abm idpostre "
+        for _ in range(300):
+            n = int(rng.integers(0, 32))
+            lines.append(bytes(rng.choice(list(alphabet), n).tolist()))
+        lines += [b"pre %s mid %s post" % (a, b)
+                  for a in (b"", b"q", b"mid", b" ")
+                  for b in (b"", b"r", b"mid w")]
+        _diff(LAZY_LAZY, lines)
+
+    def test_fuzz_greedy_greedy(self):
+        rng = np.random.default_rng(23)
+        lines = []
+        alphabet = b"ab=;end12"
+        for _ in range(300):
+            n = int(rng.integers(0, 32))
+            lines.append(bytes(rng.choice(list(alphabet), n).tolist()))
+        lines += [b"a=%s;b=%s;end" % (a, b)
+                  for a in (b"", b"1", b";b=", b"=;")
+                  for b in (b"", b"2", b";b=9")]
+        _diff(GREEDY_GREEDY, lines)
