@@ -22,13 +22,8 @@ from .config.common_provider import CommonConfigProvider
 from .config.onetime import OnetimeConfigInfoManager
 from .config.watcher import PipelineConfigWatcher
 from .input.file.file_server import FileServer
-from .input.host_monitor import HostMonitorInputRunner
-from .input.ebpf.server import EBPFServer
-from .input.forward import GrpcInputManager
-from .input.prometheus.scraper import PrometheusInputRunner
 from .monitor.alarms import AlarmManager
 from .monitor.metrics import WriteMetrics
-from .monitor.self_monitor import SelfMonitorServer
 from .monitor.watchdog import LoongCollectorMonitor
 from .pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from .pipeline.pipeline_manager import CollectionPipelineManager
@@ -180,20 +175,16 @@ class Application:
         # data batch never stalls behind a compiler invocation
         from . import native as _native
         _native.get_lib()
+        # declarative runner matrix (reference PluginRegistry.cpp:162-196):
+        # every singleton input runner gets wired — and later stopped —
+        # through the registry, so new runners need no Application edits
+        from .runner.input_registry import (InputRunnerRegistry,
+                                            register_builtin_runners)
+        register_builtin_runners()
+        InputRunnerRegistry.wire_all(self.process_queue_manager)
         fs = FileServer.instance()
-        fs.process_queue_manager = self.process_queue_manager
         fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
         fs.cpu_level_provider = lambda: self.watchdog.cpu_level
-        HostMonitorInputRunner.instance().process_queue_manager = \
-            self.process_queue_manager
-        PrometheusInputRunner.instance().process_queue_manager = \
-            self.process_queue_manager
-        EBPFServer.instance().process_queue_manager = \
-            self.process_queue_manager
-        GrpcInputManager.instance().process_queue_manager = \
-            self.process_queue_manager
-        SelfMonitorServer.instance().process_queue_manager = \
-            self.process_queue_manager
         self.config_watcher.add_source(self.config_dir)
         if self.remote_provider is not None:
             self.config_watcher.add_source(self.remote_provider.config_dir)
@@ -242,12 +233,8 @@ class Application:
         if self.remote_provider is not None:
             self.remote_provider.stop()
         self.watchdog.stop()
-        SelfMonitorServer.instance().stop()
-        HostMonitorInputRunner.instance().stop()
-        PrometheusInputRunner.instance().stop()
-        EBPFServer.instance().stop()
-        GrpcInputManager.instance().stop_all()
-        FileServer.instance().stop()
+        from .runner.input_registry import InputRunnerRegistry
+        InputRunnerRegistry.stop_all()
         self.processor_runner.stop()          # drains process queues
         self.pipeline_manager.stop_all()      # flush batchers, stop flushers
         TimeoutFlushManager.instance().flush_timeout_batches()

@@ -55,10 +55,24 @@ class FlusherKafka(Flusher):
         self.key_field = kf.encode() if isinstance(kf, str) else None
         if not self.brokers or not self.topic:
             return False
+        # reference KafkaProducer.cpp:41,111 — ssl.* and sasl.* settings;
+        # accept both top-level TLS/SASL and the Go flushers'
+        # Authentication.{TLS,SASL,PlainText} nesting
+        auth = config.get("Authentication") or {}
+        # presence checks, not truthiness: `TLS: {}` means "TLS with the
+        # system trust store", which `or` would silently drop
+        tls = config["TLS"] if "TLS" in config else auth.get("TLS")
+        sasl = config["SASL"] if "SASL" in config else auth.get("SASL")
+        if sasl is None and auth.get("PlainText"):
+            pt = auth["PlainText"]
+            sasl = {"Mechanism": "PLAIN",
+                    "Username": pt.get("Username"),
+                    "Password": pt.get("Password")}
         self.producer = KafkaProducer(
             self.brokers,
             acks=int(config.get("RequiredAcks", -1)),
-            timeout_ms=int(config.get("TimeoutMs", 10000)))
+            timeout_ms=int(config.get("TimeoutMs", 10000)),
+            tls=tls, sasl=sasl)
         strategy = FlushStrategy(
             min_cnt=int(config.get("MinCnt", 512)),
             min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),

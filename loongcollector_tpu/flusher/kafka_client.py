@@ -13,6 +13,10 @@ Python table fallback.
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import hmac
+import os
 import socket
 import struct
 import threading
@@ -25,6 +29,8 @@ log = get_logger("kafka")
 
 API_PRODUCE = 0
 API_METADATA = 3
+API_SASL_HANDSHAKE = 17
+API_SASL_AUTHENTICATE = 36
 
 
 # ---------------------------------------------------------------------------
@@ -194,13 +200,26 @@ class KafkaError(Exception):
     pass
 
 
+def _scram_escape(name: str) -> str:
+    """RFC 5802 saslname escaping: ',' and '=' are reserved."""
+    return name.replace("=", "=3D").replace(",", "=2C")
+
+
 class KafkaProducer:
     def __init__(self, brokers: List[str], client_id: str = "loongcollector-tpu",
-                 acks: int = -1, timeout_ms: int = 10000):
+                 acks: int = -1, timeout_ms: int = 10000,
+                 tls: Optional[dict] = None, sasl: Optional[dict] = None):
+        """tls: {CAFile, CertFile, KeyFile, InsecureSkipVerify} — enables
+        TLS when present (reference KafkaProducer.cpp:41 ssl.* settings).
+        sasl: {Mechanism: PLAIN|SCRAM-SHA-256|SCRAM-SHA-512, Username,
+        Password} (reference :111 sasl.* settings; Kerberos/GSSAPI is out
+        of scope — no KDC in this runtime)."""
         self.brokers = brokers
         self.client_id = client_id
         self.acks = acks
         self.timeout_ms = timeout_ms
+        self.tls = tls
+        self.sasl = sasl
         self._corr = 0
         self._conns: Dict[str, socket.socket] = {}
         # topic -> [(partition, leader "host:port")]
@@ -210,14 +229,121 @@ class KafkaProducer:
 
     # -- transport ----------------------------------------------------------
 
+    def _wrap_tls(self, sock: socket.socket, host: str) -> socket.socket:
+        import ssl
+        cfg = self.tls or {}
+        if cfg.get("InsecureSkipVerify"):
+            ctx = ssl._create_unverified_context()
+        else:
+            ctx = ssl.create_default_context(cafile=cfg.get("CAFile"))
+        cert, key = cfg.get("CertFile"), cfg.get("KeyFile")
+        if cert:
+            ctx.load_cert_chain(cert, key)
+        return ctx.wrap_socket(sock, server_hostname=host)
+
     def _connect(self, addr: str) -> socket.socket:
         sock = self._conns.get(addr)
         if sock is not None:
             return sock
         host, _, port = addr.rpartition(":")
         sock = socket.create_connection((host, int(port or 9092)), timeout=10)
+        try:
+            if self.tls is not None:
+                sock = self._wrap_tls(sock, host)
+            if self.sasl is not None:
+                self._sasl_handshake(sock)
+        except (OSError, KafkaError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         self._conns[addr] = sock
         return sock
+
+    # -- SASL ---------------------------------------------------------------
+
+    def _raw_request(self, sock: socket.socket, api: int, version: int,
+                     payload: bytes) -> bytes:
+        """One request/response on an ALREADY-OPEN socket (the handshake
+        must not recurse into _connect)."""
+        self._corr += 1
+        corr = self._corr
+        header = (struct.pack(">hhi", api, version, corr)
+                  + _str(self.client_id))
+        msg = header + payload
+        sock.sendall(struct.pack(">i", len(msg)) + msg)
+        raw = self._read_exact(sock, 4)
+        size = struct.unpack(">i", raw)[0]
+        resp = self._read_exact(sock, size)
+        got = struct.unpack(">i", resp[:4])[0]
+        if got != corr:
+            raise KafkaError(f"correlation mismatch {got} != {corr}")
+        return resp[4:]
+
+    def _sasl_authenticate(self, sock: socket.socket,
+                           auth_bytes: bytes) -> bytes:
+        resp = _Reader(self._raw_request(
+            sock, API_SASL_AUTHENTICATE, 0, _bytes(auth_bytes)))
+        err = resp.i16()
+        err_msg = resp.string()
+        n = resp.i32()
+        out = resp.data[resp.pos:resp.pos + n] if n >= 0 else b""
+        if err != 0:
+            raise KafkaError(f"SASL authenticate failed ({err}): {err_msg}")
+        return out
+
+    def _sasl_handshake(self, sock: socket.socket) -> None:
+        mech = (self.sasl.get("Mechanism") or "PLAIN").upper()
+        user = self.sasl.get("Username") or ""
+        password = self.sasl.get("Password") or ""
+        resp = _Reader(self._raw_request(
+            sock, API_SASL_HANDSHAKE, 1, _str(mech)))
+        err = resp.i16()
+        if err != 0:
+            mechs = resp.array(resp.string)
+            raise KafkaError(
+                f"SASL mechanism {mech} rejected ({err}); broker offers "
+                f"{mechs}")
+        if mech == "PLAIN":
+            self._sasl_authenticate(
+                sock, b"\0" + user.encode() + b"\0" + password.encode())
+        elif mech in ("SCRAM-SHA-256", "SCRAM-SHA-512"):
+            self._sasl_scram(sock, mech, user, password)
+        else:
+            raise KafkaError(f"unsupported SASL mechanism {mech}")
+
+    def _sasl_scram(self, sock: socket.socket, mech: str, user: str,
+                    password: str) -> None:
+        """RFC 5802 SCRAM over KIP-84 SaslAuthenticate rounds."""
+        algo = "sha256" if mech.endswith("256") else "sha512"
+        H = getattr(hashlib, algo)
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        gs2 = "n,,"
+        cf_bare = f"n={_scram_escape(user)},r={nonce}"
+        server_first = self._sasl_authenticate(
+            sock, (gs2 + cf_bare).encode()).decode()
+        parts = dict(p.split("=", 1) for p in server_first.split(","))
+        r, s, i = parts["r"], parts["s"], int(parts["i"])
+        if not r.startswith(nonce):
+            raise KafkaError("SCRAM server nonce does not extend ours")
+        salted = hashlib.pbkdf2_hmac(algo, password.encode(),
+                                     base64.b64decode(s), i)
+        client_key = hmac.new(salted, b"Client Key", H).digest()
+        stored_key = H(client_key).digest()
+        cf_woproof = f"c={base64.b64encode(gs2.encode()).decode()},r={r}"
+        auth_msg = f"{cf_bare},{server_first},{cf_woproof}".encode()
+        client_sig = hmac.new(stored_key, auth_msg, H).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        final = f"{cf_woproof},p={base64.b64encode(proof).decode()}"
+        server_final = self._sasl_authenticate(sock, final.encode()).decode()
+        fparts = dict(p.split("=", 1) for p in server_final.split(","))
+        if "e" in fparts:
+            raise KafkaError(f"SCRAM server error: {fparts['e']}")
+        server_key = hmac.new(salted, b"Server Key", H).digest()
+        expect = hmac.new(server_key, auth_msg, H).digest()
+        if base64.b64decode(fparts.get("v", "")) != expect:
+            raise KafkaError("SCRAM server signature verification failed")
 
     def _drop(self, addr: str) -> None:
         sock = self._conns.pop(addr, None)
@@ -230,11 +356,14 @@ class KafkaProducer:
     def _request(self, addr: str, api_key: int, api_version: int,
                  payload: bytes, expect_response: bool = True
                  ) -> Optional[bytes]:
+        # connect FIRST: the TLS/SASL handshake inside _connect consumes
+        # correlation ids of its own, so ours is allocated after it
+        sock = self._connect(addr)
         self._corr += 1
-        header = (struct.pack(">hhi", api_key, api_version, self._corr)
+        my_corr = self._corr
+        header = (struct.pack(">hhi", api_key, api_version, my_corr)
                   + _str(self.client_id))
         msg = header + payload
-        sock = self._connect(addr)
         try:
             sock.sendall(struct.pack(">i", len(msg)) + msg)
             if not expect_response:
@@ -246,7 +375,7 @@ class KafkaProducer:
             self._drop(addr)
             raise KafkaError(f"broker {addr}: {e}") from e
         corr = struct.unpack(">i", resp[:4])[0]
-        if corr != self._corr:
+        if corr != my_corr:
             self._drop(addr)
             raise KafkaError("correlation id mismatch")
         return resp[4:]
@@ -269,8 +398,12 @@ class KafkaProducer:
         for addr in self.brokers:
             try:
                 resp = self._request(addr, API_METADATA, 1, payload)
-            except KafkaError as e:
-                last_err = e
+            except (KafkaError, OSError) as e:
+                # OSError covers connect refusals and TLS handshake
+                # failures (ssl.SSLError ⊂ OSError) — one bad broker must
+                # not defeat multi-broker failover
+                last_err = e if isinstance(e, KafkaError) else \
+                    KafkaError(f"broker {addr}: {e}")
                 continue
             r = _Reader(resp)
             brokers = {}

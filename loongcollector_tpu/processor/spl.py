@@ -398,6 +398,7 @@ class _Stats(_Stage):
             group.set_columns(new)
             return
         group._events = []
+        group._columns = None   # stale pre-stats columns must not survive
         for ts, fields in out_rows:
             ev = group.add_log_event(ts)
             for k, v in fields.items():
@@ -464,6 +465,7 @@ class _Sort(_Stage):
             group.set_columns(compact_columns(cols, perm))
         else:
             group._events = [group.events[i] for i in order]
+            group._columns = None   # any materialized columns are stale
 
 
 class _Limit(_Stage):

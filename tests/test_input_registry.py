@@ -1,0 +1,41 @@
+"""Input runner registry (round-2 VERDICT #10): a new singleton input
+registers declaratively and gets wired + stopped with zero application.py
+edits (reference PluginRegistry.cpp:162-196 registration matrix)."""
+
+from loongcollector_tpu.runner.input_registry import (InputRunnerRegistry,
+                                                      register_builtin_runners)
+
+
+class _DummyRunner:
+    _inst = None
+
+    def __init__(self):
+        self.process_queue_manager = None
+        self.stopped = False
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_new_runner_needs_no_application_edits():
+    InputRunnerRegistry.register("dummy", _DummyRunner.instance,
+                                 stop_order=99)
+    pqm = object()
+    InputRunnerRegistry.wire_all(pqm)
+    assert _DummyRunner.instance().process_queue_manager is pqm
+    InputRunnerRegistry.stop_all()
+    assert _DummyRunner.instance().stopped
+    # builtin matrix registers idempotently and includes the file server
+    register_builtin_runners()
+    names = {e.name for e in InputRunnerRegistry.entries()}
+    assert {"file_server", "self_monitor", "prometheus", "host_monitor",
+            "ebpf", "grpc_forward", "dummy"} <= names
+    # stop order: self-monitor drains before the file server closes
+    order = [e.name for e in InputRunnerRegistry.entries()]
+    assert order.index("self_monitor") < order.index("file_server")

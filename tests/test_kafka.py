@@ -45,16 +45,22 @@ class FakeBroker(threading.Thread):
                 # skip client id string
                 cid_len = struct.unpack(">h", msg[8:10])[0]
                 body = msg[10 + max(cid_len, 0):]
-                if api == 3:
-                    resp = self._metadata_response()
-                elif api == 0:
-                    resp = self._produce_response(body)
-                else:
+                resp = self._dispatch(api, ver, body, conn)
+                if resp is None:
                     return
                 out = struct.pack(">i", corr) + resp
                 conn.sendall(struct.pack(">i", len(out)) + out)
         except OSError:
             pass
+
+    def _dispatch(self, api, ver, body, conn):
+        """Per-API handling; subclasses override to gate/extend. Returning
+        None closes the connection."""
+        if api == 3:
+            return self._metadata_response()
+        if api == 0:
+            return self._produce_response(body)
+        return None
 
     @staticmethod
     def _read(conn, n):
@@ -180,3 +186,179 @@ class TestProducerAgainstFakeBroker:
             assert b"kafka line two" in joined
         finally:
             broker.stop()
+
+
+class SaslBroker(FakeBroker):
+    """FakeBroker requiring SASL (handshake v1 + authenticate v0) before
+    Metadata/Produce; PLAIN and SCRAM-SHA-256 server sides scripted."""
+
+    USER, PASSWORD = "u1", "secret"
+
+    def __init__(self, mechanism="PLAIN"):
+        super().__init__()
+        self.mechanism = mechanism
+        self.authed_conns = set()
+        self._scram_states = {}
+
+    def _dispatch(self, api, ver, body, conn):
+        if api == 17:     # SaslHandshake
+            mlen = struct.unpack(">h", body[:2])[0]
+            mech = body[2:2 + mlen].decode()
+            if mech != self.mechanism:
+                return struct.pack(">hi", 33, 0)
+            d = self.mechanism.encode()
+            return (struct.pack(">hi", 0, 1)
+                    + struct.pack(">h", len(d)) + d)
+        if api == 36:     # SaslAuthenticate
+            alen = struct.unpack(">i", body[:4])[0]
+            auth = body[4:4 + alen]
+            state = self._scram_states.setdefault(id(conn), {})
+            ok, out = self._auth_round(auth, state)
+            err = 0 if ok else 58
+            if ok and not state.get("pending"):
+                self.authed_conns.add(id(conn))
+            return (struct.pack(">h", err) + struct.pack(">h", -1)
+                    + struct.pack(">i", len(out)) + out)
+        if id(conn) not in self.authed_conns:
+            return None   # protocol violation: not authenticated
+        return super()._dispatch(api, ver, body, conn)
+
+    def _auth_round(self, auth, state):
+        import base64, hashlib, hmac, os
+        if self.mechanism == "PLAIN":
+            parts = auth.split(b"\0")
+            ok = (len(parts) == 3 and parts[1].decode() == self.USER
+                  and parts[2].decode() == self.PASSWORD)
+            return ok, b""
+        # SCRAM-SHA-256 server
+        if not state:
+            msg = auth.decode()
+            assert msg.startswith("n,,")
+            bare = msg[3:]
+            fields = dict(p.split("=", 1) for p in bare.split(","))
+            salt = os.urandom(12)
+            snonce = fields["r"] + base64.b64encode(os.urandom(9)).decode()
+            iters = 4096
+            state.update(bare=bare, salt=salt, nonce=snonce, i=iters,
+                         pending=True)
+            sf = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                  f"i={iters}")
+            state["server_first"] = sf
+            return True, sf.encode()
+        msg = auth.decode()
+        fields = dict(p.split("=", 1) for p in msg.split(","))
+        salted = hashlib.pbkdf2_hmac("sha256", self.PASSWORD.encode(),
+                                     state["salt"], state["i"])
+        ck = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        sk = hashlib.sha256(ck).digest()
+        woproof = msg.rsplit(",p=", 1)[0]
+        auth_msg = (f"{state['bare']},{state['server_first']},"
+                    f"{woproof}").encode()
+        sig = hmac.new(sk, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(ck, sig))
+        if base64.b64decode(fields["p"]) != proof:
+            return False, b""
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        v = hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+        state["pending"] = False
+        return True, b"v=" + base64.b64encode(v)
+
+
+class TestSASL:
+    def _produce(self, broker, sasl):
+        from loongcollector_tpu.flusher.kafka_client import KafkaProducer
+        p = KafkaProducer([f"127.0.0.1:{broker.port}"], sasl=sasl)
+        p.send("logs", [(None, b"hello-sasl")])
+        p.close()
+        assert broker.produced, "record never reached the broker"
+
+    def test_plain_auth(self):
+        b = SaslBroker("PLAIN"); b.start()
+        try:
+            self._produce(b, {"Mechanism": "PLAIN", "Username": "u1",
+                              "Password": "secret"})
+        finally:
+            b.stop()
+
+    def test_plain_bad_password_rejected(self):
+        from loongcollector_tpu.flusher.kafka_client import (KafkaError,
+                                                             KafkaProducer)
+        b = SaslBroker("PLAIN"); b.start()
+        try:
+            p = KafkaProducer([f"127.0.0.1:{b.port}"],
+                              sasl={"Mechanism": "PLAIN", "Username": "u1",
+                                    "Password": "wrong"})
+            with pytest.raises(KafkaError):
+                p.send("logs", [(None, b"x")])
+            p.close()
+        finally:
+            b.stop()
+
+    def test_scram_sha256(self):
+        b = SaslBroker("SCRAM-SHA-256"); b.start()
+        try:
+            self._produce(b, {"Mechanism": "SCRAM-SHA-256",
+                              "Username": "u1", "Password": "secret"})
+        finally:
+            b.stop()
+
+    def test_scram_bad_password_rejected(self):
+        from loongcollector_tpu.flusher.kafka_client import (KafkaError,
+                                                             KafkaProducer)
+        b = SaslBroker("SCRAM-SHA-256"); b.start()
+        try:
+            p = KafkaProducer([f"127.0.0.1:{b.port}"],
+                              sasl={"Mechanism": "SCRAM-SHA-256",
+                                    "Username": "u1", "Password": "bad"})
+            with pytest.raises(KafkaError):
+                p.send("logs", [(None, b"x")])
+            p.close()
+        finally:
+            b.stop()
+
+    def test_mechanism_rejected_lists_offers(self):
+        from loongcollector_tpu.flusher.kafka_client import (KafkaError,
+                                                             KafkaProducer)
+        b = SaslBroker("PLAIN"); b.start()
+        try:
+            p = KafkaProducer([f"127.0.0.1:{b.port}"],
+                              sasl={"Mechanism": "SCRAM-SHA-256",
+                                    "Username": "u", "Password": "p"})
+            with pytest.raises(KafkaError, match="rejected"):
+                p.send("logs", [(None, b"x")])
+            p.close()
+        finally:
+            b.stop()
+
+
+class TestTLS:
+    def test_tls_handshake_and_produce(self, tmp_path):
+        """TLS-wrapped fake broker (self-signed cert via the openssl CLI)."""
+        import shutil, ssl, subprocess
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl CLI unavailable")
+        key, crt = str(tmp_path / "k.pem"), str(tmp_path / "c.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", crt, "-days", "1",
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True)
+
+        class TLSBroker(FakeBroker):
+            def __init__(self):
+                super().__init__()
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(crt, key)
+                self.sock = ctx.wrap_socket(self.sock, server_side=True)
+
+        b = TLSBroker(); b.start()
+        try:
+            from loongcollector_tpu.flusher.kafka_client import KafkaProducer
+            p = KafkaProducer([f"127.0.0.1:{b.port}"],
+                              tls={"CAFile": crt})
+            p.send("logs", [(None, b"hello-tls")])
+            p.close()
+            assert b.produced
+        finally:
+            b.stop()
