@@ -13,6 +13,10 @@ def register_all(registry) -> None:
                               InputProcessSecurity)
     from .forward import InputForward
     from .container_stdio import InputContainerStdio
+    from .http_server import InputHTTPServer, InputOTLP
+    from .journal import InputJournal
+    from .mqtt import InputMQTT
+    from .snmp import InputSNMP
     from .syslog import InputSyslog
 
     registry.register_input("input_file", InputFile)
@@ -32,3 +36,8 @@ def register_all(registry) -> None:
     registry.register_input("input_forward", InputForward)
     registry.register_input("input_container_stdio", InputContainerStdio)
     registry.register_input("input_syslog", InputSyslog)
+    registry.register_input("input_http_server", InputHTTPServer)
+    registry.register_input("input_otlp", InputOTLP)
+    registry.register_input("input_journal", InputJournal)
+    registry.register_input("input_mqtt", InputMQTT)
+    registry.register_input("input_snmp", InputSNMP)

@@ -23,6 +23,24 @@ from ...pipeline.plugin.interface import Input, PluginContext
 from ...utils.logger import get_logger
 from .adapter import (EBPFAdapter, EventSource, RawKernelEvent, get_adapter)
 from .protocol_http import parse_http
+from .protocol_mysql import parse_mysql
+from .protocol_redis import parse_redis
+
+
+def sniff_l7(payload: bytes):
+    """Protocol detection order mirrors the reference's protocol matrix
+    (core/ebpf/protocol/): HTTP (self-describing first line), then RESP
+    (typed first byte), then MySQL (length-framed packets)."""
+    rec = parse_http(payload)
+    if rec is not None:
+        return "http", rec
+    rec = parse_redis(payload)
+    if rec is not None:
+        return "redis", rec
+    rec = parse_mysql(payload)
+    if rec is not None:
+        return "mysql", rec
+    return "raw", None
 
 log = get_logger("ebpf")
 
@@ -120,7 +138,8 @@ class NetworkObserverManager(_SourceManager):
         sb = group.source_buffer
         cache = self.server.process_cache
         for raw in events:
-            rec = parse_http(raw.payload) if raw.payload else None
+            proto, rec = (sniff_l7(raw.payload) if raw.payload
+                          else ("raw", None))
             ev = group.add_log_event(raw.timestamp_ns // 1_000_000_000
                                      or int(time.time()))
             comm, _ = cache.lookup(raw.pid)
@@ -130,19 +149,49 @@ class NetworkObserverManager(_SourceManager):
             ev.set_content(b"local_addr", sb.copy_string(raw.local_addr))
             ev.set_content(b"remote_addr", sb.copy_string(raw.remote_addr))
             ev.set_content(b"direction", sb.copy_string(raw.direction))
+            ev.set_content(b"protocol", sb.copy_string(proto.encode()))
             if rec is None:
-                ev.set_content(b"protocol", sb.copy_string(b"raw"))
                 continue
-            ev.set_content(b"protocol", sb.copy_string(b"http"))
-            if rec.kind == "request":
-                ev.set_content(b"method", sb.copy_string(rec.method))
-                ev.set_content(b"path", sb.copy_string(rec.path))
-                if rec.host:
-                    ev.set_content(b"host", sb.copy_string(rec.host))
-            else:
-                ev.set_content(b"status", sb.copy_string(str(rec.status)))
-            if rec.version:
-                ev.set_content(b"http_version", sb.copy_string(rec.version))
+            ev.set_content(b"kind", sb.copy_string(rec.kind.encode()))
+            if proto == "http":
+                if rec.kind == "request":
+                    ev.set_content(b"method", sb.copy_string(rec.method))
+                    ev.set_content(b"path", sb.copy_string(rec.path))
+                    if rec.host:
+                        ev.set_content(b"host", sb.copy_string(rec.host))
+                else:
+                    ev.set_content(b"status",
+                                   sb.copy_string(str(rec.status)))
+                if rec.version:
+                    ev.set_content(b"http_version",
+                                   sb.copy_string(rec.version))
+            elif proto == "redis":
+                if rec.command:
+                    ev.set_content(b"command", sb.copy_string(rec.command))
+                if rec.key:
+                    ev.set_content(b"key", sb.copy_string(rec.key))
+                if rec.error:
+                    ev.set_content(b"error", sb.copy_string(rec.error))
+                elif rec.kind == "response":
+                    ev.set_content(b"ok", sb.copy_string(
+                        b"1" if rec.ok else b"0"))
+            elif proto == "mysql":
+                if rec.command:
+                    ev.set_content(b"command", sb.copy_string(rec.command))
+                if rec.sql:
+                    ev.set_content(b"sql", sb.copy_string(rec.sql))
+                if rec.kind == "response":
+                    if rec.error_code:
+                        ev.set_content(b"error_code", sb.copy_string(
+                            str(rec.error_code)))
+                        ev.set_content(b"error", sb.copy_string(
+                            rec.error_message))
+                    elif rec.column_count >= 0:
+                        ev.set_content(b"columns", sb.copy_string(
+                            str(rec.column_count)))
+                    else:
+                        ev.set_content(b"ok", sb.copy_string(
+                            b"1" if rec.ok else b"0"))
         group.set_tag(b"__source__", b"ebpf_network_observer")
         return group
 

@@ -161,15 +161,71 @@ class ProcessCollector:
                 rest = data[rp + 2 :].split()
                 ticks = int(rest[11]) + int(rest[12])   # utime+stime
                 rss = int(rest[21]) * os.sysconf("SC_PAGE_SIZE")
-                procs.append((ticks, comm, pid, rss))
+                nthreads = int(rest[17])
+                start_ticks = int(rest[19])
+                procs.append((ticks, comm, pid, rss, nthreads, start_ticks))
             except (OSError, IndexError, ValueError):
                 continue
         procs.sort(reverse=True)
         out = []
-        for ticks, comm, pid, rss in procs[: self.top_n]:
+        for ticks, comm, pid, rss, nthreads, start_ticks in procs[:self.top_n]:
             tags = {"pid": pid, "comm": comm}
+            # entity detail (reference ProcessEntityCollector): cmdline,
+            # uid, open fds, thread count, start time
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read().replace(b"\0", b" ").strip().decode(
+                        "utf-8", "replace")
+                if cmdline:
+                    tags["cmdline"] = cmdline[:256]
+            except OSError:
+                pass
+            try:
+                st = os.stat(f"/proc/{pid}")
+                tags["uid"] = str(st.st_uid)
+            except OSError:
+                pass
+            try:
+                n_fds = len(os.listdir(f"/proc/{pid}/fd"))
+                out.append(("process_open_fds", float(n_fds), tags))
+            except OSError:
+                pass
             out.append(("process_cpu_ticks", float(ticks), tags))
             out.append(("process_rss_bytes", float(rss), tags))
+            out.append(("process_threads", float(nthreads), tags))
+            out.append(("process_start_ticks", float(start_ticks), tags))
+        return out
+
+
+class GPUCollector:
+    """GPU utilisation/memory (reference host_monitor GPU collector via
+    NVML). Gated: reads nvidia-smi when present; on TPU hosts, surfaces
+    the accelerator count from the jax backend instead."""
+
+    name = "gpu"
+
+    def collect(self):
+        out = []
+        import shutil
+        import subprocess
+        smi = shutil.which("nvidia-smi")
+        if smi:
+            try:
+                r = subprocess.run(
+                    [smi, "--query-gpu=index,utilization.gpu,memory.used,"
+                     "memory.total", "--format=csv,noheader,nounits"],
+                    capture_output=True, timeout=5, text=True)
+                for line in r.stdout.splitlines():
+                    parts = [p.strip() for p in line.split(",")]
+                    if len(parts) != 4:
+                        continue
+                    tags = {"gpu": parts[0]}
+                    out.append(("gpu_utilization_percent",
+                                float(parts[1]), tags))
+                    out.append(("gpu_memory_used_mb", float(parts[2]), tags))
+                    out.append(("gpu_memory_total_mb", float(parts[3]), tags))
+            except (OSError, ValueError, subprocess.SubprocessError):
+                pass
         return out
 
 
@@ -180,6 +236,7 @@ COLLECTORS: Dict[str, Callable] = {
     "net": NetCollector,
     "system": SystemCollector,
     "process": ProcessCollector,
+    "gpu": GPUCollector,
 }
 
 

@@ -1,0 +1,191 @@
+"""input_journal — systemd journal tailing.
+
+Reference: plugins/input/journal/ (go-systemd sdjournal). This runtime has
+no libsystemd binding baked in, so the input drives `journalctl -o json -f`
+as a line stream — same field model (MESSAGE, PRIORITY, _SYSTEMD_UNIT,
+_HOSTNAME, __REALTIME_TIMESTAMP) — with the journal cursor checkpointed so
+restarts resume where they left off. Gated: init fails soft when
+journalctl is absent (containers without systemd).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("journal")
+
+# journald fields promoted to event fields (reference journal input's
+# default field mapping); everything else is dropped unless KeepAllFields
+_FIELDS = {
+    "MESSAGE": b"content",
+    "PRIORITY": b"priority",
+    "_SYSTEMD_UNIT": b"unit",
+    "_HOSTNAME": b"hostname",
+    "_PID": b"pid",
+    "_COMM": b"command",
+    "SYSLOG_IDENTIFIER": b"identifier",
+}
+
+
+def parse_journal_entry(line: bytes) -> Optional[Tuple[int, Dict[bytes, bytes],
+                                                       str]]:
+    """One `journalctl -o json` line → (ts_seconds, fields, cursor)."""
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    fields: Dict[bytes, bytes] = {}
+    for src, dst in _FIELDS.items():
+        v = obj.get(src)
+        if v is None:
+            continue
+        if isinstance(v, list):          # binary-ish fields arrive as arrays
+            v = bytes(v).decode("utf-8", "replace")
+        fields[dst] = str(v).encode()
+    ts_us = obj.get("__REALTIME_TIMESTAMP")
+    try:
+        ts = int(ts_us) // 1_000_000
+    except (TypeError, ValueError):
+        ts = int(time.time())
+    return ts, fields, str(obj.get("__CURSOR", ""))
+
+
+class InputJournal(Input):
+    name = "input_journal"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._cursor = ""
+        self._cursor_path = ""
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.units: List[str] = list(config.get("Units", []))
+        self.max_batch = int(config.get("MaxBatch", 256))
+        self.journalctl = config.get("JournalctlPath") or \
+            shutil.which("journalctl")
+        if not self.journalctl:
+            log.error("input_journal: journalctl not found; disabled")
+            return False
+        data_dir = config.get("CursorDir") or os.path.expanduser(
+            "~/.loongcollector_tpu")
+        self._cursor_path = os.path.join(
+            data_dir, f"journal_cursor_{context.pipeline_name}")
+        try:
+            with open(self._cursor_path) as f:
+                self._cursor = f.read().strip()
+        except OSError:
+            self._cursor = ""
+        return True
+
+    def _cmd(self) -> List[str]:
+        cmd = [self.journalctl, "-o", "json", "-f", "--no-pager"]
+        if self._cursor:
+            cmd += ["--after-cursor", self._cursor]
+        else:
+            cmd += ["-n", "0"]          # tail only: no history replay
+        for u in self.units:
+            cmd += ["-u", u]
+        return cmd
+
+    def start(self) -> bool:
+        try:
+            self._proc = subprocess.Popen(
+                self._cmd(), stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL)
+        except OSError as e:
+            log.error("input_journal spawn failed: %s", e)
+            return False
+        self._running = True
+        self._batch: List[Tuple[int, Dict[bytes, bytes]]] = []
+        self._batch_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, name="journal",
+                                        daemon=True)
+        self._thread.start()
+        # the reader thread blocks in the journalctl pipe; a quiet journal
+        # would otherwise hold the last burst unflushed indefinitely, so a
+        # timer drains the pending batch every second
+        self._flush_thread = threading.Thread(
+            target=self._flush_timer, name="journal-flush", daemon=True)
+        self._flush_thread.start()
+        return True
+
+    def _run(self) -> None:
+        assert self._proc is not None and self._proc.stdout is not None
+        for line in self._proc.stdout:
+            if not self._running:
+                break
+            parsed = parse_journal_entry(line)
+            if parsed is None:
+                continue
+            ts, fields, cursor = parsed
+            with self._batch_lock:
+                if cursor:
+                    self._cursor = cursor
+                self._batch.append((ts, fields))
+                full = len(self._batch) >= self.max_batch
+            if full:
+                self._flush_now()
+        self._flush_now()
+
+    def _flush_timer(self) -> None:
+        while self._running:
+            time.sleep(1.0)
+            self._flush_now()
+
+    def _flush_now(self) -> None:
+        with self._batch_lock:
+            batch, self._batch = self._batch, []
+        if batch:
+            self._flush(batch)
+
+    def _flush(self, batch) -> None:
+        pqm = self.context.process_queue_manager
+        if pqm is None or not batch:
+            return
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        for ts, fields in batch:
+            ev = group.add_log_event(ts)
+            for k, v in fields.items():
+                ev.set_content(k, sb.copy_string(v))
+        group.set_tag(b"__source__", b"journal")
+        pqm.push_queue(self.context.process_queue_key, group)
+        self._save_cursor()
+
+    def _save_cursor(self) -> None:
+        if not self._cursor or not self._cursor_path:
+            return
+        try:
+            os.makedirs(os.path.dirname(self._cursor_path), exist_ok=True)
+            tmp = self._cursor_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self._cursor)
+            os.replace(tmp, self._cursor_path)
+        except OSError:
+            pass
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
+        self._save_cursor()
+        return True

@@ -1,0 +1,224 @@
+"""input_mqtt — MQTT 3.1.1 subscriber over the public wire protocol.
+
+Reference: plugins/input/mqtt/ (paho client). No MQTT library in this
+image, so the input speaks the protocol directly: CONNECT/CONNACK,
+SUBSCRIBE/SUBACK, PUBLISH receive (QoS 0 and 1 — PUBACK sent), PINGREQ
+keepalive. Each PUBLISH becomes one event (topic + payload).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("mqtt")
+
+CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+SUBSCRIBE, SUBACK, PINGREQ, PINGRESP, DISCONNECT = 8, 9, 12, 13, 14
+
+
+def _mqtt_str(s: bytes) -> bytes:
+    return struct.pack(">H", len(s)) + s
+
+
+def _remaining_len(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
+
+def _read_packet(sock: socket.socket):
+    """Returns (packet_type, flags, payload) or None on EOF."""
+    h = sock.recv(1)
+    if not h:
+        return None
+    ptype, flags = h[0] >> 4, h[0] & 0x0F
+    mult, n = 1, 0
+    while True:
+        b = sock.recv(1)
+        if not b:
+            return None
+        n += (b[0] & 0x7F) * mult
+        if not b[0] & 0x80:
+            break
+        mult *= 128
+    payload = b""
+    while len(payload) < n:
+        chunk = sock.recv(n - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return ptype, flags, payload
+
+
+class MQTTSubscriber:
+    def __init__(self, host: str, port: int, topics: List[str],
+                 client_id: str = "loongcollector-tpu",
+                 username: str = "", password: str = "",
+                 keepalive: int = 30, on_message=None):
+        self.host, self.port = host, port
+        self.topics = topics
+        self.client_id = client_id
+        self.username, self.password = username, password
+        self.keepalive = keepalive
+        self.on_message = on_message
+        self._sock: Optional[socket.socket] = None
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._pkt_id = 0
+
+    def _connect(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=10)
+        flags = 0x02                                  # clean session
+        payload = _mqtt_str(self.client_id.encode())
+        if self.username:
+            flags |= 0x80
+            payload += _mqtt_str(self.username.encode())
+            if self.password:
+                flags |= 0x40
+                payload += _mqtt_str(self.password.encode())
+        var = (_mqtt_str(b"MQTT") + b"\x04" + bytes([flags])
+               + struct.pack(">H", self.keepalive))
+        pkt = bytes([CONNECT << 4]) + _remaining_len(
+            len(var) + len(payload)) + var + payload
+        sock.sendall(pkt)
+        resp = _read_packet(sock)
+        if resp is None or resp[0] != CONNACK or resp[2][1] != 0:
+            raise OSError(f"MQTT CONNACK refused: {resp}")
+        # subscribe (QoS 1 requested; broker may grant 0)
+        self._pkt_id += 1
+        sub_payload = b"".join(_mqtt_str(t.encode()) + b"\x01"
+                               for t in self.topics)
+        var = struct.pack(">H", self._pkt_id)
+        sock.sendall(bytes([(SUBSCRIBE << 4) | 0x02])
+                     + _remaining_len(len(var) + len(sub_payload))
+                     + var + sub_payload)
+        resp = _read_packet(sock)
+        if resp is None or resp[0] != SUBACK:
+            raise OSError(f"MQTT SUBACK missing: {resp}")
+        sock.settimeout(self.keepalive / 2 if self.keepalive else 15)
+        self._sock = sock
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="mqtt",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        backoff = 1.0
+        while self._running:
+            try:
+                self._connect()
+                backoff = 1.0
+                self._loop()
+            except OSError as e:
+                if self._running:
+                    log.warning("mqtt connection lost: %s", e)
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
+
+    def _loop(self) -> None:
+        assert self._sock is not None
+        while self._running:
+            try:
+                pkt = _read_packet(self._sock)
+            except socket.timeout:
+                self._sock.sendall(bytes([PINGREQ << 4, 0]))
+                continue
+            if pkt is None:
+                raise OSError("broker closed connection")
+            ptype, flags, payload = pkt
+            if ptype == PUBLISH:
+                try:
+                    qos = (flags >> 1) & 3
+                    tlen = struct.unpack(">H", payload[:2])[0]
+                    topic = payload[2:2 + tlen]
+                    pos = 2 + tlen
+                    if qos > 0:
+                        pid = struct.unpack(">H",
+                                            payload[pos:pos + 2])[0]
+                        pos += 2
+                        self._sock.sendall(bytes([PUBACK << 4, 2])
+                                           + struct.pack(">H", pid))
+                except (struct.error, IndexError) as e:
+                    # stream desync: reconnect rather than die
+                    raise OSError(f"malformed PUBLISH: {e}") from e
+                if self.on_message is not None:
+                    self.on_message(topic, payload[pos:])
+            elif ptype == PINGRESP:
+                pass
+
+    def stop(self) -> None:
+        self._running = False
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.sendall(bytes([DISCONNECT << 4, 0]))
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+
+
+class InputMQTT(Input):
+    name = "input_mqtt"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._client: Optional[MQTTSubscriber] = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        addr = config.get("Address", "")
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            log.error("input_mqtt Address must be host:port, got %r", addr)
+            return False
+        self._host, self._port = host, int(port)
+        self.topics = list(config.get("Topics", []))
+        self.username = config.get("Username", "")
+        self.password = config.get("Password", "")
+        return bool(self.topics)
+
+    def _on_message(self, topic: bytes, payload: bytes) -> None:
+        pqm = self.context.process_queue_manager
+        if pqm is None:
+            return
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_log_event(int(time.time()))
+        ev.set_content(b"topic", sb.copy_string(topic))
+        ev.set_content(b"content", sb.copy_string(payload))
+        group.set_tag(b"__source__", b"mqtt")
+        pqm.push_queue(self.context.process_queue_key, group)
+
+    def start(self) -> bool:
+        self._client = MQTTSubscriber(
+            self._host, self._port, self.topics,
+            username=self.username, password=self.password,
+            on_message=self._on_message)
+        self._client.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self._client is not None:
+            self._client.stop()
+            self._client = None
+        return True

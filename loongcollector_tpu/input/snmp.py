@@ -1,0 +1,233 @@
+"""input_snmp — SNMP v2c polling with a minimal BER codec.
+
+Reference: plugins/input/snmp/ (gosnmp). No SNMP library here, so the
+input encodes GetRequest PDUs and decodes responses directly (the tiny
+ASN.1/BER subset SNMP needs: SEQUENCE, INTEGER, OCTET STRING, OID, NULL,
+plus the application types Counter32/Gauge32/TimeTicks/Counter64).
+Each poll emits one MetricEvent per OID with numeric values, or a
+LogEvent field for strings.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import MetricValue, PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("snmp")
+
+# -- BER ---------------------------------------------------------------------
+
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _tlv(tag: int, payload: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(payload)) + payload
+
+
+def _ber_int(v: int, tag: int = 0x02) -> bytes:
+    if v == 0:
+        return _tlv(tag, b"\x00")
+    body = v.to_bytes((v.bit_length() + 8) // 8, "big", signed=True)
+    return _tlv(tag, body)
+
+
+def encode_oid(oid: str) -> bytes:
+    parts = [int(p) for p in oid.strip(".").split(".")]
+    body = bytearray([parts[0] * 40 + parts[1]])
+    for p in parts[2:]:
+        chunk = bytearray()
+        chunk.append(p & 0x7F)
+        p >>= 7
+        while p:
+            chunk.append((p & 0x7F) | 0x80)
+            p >>= 7
+        body += bytes(reversed(chunk))
+    return _tlv(0x06, bytes(body))
+
+
+def _parse_tlv(buf: bytes, pos: int) -> Tuple[int, bytes, int]:
+    tag = buf[pos]
+    pos += 1
+    ln = buf[pos]
+    pos += 1
+    if ln & 0x80:
+        nb = ln & 0x7F
+        ln = int.from_bytes(buf[pos:pos + nb], "big")
+        pos += nb
+    return tag, buf[pos:pos + ln], pos + ln
+
+
+def decode_oid(body: bytes) -> str:
+    parts = [body[0] // 40, body[0] % 40]
+    v = 0
+    for b in body[1:]:
+        v = (v << 7) | (b & 0x7F)
+        if not b & 0x80:
+            parts.append(v)
+            v = 0
+    return ".".join(str(p) for p in parts)
+
+
+def build_get_request(community: str, oids: List[str],
+                      request_id: int) -> bytes:
+    varbinds = b"".join(
+        _tlv(0x30, encode_oid(o) + _tlv(0x05, b"")) for o in oids)
+    pdu = _tlv(0xA0,                    # GetRequest-PDU
+               _ber_int(request_id)
+               + _ber_int(0) + _ber_int(0)      # error-status/index
+               + _tlv(0x30, varbinds))
+    return _tlv(0x30, _ber_int(1)               # version: v2c
+                + _tlv(0x04, community.encode()) + pdu)
+
+
+def parse_response(data: bytes) -> Dict[str, Any]:
+    """Response message → {oid: value} (ints, bytes, or None).
+    Malformed datagrams (truncated BER, stray packets) return {} — a bad
+    response must never kill the polling thread."""
+    try:
+        return _parse_response(data)
+    except (IndexError, ValueError):
+        return {}
+
+
+def _parse_response(data: bytes) -> Dict[str, Any]:
+    _, msg, _ = _parse_tlv(data, 0)
+    pos = 0
+    _, _, pos = _parse_tlv(msg, pos)            # version
+    _, _, pos = _parse_tlv(msg, pos)            # community
+    tag, pdu, _ = _parse_tlv(msg, pos)
+    pos = 0
+    _, _, pos = _parse_tlv(pdu, pos)            # request id
+    _, err, pos = _parse_tlv(pdu, pos)          # error-status
+    _, _, pos = _parse_tlv(pdu, pos)            # error-index
+    if err and int.from_bytes(err, "big"):
+        return {}
+    _, binds, _ = _parse_tlv(pdu, pos)
+    out: Dict[str, Any] = {}
+    pos = 0
+    while pos < len(binds):
+        _, vb, pos = _parse_tlv(binds, pos)
+        otag, oid_body, vpos = _parse_tlv(vb, 0)
+        vtag, val, _ = _parse_tlv(vb, vpos)
+        oid = decode_oid(oid_body)
+        if vtag == 0x02 or vtag in (0x41, 0x42, 0x43, 0x46):
+            # INTEGER / Counter32 / Gauge32 / TimeTicks / Counter64
+            out[oid] = int.from_bytes(val, "big",
+                                      signed=(vtag == 0x02))
+        elif vtag == 0x04:
+            out[oid] = val
+        elif vtag == 0x06:
+            out[oid] = decode_oid(val)
+        else:
+            out[oid] = None
+    return out
+
+
+def snmp_get(host: str, port: int, community: str, oids: List[str],
+             timeout: float = 3.0, request_id: int = 1) -> Dict[str, Any]:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(build_get_request(community, oids, request_id),
+                    (host, port))
+        data, _ = sock.recvfrom(65535)
+    finally:
+        sock.close()
+    return parse_response(data)
+
+
+# -- input plugin ------------------------------------------------------------
+
+
+class InputSNMP(Input):
+    name = "input_snmp"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._req_id = 0
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.targets: List[str] = list(config.get("Targets", []))
+        self.oids: Dict[str, str] = dict(config.get("Oids", {}))  # name→oid
+        self.community = config.get("Community", "public")
+        self.interval = float(config.get("IntervalSecs", 30.0))
+        return bool(self.targets) and bool(self.oids)
+
+    def start(self) -> bool:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="snmp",
+                                        daemon=True)
+        self._thread.start()
+        return True
+
+    def _run(self) -> None:
+        while self._running:
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — polling must survive anything
+                log.exception("snmp poll round failed")
+            for _ in range(int(self.interval * 10)):
+                if not self._running:
+                    return
+                time.sleep(0.1)
+
+    def poll_once(self) -> None:
+        pqm = self.context.process_queue_manager
+        names = list(self.oids)
+        oid_list = [self.oids[n] for n in names]
+        for target in self.targets:
+            host, _, port = target.rpartition(":")
+            self._req_id += 1
+            try:
+                vals = snmp_get(host or target, int(port or 161),
+                                self.community, oid_list,
+                                request_id=self._req_id)
+            except OSError as e:
+                log.warning("snmp poll %s failed: %s", target, e)
+                continue
+            if pqm is None:
+                continue
+            group = PipelineEventGroup()
+            sb = group.source_buffer
+            now = int(time.time())
+            for name, oid in zip(names, oid_list):
+                v = vals.get(oid.strip("."))
+                if v is None:
+                    continue
+                if isinstance(v, int):
+                    ev = group.add_metric_event(now)
+                    ev.name = name.encode()
+                    ev.value = MetricValue(float(v))
+                    ev.set_tag(b"target", target.encode())
+                else:
+                    lev = group.add_log_event(now)
+                    lev.set_content(sb.copy_string(name.encode()),
+                                    sb.copy_string(
+                                        v if isinstance(v, bytes)
+                                        else str(v).encode()))
+                    lev.set_content(b"target", sb.copy_string(
+                        target.encode()))
+            if len(group):
+                group.set_tag(b"__source__", b"snmp")
+                pqm.push_queue(self.context.process_queue_key, group)
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        return True

@@ -27,6 +27,10 @@ class HttpSink:
         self._queue: _queue.Queue = _queue.Queue()
         self._threads = []
         self._running = False
+        # per-worker persistent connections keyed by (scheme, netloc) —
+        # the reference reuses connections via curl_multi (HttpSink.cpp:91);
+        # per-thread maps need no locking
+        self._local = threading.local()
 
     def init(self) -> None:
         self._running = True
@@ -64,22 +68,67 @@ class HttpSink:
             except Exception:  # noqa: BLE001
                 log.exception("on_done callback failed")
 
-    @staticmethod
-    def _execute(request) -> Tuple[int, bytes]:
+    def _get_conn(self, scheme: str, netloc: str, timeout: float):
+        """Returns (conn, reused)."""
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        key = (scheme, netloc)
+        conn = pool.get(key)
+        reused = conn is not None
+        if conn is None:
+            conn_cls = (http.client.HTTPSConnection if scheme == "https"
+                        else http.client.HTTPConnection)
+            conn = conn_cls(netloc, timeout=timeout)
+            pool[key] = conn
+        conn.timeout = timeout
+        if reused and conn.sock is not None:
+            # http.client applies timeout only at connect(); a reused
+            # socket must be re-armed or it keeps the FIRST request's value
+            conn.sock.settimeout(timeout)
+        return conn, reused
+
+    def _drop_conn(self, scheme: str, netloc: str) -> None:
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            return
+        conn = pool.pop((scheme, netloc), None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _execute(self, request) -> Tuple[int, bytes]:
+        # _execute must NEVER raise: an escaped exception kills the worker
+        # thread and silently wedges every flusher sharing the sink
         try:
             u = urlparse(request.url)
-            conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
-                        else http.client.HTTPConnection)
-            conn = conn_cls(u.netloc, timeout=request.timeout)
             path = u.path or "/"
             if u.query:
                 path += "?" + u.query
-            conn.request(request.method, path, body=request.body,
-                         headers=request.headers)
-            resp = conn.getresponse()
-            body = resp.read()
-            status = resp.status
-            conn.close()
-            return status, body
-        except Exception as e:  # noqa: BLE001 - any transport failure = retryable
+        except ValueError as e:
             return 0, str(e).encode()
+        # one reconnect retry, but ONLY when the SEND on a kept-alive
+        # connection failed (the server closed it — standard keep-alive
+        # race; nothing was processed). A failure after the request went
+        # out (slow/lost response) must NOT re-send: the server may have
+        # ingested the batch, and duplication is the flusher's call.
+        while True:
+            reused = False
+            sent = False
+            try:
+                conn, reused = self._get_conn(u.scheme, u.netloc,
+                                              request.timeout)
+                conn.request(request.method, path, body=request.body,
+                             headers=request.headers)
+                sent = True
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.will_close:
+                    self._drop_conn(u.scheme, u.netloc)
+                return resp.status, body
+            except Exception as e:  # noqa: BLE001 - transport = retryable
+                self._drop_conn(u.scheme, u.netloc)
+                if not reused or sent:
+                    return 0, str(e).encode()
