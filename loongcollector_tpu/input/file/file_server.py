@@ -36,7 +36,8 @@ class _ConfigState:
     def __init__(self, name: str, discovery: FileDiscoveryConfig,
                  queue_key: int, tail_existing: bool,
                  multiline_start: Optional[str] = None,
-                 multiline_end: Optional[str] = None):
+                 multiline_end: Optional[str] = None,
+                 encoding: str = "utf8"):
         self.name = name
         self.poller = PollingDirFile(discovery)
         self.queue_key = queue_key
@@ -48,6 +49,7 @@ class _ConfigState:
         self.first_round = True
         self.multiline_start = multiline_start
         self.multiline_end = multiline_end
+        self.encoding = encoding
         self.pending: set = set()   # paths with bytes left after a drain
         # optional per-path group tags (container meta on stdio inputs):
         # callable(path) -> Dict[bytes, bytes] | None
@@ -55,7 +57,8 @@ class _ConfigState:
 
     def new_reader(self, path: str) -> LogFileReader:
         return LogFileReader(path, multiline_start=self.multiline_start,
-                             multiline_end=self.multiline_end)
+                             multiline_end=self.multiline_end,
+                             encoding=self.encoding)
 
 
 class FileServer:
@@ -95,11 +98,12 @@ class FileServer:
                    queue_key: int, tail_existing: bool = False,
                    multiline_start: Optional[str] = None,
                    multiline_end: Optional[str] = None,
-                   tag_provider=None) -> None:
+                   tag_provider=None, encoding: str = "utf8") -> None:
         with self._lock:
             st = _ConfigState(
                 name, discovery, queue_key, tail_existing,
-                multiline_start=multiline_start, multiline_end=multiline_end)
+                multiline_start=multiline_start, multiline_end=multiline_end,
+                encoding=encoding)
             st.tag_provider = tag_provider
             self._configs[name] = st
 
@@ -334,9 +338,14 @@ class FileServer:
                         group.set_tag(k, v)
             if pqm is not None:
                 if not pqm.push_queue(st.queue_key, group):
-                    # queue rejected after read: roll the offset back
-                    raw = group.events[0].content
-                    reader.offset -= len(raw)
+                    # queue rejected after read: roll the offset back by
+                    # the SOURCE bytes consumed (≠ content length when the
+                    # reader transcodes, e.g. GBK→UTF-8)
+                    from ...models import EventGroupMetaKey
+                    src_len = group.get_metadata(
+                        EventGroupMetaKey.LOG_FILE_LENGTH)
+                    reader.offset -= int(str(src_len)) if src_len else \
+                        len(group.events[0].content)
                     break
             moved = True
             self.checkpoints.update(reader.checkpoint())

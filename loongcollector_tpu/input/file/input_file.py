@@ -57,7 +57,8 @@ class InputFile(Input):
                       self.context.process_queue_key,
                       tail_existing=self.tail_existing,
                       multiline_start=self.multiline.get("StartPattern"),
-                      multiline_end=self.multiline.get("EndPattern"))
+                      multiline_end=self.multiline.get("EndPattern"),
+                      encoding=self.config.get("FileEncoding", "utf8"))
         fs.start()
         return True
 

@@ -66,8 +66,13 @@ class LogFileReader:
     def __init__(self, path: str, chunk_size: int = DEFAULT_CHUNK,
                  multiline_start: Optional[str] = None,
                  multiline_end: Optional[str] = None,
-                 ml_flush_timeout: float = ML_FLUSH_TIMEOUT_S):
+                 ml_flush_timeout: float = ML_FLUSH_TIMEOUT_S,
+                 encoding: str = "utf8"):
         self.path = path
+        # "gbk" transcodes chunks to UTF-8 on read (reference ReadGBK,
+        # LogFileReader.cpp:1807), holding a trailing partial multibyte
+        # character in the file like the newline rollback does
+        self.encoding = (encoding or "utf8").lower()
         self.chunk_size = chunk_size
         self.offset = 0
         self.dev_inode = DevInode()
@@ -239,7 +244,13 @@ class LogFileReader:
         if partial_tail or force_flush:
             self._ml_hold_size = -1
         read_offset = self.offset
-        self.offset += len(aligned)
+        if self.encoding == "gbk":
+            aligned, consumed_src = self._transcode_gbk(aligned, force_flush)
+            if not aligned:
+                return None
+        else:
+            consumed_src = len(aligned)
+        self.offset += consumed_src
         self.last_read_time = time.monotonic()
 
         sb = SourceBuffer(capacity=len(aligned) + 256)
@@ -253,7 +264,10 @@ class LogFileReader:
         group.set_metadata(EventGroupMetaKey.LOG_FILE_DEV,
                            str(self.dev_inode.dev))
         group.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET, str(read_offset))
-        group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH, str(len(aligned)))
+        # SOURCE bytes consumed (≠ content length under GBK transcode):
+        # exactly-once ranges and back-pressure rollback index the raw file
+        group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH,
+                           str(consumed_src))
         # stitch markers for split_multiline's cross-group carry: this chunk
         # ends mid-record / continues the previous chunk's open record
         if partial_tail:
@@ -262,6 +276,37 @@ class LogFileReader:
             group.set_metadata(EventGroupMetaKey.ML_CONTINUE, "1")
         self._prev_partial = partial_tail
         return group
+
+    @staticmethod
+    def _transcode_gbk(data: bytes, force_flush: bool
+                       ) -> Tuple[bytes, int]:
+        """GBK bytes → (utf-8 bytes, source bytes consumed).
+
+        A partial multibyte character at the END stays in the file (next
+        read completes it) unless force_flush; invalid bytes mid-stream
+        are replaced (the reference tolerates mixed content rather than
+        stalling the reader). Newline alignment upstream is GBK-safe:
+        0x0A never appears as a trail byte — which also means a chunk
+        ENDING at a newline cannot end mid-character, so only chunks cut
+        elsewhere (filled mid-line) may hold bytes back.
+        """
+        can_hold = not force_flush and not data.endswith(b"\n")
+        consumed = len(data)
+        while True:
+            try:
+                text = data[:consumed].decode("gbk")
+                break
+            except UnicodeDecodeError as ue:
+                if can_hold and ue.start >= consumed - 2 \
+                        and ue.end >= consumed:
+                    # dangling lead byte at the chunk end: hold it
+                    consumed = ue.start
+                    if consumed == 0:
+                        return b"", 0
+                    continue
+                text = data[:consumed].decode("gbk", errors="replace")
+                break
+        return text.encode("utf-8"), consumed
 
     def _ml_align(self, data: bytes) -> int:
         """Bytes of `data` that form COMPLETE multiline records.

@@ -106,3 +106,108 @@ class TestPolling:
                                   exclude_files=["y.*"])
         found = PollingDirFile(cfg).poll()
         assert found == [str(tmp_path / "x.log")]
+
+
+class TestGBKDecode:
+    """GBK transcode on read (reference ReadGBK, LogFileReader.cpp:1807)."""
+
+    def test_gbk_file_transcodes_to_utf8(self, tmp_path):
+        text = "时间=2024 级别=错误 消息=磁盘已满\nsecond line ascii\n"
+        p = tmp_path / "g.log"
+        p.write_bytes(text.encode("gbk"))
+        r = LogFileReader(str(p), encoding="gbk")
+        g = r.read()
+        assert g.events[0].content.to_bytes().decode("utf-8") == text
+
+    def test_partial_multibyte_held_at_chunk_boundary(self, tmp_path):
+        """A GBK character split by the chunk boundary must not be mangled:
+        the lead byte stays in the file until its trail byte arrives."""
+        text = "前缀abc中文内容结尾\n"
+        raw = text.encode("gbk")
+        p = tmp_path / "h.log"
+        # choose a chunk size that lands INSIDE a 2-byte character and has
+        # no newline before it (forces the filled-chunk path)
+        cut = raw.index("中".encode("gbk")) + 1
+        p.write_bytes(raw[:cut])
+        r = LogFileReader(str(p), chunk_size=cut, encoding="gbk")
+        g1 = r.read()          # filled chunk: ships decodable prefix only
+        p.write_bytes(raw)     # rest arrives (same prefix + remainder)
+        out = b"" if g1 is None else g1.events[0].content.to_bytes()
+        while True:
+            g = r.read(force_flush=True)
+            if g is None:
+                break
+            out += g.events[0].content.to_bytes()
+        assert out.decode("utf-8") == text
+
+    def test_invalid_bytes_replaced_not_fatal(self, tmp_path):
+        p = tmp_path / "i.log"
+        p.write_bytes(b"ok \x81\x20 bad\n")   # invalid GBK pair mid-line
+        r = LogFileReader(str(p), encoding="gbk")
+        g = r.read()
+        s = g.events[0].content.to_bytes().decode("utf-8")
+        assert "ok " in s and "bad" in s
+
+    def test_source_length_metadata_under_gbk(self, tmp_path):
+        """LOG_FILE_LENGTH must be SOURCE bytes (EO ranges + rollback index
+        the raw file), not the transcoded UTF-8 length."""
+        from loongcollector_tpu.models import EventGroupMetaKey
+        text = "中文行\n"
+        raw = text.encode("gbk")
+        p = tmp_path / "j.log"
+        p.write_bytes(raw)
+        r = LogFileReader(str(p), encoding="gbk")
+        g = r.read()
+        assert int(str(g.get_metadata(EventGroupMetaKey.LOG_FILE_LENGTH))) \
+            == len(raw)
+        assert r.offset == len(raw)
+        assert len(g.events[0].content.to_bytes()) == len(text.encode())
+
+    def test_backpressure_rollback_gbk_exact(self, tmp_path):
+        """Queue rejection rolls back by source bytes: re-read yields the
+        identical content, no mid-character garble, no negative offset."""
+        from loongcollector_tpu.input.file.file_server import (FileServer,
+                                                               _ConfigState)
+        from loongcollector_tpu.input.file.polling import FileDiscoveryConfig
+        text = "中文行\n"
+        p = tmp_path / "k.log"
+        p.write_bytes(text.encode("gbk"))
+        fs = FileServer()
+        st = _ConfigState("t", FileDiscoveryConfig([str(p)]), queue_key=1,
+                          tail_existing=True, encoding="gbk")
+
+        class _RejectOnce:
+            def __init__(self):
+                self.calls = 0
+                self.groups = []
+            def is_valid_to_push(self, key):
+                return True
+            def push_queue(self, key, group):
+                self.calls += 1
+                if self.calls == 1:
+                    return False
+                self.groups.append(group)
+                return True
+        pqm = _RejectOnce()
+        fs.process_queue_manager = pqm
+        r = st.new_reader(str(p))
+        assert r.open()
+        st.readers[str(p)] = r
+        fs._drain_reader(st, r)          # rejected: rolls back
+        assert r.offset == 0
+        fs._drain_reader(st, r)          # accepted
+        assert pqm.groups
+        assert pqm.groups[0].events[0].content.to_bytes().decode() == text
+
+    def test_invalid_byte_before_newline_never_stalls(self, tmp_path):
+        p = tmp_path / "l.log"
+        p.write_bytes("好\n".encode("gbk") + b"\x81\n")
+        r = LogFileReader(str(p), encoding="gbk")
+        out = b""
+        for _ in range(4):
+            g = r.read()
+            if g is None:
+                break
+            out += g.events[0].content.to_bytes()
+        assert not r.has_more(), "reader stalled on the invalid byte"
+        assert "好".encode() in out
