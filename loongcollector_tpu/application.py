@@ -279,6 +279,15 @@ class Application:
                 mgr.delete_if_sequence(cp.key, cp.sequence_id)
                 self._eo_pending.remove(cp)
                 continue
+            # the normal read path transcodes GBK→UTF-8; the replayed raw
+            # range must match or exactly the replayed events ship mojibake
+            for icfg in (getattr(p, "config", None) or {}).get("inputs", []):
+                if icfg.get("Type") == "input_file" and \
+                        str(icfg.get("FileEncoding", "utf8")).lower() == "gbk":
+                    from .input.file.reader import LogFileReader
+                    data, _ = LogFileReader._transcode_gbk(
+                        data, force_flush=True)
+                    break
             sb = SourceBuffer(len(data) + 256)
             view = sb.copy_string(data)
             group = PipelineEventGroup(sb)

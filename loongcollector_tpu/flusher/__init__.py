@@ -4,6 +4,7 @@
 def register_all(registry) -> None:
     from .blackhole import FlusherBlackHole
     from .clickhouse import FlusherClickHouse
+    from .doris import FlusherDoris
     from .elasticsearch import FlusherElasticsearch
     from .file import FlusherFile
     from .http import FlusherHTTP
@@ -25,3 +26,4 @@ def register_all(registry) -> None:
     registry.register_flusher("flusher_clickhouse", FlusherClickHouse)
     registry.register_flusher("flusher_otlp", FlusherOTLP)
     registry.register_flusher("flusher_prometheus", FlusherPrometheus)
+    registry.register_flusher("flusher_doris", FlusherDoris)

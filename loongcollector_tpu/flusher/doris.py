@@ -1,0 +1,81 @@
+"""flusher_doris — Apache Doris stream-load sink.
+
+Reference: plugins/flusher/doris/ (Go stream-load client). Doris ingests
+over plain HTTP: `PUT /api/{db}/{table}/_stream_load` with NDJSON rows,
+basic auth, and per-request headers selecting the format. Rides the shared
+HttpSinkFlusher machinery; a unique label per batch gives Doris its
+at-most-once dedupe handle.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.serializer.event_dicts import iter_event_dicts
+from .http_base import AddressRotator, HttpSinkFlusher, basic_auth_header
+
+_label_seq = itertools.count(1)
+
+
+class FlusherDoris(HttpSinkFlusher):
+    name = "flusher_doris"
+    content_type = "application/x-ndjson"
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        self.rotator = AddressRotator(config.get("Addresses", []))
+        self.database = config.get("Database", "")
+        self.table = config.get("Table", "")
+        self.auth = basic_auth_header(config)
+        self.label_prefix = config.get("LabelPrefix", "loongcollector")
+        return bool(self.rotator) and bool(self.database) and \
+            bool(self.table)
+
+    def build_payload(self, groups: List[PipelineEventGroup]
+                      ) -> Optional[Tuple[bytes, Dict[str, str]]]:
+        rows: List[bytes] = []
+        for g in groups:
+            for ts, obj in iter_event_dicts(g):
+                obj.setdefault("_timestamp", ts)
+                rows.append(json.dumps(obj, ensure_ascii=False).encode())
+        if not rows:
+            return None
+        headers = dict(self.auth)
+        headers["format"] = "json"
+        headers["read_json_by_line"] = "true"
+        headers["Expect"] = "100-continue"
+        headers["label"] = (f"{self.label_prefix}_{int(time.time())}"
+                            f"_{next(_label_seq)}")
+        return b"\n".join(rows) + b"\n", headers
+
+    def build_request(self, item):
+        req = super().build_request(item)
+        req.method = "PUT"
+        return req
+
+    def on_send_done(self, item, status: int, body: bytes) -> str:
+        """Doris reports load failures with HTTP 200 + Status != Success in
+        the JSON body (the Go reference client parses it the same way)."""
+        if 200 <= status < 300:
+            try:
+                resp = json.loads(body)
+            except ValueError:
+                return "ok"
+            st = resp.get("Status", "Success")
+            if st in ("Success", "Publish Timeout"):
+                return "ok"
+            if st == "Label Already Exists":
+                return "ok"     # duplicate delivery: the load already landed
+            from ..utils.logger import get_logger
+            get_logger("doris").error(
+                "stream load rejected: %s (%s)", st,
+                resp.get("Message", ""))
+            return "drop"       # schema/data errors do not heal on retry
+        return super().on_send_done(item, status, body)
+
+    def endpoint_url(self, item) -> str:
+        return (f"{self.rotator.next()}/api/{self.database}/"
+                f"{self.table}/_stream_load")

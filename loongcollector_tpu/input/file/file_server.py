@@ -338,14 +338,9 @@ class FileServer:
                         group.set_tag(k, v)
             if pqm is not None:
                 if not pqm.push_queue(st.queue_key, group):
-                    # queue rejected after read: roll the offset back by
-                    # the SOURCE bytes consumed (≠ content length when the
-                    # reader transcodes, e.g. GBK→UTF-8)
-                    from ...models import EventGroupMetaKey
-                    src_len = group.get_metadata(
-                        EventGroupMetaKey.LOG_FILE_LENGTH)
-                    reader.offset -= int(str(src_len)) if src_len else \
-                        len(group.events[0].content)
+                    # queue rejected after read: restore offset (SOURCE
+                    # bytes) and the multiline stitch state together
+                    reader.rollback_last()
                     break
             moved = True
             self.checkpoints.update(reader.checkpoint())

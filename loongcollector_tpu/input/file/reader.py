@@ -88,6 +88,8 @@ class LogFileReader:
         self._ml_hold_since = 0.0   # first time the current tail was held
         self._ml_hold_size = -1     # file size at that moment
         self._prev_partial = False  # last shipped chunk broke mid-record
+        self._last_consumed = 0     # rollback_last() state
+        self._last_prev_partial = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -250,6 +252,11 @@ class LogFileReader:
                 return None
         else:
             consumed_src = len(aligned)
+        # snapshot for rollback_last(): a rejected queue push must restore
+        # BOTH the offset and the multiline stitch state, or the re-read
+        # chunk ships without its ML_CONTINUE marker
+        self._last_consumed = consumed_src
+        self._last_prev_partial = self._prev_partial
         self.offset += consumed_src
         self.last_read_time = time.monotonic()
 
@@ -276,6 +283,15 @@ class LogFileReader:
             group.set_metadata(EventGroupMetaKey.ML_CONTINUE, "1")
         self._prev_partial = partial_tail
         return group
+
+    def rollback_last(self) -> None:
+        """Undo the last read() (queue rejected the group): offset AND the
+        multiline stitch chain return to their pre-read values."""
+        self.offset -= getattr(self, "_last_consumed", 0)
+        self._last_consumed = 0
+        self._prev_partial = getattr(self, "_last_prev_partial",
+                                     self._prev_partial)
+        self._ml_hold_size = -1
 
     @staticmethod
     def _transcode_gbk(data: bytes, force_flush: bool

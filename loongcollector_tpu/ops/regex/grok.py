@@ -18,6 +18,46 @@ from __future__ import annotations
 import re
 from typing import Dict, Optional
 
+def _variants(*parts) -> list:
+    """Cartesian concatenation of alternative lists — enumerates the exact
+    language of a case-class/optional-suffix pattern as plain literals."""
+    out = [""]
+    for alts in parts:
+        out = [a + b for a in out for b in alts]
+    return out
+
+
+def _loglevel_literals() -> str:
+    """LOGLEVEL as an all-literal longest-first alternation.
+
+    Same language as the classic `[Ww]arn?(?:ing)?`-style pattern (quirky
+    forms like 'waring' included), but literal branches compile to the
+    Tier-1 kernel: prefix pairs (WARN/WARNING) are sound under commit when
+    ordered longest-first with a follow-set guard (program.py), which the
+    class/optional formulation can never prove.
+    """
+    words = (
+        _variants(["A", "a"], ["lert"]) + ["ALERT"]
+        + _variants(["T", "t"], ["race"]) + ["TRACE"]
+        + _variants(["D", "d"], ["ebug"]) + ["DEBUG"]
+        + _variants(["N", "n"], ["otice"]) + ["NOTICE"]
+        + _variants(["I", "i"], ["nf"], ["", "o"], ["", "rmation"])
+        + _variants(["INF"], ["", "O"], ["", "RMATION"])
+        + _variants(["W", "w"], ["ar"], ["", "n"], ["", "ing"])
+        + _variants(["WAR"], ["", "N"], ["", "ING"])
+        + _variants(["E", "e"], ["r"], ["", "r"], ["", "or"])
+        + _variants(["ER"], ["", "R"], ["", "OR"])
+        + _variants(["C", "c"], ["ri"], ["", "t"], ["", "ical"])
+        + _variants(["CRI"], ["", "T"], ["", "ICAL"])
+        + _variants(["F", "f"], ["atal"]) + ["FATAL"]
+        + _variants(["S", "s"], ["evere"]) + ["SEVERE"]
+        + _variants(["EMERG"], ["", "ENCY"])
+        + _variants(["E", "e"], ["merg"], ["", "ency"])
+    )
+    uniq = sorted(set(words), key=lambda w: (-len(w), w))
+    return "(?:" + "|".join(uniq) + ")"
+
+
 # Standard grok vocabulary (public, logstash-compatible names).
 DEFAULT_PATTERNS: Dict[str, str] = {
     "USERNAME": r"[a-zA-Z0-9._-]+",
@@ -72,7 +112,7 @@ DEFAULT_PATTERNS: Dict[str, str] = {
     "TZ": r"[A-Z]{3,4}",
     "HTTPDATE": r"%{MONTHDAY2}/%{MONTH3}/%{YEAR}:%{TIME} %{INT}",
     "SYSLOGTIMESTAMP": r"%{MONTH} +%{MONTHDAY} %{TIME}",
-    "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|[Nn]otice|NOTICE|[Ii]nfo?(?:rmation)?|INFO?(?:RMATION)?|[Ww]arn?(?:ing)?|WARN?(?:ING)?|[Ee]rr?(?:or)?|ERR?(?:OR)?|[Cc]rit?(?:ical)?|CRIT?(?:ICAL)?|[Ff]atal|FATAL|[Ss]evere|SEVERE|EMERG(?:ENCY)?|[Ee]merg(?:ency)?)",
+    "LOGLEVEL": _loglevel_literals(),
     # composite access-log patterns, kernel-friendly field classes: the
     # request field uses [^ "] (not \S) so the optional HTTP-version group
     # and closing quote never need backtracking — same semantics for

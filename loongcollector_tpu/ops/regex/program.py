@@ -507,6 +507,16 @@ def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
                             raise Tier1Unsupported(
                                 "alternation literal is a prefix of a later "
                                 "branch (reorder longest-first)")
+                        if lits[a].startswith(lits[b2]) and lits[a] != lits[b2]:
+                            # longer-first (the normalized order): commit on
+                            # the longer branch equals backtracking ONLY if
+                            # the continuation can never consume the
+                            # extension — counterexample: (WARNING|WARN)ING
+                            ext_first = lits[a][len(lits[b2])]
+                            if follow.contains(ext_first):
+                                raise Tier1Unsupported(
+                                    "literal prefix pair: follow set can "
+                                    "consume the longer branch's extension")
                         if (absorber is not None and not pivot_lazy
                                 and len(lits[a]) != len(lits[b2])
                                 and not (_guaranteed_nonabsorber(
@@ -541,7 +551,31 @@ def _validate_ops(ops: Sequence[Op], prog: SegmentProgram,
                                 "a greedy pivot")
 
 
+def _normalize_alts(ops: Sequence[Op]) -> None:
+    """All-literal alternations with prefix pairs reorder LONGEST-FIRST
+    (in place, recursive). For `re` this is match-equivalent — backtracking
+    explores every branch and the continuation disambiguates — and it is
+    the order the commit emitter needs (WARN before WARNING would shadow
+    WARNING forever). Soundness of the commit itself is still checked by
+    the follow-set guard in _validate_ops."""
+    for op in ops:
+        if isinstance(op, Optional_):
+            _normalize_alts(op.body)
+        elif isinstance(op, Alt):
+            for b in op.branches:
+                _normalize_alts(b)
+            lits = [b[0].data if len(b) == 1 and isinstance(b[0], Lit)
+                    else None for b in op.branches]
+            if all(l is not None for l in lits):
+                has_prefix_pair = any(
+                    a != b and (a.startswith(b) or b.startswith(a))
+                    for i, a in enumerate(lits) for b in lits[i + 1:])
+                if has_prefix_pair:
+                    op.branches.sort(key=lambda br: -len(br[0].data))
+
+
 def _validate_and_bind(prog: SegmentProgram) -> None:
+    _normalize_alts(prog.ops)
     _validate_ops(prog.ops, prog, CharClass.from_bytes(b""))
 
 

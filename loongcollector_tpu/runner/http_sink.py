@@ -101,14 +101,26 @@ class HttpSink:
 
     def _execute(self, request) -> Tuple[int, bytes]:
         # _execute must NEVER raise: an escaped exception kills the worker
-        # thread and silently wedges every flusher sharing the sink
+        # thread and silently wedges every flusher sharing the sink.
+        # Method-preserving redirects (307/308) are followed a few hops —
+        # Doris stream-load answers every FE request with a 307 to a BE.
+        url = request.url
+        for _ in range(3):
+            status, body, location = self._execute_once(url, request)
+            if status in (307, 308) and location:
+                url = location
+                continue
+            return status, body
+        return status, body
+
+    def _execute_once(self, url: str, request):
         try:
-            u = urlparse(request.url)
+            u = urlparse(url)
             path = u.path or "/"
             if u.query:
                 path += "?" + u.query
         except ValueError as e:
-            return 0, str(e).encode()
+            return 0, str(e).encode(), None
         # one reconnect retry, but ONLY when the SEND on a kept-alive
         # connection failed (the server closed it — standard keep-alive
         # race; nothing was processed). A failure after the request went
@@ -125,10 +137,11 @@ class HttpSink:
                 sent = True
                 resp = conn.getresponse()
                 body = resp.read()
+                location = resp.getheader("Location")
                 if resp.will_close:
                     self._drop_conn(u.scheme, u.netloc)
-                return resp.status, body
+                return resp.status, body, location
             except Exception as e:  # noqa: BLE001 - transport = retryable
                 self._drop_conn(u.scheme, u.netloc)
                 if not reused or sent:
-                    return 0, str(e).encode()
+                    return 0, str(e).encode(), None

@@ -126,3 +126,65 @@ class TestDoublePivot:
                   for a in (b"", b"1", b";b=", b"=;")
                   for b in (b"", b"2", b";b=9")]
         _diff(GREEDY_GREEDY, lines)
+
+
+class TestPrefixPairAlternation:
+    """Longest-first normalization of literal prefix pairs (LOGLEVEL's
+    WARN/WARNING shape) with the follow-set soundness guard."""
+
+    def test_prefix_pair_compiles_and_matches(self):
+        pattern = r"(WARN|WARNING|ERROR) (\w+)"
+        prog = compile_tier1(pattern)        # normalized longest-first
+        kern = ExtractKernel(prog)
+        lines = [b"WARNING x", b"WARN y", b"ERROR z", b"WARNIN q",
+                 b"WARNINGG h"]
+        arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+        lens = np.array([len(l) for l in lines], np.int32)
+        offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+        batch = pack_rows(arena, offs, lens, 128)
+        ok, coff, clen = (np.asarray(a) for a in
+                          kern(batch.rows, batch.lengths))
+        rx = re.compile(pattern.encode())
+        for i, ln in enumerate(lines):
+            m = rx.fullmatch(ln)
+            assert bool(ok[i]) == (m is not None), ln
+            if m:
+                s, e = m.span(1)
+                assert (coff[i, 0], clen[i, 0]) == (s, e - s), ln
+
+    def test_extension_consuming_follow_rejected(self):
+        """(WARNING|WARN)ING on 'WARNING' needs backtracking into the
+        shorter branch — commit must refuse."""
+        with pytest.raises(Tier1Unsupported):
+            compile_tier1(r"(WARNING|WARN)ING")
+
+    def test_loglevel_composite_now_device_tier(self):
+        from loongcollector_tpu.ops.regex.grok import expand
+        pattern = expand("%{TIMESTAMP_ISO8601:ts} %{LOGLEVEL:lvl} "
+                         "%{DATA:logger} - %{DATA:msg} took %{INT:ms}ms")
+        prog = compile_tier1(pattern)
+        assert prog.pivot2 is not None      # double-pivot device tier
+        kern = ExtractKernel(prog)
+        rx = re.compile(pattern.encode())
+        lines = [
+            b"2024-01-02T03:04:05 WARN app.Main - slow request took 42ms",
+            b"2024-01-02T03:04:05 WARNING a.b - x - y took 7ms",
+            b"2024-01-02T03:04:05 INFO s - ok took 1ms",
+            b"not a log line",
+        ]
+        arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+        lens = np.array([len(l) for l in lines], np.int32)
+        offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+        batch = pack_rows(arena, offs, lens,
+                          pick_length_bucket(int(lens.max())))
+        ok, coff, clen = (np.asarray(a) for a in
+                          kern(batch.rows, batch.lengths))
+        for i, ln in enumerate(lines):
+            m = rx.fullmatch(ln)
+            assert bool(ok[i]) == (m is not None), ln
+            if m:
+                for g in range(rx.groups):
+                    s, e = m.span(g + 1)
+                    if s >= 0:
+                        assert (coff[i, g], clen[i, g]) == (s, e - s), \
+                            (ln, g)

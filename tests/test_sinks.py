@@ -24,14 +24,21 @@ from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
 class _Capture(http.server.BaseHTTPRequestHandler):
     requests = []
 
-    def do_POST(self):
+    def _capture(self):
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
         _Capture.requests.append(
-            {"path": self.path, "headers": dict(self.headers), "body": body})
+            {"path": self.path, "headers": dict(self.headers),
+             "body": body, "method": self.command})
         self.send_response(200)
         self.end_headers()
         self.wfile.write(b"{}")
+
+    def do_POST(self):
+        self._capture()
+
+    def do_PUT(self):
+        self._capture()
 
     def log_message(self, *a):
         pass
@@ -366,3 +373,99 @@ class TestSinkReviewFixes:
         out = agg.flush()
         assert str(out[0].get_metadata(EventGroupMetaKey.LOG_FILE_PATH)) \
             == "/var/log/a"
+
+
+class TestDoris:
+    def test_stream_load_wire_body(self, endpoint):
+        url, _ = endpoint
+        _Capture.requests.clear()
+        req = _drive("flusher_doris",
+                     {"Addresses": [url], "Database": "db", "Table": "t",
+                      "Username": "root", "Password": ""},
+                     _log_group([(1700000001, {"msg": "hi"})]))
+        assert req["path"] == "/api/db/t/_stream_load"
+        assert req["method"] == "PUT"
+        assert req["headers"]["format"] == "json"
+        assert req["headers"]["label"].startswith("loongcollector_")
+        assert req["headers"]["Authorization"].startswith("Basic ")
+        row = json.loads(req["body"].decode().strip())
+        assert row["msg"] == "hi" and row["_timestamp"] == 1700000001
+
+
+class TestDorisResponseSemantics:
+    def _fl(self):
+        reg = PluginRegistry.instance()
+        reg.load_static_plugins()
+        fl = reg.create_flusher("flusher_doris")
+        fl._init_sink({"Addresses": ["http://x"], "Database": "d",
+                       "Table": "t"})
+        return fl
+
+    def test_status_fail_in_200_body_drops_with_error(self):
+        fl = self._fl()
+        assert fl.on_send_done(None, 200, b'{"Status": "Fail", '
+                               b'"Message": "schema mismatch"}') == "drop"
+
+    def test_success_and_duplicate_label_ok(self):
+        fl = self._fl()
+        assert fl.on_send_done(None, 200, b'{"Status": "Success"}') == "ok"
+        assert fl.on_send_done(
+            None, 200, b'{"Status": "Label Already Exists"}') == "ok"
+
+    def test_transport_errors_inherit_retry(self):
+        fl = self._fl()
+        assert fl.on_send_done(None, 503, b"") == "retry"
+
+
+class TestRedirectFollow:
+    def test_307_followed_preserving_method_and_body(self):
+        """Doris FEs answer stream-load with 307 → BE; the sink must follow
+        method-preserving redirects."""
+        import http.server as hs
+        import threading as th
+        hits = []
+
+        class BE(hs.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                hits.append(("be", self.command, self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b'{"Status": "Success"}')
+
+            def log_message(self, *a):
+                pass
+
+        be = hs.HTTPServer(("127.0.0.1", 0), BE)
+        th.Thread(target=be.serve_forever, daemon=True).start()
+
+        class FE(hs.BaseHTTPRequestHandler):
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                hits.append(("fe", self.command, b""))
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{be.server_port}/loaded")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        fe = hs.HTTPServer(("127.0.0.1", 0), FE)
+        th.Thread(target=fe.serve_forever, daemon=True).start()
+        from loongcollector_tpu.flusher.http import HttpRequest
+        from loongcollector_tpu.runner.http_sink import HttpSink
+        sink = HttpSink(workers=1)
+        try:
+            status, body = sink._execute(HttpRequest(
+                "PUT", f"http://127.0.0.1:{fe.server_port}/api/d/t/_stream_load",
+                {}, b"row-data"))
+        finally:
+            fe.shutdown()
+            be.shutdown()
+        assert status == 200 and b"Success" in body
+        assert [h[0] for h in hits] == ["fe", "be"]
+        assert hits[1][1] == "PUT" and hits[1][2] == b"row-data"
