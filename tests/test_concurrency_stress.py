@@ -87,3 +87,154 @@ def test_multithreaded_push_with_hot_swaps(tmp_path):
     ids = [p["d"] for p in parsed if "d" in p]
     assert len(ids) == len(set(ids)), "duplicate events emitted"
     assert len(ids) == pushed[0], (len(ids), pushed[0])
+
+
+def _stress_harness(tmp_path, name, cfg, thread_count=4):
+    """Shared scaffold: manager + runner + one pipeline; returns
+    (pqm, mgr, runner, pipeline, out_path). Callers stop runner FIRST,
+    then mgr (drain order matches the application exit path)."""
+    pqm = ProcessQueueManager()
+    mgr = CollectionPipelineManager(pqm, SenderQueueManager())
+    runner = ProcessorRunner(pqm, mgr, thread_count=thread_count)
+    runner.init()
+    diff = ConfigDiff()
+    diff.added[name] = cfg
+    mgr.update_pipelines(diff)
+    return pqm, mgr, runner, mgr.find_pipeline(name)
+
+
+def _drain_and_stop(pqm, runner, mgr, settle=1.3):
+    deadline = time.monotonic() + 10
+    while not pqm.all_empty() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # > BATCH_FLUSH_INTERVAL_S (1.0): guarantees at least one timeout tick
+    # runs over the held carry/bucket state while threads are still alive
+    time.sleep(settle)
+    runner.stop()
+    mgr.stop_all()
+
+
+def test_multithreaded_carry_under_forced_splits(tmp_path):
+    """split_multiline's carry dict under 4 processor threads + the timeout
+    tick: producers ship ML_PARTIAL_TAIL / ML_CONTINUE chunk pairs (the
+    reader's forced-split markers). Threads may legally reorder chunks of a
+    pair, so the invariant is LINE conservation: every input line comes out
+    exactly once across all emitted records — no loss, no duplication, no
+    corruption from the stash/flush races."""
+    from loongcollector_tpu.models import (EventGroupMetaKey,
+                                           PipelineEventGroup, SourceBuffer)
+    out = tmp_path / "carry.jsonl"
+    pqm, mgr, runner, p = _stress_harness(tmp_path, "carry-stress", {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "processors": [{"Type": "processor_split_multiline_log_string_native",
+                        "Multiline": {"StartPattern": r"\d{4} .*"}}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    })
+    stop = threading.Event()
+    sent_lines = []
+    lock = threading.Lock()
+
+    def producer(tid):
+        mine = []
+        n = 0
+        while not stop.is_set():
+            n += 1
+            rid = tid * 1000000 + n
+            l1, l2 = "2024 rec-%d" % rid, "  at frame-a-%d" % rid
+            l3, l4 = "  at frame-b-%d" % rid, "2024 closer-%d" % rid
+            for data, partial, cont in (
+                    (f"{l1}\n{l2}\n".encode(), True, False),
+                    (f"{l3}\n{l4}\n".encode(), False, True)):
+                sb = SourceBuffer(len(data) + 64)
+                g = PipelineEventGroup(sb)
+                g.add_raw_event(1).set_content(sb.copy_string(data))
+                g.set_metadata(EventGroupMetaKey.LOG_FILE_PATH,
+                               f"/stress/{tid}.log")
+                g.set_metadata(EventGroupMetaKey.LOG_FILE_INODE, str(tid))
+                if partial:
+                    g.set_metadata(EventGroupMetaKey.ML_PARTIAL_TAIL, "1")
+                if cont:
+                    g.set_metadata(EventGroupMetaKey.ML_CONTINUE, "1")
+                while not pqm.push_queue(p.process_queue_key, g):
+                    if stop.is_set():
+                        break
+                    time.sleep(0.001)
+                else:
+                    mine.extend([l1, l2, l3, l4][:2] if partial
+                                else [l3, l4])
+            time.sleep(0.001)
+        with lock:
+            sent_lines.extend(mine)
+
+    threads = [threading.Thread(target=producer, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    _drain_and_stop(pqm, runner, mgr)
+    emitted = []
+    for line in out.read_text().splitlines():
+        emitted.extend(json.loads(line).get("content", "").split("\n"))
+    from collections import Counter
+    got, want = Counter(emitted), Counter(sent_lines)
+    missing = want - got
+    extra = got - want
+    assert not missing, f"lost lines: {list(missing)[:5]}"
+    assert not extra, f"duplicated lines: {list(extra)[:5]}"
+
+
+def test_multithreaded_aggregator_buckets(tmp_path):
+    """aggregator_base bucket fills/rotations racing thread 0's timeout
+    tick: object-event groups (the bucketing path) from 3 producers; every
+    event must come out exactly once."""
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    out = tmp_path / "agg.jsonl"
+    pqm, mgr, runner, p = _stress_harness(tmp_path, "agg-stress", {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "processors": [],
+        "aggregators": [{"Type": "aggregator_base", "MaxLogCount": 8,
+                         "TimeoutSecs": 0.1}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    })
+    stop = threading.Event()
+    pushed = [0]
+    lock = threading.Lock()
+
+    def producer(tid):
+        count = 0
+        n = 0
+        while not stop.is_set():
+            n += 1
+            sb = SourceBuffer(512)
+            g = PipelineEventGroup(sb)
+            for j in range(3):
+                ev = g.add_log_event(1)
+                ev.set_content(b"id", sb.copy_string(
+                    b"%d" % (tid * 1000000 + n * 10 + j)))
+            if pqm.push_queue(p.process_queue_key, g):
+                count += 3
+            time.sleep(0.001)
+        with lock:
+            pushed[0] += count
+
+    threads = [threading.Thread(target=producer, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    _drain_and_stop(pqm, runner, mgr)
+    ids = [json.loads(l)["id"] for l in out.read_text().splitlines()]
+    assert len(ids) == pushed[0], (len(ids), pushed[0])
+    assert len(set(ids)) == len(ids), "duplicate events emitted"
