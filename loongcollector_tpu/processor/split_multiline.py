@@ -269,11 +269,19 @@ class ProcessorSplitMultilineLogString(Processor):
         self._emit(group, records, injected, tss)
 
     def _stash(self, key, data: bytes, ts: int, injected) -> None:
-        if len(data) <= CARRY_CAP_BYTES:
-            with self._carry_lock:
-                self._carry[key] = (data, ts, time.monotonic())
-        else:
+        if len(data) > CARRY_CAP_BYTES:
             injected.append((1 << 30, data, ts))  # too big: emit as-is, last
+            return
+        with self._carry_lock:
+            prev = self._carry.pop(key, None)
+            self._carry[key] = (data, ts, time.monotonic())
+        if prev is not None:
+            # With multiple processor threads, chunks of one source can be
+            # processed out of order: a concurrent worker stashed for this
+            # key between our pop and this stash. Overwriting would LOSE
+            # that open record — emit it standalone instead (degraded
+            # stitching, zero loss).
+            injected.append((-3, prev[0], prev[1]))
 
     # -- pipeline drain hooks (idle/shutdown delivery of held records) ------
 

@@ -89,10 +89,10 @@ def test_multithreaded_push_with_hot_swaps(tmp_path):
     assert len(ids) == pushed[0], (len(ids), pushed[0])
 
 
-def _stress_harness(tmp_path, name, cfg, thread_count=4):
+def _stress_harness(name, cfg, thread_count=4):
     """Shared scaffold: manager + runner + one pipeline; returns
-    (pqm, mgr, runner, pipeline, out_path). Callers stop runner FIRST,
-    then mgr (drain order matches the application exit path)."""
+    (pqm, mgr, runner, pipeline). Callers stop runner FIRST, then mgr
+    (drain order matches the application exit path)."""
     pqm = ProcessQueueManager()
     mgr = CollectionPipelineManager(pqm, SenderQueueManager())
     runner = ProcessorRunner(pqm, mgr, thread_count=thread_count)
@@ -114,17 +114,21 @@ def _drain_and_stop(pqm, runner, mgr, settle=1.3):
     mgr.stop_all()
 
 
-def test_multithreaded_carry_under_forced_splits(tmp_path):
+def test_multithreaded_carry_under_forced_splits(tmp_path, monkeypatch):
     """split_multiline's carry dict under 4 processor threads + the timeout
     tick: producers ship ML_PARTIAL_TAIL / ML_CONTINUE chunk pairs (the
     reader's forced-split markers). Threads may legally reorder chunks of a
     pair, so the invariant is LINE conservation: every input line comes out
     exactly once across all emitted records — no loss, no duplication, no
     corruption from the stash/flush races."""
+    import loongcollector_tpu.processor.split_multiline as sm
     from loongcollector_tpu.models import (EventGroupMetaKey,
                                            PipelineEventGroup, SourceBuffer)
+    # shrink the idle-carry flush so thread 0's 1s timeout tick actually
+    # races flush_timeout_groups against the workers during the run
+    monkeypatch.setattr(sm, "CARRY_FLUSH_S", 0.3)
     out = tmp_path / "carry.jsonl"
-    pqm, mgr, runner, p = _stress_harness(tmp_path, "carry-stress", {
+    pqm, mgr, runner, p = _stress_harness("carry-stress", {
         "inputs": [{"Type": "input_static_file_onetime",
                     "FilePaths": ["/nonexistent"]}],
         "processors": [{"Type": "processor_split_multiline_log_string_native",
@@ -195,7 +199,7 @@ def test_multithreaded_aggregator_buckets(tmp_path):
     event must come out exactly once."""
     from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
     out = tmp_path / "agg.jsonl"
-    pqm, mgr, runner, p = _stress_harness(tmp_path, "agg-stress", {
+    pqm, mgr, runner, p = _stress_harness("agg-stress", {
         "inputs": [{"Type": "input_static_file_onetime",
                     "FilePaths": ["/nonexistent"]}],
         "processors": [],
@@ -213,14 +217,17 @@ def test_multithreaded_aggregator_buckets(tmp_path):
         n = 0
         while not stop.is_set():
             n += 1
-            sb = SourceBuffer(512)
+            sb = SourceBuffer(1024)
             g = PipelineEventGroup(sb)
-            for j in range(3):
+            # 10 events in ONE arena: the bucket fills past MaxLogCount=8
+            # within a single add(), exercising the completion branch as
+            # well as arena-change rotation across groups
+            for j in range(10):
                 ev = g.add_log_event(1)
                 ev.set_content(b"id", sb.copy_string(
-                    b"%d" % (tid * 1000000 + n * 10 + j)))
+                    b"%d" % (tid * 1000000 + n * 100 + j)))
             if pqm.push_queue(p.process_queue_key, g):
-                count += 3
+                count += 10
             time.sleep(0.001)
         with lock:
             pushed[0] += count

@@ -279,3 +279,20 @@ class TestCarryDrain:
         p.stop(is_removing=True)
         assert bh.total_events == 1
         p.release()
+
+
+def test_concurrent_stash_never_overwrites():
+    """Round-2 stress review: two stashes for the same key (out-of-order
+    chunk processing across threads) must both survive — the earlier open
+    record is emitted standalone, never overwritten."""
+    ctx = PluginContext("t")
+    ml = ProcessorSplitMultilineLogString()
+    ml.init({"Multiline": {"StartPattern": START}}, ctx)
+    injected = []
+    ml._stash("/s:1", b"2024 first-open", 1, injected)
+    ml._stash("/s:1", b"2024 second-open", 2, injected)
+    # the first record was displaced into the injected output
+    assert [(d, t) for _, d, t in injected] == [(b"2024 first-open", 1)]
+    held = ml.drain_groups()
+    assert len(held) == 1
+    assert _records(held[0]) == [b"2024 second-open"]
