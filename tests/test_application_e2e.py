@@ -31,13 +31,11 @@ def _spawn(config_dir, data_dir):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
 
 
+from conftest import wait_for
+
+
 def _wait_for(predicate, timeout=45.0, interval=0.2):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
+    return wait_for(predicate, timeout=timeout, interval=interval)
 
 
 def _stop(proc, timeout=20.0):

@@ -22,17 +22,30 @@ LITERALS = ["x", "-", ",", ";", ":", "ab", "GET", "=", "q7"]
 QUANTS = ["", "+", "*", "{2}", "{1,3}", "?"]
 
 
+PREFIX_FAMILIES = [["GET", "GETX"], ["WARN", "WARNING"], ["ab", "abab"],
+                   ["x", "xq7"]]
+
+
 def gen_pattern(rng) -> str:
     parts = []
     n = int(rng.integers(1, 7))
-    pivot_budget = 1  # at most one ambiguous pivot per pattern
+    # round 2: TWO ambiguous pivots may appear (the double-pivot compiler
+    # path needs a literal between them — the grammar interleaves literals
+    # naturally, and unsound placements must be REJECTED, never miscompiled)
+    pivot_budget = 2 if rng.integers(4) == 0 else 1
+    pivot_kind = int(rng.integers(3))   # same kind for both: lazy/greedy mix
     for _ in range(n):
-        kind = rng.integers(0, 12)
+        kind = rng.integers(0, 13)
+        if kind == 12:
+            # literal prefix-pair alternation (round-2 longest-first rule);
+            # checked BEFORE the pivot branch so families can precede pivots
+            fam = PREFIX_FAMILIES[int(rng.integers(len(PREFIX_FAMILIES)))]
+            order = list(fam) if rng.integers(2) else list(reversed(fam))
+            parts.append("(" + "|".join(order) + ")")
+            continue
         if kind >= 10 and pivot_budget:
-            # ambiguous pivot material: lazy dot / broad classes that need
-            # the bidirectional split
-            pivot_budget = 0
-            parts.append(["(.*?)", "(.*)", r"(\S*?)"][int(rng.integers(3))])
+            pivot_budget -= 1
+            parts.append(["(.*?)", "(.*)", r"(\S*?)"][pivot_kind])
             continue
         if kind < 3:
             parts.append(re.escape(LITERALS[int(rng.integers(len(LITERALS)))]))
@@ -64,7 +77,8 @@ def gen_pattern(rng) -> str:
 
 def gen_inputs(rng, pattern: str, count: int):
     """Random byte strings + mutations of strings that DO match."""
-    alphabet = b"abcxq7GET09f,;:=- \tXZ"
+    # includes W/A/R/N/I so WARN/WARNING prefix families get matching inputs
+    alphabet = b"abcxq7GET09f,;:=- \tXZWARNI"
     out = []
     for _ in range(count):
         ln = int(rng.integers(0, 24))
@@ -137,3 +151,39 @@ def test_generative_differential(seed):
         run_differential(pattern, lines, rng)
     assert accepted >= 6, f"grammar generated too few compilable patterns " \
                           f"({accepted}/{attempts})"
+
+
+PIVOT_FORMS = ["(.*?)", "(.*)", r"(\S*?)", r"([^,]*)", r"([^;]*?)"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_generative_double_pivot(seed):
+    """Targeted double-pivot generation: prefix + pivot + literal + pivot +
+    suffix assembled from the grammar pieces; every ACCEPTED program must be
+    bit-exact vs re (mismatched pivot kinds usually reject — also fine)."""
+    rng = np.random.default_rng(4000 + seed)
+    accepted = 0
+    attempts = 0
+    while accepted < 8 and attempts < 300:
+        attempts += 1
+        pk = int(rng.integers(len(PIVOT_FORMS)))
+        p1 = PIVOT_FORMS[pk]
+        p2 = (PIVOT_FORMS[pk] if rng.integers(4)
+              else PIVOT_FORMS[int(rng.integers(len(PIVOT_FORMS)))])
+        lit = re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+        pre = (re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+               if rng.integers(2)
+               else CLASSES[int(rng.integers(len(CLASSES)))] + "+")
+        suf = re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+        if rng.integers(2):
+            suf += CLASSES[int(rng.integers(len(CLASSES)))] + "+"
+        pattern = f"{pre}{p1}{lit}{p2}{suf}"
+        try:
+            prog = compile_tier1(pattern)
+        except (Tier1Unsupported, re.error):
+            continue
+        if prog.pivot2 is None:
+            continue
+        accepted += 1
+        run_differential(pattern, gen_inputs(rng, pattern, 100), rng)
+    assert accepted >= 4, f"too few double-pivot programs ({accepted})"

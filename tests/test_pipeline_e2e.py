@@ -20,13 +20,7 @@ from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
 from loongcollector_tpu.runner.processor_runner import ProcessorRunner
 
 
-def wait_for(cond, timeout=10.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
+from conftest import wait_for  # shared sink-side poll helper
 
 
 @pytest.fixture()
