@@ -16,6 +16,7 @@ def register_all(registry) -> None:
     from .http_server import InputHTTPServer, InputOTLP
     from .journal import InputJournal
     from .mqtt import InputMQTT
+    from .redis import InputRedis
     from .snmp import InputSNMP
     from .syslog import InputSyslog
 
@@ -40,4 +41,5 @@ def register_all(registry) -> None:
     registry.register_input("input_otlp", InputOTLP)
     registry.register_input("input_journal", InputJournal)
     registry.register_input("input_mqtt", InputMQTT)
+    registry.register_input("input_redis", InputRedis)
     registry.register_input("input_snmp", InputSNMP)

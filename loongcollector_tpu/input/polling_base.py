@@ -1,0 +1,55 @@
+"""Shared scaffold for interval-polling inputs (SNMP, Redis, …).
+
+Subclasses implement `poll_once()`; the base owns the thread lifecycle and
+the interruptible sleep. A poll failure can never kill the thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..pipeline.plugin.interface import Input
+from ..utils.logger import get_logger
+
+log = get_logger("polling_input")
+
+
+class PollingInput(Input):
+    interval: float = 30.0
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def poll_once(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def start(self) -> bool:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return True
+
+    def _run(self) -> None:
+        while self._running:
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — polling must survive anything
+                log.exception("%s poll round failed", self.name)
+            # 0.1s slices keep stop() responsive; min one slice so a tiny
+            # interval never degenerates into a busy loop
+            for _ in range(max(1, int(self.interval * 10))):
+                if not self._running:
+                    return
+                time.sleep(0.1)
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        return True

@@ -1,0 +1,117 @@
+"""input_redis — Redis INFO metrics polling.
+
+Reference: plugins/input/redis (go-redis INFO collector). Speaks RESP
+directly over a socket: optional AUTH, then `INFO <section>` on an
+interval; numeric fields of the reply become MetricEvents tagged with the
+target address (matching the Go plugin's field mapping).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List
+
+from ..models import MetricValue, PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext
+from ..utils.logger import get_logger
+from .polling_base import PollingInput
+
+log = get_logger("redis")
+
+
+def _read_reply(sock: socket.socket) -> bytes:
+    """One RESP reply (simple string / error / integer / bulk)."""
+    buf = b""
+    while b"\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise OSError("connection closed")
+        buf += chunk
+    head, rest = buf.split(b"\r\n", 1)
+    kind = head[:1]
+    if kind in (b"+", b":"):
+        return head[1:]
+    if kind == b"-":
+        raise OSError(f"redis error: {head[1:].decode(errors='replace')}")
+    if kind == b"$":
+        n = int(head[1:])
+        if n < 0:
+            return b""
+        while len(rest) < n + 2:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise OSError("connection closed mid-bulk")
+            rest += chunk
+        return rest[:n]
+    raise OSError(f"unexpected RESP reply {head[:16]!r}")
+
+
+def _resp_command(*args: bytes) -> bytes:
+    """RESP array framing: argument values are opaque (a password with a
+    space or CRLF must not split into extra arguments or inject commands)."""
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+def redis_info(host: str, port: int, password: str = "",
+               section: str = "", timeout: float = 5.0) -> Dict[str, str]:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        if password:
+            sock.sendall(_resp_command(b"AUTH", password.encode()))
+            _read_reply(sock)
+        args = (b"INFO", section.encode()) if section else (b"INFO",)
+        sock.sendall(_resp_command(*args))
+        raw = _read_reply(sock)
+    finally:
+        sock.close()
+    out: Dict[str, str] = {}
+    for line in raw.splitlines():
+        if not line or line.startswith(b"#"):
+            continue
+        k, sep, v = line.partition(b":")
+        if sep:
+            out[k.decode(errors="replace")] = v.decode(errors="replace")
+    return out
+
+
+class InputRedis(PollingInput):
+    name = "input_redis"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.targets: List[str] = list(config.get("Targets", []))
+        self.password = config.get("Password", "")
+        self.section = config.get("Section", "")
+        self.interval = float(config.get("IntervalSecs", 30.0))
+        return bool(self.targets)
+
+    def poll_once(self) -> None:
+        pqm = self.context.process_queue_manager
+        for target in self.targets:
+            host, _, port = target.rpartition(":")
+            try:
+                info = redis_info(host or target, int(port or 6379),
+                                  self.password, self.section)
+            except (OSError, ValueError) as e:
+                log.warning("redis poll %s failed: %s", target, e)
+                continue
+            if pqm is None or not info:
+                continue
+            group = PipelineEventGroup()
+            now = int(time.time())
+            for key, val in info.items():
+                try:
+                    num = float(val)
+                except ValueError:
+                    continue  # numeric fields only (the Go plugin's choice)
+                ev = group.add_metric_event(now)
+                ev.name = f"redis_{key}".encode()
+                ev.value = MetricValue(num)
+                ev.set_tag(b"target", target.encode())
+            if len(group):
+                group.set_tag(b"__source__", b"redis")
+                pqm.push_queue(self.context.process_queue_key, group)

@@ -11,13 +11,13 @@ LogEvent field for strings.
 from __future__ import annotations
 
 import socket
-import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from ..models import MetricValue, PipelineEventGroup
-from ..pipeline.plugin.interface import Input, PluginContext
+from ..pipeline.plugin.interface import PluginContext
 from ..utils.logger import get_logger
+from .polling_base import PollingInput
 
 log = get_logger("snmp")
 
@@ -150,13 +150,11 @@ def snmp_get(host: str, port: int, community: str, oids: List[str],
 # -- input plugin ------------------------------------------------------------
 
 
-class InputSNMP(Input):
+class InputSNMP(PollingInput):
     name = "input_snmp"
 
     def __init__(self) -> None:
         super().__init__()
-        self._thread: Optional[threading.Thread] = None
-        self._running = False
         self._req_id = 0
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
@@ -166,24 +164,6 @@ class InputSNMP(Input):
         self.community = config.get("Community", "public")
         self.interval = float(config.get("IntervalSecs", 30.0))
         return bool(self.targets) and bool(self.oids)
-
-    def start(self) -> bool:
-        self._running = True
-        self._thread = threading.Thread(target=self._run, name="snmp",
-                                        daemon=True)
-        self._thread.start()
-        return True
-
-    def _run(self) -> None:
-        while self._running:
-            try:
-                self.poll_once()
-            except Exception:  # noqa: BLE001 — polling must survive anything
-                log.exception("snmp poll round failed")
-            for _ in range(int(self.interval * 10)):
-                if not self._running:
-                    return
-                time.sleep(0.1)
 
     def poll_once(self) -> None:
         pqm = self.context.process_queue_manager
@@ -224,10 +204,3 @@ class InputSNMP(Input):
             if len(group):
                 group.set_tag(b"__source__", b"snmp")
                 pqm.push_queue(self.context.process_queue_key, group)
-
-    def stop(self, is_pipeline_removing: bool = False) -> bool:
-        self._running = False
-        if self._thread is not None:
-            self._thread.join(timeout=3)
-            self._thread = None
-        return True

@@ -252,7 +252,9 @@ class PipelineEventGroup:
                 item = {
                     "type": "metric",
                     "timestamp": ev.timestamp,
-                    "name": str(ev.name) if ev.name else "",
+                    "name": (ev.name.decode("utf-8", "replace")
+                             if isinstance(ev.name, bytes)
+                             else str(ev.name)) if ev.name else "",
                     "tags": {k.decode("utf-8", "replace"): str(v) for k, v in ev.tags.items()},
                 }
                 if ev.value.is_multi():

@@ -13,6 +13,7 @@ from typing import Dict, Iterator, List, Tuple
 
 from ...models import (LogEvent, MetricEvent, PipelineEventGroup, RawEvent,
                        SpanEvent)
+from .json_serializer import _name_str
 
 
 def iter_event_dicts(group: PipelineEventGroup
@@ -47,7 +48,7 @@ def iter_event_dicts(group: PipelineEventGroup
                 obj[k.to_str()] = v.to_str()
         elif isinstance(ev, MetricEvent):
             ts = ev.timestamp
-            obj["__name__"] = str(ev.name) if ev.name else ""
+            obj["__name__"] = _name_str(ev.name)
             if ev.value.is_multi():
                 obj["__values__"] = {k.decode(): v
                                      for k, v in ev.value.values.items()}

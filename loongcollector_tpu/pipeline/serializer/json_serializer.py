@@ -15,6 +15,16 @@ from ...models import (EventType, LogEvent, MetricEvent, PipelineEventGroup,
                        RawEvent, SpanEvent)
 
 
+def _name_str(name) -> str:
+    """Metric names arrive as bytes from inputs; str(bytes) would render
+    the b'…' repr into the wire output."""
+    if not name:
+        return ""
+    if isinstance(name, bytes):
+        return name.decode("utf-8", "replace")
+    return str(name)
+
+
 class JsonSerializer:
     name = "json"
 
@@ -35,7 +45,7 @@ class JsonSerializer:
                         obj[k.to_str()] = v.to_str()
                 elif isinstance(ev, MetricEvent):
                     obj["__time__"] = ev.timestamp
-                    obj["__name__"] = str(ev.name) if ev.name else ""
+                    obj["__name__"] = _name_str(ev.name)
                     if ev.value.is_multi():
                         obj["__values__"] = {k.decode(): v for k, v in ev.value.values.items()}
                     else:

@@ -404,3 +404,119 @@ class TestIngestRobustness:
             assert ei.value.code == 400
         finally:
             inp.stop()
+
+
+class FakeRedis(threading.Thread):
+    """Scripted Redis: AUTH + INFO over RESP."""
+
+    INFO = (b"# Server\r\nredis_version:7.2.0\r\nuptime_in_seconds:12345\r\n"
+            b"connected_clients:7\r\nused_memory:1048576\r\n"
+            b"role:master\r\n")
+
+    def __init__(self, password=""):
+        super().__init__(daemon=True)
+        self.password = password
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(2)
+        self.port = self.sock.getsockname()[1]
+        self.authed_cmds = []
+
+    def run(self):
+        try:
+            conn, _ = self.sock.accept()
+        except OSError:
+            return
+        buf = b""
+        authed = not self.password
+        pending = []      # RESP array args being collected
+        want = 0
+        while True:
+            try:
+                chunk = conn.recv(4096)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\r\n" in buf:
+                line, buf = buf.split(b"\r\n", 1)
+                if line.startswith(b"*"):
+                    want = int(line[1:])
+                    pending = []
+                    continue
+                if line.startswith(b"$"):
+                    continue
+                pending.append(line)
+                if len(pending) < want:
+                    continue
+                parts, pending, want = pending, [], 0
+                cmd = parts[0].upper()
+                self.authed_cmds.append(cmd)
+                if cmd == b"AUTH":
+                    if parts[1].decode() == self.password:
+                        authed = True
+                        conn.sendall(b"+OK\r\n")
+                    else:
+                        conn.sendall(b"-ERR invalid password\r\n")
+                elif cmd == b"INFO":
+                    if not authed:
+                        conn.sendall(b"-NOAUTH\r\n")
+                    else:
+                        conn.sendall(b"$%d\r\n%s\r\n"
+                                     % (len(self.INFO), self.INFO))
+                else:
+                    conn.sendall(b"-ERR unknown\r\n")
+
+    def stop(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TestRedisInput:
+    def test_info_metrics(self):
+        srv = FakeRedis()
+        srv.start()
+        inp, pqm = _mk_input("input_redis",
+                             {"Targets": [f"127.0.0.1:{srv.port}"],
+                              "IntervalSecs": 3600})
+        try:
+            inp.poll_once()
+        finally:
+            srv.stop()
+        assert pqm.groups
+        metrics = {bytes(ev.name): float(ev.value.value)
+                   for ev in pqm.groups[0].events}
+        assert metrics[b"redis_uptime_in_seconds"] == 12345.0
+        assert metrics[b"redis_connected_clients"] == 7.0
+        assert b"redis_role" not in metrics        # non-numeric skipped
+        assert b"redis_redis_version" not in metrics
+
+    def test_auth(self):
+        srv = FakeRedis(password="sekret")
+        srv.start()
+        inp, pqm = _mk_input("input_redis",
+                             {"Targets": [f"127.0.0.1:{srv.port}"],
+                              "Password": "sekret", "IntervalSecs": 3600})
+        try:
+            inp.poll_once()
+        finally:
+            srv.stop()
+        assert pqm.groups
+        assert srv.authed_cmds[0] == b"AUTH"
+
+    def test_metric_name_serializes_clean(self):
+        """bytes metric names must not render as b'…' reprs on the wire."""
+        from loongcollector_tpu.models import (MetricValue,
+                                               PipelineEventGroup)
+        from loongcollector_tpu.pipeline.serializer.json_serializer import \
+            JsonSerializer
+        g = PipelineEventGroup()
+        ev = g.add_metric_event(1)
+        ev.name = b"redis_uptime_in_seconds"
+        ev.value = MetricValue(1.0)
+        out = JsonSerializer().serialize([g]).decode()
+        assert '"__name__": "redis_uptime_in_seconds"' in out
+        assert "b'" not in out
