@@ -15,6 +15,7 @@ from typing import Any, Dict, List
 from ..models import MetricValue, PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext
 from ..utils.logger import get_logger
+from ..utils.net import host_port
 from .polling_base import PollingInput
 
 log = get_logger("redis")
@@ -92,10 +93,9 @@ class InputRedis(PollingInput):
     def poll_once(self) -> None:
         pqm = self.context.process_queue_manager
         for target in self.targets:
-            host, _, port = target.rpartition(":")
+            host, port = host_port(target, 6379)
             try:
-                info = redis_info(host or target, int(port or 6379),
-                                  self.password, self.section)
+                info = redis_info(host, port, self.password, self.section)
             except (OSError, ValueError) as e:
                 log.warning("redis poll %s failed: %s", target, e)
                 continue

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Tuple
 from ..models import MetricValue, PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext
 from ..utils.logger import get_logger
+from ..utils.net import host_port
 from .polling_base import PollingInput
 
 log = get_logger("snmp")
@@ -170,11 +171,10 @@ class InputSNMP(PollingInput):
         names = list(self.oids)
         oid_list = [self.oids[n] for n in names]
         for target in self.targets:
-            host, _, port = target.rpartition(":")
+            host, port = host_port(target, 161)
             self._req_id += 1
             try:
-                vals = snmp_get(host or target, int(port or 161),
-                                self.community, oid_list,
+                vals = snmp_get(host, port, self.community, oid_list,
                                 request_id=self._req_id)
             except OSError as e:
                 log.warning("snmp poll %s failed: %s", target, e)

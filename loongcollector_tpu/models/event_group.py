@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.stringview import AnyStr, StringView, as_bytes
-from .events import EventType, LogEvent, MetricEvent, PipelineEvent, RawEvent, SpanEvent
+from .events import (EventType, LogEvent, MetricEvent, PipelineEvent,
+                     RawEvent, SpanEvent, metric_name_str)
 from .source_buffer import SourceBuffer
 
 
@@ -252,9 +253,7 @@ class PipelineEventGroup:
                 item = {
                     "type": "metric",
                     "timestamp": ev.timestamp,
-                    "name": (ev.name.decode("utf-8", "replace")
-                             if isinstance(ev.name, bytes)
-                             else str(ev.name)) if ev.name else "",
+                    "name": metric_name_str(ev.name),
                     "tags": {k.decode("utf-8", "replace"): str(v) for k, v in ev.tags.items()},
                 }
                 if ev.value.is_multi():

@@ -192,3 +192,14 @@ class RawEvent(PipelineEvent):
     def set_content(self, content: AnyStr) -> None:
         self.content = (content if isinstance(content, StringView)
                         else StringView(as_bytes(content)))
+
+
+def metric_name_str(name) -> str:
+    """Metric names arrive as bytes from inputs; str(bytes) would leak the
+    b'…' repr into wire output and JSON exports. Single normalization rule
+    shared by every serializer."""
+    if not name:
+        return ""
+    if isinstance(name, bytes):
+        return name.decode("utf-8", "replace")
+    return str(name)

@@ -13,7 +13,7 @@ from typing import Dict, Iterator, List, Tuple
 
 from ...models import (LogEvent, MetricEvent, PipelineEventGroup, RawEvent,
                        SpanEvent)
-from .json_serializer import _name_str
+from ...models.events import metric_name_str as _name_str
 
 
 def iter_event_dicts(group: PipelineEventGroup

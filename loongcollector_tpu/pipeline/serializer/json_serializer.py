@@ -15,15 +15,7 @@ from ...models import (EventType, LogEvent, MetricEvent, PipelineEventGroup,
                        RawEvent, SpanEvent)
 
 
-def _name_str(name) -> str:
-    """Metric names arrive as bytes from inputs; str(bytes) would render
-    the b'…' repr into the wire output."""
-    if not name:
-        return ""
-    if isinstance(name, bytes):
-        return name.decode("utf-8", "replace")
-    return str(name)
-
+from ...models.events import metric_name_str as _name_str
 
 class JsonSerializer:
     name = "json"

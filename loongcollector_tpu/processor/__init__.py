@@ -8,6 +8,8 @@ CPU fallback (the `_tpu` aliases are also registered).
 
 
 def register_all(registry) -> None:
+    from .field_ops import (ProcessorAddFields, ProcessorDrop,
+                            ProcessorRenameFields, ProcessorStrReplace)
     from .split_log_string import ProcessorSplitLogString
     from .parse_regex import ProcessorParseRegex
     from .parse_json import ProcessorParseJson
@@ -58,3 +60,7 @@ def register_all(registry) -> None:
     registry.register_processor("processor_dynamic", DynamicPythonProcessor)
     registry.register_processor("processor_dynamic_c", DynamicCProcessor)
     registry.register_processor("processor_spl", ProcessorSPL)
+    registry.register_processor("processor_add_fields", ProcessorAddFields)
+    registry.register_processor("processor_rename", ProcessorRenameFields)
+    registry.register_processor("processor_drop", ProcessorDrop)
+    registry.register_processor("processor_strreplace", ProcessorStrReplace)

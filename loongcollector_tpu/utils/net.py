@@ -1,0 +1,18 @@
+"""Small shared network helpers."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def host_port(target: str, default_port: int) -> Tuple[str, int]:
+    """Parse 'host', 'host:port' or '[v6]:port' (bare v6 literals need
+    brackets; an unbracketed one falls back to the default port whole)."""
+    if target.startswith("["):
+        host, _, rest = target[1:].partition("]")
+        port = rest.lstrip(":")
+        return host, int(port) if port.isdigit() else default_port
+    host, sep, port = target.rpartition(":")
+    if sep and port.isdigit() and ":" not in host:
+        return host, int(port)
+    return target, default_port

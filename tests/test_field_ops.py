@@ -1,0 +1,174 @@
+"""Field-op processors (Go long-tail parity: addfields/rename/drop/
+strreplace), both event forms."""
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import (ColumnarLogs, PipelineEventGroup,
+                                       SourceBuffer)
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+from loongcollector_tpu.processor.split_log_string import \
+    ProcessorSplitLogString
+from loongcollector_tpu.processor.parse_regex import ProcessorParseRegex
+
+
+def _proc(name, cfg):
+    reg = PluginRegistry.instance()
+    reg.load_static_plugins()
+    p = reg.create_processor(name)
+    assert p is not None, name
+    assert p.init(cfg, PluginContext("t")), (name, cfg)
+    return p
+
+
+def _obj_group(rows):
+    sb = SourceBuffer(4096)
+    g = PipelineEventGroup(sb)
+    for fields in rows:
+        ev = g.add_log_event(1)
+        for k, v in fields.items():
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(v.encode()))
+    return g
+
+
+def _col_group(lines, regex, keys):
+    data = b"\n".join(lines) + b"\n"
+    sb = SourceBuffer(len(data) + 64)
+    g = PipelineEventGroup(sb)
+    g.add_raw_event(1).set_content(sb.copy_string(data))
+    ctx = PluginContext("t")
+    sp = ProcessorSplitLogString(); sp.init({}, ctx)
+    pr = ProcessorParseRegex(); pr.init({"Regex": regex, "Keys": keys}, ctx)
+    sp.process(g); pr.process(g)
+    return g
+
+
+def _rows(g):
+    if g.columns is not None and not g._events:
+        cols = g.columns
+        raw = g.source_buffer.as_array()
+        out = []
+        for i in range(len(cols)):
+            r = {}
+            for name, (fo, fl) in cols.fields.items():
+                if fl[i] >= 0:
+                    r[name] = raw[int(fo[i]):int(fo[i]) + int(fl[i])] \
+                        .tobytes().decode()
+            out.append(r)
+        return out
+    return [{k.to_str(): v.to_str() for k, v in ev.contents}
+            for ev in g.events]
+
+
+class TestAddFields:
+    def test_object_and_columnar(self):
+        p = _proc("processor_add_fields",
+                  {"Fields": {"env": "prod"}, "IgnoreIfExist": True})
+        g = _obj_group([{"m": "1"}, {"env": "dev"}])
+        p.process(g)
+        rows = _rows(g)
+        assert rows[0]["env"] == "prod"
+        assert rows[1]["env"] == "dev"      # preserved
+        gc = _col_group([b"a 1", b"b 2"], r"(\w+) (\d+)", ["w", "d"])
+        p2 = _proc("processor_add_fields", {"Fields": {"env": "prod"}})
+        p2.process(gc)
+        assert all(r["env"] == "prod" for r in _rows(gc))
+
+
+class TestRename:
+    def test_both_forms(self):
+        p = _proc("processor_rename",
+                  {"SourceKeys": ["old"], "DestKeys": ["new"]})
+        g = _obj_group([{"old": "v"}])
+        p.process(g)
+        assert _rows(g) == [{"new": "v"}]
+        gc = _col_group([b"a 1"], r"(\w+) (\d+)", ["old", "d"])
+        p.process(gc)
+        assert _rows(gc)[0]["new"] == "a"
+
+
+class TestDrop:
+    def test_drop_matching_events(self):
+        p = _proc("processor_drop", {"Match": {"lvl": "DEBUG|TRACE"}})
+        g = _obj_group([{"lvl": "DEBUG", "m": "x"},
+                        {"lvl": "INFO", "m": "y"},
+                        {"lvl": "TRACE", "m": "z"}])
+        p.process(g)
+        assert [r["lvl"] for r in _rows(g)] == ["INFO"]
+
+    def test_drop_columnar_device_match(self):
+        p = _proc("processor_drop", {"Match": {"d": r"[0-4]\d*"}})
+        gc = _col_group([b"a 1", b"b 7", b"c 42"], r"(\w+) (\d+)",
+                        ["w", "d"])
+        p.process(gc)
+        assert [r["w"] for r in _rows(gc)] == ["b"]
+
+
+class TestStrReplace:
+    def test_regex_replace(self):
+        p = _proc("processor_strreplace",
+                  {"SourceKey": "m", "Match": r"\d{3}-\d{4}",
+                   "ReplaceString": "***"})
+        g = _obj_group([{"m": "call 555-1234 now"}])
+        p.process(g)
+        assert _rows(g)[0]["m"] == "call *** now"
+
+    def test_const_replace_columnar(self):
+        p = _proc("processor_strreplace",
+                  {"SourceKey": "w", "Method": "const", "Match": "secret",
+                   "ReplaceString": "xxx"})
+        gc = _col_group([b"secret 1", b"open 2"], r"(\w+) (\d+)",
+                        ["w", "d"])
+        p.process(gc)
+        assert [r["w"] for r in _rows(gc)] == ["xxx", "open"]
+
+
+class TestReviewFixes:
+    def test_dropkeys_list_drops_fields(self):
+        """Go-compat: DropKeys as a LIST removes fields, never events."""
+        p = _proc("processor_drop", {"DropKeys": ["secret"]})
+        g = _obj_group([{"secret": "x", "m": "keep"}])
+        p.process(g)
+        assert _rows(g) == [{"m": "keep"}]
+        gc = _col_group([b"a 1"], r"(\w+) (\d+)", ["secret", "d"])
+        p.process(gc)
+        assert "secret" not in _rows(gc)[0]
+
+    def test_rename_content_pseudo_field_columnar(self):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        data = b"line one\nline two\n"
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+        ctx = PluginContext("t")
+        sp = ProcessorSplitLogString(); sp.init({}, ctx)
+        sp.process(g)
+        p = _proc("processor_rename",
+                  {"SourceKeys": ["content"], "DestKeys": ["message"]})
+        p.process(g)
+        assert [r["message"] for r in _rows(g)] == ["line one", "line two"]
+
+    def test_add_fields_fills_missing_rows_only(self):
+        gc = _col_group([b"a 1", b"nomatch"], r"(\w+) (\d+)", ["w", "env"])
+        p = _proc("processor_add_fields",
+                  {"Fields": {"env": "default"}, "IgnoreIfExist": True})
+        p.process(gc)
+        rows = _rows(gc)
+        assert rows[0]["env"] == "1"          # parsed value preserved
+        assert rows[1].get("env") == "default"  # absent row filled
+
+    def test_strreplace_non_string_match_fails_init_cleanly(self):
+        reg = PluginRegistry.instance()
+        p = reg.create_processor("processor_strreplace")
+        assert p.init({"SourceKey": "m", "Match": 404,
+                       "ReplaceString": "x"}, PluginContext("t")) in (True,)
+        # coerced to the string "404" — no crash, valid pattern
+
+    def test_host_port_parsing(self):
+        from loongcollector_tpu.utils.net import host_port
+        assert host_port("redis-prod", 6379) == ("redis-prod", 6379)
+        assert host_port("h:1234", 6379) == ("h", 1234)
+        assert host_port("[::1]:5", 6379) == ("::1", 5)
+        assert host_port("::1", 6379) == ("::1", 6379)
