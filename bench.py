@@ -87,12 +87,28 @@ def bench_regex(n=32768):
             mbps_pallas = time_kernel(kern_dev, rows_dev, lens_dev, total)
         except Exception as e:  # noqa: BLE001 — Mosaic lowering is new
             print(f"# pallas path failed on device: {e!r}", file=sys.stderr)
-    mbps = max(mbps_xla, mbps_pallas or 0.0)
+    # host tier: the native C++ scalar walker (the degraded-mode data path)
+    mbps_native = None
+    nat = eng._host_walker()
+    if nat is not None:
+        iters = 10
+        nat(arena, offsets, lengths)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            nat(arena, offsets, lengths)
+        mbps_native = total * iters / (time.perf_counter() - t0) / 1e6
+    on_accel = jax.default_backend() != "cpu"
+    if on_accel:
+        mbps = max(mbps_xla, mbps_pallas or 0.0)
+    else:
+        # degraded: the engine actually routes to the native walker — the
+        # honest CPU-vs-CPU comparison against the reference's 68 MB/s
+        mbps = max(mbps_xla, mbps_native or 0.0)
     t1 = time.perf_counter()
     res = eng.parse_batch(arena, offsets, lengths)
     e2e = total / (time.perf_counter() - t1) / 1e6
     ok_frac = float(np.asarray(res.ok).mean())
-    return mbps, e2e, ok_frac, mbps_xla, mbps_pallas
+    return mbps, e2e, ok_frac, mbps_xla, mbps_pallas, mbps_native
 
 
 def bench_grok(n=16384):
@@ -110,6 +126,13 @@ def bench_grok(n=16384):
         t0 = time.perf_counter()
         eng.parse_batch(arena, offsets, lengths)
         return total / (time.perf_counter() - t0) / 1e6
+    if jax.default_backend() == "cpu":
+        # degraded mode: time the engine's actual routed path (native tier)
+        eng.parse_batch(arena, offsets, lengths)          # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            eng.parse_batch(arena, offsets, lengths)
+        return total * 5 / (time.perf_counter() - t0) / 1e6
     rows_dev = jax.device_put(batch.rows)
     lens_dev = jax.device_put(batch.lengths)
     return time_kernel(eng._segment_kernel, rows_dev, lens_dev, total)
@@ -322,7 +345,8 @@ def main():
         degraded = ensure_live_backend()
 
     try:
-        mbps, e2e, ok_frac, mbps_xla, mbps_pallas = bench_regex()
+        (mbps, e2e, ok_frac, mbps_xla, mbps_pallas,
+         mbps_native) = bench_regex()
     except Exception as e:  # noqa: BLE001
         # Last-ditch: even the CPU path failed. Still emit the JSON line.
         print(f"# primary bench failed: {e!r}", file=sys.stderr)
@@ -347,6 +371,8 @@ def main():
     extra["kernel_xla_MBps"] = round(mbps_xla, 1)
     if mbps_pallas is not None:
         extra["kernel_pallas_MBps"] = round(mbps_pallas, 1)
+    if mbps_native is not None:
+        extra["host_native_MBps"] = round(mbps_native, 1)
     lat = _safe(bench_latency, default=None)
     if lat is not None:
         extra["batch_latency_ms_p50"] = round(lat[0], 2)

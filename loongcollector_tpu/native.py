@@ -61,8 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.warning("failed to load native library: %s", e)
             return None
-        if not hasattr(lib, "lct_snappy_decompress"):
-            # stale build from before the codecs: rebuild and reload once
+        if not hasattr(lib, "lct_t1_exec"):
+            # stale build predating the newest entry point: rebuild + reload
             if _try_build():
                 try:
                     lib = ctypes.CDLL(_SO_PATH)

@@ -38,6 +38,26 @@ def _pallas_enabled() -> Optional[bool]:
     return None
 
 
+_host_backend_cached: Optional[bool] = None
+
+
+def _native_host_mode() -> bool:
+    """True when Tier-1 programs should run on the native C++ walker:
+    the backend is CPU (no accelerator — degraded mode or tests), where
+    XLA's emulation of the masked-reduction kernel is ~10× slower than a
+    scalar walk.  LOONG_NATIVE_T1=1 forces it, =0 disables it."""
+    env = os.environ.get("LOONG_NATIVE_T1")
+    if env is not None:
+        return env == "1"
+    if os.environ.get("LOONG_PALLAS") is not None:
+        return False  # explicit device-kernel force wins over host auto
+    global _host_backend_cached
+    if _host_backend_cached is None:
+        import jax
+        _host_backend_cached = jax.default_backend() == "cpu"
+    return _host_backend_cached
+
+
 def _chunks(idx: np.ndarray, size: int):
     for i in range(0, len(idx), size):
         yield idx[i : i + size]
@@ -96,6 +116,8 @@ class RegexEngine:
         self._segment_kernel: Optional[ExtractKernel] = None
         self._pallas_kernel = None          # built lazily on first use
         self._use_pallas: Optional[bool] = None
+        self._native_exec = None            # host C++ walker, built lazily
+        self._native_tried = False
         self._dfa_kernel: Optional[DFAMatchKernel] = None
         self.tier = PatternTier.CPU
         if force_tier in (None, PatternTier.SEGMENT):
@@ -135,6 +157,16 @@ class RegexEngine:
             return self._pallas_kernel
         return self._segment_kernel
 
+    def _host_walker(self):
+        """The native C++ scalar walker for this program (degraded tier);
+        None when the library is absent or the program exceeds its limits."""
+        if not self._native_tried:
+            self._native_tried = True
+            if self._segment_kernel is not None:
+                from .native_exec import try_build
+                self._native_exec = try_build(self._segment_kernel.program)
+        return self._native_exec
+
     def parse_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> BatchParseResult:
         """Full-match + captures for N events over a shared arena."""
@@ -142,6 +174,11 @@ class RegexEngine:
         lengths = np.asarray(lengths, dtype=np.int32)
         n = len(offsets)
         C = max(self.num_caps, 1)
+        if n and self.tier is PatternTier.SEGMENT and _native_host_mode():
+            nat = self._host_walker()
+            if nat is not None:
+                k_ok, k_off, k_len = nat(arena, offsets, lengths)
+                return BatchParseResult(k_ok, k_off, k_len)
         ok = np.zeros(n, dtype=bool)
         cap_off = np.zeros((n, C), dtype=np.int32)
         cap_len = np.full((n, C), -1, dtype=np.int32)

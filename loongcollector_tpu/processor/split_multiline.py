@@ -90,61 +90,72 @@ class ProcessorSplitMultilineLogString(Processor):
         is_cont = (self.cont.match_batch(arena, offs, lens)
                    if self.cont else None)
 
-        blocks: List[Tuple[int, int]] = []
-        unmatched: List[int] = []
+        # blocks as parallel arrays (first[k], last[k]) + sorted unmatched
+        # indices — vectorised in the hot modes (start-only, end-only);
+        # start+end / start+cont have a sequential absorb dependency and
+        # walk Python lists
         if self.start is not None:
             starts_idx = np.nonzero(is_start)[0]
-            if is_end is not None:
-                # start..end blocks: lines after an end and before next start
-                # are unmatched
-                i = 0
-                while i < n:
-                    if is_start[i]:
-                        j = i
-                        while j < n and not is_end[j]:
-                            j += 1
-                        if j < n:
-                            blocks.append((i, j))
-                            i = j + 1
-                        else:
-                            blocks.append((i, n - 1))
-                            i = n
-                    else:
-                        unmatched.append(i)
-                        i += 1
-            elif is_cont is not None:
-                i = 0
-                while i < n:
-                    if is_start[i]:
-                        j = i
-                        while j + 1 < n and is_cont[j + 1]:
-                            j += 1
-                        blocks.append((i, j))
-                        i = j + 1
-                    else:
-                        unmatched.append(i)
-                        i += 1
+            if is_end is not None or is_cont is not None:
+                first, last, unmatched = self._walk_blocks(
+                    n, is_start.tolist(),
+                    is_end.tolist() if is_end is not None else None,
+                    is_cont.tolist() if is_cont is not None else None)
             else:
-                # start-only: vectorised — block k spans starts_idx[k] ..
+                # start-only: block k spans starts_idx[k] ..
                 # (starts_idx[k+1] - 1); leading lines are unmatched
                 if len(starts_idx):
-                    block_first = starts_idx
-                    block_last = np.concatenate([starts_idx[1:] - 1, [n - 1]])
-                    blocks = list(zip(block_first.tolist(),
-                                      block_last.tolist()))
-                    unmatched = list(range(int(starts_idx[0])))
+                    first = starts_idx.astype(np.int64)
+                    last = np.concatenate([starts_idx[1:] - 1, [n - 1]])
+                    unmatched = np.arange(int(starts_idx[0]), dtype=np.int64)
                 else:
-                    unmatched = list(range(n))
+                    first = np.zeros(0, dtype=np.int64)
+                    last = np.zeros(0, dtype=np.int64)
+                    unmatched = np.arange(n, dtype=np.int64)
         else:
             # end-only mode: block closes at each end-match
-            start_i = 0
-            for i in range(n):
-                if is_end[i]:
-                    blocks.append((start_i, i))
-                    start_i = i + 1
-            unmatched.extend(range(start_i, n))
+            ends_idx = np.nonzero(is_end)[0].astype(np.int64)
+            if len(ends_idx):
+                last = ends_idx
+                first = np.concatenate([[0], ends_idx[:-1] + 1])
+                tail_start = int(ends_idx[-1]) + 1
+            else:
+                first = last = np.zeros(0, dtype=np.int64)
+                tail_start = 0
+            unmatched = np.arange(tail_start, n, dtype=np.int64)
 
-        self._finish(group, cols, arena, blocks, unmatched, is_end)
+        self._finish(group, cols, arena, first, last, unmatched, is_end)
+
+    @staticmethod
+    def _walk_blocks(n, s_l, e_l, c_l):
+        """start+end / start+cont block walk (sequential absorb dependency:
+        a start line inside an open block is consumed by it, so this cannot
+        vectorise).  end mode closes at an end-match; cont mode extends
+        while the NEXT line continues."""
+        firsts: List[int] = []
+        lasts: List[int] = []
+        unmatched_l: List[int] = []
+        i = 0
+        while i < n:
+            if s_l[i]:
+                j = i
+                if e_l is not None:
+                    while j < n and not e_l[j]:
+                        j += 1
+                    if j >= n:
+                        j = n - 1
+                else:
+                    while j + 1 < n and c_l[j + 1]:
+                        j += 1
+                firsts.append(i)
+                lasts.append(j)
+                i = j + 1
+            else:
+                unmatched_l.append(i)
+                i += 1
+        return (np.array(firsts, dtype=np.int64),
+                np.array(lasts, dtype=np.int64),
+                np.array(unmatched_l, dtype=np.int64))
 
     # -- carry stitching + emission -----------------------------------------
 
@@ -153,7 +164,8 @@ class ProcessorSplitMultilineLogString(Processor):
         ino = group.get_metadata(EventGroupMetaKey.LOG_FILE_INODE) or ""
         return f"{path}:{ino}"
 
-    def _finish(self, group, cols, arena, blocks, unmatched, is_end) -> None:
+    def _finish(self, group, cols, arena, first, last, unmatched,
+                is_end) -> None:
         n = len(cols)
         offs = cols.offsets.astype(np.int64)
         lens = cols.lengths.astype(np.int64)
@@ -165,10 +177,8 @@ class ProcessorSplitMultilineLogString(Processor):
         with self._carry_lock:
             carried = self._carry.pop(key, None)
 
-        # records: (order, arena_off, arena_len) — order keeps input order;
         # injected: (order, bytes, ts) — carried records copied into the
         # group's arena at emit time (offset-stable across buffer growth)
-        records: List[Tuple[int, int, int]] = []
         injected: List[Tuple[int, bytes, int]] = []
 
         # expire orphaned stashes (source rotated/deleted and never came
@@ -184,9 +194,9 @@ class ProcessorSplitMultilineLogString(Processor):
 
         # leading run of unmatched lines (contiguous from line 0) — the
         # lines a carried open record can continue into
-        lead_end = 0
-        while lead_end < len(unmatched) and unmatched[lead_end] == lead_end:
-            lead_end += 1
+        m = len(unmatched)
+        brk = np.nonzero(unmatched != np.arange(m))[0]
+        lead_end = int(brk[0]) if len(brk) else m
 
         lead_consumed = 0
         if carried is not None:
@@ -197,10 +207,11 @@ class ProcessorSplitMultilineLogString(Processor):
                 if self.end is not None and self.start is None:
                     # end-only mode: continuation lines close at an
                     # end-match and therefore form blocks[0], not unmatched
-                    if blocks and blocks[0][0] == 0:
-                        take = blocks.pop(0)[1] + 1
+                    if len(first) and first[0] == 0:
+                        take = int(last[0]) + 1
+                        first, last = first[1:], last[1:]
                         closed = True
-                    elif not blocks and lead_end == n:
+                    elif not len(first) and lead_end == n:
                         take = n   # no END yet: whole chunk continues
                 else:
                     # start modes: absorb the leading unmatched run, but in
@@ -208,11 +219,10 @@ class ProcessorSplitMultilineLogString(Processor):
                     # after it are ordinary unmatched content
                     take = lead_end
                     if is_end is not None:
-                        for i in range(lead_end):
-                            if is_end[i]:
-                                take = i + 1
-                                closed = True
-                                break
+                        hits = np.nonzero(is_end[:lead_end])[0]
+                        if len(hits):
+                            take = int(hits[0]) + 1
+                            closed = True
             if take > 0:
                 span_lo = int(offs[0])
                 span_hi = int(offs[take - 1] + lens[take - 1])
@@ -221,7 +231,7 @@ class ProcessorSplitMultilineLogString(Processor):
                 merged = cbytes + b"\n" + bytes(
                     arena[span_lo:span_hi].tobytes())
                 lead_consumed = take
-                if ml_partial and not closed and take == n and not blocks:
+                if ml_partial and not closed and take == n and not len(first):
                     # the whole chunk is still the SAME open record —
                     # keep carrying (unless it outgrew the cap)
                     self._stash(key, merged, cts, injected)
@@ -235,38 +245,43 @@ class ProcessorSplitMultilineLogString(Processor):
         # tail record to stash when this chunk breaks mid-record (skip when
         # the whole chunk was already re-stashed as the carried record)
         if ml_partial and lead_consumed < n:
-            if blocks and blocks[-1][1] == n - 1:
-                first, last = blocks.pop()
-                lo = int(offs[first])
-                hi = int(offs[last] + lens[last])
+            if len(last) and last[-1] == n - 1:
+                f_, l_ = int(first[-1]), int(last[-1])
+                first, last = first[:-1], last[:-1]
+                lo = int(offs[f_])
+                hi = int(offs[l_] + lens[l_])
                 self._stash(key, bytes(arena[lo:hi].tobytes()),
-                            int(tss[first]), injected)
+                            int(tss[f_]), injected)
             else:
                 # trailing contiguous unmatched run ending at the last line
                 # continues an open record
-                t = len(unmatched)
-                expect = n - 1
-                while t > 0 and unmatched[t - 1] == expect and \
-                        expect >= lead_consumed:
-                    t -= 1
-                    expect -= 1
-                tail_run = unmatched[t:]
-                if tail_run:
-                    del unmatched[t:]
+                m = len(unmatched)
+                rev_brk = np.nonzero(
+                    unmatched[::-1] != (n - 1 - np.arange(m)))[0]
+                run = int(rev_brk[0]) if len(rev_brk) else m
+                run = min(run, n - lead_consumed)
+                if run > 0:
+                    tail_run = unmatched[m - run:]
+                    unmatched = unmatched[:m - run]
                     lo = int(offs[tail_run[0]])
                     hi = int(offs[tail_run[-1]] + lens[tail_run[-1]])
                     self._stash(key, bytes(arena[lo:hi].tobytes()),
                                 int(tss[tail_run[0]]), injected)
 
-        for first, last in blocks:
-            lo = int(offs[first])
-            records.append((first, lo, int(offs[last] + lens[last]) - lo))
-        if self.unmatched != "discard":
-            for i in unmatched:
-                if i < lead_consumed:
-                    continue
-                records.append((i, int(offs[i]), int(lens[i])))
-        self._emit(group, records, injected, tss)
+        kept = (unmatched[unmatched >= lead_consumed]
+                if self.unmatched != "discard"
+                else np.zeros(0, dtype=np.int64))
+        # records, vectorised: blocks are [offs[first], offs[last]+lens[last])
+        # spans (newlines included — contiguous arena slices), unmatched
+        # lines are their own spans; `order` (the block's first line index)
+        # restores input order
+        rec_order = np.concatenate([first, kept])
+        rec_off = np.concatenate([offs[first], offs[kept]])
+        rec_len = np.concatenate(
+            [offs[last] + lens[last] - offs[first], lens[kept]])
+        rec_ts = (tss[rec_order] if tss is not None
+                  else np.zeros(len(rec_order), dtype=np.int64))
+        self._emit(group, rec_order, rec_off, rec_len, rec_ts, injected)
 
     def _stash(self, key, data: bytes, ts: int, injected) -> None:
         if len(data) > CARRY_CAP_BYTES:
@@ -323,17 +338,21 @@ class ProcessorSplitMultilineLogString(Processor):
             self._carry.clear()
         return [self._carry_group(k, d, t) for k, (d, t, _) in held]
 
-    def _emit(self, group, records, injected, tss=None) -> None:
+    def _emit(self, group, rec_order, rec_off, rec_len, rec_ts,
+              injected) -> None:
         sb = group.source_buffer
-        rows: List[Tuple[int, int, int, int]] = []  # (order, off, len, ts)
-        for order, off, ln in records:
-            rows.append((order, off, ln,
-                         int(tss[order]) if tss is not None else 0))
-        for order, data, ts in injected:
-            view = sb.copy_string(data)
-            rows.append((order, view.offset, len(data), ts))
-        rows.sort(key=lambda r: r[0])
+        if injected:
+            extra = []
+            for order, data, ts in injected:
+                view = sb.copy_string(data)
+                extra.append((order, view.offset, len(data), ts))
+            rec_order = np.concatenate(
+                [rec_order, [r[0] for r in extra]])
+            rec_off = np.concatenate([rec_off, [r[1] for r in extra]])
+            rec_len = np.concatenate([rec_len, [r[2] for r in extra]])
+            rec_ts = np.concatenate([rec_ts, [r[3] for r in extra]])
+        idx = np.argsort(rec_order, kind="stable")
         group.set_columns(ColumnarLogs(
-            offsets=np.array([r[1] for r in rows], dtype=np.int32),
-            lengths=np.array([r[2] for r in rows], dtype=np.int32),
-            timestamps=np.array([r[3] for r in rows], dtype=np.int64)))
+            offsets=rec_off[idx].astype(np.int32),
+            lengths=rec_len[idx].astype(np.int32),
+            timestamps=rec_ts[idx].astype(np.int64)))

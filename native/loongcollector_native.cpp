@@ -668,3 +668,586 @@ int64_t lct_snappy_decompress(const uint8_t* src, int64_t n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Tier-1 segment-program executor (host CPU tier).
+//
+// Executes the SAME compiled SegmentProgram IR the device kernels run
+// (loongcollector_tpu/ops/regex/program.py), scalar per row, mirroring
+// ops/kernels/field_extract.py op-for-op so the two paths are bit-identical
+// (differentially fuzzed in tests/test_native_t1.py).  This is the
+// CPU-degraded tier: when no accelerator is reachable the engine routes
+// parse_batch here instead of the XLA:CPU emulation, matching how the
+// reference's hot parse loop is native C++
+// (core/plugin/processor/ProcessorParseRegexNative.cpp).
+//
+// Serialized program layout (int32 words; see ops/regex/native_exec.py):
+//   [version=1, num_caps,
+//    prefix_nwords, <prefix ops>,
+//    has_pivot, {class_id, min, max(-1=INF), lazy}?,
+//    suffix_nwords, <suffix ops, pre-reversed, literals forward-spelled>,
+//    has_pivot2, {class_id, min, max, lazy}?,
+//    mid_nwords, <mid ops>,
+//    n_split, ids..., n_mid_end, ids...]
+// Ops: 0 LIT lit_idx | 1 SPAN cls min max lazy | 2 FIXED cls n |
+//      3 CAPSTART id | 4 CAPEND id | 5 OPT nwords body |
+//      6 ALT nbranches (nwords body)*
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int kT1MaxCaps = 32;
+
+struct T1State {
+    int32_t cur;
+    bool ok;
+    int32_t cap_off[kT1MaxCaps];
+    int32_t cap_len[kT1MaxCaps];
+    int32_t cap_start[kT1MaxCaps];
+};
+
+struct T1Ctx {
+    const uint8_t* row;
+    int32_t len;
+    const uint8_t* classes;      // [K, 256] membership bytes
+    const uint8_t* lit_blob;
+    const int32_t* lit_offs;
+    const int32_t* lit_lens;
+};
+
+inline bool t1_member(const T1Ctx& c, int32_t cls, uint8_t b) {
+    return c.classes[(int64_t)cls * 256 + b] != 0;
+}
+
+// Forward walk (field_extract.py emit()): on failure sets st.ok=false and
+// returns immediately — later ops only touch state that a failed trial
+// discards, so the shortcut is semantics-preserving.
+void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
+    int64_t i = 0;
+    while (i < nw) {
+        if (!st.ok) return;
+        switch (w[i]) {
+        case 0: {  // LIT
+            int32_t li = w[i + 1];
+            int32_t k = c.lit_lens[li];
+            if (st.cur + k > c.len ||
+                memcmp(c.row + st.cur, c.lit_blob + c.lit_offs[li], k) != 0) {
+                st.ok = false;
+                return;
+            }
+            st.cur += k;
+            i += 2;
+            break;
+        }
+        case 1: {  // SPAN: maximal munch (compiler proved follow-disjoint)
+            int32_t cls = w[i + 1], mn = w[i + 2], mx = w[i + 3];
+            int32_t end = st.cur;
+            while (end < c.len && t1_member(c, cls, c.row[end])) ++end;
+            int32_t run = end - st.cur;
+            if (run < mn || (mx >= 0 && run > mx)) {
+                st.ok = false;
+                return;
+            }
+            st.cur = end;
+            i += 5;
+            break;
+        }
+        case 2: {  // FIXED
+            int32_t cls = w[i + 1], n = w[i + 2];
+            if (st.cur + n > c.len) {
+                st.ok = false;
+                return;
+            }
+            for (int32_t j = 0; j < n; ++j)
+                if (!t1_member(c, cls, c.row[st.cur + j])) {
+                    st.ok = false;
+                    return;
+                }
+            st.cur += n;
+            i += 3;
+            break;
+        }
+        case 3:
+            st.cap_start[w[i + 1]] = st.cur;
+            i += 2;
+            break;
+        case 4: {
+            int32_t id = w[i + 1];
+            st.cap_off[id] = st.cap_start[id];
+            st.cap_len[id] = st.cur - st.cap_start[id];
+            i += 2;
+            break;
+        }
+        case 5: {  // OPT: greedy preference — keep body iff it matched
+            int32_t bw = w[i + 1];
+            if (bw < 0 || i + 2 + bw > nw) {
+                st.ok = false;
+                return;
+            }
+            T1State save = st;
+            t1_emit(c, w + i + 2, bw, st);
+            if (!st.ok) st = save;
+            i += 2 + bw;
+            break;
+        }
+        case 6: {  // ALT: first branch whose whole body matches
+            int32_t nb = w[i + 1];
+            T1State before = st;
+            int64_t j = i + 2;
+            bool chosen = false;
+            for (int32_t b = 0; b < nb; ++b) {
+                if (j >= nw) {
+                    st.ok = false;
+                    return;
+                }
+                int32_t bw = w[j];
+                if (bw < 0 || j + 1 + bw > nw) {
+                    st.ok = false;
+                    return;
+                }
+                if (!chosen) {
+                    T1State trial = before;
+                    t1_emit(c, w + j + 1, bw, trial);
+                    if (trial.ok) {
+                        st = trial;
+                        chosen = true;
+                    }
+                }
+                j += 1 + bw;
+            }
+            i = j;
+            if (!chosen) {
+                st = before;
+                st.ok = false;
+                return;
+            }
+            break;
+        }
+        default:
+            st.ok = false;
+            return;
+        }
+    }
+}
+
+// Reverse walk (field_extract.py emit_reverse()): cur is the EXCLUSIVE end
+// boundary moving toward 0; ops arrive pre-reversed with literals stored in
+// forward spelling; CAPEND records the right edge, CAPSTART closes.
+void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
+                 int32_t floor_) {
+    int64_t i = 0;
+    while (i < nw) {
+        if (!st.ok) return;
+        switch (w[i]) {
+        case 0: {  // LIT ending at cur
+            int32_t li = w[i + 1];
+            int32_t k = c.lit_lens[li];
+            int32_t start = st.cur - k;
+            if (start < 0 ||
+                memcmp(c.row + start, c.lit_blob + c.lit_offs[li], k) != 0) {
+                st.ok = false;
+                return;
+            }
+            st.cur = start;
+            i += 2;
+            break;
+        }
+        case 1: {  // SPAN: maximal run ending at cur, clamped by max/floor
+            int32_t cls = w[i + 1], mn = w[i + 2], mx = w[i + 3];
+            int32_t start = st.cur;
+            while (start > 0 && t1_member(c, cls, c.row[start - 1])) --start;
+            if (mx >= 0 && start < st.cur - mx) start = st.cur - mx;
+            if (start < floor_) start = floor_;
+            if (start < 0) start = 0;
+            if (start > st.cur) start = st.cur;
+            if (st.cur - start < mn) {
+                st.ok = false;
+                return;
+            }
+            st.cur = start;
+            i += 5;
+            break;
+        }
+        case 2: {  // FIXED backward
+            int32_t cls = w[i + 1], n = w[i + 2];
+            int32_t start = st.cur - n;
+            if (start < 0) {
+                st.ok = false;
+                return;
+            }
+            for (int32_t j = start; j < st.cur; ++j)
+                if (!t1_member(c, cls, c.row[j])) {
+                    st.ok = false;
+                    return;
+                }
+            st.cur = start;
+            i += 3;
+            break;
+        }
+        case 3: {  // CAPSTART closes the group (left edge)
+            int32_t id = w[i + 1];
+            st.cap_off[id] = st.cur;
+            st.cap_len[id] = st.cap_start[id] - st.cur;
+            i += 2;
+            break;
+        }
+        case 4:  // CAPEND records the right edge
+            st.cap_start[w[i + 1]] = st.cur;
+            i += 2;
+            break;
+        case 5: {
+            int32_t bw = w[i + 1];
+            if (bw < 0 || i + 2 + bw > nw) {
+                st.ok = false;
+                return;
+            }
+            T1State save = st;
+            t1_emit_rev(c, w + i + 2, bw, st, floor_);
+            if (!st.ok) st = save;
+            i += 2 + bw;
+            break;
+        }
+        case 6: {
+            int32_t nb = w[i + 1];
+            T1State before = st;
+            int64_t j = i + 2;
+            bool chosen = false;
+            for (int32_t b = 0; b < nb; ++b) {
+                if (j >= nw) {
+                    st.ok = false;
+                    return;
+                }
+                int32_t bw = w[j];
+                if (bw < 0 || j + 1 + bw > nw) {
+                    st.ok = false;
+                    return;
+                }
+                if (!chosen) {
+                    T1State trial = before;
+                    t1_emit_rev(c, w + j + 1, bw, trial, floor_);
+                    if (trial.ok) {
+                        st = trial;
+                        chosen = true;
+                    }
+                }
+                j += 1 + bw;
+            }
+            i = j;
+            if (!chosen) {
+                st = before;
+                st.ok = false;
+                return;
+            }
+            break;
+        }
+        default:
+            st.ok = false;
+            return;
+        }
+    }
+}
+
+struct T1Header {
+    int32_t num_caps;
+    const int32_t* prefix;
+    int64_t prefix_n;
+    bool has_pivot;
+    int32_t p1_cls, p1_min, p1_max, p1_lazy;
+    const int32_t* suffix;
+    int64_t suffix_n;
+    bool has_pivot2;
+    int32_t p2_cls, p2_min, p2_max;
+    const int32_t* mid;
+    int64_t mid_n;
+    int32_t mid_fixed;       // length of the boundary literal in mid ops
+    int32_t mid_lit_idx;     // literal index of the boundary literal
+    const int32_t* split_ids;
+    int32_t n_split;
+    const int32_t* mid_end_ids;
+    int32_t n_mid_end;
+};
+
+// Recursive op-stream validation: every class id / literal index in range,
+// tags known, nested body lengths within the section.
+bool t1_validate_ops(const int32_t* w, int64_t nw, int64_t n_classes,
+                     int64_t n_lits, int32_t num_caps) {
+    int64_t i = 0;
+    while (i < nw) {
+        switch (w[i]) {
+        case 0:
+            if (i + 2 > nw || w[i + 1] < 0 || w[i + 1] >= n_lits)
+                return false;
+            i += 2;
+            break;
+        case 1:
+            if (i + 5 > nw || w[i + 1] < 0 || w[i + 1] >= n_classes)
+                return false;
+            i += 5;
+            break;
+        case 2:
+            if (i + 3 > nw || w[i + 1] < 0 || w[i + 1] >= n_classes ||
+                w[i + 2] < 0)
+                return false;
+            i += 3;
+            break;
+        case 3:
+        case 4:
+            if (i + 2 > nw || w[i + 1] < 0 || w[i + 1] >= num_caps)
+                return false;
+            i += 2;
+            break;
+        case 5: {
+            if (i + 2 > nw) return false;
+            int32_t bw = w[i + 1];
+            if (bw < 0 || i + 2 + bw > nw ||
+                !t1_validate_ops(w + i + 2, bw, n_classes, n_lits, num_caps))
+                return false;
+            i += 2 + bw;
+            break;
+        }
+        case 6: {
+            if (i + 2 > nw) return false;
+            int32_t nb = w[i + 1];
+            if (nb < 0) return false;
+            int64_t j = i + 2;
+            for (int32_t b = 0; b < nb; ++b) {
+                if (j >= nw) return false;
+                int32_t bw = w[j];
+                if (bw < 0 || j + 1 + bw > nw ||
+                    !t1_validate_ops(w + j + 1, bw, n_classes, n_lits,
+                                     num_caps))
+                    return false;
+                j += 1 + bw;
+            }
+            i = j;
+            break;
+        }
+        default:
+            return false;
+        }
+    }
+    return true;
+}
+
+bool t1_parse_header(const int32_t* w, int64_t nw, int64_t n_classes,
+                     const int32_t* lit_lens, int64_t n_lits, T1Header& h) {
+    int64_t i = 0;
+    if (nw < 3 || w[i++] != 1) return false;
+    h.num_caps = w[i++];
+    if (h.num_caps < 1 || h.num_caps > kT1MaxCaps) return false;
+    h.prefix_n = w[i++];
+    if (h.prefix_n < 0 || i + h.prefix_n > nw) return false;
+    h.prefix = w + i;
+    i += h.prefix_n;
+    if (i >= nw) return false;
+    h.has_pivot = w[i++] != 0;
+    if (h.has_pivot) {
+        if (i + 4 > nw) return false;
+        h.p1_cls = w[i];
+        h.p1_min = w[i + 1];
+        h.p1_max = w[i + 2];
+        h.p1_lazy = w[i + 3];
+        i += 4;
+    }
+    if (i >= nw) return false;
+    h.suffix_n = w[i++];
+    if (h.suffix_n < 0 || i + h.suffix_n > nw) return false;
+    h.suffix = w + i;
+    i += h.suffix_n;
+    if (i >= nw) return false;
+    h.has_pivot2 = w[i++] != 0;
+    if (h.has_pivot2) {
+        if (i + 4 > nw) return false;
+        h.p2_cls = w[i];
+        h.p2_min = w[i + 1];
+        h.p2_max = w[i + 2];
+        i += 4;
+    }
+    if (i >= nw) return false;
+    h.mid_n = w[i++];
+    if (h.mid_n < 0 || i + h.mid_n > nw) return false;
+    h.mid = w + i;
+    i += h.mid_n;
+    h.mid_fixed = 0;
+    h.mid_lit_idx = -1;
+    for (int64_t j = 0; j < h.mid_n;) {  // locate the boundary literal
+        switch (h.mid[j]) {
+        case 0:
+            h.mid_lit_idx = h.mid[j + 1];
+            if (h.mid_lit_idx < 0 || h.mid_lit_idx >= n_lits) return false;
+            h.mid_fixed = lit_lens[h.mid_lit_idx];
+            j += 2;
+            break;
+        case 3:
+        case 4:
+            j += 2;
+            break;
+        default:
+            return false;  // mid ops are one Lit + cap markers only
+        }
+        if (h.mid_lit_idx >= 0) break;
+    }
+    if (i >= nw) return false;
+    h.n_split = w[i++];
+    if (h.n_split < 0 || i + h.n_split > nw) return false;
+    h.split_ids = w + i;
+    i += h.n_split;
+    if (i >= nw) return false;
+    h.n_mid_end = w[i++];
+    if (h.n_mid_end < 0 || i + h.n_mid_end > nw) return false;
+    h.mid_end_ids = w + i;
+    i += h.n_mid_end;
+    if (h.has_pivot2 && (!h.has_pivot || h.mid_lit_idx < 0)) return false;
+    if (h.has_pivot && (h.p1_cls < 0 || h.p1_cls >= n_classes)) return false;
+    if (h.has_pivot2 && (h.p2_cls < 0 || h.p2_cls >= n_classes)) return false;
+    if (!t1_validate_ops(h.prefix, h.prefix_n, n_classes, n_lits,
+                         h.num_caps) ||
+        !t1_validate_ops(h.suffix, h.suffix_n, n_classes, n_lits,
+                         h.num_caps) ||
+        !t1_validate_ops(h.mid, h.mid_n, n_classes, n_lits, h.num_caps))
+        return false;
+    for (int32_t k = 0; k < h.n_split; ++k)
+        if (h.split_ids[k] < 0 || h.split_ids[k] >= h.num_caps) return false;
+    for (int32_t k = 0; k < h.n_mid_end; ++k)
+        if (h.mid_end_ids[k] < 0 || h.mid_end_ids[k] >= h.num_caps)
+            return false;
+    return i == nw;
+}
+
+inline bool t1_all_member(const T1Ctx& c, int32_t cls, int32_t lo,
+                          int32_t hi) {
+    for (int32_t j = lo; j < hi; ++j)
+        if (!t1_member(c, cls, c.row[j])) return false;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, -1 on malformed program.  cap_off/cap_len are
+// [n, num_caps] row-major; offsets written arena-ABSOLUTE (matched rows'
+// absent captures get off=row_origin, len=-1, matching the device path
+// after origin addition in engine.parse_batch).
+int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
+                    const int64_t* offsets, const int32_t* lengths, int64_t n,
+                    const int32_t* words, int64_t n_words,
+                    const uint8_t* classes, int64_t n_classes,
+                    const uint8_t* lit_blob, const int32_t* lit_offs,
+                    const int32_t* lit_lens, int64_t n_lits, uint8_t* ok_out,
+                    int32_t* cap_off_out, int32_t* cap_len_out) {
+    T1Header h{};
+    if (!t1_parse_header(words, n_words, n_classes, lit_lens, n_lits, h))
+        return -1;
+    const int32_t C = h.num_caps;
+
+    for (int64_t r = 0; r < n; ++r) {
+        int64_t off = offsets[r];
+        int64_t len = lengths[r];
+        if (len < 0) len = 0;
+        bool row_ok = false;
+        T1State final_st;
+        if (off >= 0 && off + len <= arena_len && len <= INT32_MAX) {
+            T1Ctx ctx{arena + off, (int32_t)len, classes, lit_blob, lit_offs,
+                      lit_lens};
+            T1State st;
+            st.cur = 0;
+            st.ok = true;
+            for (int32_t k = 0; k < C; ++k) {
+                st.cap_off[k] = 0;
+                st.cap_len[k] = -1;
+                st.cap_start[k] = 0;
+            }
+            t1_emit(ctx, h.prefix, h.prefix_n, st);
+            if (h.has_pivot2) {
+                if (st.ok) {
+                    T1State rst = st;
+                    rst.cur = ctx.len;
+                    int32_t floor_ =
+                        st.cur + h.p1_min + h.mid_fixed + h.p2_min;
+                    t1_emit_rev(ctx, h.suffix, h.suffix_n, rst, floor_);
+                    if (rst.ok) {
+                        int32_t lo1 = st.cur, hi2 = rst.cur;
+                        int32_t p_lo = lo1 + h.p1_min;
+                        int32_t p_hi = hi2 - h.mid_fixed - h.p2_min;
+                        if (p_lo < 0) p_lo = 0;
+                        int32_t p = -1;
+                        const uint8_t* lit = lit_blob + lit_offs[h.mid_lit_idx];
+                        if (h.p1_lazy) {  // both lazy: first occurrence
+                            for (int32_t q = p_lo; q <= p_hi; ++q)
+                                if (memcmp(ctx.row + q, lit, h.mid_fixed) ==
+                                    0) {
+                                    p = q;
+                                    break;
+                                }
+                        } else {  // both greedy: last occurrence
+                            for (int32_t q = p_hi; q >= p_lo; --q)
+                                if (memcmp(ctx.row + q, lit, h.mid_fixed) ==
+                                    0) {
+                                    p = q;
+                                    break;
+                                }
+                        }
+                        if (p >= 0) {
+                            st.cur = p;
+                            t1_emit(ctx, h.mid, h.mid_n, st);
+                            int32_t lo2 = st.cur;
+                            if (st.ok && hi2 >= lo2 && p - lo1 >= h.p1_min &&
+                                hi2 - lo2 >= h.p2_min &&
+                                t1_all_member(ctx, h.p1_cls, lo1, p) &&
+                                t1_all_member(ctx, h.p2_cls, lo2, hi2)) {
+                                row_ok = true;
+                                final_st = rst;
+                                for (int32_t k = 0; k < h.n_mid_end; ++k) {
+                                    int32_t id = h.mid_end_ids[k];
+                                    final_st.cap_off[id] = st.cap_off[id];
+                                    final_st.cap_len[id] = st.cap_len[id];
+                                }
+                                for (int32_t k = 0; k < h.n_split; ++k) {
+                                    int32_t id = h.split_ids[k];
+                                    final_st.cap_off[id] = st.cap_start[id];
+                                    final_st.cap_len[id] =
+                                        rst.cap_start[id] - st.cap_start[id];
+                                }
+                            }
+                        }
+                    }
+                }
+            } else if (h.has_pivot) {
+                if (st.ok) {
+                    T1State rst = st;
+                    rst.cur = ctx.len;
+                    t1_emit_rev(ctx, h.suffix, h.suffix_n, rst,
+                                st.cur + h.p1_min);
+                    if (rst.ok && rst.cur >= st.cur) {
+                        int32_t run = rst.cur - st.cur;
+                        if (run >= h.p1_min &&
+                            (h.p1_max < 0 || run <= h.p1_max) &&
+                            t1_all_member(ctx, h.p1_cls, st.cur, rst.cur)) {
+                            row_ok = true;
+                            final_st = rst;
+                            for (int32_t k = 0; k < h.n_split; ++k) {
+                                int32_t id = h.split_ids[k];
+                                final_st.cap_off[id] = st.cap_start[id];
+                                final_st.cap_len[id] =
+                                    rst.cap_start[id] - st.cap_start[id];
+                            }
+                        }
+                    }
+                }
+            } else {
+                row_ok = st.ok && st.cur == ctx.len;
+                final_st = st;
+            }
+        }
+        ok_out[r] = row_ok ? 1 : 0;
+        for (int32_t k = 0; k < C; ++k) {
+            cap_off_out[r * C + k] =
+                (int32_t)off + (row_ok ? final_st.cap_off[k] : 0);
+            cap_len_out[r * C + k] = row_ok ? final_st.cap_len[k] : -1;
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

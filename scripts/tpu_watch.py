@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""TPU-tunnel watcher: probe until the axon backend is live, then bench.
+
+The TPU tunnel in this deployment can be down for hours (see
+utils/backend.py). The driver only records BENCH_r{N}.json at round end, so
+a window of TPU availability mid-round would otherwise be wasted. This
+watcher probes in a subprocess (a wedged tunnel HANGS in-process), and on
+the first live probe runs bench.py on the real device, persisting the JSON
+line to TPU_BENCH_LATEST.json so ANY availability window yields a real
+hardware number (VERDICT r2 item #1).
+
+Usage: python scripts/tpu_watch.py [--interval SECS] [--once]
+Exits 0 after one successful TPU bench; exits 3 on --once with no TPU.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = ("import jax, jax.numpy as jnp;"
+         "d = jax.devices()[0];"
+         "jnp.zeros(8).block_until_ready();"
+         "print('PLATFORM:', d.platform)")
+
+
+def probe(timeout=90.0):
+    """Returns the live platform name ('tpu'/'axon'/'cpu'...) or None."""
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, timeout=timeout, text=True)
+    except Exception:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM:"):
+            return line.split(":", 1)[1].strip()
+    return None
+
+
+def run_bench(log):
+    """Run bench.py on the (now live) default backend; persist the line."""
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           capture_output=True, timeout=1800, text=True,
+                           cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"bench TIMED OUT after {time.time()-t0:.0f}s")
+        return False
+    log(f"bench rc={r.returncode} in {time.time()-t0:.0f}s")
+    if r.stderr:
+        log("stderr: " + r.stderr[-3000:])
+    line = None
+    for ln in r.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if r.returncode != 0 or line is None:
+        return False
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError:
+        log("unparseable bench line: " + line[:500])
+        return False
+    if doc.get("extra", {}).get("device_degraded"):
+        log("bench ran but DEGRADED (tunnel died mid-run?)")
+        return False
+    doc["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out = os.path.join(REPO, "TPU_BENCH_LATEST.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    log(f"SUCCESS: wrote {out}: value={doc['value']} {doc['unit']} "
+        f"vs_baseline={doc['vs_baseline']} device={doc['extra'].get('device')}")
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=180.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    logpath = os.path.join(REPO, "scripts", "tpu_watch.log")
+
+    def log(msg):
+        stamp = time.strftime("%H:%M:%S")
+        with open(logpath, "a") as f:
+            f.write(f"[{stamp}] {msg}\n")
+        print(f"[{stamp}] {msg}", flush=True)
+
+    log(f"watcher started (pid {os.getpid()}, interval {args.interval}s)")
+    while True:
+        plat = probe()
+        if plat is None:
+            log("probe: tunnel dead/hung")
+        elif plat == "cpu":
+            log("probe: live but CPU-only (no TPU attached)")
+        else:
+            log(f"probe: LIVE platform={plat} — running bench")
+            if run_bench(log):
+                return 0
+            log("bench failed despite live probe; will retry")
+        if args.once:
+            return 3
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+"""Differential tests for the native C++ Tier-1 walker (degraded tier).
+
+The scalar walker (native/loongcollector_native.cpp lct_t1_exec) must be
+bit-identical to the XLA masked-reduction kernel on every compiled program:
+same ok flags, same capture spans (absolute), same absent-capture encoding.
+Reuses the generative fuzz grammar so every op family (literals, spans,
+fixed spans, optionals, alternations, single and double pivots) is crossed
+against both implementations and `re.fullmatch` ground truth.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.native import get_lib
+from loongcollector_tpu.ops.device_batch import pack_rows, pick_length_bucket
+from loongcollector_tpu.ops.kernels.field_extract import ExtractKernel
+from loongcollector_tpu.ops.regex.native_exec import (NativeUnsupported,
+                                                      try_build)
+from loongcollector_tpu.ops.regex.program import (Tier1Unsupported,
+                                                  compile_tier1)
+from test_fuzz_generative import PIVOT_FORMS, gen_inputs, gen_pattern
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None or not hasattr(get_lib(), "lct_t1_exec"),
+    reason="native library unavailable")
+
+APACHE = (r'(\S+) (\S+) (\S+) \[([^\]]+)\] '
+          r'"(\S+) (\S+) ([^"]*)" (\d{3}) (\d+)')
+
+
+def _layout(lines):
+    lines = [l for l in lines if len(l) > 0] or [b"x"]
+    arena = np.frombuffer(b"".join(lines), dtype=np.uint8)
+    lens = np.array([len(l) for l in lines], dtype=np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+    return lines, arena, offs, lens
+
+
+def assert_native_matches_kernel(pattern: str, lines) -> None:
+    prog = compile_tier1(pattern)
+    nat = try_build(prog)
+    assert nat is not None, f"native build failed for {pattern!r}"
+    lines, arena, offs, lens = _layout(lines)
+    n_ok, n_off, n_len = nat(arena, offs, lens)
+
+    kern = ExtractKernel(prog)
+    L = pick_length_bucket(int(lens.max()))
+    batch = pack_rows(arena, offs, lens, L)
+    k_ok, k_off, k_len = (np.asarray(a) for a in
+                          kern(batch.rows, batch.lengths))
+    k_ok = k_ok[: batch.n_real]
+    # device offsets are row-relative; engine adds origins — replicate
+    k_off = k_off[: batch.n_real] + batch.origins[: batch.n_real, None]
+    k_len = k_len[: batch.n_real]
+
+    np.testing.assert_array_equal(n_ok, k_ok, err_msg=f"ok {pattern!r}")
+    np.testing.assert_array_equal(n_off, k_off, err_msg=f"off {pattern!r}")
+    np.testing.assert_array_equal(n_len, k_len, err_msg=f"len {pattern!r}")
+
+    # and both agree with re ground truth
+    rx = re.compile(pattern.encode())
+    for i, ln in enumerate(lines):
+        m = rx.fullmatch(ln)
+        assert bool(n_ok[i]) == (m is not None), (pattern, ln)
+        if m:
+            o = int(offs[i])
+            for g in range(rx.groups):
+                s, e = m.span(g + 1)
+                if s < 0:
+                    assert n_len[i, g] == -1, (pattern, ln, g)
+                else:
+                    assert (n_off[i, g] - o, n_len[i, g]) == (s, e - s), (
+                        pattern, ln, g)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_native_vs_kernel_generative(seed):
+    rng = np.random.default_rng(7000 + seed)
+    accepted = 0
+    attempts = 0
+    while accepted < 10 and attempts < 200:
+        attempts += 1
+        pattern = gen_pattern(rng)
+        try:
+            compile_tier1(pattern)
+        except (Tier1Unsupported, re.error):
+            continue
+        accepted += 1
+        assert_native_matches_kernel(pattern, gen_inputs(rng, pattern, 80))
+    assert accepted >= 5
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_native_double_pivot(seed):
+    rng = np.random.default_rng(9000 + seed)
+    accepted = 0
+    attempts = 0
+    while accepted < 6 and attempts < 300:
+        attempts += 1
+        from test_fuzz_generative import CLASSES, LITERALS
+        pk = int(rng.integers(len(PIVOT_FORMS)))
+        p1 = PIVOT_FORMS[pk]
+        p2 = (PIVOT_FORMS[pk] if rng.integers(4)
+              else PIVOT_FORMS[int(rng.integers(len(PIVOT_FORMS)))])
+        lit = re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+        pre = (re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+               if rng.integers(2)
+               else CLASSES[int(rng.integers(len(CLASSES)))] + "+")
+        suf = re.escape(LITERALS[int(rng.integers(len(LITERALS)))])
+        if rng.integers(2):
+            suf += CLASSES[int(rng.integers(len(CLASSES)))] + "+"
+        pattern = f"{pre}{p1}{lit}{p2}{suf}"
+        try:
+            prog = compile_tier1(pattern)
+        except (Tier1Unsupported, re.error):
+            continue
+        if prog.pivot2 is None:
+            continue
+        accepted += 1
+        assert_native_matches_kernel(pattern, gen_inputs(rng, pattern, 80))
+    assert accepted >= 3
+
+
+def test_native_apache():
+    lines = [
+        b'1.2.3.4 - frank [10/Oct/2000:13:55:36 -0700] '
+        b'"GET /apache.gif HTTP/1.0" 200 2326',
+        b'bad line',
+        b'',
+        b'9.9.9.9 - - [x] "POST / HTTP/1.1" 404 0',
+    ]
+    assert_native_matches_kernel(APACHE, lines)
+
+
+def test_native_oversize_rows():
+    """Rows longer than the largest device bucket run on the walker with
+    identical semantics (the device path would route them to Python re)."""
+    from loongcollector_tpu.ops.device_batch import LENGTH_BUCKETS
+    big = b"a" * (LENGTH_BUCKETS[-1] + 100)
+    pattern = r"(a+)"
+    prog = compile_tier1(pattern)
+    nat = try_build(prog)
+    lines, arena, offs, lens = _layout([big, b"aaa", b"b"])
+    ok, coff, clen = nat(arena, offs, lens)
+    assert list(ok) == [True, True, False]
+    assert clen[0, 0] == len(big)
+
+
+def test_engine_routes_to_native_on_cpu(monkeypatch):
+    """With a CPU backend the engine's parse_batch must produce the same
+    result through the native walker as through the device kernel."""
+    from loongcollector_tpu.ops.regex.engine import RegexEngine
+    eng = RegexEngine(APACHE)
+    lines, arena, offs, lens = _layout([
+        b'1.2.3.4 - u [t +0] "GET / HTTP/1.1" 200 1', b"nope"])
+    monkeypatch.setenv("LOONG_NATIVE_T1", "1")
+    r1 = eng.parse_batch(arena, offs, lens)
+    monkeypatch.setenv("LOONG_NATIVE_T1", "0")
+    r2 = eng.parse_batch(arena, offs, lens)
+    np.testing.assert_array_equal(np.asarray(r1.ok), np.asarray(r2.ok))
+    np.testing.assert_array_equal(r1.cap_off, r2.cap_off)
+    np.testing.assert_array_equal(r1.cap_len, r2.cap_len)
+
+
+def test_native_caps_overflow_rejected():
+    pattern = "".join(r"(\d)-" for _ in range(33))[:-1]
+    try:
+        prog = compile_tier1(pattern)
+    except Tier1Unsupported:
+        pytest.skip("pattern not Tier-1")
+    assert try_build(prog) is None or prog.num_caps <= 32
+
+
+def test_serializer_roundtrip_shapes():
+    from loongcollector_tpu.ops.regex.native_exec import serialize_program
+    prog = compile_tier1(APACHE)
+    words, bitmaps, blob, loffs, llens, ncaps = serialize_program(prog)
+    assert words.dtype == np.int32 and words[0] == 1
+    assert ncaps == 9
+    assert bitmaps.shape[1] == 256
+    assert len(loffs) == len(llens)
