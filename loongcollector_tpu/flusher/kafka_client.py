@@ -1,14 +1,20 @@
-"""Minimal Kafka wire-protocol producer (no external client library).
+"""Minimal Kafka wire-protocol client (no external client library).
 
-Reference: core/plugin/flusher/kafka/KafkaProducer.cpp uses librdkafka; this
-image has no Kafka client, so the producer speaks the public wire protocol
-directly: Metadata (v1) for leader discovery and Produce (v3) with record
-batches (magic v2, varint-framed records, CRC32C over the batch body).
+Reference: core/plugin/flusher/kafka/KafkaProducer.cpp and
+plugins/input/kafka/input_kafka.go both wrap vendor clients
+(librdkafka / sarama); this image has neither, so both directions speak the
+public wire protocol directly:
 
-Scope: plaintext brokers, acks=all/1, gzip-free (compression handled at the
-payload level by the pipeline when desired), single in-flight request per
-connection.  CRC32C comes from the native library when present, else a
-Python table fallback.
+  producer — Metadata (v1) for leader discovery and Produce (v3) with
+  record batches (magic v2, varint-framed records, CRC32C over the body);
+  consumer — the full group-membership protocol (FindCoordinator /
+  JoinGroup / SyncGroup / Heartbeat with range+roundrobin assignors),
+  OffsetFetch/OffsetCommit, ListOffsets resets, and Fetch (v4) with
+  record-batch decoding.
+
+Scope: plaintext or TLS brokers, SASL PLAIN/SCRAM, acks=all/1, single
+in-flight request per connection.  CRC32C comes from the native library
+when present, else a Python table fallback.
 """
 
 from __future__ import annotations
@@ -28,9 +34,29 @@ from ..utils.logger import get_logger
 log = get_logger("kafka")
 
 API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
 API_METADATA = 3
+API_OFFSET_COMMIT = 8
+API_OFFSET_FETCH = 9
+API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
 API_SASL_HANDSHAKE = 17
 API_SASL_AUTHENTICATE = 36
+
+# error codes the consumer acts on
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
+ERR_NOT_LEADER = 6
+ERR_COORDINATOR_NOT_AVAILABLE = 15
+ERR_NOT_COORDINATOR = 16
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
+ERR_MEMBER_ID_REQUIRED = 79
 
 
 # ---------------------------------------------------------------------------
@@ -205,9 +231,12 @@ def _scram_escape(name: str) -> str:
     return name.replace("=", "=3D").replace(",", "=2C")
 
 
-class KafkaProducer:
-    def __init__(self, brokers: List[str], client_id: str = "loongcollector-tpu",
-                 acks: int = -1, timeout_ms: int = 10000,
+class KafkaClient:
+    """Shared transport: connections, TLS, SASL, correlation ids, metadata."""
+
+    def __init__(self, brokers: List[str],
+                 client_id: str = "loongcollector-tpu",
+                 timeout_ms: int = 10000,
                  tls: Optional[dict] = None, sasl: Optional[dict] = None):
         """tls: {CAFile, CertFile, KeyFile, InsecureSkipVerify} — enables
         TLS when present (reference KafkaProducer.cpp:41 ssl.* settings).
@@ -216,7 +245,6 @@ class KafkaProducer:
         of scope — no KDC in this runtime)."""
         self.brokers = brokers
         self.client_id = client_id
-        self.acks = acks
         self.timeout_ms = timeout_ms
         self.tls = tls
         self.sasl = sasl
@@ -434,6 +462,19 @@ class KafkaProducer:
                 return
         raise last_err or KafkaError("no brokers reachable")
 
+    def close(self) -> None:
+        for addr in list(self._conns):
+            self._drop(addr)
+
+
+class KafkaProducer(KafkaClient):
+    def __init__(self, brokers: List[str],
+                 client_id: str = "loongcollector-tpu",
+                 acks: int = -1, timeout_ms: int = 10000,
+                 tls: Optional[dict] = None, sasl: Optional[dict] = None):
+        super().__init__(brokers, client_id, timeout_ms, tls, sasl)
+        self.acks = acks
+
     # -- produce ------------------------------------------------------------
 
     def _pick_partition(self, topic: str, key: Optional[bytes],
@@ -503,6 +544,525 @@ class KafkaProducer:
                     raise KafkaError(f"produce error code {err}")
         r.i32()                  # throttle_time_ms (v1+ trailer)
 
+
+# ---------------------------------------------------------------------------
+# record batch v2 decoding (consumer side)
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    """Zigzag varint → (value, new_pos)."""
+    shift = 0
+    z = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        z |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (z >> 1) ^ -(z & 1), pos
+
+
+class ConsumerRecord:
+    __slots__ = ("topic", "partition", "offset", "timestamp", "key", "value")
+
+    def __init__(self, topic, partition, offset, timestamp, key, value):
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.timestamp = timestamp
+        self.key = key
+        self.value = value
+
+
+def _snappy_body(body: bytes) -> bytes:
+    """Snappy-compressed records: raw block, or xerial-framed (the Java
+    client's historical framing)."""
+    from .. import native as native_mod
+    if body.startswith(b"\x82SNAPPY\x00"):
+        out = bytearray()
+        pos = 16                        # magic(8) + version(4) + compat(4)
+        while pos + 4 <= len(body):
+            n = struct.unpack_from(">i", body, pos)[0]
+            pos += 4
+            chunk = native_mod.snappy_decompress(body[pos : pos + n])
+            if chunk is None:
+                raise KafkaError("snappy codec unavailable (native lib)")
+            out += chunk
+            pos += n
+        return bytes(out)
+    plain = native_mod.snappy_decompress(body)
+    if plain is None:
+        raise KafkaError("snappy codec unavailable (native lib)")
+    return plain
+
+
+def decode_record_batches(data: bytes, topic: str = "", partition: int = 0
+                          ) -> Tuple[List[ConsumerRecord], Optional[int]]:
+    """Walk concatenated magic-v2 record batches → (records, next_offset).
+
+    next_offset advances past every COMPLETE batch — including control
+    batches (transaction markers, attributes bit 5) and batches whose
+    codec this client cannot decode (warned + skipped) — so the consumer
+    never refetches the same undecodable batch forever.  A truncated
+    final batch (the broker may cut at max_bytes) is silently dropped.
+    """
+    out: List[ConsumerRecord] = []
+    next_offset: Optional[int] = None
+    pos = 0
+    n = len(data)
+    while pos + 12 <= n:
+        base_offset, batch_len = struct.unpack_from(">qi", data, pos)
+        end = pos + 12 + batch_len
+        if batch_len <= 0 or end > n:
+            break                       # truncated tail
+        magic = data[pos + 16]
+        if magic != 2:
+            pos = end                   # legacy message set: skip
+            continue
+        attributes = struct.unpack_from(">h", data, pos + 21)[0]
+        last_delta = struct.unpack_from(">i", data, pos + 23)[0]
+        first_ts = struct.unpack_from(">q", data, pos + 27)[0]
+        count = struct.unpack_from(">i", data, pos + 57)[0]
+        next_offset = base_offset + last_delta + 1
+        if attributes & 0x20:           # control batch: commit/abort marker
+            pos = end
+            continue
+        body = data[pos + 61 : end]
+        codec = attributes & 0x07
+        if codec == 1:                  # gzip
+            import gzip
+            body = gzip.decompress(body)
+        elif codec == 2:                # snappy
+            body = _snappy_body(body)
+        elif codec != 0:                # lz4-frame / zstd: skip, don't wedge
+            log.warning("skipping batch at %s/%d offset %d: unsupported "
+                        "compression codec %d", topic, partition,
+                        base_offset, codec)
+            pos = end
+            continue
+        p = 0
+        for _ in range(count):
+            if p >= len(body):
+                break
+            rec_len, p = _read_varint(body, p)
+            rec_end = p + rec_len
+            p += 1                      # attributes
+            ts_delta, p = _read_varint(body, p)
+            off_delta, p = _read_varint(body, p)
+            klen, p = _read_varint(body, p)
+            key = None
+            if klen >= 0:
+                key = body[p : p + klen]
+                p += klen
+            vlen, p = _read_varint(body, p)
+            value = b""
+            if vlen >= 0:
+                value = body[p : p + vlen]
+                p += vlen
+            out.append(ConsumerRecord(topic, partition,
+                                      base_offset + off_delta,
+                                      first_ts + ts_delta, key, value))
+            p = rec_end
+        pos = end
+    return out, next_offset
+
+
+# ---------------------------------------------------------------------------
+# consumer group protocol
+# ---------------------------------------------------------------------------
+
+
+def _subscription_metadata(topics: List[str]) -> bytes:
+    """ConsumerProtocolSubscription v0."""
+    out = struct.pack(">h", 0) + struct.pack(">i", len(topics))
+    for t in topics:
+        out += _str(t)
+    out += struct.pack(">i", -1)        # user data
+    return out
+
+
+def _encode_assignment(assign: Dict[str, List[int]]) -> bytes:
+    """ConsumerProtocolAssignment v0."""
+    out = struct.pack(">h", 0) + struct.pack(">i", len(assign))
+    for topic in sorted(assign):
+        out += _str(topic) + struct.pack(">i", len(assign[topic]))
+        for p in sorted(assign[topic]):
+            out += struct.pack(">i", p)
+    out += struct.pack(">i", -1)
+    return out
+
+
+def _decode_assignment(data: bytes) -> Dict[str, List[int]]:
+    if not data:
+        return {}
+    r = _Reader(data)
+    r.i16()                             # version
+    out: Dict[str, List[int]] = {}
+    for _ in range(r.i32()):
+        topic = r.string()
+        out[topic] = [r.i32() for _ in range(r.i32())]
+    return out
+
+
+def _decode_subscription(data: bytes) -> List[str]:
+    r = _Reader(data)
+    r.i16()
+    return [r.string() for _ in range(r.i32())]
+
+
+class KafkaConsumer(KafkaClient):
+    """Consumer-group client for input_kafka (reference
+    plugins/input/kafka/input_kafka.go wraps sarama's ConsumerGroup; this
+    speaks the group protocol directly).
+
+    Usage: poll() joins/rejoins the group as needed and returns a batch of
+    ConsumerRecords; commit() writes the consumed positions back.  All
+    calls from ONE thread (the input plugin's service thread)."""
+
+    def __init__(self, brokers: List[str], group_id: str,
+                 topics: List[str], client_id: str = "loongcollector-tpu",
+                 offset_reset: str = "oldest", assignor: str = "range",
+                 session_timeout_ms: int = 10000,
+                 max_bytes: int = 4 << 20,
+                 tls: Optional[dict] = None, sasl: Optional[dict] = None):
+        super().__init__(brokers, client_id, tls=tls, sasl=sasl)
+        self.group_id = group_id
+        self.topics = list(topics)
+        self.offset_reset = offset_reset
+        self.assignor = assignor if assignor in ("range", "roundrobin") \
+            else "range"
+        self.session_timeout_ms = session_timeout_ms
+        self.max_bytes = max_bytes
+        self._coordinator: Optional[str] = None
+        self._member_id = ""
+        self._generation = -1
+        self._assignment: Dict[str, List[int]] = {}
+        self._positions: Dict[Tuple[str, int], int] = {}
+        self._committed: Dict[Tuple[str, int], int] = {}
+        self._last_heartbeat = 0.0
+        self._joined = False
+
+    # -- coordinator / membership -------------------------------------------
+
+    def _find_coordinator(self) -> str:
+        last_err: Optional[Exception] = None
+        for addr in self.brokers:
+            try:
+                resp = self._request(addr, API_FIND_COORDINATOR, 1,
+                                     _str(self.group_id) + b"\x00")
+            except (KafkaError, OSError) as e:
+                last_err = e
+                continue
+            r = _Reader(resp)
+            r.i32()                     # throttle
+            err = r.i16()
+            r.string()                  # error message
+            r.i32()                     # node id
+            host = r.string()
+            port = r.i32()
+            if err == 0:
+                return f"{host}:{port}"
+            last_err = KafkaError(f"FindCoordinator error {err}")
+        raise last_err or KafkaError("no brokers reachable")
+
+    def _join(self) -> None:
+        self._coordinator = self._find_coordinator()
+        meta = _subscription_metadata(self.topics)
+        protocols = (struct.pack(">i", 2)
+                     + _str("range") + _bytes(_subscription_metadata(
+                         self.topics))
+                     + _str("roundrobin") + _bytes(meta)) \
+            if self.assignor == "range" else \
+            (struct.pack(">i", 2)
+             + _str("roundrobin") + _bytes(meta)
+             + _str("range") + _bytes(meta))
+        for attempt in range(3):
+            payload = (_str(self.group_id)
+                       + struct.pack(">i", self.session_timeout_ms)
+                       + struct.pack(">i", self.session_timeout_ms * 3)
+                       + _str(self._member_id)
+                       + _str("consumer")
+                       + protocols)
+            r = _Reader(self._request(self._coordinator, API_JOIN_GROUP, 2,
+                                      payload))
+            r.i32()                     # throttle
+            err = r.i16()
+            generation = r.i32()
+            protocol = r.string()
+            leader = r.string()
+            member_id = r.string()
+            members = []
+            for _ in range(r.i32()):
+                mid = r.string()
+                mlen = r.i32()
+                mdata = r.data[r.pos : r.pos + mlen] if mlen >= 0 else b""
+                r.pos += max(mlen, 0)
+                members.append((mid, mdata))
+            if err == ERR_MEMBER_ID_REQUIRED:
+                self._member_id = member_id
+                continue
+            if err != 0:
+                raise KafkaError(f"JoinGroup error {err}")
+            self._member_id = member_id
+            self._generation = generation
+            break
+        else:
+            raise KafkaError("JoinGroup retries exhausted")
+
+        assignments = b""
+        if member_id == leader:
+            plan = self._assign(protocol or self.assignor, members)
+            assignments = struct.pack(">i", len(plan))
+            for mid, a in plan.items():
+                assignments += _str(mid) + _bytes(_encode_assignment(a))
+        else:
+            assignments = struct.pack(">i", 0)
+        payload = (_str(self.group_id) + struct.pack(">i", self._generation)
+                   + _str(self._member_id) + assignments)
+        r = _Reader(self._request(self._coordinator, API_SYNC_GROUP, 1,
+                                  payload))
+        r.i32()                         # throttle
+        err = r.i16()
+        alen = r.i32()
+        adata = r.data[r.pos : r.pos + alen] if alen >= 0 else b""
+        if err != 0:
+            raise KafkaError(f"SyncGroup error {err}")
+        self._assignment = _decode_assignment(adata)
+        self._positions.clear()
+        self._fetch_committed()
+        self._joined = True
+        self._last_heartbeat = time.monotonic()
+        log.info("kafka consumer joined %s gen=%d assignment=%s",
+                 self.group_id, self._generation, self._assignment)
+
+    def _assign(self, protocol: str, members) -> Dict[str, Dict[str, List[int]]]:
+        """Leader-side partition assignment (range or roundrobin)."""
+        subscribed: Dict[str, List[str]] = {}
+        for mid, mdata in members:
+            try:
+                subscribed[mid] = _decode_subscription(mdata)
+            except Exception:  # noqa: BLE001 — malformed peer metadata
+                subscribed[mid] = list(self.topics)
+        all_topics = sorted({t for ts in subscribed.values() for t in ts})
+        parts: Dict[str, List[int]] = {}
+        for t in all_topics:
+            self.refresh_metadata(t)
+            with self._lock:
+                parts[t] = [p for p, _ in self._topic_meta.get(t, [])]
+        plan: Dict[str, Dict[str, List[int]]] = {
+            mid: {} for mid, _ in members}
+        if protocol == "roundrobin":
+            i = 0
+            mids = sorted(plan)
+            for t in all_topics:
+                for p in parts[t]:
+                    takers = [m for m in mids if t in subscribed[m]]
+                    if not takers:
+                        continue
+                    m = takers[i % len(takers)]
+                    i += 1
+                    plan[m].setdefault(t, []).append(p)
+        else:                           # range, per topic
+            for t in all_topics:
+                takers = sorted(m for m in plan if t in subscribed[m])
+                if not takers:
+                    continue
+                ps = parts[t]
+                per = len(ps) // len(takers)
+                extra = len(ps) % len(takers)
+                idx = 0
+                for k, m in enumerate(takers):
+                    take = per + (1 if k < extra else 0)
+                    if take:
+                        plan[m].setdefault(t, []).extend(
+                            ps[idx : idx + take])
+                        idx += take
+        return plan
+
+    # -- offsets ------------------------------------------------------------
+
+    def _fetch_committed(self) -> None:
+        if not self._assignment:
+            return
+        payload = _str(self.group_id) + struct.pack(
+            ">i", len(self._assignment))
+        for t, ps in self._assignment.items():
+            payload += _str(t) + struct.pack(">i", len(ps))
+            for p in ps:
+                payload += struct.pack(">i", p)
+        r = _Reader(self._request(self._coordinator, API_OFFSET_FETCH, 1,
+                                  payload))
+        need_reset: List[Tuple[str, int]] = []
+        for _ in range(r.i32()):
+            t = r.string()
+            for _ in range(r.i32()):
+                p = r.i32()
+                off = r.i64()
+                r.string()              # metadata
+                err = r.i16()
+                if err == 0 and off >= 0:
+                    self._positions[(t, p)] = off
+                    self._committed[(t, p)] = off
+                else:
+                    need_reset.append((t, p))
+        for t, p in need_reset:
+            self._positions[(t, p)] = self._reset_offset(t, p)
+
+    def _reset_offset(self, topic: str, partition: int) -> int:
+        ts = -2 if self.offset_reset in ("oldest", "earliest", "") else -1
+        leader = self._leader_for(topic, partition)
+        payload = (struct.pack(">i", -1) + struct.pack(">i", 1)
+                   + _str(topic) + struct.pack(">i", 1)
+                   + struct.pack(">i", partition) + struct.pack(">q", ts))
+        r = _Reader(self._request(leader, API_LIST_OFFSETS, 1, payload))
+        for _ in range(r.i32()):        # (throttle_time only appears in v2+)
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()                 # partition
+                err = r.i16()
+                r.i64()                 # timestamp
+                off = r.i64()
+                if err != 0:
+                    raise KafkaError(f"ListOffsets error {err}")
+                return off
+        raise KafkaError("empty ListOffsets response")
+
+    def _leader_for(self, topic: str, partition: int) -> str:
+        with self._lock:
+            parts = dict(self._topic_meta.get(topic, []))
+        if partition not in parts:
+            self.refresh_metadata(topic)
+            with self._lock:
+                parts = dict(self._topic_meta.get(topic, []))
+        leader = parts.get(partition)
+        if leader is None:
+            raise KafkaError(f"no leader for {topic}/{partition}")
+        return leader
+
+    def commit(self) -> None:
+        """OffsetCommit v2 for every consumed position."""
+        dirty = {tp: off for tp, off in self._positions.items()
+                 if self._committed.get(tp) != off}
+        if not dirty or not self._joined:
+            return
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for (t, p), off in dirty.items():
+            by_topic.setdefault(t, []).append((p, off))
+        payload = (_str(self.group_id) + struct.pack(">i", self._generation)
+                   + _str(self._member_id) + struct.pack(">q", -1)
+                   + struct.pack(">i", len(by_topic)))
+        for t, ps in by_topic.items():
+            payload += _str(t) + struct.pack(">i", len(ps))
+            for p, off in ps:
+                payload += struct.pack(">i", p) + struct.pack(">q", off) \
+                    + _str(None)
+        r = _Reader(self._request(self._coordinator, API_OFFSET_COMMIT, 2,
+                                  payload))
+        for _ in range(r.i32()):
+            t = r.string()
+            for _ in range(r.i32()):
+                p = r.i32()
+                err = r.i16()
+                if err == 0:
+                    self._committed[(t, p)] = self._positions[(t, p)]
+                elif err in (ERR_ILLEGAL_GENERATION, ERR_UNKNOWN_MEMBER_ID,
+                             ERR_REBALANCE_IN_PROGRESS):
+                    self._joined = False
+                else:
+                    log.warning("OffsetCommit %s/%d error %d", t, p, err)
+
+    # -- heartbeat / fetch ---------------------------------------------------
+
+    def _maybe_heartbeat(self) -> None:
+        if time.monotonic() - self._last_heartbeat \
+                < self.session_timeout_ms / 3000.0:
+            return
+        payload = (_str(self.group_id) + struct.pack(">i", self._generation)
+                   + _str(self._member_id))
+        r = _Reader(self._request(self._coordinator, API_HEARTBEAT, 1,
+                                  payload))
+        r.i32()
+        err = r.i16()
+        self._last_heartbeat = time.monotonic()
+        if err in (ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION,
+                   ERR_UNKNOWN_MEMBER_ID, ERR_NOT_COORDINATOR,
+                   ERR_COORDINATOR_NOT_AVAILABLE):
+            log.info("heartbeat error %d: rejoining group", err)
+            self._joined = False
+        elif err != 0:
+            raise KafkaError(f"Heartbeat error {err}")
+
+    def poll(self, max_wait_ms: int = 500) -> List[ConsumerRecord]:
+        """Join if needed, heartbeat, then one Fetch round across leaders."""
+        if not self._joined:
+            self._join()
+        self._maybe_heartbeat()
+        by_leader: Dict[str, Dict[str, List[int]]] = {}
+        for t, ps in self._assignment.items():
+            for p in ps:
+                if (t, p) not in self._positions:
+                    self._positions[(t, p)] = self._reset_offset(t, p)
+                by_leader.setdefault(self._leader_for(t, p),
+                                     {}).setdefault(t, []).append(p)
+        records: List[ConsumerRecord] = []
+        for leader, topics in by_leader.items():
+            payload = (struct.pack(">i", -1)
+                       + struct.pack(">i", max_wait_ms)
+                       + struct.pack(">i", 1)
+                       + struct.pack(">i", self.max_bytes)
+                       + b"\x00"
+                       + struct.pack(">i", len(topics)))
+            for t, ps in topics.items():
+                payload += _str(t) + struct.pack(">i", len(ps))
+                for p in ps:
+                    payload += (struct.pack(">i", p)
+                                + struct.pack(">q", self._positions[(t, p)])
+                                + struct.pack(">i", self.max_bytes))
+            r = _Reader(self._request(leader, API_FETCH, 4, payload))
+            r.i32()                     # throttle
+            for _ in range(r.i32()):
+                t = r.string()
+                for _ in range(r.i32()):
+                    p = r.i32()
+                    err = r.i16()
+                    r.i64()             # high watermark
+                    r.i64()             # last stable offset
+                    for _ in range(r.i32()):
+                        r.i64()         # aborted txn producer id
+                        r.i64()         # aborted txn first offset
+                    rlen = r.i32()
+                    rdata = r.data[r.pos : r.pos + rlen] if rlen > 0 else b""
+                    r.pos += max(rlen, 0)
+                    if err == ERR_OFFSET_OUT_OF_RANGE:
+                        self._positions[(t, p)] = self._reset_offset(t, p)
+                        continue
+                    if err == ERR_NOT_LEADER:
+                        with self._lock:
+                            self._topic_meta.pop(t, None)
+                        continue
+                    if err != 0:
+                        log.warning("fetch %s/%d error %d", t, p, err)
+                        continue
+                    recs, next_off = decode_record_batches(rdata, t, p)
+                    for rec in recs:
+                        if rec.offset >= self._positions[(t, p)]:
+                            records.append(rec)
+                    advance = self._positions[(t, p)]
+                    if recs:
+                        advance = max(advance, recs[-1].offset + 1)
+                    if next_off is not None:
+                        advance = max(advance, next_off)
+                    self._positions[(t, p)] = advance
+        return records
+
     def close(self) -> None:
-        for addr in list(self._conns):
-            self._drop(addr)
+        if self._joined and self._coordinator:
+            try:
+                self.commit()
+                payload = _str(self.group_id) + _str(self._member_id)
+                self._request(self._coordinator, API_LEAVE_GROUP, 1, payload)
+            except (KafkaError, OSError):
+                pass
+        super().close()

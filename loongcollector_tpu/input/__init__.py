@@ -15,7 +15,9 @@ def register_all(registry) -> None:
     from .container_stdio import InputContainerStdio
     from .http_server import InputHTTPServer, InputOTLP
     from .journal import InputJournal
+    from .kafka import InputKafka
     from .mqtt import InputMQTT
+    from .mysql_binlog import InputCanal
     from .redis import InputRedis
     from .snmp import InputSNMP
     from .syslog import InputSyslog
@@ -43,3 +45,6 @@ def register_all(registry) -> None:
     registry.register_input("input_mqtt", InputMQTT)
     registry.register_input("input_redis", InputRedis)
     registry.register_input("input_snmp", InputSNMP)
+    registry.register_input("service_kafka", InputKafka)
+    registry.register_input("input_kafka", InputKafka)
+    registry.register_input("service_canal", InputCanal)

@@ -1,0 +1,154 @@
+"""service_kafka — Kafka consumer-group ingest.
+
+Reference: plugins/input/kafka/input_kafka.go (sarama ConsumerGroup wrap);
+here the wire protocol lives in flusher/kafka_client.py (KafkaConsumer —
+JoinGroup/SyncGroup/Heartbeat/Fetch/OffsetCommit) and this plugin runs the
+consume loop on a service thread, emitting one event per record with
+topic/partition/offset (+ optional key) fields, committing consumed
+positions after each pushed batch (at-least-once, like the reference's
+MarkMessage-after-collect).
+
+Config keys mirror the reference: Brokers, Topics, ConsumerGroup, ClientID,
+Offset (oldest|newest), Assignor (range|roundrobin), MaxMessageLen,
+SASLUsername/SASLPassword, plus TLS{...} passthrough.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..flusher.kafka_client import KafkaConsumer, KafkaError
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("input_kafka")
+
+
+class InputKafka(Input):
+    name = "service_kafka"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._consumer: Optional[KafkaConsumer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._max_len = 512 * 1024
+        self._fields_extend = False
+        # test hook: how long the poll loop sleeps after an empty poll
+        self._idle_sleep = 0.2
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self._brokers = config.get("Brokers") or []
+        self._topics = config.get("Topics") or []
+        self._group = config.get("ConsumerGroup") or ""
+        if not self._brokers or not self._topics or not self._group:
+            log.error("service_kafka requires Brokers, Topics and "
+                      "ConsumerGroup")
+            return False
+        self._client_id = config.get("ClientID") or "loongcollector-tpu"
+        self._offset = (config.get("Offset") or "oldest").lower()
+        self._assignor = (config.get("Assignor") or "range").lower()
+        self._max_len = int(config.get("MaxMessageLen") or 512 * 1024)
+        self._fields_extend = bool(config.get("FieldsExtend"))
+        sasl = None
+        if config.get("SASLUsername") and config.get("SASLPassword"):
+            sasl = {"Mechanism": config.get("SASLMechanism", "PLAIN"),
+                    "Username": config["SASLUsername"],
+                    "Password": config["SASLPassword"]}
+        self._sasl = sasl
+        self._tls = config.get("TLS")
+        return True
+
+    def start(self) -> bool:
+        self._consumer = KafkaConsumer(
+            self._brokers, self._group, self._topics,
+            client_id=self._client_id, offset_reset=self._offset,
+            assignor=self._assignor, tls=self._tls, sasl=self._sasl)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kafka-consume")
+        self._thread.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._thread is not None:
+            # the poll thread owns the sockets; wait out its longest
+            # blocking request (10s socket timeout) before touching them
+            self._thread.join(timeout=15)
+            dead = not self._thread.is_alive()
+            self._thread = None
+        else:
+            dead = True
+        if self._consumer is not None and dead:
+            try:
+                self._consumer.close()   # commits + LeaveGroup
+            except Exception:  # noqa: BLE001
+                pass
+            self._consumer = None
+        return True
+
+    # -- consume loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        cons = self._consumer            # stop() may null the attribute
+        backoff = 1.0
+        while self._running:
+            try:
+                records = cons.poll(max_wait_ms=200)
+            except Exception as e:  # noqa: BLE001 — a malformed broker
+                # response (struct.error included) must retry, not kill
+                # the consume thread (reference retries Consume forever)
+                log.warning("kafka consume error: %r (retrying)", e)
+                cons._joined = False
+                deadline = time.monotonic() + min(backoff, 5.0)
+                backoff = min(backoff * 2, 5.0)
+                while self._running and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                continue
+            backoff = 1.0
+            if not records:
+                time.sleep(self._idle_sleep)
+                continue
+            self._push(records, cons)
+            try:
+                cons.commit()
+            except (KafkaError, OSError) as e:
+                log.warning("kafka offset commit failed: %s", e)
+
+    def _push(self, records, cons=None) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        now = int(time.time())
+        for rec in records:
+            value = rec.value[: self._max_len]
+            ev = group.add_log_event(
+                rec.timestamp // 1000 if rec.timestamp > 0 else now)
+            ev.set_content(b"content", sb.copy_string(value))
+            if self._fields_extend:
+                ev.set_content(b"__topic__",
+                               sb.copy_string(rec.topic.encode()))
+                ev.set_content(b"__partition__", sb.copy_string(
+                    str(rec.partition).encode()))
+                ev.set_content(b"__offset__", sb.copy_string(
+                    str(rec.offset).encode()))
+                if rec.key:
+                    ev.set_content(b"__key__", sb.copy_string(rec.key))
+        group.set_tag(b"__source__", b"kafka")
+        pqm = self.context.process_queue_manager
+        if pqm is None:
+            return
+        while self._running and not pqm.push_queue(
+                self.context.process_queue_key, group):
+            # backpressure can outlast the group session timeout — keep
+            # heartbeating so the coordinator doesn't evict us mid-stall
+            if cons is not None:
+                try:
+                    cons._maybe_heartbeat()
+                except Exception:  # noqa: BLE001
+                    pass
+            time.sleep(0.01)

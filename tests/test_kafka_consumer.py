@@ -1,0 +1,442 @@
+"""Kafka consumer-group client + service_kafka input, against a fake broker
+that implements the group protocol (FindCoordinator/JoinGroup/SyncGroup/
+Heartbeat/OffsetFetch/OffsetCommit/ListOffsets/Fetch) over an in-memory
+partition log fed by the real producer — produce → consume → pipeline e2e.
+"""
+
+import struct
+import threading
+import time
+
+from loongcollector_tpu.flusher.kafka_client import (KafkaConsumer,
+                                                     KafkaProducer,
+                                                     decode_record_batches)
+from test_kafka import FakeBroker
+
+
+def _s(x):
+    d = x.encode()
+    return struct.pack(">h", len(d)) + d
+
+
+class _Rd:
+    def __init__(self, data):
+        self.d = data
+        self.p = 0
+
+    def i8(self):
+        v = self.d[self.p]; self.p += 1; return v
+
+    def i16(self):
+        v = struct.unpack_from(">h", self.d, self.p)[0]; self.p += 2; return v
+
+    def i32(self):
+        v = struct.unpack_from(">i", self.d, self.p)[0]; self.p += 4; return v
+
+    def i64(self):
+        v = struct.unpack_from(">q", self.d, self.p)[0]; self.p += 8; return v
+
+    def string(self):
+        n = self.i16()
+        if n < 0:
+            return None
+        v = self.d[self.p:self.p + n].decode(); self.p += n; return v
+
+    def bytes_(self):
+        n = self.i32()
+        if n < 0:
+            return b""
+        v = self.d[self.p:self.p + n]; self.p += n; return v
+
+
+class GroupBroker(FakeBroker):
+    """FakeBroker + consumer-group APIs over an in-memory partition log."""
+
+    def __init__(self, topic="logs", partitions=(0, 1)):
+        super().__init__()
+        self.topic = topic
+        self.partitions = partitions
+        # (topic, par) -> list[(base_offset, batch_bytes, count)]
+        self.logs = {(topic, p): [] for p in partitions}
+        self.next_offset = {(topic, p): 0 for p in partitions}
+        self.committed = {}
+        self.generation = 0
+        self.members = {}            # member_id -> metadata
+        self.assignments = {}        # member_id -> assignment bytes
+        self._member_seq = 0
+        self.rebalance_once = False  # next heartbeat returns 27 once
+        self.lock = threading.Lock()
+
+    # feed the log through the real producer wire format
+    def _produce_response(self, body):
+        resp = super()._produce_response(body)
+        topic, partition, batch = self.produced[-1]
+        count = struct.unpack_from(">i", batch, 57)[0]
+        with self.lock:
+            base = self.next_offset[(topic, partition)]
+            rebased = struct.pack(">q", base) + batch[8:]
+            self.logs[(topic, partition)].append((base, rebased, count))
+            self.next_offset[(topic, partition)] = base + count
+        return resp
+
+    def _dispatch(self, api, ver, body, conn):
+        if api == 10:
+            return (struct.pack(">i", 0) + struct.pack(">h", 0) + _s("")
+                    + struct.pack(">i", 0) + _s("127.0.0.1")
+                    + struct.pack(">i", self.port))
+        if api == 11:
+            return self._join_group(body)
+        if api == 14:
+            return self._sync_group(body)
+        if api == 12:
+            return self._heartbeat(body)
+        if api == 9:
+            return self._offset_fetch(body)
+        if api == 8:
+            return self._offset_commit(body)
+        if api == 2:
+            return self._list_offsets(body)
+        if api == 1:
+            return self._fetch(body)
+        if api == 13:
+            r = _Rd(body)
+            r.string()
+            mid = r.string()
+            with self.lock:
+                self.members.pop(mid, None)
+                self.assignments.pop(mid, None)
+            return struct.pack(">i", 0) + struct.pack(">h", 0)
+        return super()._dispatch(api, ver, body, conn)
+
+    def _join_group(self, body):
+        r = _Rd(body)
+        r.string()                       # group
+        r.i32(); r.i32()                 # timeouts
+        member_id = r.string()
+        r.string()                       # protocol type
+        protos = {}
+        for _ in range(r.i32()):
+            name = r.string()
+            protos[name] = r.bytes_()
+        with self.lock:
+            if not member_id:
+                self._member_seq += 1
+                member_id = f"member-{self._member_seq}"
+            self.members[member_id] = protos.get("range") or \
+                next(iter(protos.values()))
+            self.generation += 1
+            leader = sorted(self.members)[0]
+            out = (struct.pack(">i", 0) + struct.pack(">h", 0)
+                   + struct.pack(">i", self.generation) + _s("range")
+                   + _s(leader) + _s(member_id)
+                   + struct.pack(">i", len(self.members)))
+            for mid in sorted(self.members):
+                out += _s(mid) + struct.pack(
+                    ">i", len(self.members[mid])) + self.members[mid]
+        return out
+
+    def _sync_group(self, body):
+        r = _Rd(body)
+        r.string(); r.i32()
+        member_id = r.string()
+        with self.lock:
+            for _ in range(r.i32()):
+                mid = r.string()
+                self.assignments[mid] = r.bytes_()
+            mine = self.assignments.get(member_id, b"")
+        return (struct.pack(">i", 0) + struct.pack(">h", 0)
+                + struct.pack(">i", len(mine)) + mine)
+
+    def _heartbeat(self, body):
+        err = 0
+        with self.lock:
+            if self.rebalance_once:
+                self.rebalance_once = False
+                err = 27
+        return struct.pack(">i", 0) + struct.pack(">h", err)
+
+    def _offset_fetch(self, body):
+        r = _Rd(body)
+        r.string()
+        ntop = r.i32()
+        out = struct.pack(">i", ntop)
+        for _ in range(ntop):
+            t = r.string()
+            nps = r.i32()
+            out += _s(t) + struct.pack(">i", nps)
+            for _ in range(nps):
+                p = r.i32()
+                off = self.committed.get((t, p), -1)
+                out += (struct.pack(">i", p) + struct.pack(">q", off)
+                        + _s("") + struct.pack(">h", 0))
+        return out
+
+    def _offset_commit(self, body):
+        r = _Rd(body)
+        r.string(); r.i32(); r.string(); r.i64()
+        ntop = r.i32()
+        out = struct.pack(">i", ntop)
+        for _ in range(ntop):
+            t = r.string()
+            nps = r.i32()
+            out += _s(t) + struct.pack(">i", nps)
+            for _ in range(nps):
+                p = r.i32()
+                off = r.i64()
+                r.string()
+                with self.lock:
+                    self.committed[(t, p)] = off
+                out += struct.pack(">i", p) + struct.pack(">h", 0)
+        return out
+
+    def _list_offsets(self, body):
+        r = _Rd(body)
+        r.i32()
+        ntop = r.i32()
+        out = struct.pack(">i", ntop)
+        for _ in range(ntop):
+            t = r.string()
+            nps = r.i32()
+            out += _s(t) + struct.pack(">i", nps)
+            for _ in range(nps):
+                p = r.i32()
+                ts = r.i64()
+                off = 0 if ts == -2 else self.next_offset.get((t, p), 0)
+                out += (struct.pack(">i", p) + struct.pack(">h", 0)
+                        + struct.pack(">q", -1) + struct.pack(">q", off))
+        return out
+
+    def _fetch(self, body):
+        r = _Rd(body)
+        r.i32(); r.i32(); r.i32(); r.i32(); r.i8()
+        ntop = r.i32()
+        out = struct.pack(">i", 0) + struct.pack(">i", ntop)
+        for _ in range(ntop):
+            t = r.string()
+            nps = r.i32()
+            out += _s(t) + struct.pack(">i", nps)
+            for _ in range(nps):
+                p = r.i32()
+                fetch_off = r.i64()
+                r.i32()                  # partition max bytes
+                with self.lock:
+                    batches = [b for base, b, cnt in
+                               self.logs.get((t, p), [])
+                               if base + cnt > fetch_off]
+                    hw = self.next_offset.get((t, p), 0)
+                data = b"".join(batches)
+                out += (struct.pack(">i", p) + struct.pack(">h", 0)
+                        + struct.pack(">q", hw) + struct.pack(">q", hw)
+                        + struct.pack(">i", 0)
+                        + struct.pack(">i", len(data)) + data)
+        return out
+
+
+def _producer(broker):
+    return KafkaProducer([f"127.0.0.1:{broker.port}"])
+
+
+def _consumer(broker, group="g1", **kw):
+    return KafkaConsumer([f"127.0.0.1:{broker.port}"], group, ["logs"], **kw)
+
+
+class TestConsumer:
+    def test_produce_consume_roundtrip(self):
+        broker = GroupBroker()
+        broker.start()
+        try:
+            prod = _producer(broker)
+            prod.send("logs", [(b"k1", b"hello"), (None, b"world"),
+                               (b"k3", b"third")])
+            cons = _consumer(broker)
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 3 and time.monotonic() < deadline:
+                got.extend(cons.poll(max_wait_ms=50))
+            assert sorted(r.value for r in got) == [b"hello", b"third",
+                                                    b"world"]
+            assert {r.topic for r in got} == {"logs"}
+            cons.commit()
+            # committed position equals last offset + 1 per partition
+            for (t, p), off in cons._positions.items():
+                assert broker.committed.get((t, p)) == off
+            cons.close()
+            prod.close()
+        finally:
+            broker.stop()
+
+    def test_resume_from_committed(self):
+        broker = GroupBroker()
+        broker.start()
+        try:
+            prod = _producer(broker)
+            prod.send("logs", [(b"a", b"one"), (b"a", b"two")])
+            c1 = _consumer(broker)
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 2 and time.monotonic() < deadline:
+                got.extend(c1.poll(max_wait_ms=50))
+            c1.commit()
+            c1.close()
+            # new records arrive after the first consumer leaves
+            prod.send("logs", [(b"a", b"three")])
+            c2 = _consumer(broker)
+            got2 = []
+            deadline = time.monotonic() + 5
+            while not got2 and time.monotonic() < deadline:
+                got2.extend(c2.poll(max_wait_ms=50))
+            assert [r.value for r in got2] == [b"three"]
+            c2.close()
+            prod.close()
+        finally:
+            broker.stop()
+
+    def test_rebalance_rejoins(self):
+        broker = GroupBroker()
+        broker.start()
+        try:
+            cons = _consumer(broker, session_timeout_ms=100)
+            cons.poll(max_wait_ms=10)
+            gen1 = cons._generation
+            broker.rebalance_once = True
+            deadline = time.monotonic() + 5
+            while cons._generation == gen1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+                cons.poll(max_wait_ms=10)
+            assert cons._generation > gen1
+            cons.close()
+        finally:
+            broker.stop()
+
+    def test_newest_reset_skips_history(self):
+        broker = GroupBroker()
+        broker.start()
+        try:
+            prod = _producer(broker)
+            prod.send("logs", [(b"a", b"old")])
+            cons = _consumer(broker, group="g-new", offset_reset="newest")
+            assert cons.poll(max_wait_ms=10) == []
+            prod.send("logs", [(b"a", b"new")])
+            got = []
+            deadline = time.monotonic() + 5
+            while not got and time.monotonic() < deadline:
+                got.extend(cons.poll(max_wait_ms=50))
+            assert [r.value for r in got] == [b"new"]
+            cons.close()
+            prod.close()
+        finally:
+            broker.stop()
+
+
+class TestDecodeBatches:
+    def test_roundtrip_with_builder(self):
+        from loongcollector_tpu.flusher.kafka_client import build_record_batch
+        batch = build_record_batch([(b"k", b"v1"), (None, b"v2")])
+        recs, next_off = decode_record_batches(batch, "t", 3)
+        assert [(r.key, r.value, r.offset) for r in recs] == [
+            (b"k", b"v1", 0), (None, b"v2", 1)]
+        assert recs[0].partition == 3
+        assert next_off == 2
+
+    def test_truncated_tail_dropped(self):
+        from loongcollector_tpu.flusher.kafka_client import build_record_batch
+        b1 = build_record_batch([(None, b"full")])
+        b2 = build_record_batch([(None, b"cut")])
+        recs, next_off = decode_record_batches(b1 + b2[: len(b2) // 2])
+        assert [r.value for r in recs] == [b"full"]
+        assert next_off == 1            # only the complete batch counts
+
+    def test_control_batch_skipped_but_advances(self):
+        from loongcollector_tpu.flusher.kafka_client import build_record_batch
+        batch = bytearray(build_record_batch([(None, b"marker")]))
+        # set attributes bit 5 (control); attributes live at offset 21
+        batch[22] |= 0x20
+        recs, next_off = decode_record_batches(bytes(batch))
+        assert recs == [] and next_off == 1
+
+    def test_unsupported_codec_skipped_but_advances(self):
+        from loongcollector_tpu.flusher.kafka_client import build_record_batch
+        batch = bytearray(build_record_batch([(None, b"x")]))
+        batch[22] |= 0x03               # lz4
+        recs, next_off = decode_record_batches(bytes(batch))
+        assert recs == [] and next_off == 1
+
+    def test_snappy_raw_batch(self):
+        from loongcollector_tpu import native as native_mod
+        from loongcollector_tpu.flusher.kafka_client import build_record_batch
+        if native_mod.get_lib() is None:
+            import pytest
+            pytest.skip("native lib unavailable")
+        import struct as st
+        batch = bytearray(build_record_batch([(None, b"snappy-payload")]))
+        body = bytes(batch[61:])
+        comp = native_mod.snappy_compress(body)
+        batch[22] |= 0x02
+        new = bytes(batch[:61]) + comp
+        # rewrite the length field (batch_len at offset 8 covers bytes 12..end)
+        new = new[:8] + st.pack(">i", len(new) - 12) + new[12:]
+        recs, next_off = decode_record_batches(new)
+        assert [r.value for r in recs] == [b"snappy-payload"]
+
+
+class TestInputKafka:
+    def test_service_input_e2e(self):
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+
+        class _PQM:
+            def __init__(self):
+                self.groups = []
+
+            def push_queue(self, key, group):
+                self.groups.append(group)
+                return True
+
+        broker = GroupBroker()
+        broker.start()
+        try:
+            prod = _producer(broker)
+            prod.send("logs", [(b"k", b"event-1"), (None, b"event-2")])
+            reg = PluginRegistry.instance()
+            reg.load_static_plugins()
+            inp = reg.create_input("service_kafka")
+            assert inp is not None
+            ctx = PluginContext("t")
+            ctx.process_queue_key = 1
+            pqm = _PQM()
+            ctx.process_queue_manager = pqm
+            assert inp.init({
+                "Brokers": [f"127.0.0.1:{broker.port}"],
+                "Topics": ["logs"],
+                "ConsumerGroup": "svc",
+                "FieldsExtend": True,
+            }, ctx)
+            inp._idle_sleep = 0.02
+            assert inp.start()
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                if sum(len(g) for g in pqm.groups) >= 2:
+                    break
+                time.sleep(0.05)
+            inp.stop()
+            events = []
+            for g in pqm.groups:
+                for ev in g.events:
+                    events.append({k.to_str(): v.to_bytes()
+                                   for k, v in ev.contents})
+            contents = sorted(e["content"] for e in events)
+            assert contents == [b"event-1", b"event-2"]
+            assert all("__offset__" in e and "__partition__" in e
+                       for e in events)
+            # at-least-once: offsets were committed after the push
+            assert broker.committed
+            prod.close()
+        finally:
+            broker.stop()
+
+    def test_init_requires_group(self):
+        from loongcollector_tpu.input.kafka import InputKafka
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        p = InputKafka()
+        assert not p.init({"Brokers": ["x"], "Topics": ["t"]},
+                          PluginContext("t"))
