@@ -1057,10 +1057,13 @@ class KafkaConsumer(KafkaClient):
                     self._positions[(t, p)] = advance
         return records
 
-    def close(self) -> None:
+    def close(self, commit: bool = True) -> None:
+        """commit=False when the caller could not deliver the last polled
+        batch downstream — committing would drop it (at-least-once)."""
         if self._joined and self._coordinator:
             try:
-                self.commit()
+                if commit:
+                    self.commit()
                 payload = _str(self.group_id) + _str(self._member_id)
                 self._request(self._coordinator, API_LEAVE_GROUP, 1, payload)
             except (KafkaError, OSError):

@@ -39,6 +39,8 @@ class InputKafka(Input):
         self._fields_extend = False
         # test hook: how long the poll loop sleeps after an empty poll
         self._idle_sleep = 0.2
+        # set when the last polled batch could not be delivered downstream
+        self._dirty_tail = False
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -86,7 +88,7 @@ class InputKafka(Input):
             dead = True
         if self._consumer is not None and dead:
             try:
-                self._consumer.close()   # commits + LeaveGroup
+                self._consumer.close(commit=not self._dirty_tail)
             except Exception:  # noqa: BLE001
                 pass
             self._consumer = None
@@ -114,13 +116,20 @@ class InputKafka(Input):
             if not records:
                 time.sleep(self._idle_sleep)
                 continue
-            self._push(records, cons)
+            if not self._push(records, cons):
+                # undelivered (stop during backpressure): committing now —
+                # or at close — would drop the batch
+                self._dirty_tail = True
+                continue
             try:
                 cons.commit()
-            except (KafkaError, OSError) as e:
-                log.warning("kafka offset commit failed: %s", e)
+            except Exception as e:  # noqa: BLE001 — same retry contract
+                # as poll: a truncated commit response must not kill the
+                # thread; positions recommit on the next cycle
+                log.warning("kafka offset commit failed: %r", e)
 
-    def _push(self, records, cons=None) -> None:
+    def _push(self, records, cons=None) -> bool:
+        """Returns True when the group reached the process queue."""
         group = PipelineEventGroup()
         sb = group.source_buffer
         now = int(time.time())
@@ -141,9 +150,10 @@ class InputKafka(Input):
         group.set_tag(b"__source__", b"kafka")
         pqm = self.context.process_queue_manager
         if pqm is None:
-            return
-        while self._running and not pqm.push_queue(
-                self.context.process_queue_key, group):
+            return False
+        while self._running:
+            if pqm.push_queue(self.context.process_queue_key, group):
+                return True
             # backpressure can outlast the group session timeout — keep
             # heartbeating so the coordinator doesn't evict us mid-stall
             if cons is not None:
@@ -152,3 +162,4 @@ class InputKafka(Input):
                 except Exception:  # noqa: BLE001
                     pass
             time.sleep(0.01)
+        return False

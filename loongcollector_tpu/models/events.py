@@ -84,6 +84,10 @@ class LogEvent(PipelineEvent):
                 if i > idx:
                     self._index[k] = i - 1
 
+    def clear_contents(self) -> None:
+        self._contents = []
+        self._index = {}
+
     @property
     def contents(self) -> List[Tuple[StringView, StringView]]:
         return self._contents

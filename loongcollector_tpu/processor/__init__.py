@@ -28,6 +28,11 @@ def register_all(registry) -> None:
     from ..pipeline.plugin.dynamic import (DynamicCProcessor,
                                            DynamicPythonProcessor)
     from .spl import ProcessorSPL
+    from .longtail import (ProcessorBase64Decoding, ProcessorBase64Encoding,
+                           ProcessorDictMap, ProcessorEncrypt,
+                           ProcessorFieldsWithCondition, ProcessorGeoIP,
+                           ProcessorPackJson, ProcessorPickKey,
+                           ProcessorRateLimit)
 
     registry.register_processor("processor_split_log_string_native",
                                 ProcessorSplitLogString)
@@ -64,3 +69,15 @@ def register_all(registry) -> None:
     registry.register_processor("processor_rename", ProcessorRenameFields)
     registry.register_processor("processor_drop", ProcessorDrop)
     registry.register_processor("processor_strreplace", ProcessorStrReplace)
+    registry.register_processor("processor_dict_map", ProcessorDictMap)
+    registry.register_processor("processor_pick_key", ProcessorPickKey)
+    registry.register_processor("processor_packjson", ProcessorPackJson)
+    registry.register_processor("processor_base64_encoding",
+                                ProcessorBase64Encoding)
+    registry.register_processor("processor_base64_decoding",
+                                ProcessorBase64Decoding)
+    registry.register_processor("processor_encrypt", ProcessorEncrypt)
+    registry.register_processor("processor_rate_limit", ProcessorRateLimit)
+    registry.register_processor("processor_fields_with_condition",
+                                ProcessorFieldsWithCondition)
+    registry.register_processor("processor_geoip", ProcessorGeoIP)

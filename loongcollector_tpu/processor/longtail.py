@@ -1,0 +1,633 @@
+"""Go long-tail processors, batch 1 (round-3 VERDICT item 5).
+
+Reference (Go-compat semantics, differentially tested in
+tests/test_longtail_processors.py):
+  plugins/processor/dictmap/processor_dict_map.go       — value mapping
+  plugins/processor/pickkey/processor_pick_key.go       — include/exclude
+  plugins/processor/packjson/processor_packjson.go      — pack into JSON
+  plugins/processor/base64/{encoding,decoding}/         — base64
+  plugins/processor/encrypt/processor_encrypt.go        — AES-CBC + PKCS7
+  plugins/processor/ratelimit/                          — token bucket
+  plugins/processor/fieldswithcondition/                — switch-case
+  plugins/processor/geoip/processor_geoip.go            — MMDB lookup
+
+All operate on object LogEvents (post-parse). Group-level columnar fast
+paths are provided where the operation is a pure per-field transform
+(dictmap, pickkey).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import csv
+import json
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext, Processor
+from ..utils.logger import get_logger
+from .filter import compact_columns
+
+log = get_logger("longtail")
+
+
+def _contents(ev) -> Optional[list]:
+    return ev.contents if hasattr(ev, "contents") else None
+
+
+def _materialize(group: PipelineEventGroup) -> None:
+    """Columnar → object events for processors without a span-level path."""
+    if group.columns is not None and not group._events:
+        group.materialize()
+
+
+class ProcessorDictMap(Processor):
+    """Map a field's value through a dictionary
+    (plugins/processor/dictmap/processor_dict_map.go:30-67, 139-186)."""
+
+    name = "processor_dict_map"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = config.get("SourceKey") or ""
+        if not self.source_key:
+            log.error("dict_map requires SourceKey")
+            return False
+        dest = config.get("DestKey") or ""
+        self.scan_dest = bool(dest) and dest != self.source_key
+        self.dest_key = dest if self.scan_dest else self.source_key
+        self.mode = config.get("Mode", "overwrite")
+        if self.mode not in ("overwrite", "fill"):
+            log.error("dict_map Mode must be overwrite|fill")
+            return False
+        self.handle_missing = bool(config.get("HandleMissing", False))
+        self.missing = str(config.get("Missing", "Unknown"))
+        self.max_dict_size = int(config.get("MaxDictSize", 1000))
+        self.map = {str(k): str(v)
+                    for k, v in (config.get("MapDict") or {}).items()}
+        path = config.get("DictFilePath")
+        if path:
+            try:
+                with open(path, newline="") as f:
+                    for i, row in enumerate(csv.reader(f)):
+                        if len(self.map) > self.max_dict_size:
+                            break
+                        if len(row) != 2:
+                            log.error("dict_map row %d not 2 columns", i + 1)
+                            return False
+                        if row[0] in self.map and self.map[row[0]] != row[1]:
+                            log.error("dict_map duplicate key %r", row[0])
+                            return False
+                        self.map[row[0]] = row[1]
+            except OSError as e:
+                log.error("dict_map cannot read %s: %s", path, e)
+                return False
+        if not self.map:
+            log.error("dict_map requires MapDict or DictFilePath")
+            return False
+        if len(self.map) > self.max_dict_size:
+            log.error("dict_map exceeds MaxDictSize %d", self.max_dict_size)
+            return False
+        self.bmap = {k.encode(): v.encode() for k, v in self.map.items()}
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        skey = self.source_key.encode()
+        dkey = self.dest_key.encode()
+        for ev in group.events:
+            if not hasattr(ev, "get_content"):
+                continue
+            src = ev.get_content(skey)
+            if src is None:
+                # Go: missing source → optionally write Missing to DestKey
+                if self.handle_missing:
+                    self._write_dest(ev, sb, dkey, self.missing.encode())
+                continue
+            mapped = self.bmap.get(src.to_bytes())
+            if mapped is None:
+                continue                 # unmapped value: untouched
+            if not self.scan_dest:
+                ev.set_content(sb.copy_string(skey), sb.copy_string(mapped))
+            else:
+                self._write_dest(ev, sb, dkey, mapped)
+
+    def _write_dest(self, ev, sb, dkey: bytes, value: bytes) -> None:
+        existing = ev.get_content(dkey)
+        if existing is not None and self.mode == "fill":
+            return                       # fill: only when dest is absent
+        ev.set_content(sb.copy_string(dkey), sb.copy_string(value))
+
+
+class ProcessorPickKey(Processor):
+    """Keep Include fields / drop Exclude fields; events left with no
+    fields are dropped (plugins/processor/pickkey/processor_pick_key.go)."""
+
+    name = "processor_pick_key"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.include = {str(k) for k in config.get("Include") or []}
+        self.exclude = {str(k) for k in config.get("Exclude") or []}
+        return bool(self.include or self.exclude)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        cols = group.columns
+        if cols is not None and not group._events:
+            import numpy as np
+            for name in list(cols.fields):
+                if (self.include and name not in self.include) or \
+                        name in self.exclude:
+                    del cols.fields[name]
+            # the raw content column is the `content` field of the object
+            # path — subject to the same include/exclude decision
+            content_live = not cols.content_consumed or not cols.fields
+            drop_content = (self.include and "content" not in self.include) \
+                or "content" in self.exclude
+            if content_live and drop_content:
+                cols.content_consumed = True
+                content_live = False
+            # rows left with NO fields at all are dropped (Go: process()
+            # returns false on empty Contents)
+            if not content_live:
+                keep = np.zeros(len(cols), dtype=bool)
+                for offs, lens in cols.fields.values():
+                    keep |= lens >= 0
+                if not keep.all():
+                    group.set_columns(compact_columns(cols, keep))
+            return
+        _materialize(group)
+        inc = {k.encode() for k in self.include}
+        exc = {k.encode() for k in self.exclude}
+        kept = []
+        for ev in group.events:
+            contents = _contents(ev)
+            if contents is None:
+                kept.append(ev)
+                continue
+            out = [(k, v) for k, v in contents
+                   if (not inc or k.to_bytes() in inc)
+                   and k.to_bytes() not in exc]
+            if len(out) != len(contents):
+                ev.clear_contents()
+                for k, v in out:
+                    ev.set_content(k, v)
+            if out:
+                kept.append(ev)
+        if len(kept) != len(group._events):
+            group._events = kept
+
+
+class ProcessorPackJson(Processor):
+    """Pack SourceKeys into one JSON object field
+    (plugins/processor/packjson/processor_packjson.go)."""
+
+    name = "processor_packjson"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_keys = [str(k) for k in config.get("SourceKeys") or []]
+        self.dest_key = config.get("DestKey") or ""
+        self.keep_source = bool(config.get("KeepSource", True))
+        self.alarm_if_incomplete = bool(config.get("AlarmIfIncomplete",
+                                                   False))
+        return bool(self.source_keys) and bool(self.dest_key)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        keyset = {k.encode() for k in self.source_keys}
+        for ev in group.events:
+            contents = _contents(ev)
+            if contents is None:
+                continue
+            packed: Dict[str, str] = {}
+            remaining = []
+            for k, v in contents:
+                if k.to_bytes() in keyset:
+                    packed[k.to_str()] = v.to_bytes().decode(
+                        "utf-8", "replace")
+                    if self.keep_source:
+                        remaining.append((k, v))
+                else:
+                    remaining.append((k, v))
+            if self.alarm_if_incomplete and len(packed) != len(keyset):
+                missing = [k for k in self.source_keys if k not in packed]
+                log.warning("packjson SourceKeys not found %s", missing)
+            if not self.keep_source and len(remaining) != len(contents):
+                ev.clear_contents()
+                for k, v in remaining:
+                    ev.set_content(k, v)
+            blob = json.dumps(packed, ensure_ascii=False,
+                              separators=(",", ":")).encode()
+            ev.set_content(sb.copy_string(self.dest_key.encode()),
+                           sb.copy_string(blob))
+
+
+class ProcessorBase64Encoding(Processor):
+    """plugins/processor/base64/encoding — encode SourceKey, into NewKey
+    when set else in place."""
+
+    name = "processor_base64_encoding"
+    decode = False
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = (config.get("SourceKey") or "").encode()
+        self.new_key = (config.get("NewKey") or "").encode()
+        return bool(self.source_key)
+
+    def _transform(self, data: bytes) -> Optional[bytes]:
+        return base64.b64encode(data)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        for ev in group.events:
+            if not hasattr(ev, "get_content"):
+                continue
+            src = ev.get_content(self.source_key)
+            if src is None:
+                log.warning("base64: cannot find key %s",
+                            self.source_key.decode())
+                continue
+            out = self._transform(src.to_bytes())
+            if out is None:
+                continue                 # decode error: leave untouched
+            key = self.new_key or self.source_key
+            ev.set_content(sb.copy_string(key), sb.copy_string(out))
+
+
+class ProcessorBase64Decoding(ProcessorBase64Encoding):
+    name = "processor_base64_decoding"
+    decode = True
+
+    def _transform(self, data: bytes) -> Optional[bytes]:
+        try:
+            return base64.b64decode(data, validate=True)
+        except (binascii.Error, ValueError):
+            log.warning("base64 decode error")
+            return None
+
+
+class ProcessorEncrypt(Processor):
+    """AES-CBC + PKCS7, hex-encoded output
+    (plugins/processor/encrypt/processor_encrypt.go: key/IV are hex
+    strings, key may come from a file; errors blank the value unless
+    KeepSourceValueIfError)."""
+
+    name = "processor_encrypt"
+    ERROR_TEXT = b"ENCRYPT_ERROR"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_keys = {str(k).encode()
+                            for k in config.get("SourceKeys") or []}
+        params = config.get("EncryptionParameters") or {}
+        self.keep_on_error = bool(config.get("KeepSourceValueIfError",
+                                             False))
+        key_hex = params.get("Key") or ""
+        key_path = params.get("KeyFilePath") or ""
+        if key_path:
+            try:
+                with open(key_path) as f:
+                    key_hex = f.read().strip()
+            except OSError as e:
+                log.error("encrypt cannot read key file: %s", e)
+                return False
+        iv_hex = params.get("IV") or ""
+        if not self.source_keys or not key_hex or not iv_hex:
+            log.error("encrypt requires SourceKeys, Key (or KeyFilePath) "
+                      "and IV")
+            return False
+        try:
+            self.key = bytes.fromhex(key_hex)
+            self.iv = bytes.fromhex(iv_hex)
+        except ValueError as e:
+            log.error("encrypt key/IV must be hex: %s", e)
+            return False
+        if len(self.key) not in (16, 24, 32) or len(self.iv) != 16:
+            log.error("encrypt key must be 16/24/32 bytes, IV 16")
+            return False
+        if _aes_cbc(self.key, self.iv, b"\x00" * 16) is None:
+            # never let a missing cipher destroy data silently at runtime
+            log.error("encrypt unavailable: native AES not loaded")
+            return False
+        return True
+
+    def _encrypt(self, plaintext: bytes) -> Optional[bytes]:
+        pad = 16 - len(plaintext) % 16
+        padded = plaintext + bytes([pad]) * pad
+        out = _aes_cbc(self.key, self.iv, padded)
+        return out
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        for ev in group.events:
+            contents = _contents(ev)
+            if contents is None:
+                continue
+            for k, v in list(contents):
+                if k.to_bytes() not in self.source_keys:
+                    continue
+                ct = self._encrypt(v.to_bytes())
+                if ct is None:
+                    if not self.keep_on_error:
+                        ev.set_content(k, sb.copy_string(self.ERROR_TEXT))
+                    continue
+                ev.set_content(k, sb.copy_string(ct.hex().encode()))
+
+
+def _aes_cbc(key: bytes, iv: bytes, padded: bytes) -> Optional[bytes]:
+    """Native AES-CBC (pure-Python AES is unreasonably slow; the native
+    library is part of the build — None signals unavailability)."""
+    import ctypes
+
+    import numpy as np
+
+    from .. import native as native_mod
+    lib = native_mod.get_lib()
+    if lib is None or not hasattr(lib, "lct_aes_cbc_encrypt"):
+        return None
+    if not getattr(lib, "_aes_bound", False):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.lct_aes_cbc_encrypt.restype = ctypes.c_int64
+        lib.lct_aes_cbc_encrypt.argtypes = [
+            u8p, ctypes.c_int64, u8p, u8p, ctypes.c_int64, u8p]
+        lib._aes_bound = True
+    k = np.frombuffer(key, np.uint8)
+    i = np.frombuffer(iv, np.uint8)
+    d = np.frombuffer(padded, np.uint8)
+    out = np.empty(len(d), np.uint8)
+    rc = lib.lct_aes_cbc_encrypt(native_mod._u8(k), len(k),
+                                 native_mod._u8(i), native_mod._u8(d),
+                                 len(d), native_mod._u8(out))
+    if rc != 0:
+        return None
+    return out.tobytes()
+
+
+class _TokenBucket:
+    """Per-key token bucket (plugins/processor/ratelimit/token_bucket.go):
+    burst = the limit numerator; refill at limit/period per second."""
+
+    SWEEP_INTERVAL = 60.0
+
+    def __init__(self, burst: float, per_second: float):
+        self.burst = burst
+        self.per_second = per_second
+        self.buckets: Dict[bytes, List[float]] = {}  # key -> [tokens, last]
+        self.lock = threading.Lock()
+        self._next_sweep = time.monotonic() + self.SWEEP_INTERVAL
+
+    def _sweep(self, now: float) -> None:
+        """Evict idle buckets (refilled to full = carrying no state) so
+        high-cardinality keys don't grow memory unboundedly (the
+        reference's token_bucket.go runs the same periodic GC)."""
+        idle_after = max(self.SWEEP_INTERVAL,
+                         self.burst / max(self.per_second, 1e-9))
+        for key in [k for k, (_, last) in self.buckets.items()
+                    if now - last > idle_after]:
+            del self.buckets[key]
+        self._next_sweep = now + self.SWEEP_INTERVAL
+
+    def allow(self, key: bytes) -> bool:
+        now = time.monotonic()
+        with self.lock:
+            if now >= self._next_sweep:
+                self._sweep(now)
+            b = self.buckets.get(key)
+            if b is None:
+                # a fresh bucket starts FULL minus this event's token
+                self.buckets[key] = [self.burst - 1.0, now]
+                return True
+            tokens, last = b
+            tokens = min(self.burst,
+                         tokens + (now - last) * self.per_second)
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                b[1] = now
+                return True
+            b[0] = tokens
+            b[1] = now
+            return False
+
+
+class ProcessorRateLimit(Processor):
+    """Drop events above Limit per unique combination of Fields values
+    (plugins/processor/ratelimit/processor_rate_limit.go)."""
+
+    name = "processor_rate_limit"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.fields = sorted(str(f) for f in config.get("Fields") or [])
+        limit = str(config.get("Limit", "100/s"))
+        m = re.fullmatch(r"(\d+(?:\.\d+)?)/([smh])", limit.strip())
+        if not m:
+            log.error("rate_limit Limit must look like 200/s")
+            return False
+        n = float(m.group(1))
+        unit = {"s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
+        self.bucket = _TokenBucket(n, n / unit)
+        return True
+
+    def _key(self, ev) -> bytes:
+        if not self.fields:
+            return b""
+        parts = []
+        for f in self.fields:
+            v = ev.get_content(f.encode()) if hasattr(ev, "get_content") \
+                else None
+            parts.append(v.to_bytes() if v is not None else b"")
+        return b"\x1f".join(parts)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        kept = [ev for ev in group.events if self.bucket.allow(self._key(ev))]
+        if len(kept) != len(group._events):
+            group._events = kept
+
+
+class ProcessorFieldsWithCondition(Processor):
+    """Switch-case conditional field edit (plugins/processor/
+    fieldswithcondition/processor_fields_with_condition.go): first
+    matching case applies its actions; optionally drop non-matching."""
+
+    name = "processor_fields_with_condition"
+
+    _OPS = {
+        "equals": lambda cond, val: val == cond,
+        "contains": lambda cond, val: cond in val,
+        "startwith": lambda cond, val: val.startswith(cond),
+    }
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.drop_if_not_match = bool(
+            config.get("DropIfNotMatchCondition", False))
+        self.cases = []
+        for cond in config.get("Switch") or []:
+            case = cond.get("Case") or {}
+            op = (case.get("RelationOperator") or "equals").lower()
+            logic = (case.get("LogicalOperator") or "and").lower()
+            if op not in ("equals", "regexp", "contains", "startwith"):
+                op = "equals"
+            fields = {}
+            for k, v in (case.get("FieldConditions") or {}).items():
+                fields[str(k).encode()] = (
+                    re.compile(str(v).encode()) if op == "regexp"
+                    else str(v).encode())
+            actions = []
+            for act in cond.get("Actions") or []:
+                atype = act.get("type") or act.get("Type") or ""
+                actions.append({
+                    "type": atype,
+                    "ignore_if_exist": bool(act.get("IgnoreIfExist")),
+                    "fields": {str(k): str(v) for k, v in
+                               (act.get("Fields") or {}).items()},
+                    "drop_keys": [str(k) for k in
+                                  act.get("DropKeys") or []],
+                })
+            self.cases.append((op, logic, fields, actions))
+        return bool(self.cases)
+
+    def _match(self, ev, op, logic, fields) -> bool:
+        results = []
+        for key, cond in fields.items():
+            v = ev.get_content(key)
+            if v is None:
+                results.append(False)
+                continue
+            val = v.to_bytes()
+            if op == "regexp":
+                results.append(cond.search(val) is not None)
+            else:
+                results.append(self._OPS[op](cond, val))
+        if not results:
+            return True
+        return all(results) if logic == "and" else any(results)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        kept = []
+        for ev in group.events:
+            if not hasattr(ev, "get_content"):
+                kept.append(ev)
+                continue
+            matched = False
+            for op, logic, fields, actions in self.cases:
+                if self._match(ev, op, logic, fields):
+                    matched = True
+                    self._apply(ev, sb, actions)
+                    break
+            if matched or not self.drop_if_not_match:
+                kept.append(ev)
+        if len(kept) != len(group._events):
+            group._events = kept
+
+    def _apply(self, ev, sb, actions) -> None:
+        for act in actions:
+            if act["type"] == "processor_add_fields":
+                for k, v in act["fields"].items():
+                    if act["ignore_if_exist"] and \
+                            ev.get_content(k.encode()) is not None:
+                        continue
+                    ev.set_content(sb.copy_string(k.encode()),
+                                   sb.copy_string(v.encode()))
+            elif act["type"] == "processor_drop":
+                drop = {k.encode() for k in act["drop_keys"]}
+                contents = [(k, v) for k, v in ev.contents
+                            if k.to_bytes() not in drop]
+                if len(contents) != len(ev.contents):
+                    ev.clear_contents()
+                    for k, v in contents:
+                        ev.set_content(k, v)
+
+
+class ProcessorGeoIP(Processor):
+    """IP → geography via a MaxMind DB
+    (plugins/processor/geoip/processor_geoip.go; field naming
+    SourceKey_city_/_province_/_country_/_country_code_/_longitude_/
+    _latitude_ per :143-163)."""
+
+    name = "processor_geoip"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = (config.get("SourceKey") or "").encode()
+        self.language = config.get("Language", "zh-CN")
+        self.no_city = bool(config.get("NoCity", False))
+        self.no_province = bool(config.get("NoProvince", False))
+        self.no_country = bool(config.get("NoCountry", False))
+        self.no_country_code = bool(config.get("NoCountryCode", False))
+        self.no_coordinate = bool(config.get("NoCoordinate", True))
+        self.no_key_error = bool(config.get("NoKeyError", False))
+        path = config.get("DBPath") or ""
+        if not path or not self.source_key:
+            log.error("geoip requires DBPath and SourceKey")
+            return False
+        try:
+            from ..utils.mmdb import Reader
+            self.db = Reader(path)
+        except Exception as e:  # noqa: BLE001 — bad/missing db
+            log.error("geoip cannot open %s: %s", path, e)
+            return False
+        return True
+
+    def _names(self, section) -> Optional[str]:
+        names = (section or {}).get("names") or {}
+        return names.get(self.language) or names.get("en")
+
+    def process(self, group: PipelineEventGroup) -> None:
+        _materialize(group)
+        sb = group.source_buffer
+        prefix = self.source_key
+        for ev in group.events:
+            if not hasattr(ev, "get_content"):
+                continue
+            v = ev.get_content(self.source_key)
+            if v is None:
+                if self.no_key_error:
+                    log.warning("geoip: cannot find key %s",
+                                self.source_key.decode())
+                continue
+            rec = self.db.lookup(v.to_str())
+            if rec is None:
+                continue
+
+            def put(suffix: bytes, value: str) -> None:
+                ev.set_content(sb.copy_string(prefix + suffix),
+                               sb.copy_string(value.encode()))
+
+            if not self.no_city:
+                city = self._names(rec.get("city"))
+                if city:
+                    put(b"_city_", city)
+            subs = rec.get("subdivisions") or []
+            if subs:
+                if not self.no_province:
+                    prov = self._names(subs[0])
+                    if prov:
+                        put(b"_province_", prov)
+                iso = subs[0].get("iso_code")
+                if iso:
+                    put(b"_province_code_", iso)
+            country = rec.get("country") or {}
+            if not self.no_country:
+                cn = self._names(country)
+                if cn:
+                    put(b"_country_", cn)
+            if not self.no_country_code and country.get("iso_code"):
+                put(b"_country_code_", country["iso_code"])
+            loc = rec.get("location") or {}
+            if not self.no_coordinate and "longitude" in loc:
+                put(b"_longitude_", f"{loc['longitude']:.8f}")
+                put(b"_latitude_", f"{loc['latitude']:.8f}")

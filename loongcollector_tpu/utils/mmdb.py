@@ -1,0 +1,159 @@
+"""Minimal MaxMind DB (.mmdb) reader for processor_geoip.
+
+Reference: plugins/processor/geoip/processor_geoip.go opens the database
+with the oschwald/geoip2 library; this runtime has no geoip package, so
+the public MMDB binary format is read directly: metadata map located via
+the \\xAB\\xCD\\xEFMaxMind.com marker, binary search tree walk (24/28/32-bit
+records, IPv4-in-IPv6 handling), and the typed data section (pointers,
+utf8 strings, doubles/floats, uints, maps, arrays, booleans).
+
+Read-only and dependency-free; tests build fixture databases with the
+writer in tests/test_longtail_processors.py.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+from typing import Any, Optional, Tuple
+
+_MARKER = b"\xab\xcd\xefMaxMind.com"
+
+
+class MMDBError(Exception):
+    pass
+
+
+class Reader:
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self.buf = f.read()
+        idx = self.buf.rfind(_MARKER)
+        if idx < 0:
+            raise MMDBError("no MaxMind metadata marker")
+        meta_start = idx + len(_MARKER)
+        self.data_start: Optional[int] = None   # pointers invalid until set
+        self.metadata, _ = self._decode(meta_start)
+        try:
+            self.node_count = int(self.metadata["node_count"])
+            self.record_size = int(self.metadata["record_size"])
+            self.ip_version = int(self.metadata.get("ip_version", 6))
+        except (KeyError, TypeError) as e:
+            raise MMDBError(f"bad metadata: {e}") from e
+        if self.record_size not in (24, 28, 32):
+            raise MMDBError(f"unsupported record size {self.record_size}")
+        self.tree_size = self.node_count * self.record_size * 2 // 8
+        self.data_start = self.tree_size + 16
+
+    # -- tree walk -----------------------------------------------------------
+
+    def _record(self, node: int, side: int) -> int:
+        rs = self.record_size
+        base = node * rs * 2 // 8
+        b = self.buf
+        if rs == 24:
+            o = base + side * 3
+            return (b[o] << 16) | (b[o + 1] << 8) | b[o + 2]
+        if rs == 32:
+            o = base + side * 4
+            return struct.unpack_from(">I", b, o)[0]
+        # 28-bit: 7 bytes per node, middle byte shared
+        if side == 0:
+            return ((b[base + 3] & 0xF0) << 20) | (b[base] << 16) \
+                | (b[base + 1] << 8) | b[base + 2]
+        return ((b[base + 3] & 0x0F) << 24) | (b[base + 4] << 16) \
+            | (b[base + 5] << 8) | b[base + 6]
+
+    def lookup(self, ip: str) -> Optional[dict]:
+        try:
+            addr = ipaddress.ip_address(ip.strip())
+        except ValueError:
+            return None
+        if addr.version == 6 and self.ip_version == 4:
+            return None
+        if addr.version == 4 and self.ip_version == 6:
+            bits = 128
+            value = int(ipaddress.IPv6Address("::" + str(addr)))
+        else:
+            bits = 32 if addr.version == 4 else 128
+            value = int(addr)
+        node = 0
+        for i in range(bits - 1, -1, -1):
+            if node >= self.node_count:
+                break
+            node = self._record(node, (value >> i) & 1)
+        if node == self.node_count:
+            return None                  # explicit no-data record
+        if node < self.node_count:
+            return None                  # ran out of bits (malformed tree)
+        offset = node - self.node_count + self.tree_size
+        out, _ = self._decode(offset)
+        return out if isinstance(out, dict) else None
+
+    # -- data section decoding ------------------------------------------------
+
+    def _decode(self, pos: int) -> Tuple[Any, int]:
+        b = self.buf
+        ctrl = b[pos]
+        pos += 1
+        dtype = ctrl >> 5
+        if dtype == 1:                   # pointer
+            psize = ((ctrl >> 3) & 0x3) + 1
+            v = ctrl & 0x7
+            if psize == 1:
+                v = (v << 8) | b[pos]
+            elif psize == 2:
+                v = ((v << 16) | (b[pos] << 8) | b[pos + 1]) + 2048
+            elif psize == 3:
+                v = ((v << 24) | (b[pos] << 16) | (b[pos + 1] << 8)
+                     | b[pos + 2]) + 526336
+            else:
+                v = struct.unpack_from(">I", b, pos)[0]
+            if self.data_start is None:
+                raise MMDBError("pointer in metadata section")
+            out, _ = self._decode(self.data_start + v)
+            return out, pos + psize
+        if dtype == 0:                   # extended type
+            dtype = b[pos] + 7
+            pos += 1
+        size = ctrl & 0x1F
+        if size == 29:
+            size = 29 + b[pos]
+            pos += 1
+        elif size == 30:
+            size = 285 + struct.unpack_from(">H", b, pos)[0]
+            pos += 2
+        elif size == 31:
+            size = 65821 + int.from_bytes(b[pos : pos + 3], "big")
+            pos += 3
+        if dtype == 2:                   # utf8 string
+            return b[pos : pos + size].decode("utf-8", "replace"), pos + size
+        if dtype == 3:                   # double
+            return struct.unpack_from(">d", b, pos)[0], pos + 8
+        if dtype == 4:                   # bytes
+            return b[pos : pos + size], pos + size
+        if dtype in (5, 6, 9, 10):       # uint16/32/64/128
+            return int.from_bytes(b[pos : pos + size], "big"), pos + size
+        if dtype == 7:                   # map
+            out = {}
+            for _ in range(size):
+                key, pos = self._decode(pos)
+                val, pos = self._decode(pos)
+                out[key] = val
+            return out, pos
+        if dtype == 8:                   # int32
+            v = int.from_bytes(b[pos : pos + size], "big")
+            if size and v >= 1 << (size * 8 - 1):
+                v -= 1 << (size * 8)
+            return v, pos + size
+        if dtype == 11:                  # array
+            out = []
+            for _ in range(size):
+                val, pos = self._decode(pos)
+                out.append(val)
+            return out, pos
+        if dtype == 14:                  # boolean (size IS the value)
+            return bool(size), pos
+        if dtype == 15:                  # float
+            return struct.unpack_from(">f", b, pos)[0], pos + 4
+        raise MMDBError(f"unsupported data type {dtype}")

@@ -1,0 +1,478 @@
+"""Go long-tail processors batch 1: Go-compat differential semantics.
+
+Includes a from-scratch MMDB fixture writer so processor_geoip's MaxMind
+database reader is exercised against real binary-format bytes, and the
+NIST SP 800-38A known-answer vectors for the native AES-CBC used by
+processor_encrypt.
+"""
+
+import base64
+import ipaddress
+import json
+import struct
+import time
+
+import pytest
+
+from loongcollector_tpu.models import PipelineEventGroup
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+
+
+def _mk(name, config):
+    reg = PluginRegistry.instance()
+    reg.load_static_plugins()
+    p = reg.create_processor(name)
+    assert p is not None, name
+    ok = p.init(config, PluginContext("t"))
+    return p, ok
+
+
+def _group(rows):
+    """rows: list of dicts key->value (str)."""
+    g = PipelineEventGroup()
+    sb = g.source_buffer
+    for row in rows:
+        ev = g.add_log_event(int(time.time()))
+        for k, v in row.items():
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(v.encode()))
+    return g
+
+
+def _rows(g):
+    out = []
+    for ev in g.events:
+        out.append({k.to_str(): v.to_bytes() for k, v in ev.contents})
+    return out
+
+
+class TestDictMap:
+    def test_overwrite_in_place(self):
+        p, ok = _mk("processor_dict_map", {
+            "SourceKey": "_ip_",
+            "MapDict": {"127.0.0.1": "LocalHost-LocalAddr",
+                        "192.168.0.1": "default login"}})
+        assert ok
+        g = _group([{"_ip_": "192.168.0.1", "other": "x"},
+                    {"_ip_": "10.0.0.1"}])
+        p.process(g)
+        rows = _rows(g)
+        assert rows[0]["_ip_"] == b"default login"
+        assert rows[1]["_ip_"] == b"10.0.0.1"      # unmapped untouched
+
+    def test_dest_key_fill_vs_overwrite(self):
+        for mode, want in (("fill", b"keep"), ("overwrite", b"mapped")):
+            p, ok = _mk("processor_dict_map", {
+                "SourceKey": "s", "DestKey": "d", "Mode": mode,
+                "MapDict": {"a": "mapped"}})
+            assert ok
+            g = _group([{"s": "a", "d": "keep"}])
+            p.process(g)
+            assert _rows(g)[0]["d"] == want
+
+    def test_dest_key_created_when_absent(self):
+        p, ok = _mk("processor_dict_map", {
+            "SourceKey": "s", "DestKey": "d", "MapDict": {"a": "A"}})
+        assert ok
+        g = _group([{"s": "a"}])
+        p.process(g)
+        assert _rows(g)[0]["d"] == b"A"
+
+    def test_handle_missing(self):
+        p, ok = _mk("processor_dict_map", {
+            "SourceKey": "s", "DestKey": "d", "HandleMissing": True,
+            "Missing": "Unknown", "MapDict": {"a": "A"}})
+        assert ok
+        g = _group([{"other": "x"}])
+        p.process(g)
+        assert _rows(g)[0]["d"] == b"Unknown"
+
+    def test_csv_file(self, tmp_path):
+        f = tmp_path / "dict.csv"
+        f.write_text("a,Apple\nb,Banana\n")
+        p, ok = _mk("processor_dict_map",
+                    {"SourceKey": "s", "DictFilePath": str(f)})
+        assert ok
+        g = _group([{"s": "b"}])
+        p.process(g)
+        assert _rows(g)[0]["s"] == b"Banana"
+
+    def test_bad_config_rejected(self):
+        _, ok = _mk("processor_dict_map", {"SourceKey": "s"})
+        assert not ok
+        _, ok = _mk("processor_dict_map",
+                    {"SourceKey": "s", "Mode": "bogus",
+                     "MapDict": {"a": "b"}})
+        assert not ok
+
+
+class TestPickKey:
+    def test_include(self):
+        p, ok = _mk("processor_pick_key", {"Include": ["a", "b"]})
+        assert ok
+        g = _group([{"a": "1", "b": "2", "c": "3"}])
+        p.process(g)
+        assert _rows(g) == [{"a": b"1", "b": b"2"}]
+
+    def test_exclude(self):
+        p, ok = _mk("processor_pick_key", {"Exclude": ["c"]})
+        assert ok
+        g = _group([{"a": "1", "c": "3"}])
+        p.process(g)
+        assert _rows(g) == [{"a": b"1"}]
+
+    def test_empty_event_dropped(self):
+        p, ok = _mk("processor_pick_key", {"Include": ["zz"]})
+        assert ok
+        g = _group([{"a": "1"}, {"zz": "2"}])
+        p.process(g)
+        assert _rows(g) == [{"zz": b"2"}]
+
+    def test_columnar_fast_path(self):
+        import numpy as np
+        from loongcollector_tpu.models import ColumnarLogs
+        g = PipelineEventGroup()
+        cols = ColumnarLogs(np.zeros(2, np.int32), np.zeros(2, np.int32),
+                            np.zeros(2, np.int64))
+        cols.set_field("keepme", np.zeros(2, np.int32),
+                       np.array([3, -1], np.int32))
+        cols.set_field("dropme", np.zeros(2, np.int32),
+                       np.zeros(2, np.int32))
+        cols.content_consumed = True
+        g.set_columns(cols)
+        p, _ = _mk("processor_pick_key", {"Include": ["keepme"]})
+        p.process(g)
+        # row 1 has no remaining fields (keepme absent there) → dropped,
+        # matching the object path's empty-event drop
+        assert list(g.columns.fields) == ["keepme"]
+        assert len(g.columns) == 1
+
+    def test_columnar_matches_object_semantics(self):
+        """Same config, same data, both representations → same output."""
+        import numpy as np
+        from loongcollector_tpu.models import ColumnarLogs
+        data = b"xy"
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        v = sb.copy_string(data)
+        cols = ColumnarLogs(np.array([v.offset] * 2, np.int32),
+                            np.array([2, 2], np.int32),
+                            np.zeros(2, np.int64))
+        cols.set_field("foo", np.array([v.offset] * 2, np.int32),
+                       np.array([1, -1], np.int32))
+        cols.content_consumed = True
+        g.set_columns(cols)
+        p, _ = _mk("processor_pick_key", {"Include": ["foo"]})
+        p.process(g)
+        col_rows = _rows(g)             # materializes
+
+        g2 = _group([{"content": "xy", "foo": "x"}, {"content": "xy"}])
+        p2, _ = _mk("processor_pick_key", {"Include": ["foo"]})
+        p2.process(g2)
+        assert _rows(g2) == col_rows == [{"foo": b"x"}]
+
+
+class TestPackJson:
+    def test_pack_keep_source(self):
+        p, ok = _mk("processor_packjson", {
+            "SourceKeys": ["a", "b"], "DestKey": "d_key"})
+        assert ok
+        g = _group([{"a": "1", "b": "2", "c": "3"}])
+        p.process(g)
+        row = _rows(g)[0]
+        assert json.loads(row["d_key"]) == {"a": "1", "b": "2"}
+        assert row["a"] == b"1"
+
+    def test_pack_drop_source(self):
+        p, ok = _mk("processor_packjson", {
+            "SourceKeys": ["a"], "DestKey": "d", "KeepSource": False})
+        assert ok
+        g = _group([{"a": "1", "c": "3"}])
+        p.process(g)
+        row = _rows(g)[0]
+        assert "a" not in row and json.loads(row["d"]) == {"a": "1"}
+
+
+class TestBase64:
+    def test_encode_decode_roundtrip(self):
+        enc, ok = _mk("processor_base64_encoding",
+                      {"SourceKey": "content", "NewKey": "b64"})
+        assert ok
+        dec, ok = _mk("processor_base64_decoding", {"SourceKey": "b64"})
+        assert ok
+        g = _group([{"content": "hello world"}])
+        enc.process(g)
+        dec.process(g)
+        row = _rows(g)[0]
+        assert row["content"] == b"hello world"
+        assert row["b64"] == b"hello world"
+
+    def test_decode_error_keeps_original(self):
+        dec, _ = _mk("processor_base64_decoding", {"SourceKey": "x"})
+        g = _group([{"x": "!!!not-base64!!!"}])
+        dec.process(g)
+        assert _rows(g)[0]["x"] == b"!!!not-base64!!!"
+
+
+class TestEncrypt:
+    KEY = "2b7e151628aed2a6abf7158809cf4f3c"
+    IV = "000102030405060708090a0b0c0d0e0f"
+
+    def test_nist_vector_via_native(self):
+        from loongcollector_tpu.processor.longtail import _aes_cbc
+        pt = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a")
+        ct = _aes_cbc(bytes.fromhex(self.KEY), bytes.fromhex(self.IV), pt)
+        if ct is None:
+            pytest.skip("native lib unavailable")
+        assert ct.hex() == "7649abac8119b246cee98e9b12e9197d"
+
+    def test_field_encrypted_hex_pkcs7(self):
+        from loongcollector_tpu.processor.longtail import _aes_cbc
+        if _aes_cbc(b"0" * 16, b"0" * 16, b"0" * 16) is None:
+            pytest.skip("native lib unavailable")
+        p, ok = _mk("processor_encrypt", {
+            "SourceKeys": ["secret"],
+            "EncryptionParameters": {"Key": self.KEY, "IV": self.IV}})
+        assert ok
+        g = _group([{"secret": "s3cr3t", "plain": "x"}])
+        p.process(g)
+        row = _rows(g)[0]
+        assert row["plain"] == b"x"
+        ct = bytes.fromhex(row["secret"].decode())
+        assert len(ct) == 16            # one PKCS7-padded block
+        # decrypt-check with a reference pure-python inverse: encrypt of
+        # the same padded plaintext must equal the stored ciphertext
+        padded = b"s3cr3t" + bytes([10]) * 10
+        from loongcollector_tpu.processor.longtail import _aes_cbc as enc
+        assert enc(bytes.fromhex(self.KEY), bytes.fromhex(self.IV),
+                   padded) == ct
+
+    def test_key_file(self, tmp_path):
+        f = tmp_path / "key"
+        f.write_text(self.KEY)
+        p, ok = _mk("processor_encrypt", {
+            "SourceKeys": ["s"],
+            "EncryptionParameters": {"KeyFilePath": str(f),
+                                     "IV": self.IV}})
+        assert ok
+
+    def test_bad_config(self):
+        _, ok = _mk("processor_encrypt", {"SourceKeys": ["s"],
+                                          "EncryptionParameters": {}})
+        assert not ok
+        _, ok = _mk("processor_encrypt", {
+            "SourceKeys": ["s"],
+            "EncryptionParameters": {"Key": "zz", "IV": self.IV}})
+        assert not ok
+
+
+class TestRateLimit:
+    def test_limit_per_key(self):
+        p, ok = _mk("processor_rate_limit",
+                    {"Fields": ["user"], "Limit": "2/s"})
+        assert ok
+        g = _group([{"user": "a"}, {"user": "a"}, {"user": "a"},
+                    {"user": "b"}])
+        p.process(g)
+        rows = _rows(g)
+        assert len([r for r in rows if r["user"] == b"a"]) == 2
+        assert len([r for r in rows if r["user"] == b"b"]) == 1
+
+    def test_refill(self):
+        p, ok = _mk("processor_rate_limit", {"Limit": "5/s"})
+        assert ok
+        g = _group([{"n": str(i)} for i in range(10)])
+        p.process(g)
+        assert len(_rows(g)) == 5
+        time.sleep(0.5)
+        g2 = _group([{"n": str(i)} for i in range(10)])
+        p.process(g2)
+        assert 1 <= len(_rows(g2)) <= 4  # ~2.5 tokens refilled
+
+    def test_bad_limit(self):
+        _, ok = _mk("processor_rate_limit", {"Limit": "fast"})
+        assert not ok
+
+
+class TestFieldsWithCondition:
+    CFG = {
+        "DropIfNotMatchCondition": True,
+        "Switch": [
+            {"Case": {"RelationOperator": "contains",
+                      "FieldConditions": {"content": "error"}},
+             "Actions": [{"type": "processor_add_fields",
+                          "Fields": {"severity": "high"}}]},
+            {"Case": {"FieldConditions": {"content": "ok"}},
+             "Actions": [{"type": "processor_add_fields",
+                          "Fields": {"severity": "low"}},
+                         {"type": "processor_drop",
+                          "DropKeys": ["noise"]}]},
+        ],
+    }
+
+    def test_switch_case_first_match_wins(self):
+        p, ok = _mk("processor_fields_with_condition", self.CFG)
+        assert ok
+        g = _group([{"content": "an error happened", "noise": "z"},
+                    {"content": "ok", "noise": "z"},
+                    {"content": "nothing matches"}])
+        p.process(g)
+        rows = _rows(g)
+        assert len(rows) == 2           # third dropped
+        assert rows[0]["severity"] == b"high"
+        assert rows[0]["noise"] == b"z"  # first case has no drop action
+        assert rows[1]["severity"] == b"low"
+        assert "noise" not in rows[1]
+
+    def test_regexp_operator_and_keep(self):
+        cfg = {"Switch": [
+            {"Case": {"RelationOperator": "regexp",
+                      "FieldConditions": {"code": r"^5\d\d$"}},
+             "Actions": [{"type": "processor_add_fields",
+                          "Fields": {"class": "server-error"}}]}]}
+        p, ok = _mk("processor_fields_with_condition", cfg)
+        assert ok
+        g = _group([{"code": "503"}, {"code": "200"}])
+        p.process(g)
+        rows = _rows(g)
+        assert rows[0]["class"] == b"server-error"
+        assert "class" not in rows[1]   # kept (no DropIfNotMatchCondition)
+
+
+# ---------------------------------------------------------------------------
+# MMDB fixture writer + geoip
+# ---------------------------------------------------------------------------
+
+
+def _enc(v):
+    def ctrl(t, size):
+        assert size < 29
+        return bytes([(t << 5) | size])
+
+    if isinstance(v, str):
+        b = v.encode()
+        return ctrl(2, len(b)) + b
+    if isinstance(v, bool):
+        return bytes([(0 << 5) | (1 if v else 0), 14 - 7])
+    if isinstance(v, float):
+        return ctrl(3, 8) + struct.pack(">d", v)
+    if isinstance(v, int):
+        b = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+        return ctrl(6, len(b)) + b
+    if isinstance(v, dict):
+        out = ctrl(7, len(v))
+        for k, val in v.items():
+            out += _enc(str(k)) + _enc(val)
+        return out
+    if isinstance(v, list):
+        out = bytes([(0 << 5) | len(v), 11 - 7])
+        for val in v:
+            out += _enc(val)
+        return out
+    raise TypeError(type(v))
+
+
+def build_mmdb(path, entries, ip_version=4, record_size=32):
+    """entries: [(cidr, data_dict)] — minimal but spec-conformant MMDB."""
+    data_section = bytearray()
+    data_offsets = []
+    for _, data in entries:
+        data_offsets.append(len(data_section))
+        data_section += _enc(data)
+
+    nodes = [[None, None]]              # record None = no-data
+
+    def insert(cidr, data_idx):
+        net = ipaddress.ip_network(cidr)
+        bits = 32 if ip_version == 4 else 128
+        value = int(net.network_address)
+        node = 0
+        for i in range(bits - 1, bits - 1 - net.prefixlen, -1):
+            side = (value >> i) & 1
+            if i == bits - net.prefixlen:     # last bit: point at data
+                nodes[node][side] = ("data", data_idx)
+                return
+            nxt = nodes[node][side]
+            if not isinstance(nxt, int):
+                nodes.append([None, None])
+                nxt = len(nodes) - 1
+                nodes[node][side] = nxt
+            node = nxt
+
+    for i, (cidr, _) in enumerate(entries):
+        insert(cidr, i)
+
+    node_count = len(nodes)
+    tree = bytearray()
+    for left, right in nodes:
+        for rec in (left, right):
+            if rec is None:
+                val = node_count
+            elif isinstance(rec, int):
+                val = rec
+            else:
+                val = node_count + 16 + data_offsets[rec[1]]
+            tree += struct.pack(">I", val)
+    meta = {"node_count": node_count, "record_size": record_size,
+            "ip_version": ip_version, "database_type": "GeoLite2-City",
+            "languages": ["en"], "binary_format_major_version": 2,
+            "binary_format_minor_version": 0, "build_epoch": 0}
+    blob = (bytes(tree) + b"\x00" * 16 + bytes(data_section)
+            + b"\xab\xcd\xefMaxMind.com" + _enc(meta))
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+CITY_DATA = {
+    "city": {"names": {"en": "Hangzhou"}},
+    "subdivisions": [{"names": {"en": "Zhejiang"}, "iso_code": "ZJ"}],
+    "country": {"names": {"en": "China"}, "iso_code": "CN"},
+    "location": {"longitude": 120.16, "latitude": 30.29},
+}
+
+
+class TestMMDB:
+    def test_reader_lookup(self, tmp_path):
+        from loongcollector_tpu.utils.mmdb import Reader
+        db = tmp_path / "t.mmdb"
+        build_mmdb(db, [("42.120.0.0/16", CITY_DATA)])
+        r = Reader(str(db))
+        rec = r.lookup("42.120.75.131")
+        assert rec["city"]["names"]["en"] == "Hangzhou"
+        assert rec["country"]["iso_code"] == "CN"
+        assert abs(rec["location"]["longitude"] - 120.16) < 1e-9
+        assert r.lookup("8.8.8.8") is None
+        assert r.lookup("not-an-ip") is None
+
+    def test_ipv6_tree_with_ipv4_lookup(self, tmp_path):
+        from loongcollector_tpu.utils.mmdb import Reader
+        db = tmp_path / "t6.mmdb"
+        build_mmdb(db, [("::2a78:0/112", CITY_DATA)], ip_version=6)
+        r = Reader(str(db))
+        # ::2a78:0/112 covers IPv4 42.120.0.0/16 in the v4-in-v6 mapping
+        assert r.lookup("42.120.75.131") is not None
+
+
+class TestGeoIP:
+    def test_enrich(self, tmp_path):
+        db = tmp_path / "geo.mmdb"
+        build_mmdb(db, [("42.120.0.0/16", CITY_DATA)])
+        p, ok = _mk("processor_geoip", {
+            "SourceKey": "ip", "DBPath": str(db), "Language": "en",
+            "NoCoordinate": False})
+        assert ok
+        g = _group([{"ip": "42.120.75.131"}, {"ip": "8.8.8.8"}])
+        p.process(g)
+        rows = _rows(g)
+        assert rows[0]["ip_city_"] == b"Hangzhou"
+        assert rows[0]["ip_province_"] == b"Zhejiang"
+        assert rows[0]["ip_country_"] == b"China"
+        assert rows[0]["ip_country_code_"] == b"CN"
+        assert rows[0]["ip_longitude_"] == b"120.16000000"
+        assert "ip_city_" not in rows[1]
+
+    def test_missing_db_fails_init(self, tmp_path):
+        _, ok = _mk("processor_geoip", {
+            "SourceKey": "ip", "DBPath": str(tmp_path / "absent.mmdb")})
+        assert not ok
