@@ -104,6 +104,10 @@ def bench_regex(n=32768):
         # degraded: the engine actually routes to the native walker — the
         # honest CPU-vs-CPU comparison against the reference's 68 MB/s
         mbps = max(mbps_xla, mbps_native or 0.0)
+    # warm the routed path once (kernel selection / possible Pallas compile
+    # or fallback happens here, outside the timed window — a long-running
+    # agent pays this once per pattern, not per batch)
+    eng.parse_batch(arena, offsets, lengths)
     t1 = time.perf_counter()
     res = eng.parse_batch(arena, offsets, lengths)
     e2e = total / (time.perf_counter() - t1) / 1e6
@@ -382,13 +386,25 @@ def main():
         extra["pipeline_e2e_MBps"] = round(e2e3[0], 1)
         extra["event_to_flush_ms_p50"] = round(e2e3[1], 2)
         extra["event_to_flush_ms_p99"] = round(e2e3[2], 2)
-    print(json.dumps({
+    line = {
         "metric": "regex_parse_throughput",
         "value": round(mbps, 1),
         "unit": "MB/s",
         "vs_baseline": round(mbps / BASELINE_MBPS, 2),
         "extra": extra,
-    }))
+    }
+    print(json.dumps(line))
+    if not degraded and jax.devices()[0].platform == "tpu":
+        # persist the last good REAL-TPU run: the tunnel is flaky, so any
+        # window of TPU availability should leave a durable artifact
+        try:
+            import datetime
+            line["ts"] = datetime.datetime.now(
+                datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+            with open("BENCH_TPU_LAST_GOOD.json", "w") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            pass
     return 0
 
 

@@ -42,16 +42,23 @@ from ..regex.program import (INF, Alt, CapEnd, CapStart, FixedSpan, Lit,
 
 
 def _membership(rows: jnp.ndarray, intervals, complement_intervals) -> jnp.ndarray:
-    """bool [B, L] membership via the cheaper of (intervals, ~complement)."""
+    """bool [B, L] membership via the cheaper of (intervals, ~complement).
+
+    The OR-chain is seeded from the first interval compare, NOT from a
+    `jnp.zeros` constant: constant i1 seeds get a sublane-replicated Mosaic
+    layout, and `or`-ing a replicated mask with a data-derived one hits an
+    unsupported i1 relayout ("non-singleton dimension replicated in
+    destination but not source") when the Pallas path compiles on a real
+    TPU.  Every mask here must stay data-dependent."""
     negate = len(complement_intervals) < len(intervals)
     if negate:
         intervals = complement_intervals
-    m = jnp.zeros(rows.shape, dtype=bool)
+    m = None
     for lo, hi in intervals:
-        if lo == hi:
-            m = m | (rows == lo)
-        else:
-            m = m | ((rows >= lo) & (rows <= hi))
+        t = (rows == lo) if lo == hi else ((rows >= lo) & (rows <= hi))
+        m = t if m is None else (m | t)
+    if m is None:                     # empty class: never matches
+        m = rows != rows
     return ~m if negate else m
 
 
@@ -167,15 +174,23 @@ def build_extract_core(program: SegmentProgram):
             member[cid] = _membership(rows, intervals[cid],
                                       comp_intervals[cid]) & valid
 
+        # Mosaic-layout discipline (see _membership): every i1 seed must be
+        # data-dependent, or the Pallas compile trips an invalid replicated
+        # relayout.  true/false columns derive from lens; lit chains start
+        # at the first byte compare.
+        true_col = lens >= 0              # always true, never replicated
+        cur0 = jnp.minimum(lens, 0)       # always 0,   never replicated
+
         lit_ok: Dict[bytes, jnp.ndarray] = {}
         for lit in sorted(literals):
             data = np.frombuffer(lit, dtype=np.uint8)
-            m = jnp.ones((B, L), dtype=bool)
+            m = None
             for i, ch in enumerate(data):
                 shifted = rows if i == 0 else jnp.concatenate(
                     [rows[:, i:], jnp.zeros((B, i), rows.dtype)], axis=1)
-                m = m & (shifted == ch)
-            lit_ok[lit] = m
+                t = shifted == ch
+                m = t if m is None else (m & t)
+            lit_ok[lit] = m if m is not None else (rows == rows)
 
         def emit(ops, st: _WalkState, active) -> None:
             """Apply ops to st for rows where `active` (bool [B,1])."""
@@ -231,7 +246,7 @@ def build_extract_core(program: SegmentProgram):
                     st.cap_start = merged.cap_start
                 elif isinstance(op, Alt):
                     before = st.copy()
-                    chosen_any = jnp.zeros_like(st.ok)
+                    chosen_any = ~true_col    # all-false, data-dependent
                     result = before.copy()
                     remaining = active & st.ok
                     for branch in op.branches:
@@ -318,7 +333,7 @@ def build_extract_core(program: SegmentProgram):
                     st.cap_start = merged.cap_start
                 elif isinstance(op, Alt):
                     before = st.copy()
-                    chosen_any = jnp.zeros_like(st.ok)
+                    chosen_any = ~true_col    # all-false, data-dependent
                     result = before.copy()
                     remaining = active & st.ok
                     for branch in op.branches:
@@ -339,8 +354,8 @@ def build_extract_core(program: SegmentProgram):
                 else:  # pragma: no cover
                     raise AssertionError(op)
 
-        all_rows = jnp.ones((B, 1), bool)
-        st = _WalkState(jnp.zeros((B, 1), i32), all_rows, ncaps)
+        all_rows = true_col
+        st = _WalkState(cur0, all_rows, ncaps)
         emit(top_ops, st, all_rows)
 
         if pivot2 is not None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -39,6 +40,53 @@ def _pallas_enabled() -> Optional[bool]:
 
 
 _host_backend_cached: Optional[bool] = None
+
+# ---------------------------------------------------------------------------
+# Latency-aware device routing.
+#
+# A device dispatch costs a fixed round trip (sub-ms on a local chip; tens of
+# ms through a remote/tunneled TPU) before any bytes are parsed, while the
+# native C++ walker starts instantly at a few hundred MB/s.  The crossover is
+#     min_bytes = dispatch_latency * host_throughput
+# — below it the host tier finishes before the device call would even return.
+# Latency is MEASURED once per process (a tiny warm jitted call), so the
+# threshold adapts to the actual deployment: ~100 KB on local silicon,
+# tens of MB through a high-latency tunnel.  LOONG_DEVICE_MIN_BYTES overrides.
+
+_HOST_WALKER_BPS = 300e6          # conservative native-walker throughput
+_dispatch_probe_lock = threading.Lock()
+_device_min_bytes_cached: Optional[int] = None
+
+
+def _device_min_bytes() -> int:
+    global _device_min_bytes_cached
+    if _device_min_bytes_cached is not None:
+        return _device_min_bytes_cached
+    env = os.environ.get("LOONG_DEVICE_MIN_BYTES")
+    if env is not None:
+        _device_min_bytes_cached = int(env)
+        return _device_min_bytes_cached
+    with _dispatch_probe_lock:
+        if _device_min_bytes_cached is not None:
+            return _device_min_bytes_cached
+        try:
+            import jax
+            import jax.numpy as jnp
+            f = jax.jit(lambda x: x + 1)
+            x = jnp.zeros((8, 128), jnp.int32)
+            jax.block_until_ready(f(x))          # compile outside the timing
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(x))
+                samples.append(time.perf_counter() - t0)
+            lat = sorted(samples)[1]
+            crossover = int(lat * _HOST_WALKER_BPS)
+            _device_min_bytes_cached = max(32 * 1024,
+                                           min(crossover, 128 * 1024 * 1024))
+        except Exception:  # noqa: BLE001 — routing must never break parsing
+            _device_min_bytes_cached = 256 * 1024
+    return _device_min_bytes_cached
 
 
 def _native_host_mode() -> bool:
@@ -174,11 +222,21 @@ class RegexEngine:
         lengths = np.asarray(lengths, dtype=np.int32)
         n = len(offsets)
         C = max(self.num_caps, 1)
-        if n and self.tier is PatternTier.SEGMENT and _native_host_mode():
-            nat = self._host_walker()
-            if nat is not None:
-                k_ok, k_off, k_len = nat(arena, offsets, lengths)
-                return BatchParseResult(k_ok, k_off, k_len)
+        if n and self.tier is PatternTier.SEGMENT:
+            use_host = _native_host_mode()
+            if not use_host and _pallas_enabled() is None \
+                    and os.environ.get("LOONG_NATIVE_T1") != "0":
+                # accelerator backend: small batches still lose to the fixed
+                # dispatch round trip — route them to the native walker
+                # (explicit LOONG_PALLAS / LOONG_NATIVE_T1 forces win)
+                nat = self._host_walker()
+                use_host = (nat is not None
+                            and int(lengths.sum()) < _device_min_bytes())
+            if use_host:
+                nat = self._host_walker()
+                if nat is not None:
+                    k_ok, k_off, k_len = nat(arena, offsets, lengths)
+                    return BatchParseResult(k_ok, k_off, k_len)
         ok = np.zeros(n, dtype=bool)
         cap_off = np.zeros((n, C), dtype=np.int32)
         cap_len = np.full((n, C), -1, dtype=np.int32)
@@ -251,6 +309,19 @@ class RegexEngine:
         if self.tier is PatternTier.SEGMENT:
             return self.parse_batch(arena, offsets, lengths).ok
         if self.tier is PatternTier.DFA:
+            # small batches: the fixed dispatch round trip dwarfs a host
+            # re loop (the DFA tier has no native walker; `re` is its host
+            # tier, worth ~50 MB/s — scale the crossover accordingly);
+            # explicit device-kernel forces win, as in parse_batch
+            if not _native_host_mode() and _pallas_enabled() is None \
+                    and os.environ.get("LOONG_NATIVE_T1") != "0" \
+                    and int(lengths.sum()) < _device_min_bytes() // 6:
+                ok = np.zeros(n, dtype=bool)
+                for i in range(n):
+                    o, ln = int(offsets[i]), int(lengths[i])
+                    ok[i] = self._re.fullmatch(
+                        bytes(arena[o : o + ln].tobytes())) is not None
+                return ok
             ok = np.zeros(n, dtype=bool)
             max_bucket = LENGTH_BUCKETS[-1]
             over = lengths > max_bucket

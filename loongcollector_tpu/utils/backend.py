@@ -13,6 +13,7 @@ degrades throughput, never liveness.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 
@@ -21,6 +22,17 @@ from .logger import get_logger
 log = get_logger("backend")
 
 _probe_result: bool | None = None
+
+
+def cpu_pinned() -> bool:
+    """True when the operator explicitly pinned the CPU backend.  The
+    axon platform force-registers itself at interpreter start, so the
+    JAX_PLATFORMS env var alone does NOT take effect — callers must also
+    update jax.config (ensure_live_backend does).  An explicit pin skips
+    the tunnel probe entirely: 90 s probing a backend the user opted out
+    of is pure startup latency."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" \
+        or os.environ.get("LOONG_BACKEND", "").strip().lower() == "cpu"
 
 
 def probe_default_backend(timeout: float = 90.0) -> bool:
@@ -51,7 +63,14 @@ def ensure_live_backend(timeout: float = 90.0) -> bool:
 
     Returns True when running degraded (CPU fallback), False when the
     default backend is healthy. Must run BEFORE the first jax op.
+    An explicit CPU pin (JAX_PLATFORMS=cpu / LOONG_BACKEND=cpu) is applied
+    directly and is NOT degraded — the operator chose it.
     """
+    if cpu_pinned():
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        log.info("CPU backend pinned by operator; skipping device probe")
+        return False
     if probe_default_backend(timeout):
         return False
     import jax
