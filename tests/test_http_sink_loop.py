@@ -1,0 +1,231 @@
+"""Event-loop HttpSink: multiplexing, isolation, keep-alive, chunked.
+
+The round-2 VERDICT's acceptance test (item 10): one stalled destination
+plus live ones — live throughput must be unaffected, because transfers are
+gated per destination, not by a shared worker pool.
+"""
+
+import http.server
+import socket
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.runner.http_sink import HttpSink
+
+
+class _Req:
+    def __init__(self, url, method="POST", headers=None, body=b"x",
+                 timeout=10.0):
+        self.url = url
+        self.method = method
+        self.headers = headers or {}
+        self.body = body
+        self.timeout = timeout
+
+
+def _ok_server():
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        connections = set()
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            H.connections.add(self.client_address)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, H
+
+
+@pytest.fixture
+def sink():
+    s = HttpSink(workers=2)
+    s.init()
+    yield s
+    s.stop()
+
+
+def test_basic_roundtrip(sink):
+    srv, _ = _ok_server()
+    try:
+        done = []
+        sink.add_request(_Req(f"http://127.0.0.1:{srv.server_port}/"),
+                         lambda st, body: done.append((st, body)))
+        deadline = time.monotonic() + 5
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done == [(200, b"ok")]
+    finally:
+        srv.shutdown()
+
+
+def test_stalled_destination_does_not_starve_live_ones(sink):
+    """1 stalled + live destination: live requests complete while every
+    transfer to the stalled endpoint is still pending."""
+    # stalled: accepts connections, never responds
+    stall = socket.socket()
+    stall.bind(("127.0.0.1", 0))
+    stall.listen(16)
+    stall_port = stall.getsockname()[1]
+    srv, _ = _ok_server()
+    try:
+        stalled_done, live_done = [], []
+        # saturate the stalled destination's lane (per_dest=2) twice over
+        for _ in range(4):
+            sink.add_request(
+                _Req(f"http://127.0.0.1:{stall_port}/", timeout=30),
+                lambda st, b: stalled_done.append(st))
+        t0 = time.monotonic()
+        for _ in range(20):
+            sink.add_request(
+                _Req(f"http://127.0.0.1:{srv.server_port}/"),
+                lambda st, b: live_done.append(st))
+        deadline = time.monotonic() + 5
+        while len(live_done) < 20 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        assert len(live_done) == 20, (live_done, stalled_done)
+        assert all(st == 200 for st in live_done)
+        assert elapsed < 5.0
+        assert stalled_done == []        # still hanging, isolated
+    finally:
+        stall.close()
+        srv.shutdown()
+
+
+def test_keepalive_reuse(sink):
+    srv, H = _ok_server()
+    H.connections = set()
+    try:
+        done = []
+        for _ in range(3):
+            sink.add_request(_Req(f"http://127.0.0.1:{srv.server_port}/"),
+                             lambda st, b: done.append(st))
+            deadline = time.monotonic() + 5
+            want = len(done) + 1
+            while len(done) < want and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert done == [200, 200, 200]
+        # sequential requests on one sink lane reuse one connection
+        assert len(H.connections) == 1, H.connections
+    finally:
+        srv.shutdown()
+
+
+def test_chunked_response(sink):
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for part in (b"hello ", b"chunked ", b"world"):
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(part), part))
+            self.wfile.write(b"0\r\n\r\n")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        done = []
+        sink.add_request(_Req(f"http://127.0.0.1:{srv.server_port}/"),
+                         lambda st, b: done.append((st, b)))
+        deadline = time.monotonic() + 5
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done == [(200, b"hello chunked world")]
+    finally:
+        srv.shutdown()
+
+
+def test_stale_keepalive_recovery(sink):
+    """Server closes idle connections between requests; the sink must
+    discard the dead pooled connection and complete on a fresh one."""
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.send_header("Connection", "close")   # close every time
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        done = []
+        for _ in range(3):
+            sink.add_request(_Req(f"http://127.0.0.1:{srv.server_port}/"),
+                             lambda st, b: done.append(st))
+            want = len(done) + 1
+            deadline = time.monotonic() + 5
+            while len(done) < want and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert done == [200, 200, 200]
+    finally:
+        srv.shutdown()
+
+
+def test_truncated_chunked_body_is_an_error(sink):
+    """Server dies mid-chunk: must surface status 0, never a silently
+    truncated 200 body (code-review finding)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def run():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n"
+                     b"5\r\nhello\r\n")     # then die mid-stream
+        conn.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    done = []
+    try:
+        sink.add_request(_Req(f"http://127.0.0.1:{port}/", timeout=5),
+                         lambda st, b: done.append((st, b)))
+        deadline = time.monotonic() + 8
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done and done[0][0] == 0, done
+    finally:
+        srv.close()
+
+
+def test_error_status_zero_on_refused(sink):
+    # nothing listens on this port (bind without listen, then close)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    done = []
+    sink.add_request(_Req(f"http://127.0.0.1:{port}/", timeout=3),
+                     lambda st, b: done.append((st, b)))
+    deadline = time.monotonic() + 6
+    while not done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert done and done[0][0] == 0

@@ -459,11 +459,20 @@ class TestRedirectFollow:
         from loongcollector_tpu.flusher.http import HttpRequest
         from loongcollector_tpu.runner.http_sink import HttpSink
         sink = HttpSink(workers=1)
+        sink.init()
+        done = []
         try:
-            status, body = sink._execute(HttpRequest(
+            sink.add_request(HttpRequest(
                 "PUT", f"http://127.0.0.1:{fe.server_port}/api/d/t/_stream_load",
-                {}, b"row-data"))
+                {}, b"row-data"), lambda st, b: done.append((st, b)))
+            import time as _t
+            deadline = _t.monotonic() + 10
+            while not done and _t.monotonic() < deadline:
+                _t.sleep(0.01)
+            assert done, "redirect transfer never completed"
+            status, body = done[0]
         finally:
+            sink.stop()
             fe.shutdown()
             be.shutdown()
         assert status == 200 and b"Success" in body
