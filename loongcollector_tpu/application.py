@@ -60,8 +60,20 @@ class Application:
         self.pipeline_manager = CollectionPipelineManager(
             self.process_queue_manager, self.sender_queue_manager)
         self.http_sink = HttpSink()
+        from .utils.payload_crypto import PayloadCipher
+        try:
+            spill_cipher = PayloadCipher(
+                os.path.join(self.data_dir, "spill_key"))
+        except (OSError, ValueError) as e:
+            # a broken key file must not take the agent down — run with
+            # plaintext spill and alarm loudly (existing encrypted files
+            # are kept untouched until the key is restored)
+            log.error("spill cipher unavailable (%s); disk buffer will "
+                      "write PLAINTEXT", e)
+            spill_cipher = None
         self.disk_buffer = DiskBufferWriter(
-            os.path.join(self.data_dir, "buffer"))
+            os.path.join(self.data_dir, "buffer"),
+            cipher=spill_cipher)
         self.flusher_runner = FlusherRunner(self.sender_queue_manager,
                                             self.http_sink,
                                             disk_buffer=self.disk_buffer)

@@ -23,6 +23,7 @@ from ..pipeline.plugin.interface import PluginContext
 from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
 from .http import FlusherHTTP, HttpRequest
+from .sls_client import EndpointPool, classify_response
 
 
 class FlusherSLS(FlusherHTTP):
@@ -36,6 +37,7 @@ class FlusherSLS(FlusherHTTP):
         self.endpoint = ""
         self.access_key_id = ""
         self.access_key_secret = ""
+        self.endpoint_pool: EndpointPool = None  # type: ignore
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         self.context = context
@@ -45,6 +47,12 @@ class FlusherSLS(FlusherHTTP):
         self.endpoint = config.get("Endpoint", "")
         self.access_key_id = config.get("AccessKeyId", "")
         self.access_key_secret = config.get("AccessKeySecret", "")
+        # multi-endpoint region pool with fallback + primary probe-back
+        # (SLSClientManager.cpp); "Endpoints" extends the single "Endpoint"
+        endpoints = list(config.get("Endpoints", []))
+        if self.endpoint and self.endpoint not in endpoints:
+            endpoints.insert(0, self.endpoint)
+        self.endpoint_pool = EndpointPool(endpoints) if endpoints else None
         self.remote_url = (f"http://{self.project}.{self.endpoint}"
                            f"/logstores/{self.logstore}/shards/lb"
                            if self.endpoint else "")
@@ -64,13 +72,16 @@ class FlusherSLS(FlusherHTTP):
         return bool(self.logstore)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        endpoint = (self.endpoint_pool.current() if self.endpoint_pool
+                    else self.endpoint)
+        item.tag["sls_endpoint"] = endpoint
         date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
         md5 = hashlib.md5(item.data).hexdigest().upper()
         headers = {
             "Content-Type": "application/x-protobuf",
             "Content-MD5": md5,
             "Date": date,
-            "Host": f"{self.project}.{self.endpoint}",
+            "Host": f"{self.project}.{endpoint}",
             "x-log-apiversion": "0.6.0",
             "x-log-bodyrawsize": str(item.raw_size),
             "x-log-signaturemethod": "hmac-sha1",
@@ -90,17 +101,30 @@ class FlusherSLS(FlusherHTTP):
             headers["Authorization"] = (
                 f"LOG {self.access_key_id}:"
                 f"{base64.b64encode(sig).decode()}")
-        return HttpRequest("POST", self.remote_url, headers, item.data)
+        url = (f"http://{self.project}.{endpoint}"
+               f"/logstores/{self.logstore}/shards/lb")
+        return HttpRequest("POST", url, headers, item.data)
 
     def on_send_done(self, item: SenderQueueItem, status: int,
                      body: bytes) -> str:
+        verdict = classify_response(status, body)
+        endpoint = item.tag.pop("sls_endpoint", None)
+        if self.endpoint_pool is not None and endpoint:
+            # endpoint health feedback: ANY HTTP response proves the
+            # endpoint is reachable — quota (retry_slow) and 4xx (drop)
+            # responses count as endpoint-healthy so a pending primary
+            # probe always resolves; only network/5xx failures rotate
+            if verdict == "ok" or (400 <= status < 500):
+                self.endpoint_pool.on_success(endpoint)
+            elif verdict in ("retry", "retry_slow"):
+                self.endpoint_pool.on_fail(endpoint)
         cp = item.tag.get("eo_cp")
-        if 200 <= status < 300:
+        if verdict == "ok":
             if cp is not None and self.eo_sender is not None:
                 self.eo_sender.commit_slot(cp)
             return "ok"
-        if status in (403, 429, 500, 502, 503) or status <= 0:
-            return "retry"  # quota/server errors back off (reference semantics)
+        if verdict in ("retry", "retry_slow"):
+            return verdict
         if cp is not None and self.eo_sender is not None:
             self.eo_sender.commit_slot(cp)  # discard-ack frees the slot
         return "drop"

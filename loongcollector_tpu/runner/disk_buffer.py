@@ -7,8 +7,12 @@ and replay on recovery; FlusherRunner spills SLS items at exit
 
 Format: one file per payload under <dir>/buffer_<ts>_<seq>.lcb with a JSON
 header line (flusher identity + raw size + metadata) followed by the
-compressed payload bytes.  Replay re-enqueues through the live flusher of
-the same pipeline/plugin identity when it exists.
+payload bytes — ENCRYPTED at rest when a PayloadCipher is attached
+(reference DiskBufferWriter.h:56 treats buffer-file encryption as
+production-critical; a host-level reader of the spill directory must not
+recover log content).  Plaintext files from older runs still replay.
+Replay re-enqueues through the live flusher of the same pipeline/plugin
+identity when it exists.
 """
 
 from __future__ import annotations
@@ -30,9 +34,11 @@ MAX_BUFFER_BYTES = 512 * 1024 * 1024
 
 class DiskBufferWriter:
     def __init__(self, directory: str,
-                 max_bytes: int = MAX_BUFFER_BYTES):
+                 max_bytes: int = MAX_BUFFER_BYTES,
+                 cipher=None):
         self.directory = directory
         self.max_bytes = max_bytes
+        self.cipher = cipher  # utils.payload_crypto.PayloadCipher or None
         self._seq = 0
         self._lock = threading.Lock()
         self._run_id = uuid.uuid4().hex[:8]  # filenames unique across restarts
@@ -58,12 +64,16 @@ class DiskBufferWriter:
         header = dict(identity)
         header["raw_size"] = item.raw_size
         header["enqueue_time"] = time.time()
+        payload = item.data
+        if self.cipher is not None:
+            payload = self.cipher.encrypt(payload)
+            header["enc"] = "hmac-ctr-v1"
         path = os.path.join(self.directory, name)
         tmp = path + ".tmp"
         try:
             with open(tmp, "wb") as f:
                 f.write(json.dumps(header).encode() + b"\n")
-                f.write(item.data)
+                f.write(payload)
             os.replace(tmp, path)
         except OSError as e:
             log.error("disk buffer write failed: %s", e)
@@ -84,13 +94,33 @@ class DiskBufferWriter:
             return []
 
     def read(self, path: str) -> Optional[Tuple[dict, bytes]]:
+        status, header, payload = self._read_classified(path)
+        return (header, payload) if status == "ok" else None
+
+    def _read_classified(self, path: str):
+        """('ok', header, payload) | ('corrupt', None, None) — structurally
+        broken, safe to delete | ('locked', None, None) — encrypted but not
+        currently decryptable (missing/wrong key): KEEP the file, the key
+        may come back."""
         try:
             with open(path, "rb") as f:
                 header = json.loads(f.readline())
                 payload = f.read()
-            return header, payload
         except (OSError, ValueError):
-            return None
+            return "corrupt", None, None
+        if not isinstance(header, dict):
+            return "corrupt", None, None
+        if header.get("enc") == "hmac-ctr-v1":
+            if self.cipher is None:
+                log.error("encrypted buffer file but no cipher configured; "
+                          "keeping for later: %s", path)
+                return "locked", None, None
+            payload = self.cipher.decrypt(payload)
+            if payload is None:   # wrong key or tampered file
+                log.error("buffer file failed authentication; keeping: %s",
+                          path)
+                return "locked", None, None
+        return "ok", header, payload
 
     def replay(self, resolve: Callable[[dict], Optional[object]],
                limit: int = 100) -> int:
@@ -104,11 +134,12 @@ class DiskBufferWriter:
         for path in self.pending():
             if count >= limit:
                 break
-            entry = self.read(path)
-            if entry is None:
-                self._remove(path)  # corrupt file
+            status, header, payload = self._read_classified(path)
+            if status == "corrupt":
+                self._remove(path)
                 continue
-            header, payload = entry
+            if status == "locked":   # undecryptable today ≠ deletable
+                continue
             flusher = resolve(header)
             if flusher is None or flusher.sender_queue is None:
                 continue

@@ -134,11 +134,13 @@ class FlusherRunner:
                 cl.on_done()
                 if verdict == "ok":
                     cl.on_success()
+                elif verdict == "retry_slow":
+                    # quota exceeded: collapse concurrency hard (AIMD slow
+                    # path), regardless of raw status code
+                    cl.on_fail(slow=True)
                 elif verdict == "retry":
                     cl.on_fail(slow=(status == 429))
-        elif verdict != "retry":
-            pass  # queue deleted: item dropped below
-        if verdict == "retry":
+        if verdict in ("retry", "retry_slow"):
             if (self.disk_buffer is not None
                     and item.try_count >= MAX_TRY_BEFORE_SPILL
                     and flusher is not None
