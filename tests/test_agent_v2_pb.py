@@ -1,0 +1,115 @@
+"""ConfigServer v2 protobuf wire codec: golden bytes + round trips.
+
+The golden hex constants were produced by the OFFICIAL protobuf runtime
+(protoc --python_out on the reference's agentV2.proto, then
+SerializeToString) — they pin our hand-rolled codec to the real wire
+format a ConfigServer deployment speaks, independent of our own encoder.
+"""
+
+import loongcollector_tpu.config.agent_v2_pb as pb
+
+# protoc-generated golden messages (see module docstring)
+GOLDEN_REQ = bytes.fromhex(
+    "0a057269642d31100718072207696e73742d34322a126c6f6f6e67636f6c6c6563"
+    "746f722d74707532110a077470752d302e331a06686f73742d61420772756e6e69"
+    "6e674880e2cfaa06520c0a06706970652d61100318026801")
+GOLDEN_RESP = bytes.fromhex(
+    "0a057269642d311200221a0a06706970652d6210091a0e7b22696e70757473223a"
+    "205b5d7d22160a09706970652d676f6e6510ffffffffffffffffff013802")
+GOLDEN_FETCH = bytes.fromhex(
+    "0a057269642d321a1c0a06706970652d6210091a107b22666c757368657273223a"
+    "205b5d7d")
+
+
+def _golden_request() -> pb.HeartbeatRequest:
+    req = pb.HeartbeatRequest()
+    req.request_id = b"rid-1"
+    req.sequence_num = 7
+    req.capabilities = 7
+    req.instance_id = b"inst-42"
+    req.agent_type = "loongcollector-tpu"
+    req.running_status = "running"
+    req.startup_time = 1700000000
+    req.flags = 1
+    attrs = pb.AgentAttributes()
+    attrs.version = b"tpu-0.3"
+    attrs.hostname = b"host-a"
+    req.attributes = attrs
+    req.continuous_pipeline_configs.append(
+        pb.ConfigInfo(name="pipe-a", version=3, status=pb.APPLIED))
+    return req
+
+
+class TestGoldenBytes:
+    def test_encode_matches_official_runtime(self):
+        assert _golden_request().encode() == GOLDEN_REQ
+
+    def test_parse_official_response(self):
+        resp = pb.HeartbeatResponse.parse(GOLDEN_RESP)
+        assert resp.request_id == b"rid-1"
+        assert resp.common_response is not None
+        assert resp.common_response.status == 0
+        assert resp.flags == 2
+        ups = resp.continuous_pipeline_config_updates
+        assert [u.name for u in ups] == ["pipe-b", "pipe-gone"]
+        assert ups[0].version == 9
+        assert ups[0].detail == b'{"inputs": []}'
+        assert ups[1].version == -1          # removal sentinel, signed varint
+        # flags bit 2 = FetchContinuousPipelineConfigDetail
+        assert resp.flags & pb.RESP_FETCH_CONTINUOUS_PIPELINE_CONFIG_DETAIL
+
+    def test_parse_official_fetch_response(self):
+        f = pb.FetchConfigResponse.parse(GOLDEN_FETCH)
+        assert f.request_id == b"rid-2"
+        [u] = f.continuous_pipeline_config_updates
+        assert (u.name, u.version, u.detail) == (
+            "pipe-b", 9, b'{"flushers": []}')
+
+    def test_request_round_trip(self):
+        req = pb.HeartbeatRequest.parse(GOLDEN_REQ)
+        assert req.sequence_num == 7
+        assert req.agent_type == "loongcollector-tpu"
+        assert req.attributes.hostname == b"host-a"
+        assert req.startup_time == 1700000000
+        [ci] = req.continuous_pipeline_configs
+        assert (ci.name, ci.version, ci.status) == ("pipe-a", 3, pb.APPLIED)
+        assert req.encode() == GOLDEN_REQ    # re-encode is byte-identical
+
+
+class TestPrimitives:
+    def test_varint_edges(self):
+        for n in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 63 - 1):
+            enc = pb.enc_varint(n)
+            val, pos = pb.dec_varint(enc, 0)
+            assert val == n and pos == len(enc)
+
+    def test_negative_int64(self):
+        enc = pb.enc_varint(-1)
+        assert enc == b"\xff" * 9 + b"\x01"
+        cd = pb.ConfigDetail(name="x", version=-1)
+        assert pb.ConfigDetail.parse(cd.encode()).version == -1
+
+    def test_unknown_fields_skipped(self):
+        # field 99 varint + field 98 fixed32 + known field 1
+        blob = (pb.enc_varint((99 << 3) | 0) + pb.enc_varint(5)
+                + pb.enc_varint((98 << 3) | 5) + b"\x01\x02\x03\x04"
+                + pb.e_bytes(1, "keep"))
+        cd = pb.ConfigDetail.parse(blob)
+        assert cd.name == "keep"
+
+    def test_truncated_raises(self):
+        import pytest
+        with pytest.raises(ValueError):
+            pb.ConfigDetail.parse(b"\x0a\x10abc")  # claims 16, has 3
+
+    def test_map_round_trip(self):
+        attrs = pb.AgentAttributes(extras={"k8s.node": b"n1", "zone": b"z"})
+        got = pb.AgentAttributes.parse(attrs.encode())
+        assert got.extras == {"k8s.node": b"n1", "zone": b"z"}
+
+    def test_command_detail_round_trip(self):
+        cmd = pb.CommandDetail(name="onetime-1", detail=b"cfg",
+                               expire_time=1234567)
+        got = pb.CommandDetail.parse(cmd.encode())
+        assert (got.name, got.detail, got.expire_time) == (
+            "onetime-1", b"cfg", 1234567)

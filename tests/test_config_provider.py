@@ -1,10 +1,12 @@
-"""Remote config provider: heartbeat protocol against a fake ConfigServer."""
+"""Remote config provider: protobuf v2 heartbeat against a fake
+ConfigServer speaking the real agentV2.proto wire format."""
 
 import http.server
 import json
 import os
 import threading
 
+import loongcollector_tpu.config.agent_v2_pb as pb
 from loongcollector_tpu.config.common_provider import CommonConfigProvider
 from loongcollector_tpu.pipeline.task_pipeline import (Task,
                                                        TaskPipelineManager,
@@ -12,16 +14,26 @@ from loongcollector_tpu.pipeline.task_pipeline import (Task,
 
 
 class _FakeServer(http.server.BaseHTTPRequestHandler):
-    requests = []
-    response = {}
+    """Speaks serialized agentV2 protobuf, like a real ConfigServer."""
+
+    requests = []          # (path, parsed request message)
+    response = b""         # pre-encoded HeartbeatResponse bytes
+    fetch_response = b""   # pre-encoded FetchConfigResponse bytes
 
     def do_POST(self):
         n = int(self.headers.get("Content-Length", 0))
-        body = json.loads(self.rfile.read(n))
-        _FakeServer.requests.append((self.path, body))
-        out = json.dumps(_FakeServer.response).encode()
+        raw = self.rfile.read(n)
+        if self.path.endswith("/Heartbeat"):
+            _FakeServer.requests.append(
+                (self.path, pb.HeartbeatRequest.parse(raw)))
+            out = _FakeServer.response
+        else:
+            _FakeServer.requests.append(
+                (self.path, pb.FetchConfigRequest.parse(raw)))
+            out = _FakeServer.fetch_response
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Length", str(len(out)))
         self.end_headers()
         self.wfile.write(out)
 
@@ -29,15 +41,21 @@ class _FakeServer(http.server.BaseHTTPRequestHandler):
         pass
 
 
+def _hb_response(updates=(), flags=0) -> bytes:
+    resp = pb.HeartbeatResponse()
+    resp.request_id = b"r"
+    resp.flags = flags
+    resp.continuous_pipeline_config_updates.extend(updates)
+    return resp.encode()
+
+
 class TestCommonConfigProvider:
     def test_heartbeat_materializes_configs(self, tmp_path):
         _FakeServer.requests = []
-        _FakeServer.response = {
-            "pipeline_config_updates": [
-                {"name": "remote-pipe", "version": 3,
-                 "detail": {"inputs": [], "processors": [], "flushers": []}},
-            ],
-        }
+        detail = json.dumps(
+            {"inputs": [], "processors": [], "flushers": []}).encode()
+        _FakeServer.response = _hb_response(
+            [pb.ConfigDetail(name="remote-pipe", version=3, detail=detail)])
         server = http.server.HTTPServer(("127.0.0.1", 0), _FakeServer)
         port = server.server_address[1]
         threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -47,22 +65,63 @@ class TestCommonConfigProvider:
             os.makedirs(provider.config_dir, exist_ok=True)
             provider.feedback("old-cfg", "applied")
             assert provider.heartbeat_once()
-            path, body = _FakeServer.requests[0]
-            assert path == "/v2/Agent/Heartbeat"
-            assert body["agent_type"] == "loongcollector-tpu"
-            assert body["config_feedback"][0]["name"] == "old-cfg"
+            path, req = _FakeServer.requests[0]
+            assert path == "/Agent/Heartbeat"
+            assert req.agent_type == "loongcollector-tpu"
+            assert req.flags & pb.REQ_FULL_STATE
+            assert req.attributes is not None and req.attributes.hostname
+            fb = [c for c in req.continuous_pipeline_configs
+                  if c.name == "old-cfg"]
+            assert fb and fb[0].status == pb.APPLIED
             cfg_path = tmp_path / "remote" / "remote-pipe.json"
             assert cfg_path.exists()
             assert json.loads(cfg_path.read_text())["inputs"] == []
-            # version tracking: same version not re-materialized
+            # version tracking: same version not re-materialized; the next
+            # heartbeat reports the held config back to the server
             cfg_path.unlink()
             assert provider.heartbeat_once()
             assert not cfg_path.exists()
-            # removal
-            _FakeServer.response = {"removed_configs": ["remote-pipe"]}
+            _, req2 = _FakeServer.requests[-1]
+            held = [c for c in req2.continuous_pipeline_configs
+                    if c.name == "remote-pipe"]
+            assert held and held[0].version == 3
+            # removal: ConfigDetail with version == -1
+            _FakeServer.response = _hb_response(
+                [pb.ConfigDetail(name="remote-pipe", version=-1)])
             assert provider.heartbeat_once()
             with provider._lock:
                 assert "remote-pipe" not in provider._versions
+        finally:
+            server.shutdown()
+
+    def test_fetch_config_detail_flow(self, tmp_path):
+        """Server sets FetchContinuousPipelineConfigDetail: heartbeat
+        carries names only; details come from /Agent/FetchPipelineConfig."""
+        _FakeServer.requests = []
+        _FakeServer.response = _hb_response(
+            [pb.ConfigDetail(name="lazy-pipe", version=5)],
+            flags=pb.RESP_FETCH_CONTINUOUS_PIPELINE_CONFIG_DETAIL)
+        fetch = pb.FetchConfigResponse()
+        fetch.continuous_pipeline_config_updates.append(
+            pb.ConfigDetail(name="lazy-pipe", version=5,
+                            detail=b'{"inputs": [1]}'))
+        _FakeServer.fetch_response = fetch.encode()
+        server = http.server.HTTPServer(("127.0.0.1", 0), _FakeServer)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            provider = CommonConfigProvider(
+                f"http://127.0.0.1:{port}", str(tmp_path / "remote"))
+            os.makedirs(provider.config_dir, exist_ok=True)
+            assert provider.heartbeat_once()
+            paths = [p for p, _ in _FakeServer.requests]
+            assert paths == ["/Agent/Heartbeat",
+                             "/Agent/FetchPipelineConfig"]
+            _, fetch_req = _FakeServer.requests[1]
+            [want] = fetch_req.continuous_pipeline_configs
+            assert (want.name, want.version) == ("lazy-pipe", 5)
+            cfg_path = tmp_path / "remote" / "lazy-pipe.json"
+            assert json.loads(cfg_path.read_text())["inputs"] == [1]
         finally:
             server.shutdown()
 
