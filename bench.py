@@ -12,6 +12,7 @@ report under "extra".
 """
 
 import json
+import os
 import sys
 import time
 
@@ -327,6 +328,30 @@ def bench_pipeline_e2e(n_lines=60000):
             sojourns[int(len(sojourns) * 0.99)])
 
 
+def bench_resource():
+    """CPU% / RSS at 10 MB/s, the reference's regression-harness metric
+    (BASELINE.md: 3.4 % CPU / 29 MB simple, 14.2 % / 34 MB regex).  Runs
+    the REAL agent as a subprocess via scripts/resource_bench.py — short
+    windows here; run the script standalone for full-length measurements."""
+    import signal
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, "scripts/resource_bench.py", "--duration", "12"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True)   # own process group: timeout kill reaps
+    try:                          # the agent subprocesses too, no orphans
+        stdout, stderr = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise
+    if proc.returncode != 0:
+        raise RuntimeError(f"resource bench rc={proc.returncode}: "
+                           f"{stderr[-300:]}")
+    return json.loads(stdout)
+
+
 def _safe(fn, default=-1.0):
     """Sub-benchmarks must never take down the primary metric line."""
     try:
@@ -386,6 +411,9 @@ def main():
         extra["pipeline_e2e_MBps"] = round(e2e3[0], 1)
         extra["event_to_flush_ms_p50"] = round(e2e3[1], 2)
         extra["event_to_flush_ms_p99"] = round(e2e3[2], 2)
+    res = _safe(bench_resource, default=None)
+    if res is not None:
+        extra["resource_10MBps"] = res
     line = {
         "metric": "regex_parse_throughput",
         "value": round(mbps, 1),

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Resource regression harness: CPU% / RSS while ingesting 10 MB/s.
+
+Reference: test/benchmark/local/test_cases/performance_file_to_blackhole_*
++ docs/cn/developer-guide/test/benchmark.md:43-56 — the reference feeds
+10 MB/s of 512-byte lines into a file-tail pipeline and records the
+agent's CPU max/avg and RAM max/avg via cadvisor.  BASELINE.md rows:
+3.40 % CPU / 29 MB RAM (simple), 5.82 % / 29 MB (multiline),
+14.20 % / 34 MB (regex).
+
+This harness does the same against OUR agent without docker: it launches
+`python -m loongcollector_tpu` as a subprocess, appends 512-byte lines at
+the target rate, and samples /proc/<pid>/stat (utime+stime) and VmRSS
+once per second.  Scenarios: simple (raw tail -> blackhole), regex
+(apache parse), multiline (java stacktrace assembly).
+
+Standalone:  python scripts/resource_bench.py [--duration 30] [--rate 10]
+Importable:  run_all(duration_s, rate_mbps) -> {scenario: {...}} —
+bench.py embeds a short run into its JSON `extra`.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+APACHE_RE = (r'(\S+) (\S+) (\S+) \[([^\]]+)\] '
+             r'"(\S+) (\S+) ([^"]*)" (\d{3}) (\d+)')
+
+_CLK = os.sysconf("SC_CLK_TCK")
+
+
+def _pipeline_yaml(scenario: str, log_path: str) -> str:
+    head = ("inputs:\n"
+            "  - Type: input_file\n"
+            "    FilePaths:\n"
+            f"      - {log_path}\n")
+    if scenario == "regex":
+        procs = ("processors:\n"
+                 "  - Type: processor_parse_regex_tpu\n"
+                 "    SourceKey: content\n"
+                 f"    Regex: '{APACHE_RE}'\n"
+                 "    Keys: [ip, ident, user, time, method, url, protocol,"
+                 " status, size]\n")
+    elif scenario == "multiline":
+        procs = ("processors:\n"
+                 "  - Type: processor_split_multiline_log_string_native\n"
+                 "    Multiline:\n"
+                 "      StartPattern: '\\d{4}-\\d{2}-\\d{2} .*'\n")
+    else:
+        procs = ""
+    return head + procs + "flushers:\n  - Type: flusher_blackhole\n"
+
+
+def _make_line(scenario: str, i: int, size: int = 512) -> bytes:
+    if scenario == "regex":
+        base = (f'10.0.{(i >> 8) & 255}.{i & 255} - user{i % 997} '
+                f'[10/Oct/2000:13:55:{i % 60:02d} -0700] '
+                f'"GET /api/v1/resource/{i} HTTP/1.1" 200 ')
+        pad = size - len(base) - 1
+        return (base + str(10 ** (pad - 1))).encode()[:size - 1] + b"\n"
+    if scenario == "multiline" and i % 4:
+        body = f"  at com.example.Cls{i % 89}.method(Cls.java:{i % 997})"
+        return (body + " " * (size - len(body) - 1)).encode() + b"\n"
+    stamp = f"2024-01-02 03:04:{i % 60:02d} INFO request {i} handled "
+    return (stamp + "x" * (size - len(stamp) - 1)).encode() + b"\n"
+
+
+def _sample(pid: int):
+    """(cpu_ticks_total, rss_mb) or None if the process is gone."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            parts = f.read().rsplit(b")", 1)[1].split()
+        ticks = int(parts[11]) + int(parts[12])   # utime + stime
+        rss_mb = 0.0
+        with open(f"/proc/{pid}/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"VmRSS:"):
+                    rss_mb = int(line.split()[1]) / 1024.0
+                    break
+        return ticks, rss_mb
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def run_scenario(scenario: str, duration_s: float = 30.0,
+                 rate_mbps: float = 10.0) -> dict:
+    work = tempfile.mkdtemp(prefix=f"resbench_{scenario}_")
+    cfg_dir = os.path.join(work, "config")
+    os.makedirs(cfg_dir)
+    log_path = os.path.join(work, "in.log")
+    open(log_path, "wb").close()
+    with open(os.path.join(cfg_dir, "bench.yaml"), "w") as f:
+        f.write(_pipeline_yaml(scenario, log_path))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "loongcollector_tpu",
+         "--config", cfg_dir, "--data-dir", os.path.join(work, "data")],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(4.0)                      # startup + first discovery
+        if proc.poll() is not None:
+            raise RuntimeError(f"agent died rc={proc.returncode}")
+        chunk_lines = max(1, int(rate_mbps * 1e6 / 512 / 10))
+        line_no = 0
+        # warm-up feed (engine/tier selection happens on first batch)
+        with open(log_path, "ab") as sink_f:
+            for _ in range(3):
+                buf = bytearray()
+                for _ in range(chunk_lines):
+                    buf += _make_line(scenario, line_no)
+                    line_no += 1
+                sink_f.write(buf)
+                sink_f.flush()
+                time.sleep(0.1)
+        base = _sample(proc.pid)
+        if base is None:
+            raise RuntimeError("agent vanished during warm-up")
+        cpu_samples, rss_samples = [], []
+        t0 = time.monotonic()
+        lines_at_t0 = line_no          # warm-up bytes don't count
+        last_ticks, last_t = base[0], t0
+        next_write = t0
+        with open(log_path, "ab") as sink_f:
+            while time.monotonic() - t0 < duration_s:
+                buf = bytearray()
+                for _ in range(chunk_lines):
+                    buf += _make_line(scenario, line_no)
+                    line_no += 1
+                sink_f.write(buf)
+                sink_f.flush()
+                next_write += 0.1
+                sleep = next_write - time.monotonic()
+                if sleep > 0:
+                    time.sleep(sleep)
+                now = time.monotonic()
+                if now - last_t >= 1.0:
+                    s = _sample(proc.pid)
+                    if s is None:
+                        raise RuntimeError("agent died mid-bench")
+                    ticks, rss = s
+                    cpu_samples.append(
+                        (ticks - last_ticks) / _CLK / (now - last_t) * 100)
+                    rss_samples.append(rss)
+                    last_ticks, last_t = ticks, now
+        fed_mb = (line_no - lines_at_t0) * 512 / 1e6
+        if not cpu_samples:
+            raise RuntimeError("bench window too short for samples")
+        return {
+            "cpu_pct_avg": round(sum(cpu_samples) / len(cpu_samples), 2),
+            "cpu_pct_max": round(max(cpu_samples), 2),
+            "rss_mb_avg": round(sum(rss_samples) / len(rss_samples), 1),
+            "rss_mb_max": round(max(rss_samples), 1),
+            "fed_MB": round(fed_mb, 1),
+            "rate_MBps": round(fed_mb / (time.monotonic() - t0), 2),
+        }
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def run_all(duration_s: float = 30.0, rate_mbps: float = 10.0) -> dict:
+    out = {}
+    for scenario in ("simple", "regex", "multiline"):
+        out[scenario] = run_scenario(scenario, duration_s, rate_mbps)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--rate", type=float, default=10.0)
+    ap.add_argument("--scenario", choices=["simple", "regex", "multiline"])
+    args = ap.parse_args()
+    if args.scenario:
+        res = {args.scenario: run_scenario(args.scenario, args.duration,
+                                           args.rate)}
+    else:
+        res = run_all(args.duration, args.rate)
+    print(json.dumps(res, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
