@@ -1,0 +1,99 @@
+/* Versioned C ABI between the collector and an eBPF driver library.
+ *
+ * Reference boundary: core/ebpf/EBPFAdapter.cpp:149-231 dlopens the driver
+ * (BPF program loading + perf-buffer polling live there) and talks to it
+ * through a fixed symbol set; core/collection_pipeline/plugin/creator/
+ * CProcessor.h is the pattern for VERSIONED out-of-tree plugin ABIs.
+ *
+ * The collector never links the driver: it dlopens a .so exposing ONE
+ * symbol, loong_ebpf_driver_get(), returning a vtable whose first two
+ * fields pin the ABI version and the event-struct size.  Any real kernel
+ * driver (coolbpf-style) and the in-tree simulation implement the same
+ * table, so "eBPF support" survives contact with a real driver.
+ *
+ * Layout rules: fixed-size POD only, 8-byte alignment, no pointers inside
+ * the event (the event must be copyable across the boundary and, later,
+ * straight out of a perf-buffer mmap).
+ */
+
+#ifndef LOONG_EBPF_DRIVER_ABI_H
+#define LOONG_EBPF_DRIVER_ABI_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define LOONG_EBPF_ABI_VERSION 1u
+
+/* event sources (mirrors the collector's EventSource enum) */
+enum loong_ebpf_source {
+    LOONG_EBPF_NETWORK_OBSERVE  = 0,
+    LOONG_EBPF_PROCESS_SECURITY = 1,
+    LOONG_EBPF_FILE_SECURITY    = 2,
+    LOONG_EBPF_NETWORK_SECURITY = 3,
+    LOONG_EBPF_CPU_PROFILING    = 4,
+    LOONG_EBPF_SOURCE_COUNT     = 5
+};
+
+enum loong_ebpf_direction {
+    LOONG_EBPF_DIR_NONE    = 0,
+    LOONG_EBPF_DIR_INGRESS = 1,
+    LOONG_EBPF_DIR_EGRESS  = 2
+};
+
+#define LOONG_EBPF_CALLNAME_MAX 32
+#define LOONG_EBPF_PATH_MAX     128
+#define LOONG_EBPF_ADDR_MAX     64
+#define LOONG_EBPF_PAYLOAD_MAX  4096
+#define LOONG_EBPF_STACK_DEPTH  32
+#define LOONG_EBPF_FRAME_MAX    96
+
+/* one raw kernel event — what a perf buffer would deliver */
+typedef struct loong_ebpf_event {
+    uint64_t timestamp_ns;
+    uint32_t source;                       /* enum loong_ebpf_source   */
+    int32_t  pid;
+    int32_t  fd;                           /* -1 when not applicable   */
+    uint32_t flags;
+    uint16_t direction;                    /* enum loong_ebpf_direction */
+    uint16_t stack_depth;                  /* used frames              */
+    uint32_t payload_len;                  /* used bytes of payload    */
+    char     call_name[LOONG_EBPF_CALLNAME_MAX];   /* NUL-terminated   */
+    char     path[LOONG_EBPF_PATH_MAX];
+    char     local_addr[LOONG_EBPF_ADDR_MAX];
+    char     remote_addr[LOONG_EBPF_ADDR_MAX];
+    uint8_t  payload[LOONG_EBPF_PAYLOAD_MAX];
+    char     stack[LOONG_EBPF_STACK_DEPTH][LOONG_EBPF_FRAME_MAX];
+} loong_ebpf_event_t;
+
+/* delivered on the driver's poll thread; the collector must not block */
+typedef void (*loong_ebpf_cb)(const loong_ebpf_event_t *ev, void *user);
+
+/* return codes */
+#define LOONG_EBPF_OK        0
+#define LOONG_EBPF_EINVAL   -1
+#define LOONG_EBPF_ESTATE   -2
+
+typedef struct loong_ebpf_driver {
+    uint32_t abi_version;     /* must equal LOONG_EBPF_ABI_VERSION      */
+    uint32_t event_size;      /* must equal sizeof(loong_ebpf_event_t)  */
+    int (*start)(uint32_t source, loong_ebpf_cb cb, void *user);
+    int (*stop)(uint32_t source);
+    int (*suspend)(uint32_t source);
+    int (*resume)(uint32_t source);
+    /* simulation/test hook: inject one event as if read from the kernel;
+     * a real kernel driver returns LOONG_EBPF_EINVAL here */
+    int (*inject)(const loong_ebpf_event_t *ev);
+} loong_ebpf_driver_t;
+
+/* the ONE exported symbol */
+const loong_ebpf_driver_t *loong_ebpf_driver_get(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LOONG_EBPF_DRIVER_ABI_H */

@@ -1,0 +1,139 @@
+/* Simulation eBPF driver: an out-of-tree-shaped implementation of the
+ * loong_ebpf_driver ABI (ebpf_driver_abi.h), loaded by the collector via
+ * dlopen exactly like a real kernel driver would be.
+ *
+ * Reference analogue: core/ebpf/driver/ — the reference compiles its BPF
+ * wrapper layer into a separate library the agent dlopens
+ * (EBPFAdapter.cpp:149-231).  In unprivileged containers no kernel BPF can
+ * load, so this driver substitutes a deterministic event source: events
+ * arrive via inject() (tests, replay harnesses) and are delivered to the
+ * registered callback on a dedicated poll thread — preserving the real
+ * driver's threading contract (callbacks never run on the injecting
+ * thread, just as perf-buffer callbacks never run on the producing CPU's
+ * context).
+ */
+
+#include "ebpf_driver_abi.h"
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+namespace {
+
+struct SourceState {
+    loong_ebpf_cb cb = nullptr;
+    void *user = nullptr;
+    bool running = false;
+    bool suspended = false;
+};
+
+/* Deliberately LEAKED singletons: the poll thread is detached and may
+ * still be blocked on the condvar when the process exits; running static
+ * destructors under it (destroying a condvar in use) is UB and hangs
+ * interpreter shutdown.  Process-lifetime objects are never destroyed. */
+std::mutex &g_mu = *new std::mutex;
+std::condition_variable &g_cv = *new std::condition_variable;
+SourceState g_sources[LOONG_EBPF_SOURCE_COUNT];
+std::deque<loong_ebpf_event_t> &g_queue =
+    *new std::deque<loong_ebpf_event_t>;  /* the simulated perf buffer */
+bool g_poll_running = false;
+bool g_shutdown = false;
+
+void poll_loop() {
+    std::unique_lock<std::mutex> lk(g_mu);
+    while (!g_shutdown) {
+        g_cv.wait(lk, [] { return g_shutdown || !g_queue.empty(); });
+        while (!g_queue.empty()) {
+            loong_ebpf_event_t ev = g_queue.front();
+            g_queue.pop_front();
+            if (ev.source >= LOONG_EBPF_SOURCE_COUNT) continue;
+            SourceState &st = g_sources[ev.source];
+            if (!st.running || st.suspended || !st.cb) continue;
+            loong_ebpf_cb cb = st.cb;
+            void *user = st.user;
+            lk.unlock();              /* never deliver under the lock */
+            cb(&ev, user);
+            lk.lock();
+        }
+    }
+}
+
+void ensure_poll_thread() {
+    if (!g_poll_running) {
+        g_shutdown = false;
+        std::thread(poll_loop).detach();  /* process-lifetime perf poller */
+        g_poll_running = true;
+    }
+}
+
+int drv_start(uint32_t source, loong_ebpf_cb cb, void *user) {
+    if (source >= LOONG_EBPF_SOURCE_COUNT || cb == nullptr)
+        return LOONG_EBPF_EINVAL;
+    std::lock_guard<std::mutex> lk(g_mu);
+    SourceState &st = g_sources[source];
+    if (st.running) return LOONG_EBPF_ESTATE;
+    st.cb = cb;
+    st.user = user;
+    st.running = true;
+    st.suspended = false;
+    ensure_poll_thread();
+    return LOONG_EBPF_OK;
+}
+
+int drv_stop(uint32_t source) {
+    if (source >= LOONG_EBPF_SOURCE_COUNT) return LOONG_EBPF_EINVAL;
+    std::lock_guard<std::mutex> lk(g_mu);
+    SourceState &st = g_sources[source];
+    if (!st.running) return LOONG_EBPF_ESTATE;
+    st.running = false;
+    st.cb = nullptr;
+    st.user = nullptr;
+    return LOONG_EBPF_OK;
+}
+
+int drv_suspend(uint32_t source) {
+    if (source >= LOONG_EBPF_SOURCE_COUNT) return LOONG_EBPF_EINVAL;
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (!g_sources[source].running) return LOONG_EBPF_ESTATE;
+    g_sources[source].suspended = true;
+    return LOONG_EBPF_OK;
+}
+
+int drv_resume(uint32_t source) {
+    if (source >= LOONG_EBPF_SOURCE_COUNT) return LOONG_EBPF_EINVAL;
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (!g_sources[source].running) return LOONG_EBPF_ESTATE;
+    g_sources[source].suspended = false;
+    return LOONG_EBPF_OK;
+}
+
+int drv_inject(const loong_ebpf_event_t *ev) {
+    if (ev == nullptr || ev->source >= LOONG_EBPF_SOURCE_COUNT)
+        return LOONG_EBPF_EINVAL;
+    if (ev->payload_len > LOONG_EBPF_PAYLOAD_MAX ||
+        ev->stack_depth > LOONG_EBPF_STACK_DEPTH)
+        return LOONG_EBPF_EINVAL;
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_queue.push_back(*ev);
+    g_cv.notify_one();
+    return LOONG_EBPF_OK;
+}
+
+const loong_ebpf_driver_t g_driver = {
+    LOONG_EBPF_ABI_VERSION,
+    (uint32_t)sizeof(loong_ebpf_event_t),
+    drv_start,
+    drv_stop,
+    drv_suspend,
+    drv_resume,
+    drv_inject,
+};
+
+}  // namespace
+
+extern "C" const loong_ebpf_driver_t *loong_ebpf_driver_get(void) {
+    return &g_driver;
+}
