@@ -3,7 +3,8 @@ aggregator plugins, plugins/aggregator/*)."""
 
 
 def register_all(registry) -> None:
-    from .base import (AggregatorBase, AggregatorContext,
+    from .base import (AggregatorBase, AggregatorContentValueGroup,
+                       AggregatorContext, AggregatorLogstoreRouter,
                        AggregatorMetadataGroup, AggregatorShardHash)
 
     registry.register_aggregator("aggregator_base", AggregatorBase)
@@ -11,3 +12,7 @@ def register_all(registry) -> None:
     registry.register_aggregator("aggregator_metadata_group",
                                  AggregatorMetadataGroup)
     registry.register_aggregator("aggregator_shardhash", AggregatorShardHash)
+    registry.register_aggregator("aggregator_content_value_group",
+                                 AggregatorContentValueGroup)
+    registry.register_aggregator("aggregator_logstore_router",
+                                 AggregatorLogstoreRouter)

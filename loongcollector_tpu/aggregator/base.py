@@ -171,6 +171,96 @@ class AggregatorMetadataGroup(AggregatorBase):
             out.set_tag(k, v)
 
 
+class AggregatorContentValueGroup(AggregatorMetadataGroup):
+    """Group logs whose named content fields share values; the values
+    become group tags (plugins/aggregator/contentvaluegroup).  `GroupKeys`
+    names the fields; `Topic` optionally stamps the output groups;
+    `ErrIfKeyNotFound` only affects logging in the reference — missing
+    keys group under the empty value either way."""
+
+    name = "aggregator_content_value_group"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        cfg = dict(config)
+        cfg["GroupMetadataKeys"] = config.get("GroupKeys", [])
+        if not AggregatorMetadataGroup.init(self, cfg, context):
+            return False
+        self.topic = str(config.get("Topic", "")).encode()
+        return True
+
+    def _group_meta(self, out, key, src) -> None:
+        super()._group_meta(out, key, src)
+        if self.topic:
+            out.set_tag(b"__topic__", self.topic)
+
+
+class AggregatorLogstoreRouter(AggregatorBase):
+    """Route each log to a logstore by regex on one field's value
+    (plugins/aggregator/logstorerouter): RouterRegex[i] sends matching
+    logs toward RouterLogstore[i] (recorded as the output group's
+    __logstore__ tag for FlusherSLS routing); non-matching logs keep the
+    default logstore unless DropDisMatch."""
+
+    name = "aggregator_logstore_router"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        if not AggregatorBase.init(self, config, context):
+            return False
+        import re as _re
+        self.source_key = str(config.get("SourceKey", "content")).encode()
+        regexes = config.get("RouterRegex", [])
+        stores = config.get("RouterLogstore", [])
+        if len(regexes) != len(stores) or not regexes:
+            return False
+        self.routes = [(_re.compile(str(r).encode()), str(s).encode())
+                       for r, s in zip(regexes, stores)]
+        self.drop_dismatch = bool(config.get("DropDisMatch", False))
+        return True
+
+    _DROP = object()
+
+    def _route(self, ev) -> object:
+        get = getattr(ev, "get_content", None)
+        val = get(self.source_key) if get is not None else None
+        if val is not None:
+            data = bytes(val)
+            for rx, store in self.routes:
+                # unanchored, like the Go plugin's regexp.MatchString
+                if rx.search(data):
+                    return store
+        return self._DROP if self.drop_dismatch else b""
+
+    def _key(self, group: PipelineEventGroup, ev) -> Tuple:
+        return (self._route(ev), self._tag_fingerprint(group))
+
+    def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
+        cols = group.columns
+        if cols is not None and not group._events:
+            group.materialize()     # routing needs per-event field access
+        done: List[PipelineEventGroup] = []
+        with self._lock:
+            for ev in group.events:
+                key = self._key(group, ev)
+                if key[0] is self._DROP:
+                    continue
+                b = self._buckets.get(key)
+                if b is None or \
+                        b.group.source_buffer is not group.source_buffer:
+                    if b is not None:
+                        done.append(b.group)
+                    out = PipelineEventGroup(group.source_buffer)
+                    self._group_meta(out, key, group)
+                    if key[0]:
+                        out.set_tag(b"__logstore__", key[0])
+                    b = self._buckets[key] = _Bucket(out)
+                b.group.events.append(ev)
+                b.count += 1
+                if b.count >= self.max_count:
+                    done.append(b.group)
+                    del self._buckets[key]
+        return done
+
+
 class AggregatorShardHash(Aggregator):
     """Set the SLS shard-hash metadata from key field/tag values
     (plugins/aggregator/shardhash; FlusherSLS's shard routing)."""

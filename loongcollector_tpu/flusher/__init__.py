@@ -12,6 +12,8 @@ def register_all(registry) -> None:
     from .loki import FlusherLoki
     from .otlp import FlusherOTLP
     from .prometheus_rw import FlusherPrometheus
+    from .grpc_flusher import FlusherGrpc
+    from .pulsar import FlusherPulsar
     from .sls import FlusherSLS
     from .stdout import FlusherStdout
 
@@ -27,3 +29,5 @@ def register_all(registry) -> None:
     registry.register_flusher("flusher_otlp", FlusherOTLP)
     registry.register_flusher("flusher_prometheus", FlusherPrometheus)
     registry.register_flusher("flusher_doris", FlusherDoris)
+    registry.register_flusher("flusher_pulsar", FlusherPulsar)
+    registry.register_flusher("flusher_grpc", FlusherGrpc)

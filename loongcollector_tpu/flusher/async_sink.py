@@ -1,0 +1,138 @@
+"""Own-connection sinks: a per-flusher sender thread with retry/backoff.
+
+Non-HTTP flushers (Pulsar's binary protocol, gRPC channels) cannot ride
+the HttpSink event loop.  The reference runs each Go flusher on its own
+goroutines (pluginmanager/plugin_runner_v1.go flusher goroutine group);
+this mirror gives such flushers one dedicated sender thread:
+
+  batcher flush → bounded in-memory queue → sender thread →
+  deliver() with exponential backoff until TTL → drop with error
+
+so a down broker never blocks the pipeline's processing thread, and
+transient outages are retried far longer than any inline attempt could.
+A configured RequestBreaker extension gates deliveries; drain happens on
+stop() with a deadline.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext
+from ..utils.logger import get_logger
+from .http_base import HttpSinkFlusher
+
+log = get_logger("async_sink")
+
+QUEUE_CAP = 256              # pending payloads per flusher
+RETRY_TTL_S = 300.0          # give up on a payload after this long
+RETRY_MAX_DELAY_S = 10.0
+
+
+class AsyncSinkFlusher(HttpSinkFlusher):
+    """Subclasses implement deliver(payload: bytes) -> None (raise on
+    failure) plus the usual _init_sink/build_payload."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._queue: collections.deque = collections.deque()
+        self._qlock = threading.Lock()
+        self._qcv = threading.Condition(self._qlock)
+        self._sender: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- subclass surface ---------------------------------------------------
+
+    def deliver(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def retryable(self, exc: Exception) -> bool:
+        return True
+
+    # -- framework ----------------------------------------------------------
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        if not super().init(config, context):
+            return False
+        self._running = True
+        self._sender = threading.Thread(target=self._sender_loop,
+                                        name=f"{self.name}-sender",
+                                        daemon=True)
+        self._sender.start()
+        return True
+
+    def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        built = self.build_payload(groups)
+        if built is None:
+            return
+        body, _ = built
+        with self._qcv:
+            if len(self._queue) >= QUEUE_CAP:
+                dropped = self._queue.popleft()   # oldest-first shedding
+                log.error("%s queue full; dropping oldest payload "
+                          "(%d bytes)", self.name, len(dropped[0]))
+            self._queue.append((body, time.monotonic()))
+            self._qcv.notify()
+
+    def _sender_loop(self) -> None:
+        delay = 0.2
+        while True:
+            with self._qcv:
+                while self._running and not self._queue:
+                    self._qcv.wait(timeout=0.5)
+                if not self._running and not self._queue:
+                    return
+                if not self._queue:
+                    continue
+                body, born = self._queue[0]
+            if self.breaker is not None and not self.breaker.allow():
+                time.sleep(min(delay, 1.0))
+                continue
+            try:
+                self.deliver(body)
+                ok = True
+            except Exception as e:  # noqa: BLE001
+                ok = False
+                if not self.retryable(e) \
+                        or time.monotonic() - born > RETRY_TTL_S:
+                    log.error("%s delivery failed permanently, dropping "
+                              "%d bytes: %s", self.name, len(body), e)
+                    ok = None                      # drop, don't count
+                else:
+                    log.warning("%s delivery failed, will retry: %s",
+                                self.name, e)
+            if self.breaker is not None and ok is not None:
+                self.breaker.on_result(ok)
+            if ok is False:
+                time.sleep(delay)
+                delay = min(delay * 2, RETRY_MAX_DELAY_S)
+                continue
+            delay = 0.2
+            with self._qcv:
+                if self._queue:
+                    self._queue.popleft()
+
+    def build_request(self, item):
+        raise RuntimeError(f"{self.name} sends on its own connection")
+
+    def endpoint_url(self, item) -> str:
+        return ""
+
+    def on_send_done(self, item, status: int, body: bytes) -> str:
+        return "ok"
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        super().stop(is_pipeline_removing)    # final batcher flush enqueues
+        deadline = time.monotonic() + 10
+        with self._qcv:
+            self._running = False
+            self._qcv.notify_all()
+        if self._sender is not None:
+            self._sender.join(timeout=max(0.1,
+                                          deadline - time.monotonic()))
+            self._sender = None
+        return True

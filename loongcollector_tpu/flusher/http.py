@@ -47,6 +47,10 @@ class FlusherHTTP(Flusher):
         self.batcher: Batcher = None  # type: ignore
         self.eo_sender = None  # ExactlyOnceSender when ExactlyOnce configured
         self._eo_stop = False
+        self.authenticator = None     # extension refs (resolve_http_extensions)
+        self.breaker = None
+        self.flush_interceptor = None
+        self._encoder_ext = None
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -54,7 +58,16 @@ class FlusherHTTP(Flusher):
         if not self.remote_url:
             return False
         self.headers = dict(config.get("Headers", {}))
+        from .http_base import resolve_http_extensions
+        if not resolve_http_extensions(self, config, context):
+            return False
         fmt = config.get("Format", "json")
+        # an encoder EXTENSION ref overrides the built-in Format choice
+        enc_ref = config.get("Encoder")
+        self._encoder_ext = (context.get_extension(str(enc_ref))
+                             if enc_ref else None)
+        if enc_ref and self._encoder_ext is None:
+            return False
         self.serializer = (SLSEventGroupSerializer() if fmt == "sls_pb"
                            else JsonSerializer())
         self.compressor = create_compressor(config.get("Compression"))
@@ -82,6 +95,9 @@ class FlusherHTTP(Flusher):
                 concurrency=int(eo_cfg.get("Concurrency", 8)))
 
     def send(self, group: PipelineEventGroup) -> bool:
+        if self.flush_interceptor is not None \
+                and not self.flush_interceptor.filter([group]):
+            return True                 # filtered out, not an error
         if self.eo_sender is not None:
             return self._send_exactly_once(group)
         self.batcher.add(group)
@@ -124,7 +140,10 @@ class FlusherHTTP(Flusher):
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
-        data = self.serializer.serialize(groups)
+        if self._encoder_ext is not None:
+            data = self._encoder_ext.encode(groups)
+        else:
+            data = self.serializer.serialize(groups)
         raw_size = len(data)
         payload = self.compressor.compress(data)
         item = SenderQueueItem(payload, raw_size, flusher=self,
@@ -133,6 +152,8 @@ class FlusherHTTP(Flusher):
             self.sender_queue.push(item)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        from .http_base import check_breaker
+        check_breaker(self)
         headers = dict(self.headers)
         headers.setdefault("Content-Type",
                            "application/x-protobuf"
@@ -141,11 +162,16 @@ class FlusherHTTP(Flusher):
         if self.compressor.name != "none":
             headers["Content-Encoding"] = self.compressor.name
             headers["x-log-bodyrawsize"] = str(item.raw_size)
-        return HttpRequest("POST", self.remote_url, headers, item.data)
+        req = HttpRequest("POST", self.remote_url, headers, item.data)
+        if self.authenticator is not None:
+            self.authenticator.apply(req)
+        return req
 
     def on_send_done(self, item: SenderQueueItem, status: int,
                      body: bytes) -> str:
         """Returns 'ok' | 'retry' | 'drop' (reference OnSendDone semantics)."""
+        if self.breaker is not None:
+            self.breaker.on_result(200 <= status < 300)
         cp = item.tag.get("eo_cp")
         if 200 <= status < 300:
             if cp is not None and self.eo_sender is not None:

@@ -34,6 +34,9 @@ class HttpSinkFlusher(Flusher):
         self.headers: Dict[str, str] = {}
         self.compressor = None
         self.batcher: Batcher = None  # type: ignore
+        self.authenticator = None     # extension refs; resolved at init
+        self.breaker = None
+        self.flush_interceptor = None
 
     # -- subclass surface ---------------------------------------------------
 
@@ -54,6 +57,8 @@ class HttpSinkFlusher(Flusher):
         super().init(config, context)
         if not self._init_sink(config):
             return False
+        if not resolve_http_extensions(self, config, context):
+            return False
         self.headers = dict(config.get("Headers", {}))
         self.compressor = create_compressor(
             config.get("Compression", self.default_compression))
@@ -68,6 +73,9 @@ class HttpSinkFlusher(Flusher):
         return True
 
     def send(self, group: PipelineEventGroup) -> bool:
+        if self.flush_interceptor is not None \
+                and not self.flush_interceptor.filter([group]):
+            return True                 # filtered out, not an error
         self.batcher.add(group)
         return True
 
@@ -85,6 +93,7 @@ class HttpSinkFlusher(Flusher):
             self.sender_queue.push(item)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        check_breaker(self)
         headers = dict(self.headers)
         headers.setdefault("Content-Type", self.content_type)
         headers.update(item.tag.get("headers") or {})
@@ -92,11 +101,16 @@ class HttpSinkFlusher(Flusher):
             enc = {"zlib": "deflate"}.get(self.compressor.name,
                                           self.compressor.name)
             headers["Content-Encoding"] = enc
-        return HttpRequest("POST", self.endpoint_url(item), headers,
-                           item.data)
+        req = HttpRequest("POST", self.endpoint_url(item), headers,
+                          item.data)
+        if self.authenticator is not None:
+            self.authenticator.apply(req)
+        return req
 
     def on_send_done(self, item: SenderQueueItem, status: int,
                      body: bytes) -> str:
+        if self.breaker is not None:
+            self.breaker.on_result(200 <= status < 300)
         if 200 <= status < 300:
             return "ok"
         if status in (429, 500, 502, 503, 504) or status <= 0:
@@ -125,6 +139,41 @@ class AddressRotator:
 
     def next(self) -> str:
         return next(self._it)
+
+
+def resolve_http_extensions(flusher, config: Dict[str, Any],
+                            context: PluginContext) -> bool:
+    """Resolve Authenticator / RequestBreaker extension refs (reference:
+    flushers point at named instances from the pipeline's `extensions:`
+    section).  A dangling ref is a config error; no ref keeps the flusher
+    extension-free."""
+    flusher.authenticator = None
+    flusher.breaker = None
+    auth_ref = config.get("Authenticator")
+    if auth_ref:
+        flusher.authenticator = context.get_extension(str(auth_ref))
+        if flusher.authenticator is None:
+            return False
+    br_ref = config.get("RequestBreaker")
+    if br_ref:
+        flusher.breaker = context.get_extension(str(br_ref))
+        if flusher.breaker is None:
+            return False
+    flt_ref = config.get("FlushInterceptor")
+    if flt_ref:
+        flusher.flush_interceptor = context.get_extension(str(flt_ref))
+        if flusher.flush_interceptor is None:
+            return False
+    return True
+
+
+def check_breaker(flusher) -> None:
+    """Fail fast when the flusher's breaker is open: build_request raises,
+    FlusherRunner backs the item off without touching the endpoint."""
+    br = getattr(flusher, "breaker", None)
+    if br is not None and not br.allow():
+        from ..pipeline.plugin.extension import BreakerOpen
+        raise BreakerOpen(f"{flusher.name}: request breaker open")
 
 
 def basic_auth_header(config: Dict[str, Any]) -> Dict[str, str]:

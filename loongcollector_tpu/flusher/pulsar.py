@@ -1,0 +1,248 @@
+"""flusher_pulsar — Apache Pulsar producer over the binary wire protocol.
+
+Reference: plugins/flusher/pulsar/ wraps the Pulsar Go client; this
+implementation speaks the public binary protocol (PulsarApi.proto framing)
+directly, the same from-scratch approach as flusher/kafka_client.py:
+
+  simple frame:   [totalSize u32][commandSize u32][BaseCommand pb]
+  payload frame:  ... command ... [0x0e01][crc32c u32][metaSize u32]
+                  [MessageMetadata pb][payload]
+  crc32c covers metaSize+metadata+payload (Castagnoli, same table as the
+  Kafka client).
+
+Session: CONNECT → CONNECTED, PRODUCER → PRODUCER_SUCCESS, then SEND →
+SEND_RECEIPT per batch; PING answered with PONG.  The flusher connects to
+the broker given in `BrokerURL` (pulsar://host:6650) — topic lookup is the
+broker's job in multi-broker clusters and can be fronted by a proxy.
+
+Only the fields this producer needs are encoded; unknown response fields
+are skipped (proto3-style tolerance, agent_v2_pb.iter_fields).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..config.agent_v2_pb import (dec_varint, e_bytes, e_varint, enc_varint,
+                                  iter_fields)
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext
+from ..pipeline.queue.sender_queue import SenderQueueItem
+from ..utils.logger import get_logger
+from .async_sink import AsyncSinkFlusher
+from .kafka_client import crc32c
+
+log = get_logger("pulsar")
+
+# BaseCommand.Type (PulsarApi.proto)
+CONNECT = 2
+CONNECTED = 3
+PRODUCER = 5
+SEND = 6
+SEND_RECEIPT = 7
+SEND_ERROR = 8
+SUCCESS = 13
+ERROR = 14
+CLOSE_PRODUCER = 15
+PRODUCER_SUCCESS = 17
+PING = 18
+PONG = 19
+
+_MAGIC = b"\x0e\x01"
+
+
+def _cmd(cmd_type: int, field_no: int = 0, body: bytes = b"") -> bytes:
+    """BaseCommand{type=cmd_type, <field_no>=body} serialized."""
+    out = e_varint(1, cmd_type) if cmd_type else b""
+    # BaseCommand.type is field 1 (enum); the command payload is a nested
+    # message whose field number equals its position in BaseCommand
+    if field_no:
+        out += e_bytes(field_no, body)
+    return out
+
+
+def _frame_simple(command: bytes) -> bytes:
+    return struct.pack(">II", 4 + len(command), len(command)) + command
+
+
+def _frame_payload(command: bytes, metadata: bytes, payload: bytes) -> bytes:
+    meta_part = struct.pack(">I", len(metadata)) + metadata + payload
+    crc = crc32c(meta_part)
+    rest = (struct.pack(">I", len(command)) + command
+            + _MAGIC + struct.pack(">I", crc) + meta_part)
+    return struct.pack(">I", len(rest)) + rest
+
+
+class PulsarError(RuntimeError):
+    pass
+
+
+class PulsarProducer:
+    """One connection + one producer session on a broker."""
+
+    def __init__(self, broker_url: str, topic: str,
+                 timeout: float = 10.0):
+        u = urlparse(broker_url)
+        self.host = u.hostname or "localhost"
+        self.port = u.port or 6650
+        self.topic = topic
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._producer_name = ""
+        self._lock = threading.Lock()
+
+    # -- wire ---------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise PulsarError("connection closed by broker")
+            buf += chunk
+        return buf
+
+    def _read_frame(self) -> Tuple[int, Dict[int, bytes]]:
+        """Returns (command_type, {field_no: raw nested bytes})."""
+        total = struct.unpack(">I", self._read_exact(4))[0]
+        data = self._read_exact(total)
+        cmd_size = struct.unpack(">I", data[:4])[0]
+        command = data[4:4 + cmd_size]
+        cmd_type = 0
+        fields: Dict[int, bytes] = {}
+        for f, wt, v in iter_fields(command):
+            if f == 1 and wt == 0:
+                cmd_type = v
+            elif wt == 2:
+                fields[f] = bytes(v)
+        return cmd_type, fields
+
+    def _expect(self, want_type: int) -> Dict[int, bytes]:
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            cmd_type, fields = self._read_frame()
+            if cmd_type == PING:
+                self._sock.sendall(_frame_simple(_cmd(PONG)))
+                continue
+            if cmd_type == want_type:
+                return fields
+            if cmd_type in (ERROR, SEND_ERROR):
+                raise PulsarError(f"broker error: {fields}")
+            # unrelated command (e.g. broker notices) — keep waiting
+        raise PulsarError(f"timed out waiting for command {want_type}")
+
+    # -- session ------------------------------------------------------------
+
+    def connect(self) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self.timeout)
+        # CommandConnect{client_version=1, protocol_version=7}
+        body = e_bytes(1, "loongcollector-tpu") + e_varint(4, 7)
+        self._sock.sendall(_frame_simple(_cmd(CONNECT, 2, body)))
+        self._expect(CONNECTED)
+        # CommandProducer{topic=1, producer_id=2, request_id=3}
+        body = e_bytes(1, self.topic) + e_varint(2, 1) + e_varint(3, 1)
+        self._sock.sendall(_frame_simple(_cmd(PRODUCER, 5, body)))
+        fields = self._expect(PRODUCER_SUCCESS)
+        # CommandProducerSuccess{request_id=1, producer_name=2}
+        success = fields.get(17, b"")
+        for f, wt, v in iter_fields(success):
+            if f == 2 and wt == 2:
+                self._producer_name = bytes(v).decode("utf-8", "replace")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def send(self, payload: bytes,
+             properties: Optional[Dict[str, str]] = None) -> None:
+        """One message; blocks until SEND_RECEIPT (at-least-once)."""
+        with self._lock:
+            if self._sock is None:
+                self.connect()
+            self._seq += 1
+            seq = self._seq
+            try:
+                self._send_once(seq, payload, properties)
+            except (OSError, PulsarError):
+                # one reconnect attempt (broker restart / idle close)
+                self.close()
+                self.connect()
+                self._send_once(seq, payload, properties)
+
+    def _send_once(self, seq: int, payload: bytes, properties) -> None:
+        # CommandSend{producer_id=1, sequence_id=2, num_messages=3}
+        command = _cmd(SEND, 6, e_varint(1, 1) + e_varint(2, seq)
+                       + e_varint(3, 1))
+        # MessageMetadata{producer_name=1, sequence_id=2, publish_time=3,
+        #                 properties=4 (KeyValue{key=1,value=2})}
+        meta = (e_bytes(1, self._producer_name or "lct")
+                + e_varint(2, seq)
+                + e_varint(3, int(time.time() * 1000)))
+        for k, v in (properties or {}).items():
+            kv = e_bytes(1, k) + e_bytes(2, v)
+            meta += e_bytes(4, kv)
+        self._sock.sendall(_frame_payload(command, meta, payload))
+        fields = self._expect(SEND_RECEIPT)
+        receipt = fields.get(7, b"")
+        got_seq = None
+        for f, wt, v in iter_fields(receipt):
+            if f == 2 and wt == 0:
+                got_seq = v
+        if got_seq is not None and got_seq != seq:
+            raise PulsarError(f"receipt for seq {got_seq}, wanted {seq}")
+
+
+class FlusherPulsar(AsyncSinkFlusher):
+    """Batch → JSON/SLS-PB payload → Pulsar message (one per batch, with
+    pipeline properties), through the shared batcher machinery.  Delivery
+    runs on the flusher's OWN sender thread (async_sink.py) — a down
+    broker backs payloads up in the bounded queue with retry/backoff and
+    never blocks the pipeline's processing thread."""
+
+    name = "flusher_pulsar"
+    content_type = "application/octet-stream"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.producer: Optional[PulsarProducer] = None
+        self.fmt = "json"
+
+    def _init_sink(self, config: Dict[str, Any]) -> bool:
+        broker = config.get("BrokerURL") or config.get("URL", "")
+        topic = config.get("Topic", "")
+        if not broker or not topic:
+            return False
+        self.fmt = str(config.get("Format", "json")).lower()
+        self.producer = PulsarProducer(
+            broker, topic, timeout=float(config.get("TimeoutSecs", 10)))
+        return True
+
+    def build_payload(self, groups: List[PipelineEventGroup]):
+        if self.fmt in ("sls", "sls_pb"):
+            from ..pipeline.serializer.sls_serializer import \
+                SLSEventGroupSerializer
+            return SLSEventGroupSerializer().serialize(groups), {}
+        from ..pipeline.serializer.json_serializer import JsonSerializer
+        return JsonSerializer().serialize(groups), {}
+
+    def deliver(self, payload: bytes) -> None:
+        self.producer.send(
+            payload, {"pipeline": self.context.pipeline_name
+                      if self.context else ""})
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        super().stop(is_pipeline_removing)
+        if self.producer is not None:
+            self.producer.close()
+        return True

@@ -18,7 +18,10 @@ def register_all(registry) -> None:
     from .kafka import InputKafka
     from .mqtt import InputMQTT
     from .mysql_binlog import InputCanal
+    from .goprofile import InputGoProfile
+    from .lumberjack import InputLumberjack
     from .redis import InputRedis
+    from .skywalking import InputSkywalking
     from .snmp import InputSNMP
     from .syslog import InputSyslog
 
@@ -48,3 +51,8 @@ def register_all(registry) -> None:
     registry.register_input("service_kafka", InputKafka)
     registry.register_input("input_kafka", InputKafka)
     registry.register_input("service_canal", InputCanal)
+    registry.register_input("input_lumberjack", InputLumberjack)
+    registry.register_input("service_lumberjack", InputLumberjack)
+    registry.register_input("input_skywalking", InputSkywalking)
+    registry.register_input("input_goprofile", InputGoProfile)
+    registry.register_input("service_goprofile", InputGoProfile)

@@ -71,8 +71,14 @@ class GrpcInputManager:
     def __init__(self) -> None:
         self._servers: Dict[str, tuple] = {}  # addr -> (server, refcount)
         self._routes: Dict[str, int] = {}     # addr -> queue key
+        self._ports: Dict[str, int] = {}      # addr -> bound port (port 0)
         self._lock = threading.Lock()
         self.process_queue_manager = None
+
+    def bound_port(self, address: str) -> int:
+        """Actual bound port for an address (resolves ':0' test binds)."""
+        with self._lock:
+            return self._ports.get(address, 0)
 
     @classmethod
     def instance(cls) -> "GrpcInputManager":
@@ -121,6 +127,7 @@ class GrpcInputManager:
             self._routes[address] = queue_key
             server.start()
             self._servers[address] = (server, 1)
+            self._ports[address] = bound
         log.info("grpc forward listening on %s", address)
         return True
 
@@ -135,6 +142,7 @@ class GrpcInputManager:
                 return
             del self._servers[address]
             self._routes.pop(address, None)
+            self._ports.pop(address, None)
         server.stop(grace=1)
 
     def stop_all(self) -> None:

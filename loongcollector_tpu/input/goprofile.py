@@ -1,0 +1,225 @@
+"""input_goprofile — pull pprof profiles from Go services.
+
+Reference: plugins/input/goprofile/ — periodically scrapes targets'
+/debug/pprof endpoints (profile/heap/goroutine...) and ships the decoded
+profiles as events.
+
+The pprof wire format (google/pprof profile.proto, gzip-compressed):
+
+  Profile  { sample_type=1, sample=2, location=4, function=5,
+             string_table=6, time_nanos=9, duration_nanos=10 }
+  Sample   { location_id=1 (packed u64), value=2 (packed i64) }
+  Location { id=1, line=4 }
+  Line     { function_id=1, line=2 }
+  Function { id=1, name=2 (string-table index) }
+
+This decoder aggregates flat sample values per leaf function and emits the
+top-N as LogEvents (function, value, unit, profile type) — the shape the
+reference's profile pipeline ships — using the generic proto reader
+(config/agent_v2_pb); no pprof dependency.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..config.agent_v2_pb import dec_varint, iter_fields
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("goprofile")
+
+
+def _packed_varints(data: bytes) -> List[int]:
+    out = []
+    pos = 0
+    while pos < len(data):
+        v, pos = dec_varint(data, pos)
+        out.append(v)
+    return out
+
+
+def decode_pprof(data: bytes, top_n: int = 20) -> List[Tuple[str, int, str]]:
+    """[(function_name, flat_value, unit)] for the top-N leaf functions of
+    the LAST sample_type (pprof convention: cpu 'samples/count' first,
+    'cpu/nanoseconds' last; heap 'inuse_space' last)."""
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    strings: List[bytes] = []
+    samples: List[bytes] = []
+    locations: Dict[int, int] = {}      # location id -> function id
+    functions: Dict[int, int] = {}      # function id -> name string idx
+    sample_types: List[bytes] = []
+    for f, wt, v in iter_fields(data):
+        if wt != 2:
+            continue
+        v = bytes(v)
+        if f == 1:                       # ValueType{type=1, unit=2}
+            unit_idx = 0
+            for f2, wt2, v2 in iter_fields(v):
+                if f2 == 2 and wt2 == 0:
+                    unit_idx = v2
+            sample_types.append(unit_idx)
+        elif f == 2:
+            samples.append(v)
+        elif f == 4:                     # Location
+            loc_id = 0
+            func_id = 0
+            for f2, wt2, v2 in iter_fields(v):
+                if f2 == 1 and wt2 == 0:
+                    loc_id = v2
+                elif f2 == 4 and wt2 == 2:   # first Line wins (leaf)
+                    if func_id == 0:
+                        for f3, wt3, v3 in iter_fields(bytes(v2)):
+                            if f3 == 1 and wt3 == 0:
+                                func_id = v3
+            locations[loc_id] = func_id
+        elif f == 5:                     # Function
+            fid = 0
+            name_idx = 0
+            for f2, wt2, v2 in iter_fields(v):
+                if f2 == 1 and wt2 == 0:
+                    fid = v2
+                elif f2 == 2 and wt2 == 0:
+                    name_idx = v2
+            functions[fid] = name_idx
+        elif f == 6:
+            strings.append(v)
+    value_idx = max(0, len(sample_types) - 1)
+    unit = b"count"
+    if sample_types:
+        uidx = sample_types[value_idx]
+        if 0 <= uidx < len(strings):
+            unit = strings[uidx]
+    flat: Dict[int, int] = {}
+    for raw in samples:
+        loc_ids: List[int] = []
+        values: List[int] = []
+        for f2, wt2, v2 in iter_fields(raw):
+            if f2 == 1:
+                if wt2 == 2:
+                    loc_ids.extend(_packed_varints(bytes(v2)))
+                elif wt2 == 0:
+                    loc_ids.append(v2)
+            elif f2 == 2:
+                if wt2 == 2:
+                    values.extend(_packed_varints(bytes(v2)))
+                elif wt2 == 0:
+                    values.append(v2)
+        if not loc_ids or value_idx >= len(values):
+            continue
+        leaf_func = locations.get(loc_ids[0], 0)
+        flat[leaf_func] = flat.get(leaf_func, 0) + values[value_idx]
+    scored = sorted(flat.items(), key=lambda kv: -kv[1])[:top_n]
+    out = []
+    for fid, value in scored:
+        name_idx = functions.get(fid, 0)
+        name = (strings[name_idx] if 0 <= name_idx < len(strings)
+                else b"<unknown>")
+        out.append((name.decode("utf-8", "replace"), value,
+                    unit.decode("utf-8", "replace")))
+    return out
+
+
+class InputGoProfile(Input):
+    name = "input_goprofile"
+
+    PROFILE_PATHS = {
+        "cpu": "/debug/pprof/profile?seconds={dur}",
+        "heap": "/debug/pprof/heap",
+        "goroutine": "/debug/pprof/goroutine",
+        "allocs": "/debug/pprof/allocs",
+        "block": "/debug/pprof/block",
+        "mutex": "/debug/pprof/mutex",
+    }
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.targets: List[str] = []
+        self.profiles = ["cpu"]
+        self.interval_s = 60.0
+        self.cpu_seconds = 10
+        self.top_n = 20
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.targets = list(config.get("Targets", []))
+        self.profiles = [p for p in config.get("Profiles", ["cpu"])
+                         if p in self.PROFILE_PATHS]
+        self.interval_s = float(config.get("IntervalSecs", 60))
+        self.cpu_seconds = int(config.get("CpuSeconds", 10))
+        self.top_n = int(config.get("TopN", 20))
+        return bool(self.targets) and bool(self.profiles)
+
+    def start(self) -> bool:
+        self._running = True
+        self._thread = threading.Thread(target=self._run,
+                                        name="goprofile", daemon=True)
+        self._thread.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        return True
+
+    def _run(self) -> None:
+        while self._running:
+            for target in self.targets:
+                for prof in self.profiles:
+                    if not self._running:
+                        return
+                    try:
+                        self.scrape_once(target, prof)
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("pprof scrape %s/%s failed: %s",
+                                    target, prof, e)
+            deadline = time.monotonic() + self.interval_s
+            while self._running and time.monotonic() < deadline:
+                time.sleep(0.2)
+
+    def scrape_once(self, target: str, prof: str) -> int:
+        u = urlparse(target if "//" in target else f"http://{target}")
+        path = self.PROFILE_PATHS[prof].format(dur=self.cpu_seconds)
+        timeout = (self.cpu_seconds + 10 if prof == "cpu" else 10)
+        conn = http.client.HTTPConnection(u.netloc, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}")
+        finally:
+            conn.close()
+        rows = decode_pprof(body, self.top_n)
+        if not rows:
+            return 0
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        group.set_tag(b"__profile_target__", u.netloc.encode())
+        group.set_tag(b"__profile_type__", prof.encode())
+        now = int(time.time())
+        for name, value, unit in rows:
+            ev = group.add_log_event(now)
+            ev.set_content(sb.copy_string(b"function"),
+                           sb.copy_string(name.encode()))
+            ev.set_content(sb.copy_string(b"value"),
+                           sb.copy_string(str(value).encode()))
+            ev.set_content(sb.copy_string(b"unit"),
+                           sb.copy_string(unit.encode()))
+            ev.set_content(sb.copy_string(b"profile"),
+                           sb.copy_string(prof.encode()))
+        pqm = self.context.process_queue_manager if self.context else None
+        if pqm is not None:
+            pqm.push_queue(self.context.process_queue_key, group)
+        return len(rows)

@@ -127,6 +127,13 @@ class InputHTTPServer(Input):
         super().init(config, context)
         self.fmt = (config.get("Format") or self.default_format).lower()
         self.address = config.get("Address", self.default_address)
+        # a decoder EXTENSION ref (reference ext_default_decoder) overrides
+        # the built-in Format parsing
+        dec_ref = config.get("Decoder")
+        self._decoder_ext = (context.get_extension(str(dec_ref))
+                             if dec_ref else None)
+        if dec_ref and self._decoder_ext is None:
+            return False
         host, sep, port = self.address.rpartition(":")
         if not sep or not port.isdigit():
             log.error("%s Address must be host:port, got %r",
@@ -144,8 +151,14 @@ class InputHTTPServer(Input):
                 body = self.rfile.read(n)
                 try:
                     body = _decode_body(self.headers, body)
-                    group = PipelineEventGroup()
-                    count = parse_body(inp.fmt, body, group)
+                    if inp._decoder_ext is not None:
+                        groups = inp._decoder_ext.decode(body, self.headers)
+                        count = sum(len(g) for g in groups)
+                        group = groups[0] if groups else PipelineEventGroup()
+                    else:
+                        group = PipelineEventGroup()
+                        count = parse_body(inp.fmt, body, group)
+                        groups = [group]
                 except Exception as e:  # noqa: BLE001 — corrupt gzip raises
                     # EOFError/zlib.error, bad JSON shapes AttributeError/
                     # KeyError: ALL malformed input is a client 400, never
@@ -157,9 +170,11 @@ class InputHTTPServer(Input):
                 pqm = inp.context.process_queue_manager
                 ok = True
                 if count and pqm is not None:
-                    group.set_tag(b"__source__", self.client_address[0]
+                    for g in groups:
+                        g.set_tag(b"__source__", self.client_address[0]
                                   .encode())
-                    ok = pqm.push_queue(inp.context.process_queue_key, group)
+                        ok = pqm.push_queue(inp.context.process_queue_key,
+                                            g) and ok
                 self.send_response(200 if ok else 429)
                 self.end_headers()
                 self.wfile.write(b"{}" if ok else b"busy")

@@ -1,0 +1,228 @@
+"""input_lumberjack — Beats/Logstash lumberjack protocol server (v1+v2).
+
+Reference: plugins/input/lumberjack/input_lumberjack.go — TCP listener
+speaking the lumberjack framing Filebeat/winlogbeat ship with:
+
+  frame   = version byte ('1'|'2') + type byte
+  'W'     window size  (u32 BE): acks are expected per window
+  'J'     json data    (u32 seq, u32 len, JSON doc)           [v2]
+  'D'     data         (u32 seq, u32 pair_count, {klen,key,vlen,val}*) [v1]
+  'C'     compressed   (u32 len, zlib block of concatenated frames)
+  'A'     ack          (server → client: u32 seq)
+
+The server acks the highest sequence once a window completes (and on
+connection-level flush), which is what beats' publisher pipeline expects
+for at-least-once delivery.  Each data frame becomes one LogEvent; nested
+JSON values are flattened to their JSON text.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("lumberjack")
+
+
+class _ConnState:
+    __slots__ = ("window", "received", "max_seq", "version")
+
+    def __init__(self):
+        self.window = 0
+        self.received = 0
+        self.max_seq = 0
+        self.version = b"2"     # acks echo the client's protocol version
+
+
+class InputLumberjack(Input):
+    name = "input_lumberjack"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._server: Optional[socket.socket] = None
+        self._threads = []
+        self._running = False
+        self.address = "0.0.0.0:5044"
+        self._port = 0
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.address = config.get("BindAddress",
+                                  config.get("Address", self.address))
+        host, sep, port = self.address.rpartition(":")
+        if not sep or not port.isdigit():
+            return False
+        self._host, self._port = host, int(port)
+        return True
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> bool:
+        try:
+            self._server = socket.socket()
+            self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._server.bind((self._host, self._port))
+            self._server.listen(16)
+            self._port = self._server.getsockname()[1]
+        except OSError as e:
+            log.error("lumberjack bind %s failed: %s", self.address, e)
+            return False
+        self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name="lumberjack-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("lumberjack listening on %s:%d", self._host, self._port)
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        return True
+
+    # -- wire ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, addr = self._server.accept()
+            except OSError:
+                return
+            # connection threads are daemons and NOT tracked: a reconnecting
+            # beats fleet would accrete dead Thread objects without bound
+            threading.Thread(target=self._serve_conn, args=(conn, addr),
+                             name="lumberjack-conn", daemon=True).start()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        st = _ConnState()
+        src = addr[0].encode()
+        try:
+            while self._running:
+                hdr = self._read_exact(conn, 2)
+                self._handle_frame(conn, hdr, st, src,
+                                   lambda n: self._read_exact(conn, n))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_frame(self, conn, hdr: bytes, st: _ConnState, src: bytes,
+                      read) -> None:
+        version, ftype = hdr[0:1], hdr[1:2]
+        if version in (b"1", b"2"):
+            st.version = version
+        if ftype == b"W":
+            st.window = struct.unpack(">I", read(4))[0]
+            st.received = 0
+        elif ftype == b"J":
+            seq = struct.unpack(">I", read(4))[0]
+            ln = struct.unpack(">I", read(4))[0]
+            doc = read(ln)
+            self._emit_json(doc, src)
+            self._track_ack(conn, st, seq)
+        elif ftype == b"D":
+            seq = struct.unpack(">I", read(4))[0]
+            pairs = struct.unpack(">I", read(4))[0]
+            fields = {}
+            for _ in range(pairs):
+                klen = struct.unpack(">I", read(4))[0]
+                k = read(klen)
+                vlen = struct.unpack(">I", read(4))[0]
+                fields[k] = read(vlen)
+            self._emit_fields(fields, src)
+            self._track_ack(conn, st, seq)
+        elif ftype == b"C":
+            ln = struct.unpack(">I", read(4))[0]
+            block = zlib.decompress(read(ln))
+            pos = 0
+
+            def block_read(n, _b=block):
+                nonlocal pos
+                if pos + n > len(_b):
+                    raise ConnectionError("truncated compressed frame")
+                out = _b[pos:pos + n]
+                pos += n
+                return out
+
+            while pos < len(block):
+                inner_hdr = block_read(2)
+                self._handle_frame(conn, inner_hdr, st, src, block_read)
+        else:
+            raise ConnectionError(f"unknown lumberjack frame {ftype!r}")
+
+    def _track_ack(self, conn, st: _ConnState, seq: int) -> None:
+        st.received += 1
+        st.max_seq = max(st.max_seq, seq)
+        if st.window and st.received >= st.window:
+            conn.sendall(st.version + b"A" + struct.pack(">I", st.max_seq))
+            st.received = 0
+
+    # -- events -------------------------------------------------------------
+
+    def _emit_json(self, doc: bytes, src: bytes) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_log_event(int(time.time()))
+        try:
+            parsed = json.loads(doc)
+        except ValueError:
+            parsed = None
+        if isinstance(parsed, dict):
+            for k, v in parsed.items():
+                if not isinstance(v, str):
+                    v = json.dumps(v, separators=(",", ":"))
+                ev.set_content(sb.copy_string(str(k).encode()),
+                               sb.copy_string(v.encode()))
+        else:
+            ev.set_content(sb.copy_string(b"content"), sb.copy_string(doc))
+        self._push(group, src)
+
+    def _emit_fields(self, fields: Dict[bytes, bytes], src: bytes) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_log_event(int(time.time()))
+        for k, v in fields.items():
+            ev.set_content(sb.copy_string(k), sb.copy_string(v))
+        self._push(group, src)
+
+    def _push(self, group: PipelineEventGroup, src: bytes) -> None:
+        group.set_tag(b"__source__", src)
+        pqm = self.context.process_queue_manager if self.context else None
+        if pqm is not None:
+            # bounded retry: lumberjack peers rely on ack-gating, so a full
+            # queue just delays the ack (back-pressure to the beat)
+            for _ in range(200):
+                if pqm.push_queue(self.context.process_queue_key, group):
+                    return
+                time.sleep(0.01)

@@ -1,0 +1,203 @@
+"""input_skywalking — SkyWalking v3 trace segment ingest (gRPC).
+
+Reference: plugins/input/skywalkingv3/ — gRPC receivers for the SkyWalking
+agent data-collect protocol.  This input serves the trace surface:
+`/skywalking.v3.TraceSegmentReportService/collect` (client-streaming
+SegmentObject) plus the JVM-free management no-ops agents probe
+(`ManagementService/keepAlive` style calls answered with an empty
+Commands message).
+
+SegmentObject wire decode (language-agnostic data-collect-protocol):
+
+  SegmentObject { traceId=1, traceSegmentId=2, spans=3, service=4,
+                  serviceInstance=5 }
+  SpanObject    { spanId=1, parentSpanId=2, startTime=3(ms), endTime=4(ms),
+                  refs=5, operationName=6, peer=7, spanType=8, spanLayer=9,
+                  componentId=10, isError=11, tags=12, logs=13 }
+  KeyStringValuePair { key=1, value=2 }
+
+Spans become native SpanEvents (models/events.py) so downstream
+processors/serializers treat SkyWalking traffic like any other trace
+source.  Decoding reuses the generic proto reader (config/agent_v2_pb).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config.agent_v2_pb import iter_fields
+from ..models import PipelineEventGroup
+from ..models.events import SpanEvent
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("skywalking")
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover
+    grpc = None
+
+# SpanType: Entry=0 (server), Exit=1 (client), Local=2
+_KIND_MAP = {0: SpanEvent.Kind.SERVER, 1: SpanEvent.Kind.CLIENT,
+             2: SpanEvent.Kind.INTERNAL}
+
+
+def _parse_kv(data: bytes):
+    k = v = b""
+    for f, wt, val in iter_fields(data):
+        if f == 1 and wt == 2:
+            k = bytes(val)
+        elif f == 2 and wt == 2:
+            v = bytes(val)
+    return k, v
+
+
+def decode_segment(data: bytes) -> PipelineEventGroup:
+    """One SegmentObject → one group of SpanEvents."""
+    group = PipelineEventGroup()
+    trace_id = b""
+    segment_id = b""
+    service = b""
+    instance = b""
+    raw_spans: List[bytes] = []
+    for f, wt, v in iter_fields(data):
+        if f == 1 and wt == 2:
+            trace_id = bytes(v)
+        elif f == 2 and wt == 2:
+            segment_id = bytes(v)
+        elif f == 3 and wt == 2:
+            raw_spans.append(bytes(v))
+        elif f == 4 and wt == 2:
+            service = bytes(v)
+        elif f == 5 and wt == 2:
+            instance = bytes(v)
+    if service:
+        group.set_tag(b"service.name", service)
+    if instance:
+        group.set_tag(b"service.instance", instance)
+    for raw in raw_spans:
+        span_id = parent_id = 0
+        start_ms = end_ms = 0
+        name = peer = b""
+        span_type = 0     # proto3 default: absent field = Entry (server)
+        is_error = False
+        tags: List = []
+        for f, wt, v in iter_fields(raw):
+            if f == 1 and wt == 0:
+                span_id = v
+            elif f == 2 and wt == 0:
+                # parentSpanId is -1 for root spans (signed varint)
+                parent_id = v - (1 << 64) if v >= (1 << 63) else v
+            elif f == 3 and wt == 0:
+                start_ms = v
+            elif f == 4 and wt == 0:
+                end_ms = v
+            elif f == 6 and wt == 2:
+                name = bytes(v)
+            elif f == 7 and wt == 2:
+                peer = bytes(v)
+            elif f == 8 and wt == 0:
+                span_type = v
+            elif f == 11 and wt == 0:
+                is_error = bool(v)
+            elif f == 12 and wt == 2:
+                tags.append(_parse_kv(bytes(v)))
+        ev = SpanEvent(timestamp=start_ms // 1000)
+        ev.trace_id = trace_id
+        ev.span_id = b"%s-%d" % (segment_id, span_id)
+        if parent_id >= 0:
+            ev.parent_span_id = b"%s-%d" % (segment_id, parent_id)
+        ev.name = name
+        ev.kind = _KIND_MAP.get(span_type, SpanEvent.Kind.UNSPECIFIED)
+        ev.start_time_ns = start_ms * 1_000_000
+        ev.end_time_ns = end_ms * 1_000_000
+        ev.status = (SpanEvent.Status.ERROR if is_error
+                     else SpanEvent.Status.OK)
+        if peer:
+            ev.set_attribute(b"net.peer.name", peer)
+        for k, v in tags:
+            ev.set_attribute(k, v)
+        group.events.append(ev)
+    return group
+
+
+class InputSkywalking(Input):
+    name = "input_skywalking"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.address = "0.0.0.0:11800"
+        self._server = None
+        self._port = 0
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.address = config.get("Address", self.address)
+        host, sep, port = self.address.rpartition(":")
+        if not sep or not port.isdigit():
+            return False
+        self._host, self._bind_port = host, int(port)
+        return grpc is not None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> bool:
+        if grpc is None:
+            log.error("grpcio unavailable; input_skywalking disabled")
+            return False
+        inp = self
+
+        def collect(request_iterator, context):
+            n = 0
+            for raw in request_iterator:
+                try:
+                    group = decode_segment(raw)
+                except ValueError:
+                    continue
+                if len(group):
+                    pqm = inp.context.process_queue_manager
+                    if pqm is not None:
+                        pqm.push_queue(inp.context.process_queue_key, group)
+                        n += 1
+            log.debug("skywalking collect: %d segments", n)
+            return b""    # empty Commands message
+
+        def keep_alive(request: bytes, context) -> bytes:
+            return b""    # empty Commands
+
+        raw_codec = dict(request_deserializer=lambda b: b,
+                         response_serializer=lambda b: b)
+        trace_svc = grpc.method_handlers_generic_handler(
+            "skywalking.v3.TraceSegmentReportService",
+            {"collect": grpc.stream_unary_rpc_method_handler(
+                collect, **raw_codec)})
+        mgmt_svc = grpc.method_handlers_generic_handler(
+            "skywalking.v3.ManagementService",
+            {"reportInstanceProperties": grpc.unary_unary_rpc_method_handler(
+                keep_alive, **raw_codec),
+             "keepAlive": grpc.unary_unary_rpc_method_handler(
+                keep_alive, **raw_codec)})
+        from concurrent.futures import ThreadPoolExecutor
+        self._server = grpc.server(thread_pool=ThreadPoolExecutor(
+            max_workers=4))
+        self._server.add_generic_rpc_handlers((trace_svc, mgmt_svc))
+        bound = self._server.add_insecure_port(
+            f"{self._host}:{self._bind_port}")
+        if bound == 0:
+            log.error("skywalking bind %s failed", self.address)
+            return False
+        self._port = bound
+        self._server.start()
+        log.info("skywalking v3 gRPC listening on %s:%d", self._host, bound)
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self._server is not None:
+            self._server.stop(grace=1)
+            self._server = None
+        return True

@@ -17,10 +17,13 @@ from typing import Any, Dict, List, Optional
 
 from ..models import PipelineEventGroup
 from ..monitor.metrics import MetricsRecord
+from ..utils.logger import get_logger
 from .plugin.instance import FlusherInstance, InputInstance, ProcessorInstance
 from .plugin.interface import PluginContext
 from .plugin.registry import PluginRegistry
 from .route.router import Router
+
+log = get_logger("pipeline")
 
 _queue_keys = itertools.count(1)
 
@@ -97,6 +100,23 @@ class CollectionPipeline:
 
         global_cfg = config.get("global", {})
         self.context.global_config = global_cfg
+
+        # extensions FIRST: other plugins resolve them by name at init
+        # (reference pkg/pipeline/extensions + plugins/extension/)
+        for ecfg in config.get("extensions", []):
+            etyp = ecfg.get("Type", "")
+            ext = registry.create_extension(etyp)
+            if ext is None or not ext.init(ecfg, self.context):
+                return self._abort_init()
+            key = etyp
+            if ecfg.get("Alias"):
+                key = f"{etyp}/{ecfg['Alias']}"
+            if key in self.context.extensions:
+                # silent overwrite would leave the shadowed instance
+                # unstoppable and auth with the wrong credentials
+                log.error("duplicate extension %r (use Alias)", key)
+                return self._abort_init()
+            self.context.extensions[key] = ext
 
         # inputs
         for i, icfg in enumerate(config.get("inputs", [])):
@@ -247,6 +267,11 @@ class CollectionPipeline:
         self.flush_batch()
         for f in self.flushers:
             f.stop(is_removing)
+        for ext in self.context.extensions.values():
+            try:
+                ext.stop()
+            except Exception:  # noqa: BLE001
+                pass
 
     def drain_from(self, chain_idx: int,
                    groups: List[PipelineEventGroup]) -> None:

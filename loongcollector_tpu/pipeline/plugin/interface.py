@@ -26,6 +26,14 @@ class PluginContext:
         self.logger = None
         self.metrics = None
         self.pipeline = None  # set by CollectionPipeline.init
+        # named extension instances from the pipeline's `extensions:`
+        # section (reference pkg/pipeline/extensions); key = "<type>" or
+        # "<type>/<alias>"
+        self.extensions: Dict[str, Any] = {}
+
+    def get_extension(self, ref: str):
+        """Resolve an extension reference from another plugin's config."""
+        return self.extensions.get(ref)
 
 
 class Plugin:

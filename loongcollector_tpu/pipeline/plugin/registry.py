@@ -24,6 +24,7 @@ class PluginRegistry:
         self._processors: Dict[str, Callable[[], Processor]] = {}
         self._flushers: Dict[str, Callable[[], Flusher]] = {}
         self._aggregators: Dict[str, Callable[[], Plugin]] = {}
+        self._extensions: Dict[str, Callable[[], Plugin]] = {}
         self._loaded = False
 
     @classmethod
@@ -48,6 +49,10 @@ class PluginRegistry:
                             creator: Callable[[], Plugin]) -> None:
         self._aggregators[name] = creator
 
+    def register_extension(self, name: str,
+                           creator: Callable[[], Plugin]) -> None:
+        self._extensions[name] = creator
+
     def load_static_plugins(self) -> None:
         """Registers all built-in plugins (idempotent)."""
         if self._loaded:
@@ -61,6 +66,8 @@ class PluginRegistry:
         _flusher_pkg.register_all(self)
         _input_pkg.register_all(self)
         _aggregator_pkg.register_all(self)
+        from . import extension as _extension_pkg
+        _extension_pkg.register_all(self)
 
     # -- creation -----------------------------------------------------------
 
@@ -78,6 +85,10 @@ class PluginRegistry:
 
     def create_aggregator(self, name: str) -> Optional[Plugin]:
         c = self._aggregators.get(name)
+        return c() if c else None
+
+    def create_extension(self, name: str) -> Optional[Plugin]:
+        c = self._extensions.get(name)
         return c() if c else None
 
     def is_valid_input(self, name: str) -> bool:

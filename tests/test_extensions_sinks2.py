@@ -1,0 +1,356 @@
+"""Extension plugin layer + round-3 sink/aggregator breadth.
+
+Covers: ext_basicauth / ext_request_breaker / ext_default_decoder /
+ext_default_encoder / ext_groupinfo_filter through pipeline config;
+aggregator_content_value_group + aggregator_logstore_router;
+flusher_pulsar against a fake wire-protocol broker; flusher_grpc chained
+into input_forward (agent-to-agent forwarding).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.models import PipelineEventGroup
+from loongcollector_tpu.pipeline.plugin.extension import (BreakerOpen,
+                                                          ExtBasicAuth,
+                                                          ExtDefaultDecoder,
+                                                          ExtDefaultEncoder,
+                                                          ExtGroupInfoFilter,
+                                                          ExtRequestBreaker)
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+
+
+def _mk_group(rows, tags=None):
+    g = PipelineEventGroup()
+    sb = g.source_buffer
+    for row in rows:
+        ev = g.add_log_event(1700000000)
+        for k, v in row.items():
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(v.encode()))
+    for k, v in (tags or {}).items():
+        g.set_tag(k.encode(), v.encode())
+    return g
+
+
+class TestExtensions:
+    def test_basicauth_applies_header(self):
+        ext = ExtBasicAuth()
+        assert ext.init({"Username": "u", "Password": "p"},
+                        PluginContext("t"))
+
+        class Req:
+            headers = {}
+        r = Req()
+        ext.apply(r)
+        assert r.headers["Authorization"].startswith("Basic ")
+
+    def test_breaker_trips_and_recovers(self):
+        ext = ExtRequestBreaker()
+        assert ext.init({"FailureRatio": 0.5, "WindowInSeconds": 0.3},
+                        PluginContext("t"))
+        for _ in range(6):
+            ext.on_result(False)
+        assert not ext.allow()          # tripped
+        time.sleep(0.35)
+        assert ext.allow()              # cooled down: half-open probe
+        for _ in range(6):
+            ext.on_result(True)
+        assert ext.allow()
+
+    def test_decoder_json_and_sls(self):
+        ctx = PluginContext("t")
+        dec = ExtDefaultDecoder()
+        assert dec.init({"Format": "json"}, ctx)
+        [g] = dec.decode(b'{"a": "1", "n": 5}\n{"b": "2"}\n')
+        rows = [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+        assert rows[0]["a"] == b"1" and rows[0]["n"] == b"5"
+        assert rows[1]["b"] == b"2"
+        enc = ExtDefaultEncoder()
+        assert enc.init({"Format": "sls_pb"}, ctx)
+        data = enc.encode([_mk_group([{"k": "v"}])])
+        dec2 = ExtDefaultDecoder()
+        assert dec2.init({"Format": "sls_pb"}, ctx)
+        [g2] = dec2.decode(data)
+        assert {k.to_str(): v.to_bytes() for k, v in
+                g2.events[0].contents} == {"k": b"v"}
+
+    def test_groupinfo_filter(self):
+        ext = ExtGroupInfoFilter()
+        assert ext.init({"Tags": {"env": "prod"}}, PluginContext("t"))
+        keep = _mk_group([{"a": "1"}], tags={"env": "prod"})
+        drop = _mk_group([{"a": "2"}], tags={"env": "dev"})
+        assert ext.filter([keep, drop]) == [keep]
+
+    def test_pipeline_builds_extensions_and_flusher_resolves(self):
+        from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+        p = CollectionPipeline()
+        ok = p.init("ext-pipe", {
+            "extensions": [
+                {"Type": "ext_basicauth", "Username": "u", "Password": "p"},
+                {"Type": "ext_request_breaker", "Alias": "br1",
+                 "FailureRatio": 0.5},
+            ],
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "flushers": [{"Type": "flusher_http",
+                          "RemoteURL": "http://127.0.0.1:9/x",
+                          "Authenticator": "ext_basicauth",
+                          "RequestBreaker": "ext_request_breaker/br1"}],
+        })
+        assert ok
+        fl = p.flushers[0].plugin
+        assert fl.authenticator is not None
+        assert fl.breaker is not None
+        from loongcollector_tpu.pipeline.queue.sender_queue import \
+            SenderQueueItem
+        req = fl.build_request(SenderQueueItem(b"x", 1))
+        assert req.headers["Authorization"].startswith("Basic ")
+        # trip the breaker → build_request fails fast
+        for _ in range(6):
+            fl.breaker.on_result(False)
+        with pytest.raises(BreakerOpen):
+            fl.build_request(SenderQueueItem(b"x", 1))
+
+    def test_flush_interceptor_filters_groups(self):
+        from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+        p = CollectionPipeline()
+        assert p.init("flt-pipe", {
+            "extensions": [{"Type": "ext_groupinfo_filter",
+                            "Tags": {"env": "prod"}}],
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "flushers": [{"Type": "flusher_http",
+                          "RemoteURL": "http://127.0.0.1:9/x",
+                          "FlushInterceptor": "ext_groupinfo_filter",
+                          "MinCnt": 1}],
+        })
+        fl = p.flushers[0].plugin
+        sent = []
+        fl.batcher.add = lambda g: sent.append(g)
+        keep = _mk_group([{"a": "1"}], tags={"env": "prod"})
+        drop = _mk_group([{"a": "2"}], tags={"env": "dev"})
+        assert fl.send(keep) and fl.send(drop)
+        assert sent == [keep]
+
+    def test_duplicate_extension_key_fails_init(self):
+        from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+        p = CollectionPipeline()
+        assert not p.init("dup-ext", {
+            "extensions": [
+                {"Type": "ext_basicauth", "Username": "a", "Password": "x"},
+                {"Type": "ext_basicauth", "Username": "b", "Password": "y"},
+            ],
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "flushers": [{"Type": "flusher_blackhole"}],
+        })
+
+    def test_dangling_ref_fails_init(self):
+        from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+        p = CollectionPipeline()
+        assert not p.init("bad-ref", {
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "flushers": [{"Type": "flusher_http",
+                          "RemoteURL": "http://127.0.0.1:9/x",
+                          "Authenticator": "ext_basicauth"}],
+        })
+
+
+class TestNewAggregators:
+    def _agg(self, name, cfg):
+        r = PluginRegistry.instance()
+        r.load_static_plugins()
+        a = r.create_aggregator(name)
+        assert a is not None and a.init(cfg, PluginContext("t"))
+        return a
+
+    def test_content_value_group(self):
+        a = self._agg("aggregator_content_value_group",
+                      {"GroupKeys": ["app"], "Topic": "t1",
+                       "MaxLogCount": 100})
+        g = _mk_group([{"app": "web", "m": "1"}, {"app": "db", "m": "2"},
+                       {"app": "web", "m": "3"}])
+        done = a.add(g)
+        out = done + a.flush()
+        by_app = {bytes(o.get_tag(b"app")): o for o in out}
+        assert set(by_app) == {b"web", b"db"}
+        assert len(by_app[b"web"].events) == 2
+        assert bytes(by_app[b"web"].get_tag(b"__topic__")) == b"t1"
+
+    def test_logstore_router(self):
+        a = self._agg("aggregator_logstore_router",
+                      {"SourceKey": "content",
+                       "RouterRegex": ["ERROR.*", "WARN.*"],
+                       "RouterLogstore": ["errors", "warnings"],
+                       "DropDisMatch": False})
+        g = _mk_group([{"content": "ERROR boom"}, {"content": "WARN meh"},
+                       {"content": "INFO fine"}])
+        out = a.add(g) + a.flush()
+        stores = {}
+        for o in out:
+            tag = o.get_tag(b"__logstore__")
+            stores[bytes(tag) if tag else b""] = len(o.events)
+        assert stores == {b"errors": 1, b"warnings": 1, b"": 1}
+
+    def test_logstore_router_unanchored_search(self):
+        """Go regexp.MatchString is a SEARCH — substring patterns match."""
+        a = self._agg("aggregator_logstore_router",
+                      {"RouterRegex": ["ERROR"],
+                       "RouterLogstore": ["errors"],
+                       "DropDisMatch": True})
+        g = _mk_group([{"content": "level=ERROR msg=x"}])
+        out = a.add(g) + a.flush()
+        assert sum(len(o.events) for o in out) == 1
+
+    def test_logstore_router_drop_dismatch(self):
+        a = self._agg("aggregator_logstore_router",
+                      {"RouterRegex": ["ERROR.*"],
+                       "RouterLogstore": ["errors"],
+                       "DropDisMatch": True})
+        g = _mk_group([{"content": "ERROR a"}, {"content": "fine"}])
+        out = a.add(g) + a.flush()
+        assert sum(len(o.events) for o in out) == 1
+
+
+def _fake_pulsar_broker():
+    """Speaks just enough of the binary protocol: CONNECTED,
+    PRODUCER_SUCCESS, SEND_RECEIPT; records payloads."""
+    import loongcollector_tpu.flusher.pulsar as P
+    from loongcollector_tpu.config.agent_v2_pb import (e_bytes, e_varint,
+                                                       iter_fields)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    received = []
+
+    def read_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            c = conn.recv(n - len(buf))
+            if not c:
+                raise ConnectionError
+            buf += c
+        return buf
+
+    def reply(conn, cmd_type, field_no, body):
+        cmd = e_varint(1, cmd_type) + e_bytes(field_no, body)
+        conn.sendall(struct.pack(">II", 4 + len(cmd), len(cmd)) + cmd)
+
+    def run():
+        conn, _ = srv.accept()
+        try:
+            while True:
+                total = struct.unpack(">I", read_exact(conn, 4))[0]
+                data = read_exact(conn, total)
+                cmd_size = struct.unpack(">I", data[:4])[0]
+                command = data[4:4 + cmd_size]
+                cmd_type = 0
+                for f, wt, v in iter_fields(command):
+                    if f == 1 and wt == 0:
+                        cmd_type = v
+                if cmd_type == P.CONNECT:
+                    reply(conn, P.CONNECTED, 3, e_bytes(1, "srv"))
+                elif cmd_type == P.PRODUCER:
+                    reply(conn, P.PRODUCER_SUCCESS, 17,
+                          e_varint(1, 1) + e_bytes(2, "prod-1"))
+                elif cmd_type == P.SEND:
+                    rest = data[4 + cmd_size:]
+                    assert rest[:2] == b"\x0e\x01"
+                    meta_size = struct.unpack(">I", rest[6:10])[0]
+                    payload = rest[10 + meta_size:]
+                    received.append(payload)
+                    seq = None
+                    for f, wt, v in iter_fields(command):
+                        if f == 6 and wt == 2:
+                            for f2, wt2, v2 in iter_fields(bytes(v)):
+                                if f2 == 2 and wt2 == 0:
+                                    seq = v2
+                    reply(conn, P.SEND_RECEIPT, 7,
+                          e_varint(1, 1) + e_varint(2, seq or 0))
+        except (ConnectionError, OSError):
+            pass
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv, received
+
+
+class TestPulsarFlusher:
+    def test_wire_protocol_roundtrip(self):
+        srv, received = _fake_pulsar_broker()
+        try:
+            from loongcollector_tpu.flusher.pulsar import FlusherPulsar
+            fl = FlusherPulsar()
+            assert fl.init(
+                {"BrokerURL": f"pulsar://127.0.0.1:{srv.getsockname()[1]}",
+                 "Topic": "persistent://public/default/logs",
+                 "Format": "json", "MinCnt": 1, "TimeoutSecs": 5},
+                PluginContext("t"))
+            fl.send(_mk_group([{"msg": "hello pulsar"}]))
+            fl.flush_all()
+            deadline = time.monotonic() + 5
+            while not received and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert received and b"hello pulsar" in received[0]
+            fl.stop(True)
+        finally:
+            srv.close()
+
+    def test_crc_and_framing(self):
+        from loongcollector_tpu.flusher.kafka_client import crc32c
+        from loongcollector_tpu.flusher.pulsar import (_frame_payload,
+                                                       _frame_simple)
+        f = _frame_simple(b"abc")
+        assert f == struct.pack(">II", 7, 3) + b"abc"
+        pf = _frame_payload(b"CMD", b"META", b"PAYLOAD")
+        total = struct.unpack(">I", pf[:4])[0]
+        assert total == len(pf) - 4
+        # crc32c over [metaSize][metadata][payload]
+        idx = 4 + 4 + 3          # total + cmdSize + command
+        assert pf[idx:idx + 2] == b"\x0e\x01"
+        crc = struct.unpack(">I", pf[idx + 2:idx + 6])[0]
+        meta_part = pf[idx + 6:]
+        assert crc == crc32c(meta_part)
+
+
+class TestGrpcFlusher:
+    def test_chain_into_input_forward(self):
+        """flusher_grpc → input_forward: the agent-to-agent topology."""
+        grpc = pytest.importorskip("grpc")
+        from loongcollector_tpu.flusher.grpc_flusher import FlusherGrpc
+        from loongcollector_tpu.input.forward import GrpcInputManager
+        from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+            ProcessQueueManager
+
+        pqm = ProcessQueueManager()
+        q = pqm.create_or_reuse_queue(555, 1, 10, "recv")
+        mgr = GrpcInputManager.instance()
+        mgr.process_queue_manager = pqm
+        assert mgr.add_listen_input("127.0.0.1:0", 555)
+        addr = [a for a in mgr._servers][-1]
+        port = mgr.bound_port(addr)
+        fl = FlusherGrpc()
+        assert fl.init({"Address": f"127.0.0.1:{port}",
+                        "Format": "sls_pb", "MinCnt": 1},
+                       PluginContext("t"))
+        fl.send(_mk_group([{"k": "forwarded"}]))
+        fl.flush_all()
+        deadline = time.monotonic() + 5
+        got = None
+        while got is None and time.monotonic() < deadline:
+            got = q.pop()
+            if got is None:
+                time.sleep(0.01)
+        assert got is not None
+        rows = {k.to_str(): v.to_bytes()
+                for k, v in got.events[0].contents}
+        assert rows == {"k": b"forwarded"}
+        fl.stop(True)
+        mgr.remove_listen_input(addr)

@@ -1,0 +1,245 @@
+"""Round-3 ingest breadth: lumberjack (beats), SkyWalking v3, goprofile.
+
+Each test drives the REAL wire surface: a beats-framing TCP client, a
+gRPC client-streaming call, and an HTTP pprof endpoint serving a
+synthesized profile.proto blob.
+"""
+
+import gzip
+import http.server
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from loongcollector_tpu.config.agent_v2_pb import e_bytes, e_varint
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+
+def _ctx(queue_key):
+    pqm = ProcessQueueManager()
+    q = pqm.create_or_reuse_queue(queue_key, 1, 50, "t")
+    ctx = PluginContext("t")
+    ctx.process_queue_manager = pqm
+    ctx.process_queue_key = queue_key
+    return ctx, q
+
+
+def _pop(q, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        g = q.pop()
+        if g is not None:
+            return g
+        time.sleep(0.01)
+    return None
+
+
+class TestLumberjack:
+    def _start(self):
+        from loongcollector_tpu.input.lumberjack import InputLumberjack
+        ctx, q = _ctx(801)
+        inp = InputLumberjack()
+        assert inp.init({"BindAddress": "127.0.0.1:0"}, ctx)
+        assert inp.start()
+        return inp, q
+
+    def test_v2_json_frames_with_window_ack(self):
+        inp, q = self._start()
+        try:
+            s = socket.create_connection(("127.0.0.1", inp.port), timeout=5)
+            s.sendall(b"2W" + struct.pack(">I", 2))     # window = 2
+            for seq, doc in ((1, b'{"message": "hello", "beat": "x"}'),
+                             (2, b'{"message": "world"}')):
+                s.sendall(b"2J" + struct.pack(">II", seq, len(doc)) + doc)
+            ack = s.recv(6)                              # window complete
+            assert ack == b"2A" + struct.pack(">I", 2)
+            g1 = _pop(q)
+            g2 = _pop(q)
+            assert g1 is not None and g2 is not None
+            rows = {k.to_str(): v.to_bytes()
+                    for k, v in g1.events[0].contents}
+            assert rows["message"] == b"hello"
+            s.close()
+        finally:
+            inp.stop()
+
+    def test_compressed_frame(self):
+        inp, q = self._start()
+        try:
+            doc = b'{"message": "compressed"}'
+            inner = b"2J" + struct.pack(">II", 1, len(doc)) + doc
+            block = zlib.compress(inner)
+            s = socket.create_connection(("127.0.0.1", inp.port), timeout=5)
+            s.sendall(b"2W" + struct.pack(">I", 1))
+            s.sendall(b"2C" + struct.pack(">I", len(block)) + block)
+            assert s.recv(6) == b"2A" + struct.pack(">I", 1)
+            g = _pop(q)
+            rows = {k.to_str(): v.to_bytes()
+                    for k, v in g.events[0].contents}
+            assert rows["message"] == b"compressed"
+            s.close()
+        finally:
+            inp.stop()
+
+    def test_v1_data_frames(self):
+        inp, q = self._start()
+        try:
+            s = socket.create_connection(("127.0.0.1", inp.port), timeout=5)
+            s.sendall(b"1W" + struct.pack(">I", 1))
+            pairs = [(b"line", b"v1 payload"), (b"host", b"web-1")]
+            frame = b"1D" + struct.pack(">II", 1, len(pairs))
+            for k, v in pairs:
+                frame += struct.pack(">I", len(k)) + k
+                frame += struct.pack(">I", len(v)) + v
+            s.sendall(frame)
+            # v1 clients get v1-framed acks
+            assert s.recv(6) == b"1A" + struct.pack(">I", 1)
+            g = _pop(q)
+            rows = {k.to_str(): v.to_bytes()
+                    for k, v in g.events[0].contents}
+            assert rows == {"line": b"v1 payload", "host": b"web-1"}
+            s.close()
+        finally:
+            inp.stop()
+
+
+def _segment_object() -> bytes:
+    def span(span_id, parent, name, span_type, err=False):
+        body = (e_varint(1, span_id)
+                + e_varint(2, parent & ((1 << 64) - 1))
+                + e_varint(3, 1700000000000)
+                + e_varint(4, 1700000000250)
+                + e_bytes(6, name)
+                + e_varint(8, span_type)
+                + e_varint(11, 1 if err else 0)
+                + e_bytes(12, e_bytes(1, "http.method")
+                          + e_bytes(2, "GET")))
+        return body
+
+    return (e_bytes(1, "trace-abc")
+            + e_bytes(2, "seg-1")
+            + e_bytes(3, span(0, -1, "GET:/api", 0))
+            + e_bytes(3, span(1, 0, "SELECT users", 1, err=True))
+            + e_bytes(4, "cart-service")
+            + e_bytes(5, "pod-7"))
+
+
+class TestSkywalking:
+    def test_decode_segment(self):
+        from loongcollector_tpu.input.skywalking import decode_segment
+        from loongcollector_tpu.models.events import SpanEvent
+        g = decode_segment(_segment_object())
+        assert bytes(g.get_tag(b"service.name")) == b"cart-service"
+        assert len(g.events) == 2
+        root, child = g.events
+        assert root.trace_id == b"trace-abc"
+        assert root.span_id == b"seg-1-0"
+        assert root.parent_span_id == b""          # parent -1 = root
+        assert root.kind == SpanEvent.Kind.SERVER
+        assert root.name == b"GET:/api"
+        assert root.start_time_ns == 1700000000000 * 1_000_000
+        assert child.parent_span_id == b"seg-1-0"
+        assert child.kind == SpanEvent.Kind.CLIENT
+        assert child.status == SpanEvent.Status.ERROR
+        assert child.attributes[b"http.method"].to_bytes() == b"GET"
+
+    def test_grpc_stream_ingest(self):
+        grpc = pytest.importorskip("grpc")
+        from loongcollector_tpu.input.skywalking import InputSkywalking
+        ctx, q = _ctx(802)
+        inp = InputSkywalking()
+        assert inp.init({"Address": "127.0.0.1:0"}, ctx)
+        assert inp.start()
+        try:
+            ch = grpc.insecure_channel(f"127.0.0.1:{inp.port}")
+            call = ch.stream_unary(
+                "/skywalking.v3.TraceSegmentReportService/collect",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            call(iter([_segment_object()]), timeout=5)
+            g = _pop(q)
+            assert g is not None and len(g.events) == 2
+            assert g.events[0].trace_id == b"trace-abc"
+            ch.close()
+        finally:
+            inp.stop()
+
+
+def _pprof_profile() -> bytes:
+    """Synthesize a minimal cpu pprof: two functions, packed varints."""
+    strings = [b"", b"samples", b"count", b"cpu", b"nanoseconds",
+               b"main.hot", b"main.cold"]
+    out = b""
+    # sample_type: samples/count then cpu/nanoseconds (value_idx = last)
+    out += e_bytes(1, e_varint(1, 1) + e_varint(2, 2))
+    out += e_bytes(1, e_varint(1, 3) + e_varint(2, 4))
+    # samples: packed location ids + packed values
+    def sample(loc, values):
+        body = e_bytes(1, b"".join(
+            __import__("loongcollector_tpu.config.agent_v2_pb",
+                       fromlist=["enc_varint"]).enc_varint(x) for x in loc))
+        body += e_bytes(2, b"".join(
+            __import__("loongcollector_tpu.config.agent_v2_pb",
+                       fromlist=["enc_varint"]).enc_varint(x)
+            for x in values))
+        return e_bytes(2, body)
+    out += sample([1], [5, 500])
+    out += sample([1], [3, 300])
+    out += sample([2], [1, 100])
+    # locations: id + line{function_id}
+    out += e_bytes(4, e_varint(1, 1) + e_bytes(4, e_varint(1, 11)))
+    out += e_bytes(4, e_varint(1, 2) + e_bytes(4, e_varint(1, 12)))
+    # functions: id + name string index
+    out += e_bytes(5, e_varint(1, 11) + e_varint(2, 5))
+    out += e_bytes(5, e_varint(1, 12) + e_varint(2, 6))
+    for s in strings:
+        out += e_bytes(6, s) if s else b"\x32\x00"   # empty string entry
+    return gzip.compress(out)
+
+
+class TestGoProfile:
+    def test_decode_pprof(self):
+        from loongcollector_tpu.input.goprofile import decode_pprof
+        rows = decode_pprof(_pprof_profile())
+        assert rows[0] == ("main.hot", 800, "nanoseconds")
+        assert rows[1] == ("main.cold", 100, "nanoseconds")
+
+    def test_scrape_once(self):
+        blob = _pprof_profile()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                assert self.path.startswith("/debug/pprof/")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            from loongcollector_tpu.input.goprofile import InputGoProfile
+            ctx, q = _ctx(803)
+            inp = InputGoProfile()
+            assert inp.init(
+                {"Targets": [f"127.0.0.1:{srv.server_port}"],
+                 "Profiles": ["heap"]}, ctx)
+            n = inp.scrape_once(f"127.0.0.1:{srv.server_port}", "heap")
+            assert n == 2
+            g = _pop(q)
+            assert bytes(g.get_tag(b"__profile_type__")) == b"heap"
+            rows = {k.to_str(): v.to_bytes()
+                    for k, v in g.events[0].contents}
+            assert rows["function"] == b"main.hot"
+            assert rows["value"] == b"800"
+        finally:
+            srv.shutdown()
