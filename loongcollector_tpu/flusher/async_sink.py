@@ -88,7 +88,8 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                     return
                 if not self._queue:
                     continue
-                body, born = self._queue[0]
+                item = self._queue[0]
+                body, born = item
             if self.breaker is not None and not self.breaker.allow():
                 time.sleep(min(delay, 1.0))
                 continue
@@ -113,7 +114,10 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 continue
             delay = 0.2
             with self._qcv:
-                if self._queue:
+                # pop by IDENTITY: queue-full shedding may have removed the
+                # in-flight head while the lock was released during deliver;
+                # popping by position would discard an undelivered payload
+                if self._queue and self._queue[0] is item:
                     self._queue.popleft()
 
     def build_request(self, item):

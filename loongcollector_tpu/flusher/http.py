@@ -155,9 +155,14 @@ class FlusherHTTP(Flusher):
         from .http_base import check_breaker
         check_breaker(self)
         headers = dict(self.headers)
+        if self._encoder_ext is not None:
+            # the encoder EXTENSION owns the payload format
+            wire_pb = getattr(self._encoder_ext, "fmt", "") in ("sls",
+                                                                "sls_pb")
+        else:
+            wire_pb = isinstance(self.serializer, SLSEventGroupSerializer)
         headers.setdefault("Content-Type",
-                           "application/x-protobuf"
-                           if isinstance(self.serializer, SLSEventGroupSerializer)
+                           "application/x-protobuf" if wire_pb
                            else "application/json")
         if self.compressor.name != "none":
             headers["Content-Encoding"] = self.compressor.name

@@ -410,13 +410,8 @@ class KafkaClient:
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise OSError("connection closed")
-            buf += chunk
-        return bytes(buf)
+        from ..utils.netio import read_exact
+        return read_exact(sock, n)    # ConnectionError IS-A OSError
 
     # -- metadata -----------------------------------------------------------
 

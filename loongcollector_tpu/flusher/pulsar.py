@@ -100,13 +100,11 @@ class PulsarProducer:
     # -- wire ---------------------------------------------------------------
 
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise PulsarError("connection closed by broker")
-            buf += chunk
-        return buf
+        from ..utils.netio import read_exact
+        try:
+            return read_exact(self._sock, n)
+        except ConnectionError as e:
+            raise PulsarError(str(e))
 
     def _read_frame(self) -> Tuple[int, Dict[int, bytes]]:
         """Returns (command_type, {field_no: raw nested bytes})."""

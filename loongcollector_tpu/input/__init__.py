@@ -3,7 +3,8 @@
 
 def register_all(registry) -> None:
     from .file.input_file import InputFile, InputStaticFile
-    from .host_monitor import InputHostMeta, InputHostMonitor
+    from .host_monitor import (InputHostMeta, InputHostMonitor,
+                               InputProcessEntity)
     from .internal import (InputInternalAlarms,
                            InputInternalMatchedContainerInfo,
                            InputInternalMetrics)
@@ -29,6 +30,7 @@ def register_all(registry) -> None:
     registry.register_input("input_static_file_onetime", InputStaticFile)
     registry.register_input("input_host_monitor", InputHostMonitor)
     registry.register_input("input_host_meta", InputHostMeta)
+    registry.register_input("input_process_entity", InputProcessEntity)
     registry.register_input("input_internal_metrics", InputInternalMetrics)
     registry.register_input("input_internal_alarms", InputInternalAlarms)
     registry.register_input("input_internal_matched_container_info",

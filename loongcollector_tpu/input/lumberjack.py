@@ -111,24 +111,15 @@ class InputLumberjack(Input):
             threading.Thread(target=self._serve_conn, args=(conn, addr),
                              name="lumberjack-conn", daemon=True).start()
 
-    @staticmethod
-    def _read_exact(conn, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("peer closed")
-            buf += chunk
-        return buf
-
     def _serve_conn(self, conn: socket.socket, addr) -> None:
+        from ..utils.netio import read_exact
         st = _ConnState()
         src = addr[0].encode()
         try:
             while self._running:
-                hdr = self._read_exact(conn, 2)
+                hdr = read_exact(conn, 2)
                 self._handle_frame(conn, hdr, st, src,
-                                   lambda n: self._read_exact(conn, n))
+                                   lambda n: read_exact(conn, n))
         except (ConnectionError, OSError, struct.error):
             pass
         finally:
@@ -219,10 +210,13 @@ class InputLumberjack(Input):
     def _push(self, group: PipelineEventGroup, src: bytes) -> None:
         group.set_tag(b"__source__", src)
         pqm = self.context.process_queue_manager if self.context else None
-        if pqm is not None:
-            # bounded retry: lumberjack peers rely on ack-gating, so a full
-            # queue just delays the ack (back-pressure to the beat)
-            for _ in range(200):
-                if pqm.push_queue(self.context.process_queue_key, group):
-                    return
-                time.sleep(0.01)
+        if pqm is None:
+            return
+        # bounded retry, then FAIL the connection: an un-pushed frame must
+        # never be acked (at-least-once) — dropping the conn makes the
+        # beat reconnect and retransmit the unacknowledged window
+        for _ in range(200):
+            if pqm.push_queue(self.context.process_queue_key, group):
+                return
+            time.sleep(0.01)
+        raise ConnectionError("process queue full; forcing retransmit")

@@ -46,6 +46,9 @@ _PROJECT_RE = re.compile(r"project(-away)?\s+(.+)")
 _LIMIT_RE = re.compile(r"limit\s+(\d+)")
 _STATS_RE = re.compile(r"stats\s+(.+?)(?:\s+by\s+([\w,\s]+))?\s*$", re.S)
 _SORT_RE = re.compile(r"sort\s+by\s+(.+)", re.S)
+_JOIN_RE = re.compile(
+    r"join\s+(?:type\s*=\s*(inner|left)\s+)?"
+    r"file\(\s*(['\"][^'\"]+['\"])\s*\)\s+on\s+(\w+)", re.S)
 
 
 def _split_quote_aware(text: str, sep: str) -> List[str]:
@@ -167,23 +170,201 @@ class _Parse(_Stage):
                             bytes(src.arena[o : o + lens[i]].tobytes())))
 
 
+# ---------------------------------------------------------------------------
+# extend expression language: nested function calls over row fields.
+# Node = ('lit', bytes) | ('field', name) | ('call', fname, [args])
+# ---------------------------------------------------------------------------
+
+def _split_args(src: str) -> List[str]:
+    """Split call arguments on top-level commas (quote- AND paren-aware —
+    nested calls like round(div(a, b), 2) must not split inside div)."""
+    out = []
+    depth = 0
+    quote = None
+    start = 0
+    for i, ch in enumerate(src):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(src[start:i])
+            start = i + 1
+    out.append(src[start:])
+    return out
+
+
+def _parse_expr(src: str):
+    src = src.strip()
+    if src and src[0] in "'\"":
+        return ("lit", _unquote(src).encode())
+    m = re.fullmatch(r"([A-Za-z_][\w]*)\((.*)\)", src, re.S)
+    if m:
+        fname = m.group(1).lower()
+        inner = m.group(2).strip()
+        if fname == "if":
+            # first-class node so if() nests anywhere and validates at
+            # compile time like every other function
+            args = _split_args(inner)
+            if len(args) != 3:
+                raise SPLError("if() takes (cond, then, else)")
+            cm = _CMP_RE.search(args[0])
+            if not cm:
+                raise SPLError(f"if() needs a comparison: {args[0]!r}")
+            return ("if", _parse_expr(args[0][: cm.start()]), cm.group(1),
+                    _parse_expr(args[0][cm.end():]), _parse_expr(args[1]),
+                    _parse_expr(args[2]))
+        args = ([_parse_expr(a) for a in _split_args(inner)]
+                if inner else [])
+        return ("call", fname, args)
+    if re.fullmatch(r"-?\d+(\.\d+)?", src):
+        return ("lit", src.encode())
+    return ("field", src)
+
+
+def _b2f(v: bytes) -> float:
+    try:
+        return float(v)
+    except ValueError:
+        return 0.0
+
+
+_CMP_RE = re.compile(r"(==|!=|>=|<=|>|<)")
+
+
+def _eval_expr(node, fields: Dict[str, bytes]) -> bytes:
+    kind = node[0]
+    if kind == "lit":
+        return node[1]
+    if kind == "field":
+        return fields.get(node[1], b"")
+    if kind == "if":
+        _, lhs, op, rhs, then, other = node
+        lv = _eval_expr(lhs, fields)
+        rv = _eval_expr(rhs, fields)
+        ln, rn = _num(lv), _num(rv)
+        if ln is not None and rn is not None:
+            lv, rv = ln, rn            # numeric compare when both parse
+        ok = {"==": lv == rv, "!=": lv != rv, ">": lv > rv,
+              "<": lv < rv, ">=": lv >= rv, "<=": lv <= rv}[op]
+        return _eval_expr(then if ok else other, fields)
+    fname, args = node[1], node[2]
+    a = [_eval_expr(x, fields) for x in args]
+    # string functions (SLS SPL vocabulary)
+    if fname == "concat":
+        return b"".join(a)
+    if fname == "upper":
+        return a[0].upper()
+    if fname == "lower":
+        return a[0].lower()
+    if fname == "trim":
+        return a[0].strip()
+    if fname == "ltrim":
+        return a[0].lstrip()
+    if fname == "rtrim":
+        return a[0].rstrip()
+    if fname == "length":
+        return str(len(a[0])).encode()
+    if fname == "reverse":
+        return a[0][::-1]
+    if fname == "substring":
+        start = int(_b2f(a[1]))
+        n = int(_b2f(a[2])) if len(a) > 2 else len(a[0])
+        return a[0][start:start + n]
+    if fname == "replace":
+        return a[0].replace(a[1], a[2])
+    if fname == "split_part":
+        parts = a[0].split(a[1])
+        idx = int(_b2f(a[2])) - 1          # SPL split_part is 1-based
+        return parts[idx] if 0 <= idx < len(parts) else b""
+    if fname == "md5":
+        import hashlib as _h
+        return _h.md5(a[0]).hexdigest().encode()
+    if fname == "url_encode":
+        from urllib.parse import quote
+        return quote(a[0].decode("utf-8", "replace")).encode()
+    if fname == "url_decode":
+        from urllib.parse import unquote
+        return unquote(a[0].decode("utf-8", "replace")).encode()
+    if fname == "json_extract":
+        import json as _json
+        try:
+            doc = _json.loads(a[0])
+            for part in a[1].decode().strip("$.").split("."):
+                if part:
+                    doc = doc[int(part)] if isinstance(doc, list) else \
+                        doc[part]
+            if isinstance(doc, (dict, list)):
+                return _json.dumps(doc, separators=(",", ":")).encode()
+            return str(doc).encode()
+        except (ValueError, KeyError, IndexError, TypeError):
+            return b""
+    if fname == "coalesce":
+        for v in a:
+            if v:
+                return v
+        return b""
+    # math
+    if fname in ("add", "sub", "mul", "div", "mod", "pow"):
+        x, y = _b2f(a[0]), _b2f(a[1])
+        try:
+            val = {"add": x + y, "sub": x - y, "mul": x * y,
+                   "div": x / y if y else 0.0,
+                   "mod": x % y if y else 0.0, "pow": x ** y}[fname]
+        except (OverflowError, ValueError):
+            val = 0.0
+        return _fmt(val)
+    if fname == "round":
+        nd = int(_b2f(a[1])) if len(a) > 1 else 0
+        return _fmt(round(_b2f(a[0]), nd))
+    if fname == "abs":
+        return _fmt(abs(_b2f(a[0])))
+    if fname == "floor":
+        import math as _m
+        return _fmt(_m.floor(_b2f(a[0])))
+    if fname == "ceil":
+        import math as _m
+        return _fmt(_m.ceil(_b2f(a[0])))
+    # time
+    if fname == "now":
+        import time as _t
+        return str(int(_t.time())).encode()
+    if fname == "from_unixtime":
+        import time as _t
+        fmt = (a[1].decode("utf-8", "replace") if len(a) > 1
+               else "%Y-%m-%d %H:%M:%S")
+        try:
+            return _t.strftime(fmt, _t.gmtime(_b2f(a[0]))).encode()
+        except (ValueError, OverflowError):
+            return b""
+    raise SPLError(f"unknown SPL function {fname!r}")
+
+
 class _Extend(_Stage):
-    """extend dst = concat(args...) | 'literal' | field"""
+    """extend dst = <expr> — nested function calls (concat/upper/substring/
+    replace/split_part/md5/json_extract/add/round/if/from_unixtime/...),
+    field refs and literals."""
 
     def __init__(self, dst: str, expr: str):
         self.dst = dst
-        expr = expr.strip()
-        m = re.fullmatch(r"concat\((.+)\)", expr, re.S)
-        if m:
-            self.parts = [a.strip()
-                          for a in _split_quote_aware(m.group(1), ",")]
-        else:
-            self.parts = [expr]
+        self.node = _parse_expr(expr.strip())
+        # validate function names at compile time on an empty row;
+        # data-dependent runtime errors (empty separators etc.) are
+        # not compile errors
+        try:
+            _eval_expr(self.node, {})
+        except SPLError:
+            raise
+        except Exception:  # noqa: BLE001
+            pass
 
-    def _value(self, part: str, fields: Dict[str, bytes]) -> bytes:
-        if part and part[0] in "'\"":
-            return _unquote(part).encode()
-        return fields.get(part, b"")
+    def _value(self, fields: Dict[str, bytes]) -> bytes:
+        return _eval_expr(self.node, fields)
 
     def apply(self, group: PipelineEventGroup) -> None:
         sb = group.source_buffer
@@ -194,8 +375,7 @@ class _Extend(_Stage):
             offs = np.zeros(n, dtype=np.int32)
             lens = np.full(n, -1, dtype=np.int32)
             for i, fields in enumerate(rows):
-                out = b"".join(self._value(p, fields) for p in self.parts)
-                view = sb.copy_string(out)
+                view = sb.copy_string(self._value(fields))
                 offs[i] = view.offset
                 lens[i] = view.length
             cols.set_field(self.dst, offs, lens)
@@ -204,8 +384,8 @@ class _Extend(_Stage):
             if not hasattr(ev, "contents"):
                 continue
             fields = {k.to_str(): v.to_bytes() for k, v in ev.contents}
-            out = b"".join(self._value(p, fields) for p in self.parts)
-            ev.set_content(self.dst.encode(), sb.copy_string(out))
+            ev.set_content(self.dst.encode(),
+                           sb.copy_string(self._value(fields)))
 
 
 class _Rename(_Stage):
@@ -468,6 +648,57 @@ class _Sort(_Stage):
             group._columns = None   # any materialized columns are stale
 
 
+class _Join(_Stage):
+    """join [type=inner|left] file('<csv>') on <key> — hash join the event
+    stream against a CSV lookup table (header row names the columns; the
+    ON key must be one of them).  inner drops non-matching events; left
+    keeps them without the lookup columns.  The SLS SPL engine joins
+    datasets; an agent-side processor's second dataset is a local table."""
+
+    def __init__(self, join_type: Optional[str], path_src: str, key: str):
+        import csv
+        self.join_type = join_type or "inner"
+        self.key = key
+        path = _unquote(path_src)
+        self.table: Dict[bytes, Dict[str, bytes]] = {}
+        try:
+            with open(path, newline="") as f:
+                reader = csv.reader(f)
+                header = next(reader, None)
+                if not header or key not in header:
+                    raise SPLError(
+                        f"join table {path!r} lacks key column {key!r}")
+                key_idx = header.index(key)
+                for row in reader:
+                    if len(row) != len(header):
+                        continue
+                    self.table[row[key_idx].encode()] = {
+                        h: row[i].encode() for i, h in enumerate(header)
+                        if i != key_idx}
+        except OSError as e:
+            raise SPLError(f"join table {path!r} unreadable: {e}")
+
+    def apply(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        cols = group.columns
+        if cols is not None and not group._events:
+            group.materialize()     # join needs per-event mutation
+            group._columns = None   # else dropped rows resurrect from cols
+        keep = []
+        for ev in group.events:
+            fields = ({k.to_str(): v.to_bytes() for k, v in ev.contents}
+                      if hasattr(ev, "contents") else {})
+            row = self.table.get(fields.get(self.key, b""))
+            if row is not None:
+                for k, v in row.items():
+                    ev.set_content(sb.copy_string(k.encode()),
+                                   sb.copy_string(v))
+                keep.append(ev)
+            elif self.join_type == "left":
+                keep.append(ev)
+        group.events[:] = keep
+
+
 class _Limit(_Stage):
     def __init__(self, n: int):
         self.n = n
@@ -517,6 +748,8 @@ def compile_spl(script: str) -> List[_Stage]:
             stages.append(_Stats(m.group(1), m.group(2)))
         elif m := _SORT_RE.fullmatch(part):
             stages.append(_Sort(m.group(1)))
+        elif m := _JOIN_RE.fullmatch(part):
+            stages.append(_Join(m.group(1), m.group(2), m.group(3)))
         else:
             raise SPLError(f"unsupported SPL stage: {part!r}")
     return stages

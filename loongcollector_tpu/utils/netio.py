@@ -1,0 +1,15 @@
+"""Shared blocking-socket helpers for the hand-rolled wire protocols
+(Kafka, Pulsar, lumberjack)."""
+
+from __future__ import annotations
+
+
+def read_exact(sock, n: int) -> bytes:
+    """Read exactly n bytes or raise ConnectionError on EOF."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf

@@ -125,3 +125,44 @@ class TestHostMeta:
         key, group = pqm.pop_item(timeout=0)
         assert key == 88
         assert group.get_tag(b"__source__") == b"host_meta"
+
+
+class TestProcessEntity:
+    def test_entity_and_link_events(self):
+        import time as _t
+
+        from loongcollector_tpu.input.host_monitor import \
+            ProcessEntityCollector
+        c = ProcessEntityCollector(top_n=5, interval_s=30)
+        c.collect_group()            # tick baseline
+        _t.sleep(0.2)
+        g = c.collect_group()
+        rows = [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+        ents = [r for r in rows if "__entity_id__" in r]
+        links = [r for r in rows if "__src_entity_id__" in r]
+        assert len(ents) == 5 and len(links) == 5
+        e = ents[0]
+        assert e["__domain__"] == b"infra"
+        assert e["__entity_type__"] == b"infra.host.process"
+        assert e["pid"].isdigit() and e["ppid"].lstrip(b"-").isdigit()
+        assert int(e["ktime"]) > 0
+        assert e["__keep_alive_seconds__"] == b"60"
+        # entity id is stable across collections for the same process
+        g2 = c.collect_group()
+        ids2 = {r2["pid"]: r2["__entity_id__"] for ev2 in g2.events
+                for r2 in [{k.to_str(): v.to_bytes()
+                            for k, v in ev2.contents}]
+                if "__entity_id__" in r2}
+        if e["pid"] in ids2:
+            assert ids2[e["pid"]] == e["__entity_id__"]
+        # links point at the host entity
+        assert links[0]["__dest_entity_type__"] == b"acs.host.instance"
+        assert links[0]["__relation_type__"] == b"update"
+
+    def test_registered(self):
+        from loongcollector_tpu.pipeline.plugin.registry import \
+            PluginRegistry
+        r = PluginRegistry.instance()
+        r.load_static_plugins()
+        assert r.create_input("input_process_entity") is not None

@@ -199,3 +199,187 @@ class TestStatsSort:
         g2 = self._obj_group([(1, {"lat": "10"}), (2, {"lat": "nan"})])
         self._run("* | stats max(lat)", g2)
         assert self._rows(g2)[0]["max_lat"] == b"10"
+
+
+class TestFunctionLibrary:
+    """Round-3 SPL depth: nested function calls in extend."""
+
+    def _run(self, script, rows):
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        p = ProcessorSPL()
+        assert p.init({"Script": script}, PluginContext("t")), script
+        g = _mk_group(rows)
+        p.process(g)
+        return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+
+    def test_string_functions(self):
+        rows = self._run(
+            "* | extend u = upper(name) | extend s = substring(name, 1, 2)"
+            " | extend r = replace(name, 'a', 'o')"
+            " | extend p = split_part(path, '/', 3)",
+            [{"name": "alice", "path": "/api/users/42"}])
+        assert rows[0]["u"] == b"ALICE"
+        assert rows[0]["s"] == b"li"
+        assert rows[0]["r"] == b"olice"
+        assert rows[0]["p"] == b"users"
+
+    def test_nested_calls(self):
+        rows = self._run(
+            "* | extend x = concat(upper(kind), '-', md5(kind))",
+            [{"kind": "web"}])
+        import hashlib
+        assert rows[0]["x"] == (b"WEB-"
+                                + hashlib.md5(b"web").hexdigest().encode())
+
+    def test_math_and_round(self):
+        rows = self._run(
+            "* | extend total = add(a, b) | extend r = round(div(a, b), 2)",
+            [{"a": "10", "b": "4"}])
+        assert rows[0]["total"] == b"14"
+        assert rows[0]["r"] == b"2.5"
+
+    def test_if_conditional(self):
+        rows = self._run(
+            "* | extend level = if(status >= 500, 'error', 'ok')",
+            [{"status": "503"}, {"status": "200"}])
+        assert rows[0]["level"] == b"error"
+        assert rows[1]["level"] == b"ok"
+
+    def test_json_extract_and_coalesce(self):
+        rows = self._run(
+            "* | extend city = json_extract(doc, '$.addr.city')"
+            " | extend who = coalesce(nick, name)",
+            [{"doc": '{"addr": {"city": "hz"}}', "name": "bob",
+              "nick": ""}])
+        assert rows[0]["city"] == b"hz"
+        assert rows[0]["who"] == b"bob"
+
+    def test_from_unixtime(self):
+        rows = self._run(
+            "* | extend t = from_unixtime(ts, '%Y-%m-%d')",
+            [{"ts": "1700000000"}])
+        assert rows[0]["t"] == b"2023-11-14"
+
+    def test_unknown_function_fails_compile(self):
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        p = ProcessorSPL()
+        assert not p.init({"Script": "* | extend x = frobnicate(a)"},
+                          PluginContext("t"))
+
+
+class TestJoin:
+    def _table(self, tmp_path):
+        f = tmp_path / "lookup.csv"
+        f.write_text("uid,team,region\n42,core,eu\n7,infra,us\n")
+        return str(f)
+
+    def _run(self, script, rows):
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        p = ProcessorSPL()
+        assert p.init({"Script": script}, PluginContext("t")), script
+        g = _mk_group(rows)
+        p.process(g)
+        return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+
+    def test_inner_join(self, tmp_path):
+        path = self._table(tmp_path)
+        rows = self._run(
+            f"* | join file('{path}') on uid",
+            [{"uid": "42", "msg": "a"}, {"uid": "99", "msg": "b"}])
+        assert len(rows) == 1
+        assert rows[0]["team"] == b"core" and rows[0]["region"] == b"eu"
+
+    def test_left_join_keeps_unmatched(self, tmp_path):
+        path = self._table(tmp_path)
+        rows = self._run(
+            f"* | join type=left file('{path}') on uid",
+            [{"uid": "7"}, {"uid": "99"}])
+        assert len(rows) == 2
+        assert rows[0]["team"] == b"infra"
+        assert "team" not in rows[1]
+
+    def test_missing_table_fails_compile(self):
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        p = ProcessorSPL()
+        assert not p.init(
+            {"Script": "* | join file('/nonexistent.csv') on k"},
+            PluginContext("t"))
+
+
+def _mk_group(rows):
+    from loongcollector_tpu.models import PipelineEventGroup
+    g = PipelineEventGroup()
+    sb = g.source_buffer
+    for row in rows:
+        ev = g.add_log_event(1700000000)
+        for k, v in row.items():
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(v.encode()))
+    return g
+
+
+class TestReviewRegressions:
+    def _run(self, script, rows):
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        p = ProcessorSPL()
+        assert p.init({"Script": script}, PluginContext("t")), script
+        g = _mk_group(rows)
+        p.process(g)
+        return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+
+    def test_nested_if(self):
+        rows = self._run(
+            "* | extend sev = if(code >= 500, 'err',"
+            " if(code >= 400, 'warn', 'ok'))",
+            [{"code": "503"}, {"code": "404"}, {"code": "200"}])
+        assert [r["sev"] for r in rows] == [b"err", b"warn", b"ok"]
+
+    def test_if_inside_concat(self):
+        rows = self._run(
+            "* | extend m = concat('[', if(n > 1, 'many', 'one'), ']')",
+            [{"n": "5"}])
+        assert rows[0]["m"] == b"[many]"
+
+    def test_inner_join_on_columnar_group_drops_all(self, tmp_path):
+        """Dropped rows must NOT resurrect from stale columns."""
+        import numpy as np
+
+        from loongcollector_tpu.models import (ColumnarLogs,
+                                               PipelineEventGroup,
+                                               SourceBuffer)
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        from loongcollector_tpu.processor.spl import ProcessorSPL
+        f = tmp_path / "t.csv"
+        f.write_text("uid,team\n42,core\n")
+        data = b"uid=7\nuid=8\n"
+        sb = SourceBuffer(len(data) + 64)
+        view = sb.copy_string(data)
+        g = PipelineEventGroup(sb)
+        offs = np.array([view.offset, view.offset + 6], dtype=np.int64)
+        lens = np.array([5, 5], dtype=np.int32)
+        cols = ColumnarLogs(offs.astype(np.int32), lens,
+                            np.full(2, 1700000000, dtype=np.int64))
+        cols.set_field("uid", np.array([view.offset + 4,
+                                        view.offset + 10],
+                                       dtype=np.int32),
+                       np.array([1, 1], dtype=np.int32))
+        g.set_columns(cols)
+        p = ProcessorSPL()
+        assert p.init({"Script": f"* | join file('{f}') on uid"},
+                      PluginContext("t"))
+        p.process(g)
+        assert len(g) == 0, "unmatched rows resurrected from columns"
