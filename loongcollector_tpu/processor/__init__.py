@@ -81,3 +81,6 @@ def register_all(registry) -> None:
     registry.register_processor("processor_fields_with_condition",
                                 ProcessorFieldsWithCondition)
     registry.register_processor("processor_geoip", ProcessorGeoIP)
+    from .longtail2 import ALL as _LONGTAIL2
+    for _cls in _LONGTAIL2:
+        registry.register_processor(_cls.name, _cls)

@@ -1,0 +1,545 @@
+"""Long-tail processors, batch 2 — closes the remaining reference dirs.
+
+Reference: plugins/processor/{anchor,appender,cloudmeta,csv,defaultone,
+droplastkey,gotime,logtoslsmetric,md5,otel}/ with Go-compatible config
+keys and semantics (differential tests in tests/test_longtail2.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext, Processor
+from ..utils.logger import get_logger
+
+log = get_logger("longtail2")
+
+
+def each_log_event(group: PipelineEventGroup):
+    """LogEvents only (materializes columnar groups — these processors
+    mutate per-event fields)."""
+    for ev in group.events:
+        if hasattr(ev, "contents"):
+            yield ev
+
+
+# ------------------------------------------------------------------- anchor
+
+
+class ProcessorAnchor(Processor):
+    """processor_anchor (plugins/processor/anchor/anchor.go): per anchor,
+    extract the substring between Start and Stop from SourceKey into
+    FieldName; FieldType json + ExpondJSON flattens the parsed object into
+    FieldName<connector>sub keys up to MaxExpondDepth."""
+
+    name = "processor_anchor"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = str(config.get("SourceKey", "content")).encode()
+        self.keep_source = bool(config.get("KeepSource", True))
+        self.anchors = []
+        for a in config.get("Anchors", []):
+            self.anchors.append({
+                "start": str(a.get("Start", "")).encode(),
+                "stop": str(a.get("Stop", "")).encode(),
+                "field": str(a.get("FieldName", "")).encode(),
+                "json": str(a.get("FieldType", "string")) == "json",
+                "expand": bool(a.get("ExpondJSON", False)),
+                "conn": str(a.get("ExpondConnecter", "_")),
+                "depth": int(a.get("MaxExpondDepth", 0)) or 100,
+            })
+        return bool(self.anchors)
+
+    def _expand(self, ev, sb, prefix: str, doc, conn: str,
+                depth: int) -> None:
+        if depth <= 0 or not isinstance(doc, (dict, list)):
+            val = (doc if isinstance(doc, str)
+                   else json.dumps(doc, separators=(",", ":")))
+            ev.set_content(sb.copy_string(prefix.encode()),
+                           sb.copy_string(val.encode()))
+            return
+        items = (doc.items() if isinstance(doc, dict)
+                 else enumerate(doc))
+        for k, v in items:
+            self._expand(ev, sb, f"{prefix}{conn}{k}", v, conn, depth - 1)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            src = ev.get_content(self.source_key)
+            if src is None:
+                continue
+            data = src.to_bytes()
+            for a in self.anchors:
+                i = data.find(a["start"]) if a["start"] else 0
+                if i < 0:
+                    continue
+                i += len(a["start"])
+                j = data.find(a["stop"], i) if a["stop"] else len(data)
+                if j < 0:
+                    continue
+                val = data[i:j]
+                if a["json"] and a["expand"]:
+                    try:
+                        doc = json.loads(val)
+                    except ValueError:
+                        continue
+                    self._expand(ev, sb, a["field"].decode(), doc,
+                                 a["conn"], a["depth"])
+                else:
+                    ev.set_content(sb.copy_string(a["field"]),
+                                   sb.copy_string(val))
+            if not self.keep_source:
+                ev.del_content(self.source_key)
+
+
+# ----------------------------------------------------------------- appender
+
+
+class ProcessorAppender(Processor):
+    """processor_appender: append Value to Key's existing value, with
+    {{__hostname__}} / {{__ip__}} / {{env.NAME}} platform substitution."""
+
+    name = "processor_appender"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.key = str(config.get("Key", "")).encode()
+        self.value = self._substitute(str(config.get("Value", "")))
+        return bool(self.key) and bool(self.value)
+
+    @staticmethod
+    def _substitute(val: str) -> bytes:
+        import socket
+        out = val.replace("{{__hostname__}}", socket.gethostname())
+        if "{{__ip__}}" in out:
+            try:
+                ip = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                ip = ""
+            out = out.replace("{{__ip__}}", ip)
+        out = re.sub(r"\{\{env\.(\w+)\}\}",
+                     lambda m: os.environ.get(m.group(1), ""), out)
+        return out.encode()
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            old = ev.get_content(self.key)
+            merged = (old.to_bytes() if old is not None else b"") + self.value
+            ev.set_content(sb.copy_string(self.key), sb.copy_string(merged))
+
+
+# ---------------------------------------------------------------- cloudmeta
+
+
+class ProcessorCloudMeta(Processor):
+    """processor_cloud_meta: stamp host/cloud identity metadata onto events
+    (reference reads the ECS metadata service; this implementation reads
+    env overrides ALIYUN_* / standard envs with hostname/ip fallbacks —
+    metadata-server access is deployment-specific and injectable here)."""
+
+    name = "processor_cloud_meta"
+
+    _META = ("instance_id", "instance_name", "region", "zone", "hostname",
+             "ip")
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        import socket
+        want = config.get("Metadata") or list(self._META)
+        prefix = str(config.get("KeyPrefix", "__cloud_"))
+        values = {
+            "instance_id": os.environ.get("ALIYUN_INSTANCE_ID", ""),
+            "instance_name": os.environ.get("ALIYUN_INSTANCE_NAME", ""),
+            "region": os.environ.get("ALIYUN_REGION_ID", ""),
+            "zone": os.environ.get("ALIYUN_ZONE_ID", ""),
+            "hostname": socket.gethostname(),
+        }
+        try:
+            values["ip"] = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            values["ip"] = ""
+        self.fields = {(prefix + k + "__").encode(): values[k].encode()
+                       for k in want if k in values and values[k]}
+        return bool(self.fields)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            for k, v in self.fields.items():
+                ev.set_content(sb.copy_string(k), sb.copy_string(v))
+
+
+# --------------------------------------------------------------------- csv
+
+
+class ProcessorCSV(Processor):
+    """processor_csv: parse SourceKey as one CSV record into SplitKeys
+    (quote-aware); surplus columns keep ExpandKeyPrefix<N> names when
+    ExpandOthers, else are dropped; missing keys honored by NoKeyError."""
+
+    name = "processor_csv"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = str(config.get("SourceKey", "content")).encode()
+        self.split_keys = [str(k).encode()
+                           for k in config.get("SplitKeys", [])]
+        self.sep = str(config.get("SplitSep", ","))
+        self.trim = bool(config.get("TrimLeadingSpace", False))
+        self.keep_source = bool(config.get("KeepSource", False))
+        self.expand_others = bool(config.get("ExpandOthers", False))
+        self.expand_prefix = str(config.get("ExpandKeyPrefix", "expand_"))
+        return bool(self.split_keys) and len(self.sep) == 1
+
+    def process(self, group: PipelineEventGroup) -> None:
+        import csv
+        import io
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            src = ev.get_content(self.source_key)
+            if src is None:
+                continue
+            text = src.to_bytes().decode("utf-8", "replace")
+            reader = csv.reader(io.StringIO(text), delimiter=self.sep,
+                                skipinitialspace=self.trim)
+            row = next(reader, [])
+            for i, val in enumerate(row):
+                if i < len(self.split_keys):
+                    key = self.split_keys[i]
+                elif self.expand_others:
+                    key = (f"{self.expand_prefix}"
+                           f"{i - len(self.split_keys) + 1}").encode()
+                else:
+                    break
+                ev.set_content(sb.copy_string(key),
+                               sb.copy_string(val.encode()))
+            if not self.keep_source:
+                ev.del_content(self.source_key)
+
+
+# --------------------------------------------------------------- defaultone
+
+
+class ProcessorDefault(Processor):
+    """processor_default: explicit passthrough (the Go runtime's default
+    pipeline stage when no processors are configured)."""
+
+    name = "processor_default"
+
+    def process(self, group: PipelineEventGroup) -> None:
+        return
+
+
+# ------------------------------------------------------------- droplastkey
+
+
+class ProcessorDropLastKey(Processor):
+    """processor_drop_last_key: once processing added keys beyond the
+    Include set, the raw DropKey has served its purpose — remove it."""
+
+    name = "processor_drop_last_key"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.drop_key = str(config.get("DropKey", "")).encode()
+        self.include = {str(k).encode() for k in config.get("Include", [])}
+        return bool(self.drop_key) and bool(self.include)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        for ev in each_log_event(group):
+            keys = {bytes(k) for k, _ in ev.contents}
+            if keys - self.include - {self.drop_key}:
+                ev.del_content(self.drop_key)
+
+
+# ------------------------------------------------------------------ gotime
+
+
+_GO_TOKENS = [          # longest-first: Go reference layout → strptime
+    ("2006", "%Y"), ("01", "%m"), ("02", "%d"), ("15", "%H"),
+    ("04", "%M"), ("05", "%S"), ("Monday", "%A"), ("Mon", "%a"),
+    ("January", "%B"), ("Jan", "%b"), ("PM", "%p"), ("03", "%I"),
+    ("-0700", "%z"), ("MST", "%Z"), ("06", "%y"),
+]
+
+
+def go_layout_to_strptime(layout: str) -> str:
+    out = layout
+    for go, py in _GO_TOKENS:
+        out = out.replace(go, py)
+    out = re.sub(r"\.0+", lambda m: ".%f", out)   # .000... → fractional
+    return out
+
+
+class ProcessorGotime(Processor):
+    """processor_gotime: parse SourceKey using a Go time layout (or the
+    fixed 'seconds'/'milliseconds'/'microseconds' timestamp patterns),
+    write DestKey in DestFormat, optionally SetTime on the event."""
+
+    name = "processor_gotime"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = str(config.get("SourceKey", "")).encode()
+        self.source_format = str(config.get("SourceFormat", ""))
+        self.source_loc = config.get("SourceLocation")   # tz offset hours
+        self.dest_key = str(config.get("DestKey", "")).encode()
+        self.dest_format = str(config.get("DestFormat", ""))
+        self.set_time = bool(config.get("SetTime", True))
+        self.keep_source = bool(config.get("KeepSource", True))
+        if not (self.source_key and self.source_format and self.dest_key
+                and self.dest_format):
+            return False
+        self._fixed = self.source_format in ("seconds", "milliseconds",
+                                             "microseconds")
+        if not self._fixed:
+            self._py_src = go_layout_to_strptime(self.source_format)
+        self._py_dst = go_layout_to_strptime(self.dest_format)
+        return True
+
+    def _parse(self, raw: bytes) -> Optional[float]:
+        try:
+            if self._fixed:
+                v = float(raw)
+                scale = {"seconds": 1.0, "milliseconds": 1e3,
+                         "microseconds": 1e6}[self.source_format]
+                return v / scale
+            import calendar
+            import datetime as dt
+            t = dt.datetime.strptime(raw.decode("utf-8", "replace"),
+                                     self._py_src)
+            if t.tzinfo is not None:
+                return t.timestamp()
+            epoch = calendar.timegm(t.timetuple()) + t.microsecond / 1e6
+            if self.source_loc is not None:
+                return epoch - float(self.source_loc) * 3600.0
+            # no location: interpret in machine-local time (Go default)
+            return time.mktime(t.timetuple()) + t.microsecond / 1e6
+        except (ValueError, OverflowError):
+            return None
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            src = ev.get_content(self.source_key)
+            if src is None:
+                continue
+            epoch = self._parse(src.to_bytes())
+            if epoch is None:
+                continue
+            out = time.strftime(self._py_dst, time.gmtime(epoch))
+            ev.set_content(sb.copy_string(self.dest_key),
+                           sb.copy_string(out.encode()))
+            if self.set_time:
+                ev.timestamp = int(epoch)
+            if not self.keep_source:
+                ev.del_content(self.source_key)
+
+
+# --------------------------------------------------------- logtoslsmetric
+
+
+class ProcessorLogToSlsMetric(Processor):
+    """processor_log_to_sls_metric: reshape log events into MetricEvents —
+    MetricLabelKeys become labels, each MetricValues {nameKey: valueKey}
+    pair emits one metric named by the nameKey field's VALUE, plus
+    CustomMetricLabels constants; MetricTimeKey overrides the timestamp
+    (nanoseconds or seconds)."""
+
+    name = "processor_log_to_sls_metric"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.time_key = str(config.get("MetricTimeKey", "")).encode()
+        self.label_keys = [str(k).encode()
+                           for k in config.get("MetricLabelKeys", [])]
+        self.values = {str(k).encode(): str(v).encode()
+                       for k, v in (config.get("MetricValues") or {}).items()}
+        self.custom_labels = {str(k).encode(): str(v).encode()
+                              for k, v in
+                              (config.get("CustomMetricLabels") or {}).items()}
+        return bool(self.values)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        out_events = []
+        for ev in group.events:
+            if not hasattr(ev, "contents"):
+                out_events.append(ev)
+                continue
+            fields = {bytes(k): v.to_bytes() for k, v in ev.contents}
+            ts = ev.timestamp
+            if self.time_key and self.time_key in fields:
+                try:
+                    raw_ts = int(fields[self.time_key])
+                    ts = raw_ts // 10**9 if raw_ts > 10**12 else raw_ts
+                except ValueError:
+                    pass
+            for name_key, value_key in self.values.items():
+                name = fields.get(name_key)
+                raw = fields.get(value_key)
+                if name is None or raw is None:
+                    continue
+                try:
+                    value = float(raw)
+                except ValueError:
+                    continue
+                from ..models.events import MetricEvent
+                m = MetricEvent(timestamp=ts)
+                m.set_name(sb.copy_string(name))
+                m.set_value(value)
+                for lk in self.label_keys:
+                    lv = fields.get(lk)
+                    if lv is not None:
+                        m.set_tag(sb.copy_string(lk).to_bytes(),
+                                  sb.copy_string(lv))
+                for ck, cv in self.custom_labels.items():
+                    m.set_tag(ck, sb.copy_string(cv))
+                out_events.append(m)
+        group.events[:] = out_events
+
+
+# --------------------------------------------------------------------- md5
+
+
+class ProcessorMD5(Processor):
+    """processor_md5: DestKey = md5hex(SourceKey value)."""
+
+    name = "processor_md5"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = str(config.get("SourceKey", "")).encode()
+        self.dest_key = str(config.get("DestKey", "")).encode()
+        return bool(self.source_key) and bool(self.dest_key)
+
+    def process(self, group: PipelineEventGroup) -> None:
+        sb = group.source_buffer
+        for ev in each_log_event(group):
+            src = ev.get_content(self.source_key)
+            if src is None:
+                continue
+            digest = hashlib.md5(src.to_bytes()).hexdigest().encode()
+            ev.set_content(sb.copy_string(self.dest_key),
+                           sb.copy_string(digest))
+
+
+# -------------------------------------------------------------------- otel
+
+
+class ProcessorOtelTrace(Processor):
+    """processor_otel_trace: logs carrying trace-shaped fields (traceID,
+    spanID, parentSpanID, spanName/operationName, startTime, endTime,
+    statusCode, kind, attributes JSON) become native SpanEvents."""
+
+    name = "processor_otel_trace"
+
+    _KIND = {b"server": 2, b"client": 3, b"producer": 4, b"consumer": 5,
+             b"internal": 1}
+
+    def process(self, group: PipelineEventGroup) -> None:
+        from ..models.events import SpanEvent
+        out = []
+        for ev in group.events:
+            if not hasattr(ev, "contents"):
+                out.append(ev)
+                continue
+            fields = {bytes(k): v.to_bytes() for k, v in ev.contents}
+            trace_id = fields.get(b"traceID") or fields.get(b"traceId")
+            span_id = fields.get(b"spanID") or fields.get(b"spanId")
+            if not trace_id or not span_id:
+                out.append(ev)          # not a trace row: pass through
+                continue
+            span = SpanEvent(timestamp=ev.timestamp)
+            span.trace_id = trace_id
+            span.span_id = span_id
+            span.parent_span_id = (fields.get(b"parentSpanID")
+                                   or fields.get(b"parentSpanId") or b"")
+            span.name = (fields.get(b"spanName")
+                         or fields.get(b"operationName") or b"")
+            for key, attr in ((b"startTime", "start_time_ns"),
+                              (b"endTime", "end_time_ns")):
+                raw = fields.get(key)
+                if raw is not None:
+                    try:
+                        v = int(raw)
+                        setattr(span, attr,
+                                v * 1000 if v < 10**16 else v)  # µs → ns
+                    except ValueError:
+                        pass
+            span.kind = SpanEvent.Kind(
+                self._KIND.get(fields.get(b"kind", b"").lower(), 0))
+            status = fields.get(b"statusCode", b"").upper()
+            if status in (b"ERROR", b"2"):
+                span.status = SpanEvent.Status.ERROR
+            elif status in (b"OK", b"1"):
+                span.status = SpanEvent.Status.OK
+            attrs = fields.get(b"attribute") or fields.get(b"attributes")
+            if attrs:
+                try:
+                    for k, v in json.loads(attrs).items():
+                        span.set_attribute(str(k).encode(),
+                                           str(v).encode())
+                except (ValueError, AttributeError):
+                    pass
+            out.append(span)
+        group.events[:] = out
+
+
+class ProcessorOtelMetric(Processor):
+    """processor_otel_metric: logs in SLS metric shape (__name__,
+    __value__, __labels__ 'k#$#v|k#$#v', __time_nano__) become native
+    MetricEvents."""
+
+    name = "processor_otel_metric"
+
+    def process(self, group: PipelineEventGroup) -> None:
+        from ..models.events import MetricEvent
+        sb = group.source_buffer
+        out = []
+        for ev in group.events:
+            if not hasattr(ev, "contents"):
+                out.append(ev)
+                continue
+            fields = {bytes(k): v.to_bytes() for k, v in ev.contents}
+            name = fields.get(b"__name__")
+            raw = fields.get(b"__value__")
+            if not name or raw is None:
+                out.append(ev)
+                continue
+            try:
+                value = float(raw)
+            except ValueError:
+                out.append(ev)
+                continue
+            ts = ev.timestamp
+            tn = fields.get(b"__time_nano__")
+            if tn is not None:
+                try:
+                    ts = int(tn) // 10**9
+                except ValueError:
+                    pass
+            m = MetricEvent(timestamp=ts)
+            m.set_name(sb.copy_string(name))
+            m.set_value(value)
+            for pair in (fields.get(b"__labels__") or b"").split(b"|"):
+                k, sep, v = pair.partition(b"#$#")
+                if sep and k:
+                    m.set_tag(bytes(k), sb.copy_string(v))
+            out.append(m)
+        group.events[:] = out
+
+
+ALL = [ProcessorAnchor, ProcessorAppender, ProcessorCloudMeta,
+       ProcessorCSV, ProcessorDefault, ProcessorDropLastKey,
+       ProcessorGotime, ProcessorLogToSlsMetric, ProcessorMD5,
+       ProcessorOtelTrace, ProcessorOtelMetric]

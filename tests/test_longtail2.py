@@ -1,0 +1,244 @@
+"""Long-tail processors batch 2: Go-compat behavior tests.
+
+Reference semantics from plugins/processor/{anchor,appender,cloudmeta,
+csv,defaultone,droplastkey,gotime,logtoslsmetric,md5,otel}/.
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from loongcollector_tpu.models import PipelineEventGroup
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+
+
+def _proc(name, cfg):
+    r = PluginRegistry.instance()
+    r.load_static_plugins()
+    p = r.create_processor(name)
+    assert p is not None, name
+    assert p.init(cfg, PluginContext("t")), (name, cfg)
+    return p
+
+
+def _group(rows):
+    g = PipelineEventGroup()
+    sb = g.source_buffer
+    for row in rows:
+        ev = g.add_log_event(1700000000)
+        for k, v in row.items():
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(v.encode()))
+    return g
+
+
+def _rows(g):
+    return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+            for ev in g.events if hasattr(ev, "contents")]
+
+
+class TestAnchor:
+    def test_string_extraction(self):
+        p = _proc("processor_anchor", {
+            "SourceKey": "content",
+            "Anchors": [{"Start": "time:", "Stop": "\t",
+                         "FieldName": "time"},
+                        {"Start": "status:", "Stop": "",
+                         "FieldName": "status"}]})
+        g = _group([{"content": "time:12:01\tstatus:200"}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert r["time"] == b"12:01" and r["status"] == b"200"
+
+    def test_json_expansion(self):
+        p = _proc("processor_anchor", {
+            "SourceKey": "content", "KeepSource": False,
+            "Anchors": [{"Start": "json:", "Stop": "", "FieldName": "j",
+                         "FieldType": "json", "ExpondJSON": True,
+                         "ExpondConnecter": "_"}]})
+        g = _group([{"content": 'json:{"a": {"b": 1}, "c": "x"}'}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert r["j_a_b"] == b"1" and r["j_c"] == b"x"
+        assert "content" not in r
+
+
+class TestAppender:
+    def test_append_with_substitution(self):
+        import socket
+        p = _proc("processor_appender",
+                  {"Key": "tags", "Value": "|host={{__hostname__}}"})
+        g = _group([{"tags": "app=web"}])
+        p.process(g)
+        want = f"app=web|host={socket.gethostname()}".encode()
+        assert _rows(g)[0]["tags"] == want
+
+    def test_env_substitution(self, monkeypatch):
+        monkeypatch.setenv("DEPLOY_ENV", "staging")
+        p = _proc("processor_appender",
+                  {"Key": "k", "Value": "-{{env.DEPLOY_ENV}}"})
+        g = _group([{"k": "v"}])
+        p.process(g)
+        assert _rows(g)[0]["k"] == b"v-staging"
+
+
+class TestCloudMeta:
+    def test_env_metadata(self, monkeypatch):
+        monkeypatch.setenv("ALIYUN_INSTANCE_ID", "i-abc123")
+        monkeypatch.setenv("ALIYUN_REGION_ID", "cn-hangzhou")
+        p = _proc("processor_cloud_meta",
+                  {"Metadata": ["instance_id", "region", "hostname"]})
+        g = _group([{"m": "1"}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert r["__cloud_instance_id__"] == b"i-abc123"
+        assert r["__cloud_region__"] == b"cn-hangzhou"
+        assert r["__cloud_hostname__"]
+
+
+class TestCSV:
+    def test_quoted_fields(self):
+        p = _proc("processor_csv", {
+            "SourceKey": "content",
+            "SplitKeys": ["name", "city", "note"]})
+        g = _group([{"content": 'alice,"hang, zhou","said ""hi"""'}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert r["name"] == b"alice"
+        assert r["city"] == b"hang, zhou"
+        assert r["note"] == b'said "hi"'
+        assert "content" not in r
+
+    def test_expand_others(self):
+        p = _proc("processor_csv", {
+            "SourceKey": "content", "SplitKeys": ["a"],
+            "ExpandOthers": True, "ExpandKeyPrefix": "x_"})
+        g = _group([{"content": "1,2,3"}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert (r["a"], r["x_1"], r["x_2"]) == (b"1", b"2", b"3")
+
+
+class TestDropLastKey:
+    def test_drops_when_parsed(self):
+        p = _proc("processor_drop_last_key",
+                  {"DropKey": "raw", "Include": ["raw"]})
+        g = _group([{"raw": "x=1", "x": "1"},     # parsed: extra key
+                    {"raw": "unparsed"}])          # not parsed: keep
+        p.process(g)
+        rows = _rows(g)
+        assert "raw" not in rows[0]
+        assert rows[1]["raw"] == b"unparsed"
+
+
+class TestGotime:
+    def test_layout_conversion(self):
+        from loongcollector_tpu.processor.longtail2 import \
+            go_layout_to_strptime
+        assert go_layout_to_strptime("2006-01-02 15:04:05") \
+            == "%Y-%m-%d %H:%M:%S"
+        assert go_layout_to_strptime("02/Jan/2006:15:04:05 -0700") \
+            == "%d/%b/%Y:%H:%M:%S %z"
+
+    def test_parse_and_set_time(self):
+        p = _proc("processor_gotime", {
+            "SourceKey": "t", "SourceFormat": "2006-01-02 15:04:05",
+            "SourceLocation": 0, "DestKey": "iso",
+            "DestFormat": "2006-01-02", "SetTime": True})
+        g = _group([{"t": "2023-11-14 22:13:20"}])
+        p.process(g)
+        ev = g.events[0]
+        assert ev.timestamp == 1700000000
+        assert _rows(g)[0]["iso"] == b"2023-11-14"
+
+    def test_fixed_milliseconds(self):
+        p = _proc("processor_gotime", {
+            "SourceKey": "t", "SourceFormat": "milliseconds",
+            "DestKey": "d", "DestFormat": "2006-01-02 15:04:05"})
+        g = _group([{"t": "1700000000000"}])
+        p.process(g)
+        assert g.events[0].timestamp == 1700000000
+        assert _rows(g)[0]["d"] == b"2023-11-14 22:13:20"
+
+
+class TestLogToSlsMetric:
+    def test_conversion(self):
+        from loongcollector_tpu.models.events import MetricEvent
+        p = _proc("processor_log_to_sls_metric", {
+            "MetricTimeKey": "ts_nano",
+            "MetricLabelKeys": ["host"],
+            "MetricValues": {"mname": "mval"},
+            "CustomMetricLabels": {"cluster": "c1"}})
+        g = _group([{"mname": "cpu_util", "mval": "0.75",
+                     "host": "web-1", "ts_nano": "1700000001000000000"}])
+        p.process(g)
+        [m] = g.events
+        assert isinstance(m, MetricEvent)
+        assert bytes(m.name) == b"cpu_util"
+        assert m.value.value == 0.75
+        assert m.timestamp == 1700000001
+        assert bytes(m.get_tag(b"host")) == b"web-1"
+        assert bytes(m.get_tag(b"cluster")) == b"c1"
+
+    def test_bad_value_passthrough(self):
+        p = _proc("processor_log_to_sls_metric",
+                  {"MetricValues": {"n": "v"}})
+        g = _group([{"n": "m1", "v": "not-a-number"}])
+        p.process(g)
+        assert len(g.events) == 0      # unparseable value emits nothing
+
+
+class TestMD5:
+    def test_digest(self):
+        p = _proc("processor_md5", {"SourceKey": "content",
+                                    "DestKey": "sig"})
+        g = _group([{"content": "hello"}])
+        p.process(g)
+        assert _rows(g)[0]["sig"] == \
+            hashlib.md5(b"hello").hexdigest().encode()
+
+
+class TestOtel:
+    def test_trace_conversion(self):
+        from loongcollector_tpu.models.events import SpanEvent
+        p = _proc("processor_otel_trace", {})
+        g = _group([{"traceID": "t1", "spanID": "s1",
+                     "parentSpanID": "s0", "spanName": "GET /x",
+                     "startTime": "1700000000000000",
+                     "endTime": "1700000000250000",
+                     "kind": "server", "statusCode": "ERROR",
+                     "attribute": '{"http.method": "GET"}'},
+                    {"plain": "log line"}])
+        p.process(g)
+        span, plain = g.events
+        assert isinstance(span, SpanEvent)
+        assert span.trace_id == b"t1"
+        assert span.start_time_ns == 1700000000000000000
+        assert span.kind == SpanEvent.Kind.SERVER
+        assert span.status == SpanEvent.Status.ERROR
+        assert span.attributes[b"http.method"].to_bytes() == b"GET"
+        assert hasattr(plain, "contents")      # non-trace row untouched
+
+    def test_metric_conversion(self):
+        from loongcollector_tpu.models.events import MetricEvent
+        p = _proc("processor_otel_metric", {})
+        g = _group([{"__name__": "rps", "__value__": "12.5",
+                     "__labels__": "svc#$#cart|zone#$#eu",
+                     "__time_nano__": "1700000002000000000"}])
+        p.process(g)
+        [m] = g.events
+        assert isinstance(m, MetricEvent)
+        assert m.value.value == 12.5
+        assert m.timestamp == 1700000002
+        assert bytes(m.get_tag(b"svc")) == b"cart"
+        assert bytes(m.get_tag(b"zone")) == b"eu"
+
+
+class TestDefault:
+    def test_passthrough(self):
+        p = _proc("processor_default", {})
+        g = _group([{"a": "1"}])
+        p.process(g)
+        assert _rows(g) == [{"a": b"1"}]
