@@ -15,10 +15,18 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..models import PipelineEventGroup
+from ..models.events import MetricEvent, SpanEvent
 from ..pipeline.plugin.interface import PluginContext, Processor
 from ..utils.logger import get_logger
 
 log = get_logger("longtail2")
+
+
+def _replace_events(group: PipelineEventGroup, out_events: list) -> None:
+    """Swap the group's event list, clearing any columnar view — stale
+    columns would re-materialize dropped events on the next access."""
+    group.events[:] = out_events
+    group._columns = None
 
 
 def each_log_event(group: PipelineEventGroup):
@@ -77,8 +85,9 @@ class ProcessorAnchor(Processor):
             if src is None:
                 continue
             data = src.to_bytes()
-            for a in self.anchors:
-                i = data.find(a["start"]) if a["start"] else 0
+            cursor = 0      # sequential scan: each anchor starts after the
+            for a in self.anchors:      # previous one's match (Go plugin)
+                i = data.find(a["start"], cursor) if a["start"] else cursor
                 if i < 0:
                     continue
                 i += len(a["start"])
@@ -86,6 +95,7 @@ class ProcessorAnchor(Processor):
                 if j < 0:
                     continue
                 val = data[i:j]
+                cursor = j
                 if a["json"] and a["expand"]:
                     try:
                         doc = json.loads(val)
@@ -292,6 +302,7 @@ class ProcessorGotime(Processor):
         self.source_key = str(config.get("SourceKey", "")).encode()
         self.source_format = str(config.get("SourceFormat", ""))
         self.source_loc = config.get("SourceLocation")   # tz offset hours
+        self.dest_loc = config.get("DestLocation")       # tz offset hours
         self.dest_key = str(config.get("DestKey", "")).encode()
         self.dest_format = str(config.get("DestFormat", ""))
         self.set_time = bool(config.get("SetTime", True))
@@ -336,7 +347,14 @@ class ProcessorGotime(Processor):
             epoch = self._parse(src.to_bytes())
             if epoch is None:
                 continue
-            out = time.strftime(self._py_dst, time.gmtime(epoch))
+            import datetime as dt
+            # datetime.strftime supports %f (fractional layouts) and
+            # DestLocation shifts the rendered wall clock (Go plugin)
+            shift = float(self.dest_loc) * 3600.0 \
+                if self.dest_loc is not None else 0.0
+            when = dt.datetime.fromtimestamp(epoch + shift,
+                                             dt.timezone.utc)
+            out = when.strftime(self._py_dst)
             ev.set_content(sb.copy_string(self.dest_key),
                            sb.copy_string(out.encode()))
             if self.set_time:
@@ -393,19 +411,17 @@ class ProcessorLogToSlsMetric(Processor):
                     value = float(raw)
                 except ValueError:
                     continue
-                from ..models.events import MetricEvent
                 m = MetricEvent(timestamp=ts)
                 m.set_name(sb.copy_string(name))
                 m.set_value(value)
                 for lk in self.label_keys:
                     lv = fields.get(lk)
                     if lv is not None:
-                        m.set_tag(sb.copy_string(lk).to_bytes(),
-                                  sb.copy_string(lv))
+                        m.set_tag(lk, sb.copy_string(lv))
                 for ck, cv in self.custom_labels.items():
                     m.set_tag(ck, sb.copy_string(cv))
                 out_events.append(m)
-        group.events[:] = out_events
+        _replace_events(group, out_events)
 
 
 # --------------------------------------------------------------------- md5
@@ -447,7 +463,6 @@ class ProcessorOtelTrace(Processor):
              b"internal": 1}
 
     def process(self, group: PipelineEventGroup) -> None:
-        from ..models.events import SpanEvent
         out = []
         for ev in group.events:
             if not hasattr(ev, "contents"):
@@ -492,7 +507,7 @@ class ProcessorOtelTrace(Processor):
                 except (ValueError, AttributeError):
                     pass
             out.append(span)
-        group.events[:] = out
+        _replace_events(group, out)
 
 
 class ProcessorOtelMetric(Processor):
@@ -503,7 +518,6 @@ class ProcessorOtelMetric(Processor):
     name = "processor_otel_metric"
 
     def process(self, group: PipelineEventGroup) -> None:
-        from ..models.events import MetricEvent
         sb = group.source_buffer
         out = []
         for ev in group.events:
@@ -536,7 +550,7 @@ class ProcessorOtelMetric(Processor):
                 if sep and k:
                     m.set_tag(bytes(k), sb.copy_string(v))
             out.append(m)
-        group.events[:] = out
+        _replace_events(group, out)
 
 
 ALL = [ProcessorAnchor, ProcessorAppender, ProcessorCloudMeta,

@@ -242,3 +242,53 @@ class TestDefault:
         g = _group([{"a": "1"}])
         p.process(g)
         assert _rows(g) == [{"a": b"1"}]
+
+
+class TestReviewRegressions2:
+    def test_gotime_fractional_dest(self):
+        p = _proc("processor_gotime", {
+            "SourceKey": "t", "SourceFormat": "seconds",
+            "DestKey": "d", "DestFormat": "15:04:05.000"})
+        g = _group([{"t": "1700000000"}])
+        p.process(g)
+        out = _rows(g)[0]["d"]
+        assert b"%f" not in out and out.startswith(b"22:13:20.")
+
+    def test_gotime_dest_location(self):
+        p = _proc("processor_gotime", {
+            "SourceKey": "t", "SourceFormat": "seconds",
+            "DestKey": "d", "DestFormat": "2006-01-02 15:04:05",
+            "DestLocation": 8})
+        g = _group([{"t": "1700000000"}])
+        p.process(g)
+        assert _rows(g)[0]["d"] == b"2023-11-15 06:13:20"   # UTC+8
+
+    def test_anchor_sequential_scan(self):
+        p = _proc("processor_anchor", {
+            "SourceKey": "content",
+            "Anchors": [{"Start": "id=", "Stop": "&", "FieldName": "a"},
+                        {"Start": "id=", "Stop": "&", "FieldName": "b"}]})
+        g = _group([{"content": "id=1&id=2&"}])
+        p.process(g)
+        r = _rows(g)[0]
+        assert (r["a"], r["b"]) == (b"1", b"2")
+
+    def test_metric_conversion_columnar_no_resurrection(self):
+        import numpy as np
+
+        from loongcollector_tpu.models import (ColumnarLogs,
+                                               PipelineEventGroup,
+                                               SourceBuffer)
+        data = b"plain line one\nplain line two\n"
+        sb = SourceBuffer(len(data) + 64)
+        view = sb.copy_string(data)
+        g = PipelineEventGroup(sb)
+        cols = ColumnarLogs(
+            np.array([view.offset, view.offset + 15], dtype=np.int32),
+            np.array([14, 14], dtype=np.int32),
+            np.full(2, 1700000000, dtype=np.int64))
+        g.set_columns(cols)
+        p = _proc("processor_log_to_sls_metric",
+                  {"MetricValues": {"n": "v"}})
+        p.process(g)
+        assert len(g) == 0      # nothing convertible; nothing resurrects
