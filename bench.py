@@ -177,6 +177,34 @@ def bench_multiline(n_records=4096):
     return run()
 
 
+def bench_simple(n=8192):
+    """Single-line collection analogue of the reference's 546 MB/s
+    headline (README.md:66): raw chunk → columnar line split → SLS PB
+    wire serialization, both on the native fast path."""
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+    line = b"2024-01-02 03:04:05 INFO request handled " + b"x" * 470 + b"\n"
+    data = line * n
+    sp = ProcessorSplitLogString(); sp.init({}, PluginContext("bench"))
+    ser = SLSEventGroupSerializer()
+
+    def run_once():
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+        sp.process(g)
+        ser.serialize([g])
+    run_once()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        run_once()
+    return len(data) * 5 / (time.perf_counter() - t0) / 1e6
+
+
 def bench_json(n=8192):
     from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
     from loongcollector_tpu.pipeline.plugin.interface import PluginContext
@@ -393,6 +421,7 @@ def main():
         "grok_nginx_MBps": round(_safe(bench_grok), 1),
         "multiline_java_MBps": round(_safe(bench_multiline), 1),
         "json_parse_MBps": round(_safe(bench_json), 1),
+        "simple_line_MBps": round(_safe(bench_simple), 1),
         "device": str(jax.devices()[0]),
     }
     if degraded:

@@ -27,10 +27,23 @@ except ImportError:  # pragma: no cover
     _yaml = None
 
 
+def _expand_env(text: str) -> str:
+    """${NAME} → environment value (credentials stay out of config files,
+    reference AppConfig env-override shape).  Unset names stay literal so
+    a typo is visible instead of silently becoming empty."""
+    import os
+    import re
+
+    def sub(m):
+        return os.environ.get(m.group(1), m.group(0))
+
+    return re.sub(r"\$\{(\w+)\}", sub, text)
+
+
 def load_config_file(path: str) -> Optional[dict]:
     try:
         with open(path) as f:
-            text = f.read()
+            text = _expand_env(f.read())
     except OSError:
         return None
     if path.endswith((".yaml", ".yml")):

@@ -656,29 +656,49 @@ class _Join(_Stage):
     datasets; an agent-side processor's second dataset is a local table."""
 
     def __init__(self, join_type: Optional[str], path_src: str, key: str):
-        import csv
         self.join_type = join_type or "inner"
         self.key = key
-        path = _unquote(path_src)
-        self.table: Dict[bytes, Dict[str, bytes]] = {}
+        self.path = _unquote(path_src)
+        self.table: Optional[Dict[bytes, Dict[str, bytes]]] = None
+        import os
+        if os.path.exists(self.path):
+            self._load()        # present at config time: fail fast on a
+            # malformed table; an ABSENT table defers to runtime (lookup
+            # files often ship separately from pipeline configs)
+
+    def _load(self) -> None:
+        import csv
+        table: Dict[bytes, Dict[str, bytes]] = {}
         try:
-            with open(path, newline="") as f:
+            with open(self.path, newline="") as f:
                 reader = csv.reader(f)
                 header = next(reader, None)
-                if not header or key not in header:
-                    raise SPLError(
-                        f"join table {path!r} lacks key column {key!r}")
-                key_idx = header.index(key)
+                if not header or self.key not in header:
+                    raise SPLError(f"join table {self.path!r} lacks key "
+                                   f"column {self.key!r}")
+                key_idx = header.index(self.key)
                 for row in reader:
                     if len(row) != len(header):
                         continue
-                    self.table[row[key_idx].encode()] = {
+                    table[row[key_idx].encode()] = {
                         h: row[i].encode() for i, h in enumerate(header)
                         if i != key_idx}
         except OSError as e:
-            raise SPLError(f"join table {path!r} unreadable: {e}")
+            raise SPLError(f"join table {self.path!r} unreadable: {e}")
+        self.table = table
 
     def apply(self, group: PipelineEventGroup) -> None:
+        if self.table is None:
+            try:
+                self._load()        # late-shipped table: retry per batch
+            except SPLError:
+                self.table = None
+            if self.table is None:
+                from ..utils.logger import get_logger
+                get_logger("spl").warning(
+                    "join table %s not loadable yet; passing events "
+                    "through un-joined", self.path)
+                return              # left-join-like passthrough until ready
         sb = group.source_buffer
         cols = group.columns
         if cols is not None and not group._events:

@@ -201,3 +201,17 @@ class TestDiskBuffer:
         buf = DiskBufferWriter(str(d))
         buf.replay(lambda i: None)
         assert buf.pending() == []
+
+
+class TestEnvExpansion:
+    def test_config_env_placeholders(self, tmp_path, monkeypatch):
+        from loongcollector_tpu.config.watcher import load_config_file
+        monkeypatch.setenv("AK_ID", "key-123")
+        f = tmp_path / "p.yaml"
+        f.write_text("flushers:\n  - Type: flusher_sls\n"
+                     "    AccessKeyId: ${AK_ID}\n"
+                     "    AccessKeySecret: ${UNSET_NAME_XYZ}\n")
+        cfg = load_config_file(str(f))
+        fl = cfg["flushers"][0]
+        assert fl["AccessKeyId"] == "key-123"
+        assert fl["AccessKeySecret"] == "${UNSET_NAME_XYZ}"  # stays visible

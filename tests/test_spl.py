@@ -306,13 +306,32 @@ class TestJoin:
         assert rows[0]["team"] == b"infra"
         assert "team" not in rows[1]
 
-    def test_missing_table_fails_compile(self):
+    def test_absent_table_defers_malformed_fails(self, tmp_path):
         from loongcollector_tpu.processor.spl import ProcessorSPL
         from loongcollector_tpu.pipeline.plugin.interface import \
             PluginContext
+        # ABSENT table: config valid, events pass through until it ships
         p = ProcessorSPL()
-        assert not p.init(
-            {"Script": "* | join file('/nonexistent.csv') on k"},
+        missing = tmp_path / "later.csv"
+        assert p.init(
+            {"Script": f"* | join file('{missing}') on uid"},
+            PluginContext("t"))
+        g = _mk_group([{"uid": "42"}])
+        p.process(g)
+        assert len(g.events) == 1          # passthrough, not dropped
+        # table arrives: next batch joins
+        missing.write_text("uid,team\n42,core\n")
+        g2 = _mk_group([{"uid": "42"}, {"uid": "9"}])
+        p.process(g2)
+        rows = [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g2.events]
+        assert len(rows) == 1 and rows[0]["team"] == b"core"
+        # PRESENT but malformed table still fails at config time
+        bad = tmp_path / "bad.csv"
+        bad.write_text("wrong,header\n1,2\n")
+        p2 = ProcessorSPL()
+        assert not p2.init(
+            {"Script": f"* | join file('{bad}') on uid"},
             PluginContext("t"))
 
 
