@@ -66,7 +66,10 @@ class _WalkState:
     """Per-row cursor/match/capture state threaded through the emitter.
     Everything is a [B, 1] column; capture columns are concrete default
     vectors from the start (offset 0, length -1 = absent), so branch
-    merging is a pure element-wise select."""
+    merging is a pure element-wise select.  `ok` is carried as i32 0/1,
+    not bool: Mosaic legalizes `select` on i1 VALUES through an i8
+    round-trip whose final `arith.trunci i8 -> i1` the TPU backend
+    rejects — predicates stay i1, selected data stays i32."""
 
     __slots__ = ("cur", "ok", "cap_off", "cap_len", "cap_start")
 
@@ -102,6 +105,16 @@ class _WalkState:
                         for a, b in zip(taken.cap_len, other.cap_len)]
         self.cap_start = [jnp.where(mask, a, b)
                           for a, b in zip(taken.cap_start, other.cap_start)]
+
+
+def _any_row(mask: jnp.ndarray) -> jnp.ndarray:
+    """`jnp.any(mask, axis=1, keepdims=True)` expressed as an i32
+    max-reduction.  Mosaic lowers a bool (i1) row reduction through an i8
+    accumulator and then emits `arith.trunci i8 -> i1`, which the TPU
+    backend rejects ("Unsupported target bitwidth for truncation").
+    Reducing in i32 and comparing sidesteps the i8 path entirely; under
+    plain XLA the two forms fuse identically."""
+    return jnp.max(mask.astype(jnp.int32), axis=1, keepdims=True) != 0
 
 
 def walk_masks(program: SegmentProgram):
@@ -197,10 +210,9 @@ def build_extract_core(program: SegmentProgram):
             for op in ops:
                 if isinstance(op, Lit):
                     k = len(op.data)
-                    hit = jnp.any((pos == st.cur) & lit_ok[op.data],
-                                  axis=1, keepdims=True)
-                    new_ok = st.ok & hit & (st.cur + k <= lens)
-                    st.ok = jnp.where(active, new_ok, st.ok)
+                    hit = _any_row((pos == st.cur) & lit_ok[op.data])
+                    new_ok = (st.ok != 0) & hit & (st.cur + k <= lens)
+                    st.ok = jnp.where(active, new_ok.astype(i32), st.ok)
                     st.cur = jnp.where(active,
                                        jnp.minimum(st.cur + k, L32), st.cur)
                 elif isinstance(op, Span):
@@ -209,19 +221,19 @@ def build_extract_core(program: SegmentProgram):
                     end = jnp.min(cand, axis=1, keepdims=True)
                     end = jnp.maximum(jnp.minimum(end, lens), st.cur)
                     run = end - st.cur
-                    new_ok = st.ok & (run >= op.min_len)
+                    new_ok = (st.ok != 0) & (run >= op.min_len)
                     if op.max_len != INF:
                         new_ok = new_ok & (run <= op.max_len)
-                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.ok = jnp.where(active, new_ok.astype(i32), st.ok)
                     st.cur = jnp.where(active, end, st.cur)
                 elif isinstance(op, FixedSpan):
-                    new_ok = st.ok & (st.cur + op.n <= lens)
+                    new_ok = (st.ok != 0) & (st.cur + op.n <= lens)
                     if op.n > 0:
                         inside = (pos >= st.cur) & (pos < st.cur + op.n)
                         cnt = jnp.sum((member[op.class_id] & inside)
                                       .astype(i32), axis=1, keepdims=True)
                         new_ok = new_ok & (cnt == op.n)
-                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.ok = jnp.where(active, new_ok.astype(i32), st.ok)
                     st.cur = jnp.where(active,
                                        jnp.minimum(st.cur + op.n, L32), st.cur)
                 elif isinstance(op, CapStart):
@@ -236,7 +248,7 @@ def build_extract_core(program: SegmentProgram):
                 elif isinstance(op, Optional_):
                     before = st.copy()
                     emit(op.body, st, active)
-                    take = active & st.ok
+                    take = active & (st.ok != 0)
                     # greedy preference: keep the body where it matched,
                     # revert (skip the group) where it failed
                     merged = _WalkState(st.cur, st.ok, 0, init_caps=False)
@@ -246,18 +258,18 @@ def build_extract_core(program: SegmentProgram):
                     st.cap_start = merged.cap_start
                 elif isinstance(op, Alt):
                     before = st.copy()
-                    chosen_any = ~true_col    # all-false, data-dependent
+                    chosen_any = cur0         # all-zero i32, data-dependent
                     result = before.copy()
-                    remaining = active & st.ok
+                    remaining = active & (st.ok != 0)
                     for branch in op.branches:
                         trial = before.copy()
                         emit(branch, trial, remaining)
-                        chosen = remaining & trial.ok
+                        chosen = remaining & (trial.ok != 0)
                         merged = _WalkState(result.cur, result.ok, 0,
                                             init_caps=False)
                         merged.select(chosen, trial, result)
                         result = merged
-                        chosen_any = chosen_any | chosen
+                        chosen_any = chosen_any | chosen.astype(i32)
                         remaining = remaining & ~chosen
                     st.cur = jnp.where(active, result.cur, before.cur)
                     st.ok = jnp.where(active, chosen_any, before.ok)
@@ -279,9 +291,9 @@ def build_extract_core(program: SegmentProgram):
                     # forward bytes start at cur-k
                     fwd = op.data[::-1]
                     start = st.cur - k
-                    hit = jnp.any((pos == start) & lit_ok[fwd],
-                                  axis=1, keepdims=True) & (start >= 0)
-                    st.ok = jnp.where(active, st.ok & hit, st.ok)
+                    hit = _any_row((pos == start) & lit_ok[fwd]) & (start >= 0)
+                    st.ok = jnp.where(active,
+                                      ((st.ok != 0) & hit).astype(i32), st.ok)
                     st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
                 elif isinstance(op, Span):
                     m = member[op.class_id]
@@ -299,18 +311,18 @@ def build_extract_core(program: SegmentProgram):
                     start = jnp.maximum(start, floor)
                     start = jnp.minimum(jnp.maximum(start, 0), st.cur)
                     run = st.cur - start
-                    new_ok = st.ok & (run >= op.min_len)
-                    st.ok = jnp.where(active, new_ok, st.ok)
+                    new_ok = (st.ok != 0) & (run >= op.min_len)
+                    st.ok = jnp.where(active, new_ok.astype(i32), st.ok)
                     st.cur = jnp.where(active, start, st.cur)
                 elif isinstance(op, FixedSpan):
                     start = st.cur - op.n
-                    new_ok = st.ok & (start >= 0)
+                    new_ok = (st.ok != 0) & (start >= 0)
                     if op.n > 0:
                         inside = (pos >= start) & (pos < st.cur)
                         cnt = jnp.sum((member[op.class_id] & inside)
                                       .astype(i32), axis=1, keepdims=True)
                         new_ok = new_ok & (cnt == op.n)
-                    st.ok = jnp.where(active, new_ok, st.ok)
+                    st.ok = jnp.where(active, new_ok.astype(i32), st.ok)
                     st.cur = jnp.where(active, jnp.maximum(start, 0), st.cur)
                 elif isinstance(op, CapEnd):
                     # right edge of the group (encountered first in reverse)
@@ -325,7 +337,7 @@ def build_extract_core(program: SegmentProgram):
                 elif isinstance(op, Optional_):
                     before = st.copy()
                     emit_reverse(op.body, st, active, floor)
-                    take = active & st.ok
+                    take = active & (st.ok != 0)
                     merged = _WalkState(st.cur, st.ok, 0, init_caps=False)
                     merged.select(take, st, before)
                     st.cur, st.ok = merged.cur, merged.ok
@@ -333,18 +345,18 @@ def build_extract_core(program: SegmentProgram):
                     st.cap_start = merged.cap_start
                 elif isinstance(op, Alt):
                     before = st.copy()
-                    chosen_any = ~true_col    # all-false, data-dependent
+                    chosen_any = cur0         # all-zero i32, data-dependent
                     result = before.copy()
-                    remaining = active & st.ok
+                    remaining = active & (st.ok != 0)
                     for branch in op.branches:
                         trial = before.copy()
                         emit_reverse(branch, trial, remaining, floor)
-                        chosen = remaining & trial.ok
+                        chosen = remaining & (trial.ok != 0)
                         merged = _WalkState(result.cur, result.ok, 0,
                                             init_caps=False)
                         merged.select(chosen, trial, result)
                         result = merged
-                        chosen_any = chosen_any | chosen
+                        chosen_any = chosen_any | chosen.astype(i32)
                         remaining = remaining & ~chosen
                     st.cur = jnp.where(active, result.cur, before.cur)
                     st.ok = jnp.where(active, chosen_any, before.ok)
@@ -355,7 +367,7 @@ def build_extract_core(program: SegmentProgram):
                     raise AssertionError(op)
 
         all_rows = true_col
-        st = _WalkState(cur0, all_rows, ncaps)
+        st = _WalkState(cur0, true_col.astype(i32), ncaps)
         emit(top_ops, st, all_rows)
 
         if pivot2 is not None:
@@ -386,7 +398,7 @@ def build_extract_core(program: SegmentProgram):
             # middle ops run on the shared forward state at cur = p: the
             # literal advances the cursor, cap markers record edges
             st.cur = jnp.where(found, p, lo1)
-            st.ok = st.ok & found
+            st.ok = st.ok & found.astype(i32)
             emit(mid_ops, st, all_rows)
             lo2 = st.cur                  # pivot2 start (= p + |L|)
             run1 = p - lo1
@@ -397,7 +409,7 @@ def build_extract_core(program: SegmentProgram):
             inside2 = (pos >= lo2) & (pos < hi2)
             cnt2 = jnp.sum((member[pivot2.class_id] & inside2).astype(i32),
                            axis=1, keepdims=True)
-            ok = (st.ok & rst.ok & found & (hi2 >= lo2)
+            ok = ((st.ok != 0) & (rst.ok != 0) & found & (hi2 >= lo2)
                   & (cnt1 == run1) & (run1 >= pivot.min_len)
                   & (cnt2 == run2) & (run2 >= pivot2.min_len))
             final = rst
@@ -436,7 +448,7 @@ def build_extract_core(program: SegmentProgram):
             inside = (pos >= lo) & (pos < hi)
             cnt = jnp.sum((member[pivot.class_id] & inside).astype(i32),
                           axis=1, keepdims=True)
-            ok = st.ok & rst.ok & (hi >= lo) & (cnt == run)
+            ok = (st.ok != 0) & (rst.ok != 0) & (hi >= lo) & (cnt == run)
             ok = ok & (run >= pivot.min_len)
             if pivot.max_len != INF:
                 ok = ok & (run <= pivot.max_len)
@@ -452,7 +464,7 @@ def build_extract_core(program: SegmentProgram):
             off = jnp.where(ok, off, 0)
             return ok, off, length
 
-        ok = st.ok & (st.cur == lens)
+        ok = (st.ok != 0) & (st.cur == lens)
         off = jnp.concatenate(st.cap_off, axis=1)
         length = jnp.concatenate(st.cap_len, axis=1)
         length = jnp.where(ok, length, -1)

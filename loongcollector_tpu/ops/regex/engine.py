@@ -49,9 +49,12 @@ _host_backend_cached: Optional[bool] = None
 # native C++ walker starts instantly at a few hundred MB/s.  The crossover is
 #     min_bytes = dispatch_latency * host_throughput
 # — below it the host tier finishes before the device call would even return.
-# Latency is MEASURED once per process (a tiny warm jitted call), so the
-# threshold adapts to the actual deployment: ~100 KB on local silicon,
-# tens of MB through a high-latency tunnel.  LOONG_DEVICE_MIN_BYTES overrides.
+# Latency AND effective host<->device bandwidth are MEASURED once per process
+# with a realistic two-size payload probe, so the threshold adapts to the
+# actual deployment: ~100 KB on local silicon; through a high-latency tunnel
+# whose effective bandwidth is below the walker's throughput, the device can
+# never win on host-resident data and routing pins to the host tier.
+# LOONG_DEVICE_MIN_BYTES overrides.
 
 _HOST_WALKER_BPS = 300e6          # conservative native-walker throughput
 _dispatch_probe_lock = threading.Lock()
@@ -69,24 +72,98 @@ def _device_min_bytes() -> int:
     with _dispatch_probe_lock:
         if _device_min_bytes_cached is not None:
             return _device_min_bytes_cached
+        _device_min_bytes_cached = _run_dispatch_probe()
+    return _device_min_bytes_cached
+
+
+def _run_dispatch_probe() -> int:
+    """Measure the device round trip and derive the routing crossover.
+
+    The probe mimics the REAL parse path: host-resident numpy rows in, a
+    row-reduction out, result materialised back to the host.  (A
+    `jnp.zeros` input lives on-device already and makes a 70 ms tunnel
+    round trip look like 30 µs.)  Two payload sizes fit the affine cost
+    t(n) = lat + n/bw, separating fixed dispatch latency from effective
+    host<->device bandwidth.  A wedged tunnel hangs transfers instead of
+    raising, so the whole probe runs under a deadline (timeout ⇒
+    host-only)."""
+
+    def probe() -> int:
         try:
             import jax
-            import jax.numpy as jnp
-            f = jax.jit(lambda x: x + 1)
-            x = jnp.zeros((8, 128), jnp.int32)
-            jax.block_until_ready(f(x))          # compile outside the timing
-            samples = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                jax.block_until_ready(f(x))
-                samples.append(time.perf_counter() - t0)
-            lat = sorted(samples)[1]
-            crossover = int(lat * _HOST_WALKER_BPS)
-            _device_min_bytes_cached = max(32 * 1024,
-                                           min(crossover, 128 * 1024 * 1024))
+            import jax.numpy as jnp_
+            import numpy as np_
+            g = jax.jit(lambda r: r.astype(jnp_.int32).sum(axis=1))
+            sizes = [(2048, 128), (8192, 512)]      # 256 KB, 4 MB
+            times = []
+            for B, L in sizes:
+                rows = np_.zeros((B, L), np_.uint8)
+                np_.asarray(g(rows))                # compile + warm path
+                samples = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    np_.asarray(g(rows))
+                    samples.append(time.perf_counter() - t0)
+                times.append(sorted(samples)[1])
+            n0, n1 = (B * L for B, L in sizes)
+            t0_, t1_ = times
+            bw = (n1 - n0) / max(t1_ - t0_, 1e-9)
+            lat = max(t0_ - n0 / bw, 1e-6)
+            if bw <= _HOST_WALKER_BPS * 1.1:
+                # effective device bandwidth can't beat the host walker at
+                # ANY size (high-latency tunnel): never route to the device
+                return 1 << 60
+            crossover = lat / (1.0 / _HOST_WALKER_BPS - 1.0 / bw)
+            # clamp: one noisy latency sample must not pin multi-hundred-MB
+            # batches to the host for the whole process
+            return max(32 * 1024, min(int(crossover), 128 * 1024 * 1024))
         except Exception:  # noqa: BLE001 — routing must never break parsing
-            _device_min_bytes_cached = 256 * 1024
-    return _device_min_bytes_cached
+            return 256 * 1024
+
+    return _call_with_deadline(probe, _probe_deadline_s() * 2, 1 << 60)
+
+
+def _probe_deadline_s() -> float:
+    try:
+        return float(os.environ.get("LOONG_BACKEND_PROBE_TIMEOUT_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def _call_with_deadline(fn, timeout_s: float, fallback):
+    """Run `fn` on a daemon thread; return its result, or `fallback` if it
+    raises or misses the deadline.
+
+    Backend init and transfers through a remote/tunneled accelerator
+    (axon) BLOCK indefinitely when the tunnel is down — a hang, not an
+    exception — and routing must never hang the pipeline."""
+    import queue
+
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def run() -> None:
+        try:
+            q.put(fn())
+        except Exception:  # noqa: BLE001 — fall back below
+            pass
+
+    t = threading.Thread(target=run, daemon=True, name="loong-probe")
+    t.start()
+    try:
+        return q.get(timeout=timeout_s)
+    except Exception:  # noqa: BLE001 — timeout ⇒ device unusable
+        return fallback
+
+
+def _backend_is_cpu_with_deadline() -> bool:
+    """`jax.default_backend() == "cpu"`, with a hard deadline: if the
+    backend cannot even answer, it is pinned unusable ⇒ host mode."""
+
+    def query() -> bool:
+        import jax
+        return jax.default_backend() == "cpu"
+
+    return _call_with_deadline(query, _probe_deadline_s(), True)
 
 
 def _native_host_mode() -> bool:
@@ -101,8 +178,7 @@ def _native_host_mode() -> bool:
         return False  # explicit device-kernel force wins over host auto
     global _host_backend_cached
     if _host_backend_cached is None:
-        import jax
-        _host_backend_cached = jax.default_backend() == "cpu"
+        _host_backend_cached = _backend_is_cpu_with_deadline()
     return _host_backend_cached
 
 
