@@ -58,3 +58,10 @@ def register_all(registry) -> None:
     registry.register_input("input_skywalking", InputSkywalking)
     registry.register_input("input_goprofile", InputGoProfile)
     registry.register_input("service_goprofile", InputGoProfile)
+    from .jmxfetch import ServiceJmxFetch
+    from .telegraf import ServiceTelegraf
+    from .udpserver import InputUDPServer
+    registry.register_input("service_udp_server", InputUDPServer)
+    registry.register_input("input_udp_server", InputUDPServer)
+    registry.register_input("service_telegraf", ServiceTelegraf)
+    registry.register_input("service_jmxfetch", ServiceJmxFetch)

@@ -9,6 +9,8 @@ Formats:
   * raw    — each non-empty line becomes one event ("content")
   * json   — one JSON object, or an array of objects → one event each
   * ndjson — one JSON object per line
+  * influx — Influx line protocol → multi-value MetricEvents (telegraf)
+  * statsd — (dog)statsd lines → MetricEvents
   * otlp   — ExportLogsServiceRequest JSON (resourceLogs→scopeLogs→
              logRecords); InputOTLP presets this and the /v1/logs path
 """
@@ -101,6 +103,12 @@ def parse_body(fmt: str, body: bytes, group: PipelineEventGroup) -> int:
                         ev.set_content(sb.copy_string(f"resource.{k}".encode()),
                                        sb.copy_string(str(v).encode()))
                     n += 1
+    elif fmt in ("influx", "influxdb"):
+        from .metric_protocols import parse_influx_lines
+        n = parse_influx_lines(body, group)
+    elif fmt == "statsd":
+        from .metric_protocols import parse_statsd_packet
+        n = parse_statsd_packet(body, group)
     else:
         raise ValueError(f"unknown format {fmt!r}")
     return n
@@ -140,7 +148,8 @@ class InputHTTPServer(Input):
                       self.name, self.address)
             return False
         self._host, self._port = host, int(port)
-        return self.fmt in ("raw", "json", "ndjson", "otlp")
+        return self.fmt in ("raw", "json", "ndjson", "otlp",
+                            "influx", "influxdb", "statsd")
 
     def start(self) -> bool:
         inp = self

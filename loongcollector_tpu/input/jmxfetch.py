@@ -1,0 +1,374 @@
+"""service_jmxfetch — supervised JMXFetch (JVM MBean) collection.
+
+Reference: plugins/input/jmxfetch/ — jmxfetch.go (plugin config: static
+instances + bean filters), manager.go (singleton: renders conf.d YAML,
+finds a JDK, supervises the jmxfetch java agent, and ingests its metrics
+through a SHARED statsd UDP server dispatched by the `jmxfetch_ilogtail`
+tag, manager.go:173), jmxfetch_inner.go (instance YAML shape).
+
+The java/jar prerequisites are environment-gated: without them the
+manager still renders YAML configs and runs the statsd listener (any
+externally-launched jmxfetch pointed at the port works); supervision
+kicks in when `java` and `jmxfetch.jar` exist.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+from .udpserver import SharedUDPServer
+
+log = get_logger("jmxfetch")
+
+DISPATCH_KEY = "jmxfetch_ilogtail"
+_CHECK_INTERVAL_S = 5.0
+
+
+def _yaml_scalar(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    s = str(v)
+    if s == "" or any(c in s for c in ":#{}[],&*?|>'\"%@`"):
+        return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    return s
+
+
+def render_config_yaml(instances: List[Dict[str, Any]],
+                       filters: List[Dict[str, Any]],
+                       new_gc_metrics: bool) -> str:
+    """Datadog-style jmxfetch YAML (reference Manager.updateFiles).
+    Hand-rolled writer — the config shape is small and fixed, and the
+    repo carries no YAML-emitter dependency."""
+    out = ["init_config:",
+           "  is_jmx: true",
+           f"  new_gc_metrics: {_yaml_scalar(new_gc_metrics)}"]
+    if filters:
+        out.append("  conf:")
+        for f in filters:
+            out.append("    - include:")
+            for k in ("domain", "bean_regex", "type", "name"):
+                if f.get(k):
+                    out.append(f"        {k}: {_yaml_scalar(f[k])}")
+            attr = f.get("attribute")
+            if isinstance(attr, list):
+                out.append("        attribute:")
+                for a in attr:
+                    out.append(f"          - {_yaml_scalar(a)}")
+            elif isinstance(attr, dict):
+                out.append("        attribute:")
+                for name, spec in attr.items():
+                    out.append(f"          {name}:")
+                    for sk, sv in spec.items():
+                        out.append(f"            {sk}: {_yaml_scalar(sv)}")
+    out.append("instances:")
+    for inst in instances:
+        out.append(f"  - name: {_yaml_scalar(inst['name'])}")
+        for k in ("host", "port", "user", "password"):
+            if inst.get(k) not in (None, ""):
+                out.append(f"    {k}: {_yaml_scalar(inst[k])}")
+        out.append("    collect_default_jvm_metrics: "
+                   + _yaml_scalar(inst.get("default_jvm_metrics", True)))
+        tags = inst.get("tags") or []
+        if tags:
+            out.append("    tags:")
+            for t in sorted(tags):
+                out.append(f"      - {_yaml_scalar(t)}")
+    return "\n".join(out) + "\n"
+
+
+class JmxFetchManager:
+    """Singleton per install dir (reference GetJmxFetchManager)."""
+
+    _instances: Dict[str, "JmxFetchManager"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, base_dir: str) -> "JmxFetchManager":
+        with cls._instances_lock:
+            inst = cls._instances.get(base_dir)
+            if inst is None:
+                inst = cls._instances[base_dir] = JmxFetchManager(base_dir)
+            return inst
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        self.conf_dir = os.path.join(base_dir, "conf.d")
+        self.jar_path = os.path.join(base_dir, "jmxfetch.jar")
+        self._java_home = ""
+        self._cfgs: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[SharedUDPServer] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._running = False
+
+    # -- plugin-facing API ---------------------------------------------------
+
+    def config_java_home(self, jdk_path: str) -> None:
+        with self._lock:
+            if jdk_path:
+                self._java_home = jdk_path
+
+    def register(self, key: str, instances: List[Dict[str, Any]],
+                 filters: List[Dict[str, Any]], new_gc_metrics: bool,
+                 sink) -> None:
+        with self._lock:
+            self._cfgs[key] = {"instances": instances, "filters": filters,
+                               "new_gc": new_gc_metrics, "sink": sink}
+            started = self._running
+        if not started:
+            self._start_loop()
+        else:
+            with self._lock:
+                if self._server is not None:
+                    self._server.register(key, sink)
+        self._wake.set()
+
+    def unregister(self, key: str) -> None:
+        with self._lock:
+            self._cfgs.pop(key, None)
+            empty = not self._cfgs
+            if self._server is not None:
+                self._server.unregister(key)
+        try:
+            os.unlink(os.path.join(self.conf_dir, key + ".yaml"))
+        except OSError:
+            pass
+        self._wake.set()
+        if empty:
+            self._stop_loop()
+
+    @property
+    def statsd_port(self) -> int:
+        with self._lock:
+            return self._server.port if self._server is not None else 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _start_loop(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="jmxfetch-manager")
+        self._thread.start()
+
+    def _stop_loop(self) -> None:
+        with self._lock:
+            self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        self._kill()
+        with self._lock:
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                cfgs = dict(self._cfgs)
+            self._ensure_server(cfgs)
+            try:
+                self._render(cfgs)
+            except OSError as e:
+                log.warning("jmxfetch conf render failed: %s", e)
+            if cfgs:
+                self._ensure_proc()
+            else:
+                self._kill()
+            self._wake.wait(timeout=_CHECK_INTERVAL_S)
+            self._wake.clear()
+
+    def _ensure_server(self, cfgs: Dict[str, dict]) -> None:
+        with self._lock:
+            if self._server is None:
+                self._server = SharedUDPServer("127.0.0.1:0", "statsd",
+                                               DISPATCH_KEY)
+                if not self._server.start():
+                    self._server = None
+                    return
+            server = self._server
+        for key, cfg in cfgs.items():
+            server.register(key, cfg["sink"])
+
+    def _render(self, cfgs: Dict[str, dict]) -> None:
+        os.makedirs(self.conf_dir, exist_ok=True)
+        for key, cfg in cfgs.items():
+            insts = []
+            for inst in cfg["instances"]:
+                inst = dict(inst)
+                tags = set(inst.get("tags") or [])
+                tags.add(f"{DISPATCH_KEY}:{key}")
+                inst["tags"] = sorted(tags)
+                insts.append(inst)
+            text = render_config_yaml(insts, cfg["filters"], cfg["new_gc"])
+            path = os.path.join(self.conf_dir, key + ".yaml")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(text)
+            os.replace(tmp, path)
+
+    def _java_cmd(self) -> Optional[str]:
+        with self._lock:
+            home = self._java_home
+        if home:
+            cand = os.path.join(home, "bin", "java")
+            return cand if os.path.exists(cand) else None
+        cand = os.path.join(self.base_dir, "jdk", "bin", "java")
+        if os.path.exists(cand):
+            return cand
+        return shutil.which("java")
+
+    def _ensure_proc(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            return
+        java = self._java_cmd()
+        if java is None or not os.path.exists(self.jar_path):
+            return                      # degraded: configs + listener only
+        port = self.statsd_port
+        if not port:
+            return
+        try:
+            self._proc = subprocess.Popen(
+                [java, "-jar", self.jar_path,
+                 "--reporter", f"statsd:127.0.0.1:{port}",
+                 "--conf_directory", self.conf_dir, "collect"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                cwd=self.base_dir)
+            log.info("jmxfetch started pid=%s statsd_port=%d",
+                     self._proc.pid, port)
+        except OSError as e:
+            log.warning("jmxfetch start failed: %s", e)
+            self._proc = None
+
+    def _kill(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.terminate()
+                self._proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            self._proc = None
+
+
+def _instance_inner(port: int, host: str, user: str, password: str,
+                    tags: Dict[str, str], default_jvm: bool) -> Dict[str, Any]:
+    """reference NewInstanceInner: derived name + hostname/service tags."""
+    hostname = os.environ.get("_node_name_") or socket.gethostname()
+    tags = dict(tags or {})
+    tags.setdefault("hostname", hostname)
+    tags.setdefault("service", hostname)
+    if host in ("localhost", "127.0.0.1"):
+        name = f"{hostname}_{port}"
+    else:
+        name = f"{host}_{port}"
+    name = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+    return {"name": name, "host": host, "port": port, "user": user,
+            "password": password, "default_jvm_metrics": default_jvm,
+            "tags": sorted(f"{k}:{v}" for k, v in tags.items())}
+
+
+class ServiceJmxFetch(Input):
+    """service_jmxfetch (plugins/input/jmxfetch/jmxfetch.go); config keys
+    mirror the Go plugin: StaticInstances, Filters, NewGcMetrics,
+    DefaultJvmMetrics, Tags, JDKPath.  DiscoveryMode (container-based
+    instance discovery) is not wired — static instances only."""
+
+    name = "service_jmxfetch"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._manager: Optional[JmxFetchManager] = None
+        self._key = ""
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.jdk_path = str(config.get("JDKPath", ""))
+        self.new_gc = bool(config.get("NewGcMetrics", False))
+        default_jvm = bool(config.get("DefaultJvmMetrics", True))
+        common_tags = {str(k): str(v)
+                       for k, v in (config.get("Tags") or {}).items()}
+        cluster = str(config.get("Cluster", ""))
+        if cluster:
+            common_tags["cluster"] = cluster
+        self.instances = []
+        for inst in config.get("StaticInstances") or []:
+            tags = dict(common_tags)
+            tags.update({str(k): str(v)
+                         for k, v in (inst.get("Tags") or {}).items()})
+            self.instances.append(_instance_inner(
+                int(inst.get("Port", 0)), str(inst.get("Host", "localhost")),
+                str(inst.get("User", "")), str(inst.get("Password", "")),
+                tags, default_jvm))
+        self.filters = []
+        for f in config.get("Filters") or []:
+            inner: Dict[str, Any] = {
+                "domain": f.get("Domain", ""),
+                "bean_regex": f.get("BeanRegex", ""),
+                "type": f.get("Type", ""),
+                "name": f.get("Name", ""),
+            }
+            attrs = f.get("Attribute") or []
+            if attrs:
+                # list mode unless every entry has MetricType + Alias
+                if all(a.get("MetricType") and a.get("Alias")
+                       for a in attrs):
+                    inner["attribute"] = {
+                        a["Name"]: {"metric_type": a["MetricType"],
+                                    "alias": a["Alias"]} for a in attrs}
+                else:
+                    inner["attribute"] = [a.get("Name", "") for a in attrs]
+            self.filters.append(inner)
+        base = config.get("JmxFetchHome") or os.path.join(
+            os.environ.get("LOONG_THIRD_PARTY_DIR",
+                           os.path.join(os.path.expanduser("~"),
+                                        ".loongcollector", "thirdparty")),
+            "jmxfetch")
+        self._base_dir = str(base)
+        if config.get("DiscoveryMode"):
+            log.warning("service_jmxfetch DiscoveryMode is not supported; "
+                        "configure StaticInstances")
+        return bool(self.instances)
+
+    def start(self) -> bool:
+        self._manager = JmxFetchManager.get(self._base_dir)
+        self._manager.config_java_home(self.jdk_path)
+        self._key = "".join(c if c.isalnum() or c in "-_." else "_"
+                            for c in (self.context.pipeline_name or "jmx"))
+        pqm = self.context.process_queue_manager
+        key = self.context.process_queue_key
+
+        def sink(group: PipelineEventGroup) -> None:
+            group.set_tag(b"__source__", b"jmxfetch")
+            if pqm is not None:
+                pqm.push_queue(key, group)
+
+        self._manager.register(self._key, self.instances, self.filters,
+                               self.new_gc, sink)
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self._manager is not None:
+            self._manager.unregister(self._key)
+            self._manager = None
+        return True

@@ -1,0 +1,297 @@
+"""service_telegraf — supervised Telegraf agent bridge.
+
+Reference: plugins/input/telegraf/ (input_telegraf.go registers config
+snippets with a singleton Manager; telegraf_manager.go writes
+conf.d/<name>.conf + a pinned telegraf.conf, supervises the external
+telegraf process with a 30 s status check, and telegraf_log_collector.go
+tails telegraf's own log into the agent's alarm channel).
+
+Data path: the pinned telegraf.conf adds an `outputs.http` writing influx
+line protocol to this agent's HTTP ingest (Format "influx",
+input_http_server) or any sink the user's Detail configures — the bridge
+itself only manages lifecycle, exactly like the reference.
+
+Degraded gate: when no telegraf binary is present the manager still
+renders configs (an external supervisor can pick them up) and reports a
+warning instead of failing the pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("telegraf")
+
+_DEFAULT_CONF = """# DO NOT MODIFY: regenerated when the agent starts.
+[agent]
+  interval = "10s"
+  flush_interval = "10s"
+  logfile = "{logfile}"
+"""
+
+_CHECK_INTERVAL_S = 30.0
+
+
+class TelegrafManager:
+    """Singleton per install dir (reference GetTelegrafManager)."""
+
+    _instances: Dict[str, "TelegrafManager"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, base_dir: str) -> "TelegrafManager":
+        with cls._instances_lock:
+            inst = cls._instances.get(base_dir)
+            if inst is None:
+                inst = cls._instances[base_dir] = TelegrafManager(base_dir)
+            return inst
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        self.conf_dir = os.path.join(base_dir, "conf.d")
+        self.log_path = os.path.join(base_dir, "telegraf.log")
+        self.binary = (shutil.which("telegraf")
+                       or (os.path.join(base_dir, "telegraf")
+                           if os.path.exists(os.path.join(base_dir,
+                                                          "telegraf"))
+                           else None))
+        self._configs: Dict[str, str] = {}
+        self._dirty = False
+        self._sinks: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self._log_thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._running = False
+
+    # -- config registration -----------------------------------------------
+
+    def register(self, name: str, detail: str, sink=None) -> None:
+        with self._lock:
+            if self._configs.get(name) != detail:
+                self._dirty = True
+            self._configs[name] = detail
+            if sink is not None:
+                self._sinks[name] = sink
+            started = self._running
+        if not started:
+            self._start_loop()
+        self._wake.set()
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            if name in self._configs:
+                self._dirty = True
+            self._configs.pop(name, None)
+            self._sinks.pop(name, None)
+            empty = not self._configs
+        self._wake.set()
+        if empty:
+            self._stop_loop()
+
+    # -- filesystem --------------------------------------------------------
+
+    def _render(self) -> None:
+        os.makedirs(self.conf_dir, exist_ok=True)
+        with self._lock:
+            configs = dict(self._configs)
+        base = os.path.join(self.base_dir, "telegraf.conf")
+        with open(base, "w", encoding="utf-8") as f:
+            f.write(_DEFAULT_CONF.format(logfile=self.log_path))
+        keep = set()
+        for name, detail in configs.items():
+            safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                           for c in name)
+            keep.add(safe + ".conf")
+            path = os.path.join(self.conf_dir, safe + ".conf")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(detail)
+            os.replace(tmp, path)
+        for existing in os.listdir(self.conf_dir):
+            if existing.endswith(".conf") and existing not in keep:
+                os.unlink(os.path.join(self.conf_dir, existing))
+
+    # -- supervision -------------------------------------------------------
+
+    def _start_loop(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="telegraf-manager")
+        self._thread.start()
+        self._log_thread = threading.Thread(target=self._tail_log,
+                                            daemon=True,
+                                            name="telegraf-logtail")
+        self._log_thread.start()
+
+    def _stop_loop(self) -> None:
+        with self._lock:
+            self._running = False
+        self._wake.set()
+        for t in (self._thread, self._log_thread):
+            if t is not None:
+                t.join(timeout=3)
+        self._thread = self._log_thread = None
+        self._kill()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                have_cfg = bool(self._configs)
+                dirty, self._dirty = self._dirty, False
+            try:
+                self._render()
+            except OSError as e:
+                log.warning("telegraf conf render failed: %s", e)
+            if have_cfg and self.binary:
+                self._ensure_proc(reload=dirty)
+            elif not have_cfg:
+                self._kill()
+            elif self.binary is None:
+                log.warning("telegraf binary not found; configs rendered "
+                            "to %s but nothing supervises them",
+                            self.conf_dir)
+            self._wake.wait(timeout=_CHECK_INTERVAL_S)
+            self._wake.clear()
+
+    def _ensure_proc(self, reload: bool = False) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            if reload:       # config changed: telegraf reloads on SIGHUP
+                try:
+                    self._proc.send_signal(signal.SIGHUP)
+                except OSError:
+                    pass
+            return
+        try:
+            self._proc = subprocess.Popen(
+                [self.binary, "--config",
+                 os.path.join(self.base_dir, "telegraf.conf"),
+                 "--config-directory", self.conf_dir],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                cwd=self.base_dir)
+            log.info("telegraf started pid=%s", self._proc.pid)
+        except OSError as e:
+            log.warning("telegraf start failed: %s", e)
+            self._proc = None
+
+    def _kill(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.terminate()
+                self._proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            self._proc = None
+
+    # -- telegraf's own log → events (reference LogCollector) ---------------
+
+    def _tail_log(self) -> None:
+        pos = 0
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                sinks = list(self._sinks.values())
+            try:
+                if os.path.exists(self.log_path):
+                    with open(self.log_path, "rb") as f:
+                        f.seek(0, os.SEEK_END)
+                        end = f.tell()
+                        if end < pos:          # rotated
+                            pos = 0
+                        f.seek(pos)
+                        chunk = f.read(256 * 1024)
+                        # consume only complete lines; a torn tail waits
+                        # for the writer to finish it
+                        cut = chunk.rfind(b"\n")
+                        if cut < 0:
+                            chunk = b""
+                        else:
+                            chunk = chunk[: cut + 1]
+                        pos += len(chunk)
+                    if chunk and sinks:
+                        self._emit_log(chunk, sinks)
+            except OSError:
+                pass
+            time.sleep(2.0)
+
+    @staticmethod
+    def _emit_log(chunk: bytes, sinks) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        now = int(time.time())
+        for line in chunk.splitlines():
+            if not line.strip():
+                continue
+            ev = group.add_log_event(now)
+            ev.set_content(b"content", sb.copy_string(line))
+            # telegraf log format: ts level! msg  (E!/W!/I!/D!)
+            for marker, level in ((b" E! ", b"error"), (b" W! ", b"warning"),
+                                  (b" I! ", b"info"), (b" D! ", b"debug")):
+                if marker in line:
+                    ev.set_content(b"level", level)
+                    break
+        group.set_tag(b"__source__", b"telegraf")
+        if len(group):
+            for sink in sinks:
+                sink(group)
+
+
+class ServiceTelegraf(Input):
+    """service_telegraf (plugins/input/telegraf/input_telegraf.go)."""
+
+    name = "service_telegraf"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._manager: Optional[TelegrafManager] = None
+        self._cfg_name = ""
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.detail = str(config.get("Detail", ""))
+        base = config.get("TelegrafHome") or os.path.join(
+            os.environ.get("LOONG_THIRD_PARTY_DIR",
+                           os.path.join(os.path.expanduser("~"),
+                                        ".loongcollector", "thirdparty")),
+            "telegraf")
+        self._base_dir = str(base)
+        return bool(self.detail)
+
+    def start(self) -> bool:
+        self._manager = TelegrafManager.get(self._base_dir)
+        self._cfg_name = self.context.pipeline_name or "telegraf"
+        pqm = self.context.process_queue_manager
+        key = self.context.process_queue_key
+
+        def sink(group: PipelineEventGroup) -> None:
+            pqm.push_queue(key, group)
+
+        self._manager.register(self._cfg_name, self.detail,
+                               sink if pqm is not None else None)
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self._manager is not None:
+            self._manager.unregister(self._cfg_name)
+            self._manager = None
+        return True

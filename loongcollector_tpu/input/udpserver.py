@@ -1,0 +1,220 @@
+"""service_udp_server — generic UDP ingest through a pluggable decoder.
+
+Reference: plugins/input/udpserver/input_udp.go (datagram → decoder
+extension → collector) and shared_udp_server.go (one socket fan-out to
+many pipelines keyed by a dispatch tag — jmxfetch's statsd channel,
+manager.go:173).
+
+The decoder is either a Format name handled by `decode_payload` below
+(influxdb / statsd / json / raw) or an `ext_default_decoder` instance
+resolved from the pipeline's extension registry.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("udpserver")
+
+
+def decode_payload(fmt: str, data: bytes) -> Optional[PipelineEventGroup]:
+    """One datagram → one event group (or None when nothing decoded).
+
+    Non-raw formats delegate to the shared per-format parser
+    (http_server.parse_body — same code path as the HTTP ingest and
+    ext_default_decoder); only "raw" differs, because a datagram is one
+    message rather than a line stream."""
+    group = PipelineEventGroup()
+    if fmt == "raw":                       # one event per datagram
+        ev = group.add_log_event(int(time.time()))
+        ev.set_content(b"content", group.source_buffer.copy_string(data))
+        return group
+    from .http_server import parse_body
+    try:
+        n = parse_body(fmt, data, group)
+    except ValueError:
+        return None
+    return group if n else None
+
+
+class UDPServer:
+    """Datagram loop shared by the plain input and the shared dispatcher."""
+
+    def __init__(self, address: str, fmt: str,
+                 sink: Callable[[PipelineEventGroup], None],
+                 max_buffer_size: int = 65535,
+                 decoder_ext=None):
+        host, _, port = address.rpartition(":")
+        self.host = host.replace("udp://", "") or "0.0.0.0"
+        self.port = int(port)
+        self.fmt = fmt
+        self.sink = sink
+        self.max_buffer_size = max_buffer_size
+        self.decoder_ext = decoder_ext
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def start(self) -> bool:
+        try:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self._sock.settimeout(0.2)
+        except OSError as e:
+            log.error("udp bind %s:%d failed: %s", self.host, self.port, e)
+            return False
+        if self.port == 0:                 # ephemeral: report what we got
+            self.port = self._sock.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="udp-server")
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                data, _ = self._sock.recvfrom(self.max_buffer_size)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                continue
+            try:
+                if self.decoder_ext is not None:
+                    for g in self.decoder_ext.decode(data) or []:
+                        self.sink(g)
+                else:
+                    g = decode_payload(self.fmt, data)
+                    if g is not None:
+                        self.sink(g)
+            except Exception:  # noqa: BLE001 — bad datagrams must not kill it
+                log.exception("udp decode failed")
+
+
+class SharedUDPServer:
+    """One UDP socket, many pipelines: events route by a dispatch tag.
+
+    The tag (reference `__labels__` cut, shared_udp_server.go:60-78) is a
+    metric tag whose value picks the registered sink; statsd clients add
+    it via dogstatsd #tags (jmxfetch configs set `jmxfetch_ilogtail`)."""
+
+    def __init__(self, address: str, fmt: str, dispatch_key: str,
+                 max_buffer_size: int = 65535):
+        self.dispatch_key = dispatch_key.encode()
+        self._sinks: Dict[bytes, Callable[[PipelineEventGroup], None]] = {}
+        self._lock = threading.Lock()
+        self.udp = UDPServer(address, fmt, self._dispatch,
+                             max_buffer_size)
+
+    @property
+    def port(self) -> int:
+        return self.udp.port
+
+    def is_running(self) -> bool:
+        return self.udp._running
+
+    def start(self) -> bool:
+        return self.udp.start()
+
+    def stop(self) -> None:
+        self.udp.stop()
+
+    def register(self, key: str,
+                 sink: Callable[[PipelineEventGroup], None]) -> None:
+        with self._lock:
+            self._sinks[key.encode()] = sink
+
+    def unregister(self, key: str) -> None:
+        with self._lock:
+            self._sinks.pop(key.encode(), None)
+            # callers stop the socket when the last sink leaves
+
+    def sink_count(self) -> int:
+        with self._lock:
+            return len(self._sinks)
+
+    def _dispatch(self, group: PipelineEventGroup) -> None:
+        routed: Dict[bytes, List] = {}
+        for ev in group.events:
+            tags = getattr(ev, "tags", None)
+            if not tags:
+                continue
+            tag = tags.pop(self.dispatch_key, None)
+            if tag is None:
+                continue
+            routed.setdefault(tag.to_bytes(), []).append(ev)
+        with self._lock:
+            sinks = dict(self._sinks)
+        for key, events in routed.items():
+            sink = sinks.get(key)
+            if sink is None:
+                log.warning("no sink for dispatch tag %r", key)
+                continue
+            out = PipelineEventGroup(group.source_buffer)
+            out.events.extend(events)
+            sink(out)
+
+
+class InputUDPServer(Input):
+    """service_udp_server (plugins/input/udpserver/input_udp.go)."""
+
+    name = "input_udp_server"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.server: Optional[UDPServer] = None
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self._address = str(config.get("Address", "0.0.0.0:18889"))
+        self._format = str(config.get("Format", "raw")).lower()
+        self._max_buffer = int(config.get("MaxBufferSize", 65535))
+        self._decoder_ref = config.get("Decoder", "")
+        host, sep, port = self._address.replace("udp://", "").rpartition(":")
+        if not sep or not port.isdigit():
+            log.error("input_udp_server Address must be host:port, got %r",
+                      self._address)
+            return False
+        return True
+
+    def start(self) -> bool:
+        pqm = self.context.process_queue_manager
+        key = self.context.process_queue_key
+        decoder_ext = (self.context.get_extension(str(self._decoder_ref))
+                       if self._decoder_ref else None)
+
+        def sink(group: PipelineEventGroup) -> None:
+            group.set_tag(b"__source__", b"udp")
+            pqm.push_queue(key, group)
+
+        self.server = UDPServer(self._address, self._format, sink,
+                                self._max_buffer, decoder_ext)
+        return self.server.start()
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        if self.server:
+            self.server.stop()
+            self.server = None
+        return True

@@ -144,8 +144,8 @@ def _call_with_deadline(fn, timeout_s: float, fallback):
     def run() -> None:
         try:
             q.put(fn())
-        except Exception:  # noqa: BLE001 — fall back below
-            pass
+        except Exception:  # noqa: BLE001 — fast failure must not stall
+            q.put(fallback)
 
     t = threading.Thread(target=run, daemon=True, name="loong-probe")
     t.start()

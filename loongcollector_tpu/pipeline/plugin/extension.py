@@ -139,7 +139,8 @@ class ExtDefaultDecoder(Extension):
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
         self.fmt = str(config.get("Format", "json")).lower()
-        return self.fmt in ("json", "sls", "sls_pb", "raw")
+        return self.fmt in ("json", "sls", "sls_pb", "raw",
+                            "statsd", "influx", "influxdb")
 
     def decode(self, body: bytes, headers: Optional[dict] = None):
         from ...models import PipelineEventGroup
@@ -148,6 +149,12 @@ class ExtDefaultDecoder(Extension):
             return [parse_loggroup(body)]
         group = PipelineEventGroup()
         sb = group.source_buffer
+        if self.fmt == "statsd":
+            from ...input.metric_protocols import parse_statsd_packet
+            return [group] if parse_statsd_packet(body, group) else []
+        if self.fmt in ("influx", "influxdb"):
+            from ...input.metric_protocols import parse_influx_lines
+            return [group] if parse_influx_lines(body, group) else []
         if self.fmt == "raw":
             ev = group.add_log_event(int(time.time()))
             ev.set_content(sb.copy_string(b"content"), sb.copy_string(body))
