@@ -5,7 +5,8 @@ aggregator plugins, plugins/aggregator/*)."""
 def register_all(registry) -> None:
     from .base import (AggregatorBase, AggregatorContentValueGroup,
                        AggregatorContext, AggregatorLogstoreRouter,
-                       AggregatorMetadataGroup, AggregatorShardHash)
+                       AggregatorMetadataGroup, AggregatorShardHash,
+                       AggregatorSkywalking, AggregatorTelemetryRouter)
 
     registry.register_aggregator("aggregator_base", AggregatorBase)
     registry.register_aggregator("aggregator_context", AggregatorContext)
@@ -16,3 +17,7 @@ def register_all(registry) -> None:
                                  AggregatorContentValueGroup)
     registry.register_aggregator("aggregator_logstore_router",
                                  AggregatorLogstoreRouter)
+    registry.register_aggregator("aggregator_opentelemetry",
+                                 AggregatorTelemetryRouter)
+    registry.register_aggregator("aggregator_skywalking",
+                                 AggregatorSkywalking)

@@ -284,3 +284,60 @@ class AggregatorShardHash(Aggregator):
 
     def flush(self) -> List[PipelineEventGroup]:
         return []
+
+
+class AggregatorTelemetryRouter(AggregatorBase):
+    """Route events to per-signal logstores by their TYPE.
+
+    Covers aggregator_opentelemetry and aggregator_skywalking
+    (plugins/aggregator/{opentelemetry,skywalking}): both fan one mixed
+    stream into metrics/trace/log logstores.  The Go plugins infer the
+    signal from the content-pair count (≤5 → metric, ≥19 → trace); this
+    event model is typed, so MetricEvent/SpanEvent route exactly."""
+
+    name = "aggregator_opentelemetry"
+    default_prefix = "otlp"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        if not AggregatorBase.init(self, config, context):
+            return False
+        p = self.default_prefix
+        self.metrics_store = str(config.get("MetricsLogstore",
+                                            f"{p}-metrics")).encode()
+        self.trace_store = str(config.get("TraceLogstore",
+                                          f"{p}-traces")).encode()
+        self.log_store = str(config.get("LogLogstore",
+                                        f"{p}-logs")).encode()
+        self.topic = str(config.get("Topic", "")).encode()
+        return True
+
+    def _route(self, ev) -> bytes:
+        from ..models.events import MetricEvent, SpanEvent
+        if isinstance(ev, MetricEvent):
+            return self.metrics_store
+        if isinstance(ev, SpanEvent):
+            return self.trace_store
+        return self.log_store
+
+    def _key(self, group: PipelineEventGroup, ev) -> Tuple:
+        return (self._route(ev), self._tag_fingerprint(group))
+
+    def _group_meta(self, out: PipelineEventGroup, key: Tuple,
+                    src: PipelineEventGroup) -> None:
+        AggregatorBase._group_meta(self, out, key, src)
+        out.set_tag(b"__logstore__", key[0])
+        if self.topic:
+            out.set_tag(b"__topic__", self.topic)
+
+    def add(self, group: PipelineEventGroup) -> List[PipelineEventGroup]:
+        cols = group.columns
+        if cols is not None and not group._events:
+            group.materialize()     # routing needs per-event types
+        return AggregatorBase.add(self, group)
+
+
+class AggregatorSkywalking(AggregatorTelemetryRouter):
+    """plugins/aggregator/skywalking — same router, skywalking-* stores."""
+
+    name = "aggregator_skywalking"
+    default_prefix = "skywalking"

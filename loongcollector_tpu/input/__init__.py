@@ -61,6 +61,19 @@ def register_all(registry) -> None:
     from .jmxfetch import ServiceJmxFetch
     from .telegraf import ServiceTelegraf
     from .udpserver import InputUDPServer
+    from .command import InputCommand
+    from .docker_event import InputDebugFile, ServiceDockerEvent
+    from .k8s_meta import ServiceK8sMeta
+    from .mysql_query import InputMysql
+    from .probes import InputHTTPResponse, InputNetPing, InputNginxStatus
+    registry.register_input("input_command", InputCommand)
+    registry.register_input("metric_http", InputHTTPResponse)
+    registry.register_input("metric_nginx_status", InputNginxStatus)
+    registry.register_input("metric_input_netping", InputNetPing)
+    registry.register_input("service_mysql", InputMysql)
+    registry.register_input("service_docker_event", ServiceDockerEvent)
+    registry.register_input("metric_debug_file", InputDebugFile)
+    registry.register_input("service_kubernetes_meta", ServiceK8sMeta)
     registry.register_input("service_udp_server", InputUDPServer)
     registry.register_input("input_udp_server", InputUDPServer)
     registry.register_input("service_telegraf", ServiceTelegraf)

@@ -1,0 +1,150 @@
+"""input_command — periodic script execution with captured stdout.
+
+Reference: plugins/input/command/ (input_command.go: validated script
+types + non-root user gate + Base64 payloads; command_script_storage.go:
+scripts materialized under the agent conf dir keyed by config + content
+md5; RunCommandWithTimeOut: kill-on-timeout).
+
+Events carry one content field per LineSplitSep chunk plus the script_md5
+the reference stamps for traceability.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import subprocess
+import time
+from typing import Any, Dict, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext
+from ..utils.logger import get_logger
+from .polling_base import PollingInput
+
+log = get_logger("command")
+
+SCRIPT_TYPES = {
+    "bash": ("sh", "/usr/bin/bash"),
+    "shell": ("sh", "/usr/bin/sh"),
+    "python2": ("py", "/usr/bin/python2"),
+    "python3": ("py", "/usr/bin/python3"),
+}
+
+
+class InputCommand(PollingInput):
+    name = "input_command"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.script_type = str(config.get("ScriptType", "bash"))
+        if self.script_type not in SCRIPT_TYPES:
+            log.error("input_command: unsupported ScriptType %r",
+                      self.script_type)
+            return False
+        content = str(config.get("ScriptContent", ""))
+        if not content:
+            log.error("input_command: ScriptContent is required")
+            return False
+        if str(config.get("ContentEncoding", "PlainText")) == "Base64":
+            try:
+                content = base64.b64decode(content).decode()
+            except (ValueError, UnicodeDecodeError) as e:
+                log.error("input_command: bad Base64 ScriptContent: %s", e)
+                return False
+        if len(content) > 512 * 1024:
+            log.error("input_command: ScriptContent > 512K")
+            return False
+        self.user = str(config.get("User", ""))
+        if self.user == "root":
+            log.error("input_command: running as root is refused")
+            return False
+        self.content = content
+        self.content_md5 = hashlib.md5(content.encode()).hexdigest()
+        self.line_sep = str(config.get("LineSplitSep", ""))
+        self.interval = int(config.get("IntervalMs", 5000)) / 1000.0
+        self.timeout_s = min(int(config.get("TimeoutMilliSeconds", 3000)),
+                             int(config.get("IntervalMs", 5000))) / 1000.0
+        self.environments = list(config.get("Environments") or [])
+        self.ignore_error = bool(config.get("IgnoreError", False))
+        suffix, default_cmd = SCRIPT_TYPES[self.script_type]
+        self.cmd_path = str(config.get("CmdPath") or default_cmd)
+        if not os.path.exists(self.cmd_path):
+            log.error("input_command: CmdPath %s does not exist",
+                      self.cmd_path)
+            return False
+        storage = os.path.join(
+            os.environ.get("LOONG_CONF_DIR",
+                           os.path.join(os.path.expanduser("~"),
+                                        ".loongcollector")), "scripts")
+        os.makedirs(storage, exist_ok=True)
+        os.chmod(storage, 0o755)       # demoted exec user must traverse
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in (context.pipeline_name or "cmd"))
+        self.script_path = os.path.join(
+            storage, f"{safe}_{self.content_md5}.{suffix}")
+        if not os.path.exists(self.script_path):
+            with open(self.script_path, "w", encoding="utf-8") as f:
+                f.write(content)
+            os.chmod(self.script_path, 0o755)
+        return True
+
+    def _demote(self):
+        """setuid closure for the configured non-root user (only possible
+        when the agent itself runs privileged; otherwise run as-is)."""
+        if not self.user:
+            return None
+        try:
+            import pwd
+            rec = pwd.getpwnam(self.user)
+        except (ImportError, KeyError):
+            log.warning("input_command: user %r not found; running as self",
+                        self.user)
+            return None
+        if os.geteuid() != 0:
+            return None
+
+        def demote():
+            os.setgid(rec.pw_gid)
+            os.setuid(rec.pw_uid)
+        return demote
+
+    def poll_once(self) -> None:
+        env = dict(os.environ)
+        for e in self.environments:
+            k, _, v = e.partition("=")
+            env[k] = v
+        try:
+            proc = subprocess.run(
+                [self.cmd_path, self.script_path], capture_output=True,
+                timeout=self.timeout_s, env=env, text=True,
+                preexec_fn=self._demote())
+        except subprocess.TimeoutExpired:
+            if not self.ignore_error:
+                log.warning("input_command: script timed out (%ss)",
+                            self.timeout_s)
+            return
+        except OSError as e:
+            if not self.ignore_error:
+                log.warning("input_command: exec failed: %s", e)
+            return
+        if (proc.returncode != 0 or proc.stderr) and not self.ignore_error:
+            log.warning("input_command: rc=%s stderr=%r", proc.returncode,
+                        proc.stderr[:512])
+            if proc.returncode != 0:
+                return
+        chunks = (proc.stdout.split(self.line_sep) if self.line_sep
+                  else [proc.stdout])
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        now = int(time.time())
+        for chunk in chunks:
+            ev = group.add_log_event(now)
+            ev.set_content(b"content", sb.copy_string(chunk.encode()))
+            ev.set_content(b"script_md5",
+                           sb.copy_string(self.content_md5.encode()))
+        group.set_tag(b"__source__", b"command")
+        pqm = self.context.process_queue_manager
+        if pqm is not None and len(group):
+            pqm.push_queue(self.context.process_queue_key, group)

@@ -1,0 +1,163 @@
+"""service_docker_event + metric_debug_file.
+
+Reference: plugins/input/docker/event/input_docker_event.go (Docker
+Engine /events stream → _time_nano_/_action_/_type_/_id_ + actor
+attributes) and plugins/input/debugfile/input_debug_file.go (read a file
+once at init, re-emit its first LineLimit lines each round).
+
+The event stream rides the same AF_UNIX Engine-API transport as
+container discovery (container_manager._UnixHTTPConnection).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+from .polling_base import PollingInput
+
+log = get_logger("docker_event")
+
+
+class ServiceDockerEvent(Input):
+    name = "service_docker_event"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.ignore_attributes = bool(config.get("IgnoreAttributes", False))
+        from ..container_manager import DOCKER_SOCK
+        self.sock_path = str(config.get("SocketPath")
+                             or os.environ.get("LOONG_DOCKER_SOCK",
+                                               DOCKER_SOCK))
+        return True
+
+    def start(self) -> bool:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="docker-events")
+        self._thread.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        return True
+
+    def _run(self) -> None:
+        backoff = 1.0
+        while self._running:
+            if not os.path.exists(self.sock_path):
+                time.sleep(min(backoff, 30))
+                backoff = min(backoff * 2, 30)
+                continue
+            try:
+                self._stream_events()
+                backoff = 1.0
+            except OSError as e:
+                log.warning("docker event stream lost: %s", e)
+            time.sleep(min(backoff, 30))
+            backoff = min(backoff * 2, 30)
+
+    def _stream_events(self) -> None:
+        from ..container_manager import _UnixHTTPConnection
+        conn = _UnixHTTPConnection(self.sock_path, timeout=5.0)
+        conn.request("GET", "/events")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            conn.close()
+            raise OSError(f"/events HTTP {resp.status}")
+        buf = b""
+        try:
+            while self._running:
+                try:
+                    chunk = resp.read1(65536)
+                except TimeoutError:
+                    continue       # idle stream — keep waiting
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if line.strip():
+                        self._emit(line)
+        finally:
+            conn.close()
+
+    def _emit(self, line: bytes) -> None:
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            return
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_log_event(int(time.time()))
+
+        def put(k: str, v: str) -> None:
+            ev.set_content(sb.copy_string(k.encode()),
+                           sb.copy_string(str(v).encode()))
+
+        put("_time_nano_", str(msg.get("timeNano", 0)))
+        put("_action_", msg.get("Action", ""))
+        put("_type_", msg.get("Type", ""))
+        put("_id_", (msg.get("Actor") or {}).get("ID", msg.get("id", "")))
+        if not self.ignore_attributes:
+            for k, v in ((msg.get("Actor") or {})
+                         .get("Attributes") or {}).items():
+                put(k, v)
+        group.set_tag(b"__source__", b"docker_event")
+        pqm = self.context.process_queue_manager
+        if pqm is not None:
+            pqm.push_queue(self.context.process_queue_key, group)
+
+
+class InputDebugFile(PollingInput):
+    """metric_debug_file: load InputFilePath once (first LineLimit lines),
+    emit them as one event per round."""
+
+    name = "metric_debug_file"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.field_name = str(config.get("FieldName", "content"))
+        limit = int(config.get("LineLimit", 1000))
+        self.interval = int(config.get("IntervalMs", 10000)) / 1000.0
+        path = str(config.get("InputFilePath", ""))
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines: List[str] = []
+                for line in f:
+                    lines.append(line.rstrip("\n"))
+                    if len(lines) >= limit:
+                        break
+        except OSError as e:
+            log.error("metric_debug_file: %s", e)
+            return False
+        self._body = "\n".join(lines)
+        return True
+
+    def poll_once(self) -> None:
+        group = PipelineEventGroup()
+        sb = group.source_buffer
+        ev = group.add_log_event(int(time.time()))
+        ev.set_content(sb.copy_string(self.field_name.encode()),
+                       sb.copy_string(self._body.encode()))
+        group.set_tag(b"__source__", b"debug_file")
+        pqm = self.context.process_queue_manager
+        if pqm is not None:
+            pqm.push_queue(self.context.process_queue_key, group)

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import Input, PluginContext
 from ..utils.logger import get_logger
+from .supervisor import ProcessSupervisor, sanitize_name
 from .udpserver import SharedUDPServer
 
 log = get_logger("jmxfetch")
@@ -86,32 +87,18 @@ def render_config_yaml(instances: List[Dict[str, Any]],
     return "\n".join(out) + "\n"
 
 
-class JmxFetchManager:
+class JmxFetchManager(ProcessSupervisor):
     """Singleton per install dir (reference GetJmxFetchManager)."""
 
-    _instances: Dict[str, "JmxFetchManager"] = {}
-    _instances_lock = threading.Lock()
-
-    @classmethod
-    def get(cls, base_dir: str) -> "JmxFetchManager":
-        with cls._instances_lock:
-            inst = cls._instances.get(base_dir)
-            if inst is None:
-                inst = cls._instances[base_dir] = JmxFetchManager(base_dir)
-            return inst
+    check_interval_s = _CHECK_INTERVAL_S
 
     def __init__(self, base_dir: str) -> None:
-        self.base_dir = base_dir
+        super().__init__(base_dir)
         self.conf_dir = os.path.join(base_dir, "conf.d")
         self.jar_path = os.path.join(base_dir, "jmxfetch.jar")
         self._java_home = ""
         self._cfgs: Dict[str, dict] = {}
-        self._lock = threading.Lock()
         self._server: Optional[SharedUDPServer] = None
-        self._proc: Optional[subprocess.Popen] = None
-        self._thread: Optional[threading.Thread] = None
-        self._wake = threading.Event()
-        self._running = False
 
     # -- plugin-facing API ---------------------------------------------------
 
@@ -128,12 +115,12 @@ class JmxFetchManager:
                                "new_gc": new_gc_metrics, "sink": sink}
             started = self._running
         if not started:
-            self._start_loop()
+            self.start_loop()
         else:
             with self._lock:
                 if self._server is not None:
                     self._server.register(key, sink)
-        self._wake.set()
+        self.wake()
 
     def unregister(self, key: str) -> None:
         with self._lock:
@@ -145,9 +132,9 @@ class JmxFetchManager:
             os.unlink(os.path.join(self.conf_dir, key + ".yaml"))
         except OSError:
             pass
-        self._wake.set()
+        self.wake()
         if empty:
-            self._stop_loop()
+            self.stop_loop()
 
     @property
     def statsd_port(self) -> int:
@@ -156,45 +143,24 @@ class JmxFetchManager:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def _start_loop(self) -> None:
-        with self._lock:
-            if self._running:
-                return
-            self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="jmxfetch-manager")
-        self._thread.start()
-
-    def _stop_loop(self) -> None:
-        with self._lock:
-            self._running = False
-        self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=3)
-            self._thread = None
-        self._kill()
+    def _on_stop(self) -> None:
         with self._lock:
             if self._server is not None:
                 self._server.stop()
                 self._server = None
 
-    def _run(self) -> None:
-        while True:
-            with self._lock:
-                if not self._running:
-                    return
-                cfgs = dict(self._cfgs)
-            self._ensure_server(cfgs)
-            try:
-                self._render(cfgs)
-            except OSError as e:
-                log.warning("jmxfetch conf render failed: %s", e)
-            if cfgs:
-                self._ensure_proc()
-            else:
-                self._kill()
-            self._wake.wait(timeout=_CHECK_INTERVAL_S)
-            self._wake.clear()
+    def _tick(self) -> None:
+        with self._lock:
+            cfgs = dict(self._cfgs)
+        self._ensure_server(cfgs)
+        try:
+            self._render(cfgs)
+        except OSError as e:
+            log.warning("jmxfetch conf render failed: %s", e)
+        if cfgs:
+            self._ensure_proc()
+        else:
+            self.kill_proc()
 
     def _ensure_server(self, cfgs: Dict[str, dict]) -> None:
         with self._lock:
@@ -237,7 +203,7 @@ class JmxFetchManager:
         return shutil.which("java")
 
     def _ensure_proc(self) -> None:
-        if self._proc is not None and self._proc.poll() is None:
+        if self.proc_alive():
             return
         java = self._java_cmd()
         if java is None or not os.path.exists(self.jar_path):
@@ -258,18 +224,6 @@ class JmxFetchManager:
             log.warning("jmxfetch start failed: %s", e)
             self._proc = None
 
-    def _kill(self) -> None:
-        if self._proc is not None:
-            try:
-                self._proc.terminate()
-                self._proc.wait(timeout=5)
-            except (OSError, subprocess.TimeoutExpired):
-                try:
-                    self._proc.kill()
-                except OSError:
-                    pass
-            self._proc = None
-
 
 def _instance_inner(port: int, host: str, user: str, password: str,
                     tags: Dict[str, str], default_jvm: bool) -> Dict[str, Any]:
@@ -282,7 +236,7 @@ def _instance_inner(port: int, host: str, user: str, password: str,
         name = f"{hostname}_{port}"
     else:
         name = f"{host}_{port}"
-    name = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+    name = sanitize_name(name)
     return {"name": name, "host": host, "port": port, "user": user,
             "password": password, "default_jvm_metrics": default_jvm,
             "tags": sorted(f"{k}:{v}" for k, v in tags.items())}
@@ -353,8 +307,7 @@ class ServiceJmxFetch(Input):
     def start(self) -> bool:
         self._manager = JmxFetchManager.get(self._base_dir)
         self._manager.config_java_home(self.jdk_path)
-        self._key = "".join(c if c.isalnum() or c in "-_." else "_"
-                            for c in (self.context.pipeline_name or "jmx"))
+        self._key = sanitize_name(self.context.pipeline_name, "jmx")
         pqm = self.context.process_queue_manager
         key = self.context.process_queue_key
 

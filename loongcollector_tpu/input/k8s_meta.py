@@ -1,0 +1,482 @@
+"""service_kubernetes_meta — K8s entity + entity-link collection.
+
+Reference: plugins/input/kubernetesmetav2/ (service_meta.go: per-kind
+entity switches and link switches whose config VALUE is the relation
+type; meta_collector.go:419-451: the reserved __domain__/__entity_type__
+/__entity_id__/__method__/observed-time field contract;
+meta_collector_core.go: per-kind custom fields) and kubernetesmetav1
+(periodic full listing — this implementation's collection model: list
+snapshots + diff instead of informers, producing the same
+Add/Update/Delete methods).
+
+Transport rides the same injectable apiserver client as the container
+metadata cache (container_manager.K8sMetadata), so tests run against a
+local fake apiserver.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Input, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("k8s_meta")
+
+# kind → list path (cluster-scope list; namespaced objects carry their
+# namespace in metadata)
+_KIND_PATHS = {
+    "Pod": "/api/v1/pods",
+    "Node": "/api/v1/nodes",
+    "Service": "/api/v1/services",
+    "Namespace": "/api/v1/namespaces",
+    "Configmap": "/api/v1/configmaps",
+    "PersistentVolume": "/api/v1/persistentvolumes",
+    "PersistentVolumeClaim": "/api/v1/persistentvolumeclaims",
+    "Deployment": "/apis/apps/v1/deployments",
+    "ReplicaSet": "/apis/apps/v1/replicasets",
+    "DaemonSet": "/apis/apps/v1/daemonsets",
+    "StatefulSet": "/apis/apps/v1/statefulsets",
+    "Job": "/apis/batch/v1/jobs",
+    "CronJob": "/apis/batch/v1/cronjobs",
+    "Ingress": "/apis/networking.k8s.io/v1/ingresses",
+    "StorageClass": "/apis/storage.k8s.io/v1/storageclasses",
+}
+# canonical kind spelling for entity types/keys (config switch → kind)
+_KIND_NAMES = {k: ("ConfigMap" if k == "Configmap" else k)
+               for k in _KIND_PATHS}
+
+# ownerReferences-derived links: child kind → (owner kind, switch attr)
+_OWNER_LINKS = [
+    ("Pod", "ReplicaSet", "ReplicaSet2Pod"),
+    ("Pod", "StatefulSet", "StatefulSet2Pod"),
+    ("Pod", "DaemonSet", "DaemonSet2Pod"),
+    ("Pod", "Job", "Job2Pod"),
+    ("ReplicaSet", "Deployment", "Deployment2ReplicaSet"),
+    ("Job", "CronJob", "CronJob2Job"),
+]
+
+_NS_LINKS = [
+    ("Pod", "Namespace2Pod"), ("Service", "Namespace2Service"),
+    ("Deployment", "Namespace2Deployment"),
+    ("DaemonSet", "Namespace2DaemonSet"),
+    ("StatefulSet", "Namespace2StatefulSet"),
+    ("Configmap", "Namespace2Configmap"), ("Job", "Namespace2Job"),
+    ("CronJob", "Namespace2CronJob"),
+    ("PersistentVolumeClaim", "Namespace2PersistentVolumeClaim"),
+    ("Ingress", "Namespace2Ingress"),
+]
+
+
+def _meta(obj: dict) -> dict:
+    return obj.get("metadata", {}) or {}
+
+
+def _jdump(v) -> str:
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+class ServiceK8sMeta(Input):
+    """service_kubernetes_meta: entity switches (Pod/Node/Service/...),
+    EnableLabels/EnableAnnotations, link switches whose value is the
+    relation type (e.g. ``Node2Pod: runs``)."""
+
+    name = "service_kubernetes_meta"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # entity key → first_observed_time
+        self._first_seen: Dict[str, int] = {}
+        self._last_keys: set = set()
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.interval = int(config.get("Interval", 60))
+        self.kinds = [k for k in _KIND_PATHS if bool(config.get(k))]
+        self.container_entities = bool(config.get("Container"))
+        self.enable_labels = bool(config.get("EnableLabels", False))
+        self.enable_annotations = bool(config.get("EnableAnnotations", False))
+        self.cluster_id = str(config.get("ClusterID", ""))
+        self.cluster_name = str(config.get("ClusterName", ""))
+        self.cluster_region = str(config.get("ClusterRegion", ""))
+        self.domain = str(config.get("Domain", "k8s"))
+        self.links = {key: str(val) for key, val in config.items()
+                      if "2" in key and isinstance(val, str) and val}
+        # tests / out-of-cluster: explicit apiserver endpoint
+        self._endpoint = config.get("Endpoint")  # {Scheme,Host,Port,Token}
+        return bool(self.kinds)
+
+    # -- transport -----------------------------------------------------------
+
+    def _client(self):
+        from ..container_manager import K8sMetadata
+        k = K8sMetadata()
+        if self._endpoint:
+            k.configure(str(self._endpoint.get("Scheme", "http")),
+                        str(self._endpoint.get("Host", "127.0.0.1")),
+                        int(self._endpoint.get("Port", 0)),
+                        str(self._endpoint.get("Token", "")))
+        return k
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> bool:
+        client = self._client()
+        if not client.available():
+            log.warning("service_kubernetes_meta: no apiserver available; "
+                        "input idles")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, args=(client,),
+                                        daemon=True, name="k8s-meta")
+        self._thread.start()
+        return True
+
+    def stop(self, is_pipeline_removing: bool = False) -> bool:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        return True
+
+    def _run(self, client) -> None:
+        while not self._stop.is_set():
+            try:
+                self.collect_once(client)
+            except Exception:  # noqa: BLE001 — apiserver flap must not kill it
+                log.exception("k8s meta collection failed")
+            self._stop.wait(self.interval)
+
+    # -- collection ----------------------------------------------------------
+
+    def collect_once(self, client) -> Optional[PipelineEventGroup]:
+        if not client.available():
+            return None
+        snapshots: Dict[str, List[dict]] = {}
+        failed_kinds: set = set()
+        for kind in self.kinds:
+            try:
+                data = client._get_json(_KIND_PATHS[kind])
+            except (OSError, ValueError):
+                data = None
+            if data is None:
+                # transient apiserver failure: an unknown state must not
+                # read as "everything of this kind was deleted"
+                failed_kinds.add(_KIND_NAMES.get(kind, kind))
+                snapshots[kind] = []
+            else:
+                snapshots[kind] = data.get("items", []) or []
+
+        now = int(time.time())
+        group = PipelineEventGroup()
+        seen: set = set()
+        for kind in self.kinds:
+            for obj in snapshots[kind]:
+                self._emit_entity(group, kind, obj, now, seen)
+        self._emit_links(group, snapshots, now)
+        if any(k.startswith("Cluster2") for k in self.links):
+            self._emit_cluster(group, now)
+        # disappeared objects → Delete entities (skip kinds whose list
+        # failed this round — their objects may well still exist)
+        carried: set = set()
+        for key in self._last_keys - seen:
+            kind, ns, name = key.split("|", 2)
+            if kind in failed_kinds:
+                carried.add(key)
+                continue
+            ev = group.add_log_event(now)
+            self._common_entity_fields(ev, group, kind, ns, name, "Delete",
+                                       self._first_seen.get(key, now), now)
+            self._first_seen.pop(key, None)
+        self._last_keys = seen | carried
+        if not len(group):
+            return None
+        group.set_tag(b"__source__", b"k8s_meta")
+        pqm = self.context.process_queue_manager if self.context else None
+        if pqm is not None:
+            pqm.push_queue(self.context.process_queue_key, group)
+        return group
+
+    # -- entity emission -----------------------------------------------------
+
+    def _gen_key(self, kind: str, namespace: str, name: str) -> str:
+        raw = (self.cluster_id + kind + namespace + name).encode()
+        return hashlib.md5(raw).hexdigest()
+
+    def _type_key(self, kind: str) -> str:
+        return f"{self.domain}.{kind.lower()}"
+
+    def _put(self, ev, group, key: str, val: str) -> None:
+        sb = group.source_buffer
+        ev.set_content(sb.copy_string(key.encode()),
+                       sb.copy_string(str(val).encode()))
+
+    def _common_entity_fields(self, ev, group, kind: str, namespace: str,
+                              name: str, method: str, first: int,
+                              now: int, creation: str = "") -> None:
+        kindn = _KIND_NAMES.get(kind, kind)
+        self._put(ev, group, "__domain__", self.domain)
+        self._put(ev, group, "__entity_type__", self._type_key(kindn))
+        self._put(ev, group, "__entity_id__",
+                  self._gen_key(kindn, namespace, name))
+        self._put(ev, group, "__method__", method)
+        self._put(ev, group, "__first_observed_time__", str(first))
+        self._put(ev, group, "__last_observed_time__", str(now))
+        self._put(ev, group, "__keep_alive_seconds__",
+                  str(self.interval * 2))
+        self._put(ev, group, "__category__", "entity")
+        self._put(ev, group, "cluster_id", self.cluster_id)
+        self._put(ev, group, "kind", kindn)
+        self._put(ev, group, "name", name)
+        if creation:
+            self._put(ev, group, "create_time", creation)
+
+    def _emit_entity(self, group, kind: str, obj: dict, now: int,
+                     seen: set) -> None:
+        meta = _meta(obj)
+        ns = meta.get("namespace", "")
+        name = meta.get("name", "")
+        key = f"{_KIND_NAMES.get(kind, kind)}|{ns}|{name}"
+        method = "Update" if key in self._first_seen else "Add"
+        first = self._first_seen.setdefault(key, now)
+        seen.add(key)
+        ev = group.add_log_event(now)
+        self._common_entity_fields(ev, group, kind, ns, name, method, first,
+                                   now, meta.get("creationTimestamp", ""))
+        if ns:
+            self._put(ev, group, "namespace", ns)
+        if self.enable_labels:
+            self._put(ev, group, "labels", _jdump(meta.get("labels") or {}))
+        if self.enable_annotations:
+            self._put(ev, group, "annotations",
+                      _jdump(meta.get("annotations") or {}))
+        spec = obj.get("spec", {}) or {}
+        status = obj.get("status", {}) or {}
+        if kind == "Pod":
+            self._put(ev, group, "status", status.get("phase", ""))
+            self._put(ev, group, "instance_ip", status.get("podIP", ""))
+            containers = [{"name": c.get("name", ""),
+                           "image": c.get("image", "")}
+                          for c in spec.get("containers", []) or []]
+            self._put(ev, group, "containers", _jdump(containers))
+            if self.container_entities:
+                self._emit_containers(group, obj, now, first)
+        elif kind == "Node":
+            addrs = {a.get("type"): a.get("address")
+                     for a in status.get("addresses", []) or []}
+            self._put(ev, group, "internal_ip",
+                      addrs.get("InternalIP", ""))
+            self._put(ev, group, "hostname", addrs.get("Hostname", ""))
+            info = status.get("nodeInfo", {}) or {}
+            self._put(ev, group, "os", info.get("osImage", ""))
+            self._put(ev, group, "kubelet_version",
+                      info.get("kubeletVersion", ""))
+        elif kind == "Service":
+            self._put(ev, group, "cluster_ip", spec.get("clusterIP", ""))
+            self._put(ev, group, "type", spec.get("type", ""))
+            self._put(ev, group, "selector",
+                      _jdump(spec.get("selector") or {}))
+        elif kind in ("Deployment", "ReplicaSet", "StatefulSet"):
+            self._put(ev, group, "replicas",
+                      str(spec.get("replicas", "")))
+            self._put(ev, group, "ready_replicas",
+                      str(status.get("readyReplicas", 0)))
+        elif kind == "Job":
+            self._put(ev, group, "succeeded", str(status.get("succeeded", 0)))
+        elif kind == "CronJob":
+            self._put(ev, group, "schedule", spec.get("schedule", ""))
+        elif kind == "PersistentVolumeClaim":
+            self._put(ev, group, "volume_name", spec.get("volumeName", ""))
+            self._put(ev, group, "phase", status.get("phase", ""))
+        elif kind == "PersistentVolume":
+            self._put(ev, group, "phase", status.get("phase", ""))
+            self._put(ev, group, "storage_class",
+                      spec.get("storageClassName", ""))
+
+    def _emit_containers(self, group, pod: dict, now: int,
+                         first: int) -> None:
+        meta = _meta(pod)
+        ns = meta.get("namespace", "")
+        pod_name = meta.get("name", "")
+        for c in (pod.get("spec", {}) or {}).get("containers", []) or []:
+            ev = group.add_log_event(now)
+            cname = c.get("name", "")
+            self._common_entity_fields(ev, group, "container", ns,
+                                       pod_name + cname, "Update", first,
+                                       now)
+            self._put(ev, group, "name", cname)
+            self._put(ev, group, "pod_name", pod_name)
+            self._put(ev, group, "pod_namespace", ns)
+            self._put(ev, group, "image", c.get("image", ""))
+            res = c.get("resources", {}) or {}
+            for field, source in (("cpu_request", "requests"),
+                                  ("cpu_limit", "limits")):
+                self._put(ev, group, field,
+                          (res.get(source) or {}).get("cpu", ""))
+            for field, source in (("memory_request", "requests"),
+                                  ("memory_limit", "limits")):
+                self._put(ev, group, field,
+                          (res.get(source) or {}).get("memory", ""))
+            if self.links.get("Pod2Container"):
+                self._emit_link(group, now, "Pod", ns, pod_name,
+                                "container", ns, pod_name + cname,
+                                self.links["Pod2Container"])
+
+    # -- link emission -------------------------------------------------------
+
+    def _emit_link(self, group, now: int, src_kind: str, src_ns: str,
+                   src_name: str, dst_kind: str, dst_ns: str, dst_name: str,
+                   relation: str, src_domain: str = "",
+                   dst_domain: str = "") -> None:
+        ev = group.add_log_event(now)
+        self._put(ev, group, "__src_domain__", src_domain or self.domain)
+        self._put(ev, group, "__src_entity_type__", self._type_key(src_kind))
+        self._put(ev, group, "__src_entity_id__",
+                  self._gen_key(src_kind, src_ns, src_name))
+        self._put(ev, group, "__dest_domain__", dst_domain or self.domain)
+        self._put(ev, group, "__dest_entity_type__", self._type_key(dst_kind))
+        self._put(ev, group, "__dest_entity_id__",
+                  self._gen_key(dst_kind, dst_ns, dst_name))
+        self._put(ev, group, "__relation_type__", relation)
+        self._put(ev, group, "__method__", "Update")
+        self._put(ev, group, "__first_observed_time__", str(now))
+        self._put(ev, group, "__last_observed_time__", str(now))
+        self._put(ev, group, "__keep_alive_seconds__",
+                  str(self.interval * 2))
+        self._put(ev, group, "__category__", "entity_link")
+
+    def _emit_links(self, group, snaps: Dict[str, List[dict]],
+                    now: int) -> None:
+        links = self.links
+        # Node → Pod placement
+        if links.get("Node2Pod"):
+            for pod in snaps.get("Pod", []):
+                node = (pod.get("spec", {}) or {}).get("nodeName", "")
+                if node:
+                    m = _meta(pod)
+                    self._emit_link(group, now, "Node", "", node, "Pod",
+                                    m.get("namespace", ""),
+                                    m.get("name", ""), links["Node2Pod"])
+        # ownerReferences chains
+        for child_kind, owner_kind, switch in _OWNER_LINKS:
+            rel = links.get(switch)
+            if not rel:
+                continue
+            for obj in snaps.get(child_kind, []):
+                m = _meta(obj)
+                for ref in m.get("ownerReferences", []) or []:
+                    if ref.get("kind") == owner_kind:
+                        self._emit_link(group, now, owner_kind,
+                                        m.get("namespace", ""),
+                                        ref.get("name", ""), child_kind,
+                                        m.get("namespace", ""),
+                                        m.get("name", ""), rel)
+        # Deployment → Pod transitively via ReplicaSet name prefix
+        if links.get("Deployment2Pod"):
+            rs_owner = {}
+            for rs in snaps.get("ReplicaSet", []):
+                m = _meta(rs)
+                for ref in m.get("ownerReferences", []) or []:
+                    if ref.get("kind") == "Deployment":
+                        rs_owner[(m.get("namespace", ""),
+                                  m.get("name", ""))] = ref.get("name", "")
+            for pod in snaps.get("Pod", []):
+                m = _meta(pod)
+                for ref in m.get("ownerReferences", []) or []:
+                    dep = rs_owner.get((m.get("namespace", ""),
+                                        ref.get("name", "")))
+                    if ref.get("kind") == "ReplicaSet" and dep:
+                        self._emit_link(group, now, "Deployment",
+                                        m.get("namespace", ""), dep, "Pod",
+                                        m.get("namespace", ""),
+                                        m.get("name", ""),
+                                        links["Deployment2Pod"])
+        # Service → Pod via label selectors
+        if links.get("Service2Pod"):
+            for svc in snaps.get("Service", []):
+                sel = (svc.get("spec", {}) or {}).get("selector") or {}
+                if not sel:
+                    continue
+                sm = _meta(svc)
+                for pod in snaps.get("Pod", []):
+                    pm = _meta(pod)
+                    if pm.get("namespace") != sm.get("namespace"):
+                        continue
+                    plabels = pm.get("labels") or {}
+                    if all(plabels.get(k) == v for k, v in sel.items()):
+                        self._emit_link(group, now, "Service",
+                                        sm.get("namespace", ""),
+                                        sm.get("name", ""), "Pod",
+                                        pm.get("namespace", ""),
+                                        pm.get("name", ""),
+                                        links["Service2Pod"])
+        # Ingress → Service backends
+        if links.get("Ingress2Service"):
+            for ing in snaps.get("Ingress", []):
+                im = _meta(ing)
+                for rule in (ing.get("spec", {}) or {}).get("rules", []) or []:
+                    paths = ((rule.get("http") or {}).get("paths") or [])
+                    for p in paths:
+                        svc = ((p.get("backend") or {})
+                               .get("service") or {}).get("name", "")
+                        if svc:
+                            self._emit_link(group, now, "Ingress",
+                                            im.get("namespace", ""),
+                                            im.get("name", ""), "Service",
+                                            im.get("namespace", ""), svc,
+                                            links["Ingress2Service"])
+        # Pod → PVC / ConfigMap volumes
+        for switch, vol_key, vol_name_key, dst_kind in (
+                ("Pod2PersistentVolumeClaim", "persistentVolumeClaim",
+                 "claimName", "PersistentVolumeClaim"),
+                ("Pod2ConfigMap", "configMap", "name", "ConfigMap")):
+            rel = links.get(switch)
+            if not rel:
+                continue
+            for pod in snaps.get("Pod", []):
+                m = _meta(pod)
+                for vol in (pod.get("spec", {}) or {}).get("volumes", []) or []:
+                    ref = vol.get(vol_key) or {}
+                    target = ref.get(vol_name_key, "")
+                    if target:
+                        self._emit_link(group, now, "Pod",
+                                        m.get("namespace", ""),
+                                        m.get("name", ""), dst_kind,
+                                        m.get("namespace", ""), target, rel)
+        # Namespace → contained kinds
+        for kind, switch in _NS_LINKS:
+            rel = links.get(switch)
+            if not rel:
+                continue
+            for obj in snaps.get(kind, []):
+                m = _meta(obj)
+                ns = m.get("namespace", "")
+                if ns:
+                    self._emit_link(group, now, "Namespace", "", ns,
+                                    _KIND_NAMES.get(kind, kind), ns,
+                                    m.get("name", ""), rel)
+        # Cluster → Node / Namespace
+        for kind, switch in (("Node", "Cluster2Node"),
+                             ("Namespace", "Cluster2Namespace"),
+                             ("PersistentVolume", "Cluster2PersistentVolume"),
+                             ("StorageClass", "Cluster2StorageClass")):
+            rel = links.get(switch)
+            if not rel:
+                continue
+            for obj in snaps.get(kind, []):
+                m = _meta(obj)
+                self._emit_link(group, now, "cluster", "", "", kind, "",
+                                m.get("name", ""), rel)
+
+    def _emit_cluster(self, group, now: int) -> None:
+        ev = group.add_log_event(now)
+        self._common_entity_fields(ev, group, "cluster", "", "", "Update",
+                                   now, now)
+        self._put(ev, group, "cluster_name", self.cluster_name)
+        self._put(ev, group, "region_id", self.cluster_region)

@@ -1,0 +1,344 @@
+"""Network probe inputs: HTTP checker, nginx stub_status, netping.
+
+Reference:
+  * plugins/input/http/input_http.go — metric_http: periodic request per
+    address, emitting _method_/_address_/_result_/_http_response_code_/
+    _response_time_ms_ (+ optional body match and content).
+  * plugins/input/nginx/input_nginx.go — ngx_http_stub_status_module
+    counters (active/accepts/handled/requests/reading/writing/waiting).
+  * plugins/input/netping/netping.go — icmp ping / tcping / httping with
+    min/max/avg RTT summaries.  ICMP uses an unprivileged SOCK_DGRAM
+    socket where the kernel allows it (ping_group_range) and degrades to
+    counting failures otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import select
+import socket
+import ssl
+import struct
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import PluginContext
+from ..utils.logger import get_logger
+from .polling_base import PollingInput
+
+log = get_logger("probes")
+
+
+def _push(ctx, group: PipelineEventGroup, source: bytes) -> None:
+    group.set_tag(b"__source__", source)
+    pqm = ctx.process_queue_manager
+    if pqm is not None and len(group):
+        pqm.push_queue(ctx.process_queue_key, group)
+
+
+def _put(group, ev, key: str, val) -> None:
+    sb = group.source_buffer
+    ev.set_content(sb.copy_string(key.encode()),
+                   sb.copy_string(str(val).encode()))
+
+
+# --------------------------------------------------------------- metric_http
+
+
+class InputHTTPResponse(PollingInput):
+    """metric_http (plugins/input/http/input_http.go)."""
+
+    name = "metric_http"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.addresses = [str(a) for a in config.get("Addresses") or []]
+        self.address_path = str(config.get("AddressPath", ""))
+        if not self.addresses and not self.address_path:
+            self.addresses = ["http://localhost"]
+        self.method = str(config.get("Method", "GET")).upper()
+        self.body = str(config.get("Body", ""))
+        self.headers = {str(k): str(v)
+                        for k, v in (config.get("Headers") or {}).items()}
+        self.timeout_s = max(int(config.get("ResponseTimeoutMs", 5000)),
+                             100) / 1000.0
+        self.per_addr_sleep = int(config.get("PerAddressSleepMs", 0)) / 1000.0
+        self.include_body = bool(config.get("IncludeBody", False))
+        self.insecure = bool(config.get("InsecureSkipVerify", False))
+        match = config.get("ResponseStringMatch")
+        self._match = re.compile(match) if match else None
+        self.interval = int(config.get("IntervalMs", 60000)) / 1000.0
+        return True
+
+    def _load_addresses(self) -> List[str]:
+        if self.address_path:
+            try:
+                with open(self.address_path, encoding="utf-8") as f:
+                    lines = [l.strip() for l in f if l.strip()]
+                if lines:
+                    return lines
+            except OSError as e:
+                log.warning("metric_http: AddressPath unreadable: %s", e)
+        return self.addresses
+
+    def _probe(self, addr: str) -> Dict[str, Any]:
+        if "://" not in addr:
+            addr = "http://" + addr
+        out: Dict[str, Any] = {"_method_": self.method, "_address_": addr,
+                               "_result_": "failed",
+                               "_http_response_code_": 0,
+                               "_response_time_ms_": 0}
+        req = urllib.request.Request(
+            addr, data=self.body.encode() if self.body else None,
+            headers=self.headers, method=self.method)
+        ctx = ssl._create_unverified_context() if self.insecure else None
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                        context=ctx) as resp:
+                body = resp.read()
+                out["_http_response_code_"] = resp.status
+                out["_result_"] = "success"
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            out["_http_response_code_"] = e.code
+            out["_result_"] = "success"     # got a response — HTTP-level OK
+        except (OSError, ValueError) as e:
+            reason = getattr(e, "reason", e)   # URLError wraps the cause
+            timed_out = isinstance(reason, (socket.timeout, TimeoutError))
+            out["_result_"] = "timeout" if timed_out else "failed"
+            return out
+        out["_response_time_ms_"] = round(
+            (time.perf_counter() - t0) * 1000, 2)
+        if self._match is not None:
+            ok = self._match.search(body.decode("utf-8", "replace"))
+            out["_result_match_"] = "yes" if ok else "no"
+            if not ok:
+                out["_result_"] = "mismatch"
+        if self.include_body:
+            out["content"] = body.decode("utf-8", "replace")[:512 * 1024]
+        return out
+
+    def poll_once(self) -> None:
+        group = PipelineEventGroup()
+        now = int(time.time())
+        for addr in self._load_addresses():
+            fields = self._probe(addr)
+            ev = group.add_log_event(now)
+            for k, v in fields.items():
+                _put(group, ev, k, v)
+            if self.per_addr_sleep:
+                time.sleep(self.per_addr_sleep)
+        _push(self.context, group, b"http_probe")
+
+
+# --------------------------------------------------------- nginx stub_status
+
+_NGINX_RE = re.compile(
+    rb"Active connections:\s*(\d+)\s*.*?"
+    rb"(\d+)\s+(\d+)\s+(\d+)\s*"
+    rb"Reading:\s*(\d+)\s*Writing:\s*(\d+)\s*Waiting:\s*(\d+)", re.S)
+
+
+def parse_stub_status(body: bytes) -> Optional[Dict[str, str]]:
+    m = _NGINX_RE.search(body)
+    if not m:
+        return None
+    keys = ("active", "accepts", "handled", "requests",
+            "reading", "writing", "waiting")
+    return {k: m.group(i + 1).decode() for i, k in enumerate(keys)}
+
+
+class InputNginxStatus(PollingInput):
+    """metric_nginx_status (plugins/input/nginx/input_nginx.go)."""
+
+    name = "metric_nginx_status"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.urls = [str(u) for u in config.get("Urls") or []]
+        self.timeout_s = max(int(config.get("ResponseTimeoutMs", 5000)),
+                             100) / 1000.0
+        self.insecure = bool(config.get("SkipInsecureVerify", False))
+        self.interval = int(config.get("IntervalMs", 30000)) / 1000.0
+        return bool(self.urls)
+
+    def poll_once(self) -> None:
+        group = PipelineEventGroup()
+        now = int(time.time())
+        for u in self.urls:
+            try:
+                ctx = (ssl._create_unverified_context()
+                       if self.insecure else None)
+                with urllib.request.urlopen(u, timeout=self.timeout_s,
+                                            context=ctx) as resp:
+                    fields = parse_stub_status(resp.read())
+            except (OSError, ValueError) as e:
+                log.warning("nginx_status %s: %s", u, e)
+                continue
+            if fields is None:
+                log.warning("nginx_status %s: unparseable body", u)
+                continue
+            ev = group.add_log_event(now)
+            parsed = urllib.parse.urlparse(u)
+            _put(group, ev, "server", parsed.hostname or "")
+            _put(group, ev, "port", parsed.port or 80)
+            for k, v in fields.items():
+                _put(group, ev, k, v)
+        _push(self.context, group, b"nginx_status")
+
+
+# ------------------------------------------------------------------- netping
+
+
+def _icmp_ping(target: str, count: int, timeout_s: float
+               ) -> Tuple[int, List[float]]:
+    """Unprivileged ICMP echo (SOCK_DGRAM). Returns (sent, rtts_ms)."""
+    try:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM,
+                             socket.getprotobyname("icmp"))
+    except (OSError, PermissionError):
+        return 0, []
+    rtts: List[float] = []
+    try:
+        sock.settimeout(timeout_s)
+        try:
+            addr = (socket.gethostbyname(target), 0)
+        except OSError:
+            return count, []       # unresolvable target = all probes failed
+        for seq in range(count):
+            payload = struct.pack("!d", time.perf_counter()) + b"loong"
+            header = struct.pack("!BBHHH", 8, 0, 0, os.getpid() & 0xFFFF,
+                                 seq)
+            csum = _icmp_checksum(header + payload)
+            packet = struct.pack("!BBHHH", 8, 0, csum,
+                                 os.getpid() & 0xFFFF, seq) + payload
+            t0 = time.perf_counter()
+            try:
+                sock.sendto(packet, addr)
+                ready = select.select([sock], [], [], timeout_s)
+                if not ready[0]:
+                    continue
+                data, _ = sock.recvfrom(1024)
+                rtts.append((time.perf_counter() - t0) * 1000)
+            except OSError:
+                continue
+    finally:
+        sock.close()
+    return count, rtts
+
+
+def _icmp_checksum(data: bytes) -> int:
+    if len(data) % 2:
+        data += b"\x00"
+    s = sum(struct.unpack(f"!{len(data)//2}H", data))
+    s = (s >> 16) + (s & 0xFFFF)
+    s += s >> 16
+    return ~s & 0xFFFF
+
+
+def _tcp_ping(target: str, port: int, count: int, timeout_s: float
+              ) -> Tuple[int, List[float]]:
+    rtts: List[float] = []
+    for _ in range(count):
+        t0 = time.perf_counter()
+        try:
+            s = socket.create_connection((target, port), timeout=timeout_s)
+            rtts.append((time.perf_counter() - t0) * 1000)
+            s.close()
+        except OSError:
+            continue
+    return count, rtts
+
+
+class InputNetPing(PollingInput):
+    """metric_input_netping (plugins/input/netping/netping.go): ICMP /
+    tcping / httping probes emitting success counts + RTT summaries."""
+
+    name = "metric_input_netping"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.interval = min(max(int(config.get("IntervalSeconds", 60)), 5),
+                            86400)
+        self.timeout_s = min(max(int(config.get("TimeoutSeconds", 5)), 1),
+                             30)
+        self.icmp = list(config.get("ICMPConfigs") or [])
+        self.tcp = list(config.get("TCPConfigs") or [])
+        self.http = list(config.get("HTTPConfigs") or [])
+        return bool(self.icmp or self.tcp or self.http)
+
+    @staticmethod
+    def _summary(ev, group, sent: int, rtts: List[float]) -> None:
+        _put(group, ev, "total", sent)
+        _put(group, ev, "success", len(rtts))
+        _put(group, ev, "failed", sent - len(rtts))
+        if rtts:
+            avg = sum(rtts) / len(rtts)
+            _put(group, ev, "min_rtt_ms", round(min(rtts), 3))
+            _put(group, ev, "max_rtt_ms", round(max(rtts), 3))
+            _put(group, ev, "avg_rtt_ms", round(avg, 3))
+            var = sum((r - avg) ** 2 for r in rtts) / len(rtts)
+            _put(group, ev, "stddev_rtt_ms", round(var ** 0.5, 3))
+
+    def poll_once(self) -> None:
+        group = PipelineEventGroup()
+        now = int(time.time())
+        for cfg in self.icmp:
+            count = int(cfg.get("count", cfg.get("Count", 3)))
+            target = str(cfg.get("target", cfg.get("Target", "")))
+            sent, rtts = _icmp_ping(target, count, self.timeout_s)
+            ev = group.add_log_event(now)
+            _put(group, ev, "type", "ping")
+            _put(group, ev, "target", target)
+            if sent == 0:
+                _put(group, ev, "error", "icmp socket unavailable")
+            self._summary(ev, group, sent, rtts)
+        for cfg in self.tcp:
+            count = int(cfg.get("count", cfg.get("Count", 3)))
+            target = str(cfg.get("target", cfg.get("Target", "")))
+            port = int(cfg.get("port", cfg.get("Port", 80)))
+            sent, rtts = _tcp_ping(target, port, count, self.timeout_s)
+            ev = group.add_log_event(now)
+            _put(group, ev, "type", "tcping")
+            _put(group, ev, "target", f"{target}:{port}")
+            self._summary(ev, group, sent, rtts)
+        for cfg in self.http:
+            target = str(cfg.get("target", cfg.get("Target", "")))
+            method = str(cfg.get("method", cfg.get("Method", "GET")))
+            expect_code = int(cfg.get("expect_code",
+                                      cfg.get("ExpectCode", 0)))
+            ev = group.add_log_event(now)
+            _put(group, ev, "type", "httping")
+            _put(group, ev, "target", target)
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(target, method=method.upper())
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    body = resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                body = b""
+                code = e.code
+            except (OSError, ValueError):
+                _put(group, ev, "success", 0)
+                _put(group, ev, "failed", 1)
+                continue
+            rt_ms = round((time.perf_counter() - t0) * 1000, 2)
+            ok = (code == expect_code) if expect_code else (code < 400)
+            expect_body = str(cfg.get("expect_response_contains",
+                                      cfg.get("ExpectResponseContains", "")))
+            if ok and expect_body:
+                ok = expect_body.encode() in body
+            _put(group, ev, "success", 1 if ok else 0)
+            _put(group, ev, "failed", 0 if ok else 1)
+            _put(group, ev, "http_rt_ms", rt_ms)
+            _put(group, ev, "http_response_code", code)
+            _put(group, ev, "http_response_size", len(body))
+        _push(self.context, group, b"netping")

@@ -1,0 +1,117 @@
+"""Shared scaffolding for external-agent supervisors (telegraf, jmxfetch).
+
+One place for the lifecycle both managers need: a per-directory singleton
+registry, a wake-event supervision loop calling an overridable `_tick()`,
+and terminate→kill process teardown.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from ..utils.logger import get_logger
+
+log = get_logger("supervisor")
+
+
+def sanitize_name(name: str, default: str = "cfg") -> str:
+    """Config names become filenames: keep [alnum.-_], replace the rest."""
+    out = "".join(c if c.isalnum() or c in "-_." else "_"
+                  for c in (name or default))
+    return out or default
+
+
+class ProcessSupervisor:
+    """Singleton-per-base-dir manager with a wake-driven tick loop."""
+
+    check_interval_s: float = 30.0
+    _instances: Dict[str, "ProcessSupervisor"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, base_dir: str) -> "ProcessSupervisor":
+        with cls._instances_lock:
+            key = f"{cls.__name__}:{base_dir}"
+            inst = cls._instances.get(key)
+            if inst is None:
+                inst = cls._instances[key] = cls(base_dir)
+            return inst
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._running = False
+
+    # -- the loop ------------------------------------------------------------
+
+    def _tick(self) -> None:  # pragma: no cover - abstract
+        """One supervision round; runs with no locks held."""
+        raise NotImplementedError
+
+    def _on_start(self) -> None:
+        """Hook: extra threads/servers to start with the loop."""
+
+    def _on_stop(self) -> None:
+        """Hook: teardown after the loop exits (process already killed)."""
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def start_loop(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=type(self).__name__)
+        self._thread.start()
+        self._on_start()
+
+    def stop_loop(self) -> None:
+        with self._lock:
+            self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        self.kill_proc()
+        self._on_stop()
+
+    @property
+    def running(self) -> bool:
+        with self._lock:
+            return self._running
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — supervision must survive
+                log.exception("%s tick failed", type(self).__name__)
+            self._wake.wait(timeout=self.check_interval_s)
+            self._wake.clear()
+
+    # -- process management --------------------------------------------------
+
+    def proc_alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill_proc(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.terminate()
+                self._proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            self._proc = None

@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import Input, PluginContext
 from ..utils.logger import get_logger
+from .supervisor import ProcessSupervisor, sanitize_name
 
 log = get_logger("telegraf")
 
@@ -42,22 +43,13 @@ _DEFAULT_CONF = """# DO NOT MODIFY: regenerated when the agent starts.
 _CHECK_INTERVAL_S = 30.0
 
 
-class TelegrafManager:
+class TelegrafManager(ProcessSupervisor):
     """Singleton per install dir (reference GetTelegrafManager)."""
 
-    _instances: Dict[str, "TelegrafManager"] = {}
-    _instances_lock = threading.Lock()
-
-    @classmethod
-    def get(cls, base_dir: str) -> "TelegrafManager":
-        with cls._instances_lock:
-            inst = cls._instances.get(base_dir)
-            if inst is None:
-                inst = cls._instances[base_dir] = TelegrafManager(base_dir)
-            return inst
+    check_interval_s = _CHECK_INTERVAL_S
 
     def __init__(self, base_dir: str) -> None:
-        self.base_dir = base_dir
+        super().__init__(base_dir)
         self.conf_dir = os.path.join(base_dir, "conf.d")
         self.log_path = os.path.join(base_dir, "telegraf.log")
         self.binary = (shutil.which("telegraf")
@@ -68,12 +60,7 @@ class TelegrafManager:
         self._configs: Dict[str, str] = {}
         self._dirty = False
         self._sinks: Dict[str, Any] = {}
-        self._lock = threading.Lock()
-        self._proc: Optional[subprocess.Popen] = None
-        self._thread: Optional[threading.Thread] = None
         self._log_thread: Optional[threading.Thread] = None
-        self._wake = threading.Event()
-        self._running = False
 
     # -- config registration -----------------------------------------------
 
@@ -86,8 +73,8 @@ class TelegrafManager:
                 self._sinks[name] = sink
             started = self._running
         if not started:
-            self._start_loop()
-        self._wake.set()
+            self.start_loop()
+        self.wake()
 
     def unregister(self, name: str) -> None:
         with self._lock:
@@ -96,9 +83,9 @@ class TelegrafManager:
             self._configs.pop(name, None)
             self._sinks.pop(name, None)
             empty = not self._configs
-        self._wake.set()
+        self.wake()
         if empty:
-            self._stop_loop()
+            self.stop_loop()
 
     # -- filesystem --------------------------------------------------------
 
@@ -111,8 +98,7 @@ class TelegrafManager:
             f.write(_DEFAULT_CONF.format(logfile=self.log_path))
         keep = set()
         for name, detail in configs.items():
-            safe = "".join(c if c.isalnum() or c in "-_." else "_"
-                           for c in name)
+            safe = sanitize_name(name)
             keep.add(safe + ".conf")
             path = os.path.join(self.conf_dir, safe + ".conf")
             tmp = path + ".tmp"
@@ -125,53 +111,36 @@ class TelegrafManager:
 
     # -- supervision -------------------------------------------------------
 
-    def _start_loop(self) -> None:
-        with self._lock:
-            if self._running:
-                return
-            self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="telegraf-manager")
-        self._thread.start()
+    def _on_start(self) -> None:
         self._log_thread = threading.Thread(target=self._tail_log,
                                             daemon=True,
                                             name="telegraf-logtail")
         self._log_thread.start()
 
-    def _stop_loop(self) -> None:
-        with self._lock:
-            self._running = False
-        self._wake.set()
-        for t in (self._thread, self._log_thread):
-            if t is not None:
-                t.join(timeout=3)
-        self._thread = self._log_thread = None
-        self._kill()
+    def _on_stop(self) -> None:
+        if self._log_thread is not None:
+            self._log_thread.join(timeout=3)
+            self._log_thread = None
 
-    def _run(self) -> None:
-        while True:
-            with self._lock:
-                if not self._running:
-                    return
-                have_cfg = bool(self._configs)
-                dirty, self._dirty = self._dirty, False
-            try:
-                self._render()
-            except OSError as e:
-                log.warning("telegraf conf render failed: %s", e)
-            if have_cfg and self.binary:
-                self._ensure_proc(reload=dirty)
-            elif not have_cfg:
-                self._kill()
-            elif self.binary is None:
-                log.warning("telegraf binary not found; configs rendered "
-                            "to %s but nothing supervises them",
-                            self.conf_dir)
-            self._wake.wait(timeout=_CHECK_INTERVAL_S)
-            self._wake.clear()
+    def _tick(self) -> None:
+        with self._lock:
+            have_cfg = bool(self._configs)
+            dirty, self._dirty = self._dirty, False
+        try:
+            self._render()
+        except OSError as e:
+            log.warning("telegraf conf render failed: %s", e)
+        if have_cfg and self.binary:
+            self._ensure_proc(reload=dirty)
+        elif not have_cfg:
+            self.kill_proc()
+        elif self.binary is None:
+            log.warning("telegraf binary not found; configs rendered "
+                        "to %s but nothing supervises them",
+                        self.conf_dir)
 
     def _ensure_proc(self, reload: bool = False) -> None:
-        if self._proc is not None and self._proc.poll() is None:
+        if self.proc_alive():
             if reload:       # config changed: telegraf reloads on SIGHUP
                 try:
                     self._proc.send_signal(signal.SIGHUP)
@@ -190,22 +159,15 @@ class TelegrafManager:
             log.warning("telegraf start failed: %s", e)
             self._proc = None
 
-    def _kill(self) -> None:
-        if self._proc is not None:
-            try:
-                self._proc.terminate()
-                self._proc.wait(timeout=5)
-            except (OSError, subprocess.TimeoutExpired):
-                try:
-                    self._proc.kill()
-                except OSError:
-                    pass
-            self._proc = None
-
     # -- telegraf's own log → events (reference LogCollector) ---------------
 
     def _tail_log(self) -> None:
-        pos = 0
+        # tail from the current END: pre-existing log content was either
+        # already shipped by a previous run or predates this agent
+        try:
+            pos = os.path.getsize(self.log_path)
+        except OSError:
+            pos = 0
         while True:
             with self._lock:
                 if not self._running:

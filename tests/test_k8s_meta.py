@@ -1,0 +1,173 @@
+"""service_kubernetes_meta entity/link collection against a fake
+apiserver (reference plugins/input/kubernetesmetav2 field contract)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from loongcollector_tpu.input.k8s_meta import ServiceK8sMeta
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+
+
+_OBJECTS = {
+    "/api/v1/pods": [
+        {"metadata": {"name": "web-abc", "namespace": "prod",
+                      "labels": {"app": "web"},
+                      "creationTimestamp": "2026-01-01T00:00:00Z",
+                      "ownerReferences": [
+                          {"kind": "ReplicaSet", "name": "web-rs"}]},
+         "spec": {"nodeName": "n1",
+                  "containers": [{"name": "app", "image": "web:1",
+                                  "resources": {"requests": {"cpu": "100m",
+                                                             "memory": "64Mi"},
+                                                "limits": {"cpu": "1"}}}],
+                  "volumes": [{"name": "data",
+                               "persistentVolumeClaim":
+                                   {"claimName": "data-pvc"}}]},
+         "status": {"phase": "Running", "podIP": "10.0.0.5"}},
+    ],
+    "/api/v1/nodes": [
+        {"metadata": {"name": "n1"},
+         "status": {"addresses": [{"type": "InternalIP",
+                                   "address": "192.168.1.10"}],
+                    "nodeInfo": {"osImage": "linux",
+                                 "kubeletVersion": "v1.29"}}},
+    ],
+    "/api/v1/services": [
+        {"metadata": {"name": "web-svc", "namespace": "prod"},
+         "spec": {"selector": {"app": "web"}, "clusterIP": "10.96.0.1",
+                  "type": "ClusterIP"}},
+    ],
+    "/apis/apps/v1/replicasets": [
+        {"metadata": {"name": "web-rs", "namespace": "prod",
+                      "ownerReferences": [{"kind": "Deployment",
+                                           "name": "web"}]},
+         "spec": {"replicas": 2}, "status": {"readyReplicas": 2}},
+    ],
+    "/apis/apps/v1/deployments": [
+        {"metadata": {"name": "web", "namespace": "prod"},
+         "spec": {"replicas": 2}, "status": {"readyReplicas": 2}},
+    ],
+}
+
+
+class _Api(http.server.BaseHTTPRequestHandler):
+    objects = {}
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        items = self.objects.get(path)
+        if items is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = json.dumps({"items": items}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def apiserver():
+    _Api.objects = dict(_OBJECTS)
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Api)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_port
+    srv.shutdown()
+
+
+def _mk(port, extra=None):
+    cfg = {"Pod": True, "Node": True, "Service": True, "ReplicaSet": True,
+           "Deployment": True, "Container": True, "Interval": 60,
+           "ClusterID": "c1", "EnableLabels": True,
+           "Endpoint": {"Scheme": "http", "Host": "127.0.0.1",
+                        "Port": port, "Token": "t"}}
+    cfg.update(extra or {})
+    inp = ServiceK8sMeta()
+    assert inp.init(cfg, PluginContext("t"))
+    return inp
+
+
+def _rows(group):
+    return [{k.to_str(): v.to_bytes().decode() for k, v in ev.contents}
+            for ev in group.events]
+
+
+class TestEntities:
+    def test_entity_fields_and_methods(self, apiserver):
+        inp = _mk(apiserver)
+        client = inp._client()
+        g = inp.collect_once(client)
+        rows = _rows(g)
+        pods = [r for r in rows if r.get("__entity_type__") == "k8s.pod"]
+        assert len(pods) == 1
+        pod = pods[0]
+        assert pod["__domain__"] == "k8s"
+        assert pod["__method__"] == "Add"
+        assert pod["__category__"] == "entity"
+        assert pod["__keep_alive_seconds__"] == "120"
+        assert pod["status"] == "Running"
+        assert pod["instance_ip"] == "10.0.0.5"
+        assert json.loads(pod["labels"]) == {"app": "web"}
+        assert pod["cluster_id"] == "c1"
+        # containers become entities too (Container: true)
+        cont = [r for r in rows
+                if r.get("__entity_type__") == "k8s.container"]
+        assert len(cont) == 1
+        assert cont[0]["image"] == "web:1"
+        assert cont[0]["cpu_request"] == "100m"
+        assert cont[0]["memory_request"] == "64Mi"
+        assert cont[0]["cpu_limit"] == "1"
+        # node custom fields
+        node = next(r for r in rows
+                    if r.get("__entity_type__") == "k8s.node")
+        assert node["internal_ip"] == "192.168.1.10"
+        assert node["kubelet_version"] == "v1.29"
+        # second collection: methods become Update
+        rows2 = _rows(inp.collect_once(client))
+        pod2 = next(r for r in rows2
+                    if r.get("__entity_type__") == "k8s.pod")
+        assert pod2["__method__"] == "Update"
+        assert pod2["__first_observed_time__"] == \
+            pod["__first_observed_time__"]
+
+    def test_delete_on_disappearance(self, apiserver):
+        inp = _mk(apiserver)
+        client = inp._client()
+        inp.collect_once(client)
+        _Api.objects = {k: ([] if k == "/api/v1/pods" else v)
+                        for k, v in _Api.objects.items()}
+        rows = _rows(inp.collect_once(client))
+        deleted = [r for r in rows if r.get("__method__") == "Delete"]
+        # the pod and its container entity disappear
+        kinds = {r["__entity_type__"] for r in deleted}
+        assert "k8s.pod" in kinds
+
+
+class TestLinks:
+    def test_structural_links(self, apiserver):
+        inp = _mk(apiserver, {
+            "Node2Pod": "runs", "ReplicaSet2Pod": "manages",
+            "Deployment2ReplicaSet": "manages", "Deployment2Pod": "controls",
+            "Service2Pod": "selects", "Pod2Container": "contains",
+            "Pod2PersistentVolumeClaim": "mounts",
+        })
+        client = inp._client()
+        rows = _rows(inp.collect_once(client))
+        links = [r for r in rows if r.get("__category__") == "entity_link"]
+        rels = {r["__relation_type__"] for r in links}
+        assert {"runs", "manages", "controls", "selects",
+                "contains", "mounts"} <= rels
+        sel = next(r for r in links if r["__relation_type__"] == "selects")
+        assert sel["__src_entity_type__"] == "k8s.service"
+        assert sel["__dest_entity_type__"] == "k8s.pod"
+        # entity ids are md5(cluster_id + kind + ns + name) — stable
+        import hashlib
+        assert sel["__dest_entity_id__"] == hashlib.md5(
+            b"c1Podprodweb-abc").hexdigest()

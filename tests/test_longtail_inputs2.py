@@ -1,0 +1,368 @@
+"""Round-3 long-tail inputs: command, http probe, nginx status, netping
+(tcping), mysql query (vs scripted wire server), docker events (vs fake
+engine socket), debug file."""
+
+import hashlib
+import http.server
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+
+
+class _PQM:
+    def __init__(self):
+        self.groups = []
+
+    def is_valid_to_push(self, key):
+        return True
+
+    def push_queue(self, key, group):
+        self.groups.append(group)
+        return True
+
+
+def _mk_input(name, config):
+    reg = PluginRegistry.instance()
+    reg.load_static_plugins()
+    inp = reg.create_input(name)
+    assert inp is not None, name
+    ctx = PluginContext("t")
+    ctx.process_queue_key = 1
+    ctx.process_queue_manager = _PQM()
+    assert inp.init(config, ctx), (name, config)
+    return inp, ctx.process_queue_manager
+
+
+def _rows(pqm):
+    out = []
+    for g in pqm.groups:
+        for ev in g.events:
+            out.append({k.to_str(): v.to_bytes().decode()
+                        for k, v in ev.contents})
+    return out
+
+
+class TestCommand:
+    def test_exec_and_split(self, tmp_path):
+        import tempfile
+        conf = tempfile.mkdtemp(prefix="loong-cmd-")
+        os.chmod(conf, 0o755)          # `nobody` must reach the script
+        os.environ["LOONG_CONF_DIR"] = conf
+        try:
+            inp, pqm = _mk_input("input_command", {
+                "ScriptType": "shell",
+                "User": "nobody",
+                "ScriptContent": "echo alpha; echo beta",
+                "LineSplitSep": "\n",
+                "IntervalMs": 60000,
+            })
+            inp.poll_once()
+        finally:
+            del os.environ["LOONG_CONF_DIR"]
+        rows = _rows(pqm)
+        contents = [r["content"] for r in rows if r.get("content")]
+        assert "alpha" in contents and "beta" in contents
+        md5 = hashlib.md5(b"echo alpha; echo beta").hexdigest()
+        assert rows[0]["script_md5"] == md5
+
+    def test_base64_and_root_refused(self, tmp_path):
+        import tempfile
+        conf = tempfile.mkdtemp(prefix="loong-cmd-")
+        os.chmod(conf, 0o755)
+        os.environ["LOONG_CONF_DIR"] = conf
+        try:
+            import base64
+            inp, pqm = _mk_input("input_command", {
+                "ScriptType": "shell", "User": "nobody",
+                "ContentEncoding": "Base64",
+                "ScriptContent": base64.b64encode(b"echo b64ok").decode(),
+                "IntervalMs": 60000,
+            })
+            inp.poll_once()
+            assert any("b64ok" in r.get("content", "") for r in _rows(pqm))
+            reg = PluginRegistry.instance()
+            bad = reg.create_input("input_command")
+            assert not bad.init({"ScriptType": "shell", "User": "root",
+                                 "ScriptContent": "id"}, PluginContext("t"))
+        finally:
+            del os.environ["LOONG_CONF_DIR"]
+
+
+class _StatusHandler(http.server.BaseHTTPRequestHandler):
+    body = (b"Active connections: 291 \n"
+            b"server accepts handled requests\n"
+            b" 16630948 16630948 31070465 \n"
+            b"Reading: 6 Writing: 179 Waiting: 106 \n")
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.body)))
+        self.end_headers()
+        self.wfile.write(self.body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def status_server():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _StatusHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_port
+    srv.shutdown()
+
+
+class TestProbes:
+    def test_nginx_status(self, status_server):
+        inp, pqm = _mk_input("metric_nginx_status", {
+            "Urls": [f"http://127.0.0.1:{status_server}/nginx_status"]})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["active"] == "291"
+        assert row["accepts"] == "16630948"
+        assert row["requests"] == "31070465"
+        assert row["writing"] == "179"
+        assert row["server"] == "127.0.0.1"
+
+    def test_http_probe_match(self, status_server):
+        inp, pqm = _mk_input("metric_http", {
+            "Addresses": [f"http://127.0.0.1:{status_server}/"],
+            "ResponseStringMatch": r"Active connections: \d+",
+            "IncludeBody": True})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["_result_"] == "success"
+        assert row["_http_response_code_"] == "200"
+        assert row["_result_match_"] == "yes"
+        assert float(row["_response_time_ms_"]) > 0
+
+    def test_http_probe_down(self):
+        inp, pqm = _mk_input("metric_http", {
+            "Addresses": ["http://127.0.0.1:1/"],
+            "ResponseTimeoutMs": 500})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["_result_"] in ("failed", "timeout")
+
+    def test_tcping(self, status_server):
+        inp, pqm = _mk_input("metric_input_netping", {
+            "TimeoutSeconds": 2,
+            "TCPConfigs": [{"target": "127.0.0.1",
+                            "port": status_server, "count": 3}]})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["type"] == "tcping"
+        assert row["success"] == "3"
+        assert float(row["avg_rtt_ms"]) >= 0
+
+    def test_httping(self, status_server):
+        inp, pqm = _mk_input("metric_input_netping", {
+            "TimeoutSeconds": 2,
+            "HTTPConfigs": [{"target":
+                             f"http://127.0.0.1:{status_server}/",
+                             "expect_response_contains": "Active"}]})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["type"] == "httping"
+        assert row["success"] == "1"
+        assert row["http_response_code"] == "200"
+
+
+def _lenc(b: bytes) -> bytes:
+    return bytes([len(b)]) + b
+
+
+def _packet(seq, payload):
+    return struct.pack("<I", len(payload))[:3] + bytes([seq]) + payload
+
+
+class _FakeMySQL(threading.Thread):
+    """Scripted MySQL server: handshake, auth-OK, then one text result
+    set per COM_QUERY."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.queries = []
+
+    def run(self):
+        conn, _ = self.sock.accept()
+        # HandshakeV10: proto, version, thread id, salt1(8)+0, caps, ...
+        greeting = (b"\x0a" + b"8.0.0\x00" + struct.pack("<I", 1)
+                    + b"12345678\x00"
+                    + struct.pack("<H", 0x0200)      # caps low (proto41)
+                    + b"\x21" + struct.pack("<H", 0)
+                    + struct.pack("<H", 0x0200)      # caps high
+                    + b"\x15" + b"\x00" * 10
+                    + b"901234567890\x00")
+        conn.sendall(_packet(0, greeting))
+        self._read_packet(conn)                       # auth response
+        conn.sendall(_packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))  # OK
+        try:
+            while True:
+                payload = self._read_packet(conn)
+                if payload is None or payload[0] != 0x03:
+                    break
+                self.queries.append(payload[1:].decode())
+                self._send_result(conn)
+        except OSError:
+            pass
+        conn.close()
+
+    @staticmethod
+    def _read_packet(conn):
+        hdr = b""
+        while len(hdr) < 4:
+            c = conn.recv(4 - len(hdr))
+            if not c:
+                return None
+            hdr += c
+        n = int.from_bytes(hdr[:3], "little")
+        data = b""
+        while len(data) < n:
+            c = conn.recv(n - len(data))
+            if not c:
+                return None
+            data += c
+        return data
+
+    def _send_result(self, conn):
+        rows = [(b"1", b"alice"), (b"2", b"bob")]
+        seq = 1
+        conn.sendall(_packet(seq, b"\x02"))           # 2 columns
+        for name in (b"id", b"name"):
+            seq += 1
+            cdef = (_lenc(b"def") + _lenc(b"") + _lenc(b"t") + _lenc(b"t")
+                    + _lenc(name) + _lenc(name)
+                    + b"\x0c" + struct.pack("<H", 33)
+                    + struct.pack("<I", 255) + b"\xfd"
+                    + struct.pack("<H", 0) + b"\x00" + b"\x00\x00")
+            conn.sendall(_packet(seq, cdef))
+        seq += 1
+        conn.sendall(_packet(seq, b"\xfe\x00\x00\x02\x00"))   # EOF
+        for row in rows:
+            seq += 1
+            conn.sendall(_packet(seq, b"".join(_lenc(v) for v in row)))
+        seq += 1
+        conn.sendall(_packet(seq, b"\xfe\x00\x00\x02\x00"))   # EOF
+
+
+class TestMysqlQuery:
+    def test_query_and_checkpoint(self):
+        srv = _FakeMySQL()
+        srv.start()
+        inp, pqm = _mk_input("service_mysql", {
+            "Address": f"127.0.0.1:{srv.port}",
+            "User": "u", "Password": "p",
+            "StateMent": "select id, name from users where id > ?",
+            "CheckPoint": True, "CheckPointColumn": "id",
+            "CheckPointStart": "0",
+        })
+        inp.poll_once()
+        rows = _rows(pqm)
+        assert {r["name"] for r in rows} == {"alice", "bob"}
+        assert inp.cp_value == "2"                 # advanced to last row
+        assert "id > 0" in srv.queries[-1]
+        inp.stop()
+
+
+class TestDockerEvents:
+    def test_event_stream(self, tmp_path):
+        sock_path = str(tmp_path / "docker.sock")
+        ready = threading.Event()
+
+        def serve():
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(sock_path)
+            srv.listen(1)
+            ready.set()
+            conn, _ = srv.accept()
+            conn.recv(65536)                       # request headers
+            ev = json.dumps({"Type": "container", "Action": "start",
+                             "timeNano": 123,
+                             "Actor": {"ID": "abc",
+                                       "Attributes": {"name": "web"}}})
+            body = ev + "\n"
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
+                         b"\r\n\r\n" + body.encode())
+            time.sleep(1.0)
+            conn.close()
+            srv.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        ready.wait(2)
+        inp, pqm = _mk_input("service_docker_event",
+                             {"SocketPath": sock_path})
+        assert inp.start()
+        deadline = time.time() + 5
+        while not pqm.groups and time.time() < deadline:
+            time.sleep(0.05)
+        inp.stop()
+        assert pqm.groups
+        (row,) = _rows(pqm)
+        assert row["_action_"] == "start"
+        assert row["_type_"] == "container"
+        assert row["_id_"] == "abc"
+        assert row["name"] == "web"
+
+
+class TestDebugFile:
+    def test_reads_limited_lines(self, tmp_path):
+        p = tmp_path / "in.txt"
+        p.write_text("l1\nl2\nl3\n")
+        inp, pqm = _mk_input("metric_debug_file", {
+            "InputFilePath": str(p), "LineLimit": 2,
+            "FieldName": "content"})
+        inp.poll_once()
+        (row,) = _rows(pqm)
+        assert row["content"] == "l1\nl2"
+
+
+class TestTelemetryAggregators:
+    def _mixed_group(self):
+        from loongcollector_tpu.models import PipelineEventGroup
+        g = PipelineEventGroup()
+        sb = g.source_buffer
+        lg = g.add_log_event(1)
+        lg.set_content(b"content", sb.copy_string(b"a log line"))
+        m = g.add_metric_event(1)
+        m.set_name(sb.copy_string(b"cpu"))
+        m.set_value(1.5)
+        sp = g.add_span_event(1)
+        sp.name = b"GET /api"
+        return g
+
+    def test_otel_routing(self):
+        reg = PluginRegistry.instance()
+        reg.load_static_plugins()
+        agg = reg.create_aggregator("aggregator_opentelemetry")
+        assert agg.init({}, PluginContext("t"))
+        agg.add(self._mixed_group())
+        groups = agg.flush()
+        stores = {bytes(g.get_tag(b"__logstore__")): len(g.events)
+                  for g in groups}
+        assert stores == {b"otlp-logs": 1, b"otlp-metrics": 1,
+                          b"otlp-traces": 1}
+
+    def test_skywalking_defaults(self):
+        reg = PluginRegistry.instance()
+        agg = reg.create_aggregator("aggregator_skywalking")
+        assert agg.init({"Topic": "sw"}, PluginContext("t"))
+        agg.add(self._mixed_group())
+        groups = agg.flush()
+        stores = {bytes(g.get_tag(b"__logstore__")) for g in groups}
+        assert stores == {b"skywalking-logs", b"skywalking-metrics",
+                          b"skywalking-traces"}
+        assert all(bytes(g.get_tag(b"__topic__")) == b"sw" for g in groups)
