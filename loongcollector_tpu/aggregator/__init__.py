@@ -21,3 +21,4 @@ def register_all(registry) -> None:
                                  AggregatorTelemetryRouter)
     registry.register_aggregator("aggregator_skywalking",
                                  AggregatorSkywalking)
+    registry.register_aggregator("aggregator_default", AggregatorBase)

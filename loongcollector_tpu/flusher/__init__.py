@@ -31,3 +31,9 @@ def register_all(registry) -> None:
     registry.register_flusher("flusher_doris", FlusherDoris)
     registry.register_flusher("flusher_pulsar", FlusherPulsar)
     registry.register_flusher("flusher_grpc", FlusherGrpc)
+    from .testing import (FlusherChecker, FlusherSleep,
+                          FlusherStatistics)
+    registry.register_flusher("flusher_checker", FlusherChecker)
+    registry.register_flusher("flusher_sleep", FlusherSleep)
+    registry.register_flusher("flusher_statistics",
+                              FlusherStatistics)

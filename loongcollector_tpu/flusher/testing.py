@@ -1,0 +1,137 @@
+"""Test-support flushers: checker, sleep, statistics.
+
+Reference: plugins/flusher/{checker,sleep,statistics}/ — the sinks the
+reference's e2e test rigs assert against (checker records everything for
+key/value assertions, sleep injects sink latency for back-pressure tests,
+statistics prints group/event/byte rates).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..models import PipelineEventGroup
+from ..pipeline.plugin.interface import Flusher, PluginContext
+from ..utils.logger import get_logger
+
+log = get_logger("flusher_testing")
+
+
+class FlusherChecker(Flusher):
+    """flusher_checker: retains every received group; test helpers assert
+    on counts and key/value pairs (flusher_checker.go:30-78)."""
+
+    name = "flusher_checker"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.groups: List[PipelineEventGroup] = []
+        self._lock = threading.Lock()
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        with self._lock:
+            self.groups.append(group)
+        return True
+
+    # -- assertion helpers (reference GetLogCount/CheckKeyValue*) ----------
+
+    def get_log_count(self) -> int:
+        with self._lock:
+            return sum(len(g) for g in self.groups)
+
+    def check_key_value(self, key: str, value: str) -> Optional[str]:
+        """None when some event carries key=value; else the mismatch
+        (first differing value seen, or key-not-found)."""
+        kb = key.encode()
+        mismatch: Optional[str] = None
+        with self._lock:
+            for g in self.groups:
+                for ev in g.events:
+                    contents = getattr(ev, "contents", None)
+                    if not contents:
+                        continue
+                    for k, v in contents:
+                        if bytes(k) == kb:
+                            if v.to_bytes() == value.encode():
+                                return None
+                            if mismatch is None:
+                                mismatch = (
+                                    f"key: {key}, expect: {value}, "
+                                    f"real: {v.to_bytes().decode()}")
+        return mismatch or f"cannot find this key: {key}"
+
+    def check_key_value_any(self, key: str, regex: str) -> bool:
+        rx = re.compile(regex.encode())
+        kb = key.encode()
+        with self._lock:
+            for g in self.groups:
+                for ev in g.events:
+                    for k, v in getattr(ev, "contents", []) or []:
+                        if bytes(k) == kb and rx.search(v.to_bytes()):
+                            return True
+        return False
+
+
+class FlusherSleep(Flusher):
+    """flusher_sleep: stalls SleepMS per group — back-pressure and sink
+    starvation scenarios (flusher_sleep.go)."""
+
+    name = "flusher_sleep"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.sleep_s = int(config.get("SleepMS", 0)) / 1000.0
+        return True
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return True
+
+
+class FlusherStatistics(Flusher):
+    """flusher_statistics: rolling group/event/byte rates printed each
+    RateIntervalMs (flusher_statistics.go); GeneratePB also serializes to
+    measure the wire path."""
+
+    name = "flusher_statistics"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.groups = 0
+        self.events = 0
+        self.bytes = 0
+        self._window_start = time.monotonic()
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.rate_interval_s = int(config.get("RateIntervalMs", 1000)) / 1000.0
+        self.generate_pb = bool(config.get("GeneratePB", False))
+        self.sleep_s = int(config.get("SleepMsPerLogGroup", 0)) / 1000.0
+        if self.generate_pb:
+            from ..pipeline.serializer.sls_serializer import \
+                SLSEventGroupSerializer
+            self._serializer = SLSEventGroupSerializer()
+        return True
+
+    def send(self, group: PipelineEventGroup) -> bool:
+        self.groups += 1
+        self.events += len(group)
+        if self.generate_pb:
+            self.bytes += len(self._serializer.serialize([group]))
+        else:
+            self.bytes += group.data_size()
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        now = time.monotonic()
+        if now - self._window_start >= self.rate_interval_s:
+            dt = now - self._window_start
+            log.info("statistics: %.1f groups/s %.1f events/s %.1f KB/s",
+                     self.groups / dt, self.events / dt,
+                     self.bytes / 1024.0 / dt)
+            self.groups = self.events = self.bytes = 0
+            self._window_start = now
+        return True

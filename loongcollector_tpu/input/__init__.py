@@ -65,12 +65,14 @@ def register_all(registry) -> None:
     from .docker_event import InputDebugFile, ServiceDockerEvent
     from .k8s_meta import ServiceK8sMeta
     from .mysql_query import InputMysql
+    from .pgsql_query import InputPgsql
     from .probes import InputHTTPResponse, InputNetPing, InputNginxStatus
     registry.register_input("input_command", InputCommand)
     registry.register_input("metric_http", InputHTTPResponse)
     registry.register_input("metric_nginx_status", InputNginxStatus)
     registry.register_input("metric_input_netping", InputNetPing)
     registry.register_input("service_mysql", InputMysql)
+    registry.register_input("service_pgsql", InputPgsql)
     registry.register_input("service_docker_event", ServiceDockerEvent)
     registry.register_input("metric_debug_file", InputDebugFile)
     registry.register_input("service_kubernetes_meta", ServiceK8sMeta)

@@ -2,8 +2,8 @@
 
 Reference: plugins/input/mysql/mysql.go (DSN + StateMent with optional
 ``?`` checkpoint placeholder, CheckPointColumn int/time, PageSize
-pagination via LIMIT, MaxSyncSize) and plugins/input/rdb/rdb.go (the
-shared rdb collection shape that pgsql/mssql reuse).
+pagination via LIMIT, MaxSyncSize) over the shared rdb shape
+(plugins/input/rdb/rdb.go → rdb_base.RdbPollingInput here).
 
 The wire client is the repo's own MySQL protocol implementation
 (binlog_protocol.py: handshake + mysql_native_password + COM_QUERY text
@@ -13,16 +13,10 @@ result sets) — no external driver.
 from __future__ import annotations
 
 import socket
-import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
-from ..models import PipelineEventGroup
-from ..pipeline.plugin.interface import PluginContext
-from ..utils.logger import get_logger
 from . import binlog_protocol as bp
-from .polling_base import PollingInput
-
-log = get_logger("mysql_query")
+from .rdb_base import RdbPollingInput
 
 
 class MySQLQueryClient:
@@ -52,7 +46,8 @@ class MySQLQueryClient:
         if self.database:
             self.query(f"USE `{self.database}`")
 
-    def query(self, sql: str) -> Tuple[List[bytes], List[List[Optional[bytes]]]]:
+    def query(self, sql: str) -> Tuple[List[bytes],
+                                       List[List[Optional[bytes]]]]:
         if self._sock is None:
             self.connect()
         bp.write_packet(self._sock, 0, bytes([bp.COM_QUERY]) + sql.encode())
@@ -67,118 +62,22 @@ class MySQLQueryClient:
             self._sock = None
 
 
-class InputMysql(PollingInput):
+class InputMysql(RdbPollingInput):
     """service_mysql: StateMent may contain one ``?`` placeholder replaced
-    by the checkpoint value; with Limit=true, ``LIMIT PageSize`` pages are
-    fetched until a short page or MaxSyncSize rows."""
+    by the (quoted) checkpoint value; with Limit=true, LIMIT pages are
+    fetched until a short page, MaxSyncSize, or a stuck checkpoint."""
 
     name = "service_mysql"
+    placeholder = "?"
+    default_port = 3306
+    source_tag = b"mysql"
+    limit_clause = "LIMIT {offset}, {page_size}"
 
-    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
-        super().init(config, context)
-        addr = str(config.get("Address", "127.0.0.1:3306"))
-        host, _, port = addr.rpartition(":")
-        self.host = host or "127.0.0.1"
-        self.port = int(port) if port.isdigit() else 3306
-        self.user = str(config.get("User", "root"))
-        self.password = str(config.get("Password", ""))
-        self.database = str(config.get("DataBase", ""))
-        self.statement = str(config.get("StateMent", ""))
-        sp = config.get("StateMentPath")
-        if not self.statement and sp:
-            try:
-                with open(str(sp), encoding="utf-8") as f:
-                    self.statement = f.read().strip()
-            except OSError as e:
-                log.error("service_mysql: StateMentPath unreadable: %s", e)
-                return False
-        if not self.statement:
-            log.error("service_mysql: StateMent is required")
-            return False
-        self.use_checkpoint = bool(config.get("CheckPoint", False))
-        self.cp_column = str(config.get("CheckPointColumn", ""))
-        self.cp_type = str(config.get("CheckPointColumnType", "int"))
-        self.cp_value = str(config.get("CheckPointStart", "0"))
-        self.limit = bool(config.get("Limit", False))
-        self.page_size = int(config.get("PageSize", 100))
-        self.max_sync_size = int(config.get("MaxSyncSize", 0))
-        self.interval = int(config.get("IntervalMs", 60000)) / 1000.0
-        self.connect_timeout = int(config.get("DialTimeOutMs", 5000)) / 1000.0
-        self.read_timeout = int(config.get("ReadTimeOutMs", 30000)) / 1000.0
-        self._client: Optional[MySQLQueryClient] = None
-        if self.use_checkpoint and not self.cp_column:
-            log.error("service_mysql: CheckPoint requires CheckPointColumn")
-            return False
-        return True
+    def _make_client(self) -> MySQLQueryClient:
+        return MySQLQueryClient(self.host, self.port, self.user,
+                                self.password, self.database,
+                                self.connect_timeout, self.read_timeout)
 
-    # client injection point for tests
-    def _get_client(self) -> MySQLQueryClient:
-        if self._client is None:
-            self._client = MySQLQueryClient(
-                self.host, self.port, self.user, self.password,
-                self.database, self.connect_timeout, self.read_timeout)
-        return self._client
-
-    def _build_sql(self, page: int) -> str:
-        sql = self.statement
-        cp_paged = self.use_checkpoint and "?" in sql
-        if cp_paged:
-            val = self.cp_value
-            if self.cp_type == "time":
-                val = f"'{val}'"
-            sql = sql.replace("?", val, 1)
-        if self.limit and "limit" not in sql.lower():
-            # when the checkpoint placeholder drives pagination, each page's
-            # WHERE clause already advances past collected rows — adding a
-            # row offset on top would skip PageSize rows per page
-            offset = 0 if cp_paged else page * self.page_size
-            sql = f"{sql} LIMIT {offset}, {self.page_size}"
-        return sql
-
-    def poll_once(self) -> None:
-        client = self._get_client()
-        rows_total = 0
-        page = 0
-        group = PipelineEventGroup()
-        sb = group.source_buffer
-        now = int(time.time())
-        try:
-            while True:
-                names, rows = client.query(self._build_sql(page))
-                cp_idx = -1
-                if self.use_checkpoint and self.cp_column:
-                    try:
-                        cp_idx = names.index(self.cp_column.encode())
-                    except ValueError:
-                        cp_idx = -1
-                for row in rows:
-                    ev = group.add_log_event(now)
-                    for name, val in zip(names, row):
-                        ev.set_content(sb.copy_string(name),
-                                       sb.copy_string(val or b"null"))
-                    if cp_idx >= 0 and row[cp_idx] is not None:
-                        self.cp_value = row[cp_idx].decode("utf-8", "replace")
-                rows_total += len(rows)
-                page += 1
-                if not self.limit or len(rows) < self.page_size:
-                    break
-                if self.max_sync_size and rows_total >= self.max_sync_size:
-                    break
-        except (bp.MySQLError, OSError) as e:
-            log.warning("service_mysql poll failed: %s", e)
-            if self._client is not None:
-                self._client.close()
-                self._client = None
-            if not len(group):
-                return
-        group.set_tag(b"__source__", b"mysql")
-        pqm = self.context.process_queue_manager
-        if pqm is not None and len(group):
-            pqm.push_queue(self.context.process_queue_key, group)
-
-    def stop(self, is_pipeline_removing: bool = False) -> bool:
-        out = super().stop(is_pipeline_removing)
-        if self._client is not None:
-            self._client.close()
-            self._client = None
-        return out
+    @property
+    def client_errors(self) -> Tuple[type, ...]:
+        return (bp.MySQLError, OSError)

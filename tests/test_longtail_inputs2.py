@@ -366,3 +366,99 @@ class TestTelemetryAggregators:
         assert stores == {b"skywalking-logs", b"skywalking-metrics",
                           b"skywalking-traces"}
         assert all(bytes(g.get_tag(b"__topic__")) == b"sw" for g in groups)
+
+
+class _FakePgsql(threading.Thread):
+    """Scripted Postgres v3 server: md5 auth + one result per Query."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.queries = []
+
+    @staticmethod
+    def _m(tag, payload):
+        return tag + struct.pack("!I", len(payload) + 4) + payload
+
+    def run(self):
+        conn, _ = self.sock.accept()
+        # startup message
+        hdr = conn.recv(4)
+        n = struct.unpack("!I", hdr)[0] - 4
+        conn.recv(n)
+        conn.sendall(self._m(b"R", struct.pack("!I", 5) + b"salt"))  # md5
+        conn.recv(65536)                        # password message
+        conn.sendall(self._m(b"R", struct.pack("!I", 0)))            # ok
+        conn.sendall(self._m(b"Z", b"I"))
+        try:
+            while True:
+                tag = conn.recv(1)
+                if tag != b"Q":
+                    break
+                n = struct.unpack("!I", conn.recv(4))[0] - 4
+                sql = conn.recv(n).rstrip(b"\x00").decode()
+                self.queries.append(sql)
+                fields = b"".join(
+                    name + b"\x00" + b"\x00" * 18
+                    for name in (b"id", b"city"))
+                conn.sendall(self._m(b"T", struct.pack("!H", 2) + fields))
+                for row in ((b"7", b"rome"), (b"9", b"oslo")):
+                    body = struct.pack("!H", 2)
+                    for v in row:
+                        body += struct.pack("!i", len(v)) + v
+                    conn.sendall(self._m(b"D", body))
+                conn.sendall(self._m(b"C", b"SELECT 2\x00"))
+                conn.sendall(self._m(b"Z", b"I"))
+        except OSError:
+            pass
+        conn.close()
+
+
+class TestPgsqlQuery:
+    def test_md5_auth_query_checkpoint(self):
+        srv = _FakePgsql()
+        srv.start()
+        inp, pqm = _mk_input("service_pgsql", {
+            "Address": "127.0.0.1", "Port": srv.port,
+            "User": "u", "Password": "p", "DataBase": "db",
+            "StateMent": "select id, city from t where id > $1",
+            "CheckPoint": True, "CheckPointColumn": "id",
+        })
+        inp.poll_once()
+        rows = _rows(pqm)
+        assert {r["city"] for r in rows} == {"rome", "oslo"}
+        assert inp.cp_value == "9"
+        assert "id > 0" in srv.queries[-1]
+        inp.stop()
+
+
+class TestRdbBase:
+    def test_checkpoint_quoting_and_limit_word_boundary(self):
+        from loongcollector_tpu.input.mysql_query import InputMysql
+        inp = InputMysql()
+        assert inp.init({
+            "StateMent": "select rate_limit, id from t where id > ?",
+            "CheckPoint": True, "CheckPointColumn": "id",
+            "CheckPointColumnType": "time", "Limit": True, "PageSize": 5,
+        }, PluginContext("t"))
+        inp.cp_value = "x'; drop table t; --"
+        sql, paged = inp._build_sql(0)
+        # quote-escaped, not raw-spliced
+        assert "drop table" not in sql or "''" in sql
+        assert "x''; drop table t; --" in sql
+        # `rate_limit` is a column, not a LIMIT clause: page gets appended
+        assert paged and sql.rstrip().endswith("LIMIT 0, 5")
+
+    def test_int_checkpoint_rejects_non_numeric(self):
+        from loongcollector_tpu.input.mysql_query import InputMysql
+        inp = InputMysql()
+        assert inp.init({
+            "StateMent": "select id from t where id > ?",
+            "CheckPoint": True, "CheckPointColumn": "id",
+        }, PluginContext("t"))
+        inp.cp_value = "1; delete from t"
+        sql, _ = inp._build_sql(0)
+        assert "delete" not in sql
