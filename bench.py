@@ -251,7 +251,7 @@ def bench_latency(n_iters=200, batch=256):
     return samples[len(samples) // 2], samples[int(len(samples) * 0.99)]
 
 
-def bench_pipeline_e2e(n_lines=60000):
+def bench_pipeline_e2e(n_lines=600000):
     """Full-pipeline throughput: raw chunks → split → device regex parse →
     route → serialize (blackhole), through the real queue/runner machinery —
     the analogue of the reference's file_to_blackhole regression scenario."""
@@ -313,7 +313,7 @@ def bench_pipeline_e2e(n_lines=60000):
     want_events = 4096 * (pushed_bytes // len(chunk)) + 4096
     deadline = time.monotonic() + 120
     while bh.total_events < want_events and time.monotonic() < deadline:
-        time.sleep(0.005)
+        time.sleep(0.001)
     dt = time.perf_counter() - t0
     # the throughput drain must be complete BEFORE the sojourn pushes add
     # events, or an incomplete drain slips past the guard and corrupts the

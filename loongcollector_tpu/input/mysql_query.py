@@ -73,6 +73,10 @@ class InputMysql(RdbPollingInput):
     source_tag = b"mysql"
     limit_clause = "LIMIT {offset}, {page_size}"
 
+    def _escape_string(self, val: str) -> str:
+        # MySQL's default sql_mode treats backslash as an escape character
+        return val.replace("\\", "\\\\").replace("'", "''")
+
     def _make_client(self) -> MySQLQueryClient:
         return MySQLQueryClient(self.host, self.port, self.user,
                                 self.password, self.database,

@@ -103,13 +103,18 @@ class RdbPollingInput(PollingInput):
             self._client = self._make_client()
         return self._client
 
+    def _escape_string(self, val: str) -> str:
+        """Dialect hook: standard SQL doubles single quotes; dialects with
+        backslash escapes (MySQL default sql_mode) override."""
+        return val.replace("'", "''")
+
     def _quote_cp(self) -> str:
         """The checkpoint value is data read back from the database —
         never splice it raw (quote breakage at best, SQL injection via a
         monitored table at worst)."""
         val = self.cp_value
         if self.cp_type == "time":
-            return "'" + val.replace("'", "''").replace("\\", "\\\\") + "'"
+            return "'" + self._escape_string(val) + "'"
         # int checkpoints must BE ints
         try:
             return str(int(val))
@@ -119,10 +124,16 @@ class RdbPollingInput(PollingInput):
             except ValueError:
                 return "0"
 
+    @property
+    def _cp_paged(self) -> bool:
+        """True when the checkpoint placeholder drives pagination (the
+        same SQL text CAN repeat across pages)."""
+        return self.use_checkpoint and self.placeholder in self.statement
+
     def _build_sql(self, page: int) -> Tuple[str, bool]:
         """→ (sql, paged): paged=False means one iteration only."""
         sql = self.statement
-        cp_paged = self.use_checkpoint and self.placeholder in sql
+        cp_paged = self._cp_paged
         if cp_paged:
             sql = sql.replace(self.placeholder, self._quote_cp(), 1)
         # word-boundary check: a column named `rate_limit` is not a LIMIT
@@ -139,6 +150,7 @@ class RdbPollingInput(PollingInput):
         client = self._get_client()
         rows_total = 0
         page = 0
+        cp_paged = self._cp_paged
         last_cp = self.cp_value
         group = PipelineEventGroup()
         sb = group.source_buffer
@@ -169,11 +181,14 @@ class RdbPollingInput(PollingInput):
                     break
                 if self.max_sync_size and rows_total >= self.max_sync_size:
                     break
-                if cp_idx >= 0 and self.cp_value == last_cp:
-                    # checkpoint did not advance (NULL column values):
-                    # repeating the query would loop on the same rows
-                    break
-                last_cp = self.cp_value
+                if cp_paged:
+                    # placeholder-paged: the next page reruns the SAME sql
+                    # unless the checkpoint advanced — a missing checkpoint
+                    # column (cp_idx<0, e.g. aliased away) or NULL values
+                    # would loop on identical rows forever
+                    if cp_idx < 0 or self.cp_value == last_cp:
+                        break
+                    last_cp = self.cp_value
         except self.client_errors as e:  # noqa: B030 — dialect tuple
             log.warning("%s poll failed: %s", self.name, e)
             if self._client is not None:

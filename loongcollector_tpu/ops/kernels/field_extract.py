@@ -178,9 +178,16 @@ def build_extract_core(program: SegmentProgram):
         B, L = rows.shape
         i32 = jnp.int32
         # 2D iota: required inside Pallas/Mosaic, equivalent under XLA
-        pos = jax.lax.broadcasted_iota(i32, (B, L), 1)
-        valid = pos < lens
         L32 = jnp.int32(L)
+        # iota along lanes is row-constant, so Mosaic gives it a
+        # sublane-REPLICATED layout; selects like `where(mask, pos, _)`
+        # then try to relayout the i1 mask normal→replicated, which the
+        # TPU backend rejects ("replicated in destination but not in
+        # source").  Adding a data-dependent [B,1] zero column
+        # de-replicates pos at the root; XLA folds the add elsewhere.
+        pos = (jax.lax.broadcasted_iota(i32, (B, L), 1)
+               + jnp.minimum(lens, 0))
+        valid = pos < lens
 
         member: Dict[int, jnp.ndarray] = {}
         for cid in sorted(span_classes | count_classes):
