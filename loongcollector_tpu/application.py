@@ -114,6 +114,10 @@ class Application:
         os.makedirs(self.data_dir, exist_ok=True)
         check_previous_crash(self.data_dir)
         init_crash_backtrace(self.data_dir)
+        from .pipeline.plugin.checkpoint import (PluginCheckpointStore,
+                                                 set_default_store)
+        set_default_store(PluginCheckpointStore(
+            os.path.join(self.data_dir, "plugin_checkpoints.json")))
         self.onetime_manager = OnetimeConfigInfoManager(
             os.path.join(self.data_dir, "onetime_state.json"))
         self.onetime_manager.load()
@@ -222,6 +226,8 @@ class Application:
                 self.sender_queue_manager.gc_marked()
                 WriteMetrics.instance().gc_deleted()
                 self.disk_buffer.replay(self._resolve_buffered_flusher)
+                from .pipeline.plugin.checkpoint import get_default_store
+                get_default_store().flush()
                 self.pipeline_manager.check_onetime_completion(
                     self.process_queue_manager, self.sender_queue_manager)
                 if self._eo_pending:
@@ -253,6 +259,8 @@ class Application:
         self.flusher_runner.stop(
             drain=True, timeout=flags.get_flag("exit_flush_timeout"))
         self.http_sink.stop()
+        from .pipeline.plugin.checkpoint import get_default_store
+        get_default_store().flush()
         log.info("exit complete")
 
     def _replay_exactly_once(self) -> None:

@@ -90,36 +90,34 @@ class InputCommand(PollingInput):
             os.chmod(self.script_path, 0o755)
         return True
 
-    def _demote(self):
-        """setuid closure for the configured non-root user (only possible
-        when the agent itself runs privileged; otherwise run as-is)."""
+    def _demote_ids(self):
+        """(uid, gid) to run the script as, or (None, None) to run as-is.
+        Passed via subprocess's user=/group= — NOT a preexec_fn closure,
+        which can deadlock the forked child in a multi-threaded agent."""
         if not self.user:
-            return None
+            return None, None
         try:
             import pwd
             rec = pwd.getpwnam(self.user)
         except (ImportError, KeyError):
             log.warning("input_command: user %r not found; running as self",
                         self.user)
-            return None
+            return None, None
         if os.geteuid() != 0:
-            return None
-
-        def demote():
-            os.setgid(rec.pw_gid)
-            os.setuid(rec.pw_uid)
-        return demote
+            return None, None
+        return rec.pw_uid, rec.pw_gid
 
     def poll_once(self) -> None:
         env = dict(os.environ)
         for e in self.environments:
             k, _, v = e.partition("=")
             env[k] = v
+        uid, gid = self._demote_ids()
         try:
             proc = subprocess.run(
                 [self.cmd_path, self.script_path], capture_output=True,
                 timeout=self.timeout_s, env=env, text=True,
-                preexec_fn=self._demote())
+                user=uid, group=gid)
         except subprocess.TimeoutExpired:
             if not self.ignore_error:
                 log.warning("input_command: script timed out (%ss)",

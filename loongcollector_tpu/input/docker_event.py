@@ -58,6 +58,7 @@ class ServiceDockerEvent(Input):
         return True
 
     def _run(self) -> None:
+        import http.client
         backoff = 1.0
         while self._running:
             if not os.path.exists(self.sock_path):
@@ -67,7 +68,9 @@ class ServiceDockerEvent(Input):
             try:
                 self._stream_events()
                 backoff = 1.0
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
+                # a flapping daemon raises BadStatusLine/IncompleteRead, not
+                # just OSError — either way: drop the connection and back off
                 log.warning("docker event stream lost: %s", e)
             time.sleep(min(backoff, 30))
             backoff = min(backoff * 2, 30)

@@ -187,7 +187,8 @@ class ServiceK8sMeta(Input):
         carried: set = set()
         for key in self._last_keys - seen:
             kind, ns, name = key.split("|", 2)
-            if kind in failed_kinds:
+            if kind in failed_kinds or \
+                    (kind == "container" and "Pod" in failed_kinds):
                 carried.add(key)
                 continue
             ev = group.add_log_event(now)
@@ -266,7 +267,7 @@ class ServiceK8sMeta(Input):
                           for c in spec.get("containers", []) or []]
             self._put(ev, group, "containers", _jdump(containers))
             if self.container_entities:
-                self._emit_containers(group, obj, now, first)
+                self._emit_containers(group, obj, now, seen)
         elif kind == "Node":
             addrs = {a.get("type"): a.get("address")
                      for a in status.get("addresses", []) or []}
@@ -300,15 +301,22 @@ class ServiceK8sMeta(Input):
                       spec.get("storageClassName", ""))
 
     def _emit_containers(self, group, pod: dict, now: int,
-                         first: int) -> None:
+                         seen: set) -> None:
         meta = _meta(pod)
         ns = meta.get("namespace", "")
         pod_name = meta.get("name", "")
         for c in (pod.get("spec", {}) or {}).get("containers", []) or []:
             ev = group.add_log_event(now)
             cname = c.get("name", "")
+            # containers join the same first-seen/last-keys diff as the
+            # kind-level snapshot: Add on first sight, Delete when the
+            # owning pod's list no longer contains them
+            key = f"container|{ns}|{pod_name + cname}"
+            method = "Update" if key in self._first_seen else "Add"
+            first = self._first_seen.setdefault(key, now)
+            seen.add(key)
             self._common_entity_fields(ev, group, "container", ns,
-                                       pod_name + cname, "Update", first,
+                                       pod_name + cname, method, first,
                                        now)
             self._put(ev, group, "name", cname)
             self._put(ev, group, "pod_name", pod_name)

@@ -74,6 +74,13 @@ class RdbPollingInput(PollingInput):
         self.cp_column = str(config.get("CheckPointColumn", ""))
         self.cp_type = str(config.get("CheckPointColumnType", "int"))
         self.cp_value = str(config.get("CheckPointStart", "0"))
+        if self.use_checkpoint and self.cp_column:
+            # reference rdb.go persists the column checkpoint via
+            # Context.GetCheckPoint/SaveCheckPoint — restarts resume from
+            # the last collected value instead of re-ingesting everything
+            saved = context.get_checkpoint(self._cp_key())
+            if saved is not None:
+                self.cp_value = saved
         self.limit = bool(config.get("Limit", False))
         self.page_size = int(config.get("PageSize", 100))
         self.max_sync_size = int(config.get("MaxSyncSize", 0))
@@ -86,6 +93,9 @@ class RdbPollingInput(PollingInput):
             log.error("%s: CheckPoint requires CheckPointColumn", self.name)
             return False
         return True
+
+    def _cp_key(self) -> str:
+        return f"rdb_cp/{self.name}/{self.cp_column}"
 
     # -- dialect hooks -------------------------------------------------------
 
@@ -151,7 +161,7 @@ class RdbPollingInput(PollingInput):
         rows_total = 0
         page = 0
         cp_paged = self._cp_paged
-        last_cp = self.cp_value
+        last_cp = cp_at_start = self.cp_value
         group = PipelineEventGroup()
         sb = group.source_buffer
         now = int(time.time())
@@ -200,6 +210,8 @@ class RdbPollingInput(PollingInput):
         pqm = self.context.process_queue_manager
         if pqm is not None and len(group):
             pqm.push_queue(self.context.process_queue_key, group)
+        if self.use_checkpoint and self.cp_value != cp_at_start:
+            self.context.save_checkpoint(self._cp_key(), self.cp_value)
 
     def stop(self, is_pipeline_removing: bool = False) -> bool:
         out = super().stop(is_pipeline_removing)

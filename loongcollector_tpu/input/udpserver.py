@@ -92,9 +92,12 @@ class UDPServer:
             self._sock = None
 
     def _loop(self) -> None:
+        # snapshot: stop() nulls self._sock after a timed-out join, and the
+        # loop must exit quietly instead of dying on AttributeError
+        sock = self._sock
         while self._running:
             try:
-                data, _ = self._sock.recvfrom(self.max_buffer_size)
+                data, _ = sock.recvfrom(self.max_buffer_size)
             except socket.timeout:
                 continue
             except OSError:

@@ -1,0 +1,88 @@
+"""Plugin checkpoint store: small durable key→value state for plugins.
+
+Reference: the Go plugin context's GetCheckPoint/SaveCheckPoint
+(pkg/pipeline/context.go, backed by pluginmanager's leveldb checkpoint
+dir) — rdb inputs persist their column checkpoint, kafka persists
+offsets, etc.  Here: one JSON file, written atomically, keyed by
+"<pipeline>/<key>" so pipeline reloads keep their state.
+
+The store is process-global (set_default_store from Application);
+without one (tests, ad-hoc runs) checkpoints are kept in memory only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+from ...utils.logger import get_logger
+
+log = get_logger("plugin_checkpoint")
+
+
+class PluginCheckpointStore:
+    def __init__(self, path: str = ""):
+        self.path = path
+        self._lock = threading.Lock()
+        self._state: Dict[str, str] = {}
+        self._dirty = False
+        if path:
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                self._state = {str(k): str(v) for k, v in data.items()}
+        except (OSError, ValueError):
+            pass
+
+    def get(self, pipeline: str, key: str) -> Optional[str]:
+        with self._lock:
+            return self._state.get(f"{pipeline}/{key}")
+
+    def save(self, pipeline: str, key: str, value: str) -> None:
+        with self._lock:
+            self._state[f"{pipeline}/{key}"] = value
+            self._dirty = True
+
+    def delete(self, pipeline: str, key: str) -> None:
+        with self._lock:
+            if self._state.pop(f"{pipeline}/{key}", None) is not None:
+                self._dirty = True
+
+    def flush(self) -> None:
+        """Atomic write (tmp + rename); called on save-interval ticks and
+        agent shutdown."""
+        with self._lock:
+            if not self._dirty or not self.path:
+                return
+            snapshot = dict(self._state)
+            self._dirty = False
+        tmp = self.path + ".tmp"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            log.warning("plugin checkpoint flush failed: %s", e)
+            with self._lock:
+                self._dirty = True
+
+
+_default_store = PluginCheckpointStore()
+_default_lock = threading.Lock()
+
+
+def get_default_store() -> PluginCheckpointStore:
+    return _default_store
+
+
+def set_default_store(store: PluginCheckpointStore) -> None:
+    global _default_store
+    with _default_lock:
+        _default_store = store

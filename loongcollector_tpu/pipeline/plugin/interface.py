@@ -35,6 +35,17 @@ class PluginContext:
         """Resolve an extension reference from another plugin's config."""
         return self.extensions.get(ref)
 
+    # -- plugin checkpoints (reference pkg/pipeline/context.go
+    #    GetCheckPoint/SaveCheckPoint) -------------------------------------
+
+    def get_checkpoint(self, key: str):
+        from .checkpoint import get_default_store
+        return get_default_store().get(self.pipeline_name, key)
+
+    def save_checkpoint(self, key: str, value: str) -> None:
+        from .checkpoint import get_default_store
+        get_default_store().save(self.pipeline_name, key, value)
+
 
 class Plugin:
     name: str = "plugin_base"

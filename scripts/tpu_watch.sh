@@ -1,20 +1,20 @@
 #!/bin/bash
-# Opportunistic TPU bench: probe the tunnel; on the first healthy probe run
-# bench.py (which persists BENCH_TPU_LAST_GOOD.json) and exit.
-cd /root/repo
-for i in $(seq 1 120); do
-  if timeout 60 python -c "
+# Probe the TPU tunnel every ~2 min; log transitions to /tmp/tpu_watch.log.
+# When the tunnel comes alive, touch /tmp/tpu_alive so the builder can react.
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 75 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()[0]
 jnp.zeros(8).block_until_ready()
 assert d.platform == 'tpu'
-" >/dev/null 2>&1; then
-    echo "$(date +%H:%M:%S) TPU back; running bench" >> /tmp/tpu_watch.log
-    timeout 1500 python bench.py > /tmp/tpu_bench_opportunistic.json 2>/tmp/tpu_bench_opportunistic.err
-    echo "$(date +%H:%M:%S) bench rc=$?" >> /tmp/tpu_watch.log
-    exit 0
+print(d)
+" > /tmp/tpu_probe_out 2>&1; then
+    echo "$ts ALIVE $(tail -1 /tmp/tpu_probe_out)" >> /tmp/tpu_watch.log
+    touch /tmp/tpu_alive
+  else
+    echo "$ts DEAD" >> /tmp/tpu_watch.log
+    rm -f /tmp/tpu_alive
   fi
-  echo "$(date +%H:%M:%S) probe $i: down" >> /tmp/tpu_watch.log
-  sleep 180
+  sleep 110
 done
-exit 1

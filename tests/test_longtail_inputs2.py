@@ -462,3 +462,35 @@ class TestRdbBase:
         inp.cp_value = "1; delete from t"
         sql, _ = inp._build_sql(0)
         assert "delete" not in sql
+
+    def test_checkpoint_persists_across_restart(self, tmp_path):
+        """The column checkpoint survives an agent restart (reference
+        rdb.go Context.SaveCheckPoint) instead of resetting to
+        CheckPointStart and re-ingesting everything."""
+        from loongcollector_tpu.input.mysql_query import InputMysql
+        from loongcollector_tpu.pipeline.plugin.checkpoint import (
+            PluginCheckpointStore, set_default_store, get_default_store)
+        prev = get_default_store()
+        path = str(tmp_path / "plugin_cp.json")
+        set_default_store(PluginCheckpointStore(path))
+        try:
+            cfg = {"StateMent": "select id from t where id > ?",
+                   "CheckPoint": True, "CheckPointColumn": "id",
+                   "CheckPointStart": "0"}
+            inp = InputMysql()
+            assert inp.init(cfg, PluginContext("pipe-a"))
+            assert inp.cp_value == "0"
+            inp.cp_value = "4242"
+            inp.context.save_checkpoint(inp._cp_key(), inp.cp_value)
+            get_default_store().flush()
+            # simulated restart: fresh store reads the file back
+            set_default_store(PluginCheckpointStore(path))
+            inp2 = InputMysql()
+            assert inp2.init(cfg, PluginContext("pipe-a"))
+            assert inp2.cp_value == "4242"
+            # a different pipeline does not see it
+            inp3 = InputMysql()
+            assert inp3.init(cfg, PluginContext("pipe-b"))
+            assert inp3.cp_value == "0"
+        finally:
+            set_default_store(prev)
