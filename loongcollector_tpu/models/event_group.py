@@ -59,7 +59,7 @@ class ColumnarLogs:
     """
 
     __slots__ = ("offsets", "lengths", "timestamps", "fields", "parse_ok",
-                 "content_consumed")
+                 "content_consumed", "span_matrix")
 
     def __init__(self, offsets: np.ndarray, lengths: np.ndarray,
                  timestamps: Optional[np.ndarray] = None):
@@ -74,6 +74,11 @@ class ColumnarLogs:
         # extracted fields; until then `content` remains a live column even
         # when auxiliary fields exist (e.g. container stream tags)
         self.content_consumed = False
+        # serializer fast path: when the parse kernel's [N, F] span matrices
+        # cover the field dict exactly, serialization reads them directly
+        # (no per-field slicing / restacking).  (names, off_mat, len_mat);
+        # any later set_field invalidates it.
+        self.span_matrix: Optional[Tuple[List, np.ndarray, np.ndarray]] = None
 
     def __len__(self) -> int:
         return int(self.offsets.shape[0])
@@ -85,6 +90,25 @@ class ColumnarLogs:
     def set_field(self, name: str, offsets: np.ndarray, lengths: np.ndarray) -> None:
         self.fields[name] = (np.asarray(offsets, dtype=np.int32),
                              np.asarray(lengths, dtype=np.int32))
+        self.span_matrix = None
+
+    def set_fields_matrix(self, names: List, off_mat: np.ndarray,
+                          len_mat: np.ndarray) -> None:
+        """Install parsed fields from [N, F] span matrices.  Field columns
+        become views; when no other fields exist the serializer consumes the
+        matrices without a transpose.  The exact column tuples are kept in
+        span_matrix so the serializer can verify (by identity) that no
+        processor replaced or renamed fields behind its back."""
+        off_mat = np.ascontiguousarray(off_mat, dtype=np.int32)
+        len_mat = np.ascontiguousarray(len_mat, dtype=np.int32)
+        fresh = not self.fields
+        views = []
+        for g, name in enumerate(names):
+            pair = (off_mat[:, g], len_mat[:, g])
+            self.fields[name] = pair
+            views.append(pair)
+        self.span_matrix = ((list(names), off_mat, len_mat, views)
+                            if fresh else None)
 
 
 class PipelineEventGroup:

@@ -87,6 +87,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                           ctypes.c_int64, ctypes.c_int64,
                                           u8p, i32p, i32p, i32p,
                                           u8p, ctypes.c_int64]
+        if hasattr(lib, "lct_sls_serialize_strided"):
+            lib.lct_sls_serialize_strided.restype = ctypes.c_int64
+            lib.lct_sls_serialize_strided.argtypes = [
+                u8p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
+                u8p, i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64,
+                u8p, ctypes.c_int64]
         for fn in ("lct_lz4_bound", "lct_lz4_compress", "lct_lz4_decompress",
                    "lct_snappy_bound", "lct_snappy_compress",
                    "lct_snappy_uncompressed_len", "lct_snappy_decompress"):
@@ -176,11 +182,15 @@ def json_extract(arena: np.ndarray, offsets: np.ndarray,
 
 
 def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
-                  keys: list, field_offs: np.ndarray, field_lens: np.ndarray
-                  ) -> Optional[bytes]:
-    """keys: list[bytes] (≤64); field_offs/field_lens: int32 [F, n]."""
+                  keys: list, field_offs: np.ndarray, field_lens: np.ndarray,
+                  event_major: bool = False) -> Optional[bytes]:
+    """keys: list[bytes] (≤64); field_offs/field_lens: int32 — [F, n]
+    field-major by default, [n, F] when event_major=True (the parse-kernel
+    output layout, serialized without a transpose)."""
     lib = get_lib()
     if lib is None or len(keys) > 64:
+        return None
+    if event_major and not hasattr(lib, "lct_sls_serialize_strided"):
         return None
     arena = np.ascontiguousarray(arena)
     timestamps = np.ascontiguousarray(timestamps, dtype=np.int64)
@@ -188,24 +198,35 @@ def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
     field_lens = np.ascontiguousarray(field_lens, dtype=np.int32)
     keys_blob = np.frombuffer(b"".join(keys) or b"\0", dtype=np.uint8).copy()
     key_lens = np.array([len(k) for k in keys], dtype=np.int32)
+    F = len(keys)
     n = len(timestamps)
+    sf, si = (1, F) if event_major else (n, 1)
     cap = int(field_lens.clip(min=0).sum()
-              + n * (int(key_lens.sum()) + 12 * len(keys) + 16) + 64)
+              + n * (int(key_lens.sum()) + 12 * F + 16) + 64)
+
+    def call(buf, buf_cap):
+        if event_major:
+            return lib.lct_sls_serialize_strided(
+                _u8(arena), len(arena), _i64(timestamps), n, F,
+                _u8(keys_blob), _i32(key_lens), _i32(field_offs),
+                _i32(field_lens), sf, si, _u8(buf), buf_cap)
+        return lib.lct_sls_serialize(
+            _u8(arena), len(arena), _i64(timestamps), n, F, _u8(keys_blob),
+            _i32(key_lens), _i32(field_offs), _i32(field_lens), _u8(buf),
+            buf_cap)
+
     out = np.empty(cap, dtype=np.uint8)
-    written = lib.lct_sls_serialize(_u8(arena), len(arena), _i64(timestamps),
-                                    n, len(keys), _u8(keys_blob),
-                                    _i32(key_lens), _i32(field_offs),
-                                    _i32(field_lens), _u8(out), cap)
+    written = call(out, cap)
     if written < 0:
-        out = np.empty(-written, dtype=np.uint8)
-        written = lib.lct_sls_serialize(_u8(arena), len(arena),
-                                        _i64(timestamps), n, len(keys),
-                                        _u8(keys_blob), _i32(key_lens),
-                                        _i32(field_offs), _i32(field_lens),
-                                        _u8(out), -written)
+        # exact-size retry; the +16 is part of the declared capacity so the
+        # 16-byte fast copies stay legal right up to the payload end
+        out = np.empty(-written + 16, dtype=np.uint8)
+        written = call(out, -written + 16)
         if written < 0:
             return None
-    return out[:written].tobytes()
+    # a view, not bytes: the serializer joins parts once — an extra
+    # tobytes here would copy the (larger-than-input) payload again
+    return memoryview(out)[:written]
 
 
 def _codec(fn_c, fn_bound, data: bytes) -> Optional[bytes]:

@@ -23,7 +23,8 @@ from ... import native as native_mod
 from .program import (INF, Alt, CapEnd, CapStart, FixedSpan, Lit, Optional_,
                       SegmentProgram, Span)
 
-MAX_CAPS = 32  # kT1MaxCaps in the C++ executor
+MAX_CAPS = 32     # kT1MaxCaps in the C++ executor
+MAX_CLASSES = 64  # kT1MaxClasses in the C++ executor
 
 
 class NativeUnsupported(Exception):
@@ -90,6 +91,11 @@ def serialize_program(program: SegmentProgram
     ncaps = max(program.num_caps, 1)
     if ncaps > MAX_CAPS:
         raise NativeUnsupported(f"{ncaps} captures > {MAX_CAPS}")
+    if len(program.classes) > MAX_CLASSES:
+        # kT1MaxClasses: the executor rejects such programs at call time
+        # (rc=-1); refusing to build keeps the engine on its fallback tier
+        raise NativeUnsupported(
+            f"{len(program.classes)} classes > {MAX_CLASSES}")
     lits = _LitTable()
     words: List[int] = [1, ncaps]
 

@@ -55,7 +55,9 @@ class SLSEventGroupSerializer:
         self.machine_uuid = machine_uuid
 
     def serialize(self, groups: List[PipelineEventGroup]) -> bytes:
-        out = bytearray()
+        # parts are joined exactly once at the end; the native payload part
+        # is a memoryview over the native output buffer (zero interim copies)
+        parts: List = []
         for group in groups:
             cols = group.columns
             # columnar fast path also covers the raw-tail case (no parsed
@@ -64,20 +66,26 @@ class SLSEventGroupSerializer:
             # 546 MB/s simple-line scenario lives on this path)
             if cols is not None and not group._events \
                     and (cols.fields or not cols.content_consumed):
-                self._logs_from_columns(group, out)
+                data = self._native_logs(group, cols)
+                if data is not None:
+                    parts.append(data)
+                else:
+                    buf = bytearray()
+                    self._python_logs_from_columns(group, buf)
+                    parts.append(buf)
             else:
                 for ev in group.events:
                     if isinstance(ev, LogEvent):
-                        out += _len_delim(1, self._log(ev))
+                        parts.append(_len_delim(1, self._log(ev)))
             for k, v in group.tags.items():
-                out += _len_delim(6, _kv(k, v.to_bytes()))
+                parts.append(_len_delim(6, _kv(k, v.to_bytes())))
         if self.topic:
-            out += _len_delim(3, self.topic)
+            parts.append(_len_delim(3, self.topic))
         if self.source:
-            out += _len_delim(4, self.source)
+            parts.append(_len_delim(4, self.source))
         if self.machine_uuid:
-            out += _len_delim(5, self.machine_uuid)
-        return bytes(out)
+            parts.append(_len_delim(5, self.machine_uuid))
+        return b"".join(parts)
 
     def _log(self, ev: LogEvent) -> bytes:
         body = bytearray(b"\x08" + _varint(ev.timestamp & 0xFFFFFFFF))
@@ -95,12 +103,9 @@ class SLSEventGroupSerializer:
             spans.insert(0, (cols.offsets, cols.lengths))
         return names, spans
 
-    def _logs_from_columns(self, group: PipelineEventGroup, out: bytearray) -> None:
+    def _python_logs_from_columns(self, group: PipelineEventGroup,
+                                  out: bytearray) -> None:
         cols = group.columns
-        data = self._native_logs(group, cols)
-        if data is not None:
-            out += data
-            return
         raw = group.source_buffer.raw
         names, spans = self._columnar_spans(cols)
         key_prefix = [b"\x0a" + _varint(len(n)) + n for n in names]
@@ -116,6 +121,21 @@ class SLSEventGroupSerializer:
                     body += b"\x12" + _varint(len(content)) + content
             out += b"\x0a" + _varint(len(body)) + body
 
+    @staticmethod
+    def _matrix_is_current(cols, m) -> bool:
+        """The span_matrix fast path is valid only while cols.fields still
+        IS the matrix: same names, same column-view tuples (by identity).
+        Processors that mutate cols.fields directly (rename / drop /
+        replace) bypass set_field's invalidation — detect that here instead
+        of trusting the handle."""
+        names, _off_mat, _len_mat, views = m
+        if len(cols.fields) != len(names):
+            return False
+        for name, view in zip(names, views):
+            if cols.fields.get(name) is not view:
+                return False
+        return True
+
     @classmethod
     def _native_logs(cls, group: PipelineEventGroup, cols):
         import numpy as _np
@@ -123,6 +143,17 @@ class SLSEventGroupSerializer:
         from ... import native as _native
         if _native.get_lib() is None:
             return None
+        m = cols.span_matrix
+        if m is not None and cols.content_consumed \
+                and cls._matrix_is_current(cols, m):
+            # parse-kernel matrices cover the fields exactly: serialize the
+            # [N, F] layout in place, no transpose/stack
+            names, off_mat, len_mat, _views = m
+            names = [(n.encode() if isinstance(n, str) else n)
+                     for n in names]
+            return _native.sls_serialize(group.source_buffer.as_array(),
+                                         cols.timestamps, names,
+                                         off_mat, len_mat, event_major=True)
         names, spans = cls._columnar_spans(cols)
         if not names:
             return None

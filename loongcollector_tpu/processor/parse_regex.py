@@ -68,9 +68,13 @@ class ProcessorParseRegex(Processor):
         if src.columnar:
             cols = group.columns
             ncap = self.engine.num_caps
-            for g in range(min(ncap, len(self.keys))):
-                lens = np.where(ok, res.cap_len[:, g], -1).astype(np.int32)
-                cols.set_field(self.keys[g], res.cap_off[:, g], lens)
+            nkeys = min(ncap, len(self.keys))
+            # one [N, C] mask instead of per-field slicing; the matrices feed
+            # the serializer directly (ColumnarLogs.span_matrix fast path)
+            len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
+                               np.int32(-1))
+            cols.set_fields_matrix(self.keys[:nkeys],
+                                   res.cap_off[:, :nkeys], len_mat)
             # source retention
             src_off = src.offsets.astype(np.int32)
             src_len = src.lengths

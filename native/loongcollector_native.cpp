@@ -13,6 +13,10 @@
 
 #include <cstdint>
 #include <cstring>
+#include <new>
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -91,13 +95,34 @@ static inline uint8_t* put_varint(uint8_t* p, uint64_t v) {
     return p;
 }
 
-int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
-                          const int64_t* timestamps, int64_t n,
-                          int64_t F,
-                          const uint8_t* keys_blob, const int32_t* key_lens,
-                          const int32_t* field_offs,  // [F * n]
-                          const int32_t* field_lens,  // [F * n]
-                          uint8_t* out, int64_t out_cap) {
+// Short-copy with 16-byte over-write: log fields are mostly 2–20 bytes and
+// a libc memcpy call per field dominates the serializer.  Requires 16 bytes
+// of readable slack after src and writable slack after dst (the caller
+// over-allocates; src slack is bounds-checked by the caller).
+static inline uint8_t* put_bytes_fast(uint8_t* p, const uint8_t* s,
+                                      int64_t k) {
+    if (k <= 16) {
+        uint64_t a, b;
+        memcpy(&a, s, 8);
+        memcpy(&b, s + 8, 8);
+        memcpy(p, &a, 8);
+        memcpy(p + 8, &b, 8);
+        return p + k;
+    }
+    memcpy(p, s, static_cast<size_t>(k));
+    return p + k;
+}
+
+// Strided span layout: element (f, i) lives at f*sf + i*si.  Field-major
+// [F, n] ⇒ (sf=n, si=1); event-major [n, F] ⇒ (sf=1, si=F) — the parse
+// kernels emit [n, C] matrices, and serializing them directly skips a
+// transpose + stack per group.
+int64_t lct_sls_serialize_strided(
+        const uint8_t* arena, int64_t arena_len, const int64_t* timestamps,
+        int64_t n, int64_t F, const uint8_t* keys_blob,
+        const int32_t* key_lens, const int32_t* field_offs,
+        const int32_t* field_lens, int64_t sf, int64_t si, uint8_t* out,
+        int64_t out_cap) {
     // key prefix offsets into keys_blob
     int64_t key_starts[64];
     if (F > 64) return -1;
@@ -107,67 +132,115 @@ int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
     // a span is emitted iff it passes BOTH the absence and bounds checks —
     // the predicate must be identical in the size and write passes or the
     // length prefixes desynchronise from the written bytes
-    auto span_ok = [&](int64_t f, int64_t i) -> bool {
-        int32_t vlen = field_lens[f * n + i];
+    auto span_ok = [&](int64_t idx) -> bool {
+        int32_t vlen = field_lens[idx];
         if (vlen < 0) return false;
-        int32_t voff = field_offs[f * n + i];
+        int32_t voff = field_offs[idx];
         return voff >= 0 && static_cast<int64_t>(voff) + vlen <= arena_len;
     };
 
-    // pass 1: size
+    // per-field key-part size is constant across events
+    int32_t key_part[64];
+    for (int64_t f = 0; f < F; ++f)
+        key_part[f] = 1 + varint_size(key_lens[f]) + key_lens[f] + 1;
+
+    // pass 1: size — cache per-log body sizes so pass 2 doesn't re-derive
+    // them (the derivation walks every field span twice otherwise)
+    int64_t* bodies = new (std::nothrow) int64_t[n > 0 ? n : 1];
+    if (!bodies) return -1;
     int64_t total = 0;
     for (int64_t i = 0; i < n; ++i) {
         uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
         int64_t body = 1 + varint_size(ts);
+        int64_t base = i * si;
         for (int64_t f = 0; f < F; ++f) {
-            if (!span_ok(f, i)) continue;
-            int32_t vlen = field_lens[f * n + i];
-            int32_t klen = key_lens[f];
-            int64_t content = 1 + varint_size(klen) + klen +
-                              1 + varint_size(vlen) + vlen;
+            int64_t idx = base + f * sf;
+            if (!span_ok(idx)) continue;
+            int32_t vlen = field_lens[idx];
+            int64_t content = key_part[f] + varint_size(vlen) + vlen;
             body += 1 + varint_size(content) + content;
         }
+        bodies[i] = body;
         total += 1 + varint_size(body) + body;
     }
-    if (total > out_cap) return -total;
+    if (total > out_cap) {
+        delete[] bodies;
+        return -total;
+    }
+
+    // per-field constant wire prefix: 0x0a klen <key> 0x12 — one cache-hot
+    // copy per field instead of three stores + a libc memcpy
+    uint8_t keyhdr[64][112];
+    int32_t keyhdr_len[64];
+    for (int64_t f = 0; f < F; ++f) {
+        int32_t klen = key_lens[f];
+        if (klen + varint_size(klen) + 2 > 96) {
+            keyhdr_len[f] = -1;            // oversize key: slow path
+            continue;
+        }
+        uint8_t* q = keyhdr[f];
+        *q++ = 0x0a;                       // Content.Key
+        q = put_varint(q, klen);
+        memcpy(q, keys_blob + key_starts[f], klen);
+        q += klen;
+        *q++ = 0x12;                       // Content.Value tag
+        keyhdr_len[f] = (int32_t)(q - keyhdr[f]);
+    }
 
     // pass 2: write
+    const uint8_t* out_end = out + out_cap;
     uint8_t* p = out;
     for (int64_t i = 0; i < n; ++i) {
         uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
-        int64_t body = 1 + varint_size(ts);
-        for (int64_t f = 0; f < F; ++f) {
-            if (!span_ok(f, i)) continue;
-            int32_t vlen = field_lens[f * n + i];
-            int32_t klen = key_lens[f];
-            int64_t content = 1 + varint_size(klen) + klen +
-                              1 + varint_size(vlen) + vlen;
-            body += 1 + varint_size(content) + content;
-        }
         *p++ = 0x0a;                       // LogGroup.Logs
-        p = put_varint(p, body);
+        p = put_varint(p, bodies[i]);
         *p++ = 0x08;                       // Log.Time
         p = put_varint(p, ts);
+        int64_t base = i * si;
         for (int64_t f = 0; f < F; ++f) {
-            if (!span_ok(f, i)) continue;
-            int32_t vlen = field_lens[f * n + i];
-            int32_t voff = field_offs[f * n + i];
-            int32_t klen = key_lens[f];
-            int64_t content = 1 + varint_size(klen) + klen +
-                              1 + varint_size(vlen) + vlen;
+            int64_t idx = base + f * sf;
+            if (!span_ok(idx)) continue;
+            int32_t vlen = field_lens[idx];
+            int32_t voff = field_offs[idx];
+            int64_t content = key_part[f] + varint_size(vlen) + vlen;
             *p++ = 0x12;                   // Log.Contents
             p = put_varint(p, content);
-            *p++ = 0x0a;                   // Content.Key
-            p = put_varint(p, klen);
-            memcpy(p, keys_blob + key_starts[f], klen);
-            p += klen;
-            *p++ = 0x12;                   // Content.Value
+            int32_t kh = keyhdr_len[f];
+            if (kh >= 0 && p + kh + 16 <= out_end) {
+                p = put_bytes_fast(p, keyhdr[f], kh);
+            } else {
+                int32_t klen = key_lens[f];
+                *p++ = 0x0a;               // Content.Key
+                p = put_varint(p, klen);
+                memcpy(p, keys_blob + key_starts[f], klen);
+                p += klen;
+                *p++ = 0x12;               // Content.Value
+            }
             p = put_varint(p, vlen);
-            memcpy(p, arena + voff, vlen);
-            p += vlen;
+            if (p + vlen + 16 <= out_end &&
+                (int64_t)voff + vlen + 16 <= arena_len) {
+                p = put_bytes_fast(p, arena + voff, vlen);
+            } else {
+                memcpy(p, arena + voff, vlen);
+                p += vlen;
+            }
         }
     }
+    delete[] bodies;
     return p - out;
+}
+
+// legacy field-major entry point
+int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
+                          const int64_t* timestamps, int64_t n,
+                          int64_t F,
+                          const uint8_t* keys_blob, const int32_t* key_lens,
+                          const int32_t* field_offs,  // [F * n]
+                          const int32_t* field_lens,  // [F * n]
+                          uint8_t* out, int64_t out_cap) {
+    return lct_sls_serialize_strided(arena, arena_len, timestamps, n, F,
+                                     keys_blob, key_lens, field_offs,
+                                     field_lens, n, 1, out, out_cap);
 }
 
 }  // extern "C"
@@ -706,6 +779,20 @@ struct T1State {
     int32_t cap_start[kT1MaxCaps];
 };
 
+// Per-class scan acceleration, derived from the membership table once per
+// exec call: single-char negations ([^"]*, [^\]]+) scan via memchr/memrchr;
+// classes whose members include every byte in [0x21,0xFF] (\S, \w-ish
+// supersets) skip 8 bytes per SWAR word test; everything else runs a
+// "truffle"-style SIMD membership scan (two pshufb nibble tables encode an
+// arbitrary 256-byte set, 16 bytes per iteration) when the CPU has AVX2.
+struct T1ClassInfo {
+    int32_t neg_char;   // >=0: class == complement of exactly this byte
+    bool hi_member;     // every byte in [0x21, 0xFF] is a member
+    uint8_t tr_lo[16];  // truffle: bit (hi) of byte, indexed by lo nibble,
+    uint8_t tr_hi[16];  //   for hi<8 (tr_lo) / hi>=8 (tr_hi)
+};
+constexpr int kT1MaxClasses = 64;
+
 struct T1Ctx {
     const uint8_t* row;
     int32_t len;
@@ -713,10 +800,133 @@ struct T1Ctx {
     const uint8_t* lit_blob;
     const int32_t* lit_offs;
     const int32_t* lit_lens;
+    const T1ClassInfo* cinfo;
+    int32_t ncaps;
 };
 
 inline bool t1_member(const T1Ctx& c, int32_t cls, uint8_t b) {
     return c.classes[(int64_t)cls * 256 + b] != 0;
+}
+
+// Copy only the live capture slots (C of kT1MaxCaps): trial/backtrack state
+// saves happen per OPT/ALT per row, and a full struct copy (~400 B) costs
+// more than walking a typical log row.
+inline void t1_copy(T1State& d, const T1State& s, int32_t C) {
+    d.cur = s.cur;
+    d.ok = s.ok;
+    memcpy(d.cap_off, s.cap_off, (size_t)C * 4);
+    memcpy(d.cap_len, s.cap_len, (size_t)C * 4);
+    memcpy(d.cap_start, s.cap_start, (size_t)C * 4);
+}
+
+inline uint64_t t1_load8(const uint8_t* p) {
+    uint64_t x;
+    memcpy(&x, p, 8);
+    return x;
+}
+
+// SWAR: flags (high bit per lane) for bytes < 0x21
+inline uint64_t t1_low_bytes(uint64_t x) {
+    return (x - 0x2121212121212121ULL) & ~x & 0x8080808080808080ULL;
+}
+
+#if defined(__x86_64__)
+static const bool g_has_avx2 = __builtin_cpu_supports("avx2");
+
+// Truffle block: returns a bitmask of NON-member bytes among the 16 loaded.
+__attribute__((target("avx2"))) inline uint32_t t1_truffle16(
+        const uint8_t* p, __m128i lo_tbl, __m128i hi_tbl) {
+    const __m128i highconst = _mm_set1_epi8((char)0x80);
+    const __m128i bits = _mm_set1_epi64x(0x8040201008040201LL);
+    __m128i v = _mm_loadu_si128((const __m128i*)p);
+    __m128i shuf1 = _mm_shuffle_epi8(lo_tbl, v);
+    __m128i shuf2 = _mm_shuffle_epi8(hi_tbl, _mm_xor_si128(v, highconst));
+    __m128i nib_hi = _mm_andnot_si128(highconst, _mm_srli_epi64(v, 4));
+    __m128i shuf3 = _mm_shuffle_epi8(bits, nib_hi);
+    __m128i t = _mm_and_si128(_mm_or_si128(shuf1, shuf2), shuf3);
+    __m128i nonmem = _mm_cmpeq_epi8(t, _mm_setzero_si128());
+    return (uint32_t)_mm_movemask_epi8(nonmem);
+}
+
+// Forward member run via truffle; falls back to the table near the tail.
+__attribute__((target("avx2"))) int32_t t1_truffle_scan_fwd(
+        const uint8_t* row, int32_t len, int32_t start,
+        const T1ClassInfo& ci, const uint8_t* tbl) {
+    __m128i lo = _mm_loadu_si128((const __m128i*)ci.tr_lo);
+    __m128i hi = _mm_loadu_si128((const __m128i*)ci.tr_hi);
+    int32_t i = start;
+    for (; i + 16 <= len; i += 16) {
+        uint32_t nm = t1_truffle16(row + i, lo, hi);
+        if (nm) return i + (int32_t)__builtin_ctz(nm);
+    }
+    while (i < len && tbl[row[i]]) ++i;
+    return i;
+}
+
+// Backward member run via truffle (run ends at cur, exclusive).
+__attribute__((target("avx2"))) int32_t t1_truffle_scan_rev(
+        const uint8_t* row, int32_t cur, const T1ClassInfo& ci,
+        const uint8_t* tbl) {
+    __m128i lo = _mm_loadu_si128((const __m128i*)ci.tr_lo);
+    __m128i hi = _mm_loadu_si128((const __m128i*)ci.tr_hi);
+    int32_t i = cur;
+    for (; i >= 16; i -= 16) {
+        uint32_t nm = t1_truffle16(row + i - 16, lo, hi);
+        if (nm) return i - 16 + (32 - (int32_t)__builtin_clz(nm));
+    }
+    while (i > 0 && tbl[row[i - 1]]) --i;
+    return i;
+}
+#else
+static const bool g_has_avx2 = false;
+inline int32_t t1_truffle_scan_fwd(const uint8_t*, int32_t, int32_t,
+                                   const T1ClassInfo&, const uint8_t*) {
+    return -1;
+}
+inline int32_t t1_truffle_scan_rev(const uint8_t*, int32_t,
+                                   const T1ClassInfo&, const uint8_t*) {
+    return -1;
+}
+#endif
+
+// Maximal forward run of class members starting at `start`.
+inline int32_t t1_scan_fwd(const T1Ctx& c, int32_t cls, int32_t start) {
+    const T1ClassInfo& ci = c.cinfo[cls];
+    if (ci.neg_char >= 0) {
+        const void* hit = memchr(c.row + start, ci.neg_char, c.len - start);
+        return hit ? (int32_t)((const uint8_t*)hit - c.row) : c.len;
+    }
+    const uint8_t* tbl = c.classes + (int64_t)cls * 256;
+    if (g_has_avx2)
+        return t1_truffle_scan_fwd(c.row, c.len, start, ci, tbl);
+    int32_t end = start;
+    if (ci.hi_member) {
+        while (end + 8 <= c.len && !t1_low_bytes(t1_load8(c.row + end)))
+            end += 8;
+    }
+    while (end < c.len && tbl[c.row[end]]) ++end;
+    return end;
+}
+
+// Maximal backward run of class members ending at `cur` (exclusive).
+inline int32_t t1_scan_rev(const T1Ctx& c, int32_t cls, int32_t cur) {
+    const T1ClassInfo& ci = c.cinfo[cls];
+    if (ci.neg_char >= 0) {
+#ifdef _GNU_SOURCE
+        const void* hit = memrchr(c.row, ci.neg_char, cur);
+        return hit ? (int32_t)((const uint8_t*)hit - c.row) + 1 : 0;
+#endif
+    }
+    const uint8_t* tbl = c.classes + (int64_t)cls * 256;
+    if (g_has_avx2)
+        return t1_truffle_scan_rev(c.row, cur, ci, tbl);
+    int32_t start = cur;
+    if (ci.hi_member) {
+        while (start >= 8 && !t1_low_bytes(t1_load8(c.row + start - 8)))
+            start -= 8;
+    }
+    while (start > 0 && tbl[c.row[start - 1]]) --start;
+    return start;
 }
 
 // Forward walk (field_extract.py emit()): on failure sets st.ok=false and
@@ -725,13 +935,16 @@ inline bool t1_member(const T1Ctx& c, int32_t cls, uint8_t b) {
 void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
     int64_t i = 0;
     while (i < nw) {
-        if (!st.ok) return;
         switch (w[i]) {
-        case 0: {  // LIT
+        case 0: {  // LIT (1–2 byte literals inline: memcmp call costs more)
             int32_t li = w[i + 1];
             int32_t k = c.lit_lens[li];
+            const uint8_t* lp = c.lit_blob + c.lit_offs[li];
+            const uint8_t* rp = c.row + st.cur;
             if (st.cur + k > c.len ||
-                memcmp(c.row + st.cur, c.lit_blob + c.lit_offs[li], k) != 0) {
+                (k == 1 ? rp[0] != lp[0]
+                 : k == 2 ? (rp[0] != lp[0] || rp[1] != lp[1])
+                          : memcmp(rp, lp, k) != 0)) {
                 st.ok = false;
                 return;
             }
@@ -741,8 +954,7 @@ void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
         }
         case 1: {  // SPAN: maximal munch (compiler proved follow-disjoint)
             int32_t cls = w[i + 1], mn = w[i + 2], mx = w[i + 3];
-            int32_t end = st.cur;
-            while (end < c.len && t1_member(c, cls, c.row[end])) ++end;
+            int32_t end = t1_scan_fwd(c, cls, st.cur);
             int32_t run = end - st.cur;
             if (run < mn || (mx >= 0 && run > mx)) {
                 st.ok = false;
@@ -784,15 +996,17 @@ void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
                 st.ok = false;
                 return;
             }
-            T1State save = st;
+            T1State save;
+            t1_copy(save, st, c.ncaps);
             t1_emit(c, w + i + 2, bw, st);
-            if (!st.ok) st = save;
+            if (!st.ok) t1_copy(st, save, c.ncaps);
             i += 2 + bw;
             break;
         }
         case 6: {  // ALT: first branch whose whole body matches
             int32_t nb = w[i + 1];
-            T1State before = st;
+            T1State before;
+            t1_copy(before, st, c.ncaps);
             int64_t j = i + 2;
             bool chosen = false;
             for (int32_t b = 0; b < nb; ++b) {
@@ -806,10 +1020,11 @@ void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
                     return;
                 }
                 if (!chosen) {
-                    T1State trial = before;
+                    T1State trial;
+                    t1_copy(trial, before, c.ncaps);
                     t1_emit(c, w + j + 1, bw, trial);
                     if (trial.ok) {
-                        st = trial;
+                        t1_copy(st, trial, c.ncaps);
                         chosen = true;
                     }
                 }
@@ -817,7 +1032,7 @@ void t1_emit(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st) {
             }
             i = j;
             if (!chosen) {
-                st = before;
+                t1_copy(st, before, c.ncaps);
                 st.ok = false;
                 return;
             }
@@ -837,14 +1052,17 @@ void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
                  int32_t floor_) {
     int64_t i = 0;
     while (i < nw) {
-        if (!st.ok) return;
         switch (w[i]) {
         case 0: {  // LIT ending at cur
             int32_t li = w[i + 1];
             int32_t k = c.lit_lens[li];
             int32_t start = st.cur - k;
+            const uint8_t* lp = c.lit_blob + c.lit_offs[li];
+            const uint8_t* rp = c.row + start;
             if (start < 0 ||
-                memcmp(c.row + start, c.lit_blob + c.lit_offs[li], k) != 0) {
+                (k == 1 ? rp[0] != lp[0]
+                 : k == 2 ? (rp[0] != lp[0] || rp[1] != lp[1])
+                          : memcmp(rp, lp, k) != 0)) {
                 st.ok = false;
                 return;
             }
@@ -854,8 +1072,7 @@ void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
         }
         case 1: {  // SPAN: maximal run ending at cur, clamped by max/floor
             int32_t cls = w[i + 1], mn = w[i + 2], mx = w[i + 3];
-            int32_t start = st.cur;
-            while (start > 0 && t1_member(c, cls, c.row[start - 1])) --start;
+            int32_t start = t1_scan_rev(c, cls, st.cur);
             if (mx >= 0 && start < st.cur - mx) start = st.cur - mx;
             if (start < floor_) start = floor_;
             if (start < 0) start = 0;
@@ -901,15 +1118,17 @@ void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
                 st.ok = false;
                 return;
             }
-            T1State save = st;
+            T1State save;
+            t1_copy(save, st, c.ncaps);
             t1_emit_rev(c, w + i + 2, bw, st, floor_);
-            if (!st.ok) st = save;
+            if (!st.ok) t1_copy(st, save, c.ncaps);
             i += 2 + bw;
             break;
         }
         case 6: {
             int32_t nb = w[i + 1];
-            T1State before = st;
+            T1State before;
+            t1_copy(before, st, c.ncaps);
             int64_t j = i + 2;
             bool chosen = false;
             for (int32_t b = 0; b < nb; ++b) {
@@ -923,10 +1142,11 @@ void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
                     return;
                 }
                 if (!chosen) {
-                    T1State trial = before;
+                    T1State trial;
+                    t1_copy(trial, before, c.ncaps);
                     t1_emit_rev(c, w + j + 1, bw, trial, floor_);
                     if (trial.ok) {
-                        st = trial;
+                        t1_copy(st, trial, c.ncaps);
                         chosen = true;
                     }
                 }
@@ -934,7 +1154,7 @@ void t1_emit_rev(const T1Ctx& c, const int32_t* w, int64_t nw, T1State& st,
             }
             i = j;
             if (!chosen) {
-                st = before;
+                t1_copy(st, before, c.ncaps);
                 st.ok = false;
                 return;
             }
@@ -1116,9 +1336,183 @@ bool t1_parse_header(const int32_t* w, int64_t nw, int64_t n_classes,
 
 inline bool t1_all_member(const T1Ctx& c, int32_t cls, int32_t lo,
                           int32_t hi) {
-    for (int32_t j = lo; j < hi; ++j)
-        if (!t1_member(c, cls, c.row[j])) return false;
-    return true;
+    if (hi <= lo) return true;
+    const T1ClassInfo& ci = c.cinfo[cls];
+    if (ci.neg_char >= 0)
+        return memchr(c.row + lo, ci.neg_char, hi - lo) == nullptr;
+    return t1_scan_fwd(c, cls, lo) >= hi;
+}
+
+// ---------------------------------------------------------------------------
+// Decoded-op fast interpreter for the forward prefix walk.  The dominant
+// motif in compiled segment programs is CapStart→Span→CapEnd→Lit (a captured
+// field followed by its delimiter); decoding the word stream once per batch
+// and fusing that motif into a single FIELD op removes most per-row dispatch.
+// When the span class is a single-char negation whose terminator IS the
+// literal's first byte ( ([^\]]+)\] , ([^"]*)" ), one memchr finds the span
+// end and the delimiter together.  Nested OPT/ALT bodies stay on the word
+// interpreter (rare in hot patterns).
+// ---------------------------------------------------------------------------
+struct T1DecOp {
+    int32_t kind;         // 0..6 = word op kinds; 7 = FIELD
+    int32_t a, b, c2, d;  // kind-specific (FIELD: cap_id, cls, min, max)
+    int32_t lit;          // FIELD: trailing literal index (-1 = none)
+    const int32_t* w;     // kind 5/6: raw op words (for the interpreter)
+    int32_t wn;           //   width in words
+};
+
+constexpr int kT1MaxDecOps = 192;
+
+inline bool t1_lit_at(const T1Ctx& c, int32_t li, int32_t pos) {
+    int32_t k = c.lit_lens[li];
+    if (pos + k > c.len) return false;
+    const uint8_t* lp = c.lit_blob + c.lit_offs[li];
+    const uint8_t* rp = c.row + pos;
+    if (k == 1) return rp[0] == lp[0];
+    if (k == 2) return rp[0] == lp[0] && rp[1] == lp[1];
+    return memcmp(rp, lp, k) == 0;
+}
+
+// Decode + fuse a validated op stream.  Returns op count, or -1 when the
+// stream exceeds the decode buffer (caller falls back to the interpreter).
+int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
+    int32_t n = 0;
+    int64_t i = 0;
+    while (i < nw) {
+        if (n >= kT1MaxDecOps) return -1;
+        T1DecOp& o = ops[n++];
+        o.lit = -1;
+        o.w = nullptr;
+        o.wn = 0;
+        switch (w[i]) {
+        case 0:
+            o.kind = 0; o.a = w[i + 1]; i += 2;
+            break;
+        case 1:
+            o.kind = 1; o.a = w[i + 1]; o.b = w[i + 2]; o.c2 = w[i + 3];
+            i += 5;
+            break;
+        case 2:
+            o.kind = 2; o.a = w[i + 1]; o.b = w[i + 2]; i += 3;
+            break;
+        case 3:
+        case 4:
+            o.kind = w[i]; o.a = w[i + 1]; i += 2;
+            break;
+        case 5: {
+            int32_t bw = w[i + 1];
+            o.kind = 5; o.w = w + i; o.wn = 2 + bw;
+            i += 2 + bw;
+            break;
+        }
+        case 6: {
+            int32_t nb = w[i + 1];
+            int64_t j = i + 2;
+            for (int32_t b = 0; b < nb; ++b) j += 1 + w[j];
+            o.kind = 6; o.w = w + i; o.wn = (int32_t)(j - i);
+            i = j;
+            break;
+        }
+        default:
+            return -1;
+        }
+    }
+    // fusion: CAPSTART id / SPAN / CAPEND id [/ LIT]  →  FIELD
+    int32_t out = 0;
+    for (int32_t k = 0; k < n;) {
+        if (k + 2 < n && ops[k].kind == 3 && ops[k + 1].kind == 1 &&
+            ops[k + 2].kind == 4 && ops[k].a == ops[k + 2].a) {
+            T1DecOp f;
+            f.kind = 7;
+            f.a = ops[k].a;          // cap id
+            f.b = ops[k + 1].a;      // class
+            f.c2 = ops[k + 1].b;     // min
+            f.d = ops[k + 1].c2;     // max
+            f.lit = -1;
+            f.w = nullptr;
+            f.wn = 0;
+            k += 3;
+            if (k < n && ops[k].kind == 0) {
+                f.lit = ops[k].a;
+                ++k;
+            }
+            ops[out++] = f;
+        } else {
+            ops[out++] = ops[k++];
+        }
+    }
+    return out;
+}
+
+void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t nops,
+                 T1State& st) {
+    for (int32_t oi = 0; oi < nops; ++oi) {
+        const T1DecOp& o = ops[oi];
+        switch (o.kind) {
+        case 7: {  // FIELD
+            const T1ClassInfo& ci = c.cinfo[o.b];
+            int32_t start = st.cur;
+            int32_t end;
+            if (o.lit >= 0 && ci.neg_char >= 0 &&
+                c.lit_blob[c.lit_offs[o.lit]] == (uint8_t)ci.neg_char) {
+                const void* hit =
+                    memchr(c.row + start, ci.neg_char, c.len - start);
+                if (!hit) { st.ok = false; return; }
+                end = (int32_t)((const uint8_t*)hit - c.row);
+            } else {
+                end = t1_scan_fwd(c, o.b, start);
+            }
+            int32_t run = end - start;
+            if (run < o.c2 || (o.d >= 0 && run > o.d)) {
+                st.ok = false;
+                return;
+            }
+            st.cap_off[o.a] = start;
+            st.cap_len[o.a] = run;
+            st.cur = end;
+            if (o.lit >= 0) {
+                if (!t1_lit_at(c, o.lit, end)) { st.ok = false; return; }
+                st.cur = end + c.lit_lens[o.lit];
+            }
+            break;
+        }
+        case 0:
+            if (!t1_lit_at(c, o.a, st.cur)) { st.ok = false; return; }
+            st.cur += c.lit_lens[o.a];
+            break;
+        case 1: {  // SPAN
+            int32_t end = t1_scan_fwd(c, o.a, st.cur);
+            int32_t run = end - st.cur;
+            if (run < o.b || (o.c2 >= 0 && run > o.c2)) {
+                st.ok = false;
+                return;
+            }
+            st.cur = end;
+            break;
+        }
+        case 2: {  // FIXED
+            if (st.cur + o.b > c.len) { st.ok = false; return; }
+            for (int32_t j = 0; j < o.b; ++j)
+                if (!t1_member(c, o.a, c.row[st.cur + j])) {
+                    st.ok = false;
+                    return;
+                }
+            st.cur += o.b;
+            break;
+        }
+        case 3:
+            st.cap_start[o.a] = st.cur;
+            break;
+        case 4:
+            st.cap_off[o.a] = st.cap_start[o.a];
+            st.cap_len[o.a] = st.cur - st.cap_start[o.a];
+            break;
+        default:  // OPT / ALT: word interpreter on the single op
+            t1_emit(c, o.w, o.wn, st);
+            if (!st.ok) return;
+            break;
+        }
+    }
 }
 
 }  // namespace
@@ -1141,6 +1535,38 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         return -1;
     const int32_t C = h.num_caps;
 
+    // derive per-class scan accelerators from the membership tables
+    T1ClassInfo cinfo[kT1MaxClasses];
+    if (n_classes > kT1MaxClasses) return -1;
+    for (int64_t k = 0; k < n_classes; ++k) {
+        const uint8_t* tbl = classes + k * 256;
+        T1ClassInfo& ci = cinfo[k];
+        memset(ci.tr_lo, 0, 16);
+        memset(ci.tr_hi, 0, 16);
+        int32_t non = -1, n_non = 0;
+        bool hi = true;
+        for (int32_t b = 0; b < 256; ++b) {
+            if (!tbl[b]) {
+                ++n_non;
+                non = b;
+                if (b >= 0x21) hi = false;
+            } else {
+                int32_t lo_nib = b & 15, hi_nib = b >> 4;
+                if (hi_nib < 8)
+                    ci.tr_lo[lo_nib] |= (uint8_t)(1 << hi_nib);
+                else
+                    ci.tr_hi[lo_nib] |= (uint8_t)(1 << (hi_nib - 8));
+            }
+        }
+        ci.neg_char = (n_non == 1) ? non : -1;
+        ci.hi_member = hi;
+    }
+
+    // decode + fuse the prefix once per batch; -1 ⇒ interpreter fallback
+    T1DecOp dec[kT1MaxDecOps];
+    int32_t n_dec = t1_decode(h.prefix, h.prefix_n, dec);
+
+    T1Ctx ctx{nullptr, 0, classes, lit_blob, lit_offs, lit_lens, cinfo, C};
     for (int64_t r = 0; r < n; ++r) {
         int64_t off = offsets[r];
         int64_t len = lengths[r];
@@ -1148,8 +1574,8 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         bool row_ok = false;
         T1State final_st;
         if (off >= 0 && off + len <= arena_len && len <= INT32_MAX) {
-            T1Ctx ctx{arena + off, (int32_t)len, classes, lit_blob, lit_offs,
-                      lit_lens};
+            ctx.row = arena + off;
+            ctx.len = (int32_t)len;
             T1State st;
             st.cur = 0;
             st.ok = true;
@@ -1158,10 +1584,14 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                 st.cap_len[k] = -1;
                 st.cap_start[k] = 0;
             }
-            t1_emit(ctx, h.prefix, h.prefix_n, st);
+            if (n_dec >= 0)
+                t1_exec_dec(ctx, dec, n_dec, st);
+            else
+                t1_emit(ctx, h.prefix, h.prefix_n, st);
             if (h.has_pivot2) {
                 if (st.ok) {
-                    T1State rst = st;
+                    T1State rst;
+                    t1_copy(rst, st, C);
                     rst.cur = ctx.len;
                     int32_t floor_ =
                         st.cur + h.p1_min + h.mid_fixed + h.p2_min;
@@ -1197,7 +1627,7 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                                 t1_all_member(ctx, h.p1_cls, lo1, p) &&
                                 t1_all_member(ctx, h.p2_cls, lo2, hi2)) {
                                 row_ok = true;
-                                final_st = rst;
+                                t1_copy(final_st, rst, C);
                                 for (int32_t k = 0; k < h.n_mid_end; ++k) {
                                     int32_t id = h.mid_end_ids[k];
                                     final_st.cap_off[id] = st.cap_off[id];
@@ -1215,7 +1645,8 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                 }
             } else if (h.has_pivot) {
                 if (st.ok) {
-                    T1State rst = st;
+                    T1State rst;
+                    t1_copy(rst, st, C);
                     rst.cur = ctx.len;
                     t1_emit_rev(ctx, h.suffix, h.suffix_n, rst,
                                 st.cur + h.p1_min);
@@ -1225,7 +1656,7 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                             (h.p1_max < 0 || run <= h.p1_max) &&
                             t1_all_member(ctx, h.p1_cls, st.cur, rst.cur)) {
                             row_ok = true;
-                            final_st = rst;
+                            t1_copy(final_st, rst, C);
                             for (int32_t k = 0; k < h.n_split; ++k) {
                                 int32_t id = h.split_ids[k];
                                 final_st.cap_off[id] = st.cap_start[id];
@@ -1237,7 +1668,7 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                 }
             } else {
                 row_ok = st.ok && st.cur == ctx.len;
-                final_st = st;
+                t1_copy(final_st, st, C);
             }
         }
         ok_out[r] = row_ok ? 1 : 0;

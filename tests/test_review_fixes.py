@@ -239,3 +239,63 @@ class TestAdviceRound1:
         t.join(timeout=10)
         assert not t.is_alive(), "send loop deadlocked"
         assert len(sent) == 6
+
+
+class TestSpanMatrixStaleness:
+    def test_rename_after_parse_invalidates_matrix_fast_path(self):
+        """A processor that mutates cols.fields directly (rename/drop)
+        bypasses set_field invalidation; the serializer must detect the
+        stale span_matrix and emit the CURRENT field names."""
+        import numpy as np
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.pipeline.serializer.sls_serializer import (
+            SLSEventGroupSerializer, parse_loggroup)
+        from loongcollector_tpu.processor.parse_regex import ProcessorParseRegex
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+
+        data = b"alpha beta\ngamma delta\n"
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+        ctx = PluginContext("t")
+        sp = ProcessorSplitLogString(); sp.init({}, ctx)
+        pr = ProcessorParseRegex()
+        pr.init({"Regex": r"(\S+) (\S+)", "Keys": ["a", "b"]}, ctx)
+        sp.process(g)
+        pr.process(g)
+        cols = g.columns
+        # direct-dict rename, as processor_rename does
+        cols.fields["renamed"] = cols.fields.pop("a")
+        out = SLSEventGroupSerializer().serialize([g])
+        back = parse_loggroup(bytes(out))
+        keys = {bytes(k) for ev in back.events for k, _ in ev.contents}
+        assert b"renamed" in keys and b"a" not in keys
+
+    def test_matrix_fast_path_used_when_fields_untouched(self):
+        import numpy as np
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.pipeline.serializer.sls_serializer import (
+            SLSEventGroupSerializer, parse_loggroup)
+        from loongcollector_tpu.processor.parse_regex import ProcessorParseRegex
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+
+        data = b"alpha beta\ngamma delta\n"
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+        ctx = PluginContext("t")
+        sp = ProcessorSplitLogString(); sp.init({}, ctx)
+        pr = ProcessorParseRegex()
+        pr.init({"Regex": r"(\S+) (\S+)", "Keys": ["a", "b"]}, ctx)
+        sp.process(g)
+        pr.process(g)
+        assert g.columns.span_matrix is not None
+        ser = SLSEventGroupSerializer()
+        assert ser._matrix_is_current(g.columns, g.columns.span_matrix)
+        back = parse_loggroup(bytes(ser.serialize([g])))
+        vals = {bytes(v) for ev in back.events for _, v in ev.contents}
+        assert {b"alpha", b"beta", b"gamma", b"delta"} <= vals
