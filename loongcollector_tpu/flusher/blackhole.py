@@ -29,7 +29,9 @@ class FlusherBlackHole(Flusher):
     def send(self, group: PipelineEventGroup) -> bool:
         self.total_events += len(group)
         if self.serialize:
-            self.total_bytes += len(self.serializer.serialize([group]))
+            # serialize_view: measure the REAL wire cost without paying a
+            # payload copy the blackhole would immediately discard
+            self.total_bytes += len(self.serializer.serialize_view([group]))
         else:
             self.total_bytes += group.data_size()
         return True

@@ -143,7 +143,9 @@ class FlusherHTTP(Flusher):
         if self._encoder_ext is not None:
             data = self._encoder_ext.encode(groups)
         else:
-            data = self.serializer.serialize(groups)
+            # view path: the compressor consumes the serializer's buffer
+            # directly (SLS returns a memoryview; others return bytes)
+            data = self.serializer.serialize_view(groups)
         raw_size = len(data)
         payload = self.compressor.compress(data)
         item = SenderQueueItem(payload, raw_size, flusher=self,

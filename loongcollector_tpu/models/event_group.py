@@ -76,9 +76,10 @@ class ColumnarLogs:
         self.content_consumed = False
         # serializer fast path: when the parse kernel's [N, F] span matrices
         # cover the field dict exactly, serialization reads them directly
-        # (no per-field slicing / restacking).  (names, off_mat, len_mat);
-        # any later set_field invalidates it.
-        self.span_matrix: Optional[Tuple[List, np.ndarray, np.ndarray]] = None
+        # (no per-field slicing / restacking).  (names, off_mat, len_mat,
+        # column_view_tuples); any later set_field invalidates it.
+        self.span_matrix: Optional[
+            Tuple[List, np.ndarray, np.ndarray, List]] = None
 
     def __len__(self) -> int:
         return int(self.offsets.shape[0])

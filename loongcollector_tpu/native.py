@@ -201,8 +201,10 @@ def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
     F = len(keys)
     n = len(timestamps)
     sf, si = (1, F) if event_major else (n, 1)
-    cap = int(field_lens.clip(min=0).sum()
-              + n * (int(key_lens.sum()) + 12 * F + 16) + 64)
+    # cheap capacity bound: field values live in the arena, so arena_len
+    # covers Σvlen unless spans overlap (keep-source cases) — then the call
+    # returns -needed and the exact-size retry below handles it
+    cap = int(len(arena) + n * (int(key_lens.sum()) + 12 * F + 16) + 64)
 
     def call(buf, buf_cap):
         if event_major:

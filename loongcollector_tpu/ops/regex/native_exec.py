@@ -199,7 +199,8 @@ class NativeT1Executor:
             u8(ok), i32(cap_off), i32(cap_len))
         if rc != 0:
             raise NativeUnsupported(f"lct_t1_exec rc={rc}")
-        return ok.astype(bool), cap_off, cap_len
+        # zero-copy reinterpret: the executor writes strictly 0/1
+        return ok.view(np.bool_), cap_off, cap_len
 
 
 def try_build(program: SegmentProgram) -> Optional[NativeT1Executor]:

@@ -21,10 +21,12 @@ class Compressor:
     name = "none"
 
     def compress(self, data: bytes) -> bytes:
-        return data
+        # serializers may hand over a memoryview; uncompressed payloads are
+        # long-lived (sender queue, spill) so materialize here
+        return data if isinstance(data, bytes) else bytes(data)
 
     def decompress(self, data: bytes, raw_size: int = 0) -> bytes:
-        return data
+        return data if isinstance(data, bytes) else bytes(data)
 
 
 class ZlibCompressor(Compressor):

@@ -20,6 +20,12 @@ from ...models.events import metric_name_str as _name_str
 class JsonSerializer:
     name = "json"
 
+    def serialize_view(self, groups: List[PipelineEventGroup]):
+        """Serializer-interface hook: may return a memoryview when a
+        zero-copy path exists (see SLSEventGroupSerializer); here it is
+        just serialize()."""
+        return self.serialize(groups)
+
     def serialize(self, groups: List[PipelineEventGroup]) -> bytes:
         out: List[str] = []
         for group in groups:

@@ -55,8 +55,12 @@ class SLSEventGroupSerializer:
         self.machine_uuid = machine_uuid
 
     def serialize(self, groups: List[PipelineEventGroup]) -> bytes:
-        # parts are joined exactly once at the end; the native payload part
-        # is a memoryview over the native output buffer (zero interim copies)
+        return b"".join(self._parts(groups))
+
+    def _parts(self, groups: List[PipelineEventGroup]) -> List:
+        # parts are joined exactly once by the caller; the native payload
+        # part is a memoryview over the native output buffer (zero interim
+        # copies)
         parts: List = []
         for group in groups:
             cols = group.columns
@@ -85,6 +89,16 @@ class SLSEventGroupSerializer:
             parts.append(_len_delim(4, self.source))
         if self.machine_uuid:
             parts.append(_len_delim(5, self.machine_uuid))
+        return parts
+
+    def serialize_view(self, groups: List[PipelineEventGroup]):
+        """Like serialize(), but may return a memoryview over the native
+        output buffer when the payload is a single part (no tags/topic) —
+        hot sinks (blackhole, SLS→LZ4) avoid one full-payload copy.  The
+        result supports len()/buffer protocol but NOT bytes concatenation."""
+        parts = self._parts(groups)
+        if len(parts) == 1:
+            return parts[0]
         return b"".join(parts)
 
     def _log(self, ev: LogEvent) -> bytes:

@@ -70,9 +70,13 @@ class ProcessorParseRegex(Processor):
             ncap = self.engine.num_caps
             nkeys = min(ncap, len(self.keys))
             # one [N, C] mask instead of per-field slicing; the matrices feed
-            # the serializer directly (ColumnarLogs.span_matrix fast path)
-            len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
-                               np.int32(-1))
+            # the serializer directly (ColumnarLogs.span_matrix fast path).
+            # All-matched groups (the common steady state) skip the mask copy.
+            if ok.all():
+                len_mat = res.cap_len[:, :nkeys]
+            else:
+                len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
+                                   np.int32(-1))
             cols.set_fields_matrix(self.keys[:nkeys],
                                    res.cap_off[:, :nkeys], len_mat)
             # source retention

@@ -1566,6 +1566,22 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
     T1DecOp dec[kT1MaxDecOps];
     int32_t n_dec = t1_decode(h.prefix, h.prefix_n, dec);
 
+    // full coverage: a linear decoded program (no OPT/ALT, no pivots) whose
+    // FIELD/CAPEND ops unconditionally write every capture slot — per-row
+    // capture init can then be skipped entirely
+    bool full_cov = false;
+    if (n_dec >= 0 && !h.has_pivot && !h.has_pivot2 && C <= 32) {
+        uint64_t covered = 0;
+        bool simple = true;
+        for (int32_t k = 0; k < n_dec; ++k) {
+            if (dec[k].kind == 7 || dec[k].kind == 4)
+                covered |= 1ull << dec[k].a;
+            else if (dec[k].kind >= 5)
+                simple = false;
+        }
+        full_cov = simple && covered == ((1ull << C) - 1);
+    }
+
     T1Ctx ctx{nullptr, 0, classes, lit_blob, lit_offs, lit_lens, cinfo, C};
     for (int64_t r = 0; r < n; ++r) {
         int64_t off = offsets[r];
@@ -1573,16 +1589,19 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         if (len < 0) len = 0;
         bool row_ok = false;
         T1State final_st;
+        T1State st;
+        const T1State* outst = &final_st;
         if (off >= 0 && off + len <= arena_len && len <= INT32_MAX) {
             ctx.row = arena + off;
             ctx.len = (int32_t)len;
-            T1State st;
             st.cur = 0;
             st.ok = true;
-            for (int32_t k = 0; k < C; ++k) {
-                st.cap_off[k] = 0;
-                st.cap_len[k] = -1;
-                st.cap_start[k] = 0;
+            if (!full_cov) {
+                for (int32_t k = 0; k < C; ++k) {
+                    st.cap_off[k] = 0;
+                    st.cap_len[k] = -1;
+                    st.cap_start[k] = 0;
+                }
             }
             if (n_dec >= 0)
                 t1_exec_dec(ctx, dec, n_dec, st);
@@ -1668,14 +1687,22 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                 }
             } else {
                 row_ok = st.ok && st.cur == ctx.len;
-                t1_copy(final_st, st, C);
+                outst = &st;  // no pivot: emit straight from the walk state
             }
         }
         ok_out[r] = row_ok ? 1 : 0;
-        for (int32_t k = 0; k < C; ++k) {
-            cap_off_out[r * C + k] =
-                (int32_t)off + (row_ok ? final_st.cap_off[k] : 0);
-            cap_len_out[r * C + k] = row_ok ? final_st.cap_len[k] : -1;
+        int32_t* co = cap_off_out + r * C;
+        int32_t* cl = cap_len_out + r * C;
+        if (row_ok) {
+            for (int32_t k = 0; k < C; ++k) {
+                co[k] = (int32_t)off + outst->cap_off[k];
+                cl[k] = outst->cap_len[k];
+            }
+        } else {
+            for (int32_t k = 0; k < C; ++k) {
+                co[k] = (int32_t)off;
+                cl[k] = -1;
+            }
         }
     }
     return 0;

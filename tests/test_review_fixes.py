@@ -246,7 +246,6 @@ class TestSpanMatrixStaleness:
         """A processor that mutates cols.fields directly (rename/drop)
         bypasses set_field invalidation; the serializer must detect the
         stale span_matrix and emit the CURRENT field names."""
-        import numpy as np
         from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
         from loongcollector_tpu.pipeline.plugin.interface import PluginContext
         from loongcollector_tpu.pipeline.serializer.sls_serializer import (
@@ -274,7 +273,6 @@ class TestSpanMatrixStaleness:
         assert b"renamed" in keys and b"a" not in keys
 
     def test_matrix_fast_path_used_when_fields_untouched(self):
-        import numpy as np
         from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
         from loongcollector_tpu.pipeline.plugin.interface import PluginContext
         from loongcollector_tpu.pipeline.serializer.sls_serializer import (
