@@ -1373,53 +1373,22 @@ inline bool t1_lit_at(const T1Ctx& c, int32_t li, int32_t pos) {
     return memcmp(rp, lp, k) == 0;
 }
 
-// Decode + fuse a validated op stream.  Returns op count, or -1 when the
-// stream exceeds the decode buffer (caller falls back to the interpreter).
-int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
-    int32_t n = 0;
-    int64_t i = 0;
-    while (i < nw) {
-        if (n >= kT1MaxDecOps) return -1;
-        T1DecOp& o = ops[n++];
-        o.lit = -1;
-        o.w = nullptr;
-        o.wn = 0;
-        switch (w[i]) {
-        case 0:
-            o.kind = 0; o.a = w[i + 1]; i += 2;
-            break;
-        case 1:
-            o.kind = 1; o.a = w[i + 1]; o.b = w[i + 2]; o.c2 = w[i + 3];
-            i += 5;
-            break;
-        case 2:
-            o.kind = 2; o.a = w[i + 1]; o.b = w[i + 2]; i += 3;
-            break;
-        case 3:
-        case 4:
-            o.kind = w[i]; o.a = w[i + 1]; i += 2;
-            break;
-        case 5: {
-            int32_t bw = w[i + 1];
-            o.kind = 5; o.w = w + i; o.wn = 2 + bw;
-            i += 2 + bw;
-            break;
-        }
-        case 6: {
-            int32_t nb = w[i + 1];
-            int64_t j = i + 2;
-            for (int32_t b = 0; b < nb; ++b) j += 1 + w[j];
-            o.kind = 6; o.w = w + i; o.wn = (int32_t)(j - i);
-            i = j;
-            break;
-        }
-        default:
-            return -1;
-        }
-    }
-    // fusion: CAPSTART id / SPAN / CAPEND id [/ LIT]  →  FIELD
-    int32_t out = 0;
-    for (int32_t k = 0; k < n;) {
+// Decode + fuse a validated op stream into `ops[*n_ops..]`.  Nested OPT/ALT
+// bodies decode recursively into the same array directly after their parent
+// op: OPT stores its child count in .b; ALT stores its branch count in .a
+// and each branch is a BRANCH marker (kind 9) whose .b is that branch's op
+// count.  Returns the number of ops in THIS stream (excluding descendants'
+// entries... callers use the returned count plus each child's subtree size
+// via .d = total subtree ops).  Returns -1 when the buffer is exceeded.
+int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
+                       int32_t* n_ops);
+
+// Fuse CAPSTART/SPAN/CAPEND[/LIT] → FIELD over a just-decoded flat RANGE
+// [from, *n_ops) that contains no nested ops (caller guarantees).
+static void t1_fuse_range(T1DecOp* ops, int32_t from, int32_t* n_ops) {
+    int32_t out = from;
+    int32_t n = *n_ops;
+    for (int32_t k = from; k < n;) {
         if (k + 2 < n && ops[k].kind == 3 && ops[k + 1].kind == 1 &&
             ops[k + 2].kind == 4 && ops[k].a == ops[k + 2].a) {
             T1DecOp f;
@@ -1441,12 +1410,114 @@ int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
             ops[out++] = ops[k++];
         }
     }
-    return out;
+    *n_ops = out;
 }
 
-void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t nops,
-                 T1State& st) {
-    for (int32_t oi = 0; oi < nops; ++oi) {
+int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
+                       int32_t* n_ops) {
+    int64_t i = 0;
+    int32_t flat_from = *n_ops;   // start of the current fuse window
+    while (i < nw) {
+        if (*n_ops >= kT1MaxDecOps) return -1;
+        switch (w[i]) {
+        case 0: {
+            T1DecOp& o = ops[(*n_ops)++];
+            o.kind = 0; o.a = w[i + 1]; o.lit = -1; i += 2;
+            break;
+        }
+        case 1: {
+            T1DecOp& o = ops[(*n_ops)++];
+            o.kind = 1; o.a = w[i + 1]; o.b = w[i + 2]; o.c2 = w[i + 3];
+            o.lit = -1;
+            i += 5;
+            break;
+        }
+        case 2: {
+            T1DecOp& o = ops[(*n_ops)++];
+            o.kind = 2; o.a = w[i + 1]; o.b = w[i + 2]; o.lit = -1; i += 3;
+            break;
+        }
+        case 3:
+        case 4: {
+            T1DecOp& o = ops[(*n_ops)++];
+            o.kind = w[i]; o.a = w[i + 1]; o.lit = -1; i += 2;
+            break;
+        }
+        case 5: {
+            // fuse the flat run so far, then decode the body inline
+            t1_fuse_range(ops, flat_from, n_ops);
+            int32_t self = (*n_ops)++;
+            if (self >= kT1MaxDecOps) return -1;
+            ops[self].kind = 5;
+            ops[self].lit = -1;
+            int32_t bw = w[i + 1];
+            int32_t child_from = *n_ops;
+            if (t1_decode_into(w + i + 2, bw, ops, n_ops) < 0) return -1;
+            ops[self].b = *n_ops - child_from;   // children (subtree) size
+            i += 2 + bw;
+            flat_from = *n_ops;
+            break;
+        }
+        case 6: {
+            int32_t nb = w[i + 1];
+            int64_t j = i + 2;
+            bool all_lit = true;
+            for (int32_t b = 0; b < nb; ++b) {
+                if (w[j] != 2 || w[j + 1] != 0) all_lit = false;
+                j += 1 + w[j];
+            }
+            if (all_lit) {
+                // all-literal alternation (grok MONTH/LOGLEVEL style):
+                // first matching literal wins — no trial state copies
+                T1DecOp& o = ops[(*n_ops)++];
+                o.kind = 8;
+                o.lit = -1;
+                o.w = w + i;
+                o.wn = (int32_t)(j - i);
+                i = j;
+                break;
+            }
+            t1_fuse_range(ops, flat_from, n_ops);
+            int32_t self = (*n_ops)++;
+            if (self >= kT1MaxDecOps) return -1;
+            ops[self].kind = 6;
+            ops[self].a = nb;
+            ops[self].lit = -1;
+            j = i + 2;
+            for (int32_t b = 0; b < nb; ++b) {
+                int32_t marker = (*n_ops)++;
+                if (marker >= kT1MaxDecOps) return -1;
+                ops[marker].kind = 9;   // BRANCH
+                ops[marker].lit = -1;
+                int32_t bw = w[j];
+                int32_t child_from = *n_ops;
+                if (t1_decode_into(w + j + 1, bw, ops, n_ops) < 0)
+                    return -1;
+                ops[marker].b = *n_ops - child_from;
+                j += 1 + bw;
+            }
+            ops[self].b = *n_ops - self - 1;   // whole subtree size
+            i = j;
+            flat_from = *n_ops;
+            break;
+        }
+        default:
+            return -1;
+        }
+    }
+    t1_fuse_range(ops, flat_from, n_ops);
+    return *n_ops;
+}
+
+int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
+    int32_t n = 0;
+    if (t1_decode_into(w, nw, ops, &n) < 0) return -1;
+    return n;
+}
+
+void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t from,
+                 int32_t to, T1State& st) {
+    for (int32_t oi = from; oi < to; ++oi) {
         const T1DecOp& o = ops[oi];
         switch (o.kind) {
         case 7: {  // FIELD
@@ -1507,10 +1578,56 @@ void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t nops,
             st.cap_off[o.a] = st.cap_start[o.a];
             st.cap_len[o.a] = st.cur - st.cap_start[o.a];
             break;
-        default:  // OPT / ALT: word interpreter on the single op
-            t1_emit(c, o.w, o.wn, st);
-            if (!st.ok) return;
+        case 8: {  // all-literal ALT: first literal matching at cur wins
+            const int32_t* aw = o.w;
+            int32_t nb = aw[1];
+            const int32_t* q = aw + 2;   // per branch: [bw=2, 0, lit_idx]
+            bool hit = false;
+            for (int32_t b = 0; b < nb; ++b, q += 3) {
+                int32_t li = q[2];
+                if (t1_lit_at(c, li, st.cur)) {
+                    st.cur += c.lit_lens[li];
+                    hit = true;
+                    break;
+                }
+            }
+            if (!hit) { st.ok = false; return; }
             break;
+        }
+        case 5: {  // OPT: children decoded inline right after this op
+            T1State save;
+            t1_copy(save, st, c.ncaps);
+            t1_exec_dec(c, ops, oi + 1, oi + 1 + o.b, st);
+            if (!st.ok) t1_copy(st, save, c.ncaps);  // save.ok was true
+            oi += o.b;
+            break;
+        }
+        case 6: {  // ALT: BRANCH markers + bodies decoded inline
+            T1State before;
+            t1_copy(before, st, c.ncaps);
+            int32_t end = oi + 1 + o.b;
+            int32_t bi = oi + 1;
+            bool chosen = false;
+            for (int32_t b = 0; b < o.a; ++b) {
+                int32_t bn = ops[bi].b;
+                if (!chosen) {
+                    T1State trial;
+                    t1_copy(trial, before, c.ncaps);
+                    t1_exec_dec(c, ops, bi + 1, bi + 1 + bn, trial);
+                    if (trial.ok) {
+                        t1_copy(st, trial, c.ncaps);
+                        chosen = true;
+                    }
+                }
+                bi += 1 + bn;
+            }
+            oi = end - 1;
+            if (!chosen) { st.ok = false; return; }
+            break;
+        }
+        default:
+            st.ok = false;  // unreachable with a well-formed decode
+            return;
         }
     }
 }
@@ -1576,8 +1693,8 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         for (int32_t k = 0; k < n_dec; ++k) {
             if (dec[k].kind == 7 || dec[k].kind == 4)
                 covered |= 1ull << dec[k].a;
-            else if (dec[k].kind >= 5)
-                simple = false;
+            else if (dec[k].kind == 5 || dec[k].kind == 6)
+                simple = false;  // kind 8 (LITALT) never touches captures
         }
         full_cov = simple && covered == ((1ull << C) - 1);
     }
@@ -1604,7 +1721,7 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
                 }
             }
             if (n_dec >= 0)
-                t1_exec_dec(ctx, dec, n_dec, st);
+                t1_exec_dec(ctx, dec, 0, n_dec, st);
             else
                 t1_emit(ctx, h.prefix, h.prefix_n, st);
             if (h.has_pivot2) {
