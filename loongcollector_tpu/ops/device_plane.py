@@ -1,0 +1,314 @@
+"""Async overlapped host↔device data plane.
+
+The reference overlaps every pipeline stage with dedicated threads and queue
+hops (core/runner/ProcessorRunner.cpp:90-189, core/runner/FlusherRunner.cpp:168);
+its BoundedProcessQueue watermarks gate the producers
+(core/collection_pipeline/queue/BoundedProcessQueue.cpp:89-93).  The TPU
+analogue (SURVEY.md §7 step 4, §5.8) is this plane: device kernel dispatches
+are ASYNC (jax returns device buffers immediately; computation proceeds in the
+background), so the host packs and dispatches chunk N+1 while the device
+executes chunk N, and materialises results strictly as needed.
+
+Back-pressure contract: every dispatch acquires from a process-wide in-flight
+byte budget and releases it on materialisation.  When the device stalls (or a
+tunnel wedges), the budget fills, `submit` blocks, the runner thread stops
+popping, the bounded process queues hit their high watermark, and the file
+inputs get feedback-blocked — the exact chain the reference builds between
+FlusherRunner, the sender queues and the process queues, extended one hop
+further onto the device.
+
+Nothing here imports jax: the plane is agnostic to WHAT is dispatched — it
+only requires that calling the kernel is cheap (async dispatch) and that
+`numpy.asarray` on the returned buffers blocks until the device is done.
+That contract holds for jax on every backend and for the latency-injection
+test kernel below.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logger import get_logger
+
+log = get_logger("device_plane")
+
+_DEFAULT_BUDGET = 64 * 1024 * 1024  # bytes of packed rows in flight
+
+_tls = threading.local()
+
+
+def set_budget_relief(fn: Optional[Callable[[], bool]]) -> None:
+    """Register this thread's last-resort budget releaser.  While a thread
+    waits for budget in `submit`, the plane first lets the in-dispatch
+    PendingParse drain its own chunks (`on_wait`); if that owns nothing, the
+    relief hook runs — the ProcessorRunner registers one that completes the
+    overlapped group it still holds.  Together they enforce the no-deadlock
+    invariant: a thread waiting for budget never holds unmaterialised
+    futures it cannot release itself."""
+    _tls.relief = fn
+
+
+def _budget_from_env() -> int:
+    try:
+        return int(os.environ.get("LOONG_DEVICE_INFLIGHT_BYTES",
+                                  _DEFAULT_BUDGET))
+    except ValueError:
+        return _DEFAULT_BUDGET
+
+
+class DeviceFuture:
+    """A dispatched kernel call whose results are not yet materialised.
+
+    `result()` converts the device buffers to numpy (blocking until the
+    device finishes) and releases the plane budget exactly once.  If the
+    kernel raised at dispatch or materialisation, the error is surfaced from
+    `result()` so callers keep the reference's fail-at-consume semantics
+    (engine.py routes Mosaic failures to the XLA path there).
+    """
+
+    __slots__ = ("_plane", "_nbytes", "_outputs", "_error", "_done",
+                 "_materialised")
+
+    def __init__(self, plane: "DevicePlane", nbytes: int,
+                 outputs: Optional[Sequence] = None,
+                 error: Optional[BaseException] = None):
+        self._plane = plane
+        self._nbytes = nbytes
+        self._outputs = outputs
+        self._error = error
+        self._done = False
+        self._materialised: Optional[List[np.ndarray]] = None
+
+    def result(self) -> List[np.ndarray]:
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            return self._materialised  # type: ignore[return-value]
+        try:
+            if self._error is not None:
+                raise self._error
+            self._materialised = [np.asarray(o) for o in self._outputs]
+            return self._materialised
+        except BaseException as e:  # noqa: BLE001 — record, release, re-raise
+            self._error = e
+            raise
+        finally:
+            self._done = True
+            self._outputs = None
+            self._plane._release(self._nbytes)
+
+
+class DevicePlane:
+    """Process-wide async dispatch gate with an in-flight byte budget."""
+
+    _instance: Optional["DevicePlane"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self.budget_bytes = budget_bytes or _budget_from_env()
+        self._inflight = 0
+        self._dispatched = 0
+        self._lock = threading.Lock()
+        self._freed = threading.Condition(self._lock)
+        self._closed = False
+
+    @classmethod
+    def instance(cls) -> "DevicePlane":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_for_testing(cls, budget_bytes: Optional[int] = None) -> "DevicePlane":
+        with cls._instance_lock:
+            cls._instance = cls(budget_bytes)
+            return cls._instance
+
+    # -- budget -------------------------------------------------------------
+
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def dispatched_total(self) -> int:
+        with self._lock:
+            return self._dispatched
+
+    def over_budget(self) -> bool:
+        with self._lock:
+            return self._inflight >= self.budget_bytes
+
+    def would_block(self, nbytes: int) -> bool:
+        """True when submit(nbytes) would have to wait for budget.  Dispatch
+        loops that hold unmaterialised futures MUST consult this and drain
+        their own oldest future first — never sleep in submit while owning
+        the budget you are waiting for."""
+        with self._lock:
+            return (self._inflight + nbytes > self.budget_bytes
+                    and self._inflight > 0)
+
+    def _acquire(self, nbytes: int,
+                 should_abort: Optional[Callable[[], bool]] = None,
+                 on_wait: Optional[Callable[[], bool]] = None) -> None:
+        """Block until `nbytes` fits in the budget.  A single dispatch larger
+        than the whole budget is admitted when nothing is in flight (it could
+        otherwise never run).  This blocking IS the device back-pressure: the
+        caller is a runner thread, and while it waits the bounded process
+        queues upstream fill to their high watermark.
+
+        `on_wait` is called OUTSIDE the lock on every wait iteration; a
+        caller that owns unmaterialised futures must drain one there and
+        return True (False = nothing owned).  That rule makes the budget
+        deadlock-free: every waiting thread can always release the budget it
+        itself holds, so some thread always makes progress."""
+        while True:
+            with self._freed:
+                if self._closed or \
+                        self._inflight + nbytes <= self.budget_bytes or \
+                        self._inflight == 0:
+                    self._inflight += nbytes
+                    self._dispatched += 1
+                    return
+                if should_abort is not None and should_abort():
+                    raise DispatchAborted()
+            progressed = on_wait() if on_wait is not None else False
+            if not progressed:
+                relief = getattr(_tls, "relief", None)
+                progressed = bool(relief()) if relief is not None else False
+            if not progressed:
+                with self._freed:
+                    self._freed.wait(timeout=0.05)
+
+    def _release(self, nbytes: int) -> None:
+        with self._freed:
+            self._inflight = max(0, self._inflight - nbytes)
+            self._freed.notify_all()
+
+    def close(self) -> None:
+        with self._freed:
+            self._closed = True
+            self._freed.notify_all()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def submit(self, kernel: Callable, args: Sequence, nbytes: int,
+               should_abort: Optional[Callable[[], bool]] = None,
+               on_wait: Optional[Callable[[], bool]] = None
+               ) -> DeviceFuture:
+        """Dispatch `kernel(*args)` asynchronously under the byte budget.
+
+        Returns a DeviceFuture immediately (the device computes in the
+        background).  A kernel that raises AT DISPATCH produces an errored
+        future rather than raising here, so a multi-chunk dispatch loop keeps
+        its bookkeeping simple and errors surface at the (ordered)
+        materialisation point."""
+        self._acquire(nbytes, should_abort, on_wait)
+        try:
+            outputs = kernel(*args)
+            if not isinstance(outputs, (tuple, list)):
+                outputs = (outputs,)
+            return DeviceFuture(self, nbytes, outputs=outputs)
+        except DispatchAborted:
+            self._release(nbytes)
+            raise
+        except BaseException as e:  # noqa: BLE001 — deliver via result()
+            return DeviceFuture(self, nbytes, error=e)
+
+
+class DispatchAborted(RuntimeError):
+    """Raised by submit() when the caller's should_abort() fired while
+    waiting for budget (pipeline stopping)."""
+
+
+# ---------------------------------------------------------------------------
+# Latency-injection kernel: the CPU-testable stand-in for a remote device.
+
+
+class LatencyInjectedArray:
+    """Numpy-convertible handle that blocks until a deadline — models an
+    async device buffer whose computation completes `rtt` after dispatch."""
+
+    __slots__ = ("_value", "_deadline")
+
+    def __init__(self, value: np.ndarray, deadline: float):
+        self._value = value
+        self._deadline = deadline
+
+    def block_until_ready(self) -> "LatencyInjectedArray":
+        now = time.perf_counter()
+        if now < self._deadline:
+            time.sleep(self._deadline - now)
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        self.block_until_ready()
+        if dtype is not None:
+            return self._value.astype(dtype)
+        return self._value
+
+
+class LatencyInjectedKernel:
+    """Wraps a synchronous kernel so that dispatch returns instantly and
+    materialisation blocks for `rtt_s` — an honest model of a (possibly
+    tunneled) accelerator.  `concurrency=1` models a device that executes
+    one dispatch at a time: each call's deadline starts after the previous
+    call's, exactly like a device execution stream."""
+
+    def __init__(self, inner: Callable, rtt_s: float, serialize: bool = True):
+        self.inner = inner
+        self.rtt_s = rtt_s
+        self.serialize = serialize
+        self._stream_free_at = 0.0
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def __call__(self, *args):
+        outs = self.inner(*args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        now = time.perf_counter()
+        with self._lock:
+            self.calls += 1
+            if self.serialize:
+                start = max(now, self._stream_free_at)
+                deadline = start + self.rtt_s
+                self._stream_free_at = deadline
+            else:
+                deadline = now + self.rtt_s
+        return tuple(LatencyInjectedArray(np.asarray(o), deadline)
+                     for o in outs)
+
+
+class StallableKernel(LatencyInjectedKernel):
+    """Latency kernel whose completions can be held indefinitely — for
+    watermark-under-stalled-device tests."""
+
+    def __init__(self, inner: Callable, rtt_s: float = 0.0):
+        super().__init__(inner, rtt_s)
+        self._stalled = threading.Event()
+        self._stalled.set()  # set = running
+
+    def stall(self) -> None:
+        self._stalled.clear()
+
+    def unstall(self) -> None:
+        self._stalled.set()
+
+    def __call__(self, *args):
+        outs = super().__call__(*args)
+        ev = self._stalled
+
+        class _Gate(LatencyInjectedArray):
+            __slots__ = ()
+
+            def block_until_ready(self):
+                ev.wait()
+                return super().block_until_ready()
+
+        return tuple(_Gate(o._value, o._deadline) for o in outs)

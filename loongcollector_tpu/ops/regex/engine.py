@@ -262,10 +262,18 @@ class RegexEngine:
 
     # ------------------------------------------------------------------
 
+    def set_device_kernel_override(self, kern) -> None:
+        """Test/diagnostic hook: route this engine's device dispatches
+        through `kern` (e.g. a LatencyInjectedKernel modelling a remote
+        chip).  None restores normal selection."""
+        self._kernel_override = kern
+
     def _device_kernel(self):
         """Segment-tier kernel selection: fused Pallas on TPU (one VMEM
         pass per row block), XLA fusion elsewhere. Resolved once per
         engine; both paths are differentially fuzzed against each other."""
+        if getattr(self, "_kernel_override", None) is not None:
+            return self._kernel_override
         if self._use_pallas is None:
             forced = _pallas_enabled()
             if forced is not None:
@@ -294,6 +302,20 @@ class RegexEngine:
     def parse_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> BatchParseResult:
         """Full-match + captures for N events over a shared arena."""
+        return self.parse_batch_async(arena, offsets, lengths).result()
+
+    def parse_batch_async(self, arena: np.ndarray, offsets: np.ndarray,
+                          lengths: np.ndarray) -> "PendingParse":
+        """Dispatch the parse; `result()` on the returned handle materialises.
+
+        The async device data plane (SURVEY §7 step 4): each device chunk is
+        dispatched through DevicePlane under the in-flight byte budget, and
+        the host packs chunk N+1 while the device executes chunk N.  Callers
+        that hold the PendingParse (runner overlap mode) get cross-group
+        overlap too: the device computes group N while the host runs group
+        N-1's downstream processors and group N+1's pack.  Host-walker and
+        CPU-tier routing are unchanged — those paths return an
+        already-materialised PendingParse."""
         offsets = np.asarray(offsets, dtype=np.int64)
         lengths = np.asarray(lengths, dtype=np.int32)
         n = len(offsets)
@@ -312,12 +334,13 @@ class RegexEngine:
                 nat = self._host_walker()
                 if nat is not None:
                     k_ok, k_off, k_len = nat(arena, offsets, lengths)
-                    return BatchParseResult(k_ok, k_off, k_len)
+                    return PendingParse.ready(
+                        BatchParseResult(k_ok, k_off, k_len))
         ok = np.zeros(n, dtype=bool)
         cap_off = np.zeros((n, C), dtype=np.int32)
         cap_len = np.full((n, C), -1, dtype=np.int32)
         if n == 0:
-            return BatchParseResult(ok, cap_off, cap_len)
+            return PendingParse.ready(BatchParseResult(ok, cap_off, cap_len))
 
         max_bucket = LENGTH_BUCKETS[-1]
         over = lengths > max_bucket
@@ -328,40 +351,14 @@ class RegexEngine:
             cpu_idx = np.arange(n)
             device_idx = np.array([], dtype=np.int64)
 
-        kern = self._device_kernel() if len(device_idx) else None
-        for chunk in _chunks(device_idx, MAX_BATCH):
-            d_off = offsets[chunk]
-            d_len = lengths[chunk]
-            L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) or max_bucket
-            batch = pack_rows(arena, d_off, d_len, L)
-            try:
-                k_ok, k_off, k_len = kern(batch.rows, batch.lengths)
-                # materialise INSIDE the guard: async device execution
-                # surfaces runtime faults here, not at dispatch
-                k_ok = np.asarray(k_ok)
-                k_off = np.asarray(k_off)
-                k_len = np.asarray(k_len)
-            except Exception:  # noqa: BLE001
-                if kern is self._segment_kernel:
-                    raise
-                # Mosaic lowering failure must cost throughput, never
-                # liveness: pin this engine to the proven XLA path
-                from ...utils.logger import get_logger
-                get_logger("regex").exception(
-                    "pallas kernel failed for %r; falling back to XLA path",
-                    self.pattern)
-                self._use_pallas = False
-                kern = self._segment_kernel
-                k_ok, k_off, k_len = (np.asarray(a) for a in
-                                      kern(batch.rows, batch.lengths))
-            k_ok = k_ok[: batch.n_real]
-            k_off = k_off[: batch.n_real]
-            k_len = k_len[: batch.n_real]
-            ok[chunk] = k_ok
-            # row-relative → arena-absolute
-            cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
-            cap_len[chunk] = k_len
+        pending = PendingParse(self, arena, offsets, lengths,
+                               ok, cap_off, cap_len, cpu_idx)
+        if len(device_idx):
+            pending.dispatch(device_idx)
+        return pending
 
+    def _cpu_fallback_rows(self, arena, offsets, lengths, cpu_idx,
+                           ok, cap_off, cap_len) -> None:
         for i in cpu_idx:
             o, ln = int(offsets[i]), int(lengths[i])
             m = self._re.fullmatch(bytes(arena[o : o + ln].tobytes()))
@@ -372,7 +369,6 @@ class RegexEngine:
                     if s >= 0:
                         cap_off[i, g] = o + s
                         cap_len[i, g] = e - s
-        return BatchParseResult(ok, cap_off, cap_len)
 
     def match_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> np.ndarray:
@@ -419,3 +415,125 @@ class RegexEngine:
             o, ln = int(offsets[i]), int(lengths[i])
             ok[i] = self._re.fullmatch(bytes(arena[o : o + ln].tobytes())) is not None
         return ok
+
+
+class PendingParse:
+    """A parse whose device chunks are in flight.
+
+    Dispatch-ahead discipline: `dispatch()` packs and submits every device
+    chunk through the DevicePlane WITHOUT materialising — the device executes
+    chunk N while the host packs chunk N+1.  When the in-flight byte budget
+    would block a submit, the oldest owned future is drained first (never
+    sleep in submit while owning the budget you wait for — see
+    DevicePlane.would_block).  `result()` runs the CPU-tier fallback rows
+    (host work, overlapping the device), then materialises chunks in order.
+
+    Error semantics match the old synchronous loop: a Pallas/Mosaic failure
+    at materialisation pins the engine to the XLA path and re-runs that chunk
+    synchronously; failures on the XLA kernel itself propagate.
+    """
+
+    __slots__ = ("engine", "arena", "offsets", "lengths", "ok", "cap_off",
+                 "cap_len", "cpu_idx", "_chunks_pending", "_result", "kern")
+
+    def __init__(self, engine, arena, offsets, lengths, ok, cap_off, cap_len,
+                 cpu_idx):
+        self.engine = engine
+        self.arena = arena
+        self.offsets = offsets
+        self.lengths = lengths
+        self.ok = ok
+        self.cap_off = cap_off
+        self.cap_len = cap_len
+        self.cpu_idx = cpu_idx
+        self._chunks_pending = []      # [(chunk_idx, DeviceBatch, DeviceFuture)]
+        self._result = None
+        self.kern = None
+
+    @classmethod
+    def ready(cls, result: BatchParseResult) -> "PendingParse":
+        p = cls.__new__(cls)
+        p._result = result
+        p._chunks_pending = []
+        p.cpu_idx = ()
+        return p
+
+    @property
+    def done(self) -> bool:
+        return self._result is not None
+
+    def dispatch(self, device_idx: np.ndarray) -> None:
+        from ..device_plane import DevicePlane
+        plane = DevicePlane.instance()
+        self.kern = self.engine._device_kernel()
+        max_bucket = LENGTH_BUCKETS[-1]
+        for chunk in _chunks(device_idx, MAX_BATCH):
+            d_off = self.offsets[chunk]
+            d_len = self.lengths[chunk]
+            L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
+                or max_bucket
+            batch = pack_rows(self.arena, d_off, d_len, L)
+            fut = plane.submit(self.kern, (batch.rows, batch.lengths),
+                               batch.rows.nbytes,
+                               on_wait=self._drain_if_pending)
+            self._chunks_pending.append((chunk, batch, fut))
+
+    def _drain_if_pending(self) -> bool:
+        """Budget-wait hook: materialise our oldest in-flight chunk so the
+        bytes we hold are released while we wait (DevicePlane._acquire's
+        deadlock-freedom rule)."""
+        if not self._chunks_pending:
+            return False
+        self._drain_one()
+        return True
+
+    def _drain_one(self) -> None:
+        chunk, batch, fut = self._chunks_pending.pop(0)
+        try:
+            k_ok, k_off, k_len = fut.result()
+        except Exception:  # noqa: BLE001
+            if self.kern is self.engine._segment_kernel or \
+                    getattr(self.engine, "_kernel_override", None) is not None:
+                raise
+            # Mosaic lowering failure must cost throughput, never liveness:
+            # pin this engine to the proven XLA path and re-run the chunk
+            from ...utils.logger import get_logger
+            get_logger("regex").exception(
+                "pallas kernel failed for %r; falling back to XLA path",
+                self.engine.pattern)
+            self.engine._use_pallas = False
+            self.kern = self.engine._segment_kernel
+            k_ok, k_off, k_len = (np.asarray(a) for a in
+                                  self.kern(batch.rows, batch.lengths))
+        k_ok = k_ok[: batch.n_real]
+        k_off = k_off[: batch.n_real]
+        k_len = k_len[: batch.n_real]
+        self.ok[chunk] = k_ok
+        # row-relative -> arena-absolute
+        self.cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
+        self.cap_len[chunk] = k_len
+
+    def result(self) -> BatchParseResult:
+        if self._result is not None:
+            return self._result
+        # CPU-tier rows first: host work that overlaps in-flight device chunks
+        if len(self.cpu_idx):
+            self.engine._cpu_fallback_rows(
+                self.arena, self.offsets, self.lengths, self.cpu_idx,
+                self.ok, self.cap_off, self.cap_len)
+        try:
+            while self._chunks_pending:
+                self._drain_one()
+        except BaseException:
+            # a failed chunk must not leak the others' in-flight budget
+            for _, _, fut in self._chunks_pending:
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001 — releasing, not consuming
+                    pass
+            self._chunks_pending.clear()
+            raise
+        self._result = BatchParseResult(self.ok, self.cap_off, self.cap_len)
+        # drop references so the arena/batches free promptly
+        self.arena = self.offsets = self.lengths = None
+        return self._result

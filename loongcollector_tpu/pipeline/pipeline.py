@@ -288,18 +288,50 @@ class CollectionPipeline:
     # ------------------------------------------------------------------
 
     def process(self, groups: List[PipelineEventGroup]) -> None:
+        finish = self.process_begin(groups)
+        if finish is not None:
+            finish()
+
+    def process_begin(self, groups: List[PipelineEventGroup]):
+        """Run the processor chain up to and including the first
+        device-dispatch-capable processor's dispatch (async device plane,
+        SURVEY §7 step 4).
+
+        Returns None when the chain ran to completion synchronously;
+        otherwise a zero-arg continuation that materialises the device work
+        and runs the remaining processors — call it exactly once.  While the
+        continuation is outstanding the group counts as in-process for the
+        stop/drain barrier (wait_all_items_in_process_finished)."""
         with self._in_process_zero:
             self._in_process_cnt += 1
         try:
-            for inst in self.inner_processors:
-                inst.process(groups)
-            for inst in self.processors:
-                inst.process(groups)
-        finally:
-            with self._in_process_zero:
-                self._in_process_cnt -= 1
-                if self._in_process_cnt == 0:
-                    self._in_process_zero.notify_all()
+            chain = self.inner_processors + self.processors
+            for i, inst in enumerate(chain):
+                if not getattr(inst.plugin, "supports_async_dispatch", False):
+                    inst.process(groups)
+                    continue
+                tokens = inst.process_dispatch(groups)
+                rest = chain[i + 1:]
+
+                def finish(inst=inst, tokens=tokens, rest=rest):
+                    try:
+                        inst.process_complete(groups, tokens)
+                        for r in rest:
+                            r.process(groups)
+                    finally:
+                        self._exit_process()
+                return finish
+        except BaseException:
+            self._exit_process()
+            raise
+        self._exit_process()
+        return None
+
+    def _exit_process(self) -> None:
+        with self._in_process_zero:
+            self._in_process_cnt -= 1
+            if self._in_process_cnt == 0:
+                self._in_process_zero.notify_all()
 
     def send(self, groups: List[PipelineEventGroup]) -> bool:
         if self.aggregator is not None:

@@ -40,6 +40,24 @@ class ProcessorInstance:
         self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
         self.out_events.add(sum(len(g) for g in groups))
 
+    # -- async device plane (split dispatch/complete) -----------------------
+
+    def process_dispatch(self, groups: List[PipelineEventGroup]):
+        self.in_events.add(sum(len(g) for g in groups))
+        self.in_bytes.add(sum(g.data_size() for g in groups))
+        t0 = time.perf_counter()
+        tokens = [self.plugin.process_dispatch(g) for g in groups]
+        self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
+        return tokens
+
+    def process_complete(self, groups: List[PipelineEventGroup],
+                         tokens) -> None:
+        t0 = time.perf_counter()
+        for g, tok in zip(groups, tokens):
+            self.plugin.process_complete(g, tok)
+        self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
+        self.out_events.add(sum(len(g) for g in groups))
+
 
 class InputInstance:
     def __init__(self, plugin: Input, plugin_id: str = ""):

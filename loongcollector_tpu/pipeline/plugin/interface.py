@@ -80,9 +80,17 @@ class Input(Plugin):
 
 
 class Processor(Plugin):
-    """Process mutates the group in place (reference Processor.h:28-37)."""
+    """Process mutates the group in place (reference Processor.h:28-37).
+
+    Device-backed processors additionally implement the split dispatch /
+    complete protocol (`supports_async_dispatch = True`): `process_dispatch`
+    starts the device work and returns an opaque token; `process_complete`
+    materialises it and applies the results.  The runner overlaps the device
+    execution of group N with the host stages of its neighbours (SURVEY §7
+    step 4 — the async device data plane)."""
 
     name = "processor_base"
+    supports_async_dispatch = False
 
     def process(self, group: PipelineEventGroup) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -90,6 +98,15 @@ class Processor(Plugin):
     def process_many(self, groups: List[PipelineEventGroup]) -> None:
         for g in groups:
             self.process(g)
+
+    def process_dispatch(self, group: PipelineEventGroup):
+        """Start work on `group`; device work may remain in flight.  The
+        default (sync plugins) runs to completion and returns no token."""
+        self.process(group)
+        return None
+
+    def process_complete(self, group: PipelineEventGroup, token) -> None:
+        """Finish the work started by process_dispatch."""
 
 
 class Flusher(Plugin):

@@ -58,11 +58,28 @@ class ProcessorParseRegex(Processor):
                          for i in range(self.engine.num_caps)]
         return True
 
-    def process(self, group: PipelineEventGroup) -> None:
+    supports_async_dispatch = True
+
+    def process_dispatch(self, group: PipelineEventGroup):
+        """Async device plane: dispatch the group's parse and return the
+        pending handle; the device executes while the runner works on
+        neighbouring groups (process_complete applies the spans)."""
         src = extract_source(group, self.source_key)
         if src is None:
+            return None
+        return src, self.engine.parse_batch_async(
+            src.arena, src.offsets, src.lengths)
+
+    def process_complete(self, group: PipelineEventGroup, token) -> None:
+        if token is None:
             return
-        res = self.engine.parse_batch(src.arena, src.offsets, src.lengths)
+        src, pending = token
+        self._apply(group, src, pending.result())
+
+    def process(self, group: PipelineEventGroup) -> None:
+        self.process_complete(group, self.process_dispatch(group))
+
+    def _apply(self, group: PipelineEventGroup, src, res) -> None:
         ok = res.ok & src.present
 
         if src.columnar:

@@ -6,9 +6,16 @@ find the owning pipeline, run Process then Send (:90-189); thread 0 also
 pumps batch timeout flushes (:109-112); producer API PushQueue with bounded
 retries (:72-88).
 
-TPU note: one runner thread per device keeps the device queue full while
-host pre/post-processing of the NEXT batch overlaps with device execution
-(the jax dispatch is async until results are read).
+TPU note — the async device data plane (SURVEY §7 step 4): each worker keeps
+ONE group's device work in flight.  The loop dispatches group N+1 (host
+pre-processing + pack + async kernel dispatch via Pipeline.process_begin)
+BEFORE materialising group N, so the device executes N while the host packs
+N+1 and then runs N's downstream processors + send.  Device back-pressure is
+the DevicePlane in-flight byte budget: when the device stalls, dispatch
+blocks, this thread stops popping, and the bounded process queues fill to
+their high watermark, feedback-blocking the inputs
+(core/collection_pipeline/queue/BoundedProcessQueue.cpp:89-93 contract,
+extended one hop onto the device).
 """
 
 from __future__ import annotations
@@ -19,6 +26,7 @@ from typing import List, Optional
 
 from ..models import PipelineEventGroup
 from ..monitor.metrics import MetricsRecord
+from ..ops.device_plane import set_budget_relief
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from ..pipeline.queue.process_queue_manager import ProcessQueueManager
 from ..utils.logger import get_logger
@@ -34,6 +42,7 @@ class ProcessorRunner:
         self.pqm = process_queue_manager
         self.pipeline_manager = pipeline_manager
         self.thread_count = thread_count
+        self._tls = threading.local()
         self._threads: List[threading.Thread] = []
         self._running = False
         self.metrics = MetricsRecord(category="runner",
@@ -73,6 +82,11 @@ class ProcessorRunner:
     # -- worker -------------------------------------------------------------
 
     def _run(self, thread_no: int) -> None:
+        # one group's device work stays in flight per worker; kept in TLS so
+        # the DevicePlane budget-relief hook can complete it if this thread
+        # ever blocks dispatching the next group (no-deadlock invariant)
+        self._tls.pending = None
+        set_budget_relief(self._relieve_budget)
         while self._running:
             if thread_no == 0:
                 now = time.monotonic()
@@ -83,11 +97,19 @@ class ProcessorRunner:
                     except Exception:  # noqa: BLE001 — a bad hook must not
                         # kill thread 0 (all timeout flushing agent-wide)
                         log.exception("timeout flush failed")
-            item = self.pqm.pop_item(timeout=0.2)
+            # while device work is in flight, poll rather than sleep: an
+            # empty queue means the overlap window closes and we complete
+            item = self.pqm.pop_item(
+                timeout=0.0 if self._tls.pending is not None else 0.2)
             if item is None:
+                self._complete_pending()
                 continue
-            key, group = item
-            self._process_one(key, group)
+            nxt = self._dispatch_one(*item)
+            # dispatch-before-complete is the overlap: the device now holds
+            # group N+1 while we materialise + send group N on the host
+            self._complete_pending()
+            self._tls.pending = nxt
+        self._complete_pending()
         # drain remaining items on stop
         while True:
             item = self.pqm.pop_item(timeout=0)
@@ -95,17 +117,61 @@ class ProcessorRunner:
                 break
             self._process_one(*item)
 
-    def _process_one(self, key: int, group: PipelineEventGroup) -> None:
+    def _dispatch_one(self, key: int, group: PipelineEventGroup):
+        """Host pre-processing + device dispatch for one group.  Returns a
+        pending handle when device work stays in flight, else None (group
+        fully processed and sent)."""
         pipeline = self.pipeline_manager.find_pipeline_by_queue_key(key)
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
-            return
+            return None
         self.in_groups.add(1)
         self.in_events.add(len(group))
         self.in_bytes.add(group.data_size())
         groups = [group]
         try:
-            pipeline.process(groups)
-            pipeline.send(groups)
+            finish = pipeline.process_begin(groups)
         except Exception:  # noqa: BLE001
             log.exception("pipeline %s processing failed", pipeline.name)
+            return None
+        if finish is None:
+            self._send(pipeline, groups)
+            return None
+        return pipeline, groups, finish
+
+    def _complete_pending(self) -> None:
+        p = getattr(self._tls, "pending", None)
+        if p is not None:
+            self._tls.pending = None
+            self._complete(p)
+
+    def _relieve_budget(self) -> bool:
+        """DevicePlane relief hook: when this thread waits for in-flight
+        budget while dispatching, finish the overlapped group it holds so
+        the bytes it owns are released."""
+        p = getattr(self._tls, "pending", None)
+        if p is None:
+            return False
+        self._tls.pending = None
+        self._complete(p)
+        return True
+
+    def _complete(self, pending) -> None:
+        pipeline, groups, finish = pending
+        try:
+            finish()
+        except Exception:  # noqa: BLE001
+            log.exception("pipeline %s processing failed", pipeline.name)
+            return
+        self._send(pipeline, groups)
+
+    def _send(self, pipeline, groups) -> None:
+        try:
+            pipeline.send(groups)
+        except Exception:  # noqa: BLE001
+            log.exception("pipeline %s send failed", pipeline.name)
+
+    def _process_one(self, key: int, group: PipelineEventGroup) -> None:
+        pending = self._dispatch_one(key, group)
+        if pending is not None:
+            self._complete(pending)

@@ -16,12 +16,48 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 
 from .logger import get_logger
 
 log = get_logger("backend")
 
 _probe_result: bool | None = None
+
+
+def alive_file_path() -> str:
+    return os.environ.get("LOONG_TPU_ALIVE_FILE", "/tmp/tpu_alive")
+
+
+def watch_log_path() -> str:
+    return os.environ.get("LOONG_TPU_WATCH_LOG", "/tmp/tpu_watch.log")
+
+
+def watcher_verdict(max_age_s: float = 360.0) -> str:
+    """Instant liveness answer from the out-of-process tunnel watcher
+    (scripts/tpu_watch.sh probes every ~2 min; it touches the alive file on
+    a live probe and removes it on a dead one, appending to the watch log
+    either way).
+
+    'alive'   — alive file fresh: the backend answered within max_age_s;
+    'dead'    — watch log fresh but no fresh alive file: the watcher is
+                running and its last probes failed;
+    'unknown' — no watcher evidence: fall back to an in-line probe.
+
+    A dead tunnel used to cost every fresh process a 90 s probe timeout
+    (VERDICT r4 weak #7); with a running watcher the answer is free."""
+    now = time.time()
+    try:
+        if now - os.path.getmtime(alive_file_path()) <= max_age_s:
+            return "alive"
+    except OSError:
+        pass
+    try:
+        if now - os.path.getmtime(watch_log_path()) <= max_age_s:
+            return "dead"
+    except OSError:
+        pass
+    return "unknown"
 
 
 def cpu_pinned() -> bool:
@@ -44,6 +80,34 @@ def probe_default_backend(timeout: float = 90.0) -> bool:
     global _probe_result
     if _probe_result is not None:
         return _probe_result
+    verdict = watcher_verdict()
+    if verdict == "alive":
+        log.info("tunnel watcher reports backend ALIVE; skipping probe")
+        _probe_result = True
+        return True
+    if verdict == "dead":
+        log.warning("tunnel watcher reports backend DEAD; degrading "
+                    "without probing")
+        _probe_result = False
+        return False
+    # no watcher running: probe in-line, optionally retrying across a
+    # window (LOONG_BACKEND_RETRY_WINDOW_S) so a tunnel that flaps back
+    # mid-startup is still caught instead of pinning the process to CPU
+    try:
+        window = float(os.environ.get("LOONG_BACKEND_RETRY_WINDOW_S", "0"))
+    except ValueError:
+        window = 0.0
+    deadline = time.monotonic() + window
+    while True:
+        _probe_result = _subprocess_probe(timeout)
+        if _probe_result or time.monotonic() >= deadline:
+            return _probe_result
+        log.warning("backend probe failed; retrying (%.0f s left in window)",
+                    deadline - time.monotonic())
+        time.sleep(min(15.0, max(0.0, deadline - time.monotonic())))
+
+
+def _subprocess_probe(timeout: float) -> bool:
     code = ("import jax, jax.numpy as jnp;"
             "d = jax.devices()[0];"
             "jnp.zeros(8).block_until_ready();"
@@ -51,11 +115,10 @@ def probe_default_backend(timeout: float = 90.0) -> bool:
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, timeout=timeout, text=True)
-        _probe_result = r.returncode == 0 and "OK" in r.stdout
+        return r.returncode == 0 and "OK" in r.stdout
     except Exception as e:  # noqa: BLE001  (incl. TimeoutExpired)
         log.warning("backend probe failed: %r", e)
-        _probe_result = False
-    return _probe_result
+        return False
 
 
 def ensure_live_backend(timeout: float = 90.0) -> bool:

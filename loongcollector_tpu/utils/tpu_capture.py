@@ -1,0 +1,176 @@
+"""DEAD→ALIVE transition capture: on-silicon validation with no human.
+
+The TPU tunnel in this deployment dies for hours at a time (47/47 DEAD
+probes across round 4).  scripts/tpu_watch.sh maintains /tmp/tpu_alive;
+this module is what that liveness signal *drives*: on every DEAD→ALIVE
+transition the watcher invokes `capture()`, which runs
+
+1. a Pallas compile+run smoke on the real chip (the Mosaic fixes from
+   rounds 2-3 finally get an automated pass/fail record),
+2. bench.py on the live backend (bench itself persists
+   BENCH_TPU_LAST_GOOD.json, including kernel_pallas_MBps, on a
+   non-degraded TPU run),
+3. dryrun_multichip on the 8-device virtual CPU mesh (validating the
+   sharded path against the same code state the chip window measured),
+
+and writes a TPU_CAPTURE_LAST.json summary.  Every piece is injectable so
+tests can dry-run the full trigger path without hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+PALLAS_SMOKE_CODE = r"""
+import json, time
+import numpy as np
+import jax
+d = jax.devices()[0]
+assert d.platform == "tpu", f"not a TPU: {d.platform}"
+from loongcollector_tpu.ops.regex.program import compile_tier1
+from loongcollector_tpu.ops.kernels.field_extract_pallas import \
+    PallasExtractKernel
+from loongcollector_tpu.ops.device_batch import pack_rows
+prog = compile_tier1(r"(\S+) (\S+) (\d+)")
+k = PallasExtractKernel(prog)
+line = b"1.2.3.4 GET 200"
+n = 4096
+arena = np.frombuffer(line * n, np.uint8).copy()
+off = np.arange(n, dtype=np.int64) * len(line)
+ln = np.full(n, len(line), np.int32)
+batch = pack_rows(arena, off, ln, 128)
+ok, co, cl = (np.asarray(a) for a in k(batch.rows, batch.lengths))
+assert ok[:n].all(), "pallas kernel wrong on TPU"
+reps = 20
+t0 = time.perf_counter()
+for _ in range(reps):
+    ok, co, cl = k(batch.rows, batch.lengths)
+np.asarray(ok)
+dt = time.perf_counter() - t0
+print("PALLAS_OK", json.dumps(
+    {"MBps": round(n * len(line) * reps / dt / 1e6, 1)}))
+"""
+
+
+class TransitionTracker:
+    """Edge detector for the watcher loop: fires exactly on DEAD→ALIVE
+    (including a watcher that starts during an alive window — the first
+    observation counts as a transition, so an availability window is never
+    wasted just because the watcher restarted inside it)."""
+
+    def __init__(self) -> None:
+        self.prev: Optional[bool] = None
+
+    def update(self, alive: bool) -> bool:
+        fired = alive and self.prev is not True
+        self.prev = alive
+        return fired
+
+
+def pallas_smoke(run: Callable = subprocess.run, timeout: float = 900.0
+                 ) -> dict:
+    """Compile + run the fused Pallas extract kernel on the real chip in a
+    subprocess (a wedged tunnel hangs, so never in-process)."""
+    try:
+        r = run([sys.executable, "-c", PALLAS_SMOKE_CODE],
+                capture_output=True, timeout=timeout, text=True, cwd=REPO)
+    except Exception as e:  # noqa: BLE001 — incl. TimeoutExpired
+        return {"ok": False, "error": repr(e)}
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("PALLAS_OK"):
+            out = {"ok": True}
+            out.update(json.loads(ln.split(" ", 1)[1]))
+            return out
+    return {"ok": False, "error": (r.stderr or "")[-2000:],
+            "rc": r.returncode}
+
+
+def run_bench(run: Callable = subprocess.run, timeout: float = 1800.0
+              ) -> dict:
+    """bench.py on the live default backend.  bench.py itself persists
+    BENCH_TPU_LAST_GOOD.json when it completes non-degraded on a TPU."""
+    try:
+        r = run([sys.executable, os.path.join(REPO, "bench.py")],
+                capture_output=True, timeout=timeout, text=True, cwd=REPO)
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "error": repr(e)}
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.strip().startswith("{"):
+            line = ln.strip()
+    if r.returncode != 0 or line is None:
+        return {"ok": False, "rc": r.returncode,
+                "error": (r.stderr or "")[-2000:]}
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError:
+        return {"ok": False, "error": "unparseable bench line"}
+    return {"ok": True, "value": doc.get("value"),
+            "degraded": bool(doc.get("extra", {}).get("device_degraded")),
+            "device": doc.get("extra", {}).get("device")}
+
+
+def run_dryrun_multichip(run: Callable = subprocess.run,
+                         timeout: float = 900.0, n_devices: int = 8) -> dict:
+    """dryrun_multichip on a virtual CPU mesh — same contract the driver
+    checks, revalidated inside every chip window."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count={n_devices}"
+                        ).strip()
+    code = (f"import __graft_entry__ as g; g.dryrun_multichip({n_devices}); "
+            "print('DRYRUN_OK')")
+    try:
+        r = run([sys.executable, "-c", code], capture_output=True,
+                timeout=timeout, text=True, cwd=REPO, env=env)
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "error": repr(e)}
+    ok = r.returncode == 0 and "DRYRUN_OK" in (r.stdout or "")
+    out = {"ok": ok}
+    if not ok:
+        out["rc"] = r.returncode
+        out["error"] = (r.stderr or "")[-2000:]
+    return out
+
+
+def capture(run: Callable = subprocess.run, log: Callable = print,
+            repo: str = REPO) -> dict:
+    """The DEAD→ALIVE payload.  Returns (and persists) the summary."""
+    summary = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    log("tpu_capture: pallas smoke...")
+    summary["pallas"] = pallas_smoke(run)
+    log(f"tpu_capture: pallas -> {summary['pallas']}")
+    log("tpu_capture: bench.py...")
+    summary["bench"] = run_bench(run)
+    log(f"tpu_capture: bench -> {summary['bench']}")
+    log("tpu_capture: dryrun_multichip...")
+    summary["dryrun_multichip"] = run_dryrun_multichip(run)
+    log(f"tpu_capture: dryrun -> {summary['dryrun_multichip']}")
+    try:
+        with open(os.path.join(repo, "TPU_CAPTURE_LAST.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+    except OSError as e:
+        log(f"tpu_capture: could not persist summary: {e!r}")
+    return summary
+
+
+def main() -> int:
+    s = capture()
+    ok = s["pallas"].get("ok") and s["bench"].get("ok") \
+        and not s["bench"].get("degraded")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,19 @@
 #!/bin/bash
-# Probe the TPU tunnel every ~2 min; log transitions to /tmp/tpu_watch.log.
-# When the tunnel comes alive, touch /tmp/tpu_alive so the builder can react.
+# TPU-tunnel liveness watcher + DEAD->ALIVE capture trigger.
+#
+# Probes the tunnel every ~2 min:
+#   * live  -> touch /tmp/tpu_alive (consumed by utils/backend.py for an
+#              instant routing answer — no 90 s probe timeouts)
+#   * dead  -> remove /tmp/tpu_alive
+# and logs every probe to /tmp/tpu_watch.log.
+#
+# On a DEAD->ALIVE transition (or first live probe after start) it launches
+# loongcollector_tpu.utils.tpu_capture, which runs the Pallas smoke,
+# bench.py, and dryrun_multichip, persisting TPU_CAPTURE_LAST.json +
+# BENCH_TPU_LAST_GOOD.json — so any availability window yields fresh
+# on-silicon artifacts with no human in the loop.
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+prev=unknown
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 75 python -c "
@@ -12,9 +25,16 @@ print(d)
 " > /tmp/tpu_probe_out 2>&1; then
     echo "$ts ALIVE $(tail -1 /tmp/tpu_probe_out)" >> /tmp/tpu_watch.log
     touch /tmp/tpu_alive
+    if [ "$prev" != "alive" ]; then
+      echo "$ts TRANSITION dead->alive: launching capture" >> /tmp/tpu_watch.log
+      (cd "$REPO" && nohup python -m loongcollector_tpu.utils.tpu_capture \
+         >> /tmp/tpu_capture.log 2>&1 &)
+    fi
+    prev=alive
   else
     echo "$ts DEAD" >> /tmp/tpu_watch.log
     rm -f /tmp/tpu_alive
+    prev=dead
   fi
   sleep 110
 done

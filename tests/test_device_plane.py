@@ -1,0 +1,243 @@
+"""Async overlapped device data plane (SURVEY §7 step 4).
+
+Validates, without a chip, the three contracts the plane exists for:
+
+1. dispatch-ahead: with a 20 ms injected device RTT, the engine's pipelined
+   chunk path beats the serial dispatch→materialise path by ≥2×;
+2. cross-group overlap: the runner keeps one group's device work in flight
+   while host-processing its neighbours, beating serial wall-clock;
+3. back-pressure: a stalled device fills the in-flight byte budget, the
+   runner stops popping, and the bounded process queue rejects pushes at its
+   high watermark (BoundedProcessQueue.cpp:89-93 contract extended onto the
+   device) — then drains cleanly when the device recovers.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.ops import device_plane as dp
+from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                 LatencyInjectedKernel,
+                                                 StallableKernel)
+from loongcollector_tpu.ops.regex import engine as engine_mod
+from loongcollector_tpu.ops.regex.engine import RegexEngine, get_engine
+
+from conftest import wait_for
+
+
+@pytest.fixture(autouse=True)
+def device_tier(monkeypatch):
+    """Force the device tier (not the native host walker) and small chunks
+    so a modest event count spans many device dispatches."""
+    monkeypatch.setenv("LOONG_NATIVE_T1", "0")
+    monkeypatch.setattr(engine_mod, "MAX_BATCH", 256)
+    yield
+    DevicePlane.reset_for_testing()
+
+
+def _arena(line: bytes, n: int):
+    arena = np.frombuffer(line * n, dtype=np.uint8).copy()
+    offsets = np.arange(n, dtype=np.int64) * len(line)
+    lengths = np.full(n, len(line), dtype=np.int32)
+    return arena, offsets, lengths
+
+
+class TestPlaneBudget:
+    def test_acquire_release_accounting(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1000)
+        k = LatencyInjectedKernel(lambda x: x + 1, 0.0)
+        f1 = plane.submit(k, (np.arange(10),), 600)
+        assert plane.inflight_bytes() == 600
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(plane.submit(k, (np.arange(5),), 600)))
+        t.start()
+        time.sleep(0.15)
+        assert not got, "second submit must block over budget"
+        np.testing.assert_array_equal(f1.result()[0], np.arange(10) + 1)
+        t.join(2)
+        assert got, "release must unblock the waiter"
+        got[0].result()
+        assert plane.inflight_bytes() == 0
+
+    def test_oversize_single_dispatch_admitted(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=100)
+        k = LatencyInjectedKernel(lambda x: x * 2, 0.0)
+        f = plane.submit(k, (np.arange(4),), 5000)  # > whole budget
+        np.testing.assert_array_equal(f.result()[0], np.arange(4) * 2)
+        assert plane.inflight_bytes() == 0
+
+    def test_dispatch_error_surfaces_at_result(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1000)
+
+        def bad(x):
+            raise ValueError("boom")
+
+        f = plane.submit(bad, (np.arange(3),), 100)
+        assert plane.inflight_bytes() == 100  # held until consumed
+        with pytest.raises(ValueError):
+            f.result()
+        assert plane.inflight_bytes() == 0
+        with pytest.raises(ValueError):
+            f.result()  # error is sticky, budget released exactly once
+
+
+class TestEngineDispatchAhead:
+    RTT = 0.02
+
+    def test_pipelined_chunks_beat_serial_2x(self):
+        DevicePlane.reset_for_testing()
+        eng = RegexEngine(r"(\w+) (\d+)")
+        assert eng._segment_kernel is not None, "pattern must be tier-1"
+        lat = LatencyInjectedKernel(eng._segment_kernel, self.RTT,
+                                    serialize=False)
+        eng.set_device_kernel_override(lat)
+        arena, offsets, lengths = _arena(b"abc 123", 2048)  # 8 chunks of 256
+
+        # warm-up: jit-compile the geometry outside the timed window
+        eng.parse_batch(arena[:7 * 8], offsets[:8], lengths[:8])
+        n_chunks = 2048 // 256
+        t0 = time.perf_counter()
+        res = eng.parse_batch(arena, offsets, lengths)
+        elapsed = time.perf_counter() - t0
+
+        assert res.ok.all()
+        np.testing.assert_array_equal(res.cap_off[:, 0], offsets)
+        np.testing.assert_array_equal(res.cap_len[:, 1], 3)
+        serial_floor = n_chunks * self.RTT
+        assert elapsed < serial_floor / 2, (
+            f"pipelined={elapsed*1e3:.1f}ms vs serial floor "
+            f"{serial_floor*1e3:.1f}ms — dispatch-ahead not overlapping")
+
+    def test_budget_pressure_still_correct(self):
+        # budget of ~1.2 chunks forces drain-while-dispatch interleaving
+        DevicePlane.reset_for_testing(budget_bytes=40 * 1024)
+        eng = RegexEngine(r"(\w+) (\d+)x")
+        assert eng._segment_kernel is not None
+        lat = LatencyInjectedKernel(eng._segment_kernel, 0.002,
+                                    serialize=False)
+        eng.set_device_kernel_override(lat)
+        arena, offsets, lengths = _arena(b"abc 123x", 1024)
+        res = eng.parse_batch(arena, offsets, lengths)
+        assert res.ok.all()
+        assert DevicePlane.instance().inflight_bytes() == 0
+
+
+def _make_group(n_events: int, line: bytes = b"abc 123") -> PipelineEventGroup:
+    sb = SourceBuffer()
+    g = PipelineEventGroup(sb)
+    for _ in range(n_events):
+        ev = g.add_log_event(1)
+        ev.set_content(sb.copy_string(b"content"), sb.copy_string(line))
+    return g
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    from loongcollector_tpu.pipeline.pipeline_manager import (
+        CollectionPipelineManager, ConfigDiff)
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.queue.sender_queue import \
+        SenderQueueManager
+    from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+
+    pqm = ProcessQueueManager()
+    sqm = SenderQueueManager()
+    mgr = CollectionPipelineManager(pqm, sqm)
+    runner = ProcessorRunner(pqm, mgr, thread_count=1)
+    yield pqm, sqm, mgr, runner, ConfigDiff, tmp_path
+    mgr.stop_all()
+    runner.stop()
+
+
+def _start_pipeline(mgr, ConfigDiff, tmp_path, pattern, name):
+    out_path = tmp_path / f"{name}.jsonl"
+    diff = ConfigDiff()
+    diff.added[name] = {
+        "inputs": [],
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": pattern, "Keys": ["w", "d"]}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out_path),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+    mgr.update_pipelines(diff)
+    pipeline = mgr.find_pipeline(name)
+    return pipeline, out_path
+
+
+class TestRunnerOverlap:
+    RTT = 0.04
+
+    def test_cross_group_overlap(self, stack):
+        pqm, sqm, mgr, runner, ConfigDiff, tmp_path = stack
+        DevicePlane.reset_for_testing()
+        pattern = r"(\w+) (\d+)"   # engine-cache key shared with processor
+        pipeline, out_path = _start_pipeline(mgr, ConfigDiff, tmp_path,
+                                             pattern, "overlap-test")
+        eng = get_engine(pattern)
+        lat = LatencyInjectedKernel(eng._segment_kernel, self.RTT,
+                                    serialize=False)
+        eng.set_device_kernel_override(lat)
+        try:
+            runner.init()
+            key = pipeline.process_queue_key
+            # warm-up group compiles the kernel geometry
+            assert runner.push_queue(key, _make_group(4))
+            assert wait_for(lambda: out_path.exists()
+                            and out_path.read_text().count("\n") >= 4)
+
+            G = 12
+            t0 = time.perf_counter()
+            for _ in range(G):
+                assert runner.push_queue(key, _make_group(4))
+            assert wait_for(
+                lambda: out_path.read_text().count("\n") >= 4 * (G + 1),
+                timeout=G * self.RTT * 2 + 5)
+            elapsed = time.perf_counter() - t0
+            serial_floor = G * self.RTT
+            assert elapsed < serial_floor * 0.75, (
+                f"overlapped={elapsed*1e3:.0f}ms vs serial floor "
+                f"{serial_floor*1e3:.0f}ms — runner not overlapping groups")
+        finally:
+            eng.set_device_kernel_override(None)
+
+    def test_watermark_holds_under_stalled_device(self, stack):
+        pqm, sqm, mgr, runner, ConfigDiff, tmp_path = stack
+        # budget ≈ one 256×128 chunk: the second group's dispatch must wait
+        plane = DevicePlane.reset_for_testing(budget_bytes=40 * 1024)
+        pattern = r"(\w+) (\d+)y"
+        pipeline, out_path = _start_pipeline(mgr, ConfigDiff, tmp_path,
+                                             pattern, "stall-test")
+        eng = get_engine(pattern)
+        stall = StallableKernel(eng._segment_kernel, rtt_s=0.0)
+        eng.set_device_kernel_override(stall)
+        stall.stall()
+        try:
+            runner.init()
+            key = pipeline.process_queue_key
+            q = pqm.get_queue(key)
+            pushed = 0
+            for _ in range(q._cap_high + 10):
+                if not pqm.push_queue(key, _make_group(4, b"abc 123y")):
+                    break
+                pushed += 1
+            # queue must have hit its high watermark while the device stalls
+            assert wait_for(lambda: not pqm.is_valid_to_push(key), timeout=10)
+            # the plane bounds device-side work: at most budget + one chunk
+            assert plane.inflight_bytes() <= plane.budget_bytes + 40 * 1024
+            assert pushed <= q._cap_high + 3
+
+            stall.unstall()
+            assert wait_for(
+                lambda: out_path.exists()
+                and out_path.read_text().count("\n") >= 4 * pushed,
+                timeout=30)
+            assert wait_for(lambda: pqm.is_valid_to_push(key), timeout=10)
+            assert plane.inflight_bytes() == 0
+        finally:
+            eng.set_device_kernel_override(None)
