@@ -34,6 +34,9 @@ class RawKernelEvent:
     source: EventSource
     pid: int = 0
     timestamp_ns: int = 0
+    ppid: int = -1             # parent pid (-1 unknown)
+    ktime: int = 0             # process start ktime: (pid, ktime) is the
+                               # stable process identity across pid reuse
     # network events
     fd: int = -1
     local_addr: str = ""
@@ -109,7 +112,7 @@ class MockAdapter(EBPFAdapter):
 import ctypes
 import os
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 CALLNAME_MAX = 32
 PATH_MAX = 128
 ADDR_MAX = 64
@@ -139,6 +142,9 @@ class CEvent(ctypes.Structure):
         ("direction", ctypes.c_uint16),
         ("stack_depth", ctypes.c_uint16),
         ("payload_len", ctypes.c_uint32),
+        ("ppid", ctypes.c_int32),
+        ("reserved0", ctypes.c_uint32),
+        ("ktime", ctypes.c_uint64),
         ("call_name", ctypes.c_char * CALLNAME_MAX),
         ("path", ctypes.c_char * PATH_MAX),
         ("local_addr", ctypes.c_char * ADDR_MAX),
@@ -181,6 +187,8 @@ def _event_to_c(ev: RawKernelEvent) -> CEvent:
     c.pid = ev.pid
     c.fd = ev.fd
     c.flags = ev.flags
+    c.ppid = ev.ppid
+    c.ktime = ev.ktime
     c.direction = _DIRECTION_TO_U16.get(ev.direction, 0)
     c.call_name = ev.call_name.encode()[:CALLNAME_MAX - 1]
     c.path = ev.path.encode()[:PATH_MAX - 1]
@@ -205,6 +213,7 @@ def _event_from_c(c: CEvent) -> RawKernelEvent:
     return RawKernelEvent(
         source=_U32_TO_SOURCE.get(c.source, EventSource.NETWORK_OBSERVE),
         pid=c.pid, timestamp_ns=c.timestamp_ns, fd=c.fd,
+        ppid=c.ppid, ktime=c.ktime,
         local_addr=c.local_addr.decode("utf-8", "replace"),
         remote_addr=c.remote_addr.decode("utf-8", "replace"),
         direction=_DIRECTION.get(c.direction, ""),

@@ -68,9 +68,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
                     lib = ctypes.CDLL(_SO_PATH)
                 except OSError:
                     pass
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
+        # pointer params bind as c_void_p and calls pass raw addresses
+        # (arr.ctypes.data): ctypes POINTER casts cost ~2 us each and the
+        # hot wrappers pass ~20 pointers per group
+        u8p = ctypes.c_void_p
+        i32p = ctypes.c_void_p
+        i64p = ctypes.c_void_p
         lib.lct_split_lines.restype = ctypes.c_int64
         lib.lct_split_lines.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint8,
                                         ctypes.c_int64, i32p, i32p]
@@ -109,21 +112,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def _u8(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+def _u8(a: np.ndarray) -> int:
+    return a.ctypes.data
 
 
-def _i32(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+def _i32(a: np.ndarray) -> int:
+    return a.ctypes.data
 
 
-def _i64(a: np.ndarray):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+def _i64(a: np.ndarray) -> int:
+    return a.ctypes.data
 
 
 # ---------------------------------------------------------------------------
 # wrappers (None return ⇒ caller should use its fallback)
 # ---------------------------------------------------------------------------
+
+
+_split_scratch = threading.local()
 
 
 def split_lines(seg: np.ndarray, sep: int, base_offset: int
@@ -132,12 +138,18 @@ def split_lines(seg: np.ndarray, sep: int, base_offset: int
     if lib is None or len(seg) == 0:
         return None
     seg = np.ascontiguousarray(seg)
+    # worst case is one line per byte, so the span buffers are chunk-sized;
+    # reuse a per-thread scratch instead of mapping/unmapping megabytes per
+    # chunk and return right-sized copies (a few KB for real line counts)
     cap = len(seg) + 1
-    offs = np.empty(cap, dtype=np.int32)
-    lens = np.empty(cap, dtype=np.int32)
+    sc = getattr(_split_scratch, "bufs", None)
+    if sc is None or len(sc[0]) < cap:
+        sc = (np.empty(cap, dtype=np.int32), np.empty(cap, dtype=np.int32))
+        _split_scratch.bufs = sc
+    offs, lens = sc
     n = lib.lct_split_lines(_u8(seg), len(seg), sep, base_offset,
                             _i32(offs), _i32(lens))
-    return offs[:n], lens[:n]
+    return offs[:n].copy(), lens[:n].copy()
 
 
 def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,

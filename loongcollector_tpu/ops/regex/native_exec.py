@@ -150,9 +150,9 @@ def serialize_program(program: SegmentProgram
 def _bind(lib) -> None:
     if getattr(lib, "_t1_bound", False):
         return
-    u8p = ctypes.POINTER(ctypes.c_uint8)
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.c_void_p      # raw addresses (see native.py binding note)
+    i32p = ctypes.c_void_p
+    i64p = ctypes.c_void_p
     lib.lct_t1_exec.restype = ctypes.c_int64
     lib.lct_t1_exec.argtypes = [
         u8p, ctypes.c_int64, i64p, i32p, ctypes.c_int64,

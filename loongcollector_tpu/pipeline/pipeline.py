@@ -311,6 +311,13 @@ class CollectionPipeline:
                     inst.process(groups)
                     continue
                 tokens = inst.process_dispatch(groups)
+                if all(t is None for t in tokens):
+                    # nothing stayed in flight (host-tier route / empty
+                    # groups): finish the chain inline — deferring would
+                    # only delay the send.  complete() still runs so the
+                    # instance's out_events/cost metrics stay truthful.
+                    inst.process_complete(groups, tokens)
+                    continue
                 rest = chain[i + 1:]
 
                 def finish(inst=inst, tokens=tokens, rest=rest):

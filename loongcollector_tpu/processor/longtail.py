@@ -356,7 +356,7 @@ def _aes_cbc(key: bytes, iv: bytes, padded: bytes) -> Optional[bytes]:
     if lib is None or not hasattr(lib, "lct_aes_cbc_encrypt"):
         return None
     if not getattr(lib, "_aes_bound", False):
-        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u8p = ctypes.c_void_p   # raw addresses via native_mod._u8
         lib.lct_aes_cbc_encrypt.restype = ctypes.c_int64
         lib.lct_aes_cbc_encrypt.argtypes = [
             u8p, ctypes.c_int64, u8p, u8p, ctypes.c_int64, u8p]

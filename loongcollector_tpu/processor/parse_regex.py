@@ -63,12 +63,18 @@ class ProcessorParseRegex(Processor):
     def process_dispatch(self, group: PipelineEventGroup):
         """Async device plane: dispatch the group's parse and return the
         pending handle; the device executes while the runner works on
-        neighbouring groups (process_complete applies the spans)."""
+        neighbouring groups (process_complete applies the spans).  A parse
+        that completed synchronously (host-walker route) is applied here —
+        deferring it buys no overlap and would only delay the send."""
         src = extract_source(group, self.source_key)
         if src is None:
             return None
-        return src, self.engine.parse_batch_async(
+        pending = self.engine.parse_batch_async(
             src.arena, src.offsets, src.lengths)
+        if pending.done:
+            self._apply(group, src, pending.result())
+            return None
+        return src, pending
 
     def process_complete(self, group: PipelineEventGroup, token) -> None:
         if token is None:

@@ -26,7 +26,10 @@
 extern "C" {
 #endif
 
-#define LOONG_EBPF_ABI_VERSION 1u
+/* v2: +ppid +ktime on the event (process-tree cache keys events by
+ * (pid, ktime) and links children to parents — ProcessCacheManager.h:70
+ * AttachProcessData semantics need both on every kernel event) */
+#define LOONG_EBPF_ABI_VERSION 2u
 
 /* event sources (mirrors the collector's EventSource enum) */
 enum loong_ebpf_source {
@@ -61,6 +64,9 @@ typedef struct loong_ebpf_event {
     uint16_t direction;                    /* enum loong_ebpf_direction */
     uint16_t stack_depth;                  /* used frames              */
     uint32_t payload_len;                  /* used bytes of payload    */
+    int32_t  ppid;                         /* parent pid (-1 unknown)  */
+    uint32_t reserved0;                    /* alignment / future use   */
+    uint64_t ktime;                        /* proc start ktime (id key) */
     char     call_name[LOONG_EBPF_CALLNAME_MAX];   /* NUL-terminated   */
     char     path[LOONG_EBPF_PATH_MAX];
     char     local_addr[LOONG_EBPF_ADDR_MAX];

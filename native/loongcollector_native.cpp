@@ -144,30 +144,6 @@ int64_t lct_sls_serialize_strided(
     for (int64_t f = 0; f < F; ++f)
         key_part[f] = 1 + varint_size(key_lens[f]) + key_lens[f] + 1;
 
-    // pass 1: size — cache per-log body sizes so pass 2 doesn't re-derive
-    // them (the derivation walks every field span twice otherwise)
-    int64_t* bodies = new (std::nothrow) int64_t[n > 0 ? n : 1];
-    if (!bodies) return -1;
-    int64_t total = 0;
-    for (int64_t i = 0; i < n; ++i) {
-        uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
-        int64_t body = 1 + varint_size(ts);
-        int64_t base = i * si;
-        for (int64_t f = 0; f < F; ++f) {
-            int64_t idx = base + f * sf;
-            if (!span_ok(idx)) continue;
-            int32_t vlen = field_lens[idx];
-            int64_t content = key_part[f] + varint_size(vlen) + vlen;
-            body += 1 + varint_size(content) + content;
-        }
-        bodies[i] = body;
-        total += 1 + varint_size(body) + body;
-    }
-    if (total > out_cap) {
-        delete[] bodies;
-        return -total;
-    }
-
     // per-field constant wire prefix: 0x0a klen <key> 0x12 — one cache-hot
     // copy per field instead of three stores + a libc memcpy
     uint8_t keyhdr[64][112];
@@ -187,13 +163,23 @@ int64_t lct_sls_serialize_strided(
         keyhdr_len[f] = (int32_t)(q - keyhdr[f]);
     }
 
-    // pass 2: write
+    // Single pass: reserve two bytes for each Log's body-length varint and
+    // patch it once the body is written (bodies of 128..16383 bytes — the
+    // norm for log events — need exactly two; the off sizes memmove the
+    // just-written body by ±, which short bodies make cheap).  This
+    // replaces the old size-then-write double walk over every span.
+    // On overflow the exact total is computed by a (rare) sizing walk and
+    // returned as -(needed) for the caller's retry.
     const uint8_t* out_end = out + out_cap;
     uint8_t* p = out;
-    for (int64_t i = 0; i < n; ++i) {
+    bool overflow = false;
+    for (int64_t i = 0; i < n && !overflow; ++i) {
         uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
+        if (p + 16 > out_end) { overflow = true; break; }
         *p++ = 0x0a;                       // LogGroup.Logs
-        p = put_varint(p, bodies[i]);
+        uint8_t* lenpos = p;
+        p += 2;                            // reserved body-length varint
+        uint8_t* body_start = p;
         *p++ = 0x08;                       // Log.Time
         p = put_varint(p, ts);
         int64_t base = i * si;
@@ -203,10 +189,11 @@ int64_t lct_sls_serialize_strided(
             int32_t vlen = field_lens[idx];
             int32_t voff = field_offs[idx];
             int64_t content = key_part[f] + varint_size(vlen) + vlen;
+            if (p + content + 24 > out_end) { overflow = true; break; }
             *p++ = 0x12;                   // Log.Contents
             p = put_varint(p, content);
             int32_t kh = keyhdr_len[f];
-            if (kh >= 0 && p + kh + 16 <= out_end) {
+            if (kh >= 0) {
                 p = put_bytes_fast(p, keyhdr[f], kh);
             } else {
                 int32_t klen = key_lens[f];
@@ -217,16 +204,48 @@ int64_t lct_sls_serialize_strided(
                 *p++ = 0x12;               // Content.Value
             }
             p = put_varint(p, vlen);
-            if (p + vlen + 16 <= out_end &&
-                (int64_t)voff + vlen + 16 <= arena_len) {
+            if ((int64_t)voff + vlen + 16 <= arena_len) {
                 p = put_bytes_fast(p, arena + voff, vlen);
             } else {
                 memcpy(p, arena + voff, vlen);
                 p += vlen;
             }
         }
+        if (overflow) break;
+        int64_t body = p - body_start;
+        if (body < 0x80) {
+            lenpos[0] = (uint8_t)body;
+            memmove(lenpos + 1, body_start, (size_t)body);
+            p -= 1;
+        } else if (body < 0x4000) {
+            lenpos[0] = (uint8_t)(body & 0x7F) | 0x80;
+            lenpos[1] = (uint8_t)(body >> 7);
+        } else {
+            int extra = varint_size((uint64_t)body) - 2;
+            if (p + extra + 16 > out_end) { overflow = true; break; }
+            memmove(lenpos + 2 + extra, body_start, (size_t)body);
+            put_varint(lenpos, (uint64_t)body);
+            p += extra;
+        }
     }
-    delete[] bodies;
+    if (overflow) {
+        // exact resize request (same emission predicate as the writer)
+        int64_t total = 0;
+        for (int64_t i = 0; i < n; ++i) {
+            uint64_t ts = static_cast<uint64_t>(timestamps[i]) & 0xFFFFFFFFu;
+            int64_t body = 1 + varint_size(ts);
+            int64_t base = i * si;
+            for (int64_t f = 0; f < F; ++f) {
+                int64_t idx = base + f * sf;
+                if (!span_ok(idx)) continue;
+                int32_t vlen = field_lens[idx];
+                int64_t content = key_part[f] + varint_size(vlen) + vlen;
+                body += 1 + varint_size(content) + content;
+            }
+            total += 1 + varint_size(body) + body;
+        }
+        return -(total + 32);
+    }
     return p - out;
 }
 
@@ -802,6 +821,17 @@ struct T1Ctx {
     const int32_t* lit_lens;
     const T1ClassInfo* cinfo;
     int32_t ncaps;
+    // Per-row stop-mask acceleration (linear programs only): for each
+    // class used by SPAN/FIELD ops, a bitmask over the row marking
+    // NON-member bytes (bits >= len forced set), built in one vector
+    // sweep before the walk.  A field scan then collapses to a word
+    // lookup + ctz instead of a fresh SIMD scan with its setup costs —
+    // log rows average 5-15 short fields, so scan setup dominated the
+    // per-row walk time.
+    const int8_t* mask_slot;     // class id -> slot (or -1); null = off
+    const uint64_t* mask_base;   // [nslots, mask_stride] bit words
+    int32_t mask_words;          // words valid for THIS row
+    int32_t mask_stride;
 };
 
 inline bool t1_member(const T1Ctx& c, int32_t cls, uint8_t b) {
@@ -889,8 +919,196 @@ inline int32_t t1_truffle_scan_rev(const uint8_t*, int32_t,
 }
 #endif
 
+// ---------------------------------------------------------------------------
+// Stop-mask builders: one vector sweep over the row produces, per class, a
+// bitmask of non-member positions (bits >= len forced set so scans stop at
+// the row end).  `avail` is the addressable bytes from row start (to the
+// arena end) — full 32-byte loads run while i+32 <= avail; only the arena's
+// final tail falls back to scalar.
+
+constexpr int32_t kT1MaskSlots = 8;
+
+// Everything the per-row mask sweep needs, resolved once per exec call.
+// Every class — including single-char negations — runs the same truffle
+// sweep (uniformity keeps the per-slot state in registers).
+struct T1MaskPlan {
+    int32_t n_slots;
+    const T1ClassInfo* ci[kT1MaskSlots];  // truffle nibble tables
+    const uint8_t* tbl[kT1MaskSlots];     // scalar-tail membership table
+};
+
+#if defined(__x86_64__)
+// One sweep, all classes: each 32-byte block is loaded ONCE and evaluated
+// against every slot.  The slot count is a template parameter so the
+// per-slot vectors live in ymm registers and the inner loops fully unroll;
+// every class (including single-char negations) runs the uniform truffle
+// path — the nibble-decompose work (nib_hi/shuf3/vx) is shared across all
+// slots, so an extra class costs ~6 ops per block.
+template <int NS>
+__attribute__((target("avx2"))) static void t1_mask_sweepT(
+        const uint8_t* row, int32_t len, int64_t avail,
+        const T1MaskPlan& plan, uint64_t* maskbuf, int32_t stride,
+        int32_t n_words) {
+    const __m256i highconst = _mm256_set1_epi8((char)0x80);
+    const __m256i bits_tbl = _mm256_set1_epi64x(0x8040201008040201LL);
+    __m256i lo[NS], hi[NS];
+    for (int32_t s = 0; s < NS; ++s) {
+        lo[s] = _mm256_broadcastsi128_si256(
+            _mm_loadu_si128((const __m128i*)plan.ci[s]->tr_lo));
+        hi[s] = _mm256_broadcastsi128_si256(
+            _mm_loadu_si128((const __m128i*)plan.ci[s]->tr_hi));
+    }
+    int32_t i = 0;
+    for (int32_t w = 0; w < n_words; ++w) {
+        for (int32_t half = 0; half < 2; ++half, i += 32) {
+            uint32_t m[NS];
+            if (i >= len) {
+                // wholly past the row: seal() will set these bits
+                for (int32_t s = 0; s < NS; ++s) m[s] = 0;
+            } else if (i + 32 <= avail) {
+                __m256i v = _mm256_loadu_si256((const __m256i*)(row + i));
+                __m256i nib_hi = _mm256_andnot_si256(
+                    highconst, _mm256_srli_epi64(v, 4));
+                __m256i shuf3 = _mm256_shuffle_epi8(bits_tbl, nib_hi);
+                __m256i vx = _mm256_xor_si256(v, highconst);
+                for (int32_t s = 0; s < NS; ++s) {
+                    __m256i t = _mm256_and_si256(
+                        _mm256_or_si256(_mm256_shuffle_epi8(lo[s], v),
+                                        _mm256_shuffle_epi8(hi[s], vx)),
+                        shuf3);
+                    m[s] = (uint32_t)_mm256_movemask_epi8(
+                        _mm256_cmpeq_epi8(t, _mm256_setzero_si256()));
+                }
+            } else {
+                for (int32_t s = 0; s < NS; ++s) {
+                    uint32_t acc = 0;
+                    const uint8_t* tbl = plan.tbl[s];
+                    for (int32_t j = 0; j < 32 && i + j < len; ++j)
+                        if (!tbl[row[i + j]]) acc |= 1u << j;
+                    m[s] = acc;
+                }
+            }
+            for (int32_t s = 0; s < NS; ++s) {
+                uint64_t* out = maskbuf + (int64_t)s * stride;
+                if (half == 0)
+                    out[w] = m[s];
+                else
+                    out[w] |= (uint64_t)m[s] << 32;
+            }
+        }
+    }
+}
+
+// AVX-512BW sweep: one 64-byte block per mask word, mask-register loads
+// suppress faults on the tail so there is no scalar path at all, and
+// testn_epi8_mask yields the 64-bit non-member word directly.
+static const bool g_has_avx512 = __builtin_cpu_supports("avx512bw");
+
+template <int NS>
+__attribute__((target("avx512f,avx512bw"))) static void t1_mask_sweep512T(
+        const uint8_t* row, int32_t len, const T1MaskPlan& plan,
+        uint64_t* maskbuf, int32_t stride, int32_t n_words) {
+    const __m512i highconst = _mm512_set1_epi8((char)0x80);
+    const __m512i bits_tbl = _mm512_set1_epi64(0x8040201008040201LL);
+    __m512i lo[NS], hi[NS];
+    for (int32_t s = 0; s < NS; ++s) {
+        lo[s] = _mm512_broadcast_i32x4(
+            _mm_loadu_si128((const __m128i*)plan.ci[s]->tr_lo));
+        hi[s] = _mm512_broadcast_i32x4(
+            _mm_loadu_si128((const __m128i*)plan.ci[s]->tr_hi));
+    }
+    for (int32_t w = 0; w < n_words; ++w) {
+        int32_t i = w << 6;
+        int32_t rem = len - i;
+        __mmask64 loadm = rem >= 64 ? ~0ULL
+                          : rem <= 0 ? 0 : ((1ULL << rem) - 1ULL);
+        __m512i v = _mm512_maskz_loadu_epi8(loadm, row + i);
+        __m512i nib_hi =
+            _mm512_andnot_si512(highconst, _mm512_srli_epi64(v, 4));
+        __m512i shuf3 = _mm512_shuffle_epi8(bits_tbl, nib_hi);
+        __m512i vx = _mm512_xor_si512(v, highconst);
+        for (int32_t s = 0; s < NS; ++s) {
+            __m512i t = _mm512_and_si512(
+                _mm512_or_si512(_mm512_shuffle_epi8(lo[s], v),
+                                _mm512_shuffle_epi8(hi[s], vx)),
+                shuf3);
+            maskbuf[(int64_t)s * stride + w] =
+                (uint64_t)_mm512_testn_epi8_mask(t, t);
+        }
+    }
+}
+
+static void t1_mask_build_all512(const uint8_t* row, int32_t len,
+                                 const T1MaskPlan& plan, uint64_t* maskbuf,
+                                 int32_t stride, int32_t n_words) {
+    switch (plan.n_slots) {
+    case 1: t1_mask_sweep512T<1>(row, len, plan, maskbuf, stride, n_words); break;
+    case 2: t1_mask_sweep512T<2>(row, len, plan, maskbuf, stride, n_words); break;
+    case 3: t1_mask_sweep512T<3>(row, len, plan, maskbuf, stride, n_words); break;
+    case 4: t1_mask_sweep512T<4>(row, len, plan, maskbuf, stride, n_words); break;
+    case 5: t1_mask_sweep512T<5>(row, len, plan, maskbuf, stride, n_words); break;
+    case 6: t1_mask_sweep512T<6>(row, len, plan, maskbuf, stride, n_words); break;
+    case 7: t1_mask_sweep512T<7>(row, len, plan, maskbuf, stride, n_words); break;
+    default: t1_mask_sweep512T<8>(row, len, plan, maskbuf, stride, n_words); break;
+    }
+}
+
+static void t1_mask_build_all(const uint8_t* row, int32_t len,
+                              int64_t avail, const T1MaskPlan& plan,
+                              uint64_t* maskbuf, int32_t stride,
+                              int32_t n_words) {
+    if (g_has_avx512) {
+        t1_mask_build_all512(row, len, plan, maskbuf, stride, n_words);
+        return;
+    }
+    switch (plan.n_slots) {
+    case 1: t1_mask_sweepT<1>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 2: t1_mask_sweepT<2>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 3: t1_mask_sweepT<3>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 4: t1_mask_sweepT<4>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 5: t1_mask_sweepT<5>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 6: t1_mask_sweepT<6>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    case 7: t1_mask_sweepT<7>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    default: t1_mask_sweepT<8>(row, len, avail, plan, maskbuf, stride, n_words); break;
+    }
+}
+#else
+static void t1_mask_build_all(const uint8_t*, int32_t, int64_t,
+                              const T1MaskPlan&, uint64_t*, int32_t,
+                              int32_t) {}
+#endif
+
+// Force every bit at position >= len set (scan stops at row end).
+static inline void t1_mask_seal(uint64_t* out, int32_t n_words,
+                                int32_t len) {
+    int32_t w = len >> 6;
+    if (w < n_words) {
+        int32_t b = len & 63;
+        out[w] |= ~((b ? (1ull << b) : 1ull) - 1ull);
+        for (int32_t k = w + 1; k < n_words; ++k) out[k] = ~0ull;
+    }
+}
+
+// First stop (non-member) position >= start, from the precomputed mask.
+static inline int32_t t1_mask_find(const uint64_t* m, int32_t n_words,
+                                   int32_t start) {
+    int32_t w = start >> 6;
+    if (w >= n_words) return n_words << 6;  // defensive: never read past
+    uint64_t bits = m[w] >> (start & 63);
+    if (bits) return start + (int32_t)__builtin_ctzll(bits);
+    for (++w; w < n_words; ++w)
+        if (m[w]) return (w << 6) + (int32_t)__builtin_ctzll(m[w]);
+    return n_words << 6;  // unreachable: seal() guarantees a set bit
+}
+
 // Maximal forward run of class members starting at `start`.
 inline int32_t t1_scan_fwd(const T1Ctx& c, int32_t cls, int32_t start) {
+    if (c.mask_base != nullptr) {
+        int8_t s = c.mask_slot[cls];
+        if (s >= 0)
+            return t1_mask_find(c.mask_base + (int64_t)s * c.mask_stride,
+                                c.mask_words, start);
+    }
     const T1ClassInfo& ci = c.cinfo[cls];
     if (ci.neg_char >= 0) {
         const void* hit = memchr(c.row + start, ci.neg_char, c.len - start);
@@ -1359,6 +1577,9 @@ struct T1DecOp {
     int32_t lit;          // FIELD: trailing literal index (-1 = none)
     const int32_t* w;     // kind 5/6: raw op words (for the interpreter)
     int32_t wn;           //   width in words
+    const uint64_t* mask; // SPAN/FIELD: resolved per-class stop-mask slot
+                          // (filled by the exec that owns the mask buffer;
+                          // null = use the classic scanners)
 };
 
 constexpr int kT1MaxDecOps = 192;
@@ -1398,6 +1619,7 @@ static void t1_fuse_range(T1DecOp* ops, int32_t from, int32_t* n_ops) {
             f.c2 = ops[k + 1].b;     // min
             f.d = ops[k + 1].c2;     // max
             f.lit = -1;
+            f.mask = nullptr;
             f.w = nullptr;
             f.wn = 0;
             k += 3;
@@ -1422,25 +1644,25 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
         switch (w[i]) {
         case 0: {
             T1DecOp& o = ops[(*n_ops)++];
-            o.kind = 0; o.a = w[i + 1]; o.lit = -1; i += 2;
+            o.kind = 0; o.a = w[i + 1]; o.lit = -1; o.mask = nullptr; i += 2;
             break;
         }
         case 1: {
             T1DecOp& o = ops[(*n_ops)++];
             o.kind = 1; o.a = w[i + 1]; o.b = w[i + 2]; o.c2 = w[i + 3];
-            o.lit = -1;
+            o.lit = -1; o.mask = nullptr;
             i += 5;
             break;
         }
         case 2: {
             T1DecOp& o = ops[(*n_ops)++];
-            o.kind = 2; o.a = w[i + 1]; o.b = w[i + 2]; o.lit = -1; i += 3;
+            o.kind = 2; o.a = w[i + 1]; o.b = w[i + 2]; o.lit = -1; o.mask = nullptr; i += 3;
             break;
         }
         case 3:
         case 4: {
             T1DecOp& o = ops[(*n_ops)++];
-            o.kind = w[i]; o.a = w[i + 1]; o.lit = -1; i += 2;
+            o.kind = w[i]; o.a = w[i + 1]; o.lit = -1; o.mask = nullptr; i += 2;
             break;
         }
         case 5: {
@@ -1449,7 +1671,7 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
             int32_t self = (*n_ops)++;
             if (self >= kT1MaxDecOps) return -1;
             ops[self].kind = 5;
-            ops[self].lit = -1;
+            ops[self].lit = -1; ops[self].mask = nullptr;
             int32_t bw = w[i + 1];
             int32_t child_from = *n_ops;
             if (t1_decode_into(w + i + 2, bw, ops, n_ops) < 0) return -1;
@@ -1471,7 +1693,7 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
                 // first matching literal wins — no trial state copies
                 T1DecOp& o = ops[(*n_ops)++];
                 o.kind = 8;
-                o.lit = -1;
+                o.lit = -1; o.mask = nullptr;
                 o.w = w + i;
                 o.wn = (int32_t)(j - i);
                 i = j;
@@ -1482,13 +1704,13 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
             if (self >= kT1MaxDecOps) return -1;
             ops[self].kind = 6;
             ops[self].a = nb;
-            ops[self].lit = -1;
+            ops[self].lit = -1; ops[self].mask = nullptr;
             j = i + 2;
             for (int32_t b = 0; b < nb; ++b) {
                 int32_t marker = (*n_ops)++;
                 if (marker >= kT1MaxDecOps) return -1;
                 ops[marker].kind = 9;   // BRANCH
-                ops[marker].lit = -1;
+                ops[marker].lit = -1; ops[marker].mask = nullptr;
                 int32_t bw = w[j];
                 int32_t child_from = *n_ops;
                 if (t1_decode_into(w + j + 1, bw, ops, n_ops) < 0)
@@ -1524,7 +1746,9 @@ void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t from,
             const T1ClassInfo& ci = c.cinfo[o.b];
             int32_t start = st.cur;
             int32_t end;
-            if (o.lit >= 0 && ci.neg_char >= 0 &&
+            if (o.mask != nullptr && c.mask_base != nullptr) {
+                end = t1_mask_find(o.mask, c.mask_words, start);
+            } else if (o.lit >= 0 && ci.neg_char >= 0 &&
                 c.lit_blob[c.lit_offs[o.lit]] == (uint8_t)ci.neg_char) {
                 const void* hit =
                     memchr(c.row + start, ci.neg_char, c.len - start);
@@ -1552,7 +1776,9 @@ void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t from,
             st.cur += c.lit_lens[o.a];
             break;
         case 1: {  // SPAN
-            int32_t end = t1_scan_fwd(c, o.a, st.cur);
+            int32_t end = (o.mask != nullptr && c.mask_base != nullptr)
+                              ? t1_mask_find(o.mask, c.mask_words, st.cur)
+                              : t1_scan_fwd(c, o.a, st.cur);
             int32_t run = end - st.cur;
             if (run < o.b || (o.c2 >= 0 && run > o.c2)) {
                 st.ok = false;
@@ -1699,7 +1925,47 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         full_cov = simple && covered == ((1ull << C) - 1);
     }
 
-    T1Ctx ctx{nullptr, 0, classes, lit_blob, lit_offs, lit_lens, cinfo, C};
+    T1Ctx ctx{nullptr, 0, classes, lit_blob, lit_offs, lit_lens, cinfo, C,
+              nullptr, nullptr, 0, 0};
+
+    // Stop-mask acceleration: linear decoded programs only (pivot paths
+    // scan backwards; OPT/ALT re-scan from trial states — both keep the
+    // classic scanners).  Slot-assign every class used by SPAN/FIELD ops;
+    // per row one vector sweep fills the masks and every scan becomes a
+    // word lookup + ctz.
+    constexpr int32_t kMaskStride = 32;            // words → 2048-byte rows
+    int8_t mask_slot[kT1MaxClasses];
+    uint64_t maskbuf[kT1MaskSlots * kMaskStride];
+    T1MaskPlan plan{};
+    bool masks_on = false;
+    if (g_has_avx2 && n_dec >= 0 && !h.has_pivot && !h.has_pivot2) {
+        memset(mask_slot, -1, sizeof(mask_slot));
+        bool overflow = false;
+        for (int32_t k = 0; k < n_dec && !overflow; ++k) {
+            int32_t cls = -1;
+            if (dec[k].kind == 1) cls = dec[k].a;        // SPAN
+            else if (dec[k].kind == 7) cls = dec[k].b;   // FIELD
+            if (cls < 0 || mask_slot[cls] >= 0) continue;
+            if (plan.n_slots >= kT1MaskSlots) { overflow = true; break; }
+            mask_slot[cls] = (int8_t)plan.n_slots;
+            plan.ci[plan.n_slots] = &cinfo[cls];
+            plan.tbl[plan.n_slots] = classes + (int64_t)cls * 256;
+            ++plan.n_slots;
+        }
+        masks_on = !overflow && plan.n_slots > 0;
+        if (masks_on) {
+            // resolve each op's mask row once; the per-row sweep refills
+            // the same buffer so the pointers stay valid for every row
+            for (int32_t k = 0; k < n_dec; ++k) {
+                int32_t cls = dec[k].kind == 1 ? dec[k].a
+                              : dec[k].kind == 7 ? dec[k].b : -1;
+                if (cls >= 0 && mask_slot[cls] >= 0)
+                    dec[k].mask =
+                        maskbuf + (int64_t)mask_slot[cls] * kMaskStride;
+            }
+        }
+    }
+
     for (int64_t r = 0; r < n; ++r) {
         int64_t off = offsets[r];
         int64_t len = lengths[r];
@@ -1711,6 +1977,25 @@ int64_t lct_t1_exec(const uint8_t* arena, int64_t arena_len,
         if (off >= 0 && off + len <= arena_len && len <= INT32_MAX) {
             ctx.row = arena + off;
             ctx.len = (int32_t)len;
+            if (masks_on && len < kMaskStride * 64) {
+                // strict <: a row of exactly stride*64 bytes would have no
+                // sealed stop bit at index len (and a scan starting there
+                // would read one word past the slot) — classic scanners
+                // handle it instead
+                int32_t nw = (int32_t)((len + 64) >> 6);  // ≥1, covers seal
+                if (nw > kMaskStride) nw = kMaskStride;
+                t1_mask_build_all(ctx.row, ctx.len, arena_len - off, plan,
+                                  maskbuf, kMaskStride, nw);
+                for (int32_t s = 0; s < plan.n_slots; ++s)
+                    t1_mask_seal(maskbuf + (int64_t)s * kMaskStride, nw,
+                                 ctx.len);
+                ctx.mask_slot = mask_slot;
+                ctx.mask_base = maskbuf;
+                ctx.mask_words = nw;
+                ctx.mask_stride = kMaskStride;
+            } else {
+                ctx.mask_base = nullptr;
+            }
             st.cur = 0;
             st.ok = true;
             if (!full_cov) {

@@ -15,6 +15,12 @@
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 prev=unknown
 while true; do
+  # single-core host: a jax-importing probe steals CPU from a live bench —
+  # yield while one runs (the capture path relaunches bench itself anyway)
+  if pgrep -f "bench[.]py" > /dev/null 2>&1; then
+    sleep 30
+    continue
+  fi
   ts=$(date -u +%H:%M:%S)
   if timeout 75 python -c "
 import jax, jax.numpy as jnp

@@ -36,19 +36,21 @@ class TestStructLayout:
             "direction": 24,
             "stack_depth": 26,
             "payload_len": 28,
-            "call_name": 32,
-            "path": 64,
-            "local_addr": 192,
-            "remote_addr": 256,
-            "payload": 320,
-            "stack": 4416,
+            "ppid": 32,
+            "ktime": 40,
+            "call_name": 48,
+            "path": 80,
+            "local_addr": 208,
+            "remote_addr": 272,
+            "payload": 336,
+            "stack": 4432,
         }
         for name, off in expected.items():
             assert getattr(CEvent, name).offset == off, name
 
     def test_event_size(self):
-        # 4416 + 32*96 = 7488, padded to 8-byte alignment (already aligned)
-        assert ctypes.sizeof(CEvent) == 7488
+        # 4432 + 32*96 = 7504, padded to 8-byte alignment (already aligned)
+        assert ctypes.sizeof(CEvent) == 7504
 
     def test_driver_vtable_layout(self):
         assert CDriver.abi_version.offset == 0

@@ -1,0 +1,135 @@
+"""Stop-mask walker + one-pass SLS serializer regressions.
+
+The round-5 host-tier rewrite (per-row per-class stop masks built in one
+AVX sweep; serializer writes in a single pass with a reserved body-length
+varint) must stay bit-identical to Python `re` and to the wire decoder.
+Includes the 2048-byte row boundary that originally mis-parsed (mask
+region has no sealed stop bit at exactly stride*64 bytes).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import native
+from loongcollector_tpu.ops.regex.engine import RegexEngine
+from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+    parse_loggroup
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native library unavailable")
+
+APACHE = (r'(\S+) (\S+) (\S+) \[([^\]]+)\] '
+          r'"(\w+) (\S+) ([^"]*)" (\d+) (\S+)')
+
+
+def _walk(pattern, lines):
+    blob = b"\n".join(lines) + b"\n"
+    arena = np.frombuffer(blob, np.uint8)
+    offs, lens = native.split_lines(arena, 10, 0)
+    nat = RegexEngine(pattern)._host_walker()
+    assert nat is not None
+    ok, co, cl = nat(arena, offs.astype(np.int64), lens)
+    return blob, offs, lens, ok, co, cl
+
+
+def _assert_matches_re(pattern, lines):
+    blob, offs, lens, ok, co, cl = _walk(pattern, lines)
+    rx = re.compile(pattern.encode())
+    ncaps = rx.groups
+    for i in range(len(offs)):
+        o, ln = int(offs[i]), int(lens[i])
+        m = rx.fullmatch(blob[o:o + ln])
+        assert (m is not None) == bool(ok[i]), (i, blob[o:o + ln][:80])
+        if m is None:
+            continue
+        for g in range(ncaps):
+            s, e = m.span(g + 1)
+            if s >= 0:
+                assert co[i, g] - o == s, (i, g)
+                assert cl[i, g] == e - s, (i, g)
+
+
+class TestStopMaskWalker:
+    def test_mask_row_length_boundaries(self):
+        # 2048 == mask stride * 64: the original bug reported ok=False and
+        # read one word past the mask slot for a fully-matching row
+        pat = r"(\S+)"
+        for L in (1, 63, 64, 65, 127, 128, 2040, 2047, 2048, 2049, 4096):
+            lines = [b"a" * L]
+            _assert_matches_re(pat, lines)
+
+    def test_multiclass_apache_differential(self):
+        lines = [
+            b'1.2.3.4 - u7 [10/Oct/2000:13:55:36 -0700] '
+            b'"GET /x.gif HTTP/1.0" 200 2326',
+            b'bad line that does not match',
+            b'9.9.9.9 id9 - [t] "POST / HTTP/1.1" 404 -',
+            b'almost 1 2 [t] "GET / HTTP/1.0" 200',      # missing size
+        ]
+        _assert_matches_re(APACHE, lines)
+
+    def test_more_than_eight_classes_falls_back(self):
+        # 9 distinct classes exceed the mask slots: classic scanners only
+        pat = (r"([a-b]+) ([c-d]+) ([e-f]+) ([g-h]+) ([i-j]+) "
+               r"([k-l]+) ([m-n]+) ([o-p]+) ([q-r]+)")
+        lines = [b"ab cd ef gh ij kl mn op qr", b"ab cd ef gh ij kl mn op"]
+        _assert_matches_re(pat, lines)
+
+    def test_empty_and_single_byte_rows(self):
+        _assert_matches_re(r"(\w*)", [b"", b"x", b"", b"yy"])
+
+
+class TestOnePassSerializer:
+    def _roundtrip(self, values, keys=(b"k1", b"k2")):
+        blob = b"".join(values)
+        arena = np.frombuffer(blob, np.uint8) if blob else \
+            np.zeros(0, np.uint8)
+        n = len(values) // len(keys)
+        lens = np.array([len(v) for v in values], np.int32)
+        offs = np.zeros(len(values), np.int32)
+        pos = 0
+        for i, v in enumerate(values):
+            offs[i] = pos
+            pos += len(v)
+        F = len(keys)
+        field_offs = offs.reshape(n, F).T.copy()
+        field_lens = lens.reshape(n, F).T.copy()
+        ts = np.full(n, 1700000000, np.int64)
+        pay = native.sls_serialize(arena, ts, list(keys),
+                                   field_offs, field_lens)
+        assert pay is not None
+        g = parse_loggroup(bytes(pay))
+        assert len(g.events) == n
+        for i, ev in enumerate(g.events):
+            for f, k in enumerate(keys):
+                got = ev.get_content(k)
+                assert got is not None
+                assert got.to_bytes() == values[i * F + f]
+        return bytes(pay)
+
+    def test_small_bodies_one_byte_varint(self):
+        # bodies < 128 exercise the shrink-by-one memmove path
+        self._roundtrip([b"a", b"b", b"c", b"d"])
+
+    def test_medium_bodies_two_byte_varint(self):
+        self._roundtrip([b"x" * 60, b"y" * 80] * 3)
+
+    def test_large_bodies_grow_path(self):
+        # body > 16383 exercises the grow memmove path
+        self._roundtrip([b"v" * 20000, b"w" * 50])
+
+    def test_absent_spans_skipped(self):
+        arena = np.frombuffer(b"hello", np.uint8)
+        ts = np.array([1, 2], np.int64)
+        field_offs = np.array([[0, 0]], np.int32).T.reshape(1, 2)
+        field_offs = np.zeros((1, 2), np.int32)
+        field_lens = np.array([[5, -1]], np.int32).reshape(1, 2).T.copy()
+        pay = native.sls_serialize(arena, ts, [b"k"],
+                                   field_offs.reshape(1, 2),
+                                   field_lens.reshape(1, 2))
+        g = parse_loggroup(bytes(pay))
+        assert len(g.events) == 2
+        assert g.events[0].get_content(b"k").to_bytes() == b"hello"
+        assert g.events[1].get_content(b"k") is None
