@@ -18,75 +18,17 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ...models import PipelineEventGroup
+from ...models import PipelineEventGroup, SpanEvent
 from ...pipeline.plugin.interface import Input, PluginContext
 from ...utils.logger import get_logger
 from .adapter import (EBPFAdapter, EventSource, RawKernelEvent, get_adapter)
-from .protocol_http import parse_http
-from .protocol_mysql import parse_mysql
-from .protocol_redis import parse_redis
-
-
-def sniff_l7(payload: bytes):
-    """Protocol detection order mirrors the reference's protocol matrix
-    (core/ebpf/protocol/): HTTP (self-describing first line), then RESP
-    (typed first byte), then MySQL (length-framed packets)."""
-    rec = parse_http(payload)
-    if rec is not None:
-        return "http", rec
-    rec = parse_redis(payload)
-    if rec is not None:
-        return "redis", rec
-    rec = parse_mysql(payload)
-    if rec is not None:
-        return "mysql", rec
-    return "raw", None
+from .connections import ConnectionManager, sniff_l7
+from .proc_tree import ProcessTreeCache
 
 log = get_logger("ebpf")
 
 FLUSH_INTERVAL_S = 0.5
 MAX_BATCH_EVENTS = 1024
-
-
-class ProcessCacheManager:
-    """pid → (comm, cmdline) cache with TTL (reference ProcessCacheManager
-    invalidates on exec events; without a kernel driver a short TTL bounds
-    mis-attribution across pid reuse)."""
-
-    TTL_S = 30.0
-    MAX_ENTRIES = 8192
-
-    def __init__(self) -> None:
-        self._cache: Dict[int, tuple] = {}   # pid -> (comm, cmdline, expiry)
-        self._lock = threading.Lock()
-
-    def lookup(self, pid: int) -> tuple:
-        now = time.monotonic()
-        with self._lock:
-            hit = self._cache.get(pid)
-            if hit is not None and hit[2] > now:
-                return hit[0], hit[1]
-        comm = cmdline = ""
-        try:
-            with open(f"/proc/{pid}/comm") as f:
-                comm = f.read().strip()
-            with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmdline = f.read().replace(b"\0", b" ").decode(
-                    "utf-8", "replace").strip()
-        except OSError:
-            pass
-        with self._lock:
-            if len(self._cache) >= self.MAX_ENTRIES:
-                # evict expired first; if none, drop the soonest-to-expire half
-                expired = [k for k, v in self._cache.items() if v[2] <= now]
-                for k in expired:
-                    del self._cache[k]
-                if len(self._cache) >= self.MAX_ENTRIES:
-                    by_exp = sorted(self._cache.items(), key=lambda kv: kv[1][2])
-                    for k, _ in by_exp[: self.MAX_ENTRIES // 2]:
-                        del self._cache[k]
-            self._cache[pid] = (comm, cmdline, now + self.TTL_S)
-        return comm, cmdline
 
 
 class _SourceManager:
@@ -112,11 +54,16 @@ class _SourceManager:
         if time.monotonic() - self._last_flush >= FLUSH_INTERVAL_S:
             self.flush()
 
+    # managers that accumulate state outside _pending (connection spans /
+    # rollup cells) set this so their flush runs even with no raw events
+    flush_when_empty = False
+
     def flush(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
             self._last_flush = time.monotonic()
-        if not pending or self.queue_key is None:
+        if (not pending and not self.flush_when_empty) \
+                or self.queue_key is None:
             return
         group = self.build_group(pending)
         if group is not None and not group.empty():
@@ -130,22 +77,47 @@ class _SourceManager:
 
 
 class NetworkObserverManager(_SourceManager):
-    """L7 parse of captured payloads → LogEvents (reference
-    NetworkObserverManager + protocol parsers)."""
+    """L7 observer (reference NetworkObserverManager + ConnectionManager):
+    control/stats events maintain the connection table; payload events emit
+    the per-event log stream AND feed request/response matching, so each
+    flush carries logs, completed-exchange SPANs (with latency) and the
+    rollup metric cells — the observer's three output streams."""
+
+    def __init__(self, source, server):
+        super().__init__(source, server)
+        self.connections = ConnectionManager()
+
+    def on_raw_event(self, ev: RawKernelEvent) -> None:
+        if ev.call_name in ("conn_connect", "conn_accept", "conn_close"):
+            self.connections.accept_ctrl(ev)
+            return
+        if ev.call_name == "conn_stats":
+            self.connections.accept_stats(ev)
+            return
+        if ev.payload:
+            # sniff exactly once per event; build_group reuses the result
+            proto, rec = sniff_l7(ev.payload)
+            ev.l7 = (proto, rec)
+            self.connections.accept_data(ev, proto, rec)
+        super().on_raw_event(ev)
+
+    flush_when_empty = True    # spans/metrics accumulate between flushes
 
     def build_group(self, events):
         group = PipelineEventGroup()
         sb = group.source_buffer
-        cache = self.server.process_cache
+        tree = self.server.proc_tree
         for raw in events:
-            proto, rec = (sniff_l7(raw.payload) if raw.payload
-                          else ("raw", None))
+            # on_raw_event stashes the sniff result; events arriving by
+            # other paths (tests, replays) sniff here instead
+            proto, rec = getattr(raw, "l7", None) or \
+                (sniff_l7(raw.payload) if raw.payload else ("raw", None))
             ev = group.add_log_event(raw.timestamp_ns // 1_000_000_000
                                      or int(time.time()))
-            comm, _ = cache.lookup(raw.pid)
+            ent = tree.lookup(raw.pid, raw.ktime)
             ev.set_content(b"pid", sb.copy_string(str(raw.pid)))
-            if comm:
-                ev.set_content(b"comm", sb.copy_string(comm))
+            if ent is not None and ent.comm:
+                ev.set_content(b"comm", sb.copy_string(ent.comm))
             ev.set_content(b"local_addr", sb.copy_string(raw.local_addr))
             ev.set_content(b"remote_addr", sb.copy_string(raw.remote_addr))
             ev.set_content(b"direction", sb.copy_string(raw.direction))
@@ -192,28 +164,85 @@ class NetworkObserverManager(_SourceManager):
                     else:
                         ev.set_content(b"ok", sb.copy_string(
                             b"1" if rec.ok else b"0"))
+        now = int(time.time())
+        for span in self.connections.take_spans():
+            sp = group.add_span_event(now)
+            sp.name = span.name.encode()
+            sp.kind = SpanEvent.Kind.SERVER
+            sp.start_time_ns = span.start_ns
+            sp.end_time_ns = span.end_ns
+            sp.status = (SpanEvent.Status.ERROR if span.status == "error"
+                         else SpanEvent.Status.OK)
+            sp.set_attribute(b"protocol", sb.copy_string(span.protocol))
+            sp.set_attribute(b"pid", sb.copy_string(str(span.pid)))
+            sp.set_attribute(b"local_addr", sb.copy_string(span.local_addr))
+            sp.set_attribute(b"remote_addr",
+                             sb.copy_string(span.remote_addr))
+            if span.status_code:
+                sp.set_attribute(b"status_code",
+                                 sb.copy_string(span.status_code))
+            for k, v in span.attributes.items():
+                sp.set_attribute(k.encode(), sb.copy_string(v))
+            ent = tree.lookup(span.pid, span.ktime)
+            if ent is not None and ent.comm:
+                sp.set_attribute(b"comm", sb.copy_string(ent.comm))
+        for (proto, remote, status), cell in \
+                self.connections.take_rollup().items():
+            mv = group.add_metric_event(now)
+            mv.set_name(b"ebpf_l7_requests")
+            mv.set_multi_value({
+                b"count": cell.count,
+                b"errors": cell.errors,
+                b"latency_sum_ns": cell.latency_sum_ns,
+                b"latency_max_ns": cell.latency_max_ns,
+                b"rx_bytes": cell.rx_bytes,
+                b"tx_bytes": cell.tx_bytes,
+            })
+            mv.set_tag(b"protocol", sb.copy_string(proto))
+            mv.set_tag(b"remote", sb.copy_string(remote))
+            mv.set_tag(b"status", sb.copy_string(status))
         group.set_tag(b"__source__", b"ebpf_network_observer")
         return group
 
 
 class SecurityManager(_SourceManager):
     """Process/file/network security events (reference
-    {Process,File,Network}SecurityManager)."""
+    {Process,File,Network}SecurityManager).
+
+    PROCESS_SECURITY exec/clone/exit events also drive the process-tree
+    cache (reference ProcessCacheManager consumes the same stream), so
+    every security event is enriched with the process AND parent blocks
+    (AttachProcessData, ProcessCacheManager.cpp:248-291).  Driver event
+    conventions: execve events carry the binary in `path` and the argument
+    string in `payload`; clone/exit carry only identities."""
+
+    def on_raw_event(self, ev: RawKernelEvent) -> None:
+        if self.source is EventSource.PROCESS_SECURITY:
+            tree = self.server.proc_tree
+            name = ev.call_name
+            if name in ("sys_execve", "execve"):
+                binary = ev.path
+                comm = binary.rsplit("/", 1)[-1] if binary else ""
+                tree.on_execve(
+                    ev.pid, ev.ktime, ppid=ev.ppid, comm=comm,
+                    binary=binary,
+                    args=ev.payload.decode("utf-8", "replace"))
+            elif name in ("sys_clone", "clone", "sys_fork"):
+                tree.on_clone(ev.pid, ev.ktime, ev.ppid)
+            elif name in ("sys_exit", "exit", "sched_process_exit"):
+                tree.on_exit(ev.pid, ev.ktime)
+        super().on_raw_event(ev)
 
     def build_group(self, events):
         group = PipelineEventGroup()
         sb = group.source_buffer
-        cache = self.server.process_cache
+        tree = self.server.proc_tree
         for raw in events:
             ev = group.add_log_event(raw.timestamp_ns // 1_000_000_000
                                      or int(time.time()))
-            comm, cmdline = cache.lookup(raw.pid)
             ev.set_content(b"pid", sb.copy_string(str(raw.pid)))
             ev.set_content(b"call_name", sb.copy_string(raw.call_name))
-            if comm:
-                ev.set_content(b"comm", sb.copy_string(comm))
-            if cmdline:
-                ev.set_content(b"cmdline", sb.copy_string(cmdline))
+            tree.attach_process_data(raw.pid, raw.ktime, ev, sb)
             if raw.path:
                 ev.set_content(b"path", sb.copy_string(raw.path))
             if raw.remote_addr:
@@ -230,7 +259,7 @@ class CpuProfilingManager(_SourceManager):
     def build_group(self, events):
         group = PipelineEventGroup()
         sb = group.source_buffer
-        cache = self.server.process_cache
+        tree = self.server.proc_tree
         agg: Dict[tuple, int] = {}
         for raw in events:
             key = (raw.pid, tuple(raw.stack))
@@ -238,10 +267,10 @@ class CpuProfilingManager(_SourceManager):
         now = int(time.time())
         for (pid, stack), count in agg.items():
             ev = group.add_log_event(now)
-            comm, _ = cache.lookup(pid)
+            ent = tree.lookup(pid)
             ev.set_content(b"pid", sb.copy_string(str(pid)))
-            if comm:
-                ev.set_content(b"comm", sb.copy_string(comm))
+            if ent is not None and ent.comm:
+                ev.set_content(b"comm", sb.copy_string(ent.comm))
             ev.set_content(b"stack", sb.copy_string(";".join(stack)))
             ev.set_content(b"count", sb.copy_string(str(count)))
         group.set_tag(b"__source__", b"ebpf_cpu_profiling")
@@ -255,7 +284,7 @@ class EBPFServer:
     def __init__(self) -> None:
         self.adapter: EBPFAdapter = get_adapter()
         self.process_queue_manager = None
-        self.process_cache = ProcessCacheManager()
+        self.proc_tree = ProcessTreeCache()
         self._managers: Dict[EventSource, _SourceManager] = {}
         self._thread: Optional[threading.Thread] = None
         self._running = False
@@ -320,6 +349,7 @@ class EBPFServer:
             mgr.flush()
 
     def _run(self) -> None:
+        last_gc = time.monotonic()
         while self._running:
             time.sleep(0.1)
             for mgr in list(self._managers.values()):
@@ -327,6 +357,16 @@ class EBPFServer:
                     mgr.maybe_flush()
                 except Exception:  # noqa: BLE001
                     log.exception("ebpf flush failed")
+            now = time.monotonic()
+            if now - last_gc >= 5.0:
+                last_gc = now
+                try:
+                    self.proc_tree.clear_expired()
+                    netobs = self._managers.get(EventSource.NETWORK_OBSERVE)
+                    if netobs is not None:
+                        netobs.connections.iterations()
+                except Exception:  # noqa: BLE001
+                    log.exception("ebpf gc failed")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,205 @@
+"""eBPF depth: process-tree cache, connection manager, L7 spans, profiling.
+
+VERDICT r4 #4 done-bars: pid→proc-meta assertions and L7-span assertions,
+built on the v2 driver ABI (ppid + ktime on every event).  Semantics mirror
+core/ebpf/plugin/ProcessCacheManager.cpp (exec/clone/exit lifecycle, parent
+linkage, (pid, ktime) identity) and network_observer/ConnectionManager.cpp
+(ctrl/data/stats intake, bounded table, request/response matching).
+"""
+
+import time
+
+import pytest
+
+from loongcollector_tpu.input.ebpf.adapter import (EventSource, MockAdapter,
+                                                   RawKernelEvent)
+from loongcollector_tpu.input.ebpf.connections import (ConnectionManager,
+                                                       MAX_PENDING_REQS)
+from loongcollector_tpu.input.ebpf.proc_tree import ProcessTreeCache
+from loongcollector_tpu.input.ebpf.server import EBPFServer
+from loongcollector_tpu.models import SourceBuffer, SpanEvent
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+
+def _net(pid=100, fd=5, call="", payload=b"", ts=0, direction="ingress",
+         ktime=111):
+    return RawKernelEvent(source=EventSource.NETWORK_OBSERVE, pid=pid,
+                          fd=fd, call_name=call, payload=payload,
+                          timestamp_ns=ts, direction=direction,
+                          ktime=ktime, local_addr="10.0.0.1:80",
+                          remote_addr="10.9.9.9:555")
+
+
+class TestProcessTreeCache:
+    def test_exec_clone_exit_lifecycle(self):
+        t = ProcessTreeCache()
+        parent = t.on_execve(100, 10, ppid=1, comm="bash",
+                             binary="/bin/bash", args="bash -l")
+        child = t.on_clone(200, 20, ppid=100)
+        # clone inherits the parent image until it execs
+        assert child.comm == "bash"
+        assert child.parent is parent
+        assert parent.refcnt == 2
+        execd = t.on_execve(200, 25, ppid=100, comm="curl",
+                            binary="/usr/bin/curl", args="curl http://x")
+        assert execd.parent is parent
+        assert t.lookup(200).comm == "curl"          # latest image wins
+        assert t.lookup(200, 20).comm == "bash"      # old identity intact
+
+    def test_pid_ktime_identity_across_reuse(self):
+        t = ProcessTreeCache()
+        t.on_execve(300, 50, comm="old")
+        t.on_execve(300, 90, comm="new")             # pid reused
+        assert t.lookup(300, 50).comm == "old"
+        assert t.lookup(300, 90).comm == "new"
+        assert t.lookup(300).comm == "new"
+
+    def test_exit_grace_and_expiry(self, monkeypatch):
+        import loongcollector_tpu.input.ebpf.proc_tree as pt
+        t = ProcessTreeCache()
+        t.on_execve(400, 1, comm="gone")
+        t.on_exit(400, 1)
+        assert t.clear_expired() == 0                # inside grace period
+        monkeypatch.setattr(pt, "EXIT_GRACE_S", 0.0)
+        time.sleep(0.01)
+        assert t.clear_expired() == 1
+        assert t.lookup(400, 1) is None
+
+    def test_parent_ref_blocks_expiry(self, monkeypatch):
+        import loongcollector_tpu.input.ebpf.proc_tree as pt
+        monkeypatch.setattr(pt, "EXIT_GRACE_S", 0.0)
+        t = ProcessTreeCache()
+        t.on_execve(500, 1, comm="parent")
+        t.on_clone(600, 2, ppid=500)
+        t.on_exit(500, 1)
+        time.sleep(0.01)
+        # the child's ref keeps the exited parent's entry alive
+        assert t.clear_expired() == 0
+        assert t.lookup(500, 1).comm == "parent"
+
+    def test_attach_process_data_fields(self):
+        t = ProcessTreeCache()
+        t.on_execve(700, 1, ppid=1, comm="bash", binary="/bin/bash",
+                    args="bash")
+        t.on_execve(800, 2, ppid=700, comm="curl", binary="/usr/bin/curl",
+                    args="curl -s http://x", cwd="/home/u")
+        sb = SourceBuffer()
+        from loongcollector_tpu.models import PipelineEventGroup
+        g = PipelineEventGroup(sb)
+        ev = g.add_log_event(1)
+        assert t.attach_process_data(800, 2, ev, sb)
+        assert ev.get_content(b"binary") == b"/usr/bin/curl"
+        assert ev.get_content(b"arguments") == b"curl -s http://x"
+        assert ev.get_content(b"cwd") == b"/home/u"
+        assert ev.get_content(b"exec_id") == b"800:2"
+        assert ev.get_content(b"parent_pid") == b"700"
+        assert ev.get_content(b"parent_binary") == b"/bin/bash"
+
+
+class TestConnectionManager:
+    REQ = (b"GET /api/users HTTP/1.1\r\nHost: shop\r\n\r\n")
+    RESP_OK = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nhi"
+    RESP_ERR = b"HTTP/1.1 500 Oops\r\nContent-Length: 0\r\n\r\n"
+
+    def test_request_response_span_with_latency(self):
+        cm = ConnectionManager()
+        cm.accept_ctrl(_net(call="conn_accept"))
+        assert cm.accept_data(_net(payload=self.REQ, ts=1_000)) is None
+        span = cm.accept_data(_net(payload=self.RESP_OK, ts=6_000,
+                                   direction="egress"))
+        assert span is not None
+        assert span.protocol == "http"
+        assert span.name == "GET /api/users"
+        assert span.latency_ns == 5_000
+        assert span.status == "ok" and span.status_code == "200"
+        assert span.attributes["host"] == "shop"
+        assert cm.take_spans() == [span]
+
+    def test_error_rollup(self):
+        cm = ConnectionManager()
+        cm.accept_ctrl(_net(call="conn_accept"))
+        for i in range(3):
+            cm.accept_data(_net(payload=self.REQ, ts=i * 100))
+            cm.accept_data(_net(payload=self.RESP_ERR, ts=i * 100 + 40,
+                                direction="egress"))
+        roll = cm.take_rollup()
+        assert len(roll) == 1
+        (proto, remote, status), cell = next(iter(roll.items()))
+        assert proto == "http" and status == "5xx"
+        assert cell.count == 3 and cell.errors == 3
+        assert cell.latency_max_ns == 40
+
+    def test_conn_close_and_stats(self):
+        cm = ConnectionManager()
+        cm.accept_ctrl(_net(call="conn_connect"))
+        ev = _net(call="conn_stats")
+        ev.flags = (300 << 16) | 120      # tx=300, rx=120
+        cm.accept_stats(ev)
+        assert cm.connection_count() == 1
+        conn = cm._conns[(100, 5)]
+        assert conn.rx_bytes == 120 and conn.tx_bytes == 300
+        cm.accept_ctrl(_net(call="conn_close"))
+        assert cm.connection_count() == 0
+
+    def test_pending_queue_bounded(self):
+        cm = ConnectionManager()
+        for i in range(MAX_PENDING_REQS + 10):
+            cm.accept_data(_net(payload=self.REQ, ts=i))
+        conn = cm._conns[(100, 5)]
+        assert len(conn.pending) == MAX_PENDING_REQS
+
+    def test_table_bounded(self):
+        cm = ConnectionManager(max_connections=4)
+        for fd in range(8):
+            cm.accept_ctrl(_net(fd=fd, call="conn_connect"))
+        assert cm.connection_count() == 4
+        assert cm.dropped_conns == 4
+
+
+class TestServerIntegration:
+    def _server(self, source, key):
+        adapter = MockAdapter()
+        server = EBPFServer()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(key)
+        server.process_queue_manager = pqm
+        server.adapter = adapter
+        assert server.enable_plugin(source, key)
+        return adapter, server, pqm
+
+    def test_exec_enriched_security_events(self):
+        adapter, server, pqm = self._server(EventSource.PROCESS_SECURITY, 91)
+        adapter.feed(RawKernelEvent(
+            source=EventSource.PROCESS_SECURITY, pid=4000, ppid=1,
+            ktime=77, call_name="sys_execve", path="/usr/bin/nginx",
+            payload=b"nginx -g daemon off;"))
+        adapter.feed(RawKernelEvent(
+            source=EventSource.PROCESS_SECURITY, pid=4000, ktime=77,
+            call_name="security_capable"))
+        server._managers[EventSource.PROCESS_SECURITY].flush()
+        _, group = pqm.pop_item(timeout=0)
+        by_call = {ev.get_content(b"call_name"): ev for ev in group.events}
+        enr = by_call[b"security_capable"]
+        assert enr.get_content(b"binary") == b"/usr/bin/nginx"
+        assert enr.get_content(b"arguments") == b"nginx -g daemon off;"
+        assert enr.get_content(b"exec_id") == b"4000:77"
+        server.stop()
+
+    def test_network_observer_emits_spans_and_metrics(self):
+        adapter, server, pqm = self._server(EventSource.NETWORK_OBSERVE, 92)
+        adapter.feed(_net(call="conn_accept"))
+        adapter.feed(_net(payload=TestConnectionManager.REQ, ts=10_000))
+        adapter.feed(_net(payload=TestConnectionManager.RESP_OK, ts=90_000,
+                          direction="egress"))
+        server._managers[EventSource.NETWORK_OBSERVE].flush()
+        _, group = pqm.pop_item(timeout=0)
+        spans = [e for e in group.events if isinstance(e, SpanEvent)]
+        assert len(spans) == 1
+        assert spans[0].name == b"GET /api/users"
+        assert spans[0].end_time_ns - spans[0].start_time_ns == 80_000
+        assert spans[0].status == SpanEvent.Status.OK
+        metrics = [e for e in group.events
+                   if e.__class__.__name__ == "MetricEvent"]
+        assert metrics and metrics[0].value.values[b"count"] == 1.0
+        server.stop()
