@@ -37,7 +37,7 @@ class _ConfigState:
                  queue_key: int, tail_existing: bool,
                  multiline_start: Optional[str] = None,
                  multiline_end: Optional[str] = None,
-                 encoding: str = "utf8"):
+                 encoding: str = "utf8", chunk_size: Optional[int] = None):
         self.name = name
         self.poller = PollingDirFile(discovery)
         self.queue_key = queue_key
@@ -50,15 +50,20 @@ class _ConfigState:
         self.multiline_start = multiline_start
         self.multiline_end = multiline_end
         self.encoding = encoding
+        self.chunk_size = chunk_size   # None = reader default (reference
+                                       # ReadBufferSize config knob)
         self.pending: set = set()   # paths with bytes left after a drain
         # optional per-path group tags (container meta on stdio inputs):
         # callable(path) -> Dict[bytes, bytes] | None
         self.tag_provider = None
 
     def new_reader(self, path: str) -> LogFileReader:
+        kwargs = {}
+        if self.chunk_size:
+            kwargs["chunk_size"] = self.chunk_size
         return LogFileReader(path, multiline_start=self.multiline_start,
                              multiline_end=self.multiline_end,
-                             encoding=self.encoding)
+                             encoding=self.encoding, **kwargs)
 
 
 class FileServer:
@@ -84,6 +89,14 @@ class FileServer:
         # False when any watch failed (max_user_watches, permission): the
         # poll interval stays tight so unwatched paths aren't slow-tailed
         self._watch_complete = False
+        # BlockedEventManager analogue (reference event_handler/
+        # BlockedEventManager.cpp + queue FeedbackInterface): a watermark-
+        # rejected drain registers this server as the queue's feedback, so
+        # the moment the runner pops the queue below its low watermark the
+        # event thread wakes and resumes the blocked readers instead of
+        # waiting out the poll sleep
+        self._blocked_wake = threading.Event()
+        self._feedback_keys: set = set()
 
     @classmethod
     def instance(cls) -> "FileServer":
@@ -98,12 +111,13 @@ class FileServer:
                    queue_key: int, tail_existing: bool = False,
                    multiline_start: Optional[str] = None,
                    multiline_end: Optional[str] = None,
-                   tag_provider=None, encoding: str = "utf8") -> None:
+                   tag_provider=None, encoding: str = "utf8",
+                   chunk_size: Optional[int] = None) -> None:
         with self._lock:
             st = _ConfigState(
                 name, discovery, queue_key, tail_existing,
                 multiline_start=multiline_start, multiline_end=multiline_end,
-                encoding=encoding)
+                encoding=encoding, chunk_size=chunk_size)
             st.tag_provider = tag_provider
             self._configs[name] = st
 
@@ -185,6 +199,18 @@ class FileServer:
                 sleep = base * 3
             if busy and level <= 0.9:
                 continue
+            if self._blocked_wake.is_set():
+                # a queue we blocked on drained: resume immediately
+                self._blocked_wake.clear()
+                continue
+            with self._lock:
+                any_pending = any(st.pending for st in
+                                  self._configs.values())
+            if any_pending:
+                # back-pressured readers outstanding: the inotify wait
+                # below cannot see the feedback event, so bound the sleep
+                # instead of waiting out the full (possibly throttled) tick
+                sleep = min(sleep, 0.05)
             if self._listener is not None:
                 # sleep ON the inotify fd: an append wakes the thread now,
                 # not at the next poll tick (sub-poll-interval tail latency)
@@ -195,7 +221,7 @@ class FileServer:
                             for st in self._configs.values():
                                 st.last_discovery = 0.0
             else:
-                time.sleep(sleep)
+                self._blocked_wake.wait(sleep)
         if self._listener is not None:
             self._listener.close()
             self._listener = None
@@ -279,6 +305,20 @@ class FileServer:
             self._watch_complete = complete
         return busy
 
+    def _register_feedback(self, queue_key: int) -> None:
+        if queue_key in self._feedback_keys:
+            return
+        getter = getattr(self.process_queue_manager, "get_queue", None)
+        q = getter(queue_key) if getter is not None else None
+        if q is not None:
+            q.set_feedback(self)
+            self._feedback_keys.add(queue_key)
+
+    def feedback(self, key: int) -> None:
+        """Queue drained below its low watermark: wake the event thread so
+        blocked readers resume immediately (FeedbackInterface)."""
+        self._blocked_wake.set()
+
     def _check_rotation(self, st: _ConfigState, path: str) -> None:
         """rename+recreate rotation: the path's inode changed — finish the
         old inode via the rotated list, open a fresh reader at offset 0
@@ -321,7 +361,9 @@ class FileServer:
         pqm = self.process_queue_manager
         for _ in range(64):  # bounded burst per round
             if pqm is not None and not pqm.is_valid_to_push(st.queue_key):
-                break  # watermark high: retry next round (BlockedEventManager)
+                # watermark high: requeue for the feedback wakeup
+                self._register_feedback(st.queue_key)
+                break
             try:
                 group = reader.read(force_flush=force_flush)
             except OSError:
@@ -341,6 +383,7 @@ class FileServer:
                     # queue rejected after read: restore offset (SOURCE
                     # bytes) and the multiline stitch state together
                     reader.rollback_last()
+                    self._register_feedback(st.queue_key)
                     break
             moved = True
             self.checkpoints.update(reader.checkpoint())

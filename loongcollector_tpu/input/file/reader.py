@@ -161,7 +161,11 @@ class LogFileReader:
             size = os.fstat(self._fd).st_size
         except OSError:
             return False
-        return size > self.offset
+        # size < offset is TRUNCATION, not emptiness: read() must run so
+        # the offset resets and the rewritten content ships — a file
+        # copytruncate'd below the old offset would otherwise sit unread
+        # until it regrew past it
+        return size != self.offset
 
     def read(self, force_flush: bool = False
              ) -> Optional[PipelineEventGroup]:

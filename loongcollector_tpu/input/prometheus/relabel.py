@@ -13,6 +13,20 @@ import re
 from typing import Dict, List, Optional
 
 
+KNOWN_ACTIONS = frozenset({
+    "replace", "keep", "drop", "keepequal", "dropequal", "hashmod",
+    "lowercase", "uppercase", "labelmap", "labeldrop", "labelkeep",
+    "dropmetric",
+})
+
+
+class RelabelUnsupported(ValueError):
+    """Config names an action this implementation does not have.  Raised at
+    CONFIG time: silently passing labels through an unknown action would
+    surface as data corruption, not an error (reference Relabel.cpp returns
+    Action::UNDEFINED and fails the config load)."""
+
+
 class RelabelRule:
     def __init__(self, config: dict):
         self.source_labels: List[str] = list(config.get("source_labels", []))
@@ -22,6 +36,22 @@ class RelabelRule:
         self.modulus: int = int(config.get("modulus", 0) or 0)
         self.replacement: str = config.get("replacement", "$1")
         self.action: str = config.get("action", "replace").lower()
+        if self.action not in KNOWN_ACTIONS:
+            raise RelabelUnsupported(
+                f"unknown relabel action {self.action!r}")
+        # dropmetric (reference extension): drop the sample when its
+        # __name__ is in match_list
+        self.match_list = set(config.get("match_list", []))
+        if self.action == "dropmetric":
+            if not self.match_list:
+                raise RelabelUnsupported("dropmetric requires match_list")
+            self.source_labels = ["__name__"]
+        if self.action in ("lowercase", "uppercase", "hashmod") \
+                and not self.target_label:
+            # an empty target would silently create a label named "" —
+            # prometheus requires target_label for these actions
+            raise RelabelUnsupported(
+                f"{self.action} requires target_label")
 
     def _concat(self, labels: Dict[str, str]) -> str:
         return self.separator.join(labels.get(k, "") for k in self.source_labels)
@@ -51,6 +81,16 @@ class RelabelRule:
                 else:
                     out.pop(target, None)
             return out
+        if act == "lowercase":
+            out = dict(labels)
+            out[self.target_label] = val.lower()
+            return out
+        if act == "uppercase":
+            out = dict(labels)
+            out[self.target_label] = val.upper()
+            return out
+        if act == "dropmetric":
+            return None if val in self.match_list else labels
         if act == "hashmod":
             if self.modulus <= 0:
                 return labels

@@ -125,6 +125,139 @@ def _normalize_url(t: str) -> str:
     return f"http://{t}/metrics"
 
 
+class StreamScraper:
+    """Streaming scrape: response bytes flow through line-aligned chunks
+    into bounded event groups pushed mid-scrape (reference
+    component/StreamScraper.cpp:119 — the body is never buffered whole, so
+    a 100 MB federate endpoint cannot balloon the agent RSS).
+
+    feed() keeps the trailing partial line; every MAX_GROUP_SAMPLES parsed
+    samples (or MAX_GROUP_BYTES raw bytes) one group ships with a
+    stream-index tag; finish() flushes the tail and appends the scrape
+    auto-metrics (up, scrape_duration_seconds, scrape_samples_scraped)."""
+
+    MAX_GROUP_SAMPLES = 512
+    MAX_GROUP_BYTES = 1 << 20
+
+    def __init__(self, job: "ScrapeJob", target: ScrapeTarget, push):
+        self.job = job
+        self.target = target
+        self.push = push
+        self._tail = b""
+        self._group: Optional[PipelineEventGroup] = None
+        self._group_bytes = 0
+        self.stream_index = 0
+        self.samples_scraped = 0
+        self.raw_size = 0
+
+    def feed(self, chunk: bytes) -> None:
+        self.raw_size += len(chunk)
+        data = self._tail + chunk
+        nl = data.rfind(b"\n")
+        if nl < 0:
+            self._tail = data
+            return
+        complete, self._tail = data[: nl + 1], data[nl + 1:]
+        self._parse_into_group(complete)
+
+    def finish(self, duration_s: float, up: bool) -> None:
+        if self._tail and up:
+            # a failed scrape's tail may be truncated mid-number — shipping
+            # it would emit a corrupt-but-plausible sample next to up=0
+            self._parse_into_group(self._tail + b"\n")
+        self._tail = b""
+        self._flush_group()
+        # auto-metrics ride their own group and are EXEMPT from
+        # metric_relabel_configs (prometheus never relabels synthetic
+        # series — a keep rule must not break target-health alerting)
+        from ...models import SourceBuffer
+        group = PipelineEventGroup(SourceBuffer())
+        sb = group.source_buffer
+        now = int(time.time())
+        for name, value in ((b"up", 1.0 if up else 0.0),
+                            (b"scrape_duration_seconds", duration_s),
+                            (b"scrape_samples_scraped",
+                             float(self.samples_scraped))):
+            ev = group.add_metric_event(now)
+            ev.set_name(sb.copy_string(name))
+            ev.set_value(value)
+            for k, v in self.target.labels.items():
+                ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+        group.set_tag(b"job", self.job.name)
+        group.set_tag(b"__stream_index__", str(self.stream_index))
+        self.stream_index += 1
+        self.push(self.job.queue_key, group)
+
+    # -- internals ----------------------------------------------------------
+
+    def _ensure_group(self) -> PipelineEventGroup:
+        if self._group is None:
+            from ...models import SourceBuffer
+            self._group = PipelineEventGroup(SourceBuffer())
+            self._group_bytes = 0
+        return self._group
+
+    def _parse_into_group(self, data: bytes) -> None:
+        # batch by LINES so group sizes respect MAX_GROUP_SAMPLES even when
+        # one network read carries thousands of samples
+        lines = data.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()
+        i = 0
+        while i < len(lines):
+            group = self._ensure_group()
+            room = max(self.MAX_GROUP_SAMPLES - len(group.events), 1)
+            batch = lines[i:i + room]
+            i += len(batch)
+            block = b"\n".join(batch) + b"\n"
+            before = len(group.events)
+            parse_exposition(block, group=group)
+            self.samples_scraped += len(group.events) - before
+            self._group_bytes += len(block)
+            if len(group.events) >= self.MAX_GROUP_SAMPLES or \
+                    self._group_bytes >= self.MAX_GROUP_BYTES:
+                self._flush_group()
+
+    def _flush_group(self) -> None:
+        group = self._group
+        self._group = None
+        if group is None:
+            return
+        self._apply_labels(group)
+        if group.empty():
+            return    # every sample relabel-dropped: nothing to push
+        group.set_tag(b"job", self.job.name)
+        group.set_tag(b"__stream_index__", str(self.stream_index))
+        self.stream_index += 1
+        self.push(self.job.queue_key, group)
+
+    def _apply_labels(self, group: PipelineEventGroup) -> None:
+        job, target = self.job, self.target
+        if not (job.metric_relabel.rules or target.labels):
+            return
+        kept = []
+        sb = group.source_buffer
+        for ev in group.events:
+            labels = {k.decode("utf-8", "replace"): str(v)
+                      for k, v in ev.tags.items()}
+            labels.update(target.labels)
+            if getattr(ev, "name", None) is not None:
+                # __name__ must be visible to keep/drop/dropmetric rules
+                labels.setdefault("__name__", ev.name.to_str())
+            labels = job.metric_relabel.process(labels)
+            if labels is None:
+                continue
+            new_name = labels.pop("__name__", None)
+            if new_name is not None and (
+                    ev.name is None or new_name != ev.name.to_str()):
+                ev.set_name(sb.copy_string(new_name))
+            ev.tags.clear()
+            for k, v in labels.items():
+                ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+            kept.append(ev)
+        group._events = kept
+
+
 class PrometheusInputRunner:
     _instance: Optional["PrometheusInputRunner"] = None
     _instance_lock = threading.Lock()
@@ -188,33 +321,22 @@ class PrometheusInputRunner:
                             log.exception("scrape failed: %s", target.url)
 
     def scrape_one(self, job: ScrapeJob, target: ScrapeTarget) -> None:
-        body, ok = self._fetch(target.url, job.timeout)
+        pqm = self.process_queue_manager
+
+        def push(key, group):
+            if pqm is not None:
+                pqm.push_queue(key, group)
+
+        scraper = StreamScraper(job, target, push)
+        t0 = time.monotonic()
+        ok = self._fetch_stream(target.url, job.timeout, scraper.feed)
         target.up = ok
-        if not ok:
-            return
-        group = parse_exposition(body)
-        # sample relabel + target labels
-        if job.metric_relabel.rules or target.labels:
-            kept = []
-            for ev in group.events:
-                labels = {k.decode("utf-8", "replace"): str(v)
-                          for k, v in ev.tags.items()}
-                labels.update(target.labels)
-                labels = job.metric_relabel.process(labels)
-                if labels is None:
-                    continue
-                ev.tags.clear()
-                sb = group.source_buffer
-                for k, v in labels.items():
-                    ev.set_tag(sb.copy_string(k), sb.copy_string(v))
-                kept.append(ev)
-            group._events = kept
-        group.set_tag(b"job", job.name)
-        if not group.empty() and self.process_queue_manager is not None:
-            self.process_queue_manager.push_queue(job.queue_key, group)
+        scraper.finish(time.monotonic() - t0, ok)
 
     @staticmethod
-    def _fetch(url: str, timeout: float):
+    def _fetch_stream(url: str, timeout: float, sink) -> bool:
+        """Chunked GET: every read lands in `sink` immediately (the
+        StreamScraper), so the body is never held whole."""
         conn = None
         try:
             u = urlparse(url)
@@ -228,13 +350,27 @@ class PrometheusInputRunner:
                          headers={"Accept": "text/plain", "User-Agent":
                                   "loongcollector-tpu/0.1"})
             resp = conn.getresponse()
-            body = resp.read()
-            return body, 200 <= resp.status < 300
+            ok = 200 <= resp.status < 300
+            while True:
+                chunk = resp.read(64 * 1024)
+                if not chunk:
+                    break
+                if ok:
+                    sink(chunk)
+            return ok
         except (OSError, http.client.HTTPException):
-            return b"", False
+            return False
         finally:
             if conn is not None:
                 conn.close()
+
+    @classmethod
+    def _fetch(cls, url: str, timeout: float):
+        """Buffered GET (service-discovery payloads): same connection path
+        as the streaming fetch, with an accumulate-all sink."""
+        chunks: List[bytes] = []
+        ok = cls._fetch_stream(url, timeout, chunks.append)
+        return b"".join(chunks), ok
 
 
 class InputPrometheus(Input):

@@ -81,6 +81,12 @@ def register_all(registry) -> None:
     registry.register_processor("processor_fields_with_condition",
                                 ProcessorFieldsWithCondition)
     registry.register_processor("processor_geoip", ProcessorGeoIP)
+    from .prom_inner import (ProcessorPromParseMetric,
+                             ProcessorPromRelabelMetric)
+    registry.register_processor("processor_prom_parse_metric_native",
+                                ProcessorPromParseMetric)
+    registry.register_processor("processor_prom_relabel_metric_native",
+                                ProcessorPromRelabelMetric)
     from .longtail2 import ALL as _LONGTAIL2
     for _cls in _LONGTAIL2:
         registry.register_processor(_cls.name, _cls)

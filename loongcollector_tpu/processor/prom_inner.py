@@ -1,0 +1,111 @@
+"""Prometheus inner processors.
+
+Reference: core/plugin/processor/inner/ProcessorPromParseMetricNative.cpp
+(raw exposition lines → MetricEvents, one per sample) and
+ProcessorPromRelabelMetricNative.cpp (metric_relabel_configs applied to
+sample labels inside the pipeline, then the __-prefixed meta labels are
+scrubbed before the flusher sees the group).
+
+These exist so prometheus data can ride ORDINARY pipelines: a forwarder or
+file input can carry exposition text and still get the scraper's parse +
+relabel semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..input.prometheus.relabel import RelabelConfigList
+from ..input.prometheus.text_parser import parse_exposition
+from ..models import LogEvent, MetricEvent, PipelineEventGroup, RawEvent
+from ..pipeline.plugin.interface import PluginContext, Processor
+
+
+class ProcessorPromParseMetric(Processor):
+    """Exposition text (raw events / log `content`) → MetricEvents."""
+
+    name = "processor_prom_parse_metric_native"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.source_key = b"content"
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.source_key = config.get("SourceKey", "content").encode()
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        chunks: List[bytes] = []
+        cols = group.columns
+        columnar = cols is not None and not group._events
+        if columnar:
+            arena = group.source_buffer.as_array()
+            for i in range(len(cols)):
+                o, ln = int(cols.offsets[i]), int(cols.lengths[i])
+                if ln > 0:
+                    chunks.append(bytes(arena[o:o + ln].tobytes()))
+        else:
+            for ev in group.events:
+                if isinstance(ev, RawEvent) and ev.content is not None:
+                    chunks.append(ev.content.to_bytes())
+                elif isinstance(ev, LogEvent):
+                    v = ev.get_content(self.source_key)
+                    if v is not None:
+                        chunks.append(v.to_bytes())
+        if not chunks:
+            return    # nothing extractable: leave the group untouched
+        # consume the source representation only once there is text to parse
+        if columnar:
+            group._columns = None
+        else:
+            group._events = []
+        parse_exposition(b"\n".join(chunks), group=group)
+
+
+class ProcessorPromRelabelMetric(Processor):
+    """metric_relabel_configs inside the pipeline + meta-label scrub."""
+
+    name = "processor_prom_relabel_metric_native"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.relabel = RelabelConfigList([])
+        self.keep_meta = False
+
+    def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
+        super().init(config, context)
+        self.relabel = RelabelConfigList(
+            config.get("MetricRelabelConfigs",
+                       config.get("metric_relabel_configs", [])))
+        self.keep_meta = bool(config.get("KeepMetaLabels", False))
+        return True
+
+    def process(self, group: PipelineEventGroup) -> None:
+        kept = []
+        sb = group.source_buffer
+        for ev in group.events:
+            if not isinstance(ev, MetricEvent):
+                kept.append(ev)
+                continue
+            labels = {k.decode("utf-8", "replace"): str(v)
+                      for k, v in ev.tags.items()}
+            if ev.name is not None:
+                labels.setdefault("__name__", ev.name.to_str())
+            out = self.relabel.process(labels)
+            if out is None:
+                continue       # sample dropped by keep/drop/dropmetric
+            new_name = out.pop("__name__", None)
+            if new_name is not None and (
+                    ev.name is None or new_name != ev.name.to_str()):
+                ev.set_name(sb.copy_string(new_name))
+            if not self.keep_meta:
+                # __-prefixed meta labels never reach the sink (reference
+                # ProcessorPromRelabelMetricNative meta scrub)
+                out = {k: v for k, v in out.items()
+                       if not k.startswith("__")}
+            ev.tags.clear()
+            for k, v in out.items():
+                ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+            kept.append(ev)
+        group._events = kept

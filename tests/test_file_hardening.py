@@ -1,0 +1,169 @@
+"""File-server hardening: blocked-event requeue, rotation storms,
+truncate-mid-read, container-churn path updates.
+
+VERDICT r4 #5 done-bars, mirroring reference machinery:
+  event_handler/BlockedEventManager.cpp  — watermark-rejected reads requeue
+    and resume on queue feedback, with zero data loss;
+  event_handler/EventHandler.cpp:843-1217 — ModifyHandler rotation state
+    machine (multiple live rotated generations);
+  reader/LogFileReader.cpp truncate handling.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from loongcollector_tpu.input.file.file_server import FileServer
+from loongcollector_tpu.input.file.polling import FileDiscoveryConfig
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+
+from conftest import wait_for
+
+
+@pytest.fixture()
+def server(tmp_path):
+    fs = FileServer()
+    pqm = ProcessQueueManager()
+    fs.process_queue_manager = pqm
+    fs.checkpoints.path = str(tmp_path / "cp.json")
+    yield fs, pqm, tmp_path
+    fs.stop()
+
+
+def _lines_from(groups):
+    out = []
+    for g in groups:
+        for ev in g.events:
+            out.extend(ev.content.to_bytes().splitlines())
+    return out
+
+
+def _drain(pqm, key, out, stop_at=None, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        item = pqm.pop_item(timeout=0.05)
+        if item is None:
+            continue
+        out.append(item[1])
+        if stop_at is not None and \
+                len(_lines_from(out)) >= stop_at:
+            return
+
+
+class TestBlockedRequeue:
+    def test_no_loss_and_feedback_resume(self, server):
+        fs, pqm, tmp_path = server
+        log = tmp_path / "b.log"
+        lines = [f"line-{i:05d}".encode() for i in range(400)]
+        log.write_bytes(b"\n".join(lines) + b"\n")
+        # tiny queue + tiny chunks: the drain MUST hit the high watermark
+        pqm.create_or_reuse_queue(7, capacity=2)
+        fs.add_config("blk", FileDiscoveryConfig([str(log)]), 7,
+                      tail_existing=True, chunk_size=256)
+        fs.start()
+
+        # let the server block against the full queue
+        assert wait_for(lambda: not pqm.is_valid_to_push(7), timeout=5)
+        assert 7 in fs._feedback_keys   # requeued with feedback registered
+
+        got = []
+        _drain(pqm, 7, got, stop_at=len(lines), timeout=20)
+        assert _lines_from(got) == lines   # every line, in order, no loss
+
+    def test_feedback_wakes_event_thread(self, server):
+        fs, pqm, _ = server
+        fs._blocked_wake.clear()
+        fs.feedback(123)
+        assert fs._blocked_wake.is_set()
+
+
+class TestRotationStorm:
+    def test_five_generations_no_loss(self, server):
+        fs, pqm, tmp_path = server
+        log = tmp_path / "rot.log"
+        pqm.create_or_reuse_queue(8, capacity=1000)
+        fs.add_config("rot", FileDiscoveryConfig([str(log)]), 8,
+                      tail_existing=True)
+        log.write_bytes(b"gen-0 a\ngen-0 b\n")
+        fs.start()
+        expect = [b"gen-0 a", b"gen-0 b"]
+        got = []
+        for gen in range(1, 6):
+            # wait until the current generation was read (checkpointed)
+            _drain(pqm, 8, got, stop_at=len(expect), timeout=10)
+            assert _lines_from(got) == expect
+            os.rename(log, tmp_path / f"rot.log.{gen}")
+            new = [f"gen-{gen} a".encode(), f"gen-{gen} b".encode()]
+            log.write_bytes(b"\n".join(new) + b"\n")
+            expect.extend(new)
+        _drain(pqm, 8, got, stop_at=len(expect), timeout=10)
+        assert _lines_from(got) == expect
+
+    def test_rotate_with_unread_tail(self, server):
+        """Bytes appended just before rename must still ship from the
+        rotated reader (ModifyHandler keeps the old inode open)."""
+        fs, pqm, tmp_path = server
+        log = tmp_path / "tail.log"
+        pqm.create_or_reuse_queue(9, capacity=1000)
+        fs.add_config("tail", FileDiscoveryConfig([str(log)]), 9,
+                      tail_existing=True)
+        log.write_bytes(b"early\n")
+        fs.start()
+        got = []
+        _drain(pqm, 9, got, stop_at=1, timeout=10)
+        fs.pause()
+        with open(log, "ab") as f:
+            f.write(b"late-but-owed\n")
+        os.rename(log, tmp_path / "tail.log.1")
+        log.write_bytes(b"fresh\n")
+        fs.resume()
+        _drain(pqm, 9, got, stop_at=3, timeout=10)
+        assert sorted(_lines_from(got)) == sorted(
+            [b"early", b"late-but-owed", b"fresh"])
+
+
+class TestTruncateMidRead:
+    def test_truncate_below_offset_restarts(self, server):
+        fs, pqm, tmp_path = server
+        log = tmp_path / "tr.log"
+        pqm.create_or_reuse_queue(10, capacity=1000)
+        fs.add_config("tr", FileDiscoveryConfig([str(log)]), 10,
+                      tail_existing=True)
+        log.write_bytes(b"old-1\nold-2\nold-3\n")
+        fs.start()
+        got = []
+        _drain(pqm, 10, got, stop_at=3, timeout=10)
+        # truncate in place (logrotate copytruncate) and write fresh bytes
+        with open(log, "wb") as f:
+            f.write(b"new-1\nnew-2\n")
+        _drain(pqm, 10, got, stop_at=5, timeout=10)
+        lines = _lines_from(got)
+        assert lines[:3] == [b"old-1", b"old-2", b"old-3"]
+        assert lines[3:] == [b"new-1", b"new-2"]
+
+
+class TestContainerChurn:
+    def test_update_config_paths_switches_files(self, server):
+        fs, pqm, tmp_path = server
+        old = tmp_path / "c-old.log"
+        new = tmp_path / "c-new.log"
+        pqm.create_or_reuse_queue(11, capacity=1000)
+        fs.add_config("churn", FileDiscoveryConfig([str(old)]), 11,
+                      tail_existing=True)
+        old.write_bytes(b"from-old\n")
+        fs.start()
+        got = []
+        _drain(pqm, 11, got, stop_at=1, timeout=10)
+        # container restarted: stdout path moved
+        new.write_bytes(b"from-new\n")
+        fs.update_config_paths("churn", [str(new)])
+        _drain(pqm, 11, got, stop_at=2, timeout=10)
+        assert _lines_from(got) == [b"from-old", b"from-new"]
+        # the pruned reader's checkpoint is gone; the new one's exists
+        with open(old, "ab") as f:
+            f.write(b"ignored\n")
+        time.sleep(0.4)
+        assert pqm.pop_item(timeout=0.3) is None

@@ -119,3 +119,147 @@ class TestScrapeE2E:
             assert job.targets[0].up
         finally:
             server.shutdown()
+
+
+class TestRelabelMatrixCompleteness:
+    """Round-5: full reference action matrix (Relabel.h:27) + hard rejection
+    of unknown actions (silent skip would corrupt data invisibly)."""
+
+    def test_lowercase_uppercase(self):
+        rules = RelabelConfigList([
+            {"action": "lowercase", "source_labels": ["a"],
+             "target_label": "lower"},
+            {"action": "uppercase", "source_labels": ["a"],
+             "target_label": "upper"},
+        ])
+        out = rules.process({"a": "MiXeD"})
+        assert out["lower"] == "mixed" and out["upper"] == "MIXED"
+
+    def test_dropmetric_match_list(self):
+        rules = RelabelConfigList([
+            {"action": "dropmetric", "match_list": ["go_gc_total"]}])
+        assert rules.process({"__name__": "go_gc_total"}) is None
+        assert rules.process({"__name__": "http_requests"}) is not None
+
+    def test_unknown_action_rejected_at_config_time(self):
+        from loongcollector_tpu.input.prometheus.relabel import \
+            RelabelUnsupported
+        with pytest.raises(RelabelUnsupported):
+            RelabelConfigList([{"action": "teleport"}])
+        with pytest.raises(RelabelUnsupported):
+            RelabelConfigList([{"action": "dropmetric"}])  # no match_list
+
+    def test_keepequal_dropequal(self):
+        keep = RelabelConfigList([{"action": "keepequal",
+                                   "source_labels": ["a"],
+                                   "target_label": "b"}])
+        assert keep.process({"a": "x", "b": "x"}) is not None
+        assert keep.process({"a": "x", "b": "y"}) is None
+        drop = RelabelConfigList([{"action": "dropequal",
+                                   "source_labels": ["a"],
+                                   "target_label": "b"}])
+        assert drop.process({"a": "x", "b": "x"}) is None
+        assert drop.process({"a": "x", "b": "y"}) is not None
+
+
+class _BigHandler(http.server.BaseHTTPRequestHandler):
+    """Serves n_samples exposition lines with chunked writes."""
+
+    n_samples = 1500
+
+    def do_GET(self):
+        body = b"".join(
+            b'big_metric{idx="%d"} %d\n' % (i, i)
+            for i in range(self.n_samples))
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        # write in small pieces so the client reads a true stream
+        for i in range(0, len(body), 1024):
+            self.wfile.write(body[i:i + 1024])
+
+    def log_message(self, *a):
+        pass
+
+
+class TestStreamScraper:
+    def test_streaming_pushes_multiple_groups(self):
+        from loongcollector_tpu.input.prometheus.scraper import StreamScraper
+        server = http.server.HTTPServer(("127.0.0.1", 0), _BigHandler)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            pqm = ProcessQueueManager()
+            pqm.create_or_reuse_queue(56, capacity=100)
+            runner = PrometheusInputRunner()
+            runner.process_queue_manager = pqm
+            job = ScrapeJob("stream", {
+                "StaticTargets": [f"127.0.0.1:{port}"]}, queue_key=56)
+            runner.scrape_one(job, job.targets[0])
+            groups = []
+            while True:
+                item = pqm.pop_item(timeout=0)
+                if item is None:
+                    break
+                groups.append(item[1])
+            # 1500 samples at 512/group -> at least 3 groups mid-stream
+            assert len(groups) >= 3
+            total = sum(len(g.events) for g in groups)
+            # parsed samples + the 3 auto metrics
+            assert total == _BigHandler.n_samples + 3
+            idxs = [g.get_tag(b"__stream_index__") for g in groups]
+            assert idxs == [str(i).encode() for i in range(len(groups))]
+            names = [str(e.name) for e in groups[-1].events[-3:]]
+            assert names == ["up", "scrape_duration_seconds",
+                             "scrape_samples_scraped"]
+            assert groups[-1].events[-1].value.value == float(
+                _BigHandler.n_samples)
+        finally:
+            server.shutdown()
+
+    def test_partial_line_held_across_chunks(self):
+        from loongcollector_tpu.input.prometheus.scraper import StreamScraper
+        pushed = []
+        job = ScrapeJob("p", {"StaticTargets": ["h:1"]}, queue_key=1)
+        s = StreamScraper(job, job.targets[0],
+                          lambda k, g: pushed.append(g))
+        s.feed(b'm1 1\nm2{a="b"} ')
+        s.feed(b'2\nm3 3')
+        s.finish(0.01, True)
+        evs = [e for g in pushed for e in g.events]
+        assert [str(e.name) for e in evs[:3]] == ["m1", "m2", "m3"]
+        assert evs[1].get_tag(b"a") == b"b"
+
+
+class TestPromInnerProcessors:
+    def test_parse_then_relabel_pipeline(self):
+        from loongcollector_tpu.models import (PipelineEventGroup,
+                                               SourceBuffer)
+        from loongcollector_tpu.processor.prom_inner import (
+            ProcessorPromParseMetric, ProcessorPromRelabelMetric)
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(
+            b'http_req{code="200",__meta_pod="p1"} 10\n'
+            b'go_gc_total 5\n'
+            b'http_req{code="500",__meta_pod="p1"} 2\n'))
+        ctx = PluginContext()
+        parse = ProcessorPromParseMetric()
+        parse.init({}, ctx)
+        parse.process(g)
+        assert len(g.events) == 3
+        relabel = ProcessorPromRelabelMetric()
+        relabel.init({"MetricRelabelConfigs": [
+            {"action": "dropmetric", "match_list": ["go_gc_total"]},
+            {"action": "replace", "source_labels": ["code"],
+             "regex": "5..", "target_label": "error", "replacement": "1"},
+        ]}, ctx)
+        relabel.process(g)
+        assert len(g.events) == 2            # go_gc_total dropped
+        for ev in g.events:
+            assert ev.get_tag(b"__meta_pod") is None   # meta scrubbed
+        errs = [ev for ev in g.events if ev.get_tag(b"error") == b"1"]
+        assert len(errs) == 1
+        assert errs[0].get_tag(b"code") == b"500"
