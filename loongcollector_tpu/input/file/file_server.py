@@ -306,8 +306,9 @@ class FileServer:
         return busy
 
     def _register_feedback(self, queue_key: int) -> None:
-        if queue_key in self._feedback_keys:
-            return
+        # registered on EVERY rejection (set_feedback replaces the list, so
+        # this is idempotent): a deleted-and-recreated queue under the same
+        # key gets the wakeup again; _feedback_keys is introspection only
         getter = getattr(self.process_queue_manager, "get_queue", None)
         q = getter(queue_key) if getter is not None else None
         if q is not None:

@@ -136,3 +136,31 @@ class RelabelConfigList:
             if labels is None:
                 return None
         return labels
+
+
+def relabel_metric_event(ev, sb, rules: "RelabelConfigList",
+                         extra_labels=None, scrub_meta: bool = False) -> bool:
+    """Apply relabel rules to one MetricEvent in place.
+
+    Shared by the stream scraper and processor_prom_relabel_metric_native so
+    the decode/__name__-expose/rename/re-tag semantics cannot diverge.
+    Returns False when the sample is dropped by the rules."""
+    labels = {k.decode("utf-8", "replace"): str(v)
+              for k, v in ev.tags.items()}
+    if extra_labels:
+        labels.update(extra_labels)
+    if getattr(ev, "name", None) is not None:
+        labels.setdefault("__name__", ev.name.to_str())
+    out = rules.process(labels)
+    if out is None:
+        return False
+    new_name = out.pop("__name__", None)
+    if new_name is not None and (
+            ev.name is None or new_name != ev.name.to_str()):
+        ev.set_name(sb.copy_string(new_name))
+    if scrub_meta:
+        out = {k: v for k, v in out.items() if not k.startswith("__")}
+    ev.tags.clear()
+    for k, v in out.items():
+        ev.set_tag(sb.copy_string(k), sb.copy_string(v))
+    return True

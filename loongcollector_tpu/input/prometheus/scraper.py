@@ -23,7 +23,7 @@ from urllib.parse import urlparse
 from ...models import PipelineEventGroup
 from ...pipeline.plugin.interface import Input, PluginContext
 from ...utils.logger import get_logger
-from .relabel import RelabelConfigList
+from .relabel import RelabelConfigList, relabel_metric_event
 from .text_parser import parse_exposition
 
 log = get_logger("prometheus")
@@ -235,27 +235,11 @@ class StreamScraper:
         job, target = self.job, self.target
         if not (job.metric_relabel.rules or target.labels):
             return
-        kept = []
         sb = group.source_buffer
-        for ev in group.events:
-            labels = {k.decode("utf-8", "replace"): str(v)
-                      for k, v in ev.tags.items()}
-            labels.update(target.labels)
-            if getattr(ev, "name", None) is not None:
-                # __name__ must be visible to keep/drop/dropmetric rules
-                labels.setdefault("__name__", ev.name.to_str())
-            labels = job.metric_relabel.process(labels)
-            if labels is None:
-                continue
-            new_name = labels.pop("__name__", None)
-            if new_name is not None and (
-                    ev.name is None or new_name != ev.name.to_str()):
-                ev.set_name(sb.copy_string(new_name))
-            ev.tags.clear()
-            for k, v in labels.items():
-                ev.set_tag(sb.copy_string(k), sb.copy_string(v))
-            kept.append(ev)
-        group._events = kept
+        group._events = [
+            ev for ev in group.events
+            if relabel_metric_event(ev, sb, job.metric_relabel,
+                                    extra_labels=target.labels)]
 
 
 class PrometheusInputRunner:
@@ -268,6 +252,7 @@ class PrometheusInputRunner:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self.process_queue_manager = None
+        self.dropped_groups = 0   # watermark-rejected past the pace window
 
     @classmethod
     def instance(cls) -> "PrometheusInputRunner":
@@ -324,8 +309,18 @@ class PrometheusInputRunner:
         pqm = self.process_queue_manager
 
         def push(key, group):
-            if pqm is not None:
-                pqm.push_queue(key, group)
+            if pqm is None:
+                return
+            # pace on the watermark like the file input does: a slow
+            # pipeline back-pressures the scrape instead of silently
+            # dropping mid-stream groups
+            deadline = time.monotonic() + job.timeout
+            while not pqm.push_queue(key, group):
+                if time.monotonic() > deadline:
+                    self.dropped_groups += 1
+                    log.warning("scrape group dropped: queue %d full", key)
+                    return
+                time.sleep(0.01)
 
         scraper = StreamScraper(job, target, push)
         t0 = time.monotonic()

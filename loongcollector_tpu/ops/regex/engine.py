@@ -240,6 +240,7 @@ class RegexEngine:
         self._segment_kernel: Optional[ExtractKernel] = None
         self._pallas_kernel = None          # built lazily on first use
         self._use_pallas: Optional[bool] = None
+        self._sharded = None                # None=unresolved, False=off
         self._native_exec = None            # host C++ walker, built lazily
         self._native_tried = False
         self._dfa_kernel: Optional[DFAMatchKernel] = None
@@ -268,12 +269,59 @@ class RegexEngine:
         chip).  None restores normal selection."""
         self._kernel_override = kern
 
+    def _maybe_sharded(self):
+        """Multi-chip engine mode (SURVEY §2.7): when enabled and more than
+        one device is attached, SEGMENT-tier dispatches run through
+        ShardedParsePlane — the batch dimension shards over the ICI mesh,
+        per-chip extraction + psum'd telemetry.  The plane rides the same
+        async DevicePlane budget as single-chip dispatch, so watermark
+        back-pressure is unchanged.  LOONG_SHARDED=1 forces, =0 disables;
+        default auto (on when >1 device)."""
+        if self._sharded is not None:
+            return self._sharded or None
+        env = os.environ.get("LOONG_SHARDED", "").strip()
+        if env == "0" or self._segment_kernel is None:
+            self._sharded = False
+            return None
+        if env != "1" and _pallas_enabled() is not None:
+            # an explicit LOONG_PALLAS force pins the single-device kernel
+            # choice; only an explicit LOONG_SHARDED=1 outranks it
+            self._sharded = False
+            return None
+        try:
+            import jax
+            n = len(jax.devices())
+            if n <= 1 and env != "1":
+                self._sharded = False
+                return None
+            from ...parallel.mesh import ShardedKernel
+            self._sharded = ShardedKernel(self._segment_kernel.program)
+        except Exception:  # noqa: BLE001 — mesh build failure = single-chip
+            from ...utils.logger import get_logger
+            get_logger("regex").exception(
+                "sharded plane unavailable; staying single-device")
+            self._sharded = False
+            return None
+        return self._sharded
+
+    def _device_kernel_failed(self, kern) -> None:
+        """Runtime fault in a device kernel: pin this engine off that path
+        (throughput cost, never liveness)."""
+        if kern is self._pallas_kernel:
+            self._use_pallas = False
+        if self._sharded not in (None, False) and kern is self._sharded:
+            self._sharded = False
+
     def _device_kernel(self):
-        """Segment-tier kernel selection: fused Pallas on TPU (one VMEM
-        pass per row block), XLA fusion elsewhere. Resolved once per
-        engine; both paths are differentially fuzzed against each other."""
+        """Segment-tier kernel selection: sharded mesh plane when multiple
+        devices are attached, else fused Pallas on TPU (one VMEM pass per
+        row block), XLA fusion elsewhere. Resolved once per engine; the
+        paths are differentially fuzzed against each other."""
         if getattr(self, "_kernel_override", None) is not None:
             return self._kernel_override
+        sharded = self._maybe_sharded()
+        if sharded is not None:
+            return sharded
         if self._use_pallas is None:
             forced = _pallas_enabled()
             if forced is not None:
@@ -476,7 +524,10 @@ class PendingParse:
             fut = plane.submit(self.kern, (batch.rows, batch.lengths),
                                batch.rows.nbytes,
                                on_wait=self._drain_if_pending)
-            self._chunks_pending.append((chunk, batch, fut))
+            # each chunk records the kernel it was SUBMITTED on: after a
+            # fault pins the engine to the XLA path, errors from earlier
+            # in-flight chunks must still take the fallback, not re-raise
+            self._chunks_pending.append((chunk, batch, fut, self.kern))
 
     def _drain_if_pending(self) -> bool:
         """Budget-wait hook: materialise our oldest in-flight chunk so the
@@ -488,20 +539,21 @@ class PendingParse:
         return True
 
     def _drain_one(self) -> None:
-        chunk, batch, fut = self._chunks_pending.pop(0)
+        chunk, batch, fut, sub_kern = self._chunks_pending.pop(0)
         try:
             k_ok, k_off, k_len = fut.result()
         except Exception:  # noqa: BLE001
-            if self.kern is self.engine._segment_kernel or \
+            if sub_kern is self.engine._segment_kernel or \
                     getattr(self.engine, "_kernel_override", None) is not None:
                 raise
-            # Mosaic lowering failure must cost throughput, never liveness:
-            # pin this engine to the proven XLA path and re-run the chunk
+            # Mosaic/mesh runtime failure must cost throughput, never
+            # liveness: pin this engine off the failed path and re-run the
+            # chunk on the proven XLA kernel
             from ...utils.logger import get_logger
             get_logger("regex").exception(
-                "pallas kernel failed for %r; falling back to XLA path",
+                "device kernel failed for %r; falling back to XLA path",
                 self.engine.pattern)
-            self.engine._use_pallas = False
+            self.engine._device_kernel_failed(sub_kern)
             self.kern = self.engine._segment_kernel
             k_ok, k_off, k_len = (np.asarray(a) for a in
                                   self.kern(batch.rows, batch.lengths))
@@ -526,7 +578,7 @@ class PendingParse:
                 self._drain_one()
         except BaseException:
             # a failed chunk must not leak the others' in-flight budget
-            for _, _, fut in self._chunks_pending:
+            for _, _, fut, _k in self._chunks_pending:
                 try:
                     fut.result()
                 except Exception:  # noqa: BLE001 — releasing, not consuming

@@ -92,3 +92,32 @@ class ShardedParsePlane:
     @property
     def num_devices(self) -> int:
         return self.mesh.size
+
+
+class ShardedKernel:
+    """Engine-facing adapter: makes ShardedParsePlane shaped like the
+    single-device extract kernels (rows, lengths) → (ok, off, len), so the
+    regex engine's async dispatch path (DevicePlane budget + watermark
+    back-pressure) drives the whole mesh without special cases.
+
+    Batches are padded to a mesh-size multiple with zero-length rows
+    (PendingParse slices the result back to n_real).  The psum'd mesh
+    telemetry of the LAST dispatch stays on device in `last_stats` — the
+    self-monitor can materialise it off the hot path."""
+
+    def __init__(self, program: SegmentProgram, mesh: Optional[Mesh] = None):
+        self.plane = ShardedParsePlane(program, mesh)
+        self.last_stats = None
+
+    def __call__(self, rows, lengths):
+        m = self.plane.num_devices
+        b = rows.shape[0]
+        if b % m:
+            pad = m - (b % m)
+            rows = np.concatenate(
+                [rows, np.zeros((pad, rows.shape[1]), rows.dtype)])
+            lengths = np.concatenate([lengths, np.zeros(pad, lengths.dtype)])
+        rows_d, lengths_d = self.plane.put(rows, lengths)
+        ok, off, length, stats = self.plane(rows_d, lengths_d)
+        self.last_stats = stats
+        return ok, off, length

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from ..input.prometheus.relabel import RelabelConfigList
+from ..input.prometheus.relabel import (RelabelConfigList,
+                                        relabel_metric_event)
 from ..input.prometheus.text_parser import parse_exposition
 from ..models import LogEvent, MetricEvent, PipelineEventGroup, RawEvent
 from ..pipeline.plugin.interface import PluginContext, Processor
@@ -39,6 +40,7 @@ class ProcessorPromParseMetric(Processor):
         chunks: List[bytes] = []
         cols = group.columns
         columnar = cols is not None and not group._events
+        keep = []
         if columnar:
             arena = group.source_buffer.as_array()
             for i in range(len(cols)):
@@ -49,17 +51,18 @@ class ProcessorPromParseMetric(Processor):
             for ev in group.events:
                 if isinstance(ev, RawEvent) and ev.content is not None:
                     chunks.append(ev.content.to_bytes())
-                elif isinstance(ev, LogEvent):
-                    v = ev.get_content(self.source_key)
-                    if v is not None:
-                        chunks.append(v.to_bytes())
+                elif isinstance(ev, LogEvent) and \
+                        ev.get_content(self.source_key) is not None:
+                    chunks.append(ev.get_content(self.source_key).to_bytes())
+                else:
+                    keep.append(ev)   # contributed nothing: pass through
         if not chunks:
             return    # nothing extractable: leave the group untouched
-        # consume the source representation only once there is text to parse
+        # consume only the events that became exposition text
         if columnar:
             group._columns = None
         else:
-            group._events = []
+            group._events = keep
         parse_exposition(b"\n".join(chunks), group=group)
 
 
@@ -88,24 +91,9 @@ class ProcessorPromRelabelMetric(Processor):
             if not isinstance(ev, MetricEvent):
                 kept.append(ev)
                 continue
-            labels = {k.decode("utf-8", "replace"): str(v)
-                      for k, v in ev.tags.items()}
-            if ev.name is not None:
-                labels.setdefault("__name__", ev.name.to_str())
-            out = self.relabel.process(labels)
-            if out is None:
-                continue       # sample dropped by keep/drop/dropmetric
-            new_name = out.pop("__name__", None)
-            if new_name is not None and (
-                    ev.name is None or new_name != ev.name.to_str()):
-                ev.set_name(sb.copy_string(new_name))
-            if not self.keep_meta:
-                # __-prefixed meta labels never reach the sink (reference
-                # ProcessorPromRelabelMetricNative meta scrub)
-                out = {k: v for k, v in out.items()
-                       if not k.startswith("__")}
-            ev.tags.clear()
-            for k, v in out.items():
-                ev.set_tag(sb.copy_string(k), sb.copy_string(v))
-            kept.append(ev)
+            # __-prefixed meta labels never reach the sink (reference
+            # ProcessorPromRelabelMetricNative meta scrub)
+            if relabel_metric_event(ev, sb, self.relabel,
+                                    scrub_meta=not self.keep_meta):
+                kept.append(ev)
         group._events = kept
