@@ -81,6 +81,10 @@ class Application:
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))
         self.config_watcher = PipelineConfigWatcher()
+        from .config.instance_config import (InstanceConfigManager,
+                                             InstanceConfigWatcher)
+        self.instance_watcher = InstanceConfigWatcher()
+        self.instance_manager = InstanceConfigManager.instance()
         self.remote_provider = None
         endpoint = flags.get_flag("config_server_address")
         if endpoint:
@@ -202,6 +206,14 @@ class Application:
         fs.checkpoints.path = os.path.join(self.data_dir, "checkpoints.json")
         fs.cpu_level_provider = lambda: self.watchdog.cpu_level
         self.config_watcher.add_source(self.config_dir)
+        # instance configs: agent-level flag overrides applied live,
+        # without pipeline restarts (instance_config/ beside the pipeline
+        # dir; reference InstanceConfigWatcher.cpp)
+        cfg_abs = os.path.abspath(self.config_dir)
+        self.instance_watcher.add_source(
+            os.path.join(os.path.dirname(cfg_abs), "instance_config"))
+        self.instance_watcher.add_source(
+            os.path.join(cfg_abs, "instance_config"))
         if self.remote_provider is not None:
             self.config_watcher.add_source(self.remote_provider.config_dir)
             self.remote_provider.start()
@@ -223,6 +235,9 @@ class Application:
                 diff = self.config_watcher.check_config_diff()
                 if not diff.empty():
                     self.pipeline_manager.update_pipelines(diff)
+                idiff = self.instance_watcher.check_config_diff()
+                if not idiff.empty():
+                    self.instance_manager.update(idiff)
                 self.sender_queue_manager.gc_marked()
                 WriteMetrics.instance().gc_deleted()
                 self.disk_buffer.replay(self._resolve_buffered_flusher)

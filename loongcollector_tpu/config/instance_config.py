@@ -1,0 +1,160 @@
+"""Instance configs: agent-level settings applied WITHOUT pipeline restarts.
+
+Reference: core/config/watcher/InstanceConfigWatcher.cpp (directory diff
+over instance-config files, same mtime/size change detection as the
+pipeline watcher) + core/config/InstanceConfigManager.cpp
+(UpdateInstanceConfigs applies added/modified/removed configs to the
+process-wide AppConfig without touching running pipelines).
+
+An instance config file is a JSON/YAML map of flag overrides, e.g.
+    {"config": {"cpu_usage_limit": 0.6, "max_bytes_per_sec": 1048576}}
+(the flat form without the "config" wrapper is accepted too).  Multiple
+configs merge in file-name order (later wins); removing a file reverts its
+keys to the DEFAULT (or to the value from a remaining config) — applied
+live through utils.flags set_flag, whose on_flag_change callbacks update
+running components in place.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import flags
+from ..utils.logger import get_logger
+from .watcher import load_config_file
+
+log = get_logger("instance_config")
+
+
+class InstanceConfigDiff:
+    def __init__(self) -> None:
+        self.added: Dict[str, dict] = {}
+        self.modified: Dict[str, dict] = {}
+        self.removed: List[str] = []
+
+    def empty(self) -> bool:
+        return not (self.added or self.modified or self.removed)
+
+
+class InstanceConfigWatcher:
+    """Directory diff for instance configs (mtime+size change detection,
+    like PipelineConfigWatcher but feeding the flag layer)."""
+
+    def __init__(self) -> None:
+        self._dirs: List[str] = []
+        self._state: Dict[str, Tuple[float, int]] = {}
+
+    def add_source(self, directory: str) -> None:
+        if directory not in self._dirs:
+            self._dirs.append(directory)
+
+    def check_config_diff(self) -> InstanceConfigDiff:
+        diff = InstanceConfigDiff()
+        seen: Dict[str, str] = {}
+        for d in self._dirs:
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                if not fn.endswith((".json", ".yaml", ".yml")):
+                    continue
+                path = os.path.join(d, fn)
+                name = os.path.splitext(fn)[0]
+                if name in seen:
+                    continue
+                seen[name] = path
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                sig = (st.st_mtime, st.st_size)
+                old = self._state.get(path)
+                if old == sig:
+                    continue
+                cfg = load_config_file(path)
+                if cfg is None:
+                    continue
+                self._state[path] = sig
+                if old is None:
+                    diff.added[name] = cfg
+                else:
+                    diff.modified[name] = cfg
+        for path in list(self._state):
+            if not os.path.exists(path):
+                del self._state[path]
+                name = os.path.splitext(os.path.basename(path))[0]
+                if name not in seen:
+                    diff.removed.append(name)
+        return diff
+
+
+class InstanceConfigManager:
+    """Applies instance-config diffs to the flag layer, live.
+
+    Keeps per-config key sets so removal reverts exactly the keys that
+    config contributed; pipelines are never restarted (the point of
+    instance configs — reference InstanceConfigManager.cpp)."""
+
+    _instance: Optional["InstanceConfigManager"] = None
+
+    def __init__(self) -> None:
+        self._configs: Dict[str, Dict[str, object]] = {}
+        self._defaults: Dict[str, object] = {}
+
+    @classmethod
+    def instance(cls) -> "InstanceConfigManager":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    @staticmethod
+    def _flag_map(cfg: dict) -> Dict[str, object]:
+        body = cfg.get("config", cfg)
+        if not isinstance(body, dict):
+            return {}
+        return {str(k): v for k, v in body.items()}
+
+    def update(self, diff: InstanceConfigDiff) -> None:
+        if diff.empty():
+            return
+        for name in diff.removed:
+            self._configs.pop(name, None)
+        for name, cfg in list(diff.added.items()) + \
+                list(diff.modified.items()):
+            fm = self._flag_map(cfg)
+            unknown = [k for k in fm if not flags.has_flag(k)]
+            for k in unknown:
+                log.warning("instance config %s: unknown flag %r ignored",
+                            name, k)
+                fm.pop(k)
+            self._configs[name] = fm
+            log.info("instance config %s applied: %s", name, fm)
+        for name in diff.removed:
+            log.info("instance config %s removed", name)
+        self._apply()
+
+    def find_config(self, name: str) -> Optional[Dict[str, object]]:
+        return self._configs.get(name)
+
+    def _apply(self) -> None:
+        # snapshot defaults lazily the first time a key is overridden so
+        # removal can restore them
+        desired: Dict[str, object] = {}
+        for name in sorted(self._configs):          # file-name order
+            desired.update(self._configs[name])
+        for key, value in desired.items():
+            if key not in self._defaults:
+                self._defaults[key] = flags.get_flag(key)
+            try:
+                flags.set_flag(key, value)
+            except Exception:  # noqa: BLE001 — one bad value must not
+                log.exception("instance config: set %s=%r failed", key, value)
+        for key, default in list(self._defaults.items()):
+            if key not in desired:
+                try:
+                    flags.set_flag(key, default)
+                except Exception:  # noqa: BLE001 — a failing on_flag_change
+                    # callback must not kill the application control loop
+                    log.exception("instance config: restore %s=%r failed",
+                                  key, default)
+                del self._defaults[key]

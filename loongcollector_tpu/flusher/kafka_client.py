@@ -408,6 +408,50 @@ class KafkaClient:
             raise KafkaError("correlation id mismatch")
         return resp[4:]
 
+    def _pipeline_requests(self, addr: str,
+                           reqs: List[Tuple[int, int, bytes]],
+                           expect_response: bool = True,
+                           max_in_flight: int = 5) -> List[bytes]:
+        """Pipelined request windows: write up to `max_in_flight` requests
+        before reading the first response (librdkafka's
+        max.in.flight.requests.per.connection).  Kafka answers a
+        connection's requests strictly in order, so FIFO correlation-id
+        matching preserves ordering; one socket error drops the connection
+        and fails the whole window (the caller's retry re-sends it — the
+        same at-least-once contract as the serial path)."""
+        sock = self._connect(addr)
+        out: List[bytes] = []
+        try:
+            for w in range(0, len(reqs), max_in_flight):
+                window = reqs[w:w + max_in_flight]
+                corrs = []
+                buf = bytearray()
+                for api_key, api_version, payload in window:
+                    self._corr += 1
+                    corrs.append(self._corr)
+                    header = (struct.pack(">hhi", api_key, api_version,
+                                          self._corr)
+                              + _str(self.client_id))
+                    msg = header + payload
+                    buf += struct.pack(">i", len(msg)) + msg
+                sock.sendall(buf)
+                if not expect_response:
+                    continue
+                for my_corr in corrs:
+                    raw = self._read_exact(sock, 4)
+                    size = struct.unpack(">i", raw)[0]
+                    resp = self._read_exact(sock, size)
+                    corr = struct.unpack(">i", resp[:4])[0]
+                    if corr != my_corr:
+                        raise KafkaError("correlation id mismatch")
+                    out.append(resp[4:])
+        except (OSError, KafkaError) as e:
+            self._drop(addr)
+            if isinstance(e, KafkaError):
+                raise
+            raise KafkaError(f"broker {addr}: {e}") from e
+        return out
+
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:
         from ..utils.netio import read_exact
@@ -466,9 +510,14 @@ class KafkaProducer(KafkaClient):
     def __init__(self, brokers: List[str],
                  client_id: str = "loongcollector-tpu",
                  acks: int = -1, timeout_ms: int = 10000,
-                 tls: Optional[dict] = None, sasl: Optional[dict] = None):
+                 tls: Optional[dict] = None, sasl: Optional[dict] = None,
+                 max_in_flight: int = 5):
         super().__init__(brokers, client_id, timeout_ms, tls, sasl)
         self.acks = acks
+        # deep produce pipelining like librdkafka
+        # (core/plugin/flusher/kafka/KafkaProducer.cpp:41 wraps it; this
+        # client speaks the wire protocol, so the window lives here)
+        self.max_in_flight = max(1, int(max_in_flight))
 
     # -- produce ------------------------------------------------------------
 
@@ -500,29 +549,42 @@ class KafkaProducer(KafkaClient):
         for key, value in records:
             pid = self._pick_partition(topic, key, nparts)
             by_partition.setdefault(pid, []).append((key, value))
+        # group per leader and PIPELINE: per-partition batches ride one
+        # connection in max_in_flight windows instead of one blocking RTT
+        # each; per-partition order is preserved (single connection, FIFO
+        # responses)
+        by_leader: Dict[str, List[bytes]] = {}
         for partition, recs in by_partition.items():
             leader = leaders.get(partition)
             if leader is None:
                 raise KafkaError(f"no leader for {topic}/{partition}")
-            self._send_one(topic, partition, leader, recs)
+            by_leader.setdefault(leader, []).append(
+                self._produce_payload(topic, partition, recs))
+        for leader, payloads in by_leader.items():
+            reqs = [(API_PRODUCE, 3, p) for p in payloads]
+            try:
+                resps = self._pipeline_requests(
+                    leader, reqs, expect_response=(self.acks != 0),
+                    max_in_flight=self.max_in_flight)
+            except KafkaError:
+                with self._lock:
+                    self._topic_meta.pop(topic, None)  # stale leader
+                raise
+            for resp in resps:
+                self._parse_produce_response(resp, topic)
 
-    def _send_one(self, topic: str, partition: int, leader: str,
-                  records) -> None:
+    def _produce_payload(self, topic: str, partition: int, records) -> bytes:
         batch = build_record_batch(records)
         # ProduceRequest v3: transactional_id, acks, timeout, topic_data
-        payload = (_str(None)
-                   + struct.pack(">h", self.acks)
-                   + struct.pack(">i", self.timeout_ms)
-                   + struct.pack(">i", 1) + _str(topic)
-                   + struct.pack(">i", 1) + struct.pack(">i", partition)
-                   + _bytes(batch))
-        try:
-            resp = self._request(leader, API_PRODUCE, 3, payload,
-                                 expect_response=(self.acks != 0))
-        except KafkaError:
-            with self._lock:
-                self._topic_meta.pop(topic, None)  # stale leader: refetch
-            raise
+        return (_str(None)
+                + struct.pack(">h", self.acks)
+                + struct.pack(">i", self.timeout_ms)
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1) + struct.pack(">i", partition)
+                + _bytes(batch))
+
+    def _parse_produce_response(self, resp: Optional[bytes],
+                                topic: str) -> None:
         if resp is None:  # acks=0: fire and forget
             return
         r = _Reader(resp)

@@ -316,6 +316,11 @@ class PrometheusInputRunner:
             # dropping mid-stream groups
             deadline = time.monotonic() + job.timeout
             while not pqm.push_queue(key, group):
+                if pqm.get_queue(key) is None:
+                    # pipeline removed mid-scrape: the queue is gone, not
+                    # full — waiting would stall every job on this thread
+                    self.dropped_groups += 1
+                    return
                 if time.monotonic() > deadline:
                     self.dropped_groups += 1
                     log.warning("scrape group dropped: queue %d full", key)

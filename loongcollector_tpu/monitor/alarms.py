@@ -20,21 +20,83 @@ class AlarmLevel(enum.IntEnum):
 
 
 class AlarmType(str, enum.Enum):
-    """Subset of the reference's 60+ alarm types, extensible."""
+    """The reference's alarm taxonomy (core/monitor/AlarmManager.h:35-102),
+    wire-name compatible so downstream alerting rules keyed on the alarm
+    type string keep working, plus TPU-specific additions."""
 
+    # config / control plane
     CONFIG_LOAD_FAIL = "CONFIG_LOAD_FAIL_ALARM"
-    PROCESS_QUEUE_FULL = "PROCESS_QUEUE_FULL_ALARM"
-    SEND_FAIL = "SEND_FAIL_ALARM"
-    SEND_QUOTA_EXCEED = "SEND_QUOTA_EXCEED_ALARM"
-    PARSE_LOG_FAIL = "PARSE_LOG_FAIL_ALARM"
+    USER_CONFIG = "USER_CONFIG_ALARM"
+    GLOBAL_CONFIG = "GLOBAL_CONFIG_ALARM"
+    CONFIG_UPDATE = "CONFIG_UPDATE_ALARM"
+    CATEGORY_CONFIG = "CATEGORY_CONFIG_ALARM"
+    MULTI_CONFIG_MATCH = "MULTI_CONFIG_MATCH_ALARM"
+    TOO_MANY_CONFIG = "TOO_MANY_CONFIG_ALARM"
+    SAME_CONFIG = "SAME_CONFIG_ALARM"
+    # file collection
     FILE_READ_FAIL = "READ_LOG_FAIL_ALARM"
-    CHECKPOINT_FAIL = "CHECKPOINT_ALARM"
+    READ_LOG_DELAY = "READ_LOG_DELAY_ALARM"
+    SKIP_READ_LOG = "SKIP_READ_LOG_ALARM"
+    OPEN_LOGFILE_FAIL = "OPEN_LOGFILE_FAIL_ALARM"
+    LOGFILE_PERMISSION = "LOGFILE_PERMINSSION_ALARM"
+    LOGDIR_PERMISSION = "LOGDIR_PERMISSION_ALARM"
+    LOG_TRUNCATE = "LOG_TRUNCATE_ALARM"
+    SPLIT_LOG_FAIL = "SPLIT_LOG_FAIL_ALARM"
+    FILE_READER_EXCEED = "FILE_READER_EXCEED_ALARM"
+    OPEN_FILE_LIMIT = "OPEN_FILE_LIMIT_ALARM"
+    DIR_EXCEED_LIMIT = "DIR_EXCEED_LIMIT_ALARM"
+    STAT_LIMIT = "STAT_LIMIT_ALARM"
+    MODIFY_FILE_EXCEED = "MODIFY_FILE_EXCEED_ALARM"
+    INOTIFY_DIR_LIMIT = "INOTIFY_DIR_NUM_LIMIT_ALARM"
+    REGISTER_INOTIFY_FAIL = "REGISTER_INOTIFY_FAIL_ALARM"
+    INOTIFY_EVENT_OVERFLOW = "INOTIFY_EVENT_OVERFLOW_ALARM"
+    READ_STOPPED_CONTAINER = "READ_STOPPED_CONTAINER_ALARM"
+    INVALID_CONTAINER_PATH = "INVALID_CONTAINER_PATH_ALARM"
+    # processing
+    PARSE_LOG_FAIL = "PARSE_LOG_FAIL_ALARM"
+    REGEX_MATCH = "REGEX_MATCH_ALARM"
+    PARSE_TIME_FAIL = "PARSE_TIME_FAIL_ALARM"
+    OUTDATED_LOG = "OUTDATED_LOG_ALARM"
+    ENCODING_CONVERT = "ENCODING_CONVERT_ALARM"
+    LOG_GROUP_PARSE_FAIL = "LOG_GROUP_PARSE_FAIL_ALARM"
+    METRIC_GROUP_PARSE_FAIL = "METRIC_GROUP_PARSE_FAIL_ALARM"
+    RELABEL_METRIC_FAIL = "RELABEL_METRIC_FAIL_ALARM"
+    CAST_SENSITIVE_WORD = "CAST_SENSITIVE_WORD_ALARM"
+    PROCESS_TOO_SLOW = "PROCESS_TOO_SLOW_ALARM"
+    PROCESS_QUEUE_FULL = "PROCESS_QUEUE_FULL_ALARM"
+    PROCESS_QUEUE_BUSY = "PROCESS_QUEUE_BUSY_ALARM"
+    DROP_LOG = "DROP_LOG_ALARM"
+    ENCRYPT_DECRYPT_FAIL = "ENCRYPT_DECRYPT_FAIL_ALARM"
+    # sending
+    SEND_FAIL = "SEND_DATA_FAIL_ALARM"
+    SEND_QUOTA_EXCEED = "SEND_QUOTA_EXCEED_ALARM"
+    SEND_COMPRESS_FAIL = "SEND_COMPRESS_FAIL_ALARM"
+    COMPRESS_FAIL = "COMPRESS_FAIL_ALARM"
+    SERIALIZE_FAIL = "SERIALIZE_FAIL_ALARM"
+    SENDING_COSTS_TOO_MUCH_TIME = "SENDING_COSTS_TOO_MUCH_TIME_ALARM"
+    LOG_GROUP_WAIT_TOO_LONG = "LOG_GROUP_WAIT_TOO_LONG_ALARM"
     DISCARD_DATA = "DISCARD_DATA_ALARM"
+    DISCARD_SECONDARY = "DISCARD_SECONDARY_ALARM"
+    SECONDARY_READ_WRITE = "SECONDARY_READ_WRITE_ALARM"
+    # checkpoints / state
+    CHECKPOINT_FAIL = "CHECKPOINT_ALARM"
+    CHECKPOINT_V2 = "CHECKPOINT_V2_ALARM"
+    EXACTLY_ONCE = "EXACTLY_ONCE_ALARM"
+    LOAD_LOCAL_EVENT = "LOAD_LOCAL_EVENT_ALARM"
+    # agent health
     CPU_LIMIT = "CPU_EXCEED_LIMIT_ALARM"
     MEM_LIMIT = "MEM_EXCEED_LIMIT_ALARM"
-    INPUT_COLLECT_FAIL = "INPUT_COLLECT_ALARM"
-    DEVICE_PARSE_FALLBACK = "DEVICE_PARSE_FALLBACK_ALARM"  # TPU-specific
     AGENT_RESTART = "LOGTAIL_CRASH_ALARM"
+    AGENT_CRASH_STACK = "LOGTAIL_CRASH_STACK_ALARM"
+    INPUT_COLLECT_FAIL = "INPUT_COLLECT_ALARM"
+    HOST_MONITOR = "HOST_MONITOR_ALARM"
+    INNER_PROFILE = "INNER_PROFILE_ALARM"
+    HOLD_ON_TOO_SLOW = "HOLD_ON_TOO_SLOW_ALARM"
+    REGISTER_HANDLERS_TOO_SLOW = "REGISTER_HANDLERS_TOO_SLOW_ALARM"
+    # TPU-specific
+    DEVICE_PARSE_FALLBACK = "DEVICE_PARSE_FALLBACK_ALARM"
+    DEVICE_BACKEND_DEGRADED = "DEVICE_BACKEND_DEGRADED_ALARM"
+    MESH_SHARD_FALLBACK = "MESH_SHARD_FALLBACK_ALARM"
 
 
 class _AlarmRecord:

@@ -52,8 +52,8 @@ class ProcessorPromParseMetric(Processor):
                 if isinstance(ev, RawEvent) and ev.content is not None:
                     chunks.append(ev.content.to_bytes())
                 elif isinstance(ev, LogEvent) and \
-                        ev.get_content(self.source_key) is not None:
-                    chunks.append(ev.get_content(self.source_key).to_bytes())
+                        (v := ev.get_content(self.source_key)) is not None:
+                    chunks.append(v.to_bytes())
                 else:
                     keep.append(ev)   # contributed nothing: pass through
         if not chunks:

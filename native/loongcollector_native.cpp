@@ -1589,9 +1589,28 @@ inline bool t1_lit_at(const T1Ctx& c, int32_t li, int32_t pos) {
     if (pos + k > c.len) return false;
     const uint8_t* lp = c.lit_blob + c.lit_offs[li];
     const uint8_t* rp = c.row + pos;
-    if (k == 1) return rp[0] == lp[0];
-    if (k == 2) return rp[0] == lp[0] && rp[1] == lp[1];
-    return memcmp(rp, lp, k) == 0;
+    // literals ≤ 8 bytes compare as fixed-width loads (a memcmp CALL per
+    // trial dominates literal-alternation walks: 12 month branches × call
+    // overhead beats the actual byte compares by an order of magnitude)
+    switch (k) {
+    case 1: return rp[0] == lp[0];
+    case 2: return rp[0] == lp[0] && rp[1] == lp[1];
+    case 3: return rp[0] == lp[0] && rp[1] == lp[1] && rp[2] == lp[2];
+    case 4: {
+        uint32_t a, b;
+        memcpy(&a, rp, 4); memcpy(&b, lp, 4);
+        return a == b;
+    }
+    default:
+        if (k <= 8) {
+            uint64_t a = 0, b = 0;
+            memcpy(&a, rp, 4); memcpy(&b, lp, 4);
+            uint64_t a2 = 0, b2 = 0;
+            memcpy(&a2, rp + k - 4, 4); memcpy(&b2, lp + k - 4, 4);
+            return a == b && a2 == b2;
+        }
+        return memcmp(rp, lp, k) == 0;
+    }
 }
 
 // Decode + fuse a validated op stream into `ops[*n_ops..]`.  Nested OPT/ALT
@@ -1734,7 +1753,52 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
 int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
     int32_t n = 0;
     if (t1_decode_into(w, nw, ops, &n) < 0) return -1;
+    // Specialize capture-free ALT/OPT whose bodies are only LIT/FIXED ops:
+    // their trials touch nothing but st.cur, so the per-branch T1State
+    // copies (3 × ncaps ints each) are pure waste.  Grok-style composites
+    // (%{HOUR}, %{MINUTE}, %{MONTHDAY}…) are exactly these shapes and pay
+    // several copies per row otherwise.
+    auto body_simple = [&](int32_t from, int32_t count) {
+        for (int32_t k = from; k < from + count; ++k)
+            if (ops[k].kind != 0 && ops[k].kind != 2) return false;
+        return true;
+    };
+    for (int32_t i = 0; i < n; ++i) {
+        if (ops[i].kind == 5 && body_simple(i + 1, ops[i].b)) {
+            ops[i].kind = 11;                       // SIMPLEOPT
+        } else if (ops[i].kind == 6) {
+            bool all = true;
+            int32_t bi = i + 1;
+            for (int32_t b = 0; b < ops[i].a && all; ++b) {
+                if (ops[bi].kind != 9 ||
+                    !body_simple(bi + 1, ops[bi].b)) all = false;
+                bi += 1 + ops[bi].b;
+            }
+            if (all) ops[i].kind = 10;              // SIMPLEALT
+        }
+    }
     return n;
+}
+
+// Capture-free body walk: advances *cur on success, touches nothing else.
+static inline bool t1_walk_simple(const T1Ctx& c, const T1DecOp* ops,
+                                  int32_t from, int32_t count,
+                                  int32_t* cur) {
+    int32_t p = *cur;
+    for (int32_t k = from; k < from + count; ++k) {
+        const T1DecOp& q = ops[k];
+        if (q.kind == 0) {
+            if (!t1_lit_at(c, q.a, p)) return false;
+            p += c.lit_lens[q.a];
+        } else {  // FIXED
+            if (p + q.b > c.len) return false;
+            for (int32_t j = 0; j < q.b; ++j)
+                if (!t1_member(c, q.a, c.row[p + j])) return false;
+            p += q.b;
+        }
+    }
+    *cur = p;
+    return true;
 }
 
 void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t from,
@@ -1826,6 +1890,25 @@ void t1_exec_dec(const T1Ctx& c, const T1DecOp* ops, int32_t from,
             t1_exec_dec(c, ops, oi + 1, oi + 1 + o.b, st);
             if (!st.ok) t1_copy(st, save, c.ncaps);  // save.ok was true
             oi += o.b;
+            break;
+        }
+        case 11: {  // SIMPLEOPT: capture-free optional, no state copies
+            t1_walk_simple(c, ops, oi + 1, o.b, &st.cur);
+            oi += o.b;
+            break;
+        }
+        case 10: {  // SIMPLEALT: capture-free branches, first match wins
+            int32_t end = oi + 1 + o.b;
+            int32_t bi = oi + 1;
+            bool chosen = false;
+            for (int32_t b = 0; b < o.a; ++b) {
+                int32_t bn = ops[bi].b;
+                if (!chosen && t1_walk_simple(c, ops, bi + 1, bn, &st.cur))
+                    chosen = true;
+                bi += 1 + bn;
+            }
+            oi = end - 1;
+            if (!chosen) { st.ok = false; return; }
             break;
         }
         case 6: {  // ALT: BRANCH markers + bodies decoded inline

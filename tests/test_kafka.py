@@ -3,6 +3,7 @@ that decodes record batches (including CRC32C verification)."""
 
 import socket
 import struct
+import time
 import threading
 
 import pytest
@@ -362,3 +363,152 @@ class TestTLS:
             assert b.produced
         finally:
             b.stop()
+
+
+class LatencyBroker(FakeBroker):
+    """FakeBroker with per-request latency and a pipelining-aware serve
+    loop: a reader thread ingests requests as they arrive (stamping arrival
+    time) and a responder answers each no earlier than arrival + rtt, in
+    order — so a client that pipelines N requests pays ~1 RTT total while a
+    serial client pays N."""
+
+    def __init__(self, rtt_s=0.05):
+        super().__init__()
+        self.rtt_s = rtt_s
+
+    def _serve(self, conn):
+        import queue as _q
+        q = _q.Queue()
+
+        def reader():
+            try:
+                while True:
+                    raw = self._read(conn, 4)
+                    if raw is None:
+                        q.put(None)
+                        return
+                    size = struct.unpack(">i", raw)[0]
+                    msg = self._read(conn, size)
+                    q.put((time.monotonic(), msg))
+            except OSError:
+                q.put(None)
+
+        threading.Thread(target=reader, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                arrival, msg = item
+                api, ver, corr = struct.unpack(">hhi", msg[:8])
+                cid_len = struct.unpack(">h", msg[8:10])[0]
+                body = msg[10 + max(cid_len, 0):]
+                resp = self._dispatch(api, ver, body, conn)
+                if resp is None:
+                    return
+                delay = arrival + self.rtt_s - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                out = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(out)) + out)
+        except OSError:
+            pass
+
+    def _metadata_response(self):
+        # 8 partitions, one leader: the pipelining scenario
+        def s(x):
+            d = x.encode()
+            return struct.pack(">h", len(d)) + d
+        out = struct.pack(">i", 1)
+        out += struct.pack(">i", 0) + s("127.0.0.1") + struct.pack(">i", self.port)
+        out += struct.pack(">h", -1)
+        out += struct.pack(">i", 0)
+        out += struct.pack(">i", 1)
+        out += struct.pack(">h", 0) + s("logs") + b"\x00"
+        out += struct.pack(">i", 8)
+        for pid in range(8):
+            out += struct.pack(">h", 0) + struct.pack(">i", pid)
+            out += struct.pack(">i", 0)
+            out += struct.pack(">i", 0)
+            out += struct.pack(">i", 0)
+    
+        return out
+
+
+class TestProducePipelining:
+    """VERDICT r4 #9: deep produce pipelining with ordering guarantees;
+    done-bar: >3x vs the serial client on a simulated-RTT broker."""
+
+    RTT = 0.05
+
+    @staticmethod
+    def _key_for_partition(pid, nparts=8):
+        # the producer routes keyed records by md5(key) % nparts; derive a
+        # key per partition so the test covers all 8 batches
+        import hashlib
+        i = 0
+        while True:
+            k = f"k{i}".encode()
+            if int.from_bytes(hashlib.md5(k).digest()[:4],
+                              "big") % nparts == pid:
+                return k
+            i += 1
+
+    def _records(self):
+        recs = []
+        for pid in range(8):
+            key = self._key_for_partition(pid)
+            for j in range(3):
+                recs.append((key, b"v%d" % j))
+        return recs
+
+    def test_pipelined_beats_serial_3x(self):
+        broker = LatencyBroker(self.RTT)
+        broker.start()
+        try:
+            recs = self._records()
+            serial = KafkaProducer([f"127.0.0.1:{broker.port}"],
+                                   max_in_flight=1)
+            serial.refresh_metadata("logs")
+            t0 = time.monotonic()
+            serial.send("logs", recs)
+            t_serial = time.monotonic() - t0
+            serial.close()
+
+            piped = KafkaProducer([f"127.0.0.1:{broker.port}"],
+                                  max_in_flight=8)
+            piped.refresh_metadata("logs")
+            t0 = time.monotonic()
+            piped.send("logs", recs)
+            t_piped = time.monotonic() - t0
+            piped.close()
+            assert t_serial / t_piped > 3.0, (t_serial, t_piped)
+        finally:
+            broker.stop()
+
+    def test_pipelined_batches_arrive_in_order_per_partition(self):
+        broker = LatencyBroker(0.005)
+        broker.start()
+        try:
+            p = KafkaProducer([f"127.0.0.1:{broker.port}"], max_in_flight=4)
+            p.refresh_metadata("logs")
+            # many sends; each partition's batches must land in send order
+            for round_no in range(5):
+                p.send("logs", [(self._key_for_partition(pid),
+                                 f"r{round_no}".encode())
+                                for pid in range(8)])
+            p.close()
+            per_part = {}
+            for topic, partition, batch in broker.produced:
+                per_part.setdefault(partition, []).append(batch)
+            assert len(per_part) == 8
+            for pid, batches in per_part.items():
+                rounds = []
+                for b in batches:
+                    # crude but sufficient: the round marker is in the batch
+                    for r in range(5):
+                        if f"r{r}".encode() in b:
+                            rounds.append(r)
+                assert rounds == sorted(rounds), (pid, rounds)
+        finally:
+            broker.stop()
