@@ -45,6 +45,8 @@ flags.DEFINE_FLAG_INT32("config_scan_interval", "config rescan seconds", 10)
 flags.DEFINE_FLAG_INT32("checkpoint_dump_interval", "checkpoint dump seconds", 5)
 flags.DEFINE_FLAG_DOUBLE("exit_flush_timeout", "flush-out budget on exit (s)", 20.0)
 flags.DEFINE_FLAG_STRING("config_server_address", "remote ConfigServer endpoint", "")
+flags.DEFINE_FLAG_STRING("config_server_protocol",
+                         "ConfigServer protocol: v2 (default) or v1", "v2")
 
 
 class Application:
@@ -88,7 +90,16 @@ class Application:
         self.remote_provider = None
         endpoint = flags.get_flag("config_server_address")
         if endpoint:
-            self.remote_provider = CommonConfigProvider(
+            proto = flags.get_flag("config_server_protocol").strip().lower()
+            if proto == "v1":
+                from .config.legacy_provider import LegacyConfigProvider
+                provider_cls = LegacyConfigProvider
+            else:
+                if proto not in ("", "v2"):
+                    log.error("unknown config_server_protocol %r; "
+                              "falling back to v2", proto)
+                provider_cls = CommonConfigProvider
+            self.remote_provider = provider_cls(
                 endpoint, os.path.join(self.data_dir, "remote_config"))
         self.watchdog = LoongCollectorMonitor(
             on_limit_breach=self._on_limit_breach)

@@ -1,0 +1,105 @@
+"""Legacy (v1) ConfigServer provider.
+
+Reference: config_server/protocol/v1/agent.proto + the v1 enrolment flow —
+HeartBeat carries the held (name, version) set; the server answers with
+per-config check results (NEW / MODIFIED / DELETED); details for changed
+configs are pulled via /Agent/FetchPipelineConfig/.
+
+Shares everything operational with the v2 provider (scheduling, backoff,
+config-dir materialization, safe-name policy) and swaps only the wire
+protocol, so `config_server_protocol: v1` in the agent config is the whole
+migration story for fleets still on the first-generation control plane.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List
+
+from . import agent_v1_pb as pb1
+from .common_provider import AGENT_VERSION, CommonConfigProvider
+from ..utils.logger import get_logger
+
+log = get_logger("config_provider_v1")
+
+
+class _DetailShim:
+    """Adapter: v1 fetch results / delete sentinels in the shape
+    _apply_updates consumes (name, version, detail bytes)."""
+
+    __slots__ = ("name", "version", "detail")
+
+    def __init__(self, name: str, version: int, detail: bytes):
+        self.name = name
+        self.version = version
+        self.detail = detail
+
+
+class LegacyConfigProvider(CommonConfigProvider):
+    """v1-protocol ConfigServer client."""
+
+    def _heartbeat_request_v1(self) -> pb1.HeartBeatRequestV1:
+        req = pb1.HeartBeatRequestV1()
+        req.request_id = uuid.uuid4().hex
+        req.agent_id = self.instance_id
+        req.agent_type = self.agent_type
+        req.running_status = "running"
+        req.startup_time = self.startup_time
+        req.interval = int(self.interval_s)
+        attrs = pb1.AgentAttributesV1()
+        attrs.version = AGENT_VERSION
+        attrs.hostname = self._hostname.decode("utf-8", "replace")
+        attrs.ip = self._host_ip.decode("utf-8", "replace")
+        req.attributes = attrs
+        with self._lock:
+            versions = dict(self._versions)
+        req.pipeline_configs = [
+            pb1.ConfigInfoV1(name=n, version=v) for n, v in versions.items()]
+        return req
+
+    def heartbeat_once(self) -> bool:
+        body = self._post("/Agent/HeartBeat/",
+                          self._heartbeat_request_v1().encode())
+        if body is None:
+            return False
+        try:
+            resp = pb1.HeartBeatResponseV1.parse(body)
+        except ValueError:
+            log.warning("undecodable v1 heartbeat response (%d bytes)",
+                        len(body))
+            return False
+        if resp.code != pb1.RESP_ACCEPT:
+            log.warning("v1 heartbeat rejected: %s %s", resp.code,
+                        resp.message)
+            return False
+        updates: List[_DetailShim] = []
+        to_fetch: List[pb1.ConfigInfoV1] = []
+        for r in resp.pipeline_check_results:
+            if r.check_status == pb1.CHECK_DELETED:
+                updates.append(_DetailShim(r.name, -1, b""))
+            else:  # NEW / MODIFIED
+                to_fetch.append(
+                    pb1.ConfigInfoV1(name=r.name, version=r.new_version))
+        if to_fetch:
+            updates.extend(self._fetch_details_v1(to_fetch))
+        self._apply_updates(updates)
+        return True
+
+    def _fetch_details_v1(self, infos) -> List[_DetailShim]:
+        req = pb1.FetchPipelineConfigRequestV1()
+        req.request_id = uuid.uuid4().hex
+        req.agent_id = self.instance_id
+        req.req_configs = list(infos)
+        body = self._post("/Agent/FetchPipelineConfig/", req.encode())
+        if body is None:
+            return []
+        try:
+            resp = pb1.FetchPipelineConfigResponseV1.parse(body)
+        except ValueError:
+            return []
+        if resp.code != pb1.RESP_ACCEPT:
+            log.warning("v1 fetch rejected: %s %s", resp.code, resp.message)
+            return []
+        return [_DetailShim(d.name, d.version, d.detail.encode())
+                for d in resp.config_details]

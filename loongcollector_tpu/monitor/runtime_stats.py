@@ -1,0 +1,61 @@
+"""Runtime telemetry gauges for the self-monitor pipelines.
+
+Reference analogue: core/monitor/metric_models + the per-runner metric
+records the reference refreshes before each self-monitor send.  These
+gauges surface the round-5 subsystems — the async device plane's in-flight
+budget, the prometheus stream scraper's drop counter, the eBPF connection
+table — so operators see device back-pressure and shedding in the same
+internal metrics stream as everything else.
+"""
+
+from __future__ import annotations
+
+from .metrics import MetricsRecord
+
+_plane_rec = MetricsRecord(category="device_plane",
+                           labels={"component": "device_plane"})
+_prom_rec = MetricsRecord(category="prometheus_runner",
+                          labels={"component": "prometheus"})
+_ebpf_rec = MetricsRecord(category="ebpf_connections",
+                          labels={"component": "ebpf"})
+
+
+def refresh() -> None:
+    """Pull current values into the gauge records (called by the
+    self-monitor right before it snapshots).  Every section is fail-soft:
+    telemetry must never take down the monitor thread."""
+    try:
+        from ..ops.device_plane import DevicePlane
+        plane = DevicePlane._instance   # observe-only: never construct
+        if plane is not None:
+            _plane_rec.gauge("inflight_bytes").set(plane.inflight_bytes())
+            _plane_rec.gauge("budget_bytes").set(plane.budget_bytes)
+            _plane_rec.gauge("dispatched_total").set(
+                plane.dispatched_total())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..input.prometheus.scraper import PrometheusInputRunner
+        runner = PrometheusInputRunner._instance
+        if runner is not None:
+            _prom_rec.gauge("dropped_groups").set(runner.dropped_groups)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..input.ebpf.adapter import EventSource
+        from ..input.ebpf.server import EBPFServer
+        server = EBPFServer._instance
+        if server is not None:
+            netobs = server._managers.get(EventSource.NETWORK_OBSERVE)
+            if netobs is not None:
+                cm = netobs.connections
+                _ebpf_rec.gauge("connections").set(cm.connection_count())
+                _ebpf_rec.gauge("dropped_connections").set(cm.dropped_conns)
+                _ebpf_rec.gauge("unmatched_responses").set(
+                    cm.unmatched_responses)
+            _ebpf_rec.gauge("process_cache_size").set(
+                server.proc_tree.size())
+            _ebpf_rec.gauge("process_cache_misses").set(
+                server.proc_tree.misses)
+    except Exception:  # noqa: BLE001
+        pass

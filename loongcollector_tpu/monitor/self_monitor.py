@@ -92,6 +92,8 @@ class SelfMonitorServer:
         pqm = self.process_queue_manager
         if pqm is None:
             return
+        from .runtime_stats import refresh
+        refresh()   # pull device-plane / scraper / eBPF gauges
         with self._lock:
             mkey, akey = self._metrics_queue_key, self._alarms_queue_key
         # check queue validity BEFORE draining counters/alarms: the drain is

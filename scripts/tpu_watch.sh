@@ -16,8 +16,10 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 prev=unknown
 while true; do
   # single-core host: a jax-importing probe steals CPU from a live bench —
-  # yield while one runs (the capture path relaunches bench itself anyway)
-  if pgrep -f "bench[.]py" > /dev/null 2>&1; then
+  # yield while one runs (the capture path relaunches bench itself anyway).
+  # Anchored pattern: an unanchored "bench.py" also matches unrelated
+  # processes that merely mention the file in their argv.
+  if pgrep -f '^[^ ]*python[0-9.]* ([^ ]*/)?bench\.py' > /dev/null 2>&1; then
     sleep 30
     continue
   fi

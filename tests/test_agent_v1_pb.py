@@ -1,0 +1,128 @@
+"""ConfigServer v1 protocol: codec round-trips + provider flow against a
+fake v1 server (reference config_server/protocol/v1/agent.proto)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from loongcollector_tpu.config import agent_v1_pb as pb1
+from loongcollector_tpu.config.legacy_provider import LegacyConfigProvider
+
+
+class TestCodecRoundTrip:
+    def test_heartbeat_request(self):
+        req = pb1.HeartBeatRequestV1()
+        req.request_id = "r1"
+        req.agent_id = "agent-7"
+        req.tags = ["prod", "zone-a"]
+        req.startup_time = 1700000000
+        req.attributes.hostname = "host1"
+        req.attributes.extras = {"k": "v"}
+        req.pipeline_configs = [pb1.ConfigInfoV1("nginx", 3)]
+        out = pb1.HeartBeatRequestV1.parse(req.encode())
+        assert out.request_id == "r1" and out.agent_id == "agent-7"
+        assert out.tags == ["prod", "zone-a"]
+        assert out.startup_time == 1700000000
+        assert out.attributes.hostname == "host1"
+        assert out.attributes.extras == {"k": "v"}
+        assert out.pipeline_configs[0].name == "nginx"
+        assert out.pipeline_configs[0].version == 3
+
+    def test_heartbeat_response_and_commands(self):
+        resp = pb1.HeartBeatResponseV1()
+        resp.request_id = "r2"
+        r = pb1.ConfigCheckResult()
+        r.name = "app"
+        r.new_version = 5
+        r.check_status = pb1.CHECK_MODIFIED
+        resp.pipeline_check_results.append(r)
+        cmd = pb1.Command()
+        cmd.type = "upgrade"
+        cmd.id = "c1"
+        cmd.args = {"target": "1.2"}
+        resp.custom_commands.append(cmd)
+        out = pb1.HeartBeatResponseV1.parse(resp.encode())
+        assert out.pipeline_check_results[0].new_version == 5
+        assert out.pipeline_check_results[0].check_status == \
+            pb1.CHECK_MODIFIED
+        assert out.custom_commands[0].args == {"target": "1.2"}
+
+    def test_fetch_round_trip(self):
+        resp = pb1.FetchPipelineConfigResponseV1()
+        resp.config_details.append(
+            pb1.ConfigDetailV1("app", 5, '{"inputs": []}'))
+        out = pb1.FetchPipelineConfigResponseV1.parse(resp.encode())
+        assert out.config_details[0].detail == '{"inputs": []}'
+        assert out.config_details[0].version == 5
+
+
+class _V1Server(http.server.BaseHTTPRequestHandler):
+    """Scripted v1 ConfigServer: announces one NEW config, serves its
+    detail, then marks it DELETED on the next heartbeat."""
+
+    state = {"phase": 0}
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.path.rstrip("/") == "/Agent/HeartBeat":
+            req = pb1.HeartBeatRequestV1.parse(body)
+            resp = pb1.HeartBeatResponseV1()
+            resp.request_id = req.request_id
+            r = pb1.ConfigCheckResult()
+            r.name = "remote-pipe"
+            if _V1Server.state["phase"] == 0:
+                r.new_version = 1
+                r.check_status = pb1.CHECK_NEW
+            else:
+                r.old_version = 1
+                r.check_status = pb1.CHECK_DELETED
+            resp.pipeline_check_results.append(r)
+            out = resp.encode()
+        elif self.path.rstrip("/") == "/Agent/FetchPipelineConfig":
+            req = pb1.FetchPipelineConfigRequestV1.parse(body)
+            assert req.req_configs[0].name == "remote-pipe"
+            resp = pb1.FetchPipelineConfigResponseV1()
+            resp.config_details.append(pb1.ConfigDetailV1(
+                "remote-pipe", 1,
+                json.dumps({"inputs": [], "flushers": []})))
+            out = resp.encode()
+            _V1Server.state["phase"] = 1
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+class TestLegacyProviderE2E:
+    def test_new_fetch_delete_cycle(self, tmp_path):
+        _V1Server.state = {"phase": 0}
+        server = http.server.HTTPServer(("127.0.0.1", 0), _V1Server)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            p = LegacyConfigProvider(f"http://127.0.0.1:{port}",
+                                     str(tmp_path / "remote"))
+            import os
+            os.makedirs(p.config_dir, exist_ok=True)
+            assert p.heartbeat_once()
+            materialized = tmp_path / "remote" / "remote-pipe.json"
+            assert materialized.exists()
+            assert json.loads(materialized.read_text()) == {
+                "inputs": [], "flushers": []}
+            assert p._versions["remote-pipe"] == 1
+            # next heartbeat: server deletes it
+            assert p.heartbeat_once()
+            assert not materialized.exists()
+            assert "remote-pipe" not in p._versions
+        finally:
+            server.shutdown()
