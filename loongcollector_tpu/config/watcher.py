@@ -62,10 +62,36 @@ def load_config_file(path: str) -> Optional[dict]:
         return None
 
 
+# Built-in pipelines (reference PipelineConfigWatcher::InsertBuiltInPipelines
+# — enterprise builds inject provider-supplied configs ahead of the file
+# scan; the open equivalent is this registry).  Builtins win name clashes
+# with file configs, exactly like the reference's configSet ordering.
+_builtin_pipelines: Dict[str, Tuple[int, dict]] = {}  # name -> (epoch, cfg)
+_builtin_epoch = 0
+
+
+def register_builtin_pipeline(name: str, config: dict) -> None:
+    """Ship a pipeline with the agent itself (no config file on disk).
+    Registered before the watcher's next scan; same-name file configs are
+    shadowed.  A monotonic epoch (not object identity) detects
+    re-registration, so replace-with-same-address or in-place edits after
+    re-register still roll out."""
+    global _builtin_epoch
+    _builtin_epoch += 1
+    _builtin_pipelines[name] = (_builtin_epoch, config)
+
+
+def unregister_builtin_pipeline(name: str) -> None:
+    global _builtin_epoch
+    if _builtin_pipelines.pop(name, None) is not None:
+        _builtin_epoch += 1
+
+
 class PipelineConfigWatcher:
     def __init__(self) -> None:
         self._dirs: List[str] = []
         self._state: Dict[str, Tuple[float, int]] = {}  # path -> (mtime, size)
+        self._builtin_applied: Dict[str, int] = {}  # name -> id(config)
 
     def add_source(self, directory: str) -> None:
         if directory not in self._dirs:
@@ -74,6 +100,25 @@ class PipelineConfigWatcher:
     def check_config_diff(self) -> ConfigDiff:
         diff = ConfigDiff()
         seen: Dict[str, str] = {}  # name -> path
+        # builtins first: they claim their names before the file scan
+        for name, (epoch, cfg) in _builtin_pipelines.items():
+            seen[name] = f"builtin://{name}"
+            # forget any shadowed file's scan state so the file re-applies
+            # the moment the builtin unregisters (an unchanged mtime/size
+            # signature would otherwise suppress its re-discovery forever)
+            for path in list(self._state):
+                if os.path.splitext(os.path.basename(path))[0] == name:
+                    del self._state[path]
+            if self._builtin_applied.get(name) != epoch:
+                if name in self._builtin_applied:
+                    diff.modified[name] = cfg
+                else:
+                    diff.added[name] = cfg
+                self._builtin_applied[name] = epoch
+        for name in list(self._builtin_applied):
+            if name not in _builtin_pipelines:
+                del self._builtin_applied[name]
+                diff.removed.append(name)
         for d in self._dirs:
             if not os.path.isdir(d):
                 continue

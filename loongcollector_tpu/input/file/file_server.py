@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from ...utils import flags
 from ...utils.logger import get_logger
 from .checkpoint import CheckPointManager
 from .event_listener import create_listener
@@ -26,6 +28,17 @@ from .reader import LogFileReader
 log = get_logger("file_server")
 
 DISCOVERY_INTERVAL_S = 1.0
+
+# reference parity knobs (reader/LogFileReader.cpp:70 read_delay_alarm_duration,
+# FileReaderOptions ReadDelayAlertThresholdBytes, EventHandler.cpp:342
+# FILE_READER_EXCEED_ALARM reader-count ceiling)
+flags.DEFINE_FLAG_INT64("read_delay_alarm_bytes",
+                        "backlog bytes before READ_LOG_DELAY_ALARM",
+                        200 * 1024 * 1024)
+flags.DEFINE_FLAG_INT32("read_delay_alarm_duration",
+                        "seconds between repeated read-delay alarms", 60)
+flags.DEFINE_FLAG_INT32("max_file_reader_num",
+                        "max simultaneously open log readers", 512)
 IDLE_SLEEP_S = 0.05
 # with inotify the thread sleeps ON the fd, so the poll interval can relax:
 # events wake it instantly and polling is only the discovery/rotation net
@@ -97,6 +110,9 @@ class FileServer:
         # waiting out the poll sleep
         self._blocked_wake = threading.Event()
         self._feedback_keys: set = set()
+        # path -> last alarm time (per-file alarm rate limiting)
+        self._delay_alarms: Dict[str, float] = {}
+        self._reader_limit_alarms: Dict[str, float] = {}
 
     @classmethod
     def instance(cls) -> "FileServer":
@@ -255,6 +271,8 @@ class FileServer:
                         self.checkpoints.remove(r.dev_inode.dev,
                                                 r.dev_inode.inode)
                         r.close()
+                        self._delay_alarms.pop(path, None)
+                        self._reader_limit_alarms.pop(path, None)
                 st.first_round = False
             # drain readers with unread bytes. With complete inotify
             # coverage, off-discovery rounds only stat files that fired an
@@ -268,6 +286,11 @@ class FileServer:
             else:
                 targets = list(st.readers.values())
             for r in targets:
+                if ran_discovery:
+                    # once per discovery pass is plenty for an alarm that
+                    # rate-limits to one per minute; checking every poll
+                    # tick would double the per-reader fstat load
+                    self._check_read_delay(st, r)
                 if r.has_more():
                     moved = self._drain_reader(st, r)
                     busy |= moved
@@ -305,6 +328,26 @@ class FileServer:
             self._watch_complete = complete
         return busy
 
+    def _check_read_delay(self, st: _ConfigState, reader) -> None:
+        """READ_LOG_DELAY_ALARM (reference LogFileReader.cpp:1540-1559):
+        the writer is outrunning the reader by more than the threshold —
+        alarm at most once per duration per file."""
+        backlog = reader.backlog()
+        if backlog <= flags.get_flag("read_delay_alarm_bytes"):
+            self._delay_alarms.pop(reader.path, None)
+            return
+        now = time.monotonic()
+        last = self._delay_alarms.get(reader.path, 0.0)
+        if now - last < flags.get_flag("read_delay_alarm_duration"):
+            return
+        self._delay_alarms[reader.path] = now
+        log.warning("read log delay: %s falls behind %d bytes",
+                    reader.path, backlog)
+        AlarmManager.instance().send_alarm(
+            AlarmType.READ_LOG_DELAY,
+            f"fall behind {backlog} bytes, path: {reader.path}",
+            AlarmLevel.ERROR, st.name)
+
     def _register_feedback(self, queue_key: int) -> None:
         # registered on EVERY rejection (set_feedback replaces the list, so
         # this is idempotent): a deleted-and-recreated queue under the same
@@ -331,13 +374,56 @@ class FileServer:
         cur = get_dev_inode(path)
         if cur.valid() and cur.inode != r.dev_inode.inode:
             st.rotated.append(r)
+            # rotation churn must not blow past the fd ceiling: shed old
+            # rotated readers first (best effort — the LIVE path always
+            # reopens, or rotated data would be lost)
+            self._shed_for_capacity(st, path)
             new = st.new_reader(path)
             if new.open():
                 st.readers[path] = new
             else:
                 del st.readers[path]
 
+    def _reader_count(self) -> int:
+        with self._lock:
+            return sum(len(c.readers) + len(c.rotated)
+                       for c in self._configs.values())
+
+    def _shed_for_capacity(self, st: _ConfigState, path: str) -> bool:
+        """At the reader ceiling: shed the oldest ROTATED reader (the
+        reference cleans the rotator queue, EventHandler.cpp:330-348).
+        Returns True when a slot was freed.  The alarm rate-limits per
+        path — at a pinned limit a 1 s discovery pass would otherwise emit
+        one alarm per pending file per second, forever."""
+        if self._reader_count() < flags.get_flag("max_file_reader_num"):
+            return True
+        freed = False
+        with self._lock:
+            configs = list(self._configs.values())
+        for c in configs:
+            if c.rotated:
+                old = c.rotated.pop(0)
+                self.checkpoints.update(old.checkpoint())
+                old.close()
+                freed = True
+                break
+        now = time.monotonic()
+        last = self._reader_limit_alarms.get(path, 0.0)
+        if now - last >= flags.get_flag("read_delay_alarm_duration"):
+            self._reader_limit_alarms[path] = now
+            msg = (f"log reader count at limit "
+                   f"({flags.get_flag('max_file_reader_num')}); "
+                   + ("dropped an old rotated reader" if freed
+                      else f"skipping {path}"))
+            log.warning("%s", msg)
+            AlarmManager.instance().send_alarm(
+                AlarmType.FILE_READER_EXCEED, msg,
+                AlarmLevel.WARNING, st.name)
+        return freed
+
     def _open_reader(self, st: _ConfigState, path: str) -> None:
+        if not self._shed_for_capacity(st, path):
+            return
         r = st.new_reader(path)
         if not r.open():
             return

@@ -154,6 +154,16 @@ class LogFileReader:
 
     # -- reading ------------------------------------------------------------
 
+    def backlog(self) -> int:
+        """Unread bytes (size - offset); 0 when unreadable or truncated."""
+        if self._fd is None:
+            return 0
+        try:
+            size = os.fstat(self._fd).st_size
+        except OSError:
+            return 0
+        return max(0, size - self.offset)
+
     def has_more(self) -> bool:
         if self._fd is None:
             return False

@@ -215,3 +215,35 @@ class TestEnvExpansion:
         fl = cfg["flushers"][0]
         assert fl["AccessKeyId"] == "key-123"
         assert fl["AccessKeySecret"] == "${UNSET_NAME_XYZ}"  # stays visible
+
+
+class TestBuiltinPipelines:
+    """Reference PipelineConfigWatcher::InsertBuiltInPipelines (the open
+    equivalent of enterprise provider-injected configs): builtins apply
+    without files on disk and shadow same-name file configs."""
+
+    def test_register_apply_shadow_remove(self, tmp_path):
+        import json
+        from loongcollector_tpu.config.watcher import (
+            PipelineConfigWatcher, register_builtin_pipeline,
+            unregister_builtin_pipeline)
+        cfg = {"inputs": [], "processors": [], "flushers": []}
+        register_builtin_pipeline("builtin-mon", cfg)
+        try:
+            w = PipelineConfigWatcher()
+            w.add_source(str(tmp_path))
+            # a same-name file config must be shadowed by the builtin
+            (tmp_path / "builtin-mon.json").write_text(
+                json.dumps({"inputs": [{"Type": "input_file"}]}))
+            d = w.check_config_diff()
+            assert d.added == {"builtin-mon": cfg}
+            assert w.check_config_diff().empty()      # stable: no re-add
+            unregister_builtin_pipeline("builtin-mon")
+            # the same scan that retires the builtin discovers the file
+            # config that was shadowed under the name
+            d = w.check_config_diff()
+            assert "builtin-mon" in d.removed
+            assert d.added["builtin-mon"]["inputs"][0]["Type"] == \
+                "input_file"
+        finally:
+            unregister_builtin_pipeline("builtin-mon")

@@ -167,3 +167,59 @@ class TestContainerChurn:
             f.write(b"ignored\n")
         time.sleep(0.4)
         assert pqm.pop_item(timeout=0.3) is None
+
+
+class TestReaderLimitsAndDelayAlarms:
+    """Reference parity: FILE_READER_EXCEED (EventHandler.cpp:342) and
+    READ_LOG_DELAY (LogFileReader.cpp:1540-1559) wired to real emission
+    sites."""
+
+    @pytest.fixture(autouse=True)
+    def clean_alarms(self):
+        from loongcollector_tpu.monitor.alarms import AlarmManager
+        AlarmManager.instance().flush()
+        yield
+        AlarmManager.instance().flush()   # never leak into other tests
+
+    def _alarm_types(self):
+        from loongcollector_tpu.monitor.alarms import AlarmManager
+        return {a["alarm_type"] for a in AlarmManager.instance().flush()}
+
+    def test_reader_count_ceiling(self, server, monkeypatch):
+        from loongcollector_tpu.utils import flags
+        fs, pqm, tmp_path = server
+        self._alarm_types()   # drain stale alarms from other tests
+        monkeypatch.setattr(flags._registry["max_file_reader_num"],
+                            "value", 2)
+        pqm.create_or_reuse_queue(21, capacity=1000)
+        for i in range(4):
+            (tmp_path / f"r{i}.log").write_bytes(b"x\n")
+        fs.add_config("lim", FileDiscoveryConfig([str(tmp_path / "r*.log")]),
+                      21, tail_existing=True)
+        fs.start()
+        assert wait_for(lambda: fs._reader_count() >= 2, timeout=5)
+        time.sleep(0.5)
+        assert fs._reader_count() <= 2          # ceiling holds
+        assert wait_for(lambda: "FILE_READER_EXCEED_ALARM"
+                        in self._alarm_types(), timeout=5)
+
+    def test_read_delay_alarm(self, server, monkeypatch):
+        from loongcollector_tpu.utils import flags
+        fs, pqm, tmp_path = server
+        self._alarm_types()
+        monkeypatch.setattr(flags._registry["read_delay_alarm_bytes"],
+                            "value", 64)
+        monkeypatch.setattr(flags._registry["read_delay_alarm_duration"],
+                            "value", 0)
+        log = tmp_path / "slow.log"
+        log.write_bytes(b"a" * 4096 + b"\n")
+        # a queue that is ALWAYS full: the reader can never drain, so the
+        # backlog persists past the threshold
+        q = pqm.create_or_reuse_queue(22, capacity=1)
+        from loongcollector_tpu.models import PipelineEventGroup
+        q.push(PipelineEventGroup())            # fill to high watermark
+        fs.add_config("slow", FileDiscoveryConfig([str(log)]), 22,
+                      tail_existing=True, chunk_size=128)
+        fs.start()
+        assert wait_for(lambda: "READ_LOG_DELAY_ALARM"
+                        in self._alarm_types(), timeout=5)
