@@ -95,35 +95,64 @@ class ProcessorParseDelimiter(Processor):
                 self.engine = get_engine(pattern)
         return True
 
-    def process(self, group: PipelineEventGroup) -> None:
+    supports_async_dispatch = True
+
+    def process_dispatch(self, group: PipelineEventGroup):
+        """Async device plane (same split as processor_parse_regex_tpu):
+        the delimiter segment program dispatches now, the spans apply in
+        process_complete while the device moves on to the next group."""
         src = extract_source(group, self.source_key)
         if src is None:
-            return
+            return None
         if (self.engine is not None and src.columnar
                 and not self.quote_mode and not self.allow_not_enough):
-            cols = group.columns
-            res = self.engine.parse_batch(src.arena, src.offsets, src.lengths)
-            ok = res.ok & src.present
-            for g, key in enumerate(self.keys):
-                lens = np.where(ok, res.cap_len[:, g], -1).astype(np.int32)
-                cols.set_field(key, res.cap_off[:, g], lens)
-            keep = (~ok) & src.present if self.keep_source_on_fail else \
-                np.zeros(len(ok), dtype=bool)
-            if self.keep_source_on_success:
-                keep = keep | (ok & src.present)
-            if keep.any():
-                cols.set_field(self.renamed_source_key,
-                               src.offsets.astype(np.int32),
-                               np.where(keep, src.lengths, -1).astype(np.int32))
-            cols.parse_ok = ok
-            if src.from_content:
-                cols.content_consumed = True
-            return
+            pending = self.engine.parse_batch_async(
+                src.arena, src.offsets, src.lengths)
+            if pending.done:
+                self._apply_device(group, src, pending.result())
+                return None
+            return src, pending
+        self._process_host(group)
+        return None
 
+    def process_complete(self, group: PipelineEventGroup, token) -> None:
+        if token is None:
+            return
+        src, pending = token
+        self._apply_device(group, src, pending.result())
+
+    def process(self, group: PipelineEventGroup) -> None:
+        self.process_complete(group, self.process_dispatch(group))
+
+    def _apply_device(self, group: PipelineEventGroup, src, res) -> None:
+        cols = group.columns
+        ok = res.ok & src.present
+        nkeys = min(len(self.keys), res.cap_len.shape[1])
+        # matrix install (regex-processor fast path): one [N, K] mask at
+        # most, and the serializer keeps its zero-transpose span_matrix
+        if ok.all():
+            len_mat = res.cap_len[:, :nkeys]
+        else:
+            len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
+                               np.int32(-1))
+        cols.set_fields_matrix(self.keys[:nkeys],
+                               res.cap_off[:, :nkeys], len_mat)
+        keep = (~ok) & src.present if self.keep_source_on_fail else \
+            np.zeros(len(ok), dtype=bool)
+        if self.keep_source_on_success:
+            keep = keep | (ok & src.present)
+        if keep.any():
+            cols.set_field(self.renamed_source_key,
+                           src.offsets.astype(np.int32),
+                           np.where(keep, src.lengths, -1).astype(np.int32))
+        cols.parse_ok = ok
+        if src.from_content:
+            cols.content_consumed = True
+
+    def _process_host(self, group: PipelineEventGroup) -> None:
         # host path: quote-mode FSM or row groups
         sb = group.source_buffer
-        raw = src.arena
-        for i, ev in enumerate(group.events):
+        for ev in group.events:
             if not hasattr(ev, "get_content"):
                 continue
             v = ev.get_content(self.source_key)

@@ -241,3 +241,45 @@ class TestRunnerOverlap:
             assert plane.inflight_bytes() == 0
         finally:
             eng.set_device_kernel_override(None)
+
+
+class TestDelimiterAsyncSplit:
+    """processor_parse_delimiter_tpu rides the same dispatch/complete split
+    as the regex processor: device work stays pending across the group
+    boundary and applies at complete()."""
+
+    def test_dispatch_defers_then_completes(self, monkeypatch):
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        from loongcollector_tpu.processor.parse_delimiter import \
+            ProcessorParseDelimiter
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        DevicePlane.reset_for_testing()
+        ctx = PluginContext()
+        p = ProcessorParseDelimiter()
+        assert p.init({"Separator": ",", "Keys": ["a", "b", "c"]}, ctx)
+        eng = p.engine
+        lat = LatencyInjectedKernel(eng._segment_kernel, 0.02,
+                                    serialize=False)
+        eng.set_device_kernel_override(lat)
+        try:
+            sb = SourceBuffer()
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(
+                b"x,y,z\n1,2,3\n"))
+            sp = ProcessorSplitLogString()
+            sp.init({}, ctx)
+            sp.process(g)
+            token = p.process_dispatch(g)
+            assert token is not None          # device work in flight
+            p.process_complete(g, token)
+            cols = g.columns
+            assert cols.parse_ok.all()
+            arena = g.source_buffer.as_array()
+            offs, lens = cols.fields["b"]
+            got = [bytes(arena[int(offs[i]):int(offs[i]) + int(lens[i])]
+                         .tobytes()) for i in range(2)]
+            assert got == [b"y", b"2"]
+        finally:
+            eng.set_device_kernel_override(None)
