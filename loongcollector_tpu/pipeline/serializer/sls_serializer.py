@@ -178,10 +178,13 @@ class SLSEventGroupSerializer:
                                      field_offs, field_lens)
 
 
-def parse_loggroup(data: bytes) -> PipelineEventGroup:
+def parse_loggroup(data: bytes, group: Optional[PipelineEventGroup] = None
+                   ) -> PipelineEventGroup:
     """Decode LogGroup wire bytes back into an event group (the ingest-side
     mirror of the serializer; reference ProcessorParseFromPBNative decodes
-    PB-transferred groups on the forward path)."""
+    PB-transferred groups on the forward path).  Passing `group` decodes
+    straight into its SourceBuffer — the forward path copies each string
+    exactly once."""
 
     def read_varint(buf: bytes, i: int):
         shift = v = 0
@@ -212,7 +215,8 @@ def parse_loggroup(data: bytes) -> PipelineEventGroup:
                 v = payload
         return k, v
 
-    group = PipelineEventGroup()
+    if group is None:
+        group = PipelineEventGroup()
     sb = group.source_buffer
     i = 0
     n = len(data)

@@ -87,6 +87,9 @@ def register_all(registry) -> None:
                                 ProcessorPromParseMetric)
     registry.register_processor("processor_prom_relabel_metric_native",
                                 ProcessorPromRelabelMetric)
+    from .parse_from_pb import ProcessorParseFromPB
+    registry.register_processor("processor_parse_from_pb_native",
+                                ProcessorParseFromPB)
     from .longtail2 import ALL as _LONGTAIL2
     for _cls in _LONGTAIL2:
         registry.register_processor(_cls.name, _cls)

@@ -74,3 +74,40 @@ def extract_source(group: PipelineEventGroup,
         if len(values) else np.zeros(0, np.int64)
     return SourceColumns(arena, offsets.astype(np.int64), lengths, False,
                          np.array(present, dtype=bool))
+
+
+def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
+                      keep_on_success: bool, renamed_source_key: str) -> None:
+    """Columnar install of device parse results — shared by the regex and
+    delimiter processors so the subtle parts (all-ok fast path, span_matrix
+    preservation, keep-source mask algebra, content consumption) cannot
+    diverge between them."""
+    import numpy as np
+
+    cols = group.columns
+    ok = res.ok & src.present
+    nkeys = min(len(keys), res.cap_len.shape[1])
+    # one [N, K] mask at most; all-matched groups (the steady state) install
+    # the kernel matrices as-is and keep the serializer's zero-transpose
+    # span_matrix fast path
+    if ok.all():
+        len_mat = res.cap_len[:, :nkeys]
+    else:
+        len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
+                           np.int32(-1))
+    cols.set_fields_matrix(keys[:nkeys], res.cap_off[:, :nkeys], len_mat)
+    # source retention
+    if keep_on_fail and keep_on_success:
+        keep = src.present
+    elif keep_on_fail:
+        keep = (~ok) & src.present
+    elif keep_on_success:
+        keep = ok & src.present
+    else:
+        keep = np.zeros(len(ok), dtype=bool)
+    if keep.any():
+        cols.set_field(renamed_source_key, src.offsets.astype(np.int32),
+                       np.where(keep, src.lengths, -1).astype(np.int32))
+    cols.parse_ok = ok
+    if src.from_content:
+        cols.content_consumed = True

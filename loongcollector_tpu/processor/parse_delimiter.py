@@ -20,7 +20,7 @@ import numpy as np
 from ..models import PipelineEventGroup
 from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
-from .common import RAW_LOG_KEY, extract_source
+from .common import RAW_LOG_KEY, apply_parse_spans, extract_source
 
 
 def _csv_fsm_split(data: bytes, sep: bytes, quote: int = 0x22) -> List[bytes]:
@@ -101,19 +101,24 @@ class ProcessorParseDelimiter(Processor):
         """Async device plane (same split as processor_parse_regex_tpu):
         the delimiter segment program dispatches now, the spans apply in
         process_complete while the device moves on to the next group."""
+        if self.engine is None or self.quote_mode or self.allow_not_enough:
+            # configs that can never take the device path skip the source
+            # row-pack entirely (extract_source copies every event's bytes
+            # on row groups just to be discarded here otherwise)
+            self._process_host(group)
+            return None
         src = extract_source(group, self.source_key)
         if src is None:
             return None
-        if (self.engine is not None and src.columnar
-                and not self.quote_mode and not self.allow_not_enough):
-            pending = self.engine.parse_batch_async(
-                src.arena, src.offsets, src.lengths)
-            if pending.done:
-                self._apply_device(group, src, pending.result())
-                return None
-            return src, pending
-        self._process_host(group)
-        return None
+        if not src.columnar:
+            self._process_host(group)
+            return None
+        pending = self.engine.parse_batch_async(
+            src.arena, src.offsets, src.lengths)
+        if pending.done:
+            self._apply_device(group, src, pending.result())
+            return None
+        return src, pending
 
     def process_complete(self, group: PipelineEventGroup, token) -> None:
         if token is None:
@@ -125,29 +130,10 @@ class ProcessorParseDelimiter(Processor):
         self.process_complete(group, self.process_dispatch(group))
 
     def _apply_device(self, group: PipelineEventGroup, src, res) -> None:
-        cols = group.columns
-        ok = res.ok & src.present
-        nkeys = min(len(self.keys), res.cap_len.shape[1])
-        # matrix install (regex-processor fast path): one [N, K] mask at
-        # most, and the serializer keeps its zero-transpose span_matrix
-        if ok.all():
-            len_mat = res.cap_len[:, :nkeys]
-        else:
-            len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
-                               np.int32(-1))
-        cols.set_fields_matrix(self.keys[:nkeys],
-                               res.cap_off[:, :nkeys], len_mat)
-        keep = (~ok) & src.present if self.keep_source_on_fail else \
-            np.zeros(len(ok), dtype=bool)
-        if self.keep_source_on_success:
-            keep = keep | (ok & src.present)
-        if keep.any():
-            cols.set_field(self.renamed_source_key,
-                           src.offsets.astype(np.int32),
-                           np.where(keep, src.lengths, -1).astype(np.int32))
-        cols.parse_ok = ok
-        if src.from_content:
-            cols.content_consumed = True
+        apply_parse_spans(group, src, res, self.keys,
+                          self.keep_source_on_fail,
+                          self.keep_source_on_success,
+                          self.renamed_source_key)
 
     def _process_host(self, group: PipelineEventGroup) -> None:
         # host path: quote-mode FSM or row groups

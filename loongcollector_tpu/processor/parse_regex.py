@@ -23,7 +23,7 @@ import numpy as np
 from ..models import ColumnarLogs, PipelineEventGroup
 from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
-from .common import RAW_LOG_KEY, extract_source
+from .common import RAW_LOG_KEY, apply_parse_spans, extract_source
 
 
 class ProcessorParseRegex(Processor):
@@ -89,36 +89,10 @@ class ProcessorParseRegex(Processor):
         ok = res.ok & src.present
 
         if src.columnar:
-            cols = group.columns
-            ncap = self.engine.num_caps
-            nkeys = min(ncap, len(self.keys))
-            # one [N, C] mask instead of per-field slicing; the matrices feed
-            # the serializer directly (ColumnarLogs.span_matrix fast path).
-            # All-matched groups (the common steady state) skip the mask copy.
-            if ok.all():
-                len_mat = res.cap_len[:, :nkeys]
-            else:
-                len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
-                                   np.int32(-1))
-            cols.set_fields_matrix(self.keys[:nkeys],
-                                   res.cap_off[:, :nkeys], len_mat)
-            # source retention
-            src_off = src.offsets.astype(np.int32)
-            src_len = src.lengths
-            if self.keep_source_on_fail and self.keep_source_on_success:
-                keep = src.present
-            elif self.keep_source_on_fail:
-                keep = (~ok) & src.present
-            elif self.keep_source_on_success:
-                keep = ok & src.present
-            else:
-                keep = np.zeros(len(ok), dtype=bool)
-            if keep.any():
-                cols.set_field(self.renamed_source_key, src_off,
-                               np.where(keep, src_len, -1).astype(np.int32))
-            cols.parse_ok = ok
-            if src.from_content:
-                cols.content_consumed = True
+            apply_parse_spans(group, src, res, self.keys,
+                              self.keep_source_on_fail,
+                              self.keep_source_on_success,
+                              self.renamed_source_key)
             return
 
         # row path (non-columnar groups)

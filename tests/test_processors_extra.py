@@ -183,3 +183,53 @@ class TestContainerKeepTime:
         p.process(g)
         out = JsonSerializer().serialize([g]).decode()
         assert "_partial_" not in out
+
+
+class TestParseFromPB:
+    """processor_parse_from_pb_native (reference inner/
+    ProcessorParseFromPBNative.cpp): forward-path PB payloads expand into
+    ordinary events — exact inverse of the SLS serializer."""
+
+    def test_roundtrip_through_processor(self):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+            SLSEventGroupSerializer
+        from loongcollector_tpu.processor.parse_from_pb import \
+            ProcessorParseFromPB
+
+        # build a source group, serialize it (what a forwarder would ship)
+        sb = SourceBuffer()
+        src = PipelineEventGroup(sb)
+        ev = src.add_log_event(1700000100)
+        ev.set_content(sb.copy_string(b"k1"), sb.copy_string(b"v1"))
+        ev.set_content(sb.copy_string(b"k2"), sb.copy_string(b"v2"))
+        src.set_tag(b"host", b"h9")
+        payload = bytes(SLSEventGroupSerializer().serialize_view([src]))
+
+        # receiving side: one raw event holding the PB bytes
+        sb2 = SourceBuffer()
+        g = PipelineEventGroup(sb2)
+        g.add_raw_event(1).set_content(sb2.copy_string(payload))
+        p = ProcessorParseFromPB()
+        p.init({}, PluginContext())
+        p.process(g)
+        assert len(g.events) == 1
+        out = g.events[0]
+        assert out.timestamp == 1700000100
+        assert out.get_content(b"k1") == b"v1"
+        assert out.get_content(b"k2") == b"v2"
+        assert g.get_tag(b"host") == b"h9"
+
+    def test_garbage_payload_kept_out(self):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_from_pb import \
+            ProcessorParseFromPB
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(b"\xff\xfe garbage"))
+        p = ProcessorParseFromPB()
+        p.init({}, PluginContext())
+        p.process(g)          # must not raise
+        assert len(g.events) == 0
