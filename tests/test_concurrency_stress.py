@@ -245,3 +245,55 @@ def test_multithreaded_aggregator_buckets(tmp_path):
     ids = [json.loads(l)["id"] for l in out.read_text().splitlines()]
     assert len(ids) == pushed[0], (len(ids), pushed[0])
     assert len(set(ids)) == len(ids), "duplicate events emitted"
+
+
+class TestDevicePlaneStress:
+    """Race coverage for the async device plane (SURVEY §5.2): many
+    threads dispatching through one tight budget with injected latency
+    must neither deadlock nor corrupt results, and the budget must drain
+    to zero."""
+
+    def test_parallel_parses_under_tight_budget(self, monkeypatch):
+        import numpy as np
+        from loongcollector_tpu.ops import device_plane as dp
+        from loongcollector_tpu.ops.regex import engine as engine_mod
+        from loongcollector_tpu.ops.regex.engine import RegexEngine
+
+        monkeypatch.setenv("LOONG_NATIVE_T1", "0")
+        monkeypatch.setattr(engine_mod, "MAX_BATCH", 128)
+        plane = dp.DevicePlane.reset_for_testing(budget_bytes=48 * 1024)
+        try:
+            eng = RegexEngine(r"(\w+):(\d+)")
+            lat = dp.LatencyInjectedKernel(eng._segment_kernel, 0.002,
+                                           serialize=False)
+            eng.set_device_kernel_override(lat)
+            line = b"abc:123"
+            n = 512                      # 4 chunks per parse at MAX_BATCH=128
+            arena = np.frombuffer(line * n, np.uint8).copy()
+            offs = np.arange(n, dtype=np.int64) * len(line)
+            lens = np.full(n, len(line), np.int32)
+            eng.parse_batch(arena, offs, lens)     # compile outside threads
+
+            errors = []
+
+            def worker():
+                try:
+                    for _ in range(8):
+                        res = eng.parse_batch(arena, offs, lens)
+                        assert res.ok.all()
+                        assert (res.cap_len[:, 0] == 3).all()
+                        assert (res.cap_len[:, 1] == 3).all()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "deadlock: worker never finished"
+            assert not errors, errors
+            assert plane.inflight_bytes() == 0
+        finally:
+            eng.set_device_kernel_override(None)
+            dp.DevicePlane.reset_for_testing()
