@@ -34,8 +34,24 @@ class CollectionPipelineManager:
         self.sender_queue_manager = sender_queue_manager
         self.onetime_manager = None  # OnetimeConfigInfoManager when wired
         self._pending_onetime: Dict[str, dict] = {}
+        # queue_key -> pipeline, rebuilt lazily after every topology change
+        self._queue_key_cache: Dict[int, CollectionPipeline] = {}
 
     def update_pipelines(self, diff: ConfigDiff) -> None:
+        # drop the hot-path queue-key cache for the duration of the update
+        # (consumers fall back to the locked scan) and rebuild it at the
+        # end — lazy filling DURING the mutation window could cache a
+        # pipeline this very update is replacing
+        self._queue_key_cache = {}
+        try:
+            self._update_pipelines_inner(diff)
+        finally:
+            with self._lock:
+                self._queue_key_cache = {
+                    p.process_queue_key: p
+                    for p in self._pipelines.values()}
+
+    def _update_pipelines_inner(self, diff: ConfigDiff) -> None:
         for name in diff.removed:
             old = self._pipelines.get(name)
             if old is not None:
@@ -118,6 +134,14 @@ class CollectionPipelineManager:
             return self._pipelines.get(name)
 
     def find_pipeline_by_queue_key(self, key: int) -> Optional[CollectionPipeline]:
+        # hot path: the processor runner resolves this once per popped
+        # group — a cached key map beats scanning pipelines under the lock
+        p = self._queue_key_cache.get(key)
+        if p is not None:
+            return p
+        # miss (mid-update window): scan, but do NOT write the cache — a
+        # lazy fill here could pin a pipeline that the in-flight update is
+        # about to stop; update_pipelines rebuilds the map when it's done
         with self._lock:
             for p in self._pipelines.values():
                 if p.process_queue_key == key:

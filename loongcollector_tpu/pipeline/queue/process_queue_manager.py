@@ -22,6 +22,12 @@ class ProcessQueueManager:
         self._lock = threading.Lock()
         self._data_cv = threading.Condition(self._lock)
         self._rr_cursor: Dict[int, int] = {p: 0 for p in range(PRIORITY_COUNT)}
+        # pop hot path: per-priority queue lists are rebuilt only when the
+        # topology changes (one pop per processed group made the per-pop
+        # snapshot copies measurable)
+        self._version = 0
+        self._snapshot_version = -1
+        self._by_prio: Dict[int, list] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -35,11 +41,13 @@ class ProcessQueueManager:
                 q = cls(key, priority, capacity, pipeline_name)
                 q._manager_cv = self._data_cv
                 self._queues[key] = q
+                self._version += 1
             return q
 
     def delete_queue(self, key: int) -> None:
         with self._lock:
-            self._queues.pop(key, None)
+            if self._queues.pop(key, None) is not None:
+                self._version += 1
 
     def get_queue(self, key: int) -> Optional[BoundedProcessQueue]:
         with self._lock:
@@ -77,10 +85,18 @@ class ProcessQueueManager:
 
     def _try_pop(self) -> Optional[Tuple[int, PipelineEventGroup]]:
         with self._lock:
-            queues = list(self._queues.values())
+            if self._snapshot_version != self._version:
+                self._by_prio = {p: [] for p in range(PRIORITY_COUNT)}
+                for q in self._queues.values():
+                    # KeyError here = misconfigured priority; silently
+                    # parking the queue in an unvisited bucket would stall
+                    # its data instead
+                    self._by_prio[q.priority].append(q)
+                self._snapshot_version = self._version
+            by_prio = self._by_prio
             cursors = dict(self._rr_cursor)
         for prio in range(PRIORITY_COUNT):
-            level = [q for q in queues if q.priority == prio]
+            level = by_prio.get(prio)
             if not level:
                 continue
             start = cursors.get(prio, 0) % len(level)
