@@ -16,6 +16,7 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..pipeline.pipeline_manager import ConfigDiff
 from ..utils.logger import get_logger
 
@@ -49,17 +50,26 @@ def load_config_file(path: str) -> Optional[dict]:
     if path.endswith((".yaml", ".yml")):
         if _yaml is None:
             log.error("PyYAML unavailable; cannot load %s", path)
+            _config_alarm(path, "PyYAML unavailable")
             return None
         try:
             return _yaml.safe_load(text)
         except _yaml.YAMLError as e:
             log.error("bad yaml %s: %s", path, e)
+            _config_alarm(path, e)
             return None
     try:
         return json.loads(text)
     except ValueError as e:
         log.error("bad json %s: %s", path, e)
+        _config_alarm(path, e)
         return None
+
+
+def _config_alarm(path: str, err) -> None:
+    AlarmManager.instance().send_alarm(
+        AlarmType.USER_CONFIG, f"unparsable config {path}: {err}",
+        AlarmLevel.ERROR)
 
 
 # Built-in pipelines (reference PipelineConfigWatcher::InsertBuiltInPipelines

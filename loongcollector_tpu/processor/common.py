@@ -90,7 +90,8 @@ def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
     # one [N, K] mask at most; all-matched groups (the steady state) install
     # the kernel matrices as-is and keep the serializer's zero-transpose
     # span_matrix fast path
-    if ok.all():
+    all_ok = bool(ok.all())
+    if all_ok:
         len_mat = res.cap_len[:, :nkeys]
     else:
         len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
@@ -111,3 +112,9 @@ def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
     cols.parse_ok = ok
     if src.from_content:
         cols.content_consumed = True
+    if not all_ok and bool((~ok & src.present).any()):
+        from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+        AlarmManager.instance().send_alarm(
+            AlarmType.PARSE_LOG_FAIL,
+            "events failed to parse (kept as rawLog when configured)",
+            AlarmLevel.WARNING)

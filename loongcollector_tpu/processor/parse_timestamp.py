@@ -15,6 +15,8 @@ from typing import Any, Dict
 
 import numpy as np
 
+from ..monitor.alarms import (AlarmLevel, AlarmManager,
+                              AlarmType)
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import extract_source
@@ -45,9 +47,19 @@ class ProcessorParseTimestamp(Processor):
                 self.source_timezone_offset = None
         return True
 
+    def _alarm_fail(self) -> None:
+        AlarmManager.instance().send_alarm(
+            AlarmType.PARSE_TIME_FAIL,
+            f"timestamp parse failed (format {self.source_format!r})",
+            AlarmLevel.WARNING)
+
     def _parse_one(self, data: bytes) -> int:
         ts = self._memo.get(data)
         if ts is not None:
+            if ts < 0:
+                # memoized FAILURE: still alarm, or the aggregated count
+                # undercounts a stream of identical bad values by memo-hits
+                self._alarm_fail()
             return ts
         try:
             st = time.strptime(data.decode("utf-8", "replace"), self.source_format)
@@ -58,6 +70,7 @@ class ProcessorParseTimestamp(Processor):
                 ts = int(time.mktime(st))
         except ValueError:
             ts = -1
+            self._alarm_fail()
         if len(self._memo) > 4096:
             self._memo.clear()
         self._memo[data] = ts

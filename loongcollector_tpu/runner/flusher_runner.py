@@ -18,6 +18,7 @@ from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
                                            SendingStatus)
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..utils.logger import get_logger
 from .http_sink import HttpSink
 
@@ -125,8 +126,10 @@ class FlusherRunner:
         flusher = item.flusher
         q = self.sqm.get_queue(item.queue_key)
         verdict = "drop"
+        cb_failed = True
         try:
             verdict = flusher.on_send_done(item, status, body)
+            cb_failed = False
         except Exception:  # noqa: BLE001
             log.exception("on_send_done failed")
         if q is not None:
@@ -140,6 +143,24 @@ class FlusherRunner:
                     cl.on_fail(slow=True)
                 elif verdict == "retry":
                     cl.on_fail(slow=(status == 429))
+        if verdict == "retry_slow":
+            AlarmManager.instance().send_alarm(
+                AlarmType.SEND_QUOTA_EXCEED,
+                f"quota exceeded (status {status})", AlarmLevel.WARNING)
+        elif verdict == "retry":
+            AlarmManager.instance().send_alarm(
+                AlarmType.SEND_FAIL, f"send failed (status {status}); "
+                "backing off", AlarmLevel.WARNING)
+        elif verdict == "drop":
+            # the exception fallback also lands here: the payload IS lost
+            # either way, but operators must not read a local flusher bug
+            # as a backend rejection
+            AlarmManager.instance().send_alarm(
+                AlarmType.DISCARD_DATA,
+                ("payload dropped: flusher callback failed "
+                 if cb_failed else
+                 "payload dropped after permanent rejection ")
+                + f"(status {status})", AlarmLevel.ERROR)
         if verdict in ("retry", "retry_slow"):
             if (self.disk_buffer is not None
                     and item.try_count >= MAX_TRY_BEFORE_SPILL

@@ -25,6 +25,7 @@ import time
 from typing import List, Optional
 
 from ..models import PipelineEventGroup
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops.device_plane import set_budget_relief
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
@@ -60,6 +61,10 @@ class ProcessorRunner:
             if self.pqm.push_queue(key, group):
                 return True
             time.sleep(0.01)
+        AlarmManager.instance().send_alarm(
+            AlarmType.PROCESS_QUEUE_FULL,
+            f"push rejected after {retry_times} retries (queue {key})",
+            AlarmLevel.WARNING)
         return False
 
     # -- lifecycle ----------------------------------------------------------

@@ -166,3 +166,77 @@ class TestProcessEntity:
         r = PluginRegistry.instance()
         r.load_static_plugins()
         assert r.create_input("input_process_entity") is not None
+
+
+class TestAlarmEmissionSites:
+    """Round-5: taxonomy types are wired to REAL emission sites, not just
+    declared (reference AlarmManager call sites across subsystems)."""
+
+    def _flush_types(self):
+        from loongcollector_tpu.monitor.alarms import AlarmManager
+        return {a["alarm_type"] for a in AlarmManager.instance().flush()}
+
+    def test_parse_fail_emits(self):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_regex import \
+            ProcessorParseRegex
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        self._flush_types()
+        ctx = PluginContext()
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(b"no digits here\n"))
+        sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"(\d+)", "Keys": ["n"]}, ctx)
+        p.process(g)
+        assert "PARSE_LOG_FAIL_ALARM" in self._flush_types()
+
+    def test_bad_config_emits(self, tmp_path):
+        from loongcollector_tpu.config.watcher import load_config_file
+        self._flush_types()
+        bad = tmp_path / "broken.json"
+        bad.write_text("{not json")
+        assert load_config_file(str(bad)) is None
+        assert "USER_CONFIG_ALARM" in self._flush_types()
+
+    def test_timestamp_fail_emits(self):
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_timestamp import \
+            ProcessorParseTimestamp
+        self._flush_types()
+        p = ProcessorParseTimestamp()
+        p.init({"SourceFormat": "%Y-%m-%d"}, PluginContext())
+        assert p._parse_one(b"not-a-date") == -1
+        assert "PARSE_TIME_FAIL_ALARM" in self._flush_types()
+
+    def test_send_verdict_alarms(self):
+        from loongcollector_tpu.pipeline.queue.sender_queue import (
+            SenderQueueItem, SenderQueueManager)
+        from loongcollector_tpu.runner.flusher_runner import FlusherRunner
+        self._flush_types()
+        sqm = SenderQueueManager()
+        sqm.create_or_reuse_queue(901)
+
+        class _F:
+            name = "f"; plugin_id = "f/0"; context = None
+            sender_queue = None; queue_key = 901
+            def on_send_done(self, item, status, body):
+                return {500: "retry", 429: "retry_slow", 400: "drop"}[status]
+            def spill_identity(self):
+                return {}
+
+        runner = FlusherRunner(sqm, http_sink=None)
+        for status in (500, 429, 400):
+            item = SenderQueueItem(data=b"x", raw_size=1, flusher=_F(),
+                                   queue_key=901)
+            q = sqm.get_queue(901)
+            if q is not None:
+                q.push(item)
+            runner._on_done(item, status, b"")
+        types = self._flush_types()
+        assert "SEND_DATA_FAIL_ALARM" in types
+        assert "SEND_QUOTA_EXCEED_ALARM" in types
+        assert "DISCARD_DATA_ALARM" in types
