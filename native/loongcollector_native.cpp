@@ -1568,14 +1568,15 @@ inline bool t1_all_member(const T1Ctx& c, int32_t cls, int32_t lo,
 // and fusing that motif into a single FIELD op removes most per-row dispatch.
 // When the span class is a single-char negation whose terminator IS the
 // literal's first byte ( ([^\]]+)\] , ([^"]*)" ), one memchr finds the span
-// end and the delimiter together.  Nested OPT/ALT bodies stay on the word
-// interpreter (rare in hot patterns).
+// end and the delimiter together.  OPT/ALT bodies decode inline after their
+// parent op; capture-free shapes are further specialized (kinds 8/10/11) to
+// copy-free trials, the rest keep the generic save/restore trials.
 // ---------------------------------------------------------------------------
 struct T1DecOp {
     int32_t kind;         // 0..6 = word op kinds; 7 = FIELD
     int32_t a, b, c2, d;  // kind-specific (FIELD: cap_id, cls, min, max)
     int32_t lit;          // FIELD: trailing literal index (-1 = none)
-    const int32_t* w;     // kind 5/6: raw op words (for the interpreter)
+    const int32_t* w;     // kind 8 (all-literal ALT): raw branch words
     int32_t wn;           //   width in words
     const uint64_t* mask; // SPAN/FIELD: resolved per-class stop-mask slot
                           // (filled by the exec that owns the mask buffer;
@@ -1753,19 +1754,36 @@ int32_t t1_decode_into(const int32_t* w, int64_t nw, T1DecOp* ops,
 int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
     int32_t n = 0;
     if (t1_decode_into(w, nw, ops, &n) < 0) return -1;
-    // Specialize capture-free ALT/OPT whose bodies are only LIT/FIXED ops:
-    // their trials touch nothing but st.cur, so the per-branch T1State
-    // copies (3 × ncaps ints each) are pure waste.  Grok-style composites
-    // (%{HOUR}, %{MINUTE}, %{MONTHDAY}…) are exactly these shapes and pay
-    // several copies per row otherwise.
+    // Specialize capture-free ALT/OPT: their trials touch nothing but
+    // st.cur, so the per-branch T1State copies (3 × ncaps ints each) are
+    // pure waste.  Bodies may contain LIT/FIXED/SPAN and NESTED capture-
+    // free ALT/OPT (grok time composites are several levels deep:
+    // `(?::(?:[0-5][0-9]|60)(?:[:.,][0-9]+)?)?`); anything touching
+    // captures keeps the generic trial machinery.  Innermost shapes
+    // specialize first because the scan runs left-to-right and bodies
+    // follow their parent op, so a parent sees its children's rewritten
+    // kinds... except a parent PRECEDES its body in the decoded layout —
+    // hence the fixpoint loop (depth ≤ kT1MaxDecOps, converges in
+    // nesting-depth passes, tiny in practice).
     auto body_simple = [&](int32_t from, int32_t count) {
-        for (int32_t k = from; k < from + count; ++k)
-            if (ops[k].kind != 0 && ops[k].kind != 2) return false;
+        for (int32_t k = from; k < from + count;) {
+            int32_t kind = ops[k].kind;
+            if (kind == 0 || kind == 1 || kind == 2 || kind == 8) {
+                ++k;                            // LIT / SPAN / FIXED / LITALT
+            } else if (kind == 10 || kind == 11) {
+                k += 1 + ops[k].b;              // nested simple subtree
+            } else {
+                return false;
+            }
+        }
         return true;
     };
-    for (int32_t i = 0; i < n; ++i) {
+    // reverse scan: every body FOLLOWS its parent op in the decoded
+    // layout, so walking backwards rewrites all descendants before their
+    // parent — one pass, no fixpoint
+    for (int32_t i = n - 1; i >= 0; --i) {
         if (ops[i].kind == 5 && body_simple(i + 1, ops[i].b)) {
-            ops[i].kind = 11;                       // SIMPLEOPT
+            ops[i].kind = 11;                   // SIMPLEOPT
         } else if (ops[i].kind == 6) {
             bool all = true;
             int32_t bi = i + 1;
@@ -1774,27 +1792,81 @@ int32_t t1_decode(const int32_t* w, int64_t nw, T1DecOp* ops) {
                     !body_simple(bi + 1, ops[bi].b)) all = false;
                 bi += 1 + ops[bi].b;
             }
-            if (all) ops[i].kind = 10;              // SIMPLEALT
+            if (all) ops[i].kind = 10;          // SIMPLEALT
         }
     }
     return n;
 }
 
 // Capture-free body walk: advances *cur on success, touches nothing else.
-static inline bool t1_walk_simple(const T1Ctx& c, const T1DecOp* ops,
-                                  int32_t from, int32_t count,
-                                  int32_t* cur) {
+// Handles LIT/FIXED/SPAN and NESTED capture-free ALT/OPT — a failed trial
+// at any depth leaves the caller's cursor untouched (locals only, zero
+// T1State copies).
+static bool t1_walk_simple(const T1Ctx& c, const T1DecOp* ops,
+                           int32_t from, int32_t count, int32_t* cur) {
     int32_t p = *cur;
-    for (int32_t k = from; k < from + count; ++k) {
+    for (int32_t k = from; k < from + count;) {
         const T1DecOp& q = ops[k];
-        if (q.kind == 0) {
+        switch (q.kind) {
+        case 0:
             if (!t1_lit_at(c, q.a, p)) return false;
             p += c.lit_lens[q.a];
-        } else {  // FIXED
+            ++k;
+            break;
+        case 1: {  // SPAN (maximal munch, follow-disjoint by compilation)
+            int32_t end = (q.mask != nullptr && c.mask_base != nullptr)
+                              ? t1_mask_find(q.mask, c.mask_words, p)
+                              : t1_scan_fwd(c, q.a, p);
+            int32_t run = end - p;
+            if (run < q.b || (q.c2 >= 0 && run > q.c2)) return false;
+            p = end;
+            ++k;
+            break;
+        }
+        case 2:    // FIXED
             if (p + q.b > c.len) return false;
             for (int32_t j = 0; j < q.b; ++j)
                 if (!t1_member(c, q.a, c.row[p + j])) return false;
             p += q.b;
+            ++k;
+            break;
+        case 8: {  // all-literal ALT: first literal matching at p wins
+            const int32_t* aw = q.w;
+            int32_t nb = aw[1];
+            const int32_t* br = aw + 2;  // per branch: [bw=2, 0, lit_idx]
+            bool hit = false;
+            for (int32_t b = 0; b < nb; ++b, br += 3) {
+                int32_t li = br[2];
+                if (t1_lit_at(c, li, p)) {
+                    p += c.lit_lens[li];
+                    hit = true;
+                    break;
+                }
+            }
+            if (!hit) return false;
+            ++k;
+            break;
+        }
+        case 11:   // nested SIMPLEOPT
+            t1_walk_simple(c, ops, k + 1, q.b, &p);
+            k += 1 + q.b;
+            break;
+        case 10: {  // nested SIMPLEALT: first matching branch wins
+            int32_t end = k + 1 + q.b;
+            int32_t bi = k + 1;
+            bool chosen = false;
+            for (int32_t b = 0; b < q.a; ++b) {
+                int32_t bn = ops[bi].b;
+                if (!chosen && t1_walk_simple(c, ops, bi + 1, bn, &p))
+                    chosen = true;
+                bi += 1 + bn;
+            }
+            if (!chosen) return false;
+            k = end;
+            break;
+        }
+        default:
+            return false;  // unreachable: body_simple gates the shapes
         }
     }
     *cur = p;
