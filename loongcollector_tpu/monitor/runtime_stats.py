@@ -18,6 +18,8 @@ _prom_rec = MetricsRecord(category="prometheus_runner",
                           labels={"component": "prometheus"})
 _ebpf_rec = MetricsRecord(category="ebpf_connections",
                           labels={"component": "ebpf"})
+_mesh_rec = MetricsRecord(category="mesh_parse",
+                          labels={"component": "sharded_plane"})
 
 
 def refresh() -> None:
@@ -32,6 +34,26 @@ def refresh() -> None:
             _plane_rec.gauge("budget_bytes").set(plane.budget_bytes)
             _plane_rec.gauge("dispatched_total").set(
                 plane.dispatched_total())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # psum'd mesh telemetry from the most recent sharded dispatch; the
+        # int() materialisation happens HERE (monitor cadence), never on
+        # the dispatch hot path
+        from ..ops.regex.engine import _engine_cache, _engine_cache_lock
+        with _engine_cache_lock:
+            engines = list(_engine_cache.values())
+        # LRU dict: most-recently-used engines live at the END — walk in
+        # reverse so the gauges report the freshest mesh dispatch
+        for eng in reversed(engines):
+            sharded = getattr(eng, "_sharded", None)
+            stats = getattr(sharded, "last_stats", None)
+            if stats:
+                _mesh_rec.gauge("devices").set(sharded.plane.num_devices)
+                _mesh_rec.gauge("last_matched").set(int(stats["matched"]))
+                _mesh_rec.gauge("last_events").set(int(stats["events"]))
+                _mesh_rec.gauge("last_bytes").set(int(stats["bytes"]))
+                break
     except Exception:  # noqa: BLE001
         pass
     try:
