@@ -112,6 +112,14 @@ class TelegrafManager(ProcessSupervisor):
     # -- supervision -------------------------------------------------------
 
     def _on_start(self) -> None:
+        # snapshot the tail position BEFORE the thread exists: anything
+        # appended after start() returns is then guaranteed to ship (the
+        # thread taking the snapshot raced writers that appended between
+        # start() returning and the thread's first scheduling)
+        try:
+            self._log_pos = os.path.getsize(self.log_path)
+        except OSError:
+            self._log_pos = 0
         self._log_thread = threading.Thread(target=self._tail_log,
                                             daemon=True,
                                             name="telegraf-logtail")
@@ -162,12 +170,10 @@ class TelegrafManager(ProcessSupervisor):
     # -- telegraf's own log → events (reference LogCollector) ---------------
 
     def _tail_log(self) -> None:
-        # tail from the current END: pre-existing log content was either
-        # already shipped by a previous run or predates this agent
-        try:
-            pos = os.path.getsize(self.log_path)
-        except OSError:
-            pos = 0
+        # tail from the position snapshotted at start (pre-existing log
+        # content was either already shipped by a previous run or predates
+        # this agent)
+        pos = getattr(self, "_log_pos", 0)
         while True:
             with self._lock:
                 if not self._running:
