@@ -67,10 +67,14 @@ class ProcessSupervisor:
             if self._running:
                 return
             self._running = True
+        # _on_start BEFORE the run loop exists: subclasses snapshot state
+        # there (e.g. telegraf's log tail position) that must precede any
+        # side effect of the first tick — starting the loop first let the
+        # fresh process's own startup output race the snapshot
+        self._on_start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=type(self).__name__)
         self._thread.start()
-        self._on_start()
 
     def stop_loop(self) -> None:
         with self._lock:

@@ -95,23 +95,33 @@ class ProcessorParseRegex(Processor):
                               self.renamed_source_key)
             return
 
-        # row path (non-columnar groups)
+        # row path (non-columnar groups) — reference ordering
+        # (ProcessorParseRegexNative.cpp ProcessEvent): capture the raw
+        # source FIRST (a key may overwrite it), delete the source unless a
+        # successful parse overwrote it, then re-add under the renamed key
+        # per the keep flags
         sb = group.source_buffer
+        key_bytes = [k.encode() for k in self.keys]
+        renamed = self.renamed_source_key.encode()
         for i, ev in enumerate(group.events):
             if not hasattr(ev, "get_content"):
                 continue  # RawEvent/metric/span rows don't carry fields
+            raw = ev.get_content(self.source_key)
             if ok[i]:
+                overwritten = False
                 for g in range(min(self.engine.num_caps, len(self.keys))):
                     ln = int(res.cap_len[i, g])
                     if ln >= 0:
                         o = int(res.cap_off[i, g])
                         data = bytes(src.arena[o : o + ln].tobytes())
-                        ev.set_content(self.keys[g].encode(), sb.copy_string(data))
-                if not self.keep_source_on_success:
+                        ev.set_content(key_bytes[g], sb.copy_string(data))
+                        if key_bytes[g] == self.source_key:
+                            overwritten = True
+                if not overwritten:
                     ev.del_content(self.source_key)
+                if self.keep_source_on_success and raw is not None:
+                    ev.set_content(renamed, raw)
             else:
-                if self.keep_source_on_fail:
-                    v = ev.get_content(self.source_key)
-                    if v is not None and self.renamed_source_key.encode() != self.source_key:
-                        ev.set_content(self.renamed_source_key.encode(), v)
-                        ev.del_content(self.source_key)
+                ev.del_content(self.source_key)
+                if self.keep_source_on_fail and raw is not None:
+                    ev.set_content(renamed, raw)

@@ -233,3 +233,57 @@ class TestParseFromPB:
         p.init({}, PluginContext())
         p.process(g)          # must not raise
         assert len(g.events) == 0
+
+
+class TestKeepSourceCombos:
+    """Columnar (shared apply_parse_spans) vs row-path keep/discard
+    semantics must agree for every CommonParserOptions combination
+    (reference ProcessorParseRegexNative.cpp:153-165)."""
+
+    DATA = b"1 ok\nbad line\n2 fine\n"
+
+    def _run(self, keep_fail, keep_success, columnar):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_regex import \
+            ProcessorParseRegex
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        ctx = PluginContext()
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        if columnar:
+            g.add_raw_event(1).set_content(sb.copy_string(self.DATA))
+            sp = ProcessorSplitLogString()
+            sp.init({}, ctx)
+            sp.process(g)
+        else:
+            for line in self.DATA.splitlines():
+                ev = g.add_log_event(1)
+                ev.set_content(sb.copy_string(b"content"),
+                               sb.copy_string(line))
+        p = ProcessorParseRegex()
+        p.init({"Regex": r"(\d+) (\w+)", "Keys": ["n", "w"],
+                "KeepingSourceWhenParseFail": keep_fail,
+                "KeepingSourceWhenParseSucceed": keep_success}, ctx)
+        p.process(g)
+        out = []
+        for ev in g.events:
+            out.append({k.to_str(): v.to_bytes() for k, v in ev.contents})
+        return out
+
+    @pytest.mark.parametrize("keep_fail", [True, False])
+    @pytest.mark.parametrize("keep_success", [True, False])
+    def test_columnar_matches_row_path(self, keep_fail, keep_success):
+        col = self._run(keep_fail, keep_success, columnar=True)
+        row = self._run(keep_fail, keep_success, columnar=False)
+        assert len(col) == len(row) == 3
+        for c, r in zip(col, row):
+            # both paths emit kept source bytes under the SAME renamed key
+            # (reference ShouldAddSourceContent semantics) — exact key
+            # spelling is part of the contract
+            assert c.get("n") == r.get("n")
+            assert c.get("w") == r.get("w")
+            assert c.get("rawLog") == r.get("rawLog"), \
+                (keep_fail, keep_success, c, r)
+            assert "content" not in c and "content" not in r, (c, r)
