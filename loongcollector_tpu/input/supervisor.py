@@ -71,7 +71,12 @@ class ProcessSupervisor:
         # there (e.g. telegraf's log tail position) that must precede any
         # side effect of the first tick — starting the loop first let the
         # fresh process's own startup output race the snapshot
-        self._on_start()
+        try:
+            self._on_start()
+        except BaseException:
+            with self._lock:
+                self._running = False   # a failed hook must not wedge
+            raise                       # future start_loop() calls
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=type(self).__name__)
         self._thread.start()

@@ -77,7 +77,8 @@ def extract_source(group: PipelineEventGroup,
 
 
 def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
-                      keep_on_success: bool, renamed_source_key: str) -> None:
+                      keep_on_success: bool, renamed_source_key: str,
+                      source_key=None) -> None:
     """Columnar install of device parse results — shared by the regex and
     delimiter processors so the subtle parts (all-ok fast path, span_matrix
     preservation, keep-source mask algebra, content consumption) cannot
@@ -112,9 +113,36 @@ def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
     cols.parse_ok = ok
     if src.from_content:
         cols.content_consumed = True
+    elif source_key is not None:
+        # named source field: consumed like the reference's DelContent
+        # unless one of the parsed keys overwrote that very name (the
+        # rawLog re-add above already handled the keep flags)
+        skey = source_key.decode() if isinstance(source_key, bytes) \
+            else source_key
+        if skey not in keys[:nkeys]:
+            cols.fields.pop(skey, None)
+            cols.span_matrix = None
     if not all_ok and bool((~ok & src.present).any()):
         from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
         AlarmManager.instance().send_alarm(
             AlarmType.PARSE_LOG_FAIL,
             "events failed to parse (kept as rawLog when configured)",
             AlarmLevel.WARNING)
+
+
+def finish_row_keep(ev, raw, parse_ok: bool, source_key: bytes,
+                    overwritten: bool, keep_on_fail: bool,
+                    keep_on_success: bool, renamed: bytes) -> None:
+    """Row-path keep/discard tail shared by the regex and delimiter
+    processors (reference ProcessEvent ordering): delete the source unless
+    a successful parse overwrote it, then re-add the captured raw bytes
+    under the renamed key per the keep flags."""
+    if parse_ok:
+        if not overwritten:
+            ev.del_content(source_key)
+        if keep_on_success and raw is not None:
+            ev.set_content(renamed, raw)
+    else:
+        ev.del_content(source_key)
+        if keep_on_fail and raw is not None:
+            ev.set_content(renamed, raw)

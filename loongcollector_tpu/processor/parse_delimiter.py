@@ -20,7 +20,8 @@ import numpy as np
 from ..models import PipelineEventGroup
 from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
-from .common import RAW_LOG_KEY, apply_parse_spans, extract_source
+from .common import (RAW_LOG_KEY, apply_parse_spans,
+                     extract_source, finish_row_keep)
 
 
 def _csv_fsm_split(data: bytes, sep: bytes, quote: int = 0x22) -> List[bytes]:
@@ -133,31 +134,40 @@ class ProcessorParseDelimiter(Processor):
         apply_parse_spans(group, src, res, self.keys,
                           self.keep_source_on_fail,
                           self.keep_source_on_success,
-                          self.renamed_source_key)
+                          self.renamed_source_key,
+                          source_key=self.source_key)
 
     def _process_host(self, group: PipelineEventGroup) -> None:
-        # host path: quote-mode FSM or row groups
+        # host path: quote-mode FSM or row groups.  Keep/discard follows
+        # the reference ordering shared with apply_parse_spans: capture the
+        # raw source, delete it unless a key overwrote it, re-add under the
+        # renamed key per the keep flags.
         sb = group.source_buffer
+        key_bytes = [k.encode() for k in self.keys]
+        renamed = self.renamed_source_key.encode()
         for ev in group.events:
             if not hasattr(ev, "get_content"):
                 continue
-            v = ev.get_content(self.source_key)
-            if v is None:
+            raw = ev.get_content(self.source_key)
+            if raw is None:
                 continue
-            data = v.to_bytes()
+            data = raw.to_bytes()
             fields = (_csv_fsm_split(data, self.separator)
                       if self.quote_mode else data.split(self.separator))
             if len(fields) < len(self.keys) and not self.allow_not_enough:
-                if self.keep_source_on_fail and \
-                        self.renamed_source_key.encode() != self.source_key:
-                    ev.set_content(self.renamed_source_key.encode(), v)
-                    ev.del_content(self.source_key)
+                finish_row_keep(ev, raw, False, self.source_key, False,
+                                self.keep_source_on_fail,
+                                self.keep_source_on_success, renamed)
                 continue
             if len(fields) > len(self.keys):
                 head = fields[: len(self.keys) - 1]
                 tail = self.separator.join(fields[len(self.keys) - 1:])
                 fields = head + [tail]
-            for key, val in zip(self.keys, fields):
-                ev.set_content(key.encode(), sb.copy_string(val))
-            if not self.keep_source_on_success:
-                ev.del_content(self.source_key)
+            overwritten = False
+            for key, val in zip(key_bytes, fields):
+                ev.set_content(key, sb.copy_string(val))
+                if key == self.source_key:
+                    overwritten = True
+            finish_row_keep(ev, raw, True, self.source_key, overwritten,
+                            self.keep_source_on_fail,
+                            self.keep_source_on_success, renamed)

@@ -23,7 +23,8 @@ import numpy as np
 from ..models import ColumnarLogs, PipelineEventGroup
 from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
-from .common import RAW_LOG_KEY, apply_parse_spans, extract_source
+from .common import (RAW_LOG_KEY, apply_parse_spans,
+                     extract_source, finish_row_keep)
 
 
 class ProcessorParseRegex(Processor):
@@ -92,7 +93,8 @@ class ProcessorParseRegex(Processor):
             apply_parse_spans(group, src, res, self.keys,
                               self.keep_source_on_fail,
                               self.keep_source_on_success,
-                              self.renamed_source_key)
+                              self.renamed_source_key,
+                              source_key=self.source_key)
             return
 
         # row path (non-columnar groups) — reference ordering
@@ -107,8 +109,8 @@ class ProcessorParseRegex(Processor):
             if not hasattr(ev, "get_content"):
                 continue  # RawEvent/metric/span rows don't carry fields
             raw = ev.get_content(self.source_key)
+            overwritten = False
             if ok[i]:
-                overwritten = False
                 for g in range(min(self.engine.num_caps, len(self.keys))):
                     ln = int(res.cap_len[i, g])
                     if ln >= 0:
@@ -117,11 +119,6 @@ class ProcessorParseRegex(Processor):
                         ev.set_content(key_bytes[g], sb.copy_string(data))
                         if key_bytes[g] == self.source_key:
                             overwritten = True
-                if not overwritten:
-                    ev.del_content(self.source_key)
-                if self.keep_source_on_success and raw is not None:
-                    ev.set_content(renamed, raw)
-            else:
-                ev.del_content(self.source_key)
-                if self.keep_source_on_fail and raw is not None:
-                    ev.set_content(renamed, raw)
+            finish_row_keep(ev, raw, bool(ok[i]), self.source_key,
+                            overwritten, self.keep_source_on_fail,
+                            self.keep_source_on_success, renamed)

