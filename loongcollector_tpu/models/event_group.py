@@ -231,7 +231,10 @@ class PipelineEventGroup:
         offs = cols.offsets
         lens = cols.lengths
         tss = cols.timestamps
-        emit_content = not field_items or not cols.content_consumed
+        # consumed content NEVER resurrects, even when every field was
+        # later dropped (all-failed + discard configs); the raw-tail case
+        # (no parse ran) is exactly content_consumed == False
+        emit_content = not cols.content_consumed
         for i in range(len(cols)):
             ev = LogEvent(int(tss[i]))
             if emit_content:

@@ -98,6 +98,11 @@ def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
         len_mat = np.where(ok[:, None], res.cap_len[:, :nkeys],
                            np.int32(-1))
     cols.set_fields_matrix(keys[:nkeys], res.cap_off[:, :nkeys], len_mat)
+    # consume a NAMED source BEFORE the keep machinery re-adds the raw
+    # bytes — with RenamedSourceKey == SourceKey the re-added field must
+    # survive (reference DelContent-then-AddLog ordering)
+    if not src.from_content and source_key is not None:
+        consume_named_source(cols, source_key, keys[:nkeys])
     # source retention
     if keep_on_fail and keep_on_success:
         keep = src.present
@@ -113,15 +118,6 @@ def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
     cols.parse_ok = ok
     if src.from_content:
         cols.content_consumed = True
-    elif source_key is not None:
-        # named source field: consumed like the reference's DelContent
-        # unless one of the parsed keys overwrote that very name (the
-        # rawLog re-add above already handled the keep flags)
-        skey = source_key.decode() if isinstance(source_key, bytes) \
-            else source_key
-        if skey not in keys[:nkeys]:
-            cols.fields.pop(skey, None)
-            cols.span_matrix = None
     if not all_ok and bool((~ok & src.present).any()):
         from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
         AlarmManager.instance().send_alarm(
@@ -146,3 +142,15 @@ def finish_row_keep(ev, raw, parse_ok: bool, source_key: bytes,
         ev.del_content(source_key)
         if keep_on_fail and raw is not None:
             ev.set_content(renamed, raw)
+
+
+def consume_named_source(cols, source_key, parsed_key_names) -> None:
+    """Reference DelContent for a NAMED source field: drop it unless one of
+    the parsed keys overwrote that very name.  Callers must run this
+    BEFORE re-adding the kept raw source under RenamedSourceKey, or the
+    RenamedSourceKey == SourceKey configuration destroys what it kept."""
+    skey = source_key.decode("utf-8", "replace") \
+        if isinstance(source_key, bytes) else source_key
+    if skey not in parsed_key_names:
+        cols.fields.pop(skey, None)
+        cols.span_matrix = None

@@ -101,38 +101,50 @@ class ProcessorParseJson(Processor):
                     field_lens[k][i] = view.length
             for k in field_offs:
                 cols.set_field(k, field_offs[k], field_lens[k])
+            if not src.from_content:
+                from .common import consume_named_source
+                consume_named_source(cols, self.source_key,
+                                     set(field_offs))
             self._retain_source(cols, src, ok)
             cols.parse_ok = ok
             if src.from_content:
                 cols.content_consumed = True
             return
 
+        # row path keep/discard: the shared reference ordering (capture
+        # raw, delete unless overwritten, re-add under the renamed key)
+        from .common import finish_row_keep
         sb = group.source_buffer
+        renamed = self.renamed_source_key.encode()
         for ev in group.events:
             if not hasattr(ev, "get_content"):
                 continue
-            v = ev.get_content(self.source_key)
-            if v is None:
+            raw = ev.get_content(self.source_key)
+            if raw is None:
                 continue
             try:
-                obj = json.loads(v.to_bytes())
+                obj = json.loads(raw.to_bytes())
                 if not isinstance(obj, dict):
                     raise ValueError
             except Exception:  # noqa: BLE001
-                if self.keep_source_on_fail:
-                    if self.renamed_source_key.encode() != self.source_key:
-                        ev.set_content(self.renamed_source_key.encode(), v)
-                        ev.del_content(self.source_key)
+                finish_row_keep(ev, raw, False, self.source_key, False,
+                                self.keep_source_on_fail,
+                                self.keep_source_on_success, renamed)
                 continue
+            overwritten = False
             for k, val in obj.items():
                 if not isinstance(val, str):
                     val = json.dumps(val, ensure_ascii=False) \
                         if isinstance(val, (dict, list)) else \
                         ("true" if val is True else "false" if val is False
                          else "null" if val is None else str(val))
-                ev.set_content(sb.copy_string(k), sb.copy_string(val))
-            if not self.keep_source_on_success:
-                ev.del_content(self.source_key)
+                kb = k.encode() if isinstance(k, str) else k
+                ev.set_content(sb.copy_string(kb), sb.copy_string(val))
+                if kb == self.source_key:
+                    overwritten = True
+            finish_row_keep(ev, raw, True, self.source_key, overwritten,
+                            self.keep_source_on_fail,
+                            self.keep_source_on_success, renamed)
 
     @staticmethod
     def _discover_schema(raw, src, candidates):

@@ -387,3 +387,117 @@ class TestNamedSourceKeyParity:
         assert "msg" not in col[0] and "msg" not in col[1]
         assert col[0] == {"n": b"7", "w": b"yes"}
         assert col[1] == {}
+
+
+class TestJsonKeepCombos:
+    """JSON parse keep/discard parity: columnar vs row paths across the
+    keep-flag matrix, including the named-SourceKey consumption rule."""
+
+    DATA = b'{"a":"1","b":"2"}\nnot json\n'
+
+    def _run(self, keep_fail, keep_success, columnar):
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        ctx = PluginContext()
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        if columnar:
+            g.add_raw_event(1).set_content(sb.copy_string(self.DATA))
+            sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        else:
+            for line in self.DATA.splitlines():
+                ev = g.add_log_event(1)
+                ev.set_content(sb.copy_string(b"content"),
+                               sb.copy_string(line))
+        p = ProcessorParseJson()
+        p.init({"KeepingSourceWhenParseFail": keep_fail,
+                "KeepingSourceWhenParseSucceed": keep_success}, ctx)
+        p.process(g)
+        return [{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                for ev in g.events]
+
+    @pytest.mark.parametrize("keep_fail", [True, False])
+    @pytest.mark.parametrize("keep_success", [True, False])
+    def test_columnar_matches_row(self, keep_fail, keep_success):
+        col = self._run(keep_fail, keep_success, columnar=True)
+        row = self._run(keep_fail, keep_success, columnar=False)
+        assert len(col) == len(row) == 2
+        for c, r in zip(col, row):
+            assert c.get("a") == r.get("a")
+            assert c.get("b") == r.get("b")
+            assert c.get("rawLog") == r.get("rawLog"), \
+                (keep_fail, keep_success, c, r)
+            assert "content" not in c and "content" not in r, (c, r)
+
+
+class TestRenamedEqualsSourceKey:
+    """Round-5 review regression: RenamedSourceKey == SourceKey must keep
+    the raw source on BOTH paths (consume runs before the keep re-add)."""
+
+    def test_regex_renamed_equals_source(self):
+        import numpy as np
+        from loongcollector_tpu.models import (ColumnarLogs,
+                                               PipelineEventGroup,
+                                               SourceBuffer)
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_regex import \
+            ProcessorParseRegex
+        ctx = PluginContext()
+
+        def columnar():
+            sb = SourceBuffer()
+            g = PipelineEventGroup(sb)
+            v1 = sb.copy_string(b"5 yes")
+            v2 = sb.copy_string(b"nope")
+            cols = ColumnarLogs(
+                offsets=np.array([v1.offset, v2.offset], np.int32),
+                lengths=np.array([v1.length, v2.length], np.int32))
+            cols.content_consumed = True
+            cols.set_field("msg", np.array([v1.offset, v2.offset], np.int32),
+                           np.array([v1.length, v2.length], np.int32))
+            g._columns = cols
+            return g
+
+        def rows():
+            sb = SourceBuffer()
+            g = PipelineEventGroup(sb)
+            for line in (b"5 yes", b"nope"):
+                ev = g.add_log_event(1)
+                ev.set_content(sb.copy_string(b"msg"), sb.copy_string(line))
+            return g
+
+        outs = []
+        for g in (columnar(), rows()):
+            p = ProcessorParseRegex()
+            p.init({"SourceKey": "msg", "RenamedSourceKey": "msg",
+                    "Regex": r"(\d+) (\w+)", "Keys": ["n", "w"],
+                    "KeepingSourceWhenParseFail": True}, ctx)
+            p.process(g)
+            outs.append([{k.to_str(): v.to_bytes() for k, v in ev.contents}
+                         for ev in g.events])
+        col, row = outs
+        assert col == row, (col, row)
+        assert col[1] == {"msg": b"nope"}     # kept raw under the SAME name
+
+    def test_json_all_fail_discard_emits_nothing(self):
+        """Consumed content must not resurrect when every field is dropped
+        (all-failed + discard config on a columnar group)."""
+        from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+        from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+        from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        ctx = PluginContext()
+        sb = SourceBuffer()
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(b"junk one\njunk2\n"))
+        sp = ProcessorSplitLogString(); sp.init({}, ctx); sp.process(g)
+        p = ProcessorParseJson()
+        p.init({"KeepingSourceWhenParseFail": False}, ctx)
+        p.process(g)
+        for ev in g.events:
+            assert {k.to_str(): v for k, v in ev.contents} == {}, \
+                list(ev.contents)
