@@ -144,20 +144,28 @@ class ProcessorParseApsara(Processor):
             if src.from_content:
                 cols.content_consumed = True
             return
+        from .common import finish_row_keep
+        renamed = self.renamed_source_key.encode()
         for ev in group.events:
             if not hasattr(ev, "get_content"):
                 continue
-            v = ev.get_content(self.source_key)
-            if v is None:
+            raw = ev.get_content(self.source_key)
+            if raw is None:
                 continue
-            parsed = self._parse_line(v.to_bytes())
+            parsed = self._parse_line(raw.to_bytes())
             if parsed is None:
-                if self.keep_source_on_fail:
-                    ev.set_content(self.renamed_source_key.encode(), v)
-                    ev.del_content(self.source_key)
+                # shared reference ordering: the source is consumed either
+                # way; keep_fail re-adds it under the renamed key
+                finish_row_keep(ev, raw, False, self.source_key, False,
+                                self.keep_source_on_fail, False, renamed)
                 continue
             ts, fields = parsed
             ev.timestamp = ts
+            overwritten = False
             for k, val in fields:
-                ev.set_content(sb.copy_string(k), sb.copy_string(val))
-            ev.del_content(self.source_key)
+                kb = k if isinstance(k, bytes) else k.encode()
+                ev.set_content(sb.copy_string(kb), sb.copy_string(val))
+                if kb == self.source_key:
+                    overwritten = True
+            finish_row_keep(ev, raw, True, self.source_key, overwritten,
+                            self.keep_source_on_fail, False, renamed)
