@@ -98,16 +98,19 @@ class ProcessorGrok(Processor):
                 cols.content_consumed = True
             return
 
-        # row path
+        # row path — shared reference keep/discard ordering
+        from .common import finish_row_keep
         sb = group.source_buffer
+        renamed = self.renamed_source_key.encode()
         for i, ev in enumerate(group.events):
             if not hasattr(ev, "get_content"):
                 continue
-            v = ev.get_content(self.source_key)
-            if v is None:
+            raw = ev.get_content(self.source_key)
+            if raw is None:
                 continue
-            data = v.to_bytes()
+            data = raw.to_bytes()
             hit = False
+            overwritten = False
             for engine, keys in self._engines:
                 m = engine._re.fullmatch(data)
                 if m is None:
@@ -115,11 +118,10 @@ class ProcessorGrok(Processor):
                 hit = True
                 for g, key in enumerate(keys):
                     if key and m.group(g + 1) is not None:
-                        ev.set_content(key.encode(),
-                                       sb.copy_string(m.group(g + 1)))
-                ev.del_content(self.source_key)
+                        kb = key.encode()
+                        ev.set_content(kb, sb.copy_string(m.group(g + 1)))
+                        if kb == self.source_key:
+                            overwritten = True
                 break
-            if not hit and self.keep_source_on_fail:
-                if self.renamed_source_key.encode() != self.source_key:
-                    ev.set_content(self.renamed_source_key.encode(), v)
-                    ev.del_content(self.source_key)
+            finish_row_keep(ev, raw, hit, self.source_key, overwritten,
+                            self.keep_source_on_fail, False, renamed)
