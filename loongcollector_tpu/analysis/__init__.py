@@ -1,0 +1,43 @@
+"""loonglint — AST-based invariant checker for the loongcollector-tpu tree.
+
+The round-5 advisor found a liveness-killing budget leak by hand
+(ops/regex/engine.py: PendingParse.dispatch abandoned submitted
+DeviceFutures on its error path).  That class of bug — an async device
+data plane whose host-side orchestration silently drops budget, blocks
+under a lock, or breaks JAX tracing purity — recurs in any threaded
+accelerator pipeline and is exactly what a paper-shaped "fast as the
+hardware allows" system cannot tolerate.  loonglint machine-checks those
+invariants on every tier-1 run.
+
+Checkers (see docs/static_analysis.md):
+
+  acquire-release       budget/slot/token acquisition must release on all
+                        paths (try/finally, except-drain, or with)
+  blocking-under-lock   no blocking call while a threading lock is held,
+                        plus a whole-program lock-ordering cycle report
+  tracing-hygiene       no host time/random/print/implicit-sync inside
+                        @jax.jit / Pallas kernel bodies under ops/
+  registry-consistency  _native/_tpu processor tier wiring is coherent and
+                        every alarm site uses a type from monitor/alarms.py
+
+Suppression: append ``# loonglint: disable=<check>[,<check>]`` to the
+flagged line.  Pre-existing debt goes in the budgeted allowlist file
+(analysis/allowlist.txt, <= 10 entries — enforced by tier-1).
+
+Run: ``python -m loongcollector_tpu.analysis [--json]``.
+"""
+
+from __future__ import annotations
+
+from .core import (AnalysisResult, Checker, Finding, ModuleInfo, Program,
+                   load_allowlist, run_analysis)
+
+__all__ = [
+    "AnalysisResult",
+    "Checker",
+    "Finding",
+    "ModuleInfo",
+    "Program",
+    "load_allowlist",
+    "run_analysis",
+]

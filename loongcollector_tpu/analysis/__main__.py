@@ -1,0 +1,107 @@
+"""loonglint CLI.
+
+    python -m loongcollector_tpu.analysis              # human output
+    python -m loongcollector_tpu.analysis --json       # machine output
+    python -m loongcollector_tpu.analysis --list-checks
+    python -m loongcollector_tpu.analysis --root path/ --allowlist file
+
+Exit status: 0 clean (allowlisted/suppressed debt is reported but does not
+fail), 1 violations or parse errors, 2 usage errors.  Tier-1 runs this via
+tests/test_static_analysis.py, so a violation fails the suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .checkers import all_checkers
+from .core import (ALLOWLIST_BUDGET, default_allowlist_path, default_root,
+                   load_allowlist, run_analysis)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m loongcollector_tpu.analysis",
+        description="loonglint: AST invariant checker for loongcollector-tpu")
+    parser.add_argument("--root", default=None,
+                        help="directory or file to scan (default: the "
+                             "loongcollector_tpu package)")
+    parser.add_argument("--allowlist", default=None,
+                        help="allowlist file (default: analysis/allowlist.txt)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit one JSON document instead of text")
+    parser.add_argument("--checks", default=None,
+                        help="comma-separated subset of checks to run")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="list available checks and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for checker in all_checkers():
+            print(f"{checker.name:24s} {checker.description}")
+        return 0
+
+    checkers = all_checkers()
+    if args.checks:
+        wanted = {c.strip() for c in args.checks.split(",") if c.strip()}
+        known = set().union(*(c.produces for c in checkers))
+        unknown = wanted - known
+        if unknown:
+            print(f"unknown checks: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        # match on produces, not name: `--checks lock-ordering` must run
+        # the blocking-under-lock checker that emits those findings
+        checkers = [c for c in checkers if wanted & c.produces]
+
+    allowlist_path = args.allowlist if args.allowlist is not None \
+        else default_allowlist_path()
+    entries = load_allowlist(allowlist_path)
+    result = run_analysis(root=args.root or default_root(),
+                          checkers=checkers,
+                          allowlist_path=allowlist_path)
+    if args.checks:
+        # a multi-check checker may emit sibling findings the user did
+        # not ask for — keep only the requested check names
+        result.findings = [f for f in result.findings if f.check in wanted]
+        result.suppressed = [f for f in result.suppressed
+                             if f.check in wanted]
+        result.allowlisted = [f for f in result.allowlisted
+                              if f.check in wanted]
+
+    over_budget = len(entries) > ALLOWLIST_BUDGET
+
+    if args.as_json:
+        doc = result.to_dict()
+        doc["allowlist_entries"] = len(entries)
+        doc["allowlist_budget"] = ALLOWLIST_BUDGET
+        doc["allowlist_over_budget"] = over_budget
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.format())
+        for err in result.parse_errors:
+            print(f"PARSE ERROR: {err}")
+        if result.allowlisted:
+            print(f"-- {len(result.allowlisted)} allowlisted finding(s) "
+                  f"(budget {len(entries)}/{ALLOWLIST_BUDGET} entries):")
+            for f in result.allowlisted:
+                print(f"   {f.format()}")
+        if result.suppressed:
+            print(f"-- {len(result.suppressed)} inline-suppressed "
+                  "finding(s)")
+        if over_budget:
+            print(f"ALLOWLIST OVER BUDGET: {len(entries)} entries > "
+                  f"{ALLOWLIST_BUDGET} allowed — pay down debt before "
+                  "adding more")
+        status = "clean" if result.ok and not over_budget else "FAILED"
+        print(f"loonglint: {result.files_scanned} files, "
+              f"{len(result.findings)} violation(s) — {status}")
+
+    return 0 if result.ok and not over_budget else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Checker registry.  Adding a checker = new module here + one entry in
+_CHECKER_CLASSES (docs/static_analysis.md#adding-a-new-checker)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Checker
+from .acquire_release import AcquireReleaseChecker
+from .blocking_locks import BlockingUnderLockChecker
+from .registry_consistency import RegistryConsistencyChecker
+from .tracing_hygiene import TracingHygieneChecker
+
+_CHECKER_CLASSES = [
+    AcquireReleaseChecker,
+    BlockingUnderLockChecker,
+    TracingHygieneChecker,
+    RegistryConsistencyChecker,
+]
+
+
+def all_checkers() -> List[Checker]:
+    return [cls() for cls in _CHECKER_CLASSES]
+
+
+def checker_names() -> List[str]:
+    return [cls.name for cls in _CHECKER_CLASSES]

@@ -1,0 +1,360 @@
+"""blocking-under-lock: no blocking call while a threading lock is held,
+plus a whole-program lock-ordering cycle report.
+
+A runner thread that sleeps, joins, materialises a DeviceFuture, or does
+socket I/O while holding a mutex serialises every other thread that needs
+that mutex behind device/network latency — the exact anti-pattern the
+device plane's "call on_wait OUTSIDE the lock" discipline exists to avoid.
+And two threads that take the same two locks in opposite orders deadlock;
+with runner/, pipeline/queue/ and the device plane all cross-calling each
+other, that ordering is a whole-program property no single diff shows.
+
+Lock identification (deliberately syntactic, so the checker needs no
+imports of the checked code):
+
+  * attributes assigned from threading.Lock()/RLock()/Condition() anywhere
+    in the module, plus
+  * names matching the lock naming convention (_lock, _mutex, _cond,
+    _freed, _not_empty, ...).
+
+Held regions: ``with <lock>:`` bodies and ``<lock>.acquire()`` ..
+``<lock>.release()`` spans within one statement list.
+
+Blocking calls flagged under a held lock: time.sleep, Future.result,
+Thread.join, blocking queue get/put, socket connect/accept/recv/sendall,
+subprocess run/call/check_output, and ``.wait()`` on anything OTHER than
+the held condition itself (cond.wait() releases the lock it guards — that
+is the one legal blocking wait).
+
+Lock ordering: edges A -> B whenever B is acquired while A is held, both
+lexically nested and one interprocedural hop (a call made under A to a
+method that acquires B, resolved by unique method name).  Cycles in that
+graph are reported on the finalize pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import (Checker, Finding, ModuleInfo, Program, attr_tail,
+                    call_name, iter_functions, receiver_repr)
+
+CHECK = "blocking-under-lock"
+CHECK_ORDER = "lock-ordering"
+
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|mutex|mtx|cond|condition|freed|cv|not_empty|not_full)$")
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+_BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.call",
+                    "subprocess.check_output", "subprocess.check_call",
+                    "select.select"}
+_BLOCKING_TAILS = {"result", "join", "accept", "connect", "recv",
+                   "recv_into", "sendall", "read_exact"}
+_QUEUE_TAILS = {"get", "put"}
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _tail_name(text: str) -> str:
+    return text.rsplit(".", 1)[-1]
+
+
+class _ModuleLocks:
+    """Lock attributes discovered in one module: exact names assigned from
+    threading ctors, merged with the naming convention."""
+
+    def __init__(self, tree: ast.AST):
+        self.assigned: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if call_name(node.value) in _LOCK_CTORS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute):
+                            self.assigned.add(tgt.attr)
+                        elif isinstance(tgt, ast.Name):
+                            self.assigned.add(tgt.id)
+
+    def is_lock_expr(self, node: ast.AST) -> bool:
+        text = _expr_text(node)
+        if not text or "(" in text:
+            return False
+        tail = _tail_name(text)
+        return tail in self.assigned or bool(_LOCK_NAME_RE.search(tail))
+
+
+def _blocking_queue_call(node: ast.Call) -> bool:
+    """Blocking-shaped queue call.  `x.get(key)` (a positional arg) is the
+    dict API, not queue.Queue — never flagged; `x.get()` / `x.put(item)`
+    without block=False/timeout are the blocking queue shapes."""
+    tail = attr_tail(node)
+    if tail == "get" and node.args:
+        return False
+    for kw in node.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+        if kw.arg == "timeout":
+            # bounded wait: the repo's convention treats short timeouts as
+            # polling; only unbounded blocking is flagged
+            return False
+    return True
+
+
+def _blocking_reason(node: ast.Call, held: List[str]) -> Optional[str]:
+    dotted = call_name(node)
+    tail = attr_tail(node)
+    recv = receiver_repr(node)
+    if dotted in _BLOCKING_DOTTED:
+        return f"{dotted}()"
+    if tail == "wait":
+        # cond.wait() on the held condition releases it — the legal shape
+        if recv in held:
+            return None
+        return f"{recv or '?'}.wait()"
+    if tail in _BLOCKING_TAILS:
+        if tail == "result" and not recv:
+            return None
+        return f"{recv or '?'}.{tail}()"
+    if tail in _QUEUE_TAILS:
+        rl = recv.lower()
+        if ("queue" in rl or rl.endswith("_q") or rl.split(".")[-1] == "q") \
+                and _blocking_queue_call(node):
+            return f"blocking {recv}.{tail}()"
+    return None
+
+
+class _FuncScan:
+    """One function's lock behaviour: findings + acquired-under-held edges
+    + calls made under each held lock (for the interprocedural hop)."""
+
+    def __init__(self, mod: ModuleInfo, locks: _ModuleLocks, qualname: str,
+                 func: ast.AST):
+        self.mod = mod
+        self.locks = locks
+        self.qualname = qualname
+        self.findings: List[Finding] = []
+        # (held_lock_text, acquired_lock_text, line)
+        self.edges: List[Tuple[str, str, int]] = []
+        # method names called while a lock is held: (held, callee, line)
+        self.calls_under: List[Tuple[str, str, int]] = []
+        self.acquires: Set[str] = set()
+        self._walk_body(list(getattr(func, "body", [])), [])
+
+    def _lock_of_with(self, item: ast.withitem) -> Optional[str]:
+        if self.locks.is_lock_expr(item.context_expr):
+            return _expr_text(item.context_expr)
+        return None
+
+    def _walk_body(self, body: List[ast.stmt], held: List[str]) -> None:
+        linear: List[str] = []   # locks taken via .acquire() in this block
+        for stmt in body:
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Call):
+                node = stmt.value
+                tail = attr_tail(node)
+                recv = receiver_repr(node)
+                if tail == "acquire" and recv and \
+                        self.locks.is_lock_expr(node.func.value):  # type: ignore[union-attr]
+                    self._note_acquire(recv, held + linear, stmt.lineno)
+                    linear.append(recv)
+                    continue
+                if tail == "release" and recv in linear:
+                    linear.remove(recv)
+                    continue
+            self._walk_stmt(stmt, held + linear)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            newly = []
+            for item in stmt.items:
+                lk = self._lock_of_with(item)
+                if lk is not None:
+                    self._note_acquire(lk, held, stmt.lineno)
+                    newly.append(lk)
+                else:
+                    self._scan_expr(item.context_expr, held)
+            self._walk_body(stmt.body, held + newly)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs execute later, not under this lock
+        # expression fields first (loop iterables, if tests, call exprs),
+        # then each nested statement list exactly once
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            items = value if isinstance(value, list) else [value]
+            for item in items:
+                if isinstance(item, ast.expr):
+                    self._scan_expr(item, held)
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list) and sub and \
+                    isinstance(sub[0], ast.stmt):
+                self._walk_body(sub, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_body(handler.body, held)
+
+    def _note_acquire(self, lock: str, held: List[str], line: int) -> None:
+        self.acquires.add(lock)
+        for h in held:
+            if _tail_name(h) != _tail_name(lock):
+                self.edges.append((h, lock, line))
+
+    def _scan_expr(self, expr: ast.AST, held: List[str]) -> None:
+        if not held:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _blocking_reason(node, held)
+            if reason is not None:
+                self.findings.append(Finding(
+                    CHECK, self.mod.relpath, node.lineno, node.col_offset,
+                    f"blocking call {reason} while holding {held[-1]}",
+                    symbol=self.qualname))
+            tail = attr_tail(node)
+            if tail and isinstance(node.func, ast.Attribute):
+                self.calls_under.append((held[-1], tail, node.lineno))
+
+
+class BlockingUnderLockChecker(Checker):
+    name = CHECK
+    description = ("no blocking calls while a threading lock is held; "
+                   "whole-program lock-ordering cycle detection")
+
+    @property
+    def produces(self) -> frozenset:
+        return frozenset((CHECK, CHECK_ORDER))
+
+    def __init__(self) -> None:
+        self._scans: List[Tuple[ModuleInfo, _FuncScan]] = []
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        locks = _ModuleLocks(mod.tree)
+        for qualname, func in iter_functions(mod.tree):
+            scan = _FuncScan(mod, locks, qualname, func)
+            self._scans.append((mod, scan))
+            yield from scan.findings
+
+    # -- lock-ordering graph -------------------------------------------------
+
+    def finalize(self, program: Program) -> Iterator[Finding]:
+        # canonical lock node: ClassOrModule.attr — approximate lock
+        # identity by final attribute name qualified by the owning class
+        def node_id(mod: ModuleInfo, qualname: str, lock_text: str) -> str:
+            owner = qualname.rsplit(".", 2)[0] if "." in qualname else \
+                mod.relpath
+            if lock_text.startswith("self."):
+                return f"{owner}.{_tail_name(lock_text)}"
+            return f"{mod.relpath}:{_tail_name(lock_text)}"
+
+        # method name -> lock node ids it acquires, for the 1-hop
+        # interprocedural edges.  Only UNIQUELY-named lock-taking methods
+        # resolve: a name like `get` or `close` defined on many classes
+        # would wire unrelated locks together and fabricate cycles.
+        name_count: Dict[str, int] = {}
+        for _, scan in self._scans:
+            mname = scan.qualname.rsplit(".", 1)[-1]
+            name_count[mname] = name_count.get(mname, 0) + 1
+        method_acquires: Dict[str, Set[str]] = {}
+        for mod, scan in self._scans:
+            mname = scan.qualname.rsplit(".", 1)[-1]
+            if name_count.get(mname, 0) != 1 or not scan.acquires:
+                continue
+            for lk in scan.acquires:
+                method_acquires.setdefault(mname, set()).add(
+                    node_id(mod, scan.qualname, lk))
+
+        edges: Dict[str, Set[str]] = {}
+        where: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        def add_edge(a: str, b: str, mod: ModuleInfo, line: int) -> None:
+            if a == b:
+                return
+            edges.setdefault(a, set()).add(b)
+            where.setdefault((a, b), (mod.relpath, line))
+
+        for mod, scan in self._scans:
+            for held, acquired, line in scan.edges:
+                add_edge(node_id(mod, scan.qualname, held),
+                         node_id(mod, scan.qualname, acquired), mod, line)
+            for held, callee, line in scan.calls_under:
+                for target in method_acquires.get(callee, ()):
+                    add_edge(node_id(mod, scan.qualname, held), target,
+                             mod, line)
+
+        yield from self._report_cycles(edges, where)
+
+    def _report_cycles(self, edges: Dict[str, Set[str]],
+                       where: Dict[Tuple[str, str], Tuple[str, int]]
+                       ) -> Iterator[Finding]:
+        # iterative Tarjan SCC; every SCC with >1 node is a potential
+        # deadlock cycle
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        onstack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(root: str) -> None:
+            work = [(root, iter(sorted(edges.get(root, ()))))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            onstack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        onstack.add(w)
+                        work.append((w, iter(sorted(edges.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in onstack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(edges):
+            if v not in index:
+                strongconnect(v)
+
+        for scc in sccs:
+            members = sorted(scc)
+            a, b = members[0], members[1]
+            relpath, line = where.get(
+                (a, b), where.get((b, a), ("<program>", 1)))
+            yield Finding(
+                CHECK_ORDER, relpath, line, 0,
+                "potential lock-order cycle: " + " <-> ".join(members),
+                symbol="lock-graph")

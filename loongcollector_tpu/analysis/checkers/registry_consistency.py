@@ -1,0 +1,149 @@
+"""registry-consistency: plugin tier wiring and alarm taxonomy coherence.
+
+Two whole-program properties the type system cannot see:
+
+1. Processor tier wiring.  The reference keeps `_native` names for drop-in
+   config compatibility and this repo adds `_tpu` aliases for the
+   device-tier processors (processor/__init__.py docstring).  A `_tpu`
+   registration without its `_native` sibling breaks config portability;
+   siblings bound to DIFFERENT classes silently fork behaviour between
+   tiers.
+
+2. Alarm taxonomy.  Every `AlarmType.X` reference and every
+   `send_alarm(...)` first argument must resolve to a member defined in
+   monitor/alarms.py — a typo'd alarm type raises AttributeError on the
+   ERROR path, exactly where it is never exercised by tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import (Checker, Finding, ModuleInfo, Program, attr_tail,
+                    iter_functions)
+
+CHECK = "registry-consistency"
+
+_TIER_SUFFIXES = ("_native", "_tpu")
+
+
+def _class_arg_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Lambda):
+        return "<lambda>"
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return "<expr>"
+
+
+class RegistryConsistencyChecker(Checker):
+    name = CHECK
+    description = ("_native/_tpu processor registrations stay paired and "
+                   "bound to one implementation; alarm sites use "
+                   "AlarmType members defined in monitor/alarms.py")
+
+    def finalize(self, program: Program) -> Iterator[Finding]:
+        registrations: Dict[str, Tuple[str, str, int]] = {}
+        alarm_members: Set[str] = set()
+        alarm_defs_found = False
+
+        for mod in program.modules:
+            if mod.relpath.endswith("monitor/alarms.py"):
+                alarm_members = self._alarm_members(mod)
+                alarm_defs_found = True
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        attr_tail(node) == "register_processor" and \
+                        len(node.args) >= 2 and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    registrations[node.args[0].value] = (
+                        _class_arg_name(node.args[1]), mod.relpath,
+                        node.lineno)
+
+        yield from self._check_tiers(registrations)
+        if alarm_defs_found:
+            yield from self._check_alarm_sites(program, alarm_members)
+
+    # -- tier wiring ---------------------------------------------------------
+
+    def _check_tiers(self, regs: Dict[str, Tuple[str, str, int]]
+                     ) -> Iterator[Finding]:
+        for name, (cls, relpath, line) in sorted(regs.items()):
+            for suffix in _TIER_SUFFIXES:
+                if not name.endswith(suffix):
+                    continue
+                base = name[: -len(suffix)]
+                other = base + ("_tpu" if suffix == "_native" else "_native")
+                if other not in regs:
+                    # _native without _tpu is the normal CPU-only case;
+                    # _tpu without _native breaks config compatibility
+                    if suffix == "_tpu":
+                        yield Finding(
+                            CHECK, relpath, line, 0,
+                            f"processor `{name}` registered with no "
+                            f"`{other}` sibling: device-tier configs "
+                            "cannot fall back by rename",
+                            symbol=name)
+                    continue
+                if suffix == "_tpu" and regs[other][0] != cls:
+                    yield Finding(
+                        CHECK, relpath, line, 0,
+                        f"tier fork: `{name}` -> {cls} but `{other}` -> "
+                        f"{regs[other][0]}; siblings must share one "
+                        "implementation",
+                        symbol=name)
+
+    # -- alarm taxonomy ------------------------------------------------------
+
+    @staticmethod
+    def _alarm_members(mod: ModuleInfo) -> Set[str]:
+        members: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "AlarmType":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Assign):
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                members.add(tgt.id)
+        return members
+
+    def _check_alarm_sites(self, program: Program, members: Set[str]
+                           ) -> Iterator[Finding]:
+        for mod in program.modules:
+            if mod.relpath.endswith("monitor/alarms.py"):
+                continue
+            func_of: List[Tuple[str, ast.AST]] = list(
+                iter_functions(mod.tree))
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "AlarmType" and \
+                        node.attr not in members:
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        f"AlarmType.{node.attr} is not defined in "
+                        "monitor/alarms.py",
+                        symbol=self._enclosing(func_of, node))
+                if isinstance(node, ast.Call) and \
+                        attr_tail(node) == "send_alarm" and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        "send_alarm() called with a raw literal instead "
+                        "of an AlarmType member",
+                        symbol=self._enclosing(func_of, node))
+
+    @staticmethod
+    def _enclosing(funcs: List[Tuple[str, ast.AST]], node: ast.AST) -> str:
+        best = ""
+        for qn, fn in funcs:
+            if fn.lineno <= node.lineno <= \
+                    getattr(fn, "end_lineno", fn.lineno):
+                best = qn
+        return best

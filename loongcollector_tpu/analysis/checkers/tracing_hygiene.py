@@ -1,0 +1,190 @@
+"""tracing-hygiene: no host-side effects inside traced JAX code under ops/.
+
+A ``@jax.jit`` or Pallas kernel body executes at TRACE time as ordinary
+Python; anything it does outside the jnp value-flow is silently frozen
+into the compiled program (time.time(), random, mutable-global reads) or
+forces a device sync / trace error at the worst moment (float(x) on a
+traced value, np.asarray on device buffers, host print).  The kernels
+under ops/ are the hot path of the whole paper design — processor_parse_*
+throughput collapses if a stray host hook rides along in a kernel.
+
+Traced scopes recognised (syntactic, per module):
+
+  * ``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``
+    decorated functions;
+  * functions passed to ``pl.pallas_call(...)`` / ``pallas_call(...)``;
+  * ``jax.jit(f)`` call sites — for a local ``f``, the def is marked; for
+    ``jax.jit(make_fn(...))`` factory shapes, every def nested inside the
+    local factory is marked (the returned closure is what gets traced).
+
+Only files under ops/ are scanned: that is where kernel code lives, and
+host-side orchestration (runner/, flusher/) legitimately uses time and
+randomness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from ..core import (Checker, Finding, ModuleInfo, attr_tail, call_name,
+                    iter_functions)
+
+CHECK = "tracing-hygiene"
+
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.sleep", "time.process_time"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_CAST_NAMES = {"float", "int", "bool"}
+
+
+def _decorator_is_jit(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name in ("functools.partial", "partial"):
+            return bool(dec.args) and _decorator_is_jit(dec.args[0])
+        return name in ("jax.jit", "jit", "pl.pallas_call", "pallas_call")
+    try:
+        name = ast.unparse(dec)
+    except Exception:  # pragma: no cover
+        return False
+    return name in ("jax.jit", "jit")
+
+
+class TracingHygieneChecker(Checker):
+    name = CHECK
+    description = ("no time/random/print/mutable-global/implicit-sync "
+                   "inside @jax.jit or Pallas kernel bodies under ops/")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if "/ops/" not in "/" + mod.relpath:
+            return
+        funcs = dict(iter_functions(mod.tree))
+        by_name: Dict[str, List[ast.AST]] = {}
+        for qn, fn in funcs.items():
+            by_name.setdefault(qn.rsplit(".", 1)[-1], []).append(fn)
+
+        traced: Set[ast.AST] = set()
+        for qn, fn in funcs.items():
+            if any(_decorator_is_jit(d) for d in fn.decorator_list):
+                traced.add(fn)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in ("pl.pallas_call", "pallas_call") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    traced.update(by_name.get(arg.id, ()))
+            elif name in ("jax.jit", "jit") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    traced.update(by_name.get(arg.id, ()))
+                elif isinstance(arg, ast.Call) and \
+                        isinstance(arg.func, ast.Name):
+                    # jax.jit(make_fn(...)): the closure returned by the
+                    # local factory is traced
+                    for factory in by_name.get(arg.func.id, ()):
+                        for _, inner in iter_functions(factory):
+                            traced.add(inner)
+
+        mutable_globals = self._mutable_globals(mod.tree)
+
+        seen: Set[ast.AST] = set()
+        for qn, fn in funcs.items():
+            if fn not in traced or fn in seen:
+                continue
+            # nested defs inside a traced body trace with it — mark them
+            # seen so they are not reported twice
+            for _, inner in iter_functions(fn):
+                seen.add(inner)
+            yield from self._scan_traced(mod, qn, fn, mutable_globals)
+
+    @staticmethod
+    def _mutable_globals(tree: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in getattr(tree, "body", []):
+            if isinstance(stmt, ast.Assign):
+                if isinstance(stmt.value, (ast.List, ast.Dict, ast.Set,
+                                           ast.ListComp, ast.DictComp,
+                                           ast.SetComp)):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.add(tgt.id)
+        return out
+
+    def _scan_traced(self, mod: ModuleInfo, qualname: str, fn: ast.AST,
+                     mutable_globals: Set[str]) -> Iterator[Finding]:
+        params: Set[str] = set()
+        local_names: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = sub.args
+                for group in (a.posonlyargs, a.args, a.kwonlyargs):
+                    for p in group:
+                        local_names.add(p.arg)
+                        if sub is fn:
+                            params.add(p.arg)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                          ast.Store):
+                local_names.add(sub.id)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    "`global` inside a traced function: writes do not "
+                    "re-trace and reads are frozen at trace time",
+                    symbol=qualname)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in mutable_globals and \
+                    node.id not in local_names:
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"read of mutable module global `{node.id}` inside a "
+                    "traced function is frozen at trace time",
+                    symbol=qualname)
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = attr_tail(node)
+            if name in _TIME_CALLS:
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"host clock `{name}()` inside a traced function is "
+                    "evaluated once at trace time, not per call",
+                    symbol=qualname)
+            elif name == "print":
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    "host print() inside a traced function (use "
+                    "jax.debug.print for traced values)",
+                    symbol=qualname)
+            elif name.startswith(("random.", "np.random.",
+                                  "numpy.random.")):
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"host RNG `{name}()` inside a traced function is "
+                    "frozen at trace time (use jax.random with a key)",
+                    symbol=qualname)
+            elif name in _SYNC_CALLS:
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"`{name}()` inside a traced function forces a host "
+                    "sync / constant-folds device values",
+                    symbol=qualname)
+            elif tail == "block_until_ready":
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    "block_until_ready() inside a traced function",
+                    symbol=qualname)
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in _CAST_NAMES and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in params:
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    f"`{node.func.id}({node.args[0].id})` on a traced "
+                    "argument forces a device sync (trace error under "
+                    "jit)", symbol=qualname)

@@ -1,0 +1,353 @@
+"""loonglint framework: module loading, suppressions, allowlist, runner.
+
+Design: every checker sees each parsed module (`check_module`) and, after
+the whole tree is parsed, the assembled `Program` (`finalize`) for
+whole-program passes (lock-ordering graph, registry wiring).  Findings are
+filtered through two suppression layers before they fail the run:
+
+  1. inline ``# loonglint: disable=<check>`` comments on the flagged line;
+  2. the budgeted allowlist file (one ``relpath::check[::substr]`` entry
+     per line) for pre-existing debt that is tracked, not hidden.
+
+The allowlist is deliberately small: tier-1 asserts it stays <= 10 entries
+(ALLOWLIST_BUDGET), so debt can only be parked, never accumulated.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+ALLOWLIST_BUDGET = 10
+
+_SUPPRESS_RE = re.compile(r"#\s*loonglint:\s*disable=([A-Za-z0-9_,-]+)")
+
+# directories never scanned inside the package tree
+_SKIP_DIRS = {"__pycache__", "testdata"}
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("check", "path", "line", "col", "message", "symbol")
+
+    def __init__(self, check: str, path: str, line: int, col: int,
+                 message: str, symbol: str = ""):
+        self.check = check
+        self.path = path          # repo-relative, forward slashes
+        self.line = line
+        self.col = col
+        self.message = message
+        self.symbol = symbol      # enclosing function/class, for allowlist
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message}
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.check}:"
+                f" {self.message}{sym}")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Finding {self.format()}>"
+
+
+class ModuleInfo:
+    """A parsed source module plus the bits ast drops (comment lines)."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of check names disabled on that line
+        self.suppressions: Dict[int, set] = {}
+        self._standalone: set = set()   # comment-only suppression lines
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.suppressions[i] = {
+                    c.strip() for c in m.group(1).split(",") if c.strip()}
+                if text.lstrip().startswith("#"):
+                    self._standalone.add(i)
+
+    def suppressed(self, line: int, check: str) -> bool:
+        """A trailing disable comment suppresses its own line; a
+        comment-ONLY disable line suppresses the line below it — standard
+        lint idiom, and the only option when the flagged expression spans
+        lines."""
+        banned = self.suppressions.get(line)
+        if banned and (check in banned or "all" in banned):
+            return True
+        if line - 1 in self._standalone:
+            banned = self.suppressions.get(line - 1)
+            if banned and (check in banned or "all" in banned):
+                return True
+        return False
+
+
+class Program:
+    """The whole parsed tree, handed to checkers' finalize pass."""
+
+    def __init__(self, root: str, modules: Sequence[ModuleInfo]):
+        self.root = root
+        self.modules = list(modules)
+        self.by_relpath = {m.relpath: m for m in self.modules}
+
+
+class Checker:
+    """Base class: subclasses set `name`/`description` and override one or
+    both passes.  Checkers must only *report* — never mutate the tree.
+    A checker that emits findings under more than one check name lists
+    them all in `produces` (used by the CLI's --checks filter)."""
+
+    name = "base"
+    description = ""
+
+    @property
+    def produces(self) -> frozenset:
+        return frozenset((self.name,))
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, program: Program) -> Iterator[Finding]:
+        return iter(())
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several checkers
+
+
+def iter_functions(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield (qualname, FunctionDef/AsyncFunctionDef) for every function,
+    with class nesting reflected in the qualname."""
+
+    def walk(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                yield qn, child
+                yield from walk(child, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best effort: `a.b.c(...)` -> 'a.b.c',
+    `f(...)` -> 'f'.  Unresolvable shapes (subscripts, calls) yield ''. """
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("?")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def attr_tail(node: ast.Call) -> str:
+    """Final attribute of a method call: `x.y.submit(...)` -> 'submit'."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def receiver_repr(node: ast.Call) -> str:
+    """Textual receiver of a method call: `self._plane.submit()` ->
+    'self._plane'."""
+    if not isinstance(node.func, ast.Attribute):
+        return ""
+    try:
+        return ast.unparse(node.func.value)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ""
+
+
+class ParentMap:
+    """child -> parent links for upward walks (ast has none built in)."""
+
+    def __init__(self, tree: ast.AST):
+        self._parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(cur)
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+
+
+def load_allowlist(path: str) -> List[Tuple[str, str, str]]:
+    """Parse the allowlist file: one ``relpath::check[::substr]`` entry per
+    non-comment line.  Returns [(relpath, check, substr)]."""
+    entries: List[Tuple[str, str, str]] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("::")
+            if len(parts) < 2:
+                raise ValueError(
+                    f"{path}: malformed allowlist entry {line!r} "
+                    "(want relpath::check[::substr])")
+            relpath, check = parts[0], parts[1]
+            substr = parts[2] if len(parts) > 2 else ""
+            entries.append((relpath, check, substr))
+    return entries
+
+
+def _allowed(finding: Finding,
+             allowlist: Sequence[Tuple[str, str, str]]) -> bool:
+    for relpath, check, substr in allowlist:
+        if finding.check != check and check != "all":
+            continue
+        # path-component boundary: `a.py` must not match `data.py`
+        if finding.path != relpath \
+                and not finding.path.endswith("/" + relpath):
+            continue
+        if substr and substr not in finding.message \
+                and substr != finding.symbol:
+            continue
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+class AnalysisResult:
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []     # violations that fail the run
+        self.suppressed: List[Finding] = []   # inline-disabled
+        self.allowlisted: List[Finding] = []  # parked debt
+        self.parse_errors: List[str] = []
+        self.files_scanned = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "allowlisted": [f.to_dict() for f in self.allowlisted],
+            "parse_errors": self.parse_errors,
+        }
+
+
+def default_root() -> str:
+    """The package tree itself — loonglint ships inside what it checks."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "allowlist.txt")
+
+
+def collect_modules(root: str,
+                    errors: Optional[List[str]] = None) -> List[ModuleInfo]:
+    mods: List[ModuleInfo] = []
+    root = os.path.abspath(root)
+    base = os.path.dirname(root)
+    if os.path.isfile(root):
+        paths: Iterable[str] = [root]
+        # climb the package spine so a single-file scan keeps its
+        # package-relative path — path-scoped checks (tracing-hygiene's
+        # ops/ scope, monitor/alarms.py detection, allowlist matching)
+        # must behave identically to a tree scan
+        base = os.path.dirname(root)
+        while os.path.exists(os.path.join(base, "__init__.py")):
+            base = os.path.dirname(base)
+    else:
+        paths = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(dirpath, fn))  # type: ignore[attr-defined]
+    for path in paths:
+        relpath = os.path.relpath(path, base)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            mods.append(ModuleInfo(path, relpath, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            if errors is not None:
+                errors.append(f"{relpath}: {e}")
+    return mods
+
+
+def run_analysis(root: Optional[str] = None,
+                 checkers: Optional[Sequence[Checker]] = None,
+                 allowlist_path: Optional[str] = None) -> AnalysisResult:
+    """Scan `root` (default: the loongcollector_tpu package) with all
+    registered checkers, returning the filtered result."""
+    from .checkers import all_checkers
+    root = root or default_root()
+    if checkers is None:
+        checkers = all_checkers()
+    allowlist = load_allowlist(
+        allowlist_path if allowlist_path is not None
+        else default_allowlist_path())
+
+    result = AnalysisResult()
+    modules = collect_modules(root, errors=result.parse_errors)
+    result.files_scanned = len(modules)
+    program = Program(root, modules)
+
+    raw: List[Tuple[Finding, ModuleInfo]] = []
+    for checker in checkers:
+        for mod in modules:
+            for finding in checker.check_module(mod):
+                raw.append((finding, mod))
+        for finding in checker.finalize(program):
+            raw.append((finding, program.by_relpath.get(finding.path)))
+
+    seen = set()
+    for finding, mod in raw:
+        key = (finding.check, finding.path, finding.line, finding.col,
+               finding.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        if mod is not None and mod.suppressed(finding.line, finding.check):
+            result.suppressed.append(finding)
+        elif _allowed(finding, allowlist):
+            result.allowlisted.append(finding)
+        else:
+            result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return result

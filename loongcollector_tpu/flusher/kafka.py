@@ -72,7 +72,8 @@ class FlusherKafka(Flusher):
             self.brokers,
             acks=int(config.get("RequiredAcks", -1)),
             timeout_ms=int(config.get("TimeoutMs", 10000)),
-            tls=tls, sasl=sasl)
+            tls=tls, sasl=sasl,
+            max_in_flight=int(config.get("MaxInFlight", 5)))
         strategy = FlushStrategy(
             min_cnt=int(config.get("MinCnt", 512)),
             min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),
@@ -141,6 +142,14 @@ class FlusherKafka(Flusher):
             try:
                 self.producer.send(topic, records)
             except KafkaError as e:
+                # partial-ack aware retry: re-send ONLY what the broker
+                # did not acknowledge (KafkaProduceError.unacked); acked
+                # batches must not be duplicated by the retry
+                failed = getattr(e, "unacked", None)
+                if failed is not None:
+                    records = failed
+                if not records:
+                    continue
                 if attempt + 1 >= self.max_retries:
                     log.error("kafka produce to %s failed after %d tries, "
                               "dropping %d records: %s",

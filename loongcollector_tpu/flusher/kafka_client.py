@@ -226,6 +226,29 @@ class KafkaError(Exception):
     pass
 
 
+class KafkaPipelineError(KafkaError):
+    """A pipelined window failed partway: `responses` holds the replies
+    that WERE read (FIFO order, so responses[i] answers request i).  The
+    caller uses them to ack the delivered prefix instead of retrying the
+    whole window."""
+
+    def __init__(self, message: str, responses: List[bytes]):
+        super().__init__(message)
+        self.responses = responses
+
+
+class KafkaProduceError(KafkaError):
+    """Produce failed for part of a send: `unacked` holds exactly the
+    (key, value) records the broker did not acknowledge — the retry set.
+    Records absent from `unacked` were acked and must NOT be re-sent
+    (at-least-once without gratuitous duplication)."""
+
+    def __init__(self, message: str,
+                 unacked: List[Tuple[Optional[bytes], bytes]]):
+        super().__init__(message)
+        self.unacked = unacked
+
+
 def _scram_escape(name: str) -> str:
     """RFC 5802 saslname escaping: ',' and '=' are reserved."""
     return name.replace("=", "=3D").replace(",", "=2C")
@@ -386,7 +409,12 @@ class KafkaClient:
                  ) -> Optional[bytes]:
         # connect FIRST: the TLS/SASL handshake inside _connect consumes
         # correlation ids of its own, so ours is allocated after it
-        sock = self._connect(addr)
+        try:
+            sock = self._connect(addr)
+        except OSError as e:
+            # keep the KafkaError contract: a refused/reset connect must
+            # not escape raw and kill the caller's sender thread
+            raise KafkaError(f"broker {addr}: {e}") from e
         self._corr += 1
         my_corr = self._corr
         header = (struct.pack(">hhi", api_key, api_version, my_corr)
@@ -419,7 +447,14 @@ class KafkaClient:
         matching preserves ordering; one socket error drops the connection
         and fails the whole window (the caller's retry re-sends it — the
         same at-least-once contract as the serial path)."""
-        sock = self._connect(addr)
+        # a connect/handshake failure means NOTHING in this batch was
+        # delivered: surface it as a pipeline error with zero responses so
+        # produce() books every payload as unacked instead of aborting
+        try:
+            sock = self._connect(addr)
+        except (OSError, KafkaError) as e:
+            self._drop(addr)
+            raise KafkaPipelineError(f"broker {addr}: {e}", []) from e
         out: List[bytes] = []
         try:
             for w in range(0, len(reqs), max_in_flight):
@@ -447,9 +482,11 @@ class KafkaClient:
                     out.append(resp[4:])
         except (OSError, KafkaError) as e:
             self._drop(addr)
-            if isinstance(e, KafkaError):
-                raise
-            raise KafkaError(f"broker {addr}: {e}") from e
+            msg = str(e) if isinstance(e, KafkaError) else \
+                f"broker {addr}: {e}"
+            # responses already read answer a delivered prefix — hand
+            # them back so the caller retries only the unacked tail
+            raise KafkaPipelineError(msg, out) from e
         return out
 
     @staticmethod
@@ -552,26 +589,55 @@ class KafkaProducer(KafkaClient):
         # group per leader and PIPELINE: per-partition batches ride one
         # connection in max_in_flight windows instead of one blocking RTT
         # each; per-partition order is preserved (single connection, FIFO
-        # responses)
-        by_leader: Dict[str, List[bytes]] = {}
+        # responses).  Each payload keeps its backing records so a partial
+        # window failure can report exactly the unacked set.
+        by_leader: Dict[str, List[Tuple[bytes, List[Tuple[Optional[bytes],
+                                                          bytes]]]]] = {}
         for partition, recs in by_partition.items():
             leader = leaders.get(partition)
             if leader is None:
                 raise KafkaError(f"no leader for {topic}/{partition}")
             by_leader.setdefault(leader, []).append(
-                self._produce_payload(topic, partition, recs))
-        for leader, payloads in by_leader.items():
-            reqs = [(API_PRODUCE, 3, p) for p in payloads]
+                (self._produce_payload(topic, partition, recs), recs))
+        unacked: List[Tuple[Optional[bytes], bytes]] = []
+        first_err: Optional[KafkaError] = None
+        for leader, entries in by_leader.items():
+            reqs = [(API_PRODUCE, 3, payload) for payload, _ in entries]
             try:
                 resps = self._pipeline_requests(
                     leader, reqs, expect_response=(self.acks != 0),
                     max_in_flight=self.max_in_flight)
-            except KafkaError:
+                err: Optional[KafkaError] = None
+            except KafkaPipelineError as e:
+                resps, err = e.responses, e
+            if err is not None:
                 with self._lock:
                     self._topic_meta.pop(topic, None)  # stale leader
-                raise
-            for resp in resps:
-                self._parse_produce_response(resp, topic)
+                first_err = first_err or err
+                if self.acks == 0:
+                    # fire-and-forget: no acks exist, the whole leader
+                    # group is in doubt — classic at-least-once retry
+                    for _, recs in entries:
+                        unacked.extend(recs)
+                    continue
+            # responses arrive FIFO: resps[i] answers entries[i]; payloads
+            # past the received prefix were never acked
+            for i, (_payload, recs) in enumerate(entries):
+                if err is None and self.acks == 0:
+                    continue                      # acks=0 clean send
+                if i < len(resps):
+                    try:
+                        self._parse_produce_response(resps[i], topic)
+                    except KafkaError as pe:
+                        first_err = first_err or pe
+                        unacked.extend(recs)
+                else:
+                    unacked.extend(recs)
+        if first_err is not None:
+            raise KafkaProduceError(
+                f"produce to {topic} partially failed "
+                f"({len(unacked)} records unacked): {first_err}",
+                unacked) from first_err
 
     def _produce_payload(self, topic: str, partition: int, records) -> bytes:
         batch = build_record_batch(records)

@@ -72,7 +72,7 @@ class DeviceFuture:
     """
 
     __slots__ = ("_plane", "_nbytes", "_outputs", "_error", "_done",
-                 "_materialised")
+                 "_materialised", "__weakref__")
 
     def __init__(self, plane: "DevicePlane", nbytes: int,
                  outputs: Optional[Sequence] = None,
@@ -101,6 +101,35 @@ class DeviceFuture:
             self._done = True
             self._outputs = None
             self._plane._release(self._nbytes)
+
+    def release(self) -> None:
+        """Force-release without materialising: error-path cleanup for a
+        dispatch loop that cannot (or must not) consume this future.  The
+        device buffers are dropped; the budget returns immediately."""
+        if self._done:
+            return
+        self._done = True
+        self._outputs = None
+        if self._error is None:
+            self._error = RuntimeError(
+                "DeviceFuture released without materialisation")
+        self._plane._release(self._nbytes)
+
+    def __del__(self):
+        # Last-resort budget backstop: an abandoned in-flight future must
+        # never strand plane budget (the round-5 PendingParse.dispatch
+        # leak).  Reaching this path is a bug upstream — warn loudly.
+        try:
+            if not self._done:
+                self._done = True
+                self._outputs = None
+                self._plane._release(self._nbytes)
+                log.warning(
+                    "DeviceFuture dropped without result()/release(); "
+                    "budget (%d bytes) reclaimed by finaliser — fix the "
+                    "owning dispatch path", self._nbytes)
+        except Exception:  # noqa: BLE001 — never raise from a finaliser
+            pass
 
 
 class DevicePlane:

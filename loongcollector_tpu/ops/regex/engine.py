@@ -515,19 +515,28 @@ class PendingParse:
         plane = DevicePlane.instance()
         self.kern = self.engine._device_kernel()
         max_bucket = LENGTH_BUCKETS[-1]
-        for chunk in _chunks(device_idx, MAX_BATCH):
-            d_off = self.offsets[chunk]
-            d_len = self.lengths[chunk]
-            L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
-                or max_bucket
-            batch = pack_rows(self.arena, d_off, d_len, L)
-            fut = plane.submit(self.kern, (batch.rows, batch.lengths),
-                               batch.rows.nbytes,
-                               on_wait=self._drain_if_pending)
-            # each chunk records the kernel it was SUBMITTED on: after a
-            # fault pins the engine to the XLA path, errors from earlier
-            # in-flight chunks must still take the fallback, not re-raise
-            self._chunks_pending.append((chunk, batch, fut, self.kern))
+        try:
+            for chunk in _chunks(device_idx, MAX_BATCH):
+                d_off = self.offsets[chunk]
+                d_len = self.lengths[chunk]
+                L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
+                    or max_bucket
+                batch = pack_rows(self.arena, d_off, d_len, L)
+                fut = plane.submit(self.kern, (batch.rows, batch.lengths),
+                                   batch.rows.nbytes,
+                                   on_wait=self._drain_if_pending)
+                # each chunk records the kernel it was SUBMITTED on: after a
+                # fault pins the engine to the XLA path, errors from earlier
+                # in-flight chunks must still take the fallback, not re-raise
+                self._chunks_pending.append((chunk, batch, fut, self.kern))
+        except BaseException:
+            # a failed pack/submit must not strand the budget the already-
+            # submitted futures hold (round-5 leak): force-release them —
+            # the caller abandons this parse, nobody will result() them
+            for _, _, fut, _k in self._chunks_pending:
+                fut.release()
+            self._chunks_pending.clear()
+            raise
 
     def _drain_if_pending(self) -> bool:
         """Budget-wait hook: materialise our oldest in-flight chunk so the

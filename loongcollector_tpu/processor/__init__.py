@@ -62,6 +62,8 @@ def register_all(registry) -> None:
                                 ProcessorTimestampFilter)
     registry.register_processor("processor_classify_url_tpu",
                                 ProcessorClassifyUrl)
+    registry.register_processor("processor_classify_url_native",
+                                ProcessorClassifyUrl)
     registry.register_processor("processor_dynamic", DynamicPythonProcessor)
     registry.register_processor("processor_dynamic_c", DynamicCProcessor)
     registry.register_processor("processor_spl", ProcessorSPL)

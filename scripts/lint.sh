@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Repo lint gate — everything here also runs under tier-1 (the loonglint
+# scan and the stress tests are pytest-gated), so this script is the fast
+# local entry point, not the only enforcement.
+#
+#   1. loonglint: AST invariant checks over loongcollector_tpu/
+#      (docs/static_analysis.md);
+#   2. native hygiene: -Werror syntax pass + clang-tidy when installed;
+#   3. ResourceWarning sweep: the concurrency stress tests under
+#      `python -X dev -W error::ResourceWarning` — an unclosed socket,
+#      file, or thread-local leak in the hot paths fails loudly here.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== loonglint =="
+python -m loongcollector_tpu.analysis "$@"
+
+echo "== native lint =="
+make -C native lint
+
+echo "== ResourceWarning sweep (concurrency stress) =="
+JAX_PLATFORMS=cpu python -X dev -W error::ResourceWarning -m pytest \
+    tests/test_concurrency_stress.py tests/test_queues.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+echo "lint OK"

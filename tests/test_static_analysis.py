@@ -1,0 +1,534 @@
+"""loonglint: the tier-1 static-analysis gate plus per-checker fixtures.
+
+Two layers:
+
+1. `TestTier1Gate` runs the REAL full-tree scan — a loonglint violation
+   anywhere in loongcollector_tpu/ fails the suite, and the allowlist is
+   held to its <= 10 entry budget.  This is how the checkers are "wired
+   into tier-1": the pytest gate cannot be skipped without skipping
+   tier-1 itself.
+
+2. Fixture tests feed each checker known-bad source (including a faithful
+   excerpt of the round-5 PendingParse.dispatch budget leak,
+   ops/regex/engine.py:513 pre-fix) and assert it is caught, plus the
+   known-good variants to pin down precision.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from loongcollector_tpu.analysis import (Finding, ModuleInfo, Program,
+                                         load_allowlist, run_analysis)
+from loongcollector_tpu.analysis.checkers import all_checkers, checker_names
+from loongcollector_tpu.analysis.checkers.acquire_release import \
+    AcquireReleaseChecker
+from loongcollector_tpu.analysis.checkers.blocking_locks import \
+    BlockingUnderLockChecker
+from loongcollector_tpu.analysis.checkers.registry_consistency import \
+    RegistryConsistencyChecker
+from loongcollector_tpu.analysis.checkers.tracing_hygiene import \
+    TracingHygieneChecker
+from loongcollector_tpu.analysis.core import (ALLOWLIST_BUDGET,
+                                              default_allowlist_path)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scan(src, checker, relpath="loongcollector_tpu/ops/fixture.py",
+         extra_modules=()):
+    """Run one checker over inline fixture source; returns findings."""
+    mod = ModuleInfo("/fx/" + relpath, relpath, textwrap.dedent(src))
+    mods = [mod] + [ModuleInfo("/fx/" + rp, rp, textwrap.dedent(s))
+                    for rp, s in extra_modules]
+    findings = list(checker.check_module(mod))
+    for extra in mods[1:]:
+        findings += list(checker.check_module(extra))
+    findings += list(checker.finalize(Program("/fx", mods)))
+    return findings
+
+
+def checks_of(findings):
+    return {f.check for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# 1. the tier-1 gate
+
+
+class TestTier1Gate:
+    def test_full_tree_scan_is_clean(self):
+        result = run_analysis()
+        assert result.files_scanned > 100, "scan missed the package tree"
+        assert result.ok, (
+            "loonglint violations in the tree:\n"
+            + "\n".join(f.format() for f in result.findings)
+            + "\n".join(result.parse_errors))
+
+    def test_allowlist_within_budget(self):
+        entries = load_allowlist(default_allowlist_path())
+        assert len(entries) <= ALLOWLIST_BUDGET, (
+            f"allowlist has {len(entries)} entries; budget is "
+            f"{ALLOWLIST_BUDGET} — pay down debt instead of parking more")
+
+    def test_cli_json_contract(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "loongcollector_tpu.analysis", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is True
+        assert doc["allowlist_entries"] <= doc["allowlist_budget"]
+        assert doc["files_scanned"] > 100
+
+    def test_all_four_checkers_registered(self):
+        names = checker_names()
+        assert names == ["acquire-release", "blocking-under-lock",
+                         "tracing-hygiene", "registry-consistency"]
+        assert len(all_checkers()) == 4
+
+
+# ---------------------------------------------------------------------------
+# 2. acquire-release fixtures
+
+
+# Faithful excerpt of ops/regex/engine.py:513 BEFORE the round-5 fix: the
+# dispatch loop submits device chunks (acquiring plane budget) and appends
+# the futures with no exception guard — a mid-loop pack/submit failure
+# strands every already-acquired chunk's budget forever.
+ENGINE_513_LEAK = """
+class PendingParse:
+    def dispatch(self, device_idx):
+        plane = DevicePlane.instance()
+        self.kern = self.engine._device_kernel()
+        max_bucket = LENGTH_BUCKETS[-1]
+        for chunk in _chunks(device_idx, MAX_BATCH):
+            d_off = self.offsets[chunk]
+            d_len = self.lengths[chunk]
+            L = pick_length_bucket(int(d_len.max())) or max_bucket
+            batch = pack_rows(self.arena, d_off, d_len, L)
+            fut = plane.submit(self.kern, (batch.rows, batch.lengths),
+                               batch.rows.nbytes,
+                               on_wait=self._drain_if_pending)
+            self._chunks_pending.append((chunk, batch, fut, self.kern))
+"""
+
+ENGINE_513_FIXED = """
+class PendingParse:
+    def dispatch(self, device_idx):
+        plane = DevicePlane.instance()
+        self.kern = self.engine._device_kernel()
+        try:
+            for chunk in _chunks(device_idx, MAX_BATCH):
+                batch = pack_rows(self.arena, chunk)
+                fut = plane.submit(self.kern, (batch.rows, batch.lengths),
+                                   batch.rows.nbytes,
+                                   on_wait=self._drain_if_pending)
+                self._chunks_pending.append((chunk, batch, fut, self.kern))
+        except BaseException:
+            for _, _, fut, _k in self._chunks_pending:
+                fut.release()
+            self._chunks_pending.clear()
+            raise
+"""
+
+
+class TestAcquireRelease:
+    def test_flags_the_engine_513_leak_shape(self):
+        findings = scan(ENGINE_513_LEAK, AcquireReleaseChecker())
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.check == "acquire-release"
+        assert f.symbol == "PendingParse.dispatch"
+        assert "strands the in-flight budget" in f.message
+
+    def test_fixed_dispatch_is_clean(self):
+        assert scan(ENGINE_513_FIXED, AcquireReleaseChecker()) == []
+
+    def test_try_finally_is_clean(self):
+        src = """
+        def pump(plane, kern, chunks):
+            futs = []
+            try:
+                for c in chunks:
+                    futs.append(plane.submit(kern, (c,), c.nbytes))
+            finally:
+                for f in futs:
+                    f.result()
+        """
+        assert scan(src, AcquireReleaseChecker()) == []
+
+    def test_straight_line_submit_consume_is_clean(self):
+        src = """
+        def one(plane, kern, batch):
+            fut = plane.submit(kern, (batch,), batch.nbytes)
+            return fut.result()
+        """
+        assert scan(src, AcquireReleaseChecker()) == []
+
+    def test_raw_acquire_in_loop_flagged(self):
+        src = """
+        def drain(plane, sizes):
+            for n in sizes:
+                plane._acquire(n)
+                process(n)
+                plane._release(n)
+        """
+        findings = scan(src, AcquireReleaseChecker())
+        assert checks_of(findings) == {"acquire-release"}
+
+    def test_inline_suppression(self):
+        src = ENGINE_513_LEAK.replace(
+            "            fut = plane.submit(",
+            "            # loonglint: disable=acquire-release\n"
+            "            fut = plane.submit(")
+        mod = ModuleInfo("/fx/a.py", "loongcollector_tpu/ops/a.py",
+                         textwrap.dedent(src))
+        findings = list(AcquireReleaseChecker().check_module(mod))
+        assert len(findings) == 1
+        # the runner consults mod.suppressed — verify the wiring
+        assert mod.suppressed(findings[0].line, findings[0].check)
+
+
+# ---------------------------------------------------------------------------
+# 3. blocking-under-lock fixtures
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_flagged(self):
+        src = """
+        import threading, time
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def run(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """
+        findings = scan(src, BlockingUnderLockChecker())
+        assert checks_of(findings) == {"blocking-under-lock"}
+        assert "time.sleep" in findings[0].message
+
+    def test_future_result_under_lock_flagged(self):
+        src = """
+        class Pump:
+            def drain(self):
+                with self._lock:
+                    data = self.fut.result()
+        """
+        findings = scan(src, BlockingUnderLockChecker())
+        assert checks_of(findings) == {"blocking-under-lock"}
+
+    def test_condition_wait_on_held_lock_is_clean(self):
+        # the device-plane shape: Condition.wait releases the lock it
+        # guards — the one legal blocking wait
+        src = """
+        class Plane:
+            def _acquire_wait(self):
+                with self._freed:
+                    self._freed.wait(timeout=0.05)
+        """
+        assert scan(src, BlockingUnderLockChecker()) == []
+
+    def test_dict_get_under_lock_is_clean(self):
+        src = """
+        class Manager:
+            def lookup(self, key):
+                with self._lock:
+                    return self._queues.get(key)
+        """
+        assert scan(src, BlockingUnderLockChecker()) == []
+
+    def test_blocking_queue_get_under_lock_flagged(self):
+        src = """
+        class Manager:
+            def pump(self):
+                with self._lock:
+                    item = self.in_queue.get()
+        """
+        findings = scan(src, BlockingUnderLockChecker())
+        assert checks_of(findings) == {"blocking-under-lock"}
+
+    def test_lock_ordering_cycle_detected(self):
+        src = """
+        import threading
+        class Alpha:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def alpha_push(self):
+                with self._lock:
+                    self.beta.beta_push()
+        class Beta:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def beta_push(self):
+                with self._lock:
+                    self.alpha.alpha_drain()
+        class AlphaPeer:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def alpha_drain(self):
+                with self._lock:
+                    self.alpha.alpha_push()
+        """
+        src2 = """
+        import threading
+        class Gamma:
+            pass
+        """
+        findings = scan(src, BlockingUnderLockChecker(),
+                        relpath="loongcollector_tpu/runner/fx.py",
+                        extra_modules=[
+                            ("loongcollector_tpu/runner/fx2.py", src2)])
+        order = [f for f in findings if f.check == "lock-ordering"]
+        assert order, "expected a lock-order cycle report"
+        assert "Alpha._lock" in order[0].message
+        assert "Beta._lock" in order[0].message
+
+    def test_consistent_order_has_no_cycle(self):
+        src = """
+        import threading
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def outer_push(self):
+                with self._lock:
+                    self.inner.inner_push()
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def inner_push(self):
+                with self._lock:
+                    pass
+        """
+        findings = scan(src, BlockingUnderLockChecker(),
+                        relpath="loongcollector_tpu/runner/fx.py")
+        assert [f for f in findings if f.check == "lock-ordering"] == []
+
+
+# ---------------------------------------------------------------------------
+# 4. tracing-hygiene fixtures
+
+
+class TestTracingHygiene:
+    def test_time_in_jit_flagged(self):
+        src = """
+        import time, jax
+        @jax.jit
+        def kernel(rows):
+            t0 = time.time()
+            return rows + 1
+        """
+        findings = scan(src, TracingHygieneChecker())
+        assert checks_of(findings) == {"tracing-hygiene"}
+        assert "time.time" in findings[0].message
+
+    def test_print_in_pallas_kernel_flagged(self):
+        src = """
+        from jax.experimental import pallas as pl
+        def _kern(rows_ref, out_ref):
+            print("debug", rows_ref)
+            out_ref[...] = rows_ref[...]
+        def build(rows):
+            return pl.pallas_call(_kern, out_shape=None)(rows)
+        """
+        findings = scan(src, TracingHygieneChecker())
+        assert checks_of(findings) == {"tracing-hygiene"}
+        assert "print" in findings[0].message
+
+    def test_factory_closure_is_traced(self):
+        # the repo idiom: self._fn = jax.jit(build_fn(program))
+        src = """
+        import time, jax
+        def build_fn(program):
+            def run(rows, lengths):
+                time.sleep(0.001)
+                return rows
+            return run
+        fn = jax.jit(build_fn(None))
+        """
+        findings = scan(src, TracingHygieneChecker())
+        assert checks_of(findings) == {"tracing-hygiene"}
+
+    def test_np_asarray_in_jit_flagged(self):
+        src = """
+        import jax
+        import numpy as np
+        @jax.jit
+        def kernel(rows):
+            host = np.asarray(rows)
+            return host
+        """
+        findings = scan(src, TracingHygieneChecker())
+        assert checks_of(findings) == {"tracing-hygiene"}
+
+    def test_float_cast_of_traced_param_flagged(self):
+        src = """
+        import jax
+        @jax.jit
+        def kernel(x):
+            return float(x)
+        """
+        findings = scan(src, TracingHygieneChecker())
+        assert checks_of(findings) == {"tracing-hygiene"}
+
+    def test_host_code_outside_ops_not_scanned(self):
+        src = """
+        import time, jax
+        @jax.jit
+        def kernel(rows):
+            return time.time()
+        """
+        assert scan(src, TracingHygieneChecker(),
+                    relpath="loongcollector_tpu/runner/fx.py") == []
+
+    def test_untraced_host_helper_is_clean(self):
+        src = """
+        import time
+        def host_side(batch):
+            t0 = time.time()
+            return batch, t0
+        """
+        assert scan(src, TracingHygieneChecker()) == []
+
+    def test_static_shape_math_is_clean(self):
+        # int()/float() on non-parameter statics is trace-time shape math
+        src = """
+        import jax
+        @jax.jit
+        def kernel(rows):
+            width = int(SOME_STATIC)
+            return rows[:width]
+        """
+        assert scan(src, TracingHygieneChecker()) == []
+
+
+# ---------------------------------------------------------------------------
+# 5. registry-consistency fixtures
+
+
+FAKE_ALARMS = """
+class AlarmType:
+    SEND_FAIL = "SEND_DATA_FAIL_ALARM"
+    PARSE_LOG_FAIL = "PARSE_LOG_FAIL_ALARM"
+"""
+
+
+class TestRegistryConsistency:
+    def test_tpu_without_native_sibling_flagged(self):
+        src = """
+        def register_all(registry):
+            registry.register_processor("processor_parse_foo_tpu",
+                                        ProcessorFoo)
+        """
+        findings = scan(src, RegistryConsistencyChecker(),
+                        relpath="loongcollector_tpu/processor/__init__.py")
+        assert checks_of(findings) == {"registry-consistency"}
+        assert "no `processor_parse_foo_native` sibling" in \
+            findings[0].message
+
+    def test_paired_tiers_same_class_clean(self):
+        src = """
+        def register_all(registry):
+            registry.register_processor("processor_parse_foo_native",
+                                        ProcessorFoo)
+            registry.register_processor("processor_parse_foo_tpu",
+                                        ProcessorFoo)
+        """
+        assert scan(src, RegistryConsistencyChecker(),
+                    relpath="loongcollector_tpu/processor/__init__.py") == []
+
+    def test_tier_fork_flagged(self):
+        src = """
+        def register_all(registry):
+            registry.register_processor("processor_parse_foo_native",
+                                        ProcessorFooHost)
+            registry.register_processor("processor_parse_foo_tpu",
+                                        ProcessorFooDevice)
+        """
+        findings = scan(src, RegistryConsistencyChecker(),
+                        relpath="loongcollector_tpu/processor/__init__.py")
+        assert any("tier fork" in f.message for f in findings)
+
+    def test_unknown_alarm_type_flagged(self):
+        src = """
+        from ..monitor.alarms import AlarmManager, AlarmType
+        def fail(mgr):
+            mgr.send_alarm(AlarmType.TOTALLY_BOGUS, "boom")
+        """
+        findings = scan(
+            src, RegistryConsistencyChecker(),
+            relpath="loongcollector_tpu/flusher/fx.py",
+            extra_modules=[("loongcollector_tpu/monitor/alarms.py",
+                            FAKE_ALARMS)])
+        assert checks_of(findings) == {"registry-consistency"}
+        assert "TOTALLY_BOGUS" in findings[0].message
+
+    def test_known_alarm_type_clean(self):
+        src = """
+        from ..monitor.alarms import AlarmManager, AlarmType
+        def ok(mgr):
+            mgr.send_alarm(AlarmType.SEND_FAIL, "boom")
+        """
+        assert scan(
+            src, RegistryConsistencyChecker(),
+            relpath="loongcollector_tpu/flusher/fx.py",
+            extra_modules=[("loongcollector_tpu/monitor/alarms.py",
+                            FAKE_ALARMS)]) == []
+
+    def test_raw_string_alarm_flagged(self):
+        src = """
+        def fail(mgr):
+            mgr.send_alarm("SEND_DATA_FAIL_ALARM", "boom")
+        """
+        findings = scan(
+            src, RegistryConsistencyChecker(),
+            relpath="loongcollector_tpu/flusher/fx.py",
+            extra_modules=[("loongcollector_tpu/monitor/alarms.py",
+                            FAKE_ALARMS)])
+        assert any("raw literal" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 6. framework plumbing
+
+
+class TestFramework:
+    def test_allowlist_matching(self):
+        from loongcollector_tpu.analysis.core import _allowed
+        f = Finding("blocking-under-lock",
+                    "loongcollector_tpu/flusher/pulsar.py", 170, 16,
+                    "blocking call self.connect() while holding self._lock",
+                    symbol="PulsarProducer.send")
+        assert _allowed(f, [("flusher/pulsar.py", "blocking-under-lock",
+                             "PulsarProducer.send")])
+        assert not _allowed(f, [("flusher/pulsar.py", "acquire-release",
+                                 "")])
+        assert not _allowed(f, [("flusher/kafka.py",
+                                 "blocking-under-lock", "")])
+
+    def test_suppression_parsing(self):
+        mod = ModuleInfo("/fx/x.py", "x.py",
+                         "a = 1  # loonglint: disable=foo,bar\nb = 2\n")
+        assert mod.suppressed(1, "foo")
+        assert mod.suppressed(1, "bar")
+        assert not mod.suppressed(1, "baz")
+        assert not mod.suppressed(2, "foo")
+
+    def test_findings_have_stable_json_shape(self):
+        f = Finding("acquire-release", "p.py", 3, 1, "msg", symbol="f")
+        assert f.to_dict() == {"check": "acquire-release", "path": "p.py",
+                               "line": 3, "col": 1, "symbol": "f",
+                               "message": "msg"}
+
+    def test_allowlist_respects_path_boundaries(self):
+        from loongcollector_tpu.analysis.core import _allowed
+        f = Finding("blocking-under-lock",
+                    "loongcollector_tpu/input/data.py", 1, 0, "msg")
+        # `a.py` must not match `data.py` by suffix accident
+        assert not _allowed(f, [("a.py", "blocking-under-lock", "")])
+        assert _allowed(f, [("input/data.py", "blocking-under-lock", "")])
+        assert _allowed(f, [("loongcollector_tpu/input/data.py",
+                             "blocking-under-lock", "")])
