@@ -9,6 +9,7 @@ from ..core import Checker
 from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
 from .registry_consistency import RegistryConsistencyChecker
+from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
 
 _CHECKER_CLASSES = [
@@ -16,6 +17,7 @@ _CHECKER_CLASSES = [
     BlockingUnderLockChecker,
     TracingHygieneChecker,
     RegistryConsistencyChecker,
+    SwallowedFaultChecker,
 ]
 
 

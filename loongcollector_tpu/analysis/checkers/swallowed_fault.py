@@ -1,0 +1,93 @@
+"""swallowed-fault: broad exception handlers that silently discard errors
+in the send paths (flusher/ and runner/).
+
+A bare ``except:`` / ``except Exception:`` whose body is only ``pass`` or
+``continue`` eats every failure signal — including the typed faults the
+loongchaos plane injects: a storm that "passes" because the faults vanished
+into a silent handler proves nothing.  In the send paths specifically,
+a swallowed error is also a swallowed payload: no retry verdict, no
+breaker feedback, no alarm.
+
+Flagged:   broad handler (bare, Exception, BaseException — alone or in a
+           tuple) whose body contains nothing but pass/continue.
+Exempt:    handlers whose ``try`` body is pure teardown (every statement a
+           close/shutdown/cancel-style call) — best-effort cleanup of a
+           resource that is being discarded has no signal to preserve.
+Escape:    ``# loonglint: disable=swallowed-fault`` with a justification,
+           for the rare deliberate fallback (e.g. the native-CRC probe).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, attr_tail, iter_functions
+
+CHECK = "swallowed-fault"
+
+_SCOPES = ("/flusher/", "/runner/")
+_BROAD_NAMES = {"Exception", "BaseException"}
+_CLEANUP_TAILS = {"close", "shutdown", "cancel", "unlink", "stop",
+                  "terminate", "kill", "remove"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(el, ast.Name) and el.id in _BROAD_NAMES
+                   for el in t.elts)
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(stmt, (ast.Pass, ast.Continue))
+               for stmt in handler.body)
+
+
+def _cleanup_only(try_body: List[ast.stmt]) -> bool:
+    for stmt in try_body:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and attr_tail(stmt.value) in _CLEANUP_TAILS):
+            return False
+    return bool(try_body)
+
+
+class SwallowedFaultChecker(Checker):
+    name = CHECK
+    description = ("no broad except-pass/continue in flusher/ and runner/ "
+                   "send paths (they eat injected faults silently)")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        relpath = "/" + mod.relpath
+        if not any(scope in relpath for scope in _SCOPES):
+            return
+        funcs: List[Tuple[str, ast.AST]] = list(iter_functions(mod.tree))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not (_is_broad(handler) and _swallows(handler)):
+                    continue
+                if _cleanup_only(node.body):
+                    continue
+                yield Finding(
+                    CHECK, mod.relpath, handler.lineno, handler.col_offset,
+                    "broad exception swallowed (pass/continue): failures "
+                    "and injected faults die here with no retry verdict, "
+                    "breaker feedback or alarm",
+                    symbol=self._enclosing(funcs, handler))
+
+    @staticmethod
+    def _enclosing(funcs: List[Tuple[str, ast.AST]], node: ast.AST) -> str:
+        best = ""
+        for qn, fn in funcs:
+            if (fn.lineno <= node.lineno
+                    and node.lineno <= (fn.end_lineno or fn.lineno)):
+                best = qn      # innermost wins: iteration is outside-in
+        return best

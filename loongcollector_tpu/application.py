@@ -76,9 +76,16 @@ class Application:
         self.disk_buffer = DiskBufferWriter(
             os.path.join(self.data_dir, "buffer"),
             cipher=spill_cipher)
+        from .flusher.async_sink import set_default_disk_buffer
+        set_default_disk_buffer(self.disk_buffer)
         self.flusher_runner = FlusherRunner(self.sender_queue_manager,
                                             self.http_sink,
                                             disk_buffer=self.disk_buffer)
+        # loongchaos: LOONG_CHAOS_SEED activates the deterministic fault
+        # plane for this process (docs/robustness.md); no-op otherwise
+        from . import chaos
+        if chaos.install_from_env():
+            log.warning("chaos plane ACTIVE (seed from %s)", chaos.ENV_SEED)
         self.processor_runner = ProcessorRunner(
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))

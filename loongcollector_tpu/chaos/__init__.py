@@ -1,0 +1,33 @@
+"""loongchaos — deterministic fault injection for every I/O and device
+boundary (ISSUE 2 tentpole; docs/robustness.md has the operator guide).
+
+Usage:
+
+    from loongcollector_tpu import chaos
+
+    with chaos.active(chaos.ChaosPlan(seed=7, rules={
+            "http_sink.send": chaos.FaultSpec(prob=0.5, max_faults=20)})):
+        ...drive the pipeline; faults land deterministically...
+
+    # or env-driven: LOONG_CHAOS_SEED=7 activates ChaosPlan.default(7)
+    # at application start.
+
+Disabled (the default), every `faultpoint()` call is a no-op check.
+"""
+
+from .plan import (ACTION_CORRUPT, ACTION_DELAY, ACTION_ERROR,
+                   ACTION_PARTIAL, ALL_ACTIONS, ChaosFault, ChaosPlan,
+                   Decision, FaultSpec)
+from .plane import (ENV_SEED, active, current_plan, fault_counts,
+                    faultpoint, hit_counts, install, install_from_env,
+                    is_active, register_point, registered_points, schedule,
+                    schedule_by_point, uninstall)
+
+__all__ = [
+    "ACTION_CORRUPT", "ACTION_DELAY", "ACTION_ERROR", "ACTION_PARTIAL",
+    "ALL_ACTIONS", "ChaosFault", "ChaosPlan", "Decision", "FaultSpec",
+    "ENV_SEED", "active", "current_plan", "fault_counts", "faultpoint",
+    "hit_counts", "install", "install_from_env", "is_active",
+    "register_point", "registered_points", "schedule",
+    "schedule_by_point", "uninstall",
+]

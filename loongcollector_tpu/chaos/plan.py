@@ -1,0 +1,165 @@
+"""Seeded fault schedules: WHAT to inject, decided deterministically.
+
+A `ChaosPlan` binds a seed to a set of per-fault-point rules.  Decisions
+are drawn from a *per-point* RNG stream derived from ``(seed, point)`` and
+cached by hit index, so the decision for hit N of point P depends only on
+(seed, P, N) — never on thread interleaving across points.  Re-running a
+workload with the same seed replays the identical fault schedule at every
+point that receives the same number of hits, which is what makes a failing
+soak seed reproducible (ISSUE 2 acceptance: "re-running any failing seed
+reproduces the identical fault schedule").
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ChaosFault(RuntimeError):
+    """Default typed fault raised at a fault point with no site-specific
+    exception class.  Sites that own a richer error taxonomy (KafkaError,
+    PulsarError, OSError...) pass theirs so injected faults travel the
+    exact recovery path a real failure would."""
+
+
+#: actions a fault point can be told to take
+ACTION_ERROR = "error"      # raise the site's typed fault
+ACTION_DELAY = "delay"      # sleep in-line (slow network / device)
+ACTION_PARTIAL = "partial"  # partial ack: the site delivers a prefix only
+ACTION_CORRUPT = "corrupt"  # corrupt-at-rest: the site garbles its output
+
+ALL_ACTIONS = (ACTION_ERROR, ACTION_DELAY, ACTION_PARTIAL, ACTION_CORRUPT)
+
+
+class FaultSpec:
+    """Per-point rule: how often to fault, with which actions.
+
+    prob         per-hit fault probability
+    kinds        actions drawn (uniformly) when a hit faults
+    delay_range  (lo, hi) seconds for ACTION_DELAY
+    max_faults   stop faulting after this many injected faults (the storm
+                 "clears", letting recovery invariants be asserted);
+                 None = never clears
+    after_hits   first hits never fault (lets a system warm up)
+    """
+
+    __slots__ = ("prob", "kinds", "delay_range", "max_faults", "after_hits")
+
+    def __init__(self, prob: float = 0.25,
+                 kinds: Sequence[str] = (ACTION_ERROR,),
+                 delay_range: Tuple[float, float] = (0.001, 0.02),
+                 max_faults: Optional[int] = None,
+                 after_hits: int = 0):
+        for k in kinds:
+            if k not in ALL_ACTIONS:
+                raise ValueError(f"unknown fault action {k!r}")
+        self.prob = float(prob)
+        self.kinds = tuple(kinds)
+        self.delay_range = (float(delay_range[0]), float(delay_range[1]))
+        self.max_faults = max_faults
+        self.after_hits = int(after_hits)
+
+
+class Decision:
+    """One per-hit verdict.  ``magnitude`` is a stable uniform draw in
+    [0, 1) that sites scale to their own units (partial-ack prefix
+    fraction, corruption offset)."""
+
+    __slots__ = ("point", "hit", "action", "delay_s", "magnitude")
+
+    def __init__(self, point: str, hit: int, action: str,
+                 delay_s: float, magnitude: float):
+        self.point = point
+        self.hit = hit
+        self.action = action
+        self.delay_s = delay_s
+        self.magnitude = magnitude
+
+    def key(self) -> tuple:
+        """Comparable identity for schedule-equality assertions."""
+        return (self.point, self.hit, self.action,
+                round(self.delay_s, 9), round(self.magnitude, 9))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"<Decision {self.point}#{self.hit} {self.action}"
+                f" delay={self.delay_s:.4f} mag={self.magnitude:.4f}>")
+
+
+class ChaosPlan:
+    """seed + {point pattern: FaultSpec} → deterministic decision streams.
+
+    Rule lookup: exact point name first, then ``fnmatch`` patterns in
+    sorted order (longest pattern wins ties), so ``"disk_buffer.*"`` covers
+    both write and replay while ``"disk_buffer.write"`` can still override.
+
+    NOT internally locked: the plane serializes decide() under its own hit
+    lock (one lock, not two, on the fault path).
+    """
+
+    def __init__(self, seed: int,
+                 rules: Optional[Dict[str, FaultSpec]] = None):
+        self.seed = int(seed)
+        self.rules = dict(rules or {})
+        self._streams: Dict[str, random.Random] = {}
+        self._decisions: Dict[str, List[Optional[Decision]]] = {}
+        self._faults_injected: Dict[str, int] = {}
+
+    @classmethod
+    def default(cls, seed: int, prob: float = 0.2,
+                max_faults: Optional[int] = 64) -> "ChaosPlan":
+        """The LOONG_CHAOS_SEED schedule: error+delay storms everywhere,
+        clearing after `max_faults` per point so long-running agents
+        recover instead of flapping forever."""
+        return cls(seed, {"*": FaultSpec(
+            prob=prob, kinds=(ACTION_ERROR, ACTION_DELAY),
+            max_faults=max_faults)})
+
+    def spec_for(self, point: str) -> Optional[FaultSpec]:
+        spec = self.rules.get(point)
+        if spec is not None:
+            return spec
+        best: Optional[Tuple[int, str]] = None
+        for pattern in self.rules:
+            if fnmatch.fnmatchcase(point, pattern):
+                cand = (len(pattern), pattern)
+                if best is None or cand > best:
+                    best = cand
+        return self.rules[best[1]] if best is not None else None
+
+    def decide(self, point: str, hit: int) -> Optional[Decision]:
+        """Decision for hit number `hit` (0-based) of `point`; None = no
+        fault.  Cached: asking again for the same (point, hit) returns the
+        identical decision."""
+        cache = self._decisions.setdefault(point, [])
+        while len(cache) <= hit:
+            cache.append(self._draw(point, len(cache)))
+        return cache[hit]
+
+    def _draw(self, point: str, hit: int) -> Optional[Decision]:
+        spec = self.spec_for(point)
+        if spec is None:
+            return None
+        rng = self._streams.get(point)
+        if rng is None:
+            rng = self._streams[point] = random.Random(
+                f"{self.seed}:{point}")
+        # one fixed-size draw block per hit keeps the stream aligned no
+        # matter which branch a given hit takes
+        roll = rng.random()
+        kind_roll = rng.random()
+        delay_roll = rng.random()
+        magnitude = rng.random()
+        if hit < spec.after_hits or roll >= spec.prob:
+            return None
+        if spec.max_faults is not None and \
+                self._faults_injected.get(point, 0) >= spec.max_faults:
+            return None
+        self._faults_injected[point] = \
+            self._faults_injected.get(point, 0) + 1
+        action = spec.kinds[int(kind_roll * len(spec.kinds))
+                            % len(spec.kinds)]
+        lo, hi = spec.delay_range
+        delay_s = lo + (hi - lo) * delay_roll
+        return Decision(point, hit, action, delay_s, magnitude)

@@ -1,0 +1,182 @@
+"""loongchaos: the process-wide fault-injection plane.
+
+Every I/O and device boundary registers a named fault point at import time
+(`register_point`) and calls `faultpoint(name, ...)` on each hit.  With no
+plan installed the hit is a single module-global read and an immediate
+return — the send/dispatch hot paths pay one predictable branch, nothing
+else.  With a plan installed (programmatic `install()` or the
+``LOONG_CHAOS_SEED`` env var via `install_from_env()`), each hit draws a
+deterministic per-point decision (chaos/plan.py) and either
+
+  * raises the site's typed fault (``exc`` class, default ChaosFault),
+  * sleeps in-line (injected latency), or
+  * returns the Decision for site-specific interpretation — partial acks
+    (Kafka window prefix) and corrupt-at-rest (disk buffer) cannot be
+    expressed as a raise, the owning site applies them.
+
+The plane keeps a bounded schedule log of every injected fault for
+reproducibility assertions, and exports fault counters through
+monitor/metrics.py (category "agent", component "chaos").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .plan import (ACTION_CORRUPT, ACTION_DELAY, ACTION_ERROR,
+                   ACTION_PARTIAL, ChaosFault, ChaosPlan, Decision)
+
+ENV_SEED = "LOONG_CHAOS_SEED"
+
+_SCHEDULE_CAP = 100_000   # injected-fault log bound (soaks stay well under)
+
+_lock = threading.Lock()
+_plan: Optional[ChaosPlan] = None
+_hits: Dict[str, int] = {}
+_schedule: List[tuple] = []
+_registered: Set[str] = set()
+_metrics = None           # lazy MetricsRecord; created on first install
+
+
+def register_point(name: str) -> str:
+    """Declare a fault point (module import time).  Returns the name so
+    call sites can keep a module-level constant: the registry is the
+    catalogue `registered_points()` exposes to docs/tests/default plans."""
+    with _lock:
+        _registered.add(name)
+    return name
+
+
+def registered_points() -> List[str]:
+    with _lock:
+        return sorted(_registered)
+
+
+def is_active() -> bool:
+    return _plan is not None
+
+
+def current_plan() -> Optional[ChaosPlan]:
+    return _plan
+
+
+def install(plan: ChaosPlan) -> None:
+    """Activate `plan` process-wide; resets hit counts and the schedule
+    log so every install starts a fresh, comparable run."""
+    global _plan, _metrics
+    with _lock:
+        if _metrics is None:
+            from ..monitor.metrics import MetricsRecord
+            _metrics = MetricsRecord(category="agent",
+                                     labels={"component": "chaos"})
+        _hits.clear()
+        del _schedule[:]
+        _plan = plan
+        _metrics.gauge("chaos_active").set(1.0)
+        _metrics.gauge("chaos_seed").set(float(plan.seed))
+
+
+def uninstall() -> None:
+    global _plan
+    with _lock:
+        _plan = None
+        if _metrics is not None:
+            _metrics.gauge("chaos_active").set(0.0)
+
+
+@contextlib.contextmanager
+def active(plan: ChaosPlan):
+    """Scoped installation for tests: `with chaos.active(plan): ...`."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def install_from_env(env=os.environ) -> bool:
+    """Install ChaosPlan.default(seed) when LOONG_CHAOS_SEED is set.
+    Called once at application start; returns True when chaos went live."""
+    raw = env.get(ENV_SEED)
+    if not raw:
+        return False
+    try:
+        seed = int(raw)
+    except ValueError:
+        return False
+    install(ChaosPlan.default(seed))
+    return True
+
+
+def schedule() -> List[tuple]:
+    """Injected-fault log: [(point, hit, action, delay_s, magnitude)].
+    Two runs with the same seed and per-point hit counts produce equal
+    per-point subsequences (global order may differ across threads)."""
+    with _lock:
+        return list(_schedule)
+
+
+def schedule_by_point() -> Dict[str, List[tuple]]:
+    """The schedule grouped per point — the thread-order-independent form
+    determinism assertions compare."""
+    out: Dict[str, List[tuple]] = {}
+    for entry in schedule():
+        out.setdefault(entry[0], []).append(entry)
+    return out
+
+
+def fault_counts() -> Dict[str, int]:
+    """point -> injected faults so far (all actions)."""
+    counts: Dict[str, int] = {}
+    for entry in schedule():
+        counts[entry[0]] = counts.get(entry[0], 0) + 1
+    return counts
+
+
+def hit_counts() -> Dict[str, int]:
+    with _lock:
+        return dict(_hits)
+
+
+def faultpoint(name: str, exc: Optional[type] = None,
+               raise_: bool = True) -> Optional[Decision]:
+    """One hit at fault point `name`.
+
+    Disabled plane: returns None after a single global read — the no-op
+    fast path every boundary rides in production.
+
+    Active plane: ERROR raises ``(exc or ChaosFault)`` (unless
+    ``raise_=False``, for sites where an exception cannot propagate —
+    they receive the Decision and degrade in their own vocabulary, e.g.
+    a queue rejecting the push).  DELAY sleeps here and returns None.
+    PARTIAL/CORRUPT return the Decision for the site to apply; sites
+    that cannot interpret them may ignore the return value.
+    """
+    plan = _plan
+    if plan is None:
+        return None
+    with _lock:
+        if _plan is not plan:       # racing uninstall/reinstall
+            return None
+        hit = _hits.get(name, 0)
+        _hits[name] = hit + 1
+        decision = plan.decide(name, hit)
+        if decision is None:
+            return None
+        if len(_schedule) < _SCHEDULE_CAP:
+            _schedule.append(decision.key())
+        if _metrics is not None:
+            _metrics.counter("faults_injected_total").add(1)
+            _metrics.counter(f"faults_{decision.action}_total").add(1)
+    if decision.action == ACTION_DELAY:
+        time.sleep(decision.delay_s)
+        return None
+    if decision.action == ACTION_ERROR and raise_:
+        raise (exc or ChaosFault)(
+            f"chaos[{name}#{decision.hit}]: injected fault "
+            f"(seed {plan.seed})")
+    return decision

@@ -12,6 +12,13 @@ so a down broker never blocks the pipeline's processing thread, and
 transient outages are retried far longer than any inline attempt could.
 A configured RequestBreaker extension gates deliveries; drain happens on
 stop() with a deadline.
+
+Each async sink also carries the unified per-sink circuit breaker
+(runner/circuit.py): persistent delivery failure OPENs the circuit, the
+pending queue spills to the shared DiskBufferWriter instead of aging
+toward the TTL drop, and a successful half-open probe re-closes the
+circuit and replays the spilled payloads through this same sink — the
+identical degradation policy FlusherRunner applies to HTTP-family sinks.
 """
 
 from __future__ import annotations
@@ -23,6 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext
+from ..pipeline.queue.sender_queue import SenderQueueItem
+from ..runner.circuit import BreakerState, SinkCircuitBreaker
 from ..utils.logger import get_logger
 from .http_base import HttpSinkFlusher
 
@@ -31,6 +40,30 @@ log = get_logger("async_sink")
 QUEUE_CAP = 256              # pending payloads per flusher
 RETRY_TTL_S = 300.0          # give up on a payload after this long
 RETRY_MAX_DELAY_S = 10.0
+
+_default_disk_buffer = None
+
+
+def set_default_disk_buffer(disk_buffer) -> None:
+    """Process-wide spill target for async sinks (the Application passes
+    its DiskBufferWriter; tests pass a scratch one).  Sinks initialized
+    before this call keep running without spill-on-open."""
+    global _default_disk_buffer
+    _default_disk_buffer = disk_buffer
+
+
+class _ReplayTarget:
+    """Adapter letting DiskBufferWriter.replay() feed an async sink: the
+    replayed SenderQueueItem's bytes re-enter the sink's own in-memory
+    queue (async sinks do not drain a SenderQueue)."""
+
+    def __init__(self, flusher: "AsyncSinkFlusher"):
+        self._flusher = flusher
+        self.sender_queue = self
+        self.queue_key = flusher.queue_key
+
+    def push(self, item: SenderQueueItem) -> bool:
+        return self._flusher._requeue_payload(item.data)
 
 
 class AsyncSinkFlusher(HttpSinkFlusher):
@@ -44,6 +77,9 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         self._qcv = threading.Condition(self._qlock)
         self._sender: Optional[threading.Thread] = None
         self._running = False
+        self.circuit: Optional[SinkCircuitBreaker] = None
+        self.disk_buffer = None
+        self._replay_pending = threading.Event()
 
     # -- subclass surface ---------------------------------------------------
 
@@ -58,6 +94,15 @@ class AsyncSinkFlusher(HttpSinkFlusher):
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         if not super().init(config, context):
             return False
+        if self.disk_buffer is None:
+            self.disk_buffer = _default_disk_buffer
+        self.circuit = SinkCircuitBreaker(
+            f"{context.pipeline_name}/{self.name}",
+            failure_threshold=int(config.get("BreakerFailureThreshold", 5)),
+            error_rate=float(config.get("BreakerErrorRate", 0.5)),
+            cooldown_s=float(config.get("BreakerCooldownSecs", 5.0)),
+            on_close=self._replay_pending.set,
+            pipeline=context.pipeline_name)
         self._running = True
         self._sender = threading.Thread(target=self._sender_loop,
                                         name=f"{self.name}-sender",
@@ -78,11 +123,91 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             self._queue.append((body, time.monotonic()))
             self._qcv.notify()
 
-    def _sender_loop(self) -> None:
-        delay = 0.2
+    def _requeue_payload(self, body: bytes) -> bool:
+        """Replayed disk-buffer payload re-enters the send queue with a
+        fresh TTL (its on-disk wait must not count against it).  At
+        capacity the replay is REFUSED (False) — shedding a live queued
+        payload to admit a replayed one would trade one loss for another;
+        the disk file stays put for a later round instead."""
+        with self._qcv:
+            if len(self._queue) >= QUEUE_CAP:
+                return False
+            self._queue.append((body, time.monotonic()))
+            self._qcv.notify()
+            return True
+
+    # -- spill / replay ------------------------------------------------------
+
+    def _spill_queue_on_open(self) -> bool:
+        """Move every pending payload to the disk buffer (open circuit).
+        Returns True when at least one payload moved; payloads the buffer
+        refuses (full) stay queued for the backoff path."""
+        if self.disk_buffer is None:
+            return False
+        moved = 0
+        identity = self.spill_identity()
         while True:
             with self._qcv:
-                while self._running and not self._queue:
+                if not self._queue:
+                    break
+                body, born = self._queue[0]
+            item = SenderQueueItem(body, len(body), flusher=self,
+                                   queue_key=self.queue_key)
+            if not self.disk_buffer.spill(item, identity):
+                break
+            moved += 1
+            if self.circuit is not None:
+                self.circuit.note_spilled()
+            with self._qcv:
+                # shedding may have rotated the deque while spilling: only
+                # drop the exact payload that reached disk
+                if self._queue and self._queue[0][0] is body:
+                    self._queue.popleft()
+        if moved:
+            log.warning("%s circuit open: spilled %d pending payloads to "
+                        "disk buffer", self.name, moved)
+        return moved > 0
+
+    def _replay_spilled(self) -> None:
+        if self.disk_buffer is None:
+            return
+        me = self.spill_identity()
+        target = _ReplayTarget(self)
+
+        def resolve(identity: dict):
+            if all(identity.get(k) == v for k, v in me.items()):
+                return target
+            return None
+
+        try:
+            self.disk_buffer.replay(resolve)
+        except Exception:  # noqa: BLE001
+            log.exception("%s circuit-close replay failed; files kept",
+                          self.name)
+
+    # -- sender loop ---------------------------------------------------------
+
+    def _sender_loop(self) -> None:
+        delay = 0.2
+        last_probe_replay = 0.0
+        while True:
+            if self._replay_pending.is_set():
+                self._replay_pending.clear()
+                self._replay_spilled()
+            # spill-on-open empties the in-memory queue — pull payloads
+            # back from disk as probe traffic once a cooldown has passed
+            # (a failing probe re-spills them)
+            now = time.monotonic()
+            if (self.circuit is not None and self.disk_buffer is not None
+                    and self.circuit.state is not BreakerState.CLOSED
+                    and now - last_probe_replay >= self.circuit.cooldown_s):
+                last_probe_replay = now
+                self._replay_spilled()
+            with self._qcv:
+                # single bounded wait (not a loop): an empty-queue wakeup
+                # must fall back through the outer loop so the open-circuit
+                # probe replay above still runs with nothing in memory
+                if self._running and not self._queue:
                     self._qcv.wait(timeout=0.5)
                 if not self._running and not self._queue:
                     return
@@ -92,6 +217,13 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 body, born = item
             if self.breaker is not None and not self.breaker.allow():
                 time.sleep(min(delay, 1.0))
+                continue
+            if self.circuit is not None and not self.circuit.allow_probe():
+                # open circuit: payloads go to disk instead of aging in
+                # memory toward the TTL drop; if the buffer is absent or
+                # full, fall back to plain pacing
+                if not self._spill_queue_on_open():
+                    time.sleep(min(delay, 0.5))
                 continue
             try:
                 self.deliver(body)
@@ -108,7 +240,24 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                                 self.name, e)
             if self.breaker is not None and ok is not None:
                 self.breaker.on_result(ok)
+            if self.circuit is not None:
+                if ok:
+                    self.circuit.on_success()
+                elif ok is not None:
+                    self.circuit.on_failure()
+                else:
+                    # permanent drop (non-retryable / TTL expired): no
+                    # clean health signal — release any held probe slot
+                    # so the breaker cannot wedge half-open
+                    self.circuit.on_inconclusive()
             if ok is False:
+                # a failure that leaves the circuit open spills NOW — the
+                # exponential backoff sleep outlasts the probe cooldown, so
+                # waiting for the next allow_probe() would never degrade
+                if (self.circuit is not None and self.circuit.is_open()
+                        and self._spill_queue_on_open()):
+                    delay = 0.2
+                    continue
                 time.sleep(delay)
                 delay = min(delay * 2, RETRY_MAX_DELAY_S)
                 continue

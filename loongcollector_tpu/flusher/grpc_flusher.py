@@ -16,6 +16,8 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from .. import chaos
+from ..chaos import ChaosFault
 from ..models import PipelineEventGroup
 from ..pipeline.plugin.interface import PluginContext
 from ..pipeline.queue.sender_queue import SenderQueueItem
@@ -23,6 +25,8 @@ from ..utils.logger import get_logger
 from .async_sink import AsyncSinkFlusher
 
 log = get_logger("grpc_flusher")
+
+FP_SEND = chaos.register_point("grpc_flusher.send")
 
 try:
     import grpc
@@ -69,9 +73,12 @@ class FlusherGrpc(AsyncSinkFlusher):
         return JsonSerializer().serialize(groups), {}
 
     def deliver(self, payload: bytes) -> None:
+        chaos.faultpoint(FP_SEND)
         self._call(payload, timeout=self.timeout)
 
     def retryable(self, exc: Exception) -> bool:
+        if isinstance(exc, ChaosFault):
+            return True     # injected faults model transient channel loss
         code = exc.code() if hasattr(exc, "code") else None
         return code in (grpc.StatusCode.UNAVAILABLE,
                         grpc.StatusCode.DEADLINE_EXCEEDED,

@@ -21,6 +21,7 @@ from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
 from ..pipeline.plugin.interface import Flusher, PluginContext
 from ..pipeline.serializer.json_serializer import JsonSerializer
+from ..runner.circuit import SinkCircuitBreaker
 from ..utils.logger import get_logger
 from .kafka_client import KafkaError, KafkaProducer
 
@@ -29,6 +30,9 @@ log = get_logger("kafka")
 
 class FlusherKafka(Flusher):
     name = "flusher_kafka"
+    # class-level default: test rigs (and tools) that bypass __init__ via
+    # __new__ still get a gate-free _send_loop
+    circuit: Optional[SinkCircuitBreaker] = None
 
     def __init__(self) -> None:
         super().__init__()
@@ -43,6 +47,7 @@ class FlusherKafka(Flusher):
         self._worker: Optional[threading.Thread] = None
         self._running = False
         self.max_retries = 5
+        self.circuit: Optional[SinkCircuitBreaker] = None
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -79,6 +84,12 @@ class FlusherKafka(Flusher):
             min_size_bytes=int(config.get("MinSizeBytes", 256 * 1024)),
             timeout_secs=float(config.get("TimeoutSecs", 1.0)))
         self.max_retries = int(config.get("MaxRetries", 5))
+        self.circuit = SinkCircuitBreaker(
+            f"{context.pipeline_name}/{self.name}",
+            failure_threshold=int(config.get("BreakerFailureThreshold", 5)),
+            error_rate=float(config.get("BreakerErrorRate", 0.5)),
+            cooldown_s=float(config.get("BreakerCooldownSecs", 5.0)),
+            pipeline=context.pipeline_name)
         self.batcher = Batcher(strategy, on_flush=self._flush_groups,
                                flusher_id=self.name,
                                pipeline_name=context.pipeline_name)
@@ -139,9 +150,25 @@ class FlusherKafka(Flusher):
                         self._send_queue.get_nowait()
                 except _queue.Empty:
                     continue
+            if self._running and self.circuit is not None \
+                    and not self.circuit.allow_probe():
+                # open circuit: park the batch on the retry deque for one
+                # cooldown instead of hammering a dead broker (attempt
+                # count unchanged — breaker waits don't burn retries).
+                # Once stop() clears _running, parking ends and batches
+                # drain through the bounded attempt budget as before, so
+                # shutdown stays bounded and close() never races sends.
+                retry.append((topic, records, attempt,
+                              time.monotonic() + self.circuit.cooldown_s))
+                time.sleep(0.05)
+                continue
             try:
                 self.producer.send(topic, records)
+                if self.circuit is not None:
+                    self.circuit.on_success()
             except KafkaError as e:
+                if self.circuit is not None:
+                    self.circuit.on_failure()
                 # partial-ack aware retry: re-send ONLY what the broker
                 # did not acknowledge (KafkaProduceError.unacked); acked
                 # batches must not be duplicated by the retry

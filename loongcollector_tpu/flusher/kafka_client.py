@@ -29,9 +29,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .. import chaos
 from ..utils.logger import get_logger
 
 log = get_logger("kafka")
+
+FP_PRODUCE = chaos.register_point("kafka_client.produce")
 
 API_PRODUCE = 0
 API_FETCH = 1
@@ -100,6 +103,10 @@ def crc32c(data: bytes) -> int:
             return int(lib.lct_crc32c(
                 arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                 len(arr), 0))
+    # deliberate capability probe, not a send path: ANY native-lib trouble
+    # (missing .so, ctypes mismatch) falls back to the pure-python table —
+    # there is no payload or signal to preserve here
+    # loonglint: disable=swallowed-fault
     except Exception:  # noqa: BLE001
         pass
     return _crc32c_py(data)
@@ -572,6 +579,25 @@ class KafkaProducer(KafkaClient):
 
     def send(self, topic: str,
              records: List[Tuple[Optional[bytes], bytes]]) -> None:
+        # chaos: "error" = broker unreachable before anything shipped (all
+        # records unacked); "partial" = only a window prefix reaches the
+        # broker — the prefix is sent for real, the suffix is reported
+        # unacked exactly like a mid-window connection drop, so the
+        # caller's partial-ack retry path is exercised without any loss
+        decision = chaos.faultpoint(FP_PRODUCE, exc=KafkaError)
+        if decision is not None and decision.action == chaos.ACTION_PARTIAL \
+                and len(records) > 1:
+            k = max(1, int(len(records) * decision.magnitude))
+            prefix, suffix = records[:k], records[k:]
+            try:
+                self.send(topic, prefix)
+            except KafkaProduceError as e:
+                raise KafkaProduceError(
+                    f"chaos partial window: {e}",
+                    list(e.unacked) + suffix) from e
+            raise KafkaProduceError(
+                f"chaos[{FP_PRODUCE}#{decision.hit}]: window cut after "
+                f"{k}/{len(records)} records", suffix)
         with self._lock:
             parts = self._topic_meta.get(topic)
         if not parts:

@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
+from .. import chaos
 from ..config.agent_v2_pb import (dec_varint, e_bytes, e_varint, enc_varint,
                                   iter_fields)
 from ..models import PipelineEventGroup
@@ -38,6 +39,8 @@ from .async_sink import AsyncSinkFlusher
 from .kafka_client import crc32c
 
 log = get_logger("pulsar")
+
+FP_SEND = chaos.register_point("pulsar.send")
 
 # BaseCommand.Type (PulsarApi.proto)
 CONNECT = 2
@@ -83,7 +86,14 @@ class PulsarError(RuntimeError):
 
 
 class PulsarProducer:
-    """One connection + one producer session on a broker."""
+    """One connection + one producer session on a broker.
+
+    Threading contract: the blocking send path (`send`) is owned by ONE
+    caller — FlusherPulsar's dedicated sender thread (async_sink.py), which
+    is also joined before close().  Socket I/O therefore runs lock-free
+    (the PR-1 loonglint debt: connect/reconnect under self._lock blocked
+    sibling senders behind broker latency); only sequence-id allocation
+    keeps a lock, held for an increment and nothing else."""
 
     def __init__(self, broker_url: str, topic: str,
                  timeout: float = 10.0):
@@ -95,7 +105,7 @@ class PulsarProducer:
         self._sock: Optional[socket.socket] = None
         self._seq = 0
         self._producer_name = ""
-        self._lock = threading.Lock()
+        self._seq_lock = threading.Lock()
 
     # -- wire ---------------------------------------------------------------
 
@@ -164,19 +174,22 @@ class PulsarProducer:
 
     def send(self, payload: bytes,
              properties: Optional[Dict[str, str]] = None) -> None:
-        """One message; blocks until SEND_RECEIPT (at-least-once)."""
-        with self._lock:
-            if self._sock is None:
-                self.connect()
+        """One message; blocks until SEND_RECEIPT (at-least-once).  Single
+        caller by contract (see class docstring) — broker I/O runs outside
+        any lock."""
+        chaos.faultpoint(FP_SEND, exc=PulsarError)
+        if self._sock is None:
+            self.connect()
+        with self._seq_lock:
             self._seq += 1
             seq = self._seq
-            try:
-                self._send_once(seq, payload, properties)
-            except (OSError, PulsarError):
-                # one reconnect attempt (broker restart / idle close)
-                self.close()
-                self.connect()
-                self._send_once(seq, payload, properties)
+        try:
+            self._send_once(seq, payload, properties)
+        except (OSError, PulsarError):
+            # one reconnect attempt (broker restart / idle close)
+            self.close()
+            self.connect()
+            self._send_once(seq, payload, properties)
 
     def _send_once(self, seq: int, payload: bytes, properties) -> None:
         # CommandSend{producer_id=1, sequence_id=2, num_messages=3}

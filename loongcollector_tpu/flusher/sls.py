@@ -15,6 +15,7 @@ import hmac
 import time
 from typing import Any, Dict, List
 
+from .. import chaos
 from ..models import PipelineEventGroup
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
@@ -24,6 +25,8 @@ from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
 from .http import FlusherHTTP, HttpRequest
 from .sls_client import EndpointPool, classify_response
+
+FP_POST = chaos.register_point("sls_client.post")
 
 
 class FlusherSLS(FlusherHTTP):
@@ -72,6 +75,9 @@ class FlusherSLS(FlusherHTTP):
         return bool(self.logstore)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
+        # a fault here rides the build_request-failure path: FlusherRunner
+        # backs the item off and feeds the sink circuit breaker
+        chaos.faultpoint(FP_POST)
         endpoint = (self.endpoint_pool.current() if self.endpoint_pool
                     else self.endpoint)
         item.tag["sls_endpoint"] = endpoint

@@ -24,11 +24,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
+from ... import chaos
 from ...models import EventGroupMetaKey, PipelineEventGroup, SourceBuffer
 
 DEFAULT_CHUNK = 512 * 1024
 SIGNATURE_SIZE = 1024
 ML_FLUSH_TIMEOUT_S = 5.0
+
+FP_READ = chaos.register_point("file_input.read")
 
 
 @dataclass
@@ -193,6 +196,10 @@ class LogFileReader:
         if fd is None:
             return None
         try:
+            # injected OSError = transient read failure (NFS hiccup,
+            # rotated-away fd): this poll round yields nothing, the next
+            # one re-reads from the unchanged offset — no bytes skipped
+            chaos.faultpoint(FP_READ, exc=OSError)
             size = os.fstat(fd).st_size
         except OSError:
             return None

@@ -78,6 +78,7 @@ class AlarmType(str, enum.Enum):
     DISCARD_DATA = "DISCARD_DATA_ALARM"
     DISCARD_SECONDARY = "DISCARD_SECONDARY_ALARM"
     SECONDARY_READ_WRITE = "SECONDARY_READ_WRITE_ALARM"
+    SINK_CIRCUIT_OPEN = "SINK_CIRCUIT_OPEN_ALARM"
     # checkpoints / state
     CHECKPOINT_FAIL = "CHECKPOINT_ALARM"
     CHECKPOINT_V2 = "CHECKPOINT_V2_ALARM"

@@ -33,11 +33,14 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import chaos
 from ..utils.logger import get_logger
 
 log = get_logger("device_plane")
 
 _DEFAULT_BUDGET = 64 * 1024 * 1024  # bytes of packed rows in flight
+
+FP_SUBMIT = chaos.register_point("device_plane.submit")
 
 _tls = threading.local()
 
@@ -239,6 +242,10 @@ class DevicePlane:
         materialisation point."""
         self._acquire(nbytes, should_abort, on_wait)
         try:
+            # after _acquire, inside the try: an injected fault behaves
+            # exactly like a kernel raising at dispatch — errored future,
+            # budget released at the consume point (result/release)
+            chaos.faultpoint(FP_SUBMIT)
             outputs = kernel(*args)
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)

@@ -19,10 +19,13 @@ import threading
 from collections import deque
 from typing import Callable, Deque, List, Optional
 
+from ... import chaos
 from ...models import PipelineEventGroup
 
 DEFAULT_CAPACITY = 20
 LOW_WATERMARK_RATIO = 2 / 3
+
+FP_PUSH = chaos.register_point("bounded_queue.push")
 
 
 class QueueStatus(enum.Enum):
@@ -68,6 +71,14 @@ class BoundedProcessQueue:
     # -- producer side ------------------------------------------------------
 
     def push(self, group: PipelineEventGroup) -> bool:
+        # an exception cannot propagate to input threads, so an injected
+        # "error" degrades in this queue's own vocabulary: a watermark-style
+        # rejection the producer already handles with feedback-blocking
+        decision = chaos.faultpoint(FP_PUSH, raise_=False)
+        if decision is not None and decision.action == chaos.ACTION_ERROR:
+            with self._lock:
+                self.total_rejected += 1
+            return False
         with self._lock:
             if not self._valid_to_push:
                 self.total_rejected += 1

@@ -24,12 +24,18 @@ import time
 import uuid
 from typing import Callable, List, Optional, Tuple
 
+from .. import chaos
+from ..chaos import ChaosFault
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..utils.logger import get_logger
 
 log = get_logger("disk_buffer")
 
 MAX_BUFFER_BYTES = 512 * 1024 * 1024
+
+FP_WRITE = chaos.register_point("disk_buffer.write")
+FP_REPLAY = chaos.register_point("disk_buffer.replay")
 
 
 class DiskBufferWriter:
@@ -71,15 +77,31 @@ class DiskBufferWriter:
         path = os.path.join(self.directory, name)
         tmp = path + ".tmp"
         try:
+            # injected OSError rides the real write-failure path below;
+            # a "corrupt" decision garbles the file AFTER the atomic
+            # rename (corrupt-at-rest — replay must quarantine, not abort)
+            decision = chaos.faultpoint(FP_WRITE, exc=OSError)
+            # crash-safe: temp file + fsync + atomic rename — a crash or
+            # power cut mid-spill leaves either the complete old state or
+            # a stray .tmp (ignored by pending()), never a torn .lcb
             with open(tmp, "wb") as f:
                 f.write(json.dumps(header).encode() + b"\n")
                 f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            if decision is not None and decision.action == chaos.ACTION_CORRUPT:
+                with open(path, "r+b") as f:
+                    f.write(b"\x00chaos-corrupt\x00")
         except OSError as e:
             log.error("disk buffer write failed: %s", e)
             with self._lock:
                 if self._total is not None:
                     self._total -= len(item.data)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
             return False
         return True
 
@@ -134,9 +156,16 @@ class DiskBufferWriter:
         for path in self.pending():
             if count >= limit:
                 break
+            try:
+                chaos.faultpoint(FP_REPLAY)
+            except ChaosFault:
+                continue     # transient replay fault: file stays for later
             status, header, payload = self._read_classified(path)
             if status == "corrupt":
-                self._remove(path)
+                # quarantine, don't delete: a malformed file is evidence
+                # (torn write from a crash, bit rot, injected corruption)
+                # and must neither abort the replay loop nor vanish
+                self._quarantine(path)
                 continue
             if status == "locked":   # undecryptable today ≠ deletable
                 continue
@@ -146,12 +175,41 @@ class DiskBufferWriter:
             item = SenderQueueItem(payload, header.get("raw_size", len(payload)),
                                    flusher=flusher,
                                    queue_key=flusher.queue_key)
-            flusher.sender_queue.push(item)
+            if flusher.sender_queue.push(item) is False:
+                # target refused (replay adapter at capacity): the file is
+                # the only copy — keep it for a later round
+                continue
             self._remove(path)
             count += 1
         if count:
             log.info("replayed %d buffered payloads", count)
         return count
+
+    def _quarantine(self, path: str) -> None:
+        """Rename a malformed buffer file to `.lcb.bad` (out of pending())
+        and alarm — operators can inspect or purge, replay moves on."""
+        try:
+            size = os.path.getsize(path)
+            os.replace(path, path + ".bad")
+        except OSError as e:
+            log.error("quarantine of %s failed: %s", path, e)
+            return
+        with self._lock:
+            if self._total is not None:
+                self._total = max(0, self._total - size)
+        log.error("malformed buffer file quarantined: %s.bad", path)
+        AlarmManager.instance().send_alarm(
+            AlarmType.SECONDARY_READ_WRITE,
+            f"malformed disk-buffer file quarantined ({size} bytes)",
+            AlarmLevel.ERROR)
+
+    def quarantined(self) -> List[str]:
+        try:
+            return sorted(os.path.join(self.directory, f)
+                          for f in os.listdir(self.directory)
+                          if f.endswith(".lcb.bad"))
+        except OSError:
+            return []
 
     def _remove(self, path: str) -> None:
         try:

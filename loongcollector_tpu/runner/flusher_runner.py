@@ -4,13 +4,21 @@ Reference: core/runner/FlusherRunner.cpp — single thread (:168); pops
 available items (rate + AIMD gates consulted inside the queues), dispatches
 by sink type (:219), exponential backoff on failure (100 ms → 10 s,
 :133-141), global send-byte rate limit (:202-204).
+
+On top of the reference shape, each sink gets a three-state circuit
+breaker (runner/circuit.py): a sink that fails persistently OPENs its
+breaker, and instead of spinning payloads through the retry heap the
+runner routes them straight to the DiskBufferWriter (spill-on-open
+degradation).  When the half-open probe succeeds the breaker re-closes
+and the runner replays the spilled payloads back through the live
+flusher — the unified resilience policy ISSUE 2 asks for.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import heapq
 
@@ -19,7 +27,9 @@ from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
                                            SendingStatus)
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from ..utils import flags
 from ..utils.logger import get_logger
+from .circuit import BreakerState, SinkCircuitBreaker
 from .http_sink import HttpSink
 
 log = get_logger("flusher_runner")
@@ -28,11 +38,19 @@ RETRY_BASE_S = 0.1
 RETRY_MAX_S = 10.0
 MAX_TRY_BEFORE_SPILL = 20  # persistent failure → disk buffer (if configured)
 
+# reference FlusherRunner.cpp:223-227 enable_full_drain_mode: spill what the
+# exit drain budget could not flush instead of dropping it
+flags.DEFINE_FLAG_BOOL("enable_full_drain_mode",
+                       "spill undrained payloads to disk on exit", True)
+
 
 class FlusherRunner:
     def __init__(self, sender_queue_manager: SenderQueueManager,
                  http_sink: Optional[HttpSink] = None,
-                 max_bytes_per_sec: int = 0, disk_buffer=None):
+                 max_bytes_per_sec: int = 0, disk_buffer=None,
+                 breaker_failure_threshold: int = 5,
+                 breaker_error_rate: float = 0.5,
+                 breaker_cooldown_s: float = 5.0):
         self.sqm = sender_queue_manager
         self.http_sink = http_sink
         self.disk_buffer = disk_buffer
@@ -42,16 +60,55 @@ class FlusherRunner:
         self._retry_heap = []
         self._retry_lock = threading.Lock()
         self._retry_thread: Optional[threading.Thread] = None
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_error_rate = breaker_error_rate
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._breakers: Dict[int, SinkCircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
+        # flushers seen at spill time, keyed by spill identity — the
+        # resolver for breaker-close replay (the Application's periodic
+        # replay handles flushers this runner never met)
+        self._spilled_flushers: Dict[Tuple[str, str, str], object] = {}
+        self._replay_pending = threading.Event()
         self.metrics = MetricsRecord(category="runner",
                                      labels={"runner": "flusher"})
         self.out_items = self.metrics.counter("out_items_total")
         self.out_bytes = self.metrics.counter("out_size_bytes")
+        self.spilled_items = self.metrics.counter("spilled_items_total")
 
     def init(self) -> None:
         self._running = True
         self._thread = threading.Thread(target=self._run, name="flusher-runner",
                                         daemon=True)
         self._thread.start()
+
+    # -- circuit breakers ----------------------------------------------------
+
+    def breaker_for(self, item: SenderQueueItem) -> SinkCircuitBreaker:
+        key = item.queue_key
+        with self._breaker_lock:
+            br = self._breakers.get(key)
+            if br is None:
+                flusher = item.flusher
+                ident = (flusher.spill_identity() if flusher is not None
+                         else {})
+                name = (f"{ident.get('pipeline', '')}/"
+                        f"{ident.get('flusher_type', 'unknown')}")
+                br = SinkCircuitBreaker(
+                    name,
+                    failure_threshold=self.breaker_failure_threshold,
+                    error_rate=self.breaker_error_rate,
+                    cooldown_s=self.breaker_cooldown_s,
+                    on_close=self._replay_pending.set,
+                    pipeline=ident.get("pipeline", ""))
+                self._breakers[key] = br
+            return br
+
+    def breakers(self) -> Dict[int, SinkCircuitBreaker]:
+        with self._breaker_lock:
+            return dict(self._breakers)
+
+    # -- lifecycle -----------------------------------------------------------
 
     def stop(self, drain: bool = True, timeout: float = 5.0) -> None:
         if drain:
@@ -66,21 +123,45 @@ class FlusherRunner:
         # (reference FlusherRunner.cpp:223-227 full-drain/spill on exit).
         # Items still in-flight in the HTTP sink are skipped — their pending
         # send may yet succeed, and spilling them would double-deliver.
-        if self.disk_buffer is not None:
-            for q in list(self.sqm._queues.values()):
-                with q._lock:
-                    items = [i for i in q._items
-                             if not getattr(i, "in_flight", False)
-                             and "eo_cp" not in i.tag]
-                for item in items:
-                    flusher = item.flusher
-                    if flusher is None:
-                        continue
-                    if self.disk_buffer.spill(item, flusher.spill_identity()):
-                        q.remove(item)
+        if self.disk_buffer is None \
+                or not flags.get_flag("enable_full_drain_mode"):
+            return
+        # the retry heap first: its items are normally still queued (and get
+        # spilled below), but items whose queue was deleted mid-backoff are
+        # reachable ONLY from the heap — dropping the heap would drop them
+        with self._retry_lock:
+            heap_items = [entry[2] for entry in self._retry_heap]
+            self._retry_heap.clear()
+        for q in list(self.sqm._queues.values()):
+            with q._lock:
+                items = [i for i in q._items
+                         if not getattr(i, "in_flight", False)]
+            for item in items:
+                self._spill_item(item)
+        for item in heap_items:
+            if getattr(item, "in_flight", False):
+                continue
+            if self.sqm.get_queue(item.queue_key) is not None:
+                continue        # still queued: the loop above owned it
+            self._spill_item(item)
 
     def _run(self) -> None:
+        last_probe_replay = 0.0
         while self._running:
+            if self._replay_pending.is_set():
+                self._replay_pending.clear()
+                self._replay_spilled()
+            # a fully-spilled sink has no queued traffic left to drive the
+            # half-open probe: when any breaker is off-CLOSED and a cooldown
+            # has passed, pull spilled payloads back as probe traffic (a
+            # failing probe just re-spills them)
+            now = time.monotonic()
+            if (self.disk_buffer is not None
+                    and now - last_probe_replay >= self.breaker_cooldown_s
+                    and any(br.state is not BreakerState.CLOSED
+                            for br in self.breakers().values())):
+                last_probe_replay = now
+                self._replay_spilled()
             items = self.sqm.get_available_items()
             if not items:
                 time.sleep(0.02)
@@ -104,17 +185,76 @@ class FlusherRunner:
         if q is not None:
             q.reset_item_status(item)
 
+    # -- spill / replay ------------------------------------------------------
+
+    def _spill_item(self, item: SenderQueueItem, breaker=None) -> bool:
+        """Route one undeliverable item to the disk buffer, freeing its
+        queue slot.  False when spilling is impossible (no buffer, buffer
+        full, exactly-once item) — the caller falls back to backoff."""
+        flusher = item.flusher
+        if (self.disk_buffer is None or flusher is None
+                or "eo_cp" in item.tag):
+            return False
+        identity = flusher.spill_identity()
+        if not self.disk_buffer.spill(item, identity):
+            return False
+        self.spilled_items.add(1)
+        if breaker is not None:
+            breaker.note_spilled()
+        self._spilled_flushers[(identity.get("pipeline", ""),
+                                identity.get("flusher_type", ""),
+                                identity.get("plugin_id", ""))] = flusher
+        self.sqm.remove_item(item)
+        return True
+
+    def _resolve_spilled(self, identity: dict):
+        key = (identity.get("pipeline", ""),
+               identity.get("flusher_type", ""),
+               identity.get("plugin_id", ""))
+        flusher = self._spilled_flushers.get(key)
+        if flusher is None:
+            return None
+        # a pipeline swap deletes the sender queue: replaying into the
+        # orphaned queue object would strand the payload AND delete its
+        # file — drop the stale registry entry and keep the file for the
+        # Application's resolver (which knows the live pipelines)
+        q = self.sqm.get_queue(getattr(flusher, "queue_key", -1))
+        if q is None or q is not getattr(flusher, "sender_queue", None):
+            self._spilled_flushers.pop(key, None)
+            return None
+        return flusher
+
+    def _replay_spilled(self) -> None:
+        if self.disk_buffer is None:
+            return
+        try:
+            self.disk_buffer.replay(self._resolve_spilled)
+        except Exception:  # noqa: BLE001
+            log.exception("breaker-close replay failed; files kept")
+
+    # -- dispatch ------------------------------------------------------------
+
     def _dispatch(self, item: SenderQueueItem) -> None:
         flusher = item.flusher
         if flusher is None or self.http_sink is None:
             self._release_limiters(item)
             self.sqm.remove_item(item)
             return
+        breaker = self.breaker_for(item)
+        if not breaker.allow_probe():
+            # open circuit: degrade to disk instead of burning retries
+            self._release_limiters(item)
+            if not self._spill_item(item, breaker):
+                self._backoff_retry(item)
+            return
         try:
             request = flusher.build_request(item)
         except Exception:  # noqa: BLE001
             log.exception("build_request failed; backing off")
             self._release_limiters(item)
+            breaker.on_failure()
+            if breaker.is_open() and self._spill_item(item, breaker):
+                return
             self._backoff_retry(item)
             return
         item.in_flight = True
@@ -125,6 +265,7 @@ class FlusherRunner:
         item.in_flight = False
         flusher = item.flusher
         q = self.sqm.get_queue(item.queue_key)
+        breaker = self.breaker_for(item)
         verdict = "drop"
         cb_failed = True
         try:
@@ -143,6 +284,19 @@ class FlusherRunner:
                     cl.on_fail(slow=True)
                 elif verdict == "retry":
                     cl.on_fail(slow=(status == 429))
+        if verdict == "ok":
+            breaker.on_success()
+        elif verdict in ("retry", "retry_slow"):
+            breaker.on_failure()
+        elif not cb_failed and status > 0:
+            # permanent rejection with a real HTTP status: the payload is
+            # dropped but the ENDPOINT answered — that is a healthy sink
+            # (and a probe in flight must not wedge the slot)
+            breaker.on_success()
+        else:
+            # callback blew up / status unknown: no health signal either
+            # way — release a held probe slot without recording a sample
+            breaker.on_inconclusive()
         if verdict == "retry_slow":
             AlarmManager.instance().send_alarm(
                 AlarmType.SEND_QUOTA_EXCEED,
@@ -162,14 +316,12 @@ class FlusherRunner:
                  "payload dropped after permanent rejection ")
                 + f"(status {status})", AlarmLevel.ERROR)
         if verdict in ("retry", "retry_slow"):
-            if (self.disk_buffer is not None
-                    and item.try_count >= MAX_TRY_BEFORE_SPILL
-                    and flusher is not None
-                    and "eo_cp" not in item.tag):
-                # persistent failure: spill to disk and free the queue slot
-                # (reference DiskBufferWriter semantics)
-                if self.disk_buffer.spill(item, flusher.spill_identity()):
-                    self.sqm.remove_item(item)
+            # spill-on-open: an open breaker (or plain try-count exhaustion)
+            # moves the payload to disk and frees the queue slot
+            # (reference DiskBufferWriter semantics)
+            if (breaker.is_open()
+                    or item.try_count >= MAX_TRY_BEFORE_SPILL):
+                if self._spill_item(item, breaker):
                     return
             self._backoff_retry(item)
             return
@@ -207,3 +359,7 @@ class FlusherRunner:
             q = self.sqm.get_queue(item.queue_key)
             if q is not None:
                 q.reset_item_status(item)
+            else:
+                # queue deleted while the item waited out its backoff
+                # (pipeline swap): spill instead of silently vanishing
+                self._spill_item(item)

@@ -35,11 +35,14 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
+from .. import chaos
 from ..utils.logger import get_logger
 
 log = get_logger("http_sink")
 
 _MAX_REDIRECTS = 3
+
+FP_SEND = chaos.register_point("http_sink.send")
 
 
 class _Dest:
@@ -91,8 +94,8 @@ class HttpSink:
                 fut = asyncio.run_coroutine_threadsafe(
                     self._drain(5.0), loop)
                 fut.result(timeout=8)
-            except Exception:  # noqa: BLE001 — loop may already be closing
-                pass
+            except Exception as e:  # noqa: BLE001 — loop may already be closing
+                log.warning("http sink drain interrupted at stop: %r", e)
             loop.call_soon_threadsafe(self._shutdown_loop)
             if self._thread is not None:
                 self._thread.join(timeout=5)
@@ -173,6 +176,10 @@ class HttpSink:
     async def _transfer(self, request, on_done) -> None:
         status, body = 0, b""
         try:
+            # injected faults surface as status 0 + error body — the exact
+            # shape a refused connect / RST produces, so flushers classify
+            # them through their real retry verdicts
+            chaos.faultpoint(FP_SEND, exc=ConnectionError)
             url = request.url
             for _ in range(_MAX_REDIRECTS):
                 status, body, location = await self._execute_once(url, request)

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Full chaos soak — the long-running sibling of scripts/lint.sh
+# (docs/robustness.md).  Tier-1 already runs the fast fixed-seed subset of
+# tests/test_chaos_soak.py; this script adds the extended seed matrix
+# (`-m slow`) plus a loonglint pass so a soak run reports on both the
+# dynamic and static robustness gates.
+#
+#   scripts/soak.sh                 # full soak, default seeds
+#   LOONG_CHAOS_SEED=123 scripts/soak.sh --reproduce
+#       # re-run ONLY the tier-1 storm matrix under one env-driven seed
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--reproduce" ]]; then
+    seed="${LOONG_CHAOS_SEED:?--reproduce needs LOONG_CHAOS_SEED set}"
+    echo "== reproducing storm seed ${seed} =="
+    JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_chaos_soak.py::TestSinkStorm" \
+        "tests/test_chaos_soak.py::TestDeviceStorm" \
+        -q -p no:cacheprovider -k "[${seed}]"
+    exit 0
+fi
+
+echo "== loonglint =="
+python -m loongcollector_tpu.analysis
+
+echo "== chaos soak: tier-1 seed matrix =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+echo "== chaos soak: extended seed matrix (slow) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
+    -q -m slow -p no:cacheprovider
+
+echo "soak OK"

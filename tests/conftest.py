@@ -47,6 +47,9 @@ def _dump_then_terminate(signum, frame):
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soaks excluded from tier-1 (-m 'not slow'); "
+        "scripts/soak.sh runs them")
     # The dump must reach the REAL stderr: during a test, pytest's
     # fd-level capture points fd 2 at a per-test temp file that dies with
     # the process.  At conftest IMPORT capture is already active (fd 2 is

@@ -1,0 +1,644 @@
+"""loongchaos soak: seeded fault storms against the real send/dispatch
+stack, asserting the core robustness invariants (ISSUE 2 acceptance):
+
+  * at-least-once sinks lose no event across fault/recover cycles
+    (duplicates allowed, holes never);
+  * DevicePlane.inflight_bytes() returns to zero after every storm;
+  * every breaker that OPENs re-closes once faults clear;
+  * re-running a seed reproduces the identical per-point fault schedule;
+  * with chaos disabled every fault point is a no-op check.
+
+The tier-1 subset runs 8 fixed seeds with short storms; the full soak
+(`-m slow`, scripts/soak.sh) widens both.
+"""
+
+import http.server
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import chaos
+from loongcollector_tpu.chaos import ChaosFault, ChaosPlan, FaultSpec
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                 LatencyInjectedKernel)
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.pipeline.queue.sender_queue import (
+    SenderQueueItem, SenderQueueManager)
+from loongcollector_tpu.runner import flusher_runner as fr_mod
+from loongcollector_tpu.runner.circuit import BreakerState
+from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+from loongcollector_tpu.runner.flusher_runner import FlusherRunner
+from loongcollector_tpu.runner.http_sink import HttpSink
+
+from conftest import wait_for
+
+SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240803)
+
+SOAK_SEEDS = tuple(range(100, 124))      # full soak: 24 more seeds
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """No chaos plan leaks between tests; drain the alarm singleton."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+    AlarmManager.instance().flush()
+
+
+@pytest.fixture()
+def fast_retries(monkeypatch):
+    """Soak-speed backoff so a 20-fault storm resolves in seconds."""
+    monkeypatch.setattr(fr_mod, "RETRY_BASE_S", 0.02)
+    monkeypatch.setattr(fr_mod, "RETRY_MAX_S", 0.25)
+
+
+# ---------------------------------------------------------------------------
+# harness
+
+
+class _RecordingHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.server.rec_lock:
+            self.server.received.add(bytes(body))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def recording_server():
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _RecordingHandler)
+    server.received = set()
+    server.rec_lock = threading.Lock()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server
+    server.shutdown()
+
+
+class _FakeFlusher:
+    name = "flusher_fake"
+    plugin_id = "flusher_fake/0"
+    context = None
+    sender_queue = None
+    queue_key = 0
+
+    def __init__(self, url):
+        self.url = url
+
+    def build_request(self, item):
+        from loongcollector_tpu.flusher.http import HttpRequest
+        return HttpRequest("POST", self.url, {}, item.data, timeout=5)
+
+    def on_send_done(self, item, status, body):
+        if 200 <= status < 300:
+            return "ok"
+        if status in (429, 500, 502, 503, 504) or status <= 0:
+            return "retry"
+        return "drop"
+
+    def spill_identity(self):
+        return {"pipeline": "t", "flusher_type": self.name,
+                "plugin_id": self.plugin_id}
+
+
+def _drive_sink_storm(seed, server, tmp_path, n_payloads=12,
+                      max_faults=20, timeout=60.0):
+    """One seeded storm through sender queue → FlusherRunner → HttpSink,
+    faults injected at http_sink.send.  Returns (payloads, runner)."""
+    sqm = SenderQueueManager()
+    q = sqm.create_or_reuse_queue(1, capacity=n_payloads + 4)
+    sink = HttpSink(workers=2)
+    sink.init()
+    db = DiskBufferWriter(str(tmp_path / f"buf{seed}"))
+    runner = FlusherRunner(sqm, sink, disk_buffer=db,
+                           breaker_failure_threshold=3,
+                           breaker_cooldown_s=0.15)
+    runner.init()
+    url = f"http://127.0.0.1:{server.server_address[1]}/s{seed}"
+    flusher = _FakeFlusher(url)
+    flusher.queue_key = 1
+    flusher.sender_queue = q
+    payloads = {f"payload-{seed}-{i:03d}".encode() for i in range(n_payloads)}
+    try:
+        chaos.install(ChaosPlan(seed, {
+            "http_sink.send": FaultSpec(
+                prob=0.55, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+                delay_range=(0.001, 0.005), max_faults=max_faults)}))
+        for p in sorted(payloads):
+            q.push(SenderQueueItem(p, len(p), flusher=flusher, queue_key=1))
+        assert wait_for(lambda: payloads <= server.received,
+                        timeout=timeout), (
+            f"seed {seed}: lost {len(payloads - server.received)} payloads; "
+            f"schedule={chaos.schedule()[:20]}")
+        # faults cleared: every opened breaker must re-close
+        assert wait_for(lambda: all(
+            br.state is BreakerState.CLOSED
+            for br in runner.breakers().values()), timeout=20), (
+            f"seed {seed}: breaker stuck "
+            f"{[br.state for br in runner.breakers().values()]}")
+        return payloads, runner
+    finally:
+        chaos.uninstall()
+        runner.stop(drain=False)
+        sink.stop()
+
+
+# ---------------------------------------------------------------------------
+# disabled-plane contract
+
+
+class TestDisabledPlane:
+    def test_faultpoint_is_noop_when_disabled(self):
+        assert not chaos.is_active()
+        for point in chaos.registered_points():
+            assert chaos.faultpoint(point, exc=RuntimeError) is None
+        assert chaos.hit_counts() == {}
+        assert chaos.schedule() == []
+
+    def test_registered_catalogue_covers_issue_boundaries(self):
+        # import the modules that register lazily-loaded points
+        import loongcollector_tpu.flusher.grpc_flusher  # noqa: F401
+        import loongcollector_tpu.flusher.kafka_client  # noqa: F401
+        import loongcollector_tpu.flusher.pulsar  # noqa: F401
+        import loongcollector_tpu.flusher.sls  # noqa: F401
+        import loongcollector_tpu.input.file.reader  # noqa: F401
+        pts = set(chaos.registered_points())
+        assert {"http_sink.send", "kafka_client.produce", "pulsar.send",
+                "grpc_flusher.send", "sls_client.post", "disk_buffer.write",
+                "disk_buffer.replay", "device_plane.submit",
+                "bounded_queue.push", "file_input.read"} <= pts
+
+    def test_env_activation(self):
+        assert not chaos.install_from_env({})
+        assert not chaos.install_from_env({"LOONG_CHAOS_SEED": "bogus"})
+        assert chaos.install_from_env({"LOONG_CHAOS_SEED": "42"})
+        assert chaos.is_active()
+        assert chaos.current_plan().seed == 42
+
+
+# ---------------------------------------------------------------------------
+# determinism
+
+
+def _drive_points(plan, rounds=150):
+    chaos.install(plan)
+    try:
+        for _ in range(rounds):
+            try:
+                chaos.faultpoint("http_sink.send", exc=RuntimeError)
+            except RuntimeError:
+                pass
+            chaos.faultpoint("kafka_client.produce", raise_=False)
+            try:
+                chaos.faultpoint("device_plane.submit")
+            except ChaosFault:
+                pass
+        return chaos.schedule_by_point()
+    finally:
+        chaos.uninstall()
+
+
+class TestDeterminism:
+    RULES = {
+        "http_sink.send": FaultSpec(prob=0.4, kinds=chaos.ALL_ACTIONS,
+                                    delay_range=(0.0, 0.0)),
+        "kafka_client.produce": FaultSpec(prob=0.3,
+                                          kinds=(chaos.ACTION_PARTIAL,),
+                                          delay_range=(0.0, 0.0)),
+        "device_plane.submit": FaultSpec(prob=0.2, delay_range=(0.0, 0.0)),
+    }
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_same_seed_identical_schedule(self, seed):
+        s1 = _drive_points(ChaosPlan(seed, dict(self.RULES)))
+        s2 = _drive_points(ChaosPlan(seed, dict(self.RULES)))
+        assert s1 == s2, f"seed {seed} schedule not reproducible"
+        assert s1, f"seed {seed} injected nothing in 150 rounds"
+
+    def test_different_seeds_diverge(self):
+        s1 = _drive_points(ChaosPlan(1, dict(self.RULES)))
+        s2 = _drive_points(ChaosPlan(2, dict(self.RULES)))
+        assert s1 != s2
+
+    def test_hit_order_across_threads_irrelevant_per_point(self):
+        """Per-point decisions depend only on (seed, point, hit index):
+        hammer the same plan from many threads, then compare the per-point
+        schedules against a single-threaded run."""
+        plan_mt = ChaosPlan(5, {"p.x": FaultSpec(prob=0.5,
+                                                 delay_range=(0.0, 0.0))})
+        chaos.install(plan_mt)
+        hits_per_thread, nthreads = 40, 4
+
+        def worker():
+            for _ in range(hits_per_thread):
+                chaos.faultpoint("p.x", raise_=False)
+
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        mt = chaos.schedule_by_point()
+        chaos.uninstall()
+
+        chaos.install(ChaosPlan(5, {"p.x": FaultSpec(
+            prob=0.5, delay_range=(0.0, 0.0))}))
+        for _ in range(hits_per_thread * nthreads):
+            chaos.faultpoint("p.x", raise_=False)
+        st = chaos.schedule_by_point()
+        chaos.uninstall()
+        assert sorted(mt.get("p.x", [])) == sorted(st.get("p.x", []))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 storm matrix
+
+
+class TestSinkStorm:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_zero_loss_and_breakers_reclose(self, seed, recording_server,
+                                            tmp_path, fast_retries):
+        payloads, _ = _drive_sink_storm(seed, recording_server, tmp_path)
+        assert payloads <= recording_server.received
+        counts = chaos.fault_counts()
+        assert counts.get("http_sink.send", 0) > 0, (
+            f"seed {seed} injected no faults — storm did not happen")
+
+
+class TestDeviceStorm:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_inflight_returns_to_zero(self, seed):
+        plane = DevicePlane(budget_bytes=8 * 1024)
+        kernel = LatencyInjectedKernel(lambda x: x * 2, rtt_s=0.0005)
+        chaos.install(ChaosPlan(seed, {"device_plane.submit": FaultSpec(
+            prob=0.5, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+            delay_range=(0.0, 0.002), max_faults=40)}))
+        injected = []
+        oks = []
+
+        def worker(tid):
+            arr = np.arange(8, dtype=np.int64)
+            for _ in range(25):
+                fut = plane.submit(kernel, (arr,), nbytes=1024)
+                try:
+                    out = fut.result()
+                    oks.append((tid, int(out[0][0])))
+                except ChaosFault:
+                    injected.append(tid)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        chaos.uninstall()
+        assert plane.inflight_bytes() == 0, (
+            f"seed {seed}: {plane.inflight_bytes()} bytes stranded")
+        assert len(oks) + len(injected) == 4 * 25
+        # storm actually stormed, and the plane still works afterwards
+        assert injected, f"seed {seed} injected nothing"
+        fut = plane.submit(kernel, (np.arange(8, dtype=np.int64),),
+                           nbytes=512)
+        assert fut.result()[0][0] == 0
+        assert plane.inflight_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# kafka partial acks
+
+
+class TestKafkaPartialAck:
+    def test_window_prefix_acked_suffix_retried(self):
+        from test_kafka import FakeBroker
+        from loongcollector_tpu.flusher.kafka_client import (
+            KafkaProducer, KafkaProduceError)
+        broker = FakeBroker()
+        broker.start()
+        try:
+            p = KafkaProducer([f"127.0.0.1:{broker.port}"], acks=-1,
+                              timeout_ms=5000)
+            records = [(None, f"rec-{i}".encode()) for i in range(6)]
+            chaos.install(ChaosPlan(9, {"kafka_client.produce": FaultSpec(
+                prob=1.0, kinds=(chaos.ACTION_PARTIAL,), max_faults=1)}))
+            with pytest.raises(KafkaProduceError) as ei:
+                p.send("logs", records)
+            unacked = ei.value.unacked
+            assert 0 < len(unacked) < 6, "window must be cut, not dropped"
+            # the acked prefix reached the broker for real
+            prefix = [v for _, v in records[:6 - len(unacked)]]
+            blob = b"".join(b for _, _, b in broker.produced)
+            for v in prefix:
+                assert v in blob, f"acked prefix record {v} never shipped"
+            # the retry (faults exhausted: max_faults=1) completes the set
+            p.send("logs", unacked)
+            blob = b"".join(b for _, _, b in broker.produced)
+            for _, v in records:
+                assert v in blob, f"record {v} lost across partial ack"
+            p.close()
+        finally:
+            chaos.uninstall()
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# disk buffer: corrupt-at-rest → quarantine, crash-safe spill
+
+
+class TestDiskBufferChaos:
+    def _spill(self, db, body, flusher):
+        item = SenderQueueItem(body, len(body), flusher=flusher, queue_key=1)
+        assert db.spill(item, flusher.spill_identity())
+
+    def test_corrupt_at_rest_quarantined_replay_continues(self, tmp_path):
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        flusher = _FakeFlusher("http://x/")
+
+        class _Q:
+            def __init__(self):
+                self.items = []
+
+            def push(self, item):
+                self.items.append(item)
+
+        flusher.sender_queue = _Q()
+        chaos.install(ChaosPlan(4, {"disk_buffer.write": FaultSpec(
+            prob=1.0, kinds=(chaos.ACTION_CORRUPT,), max_faults=1)}))
+        self._spill(db, b"first-corrupted", flusher)   # fault #1: corrupted
+        self._spill(db, b"second-intact", flusher)
+        chaos.uninstall()
+        assert len(db.pending()) == 2
+        AlarmManager.instance().flush()
+        replayed = db.replay(lambda identity: flusher)
+        # the corrupt file must not abort the loop: the intact one replays
+        assert replayed == 1
+        assert [i.data for i in flusher.sender_queue.items] == \
+            [b"second-intact"]
+        assert len(db.quarantined()) == 1
+        assert db.pending() == []
+        alarms = AlarmManager.instance().flush()
+        assert any(a["alarm_type"] == AlarmType.SECONDARY_READ_WRITE.value
+                   for a in alarms)
+
+    def test_replay_fault_keeps_file_for_later(self, tmp_path):
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        flusher = _FakeFlusher("http://x/")
+
+        class _Q:
+            def __init__(self):
+                self.items = []
+
+            def push(self, item):
+                self.items.append(item)
+
+        flusher.sender_queue = _Q()
+        self._spill(db, b"payload-a", flusher)
+        chaos.install(ChaosPlan(4, {"disk_buffer.replay": FaultSpec(
+            prob=1.0, max_faults=1)}))
+        assert db.replay(lambda identity: flusher) == 0   # injected fault
+        assert len(db.pending()) == 1                     # file survives
+        assert db.replay(lambda identity: flusher) == 1   # fault cleared
+        chaos.uninstall()
+        assert db.pending() == []
+
+    def test_spill_leaves_no_tmp_files(self, tmp_path):
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        flusher = _FakeFlusher("http://x/")
+        for i in range(5):
+            self._spill(db, f"p{i}".encode(), flusher)
+        leftovers = [p for p in __import__("os").listdir(str(tmp_path / "buf"))
+                     if p.endswith(".tmp")]
+        assert leftovers == []
+        assert len(db.pending()) == 5
+
+
+# ---------------------------------------------------------------------------
+# async sink: spill-on-open + replay-on-close
+
+
+def _make_stub_async_sink(tmp_path, fail_event):
+    from loongcollector_tpu.flusher.async_sink import AsyncSinkFlusher
+
+    class _Stub(AsyncSinkFlusher):
+        name = "flusher_stub_async"
+
+        def __init__(self):
+            super().__init__()
+            self.delivered = []
+            self._dlock = threading.Lock()
+
+        def _init_sink(self, config):
+            return True
+
+        def build_payload(self, groups):
+            return b"unused", {}
+
+        def deliver(self, payload):
+            if fail_event.is_set():
+                raise ConnectionError("sink down (test)")
+            with self._dlock:
+                self.delivered.append(payload)
+
+    sink = _Stub()
+    sink.plugin_id = "flusher_stub_async/0"
+    sink.disk_buffer = DiskBufferWriter(str(tmp_path / "abuf"))
+    assert sink.init({"BreakerFailureThreshold": 3,
+                      "BreakerCooldownSecs": 0.15}, PluginContext("t"))
+    return sink
+
+
+class TestAsyncSinkCircuit:
+    def test_spill_on_open_then_replay_on_close(self, tmp_path):
+        down = threading.Event()
+        down.set()
+        sink = _make_stub_async_sink(tmp_path, down)
+        try:
+            payloads = [f"async-{i}".encode() for i in range(6)]
+            for p in payloads:
+                sink._requeue_payload(p)
+            # circuit trips after 3 consecutive failures, then the whole
+            # queue spills to disk
+            assert wait_for(lambda: sink.circuit.state
+                            is not BreakerState.CLOSED, timeout=10)
+            assert wait_for(lambda: len(sink.disk_buffer.pending()) > 0,
+                            timeout=10)
+            # sink recovers: probe succeeds, circuit re-closes, spilled
+            # payloads replay through this same sink
+            down.clear()
+            assert wait_for(lambda: sorted(sink.delivered)
+                            == sorted(payloads), timeout=20), (
+                sink.delivered)
+            assert wait_for(lambda: sink.circuit.state
+                            is BreakerState.CLOSED, timeout=10)
+            assert wait_for(lambda: sink.disk_buffer.pending() == [],
+                            timeout=10)
+        finally:
+            sink.stop()
+
+
+# ---------------------------------------------------------------------------
+# FlusherRunner.stop(drain=True) spill parity
+
+
+class TestStopDrainSpill:
+    def test_undrained_and_retry_heap_items_spill(self, tmp_path,
+                                                  fast_retries):
+        sqm = SenderQueueManager()
+        q = sqm.create_or_reuse_queue(1)
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        runner = FlusherRunner(sqm, None, disk_buffer=db)
+        # no http sink: items cannot drain; push 3 queued items
+        flusher = _FakeFlusher("http://127.0.0.1:9/never")
+        flusher.queue_key = 1
+        flusher.sender_queue = q
+        items = [SenderQueueItem(f"undrained-{i}".encode(), 8,
+                                 flusher=flusher, queue_key=1)
+                 for i in range(3)]
+        for it in items:
+            q.push(it)
+        # orphan: an item whose queue was deleted while it waited in the
+        # retry heap (reachable only from the heap)
+        orphan_flusher = _FakeFlusher("http://127.0.0.1:9/never")
+        orphan_flusher.queue_key = 77
+        orphan = SenderQueueItem(b"orphan-payload", 14,
+                                 flusher=orphan_flusher, queue_key=77)
+        runner._backoff_retry(orphan)
+        runner.stop(drain=True, timeout=0.2)
+        names = db.pending()
+        assert len(names) == 4, names
+        bodies = {db.read(p)[1] for p in names}
+        assert b"orphan-payload" in bodies
+        assert {f"undrained-{i}".encode() for i in range(3)} <= bodies
+        assert q.empty()
+
+    def test_full_drain_mode_off_drops_instead(self, tmp_path):
+        from loongcollector_tpu.utils import flags
+        sqm = SenderQueueManager()
+        q = sqm.create_or_reuse_queue(1)
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        runner = FlusherRunner(sqm, None, disk_buffer=db)
+        flusher = _FakeFlusher("http://127.0.0.1:9/never")
+        flusher.queue_key = 1
+        flusher.sender_queue = q
+        q.push(SenderQueueItem(b"x", 1, flusher=flusher, queue_key=1))
+        old = flags.get_flag("enable_full_drain_mode")
+        flags.set_flag("enable_full_drain_mode", False)
+        try:
+            runner.stop(drain=True, timeout=0.1)
+        finally:
+            flags.set_flag("enable_full_drain_mode", old)
+        assert db.pending() == []
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+
+
+class TestBreakerStateMachine:
+    def _breaker(self, **kw):
+        from loongcollector_tpu.runner.circuit import SinkCircuitBreaker
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("cooldown_s", 0.05)
+        return SinkCircuitBreaker("t/sink", **kw)
+
+    def test_streak_trips_and_probe_recloses(self):
+        closed = []
+        br = self._breaker()
+        br.on_close = lambda: closed.append(1)
+        for _ in range(2):
+            br.on_failure()
+        assert br.state is BreakerState.CLOSED
+        br.on_failure()
+        assert br.state is BreakerState.OPEN
+        assert not br.allow_probe()          # cooldown not elapsed
+        time.sleep(0.06)
+        assert br.allow_probe()              # HALF_OPEN, slot claimed
+        assert br.state is BreakerState.HALF_OPEN
+        assert not br.allow_probe()          # single probe slot
+        br.on_success()
+        assert br.state is BreakerState.CLOSED
+        assert closed == [1]
+
+    def test_probe_failure_reopens_and_rearms(self):
+        br = self._breaker()
+        for _ in range(3):
+            br.on_failure()
+        time.sleep(0.06)
+        assert br.allow_probe()
+        br.on_failure()                      # probe failed
+        assert br.state is BreakerState.OPEN
+        assert not br.allow_probe()          # cooldown re-armed
+        time.sleep(0.06)
+        assert br.allow_probe()
+
+    def test_error_rate_trips_without_streak(self):
+        br = self._breaker(failure_threshold=100, error_rate=0.5,
+                           window=10, min_samples=8)
+        # alternating outcomes never build a failure streak; the 8th
+        # sample makes 5/8 failures > 50% and trips on rate alone
+        outcomes = [False, True, False, True, False, True, False, False]
+        for ok in outcomes:
+            br.on_success() if ok else br.on_failure()
+        assert br.state is BreakerState.OPEN
+
+    def test_inconclusive_probe_releases_slot(self):
+        """A probe whose send ends with no health signal (payload dropped
+        as invalid, callback lost) must not wedge the single probe slot
+        forever — the breaker re-arms and probes again next cooldown."""
+        br = self._breaker()
+        for _ in range(3):
+            br.on_failure()
+        time.sleep(0.06)
+        assert br.allow_probe()
+        br.on_inconclusive()                 # probe evaporated
+        assert br.state is BreakerState.OPEN
+        time.sleep(0.06)
+        assert br.allow_probe()              # slot free again
+        br.on_success()
+        assert br.state is BreakerState.CLOSED
+
+    def test_stuck_probe_expires(self):
+        br = self._breaker()
+        br.probe_timeout_s = 0.05
+        for _ in range(3):
+            br.on_failure()
+        time.sleep(0.06)
+        assert br.allow_probe()              # slot claimed, outcome never
+        time.sleep(0.06)                     # ...reported
+        assert br.is_open() or br.allow_probe()
+        # after expiry + cooldown the slot must be claimable again
+        time.sleep(0.06)
+        assert br.allow_probe()
+
+    def test_success_resets_streak(self):
+        br = self._breaker()
+        br.on_failure()
+        br.on_failure()
+        br.on_success()
+        br.on_failure()
+        br.on_failure()
+        assert br.state is BreakerState.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# full soak (slow): more seeds, longer storms — scripts/soak.sh
+
+
+@pytest.mark.slow
+class TestFullSoak:
+    @pytest.mark.parametrize("seed", SOAK_SEEDS)
+    def test_extended_sink_storm(self, seed, recording_server, tmp_path,
+                                 fast_retries):
+        payloads, _ = _drive_sink_storm(seed, recording_server, tmp_path,
+                                        n_payloads=24, max_faults=60,
+                                        timeout=120)
+        assert payloads <= recording_server.received

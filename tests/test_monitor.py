@@ -96,6 +96,75 @@ class TestHostMonitor:
         assert "memory_total_bytes" in names
 
 
+class TestCircuitAlarmPropagation:
+    """ISSUE 2 satellite: SINK_CIRCUIT_OPEN and watchdog-breach alarms must
+    surface in self-monitor output (the agent's own data plane), not just
+    in logs."""
+
+    def _alarm_types(self, pqm, server):
+        server.send_once()
+        types = set()
+        while True:
+            popped = pqm.pop_item(timeout=0)
+            if popped is None or popped[1] is None:
+                break
+            _, group = popped
+            for ev in group.events:
+                contents = {k.to_bytes(): v.to_bytes()
+                            for k, v in getattr(ev, "contents", [])}
+                if b"alarm_type" in contents:
+                    types.add(contents[b"alarm_type"])
+        return types
+
+    def _server(self, pqm):
+        server = SelfMonitorServer()
+        server.process_queue_manager = pqm
+        server.set_alarms_pipeline(301)
+        return server
+
+    def test_sink_circuit_open_reaches_self_monitor(self):
+        from loongcollector_tpu.runner.circuit import (BreakerState,
+                                                       SinkCircuitBreaker)
+        AlarmManager.instance().flush()   # start from a clean singleton
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(301)
+        server = self._server(pqm)
+        br = SinkCircuitBreaker("t/flusher_x", failure_threshold=2,
+                                cooldown_s=30.0, pipeline="t")
+        br.on_failure()
+        assert br.state is BreakerState.CLOSED
+        br.on_failure()
+        assert br.state is BreakerState.OPEN
+        assert br.metrics.gauge("state").value == float(BreakerState.OPEN)
+        types = self._alarm_types(pqm, server)
+        assert b"SINK_CIRCUIT_OPEN_ALARM" in types
+
+    def test_watchdog_breach_alarm_reaches_self_monitor(self):
+        from loongcollector_tpu.monitor.watchdog import LoongCollectorMonitor
+        from loongcollector_tpu.utils import flags
+        AlarmManager.instance().flush()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(301)
+        server = self._server(pqm)
+        breaches = []
+        mon = LoongCollectorMonitor(interval_s=0.01,
+                                    on_limit_breach=breaches.append)
+        old_mem = flags.get_flag("memory_usage_limit_mb")
+        flags.set_flag("memory_usage_limit_mb", 1)   # rss always over
+        try:
+            mon.start()
+            deadline = time.monotonic() + 5
+            while not breaches and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            mon.stop()
+            flags.set_flag("memory_usage_limit_mb", old_mem)
+        assert breaches and "rss" in breaches[0], \
+            "restart-request callback should carry the breach description"
+        types = self._alarm_types(pqm, server)
+        assert b"MEM_EXCEED_LIMIT_ALARM" in types
+
+
 class TestWatchdog:
     def test_self_stat_readable(self):
         ticks, rss = _read_self_stat()

@@ -83,11 +83,12 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_four_checkers_registered(self):
+    def test_all_five_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
-                         "tracing-hygiene", "registry-consistency"]
-        assert len(all_checkers()) == 4
+                         "tracing-hygiene", "registry-consistency",
+                         "swallowed-fault"]
+        assert len(all_checkers()) == 5
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +494,107 @@ class TestRegistryConsistency:
 
 # ---------------------------------------------------------------------------
 # 6. framework plumbing
+
+
+class TestSwallowedFault:
+    """swallowed-fault (ISSUE 2): broad except-pass/continue in flusher/
+    and runner/ send paths eat injected chaos faults silently."""
+
+    SCOPE = "loongcollector_tpu/flusher/fixture.py"
+
+    def _scan(self, src, relpath=None):
+        from loongcollector_tpu.analysis.checkers.swallowed_fault import \
+            SwallowedFaultChecker
+        return scan(src, SwallowedFaultChecker(),
+                    relpath=relpath or self.SCOPE)
+
+    def test_flags_broad_except_pass(self):
+        findings = self._scan("""
+            def deliver(payload):
+                try:
+                    sock.sendall(payload)
+                except Exception:
+                    pass
+        """)
+        assert checks_of(findings) == {"swallowed-fault"}
+        assert findings[0].symbol == "deliver"
+
+    def test_flags_bare_except_continue_in_loop(self):
+        findings = self._scan("""
+            def send_loop(queue):
+                for item in queue:
+                    try:
+                        producer.send(item)
+                    except:
+                        continue
+        """, relpath="loongcollector_tpu/runner/fixture.py")
+        assert checks_of(findings) == {"swallowed-fault"}
+
+    def test_flags_broad_tuple(self):
+        findings = self._scan("""
+            def send(x):
+                try:
+                    post(x)
+                except (OSError, Exception):
+                    pass
+        """)
+        assert checks_of(findings) == {"swallowed-fault"}
+
+    def test_narrow_exception_ok(self):
+        findings = self._scan("""
+            def send(x):
+                try:
+                    post(x)
+                except OSError:
+                    pass
+        """)
+        assert findings == []
+
+    def test_handler_that_logs_ok(self):
+        findings = self._scan("""
+            def send(x):
+                try:
+                    post(x)
+                except Exception:
+                    log.warning("send failed, will retry")
+        """)
+        assert findings == []
+
+    def test_cleanup_only_try_body_exempt(self):
+        findings = self._scan("""
+            def stop(sock):
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+        """)
+        assert findings == []
+
+    def test_out_of_scope_paths_ignored(self):
+        findings = self._scan("""
+            def anything(x):
+                try:
+                    go(x)
+                except Exception:
+                    pass
+        """, relpath="loongcollector_tpu/input/fixture.py")
+        assert findings == []
+
+    def test_inline_disable_suppresses(self):
+        src = """
+def send(x):
+    try:
+        probe_native(x)
+    # loonglint: disable=swallowed-fault
+    except Exception:
+        pass
+"""
+        mod = ModuleInfo("/fx/" + self.SCOPE, self.SCOPE, src)
+        from loongcollector_tpu.analysis.checkers.swallowed_fault import \
+            SwallowedFaultChecker
+        findings = list(SwallowedFaultChecker().check_module(mod))
+        assert len(findings) == 1
+        assert mod.suppressed(findings[0].line, findings[0].check)
 
 
 class TestFramework:
