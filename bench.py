@@ -257,6 +257,17 @@ def bench_latency(n_iters=200, batch=256):
     return samples[len(samples) // 2], samples[int(len(samples) * 0.99)]
 
 
+def _hist_ms(hist):
+    """Histogram snapshot in milliseconds for the BENCH json — the
+    latency *trajectory* (p50/p90/p99/max + volume), not just throughput."""
+    s = hist.snapshot()
+    return {"count": s["count"],
+            "p50_ms": round(s["p50"] * 1000, 3),
+            "p90_ms": round(s["p90"] * 1000, 3),
+            "p99_ms": round(s["p99"] * 1000, 3),
+            "max_ms": round(s["max"] * 1000, 3)}
+
+
 def bench_pipeline_e2e(n_lines=600000):
     """Full-pipeline throughput: raw chunks → split → device regex parse →
     route → serialize (blackhole), through the real queue/runner machinery —
@@ -304,6 +315,15 @@ def bench_pipeline_e2e(n_lines=600000):
         time.sleep(0.005)
     if bh.total_events == 0:
         raise RuntimeError("pipeline warm-up never completed")
+    # zero the process-global latency histograms AFTER warm-up so the
+    # reported trajectory describes THIS e2e run, not the microbenches
+    # (bench_regex etc.) that ran earlier in the same process
+    from loongcollector_tpu.ops.device_plane import roundtrip_histogram
+    from loongcollector_tpu.pipeline.queue.bounded_queue import \
+        queue_wait_histogram
+    runner.e2e_hist.snapshot(reset=True)
+    roundtrip_histogram().snapshot(reset=True)
+    queue_wait_histogram().snapshot(reset=True)
     # best-of-3: the bench host is a shared single core — transient CPU
     # steal (co-tenants, monitoring probes) halves a single sample; the
     # least-contended trial is the honest machine capability
@@ -365,11 +385,21 @@ def bench_pipeline_e2e(n_lines=600000):
             raise RuntimeError("sojourn group never reached the sink")
         sojourns.append((time.perf_counter() - t1) * 1000)
     sojourns.sort()
+    # the always-on latency histograms accumulated since the post-warm-up
+    # reset: per-group pop→sent latency, device submit→resolve round-trips
+    # and process-queue waits — the per-stage balance view next to
+    # throughput
+    trajectory = {
+        "pipeline_e2e": _hist_ms(runner.e2e_hist),
+        "device_roundtrip": _hist_ms(roundtrip_histogram()),
+        "queue_wait": _hist_ms(queue_wait_histogram()),
+    }
     runner.stop()
     mgr.stop_all()
     return (pushed_bytes / dt / 1e6,
             sojourns[len(sojourns) // 2],
-            sojourns[int(len(sojourns) * 0.99)])
+            sojourns[int(len(sojourns) * 0.99)],
+            trajectory)
 
 
 def bench_resource():
@@ -456,6 +486,7 @@ def main():
         extra["pipeline_e2e_MBps"] = round(e2e3[0], 1)
         extra["event_to_flush_ms_p50"] = round(e2e3[1], 2)
         extra["event_to_flush_ms_p99"] = round(e2e3[2], 2)
+        extra["latency_trajectory"] = e2e3[3]
     res = _safe(bench_resource, default=None)
     if res is not None:
         extra["resource_10MBps"] = res

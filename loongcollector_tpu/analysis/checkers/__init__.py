@@ -8,6 +8,7 @@ from typing import List
 from ..core import Checker
 from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
+from .metric_naming import MetricNamingChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
@@ -18,6 +19,7 @@ _CHECKER_CLASSES = [
     TracingHygieneChecker,
     RegistryConsistencyChecker,
     SwallowedFaultChecker,
+    MetricNamingChecker,
 ]
 
 

@@ -1,0 +1,163 @@
+"""metric-naming: self-metric hygiene across the whole tree.
+
+Three rules, one check name:
+
+1. **snake_case names** — every literal name passed to ``.counter(...)`` /
+   ``.gauge(...)`` / ``.histogram(...)`` must match ``[a-z][a-z0-9_]*``
+   (the runtime registration in monitor/metrics.py enforces the same rule;
+   this catches it before the process does).  f-string names are checked
+   on their literal fragments (``f"faults_{action}_total"`` passes).
+
+2. **one name, one kind** — a name registered as a counter in one place
+   and a gauge (or histogram) in another would export the same Prometheus
+   series name with two conflicting TYPEs.  Whole-program pass.
+
+3. **record ownership** — a class that creates a ``MetricsRecord`` into a
+   ``self.<attr>`` must either call ``self.<attr>.mark_deleted()``
+   somewhere in the class (retiring the record when the owner stops) or
+   let the record escape to an external owner (hand it to another object,
+   append it to a registry — the pipeline's ``_metric_records`` pattern).
+   A record that is only ever used for registration and never released
+   accumulates forever in WriteMetrics across construct/stop cycles — the
+   leak the FlusherRunner/SinkCircuitBreaker pair had before this PR.
+   Module-level records (runtime_stats, the chaos plane) are process-
+   lifetime by design and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from ..core import (Checker, Finding, ModuleInfo, ParentMap, Program,
+                    attr_tail, call_name, receiver_repr)
+
+CHECK = "metric-naming"
+
+_KINDS = {"counter", "gauge", "histogram"}
+#: self.<attr> method calls that do not count as the record escaping
+_NON_ESCAPE_TAILS = _KINDS | {"histograms", "mark_deleted", "snapshot"}
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_FRAGMENT_RE = re.compile(r"^[a-z0-9_]*$")
+
+
+class _Registration:
+    __slots__ = ("name", "kind", "relpath", "line", "col")
+
+    def __init__(self, name: str, kind: str, relpath: str, line: int,
+                 col: int):
+        self.name = name
+        self.kind = kind
+        self.relpath = relpath
+        self.line = line
+        self.col = col
+
+
+class MetricNamingChecker(Checker):
+    name = CHECK
+    description = ("metric names snake_case and kind-consistent; "
+                   "MetricsRecords owned by a class must be mark_deleted "
+                   "or escape to an owner")
+
+    def __init__(self) -> None:
+        self._registrations: List[_Registration] = []
+
+    # -- per module ---------------------------------------------------------
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and attr_tail(node) in _KINDS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                self._registrations.append(_Registration(
+                    name, attr_tail(node), mod.relpath, node.lineno,
+                    node.col_offset))
+                if not _NAME_RE.match(name):
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        f"metric name {name!r} is not snake_case "
+                        "([a-z][a-z0-9_]*)")
+            elif isinstance(arg, ast.JoinedStr):
+                for part in arg.values:
+                    if isinstance(part, ast.Constant) and \
+                            isinstance(part.value, str) and \
+                            not _FRAGMENT_RE.match(part.value):
+                        yield Finding(
+                            CHECK, mod.relpath, node.lineno, node.col_offset,
+                            f"metric name fragment {part.value!r} is not "
+                            "snake_case ([a-z0-9_]*)")
+        yield from self._check_ownership(mod)
+
+    # -- ownership (per class) ----------------------------------------------
+
+    def _check_ownership(self, mod: ModuleInfo) -> Iterator[Finding]:
+        pm = ParentMap(mod.tree)
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            owned: Dict[str, Tuple[int, int]] = {}
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        call_name(node.value).endswith("MetricsRecord"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            owned[tgt.attr] = (node.lineno, node.col_offset)
+            if not owned:
+                continue
+            released, escaped = set(), set()
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in owned):
+                    continue
+                parent = pm.parent(node)
+                if isinstance(parent, ast.Assign) and node in parent.targets:
+                    continue                      # the creating assignment
+                if isinstance(parent, ast.Attribute) and \
+                        parent.value is node:
+                    gp = pm.parent(parent)
+                    if isinstance(gp, ast.Call) and gp.func is parent and \
+                            parent.attr in _NON_ESCAPE_TAILS:
+                        if parent.attr == "mark_deleted":
+                            released.add(node.attr)
+                        continue                  # registration/cleanup use
+                escaped.add(node.attr)            # any other use: handed off
+            for attr in sorted(owned):
+                if attr in released or attr in escaped:
+                    continue
+                line, col = owned[attr]
+                yield Finding(
+                    CHECK, mod.relpath, line, col,
+                    f"MetricsRecord in self.{attr} is never "
+                    "mark_deleted()-ed and never escapes to an owner: the "
+                    "record accumulates in WriteMetrics across "
+                    "construct/stop cycles", symbol=cls.name)
+
+    # -- whole program ------------------------------------------------------
+
+    def finalize(self, program: Program) -> Iterator[Finding]:
+        by_name: Dict[str, List[_Registration]] = {}
+        for reg in self._registrations:
+            by_name.setdefault(reg.name, []).append(reg)
+        self._registrations = []
+        for name, regs in sorted(by_name.items()):
+            kinds = sorted({r.kind for r in regs})
+            if len(kinds) <= 1:
+                continue
+            first = min(regs, key=lambda r: (r.relpath, r.line))
+            sites = ", ".join(sorted({f"{r.relpath}:{r.line} ({r.kind})"
+                                      for r in regs})[:4])
+            yield Finding(
+                CHECK, first.relpath, first.line, first.col,
+                f"metric name {name!r} registered with conflicting kinds "
+                f"{'/'.join(kinds)} — one exposition series cannot have "
+                f"two types [{sites}]")

@@ -86,6 +86,15 @@ class Application:
         from . import chaos
         if chaos.install_from_env():
             log.warning("chaos plane ACTIVE (seed from %s)", chaos.ENV_SEED)
+        # loongtrace: LOONG_TRACE=1 activates the span layer (sampling via
+        # LOONG_TRACE_SAMPLE/LOONG_TRACE_SEED); LOONG_EXPO_PORT serves the
+        # Prometheus-text endpoint (docs/observability.md)
+        from . import trace
+        if trace.install_from_env():
+            log.info("loongtrace ACTIVE (sample=%s)",
+                     trace.active_tracer().config.sample_rate)
+        from .monitor.exposition import start_from_env as _expo_from_env
+        self.exposition = _expo_from_env()
         self.processor_runner = ProcessorRunner(
             self.process_queue_manager, self.pipeline_manager,
             thread_count=flags.get_flag("process_thread_count"))
@@ -292,6 +301,8 @@ class Application:
         self.flusher_runner.stop(
             drain=True, timeout=flags.get_flag("exit_flush_timeout"))
         self.http_sink.stop()
+        if getattr(self, "exposition", None) is not None:
+            self.exposition.stop()
         from .pipeline.plugin.checkpoint import get_default_store
         get_default_store().flush()
         log.info("exit complete")

@@ -21,7 +21,7 @@ from .plan import (ACTION_CORRUPT, ACTION_DELAY, ACTION_ERROR,
 from .plane import (ENV_SEED, active, current_plan, fault_counts,
                     faultpoint, hit_counts, install, install_from_env,
                     is_active, register_point, registered_points, schedule,
-                    schedule_by_point, uninstall)
+                    reset, schedule_by_point, uninstall)
 
 __all__ = [
     "ACTION_CORRUPT", "ACTION_DELAY", "ACTION_ERROR", "ACTION_PARTIAL",
@@ -29,5 +29,5 @@ __all__ = [
     "ENV_SEED", "active", "current_plan", "fault_counts", "faultpoint",
     "hit_counts", "install", "install_from_env", "is_active",
     "register_point", "registered_points", "schedule",
-    "schedule_by_point", "uninstall",
+    "reset", "schedule_by_point", "uninstall",
 ]

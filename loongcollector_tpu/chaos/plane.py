@@ -88,6 +88,16 @@ def uninstall() -> None:
             _metrics.gauge("chaos_active").set(0.0)
 
 
+def reset() -> None:
+    """Uninstall AND forget all hit counts / the schedule log.  Plain
+    `uninstall` keeps them so a finished storm stays inspectable; tests
+    that must not see a previous test's storm call this instead."""
+    with _lock:
+        _hits.clear()
+        del _schedule[:]
+    uninstall()
+
+
 @contextlib.contextmanager
 def active(plan: ChaosPlan):
     """Scoped installation for tests: `with chaos.active(plan): ...`."""
@@ -172,6 +182,13 @@ def faultpoint(name: str, exc: Optional[type] = None,
         if _metrics is not None:
             _metrics.counter("faults_injected_total").add(1)
             _metrics.counter(f"faults_{decision.action}_total").add(1)
+    # every injection lands on the trace timeline (and the current span,
+    # if one is open on this thread): a storm is one causal story —
+    # injection → breaker transition → spill — not three disjoint logs
+    from .. import trace
+    if trace.is_active():
+        trace.event("chaos.inject", point=name, hit=decision.hit,
+                    action=decision.action)
     if decision.action == ACTION_DELAY:
         time.sleep(decision.delay_s)
         return None

@@ -288,4 +288,9 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             self._sender.join(timeout=max(0.1,
                                           deadline - time.monotonic()))
             self._sender = None
+        if self.circuit is not None:
+            # retire the breaker's metric record with its owner: a config
+            # reload stops this instance and builds a fresh breaker — the
+            # old record must not accumulate in WriteMetrics
+            self.circuit.mark_deleted()
         return True

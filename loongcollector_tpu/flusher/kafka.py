@@ -198,4 +198,8 @@ class FlusherKafka(Flusher):
             self._worker = None
         if self.producer:
             self.producer.close()
+        if self.circuit is not None:
+            # retire the breaker's metric record with its owner (a reload
+            # creates a fresh breaker; the old record must not accumulate)
+            self.circuit.mark_deleted()
         return True

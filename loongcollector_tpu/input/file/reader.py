@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-from ... import chaos
+from ... import chaos, trace
 from ...models import EventGroupMetaKey, PipelineEventGroup, SourceBuffer
 
 DEFAULT_CHUNK = 512 * 1024
@@ -303,6 +303,12 @@ class LogFileReader:
         if self._prev_partial:
             group.set_metadata(EventGroupMetaKey.ML_CONTINUE, "1")
         self._prev_partial = partial_tail
+        # span layer head: one timeline event per shipped chunk — the
+        # input-read edge of the trace (offset/bytes are content-stable,
+        # so a replayed soak produces the identical read sequence)
+        if trace.is_active():
+            trace.event("input.read", path=self.path,
+                        offset=read_offset, nbytes=consumed_src)
         return group
 
     def rollback_last(self) -> None:

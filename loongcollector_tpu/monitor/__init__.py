@@ -1,1 +1,2 @@
-from .metrics import Counter, Gauge, MetricsRecord, ReadMetrics, WriteMetrics
+from .metrics import (Counter, Gauge, Histogram, MetricsRecord, ReadMetrics,
+                      WriteMetrics)

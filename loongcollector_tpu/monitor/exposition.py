@@ -1,0 +1,198 @@
+"""Prometheus-text self-telemetry endpoint (off by default).
+
+Reference analogue: the reference agent exposes its internal metrics for
+scraping next to the self-monitor pipelines; here a stdlib
+ThreadingHTTPServer serves ``GET /metrics`` rendering every live
+MetricsRecord in text exposition format v0.0.4:
+
+  * counters  → ``loong_<name>`` (NOTE: the self-monitor drains counters
+    with delta semantics on its own cadence, so scraped counter values
+    are deltas since the last self-monitor send, not process-lifetime
+    cumulatives — documented in docs/observability.md);
+  * gauges    → ``loong_<name>``;
+  * histograms→ full ``_bucket{le=...}`` / ``_sum`` / ``_count`` series
+    plus pre-computed ``_p50/_p90/_p99`` gauges for humans;
+  * record labels (pipeline, plugin_id, sink...) become metric labels,
+    with ``category`` always present.
+
+Rendering never resets anything — scraping is read-only and safe to run
+concurrently with the self-monitor drain.
+
+Activation: ``LOONG_EXPO_PORT=<port>`` env (application start) or
+programmatic ``ExpositionServer(port).start()``; binds 127.0.0.1 unless
+``LOONG_EXPO_HOST`` widens it.
+"""
+
+from __future__ import annotations
+
+import http.server
+import math
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logger import get_logger
+from .metrics import WriteMetrics
+
+log = get_logger("exposition")
+
+ENV_PORT = "LOONG_EXPO_PORT"
+ENV_HOST = "LOONG_EXPO_HOST"
+
+_PREFIX = "loong_"
+_NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
+_LABEL_SAN = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(raw: str) -> str:
+    name = _NAME_SAN.sub("_", raw)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return _PREFIX + name
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = []
+    for k in sorted(labels):
+        key = _LABEL_SAN.sub("_", str(k))
+        val = str(labels[k]).replace("\\", "\\\\").replace(
+            '"', '\\"').replace("\n", "\\n")
+        parts.append(f'{key}="{val}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    if v != v:                      # NaN
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render() -> str:
+    """The whole live metric tree in text exposition format."""
+    by_name: Dict[Tuple[str, str], List[str]] = {}
+
+    def emit(name: str, typ: str, line: str) -> None:
+        by_name.setdefault((name, typ), []).append(line)
+
+    for rec in WriteMetrics.instance().records():
+        labels = dict(rec.labels)
+        labels["category"] = rec.category
+        # one snapshot per record: it already carries the histogram
+        # percentiles, so only the bucket vectors need a separate read
+        snap = rec.snapshot(reset_counters=False)
+        for raw, value in snap["counters"].items():
+            name = _metric_name(raw)
+            emit(name, "counter", f"{name}{_label_str(labels)} {_fmt(value)}")
+        for raw, value in snap["gauges"].items():
+            name = _metric_name(raw)
+            emit(name, "gauge", f"{name}{_label_str(labels)} {_fmt(value)}")
+        for hist in rec.histograms():
+            name = _metric_name(hist.name)
+            hsnap = snap["histograms"].get(hist.name)
+            if hsnap is None:      # registered after the snapshot above
+                continue
+            for le, cum in hist.buckets():
+                le_label = 'le="%s"' % _fmt(le)
+                emit(name, "histogram",
+                     f"{name}_bucket{_label_str(labels, le_label)} {cum}")
+            emit(name, "histogram",
+                 f"{name}_sum{_label_str(labels)} {_fmt(hsnap['sum'])}")
+            emit(name, "histogram",
+                 f"{name}_count{_label_str(labels)} {hsnap['count']}")
+            for q in ("p50", "p90", "p99"):
+                qname = f"{name}_{q}"
+                emit(qname, "gauge",
+                     f"{qname}{_label_str(labels)} {_fmt(hsnap[q])}")
+    out: List[str] = []
+    for (name, typ) in sorted(by_name):
+        out.append(f"# TYPE {name} {typ}")
+        # insertion order, not lexical: histogram buckets must stay in
+        # ascending `le` order ("+Inf" sorts lexically first)
+        out.extend(by_name[(name, typ)])
+    return "\n".join(out) + "\n"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            body = render().encode("utf-8")
+        except Exception as e:  # noqa: BLE001 — a bad record must not 500-loop
+            log.exception("exposition render failed")
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(repr(e).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrape traffic is not agent log news
+        pass
+
+
+class ExpositionServer:
+    """Lifecycle wrapper; `port=0` binds an ephemeral port (tests)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = port
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> bool:
+        if self._server is not None:
+            return True
+        try:
+            self._server = http.server.ThreadingHTTPServer(
+                (self.host, self.port), _Handler)
+        except OSError as e:
+            log.error("exposition endpoint bind %s:%d failed: %s",
+                      self.host, self.port, e)
+            self._server = None
+            return False
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="exposition", daemon=True)
+        self._thread.start()
+        log.info("exposition endpoint on http://%s:%d/metrics",
+                 self.host, self.port)
+        return True
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def start_from_env(env=os.environ) -> Optional[ExpositionServer]:
+    """LOONG_EXPO_PORT activates the endpoint at application start."""
+    raw = env.get(ENV_PORT)
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        log.error("bad %s=%r; exposition endpoint stays off", ENV_PORT, raw)
+        return None
+    server = ExpositionServer(port, env.get(ENV_HOST, "127.0.0.1"))
+    return server if server.start() else None

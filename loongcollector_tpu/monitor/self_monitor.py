@@ -34,6 +34,7 @@ class SelfMonitorServer:
         # queue keys of the internal pipelines (set by the internal inputs)
         self._metrics_queue_key: Optional[int] = None
         self._alarms_queue_key: Optional[int] = None
+        self._traces_queue_key: Optional[int] = None
         self.process_queue_manager = None
         self.interval_s = SEND_INTERVAL_S
 
@@ -53,6 +54,12 @@ class SelfMonitorServer:
     def set_alarms_pipeline(self, queue_key: Optional[int]) -> None:
         with self._lock:
             self._alarms_queue_key = queue_key
+
+    def set_traces_pipeline(self, queue_key: Optional[int]) -> None:
+        """Route loongtrace spans/events to their own internal pipeline;
+        when unset they ride the metrics pipeline (dogfooding either way)."""
+        with self._lock:
+            self._traces_queue_key = queue_key
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -96,6 +103,7 @@ class SelfMonitorServer:
         refresh()   # pull device-plane / scraper / eBPF gauges
         with self._lock:
             mkey, akey = self._metrics_queue_key, self._alarms_queue_key
+            tkey = self._traces_queue_key
         # check queue validity BEFORE draining counters/alarms: the drain is
         # destructive, and the window where the queue is full is exactly the
         # window whose telemetry must not be lost — deltas keep accumulating
@@ -108,6 +116,13 @@ class SelfMonitorServer:
             group = self._alarms_group()
             if group is not None and not group.empty():
                 pqm.push_queue(akey, group)
+        # traces share the metrics pipeline unless routed to their own;
+        # same destructive-drain rule: only drain into a pushable queue
+        tkey = tkey if tkey is not None else mkey
+        if tkey is not None and pqm.is_valid_to_push(tkey):
+            group = self._traces_group()
+            if group is not None and not group.empty():
+                pqm.push_queue(tkey, group)
 
     @staticmethod
     def _metrics_group() -> Optional[PipelineEventGroup]:
@@ -125,12 +140,37 @@ class SelfMonitorServer:
                 values[k] = float(v)
             for k, v in snap["gauges"].items():
                 values[k] = float(v)
+            for k, h in snap.get("histograms", {}).items():
+                # flattened percentile trio + volume: the self-monitor
+                # stream is multi-value metric events, not bucket vectors
+                # (the exposition endpoint serves the full buckets)
+                values[f"{k}_count"] = float(h["count"])
+                values[f"{k}_p50"] = float(h["p50"])
+                values[f"{k}_p90"] = float(h["p90"])
+                values[f"{k}_p99"] = float(h["p99"])
+                values[f"{k}_max"] = float(h["max"])
             if values:
                 ev.set_multi_value(values)
             for k, v in snap["labels"].items():
                 ev.set_tag(sb.copy_string(k), sb.copy_string(str(v)))
         group.set_tag(b"__source__", b"self_monitor")
         return group
+
+    @staticmethod
+    def _traces_group() -> Optional[PipelineEventGroup]:
+        """Drain the active tracer into one event group (spans + timeline
+        events as log events, __source__ = loongtrace).  No-op when
+        tracing is disabled — the drain is destructive, so it only runs
+        against a live tracer."""
+        from .. import trace
+        tracer = trace.active_tracer()
+        if tracer is None:
+            return None
+        spans, events = tracer.drain()
+        if not spans and not events:
+            return None
+        from ..trace.export import traces_to_group
+        return traces_to_group(spans, events)
 
     @staticmethod
     def _alarms_group() -> Optional[PipelineEventGroup]:

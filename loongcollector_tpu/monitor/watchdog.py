@@ -65,6 +65,9 @@ class LoongCollectorMonitor:
         if self._thread:
             self._thread.join(timeout=2)
             self._thread = None
+        # retire the record: a stopped watchdog exports nothing further
+        # (loonglint metric-naming ownership rule)
+        self.metrics.mark_deleted()
 
     def _run(self) -> None:
         hz = os.sysconf("SC_CLK_TCK")

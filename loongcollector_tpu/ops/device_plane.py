@@ -33,7 +33,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from .. import chaos
+from .. import chaos, trace
 from ..utils.logger import get_logger
 
 log = get_logger("device_plane")
@@ -43,6 +43,21 @@ _DEFAULT_BUDGET = 64 * 1024 * 1024  # bytes of packed rows in flight
 FP_SUBMIT = chaos.register_point("device_plane.submit")
 
 _tls = threading.local()
+
+# submit→resolve stopwatch sink: one shared histogram (lazy so importing
+# the plane never touches the metrics registry)
+_rtt_hist = None
+
+
+def roundtrip_histogram():
+    """The device round-trip latency histogram (dispatch → materialise),
+    observed by every DeviceFuture that resolves successfully."""
+    global _rtt_hist
+    if _rtt_hist is None:
+        from ..monitor.metrics import shared_histogram
+        _rtt_hist = shared_histogram("device_roundtrip_seconds",
+                                     labels={"component": "device_plane"})
+    return _rtt_hist
 
 
 def set_budget_relief(fn: Optional[Callable[[], bool]]) -> None:
@@ -75,17 +90,22 @@ class DeviceFuture:
     """
 
     __slots__ = ("_plane", "_nbytes", "_outputs", "_error", "_done",
-                 "_materialised", "__weakref__")
+                 "_materialised", "_t0", "_span", "__weakref__")
 
     def __init__(self, plane: "DevicePlane", nbytes: int,
                  outputs: Optional[Sequence] = None,
-                 error: Optional[BaseException] = None):
+                 error: Optional[BaseException] = None,
+                 span=None):
         self._plane = plane
         self._nbytes = nbytes
         self._outputs = outputs
         self._error = error
         self._done = False
         self._materialised: Optional[List[np.ndarray]] = None
+        # the submit→resolve stopwatch starts the moment the dispatched
+        # future exists; result()/release() stops it exactly once
+        self._t0 = time.perf_counter()
+        self._span = span
 
     def result(self) -> List[np.ndarray]:
         if self._done:
@@ -96,13 +116,19 @@ class DeviceFuture:
             if self._error is not None:
                 raise self._error
             self._materialised = [np.asarray(o) for o in self._outputs]
+            roundtrip_histogram().observe(time.perf_counter() - self._t0)
+            if self._span is not None:
+                self._span.end("ok")
             return self._materialised
         except BaseException as e:  # noqa: BLE001 — record, release, re-raise
             self._error = e
+            if self._span is not None:
+                self._span.end("error")
             raise
         finally:
             self._done = True
             self._outputs = None
+            self._span = None
             self._plane._release(self._nbytes)
 
     def release(self) -> None:
@@ -116,6 +142,9 @@ class DeviceFuture:
         if self._error is None:
             self._error = RuntimeError(
                 "DeviceFuture released without materialisation")
+        if self._span is not None:
+            self._span.end("released")
+            self._span = None
         self._plane._release(self._nbytes)
 
     def __del__(self):
@@ -126,6 +155,9 @@ class DeviceFuture:
             if not self._done:
                 self._done = True
                 self._outputs = None
+                if self._span is not None:
+                    self._span.end("abandoned")
+                    self._span = None
                 self._plane._release(self._nbytes)
                 log.warning(
                     "DeviceFuture dropped without result()/release(); "
@@ -241,6 +273,10 @@ class DevicePlane:
         its bookkeeping simple and errors surface at the (ordered)
         materialisation point."""
         self._acquire(nbytes, should_abort, on_wait)
+        tracer = trace.active_tracer()
+        span = (tracer.child_or_sampled("device", "device.roundtrip",
+                                        {"nbytes": nbytes})
+                if tracer is not None else None)
         try:
             # after _acquire, inside the try: an injected fault behaves
             # exactly like a kernel raising at dispatch — errored future,
@@ -249,12 +285,14 @@ class DevicePlane:
             outputs = kernel(*args)
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)
-            return DeviceFuture(self, nbytes, outputs=outputs)
+            return DeviceFuture(self, nbytes, outputs=outputs, span=span)
         except DispatchAborted:
+            if span is not None:
+                span.end("aborted")
             self._release(nbytes)
             raise
         except BaseException as e:  # noqa: BLE001 — deliver via result()
-            return DeviceFuture(self, nbytes, error=e)
+            return DeviceFuture(self, nbytes, error=e, span=span)
 
 
 class DispatchAborted(RuntimeError):

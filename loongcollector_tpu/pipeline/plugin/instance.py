@@ -10,6 +10,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List
 
+from ... import trace
 from ...models import PipelineEventGroup
 from ...monitor.metrics import MetricsRecord
 from .interface import Flusher, Input, PluginContext, Processor
@@ -26,6 +27,10 @@ class ProcessorInstance:
         self.out_events = self.metrics.counter("out_events_total")
         self.in_bytes = self.metrics.counter("in_size_bytes")
         self.cost_ms = self.metrics.counter("total_process_time_ms")
+        # per-stage latency distribution (the ParPaRaw per-stage balance
+        # view); the async device stage observes dispatch and complete
+        # phases separately
+        self.stage_hist = self.metrics.histogram("stage_seconds")
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         self.plugin.metrics_record = self.metrics
@@ -35,9 +40,21 @@ class ProcessorInstance:
         n_in = sum(len(g) for g in groups)
         self.in_events.add(n_in)
         self.in_bytes.add(sum(g.data_size() for g in groups))
+        tracer = trace.active_tracer()
+        sp = (tracer.child_or_sampled("processor",
+                                      "processor." + self.plugin.name)
+              if tracer is not None else None)
         t0 = time.perf_counter()
-        self.plugin.process_many(groups)
-        self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
+        ok = False
+        try:
+            self.plugin.process_many(groups)
+            ok = True
+        finally:
+            dt = time.perf_counter() - t0
+            self.stage_hist.observe(dt)
+            self.cost_ms.add(int(dt * 1000))
+            if sp is not None:
+                sp.end(None if ok else "error")
         self.out_events.add(sum(len(g) for g in groups))
 
     # -- async device plane (split dispatch/complete) -----------------------
@@ -45,17 +62,43 @@ class ProcessorInstance:
     def process_dispatch(self, groups: List[PipelineEventGroup]):
         self.in_events.add(sum(len(g) for g in groups))
         self.in_bytes.add(sum(g.data_size() for g in groups))
+        tracer = trace.active_tracer()
+        sp = (tracer.child_or_sampled("processor",
+                                      "processor." + self.plugin.name
+                                      + ".dispatch")
+              if tracer is not None else None)
         t0 = time.perf_counter()
-        tokens = [self.plugin.process_dispatch(g) for g in groups]
-        self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
+        ok = False
+        try:
+            tokens = [self.plugin.process_dispatch(g) for g in groups]
+            ok = True
+        finally:
+            dt = time.perf_counter() - t0
+            self.stage_hist.observe(dt)
+            self.cost_ms.add(int(dt * 1000))
+            if sp is not None:
+                sp.end(None if ok else "error")
         return tokens
 
     def process_complete(self, groups: List[PipelineEventGroup],
                          tokens) -> None:
+        tracer = trace.active_tracer()
+        sp = (tracer.child_or_sampled("processor",
+                                      "processor." + self.plugin.name
+                                      + ".complete")
+              if tracer is not None else None)
         t0 = time.perf_counter()
-        for g, tok in zip(groups, tokens):
-            self.plugin.process_complete(g, tok)
-        self.cost_ms.add(int((time.perf_counter() - t0) * 1000))
+        ok = False
+        try:
+            for g, tok in zip(groups, tokens):
+                self.plugin.process_complete(g, tok)
+            ok = True
+        finally:
+            dt = time.perf_counter() - t0
+            self.stage_hist.observe(dt)
+            self.cost_ms.add(int(dt * 1000))
+            if sp is not None:
+                sp.end(None if ok else "error")
         self.out_events.add(sum(len(g) for g in groups))
 
 
@@ -95,7 +138,21 @@ class FlusherInstance:
     def send(self, group: PipelineEventGroup) -> bool:
         self.in_events.add(len(group))
         self.in_groups.add(1)
-        return self.plugin.send(group)
+        # batch + serialize + sender-queue enqueue all live under the
+        # flusher plugin's send — one span covers the serialize stage
+        tracer = trace.active_tracer()
+        sp = (tracer.child_or_sampled("flusher", "flusher.send",
+                                      attrs={"flusher": self.plugin.name,
+                                             "events": len(group)})
+              if tracer is not None else None)
+        ok = False
+        try:
+            result = self.plugin.send(group)
+            ok = True
+            return result
+        finally:
+            if sp is not None:
+                sp.end(None if ok else "error")
 
     def start(self) -> bool:
         return self.plugin.start()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from collections import deque
 from typing import Callable, Deque, List, Optional
 
@@ -26,6 +27,19 @@ DEFAULT_CAPACITY = 20
 LOW_WATERMARK_RATIO = 2 / 3
 
 FP_PUSH = chaos.register_point("bounded_queue.push")
+
+# shared queue-wait histogram (lazy: importing queues never touches the
+# metrics registry); every bounded process queue observes into it
+_wait_hist = None
+
+
+def queue_wait_histogram():
+    global _wait_hist
+    if _wait_hist is None:
+        from ...monitor.metrics import shared_histogram
+        _wait_hist = shared_histogram("queue_wait_seconds",
+                                      labels={"component": "process_queue"})
+    return _wait_hist
 
 
 class QueueStatus(enum.Enum):
@@ -58,6 +72,9 @@ class BoundedProcessQueue:
         self._cap_high = max(capacity, 1)
         self._cap_low = max(int(capacity * LOW_WATERMARK_RATIO), 1)
         self._items: Deque[PipelineEventGroup] = deque()
+        # enqueue timestamps ride a parallel FIFO (groups use __slots__,
+        # so the wait cannot be stamped on the group itself)
+        self._enq_ts: Deque[float] = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._valid_to_push = True
@@ -84,6 +101,7 @@ class BoundedProcessQueue:
                 self.total_rejected += 1
                 return False
             self._items.append(group)
+            self._enq_ts.append(time.perf_counter())
             self.total_pushed += 1
             if len(self._items) >= self._cap_high:
                 self._valid_to_push = False
@@ -101,12 +119,15 @@ class BoundedProcessQueue:
             if not self._pop_enabled or not self._items:
                 return None
             item = self._items.popleft()
+            enq = self._enq_ts.popleft() if self._enq_ts else None
             self.total_popped += 1
             if not self._valid_to_push and len(self._items) <= self._cap_low:
                 self._valid_to_push = True
                 feedbacks = list(self._feedback)
             else:
                 feedbacks = []
+        if enq is not None:
+            queue_wait_histogram().observe(time.perf_counter() - enq)
         for fb in feedbacks:
             fb.feedback(self.key)
         return item
@@ -139,9 +160,12 @@ class CircularProcessQueue(BoundedProcessQueue):
     def push(self, group: PipelineEventGroup) -> bool:
         with self._lock:
             self._items.append(group)
+            self._enq_ts.append(time.perf_counter())
             self.total_pushed += 1
             while len(self._items) > self._cap_high:
                 self._items.popleft()
+                if self._enq_ts:
+                    self._enq_ts.popleft()
                 self.total_dropped += 1
             self._not_empty.notify()
             return True

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from .. import trace
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..utils.logger import get_logger
@@ -126,6 +127,8 @@ class SinkCircuitBreaker:
                 self._probe_in_flight = True
                 self._probe_started = time.monotonic()
                 self._probes_total.add(1)
+                if trace.is_active():
+                    trace.event("breaker.half_open", sink=self.name)
                 return True
             if self._probe_in_flight:
                 return False
@@ -155,6 +158,8 @@ class SinkCircuitBreaker:
                 closed_now = True
         if closed_now:
             log.info("sink circuit %s re-closed", self.name)
+            if trace.is_active():
+                trace.event("breaker.close", sink=self.name)
             if self.on_close is not None:
                 self.on_close()
 
@@ -197,6 +202,12 @@ class SinkCircuitBreaker:
         if len(self._results) > self.window:
             del self._results[0]
 
+    def mark_deleted(self) -> None:
+        """Retire this breaker's metric record (owner stopped or its
+        sink's queue was deleted) — the record must not outlive it in
+        WriteMetrics."""
+        self.metrics.mark_deleted()
+
     def _reopen(self, why: str) -> None:
         self._state = BreakerState.OPEN
         self._opened_at = time.monotonic()
@@ -204,6 +215,8 @@ class SinkCircuitBreaker:
         self._streak = 0
         self._state_gauge.set(float(BreakerState.OPEN))
         self._opened_total.add(1)
+        if trace.is_active():
+            trace.event("breaker.open", sink=self.name, why=why)
         log.warning("sink circuit %s opened: %s", self.name, why)
         AlarmManager.instance().send_alarm(
             AlarmType.SINK_CIRCUIT_OPEN,

@@ -24,7 +24,7 @@ import time
 import uuid
 from typing import Callable, List, Optional, Tuple
 
-from .. import chaos
+from .. import chaos, trace
 from ..chaos import ChaosFault
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..pipeline.queue.sender_queue import SenderQueueItem
@@ -103,6 +103,11 @@ class DiskBufferWriter:
             except OSError:
                 pass
             return False
+        if trace.is_active():
+            trace.event("disk_buffer.spill",
+                        pipeline=header.get("pipeline", ""),
+                        flusher=header.get("flusher_type", ""),
+                        nbytes=len(item.data))
         return True
 
     # -- read / replay ------------------------------------------------------
@@ -181,6 +186,11 @@ class DiskBufferWriter:
                 continue
             self._remove(path)
             count += 1
+            if trace.is_active():
+                trace.event("disk_buffer.replay",
+                            pipeline=header.get("pipeline", ""),
+                            flusher=header.get("flusher_type", ""),
+                            nbytes=len(payload))
         if count:
             log.info("replayed %d buffered payloads", count)
         return count
@@ -198,6 +208,8 @@ class DiskBufferWriter:
             if self._total is not None:
                 self._total = max(0, self._total - size)
         log.error("malformed buffer file quarantined: %s.bad", path)
+        if trace.is_active():
+            trace.event("disk_buffer.quarantine", nbytes=size)
         AlarmManager.instance().send_alarm(
             AlarmType.SECONDARY_READ_WRITE,
             f"malformed disk-buffer file quarantined ({size} bytes)",

@@ -22,6 +22,7 @@ from typing import Dict, Optional, Tuple
 
 import heapq
 
+from .. import trace
 from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
@@ -75,6 +76,11 @@ class FlusherRunner:
         self.out_items = self.metrics.counter("out_items_total")
         self.out_bytes = self.metrics.counter("out_size_bytes")
         self.spilled_items = self.metrics.counter("spilled_items_total")
+        # dispatch → on_done latency per send attempt, and how long items
+        # sat in their sender queue before this dispatch picked them up
+        self.sink_rtt_hist = self.metrics.histogram("sink_rtt_seconds")
+        self.sender_wait_hist = self.metrics.histogram(
+            "sender_queue_wait_seconds")
 
     def init(self) -> None:
         self._running = True
@@ -119,6 +125,18 @@ class FlusherRunner:
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        try:
+            self._exit_spill()
+        finally:
+            # retire this runner's metric records (and its breakers') AFTER
+            # the exit spill — its spilled_items_total increments must land
+            # on a record that is still exportable — so repeated
+            # construct/stop cycles never accumulate live records
+            self.metrics.mark_deleted()
+            for br in self.breakers().values():
+                br.mark_deleted()
+
+    def _exit_spill(self) -> None:
         # exit spill: whatever could not drain in the budget persists to disk
         # (reference FlusherRunner.cpp:223-227 full-drain/spill on exit).
         # Items still in-flight in the HTTP sink are skipped — their pending
@@ -258,11 +276,29 @@ class FlusherRunner:
             self._backoff_retry(item)
             return
         item.in_flight = True
+        self.sender_wait_hist.observe(
+            max(0.0, time.monotonic() - item.enqueue_time))
+        # the send-attempt stopwatch rides the item's last_send_time slot
+        # (its reference meaning); _on_done turns it into the sink RTT
+        item.last_send_time = time.monotonic()
+        tracer = trace.active_tracer()
+        sp = (tracer.child_or_sampled(f"sink:{breaker.name}", "sink.send",
+                                      attrs={"sink": breaker.name,
+                                             "try_count": item.try_count})
+              if tracer is not None else None)
         self.http_sink.add_request(
-            request, lambda status, body, it=item: self._on_done(it, status, body))
+            request, lambda status, body, it=item, sp=sp:
+            self._on_done(it, status, body, sp))
 
-    def _on_done(self, item: SenderQueueItem, status: int, body: bytes) -> None:
+    def _on_done(self, item: SenderQueueItem, status: int, body: bytes,
+                 span=None) -> None:
         item.in_flight = False
+        if item.last_send_time:
+            self.sink_rtt_hist.observe(
+                max(0.0, time.monotonic() - item.last_send_time))
+        if span is not None:
+            span.set_attr("status", status)
+            span.end("ok" if 200 <= status < 300 else "error")
         flusher = item.flusher
         q = self.sqm.get_queue(item.queue_key)
         breaker = self.breaker_for(item)
@@ -333,6 +369,9 @@ class FlusherRunner:
         """Exponential backoff (100 ms → 10 s, reference FlusherRunner.cpp
         :133-141) via a single shared timer heap — no thread per retry."""
         delay = min(RETRY_BASE_S * (2 ** min(item.try_count, 8)), RETRY_MAX_S)
+        if trace.is_active():
+            trace.event("retry.backoff", try_count=item.try_count,
+                        delay_s=delay)
         with self._retry_lock:
             heapq.heappush(self._retry_heap,
                            (time.monotonic() + delay, id(item), item))

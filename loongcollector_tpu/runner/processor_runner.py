@@ -24,6 +24,7 @@ import threading
 import time
 from typing import List, Optional
 
+from .. import trace
 from ..models import PipelineEventGroup
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
@@ -51,6 +52,10 @@ class ProcessorRunner:
         self.in_groups = self.metrics.counter("in_event_groups_total")
         self.in_events = self.metrics.counter("in_events_total")
         self.in_bytes = self.metrics.counter("in_size_bytes")
+        # pop → send-returned latency per group (process + device overlap +
+        # downstream processors + route/flush enqueue); queue wait is its
+        # own histogram on the process-queue side
+        self.e2e_hist = self.metrics.histogram("pipeline_e2e_seconds")
         self.last_flush = time.monotonic()
 
     # -- producer API -------------------------------------------------------
@@ -83,6 +88,10 @@ class ProcessorRunner:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        # a stopped runner exports nothing further; its record must not
+        # accumulate in WriteMetrics across restarts (loonglint
+        # metric-naming ownership rule)
+        self.metrics.mark_deleted()
 
     # -- worker -------------------------------------------------------------
 
@@ -133,16 +142,43 @@ class ProcessorRunner:
         self.in_groups.add(1)
         self.in_events.add(len(group))
         self.in_bytes.add(group.data_size())
+        t0 = time.perf_counter()
+        sp = None
+        tracer = trace.active_tracer()
+        if tracer is not None:
+            # deterministic per-group sampling: the Nth group of pipeline P
+            # draws from (seed, "P:N") only — a replayed soak traces the
+            # identical group set (docs/observability.md)
+            gkey = tracer.next_group_key(pipeline.name or "pipeline")
+            if tracer.should_sample(gkey):
+                sp = tracer.start_span(
+                    "pipeline.process", trace_id=gkey,
+                    attrs={"pipeline": pipeline.name, "events": len(group)})
+                tracer.push_current(sp)
         groups = [group]
         try:
             finish = pipeline.process_begin(groups)
         except Exception:  # noqa: BLE001
             log.exception("pipeline %s processing failed", pipeline.name)
+            self._finish_group(sp, t0, "error")
             return None
         if finish is None:
             self._send(pipeline, groups)
+            self._finish_group(sp, t0, "ok")
             return None
-        return pipeline, groups, finish
+        # the group's device work stays in flight: detach its span from
+        # this thread so the NEXT group's dispatch does not nest under it
+        if sp is not None:
+            tracer.pop_current(sp)
+        return pipeline, groups, finish, sp, t0
+
+    def _finish_group(self, sp, t0: float, status: str) -> None:
+        self.e2e_hist.observe(time.perf_counter() - t0)
+        if sp is not None:
+            tracer = trace.active_tracer()
+            if tracer is not None:
+                tracer.pop_current(sp)
+            sp.end(status)
 
     def _complete_pending(self) -> None:
         p = getattr(self._tls, "pending", None)
@@ -162,13 +198,20 @@ class ProcessorRunner:
         return True
 
     def _complete(self, pending) -> None:
-        pipeline, groups, finish = pending
+        pipeline, groups, finish, sp, t0 = pending
+        tracer = trace.active_tracer()
+        if sp is not None and tracer is not None:
+            # re-attach: device materialisation + downstream processors +
+            # send events belong to this group's span
+            tracer.push_current(sp)
         try:
             finish()
         except Exception:  # noqa: BLE001
             log.exception("pipeline %s processing failed", pipeline.name)
+            self._finish_group(sp, t0, "error")
             return
         self._send(pipeline, groups)
+        self._finish_group(sp, t0, "ok")
 
     def _send(self, pipeline, groups) -> None:
         try:

@@ -1,0 +1,62 @@
+"""Trace → self-telemetry conversion.
+
+Reference shape: core/monitor/SelfMonitorServer.cpp converts metric
+records and alarms into PipelineEventGroups pushed into INTERNAL
+pipelines; traces ride the same dogfooding path — every finished span and
+timeline event becomes a log event tagged ``__source__ = loongtrace``, so
+an operator's sink sees a breaker trip, the chaos injection that caused
+it, and the resulting spill as rows of one queryable stream.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from ..models import PipelineEventGroup
+from .tracer import Span, TraceEvent
+
+
+def _put(ev, sb, key: str, value: str) -> None:
+    ev.set_content(sb.copy_string(key), sb.copy_string(value))
+
+
+def traces_to_group(spans: List[Span],
+                    events: List[TraceEvent]) -> Optional[PipelineEventGroup]:
+    """One event group carrying a drained trace batch; None when empty."""
+    if not spans and not events:
+        return None
+    group = PipelineEventGroup()
+    sb = group.source_buffer
+    for span in spans:
+        ev = group.add_log_event(int(span.start_wall))
+        _put(ev, sb, "kind", "span")
+        _put(ev, sb, "name", span.name)
+        _put(ev, sb, "trace_id", span.trace_id)
+        _put(ev, sb, "span_id", str(span.span_id))
+        if span.parent_id is not None:
+            _put(ev, sb, "parent_id", str(span.parent_id))
+        _put(ev, sb, "status", span.status)
+        if span.duration_s is not None:
+            _put(ev, sb, "duration_ms",
+                 f"{span.duration_s * 1000.0:.3f}")
+        if span.attrs:
+            _put(ev, sb, "attrs", json.dumps(span.attrs, sort_keys=True,
+                                             default=str))
+        if span.events:
+            _put(ev, sb, "events", json.dumps(
+                [{"name": n, "t_ms": round(dt * 1000.0, 3), **a}
+                 for n, dt, a in span.events],
+                sort_keys=True, default=str))
+    for tev in events:
+        ev = group.add_log_event(int(tev.wall))
+        _put(ev, sb, "kind", "event")
+        _put(ev, sb, "name", tev.name)
+        _put(ev, sb, "seq", str(tev.seq))
+        if tev.span_id is not None:
+            _put(ev, sb, "span_id", str(tev.span_id))
+        if tev.attrs:
+            _put(ev, sb, "attrs", json.dumps(tev.attrs, sort_keys=True,
+                                             default=str))
+    group.set_tag(b"__source__", b"loongtrace")
+    return group

@@ -1,0 +1,414 @@
+"""loongtrace: the always-available, off-by-default pipeline span layer.
+
+The paper's throughput headline (546 MB/s single-line, 68 MB/s regex
+parse) says nothing about WHERE time goes once the parse hot path moves
+onto the device plane; ParPaRaw-style parallel pipelines live or die on
+per-stage latency balance.  This tracer makes the full event path — input
+read → processor runner → device submit/resolve → batch/serialize →
+flusher send — observable as spans, and makes the loongchaos plane's
+injections, breaker transitions, spill/replay and retry decisions visible
+as *span events* on one causal timeline.
+
+Contract (mirrors chaos/plane.py, which established the idiom):
+
+  * Disabled (the production default) every hook is ONE module-global
+    read and an immediate return — `scripts/trace_overhead.py` gates the
+    cost against a plain no-op call.
+  * Enabled, sampling is deterministic per event-group key: the keep/drop
+    draw depends only on ``(seed, key)`` (the seeded-stream idea from
+    chaos/plan.py), so a traced soak replays the identical trace set.
+  * The timeline's *structure* (names + attributes, never timestamps) is
+    canonically serializable (`structure_bytes`), so two runs of the same
+    seeded storm compare byte-identical.
+
+Activation: programmatic ``enable()`` / scoped ``active()`` for tests, or
+``LOONG_TRACE=1`` (with optional ``LOONG_TRACE_SAMPLE`` / ``LOONG_TRACE_SEED``)
+via ``install_from_env()`` at application start.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV_ENABLE = "LOONG_TRACE"
+ENV_SAMPLE = "LOONG_TRACE_SAMPLE"
+ENV_SEED = "LOONG_TRACE_SEED"
+
+_SPAN_CAP = 50_000      # finished-span ring bound
+_EVENT_CAP = 100_000    # timeline bound (matches chaos._SCHEDULE_CAP)
+_MAX_EVENTS_PER_SPAN = 256
+
+
+class Span:
+    """One timed operation.  `end()` is idempotent; the tracer records the
+    span at first end.  `add_event` attaches a named point event (kept in
+    arrival order); events recorded after `end()` are dropped."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "start_wall", "_start_perf", "duration_s", "attrs",
+                 "events", "status", "_ended")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: int, parent_id: Optional[int],
+                 attrs: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_wall = time.time()
+        self._start_perf = time.perf_counter()
+        self.duration_s: Optional[float] = None
+        self.attrs = dict(attrs) if attrs else {}
+        self.events: List[Tuple[str, float, dict]] = []
+        self.status = "ok"
+        self._ended = False
+
+    def set_attr(self, key: str, value) -> None:
+        if not self._ended:
+            self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        if self._ended or len(self.events) >= _MAX_EVENTS_PER_SPAN:
+            return
+        self.events.append(
+            (name, time.perf_counter() - self._start_perf, attrs))
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        if status is not None:
+            self.status = status
+        self.duration_s = time.perf_counter() - self._start_perf
+        self.tracer._record(self)
+
+    # context-manager sugar: ``with trace.span("x") as sp: ...``
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end("error" if exc_type is not None else None)
+
+
+class TraceEvent:
+    """A free-standing timeline entry (breaker transition, chaos
+    injection, spill...) — recorded even when no span is current, so the
+    causal storm timeline survives thread hops."""
+
+    __slots__ = ("name", "seq", "wall", "attrs", "span_id")
+
+    def __init__(self, name: str, seq: int, attrs: dict,
+                 span_id: Optional[int]):
+        self.name = name
+        self.seq = seq
+        self.wall = time.time()
+        self.attrs = attrs
+        self.span_id = span_id
+
+    def structure_key(self) -> tuple:
+        """Identity stripped of everything timing- and thread-dependent."""
+        return (self.name,
+                tuple(sorted((k, _stable(v)) for k, v in self.attrs.items())))
+
+
+def _stable(v):
+    """Canonicalize an attribute value for structure comparison: floats
+    are rounded (chaos Decision.key idiom) so re-derived magnitudes
+    compare equal; everything else must already be primitive."""
+    if isinstance(v, float):
+        return round(v, 9)
+    return v
+
+
+class TraceConfig:
+    __slots__ = ("sample_rate", "seed")
+
+    def __init__(self, sample_rate: float = 1.0, seed: int = 0):
+        self.sample_rate = float(sample_rate)
+        self.seed = int(seed)
+
+
+class Tracer:
+    """Process-wide span/timeline store.  All mutation is lock-cheap:
+    one lock, short critical sections, bounded buffers."""
+
+    def __init__(self, config: Optional[TraceConfig] = None):
+        self.config = config or TraceConfig()
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []          # finished spans, arrival order
+        self._timeline: List[TraceEvent] = []
+        self._event_seq = itertools.count()
+        self._span_ids = itertools.count(1)
+        self._dropped_spans = 0
+        self._sample_cache: Dict[str, bool] = {}
+        self._group_seq: Dict[str, int] = {}
+        self._tls = threading.local()
+
+    # -- sampling (deterministic per key) -----------------------------------
+
+    def should_sample(self, key: str) -> bool:
+        """Keep/drop draw for one event-group key.  Depends only on
+        (seed, key) — the chaos/plan.py seeded-stream idea — so replaying
+        the same workload traces the identical group set."""
+        rate = self.config.sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            hit = self._sample_cache.get(key)
+            if hit is None:
+                hit = (random.Random(f"{self.config.seed}:{key}").random()
+                       < rate)
+                if len(self._sample_cache) < _EVENT_CAP:
+                    self._sample_cache[key] = hit
+        return hit
+
+    def next_group_key(self, stream: str) -> str:
+        """Stable per-stream sequence key: the Nth group of stream S gets
+        key "S:N" in every run that feeds S the same groups in order."""
+        with self._lock:
+            n = self._group_seq.get(stream, 0)
+            self._group_seq[stream] = n + 1
+        return f"{stream}:{n}"
+
+    # -- spans --------------------------------------------------------------
+
+    def start_span(self, name: str, trace_id: str = "",
+                   parent: Optional[Span] = None,
+                   attrs: Optional[dict] = None) -> Span:
+        if parent is None:
+            parent = self.current_span()
+        if parent is not None and not trace_id:
+            trace_id = parent.trace_id
+        return Span(self, name, trace_id, next(self._span_ids),
+                    parent.span_id if parent is not None else None, attrs)
+
+    def child_or_sampled(self, stream: str, name: str,
+                         attrs: Optional[dict] = None) -> Optional[Span]:
+        """Span-creation policy for instrumented stages: under a live
+        (already-sampled) root span the stage always records as its
+        child; a rootless stage draws its own deterministic keep/drop
+        from the per-stream key sequence — so total span volume scales
+        with the sample rate at EVERY instrumentation point, not just
+        the pipeline root."""
+        parent = self.current_span()
+        if parent is not None:
+            return self.start_span(name, parent=parent, attrs=attrs)
+        if self.config.sample_rate >= 1.0:       # fast path: no key draw
+            return self.start_span(name, attrs=attrs)
+        key = self.next_group_key(stream)
+        if not self.should_sample(key):
+            return None
+        return self.start_span(name, trace_id=key, attrs=attrs)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) < _SPAN_CAP:
+                self._spans.append(span)
+            else:
+                self._dropped_spans += 1
+        stack = getattr(self._tls, "stack", None)
+        if stack and span in stack:
+            stack.remove(span)
+
+    # current-span stack (per thread) — push/pop is explicit so the
+    # overlapped dispatch loop can detach group N's span while N+1 packs
+    def push_current(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def pop_current(self, span: Optional[Span] = None) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return
+        if span is None:
+            stack.pop()
+        elif span in stack:
+            stack.remove(span)
+
+    def current_span(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    # -- timeline -----------------------------------------------------------
+
+    def event(self, name: str, **attrs) -> None:
+        cur = self.current_span()
+        if cur is not None:
+            cur.add_event(name, **attrs)
+        ev = TraceEvent(name, next(self._event_seq), attrs,
+                        cur.span_id if cur is not None else None)
+        with self._lock:
+            if len(self._timeline) < _EVENT_CAP:
+                self._timeline.append(ev)
+
+    # -- retrieval ----------------------------------------------------------
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def timeline(self) -> List[TraceEvent]:
+        with self._lock:
+            return list(self._timeline)
+
+    def timeline_by_name(self) -> Dict[str, List[TraceEvent]]:
+        out: Dict[str, List[TraceEvent]] = {}
+        for ev in self.timeline():
+            out.setdefault(ev.name, []).append(ev)
+        return out
+
+    def drain(self) -> Tuple[List[Span], List[TraceEvent]]:
+        """Remove-and-return everything recorded so far (self-monitor
+        export cadence): each span/event ships exactly once."""
+        with self._lock:
+            spans, self._spans = self._spans, []
+            events, self._timeline = self._timeline, []
+        return spans, events
+
+    def structure(self) -> List[tuple]:
+        """The timeline + span set reduced to its timing-independent
+        structure, canonically ordered: per-name event subsequences keep
+        arrival order (deterministic under one thread, and per-point
+        deterministic like the chaos schedule under many), names sort
+        lexically, spans reduce to (name, status, sorted attr keys,
+        event names)."""
+        events = self.timeline_by_name()
+        out: List[tuple] = []
+        for name in sorted(events):
+            for ev in events[name]:
+                out.append(("event",) + ev.structure_key())
+        spans = sorted(
+            ((s.name, s.status,
+              tuple(sorted((k, _stable(v)) for k, v in s.attrs.items()
+                           if k not in _VOLATILE_ATTRS)),
+              tuple(e[0] for e in s.events))
+             for s in self.finished_spans()))
+        out.extend(("span",) + s for s in spans)
+        return out
+
+    def structure_bytes(self) -> bytes:
+        """Byte-comparable canonical serialization of `structure()` — the
+        re-run-the-seed acceptance artifact."""
+        return json.dumps(self.structure(), sort_keys=True,
+                          separators=(",", ":"),
+                          default=str).encode("utf-8")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spans": len(self._spans),
+                    "events": len(self._timeline),
+                    "dropped_spans": self._dropped_spans}
+
+
+#: span attributes whose values are run-dependent (sizes are stable, ids
+#: and timings are not) — excluded from structure comparison
+_VOLATILE_ATTRS = frozenset({"duration_ms", "wall", "thread"})
+
+
+# ---------------------------------------------------------------------------
+# module-level plane (the chaos/plane.py shape): one global, one branch
+
+
+_tracer: Optional[Tracer] = None
+
+
+def is_active() -> bool:
+    return _tracer is not None
+
+
+def active_tracer() -> Optional[Tracer]:
+    """THE disabled-path hook: call sites read this once; None means
+    tracing is off and nothing else may run."""
+    return _tracer
+
+
+def enable(config: Optional[TraceConfig] = None) -> Tracer:
+    global _tracer
+    t = Tracer(config)
+    _tracer = t
+    return t
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+@contextlib.contextmanager
+def active(config: Optional[TraceConfig] = None):
+    """Scoped activation for tests: ``with trace.active() as t: ...``."""
+    t = enable(config)
+    try:
+        yield t
+    finally:
+        disable()
+
+
+def install_from_env(env=os.environ) -> bool:
+    """LOONG_TRACE=1 activates tracing at application start;
+    LOONG_TRACE_SAMPLE (float, default 1.0) and LOONG_TRACE_SEED (int,
+    default 0) shape deterministic sampling."""
+    raw = env.get(ENV_ENABLE)
+    if not raw or raw.strip().lower() in ("0", "false", "no", "off"):
+        return False
+    try:
+        rate = float(env.get(ENV_SAMPLE, "1.0"))
+    except ValueError:
+        rate = 1.0
+    try:
+        seed = int(env.get(ENV_SEED, "0"))
+    except ValueError:
+        seed = 0
+    enable(TraceConfig(sample_rate=rate, seed=seed))
+    return True
+
+
+# -- hot-path hooks: each is one global read + branch when disabled ---------
+
+
+def event(name: str, **attrs) -> None:
+    """Record a timeline event (and attach to the current span, if any).
+    Disabled: a single branch."""
+    t = _tracer
+    if t is None:
+        return
+    t.event(name, **attrs)
+
+
+def start_span(name: str, trace_id: str = "",
+               parent: Optional[Span] = None,
+               attrs: Optional[dict] = None) -> Optional[Span]:
+    t = _tracer
+    if t is None:
+        return None
+    return t.start_span(name, trace_id, parent, attrs)
+
+
+def span(name: str, **attrs):
+    """``with trace.span("stage"): ...`` — returns a no-op context when
+    disabled (the with-statement itself is the only residual cost, so
+    hot paths should prefer an ``is_active()`` guard)."""
+    t = _tracer
+    if t is None:
+        return contextlib.nullcontext()
+    sp = t.start_span(name, attrs=attrs or None)
+    return sp
+
+
+def current_span() -> Optional[Span]:
+    t = _tracer
+    if t is None:
+        return None
+    return t.current_span()

@@ -8,12 +8,18 @@
 #   2. native hygiene: -Werror syntax pass + clang-tidy when installed;
 #   3. ResourceWarning sweep: the concurrency stress tests under
 #      `python -X dev -W error::ResourceWarning` — an unclosed socket,
-#      file, or thread-local leak in the hot paths fails loudly here.
+#      file, or thread-local leak in the hot paths fails loudly here;
+#   4. tracing-overhead smoke: loongtrace's disabled path must stay one
+#      branch per hook (10k-event synthetic pipeline, disabled vs no-op
+#      baseline, >5% regression fails — docs/observability.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== loonglint =="
 python -m loongcollector_tpu.analysis "$@"
+
+echo "== tracing-overhead smoke =="
+JAX_PLATFORMS=cpu python scripts/trace_overhead.py
 
 echo "== native lint =="
 make -C native lint

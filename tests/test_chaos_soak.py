@@ -19,7 +19,7 @@ import time
 import numpy as np
 import pytest
 
-from loongcollector_tpu import chaos
+from loongcollector_tpu import chaos, trace
 from loongcollector_tpu.chaos import ChaosFault, ChaosPlan, FaultSpec
 from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
 from loongcollector_tpu.ops.device_plane import (DevicePlane,
@@ -42,10 +42,14 @@ SOAK_SEEDS = tuple(range(100, 124))      # full soak: 24 more seeds
 
 @pytest.fixture(autouse=True)
 def _chaos_clean():
-    """No chaos plan leaks between tests; drain the alarm singleton."""
-    chaos.uninstall()
+    """No chaos plan (or tracer) leaks between tests; drain the alarm
+    singleton.  Full reset: hit counts and the schedule log from another
+    test file's storm must not be visible here."""
+    chaos.reset()
+    trace.disable()
     yield
-    chaos.uninstall()
+    chaos.reset()
+    trace.disable()
     AlarmManager.instance().flush()
 
 
@@ -268,11 +272,34 @@ class TestSinkStorm:
     @pytest.mark.parametrize("seed", SEEDS)
     def test_zero_loss_and_breakers_reclose(self, seed, recording_server,
                                             tmp_path, fast_retries):
-        payloads, _ = _drive_sink_storm(seed, recording_server, tmp_path)
+        tracer = trace.enable()
+        payloads, runner = _drive_sink_storm(seed, recording_server, tmp_path)
         assert payloads <= recording_server.received
         counts = chaos.fault_counts()
         assert counts.get("http_sink.send", 0) > 0, (
             f"seed {seed} injected no faults — storm did not happen")
+        # -- trace timeline upgrade (ISSUE 3): the storm must be one
+        # causal story — ZERO silent injections, every breaker transition
+        # visible on the same timeline as the faults that caused it
+        by_name = tracer.timeline_by_name()
+        injected = {(e.attrs["point"], e.attrs["hit"], e.attrs["action"])
+                    for e in by_name.get("chaos.inject", ())}
+        scheduled = {(p, h, a) for (p, h, a, _d, _m) in chaos.schedule()}
+        assert scheduled == injected, (
+            f"seed {seed}: injections missing from the trace timeline: "
+            f"{scheduled ^ injected}")
+        opened = sum(br.metrics.counter("opened_total").value
+                     for br in runner.breakers().values())
+        reclosed = sum(br.metrics.counter("reclosed_total").value
+                       for br in runner.breakers().values())
+        assert len(by_name.get("breaker.open", ())) == opened, (
+            f"seed {seed}: breaker open transitions missing from trace")
+        assert len(by_name.get("breaker.close", ())) == reclosed, (
+            f"seed {seed}: breaker close transitions missing from trace")
+        # spans flowed too: the sink sends of a traced storm are spans
+        sink_spans = [s for s in tracer.finished_spans()
+                      if s.name == "sink.send"]
+        assert sink_spans, f"seed {seed}: no sink.send spans recorded"
 
 
 class TestDeviceStorm:

@@ -83,12 +83,12 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_five_checkers_registered(self):
+    def test_all_six_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
-                         "swallowed-fault"]
-        assert len(all_checkers()) == 5
+                         "swallowed-fault", "metric-naming"]
+        assert len(all_checkers()) == 6
 
 
 # ---------------------------------------------------------------------------
@@ -595,6 +595,150 @@ def send(x):
         findings = list(SwallowedFaultChecker().check_module(mod))
         assert len(findings) == 1
         assert mod.suppressed(findings[0].line, findings[0].check)
+
+
+class TestMetricNaming:
+    """metric-naming (ISSUE 3): snake_case metric names, one exposition
+    kind per name, and class-owned MetricsRecords must be released."""
+
+    def _scan(self, src, relpath="loongcollector_tpu/runner/fixture.py",
+              extra_modules=()):
+        from loongcollector_tpu.analysis.checkers.metric_naming import \
+            MetricNamingChecker
+        return scan(src, MetricNamingChecker(), relpath=relpath,
+                    extra_modules=extra_modules)
+
+    # -- naming --------------------------------------------------------------
+
+    def test_flags_non_snake_case_literal(self):
+        findings = self._scan("""
+            class R:
+                def __init__(self):
+                    self.metrics = MetricsRecord()
+                    self.metrics.counter("camelCaseTotal")
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """)
+        assert checks_of(findings) == {"metric-naming"}
+        assert "snake_case" in findings[0].message
+
+    def test_fstring_fragments_checked(self):
+        findings = self._scan("""
+            class R:
+                def __init__(self, action):
+                    self.metrics = MetricsRecord()
+                    self.metrics.counter(f"faults_{action}_total")
+                    self.metrics.counter(f"Bad-{action}_total")
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """)
+        assert len(findings) == 1
+        assert "'Bad-'" in findings[0].message
+
+    def test_snake_case_names_pass(self):
+        findings = self._scan("""
+            class R:
+                def __init__(self):
+                    self.metrics = MetricsRecord()
+                    self.metrics.counter("in_events_total")
+                    self.metrics.gauge("state")
+                    self.metrics.histogram("rtt_seconds")
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """)
+        assert findings == []
+
+    # -- kind uniqueness -----------------------------------------------------
+
+    def test_flags_cross_module_kind_conflict(self):
+        findings = self._scan("""
+            class A:
+                def __init__(self):
+                    self.metrics = MetricsRecord()
+                    self.metrics.counter("depth")
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """, extra_modules=[("loongcollector_tpu/flusher/fx2.py", """
+            class B:
+                def __init__(self):
+                    self.metrics = MetricsRecord()
+                    self.metrics.gauge("depth")
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """)])
+        assert any("conflicting kinds counter/gauge" in f.message
+                   for f in findings)
+
+    def test_same_kind_everywhere_ok(self):
+        findings = self._scan("""
+            class A:
+                def __init__(self):
+                    self.m = MetricsRecord()
+                    self.m.counter("in_events_total")
+                def stop(self):
+                    self.m.mark_deleted()
+        """, extra_modules=[("loongcollector_tpu/flusher/fx2.py", """
+            class B:
+                def __init__(self):
+                    self.m = MetricsRecord()
+                    self.m.counter("in_events_total")
+                def stop(self):
+                    self.m.mark_deleted()
+        """)])
+        assert findings == []
+
+    # -- ownership -----------------------------------------------------------
+
+    def test_flags_leaked_record(self):
+        """The pre-PR-3 SinkCircuitBreaker shape: a record created per
+        construct, registered into WriteMetrics, never released."""
+        findings = self._scan("""
+            class Breaker:
+                def __init__(self):
+                    self.metrics = MetricsRecord(category="component")
+                    self.opened = self.metrics.counter("opened_total")
+        """)
+        assert checks_of(findings) == {"metric-naming"}
+        assert "never mark_deleted" in findings[0].message
+        assert findings[0].symbol == "Breaker"
+
+    def test_mark_deleted_in_class_ok(self):
+        findings = self._scan("""
+            class Runner:
+                def __init__(self):
+                    self.metrics = MetricsRecord()
+                def stop(self):
+                    self.metrics.mark_deleted()
+        """)
+        assert findings == []
+
+    def test_escape_to_owner_ok(self):
+        """The plugin-instance shape: the record is handed to an external
+        owner (the pipeline's _metric_records) which releases it."""
+        findings = self._scan("""
+            class Instance:
+                def __init__(self, plugin):
+                    self.metrics = MetricsRecord()
+                    plugin.metrics_record = self.metrics
+        """)
+        assert findings == []
+
+    def test_append_escape_ok(self):
+        findings = self._scan("""
+            class Pipeline:
+                def __init__(self):
+                    self._records = []
+                    self.metrics = MetricsRecord()
+                    self._records.append(self.metrics)
+        """)
+        assert findings == []
+
+    def test_module_level_record_exempt(self):
+        findings = self._scan("""
+            _rec = MetricsRecord(category="agent")
+            _hist = _rec.histogram("wait_seconds")
+        """)
+        assert findings == []
 
 
 class TestFramework:

@@ -1,0 +1,594 @@
+"""loongtrace: span layer, deterministic timeline, histograms, exposition.
+
+The ISSUE 3 acceptance spine lives here:
+
+  * a single seeded chaos storm produces a deterministic trace timeline
+    containing the injected faults, breaker transitions and spill/replay
+    events — re-running the same seed yields BYTE-IDENTICAL span
+    structure (`TestDeterministicTimeline`);
+  * histograms are retrievable via the Prometheus-text endpoint and
+    traces flow as self-telemetry PipelineEventGroups
+    (`TestExposition`, `TestSelfMonitorTraces`);
+  * the `MetricsRecord.snapshot(reset_counters=True)` read-reset race is
+    fixed: concurrent adds are never lost (`TestMetricsRaces`);
+  * metric records owned by runners/breakers retire on stop
+    (`TestRecordOwnership`).
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import chaos, trace
+from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+from loongcollector_tpu.monitor.alarms import AlarmManager
+from loongcollector_tpu.monitor import exposition
+from loongcollector_tpu.monitor.metrics import (Histogram, MetricsRecord,
+                                                ReadMetrics, WriteMetrics)
+from loongcollector_tpu.monitor.self_monitor import SelfMonitorServer
+from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                 LatencyInjectedKernel,
+                                                 roundtrip_histogram)
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import (SenderQueueItem,
+                                                            SenderQueueManager)
+from loongcollector_tpu.runner.circuit import BreakerState, SinkCircuitBreaker
+from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+from loongcollector_tpu.runner.flusher_runner import FlusherRunner
+from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+from loongcollector_tpu.trace import TraceConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    trace.disable()
+    yield
+    chaos.reset()      # full reset: later tests must not see our storms
+    trace.disable()
+    # breaker trips in the storm tests raise SINK_CIRCUIT_OPEN alarms on
+    # the process-wide singleton — drain them or they poison other files
+    AlarmManager.instance().flush()
+
+
+# ---------------------------------------------------------------------------
+# disabled-path contract
+
+
+class TestDisabledPath:
+    def test_hooks_are_noops(self):
+        assert not trace.is_active()
+        assert trace.active_tracer() is None
+        assert trace.start_span("x") is None
+        assert trace.current_span() is None
+        trace.event("x", a=1)          # swallowed, no tracer to record it
+        with trace.span("y"):
+            pass
+        tracer = trace.enable()
+        assert tracer.finished_spans() == []
+        assert tracer.timeline() == []
+
+    def test_scoped_activation(self):
+        with trace.active() as t:
+            assert trace.is_active()
+            trace.event("inside")
+            assert len(t.timeline()) == 1
+        assert not trace.is_active()
+
+    def test_env_activation(self):
+        assert not trace.install_from_env({})
+        assert not trace.install_from_env({"LOONG_TRACE": "0"})
+        assert trace.install_from_env({"LOONG_TRACE": "1",
+                                       "LOONG_TRACE_SAMPLE": "0.25",
+                                       "LOONG_TRACE_SEED": "7"})
+        t = trace.active_tracer()
+        assert t.config.sample_rate == 0.25
+        assert t.config.seed == 7
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+class TestSpans:
+    def test_span_lifecycle_and_parenting(self):
+        t = trace.enable()
+        root = t.start_span("root", trace_id="g:0")
+        t.push_current(root)
+        child = t.start_span("child")
+        assert child.parent_id == root.span_id
+        assert child.trace_id == "g:0"
+        child.end()
+        trace.event("boom", k=1)       # attaches to current root span
+        t.pop_current(root)
+        root.end()
+        spans = {s.name: s for s in t.finished_spans()}
+        assert set(spans) == {"root", "child"}
+        assert spans["root"].duration_s is not None
+        assert [e[0] for e in spans["root"].events] == ["boom"]
+        # the timeline keeps the event too, linked to the span
+        (ev,) = t.timeline()
+        assert ev.span_id == root.span_id
+
+    def test_end_is_idempotent(self):
+        t = trace.enable()
+        sp = t.start_span("once")
+        sp.end()
+        sp.end("error")
+        assert len(t.finished_spans()) == 1
+        assert t.finished_spans()[0].status == "ok"
+
+    def test_context_manager_records_error_status(self):
+        t = trace.enable()
+        with pytest.raises(ValueError):
+            with trace.span("risky"):
+                raise ValueError("x")
+        assert t.finished_spans()[0].status == "error"
+
+
+# ---------------------------------------------------------------------------
+# deterministic sampling
+
+
+class TestDeterministicSampling:
+    def test_same_seed_same_verdicts(self):
+        t1 = trace.enable(TraceConfig(sample_rate=0.5, seed=11))
+        v1 = [t1.should_sample(f"p:{i}") for i in range(200)]
+        t2 = trace.enable(TraceConfig(sample_rate=0.5, seed=11))
+        v2 = [t2.should_sample(f"p:{i}") for i in range(200)]
+        assert v1 == v2
+        assert any(v1) and not all(v1)
+
+    def test_different_seeds_diverge(self):
+        a = trace.Tracer(TraceConfig(sample_rate=0.5, seed=1))
+        b = trace.Tracer(TraceConfig(sample_rate=0.5, seed=2))
+        assert [a.should_sample(f"p:{i}") for i in range(64)] != \
+            [b.should_sample(f"p:{i}") for i in range(64)]
+
+    def test_rate_extremes(self):
+        t = trace.Tracer(TraceConfig(sample_rate=1.0))
+        assert all(t.should_sample(f"k:{i}") for i in range(8))
+        t = trace.Tracer(TraceConfig(sample_rate=0.0))
+        assert not any(t.should_sample(f"k:{i}") for i in range(8))
+
+    def test_group_keys_are_stable_sequences(self):
+        t = trace.enable()
+        assert t.next_group_key("p1") == "p1:0"
+        assert t.next_group_key("p1") == "p1:1"
+        assert t.next_group_key("p2") == "p2:0"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance spine: seeded storm → deterministic, byte-identical trace
+
+
+class _Q:
+    def __init__(self):
+        self.items = []
+
+    def push(self, item):
+        self.items.append(item)
+        return True
+
+
+class _StormFlusher:
+    name = "flusher_storm"
+    queue_key = 1
+
+    def __init__(self):
+        self.sender_queue = _Q()
+
+    def spill_identity(self):
+        return {"pipeline": "storm", "flusher_type": self.name,
+                "plugin_id": "flusher_storm/0"}
+
+
+def _run_seeded_storm(seed, tmp_path, tag):
+    """One single-threaded storm through REAL components: chaos
+    faultpoints, a SinkCircuitBreaker, DiskBufferWriter spill/replay and
+    DevicePlane round-trips — everything the timeline must witness."""
+    tracer = trace.enable(TraceConfig(seed=seed))
+    br = SinkCircuitBreaker("storm/sink", failure_threshold=2,
+                            cooldown_s=0.0)
+    db = DiskBufferWriter(str(tmp_path / f"storm-{tag}"))
+    flusher = _StormFlusher()
+    plane = DevicePlane(budget_bytes=1 << 20)
+    kernel = LatencyInjectedKernel(lambda x: x + 1, rtt_s=0.0)
+    arr = np.arange(4, dtype=np.int64)
+    plan = ChaosPlan(seed, {
+        "http_sink.send": FaultSpec(prob=0.45, delay_range=(0.0, 0.0),
+                                    max_faults=10),
+        "device_plane.submit": FaultSpec(prob=0.3, delay_range=(0.0, 0.0),
+                                         max_faults=6),
+    })
+    with chaos.active(plan):
+        for i in range(40):
+            try:
+                chaos.faultpoint("http_sink.send", exc=ConnectionError)
+                br.on_success()
+            except ConnectionError:
+                br.on_failure()
+                if br.state is not BreakerState.CLOSED:
+                    item = SenderQueueItem(b"payload-%d" % i, 8,
+                                           flusher=flusher, queue_key=1)
+                    assert db.spill(item, flusher.spill_identity())
+                    br.note_spilled()
+            if br.state is not BreakerState.CLOSED and br.allow_probe():
+                br.on_success()                       # probe → re-close
+        for _ in range(12):
+            fut = plane.submit(kernel, (arr,), nbytes=64)
+            try:
+                fut.result()
+            except chaos.ChaosFault:
+                pass
+        db.replay(lambda identity: flusher)
+    structure = tracer.structure_bytes()
+    by_name = tracer.timeline_by_name()
+    schedule = chaos.schedule()
+    br.mark_deleted()
+    trace.disable()
+    return structure, by_name, schedule
+
+
+class TestDeterministicTimeline:
+    SEED = 20240803
+
+    def test_storm_timeline_is_complete_and_reproducible(self, tmp_path):
+        s1, by_name, schedule = _run_seeded_storm(self.SEED, tmp_path, "a")
+        # every injected fault is on the timeline — zero silent injections
+        injected = {(e.attrs["point"], e.attrs["hit"], e.attrs["action"])
+                    for e in by_name["chaos.inject"]}
+        assert injected == {(p, h, a) for (p, h, a, _d, _m) in schedule}
+        assert injected, "storm injected nothing"
+        # breaker transitions and spill/replay are all visible
+        assert by_name.get("breaker.open"), "no breaker.open on timeline"
+        assert by_name.get("breaker.half_open")
+        assert by_name.get("breaker.close")
+        assert by_name.get("disk_buffer.spill"), "no spill on timeline"
+        assert by_name.get("disk_buffer.replay"), "no replay on timeline"
+        # the same seed re-runs to BYTE-IDENTICAL span structure
+        s2, _, _ = _run_seeded_storm(self.SEED, tmp_path, "b")
+        assert s1 == s2
+
+    def test_different_seeds_produce_different_structure(self, tmp_path):
+        s1, _, _ = _run_seeded_storm(3, tmp_path, "c")
+        s2, _, _ = _run_seeded_storm(4, tmp_path, "d")
+        assert s1 != s2
+
+
+# ---------------------------------------------------------------------------
+# device plane: the submit→resolve stopwatch
+
+
+class TestDeviceRoundtrip:
+    def test_stopwatch_feeds_histogram_and_spans(self):
+        base = roundtrip_histogram().count
+        plane = DevicePlane(budget_bytes=1 << 20)
+        kernel = LatencyInjectedKernel(lambda x: x * 2, rtt_s=0.002)
+        t = trace.enable()
+        fut = plane.submit(kernel, (np.arange(8, dtype=np.int64),),
+                           nbytes=64)
+        assert fut.result()[0][1] == 2
+        assert roundtrip_histogram().count == base + 1
+        assert roundtrip_histogram().snapshot()["max"] >= 0.002
+        (sp,) = t.finished_spans()
+        assert sp.name == "device.roundtrip"
+        assert sp.status == "ok"
+        assert sp.attrs["nbytes"] == 64
+        assert sp.duration_s >= 0.002
+
+    def test_errored_future_ends_span_error(self):
+        plane = DevicePlane(budget_bytes=1 << 20)
+        t = trace.enable()
+
+        def boom(x):
+            raise RuntimeError("kernel exploded")
+
+        fut = plane.submit(boom, (np.arange(2),), nbytes=8)
+        with pytest.raises(RuntimeError):
+            fut.result()
+        (sp,) = t.finished_spans()
+        assert sp.status == "error"
+        assert plane.inflight_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# histogram
+
+
+class TestHistogram:
+    def test_log2_buckets_and_percentiles(self):
+        h = Histogram("t_seconds")
+        for _ in range(90):
+            h.observe(0.001)
+        for _ in range(10):
+            h.observe(1.0)
+        s = h.snapshot()
+        assert s["count"] == 100
+        assert 0.001 <= s["p50"] <= 0.002048
+        assert 0.001 <= s["p90"] <= 0.002048
+        assert s["p99"] == 1.0          # clamped to observed max
+        assert s["max"] == 1.0
+        assert abs(s["sum"] - (0.09 + 10.0)) < 1e-9
+
+    def test_overflow_and_negative_clamp(self):
+        h = Histogram("t_seconds", base=1e-6, n_buckets=4)
+        h.observe(10.0)                 # way past the top finite bucket
+        h.observe(-5.0)                 # clamped to zero
+        buckets = h.buckets()
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == 2
+        assert buckets[0][1] == 1       # the clamped zero
+        assert h.snapshot()["max"] == 10.0
+
+    def test_reset_semantics(self):
+        h = Histogram("t_seconds")
+        h.observe(0.5)
+        assert h.snapshot(reset=True)["count"] == 1
+        assert h.snapshot()["count"] == 0
+
+    def test_concurrent_observe_conserves_count(self):
+        h = Histogram("t_seconds")
+
+        def worker():
+            for _ in range(2000):
+                h.observe(0.001)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.snapshot()["count"] == 8000
+
+    def test_record_registration_and_snapshot_shape(self):
+        rec = MetricsRecord(category="test_hist")
+        h = rec.histogram("lat_seconds")
+        assert rec.histogram("lat_seconds") is h
+        h.observe(0.01)
+        snap = rec.snapshot()
+        assert snap["histograms"]["lat_seconds"]["count"] == 1
+        rec.mark_deleted()
+
+
+# ---------------------------------------------------------------------------
+# the snapshot race fix
+
+
+class TestMetricsRaces:
+    def test_reset_snapshot_never_loses_adds(self):
+        """Two threads: one hammers add(1), one snapshots with reset.
+        Conservation law: sum of drained deltas + residual == total adds.
+        Pre-fix, an add could land between a counter's read and reset and
+        vanish."""
+        rec = MetricsRecord(category="race_test")
+        c = rec.counter("hits_total")
+        n_adds = 50_000
+        drained = []
+        stop = threading.Event()
+
+        def snapshotter():
+            while not stop.is_set():
+                drained.append(rec.snapshot(
+                    reset_counters=True)["counters"]["hits_total"])
+
+        t = threading.Thread(target=snapshotter)
+        t.start()
+        for _ in range(n_adds):
+            c.add(1)
+        stop.set()
+        t.join()
+        residual = rec.snapshot(reset_counters=True)["counters"]["hits_total"]
+        assert sum(drained) + residual == n_adds
+        rec.mark_deleted()
+
+    def test_concurrent_registration_during_snapshot(self):
+        """First-touch registration mid-snapshot must never blow up the
+        iteration (the chaos plane registers fault counters lazily during
+        storms, racing the self-monitor's snapshot loop)."""
+        rec = MetricsRecord(category="race_test")
+        stop = threading.Event()
+        errors = []
+
+        def registrar():
+            i = 0
+            while not stop.is_set():
+                # bounded name space: the race needs first-touch inserts
+                # racing the snapshot iteration, not unbounded dict growth
+                # (unbounded, each snapshot gets quadratically slower and
+                # the test wedges under adverse scheduling)
+                rec.counter(f"c{i % 256}_total").add(1)
+                rec.gauge(f"g{i % 256}").set(1.0)
+                i += 1
+
+        def snapshotter():
+            try:
+                for _ in range(300):
+                    rec.snapshot(reset_counters=True)
+            except RuntimeError as e:    # "dict changed size during iteration"
+                errors.append(e)
+            finally:
+                stop.set()
+
+        ts = [threading.Thread(target=registrar),
+              threading.Thread(target=snapshotter)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        rec.mark_deleted()
+
+    def test_name_validation_and_kind_uniqueness(self):
+        rec = MetricsRecord(category="val_test")
+        with pytest.raises(ValueError):
+            rec.counter("Not-Snake")
+        rec.counter("depth_total")
+        with pytest.raises(ValueError):
+            rec.gauge("depth_total")     # same name, different kind
+        rec.mark_deleted()
+
+
+# ---------------------------------------------------------------------------
+# record ownership: runners/breakers retire their records on stop
+
+
+class TestRecordOwnership:
+    def _live(self):
+        WriteMetrics.instance().gc_deleted()
+        return len(WriteMetrics.instance().records())
+
+    def test_flusher_runner_and_breakers_retire_on_stop(self):
+        base = self._live()
+        runner = FlusherRunner(SenderQueueManager(), None)
+        flusher = _StormFlusher()
+        item = SenderQueueItem(b"x", 1, flusher=flusher, queue_key=9)
+        runner.breaker_for(item)         # creates a breaker record
+        assert self._live() == base + 2
+        runner.stop(drain=False)
+        assert self._live() == base
+
+    def test_processor_runner_retires_on_stop(self):
+        base = self._live()
+        runner = ProcessorRunner(ProcessQueueManager(), None,
+                                 thread_count=1)
+        assert self._live() == base + 1
+        runner.init()
+        runner.stop()
+        assert self._live() == base
+
+
+# ---------------------------------------------------------------------------
+# exposition endpoint + self-telemetry
+
+
+class TestExposition:
+    def test_render_includes_histograms_and_labels(self):
+        rec = MetricsRecord(category="expo_test", labels={"sink": "s1"})
+        rec.counter("sent_total").add(4)
+        rec.histogram("rtt_seconds").observe(0.004)
+        text = exposition.render()
+        rec.mark_deleted()
+        assert '<' not in text.split("\n")[0]
+        assert 'loong_sent_total{category="expo_test",sink="s1"} 4' in text
+        assert "# TYPE loong_rtt_seconds histogram" in text
+        assert 'loong_rtt_seconds_bucket{category="expo_test",' \
+            'sink="s1",le="+Inf"} 1' in text
+        assert "loong_rtt_seconds_count" in text
+        assert "loong_rtt_seconds_p99" in text
+
+    def test_render_does_not_reset_counters(self):
+        rec = MetricsRecord(category="expo_test2")
+        rec.counter("kept_total").add(7)
+        exposition.render()
+        assert rec.counter("kept_total").value == 7
+        rec.mark_deleted()
+
+    def test_http_endpoint_serves_storm_histograms(self, tmp_path):
+        """Acceptance leg: after a seeded storm the latency histograms are
+        retrievable over the Prometheus endpoint."""
+        _run_seeded_storm(42, tmp_path, "expo")
+        server = exposition.ExpositionServer(0)
+        assert server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=5).read().decode()
+        finally:
+            server.stop()
+        assert "loong_device_roundtrip_seconds_bucket" in body
+        assert "loong_device_roundtrip_seconds_p50" in body
+        # 404 for anything else, and stop() is idempotent
+        server.stop()
+
+    def test_start_from_env(self):
+        assert exposition.start_from_env({}) is None
+        assert exposition.start_from_env({"LOONG_EXPO_PORT": "bogus"}) is None
+        server = exposition.start_from_env({"LOONG_EXPO_PORT": "0"})
+        assert server is not None
+        server.stop()
+
+
+class TestSelfMonitorTraces:
+    def test_traces_flow_as_event_groups(self, tmp_path):
+        """Acceptance leg: the storm's spans/events flow to sinks as
+        PipelineEventGroups through the self-monitor pipeline."""
+        tracer = trace.enable()
+        trace.event("chaos.inject", point="x", hit=0, action="error")
+        sp = tracer.start_span("pipeline.process", trace_id="p:0")
+        sp.end()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(201)
+        pqm.create_or_reuse_queue(202)
+        server = SelfMonitorServer()
+        server.process_queue_manager = pqm
+        server.set_metrics_pipeline(201)
+        server.set_traces_pipeline(202)
+        server.send_once()
+        key, group = pqm.pop_item(timeout=0)
+        while key != 202:
+            key, group = pqm.pop_item(timeout=0)
+        assert bytes(group.get_tag(b"__source__")) == b"loongtrace"
+        kinds = set()
+        names = set()
+        for ev in group.events:
+            c = {bytes(k): bytes(v) for k, v in ev.contents}
+            kinds.add(c[b"kind"])
+            names.add(c[b"name"])
+        assert kinds == {b"span", b"event"}
+        assert {b"chaos.inject", b"pipeline.process"} <= names
+        # drained: a second send has nothing trace-wise
+        assert tracer.finished_spans() == []
+        assert tracer.timeline() == []
+
+    def test_histogram_percentiles_flatten_into_metrics_group(self):
+        rec = MetricsRecord(category="selfmon_hist",
+                            labels={"pipeline_name": "px"})
+        rec.histogram("wait_seconds").observe(0.01)
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(211)
+        server = SelfMonitorServer()
+        server.process_queue_manager = pqm
+        server.set_metrics_pipeline(211)
+        server.send_once()
+        rec.mark_deleted()
+        found = {}
+        while True:
+            item = pqm.pop_item(timeout=0)
+            if item is None:
+                break
+            _, group = item
+            for ev in group.events:
+                if str(ev.name) == "selfmon_hist":
+                    found = ev.value.values
+        assert found, "histogram record never reached the metrics group"
+        keys = {k.decode() for k in found}
+        assert {"wait_seconds_count", "wait_seconds_p50", "wait_seconds_p99",
+                "wait_seconds_max"} <= keys
+
+
+# ---------------------------------------------------------------------------
+# timeline bounds
+
+
+class TestTimelineBounds:
+    def test_span_events_are_bounded(self):
+        t = trace.enable()
+        sp = t.start_span("busy")
+        for i in range(500):
+            sp.add_event("e", i=i)
+        sp.end()
+        assert len(t.finished_spans()[0].events) <= 256
+
+    def test_drain_returns_everything_once(self):
+        t = trace.enable()
+        t.start_span("a").end()
+        trace.event("x")
+        spans, events = t.drain()
+        assert len(spans) == 1 and len(events) == 1
+        spans, events = t.drain()
+        assert spans == [] and events == []
