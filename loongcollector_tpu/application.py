@@ -40,7 +40,8 @@ from .utils.logger import get_logger
 
 log = get_logger("application")
 
-flags.DEFINE_FLAG_INT32("process_thread_count", "processor runner threads", 1)
+# process_thread_count is defined by runner.processor_runner (loongshard
+# default >1); app-config overrides still apply through the flag registry
 flags.DEFINE_FLAG_INT32("config_scan_interval", "config rescan seconds", 10)
 flags.DEFINE_FLAG_INT32("checkpoint_dump_interval", "checkpoint dump seconds", 5)
 flags.DEFINE_FLAG_DOUBLE("exit_flush_timeout", "flush-out budget on exit (s)", 20.0)
@@ -95,9 +96,10 @@ class Application:
                      trace.active_tracer().config.sample_rate)
         from .monitor.exposition import start_from_env as _expo_from_env
         self.exposition = _expo_from_env()
+        from .runner.processor_runner import resolve_thread_count
         self.processor_runner = ProcessorRunner(
             self.process_queue_manager, self.pipeline_manager,
-            thread_count=flags.get_flag("process_thread_count"))
+            thread_count=resolve_thread_count())
         self.config_watcher = PipelineConfigWatcher()
         from .config.instance_config import (InstanceConfigManager,
                                              InstanceConfigWatcher)

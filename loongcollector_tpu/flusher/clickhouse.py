@@ -8,12 +8,11 @@ library, which is the idiomatic shape for this framework's sender path.
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import quote
 
 from ..models import PipelineEventGroup
-from ..pipeline.serializer.event_dicts import iter_event_dicts
+from ..pipeline.serializer.batch_json import ndjson_payload
 from .http_base import AddressRotator, HttpSinkFlusher, basic_auth_header
 
 
@@ -30,14 +29,13 @@ class FlusherClickHouse(HttpSinkFlusher):
 
     def build_payload(self, groups: List[PipelineEventGroup]
                       ) -> Optional[Tuple[bytes, Dict[str, str]]]:
-        rows: List[bytes] = []
-        for g in groups:
-            for ts, obj in iter_event_dicts(g):
-                obj.setdefault("_timestamp", ts)
-                rows.append(json.dumps(obj, ensure_ascii=False).encode())
-        if not rows:
+        # shared batched serializer (loongshard): columnar groups assemble
+        # JSONEachRow bytes natively, identical to the old per-row
+        # json.dumps loop (tests/test_batch_json.py goldens)
+        body = ndjson_payload(groups, ts_key="_timestamp")
+        if body is None:
             return None
-        return b"\n".join(rows) + b"\n", self.auth
+        return body, self.auth
 
     def endpoint_url(self, item) -> str:
         q = quote(f"INSERT INTO {self.database}.{self.table} "

@@ -52,6 +52,9 @@ class FlusherLoki(HttpSinkFlusher):
                 if "content" in obj and len(obj) == 1:
                     line = str(obj["content"])
                 else:
+                    # the loki stream body re-wraps the line as a JSON
+                    # string value, so rows stay str here (an encode/decode
+                    # round trip through the bytes helper would be waste)
                     line = json.dumps(obj, ensure_ascii=False) if obj else ""
                 k = tuple(sorted(labels.items()))
                 entry = streams.setdefault(k, {"stream": labels,

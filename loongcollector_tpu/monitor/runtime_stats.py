@@ -20,6 +20,8 @@ _ebpf_rec = MetricsRecord(category="ebpf_connections",
                           labels={"component": "ebpf"})
 _mesh_rec = MetricsRecord(category="mesh_parse",
                           labels={"component": "sharded_plane"})
+_shard_rec = MetricsRecord(category="processor_shards",
+                           labels={"component": "loongshard"})
 
 
 def refresh() -> None:
@@ -54,6 +56,25 @@ def refresh() -> None:
                 _mesh_rec.gauge("last_events").set(int(stats["events"]))
                 _mesh_rec.gauge("last_bytes").set(int(stats["bytes"]))
                 break
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongshard: live shard backlog — an imbalanced affinity hash or a
+        # wedged worker shows up here as one inbox holding the max depth
+        from ..runner import processor_runner as _pr
+        runner = _pr._active_runner       # observe-only: never construct
+        if runner is not None:
+            depths = runner.inbox_depths()
+            _shard_rec.gauge("process_workers").set(runner.thread_count)
+            _shard_rec.gauge("inbox_backlog_groups").set(sum(depths))
+            _shard_rec.gauge("inbox_backlog_max").set(
+                max(depths) if depths else 0)
+        else:
+            # no live runner: zero rather than freeze the last values — a
+            # stopped runner must not export a phantom backlog
+            _shard_rec.gauge("process_workers").set(0)
+            _shard_rec.gauge("inbox_backlog_groups").set(0)
+            _shard_rec.gauge("inbox_backlog_max").set(0)
     except Exception:  # noqa: BLE001
         pass
     try:

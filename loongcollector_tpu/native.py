@@ -61,7 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError as e:
             log.warning("failed to load native library: %s", e)
             return None
-        if not hasattr(lib, "lct_t1_exec"):
+        if not hasattr(lib, "lct_t1_exec") \
+                or not hasattr(lib, "lct_ndjson_serialize"):
             # stale build predating the newest entry point: rebuild + reload
             if _try_build():
                 try:
@@ -96,6 +97,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
                 u8p, i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64,
                 u8p, ctypes.c_int64]
+        if hasattr(lib, "lct_ndjson_serialize"):
+            lib.lct_ndjson_serialize.restype = ctypes.c_int64
+            lib.lct_ndjson_serialize.argtypes = [
+                u8p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int64,
+                u8p, i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64,
+                u8p, ctypes.c_int64, ctypes.c_int32,
+                u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                u8p, ctypes.c_int64, u8p, ctypes.c_int64]
         for fn in ("lct_lz4_bound", "lct_lz4_compress", "lct_lz4_decompress",
                    "lct_snappy_bound", "lct_snappy_compress",
                    "lct_snappy_uncompressed_len", "lct_snappy_decompress"):
@@ -193,6 +202,29 @@ def json_extract(arena: np.ndarray, offsets: np.ndarray,
     return out_offs, out_lens, ok.astype(bool), fallback.astype(bool)
 
 
+_key_cache: dict = {}
+_key_cache_lock = threading.Lock()
+
+
+def _key_struct(keys: tuple) -> Tuple[np.ndarray, np.ndarray]:
+    """(keys_blob, key_lens) for a key tuple — serializers call with the
+    same schema for every group, so build the arrays once (the per-call
+    join+copy was measurable at pipeline-e2e rates)."""
+    with _key_cache_lock:
+        st = _key_cache.get(keys)
+    if st is None:
+        # build OUTSIDE the lock (the join is O(schema) work); the
+        # setdefault makes a racing double-build harmless
+        blob = np.frombuffer(b"".join(keys) or b"\0",
+                             dtype=np.uint8).copy()
+        lens = np.array([len(k) for k in keys], dtype=np.int32)
+        with _key_cache_lock:
+            if len(_key_cache) >= 256:    # unbounded schemas must not leak
+                _key_cache.clear()
+            st = _key_cache.setdefault(keys, (blob, lens))
+    return st
+
+
 def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
                   keys: list, field_offs: np.ndarray, field_lens: np.ndarray,
                   event_major: bool = False) -> Optional[bytes]:
@@ -208,8 +240,7 @@ def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
     timestamps = np.ascontiguousarray(timestamps, dtype=np.int64)
     field_offs = np.ascontiguousarray(field_offs, dtype=np.int32)
     field_lens = np.ascontiguousarray(field_lens, dtype=np.int32)
-    keys_blob = np.frombuffer(b"".join(keys) or b"\0", dtype=np.uint8).copy()
-    key_lens = np.array([len(k) for k in keys], dtype=np.int32)
+    keys_blob, key_lens = _key_struct(tuple(keys))
     F = len(keys)
     n = len(timestamps)
     sf, si = (1, F) if event_major else (n, 1)
@@ -240,6 +271,57 @@ def sls_serialize(arena: np.ndarray, timestamps: np.ndarray,
             return None
     # a view, not bytes: the serializer joins parts once — an extra
     # tobytes here would copy the (larger-than-input) payload again
+    return memoryview(out)[:written]
+
+
+NDJSON_TS_NONE = 0
+NDJSON_TS_EPOCH = 1
+NDJSON_TS_ISO8601 = 2
+
+
+def ndjson_serialize(arena: np.ndarray, timestamps: np.ndarray,
+                     key_frags: tuple, field_offs: np.ndarray,
+                     field_lens: np.ndarray, prefix: bytes,
+                     prefix_members: bool, ts_frag: bytes, ts_mode: int,
+                     ts_first: bool, suffix: bytes = b"\n",
+                     event_major: bool = False) -> Optional[memoryview]:
+    """NDJSON rows from columnar spans (loongshard zero-copy fast path).
+
+    key_frags: per-field ``b'"key": "'`` fragments (keys pre-escaped by the
+    caller); prefix: row head (``{`` + encoded group tags, no trailing
+    separator); ts_frag: ``b'"<key>": '``.  Caller guarantees every emitted
+    span is valid UTF-8 (json.dumps replacement semantics live on the
+    Python fallback).  Returns a memoryview over the output buffer, or
+    None when the library is unavailable / the row shape is unsupported."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_ndjson_serialize") \
+            or len(key_frags) > 64:
+        return None
+    arena = np.ascontiguousarray(arena)
+    timestamps = np.ascontiguousarray(timestamps, dtype=np.int64)
+    field_offs = np.ascontiguousarray(field_offs, dtype=np.int32)
+    field_lens = np.ascontiguousarray(field_lens, dtype=np.int32)
+    frags_blob, frag_lens = _key_struct(key_frags)
+    F = len(key_frags)
+    n = len(timestamps)
+    sf, si = (1, F) if event_major else (n, 1)
+    prefix_b = np.frombuffer(prefix or b"\0", dtype=np.uint8)
+    ts_b = np.frombuffer(ts_frag or b"\0", dtype=np.uint8)
+    suffix_b = np.frombuffer(suffix or b"\0", dtype=np.uint8)
+    # worst case: every value byte expands 6x (\u00XX), plus per-row
+    # framing — mirrors the C row bound so -1 can only mean "unsupported"
+    cap = int(n * (len(prefix) + len(ts_frag) + 48 + int(frag_lens.sum())
+                   + 4 * F + len(suffix) + 2) + 6 * len(arena) + 64)
+    out = np.empty(cap, dtype=np.uint8)
+    written = lib.lct_ndjson_serialize(
+        _u8(arena), len(arena), _i64(timestamps), n, F,
+        _u8(frags_blob), _i32(frag_lens), _i32(field_offs),
+        _i32(field_lens), sf, si,
+        _u8(prefix_b), len(prefix), 1 if prefix_members else 0,
+        _u8(ts_b), len(ts_frag), ts_mode, 1 if ts_first else 0,
+        _u8(suffix_b), len(suffix), _u8(out), cap)
+    if written < 0:
+        return None
     return memoryview(out)[:written]
 
 

@@ -184,9 +184,14 @@ class NativeT1Executor:
         lengths = np.ascontiguousarray(lengths, dtype=np.int32)
         n = len(offsets)
         C = self.num_caps
-        ok = np.empty(n, dtype=np.uint8)
-        cap_off = np.empty((n, C), dtype=np.int32)
-        cap_len = np.empty((n, C), dtype=np.int32)
+        # one arena carve instead of three mmap-class allocations: the
+        # outputs live as long as the group's columns, so they cannot be
+        # pooled, but they CAN share one block (pipeline-e2e hot path)
+        span = n * C * 4
+        blk = np.empty(span * 2 + n, dtype=np.uint8)
+        cap_off = blk[:span].view(np.int32).reshape(n, C)
+        cap_len = blk[span:span * 2].view(np.int32).reshape(n, C)
+        ok = blk[span * 2:]
         u8 = native_mod._u8
         i32 = native_mod._i32
         i64 = native_mod._i64

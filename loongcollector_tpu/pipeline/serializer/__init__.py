@@ -1,2 +1,3 @@
+from .batch_json import dumps_row, native_group_rows, ndjson_payload
 from .json_serializer import JsonSerializer
 from .sls_serializer import SLSEventGroupSerializer

@@ -2,8 +2,11 @@
 core/collection_pipeline/serializer/JsonSerializer.cpp — one JSON object per
 event with group tags folded in).
 
-Columnar fast path: serializes straight from the field span columns without
-materialising per-event objects.
+Columnar fast path (loongshard): rows are assembled in native code straight
+from the SourceBuffer arena spans — cached group-tag prefix, cached key
+fragments, no per-event dict, no per-event ``json.dumps`` (batch_json).
+Event groups and non-ASCII payloads keep the original dict path; output is
+byte-identical either way.
 """
 
 from __future__ import annotations
@@ -17,6 +20,8 @@ from ...models import (EventType, LogEvent, MetricEvent, PipelineEventGroup,
 
 from ...models.events import metric_name_str as _name_str
 
+from .batch_json import TS_EPOCH, native_group_rows
+
 class JsonSerializer:
     name = "json"
 
@@ -27,39 +32,55 @@ class JsonSerializer:
         return self.serialize(groups)
 
     def serialize(self, groups: List[PipelineEventGroup]) -> bytes:
-        out: List[str] = []
+        parts: List = []
         for group in groups:
-            tags = {k.decode("utf-8", "replace"): str(v)
-                    for k, v in group.tags.items()}
             cols = group.columns
             if cols is not None and cols.fields and not group._events:
+                # native zero-copy assembly; None ⇒ dict fallback (event
+                # groups, non-ASCII spans, key collisions)
+                fast = native_group_rows(group, "__time__",
+                                         ts_mode=TS_EPOCH, ts_first=True)
+                if fast is not None:
+                    if len(fast):
+                        parts.append(fast)
+                    continue
+            out: List[str] = []
+            tags = {k.decode("utf-8", "replace"): str(v)
+                    for k, v in group.tags.items()}
+            if cols is not None and cols.fields and not group._events:
                 self._serialize_columnar(group, tags, out)
-                continue
-            for ev in group.events:
-                obj = dict(tags)
-                if isinstance(ev, LogEvent):
-                    obj["__time__"] = ev.timestamp
-                    for k, v in ev.contents:
-                        obj[k.to_str()] = v.to_str()
-                elif isinstance(ev, MetricEvent):
-                    obj["__time__"] = ev.timestamp
-                    obj["__name__"] = _name_str(ev.name)
-                    if ev.value.is_multi():
-                        obj["__values__"] = {k.decode(): v for k, v in ev.value.values.items()}
-                    else:
-                        obj["__value__"] = ev.value.value
-                    obj["__labels__"] = {k.decode(): str(v) for k, v in ev.tags.items()}
-                elif isinstance(ev, SpanEvent):
-                    obj["traceId"] = ev.trace_id.decode("utf-8", "replace")
-                    obj["spanId"] = ev.span_id.decode("utf-8", "replace")
-                    obj["name"] = ev.name.decode("utf-8", "replace")
-                    obj["startTimeNs"] = ev.start_time_ns
-                    obj["endTimeNs"] = ev.end_time_ns
-                elif isinstance(ev, RawEvent):
-                    obj["__time__"] = ev.timestamp
-                    obj["content"] = str(ev.content) if ev.content else ""
-                out.append(json.dumps(obj, ensure_ascii=False))
-        return ("\n".join(out) + "\n").encode("utf-8") if out else b""
+            else:
+                self._serialize_events(group, tags, out)
+            if out:
+                parts.append(("\n".join(out) + "\n").encode("utf-8"))
+        return b"".join(parts) if parts else b""
+
+    def _serialize_events(self, group: PipelineEventGroup, tags: dict,
+                          out: List[str]) -> None:
+        for ev in group.events:
+            obj = dict(tags)
+            if isinstance(ev, LogEvent):
+                obj["__time__"] = ev.timestamp
+                for k, v in ev.contents:
+                    obj[k.to_str()] = v.to_str()
+            elif isinstance(ev, MetricEvent):
+                obj["__time__"] = ev.timestamp
+                obj["__name__"] = _name_str(ev.name)
+                if ev.value.is_multi():
+                    obj["__values__"] = {k.decode(): v for k, v in ev.value.values.items()}
+                else:
+                    obj["__value__"] = ev.value.value
+                obj["__labels__"] = {k.decode(): str(v) for k, v in ev.tags.items()}
+            elif isinstance(ev, SpanEvent):
+                obj["traceId"] = ev.trace_id.decode("utf-8", "replace")
+                obj["spanId"] = ev.span_id.decode("utf-8", "replace")
+                obj["name"] = ev.name.decode("utf-8", "replace")
+                obj["startTimeNs"] = ev.start_time_ns
+                obj["endTimeNs"] = ev.end_time_ns
+            elif isinstance(ev, RawEvent):
+                obj["__time__"] = ev.timestamp
+                obj["content"] = str(ev.content) if ev.content else ""
+            out.append(json.dumps(obj, ensure_ascii=False))
 
     def _serialize_columnar(self, group: PipelineEventGroup, tags: dict,
                             out: List[str]) -> None:

@@ -1,4 +1,4 @@
-"""ProcessorRunner: the processing thread engine.
+"""ProcessorRunner: the sharded multi-worker processing engine (loongshard).
 
 Reference: core/runner/ProcessorRunner.cpp — N worker threads (default 1,
 app_config/AppConfig.cpp:58) pop from the process-queue manager (priority RR),
@@ -6,52 +6,237 @@ find the owning pipeline, run Process then Send (:90-189); thread 0 also
 pumps batch timeout flushes (:109-112); producer API PushQueue with bounded
 retries (:72-88).
 
-TPU note — the async device data plane (SURVEY §7 step 4): each worker keeps
-ONE group's device work in flight.  The loop dispatches group N+1 (host
-pre-processing + pack + async kernel dispatch via Pipeline.process_begin)
-BEFORE materialising group N, so the device executes N while the host packs
-N+1 and then runs N's downstream processors + send.  Device back-pressure is
-the DevicePlane in-flight byte budget: when the device stalls, dispatch
-blocks, this thread stops popping, and the bounded process queues fill to
-their high watermark, feedback-blocking the inputs
-(core/collection_pipeline/queue/BoundedProcessQueue.cpp:89-93 contract,
-extended one hop onto the device).
+loongshard (ISSUE 4) makes thread_count real without giving up ordering:
+
+* ``thread_count == 1`` keeps the reference shape — one worker popping the
+  process-queue manager directly.
+* ``thread_count > 1`` adds a dispatch loop that pops the queue manager and
+  routes every group to a fixed worker by affinity hash on
+  (process queue key, ``__source__`` tag).  All groups of one source stream
+  land on one worker, and each worker is a single thread that sends groups
+  in pop order — per-source ordering is preserved while distinct sources
+  (and distinct pipelines) process in parallel.  The hash is CRC32, stable
+  across runs and processes (PYTHONHASHSEED-proof), so a replayed soak
+  shards identically.
+* Worker inboxes are small and bounded: when a worker falls behind, the
+  dispatcher blocks on its inbox, stops popping, and the bounded process
+  queues fill to their high watermark — the same feedback chain as before,
+  one hop longer.
+
+TPU note — the async device data plane (SURVEY §7 step 4): each worker owns
+ONE WorkerLane holding a group whose device work is in flight.  The worker
+dispatches group N+1 (host pre-processing + pack + async kernel dispatch via
+Pipeline.process_begin) BEFORE materialising group N, so the device executes
+N while the host packs N+1.  Device back-pressure is the DevicePlane
+in-flight byte budget: when the device stalls, dispatch blocks, the worker
+stops consuming, its inbox fills, the dispatcher stops popping, and the
+bounded process queues feedback-block the inputs.  Every worker registers a
+budget-relief hook bound to ITS lane, so a worker waiting for budget always
+completes the overlapped group it owns (no-deadlock invariant, per lane).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import List, Optional
+import zlib
+from collections import deque
+from typing import List, Optional, Tuple
 
 from .. import trace
-from ..models import PipelineEventGroup
+from ..models import EventGroupMetaKey, PipelineEventGroup
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops.device_plane import set_budget_relief
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from ..pipeline.queue.process_queue_manager import ProcessQueueManager
+from ..utils import flags
 from ..utils.logger import get_logger
 
 log = get_logger("processor_runner")
 
 BATCH_FLUSH_INTERVAL_S = 1.0
 
+# loongshard default: scale past one worker out of the box, but never spawn
+# more shards than the host can run (the reference default of 1 mirrored the
+# pre-shard engine; docs/performance.md)
+DEFAULT_PROCESS_THREADS = max(2, min(4, os.cpu_count() or 2))
+
+flags.DEFINE_FLAG_INT32("process_thread_count",
+                        "processor runner worker shards",
+                        DEFAULT_PROCESS_THREADS)
+
+ENV_THREADS = "LOONG_PROCESS_THREADS"
+
+# observe-only handle for the self-monitor (monitor/runtime_stats.py):
+# the live runner's shard state without constructing anything
+_active_runner = None
+
+
+def resolve_thread_count(env=os.environ) -> int:
+    """Active worker count: ``LOONG_PROCESS_THREADS`` wins over the
+    ``process_thread_count`` flag (itself overridable by app config and
+    ``LOONG_PROCESS_THREAD_COUNT``); anything invalid or < 1 falls back,
+    and the result is always >= 1."""
+    raw = env.get(ENV_THREADS)
+    if raw is not None:
+        try:
+            n = int(raw)
+            if n >= 1:
+                return n
+            log.warning("%s=%r below 1; using flag", ENV_THREADS, raw)
+        except ValueError:
+            log.warning("invalid %s=%r; using flag", ENV_THREADS, raw)
+    return max(1, int(flags.get_flag("process_thread_count")))
+
+# per-worker inbox depth: small on purpose — the real buffering lives in the
+# bounded process queues; the inbox only smooths the dispatch hop
+INBOX_CAPACITY = 4
+
+_SOURCE_TAG = b"__source__"
+
+
+def shard_of(queue_key: int, source: Optional[bytes], n: int) -> int:
+    """Affinity shard for a group: CRC32 over the source identity seeded
+    with the process queue key.  Deterministic across processes (no Python
+    hash randomisation) so replayed storms shard identically."""
+    if n <= 1:
+        return 0
+    return zlib.crc32(source or b"", queue_key & 0xFFFFFFFF) % n
+
+
+def group_source_id(group: PipelineEventGroup) -> Optional[bytes]:
+    """The per-source ordering identity of a group: the ``__source__`` tag
+    when an input sets one, else the originating file (path + inode — two
+    rotated generations of one path may interleave but each stream keeps a
+    stable home), else None.  Unkeyed groups of one pipeline all land on one
+    worker — ordering-safe by construction."""
+    src = group.get_tag(_SOURCE_TAG)
+    if src is not None:
+        return src.to_bytes()
+    path = group.get_metadata(EventGroupMetaKey.LOG_FILE_PATH)
+    if path is not None:
+        inode = group.get_metadata(EventGroupMetaKey.LOG_FILE_INODE)
+        pid = path.to_bytes()
+        return (pid + b":" + inode.to_bytes()) if inode is not None else pid
+    return None
+
+
+class WorkerLane:
+    """One worker's overlapped-dispatch slot (its device lane).
+
+    Exactly one group's device work stays in flight per worker.  ``take()``
+    removes and returns the pending entry atomically, so the worker loop and
+    the DevicePlane budget-relief hook can race to complete it and exactly
+    one side wins — the multi-lane generalisation of the old single-TLS-slot
+    accounting (which broke down as soon as more than one worker owned
+    in-flight device budget)."""
+
+    __slots__ = ("worker_id", "_lock", "_pending")
+
+    def __init__(self, worker_id: int):
+        self.worker_id = worker_id
+        self._lock = threading.Lock()
+        self._pending = None
+
+    def put(self, pending) -> None:
+        if pending is None:
+            return
+        with self._lock:
+            assert self._pending is None, "lane already holds a group"
+            self._pending = pending
+
+    def take(self):
+        with self._lock:
+            p, self._pending = self._pending, None
+            return p
+
+    def busy(self) -> bool:
+        with self._lock:
+            return self._pending is not None
+
+
+class _ShardInbox:
+    """Bounded SPSC handoff between the dispatch loop and one worker.
+    A full inbox blocks the dispatcher (back-pressure); ``close()`` wakes
+    the worker for final drain."""
+
+    def __init__(self, capacity: int = INBOX_CAPACITY):
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._capacity = capacity
+        self._closed = False
+
+    def put(self, item, timeout: float = 1.0) -> bool:
+        """Blocks while full.  Returns False only when closed (caller then
+        owns the item again) or the wait timed out with no space."""
+        deadline = time.monotonic() + timeout
+        with self._not_full:
+            while len(self._items) >= self._capacity and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._not_full.wait(remaining)
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
+    def get(self, timeout: float = 0.2):
+        with self._not_empty:
+            if not self._items:
+                if timeout > 0 and not self._closed:
+                    self._not_empty.wait(timeout)
+                if not self._items:
+                    return None
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def drained(self) -> bool:
+        with self._lock:
+            return self._closed and not self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
 
 class ProcessorRunner:
     def __init__(self, process_queue_manager: ProcessQueueManager,
-                 pipeline_manager, thread_count: int = 1):
+                 pipeline_manager, thread_count: Optional[int] = None):
         self.pqm = process_queue_manager
         self.pipeline_manager = pipeline_manager
-        self.thread_count = thread_count
-        self._tls = threading.local()
+        if thread_count is None:
+            thread_count = resolve_thread_count()
+        self.thread_count = max(1, int(thread_count))
         self._threads: List[threading.Thread] = []
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._lanes: List[WorkerLane] = []
+        self._inboxes: List[_ShardInbox] = []
         self._running = False
         self.metrics = MetricsRecord(category="runner",
                                      labels={"runner": "processor"})
         self.in_groups = self.metrics.counter("in_event_groups_total")
         self.in_events = self.metrics.counter("in_events_total")
         self.in_bytes = self.metrics.counter("in_size_bytes")
+        # active worker count: the exposition endpoint / self-monitor report
+        # how many shards this agent actually runs (ISSUE 4 satellite)
+        self.workers_gauge = self.metrics.gauge("process_workers")
         # pop → send-returned latency per group (process + device overlap +
         # downstream processors + route/flush enqueue); queue wait is its
         # own histogram on the process-queue side
@@ -75,66 +260,189 @@ class ProcessorRunner:
     # -- lifecycle ----------------------------------------------------------
 
     def init(self) -> None:
+        global _active_runner
         self._running = True
+        self._lanes = [WorkerLane(i) for i in range(self.thread_count)]
+        self.workers_gauge.set(self.thread_count)
+        _active_runner = self
+        if self.thread_count == 1:
+            t = threading.Thread(target=self._run_single, args=(0,),
+                                 name="processor-0", daemon=True)
+            t.start()
+            self._threads.append(t)
+            return
+        self._inboxes = [_ShardInbox() for _ in range(self.thread_count)]
         for i in range(self.thread_count):
-            t = threading.Thread(target=self._run, args=(i,),
+            t = threading.Thread(target=self._run_worker, args=(i,),
                                  name=f"processor-{i}", daemon=True)
             t.start()
             self._threads.append(t)
+        self._dispatch_thread = threading.Thread(
+            target=self._run_dispatch, name="processor-dispatch", daemon=True)
+        self._dispatch_thread.start()
+
+    def inbox_depths(self) -> List[int]:
+        """Queued groups per worker inbox (empty list when single-worker:
+        the reference shape has no dispatch hop to observe)."""
+        return [len(ib) for ib in self._inboxes]
 
     def stop(self) -> None:
+        global _active_runner
+        if _active_runner is self:
+            _active_runner = None
         self._running = False
         self.pqm.wake_up()
+        if self._dispatch_thread is not None:
+            # the dispatch loop drains the process queues into the inboxes
+            # and closes them; workers exit after their final drain
+            self._dispatch_thread.join(timeout=10)
+            if self._dispatch_thread.is_alive():
+                # wedged dispatch must not wedge stop(): close inboxes so
+                # workers can still finish what they already hold
+                for ib in self._inboxes:
+                    ib.close()
+            self._dispatch_thread = None
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        # inboxes/lanes stay allocated (closed): a dispatch thread that
+        # out-lived its join timeout may still call _route — an empty list
+        # there would IndexError it mid-drain; init() rebuilds both
         # a stopped runner exports nothing further; its record must not
         # accumulate in WriteMetrics across restarts (loonglint
         # metric-naming ownership rule)
         self.metrics.mark_deleted()
 
-    # -- worker -------------------------------------------------------------
+    # -- shard routing ------------------------------------------------------
 
-    def _run(self, thread_no: int) -> None:
-        # one group's device work stays in flight per worker; kept in TLS so
-        # the DevicePlane budget-relief hook can complete it if this thread
-        # ever blocks dispatching the next group (no-deadlock invariant)
-        self._tls.pending = None
-        set_budget_relief(self._relieve_budget)
+    def _shard(self, key: int, group: PipelineEventGroup) -> int:
+        return shard_of(key, group_source_id(group), self.thread_count)
+
+    def _pump_timeout_flush(self) -> None:
+        now = time.monotonic()
+        if now - self.last_flush >= BATCH_FLUSH_INTERVAL_S:
+            self.last_flush = now
+            try:
+                TimeoutFlushManager.instance().flush_timeout_batches()
+            except Exception:  # noqa: BLE001 — a bad hook must not kill
+                # the thread pumping all timeout flushing agent-wide
+                log.exception("timeout flush failed")
+
+    def _run_dispatch(self) -> None:
+        """Sharded mode only: pop the queue manager, route by affinity.
+        Also pumps timeout flushes (the reference's thread-0 duty)."""
         while self._running:
-            if thread_no == 0:
-                now = time.monotonic()
-                if now - self.last_flush >= BATCH_FLUSH_INTERVAL_S:
-                    self.last_flush = now
-                    try:
-                        TimeoutFlushManager.instance().flush_timeout_batches()
-                    except Exception:  # noqa: BLE001 — a bad hook must not
-                        # kill thread 0 (all timeout flushing agent-wide)
-                        log.exception("timeout flush failed")
+            self._pump_timeout_flush()
+            item = self.pqm.pop_item(timeout=0.2)
+            if item is None:
+                continue
+            self._route(item)
+        # drain remaining items on stop: keep affinity so ordering holds
+        # through shutdown too
+        while True:
+            item = self.pqm.pop_item(timeout=0)
+            if item is None:
+                break
+            self._route(item)
+        for ib in self._inboxes:
+            ib.close()
+
+    def _route(self, item: Tuple[int, PipelineEventGroup]) -> None:
+        key, group = item
+        inbox = self._inboxes[self._shard(key, group)]
+        # a full inbox blocks here — that is the back-pressure hop; the
+        # timeout only exists so a wedged worker cannot wedge dispatch
+        # (and with it the flush pump) forever.  Known tradeoff: while one
+        # shard's inbox is full, dispatch (and with it every pipeline)
+        # waits — the same agent-wide escalation the reference's
+        # thread_count=1 default has, traded here for per-source ordering;
+        # per-pipeline dispatch isolation is future work
+        # (docs/performance.md)
+        while not inbox.put(item, timeout=1.0):
+            if inbox.is_closed():
+                # forced shutdown (stop() closed the inboxes after the
+                # drain-join timed out): process inline on this thread
+                # rather than dropping — the old single-thread drain
+                # semantics; ordering past this point is best-effort
+                self._process_one(key, group)
+                return
+            self._pump_timeout_flush()
+
+    # -- workers ------------------------------------------------------------
+
+    def _make_relief(self, lane: WorkerLane):
+        """Budget-relief hook bound to ONE lane: when this worker waits for
+        in-flight budget while dispatching, finish the overlapped group the
+        lane holds so the bytes it owns are released.  Bound explicitly (not
+        read from TLS at call time) so the hook always completes the owning
+        worker's group even if relief plumbing ever runs off-thread."""
+        def _relieve() -> bool:
+            p = lane.take()
+            if p is None:
+                return False
+            self._complete(p)
+            return True
+        return _relieve
+
+    def _run_single(self, worker_id: int) -> None:
+        """thread_count == 1: the reference shape — pop the queue manager
+        directly, no dispatch hop."""
+        lane = self._lanes[worker_id]
+        set_budget_relief(self._make_relief(lane))
+        while self._running:
+            self._pump_timeout_flush()
             # while device work is in flight, poll rather than sleep: an
             # empty queue means the overlap window closes and we complete
-            item = self.pqm.pop_item(
-                timeout=0.0 if self._tls.pending is not None else 0.2)
+            item = self.pqm.pop_item(timeout=0.0 if lane.busy() else 0.2)
             if item is None:
-                self._complete_pending()
+                self._complete_lane(lane)
                 continue
-            nxt = self._dispatch_one(*item)
+            nxt = self._dispatch_one(*item, lane=lane)
             # dispatch-before-complete is the overlap: the device now holds
             # group N+1 while we materialise + send group N on the host
-            self._complete_pending()
-            self._tls.pending = nxt
-        self._complete_pending()
+            self._complete_lane(lane)
+            lane.put(nxt)
+        self._complete_lane(lane)
         # drain remaining items on stop
         while True:
             item = self.pqm.pop_item(timeout=0)
             if item is None:
                 break
             self._process_one(*item)
+        set_budget_relief(None)
 
-    def _dispatch_one(self, key: int, group: PipelineEventGroup):
+    def _run_worker(self, worker_id: int) -> None:
+        """Sharded mode: consume this worker's inbox with the same
+        overlapped device lane as the single-thread loop."""
+        lane = self._lanes[worker_id]
+        inbox = self._inboxes[worker_id]
+        set_budget_relief(self._make_relief(lane))
+        while True:
+            item = inbox.get(timeout=0.0 if lane.busy() else 0.2)
+            if item is None:
+                self._complete_lane(lane)
+                if inbox.drained():
+                    break
+                continue
+            nxt = self._dispatch_one(*item, lane=lane)
+            self._complete_lane(lane)
+            lane.put(nxt)
+        self._complete_lane(lane)
+        set_budget_relief(None)
+
+    def _dispatch_one(self, key: int, group: PipelineEventGroup,
+                      lane: Optional[WorkerLane] = None):
         """Host pre-processing + device dispatch for one group.  Returns a
         pending handle when device work stays in flight, else None (group
-        fully processed and sent)."""
+        fully processed and sent).
+
+        Ordering invariant: when this group resolves on the host tier
+        (finish is None) it is SENT here, inline — so the worker's lane
+        must be completed first.  Otherwise a device-routed group N could
+        still sit in the lane while host-routed group N+1 of the SAME
+        source overtakes it at the sink (observed in the agent drive: the
+        first group of a stream pays the XLA compile on the device path
+        while later small groups take the native walker)."""
         pipeline = self.pipeline_manager.find_pipeline_by_queue_key(key)
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
@@ -163,6 +471,10 @@ class ProcessorRunner:
             self._finish_group(sp, t0, "error")
             return None
         if finish is None:
+            if lane is not None:
+                # drain the overlapped group BEFORE this inline send: same
+                # worker ⇒ possibly same source; send order = pop order
+                self._complete_lane(lane)
             self._send(pipeline, groups)
             self._finish_group(sp, t0, "ok")
             return None
@@ -180,22 +492,10 @@ class ProcessorRunner:
                 tracer.pop_current(sp)
             sp.end(status)
 
-    def _complete_pending(self) -> None:
-        p = getattr(self._tls, "pending", None)
+    def _complete_lane(self, lane: WorkerLane) -> None:
+        p = lane.take()
         if p is not None:
-            self._tls.pending = None
             self._complete(p)
-
-    def _relieve_budget(self) -> bool:
-        """DevicePlane relief hook: when this thread waits for in-flight
-        budget while dispatching, finish the overlapped group it holds so
-        the bytes it owns are released."""
-        p = getattr(self._tls, "pending", None)
-        if p is None:
-            return False
-        self._tls.pending = None
-        self._complete(p)
-        return True
 
     def _complete(self, pending) -> None:
         pipeline, groups, finish, sp, t0 = pending

@@ -264,6 +264,206 @@ int64_t lct_sls_serialize(const uint8_t* arena, int64_t arena_len,
 
 }  // extern "C"
 
+// ---------------------------------------------------------------------------
+// NDJSON serialization from columnar spans (loongshard zero-copy fast path).
+//
+// One JSON object per event, byte-identical to CPython's
+// json.dumps(obj, ensure_ascii=False) with default separators:
+//   <prefix>[", "]"<ts>": N, "key": "value", ...}<suffix>
+//
+// * prefix is the caller-built row head: '{' plus the JSON-encoded group
+//   tags, WITHOUT a trailing separator (prefix_members says whether it
+//   already holds members);
+// * key_frags are caller-built '"key": "' fragments (keys pre-escaped);
+// * values are arena spans escaped inline the way json.dumps does it
+//   (\" \\ \b \f \n \r \t, \u00XX for remaining control bytes); bytes
+//   >= 0x80 pass through unchanged — the CALLER guarantees the span is
+//   valid UTF-8 (rows that are not must stay on the Python fallback to
+//   match the codec's replacement semantics);
+// * ts_mode: 0 = no timestamp member, 1 = decimal epoch, 2 = ISO-8601
+//   UTC ("%Y-%m-%dT%H:%M:%SZ"); ts_first: 1 = right after the prefix
+//   (JsonSerializer layout), 0 = appended after the fields (the
+//   setdefault layout of the NDJSON flushers).
+//
+// Spans use the same strided layout as lct_sls_serialize_strided.
+// Returns bytes written, or -1 when out_cap cannot hold a row (callers
+// allocate the worst-case bound up front, so -1 means "fall back").
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// JSON string-escape class per byte: 0 = emit as-is (includes >= 0x80;
+// see the UTF-8 caller contract), 1 = two-char escape, 2 = \u00XX
+inline const uint8_t* json_escape_class() {
+    static uint8_t cls[256];
+    static bool init = false;
+    if (!init) {
+        for (int i = 0; i < 0x20; ++i) cls[i] = 2;
+        cls['\b'] = cls['\t'] = cls['\n'] = cls['\f'] = cls['\r'] = 1;
+        cls['"'] = cls['\\'] = 1;
+        init = true;
+    }
+    return cls;
+}
+
+inline uint8_t* put_json_escaped(uint8_t* p, const uint8_t* s, int64_t k,
+                                 const uint8_t* cls) {
+    static const char hex[] = "0123456789abcdef";
+    int64_t run = 0;
+    for (int64_t j = 0; j < k; ++j) {
+        uint8_t c = s[j];
+        if (cls[c] == 0) { ++run; continue; }
+        if (run) { memcpy(p, s + j - run, (size_t)run); p += run; run = 0; }
+        if (cls[c] == 1) {
+            *p++ = '\\';
+            switch (c) {
+                case '\b': *p++ = 'b'; break;
+                case '\t': *p++ = 't'; break;
+                case '\n': *p++ = 'n'; break;
+                case '\f': *p++ = 'f'; break;
+                case '\r': *p++ = 'r'; break;
+                default:   *p++ = c;   break;  // '"' and '\\'
+            }
+        } else {
+            *p++ = '\\'; *p++ = 'u'; *p++ = '0'; *p++ = '0';
+            *p++ = hex[c >> 4]; *p++ = hex[c & 0xF];
+        }
+    }
+    if (run) { memcpy(p, s + k - run, (size_t)run); p += run; }
+    return p;
+}
+
+inline uint8_t* put_decimal_i64(uint8_t* p, int64_t v) {
+    if (v < 0) { *p++ = '-'; }
+    uint64_t u = v < 0 ? (uint64_t)(-(v + 1)) + 1 : (uint64_t)v;
+    char tmp[20];
+    int k = 0;
+    do { tmp[k++] = (char)('0' + u % 10); u /= 10; } while (u);
+    while (k) *p++ = tmp[--k];
+    return p;
+}
+
+inline uint8_t* put_2d(uint8_t* p, int v) {
+    *p++ = (uint8_t)('0' + v / 10);
+    *p++ = (uint8_t)('0' + v % 10);
+    return p;
+}
+
+// epoch seconds → "YYYY-MM-DDTHH:MM:SSZ" (proleptic Gregorian, UTC) —
+// the civil_from_days algorithm, matching Python's
+// datetime.fromtimestamp(ts, tz=utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+inline uint8_t* put_iso8601(uint8_t* p, int64_t ts) {
+    int64_t days = ts / 86400;
+    int64_t rem = ts % 86400;
+    if (rem < 0) { rem += 86400; --days; }
+    int64_t z = days + 719468;
+    int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+    int64_t doe = z - era * 146097;
+    int64_t yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+    int64_t y = yoe + era * 400;
+    int64_t doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+    int64_t mp = (5 * doy + 2) / 153;
+    int64_t d = doy - (153 * mp + 2) / 5 + 1;
+    int64_t m = mp < 10 ? mp + 3 : mp - 9;
+    if (m <= 2) ++y;
+    p = put_decimal_i64(p, y);
+    *p++ = '-'; p = put_2d(p, (int)m);
+    *p++ = '-'; p = put_2d(p, (int)d);
+    *p++ = 'T'; p = put_2d(p, (int)(rem / 3600));
+    *p++ = ':'; p = put_2d(p, (int)((rem / 60) % 60));
+    *p++ = ':'; p = put_2d(p, (int)(rem % 60));
+    *p++ = 'Z';
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t lct_ndjson_serialize(
+        const uint8_t* arena, int64_t arena_len, const int64_t* timestamps,
+        int64_t n, int64_t F,
+        const uint8_t* frags_blob, const int32_t* frag_lens,
+        const int32_t* field_offs, const int32_t* field_lens,
+        int64_t sf, int64_t si,
+        const uint8_t* prefix, int64_t prefix_len, int32_t prefix_members,
+        const uint8_t* ts_frag, int64_t ts_frag_len,
+        int32_t ts_mode, int32_t ts_first,
+        const uint8_t* suffix, int64_t suffix_len,
+        uint8_t* out, int64_t out_cap) {
+    if (F > 64) return -1;
+    const uint8_t* cls = json_escape_class();
+    int64_t frag_starts[64];
+    int64_t acc = 0;
+    int64_t frags_total = 0;
+    for (int64_t f = 0; f < F; ++f) {
+        frag_starts[f] = acc;
+        acc += frag_lens[f];
+        frags_total += frag_lens[f];
+    }
+    auto span_ok = [&](int64_t idx) -> bool {
+        int32_t vlen = field_lens[idx];
+        if (vlen < 0) return false;
+        int32_t voff = field_offs[idx];
+        return voff >= 0 && static_cast<int64_t>(voff) + vlen <= arena_len;
+    };
+    const uint8_t* out_end = out + out_cap;
+    uint8_t* p = out;
+    for (int64_t i = 0; i < n; ++i) {
+        // conservative row bound: every value byte may expand 6x
+        int64_t base = i * si;
+        int64_t vbytes = 0;
+        for (int64_t f = 0; f < F; ++f) {
+            int64_t idx = base + f * sf;
+            if (span_ok(idx)) vbytes += field_lens[idx];
+        }
+        int64_t bound = prefix_len + ts_frag_len + 48 + frags_total
+                        + 4 * F + 6 * vbytes + suffix_len + 2;
+        if (p + bound > out_end) return -1;
+        memcpy(p, prefix, (size_t)prefix_len);
+        p += prefix_len;
+        bool members = prefix_members != 0;
+        if (ts_mode != 0 && ts_first != 0) {
+            if (members) { *p++ = ','; *p++ = ' '; }
+            memcpy(p, ts_frag, (size_t)ts_frag_len);
+            p += ts_frag_len;
+            if (ts_mode == 2) {
+                *p++ = '"'; p = put_iso8601(p, timestamps[i]); *p++ = '"';
+            } else {
+                p = put_decimal_i64(p, timestamps[i]);
+            }
+            members = true;
+        }
+        for (int64_t f = 0; f < F; ++f) {
+            int64_t idx = base + f * sf;
+            if (!span_ok(idx)) continue;
+            if (members) { *p++ = ','; *p++ = ' '; }
+            memcpy(p, frags_blob + frag_starts[f], (size_t)frag_lens[f]);
+            p += frag_lens[f];
+            p = put_json_escaped(p, arena + field_offs[idx],
+                                 field_lens[idx], cls);
+            *p++ = '"';
+            members = true;
+        }
+        if (ts_mode != 0 && ts_first == 0) {
+            if (members) { *p++ = ','; *p++ = ' '; }
+            memcpy(p, ts_frag, (size_t)ts_frag_len);
+            p += ts_frag_len;
+            if (ts_mode == 2) {
+                *p++ = '"'; p = put_iso8601(p, timestamps[i]); *p++ = '"';
+            } else {
+                p = put_decimal_i64(p, timestamps[i]);
+            }
+        }
+        *p++ = '}';
+        memcpy(p, suffix, (size_t)suffix_len);
+        p += suffix_len;
+    }
+    return p - out;
+}
+
+}  // extern "C"
+
 extern "C" {
 
 // ---------------------------------------------------------------------------

@@ -21,6 +21,15 @@ python -m loongcollector_tpu.analysis "$@"
 echo "== tracing-overhead smoke =="
 JAX_PLATFORMS=cpu python scripts/trace_overhead.py
 
+echo "== multi-worker smoke (loongshard) =="
+# the disabled-trace overhead gate and the metric-naming checker must hold
+# with the sharded plane active (LOONG_PROCESS_THREADS=4): the overhead
+# budget is per-hook regardless of worker count, and every worker-owned
+# metric record must still obey the naming/ownership rules
+JAX_PLATFORMS=cpu LOONG_PROCESS_THREADS=4 python scripts/trace_overhead.py
+LOONG_PROCESS_THREADS=4 python -m loongcollector_tpu.analysis \
+    --checks metric-naming
+
 echo "== native lint =="
 make -C native lint
 

@@ -28,6 +28,12 @@ echo "== chaos soak: tier-1 seed matrix =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m 'not slow' -p no:cacheprovider
 
+echo "== chaos soak: sharded-plane storm matrix (loongshard) =="
+# the multi-worker storms: 8 seeds through thread_count=4 shards — zero
+# loss, inflight==0, per-source order, schedule prefix-determinism
+JAX_PLATFORMS=cpu LOONG_PROCESS_THREADS=4 python -m pytest \
+    tests/test_loongshard.py -q -m 'not slow' -p no:cacheprovider
+
 echo "== chaos soak: extended seed matrix (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m slow -p no:cacheprovider

@@ -149,7 +149,74 @@ def main() -> int:
               f"> {(MAX_DISABLED_OVER_BASELINE - 1) * 100:.0f}% in every "
               "round — the disabled tracer must stay one branch per hook")
         return 1
+    rc = smoke_multiworker()
+    if rc:
+        return rc
     print("trace overhead OK")
+    return 0
+
+
+def smoke_multiworker() -> int:
+    """loongshard smoke (lint.sh runs this file with
+    LOONG_PROCESS_THREADS=4): with the sharded plane active, a burst of
+    multi-source groups must drain losslessly, in per-source order, and
+    the runner must stop cleanly.  No-op when the env var is absent or 1
+    (the single-worker path is what the paired rounds above measured)."""
+    import os
+    import time as _time
+    if int(os.environ.get("LOONG_PROCESS_THREADS", "1") or "1") <= 1:
+        return 0
+    from loongcollector_tpu.pipeline.pipeline_manager import (
+        CollectionPipelineManager, ConfigDiff)
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.queue.sender_queue import \
+        SenderQueueManager
+    from loongcollector_tpu.runner.processor_runner import (
+        ProcessorRunner, resolve_thread_count)
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    tc = resolve_thread_count()
+    pqm = ProcessQueueManager()
+    mgr = CollectionPipelineManager(pqm, SenderQueueManager())
+    runner = ProcessorRunner(pqm, mgr, thread_count=tc)
+    runner.init()
+    diff = ConfigDiff()
+    diff.added["overhead-shard"] = {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "processors": [],
+        "flushers": [{"Type": "flusher_blackhole"}],
+    }
+    mgr.update_pipelines(diff)
+    p = mgr.find_pipeline("overhead-shard")
+    bh = p.flushers[0].plugin
+    n_groups, per_group = 48, 32
+    line = b"2024-01-02 03:04:05 INFO shard smoke\n"
+    try:
+        for i in range(n_groups):
+            payload = line * per_group
+            sb = SourceBuffer(len(payload) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(payload))
+            g.set_tag(b"__source__", b"smoke-%d" % (i % 6))
+            deadline = _time.monotonic() + 20
+            while not pqm.push_queue(p.process_queue_key, g):
+                if _time.monotonic() > deadline:
+                    print("FAIL: multi-worker smoke push starved")
+                    return 1
+                _time.sleep(0.002)
+        deadline = _time.monotonic() + 30
+        while bh.total_events < n_groups and \
+                _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        if bh.total_events < n_groups:
+            print(f"FAIL: multi-worker smoke lost groups "
+                  f"({bh.total_events}/{n_groups} reached the sink)")
+            return 1
+    finally:
+        runner.stop()
+        mgr.stop_all()
+    print(f"multi-worker smoke OK ({tc} workers, {n_groups} groups)")
     return 0
 
 

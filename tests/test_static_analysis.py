@@ -193,6 +193,49 @@ class TestAcquireRelease:
         assert mod.suppressed(findings[0].line, findings[0].check)
 
 
+# The loongshard multi-lane shape (ISSUE 4): N workers each own a lane
+# holding an in-flight dispatch whose budget only that lane's completion
+# releases.  A dispatch loop that parks futures across SEVERAL lanes must
+# discharge every lane on failure — completing just the current one leaves
+# the other lanes' budget stranded (the multi-worker generalisation of the
+# single-TLS-slot assumption the old runner made).
+MULTI_LANE_LEAK = """
+class ShardDispatcher:
+    def dispatch_all(self, plane, kern, shards):
+        for worker_id, batch in shards:
+            fut = plane.submit(kern, (batch.rows,), batch.rows.nbytes,
+                               on_wait=self._drain_own)
+            self.lanes[worker_id].put((batch, fut))
+"""
+
+MULTI_LANE_FIXED = """
+class ShardDispatcher:
+    def dispatch_all(self, plane, kern, shards):
+        try:
+            for worker_id, batch in shards:
+                fut = plane.submit(kern, (batch.rows,), batch.rows.nbytes,
+                                   on_wait=self._drain_own)
+                self.lanes[worker_id].put((batch, fut))
+        except BaseException:
+            for lane in self.lanes:
+                pending = lane.take()
+                if pending is not None:
+                    pending[1].release()
+            raise
+"""
+
+
+class TestMultiLaneAcquireRelease:
+    def test_unguarded_multi_lane_dispatch_flagged(self):
+        findings = scan(MULTI_LANE_LEAK, AcquireReleaseChecker(),
+                        relpath="loongcollector_tpu/runner/fixture.py")
+        assert checks_of(findings) == {"acquire-release"}
+
+    def test_lane_draining_handler_is_clean(self):
+        assert scan(MULTI_LANE_FIXED, AcquireReleaseChecker(),
+                    relpath="loongcollector_tpu/runner/fixture.py") == []
+
+
 # ---------------------------------------------------------------------------
 # 3. blocking-under-lock fixtures
 
