@@ -379,7 +379,7 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         mbps = pushed_bytes / dt / 1e6
         runner.stop()
         mgr.stop_all()
-        return (mbps, None, None, None)
+        return (mbps, None, None, None, None)
     make_group = _mk
     # event→flush sojourn: push single-chunk groups one at a time and time
     # arrival at the sink (the BASELINE p99 latency metric)
@@ -424,12 +424,66 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         },
         "process_workers": runner.thread_count,
     }
+    utilization = _collect_utilization(pqm, p, bh, runner)
     runner.stop()
     mgr.stop_all()
     return (pushed_bytes / dt / 1e6,
             sojourns[len(sojourns) // 2],
             sojourns[int(len(sojourns) * 0.99)],
-            trajectory)
+            trajectory, utilization)
+
+
+def _collect_utilization(pqm, p, bh, runner, n_groups=24, window_s=8.0):
+    """loongprof: WHY a run was slow, next to how slow it was.  A short
+    profiled window (sampler at 97 Hz over `n_groups` extra small groups)
+    yields the per-scope top-5 exclusive self-cost; the device plane's
+    utilization accounting and the per-lane overlap ratios come from the
+    run itself.  Runs AFTER the timed windows so the headline numbers
+    never pay for the sampler."""
+    from loongcollector_tpu import prof
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.ops.device_plane import DevicePlane
+
+    line = b"127.0.0.1 - u [10/Oct/2000:13:55:36 -0700] " \
+           b'"GET /x HTTP/1.1" 200 1\n'
+    payload = line * 256
+    profiler = prof.enable(hz=97)
+    try:
+        base = bh.total_events
+        for _ in range(n_groups):
+            sb = SourceBuffer(len(payload) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(payload))
+            deadline = time.monotonic() + window_s
+            while not pqm.push_queue(p.process_queue_key, g):
+                if time.monotonic() > deadline:
+                    break
+                time.sleep(0.001)
+        deadline = time.monotonic() + window_s
+        while bh.total_events < base + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.3)              # let the sampler land a few samples
+        top = profiler.top_self_costs(5)
+    finally:
+        prof.disable()
+    overlaps = runner.lane_overlap()
+    util = {
+        "top_self_cost_ms": {k: v for k, v in top},
+        "lane_overlap_ratio": (round(sum(overlaps) / len(overlaps), 4)
+                               if overlaps else 0.0),
+    }
+    plane = DevicePlane._instance      # observe-only: never construct
+    if plane is not None:
+        u = plane.utilization()
+        util.update({
+            "budget_occupancy_avg": round(u["occupancy_avg"], 6),
+            "device_busy_fraction": round(u["busy_fraction"], 4),
+            "device_idle_while_backlogged_ms":
+                round(u["idle_while_backlogged_ms"], 1),
+            "submit_queue_depth": u["submit_queue_depth"],
+            "dispatched_total": u["dispatched_total"],
+        })
+    return util
 
 
 def bench_scaling(n_lines=200000):
@@ -440,8 +494,8 @@ def bench_scaling(n_lines=200000):
     2x, and that ceiling, not the sharding design, bounds the ratio."""
     out = {}
     for tc in (1, 2, 4):
-        mbps, _, _, _ = bench_pipeline_e2e(n_lines=n_lines,
-                                           thread_count=tc, sojourn=False)
+        mbps, _, _, _, _ = bench_pipeline_e2e(n_lines=n_lines,
+                                              thread_count=tc, sojourn=False)
         out[f"threads_{tc}"] = round(mbps, 1)
     if out.get("threads_1"):
         best = max(out[k] for k in list(out))
@@ -640,6 +694,10 @@ def main():
         extra["event_to_flush_ms_p50"] = round(e2e3[1], 2)
         extra["event_to_flush_ms_p99"] = round(e2e3[2], 2)
         extra["latency_trajectory"] = e2e3[3]
+        # loongprof: device-budget occupancy, idle-while-backlogged and
+        # the per-scope top-5 self-cost — BENCH_*.json now records WHY a
+        # run was slow, not just that it was (docs/observability.md)
+        extra["utilization"] = e2e3[4]
     # the headline pipeline_e2e_MBps stays the full default-config run —
     # the sweep uses shorter windows, so its numbers live under scaling
     # only and never replace the headline they would be inconsistent with

@@ -26,6 +26,14 @@ subprocess run/call/check_output, and ``.wait()`` on anything OTHER than
 the held condition itself (cond.wait() releases the lock it guards — that
 is the one legal blocking wait).
 
+loongprof extends the callee set with the flight recorder: ``record()``
+on a flight/recorder receiver (``flight.record``, ``self._recorder.record``,
+``self.flight_recorder.record``...) must never run under a held lock —
+the recorder takes its own ring lock, and wiring notable-event reporting
+into arbitrary lock bodies is exactly how an observability layer becomes
+a deadlock participant.  Transition sites buffer under the lock and emit
+after release (runner/circuit.py's ``_emit`` pattern).
+
 Lock ordering: edges A -> B whenever B is acquired while A is held, both
 lexically nested and one interprocedural hop (a call made under A to a
 method that acquires B, resolved by unique method name).  Cycles in that
@@ -55,6 +63,11 @@ _BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.call",
 _BLOCKING_TAILS = {"result", "join", "accept", "connect", "recv",
                    "recv_into", "sendall", "read_exact"}
 _QUEUE_TAILS = {"get", "put"}
+
+#: receivers whose .record() is the flight recorder (loongprof): the
+#: module handle, a recorder attribute, or anything named for it
+_FLIGHT_RECV_TAILS = {"flight", "recorder", "flight_recorder",
+                      "_flight", "_recorder", "_flight_recorder"}
 
 
 def _expr_text(node: ast.AST) -> str:
@@ -125,6 +138,9 @@ def _blocking_reason(node: ast.Call, held: List[str]) -> Optional[str]:
         if tail == "result" and not recv:
             return None
         return f"{recv or '?'}.{tail}()"
+    if tail == "record" and recv and \
+            _tail_name(recv) in _FLIGHT_RECV_TAILS:
+        return f"flight-recorder {recv}.record()"
     if tail in _QUEUE_TAILS:
         rl = recv.lower()
         if ("queue" in rl or rl.endswith("_q") or rl.split(".")[-1] == "q") \

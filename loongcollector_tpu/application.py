@@ -94,6 +94,13 @@ class Application:
         if trace.install_from_env():
             log.info("loongtrace ACTIVE (sample=%s)",
                      trace.active_tracer().config.sample_rate)
+        # loongprof: LOONG_PROF=1 starts the sampling profiler
+        # (LOONG_PROF_HZ shapes the rate); the flight recorder is always
+        # on and dumps on SIGTERM / watchdog breach / crash
+        from . import prof
+        if prof.install_from_env():
+            log.info("loongprof ACTIVE (%.0f Hz)",
+                     prof.active_profiler().hz)
         from .monitor.exposition import start_from_env as _expo_from_env
         self.exposition = _expo_from_env()
         from .runner.processor_runner import resolve_thread_count
@@ -122,6 +129,7 @@ class Application:
         self.watchdog = LoongCollectorMonitor(
             on_limit_breach=self._on_limit_breach)
         self._sig_stop = threading.Event()
+        self._sig_received = None   # signum, set async-safely by the handler
 
     def _load_app_config(self) -> None:
         """Agent-level config file (reference loongcollector_config.json +
@@ -147,6 +155,10 @@ class Application:
         os.makedirs(self.data_dir, exist_ok=True)
         check_previous_crash(self.data_dir)
         init_crash_backtrace(self.data_dir)
+        # unsolicited flight dumps (signals, watchdog, crash) land next to
+        # the crash backtrace so one directory holds the whole post-mortem
+        from .prof import flight
+        flight.set_dump_dir(self.data_dir)
         from .pipeline.plugin.checkpoint import (PluginCheckpointStore,
                                                  set_default_store)
         set_default_store(PluginCheckpointStore(
@@ -284,6 +296,17 @@ class Application:
                     break
             else:
                 self._sig_stop.wait(1.0)
+        if self._sig_received is not None:
+            # a signalled agent leaves its last seconds on disk: the
+            # flight ring (alarms, injections, breaker flips, stalls) +
+            # final stacks.  Runs HERE, on the main loop after the wait
+            # returned — never inside the signal handler, where the ring
+            # or logging lock may already be held by the interrupted frame
+            signum = self._sig_received
+            log.info("signal %d received", signum)
+            from .prof import flight
+            flight.record("signal", signum=signum)
+            flight.dump(reason=f"signal_{signum}")
         self.exit()
 
     def exit(self) -> None:
@@ -305,6 +328,8 @@ class Application:
         self.http_sink.stop()
         if getattr(self, "exposition", None) is not None:
             self.exposition.stop()
+        from . import prof
+        prof.disable()                        # stop sampler, retire records
         from .pipeline.plugin.checkpoint import get_default_store
         get_default_store().flush()
         log.info("exit complete")
@@ -398,7 +423,12 @@ class Application:
         self._sig_stop.set()
 
     def handle_signal(self, signum, frame) -> None:  # noqa: ARG002
-        log.info("signal %d received", signum)
+        # Python signal handlers run on the main thread between bytecodes:
+        # taking ANY non-reentrant lock here (the flight ring's, logging's)
+        # can deadlock against the interrupted frame.  Only async-safe
+        # work happens here — the flight dump runs from the main loop
+        # right after the wait returns (see start()).
+        self._sig_received = signum
         self._sig_stop.set()
 
 
@@ -440,6 +470,9 @@ def main(argv=None) -> int:
         trace = traceback.format_exc()
         log.critical("unhandled exception in main loop:\n%s", trace)
         record_crash(app.data_dir, trace)
+        from .prof import flight
+        flight.record("crash", error=trace.strip().rsplit("\n", 1)[-1][:200])
+        flight.dump(reason="crash")
         try:
             # the orderly drain is still possible — flush what we can before
             # the supervisor restarts us

@@ -189,6 +189,11 @@ def faultpoint(name: str, exc: Optional[type] = None,
     if trace.is_active():
         trace.event("chaos.inject", point=name, hit=decision.hit,
                     action=decision.action)
+    # ... and in the flight ring (outside the plane lock): a crash mid-
+    # storm dumps exactly which injections preceded it (docs/observability)
+    from ..prof import flight
+    flight.record("chaos.inject", point=name, hit=decision.hit,
+                  action=decision.action)
     if decision.action == ACTION_DELAY:
         time.sleep(decision.delay_s)
         return None

@@ -82,16 +82,25 @@ class FlusherElasticsearch(HttpSinkFlusher):
             return None
         return b"".join(parts), self.auth
 
-    @staticmethod
-    def _ts_in_range(group: PipelineEventGroup) -> bool:
-        """Fast path only for sane epochs (>= 0): strftime("%Y") padding
-        for years before 1000 is platform libc behaviour the native
-        ISO-8601 writer does not chase."""
+    #: last epoch second of year 9999 — datetime.fromtimestamp raises past
+    #: it, so the canonical dict path would surface such timestamps as a
+    #: flusher error; the fast path must not silently serialize them
+    _TS_MAX = 253402300799
+
+    @classmethod
+    def _ts_in_range(cls, group: PipelineEventGroup) -> bool:
+        """Fast path only for sane epochs (0 <= ts <= year 9999):
+        strftime("%Y") padding for years before 1000 is platform libc
+        behaviour the native ISO-8601 writer does not chase, and a
+        millisecond-epoch outlier must fail loudly on the dict path, not
+        ship a five-digit year."""
         cols = group.columns
         if cols is None:
             return False
         tss = np.asarray(cols.timestamps)
-        return bool(len(tss) == 0 or int(tss.min()) >= 0)
+        return bool(len(tss) == 0
+                    or (int(tss.min()) >= 0
+                        and int(tss.max()) <= cls._TS_MAX))
 
     def endpoint_url(self, item) -> str:
         return f"{self.rotator.next()}/_bulk"

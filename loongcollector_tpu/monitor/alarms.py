@@ -102,7 +102,7 @@ class AlarmType(str, enum.Enum):
 
 class _AlarmRecord:
     __slots__ = ("type", "level", "message", "count", "first_time", "last_time",
-                 "pipeline")
+                 "pipeline", "details")
 
     def __init__(self, typ: AlarmType, level: AlarmLevel, message: str,
                  pipeline: str):
@@ -113,6 +113,9 @@ class _AlarmRecord:
         self.first_time = time.time()
         self.last_time = self.first_time
         self.pipeline = pipeline
+        # structured payload (loongprof: flight-dump path, breach stack):
+        # latest-wins across aggregation so a flush ships fresh pointers
+        self.details: Dict[str, str] = {}
 
 
 class AlarmManager:
@@ -132,15 +135,31 @@ class AlarmManager:
 
     def send_alarm(self, typ: AlarmType, message: str,
                    level: AlarmLevel = AlarmLevel.WARNING,
-                   pipeline: str = "") -> None:
+                   pipeline: str = "",
+                   details: Optional[Dict[str, str]] = None) -> None:
         key = (typ.value, message[:128], pipeline)
         with self._lock:
             rec = self._records.get(key)
-            if rec is None:
+            created = rec is None
+            if created:
                 rec = _AlarmRecord(typ, level, message, pipeline)
                 self._records[key] = rec
             rec.count += 1
             rec.last_time = time.time()
+            if details:
+                rec.details.update({str(k): str(v)
+                                    for k, v in details.items()})
+        # a NEW aggregation key is a notable event: mirror it into the
+        # flight ring (OUTSIDE self._lock — loonglint blocking-under-lock
+        # rule) so a crash dump carries the alarms that preceded it.
+        # Repeats of an already-aggregated alarm ride the record's count
+        # instead — a 1 Hz sustained breach must not evict the ring's
+        # chaos/breaker/stall history with thousands of identical entries
+        if created:
+            from ..prof import flight
+            flight.record("alarm", type=typ.value,
+                          level=level.name.lower(),
+                          message=message[:160], pipeline=pipeline)
 
     def flush(self) -> List[dict]:
         """Drain aggregated alarms as event dicts for the self-monitor
@@ -148,15 +167,23 @@ class AlarmManager:
         with self._lock:
             records = list(self._records.values())
             self._records.clear()
-        return [{
-            "alarm_type": r.type.value,
-            "alarm_level": r.level.name.lower(),
-            "alarm_message": r.message,
-            "alarm_count": str(r.count),
-            "pipeline": r.pipeline,
-            "first_time": str(int(r.first_time)),
-            "last_time": str(int(r.last_time)),
-        } for r in records]
+        out = []
+        for r in records:
+            doc = {
+                "alarm_type": r.type.value,
+                "alarm_level": r.level.name.lower(),
+                "alarm_message": r.message,
+                "alarm_count": str(r.count),
+                "pipeline": r.pipeline,
+                "first_time": str(int(r.first_time)),
+                "last_time": str(int(r.last_time)),
+            }
+            # structured details ride as extra content fields; the fixed
+            # keys above always win a name collision
+            for k, v in r.details.items():
+                doc.setdefault(k, v)
+            out.append(doc)
+        return out
 
     def empty(self) -> bool:
         with self._lock:

@@ -18,6 +18,19 @@ MetricsRecord in text exposition format v0.0.4:
 Rendering never resets anything — scraping is read-only and safe to run
 concurrently with the self-monitor drain.
 
+loongprof (ISSUE 5) grows the endpoint into the agent's debug surface:
+
+  * ``/healthz``       — liveness: 200 + uptime / worker-count JSON;
+  * ``/debug/status``  — running status JSON (pipelines, queue depths,
+    worker backlogs, breaker states, device-budget utilization, flight
+    ring counts), assembled from observe-only module handles — the
+    endpoint never constructs a subsystem to report on it;
+  * ``/debug/pprof``   — the active profiler's folded stacks
+    (flamegraph input; a comment line when profiling is off);
+  * ``/debug/flight``  — the live flight-recorder ring as JSON (the same
+    document a crash dump writes);
+  * anything else      — 404 (the metrics page answers ONLY /metrics).
+
 Activation: ``LOONG_EXPO_PORT=<port>`` env (application start) or
 programmatic ``ExpositionServer(port).start()``; binds 127.0.0.1 unless
 ``LOONG_EXPO_HOST`` widens it.
@@ -26,10 +39,12 @@ programmatic ``ExpositionServer(port).start()``; binds 127.0.0.1 unless
 from __future__ import annotations
 
 import http.server
+import json
 import math
 import os
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.logger import get_logger
@@ -39,6 +54,8 @@ log = get_logger("exposition")
 
 ENV_PORT = "LOONG_EXPO_PORT"
 ENV_HOST = "LOONG_EXPO_HOST"
+
+_process_t0 = time.monotonic()
 
 _PREFIX = "loong_"
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
@@ -121,23 +138,142 @@ def render() -> str:
     return "\n".join(out) + "\n"
 
 
+def process_workers() -> int:
+    """Active processor shard count, 0 when no runner is live."""
+    from ..runner import processor_runner as _pr
+    runner = _pr._active_runner
+    return runner.thread_count if runner is not None else 0
+
+
+def collect_status() -> dict:
+    """The /debug/status document: a one-page answer to "what is this
+    agent doing right now", assembled from observe-only handles.  Every
+    section is fail-soft — a half-constructed subsystem (agent starting
+    up, test harness) yields an absent section, never a 500."""
+    doc: dict = {"time": int(time.time()),
+                 "uptime_s": round(time.monotonic() - _process_t0, 1),
+                 "pid": os.getpid()}
+    try:
+        from ..pipeline import pipeline_manager as _pm
+        mgr = _pm._active_manager
+        if mgr is not None:
+            pqm = mgr.process_queue_manager
+            with mgr._lock:
+                items = list(mgr._pipelines.items())
+            pipelines = {}
+            for name, p in items:
+                entry: dict = {"queue_key": p.process_queue_key}
+                if pqm is not None:
+                    q = pqm.get_queue(p.process_queue_key)
+                    if q is not None:
+                        entry["queue_depth"] = q.size()
+                pipelines[name] = entry
+            doc["pipelines"] = pipelines
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..runner import processor_runner as _pr
+        runner = _pr._active_runner
+        if runner is not None:
+            doc["workers"] = {
+                "count": runner.thread_count,
+                "inbox_depths": runner.inbox_depths(),
+                "lane_overlap": [round(x, 4)
+                                 for x in runner.lane_overlap()],
+            }
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..runner import flusher_runner as _fr
+        fr = _fr._active_runner
+        if fr is not None:
+            doc["breakers"] = {br.name: br.state.name
+                               for br in fr.breakers().values()}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..ops.device_plane import DevicePlane
+        plane = DevicePlane._instance    # observe-only: never construct
+        if plane is not None:
+            u = plane.utilization()
+            doc["device"] = {k: (round(v, 6) if isinstance(v, float) else v)
+                             for k, v in u.items()}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..prof import flight as _flight
+        rec = _flight.recorder()
+        doc["flight"] = {"events": len(rec),
+                         "recorded_total": rec.recorded_total(),
+                         "dropped": rec.dropped_total()}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .. import prof as _prof
+        p = _prof.active_profiler()
+        doc["profiler"] = {"active": p is not None,
+                           "samples": p.samples_total() if p else 0}
+    except Exception:  # noqa: BLE001
+        pass
+    return doc
+
+
+_INDEX = (b"loongcollector_tpu exposition endpoint\n"
+          b"  /metrics       Prometheus text exposition\n"
+          b"  /healthz       liveness (uptime + worker count)\n"
+          b"  /debug/status  running-status JSON\n"
+          b"  /debug/pprof   folded stacks (loongprof)\n"
+          b"  /debug/flight  flight-recorder ring JSON\n")
+
+_PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
+_JSON_CT = "application/json; charset=utf-8"
+_TEXT_CT = "text/plain; charset=utf-8"
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
-            self.send_response(404)
-            self.end_headers()
-            return
+        path = self.path.split("?", 1)[0]
         try:
-            body = render().encode("utf-8")
+            if path == "/metrics":
+                self._reply(200, _PROM_CT, render().encode("utf-8"))
+            elif path == "/healthz":
+                doc = {"status": "ok", "pid": os.getpid(),
+                       "uptime_s": round(time.monotonic() - _process_t0, 1),
+                       "process_workers": process_workers()}
+                self._reply(200, _JSON_CT,
+                            (json.dumps(doc, sort_keys=True) + "\n").encode())
+            elif path == "/debug/status":
+                self._reply(200, _JSON_CT,
+                            (json.dumps(collect_status(), sort_keys=True,
+                                        default=str) + "\n").encode())
+            elif path == "/debug/flight":
+                from ..prof import flight as _flight
+                doc = _flight.recorder().snapshot(reason="live")
+                self._reply(200, _JSON_CT,
+                            (json.dumps(doc, sort_keys=True,
+                                        default=str) + "\n").encode())
+            elif path == "/debug/pprof":
+                from .. import prof as _prof
+                p = _prof.active_profiler()
+                body = (p.folded_text() if p is not None
+                        else "# profiler inactive (set LOONG_PROF=1)\n")
+                self._reply(200, _TEXT_CT, body.encode("utf-8"))
+            elif path == "/":
+                # an index, NOT the metrics page: unknown or bare paths
+                # must never masquerade as a scrape target
+                self._reply(200, _TEXT_CT, _INDEX)
+            else:
+                self.send_response(404)
+                self.end_headers()
         except Exception as e:  # noqa: BLE001 — a bad record must not 500-loop
             log.exception("exposition render failed")
             self.send_response(500)
             self.end_headers()
             self.wfile.write(repr(e).encode())
-            return
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -22,6 +22,8 @@ _mesh_rec = MetricsRecord(category="mesh_parse",
                           labels={"component": "sharded_plane"})
 _shard_rec = MetricsRecord(category="processor_shards",
                            labels={"component": "loongshard"})
+_prof_rec = MetricsRecord(category="profiler",
+                          labels={"component": "loongprof"})
 
 
 def refresh() -> None:
@@ -36,6 +38,24 @@ def refresh() -> None:
             _plane_rec.gauge("budget_bytes").set(plane.budget_bytes)
             _plane_rec.gauge("dispatched_total").set(
                 plane.dispatched_total())
+            # loongprof utilization accounting: occupancy integral,
+            # submit-queue depth, and the "shard more vs device-bound"
+            # counter (docs/observability.md)
+            u = plane.utilization()
+            _plane_rec.gauge("budget_held_fraction_now").set(
+                u["held_fraction"])
+            _plane_rec.gauge("budget_occupancy_avg").set(u["occupancy_avg"])
+            _plane_rec.gauge("device_busy_fraction").set(u["busy_fraction"])
+            # monotone integrals next to the lifetime averages: rate()
+            # over a scrape pair recovers the RECENT fraction, which the
+            # averages cannot show on a long-lived agent
+            _plane_rec.gauge("budget_occupancy_integral_seconds").set(
+                u["occupancy_integral_s"])
+            _plane_rec.gauge("device_busy_seconds").set(u["busy_s"])
+            _plane_rec.gauge("submit_queue_depth").set(
+                u["submit_queue_depth"])
+            _plane_rec.gauge("device_idle_while_backlogged_ms").set(
+                u["idle_while_backlogged_ms"])
     except Exception:  # noqa: BLE001
         pass
     try:
@@ -69,12 +89,35 @@ def refresh() -> None:
             _shard_rec.gauge("inbox_backlog_groups").set(sum(depths))
             _shard_rec.gauge("inbox_backlog_max").set(
                 max(depths) if depths else 0)
+            overlaps = runner.lane_overlap()
+            _shard_rec.gauge("lane_overlap_ratio").set(
+                sum(overlaps) / len(overlaps) if overlaps else 0.0)
         else:
             # no live runner: zero rather than freeze the last values — a
-            # stopped runner must not export a phantom backlog
+            # stopped runner must not export a phantom backlog (or a
+            # phantom device-overlap signal)
             _shard_rec.gauge("process_workers").set(0)
             _shard_rec.gauge("inbox_backlog_groups").set(0)
             _shard_rec.gauge("inbox_backlog_max").set(0)
+            _shard_rec.gauge("lane_overlap_ratio").set(0.0)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongprof: sampler + flight-ring health in the same stream as
+        # everything else (per-scope self_cost_ms counters export through
+        # their own records — the profiler owns those)
+        from .. import prof as _prof
+        from ..prof import flight as _flight
+        p = _prof.active_profiler()
+        _prof_rec.gauge("prof_active").set(1.0 if p is not None else 0.0)
+        _prof_rec.gauge("prof_samples_total").set(
+            float(p.samples_total()) if p is not None else 0.0)
+        rec = _flight.recorder()
+        _prof_rec.gauge("flight_events").set(float(len(rec)))
+        _prof_rec.gauge("flight_recorded_total").set(
+            float(rec.recorded_total()))
+        _prof_rec.gauge("flight_dropped_total").set(
+            float(rec.dropped_total()))
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import prof
+from ..prof import flight
 from ..utils import flags
 from ..utils.logger import get_logger
 from .alarms import AlarmLevel, AlarmManager, AlarmType
@@ -51,6 +53,8 @@ class LoongCollectorMonitor:
         self.mem_gauge = self.metrics.gauge("memory_rss_bytes")
         self.cpu_level = 0.0  # 0..1 fraction of the limit, for flow control
         self._breach_streak = 0
+        self._last_dump_path: Optional[str] = None
+        self._episode_details: Optional[dict] = None
 
     def start(self) -> None:
         if self._running:
@@ -88,26 +92,54 @@ class LoongCollectorMonitor:
             cpu_limit = flags.get_flag("cpu_usage_limit")
             mem_limit = flags.get_flag("memory_usage_limit_mb") * 1024 * 1024
             self.cpu_level = min(cores / cpu_limit, 1.0) if cpu_limit > 0 else 0.0
-            breach = None
-            if cpu_limit > 0 and cores > cpu_limit:
-                breach = f"cpu {cores:.2f} cores > limit {cpu_limit}"
-                log.warning("watchdog: %s", breach)
-                # stable message so AlarmManager aggregation collapses samples
-                AlarmManager.instance().send_alarm(
-                    AlarmType.CPU_LIMIT, "agent cpu over limit",
-                    AlarmLevel.ERROR)
-            if rss > mem_limit > 0:
-                breach = f"rss {rss>>20} MB > limit {mem_limit>>20} MB"
-                log.warning("watchdog: %s", breach)
-                AlarmManager.instance().send_alarm(
-                    AlarmType.MEM_LIMIT, "agent memory over limit",
-                    AlarmLevel.CRITICAL)
-            if breach:
-                self._breach_streak += 1
-                # sustained breach (10 samples) triggers the restart action,
-                # mirroring the reference's suicide-and-restart contract
-                if self._breach_streak >= 10 and self.on_limit_breach:
-                    self.on_limit_breach(breach)
-                    self._breach_streak = 0
-            else:
+            self._check_limits(cores, rss, cpu_limit, mem_limit)
+
+    def _breach_details(self, breach: str) -> dict:
+        """loongprof: a breach alarm must be diagnosable post-mortem —
+        attach the flight-recorder dump path and the breaching thread's
+        sampled stack to the alarm payload.  The flight event, the stack
+        sample AND the dump all happen once per breach EPISODE (streak
+        start): a sustained breach at 1 Hz must neither flood the flight
+        ring with identical entries nor pay an all-thread stack walk per
+        sample on an agent already over its CPU limit."""
+        if self._episode_details is not None:
+            return dict(self._episode_details, breach=breach)
+        stack = prof.hottest_stack()
+        flight.record("watchdog.breach", breach=breach)
+        self._last_dump_path = flight.dump(reason="watchdog_breach")
+        details = {"flight_dump": self._last_dump_path or "",
+                   "breach": breach}
+        if stack is not None:
+            details["breach_thread"] = stack[0]
+            details["breach_stack"] = stack[1][-1600:]
+        self._episode_details = details
+        return dict(details)
+
+    def _check_limits(self, cores: float, rss: int, cpu_limit: float,
+                      mem_limit: int) -> None:
+        breach = None
+        if cpu_limit > 0 and cores > cpu_limit:
+            breach = f"cpu {cores:.2f} cores > limit {cpu_limit}"
+            log.warning("watchdog: %s", breach)
+            # stable message so AlarmManager aggregation collapses samples
+            AlarmManager.instance().send_alarm(
+                AlarmType.CPU_LIMIT, "agent cpu over limit",
+                AlarmLevel.ERROR, details=self._breach_details(breach))
+        if rss > mem_limit > 0:
+            breach = f"rss {rss>>20} MB > limit {mem_limit>>20} MB"
+            log.warning("watchdog: %s", breach)
+            AlarmManager.instance().send_alarm(
+                AlarmType.MEM_LIMIT, "agent memory over limit",
+                AlarmLevel.CRITICAL, details=self._breach_details(breach))
+        if breach:
+            self._breach_streak += 1
+            # sustained breach (10 samples) triggers the restart action,
+            # mirroring the reference's suicide-and-restart contract
+            if self._breach_streak >= 10 and self.on_limit_breach:
+                self.on_limit_breach(breach)
                 self._breach_streak = 0
+        else:
+            self._breach_streak = 0
+            # next episode gets a fresh dump, stack sample and flight entry
+            self._last_dump_path = None
+            self._episode_details = None

@@ -33,7 +33,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from .. import chaos, trace
+from .. import chaos, prof, trace
 from ..utils.logger import get_logger
 
 log = get_logger("device_plane")
@@ -58,6 +58,34 @@ def roundtrip_histogram():
         _rtt_hist = shared_histogram("device_roundtrip_seconds",
                                      labels={"component": "device_plane"})
     return _rtt_hist
+
+
+_held_hist = None
+
+
+def held_fraction_histogram():
+    """Distribution of the budget fraction held at each dispatch — the
+    loongprof utilization view: a histogram living near 1.0 means the
+    budget (not the device) gates dispatch."""
+    global _held_hist
+    if _held_hist is None:
+        from ..monitor.metrics import shared_histogram
+        _held_hist = shared_histogram("device_budget_held_fraction",
+                                      labels={"component": "device_plane"})
+    return _held_hist
+
+
+def note_host_backlog() -> None:
+    """loongprof utilization probe, called by runner loops that just
+    popped work while more work remains queued: if the device plane sits
+    idle even though the host has backlog, the idle gap is charged to
+    ``device_idle_while_backlogged_ms`` — the single number separating
+    "shard more workers" (host-bound: counter grows) from "the device is
+    the bottleneck" (counter flat while occupancy is high).  One global
+    read when no plane was ever constructed."""
+    plane = DevicePlane._instance
+    if plane is not None:
+        plane.note_backlogged()
 
 
 def set_budget_relief(fn: Optional[Callable[[], bool]]) -> None:
@@ -115,7 +143,13 @@ class DeviceFuture:
         try:
             if self._error is not None:
                 raise self._error
-            self._materialised = [np.asarray(o) for o in self._outputs]
+            # loongprof: materialisation is where the host actually waits
+            # on the device — attribute that wall time to the device scope
+            prof.push_marker("device", "materialise")
+            try:
+                self._materialised = [np.asarray(o) for o in self._outputs]
+            finally:
+                prof.pop_marker()
             roundtrip_histogram().observe(time.perf_counter() - self._t0)
             if self._span is not None:
                 self._span.end("ok")
@@ -180,6 +214,16 @@ class DevicePlane:
         self._lock = threading.Lock()
         self._freed = threading.Condition(self._lock)
         self._closed = False
+        # -- loongprof utilization accounting (all under self._lock) --------
+        now = time.perf_counter()
+        self._util_t0 = now                 # accounting epoch
+        self._util_last = now               # last occupancy transition
+        self._occupancy_integral = 0.0      # ∫ (inflight/budget) dt
+        self._busy_s = 0.0                  # time with inflight > 0
+        self._idle_since: Optional[float] = now
+        self._idle_backlogged_ms = 0.0
+        self._backlog_probe_at: Optional[float] = None
+        self._waiters = 0                   # threads blocked in _acquire
 
     @classmethod
     def instance(cls) -> "DevicePlane":
@@ -217,9 +261,70 @@ class DevicePlane:
             return (self._inflight + nbytes > self.budget_bytes
                     and self._inflight > 0)
 
+    # -- utilization accounting (loongprof) ---------------------------------
+
+    def _util_tick(self, now: float) -> None:
+        """Lock held.  Fold the elapsed interval into the occupancy
+        integrals BEFORE an inflight transition."""
+        dt = now - self._util_last
+        if dt > 0:
+            self._occupancy_integral += (self._inflight / self.budget_bytes
+                                         if self.budget_bytes else 0.0) * dt
+            if self._inflight > 0:
+                self._busy_s += dt
+        self._util_last = now
+
+    def note_backlogged(self) -> None:
+        """The host has queued work RIGHT NOW (caller just popped an item
+        with more behind it).  Charge the device-idle gap SINCE THE LAST
+        backlogged probe to ``device_idle_while_backlogged_ms`` — the
+        first probe of an idle span only arms the window, so the hour the
+        agent sat idle with no traffic is never charged when a burst
+        finally arrives (backlog must exist at BOTH ends of a charged
+        gap).  Planes that never dispatched stay at zero — a pure-host
+        pipeline's idle device is not a finding."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._dispatched == 0 or self._inflight > 0 \
+                    or self._idle_since is None:
+                self._backlog_probe_at = None
+                return
+            if self._backlog_probe_at is None:
+                self._backlog_probe_at = now
+                return
+            start = max(self._idle_since, self._backlog_probe_at)
+            if now > start:
+                self._idle_backlogged_ms += (now - start) * 1000.0
+            self._backlog_probe_at = now
+
+    def utilization(self) -> dict:
+        """Snapshot of the device-plane utilization accounting — the
+        "shard more vs device-bound" dashboard (docs/observability.md)."""
+        now = time.perf_counter()
+        with self._lock:
+            self._util_tick(now)
+            elapsed = max(now - self._util_t0, 1e-9)
+            return {
+                "budget_bytes": self.budget_bytes,
+                "inflight_bytes": self._inflight,
+                "held_fraction": (self._inflight / self.budget_bytes
+                                  if self.budget_bytes else 0.0),
+                "occupancy_avg": self._occupancy_integral / elapsed,
+                "busy_fraction": self._busy_s / elapsed,
+                # raw monotone integrals: lifetime averages go inert on a
+                # long-lived agent, but rate() over these recovers the
+                # RECENT occupancy/busy fraction from any scrape pair
+                "occupancy_integral_s": self._occupancy_integral,
+                "busy_s": self._busy_s,
+                "idle_while_backlogged_ms": self._idle_backlogged_ms,
+                "submit_queue_depth": self._waiters,
+                "dispatched_total": self._dispatched,
+                "elapsed_s": elapsed,
+            }
+
     def _acquire(self, nbytes: int,
                  should_abort: Optional[Callable[[], bool]] = None,
-                 on_wait: Optional[Callable[[], bool]] = None) -> None:
+                 on_wait: Optional[Callable[[], bool]] = None) -> int:
         """Block until `nbytes` fits in the budget.  A single dispatch larger
         than the whole budget is admitted when nothing is in flight (it could
         otherwise never run).  This blocking IS the device back-pressure: the
@@ -231,27 +336,50 @@ class DevicePlane:
         return True (False = nothing owned).  That rule makes the budget
         deadlock-free: every waiting thread can always release the budget it
         itself holds, so some thread always makes progress."""
-        while True:
-            with self._freed:
-                if self._closed or \
-                        self._inflight + nbytes <= self.budget_bytes or \
-                        self._inflight == 0:
-                    self._inflight += nbytes
-                    self._dispatched += 1
-                    return
-                if should_abort is not None and should_abort():
-                    raise DispatchAborted()
-            progressed = on_wait() if on_wait is not None else False
-            if not progressed:
-                relief = getattr(_tls, "relief", None)
-                progressed = bool(relief()) if relief is not None else False
-            if not progressed:
+        waiting = False
+        try:
+            while True:
                 with self._freed:
-                    self._freed.wait(timeout=0.05)
+                    if self._closed or \
+                            self._inflight + nbytes <= self.budget_bytes or \
+                            self._inflight == 0:
+                        self._util_tick(time.perf_counter())
+                        self._inflight += nbytes
+                        self._dispatched += 1
+                        self._idle_since = None
+                        # post-admission inflight, returned so the caller
+                        # can observe THIS dispatch's held fraction without
+                        # re-taking the lock (a later read would race
+                        # concurrent releases)
+                        return self._inflight
+                    if should_abort is not None and should_abort():
+                        raise DispatchAborted()
+                    if not waiting:
+                        # submit-queue depth: threads blocked on budget —
+                        # sustained depth > 0 with high occupancy means the
+                        # budget (or the device behind it) gates the host
+                        waiting = True
+                        self._waiters += 1
+                progressed = on_wait() if on_wait is not None else False
+                if not progressed:
+                    relief = getattr(_tls, "relief", None)
+                    progressed = bool(relief()) if relief is not None \
+                        else False
+                if not progressed:
+                    with self._freed:
+                        self._freed.wait(timeout=0.05)
+        finally:
+            if waiting:
+                with self._lock:
+                    self._waiters -= 1
 
     def _release(self, nbytes: int) -> None:
         with self._freed:
+            self._util_tick(time.perf_counter())
             self._inflight = max(0, self._inflight - nbytes)
+            if self._inflight == 0:
+                self._idle_since = self._util_last
+                self._backlog_probe_at = None
             self._freed.notify_all()
 
     def close(self) -> None:
@@ -272,7 +400,10 @@ class DevicePlane:
         future rather than raising here, so a multi-chunk dispatch loop keeps
         its bookkeeping simple and errors surface at the (ordered)
         materialisation point."""
-        self._acquire(nbytes, should_abort, on_wait)
+        inflight_now = self._acquire(nbytes, should_abort, on_wait)
+        if self.budget_bytes:
+            held_fraction_histogram().observe(
+                inflight_now / self.budget_bytes)
         tracer = trace.active_tracer()
         span = (tracer.child_or_sampled("device", "device.roundtrip",
                                         {"nbytes": nbytes})
@@ -282,7 +413,11 @@ class DevicePlane:
             # exactly like a kernel raising at dispatch — errored future,
             # budget released at the consume point (result/release)
             chaos.faultpoint(FP_SUBMIT)
-            outputs = kernel(*args)
+            prof.push_marker("device", "dispatch")
+            try:
+                outputs = kernel(*args)
+            finally:
+                prof.pop_marker()
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)
             return DeviceFuture(self, nbytes, outputs=outputs, span=span)

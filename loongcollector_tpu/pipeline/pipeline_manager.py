@@ -15,6 +15,11 @@ from .pipeline import CollectionPipeline
 
 log = get_logger("pipeline_manager")
 
+# observe-only handle for /debug/status (monitor/exposition.py): the most
+# recently constructed manager — never constructed, never mutated through
+# this; stop_all() clears it (runner/processor_runner.py idiom)
+_active_manager = None
+
 
 class ConfigDiff:
     def __init__(self) -> None:
@@ -36,6 +41,8 @@ class CollectionPipelineManager:
         self._pending_onetime: Dict[str, dict] = {}
         # queue_key -> pipeline, rebuilt lazily after every topology change
         self._queue_key_cache: Dict[int, CollectionPipeline] = {}
+        global _active_manager
+        _active_manager = self
 
     def update_pipelines(self, diff: ConfigDiff) -> None:
         # drop the hot-path queue-key cache for the duration of the update
@@ -153,6 +160,9 @@ class CollectionPipelineManager:
             return list(self._pipelines)
 
     def stop_all(self) -> None:
+        global _active_manager
+        if _active_manager is self:
+            _active_manager = None
         with self._lock:
             pipelines = list(self._pipelines.values())
         for p in pipelines:

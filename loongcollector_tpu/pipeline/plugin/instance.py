@@ -10,7 +10,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List
 
-from ... import trace
+from ... import prof, trace
 from ...models import PipelineEventGroup
 from ...monitor.metrics import MetricsRecord
 from .interface import Flusher, Input, PluginContext, Processor
@@ -44,6 +44,7 @@ class ProcessorInstance:
         sp = (tracer.child_or_sampled("processor",
                                       "processor." + self.plugin.name)
               if tracer is not None else None)
+        prof.push_marker("plugin", self.plugin_id or self.plugin.name)
         t0 = time.perf_counter()
         ok = False
         try:
@@ -51,6 +52,7 @@ class ProcessorInstance:
             ok = True
         finally:
             dt = time.perf_counter() - t0
+            prof.pop_marker()
             self.stage_hist.observe(dt)
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:
@@ -67,6 +69,7 @@ class ProcessorInstance:
                                       "processor." + self.plugin.name
                                       + ".dispatch")
               if tracer is not None else None)
+        prof.push_marker("plugin", self.plugin_id or self.plugin.name)
         t0 = time.perf_counter()
         ok = False
         try:
@@ -74,6 +77,7 @@ class ProcessorInstance:
             ok = True
         finally:
             dt = time.perf_counter() - t0
+            prof.pop_marker()
             self.stage_hist.observe(dt)
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:
@@ -87,6 +91,7 @@ class ProcessorInstance:
                                       "processor." + self.plugin.name
                                       + ".complete")
               if tracer is not None else None)
+        prof.push_marker("plugin", self.plugin_id or self.plugin.name)
         t0 = time.perf_counter()
         ok = False
         try:
@@ -95,6 +100,7 @@ class ProcessorInstance:
             ok = True
         finally:
             dt = time.perf_counter() - t0
+            prof.pop_marker()
             self.stage_hist.observe(dt)
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:

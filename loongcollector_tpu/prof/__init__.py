@@ -1,0 +1,105 @@
+"""loongprof: continuous self-profiling + crash flight recorder.
+
+Off by default; ``enable()`` / ``LOONG_PROF=1`` turns the sampler on
+(``LOONG_PROF_HZ`` shapes the rate).  Every hook in this package is a
+single module-global read + branch when disabled — the chaos-plane idiom,
+gated by scripts/prof_overhead.py the same way scripts/trace_overhead.py
+gates loongtrace.
+
+The flight recorder (prof/flight.py) is ALWAYS on: notable events are
+rare by definition, the ring is bounded, and a crash dump that says
+"flight recording was disabled" helps nobody.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from . import flight
+from .profiler import (DEFAULT_HZ, Profiler, hottest_stack,
+                       sample_stacks_once)
+
+ENV_ENABLE = "LOONG_PROF"
+ENV_HZ = "LOONG_PROF_HZ"
+
+__all__ = [
+    "DEFAULT_HZ", "ENV_ENABLE", "ENV_HZ", "Profiler", "active",
+    "active_profiler", "disable", "enable", "flight", "hottest_stack",
+    "install_from_env", "is_active", "pop_marker", "push_marker",
+    "sample_stacks_once",
+]
+
+_profiler: Optional[Profiler] = None
+
+
+def is_active() -> bool:
+    return _profiler is not None
+
+
+def active_profiler() -> Optional[Profiler]:
+    """THE disabled-path hook: call sites read this once; None means
+    profiling is off and nothing else may run."""
+    return _profiler
+
+
+def enable(hz: float = DEFAULT_HZ, autostart: bool = True) -> Profiler:
+    global _profiler
+    disable()
+    p = Profiler(hz=hz)
+    _profiler = p
+    if autostart:
+        p.start()
+    return p
+
+
+def disable() -> None:
+    global _profiler
+    p, _profiler = _profiler, None
+    if p is not None:
+        p.stop()
+
+
+@contextlib.contextmanager
+def active(hz: float = DEFAULT_HZ, autostart: bool = True):
+    """Scoped activation for tests: ``with prof.active() as p: ...``."""
+    p = enable(hz=hz, autostart=autostart)
+    try:
+        yield p
+    finally:
+        disable()
+
+
+def install_from_env(env=os.environ) -> bool:
+    """LOONG_PROF=1 activates the sampler at application start;
+    LOONG_PROF_HZ (float, default 29) shapes the sampling rate."""
+    raw = env.get(ENV_ENABLE)
+    if not raw or raw.strip().lower() in ("0", "false", "no", "off"):
+        return False
+    try:
+        hz = float(env.get(ENV_HZ, str(DEFAULT_HZ)))
+    except ValueError:
+        hz = DEFAULT_HZ
+    enable(hz=hz)
+    return True
+
+
+# -- hot-path hooks: each is one global read + branch when disabled ---------
+
+
+def push_marker(kind: str, name: str = "") -> None:
+    """Mark the calling thread's current scope (``kind:name``) for
+    sample attribution.  Pass the label in two pieces so the disabled
+    path never concatenates strings."""
+    p = _profiler
+    if p is None:
+        return
+    p.push_marker(kind, name)
+
+
+def pop_marker() -> None:
+    p = _profiler
+    if p is None:
+        return
+    p.pop_marker()

@@ -1,0 +1,245 @@
+"""Crash flight recorder: the last N notable events, always on.
+
+Reference analogue: the reference agent's self-monitor keeps enough
+post-mortem state (alarms, profile data, running status) that a crashed
+or killed agent can explain its final seconds.  Here a fixed-size ring
+buffer records every *notable* event — alarms, chaos injections, circuit
+breaker transitions, disk-buffer spills/replays/quarantines, watchdog
+breaches, worker stalls — plus the last few sampled thread-stack sets
+from the profiler (prof/profiler.py), and dumps deterministically to a
+JSON file on SIGTERM, watchdog breach, or unhandled crash
+(application.py wires the triggers).  The live ring is served at
+``/debug/flight`` by monitor/exposition.py.
+
+Contract:
+
+  * ``record()`` is lock-cheap — one short lock around a bounded deque
+    append — and MUST NEVER be called while the caller holds another
+    lock (loonglint's blocking-under-lock checker enforces this
+    statically: a recorder wedged behind a contended ring lock must not
+    wedge the data path).  Notable events are rare by definition; the
+    hot paths never call in here.
+  * The ring is bounded (`capacity`); overflow drops the OLDEST events
+    and counts the drop, so a crash dump always holds the newest
+    history.
+  * `canonicalize(doc)` strips every timing- and thread-dependent field
+    so two seeded runs compare byte-stable per event stream (the same
+    per-point guarantee the chaos schedule gives — global interleaving
+    across threads is not deterministic, per-stream subsequences are).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logger import get_logger
+
+log = get_logger("flight")
+
+RING_CAPACITY = 2048      # notable events kept
+STACK_CAPACITY = 16       # last-N sampled stack sets kept
+DUMP_BASENAME = "flight.json"
+
+#: attrs whose values are timing/thread dependent — stripped by
+#: `canonicalize` (mirrors trace.tracer._VOLATILE_ATTRS)
+_VOLATILE_ATTRS = frozenset({"delay_s", "duration_s", "depth", "wait_s",
+                             "dump", "path"})
+
+
+class FlightRecorder:
+    """Bounded ring of (seq, wall, kind, attrs) + last-N stack samples."""
+
+    def __init__(self, capacity: int = RING_CAPACITY,
+                 stack_capacity: int = STACK_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._stacks: deque = deque(maxlen=stack_capacity)
+        self._seq = itertools.count()
+        self._recorded_total = 0
+
+    # -- recording (lock-cheap; NEVER call under another lock) --------------
+
+    def record(self, kind: str, **attrs) -> None:
+        wall = time.time()
+        with self._lock:
+            # seq is drawn under the lock so ring order IS seq order —
+            # the guarantee snapshot() documents
+            self._recorded_total += 1
+            self._events.append((next(self._seq), wall, kind, attrs))
+
+    def record_stacks(self, stacks: List[Tuple[str, str]]) -> None:
+        """Attach one sampled stack set [(thread_name, folded), ...] —
+        the profiler pushes its latest sample here so a crash dump shows
+        what every thread was doing just before the end."""
+        entry = (time.time(), list(stacks))
+        with self._lock:
+            self._stacks.append(entry)
+
+    # -- retrieval ----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def recorded_total(self) -> int:
+        with self._lock:
+            return self._recorded_total
+
+    def dropped_total(self) -> int:
+        with self._lock:
+            return max(0, self._recorded_total - len(self._events))
+
+    def events(self) -> List[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def events_by_kind(self) -> Dict[str, List[tuple]]:
+        out: Dict[str, List[tuple]] = {}
+        for ev in self.events():
+            out.setdefault(ev[2], []).append(ev)
+        return out
+
+    def reset(self) -> None:
+        """Tests only: forget everything (a previous test's storm must
+        not leak into this one's dump)."""
+        with self._lock:
+            self._events.clear()
+            self._stacks.clear()
+            self._recorded_total = 0
+
+    # -- snapshot / dump ----------------------------------------------------
+
+    def snapshot(self, reason: str = "") -> dict:
+        """The dump document: newest-history ring + last stack samples.
+        Deterministic ordering (ring order = seq order); `canonicalize`
+        strips the volatile fields for byte-stable comparison."""
+        with self._lock:
+            events = list(self._events)
+            stacks = list(self._stacks)
+            total = self._recorded_total
+        return {
+            "reason": reason,
+            "time": int(time.time()),
+            "pid": os.getpid(),
+            "recorded_total": total,
+            "dropped": max(0, total - len(events)),
+            "capacity": self.capacity,
+            "events": [
+                {"seq": seq, "wall": wall, "kind": kind, "attrs": attrs}
+                for (seq, wall, kind, attrs) in events
+            ],
+            "stacks": [
+                {"wall": wall,
+                 "threads": [{"thread": name, "stack": folded}
+                             for name, folded in sample]}
+                for (wall, sample) in stacks
+            ],
+        }
+
+    def dump(self, path: Optional[str] = None, reason: str = "",
+             to_log: bool = True) -> Optional[str]:
+        """Write the snapshot to `path` (default: <dump_dir>/flight.json)
+        atomically, and mirror a short form to the log.  Returns the
+        written path, or None when writing failed (the dump must never
+        raise — it runs on crash paths)."""
+        doc = self.snapshot(reason=reason)
+        if path is None:
+            path = os.path.join(_dump_dir, DUMP_BASENAME)
+        tmp = None
+        try:
+            # unique tmp per dump: concurrent dumpers (watchdog breach +
+            # SIGTERM racing on the crash path) must never truncate each
+            # other's half-written file — last os.replace wins atomically
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(path) + ".",
+                suffix=".tmp", dir=os.path.dirname(path) or ".")
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            log.error("flight dump to %s failed: %s", path, e)
+            if tmp is not None:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            path = None
+        if to_log:
+            tail = doc["events"][-20:]
+            log.warning(
+                "flight recorder dump (%s): %d events (%d dropped), "
+                "last %d: %s", reason or "unsolicited", len(doc["events"]),
+                doc["dropped"], len(tail),
+                "; ".join(f"{e['kind']}{e['attrs']}" for e in tail))
+        return path
+
+
+# ---------------------------------------------------------------------------
+# canonicalization (shared by tests and operators diffing two dumps)
+
+
+def _stable(v):
+    if isinstance(v, float):
+        return round(v, 9)
+    return v
+
+
+def canonicalize(doc: dict, kinds: Optional[frozenset] = None) -> bytes:
+    """Reduce a dump document to its timing-independent structure:
+    per-kind event subsequences in ring order, kinds sorted, wall/seq and
+    volatile attrs stripped, stacks dropped.  Per-kind subsequences are
+    deterministic for a seeded single-source stream (the chaos-schedule
+    guarantee); pass `kinds` to restrict comparison to the streams that
+    are seed-deterministic (e.g. ``frozenset({"chaos.inject"})`` — alarm
+    and breaker timing varies across runs even under one seed)."""
+    by_kind: Dict[str, List[tuple]] = {}
+    for ev in doc.get("events", []):
+        kind = ev["kind"]
+        if kinds is not None and kind not in kinds:
+            continue
+        attrs = tuple(sorted((k, _stable(v)) for k, v in ev["attrs"].items()
+                             if k not in _VOLATILE_ATTRS))
+        by_kind.setdefault(kind, []).append(attrs)
+    out = [(k,) + tuple(v) for k in sorted(by_kind) for v in by_kind[k]]
+    return json.dumps(out, sort_keys=True, separators=(",", ":"),
+                      default=str).encode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# module-level recorder: always on (events are rare; the ring is bounded)
+
+_recorder = FlightRecorder()
+# default: the system temp dir — a bare-library breach must never litter
+# the process cwd; the Application points this at its data dir on init
+# (the dump path is always logged, so the file stays discoverable)
+_dump_dir = tempfile.gettempdir()
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def record(kind: str, **attrs) -> None:
+    """Record one notable event into the process flight ring.  NEVER call
+    while holding a lock (loonglint: blocking-under-lock)."""
+    _recorder.record(kind, **attrs)
+
+
+def set_dump_dir(path: str) -> None:
+    """Where unsolicited dumps (signals, crashes, watchdog) land —
+    the Application points this at its data dir."""
+    global _dump_dir
+    _dump_dir = path
+
+
+def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
+    return _recorder.dump(path=path, reason=reason)

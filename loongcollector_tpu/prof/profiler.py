@@ -1,0 +1,302 @@
+"""Sampling wall-clock profiler: where does this agent spend its time?
+
+The paper's self-monitoring pillar (PAPER.md): the reference agent ships
+its own CPU profile and running status so a production operator can ask
+"what is the agent doing right now" without attaching a debugger.  Here a
+sampler thread wakes at ``hz`` (``LOONG_PROF_HZ``, default 29 — an odd
+rate so it never phase-locks with 1 Hz/10 Hz periodic loops), walks
+``sys._current_frames()`` and
+
+  * aggregates **folded stacks** (``thread;outer;...;leaf count`` —
+    flamegraph input, served at ``/debug/pprof``);
+  * attributes **exclusive self-cost** to the innermost context marker of
+    each thread (markers are planted by ProcessorRunner workers
+    [``worker:...`` / ``pipeline:...``], ProcessorInstance
+    [``plugin:...``], FlusherRunner and the device plane), exporting
+    ``self_cost_ms`` counters per scope through monitor/metrics.py — so
+    per-plugin CPU shows up in the Prometheus exposition and the
+    self-monitor pipeline next to every other metric;
+  * pushes each sampled stack set into the flight recorder's last-N ring
+    (prof/flight.py), so a crash dump shows what every thread was doing.
+
+Threads without a marker attribute to ``thread:<name>`` — the sampler
+never loses cost, it only loses granularity.
+
+The profiler is off by default; disabled hooks are one module-global
+read (chaos-plane idiom, gated by scripts/prof_overhead.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..monitor.metrics import MetricsRecord
+
+DEFAULT_HZ = 29.0
+_FOLDED_CAP = 50_000        # distinct folded stacks kept
+_MAX_DEPTH = 64             # frames per stack
+_SCOPE_CAP = 256            # distinct per-scope metric records kept
+
+#: ephemeral-thread normalizer: default thread names carry a per-thread
+#: serial ("Thread-12 (process_request_thread)"); stripping the digits
+#: collapses them to one scope, or scope-record cardinality (and the
+#: exposition page) would grow with every scrape-handler thread sampled
+_THREAD_SERIAL_RE = re.compile(r"\d+")
+
+
+def _fold_frame(frame, max_depth: int = _MAX_DEPTH) -> str:
+    """Leaf-last folded stack for one thread's current frame."""
+    parts: List[str] = []
+    depth = 0
+    while frame is not None and depth < max_depth:
+        code = frame.f_code
+        parts.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}"
+                     f":{frame.f_lineno})")
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()
+    return ";".join(parts)
+
+
+def sample_stacks_once(skip_ident: Optional[int] = None
+                       ) -> List[Tuple[str, str]]:
+    """One-shot stack sample of every live thread — usable without an
+    active profiler (the watchdog attaches this to breach alarms)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        if tid == skip_ident:
+            continue
+        out.append((names.get(tid, f"tid-{tid}"), _fold_frame(frame)))
+    return out
+
+
+_IDLE_LEAVES = ("wait (", "sleep (", "select (", "poll (", "accept (",
+                "_sample_loop (", "sample_stacks_once (")
+
+#: leaf frames of PARKED threads (blocked in a wait, not burning CPU) —
+#: they accrue wall_ms but not self_cost_ms, so the top-self-cost ranking
+#: answers "what burns the CPU", not "what exists"
+_PARKED_LEAVES = ("wait (", "sleep (", "select (", "poll (", "accept (",
+                  "get (", "recv (", "recv_into (", "read (")
+
+
+def _leaf_parked(folded: str) -> bool:
+    leaf = folded.rsplit(";", 1)[-1]
+    return any(m in leaf for m in _PARKED_LEAVES)
+
+
+def hottest_stack(stacks: Optional[List[Tuple[str, str]]] = None
+                  ) -> Optional[Tuple[str, str]]:
+    """Best-effort "breaching thread" heuristic: the deepest sampled
+    thread whose leaf frame is NOT an idle wait (threads parked in
+    sleep/wait/select are not the ones burning the CPU limit — and
+    neither is this sampling call itself).  Falls back to the deepest
+    stack when every thread looks idle, so the caller always gets SOME
+    stack to attach."""
+    if stacks is None:
+        stacks = sample_stacks_once()
+    busy = [s for s in stacks
+            if s[1] and not any(m in s[1].rsplit(";", 1)[-1]
+                                for m in _IDLE_LEAVES)]
+    pool = busy or stacks
+    if not pool:
+        return None
+    return max(pool, key=lambda s: s[1].count(";"))
+
+
+class Profiler:
+    """Process-wide sampling profiler.  `start()` spawns the sampler
+    thread; `sample_once()` is callable directly (tests, and the dump
+    path wants one final sample)."""
+
+    def __init__(self, hz: float = DEFAULT_HZ):
+        self.hz = max(1.0, float(hz))
+        self.interval_s = 1.0 / self.hz
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._lock = threading.Lock()
+        self._folded: Dict[str, int] = {}
+        self._marker_lock = threading.Lock()
+        self._markers: Dict[int, List[str]] = {}
+        self._samples_total = 0
+        self._records: Dict[str, MetricsRecord] = {}
+        self._records_lock = threading.Lock()
+
+    # -- context markers (planted by instrumented threads) -------------------
+
+    def push_marker(self, kind: str, name: str = "") -> None:
+        label = f"{kind}:{name}" if name else kind
+        tid = threading.get_ident()
+        with self._marker_lock:
+            self._markers.setdefault(tid, []).append(label)
+
+    def pop_marker(self) -> None:
+        tid = threading.get_ident()
+        with self._marker_lock:
+            stack = self._markers.get(tid)
+            if stack:
+                stack.pop()
+                if not stack:
+                    del self._markers[tid]
+
+    def current_marker(self, tid: Optional[int] = None) -> Optional[str]:
+        if tid is None:
+            tid = threading.get_ident()
+        with self._marker_lock:
+            stack = self._markers.get(tid)
+            return stack[-1] if stack else None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._sample_loop,
+                                        name="loongprof", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        # retire the per-scope records: a stopped profiler exports nothing
+        # further (loonglint metric-naming ownership rule)
+        with self._records_lock:
+            records = list(self._records.values())
+        for rec in records:
+            rec.mark_deleted()
+
+    def _sample_loop(self) -> None:
+        while self._running:
+            time.sleep(self.interval_s)
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — sampling must never kill
+                pass           # the process it observes
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample_once(self) -> int:
+        """Sample every thread but the sampler itself.  Returns the number
+        of threads sampled.  (Callable from any thread — tests and the
+        dump path take one final sample directly; only the dedicated
+        sampler thread is excluded, so a direct call still sees the
+        caller's own stack.)"""
+        own = self._thread.ident if self._thread is not None else None
+        interval_ms = self.interval_s * 1000.0
+        names = {t.ident: t.name for t in threading.enumerate()}
+        frames = sys._current_frames()
+        with self._marker_lock:
+            markers = {tid: stack[-1]
+                       for tid, stack in self._markers.items() if stack}
+            # threads that died keep no marker state behind.  Liveness is
+            # re-checked HERE, under the lock — the `frames` snapshot
+            # above is stale, and judging by it would delete the marker a
+            # thread pushed after the snapshot (misattributing it forever)
+            alive = {t.ident for t in threading.enumerate()}
+            for tid in list(self._markers):
+                if tid not in alive:
+                    del self._markers[tid]
+        stacks: List[Tuple[str, str]] = []
+        costs: Dict[str, List[float]] = {}         # scope -> [wall, busy]
+        for tid, frame in frames.items():
+            if tid == own:
+                continue
+            tname = names.get(tid, f"tid-{tid}")
+            folded = f"{tname};{_fold_frame(frame)}"
+            stacks.append((tname, folded))
+            # unmarked fallback strips thread serials: "Thread-12 (...)"
+            # and "Thread-13 (...)" are one scope, not two series
+            scope = markers.get(tid) or \
+                f"thread:{_THREAD_SERIAL_RE.sub('*', tname)}"
+            entry = costs.setdefault(scope, [0.0, 0.0])
+            entry[0] += interval_ms
+            if not _leaf_parked(folded):
+                # a parked thread (blocked in a wait) accrues wall time
+                # but no SELF cost — the top-cost ranking must surface the
+                # plugin burning the CPU, not the thread-pool that exists
+                entry[1] += interval_ms
+        # one lock acquisition per sample, not per thread: the sampler
+        # runs at up to ~100 Hz and is itself overhead-gated
+        with self._lock:
+            for _tname, folded in stacks:
+                if folded in self._folded or len(self._folded) < _FOLDED_CAP:
+                    self._folded[folded] = self._folded.get(folded, 0) + 1
+            self._samples_total += 1
+        for scope, (wall_ms, busy_ms) in costs.items():
+            rec = self._scope_record(scope)
+            rec.counter("wall_ms").add(int(round(wall_ms)))
+            if busy_ms:
+                rec.counter("self_cost_ms").add(int(round(busy_ms)))
+        # the flight recorder keeps the last few stack sets for the
+        # post-mortem dump (record_stacks takes only its own ring lock)
+        from . import flight
+        flight.recorder().record_stacks(stacks)
+        return len(stacks)
+
+    def _scope_record(self, scope: str) -> MetricsRecord:
+        rec = self._records.get(scope)
+        if rec is None:
+            with self._records_lock:
+                rec = self._records.get(scope)
+                if rec is None:
+                    if len(self._records) >= _SCOPE_CAP:
+                        # cardinality backstop: past the cap, new scopes
+                        # collapse into one overflow record rather than
+                        # growing the registry (and every scrape) forever
+                        scope = "overflow"
+                        rec = self._records.get(scope)
+                    if rec is None:
+                        rec = MetricsRecord(category="profiler",
+                                            labels={"component": "prof",
+                                                    "scope": scope})
+                        self._records[scope] = rec
+        return rec
+
+    # -- retrieval ----------------------------------------------------------
+
+    def samples_total(self) -> int:
+        with self._lock:
+            return self._samples_total
+
+    def folded(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._folded)
+
+    def folded_text(self) -> str:
+        """Flamegraph input: one ``stack count`` line per distinct folded
+        stack, highest count first (stable tie-break on the stack text so
+        two snapshots of one run diff cleanly)."""
+        items = sorted(self.folded().items(), key=lambda kv: (-kv[1], kv[0]))
+        return "".join(f"{stack} {count}\n" for stack, count in items)
+
+    def self_costs_ms(self) -> Dict[str, int]:
+        """scope -> accumulated exclusive SELF cost (ms): wall time of
+        samples whose leaf was not parked in a wait."""
+        with self._records_lock:
+            records = dict(self._records)
+        return {scope: rec.counter("self_cost_ms").value
+                for scope, rec in records.items()}
+
+    def wall_costs_ms(self) -> Dict[str, int]:
+        """scope -> accumulated wall time (ms), parked samples included."""
+        with self._records_lock:
+            records = dict(self._records)
+        return {scope: rec.counter("wall_ms").value
+                for scope, rec in records.items()}
+
+    def top_self_costs(self, n: int = 5) -> List[Tuple[str, int]]:
+        """Busiest scopes first — ranked by non-parked self-cost (wall
+        time as the tiebreak), so an idle thread pool never outranks the
+        plugin actually burning the CPU."""
+        walls = self.wall_costs_ms()
+        costs = sorted(self.self_costs_ms().items(),
+                       key=lambda kv: (-kv[1], -walls.get(kv[0], 0), kv[0]))
+        return costs[:n]

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional, Tuple
 from .. import trace
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
+from ..prof import flight
 from ..utils.logger import get_logger
 
 log = get_logger("circuit")
@@ -77,6 +78,11 @@ class SinkCircuitBreaker:
         # slot forever — after this long the probe counts as failed
         self.probe_timeout_s = max(30.0, 2 * self.cooldown_s)
         self._lock = threading.Lock()
+        # transitions decided under the lock are EMITTED (trace event,
+        # flight-recorder entry, alarm, on_close callback) outside it by
+        # _emit() — the flight recorder must never be called under a held
+        # lock (loonglint: blocking-under-lock, flight-record rule)
+        self._pending_emits: List[Tuple[str, str]] = []
         self.metrics = MetricsRecord(
             category="component",
             labels={"component": "sink_circuit", "sink": name})
@@ -108,34 +114,37 @@ class SinkCircuitBreaker:
                 return False
             self._expire_stuck_probe()
             if self._state is BreakerState.HALF_OPEN:
-                return self._probe_in_flight
-            return time.monotonic() - self._opened_at < self.cooldown_s
+                out = self._probe_in_flight
+            else:
+                out = time.monotonic() - self._opened_at < self.cooldown_s
+        self._emit()
+        return out
 
     def allow_probe(self) -> bool:
         """True when a send may proceed: always in CLOSED; in OPEN only
         once the cooldown elapsed (transitioning to HALF_OPEN and claiming
         the single probe slot); in HALF_OPEN only if the slot is free."""
+        out = False
         with self._lock:
             if self._state is BreakerState.CLOSED:
                 return True
             self._expire_stuck_probe()
             if self._state is BreakerState.OPEN:
-                if time.monotonic() - self._opened_at < self.cooldown_s:
-                    return False
-                self._state = BreakerState.HALF_OPEN
-                self._state_gauge.set(float(BreakerState.HALF_OPEN))
+                if time.monotonic() - self._opened_at >= self.cooldown_s:
+                    self._state = BreakerState.HALF_OPEN
+                    self._state_gauge.set(float(BreakerState.HALF_OPEN))
+                    self._probe_in_flight = True
+                    self._probe_started = time.monotonic()
+                    self._probes_total.add(1)
+                    self._pending_emits.append(("half_open", ""))
+                    out = True
+            elif not self._probe_in_flight:
                 self._probe_in_flight = True
                 self._probe_started = time.monotonic()
                 self._probes_total.add(1)
-                if trace.is_active():
-                    trace.event("breaker.half_open", sink=self.name)
-                return True
-            if self._probe_in_flight:
-                return False
-            self._probe_in_flight = True
-            self._probe_started = time.monotonic()
-            self._probes_total.add(1)
-            return True
+                out = True
+        self._emit()
+        return out
 
     def note_spilled(self, n: int = 1) -> None:
         self._spilled_total.add(n)
@@ -143,7 +152,6 @@ class SinkCircuitBreaker:
     # -- outcomes ------------------------------------------------------------
 
     def on_success(self) -> None:
-        closed_now = False
         with self._lock:
             self._record(True)
             self._streak = 0
@@ -155,13 +163,8 @@ class SinkCircuitBreaker:
                 self._results.clear()
                 self._state_gauge.set(float(BreakerState.CLOSED))
                 self._reclosed_total.add(1)
-                closed_now = True
-        if closed_now:
-            log.info("sink circuit %s re-closed", self.name)
-            if trace.is_active():
-                trace.event("breaker.close", sink=self.name)
-            if self.on_close is not None:
-                self.on_close()
+                self._pending_emits.append(("close", ""))
+        self._emit()
 
     def on_inconclusive(self) -> None:
         """The send ended without a health signal (payload dropped as
@@ -174,6 +177,7 @@ class SinkCircuitBreaker:
                 self._reopen("probe outcome inconclusive")
             elif self._state is BreakerState.OPEN:
                 self._probe_in_flight = False
+        self._emit()
 
     def on_failure(self) -> None:
         with self._lock:
@@ -181,19 +185,19 @@ class SinkCircuitBreaker:
             self._streak += 1
             if self._state is BreakerState.HALF_OPEN:
                 self._reopen("half-open probe failed")
-                return
-            if self._state is BreakerState.OPEN:
+            elif self._state is BreakerState.OPEN:
                 self._probe_in_flight = False
-                return
-            trip_streak = self._streak >= self.failure_threshold
-            trip_rate = (len(self._results) >= self.min_samples
-                         and (self._results.count(False) / len(self._results)
-                              > self.error_rate))
-            if trip_streak or trip_rate:
-                self._reopen(
-                    f"{self._streak} consecutive failures" if trip_streak
-                    else f"error rate over {self.error_rate:.0%} "
-                         f"in last {len(self._results)} sends")
+            else:
+                trip_streak = self._streak >= self.failure_threshold
+                trip_rate = (len(self._results) >= self.min_samples
+                             and (self._results.count(False)
+                                  / len(self._results) > self.error_rate))
+                if trip_streak or trip_rate:
+                    self._reopen(
+                        f"{self._streak} consecutive failures" if trip_streak
+                        else f"error rate over {self.error_rate:.0%} "
+                             f"in last {len(self._results)} sends")
+        self._emit()
 
     # -- internals (call with lock held) -------------------------------------
 
@@ -215,10 +219,40 @@ class SinkCircuitBreaker:
         self._streak = 0
         self._state_gauge.set(float(BreakerState.OPEN))
         self._opened_total.add(1)
-        if trace.is_active():
-            trace.event("breaker.open", sink=self.name, why=why)
-        log.warning("sink circuit %s opened: %s", self.name, why)
-        AlarmManager.instance().send_alarm(
-            AlarmType.SINK_CIRCUIT_OPEN,
-            f"sink {self.name} circuit opened: {why}; degrading to disk "
-            "buffer", AlarmLevel.ERROR, pipeline=self.pipeline)
+        self._pending_emits.append(("open", why))
+
+    def _emit(self) -> None:
+        """Deliver transition side effects (trace event, flight-recorder
+        entry, alarm, on_close) decided under the lock — outside it."""
+        # unlocked pre-check: transitions are rare, and every send pays
+        # is_open()/allow_probe() — the common no-transition path must not
+        # buy a second lock cycle.  Appends happen only under the lock and
+        # each appender drains via its own _emit, so a stale-empty read
+        # here just defers delivery to the thread that appended.
+        if not self._pending_emits:
+            return
+        with self._lock:
+            if not self._pending_emits:
+                return
+            emits, self._pending_emits = self._pending_emits, []
+        for kind, why in emits:
+            if kind == "open":
+                if trace.is_active():
+                    trace.event("breaker.open", sink=self.name, why=why)
+                flight.record("breaker.open", sink=self.name, why=why)
+                log.warning("sink circuit %s opened: %s", self.name, why)
+                AlarmManager.instance().send_alarm(
+                    AlarmType.SINK_CIRCUIT_OPEN,
+                    f"sink {self.name} circuit opened: {why}; degrading to "
+                    "disk buffer", AlarmLevel.ERROR, pipeline=self.pipeline)
+            elif kind == "half_open":
+                if trace.is_active():
+                    trace.event("breaker.half_open", sink=self.name)
+                flight.record("breaker.half_open", sink=self.name)
+            else:
+                if trace.is_active():
+                    trace.event("breaker.close", sink=self.name)
+                flight.record("breaker.close", sink=self.name)
+                log.info("sink circuit %s re-closed", self.name)
+                if self.on_close is not None:
+                    self.on_close()

@@ -27,6 +27,7 @@ from typing import Callable, List, Optional, Tuple
 from .. import chaos, trace
 from ..chaos import ChaosFault
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from ..prof import flight
 from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..utils.logger import get_logger
 
@@ -108,6 +109,10 @@ class DiskBufferWriter:
                         pipeline=header.get("pipeline", ""),
                         flusher=header.get("flusher_type", ""),
                         nbytes=len(item.data))
+        flight.record("disk_buffer.spill",
+                      pipeline=header.get("pipeline", ""),
+                      flusher=header.get("flusher_type", ""),
+                      nbytes=len(item.data))
         return True
 
     # -- read / replay ------------------------------------------------------
@@ -191,6 +196,10 @@ class DiskBufferWriter:
                             pipeline=header.get("pipeline", ""),
                             flusher=header.get("flusher_type", ""),
                             nbytes=len(payload))
+            flight.record("disk_buffer.replay",
+                          pipeline=header.get("pipeline", ""),
+                          flusher=header.get("flusher_type", ""),
+                          nbytes=len(payload))
         if count:
             log.info("replayed %d buffered payloads", count)
         return count

@@ -22,7 +22,7 @@ from typing import Dict, Optional, Tuple
 
 import heapq
 
-from .. import trace
+from .. import prof, trace
 from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
@@ -43,6 +43,11 @@ MAX_TRY_BEFORE_SPILL = 20  # persistent failure → disk buffer (if configured)
 # exit drain budget could not flush instead of dropping it
 flags.DEFINE_FLAG_BOOL("enable_full_drain_mode",
                        "spill undrained payloads to disk on exit", True)
+
+# observe-only handle for /debug/status (monitor/exposition.py): the live
+# runner's breaker states without constructing anything — the same idiom
+# as runner/processor_runner.py's _active_runner
+_active_runner = None
 
 
 class FlusherRunner:
@@ -83,7 +88,9 @@ class FlusherRunner:
             "sender_queue_wait_seconds")
 
     def init(self) -> None:
+        global _active_runner
         self._running = True
+        _active_runner = self
         self._thread = threading.Thread(target=self._run, name="flusher-runner",
                                         daemon=True)
         self._thread.start()
@@ -117,6 +124,9 @@ class FlusherRunner:
     # -- lifecycle -----------------------------------------------------------
 
     def stop(self, drain: bool = True, timeout: float = 5.0) -> None:
+        global _active_runner
+        if _active_runner is self:
+            _active_runner = None
         if drain:
             deadline = time.monotonic() + timeout
             while not self.sqm.all_empty() and time.monotonic() < deadline:
@@ -164,6 +174,13 @@ class FlusherRunner:
             self._spill_item(item)
 
     def _run(self) -> None:
+        prof.push_marker("worker", "flusher-runner")
+        try:
+            self._run_inner()
+        finally:
+            prof.pop_marker()
+
+    def _run_inner(self) -> None:
         last_probe_replay = 0.0
         while self._running:
             if self._replay_pending.is_set():

@@ -44,11 +44,12 @@ import zlib
 from collections import deque
 from typing import List, Optional, Tuple
 
-from .. import trace
+from .. import prof, trace
 from ..models import EventGroupMetaKey, PipelineEventGroup
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
-from ..ops.device_plane import set_budget_relief
+from ..ops.device_plane import note_host_backlog, set_budget_relief
+from ..prof import flight
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from ..pipeline.queue.process_queue_manager import ProcessQueueManager
 from ..utils import flags
@@ -133,12 +134,18 @@ class WorkerLane:
     accounting (which broke down as soon as more than one worker owned
     in-flight device budget)."""
 
-    __slots__ = ("worker_id", "_lock", "_pending")
+    __slots__ = ("worker_id", "_lock", "_pending", "_t0", "_held_since",
+                 "_held_s")
 
     def __init__(self, worker_id: int):
         self.worker_id = worker_id
         self._lock = threading.Lock()
         self._pending = None
+        # loongprof: overlap accounting — how long this lane held a group
+        # whose device work was in flight, over the lane's lifetime
+        self._t0 = time.perf_counter()
+        self._held_since = 0.0
+        self._held_s = 0.0
 
     def put(self, pending) -> None:
         if pending is None:
@@ -146,15 +153,30 @@ class WorkerLane:
         with self._lock:
             assert self._pending is None, "lane already holds a group"
             self._pending = pending
+            self._held_since = time.perf_counter()
 
     def take(self):
         with self._lock:
             p, self._pending = self._pending, None
+            if p is not None:
+                self._held_s += time.perf_counter() - self._held_since
             return p
 
     def busy(self) -> bool:
         with self._lock:
             return self._pending is not None
+
+    def overlap_ratio(self) -> float:
+        """Fraction of this lane's lifetime spent with device work in
+        flight — near 0 means the worker never overlaps (host-bound or
+        idle), near 1 means the lane is saturated (device-bound)."""
+        now = time.perf_counter()
+        with self._lock:
+            held = self._held_s
+            if self._pending is not None:
+                held += now - self._held_since
+        elapsed = max(now - self._t0, 1e-9)
+        return held / elapsed
 
 
 class _ShardInbox:
@@ -286,6 +308,14 @@ class ProcessorRunner:
         the reference shape has no dispatch hop to observe)."""
         return [len(ib) for ib in self._inboxes]
 
+    def lane_overlap(self) -> List[float]:
+        """Per-lane device-overlap ratio (loongprof utilization): the
+        fraction of each worker's lifetime its lane held in-flight device
+        work.  Uniformly low with a growing
+        ``device_idle_while_backlogged_ms`` counter says "shard more";
+        uniformly high says the device is the bottleneck."""
+        return [lane.overlap_ratio() for lane in self._lanes]
+
     def stop(self) -> None:
         global _active_runner
         if _active_runner is self:
@@ -349,7 +379,9 @@ class ProcessorRunner:
 
     def _route(self, item: Tuple[int, PipelineEventGroup]) -> None:
         key, group = item
-        inbox = self._inboxes[self._shard(key, group)]
+        shard = self._shard(key, group)
+        inbox = self._inboxes[shard]
+        stalled = False
         # a full inbox blocks here — that is the back-pressure hop; the
         # timeout only exists so a wedged worker cannot wedge dispatch
         # (and with it the flush pump) forever.  Known tradeoff: while one
@@ -366,6 +398,13 @@ class ProcessorRunner:
                 # semantics; ordering past this point is best-effort
                 self._process_one(key, group)
                 return
+            if not stalled:
+                # a worker whose full inbox blocked dispatch for a whole
+                # timeout round is stalled — one flight event per episode
+                # (no lock held here: the put timed out and returned)
+                stalled = True
+                flight.record("worker.stall", worker=shard,
+                              depth=len(inbox))
             self._pump_timeout_flush()
 
     # -- workers ------------------------------------------------------------
@@ -389,27 +428,39 @@ class ProcessorRunner:
         directly, no dispatch hop."""
         lane = self._lanes[worker_id]
         set_budget_relief(self._make_relief(lane))
-        while self._running:
-            self._pump_timeout_flush()
-            # while device work is in flight, poll rather than sleep: an
-            # empty queue means the overlap window closes and we complete
-            item = self.pqm.pop_item(timeout=0.0 if lane.busy() else 0.2)
-            if item is None:
+        prof.push_marker("worker", f"processor-{worker_id}")
+        had_item = False
+        try:
+            while self._running:
+                self._pump_timeout_flush()
+                # while device work is in flight, poll rather than sleep: an
+                # empty queue means the overlap window closes and we complete
+                item = self.pqm.pop_item(timeout=0.0 if lane.busy() else 0.2)
+                if item is None:
+                    had_item = False
+                    self._complete_lane(lane)
+                    continue
+                if had_item:
+                    # two consecutive non-empty pops = sustained backlog on
+                    # the single worker: probe the device-idle accounting
+                    # (the sharded loop probes on inbox depth instead)
+                    note_host_backlog()
+                had_item = True
+                nxt = self._dispatch_one(*item, lane=lane)
+                # dispatch-before-complete is the overlap: the device now
+                # holds group N+1 while we materialise + send group N
                 self._complete_lane(lane)
-                continue
-            nxt = self._dispatch_one(*item, lane=lane)
-            # dispatch-before-complete is the overlap: the device now holds
-            # group N+1 while we materialise + send group N on the host
+                lane.put(nxt)
             self._complete_lane(lane)
-            lane.put(nxt)
-        self._complete_lane(lane)
-        # drain remaining items on stop
-        while True:
-            item = self.pqm.pop_item(timeout=0)
-            if item is None:
-                break
-            self._process_one(*item)
-        set_budget_relief(None)
+            # drain remaining items on stop
+            while True:
+                item = self.pqm.pop_item(timeout=0)
+                if item is None:
+                    break
+                self._process_one(*item)
+        finally:
+            prof.pop_marker()
+            set_budget_relief(None)
 
     def _run_worker(self, worker_id: int) -> None:
         """Sharded mode: consume this worker's inbox with the same
@@ -417,18 +468,27 @@ class ProcessorRunner:
         lane = self._lanes[worker_id]
         inbox = self._inboxes[worker_id]
         set_budget_relief(self._make_relief(lane))
-        while True:
-            item = inbox.get(timeout=0.0 if lane.busy() else 0.2)
-            if item is None:
+        prof.push_marker("worker", f"processor-{worker_id}")
+        try:
+            while True:
+                item = inbox.get(timeout=0.0 if lane.busy() else 0.2)
+                if item is None:
+                    self._complete_lane(lane)
+                    if inbox.drained():
+                        break
+                    continue
+                if len(inbox):
+                    # host has backlog at this very moment: charge any
+                    # device-idle gap (utilization accounting — the
+                    # "shard more vs device-bound" counter)
+                    note_host_backlog()
+                nxt = self._dispatch_one(*item, lane=lane)
                 self._complete_lane(lane)
-                if inbox.drained():
-                    break
-                continue
-            nxt = self._dispatch_one(*item, lane=lane)
+                lane.put(nxt)
             self._complete_lane(lane)
-            lane.put(nxt)
-        self._complete_lane(lane)
-        set_budget_relief(None)
+        finally:
+            prof.pop_marker()
+            set_budget_relief(None)
 
     def _dispatch_one(self, key: int, group: PipelineEventGroup,
                       lane: Optional[WorkerLane] = None):
@@ -464,20 +524,25 @@ class ProcessorRunner:
                     attrs={"pipeline": pipeline.name, "events": len(group)})
                 tracer.push_current(sp)
         groups = [group]
+        prof.push_marker("pipeline", pipeline.name or "pipeline")
         try:
-            finish = pipeline.process_begin(groups)
-        except Exception:  # noqa: BLE001
-            log.exception("pipeline %s processing failed", pipeline.name)
-            self._finish_group(sp, t0, "error")
-            return None
-        if finish is None:
-            if lane is not None:
-                # drain the overlapped group BEFORE this inline send: same
-                # worker ⇒ possibly same source; send order = pop order
-                self._complete_lane(lane)
-            self._send(pipeline, groups)
-            self._finish_group(sp, t0, "ok")
-            return None
+            try:
+                finish = pipeline.process_begin(groups)
+            except Exception:  # noqa: BLE001
+                log.exception("pipeline %s processing failed", pipeline.name)
+                self._finish_group(sp, t0, "error")
+                return None
+            if finish is None:
+                if lane is not None:
+                    # drain the overlapped group BEFORE this inline send:
+                    # same worker ⇒ possibly same source; send order = pop
+                    # order
+                    self._complete_lane(lane)
+                self._send(pipeline, groups)
+                self._finish_group(sp, t0, "ok")
+                return None
+        finally:
+            prof.pop_marker()
         # the group's device work stays in flight: detach its span from
         # this thread so the NEXT group's dispatch does not nest under it
         if sp is not None:
@@ -504,14 +569,18 @@ class ProcessorRunner:
             # re-attach: device materialisation + downstream processors +
             # send events belong to this group's span
             tracer.push_current(sp)
+        prof.push_marker("pipeline", pipeline.name or "pipeline")
         try:
-            finish()
-        except Exception:  # noqa: BLE001
-            log.exception("pipeline %s processing failed", pipeline.name)
-            self._finish_group(sp, t0, "error")
-            return
-        self._send(pipeline, groups)
-        self._finish_group(sp, t0, "ok")
+            try:
+                finish()
+            except Exception:  # noqa: BLE001
+                log.exception("pipeline %s processing failed", pipeline.name)
+                self._finish_group(sp, t0, "error")
+                return
+            self._send(pipeline, groups)
+            self._finish_group(sp, t0, "ok")
+        finally:
+            prof.pop_marker()
 
     def _send(self, pipeline, groups) -> None:
         try:

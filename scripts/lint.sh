@@ -21,6 +21,11 @@ python -m loongcollector_tpu.analysis "$@"
 echo "== tracing-overhead smoke =="
 JAX_PLATFORMS=cpu python scripts/trace_overhead.py
 
+echo "== profiler-overhead smoke (loongprof) =="
+# with LOONG_PROF off the marker hooks must stay one branch per hook —
+# same disabled-vs-noop-baseline >5% paired-min gate as the trace smoke
+JAX_PLATFORMS=cpu python scripts/prof_overhead.py
+
 echo "== multi-worker smoke (loongshard) =="
 # the disabled-trace overhead gate and the metric-naming checker must hold
 # with the sharded plane active (LOONG_PROCESS_THREADS=4): the overhead

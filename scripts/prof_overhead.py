@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""loongprof overhead smoke gate (wired into scripts/lint.sh).
+
+The loongprof contract (docs/observability.md) mirrors loongtrace's: with
+``LOONG_PROF`` off, every hook — ``prof.is_active``, ``prof.push_marker``,
+``prof.pop_marker`` — is one module-global read + branch.  Same two-layer
+proof as scripts/trace_overhead.py, same paired-min method:
+
+1. **Per-hook microbench** — ns/call of the disabled hooks under a
+   generous absolute ceiling (a disabled path that allocates or locks
+   blows through it immediately).
+
+2. **10k-event synthetic pipeline** — the marker-instrumented path
+   (ProcessorInstance split stage + SLS serialization) timed with hooks
+   as shipped (profiler disabled) vs the same hooks monkeypatched to
+   bare no-ops, interleaved paired rounds; the gate is the MINIMUM
+   paired disabled/baseline ratio (>5% in EVERY round fails).  The
+   profiler-enabled time is reported informationally — enabling MAY
+   cost, disabling MUST NOT.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+N_EVENTS = 10_000
+REPEATS = 9
+MAX_DISABLED_OVER_BASELINE = 1.05      # the 5% gate
+MAX_HOOK_NS = 2_000                    # catastrophic-regression ceiling
+
+
+def bench_hooks():
+    from loongcollector_tpu import prof
+    prof.disable()
+    out = {}
+    for label, fn in (("is_active", prof.is_active),
+                      ("push_marker", lambda: prof.push_marker("p", "x")),
+                      ("pop_marker", prof.pop_marker)):
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / n)
+        out[label] = best * 1e9
+    return out
+
+
+def make_runner():
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.pipeline.plugin.instance import ProcessorInstance
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+    inst = ProcessorInstance(ProcessorSplitLogString(), "split/prof_overhead")
+    assert inst.init({}, PluginContext("prof_overhead"))
+    ser = SLSEventGroupSerializer()
+    line = b"2024-01-02 03:04:05 INFO request handled ok\n"
+    data = line * N_EVENTS
+
+    def run_timed():
+        sb = SourceBuffer(len(data) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+        t0 = time.perf_counter()
+        inst.process([g])
+        ser.serialize([g])
+        dt = time.perf_counter() - t0
+        assert len(g) == N_EVENTS
+        return dt
+
+    return inst, run_timed
+
+
+def main() -> int:
+    from loongcollector_tpu import prof
+    hooks = bench_hooks()
+    print("disabled hook cost (ns/call): "
+          + ", ".join(f"{k}={v:.0f}" for k, v in hooks.items()))
+    bad = {k: v for k, v in hooks.items() if v > MAX_HOOK_NS}
+    if bad:
+        print(f"FAIL: disabled hooks over {MAX_HOOK_NS} ns: {bad}")
+        return 1
+
+    import gc
+    inst, run_timed = make_runner()
+    noop_active = lambda: False                       # noqa: E731
+    noop_none = lambda *a, **k: None                  # noqa: E731
+    real = (prof.is_active, prof.push_marker, prof.pop_marker,
+            prof.active_profiler)
+
+    def set_baseline():
+        prof.disable()
+        prof.is_active = noop_active
+        prof.push_marker = noop_none
+        prof.pop_marker = noop_none
+        prof.active_profiler = noop_none
+
+    def set_disabled():
+        (prof.is_active, prof.push_marker, prof.pop_marker,
+         prof.active_profiler) = real
+        prof.disable()
+
+    def set_enabled():
+        (prof.is_active, prof.push_marker, prof.pop_marker,
+         prof.active_profiler) = real
+        # sampler runs for real — the enabled number includes the
+        # sampling thread stealing cycles, as production would
+        prof.enable(hz=97)
+
+    # Paired rounds, min ratio across rounds: a REAL disabled-path
+    # regression is systematic and survives every pairing; co-tenant CPU
+    # steal on a shared core does not (see scripts/trace_overhead.py).
+    dis_ratios, en_ratios = [], []
+    try:
+        run_timed()                                   # warm the path
+        for i in range(REPEATS):
+            pair = [("baseline", set_baseline), ("disabled", set_disabled)]
+            if i % 2:                                 # kill position bias
+                pair.reverse()
+            times = {}
+            for name, setup in pair + [("enabled", set_enabled)]:
+                setup()
+                gc.collect()
+                times[name] = run_timed()
+                prof.disable()
+            dis_ratios.append(times["disabled"] / times["baseline"])
+            en_ratios.append(times["enabled"] / times["baseline"])
+    finally:
+        (prof.is_active, prof.push_marker, prof.pop_marker,
+         prof.active_profiler) = real
+        prof.disable()
+        inst.metrics.mark_deleted()
+
+    ratio = min(dis_ratios)
+    print(f"{N_EVENTS}-event synthetic pipeline, {REPEATS} paired rounds: "
+          f"disabled/baseline min={ratio:.3f} "
+          f"median={sorted(dis_ratios)[len(dis_ratios) // 2]:.3f}  "
+          f"enabled/baseline min={min(en_ratios):.3f}")
+    if ratio > MAX_DISABLED_OVER_BASELINE:
+        print(f"FAIL: disabled-path overhead {(ratio - 1) * 100:.1f}% "
+              f"> {(MAX_DISABLED_OVER_BASELINE - 1) * 100:.0f}% in every "
+              "round — the disabled profiler must stay one branch per hook")
+        return 1
+    print("prof overhead OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -295,6 +295,65 @@ class TestBlockingUnderLock:
         findings = scan(src, BlockingUnderLockChecker())
         assert checks_of(findings) == {"blocking-under-lock"}
 
+    def test_flight_record_under_lock_flagged(self):
+        # loongprof rule: the flight recorder must never be called with a
+        # lock held — transition sites buffer and emit after release
+        # (runner/circuit.py _emit)
+        src = """
+        import threading
+        from loongcollector_tpu.prof import flight
+        class Breaker:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def trip(self):
+                with self._lock:
+                    flight.record("breaker.open", sink=self.name)
+        """
+        findings = scan(src, BlockingUnderLockChecker())
+        assert checks_of(findings) == {"blocking-under-lock"}
+        assert "flight-recorder" in findings[0].message
+
+    def test_flight_recorder_attribute_under_lock_flagged(self):
+        src = """
+        import threading
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def note(self):
+                with self._lock:
+                    self._recorder.record("ev", n=1)
+        """
+        findings = scan(src, BlockingUnderLockChecker())
+        assert checks_of(findings) == {"blocking-under-lock"}
+
+    def test_flight_record_outside_lock_is_clean(self):
+        src = """
+        import threading
+        from loongcollector_tpu.prof import flight
+        class Breaker:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def trip(self):
+                with self._lock:
+                    self._state = 1
+                flight.record("breaker.open", sink=self.name)
+        """
+        assert scan(src, BlockingUnderLockChecker()) == []
+
+    def test_unrelated_record_receiver_is_clean(self):
+        # `.record()` on a non-flight receiver (a metrics store, a WAL)
+        # is not the flight recorder — precision matters
+        src = """
+        import threading
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def add(self):
+                with self._lock:
+                    self.journal.record("row")
+        """
+        assert scan(src, BlockingUnderLockChecker()) == []
+
     def test_lock_ordering_cycle_detected(self):
         src = """
         import threading
