@@ -472,6 +472,20 @@ def _collect_utilization(pqm, p, bh, runner, n_groups=24, window_s=8.0):
         "lane_overlap_ratio": (round(sum(overlaps) / len(overlaps), 4)
                                if overlaps else 0.0),
     }
+    try:
+        # loongstream: padding waste + the width auto-tuner's decisions —
+        # what the batch geometry cost this run, not just how fast it was
+        from loongcollector_tpu.ops import device_stream as _ds
+        ring_totals = _ds.batch_ring().totals()
+        util["batch_padding"] = {
+            "packs": ring_totals["packs"],
+            "real_rows": ring_totals["real_rows"],
+            "padded_rows": ring_totals["padded_rows"],
+            "padding_fraction": round(ring_totals["padding_fraction"], 4),
+        }
+        util["stream_tuner"] = _ds.auto_tuner().chosen()
+    except Exception:  # noqa: BLE001
+        pass
     plane = DevicePlane._instance      # observe-only: never construct
     if plane is not None:
         u = plane.utilization()
@@ -609,6 +623,97 @@ def _native_parallel_ceiling():
     return round(sum(duo) / solo[0], 2)
 
 
+def bench_streaming(n_chunks=24):
+    """loongstream (ISSUE 6): pipeline-depth sweep of the streaming device
+    dispatch against a latency-injected concurrency-1 device model — a
+    5 ms round trip split 2.25 ms wire each way + 0.5 ms serialized
+    execution (the tunneled-TPU profile: latency-dominated, execution
+    fast).  Depth 1 is the old submit→materialise round trip; depth 3 is
+    the shipping default.  Also records ring occupancy/reuse, the
+    auto-tuner's chosen geometries and the post-sweep
+    device_idle_while_backlogged_ms."""
+    from loongcollector_tpu.ops import device_stream as ds
+    from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                     LatencyInjectedKernel)
+    from loongcollector_tpu.ops.regex import engine as engine_mod
+    from loongcollector_tpu.ops.regex.engine import RegexEngine
+
+    ds.reset_for_testing()
+    old_max = engine_mod.MAX_BATCH
+    old_env = os.environ.get("LOONG_NATIVE_T1")
+    os.environ["LOONG_NATIVE_T1"] = "0"     # force the device tier
+    engine_mod.MAX_BATCH = 256              # many chunks per parse
+    try:
+        plane = DevicePlane.reset_for_testing(budget_bytes=1 << 26)
+        eng = RegexEngine(r"(\w+) (\d+)")
+        kern = LatencyInjectedKernel(eng._segment_kernel, rtt_s=0.0005,
+                                     serialize=True, wire_s=0.00225)
+        eng.set_device_kernel_override(kern)
+        line = b"abc 12345"
+        n = 256 * n_chunks
+        arena = np.frombuffer(line * n, dtype=np.uint8).copy()
+        offsets = np.arange(n, dtype=np.int64) * len(line)
+        lengths = np.full(n, len(line), dtype=np.int32)
+        total = len(arena)
+        eng.parse_batch(arena[:72], offsets[:8], lengths[:8])   # compile
+
+        # best-of-3 per depth, INTERLEAVED rounds: a co-tenant steal burst
+        # on the shared core inflates one round of every depth instead of
+        # sinking one depth's whole block
+        best = {}
+        results = {}
+        for _round in range(3):
+            for depth in (1, 2, 3):
+                t0 = time.perf_counter()
+                res = eng.parse_batch_async(arena, offsets, lengths,
+                                            depth=depth).result()
+                dt = time.perf_counter() - t0
+                if depth not in best or dt < best[depth]:
+                    best[depth] = dt
+                    results[depth] = res
+        sweep = {f"depth_{d}": {
+            "ms": round(t * 1e3, 1),
+            "MBps": round(total / t / 1e6, 1),
+        } for d, t in sorted(best.items())}
+        identical = all(
+            np.array_equal(results[1].ok, results[d].ok)
+            and np.array_equal(results[1].cap_off, results[d].cap_off)
+            and np.array_equal(results[1].cap_len, results[d].cap_len)
+            for d in (2, 3))
+        ring = ds.batch_ring()
+        stats = ring.stats()
+        reuses = sum(s["slot_reuses"] for s in stats.values())
+        allocs = sum(s["slot_allocs"] for s in stats.values())
+        out = {
+            "model": {"rtt_ms": 5.0, "wire_ms_each_way": 2.25,
+                      "exec_ms": 0.5, "concurrency": 1,
+                      "chunks": n_chunks, "rows_per_chunk": 256},
+            "depth_sweep": sweep,
+            "overlap_x_depth3": round(
+                sweep["depth_1"]["ms"] / sweep["depth_3"]["ms"], 2),
+            "byte_identical_across_depths": identical,
+            "ring": {
+                "leased_after": ring.leased_total(),
+                "pooled": ring.pooled_total(),
+                "slot_allocs": allocs,
+                "slot_reuses": reuses,
+                "reuse_fraction": round(reuses / max(allocs + reuses, 1), 3),
+            },
+            "tuner": ds.auto_tuner().chosen(),
+            "device_idle_while_backlogged_ms_after": round(
+                plane.utilization()["idle_while_backlogged_ms"], 1),
+        }
+        return out
+    finally:
+        engine_mod.MAX_BATCH = old_max
+        if old_env is None:
+            os.environ.pop("LOONG_NATIVE_T1", None)
+        else:
+            os.environ["LOONG_NATIVE_T1"] = old_env
+        DevicePlane.reset_for_testing()
+        ds.reset_for_testing()
+
+
 def bench_resource():
     """CPU% / RSS at 10 MB/s, the reference's regression-harness metric
     (BASELINE.md: 3.4 % CPU / 29 MB simple, 14.2 % / 34 MB regex).  Runs
@@ -704,6 +809,12 @@ def main():
     scaling = _safe(bench_scaling, default=None)
     if scaling is not None:
         extra["scaling"] = scaling
+    # loongstream: runs LAST among the pipeline benches so its latency-
+    # injected plane/tuner state never leaks into the headline numbers
+    # (bench_streaming resets both on exit)
+    streaming = _safe(bench_streaming, default=None)
+    if streaming is not None:
+        extra["streaming"] = streaming
     from loongcollector_tpu.runner.processor_runner import \
         resolve_thread_count
     extra["process_threads"] = resolve_thread_count()

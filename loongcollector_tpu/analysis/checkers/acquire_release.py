@@ -22,6 +22,12 @@ Acquire APIs (attr call + receiver filter, to stay quiet on unrelated
   .submit(...)    when the receiver mentions a device plane, or the call
                   passes the plane-protocol kwargs (nbytes / on_wait)
   ._acquire(...)  the raw budget primitive, same escape rules
+  .lease(...)     loongstream batch-ring slots (receiver mentions a ring):
+                  a leased BatchSlot escaping the statement must be
+                  releasable on every path, exactly like plane budget — a
+                  mid-loop pack/submit exception that strands leased slots
+                  starves the ring's pools and breaks the storm
+                  conservation invariant (ring.leased_total() == 0)
 """
 
 from __future__ import annotations
@@ -44,6 +50,9 @@ def _is_acquire_call(node: ast.Call) -> bool:
     tail = attr_tail(node)
     if tail == "_acquire":
         return True
+    if tail == "lease":
+        # ring-slot leases: `ring.lease(B, L)` / `batch_ring().lease(...)`
+        return "ring" in receiver_repr(node).lower()
     if tail != "submit":
         return False
     recv = receiver_repr(node).lower()
@@ -131,10 +140,14 @@ class AcquireReleaseChecker(Checker):
                     continue
                 if _guarding_try(parents, node, func):
                     continue
+                what = ("ring slot leased" if tail == "lease"
+                        else "budget acquired")
+                stranded = ("the leased ring slot"
+                            if tail == "lease" else "the in-flight budget")
                 yield Finding(
                     CHECK, mod.relpath, node.lineno, node.col_offset,
-                    f"budget acquired via .{tail}() {reason} with no "
+                    f"{what} via .{tail}() {reason} with no "
                     "enclosing try/finally or except-drain: an exception "
-                    "here strands the in-flight budget (the "
+                    f"here strands {stranded} (the "
                     "PendingParse.dispatch leak shape)",
                     symbol=qualname)

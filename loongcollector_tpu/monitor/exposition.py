@@ -201,6 +201,22 @@ def collect_status() -> dict:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongstream: ring occupancy, per-geometry padding waste, and the
+        # width auto-tuner's chosen floors/deadline — the streaming plane's
+        # "why is the device starving / what is padding costing" page
+        from ..ops import device_stream as _ds
+        ring = _ds._ring          # observe-only: never construct
+        if ring is not None:
+            tuner = _ds._tuner
+            doc["streaming"] = {
+                "depth": _ds.stream_depth(),
+                "ring": ring.totals(),
+                "geometries": ring.stats(),
+                "tuner": tuner.chosen() if tuner is not None else None,
+            }
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..prof import flight as _flight
         rec = _flight.recorder()
         doc["flight"] = {"events": len(rec),

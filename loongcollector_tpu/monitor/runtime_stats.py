@@ -59,6 +59,25 @@ def refresh() -> None:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongstream: batch-ring occupancy + padding waste + the auto-
+        # tuner's live decisions, next to the plane budget they feed
+        # (observe-only: a pipeline that never streamed exports nothing)
+        from ..ops import device_stream as _ds
+        ring = _ds._ring
+        if ring is not None:
+            totals = ring.totals()
+            _plane_rec.gauge("ring_slots_leased").set(totals["leased"])
+            _plane_rec.gauge("ring_slots_pooled").set(totals["pooled"])
+            _plane_rec.gauge("batch_padding_fraction_lifetime").set(
+                totals["padding_fraction"])
+            _plane_rec.gauge("stream_depth").set(_ds.stream_depth())
+        tuner = _ds._tuner
+        if tuner is not None:
+            _plane_rec.gauge("stream_flush_deadline_ms").set(
+                tuner.flush_deadline_s() * 1000.0)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         # psum'd mesh telemetry from the most recent sharded dispatch; the
         # int() materialisation happens HERE (monitor cadence), never on
         # the dispatch hot path

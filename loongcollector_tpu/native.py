@@ -162,7 +162,8 @@ def split_lines(seg: np.ndarray, sep: int, base_offset: int
 
 
 def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
-              L: int, B: int) -> Optional[np.ndarray]:
+              L: int, B: int,
+              out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
     lib = get_lib()
     if lib is None:
         return None
@@ -170,7 +171,15 @@ def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     lengths = np.ascontiguousarray(lengths, dtype=np.int32)
     n = len(offsets)
-    rows = np.zeros((B, L), dtype=np.uint8)
+    if out is not None:
+        # batch-ring reuse: the C packer fully writes rows [0, n) (memcpy +
+        # tail memset) but never touches the padding rows [n, B), which may
+        # hold a previous generation's bytes — re-zero only those
+        rows = out
+        if n < B:
+            rows[n:].fill(0)
+    else:
+        rows = np.zeros((B, L), dtype=np.uint8)
     lib.lct_pack_rows(_u8(arena), len(arena), _i64(offsets), _i32(lengths),
                       n, L, _u8(rows))
     return rows

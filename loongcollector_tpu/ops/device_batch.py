@@ -33,10 +33,13 @@ def pick_length_bucket(max_len: int) -> Optional[int]:
     return None  # overlong → CPU fallback
 
 
-def pad_batch(n: int) -> int:
+def pad_batch(n: int, min_batch: Optional[int] = None) -> int:
     """Power-of-two batch size ≥ n, capped at MAX_BATCH (callers must chunk
-    inputs larger than MAX_BATCH)."""
-    b = MIN_BATCH
+    inputs larger than MAX_BATCH).  ``min_batch`` lowers the floor below
+    the static MIN_BATCH — the width auto-tuner
+    (ops/device_stream.WidthAutoTuner) passes its per-length-bucket floor
+    here so sparse traffic stops paying 256-row tensors for 8 real rows."""
+    b = min_batch if min_batch else MIN_BATCH
     while b < n:
         b *= 2
     return min(b, MAX_BATCH)
@@ -53,11 +56,18 @@ class DeviceBatch:
 
 
 def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
-              L: int, B: Optional[int] = None) -> DeviceBatch:
+              L: int, B: Optional[int] = None,
+              out: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+              ) -> DeviceBatch:
     """Gather per-event byte rows out of the flat arena.
 
     arena: uint8 [N]; offsets/lengths: int32 [n].  Events longer than L must
     be filtered out by the caller beforehand.
+
+    ``out=(rows, lengths, origins)`` packs into pre-allocated [B, L]/[B]
+    buffers instead of allocating — the streaming batch-ring path
+    (ops/device_stream.BatchSlot) reuses the same host pages every
+    generation, so the H2D staging side never churns the allocator.
     """
     n = len(offsets)
     if B is None:
@@ -65,20 +75,37 @@ def pack_rows(arena: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
     assert n <= B
     offsets = np.asarray(offsets, dtype=np.int64)
     lengths32 = np.asarray(lengths, dtype=np.int32)
+    out_rows = None
+    if out is not None:
+        out_rows, out_lengths, out_origins = out
+        assert out_rows.shape == (B, L), (out_rows.shape, B, L)
 
     from ..native import pack_rows as native_pack
-    rows = native_pack(arena, offsets, lengths32, L, B)
+    rows = native_pack(arena, offsets, lengths32, L, B, out=out_rows)
     if rows is None:
         # numpy fallback: index matrix [n, L], clipped so OOB reads land on
         # a valid byte, then tail-zeroed for deterministic padding
         idx = offsets[:, None] + np.arange(L, dtype=np.int64)[None, :]
         np.clip(idx, 0, len(arena) - 1 if len(arena) else 0, out=idx)
-        rows = arena[idx] if len(arena) else np.zeros((n, L), np.uint8)
+        body = arena[idx] if len(arena) else np.zeros((n, L), np.uint8)
         mask = np.arange(L, dtype=np.int32)[None, :] < lengths32[:, None]
-        rows &= mask.astype(np.uint8) * np.uint8(255)
-        if B > n:
-            rows = np.concatenate([rows, np.zeros((B - n, L), np.uint8)],
+        body &= mask.astype(np.uint8) * np.uint8(255)
+        if out_rows is not None:
+            rows = out_rows
+            rows[:n] = body
+            rows[n:] = 0
+        elif B > n:
+            rows = np.concatenate([body, np.zeros((B - n, L), np.uint8)],
                                   axis=0)
+        else:
+            rows = body
+    if out is not None:
+        out_lengths[:n] = lengths32
+        out_lengths[n:] = 0
+        out_origins[:n] = offsets.astype(np.int32)
+        out_origins[n:] = 0
+        return DeviceBatch(rows=rows, lengths=out_lengths,
+                           origins=out_origins, n_real=n)
     if B > n:
         lengths32 = np.concatenate([lengths32, np.zeros(B - n, np.int32)])
         origins = np.concatenate(

@@ -389,6 +389,14 @@ class DevicePlane:
 
     # -- dispatch -----------------------------------------------------------
 
+    def open_stream(self, depth: Optional[int] = None):
+        """A pipelined dispatch window over this plane (loongstream): up to
+        ``depth`` batches in flight, strict submit-order results, ring
+        advance on overflow — the streaming replacement for the
+        submit→materialise round trip.  See ops/device_stream.DeviceStream."""
+        from .device_stream import DeviceStream
+        return DeviceStream(self, depth)
+
     def submit(self, kernel: Callable, args: Sequence, nbytes: int,
                should_abort: Optional[Callable[[], bool]] = None,
                on_wait: Optional[Callable[[], bool]] = None
@@ -465,14 +473,26 @@ class LatencyInjectedArray:
 class LatencyInjectedKernel:
     """Wraps a synchronous kernel so that dispatch returns instantly and
     materialisation blocks for `rtt_s` — an honest model of a (possibly
-    tunneled) accelerator.  `concurrency=1` models a device that executes
-    one dispatch at a time: each call's deadline starts after the previous
-    call's, exactly like a device execution stream."""
+    tunneled) accelerator.  `serialize=True` (concurrency 1) models a
+    device that executes one dispatch at a time: each call's execution
+    starts after the previous call's, exactly like a device execution
+    stream.
 
-    def __init__(self, inner: Callable, rtt_s: float, serialize: bool = True):
+    ``wire_s`` splits a tunneled round trip into its pipelinable part:
+    each dispatch pays one-way wire latency BEFORE execution can start
+    (H2D) and the host pays it again before results are visible (D2H), so
+    a synchronous round trip costs ``2*wire_s + rtt_s`` while a pipelined
+    dispatcher overlaps the wire legs of neighbouring batches and is
+    bounded only by the serialized execution stream (``rtt_s`` per batch).
+    This is what the loongstream depth sweep measures.  wire_s=0 keeps the
+    original single-latency behaviour."""
+
+    def __init__(self, inner: Callable, rtt_s: float, serialize: bool = True,
+                 wire_s: float = 0.0):
         self.inner = inner
         self.rtt_s = rtt_s
         self.serialize = serialize
+        self.wire_s = wire_s
         self._stream_free_at = 0.0
         self._lock = threading.Lock()
         self.calls = 0
@@ -485,11 +505,14 @@ class LatencyInjectedKernel:
         with self._lock:
             self.calls += 1
             if self.serialize:
-                start = max(now, self._stream_free_at)
-                deadline = start + self.rtt_s
-                self._stream_free_at = deadline
+                # execution may start once the batch has crossed the wire
+                # AND the single execution stream is free
+                start = max(now + self.wire_s, self._stream_free_at)
+                exec_done = start + self.rtt_s
+                self._stream_free_at = exec_done
             else:
-                deadline = now + self.rtt_s
+                exec_done = now + self.wire_s + self.rtt_s
+            deadline = exec_done + self.wire_s   # results cross back
         return tuple(LatencyInjectedArray(np.asarray(o), deadline)
                      for o in outs)
 

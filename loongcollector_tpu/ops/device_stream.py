@@ -1,0 +1,585 @@
+"""loongstream: the streaming device pipeline (batch rings + auto-tuner).
+
+`BENCH_TPU_LAST_GOOD.json` shows the kernel parsing at 128 GB/s while the
+pipeline moves 2 MB/s end-to-end: the device sits idle on batch assembly,
+H2D/D2H transfer and synchronous round-trips (exactly what loongprof's
+``device_idle_while_backlogged_ms`` measures).  This module closes that gap
+on the host side of the dispatch:
+
+* **BatchRing / BatchSlot** — a persistent ring of pre-allocated
+  fixed-geometry batch buffers per ``(B, L)`` geometry.  Packing reuses the
+  slot's arrays instead of allocating per dispatch (no allocator churn, no
+  fresh page faults on the H2D path), and every pack records padding waste
+  (padded-vs-real rows and bytes) per geometry, observable in
+  /debug/status, the Prometheus exposition and ``bench.py``
+  ``extra.utilization``.  Slots are leased and MUST be released exactly
+  once — the loonglint acquire-release checker enforces the pairing the
+  same way it does for device-budget futures.
+
+* **DeviceStream** — the pipelined dispatch window (ParPaRaw's feeding
+  discipline): up to ``depth`` batches stay in flight; submitting into a
+  full window first materialises the OLDEST batch (the ring advance), so
+  the host packs/H2Ds batch N+1 while the device computes N and batch
+  N-depth+1 returns spans.  Results complete strictly in submit order; a
+  fault mid-ring errors only that batch's entry, releases its slot and
+  budget, and never stalls or reorders the ring.
+
+* **WidthAutoTuner** — replaces the static ``MIN_BATCH``/``pad_batch``
+  policy with runtime-chosen B floors per length bucket (driven by the
+  measured padding fraction) and a flush deadline for the worker lane
+  rings (driven by the device-utilization accounting: when
+  ``device_idle_while_backlogged_ms`` grows, batches ride the ring longer
+  to buy overlap; when the device keeps up, the deadline shrinks back for
+  latency).
+
+Chaos fault points ``device_plane.h2d`` (pack/transfer stage — wrap the
+kernel with :func:`h2d_gated`) and ``device_plane.ring_advance``
+(materialise stage) make the async stages stormable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import chaos
+from .device_batch import MIN_BATCH, pack_rows
+
+FP_RING_ADVANCE = chaos.register_point("device_plane.ring_advance")
+FP_H2D = chaos.register_point("device_plane.h2d")
+
+ENV_DEPTH = "LOONG_STREAM_DEPTH"
+ENV_TUNER = "LOONG_STREAM_TUNER"
+
+DEFAULT_DEPTH = 3
+MAX_DEPTH = 8
+
+#: the tuner never shrinks a geometry floor below this (a 32-row dispatch
+#: still amortises its fixed cost ~32x over a single-row call)
+MIN_TUNED_FLOOR = 32
+
+
+def stream_depth(env=os.environ) -> int:
+    """Pipeline depth: how many batches one dispatch loop keeps in flight
+    (pack N+1 / compute N / span-return N-1 needs 3).  ``LOONG_STREAM_DEPTH``
+    overrides; clamped to [1, 8] — 1 degenerates to the synchronous
+    submit→materialise round-trip (the bench sweep's baseline)."""
+    raw = env.get(ENV_DEPTH)
+    if raw:
+        try:
+            return max(1, min(int(raw), MAX_DEPTH))
+        except ValueError:
+            pass
+    return DEFAULT_DEPTH
+
+
+def tuner_enabled(env=os.environ) -> bool:
+    return env.get(ENV_TUNER) != "0"
+
+
+def h2d_gated(kernel):
+    """Wrap a kernel so the dispatch-side pack/H2D stage is a chaos fault
+    point: an injected ERROR raises inside the DevicePlane.submit try —
+    exactly a kernel failing at dispatch — so only THAT batch's future
+    errors (budget released at its consume point) and the ring keeps
+    moving.  A DELAY models a slow transfer.  Disabled plane: one global
+    read per dispatch."""
+    def _gated(*args):
+        chaos.faultpoint(FP_H2D)
+        return kernel(*args)
+    return _gated
+
+
+# ---------------------------------------------------------------------------
+# padding-waste accounting
+
+
+_pad_hist = None
+
+
+def padding_fraction_histogram():
+    """Per-pack fraction of the device tensor that is padding (rows beyond
+    n_real plus the zero tail of every real row): a distribution living
+    near 1.0 means the geometry floor, not the data, sizes the dispatch —
+    the signal the width auto-tuner acts on."""
+    global _pad_hist
+    if _pad_hist is None:
+        from ..monitor.metrics import shared_histogram
+        _pad_hist = shared_histogram("device_batch_padding_fraction",
+                                     labels={"component": "device_stream"})
+    return _pad_hist
+
+
+_geom_records: Dict[Tuple[int, int], object] = {}
+_geom_records_lock = threading.Lock()
+
+
+def _geometry_record(B: int, L: int):
+    rec = _geom_records.get((B, L))
+    if rec is None:
+        with _geom_records_lock:
+            rec = _geom_records.get((B, L))
+            if rec is None:
+                from ..monitor.metrics import MetricsRecord
+                rec = MetricsRecord(
+                    category="device_plane",
+                    labels={"component": "batch_ring",
+                            "geometry": f"{B}x{L}"})
+                _geom_records[(B, L)] = rec
+    return rec
+
+
+class _GeometryStats:
+    __slots__ = ("packs", "real_rows", "padded_rows", "real_bytes",
+                 "padded_bytes", "slot_allocs", "slot_reuses")
+
+    def __init__(self) -> None:
+        self.packs = 0
+        self.real_rows = 0
+        self.padded_rows = 0
+        self.real_bytes = 0
+        self.padded_bytes = 0
+        self.slot_allocs = 0
+        self.slot_reuses = 0
+
+    def as_dict(self) -> dict:
+        total = self.real_bytes + self.padded_bytes
+        return {
+            "packs": self.packs,
+            "real_rows": self.real_rows,
+            "padded_rows": self.padded_rows,
+            "real_bytes": self.real_bytes,
+            "padded_bytes": self.padded_bytes,
+            "padding_fraction": (self.padded_bytes / total) if total else 0.0,
+            "slot_allocs": self.slot_allocs,
+            "slot_reuses": self.slot_reuses,
+        }
+
+
+# ---------------------------------------------------------------------------
+# batch ring
+
+
+class BatchSlot:
+    """One pre-allocated fixed-geometry batch buffer, leased from the ring.
+
+    ``pack()`` fills the slot's arrays from the arena (zero-copy reuse of
+    the same host pages every generation) and returns the DeviceBatch view;
+    ``release()`` returns the slot to its pool — exactly once, after the
+    dispatch that used it has materialised (the kernel may alias the
+    buffers until then)."""
+
+    __slots__ = ("_ring", "B", "L", "rows", "lengths", "origins", "_leased")
+
+    def __init__(self, ring: "BatchRing", B: int, L: int):
+        self._ring = ring
+        self.B = B
+        self.L = L
+        self.rows = np.zeros((B, L), dtype=np.uint8)
+        self.lengths = np.zeros(B, dtype=np.int32)
+        self.origins = np.zeros(B, dtype=np.int32)
+        self._leased = False
+
+    def pack(self, arena: np.ndarray, offsets: np.ndarray,
+             lengths: np.ndarray):
+        """Pack rows into this slot's buffers; records padding waste and
+        feeds the auto-tuner."""
+        batch = pack_rows(arena, offsets, lengths, self.L, self.B,
+                          out=(self.rows, self.lengths, self.origins))
+        self._ring.record_pack(self.B, self.L, batch.n_real,
+                               int(np.asarray(lengths, np.int64).sum()))
+        return batch
+
+    def release(self) -> None:
+        if not self._leased:
+            return
+        self._leased = False
+        self._ring._return(self)
+
+    def __del__(self):
+        # ledger backstop: a leased slot dropped without release() belongs
+        # to an abandoned dispatch (the DeviceFuture finaliser already
+        # warns about that path) — keep the lease count truthful so the
+        # storm conservation assertions measure real leaks, not GC noise
+        try:
+            if self._leased:
+                self._leased = False
+                self._ring._forget()
+        except Exception:  # noqa: BLE001 — never raise from a finaliser
+            pass
+
+
+class BatchRing:
+    """Geometry-keyed pools of reusable BatchSlots plus the padding-waste
+    ledger.  ``lease()`` never blocks: past the per-geometry pool cap it
+    hands out a transient slot (dropped on release) — back-pressure is the
+    DevicePlane byte budget's job, the ring only recycles memory."""
+
+    def __init__(self, slots_per_geometry: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._pools: Dict[Tuple[int, int], List[BatchSlot]] = {}
+        self._stats: Dict[Tuple[int, int], _GeometryStats] = {}
+        self._leased = 0
+        self._slots_per_geometry = slots_per_geometry
+
+    def _cap(self) -> int:
+        if self._slots_per_geometry is not None:
+            return self._slots_per_geometry
+        return stream_depth() + 2
+
+    def lease(self, B: int, L: int) -> BatchSlot:
+        with self._lock:
+            pool = self._pools.get((B, L))
+            slot = pool.pop() if pool else None
+            self._leased += 1
+            st = self._stats.setdefault((B, L), _GeometryStats())
+            if slot is None:
+                st.slot_allocs += 1
+            else:
+                st.slot_reuses += 1
+        if slot is None:
+            slot = BatchSlot(self, B, L)
+        slot._leased = True
+        return slot
+
+    def _return(self, slot: BatchSlot) -> None:
+        with self._lock:
+            self._leased = max(0, self._leased - 1)
+            pool = self._pools.setdefault((slot.B, slot.L), [])
+            if len(pool) < self._cap():
+                pool.append(slot)
+
+    def _forget(self) -> None:
+        """A leased slot died un-released (finaliser backstop)."""
+        with self._lock:
+            self._leased = max(0, self._leased - 1)
+
+    def record_pack(self, B: int, L: int, n_real: int,
+                    real_bytes: int) -> None:
+        total_bytes = B * L
+        padded_bytes = max(0, total_bytes - real_bytes)
+        with self._lock:
+            st = self._stats.setdefault((B, L), _GeometryStats())
+            st.packs += 1
+            st.real_rows += n_real
+            st.padded_rows += B - n_real
+            st.real_bytes += real_bytes
+            st.padded_bytes += padded_bytes
+        frac = padded_bytes / total_bytes if total_bytes else 0.0
+        padding_fraction_histogram().observe(frac)
+        rec = _geometry_record(B, L)
+        rec.counter("batch_rows_real_total").add(n_real)
+        rec.counter("batch_rows_padded_total").add(B - n_real)
+        rec.counter("batch_bytes_real_total").add(real_bytes)
+        rec.counter("batch_bytes_padded_total").add(padded_bytes)
+        auto_tuner().observe_pack(L, B, n_real)
+
+    # -- observability ------------------------------------------------------
+
+    def leased_total(self) -> int:
+        with self._lock:
+            return self._leased
+
+    def pooled_total(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._pools.values())
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-geometry padding/reuse ledger, keyed "BxL"."""
+        with self._lock:
+            return {f"{B}x{L}": st.as_dict()
+                    for (B, L), st in sorted(self._stats.items())}
+
+    def totals(self) -> dict:
+        with self._lock:
+            real_b = sum(s.real_bytes for s in self._stats.values())
+            pad_b = sum(s.padded_bytes for s in self._stats.values())
+            return {
+                "leased": self._leased,
+                "pooled": sum(len(p) for p in self._pools.values()),
+                "packs": sum(s.packs for s in self._stats.values()),
+                "real_rows": sum(s.real_rows for s in self._stats.values()),
+                "padded_rows": sum(s.padded_rows
+                                   for s in self._stats.values()),
+                "real_bytes": real_b,
+                "padded_bytes": pad_b,
+                "padding_fraction": (pad_b / (real_b + pad_b)
+                                     if real_b + pad_b else 0.0),
+            }
+
+
+_ring: Optional[BatchRing] = None
+_ring_lock = threading.Lock()
+
+
+def batch_ring() -> BatchRing:
+    global _ring
+    if _ring is None:
+        with _ring_lock:
+            if _ring is None:
+                _ring = BatchRing()
+    return _ring
+
+
+# ---------------------------------------------------------------------------
+# width auto-tuner
+
+
+class _BucketState:
+    __slots__ = ("floor", "ewma_pad", "packs_since", "packs_total")
+
+    def __init__(self) -> None:
+        self.floor = MIN_BATCH
+        self.ewma_pad = 0.0
+        self.packs_since = 0
+        self.packs_total = 0
+
+
+class WidthAutoTuner:
+    """Runtime batch-geometry and flush-deadline policy.
+
+    * **B floors**: per length bucket L, the padded batch size floor starts
+      at the static ``MIN_BATCH`` and walks down by powers of two (never
+      below ``MIN_TUNED_FLOOR``) while the observed ROW padding fraction
+      ``(B - n_real) / B`` stays high — sparse traffic stops paying for
+      256-row tensors that carry 8 real rows.  It walks back up when
+      batches run row-dense.  Row occupancy, not byte occupancy, drives
+      the decision: the zero tail inside a real row is the L bucket's
+      geometry cost (a dense batch of 50-byte lines in the 128 bucket
+      must NOT shrink B); the byte view stays observable through the
+      ``device_batch_padding_fraction`` histogram.  Movement is
+      hysteretic (one step per ``ADJUST_EVERY`` packs) so the jit geometry
+      cache sees at most a handful of shapes per bucket.
+    * **flush deadline**: how long a worker lane lets a pending batch ride
+      the ring before force-completing it.  When the device-utilization
+      accounting reports ``device_idle_while_backlogged_ms`` growing (the
+      host cannot feed the device), the deadline stretches — deeper
+      effective overlap; when the device keeps up it decays back toward
+      the default so latency stays interactive.
+    """
+
+    ADJUST_EVERY = 32        # packs per floor step (hysteresis)
+    HIGH_PAD = 0.5           # shrink the floor above this EWMA
+    LOW_PAD = 0.05           # re-grow the floor below this EWMA
+    EWMA_ALPHA = 0.125
+
+    DEADLINE_DEFAULT_S = 0.020
+    DEADLINE_MAX_S = 0.100
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, _BucketState] = {}
+        self._flush_deadline_s = self.DEADLINE_DEFAULT_S
+        self._last_adjust = 0.0
+        # None = unarmed: the first look at the plane only records the
+        # baseline — a tuner created next to a long-lived plane must not
+        # charge the plane's lifetime idle history to its first period
+        # (the same retroactive-charging shape note_backlogged guards)
+        self._last_idle_ms: Optional[float] = None
+        self._deadline_adjusts = 0
+
+    # -- B floor ------------------------------------------------------------
+
+    def min_batch_for(self, L: int) -> int:
+        if not tuner_enabled():
+            return MIN_BATCH
+        with self._lock:
+            st = self._buckets.get(L)
+            return st.floor if st is not None else MIN_BATCH
+
+    def observe_pack(self, L: int, B: int, n_real: int) -> None:
+        # row occupancy, deliberately NOT bytes: see the class docstring
+        frac = (B - n_real) / B if B else 0.0
+        with self._lock:
+            st = self._buckets.setdefault(L, _BucketState())
+            st.packs_total += 1
+            st.packs_since += 1
+            st.ewma_pad += self.EWMA_ALPHA * (frac - st.ewma_pad)
+            if not tuner_enabled() or st.packs_since < self.ADJUST_EVERY:
+                return
+            st.packs_since = 0
+            if st.ewma_pad > self.HIGH_PAD and st.floor > MIN_TUNED_FLOOR:
+                st.floor //= 2
+            elif st.ewma_pad < self.LOW_PAD and st.floor < MIN_BATCH:
+                st.floor *= 2
+
+    # -- flush deadline -------------------------------------------------------
+
+    def flush_deadline_s(self) -> float:
+        return self._flush_deadline_s
+
+    def maybe_adjust(self) -> None:
+        """Periodic (≥1 s apart) deadline adjustment off the device plane's
+        utilization accounting.  Observe-only: never constructs a plane."""
+        if not tuner_enabled():
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_adjust < 1.0:
+                return
+            self._last_adjust = now
+        from .device_plane import DevicePlane
+        plane = DevicePlane._instance
+        if plane is None:
+            return
+        idle_ms = plane.utilization()["idle_while_backlogged_ms"]
+        with self._lock:
+            if self._last_idle_ms is None:
+                self._last_idle_ms = idle_ms    # arm the window only
+                return
+            delta = idle_ms - self._last_idle_ms
+            self._last_idle_ms = idle_ms
+            if delta > 25.0:
+                # the device starved while the host had backlog: let
+                # batches ride the ring longer (more overlap in flight)
+                self._flush_deadline_s = min(
+                    self._flush_deadline_s * 2.0, self.DEADLINE_MAX_S)
+                self._deadline_adjusts += 1
+            elif self._flush_deadline_s > self.DEADLINE_DEFAULT_S:
+                # device kept up this period: decay back toward the
+                # latency-friendly default
+                self._flush_deadline_s = max(
+                    self._flush_deadline_s / 2.0, self.DEADLINE_DEFAULT_S)
+                self._deadline_adjusts += 1
+
+    # -- observability ------------------------------------------------------
+
+    def chosen(self) -> dict:
+        """The tuner's current decisions — /debug/status and bench.py
+        record these so every geometry the auto-tuner picked is auditable."""
+        with self._lock:
+            return {
+                "enabled": tuner_enabled(),
+                "flush_deadline_ms": round(self._flush_deadline_s * 1e3, 3),
+                "deadline_adjusts": self._deadline_adjusts,
+                "buckets": {
+                    str(L): {"floor": st.floor,
+                             "ewma_row_padding_fraction":
+                                 round(st.ewma_pad, 4),
+                             "packs": st.packs_total}
+                    for L, st in sorted(self._buckets.items())
+                },
+            }
+
+
+_tuner: Optional[WidthAutoTuner] = None
+_tuner_lock = threading.Lock()
+
+
+def auto_tuner() -> WidthAutoTuner:
+    global _tuner
+    if _tuner is None:
+        with _tuner_lock:
+            if _tuner is None:
+                _tuner = WidthAutoTuner()
+    return _tuner
+
+
+def reset_for_testing() -> None:
+    """Fresh ring + tuner (tests must not inherit another test's floors,
+    deadlines or padding ledger)."""
+    global _ring, _tuner
+    with _ring_lock:
+        _ring = BatchRing()
+    with _tuner_lock:
+        _tuner = WidthAutoTuner()
+
+
+# ---------------------------------------------------------------------------
+# the pipelined dispatch window
+
+
+class DeviceStream:
+    """Ordered pipelined dispatch over a DevicePlane.
+
+    ``submit`` never lets more than ``depth`` batches stay in flight: a
+    full window first advances the ring (materialises the OLDEST batch),
+    so with depth 3 the host is packing batch N+1 while the device
+    computes N and N-1's spans return.  ``drain()`` materialises the rest.
+    Results arrive strictly in submit order as ``(tag, outputs)`` — an
+    errored batch (kernel failure or injected ``device_plane.h2d`` /
+    ``device_plane.ring_advance`` fault) delivers ``(tag, exception)`` in
+    its slot's position: the fault costs one batch, never the ring.
+
+    NOTE: the regex engine's PendingParse implements the same window
+    discipline inline (ops/regex/engine.py) because its per-chunk error
+    handling is engine-specific (Pallas→XLA pinning, CPU re-run of a
+    faulted chunk).  A change to the ring invariants here — advance
+    order, slot/budget release, fault isolation — almost certainly needs
+    a mirror there.
+    """
+
+    def __init__(self, plane=None, depth: Optional[int] = None):
+        if plane is None:
+            from .device_plane import DevicePlane
+            plane = DevicePlane.instance()
+        self.plane = plane
+        self.depth = max(1, depth if depth is not None else stream_depth())
+        self._window: deque = deque()
+        self._results: List[Tuple[object, object]] = []
+        self.advances = 0
+
+    def inflight(self) -> int:
+        return len(self._window)
+
+    def submit(self, kernel, args, nbytes: int, tag=None,
+               slot: Optional[BatchSlot] = None) -> None:
+        """Dispatch under the plane budget, advancing first if the window
+        is full.  When ``slot`` is given the stream owns its release (at
+        materialisation, success or error — including a failure in the
+        pre-submit advance, which would otherwise strand the new slot)."""
+        try:
+            while len(self._window) >= self.depth:
+                self.advance()
+            fut = self.plane.submit(h2d_gated(kernel), args, nbytes,
+                                    on_wait=self._advance_if_any)
+        except BaseException:
+            if slot is not None:
+                slot.release()
+            raise
+        self._window.append((tag, slot, fut))
+
+    def _advance_if_any(self) -> bool:
+        if not self._window:
+            return False
+        self.advance()
+        return True
+
+    def advance(self):
+        """Materialise the oldest in-flight batch (the ring advance) and
+        append its result.  Errors are captured per batch — the window
+        keeps its order and the slot/budget always return."""
+        if not self._window:
+            return None
+        tag, slot, fut = self._window.popleft()
+        self.advances += 1
+        try:
+            try:
+                chaos.faultpoint(FP_RING_ADVANCE)
+                out = fut.result()
+            except Exception as e:  # noqa: BLE001 — delivered in-order
+                fut.release()
+                out = e
+            except BaseException:
+                # KeyboardInterrupt/SystemExit must reach the caller, not
+                # become a ring entry — release and propagate
+                fut.release()
+                raise
+        finally:
+            if slot is not None:
+                slot.release()
+        self._results.append((tag, out))
+        return out
+
+    def drain(self) -> List[Tuple[object, object]]:
+        """Advance until the window empties; returns (and clears) all
+        results in submit order."""
+        while self._window:
+            self.advance()
+        out, self._results = self._results, []
+        return out

@@ -85,6 +85,19 @@ class DFAMatchKernel:
     def __init__(self, dfa: DFA):
         self.dfa = dfa
         self._fn = jax.jit(build_dfa_match_fn(dfa))
+        self._fn_donated = None
 
     def __call__(self, rows, lengths) -> np.ndarray:
         return self._fn(rows, lengths)
+
+    def donated_call(self, rows, lengths) -> np.ndarray:
+        """Streaming-path variant: donate the per-dispatch staging buffers
+        so XLA reuses their HBM (see ExtractKernel.donated_call — same
+        contract, same CPU gating)."""
+        from .field_extract import donation_supported
+        if not donation_supported():
+            return self._fn(rows, lengths)
+        if self._fn_donated is None:
+            self._fn_donated = jax.jit(build_dfa_match_fn(self.dfa),
+                                       donate_argnums=(0, 1))
+        return self._fn_donated(rows, lengths)

@@ -493,6 +493,24 @@ def build_extract_fn(program: SegmentProgram):
     return extract
 
 
+_donation_cached = None
+
+
+def donation_supported() -> bool:
+    """Buffer donation is real on TPU/GPU — XLA reuses the donated input
+    HBM for outputs instead of allocating fresh buffers per dispatch (the
+    loongstream ring's device-side half).  On CPU jit ignores donation
+    with a per-call warning, so the donating variant is never built
+    there."""
+    global _donation_cached
+    if _donation_cached is None:
+        try:
+            _donation_cached = jax.default_backend() in ("tpu", "gpu")
+        except Exception:  # noqa: BLE001 — no backend ⇒ no donation
+            _donation_cached = False
+    return _donation_cached
+
+
 class ExtractKernel:
     """Owns the jitted extraction function for one compiled program.
 
@@ -503,10 +521,25 @@ class ExtractKernel:
     def __init__(self, program: SegmentProgram):
         self.program = program
         self._fn = jax.jit(build_extract_fn(program))
+        self._fn_donated = None
 
     def __call__(self, rows, lengths) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         ok, off, length = self._fn(rows, lengths)
         return ok, off, length
+
+    def donated_call(self, rows, lengths
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Streaming-path dispatch: inputs are per-dispatch host staging
+        buffers (batch-ring slots), so their device copies are transient —
+        donating them lets XLA alias that HBM for the outputs.  NOT safe
+        for callers that re-use a device-resident input across calls (the
+        bench kernel loop): those stay on __call__."""
+        if not donation_supported():
+            return self._fn(rows, lengths)
+        if self._fn_donated is None:
+            self._fn_donated = jax.jit(build_extract_fn(self.program),
+                                       donate_argnums=(0, 1))
+        return self._fn_donated(rows, lengths)
 
     @property
     def num_caps(self) -> int:

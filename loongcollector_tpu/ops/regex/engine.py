@@ -21,8 +21,11 @@ import numpy as np
 
 import threading
 
-from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows,
+from ... import chaos
+from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows, pad_batch,
                             pick_length_bucket)
+from ..device_stream import (FP_RING_ADVANCE, auto_tuner, batch_ring,
+                             h2d_gated, stream_depth)
 from ..kernels.dfa_scan import DFAMatchKernel
 from ..kernels.field_extract import ExtractKernel
 from .dfa import DFAUnsupported, compile_dfa
@@ -353,7 +356,8 @@ class RegexEngine:
         return self.parse_batch_async(arena, offsets, lengths).result()
 
     def parse_batch_async(self, arena: np.ndarray, offsets: np.ndarray,
-                          lengths: np.ndarray) -> "PendingParse":
+                          lengths: np.ndarray,
+                          depth: Optional[int] = None) -> "PendingParse":
         """Dispatch the parse; `result()` on the returned handle materialises.
 
         The async device data plane (SURVEY §7 step 4): each device chunk is
@@ -363,7 +367,13 @@ class RegexEngine:
         overlap too: the device computes group N while the host runs group
         N-1's downstream processors and group N+1's pack.  Host-walker and
         CPU-tier routing are unchanged — those paths return an
-        already-materialised PendingParse."""
+        already-materialised PendingParse.
+
+        loongstream: chunks ride batch-ring slots and at most ``depth``
+        (default ``LOONG_STREAM_DEPTH``) stay in flight — the ring advance
+        (span return of chunk N-depth+1) overlaps packing/H2D of N+1 and
+        device compute of N.  ``depth=1`` forces the synchronous
+        submit→materialise round trip (the bench sweep baseline)."""
         offsets = np.asarray(offsets, dtype=np.int64)
         lengths = np.asarray(lengths, dtype=np.int32)
         n = len(offsets)
@@ -400,7 +410,7 @@ class RegexEngine:
             device_idx = np.array([], dtype=np.int64)
 
         pending = PendingParse(self, arena, offsets, lengths,
-                               ok, cap_off, cap_len, cpu_idx)
+                               ok, cap_off, cap_len, cpu_idx, depth=depth)
         if len(device_idx):
             pending.dispatch(device_idx)
         return pending
@@ -468,24 +478,32 @@ class RegexEngine:
 class PendingParse:
     """A parse whose device chunks are in flight.
 
-    Dispatch-ahead discipline: `dispatch()` packs and submits every device
-    chunk through the DevicePlane WITHOUT materialising — the device executes
-    chunk N while the host packs chunk N+1.  When the in-flight byte budget
-    would block a submit, the oldest owned future is drained first (never
-    sleep in submit while owning the budget you wait for — see
-    DevicePlane.would_block).  `result()` runs the CPU-tier fallback rows
-    (host work, overlapping the device), then materialises chunks in order.
+    loongstream dispatch discipline: `dispatch()` packs each device chunk
+    into a leased batch-ring slot (pre-allocated fixed-geometry buffers —
+    no per-dispatch allocation on the H2D path) and submits it through the
+    DevicePlane, keeping at most ``depth`` chunks in flight: a full window
+    first advances the ring (materialises the OLDEST chunk), so the host
+    packs chunk N+1 while the device executes N and N-depth+1 returns
+    spans.  When the in-flight byte budget would block a submit, the
+    oldest owned future is drained first (never sleep in submit while
+    owning the budget you wait for — see DevicePlane.would_block).
+    `result()` runs the CPU-tier fallback rows (host work, overlapping the
+    device), then materialises remaining chunks in order.
 
-    Error semantics match the old synchronous loop: a Pallas/Mosaic failure
-    at materialisation pins the engine to the XLA path and re-runs that chunk
-    synchronously; failures on the XLA kernel itself propagate.
+    Error semantics: an injected chaos fault (``device_plane.h2d`` /
+    ``device_plane.ring_advance`` / ``device_plane.submit``) costs that one
+    chunk a synchronous re-run — never the parse, never the ring order.  A
+    Pallas/Mosaic failure at materialisation pins the engine to the XLA
+    path and re-runs that chunk synchronously; failures on the XLA kernel
+    itself propagate.  Every path releases the chunk's slot and budget.
     """
 
     __slots__ = ("engine", "arena", "offsets", "lengths", "ok", "cap_off",
-                 "cap_len", "cpu_idx", "_chunks_pending", "_result", "kern")
+                 "cap_len", "cpu_idx", "_chunks_pending", "_result", "kern",
+                 "depth")
 
     def __init__(self, engine, arena, offsets, lengths, ok, cap_off, cap_len,
-                 cpu_idx):
+                 cpu_idx, depth=None):
         self.engine = engine
         self.arena = arena
         self.offsets = offsets
@@ -494,9 +512,11 @@ class PendingParse:
         self.cap_off = cap_off
         self.cap_len = cap_len
         self.cpu_idx = cpu_idx
-        self._chunks_pending = []      # [(chunk_idx, DeviceBatch, DeviceFuture)]
+        # [(chunk_idx, DeviceBatch, BatchSlot, DeviceFuture, kernel)]
+        self._chunks_pending = []
         self._result = None
         self.kern = None
+        self.depth = max(1, depth if depth is not None else stream_depth())
 
     @classmethod
     def ready(cls, result: BatchParseResult) -> "PendingParse":
@@ -513,28 +533,52 @@ class PendingParse:
     def dispatch(self, device_idx: np.ndarray) -> None:
         from ..device_plane import DevicePlane
         plane = DevicePlane.instance()
+        ring = batch_ring()
+        tuner = auto_tuner()
         self.kern = self.engine._device_kernel()
         max_bucket = LENGTH_BUCKETS[-1]
         try:
             for chunk in _chunks(device_idx, MAX_BATCH):
+                # ring advance: a full window materialises its oldest chunk
+                # (span return of N-depth+1) before packing N+1
+                while len(self._chunks_pending) >= self.depth:
+                    self._drain_one()
+                # re-read the kernel PER CHUNK: the drain above (or the
+                # budget-wait hook inside submit) may have pinned the
+                # engine to the XLA path mid-dispatch — each pending tuple
+                # must record the kernel its chunk was actually SUBMITTED
+                # on, or the materialise-time fallback check misfires.
+                # Buffer donation: a kernel offering a donating variant
+                # gets it on this path — each dispatch's inputs are
+                # transient staging copies, so XLA may reuse their HBM for
+                # the outputs instead of allocating per dispatch.
+                sub_kern = self.kern
+                call = getattr(sub_kern, "donated_call", None) or sub_kern
                 d_off = self.offsets[chunk]
                 d_len = self.lengths[chunk]
                 L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
                     or max_bucket
-                batch = pack_rows(self.arena, d_off, d_len, L)
-                fut = plane.submit(self.kern, (batch.rows, batch.lengths),
-                                   batch.rows.nbytes,
-                                   on_wait=self._drain_if_pending)
-                # each chunk records the kernel it was SUBMITTED on: after a
-                # fault pins the engine to the XLA path, errors from earlier
-                # in-flight chunks must still take the fallback, not re-raise
-                self._chunks_pending.append((chunk, batch, fut, self.kern))
+                B = pad_batch(len(chunk), min_batch=tuner.min_batch_for(L))
+                slot = ring.lease(B, L)
+                try:
+                    batch = slot.pack(self.arena, d_off, d_len)
+                    fut = plane.submit(h2d_gated(call),
+                                       (batch.rows, batch.lengths),
+                                       batch.rows.nbytes,
+                                       on_wait=self._drain_if_pending)
+                except BaseException:
+                    slot.release()
+                    raise
+                self._chunks_pending.append((chunk, batch, slot, fut,
+                                             sub_kern))
         except BaseException:
-            # a failed pack/submit must not strand the budget the already-
-            # submitted futures hold (round-5 leak): force-release them —
-            # the caller abandons this parse, nobody will result() them
-            for _, _, fut, _k in self._chunks_pending:
+            # a failed pack/submit must not strand the budget (or the ring
+            # slots) the already-submitted futures hold (round-5 leak):
+            # force-release them — the caller abandons this parse, nobody
+            # will result() them
+            for _, _, slot, fut, _k in self._chunks_pending:
                 fut.release()
+                slot.release()
             self._chunks_pending.clear()
             raise
 
@@ -548,31 +592,47 @@ class PendingParse:
         return True
 
     def _drain_one(self) -> None:
-        chunk, batch, fut, sub_kern = self._chunks_pending.pop(0)
+        chunk, batch, slot, fut, sub_kern = self._chunks_pending.pop(0)
         try:
-            k_ok, k_off, k_len = fut.result()
-        except Exception:  # noqa: BLE001
-            if sub_kern is self.engine._segment_kernel or \
-                    getattr(self.engine, "_kernel_override", None) is not None:
-                raise
-            # Mosaic/mesh runtime failure must cost throughput, never
-            # liveness: pin this engine off the failed path and re-run the
-            # chunk on the proven XLA kernel
-            from ...utils.logger import get_logger
-            get_logger("regex").exception(
-                "device kernel failed for %r; falling back to XLA path",
-                self.engine.pattern)
-            self.engine._device_kernel_failed(sub_kern)
-            self.kern = self.engine._segment_kernel
-            k_ok, k_off, k_len = (np.asarray(a) for a in
-                                  self.kern(batch.rows, batch.lengths))
-        k_ok = k_ok[: batch.n_real]
-        k_off = k_off[: batch.n_real]
-        k_len = k_len[: batch.n_real]
-        self.ok[chunk] = k_ok
-        # row-relative -> arena-absolute
-        self.cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
-        self.cap_len[chunk] = k_len
+            try:
+                chaos.faultpoint(FP_RING_ADVANCE)
+                k_ok, k_off, k_len = fut.result()
+            except chaos.ChaosFault:
+                # injected async-stage fault (h2d / ring_advance / submit):
+                # it must error only THIS chunk — the slot still holds the
+                # packed rows, so re-run synchronously and keep the ring
+                # moving in order.  fut.release() is a no-op if result()
+                # already returned the budget.
+                fut.release()
+                k_ok, k_off, k_len = (np.asarray(a) for a in
+                                      sub_kern(batch.rows, batch.lengths))
+            except Exception:  # noqa: BLE001
+                if sub_kern is self.engine._segment_kernel or \
+                        getattr(self.engine, "_kernel_override",
+                                None) is not None:
+                    raise
+                # Mosaic/mesh runtime failure must cost throughput, never
+                # liveness: pin this engine off the failed path and re-run
+                # the chunk on the proven XLA kernel
+                from ...utils.logger import get_logger
+                get_logger("regex").exception(
+                    "device kernel failed for %r; falling back to XLA path",
+                    self.engine.pattern)
+                self.engine._device_kernel_failed(sub_kern)
+                self.kern = self.engine._segment_kernel
+                k_ok, k_off, k_len = (np.asarray(a) for a in
+                                      self.kern(batch.rows, batch.lengths))
+            k_ok = k_ok[: batch.n_real]
+            k_off = k_off[: batch.n_real]
+            k_len = k_len[: batch.n_real]
+            self.ok[chunk] = k_ok
+            # row-relative -> arena-absolute
+            self.cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
+            self.cap_len[chunk] = k_len
+        finally:
+            # the slot may be repacked the moment it returns to the ring:
+            # release only after the spans were copied out above
+            slot.release()
 
     def result(self) -> BatchParseResult:
         if self._result is not None:
@@ -586,12 +646,14 @@ class PendingParse:
             while self._chunks_pending:
                 self._drain_one()
         except BaseException:
-            # a failed chunk must not leak the others' in-flight budget
-            for _, _, fut, _k in self._chunks_pending:
+            # a failed chunk must not leak the others' in-flight budget —
+            # or their ring slots
+            for _, _, slot, fut, _k in self._chunks_pending:
                 try:
                     fut.result()
                 except Exception:  # noqa: BLE001 — releasing, not consuming
                     pass
+                slot.release()
             self._chunks_pending.clear()
             raise
         self._result = BatchParseResult(self.ok, self.cap_off, self.cap_len)

@@ -23,16 +23,22 @@ loongshard (ISSUE 4) makes thread_count real without giving up ordering:
   queues fill to their high watermark — the same feedback chain as before,
   one hop longer.
 
-TPU note — the async device data plane (SURVEY §7 step 4): each worker owns
-ONE WorkerLane holding a group whose device work is in flight.  The worker
-dispatches group N+1 (host pre-processing + pack + async kernel dispatch via
-Pipeline.process_begin) BEFORE materialising group N, so the device executes
-N while the host packs N+1.  Device back-pressure is the DevicePlane
-in-flight byte budget: when the device stalls, dispatch blocks, the worker
-stops consuming, its inbox fills, the dispatcher stops popping, and the
-bounded process queues feedback-block the inputs.  Every worker registers a
-budget-relief hook bound to ITS lane, so a worker waiting for budget always
-completes the overlapped group it owns (no-deadlock invariant, per lane).
+TPU note — the async device data plane (SURVEY §7 step 4), now streaming
+(loongstream, ISSUE 6): each worker owns ONE WorkerLane — a FIFO ring
+holding up to ``LOONG_STREAM_DEPTH - 1`` groups whose device work is in
+flight.  The worker dispatches group N+1 (host pre-processing + ring-slot
+pack + async kernel dispatch via Pipeline.process_begin), then advances the
+ring: the OLDEST pending group (N-depth+1) materialises and sends while the
+device computes the newer ones — pack/H2D of N+1 overlaps compute of N and
+span-return of N-1.  The auto-tuner's flush deadline bounds how long a
+group may ride the ring, so trickle traffic keeps interactive latency.
+Device back-pressure is the DevicePlane in-flight byte budget: when the
+device stalls, dispatch blocks, the worker stops consuming, its inbox
+fills, the dispatcher stops popping, and the bounded process queues
+feedback-block the inputs.  Every worker registers a budget-relief hook
+bound to ITS lane, so a worker waiting for budget always completes the
+oldest overlapped group it owns (no-deadlock invariant, per lane; FIFO, so
+relief never reorders sends).
 """
 
 from __future__ import annotations
@@ -49,6 +55,7 @@ from ..models import EventGroupMetaKey, PipelineEventGroup
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops.device_plane import note_host_backlog, set_budget_relief
+from ..ops.device_stream import auto_tuner
 from ..prof import flight
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from ..pipeline.queue.process_queue_manager import ProcessQueueManager
@@ -125,22 +132,29 @@ def group_source_id(group: PipelineEventGroup) -> Optional[bytes]:
 
 
 class WorkerLane:
-    """One worker's overlapped-dispatch slot (its device lane).
+    """One worker's overlapped-dispatch ring (its device lane).
 
-    Exactly one group's device work stays in flight per worker.  ``take()``
-    removes and returns the pending entry atomically, so the worker loop and
-    the DevicePlane budget-relief hook can race to complete it and exactly
-    one side wins — the multi-lane generalisation of the old single-TLS-slot
-    accounting (which broke down as soon as more than one worker owned
-    in-flight device budget)."""
+    loongstream: up to ``depth - 1`` groups' device work stays in flight
+    per worker (``LOONG_STREAM_DEPTH``, default 3 ⇒ two pending groups
+    while a third packs/dispatches).  The ring is strict FIFO — ``take()``
+    removes and returns the OLDEST pending entry atomically, so the worker
+    loop and the DevicePlane budget-relief hook can race to complete it
+    and exactly one side wins, and completion (send) order always matches
+    dispatch (pop) order: per-source ordering survives any depth.
+    ``oldest_age()`` drives the auto-tuner's flush deadline — a pending
+    group never rides the ring past it, bounding batch latency when the
+    queue trickles."""
 
-    __slots__ = ("worker_id", "_lock", "_pending", "_t0", "_held_since",
-                 "_held_s")
+    __slots__ = ("worker_id", "depth", "capacity", "_lock", "_pending",
+                 "_t0", "_held_since", "_held_s")
 
-    def __init__(self, worker_id: int):
+    def __init__(self, worker_id: int, depth: Optional[int] = None):
+        from ..ops.device_stream import stream_depth
         self.worker_id = worker_id
+        self.depth = depth if depth is not None else stream_depth()
+        self.capacity = max(1, self.depth - 1)
         self._lock = threading.Lock()
-        self._pending = None
+        self._pending: deque = deque()   # [(pending, enqueued_at)]
         # loongprof: overlap accounting — how long this lane held a group
         # whose device work was in flight, over the lane's lifetime
         self._t0 = time.perf_counter()
@@ -150,21 +164,43 @@ class WorkerLane:
     def put(self, pending) -> None:
         if pending is None:
             return
+        now = time.perf_counter()
         with self._lock:
-            assert self._pending is None, "lane already holds a group"
-            self._pending = pending
-            self._held_since = time.perf_counter()
+            assert len(self._pending) < self.capacity, "lane ring full"
+            if not self._pending:
+                self._held_since = now
+            self._pending.append((pending, now))
 
     def take(self):
+        """Remove and return the OLDEST pending entry (FIFO — the ring
+        advance), or None."""
         with self._lock:
-            p, self._pending = self._pending, None
-            if p is not None:
+            if not self._pending:
+                return None
+            p, _t = self._pending.popleft()
+            if not self._pending:
                 self._held_s += time.perf_counter() - self._held_since
             return p
 
     def busy(self) -> bool:
         with self._lock:
-            return self._pending is not None
+            return bool(self._pending)
+
+    def full(self) -> bool:
+        with self._lock:
+            return len(self._pending) >= self.capacity
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def oldest_age(self) -> Optional[float]:
+        """Seconds the oldest pending group has ridden the ring (None when
+        empty) — compared against the auto-tuner's flush deadline."""
+        with self._lock:
+            if not self._pending:
+                return None
+            return time.perf_counter() - self._pending[0][1]
 
     def overlap_ratio(self) -> float:
         """Fraction of this lane's lifetime spent with device work in
@@ -173,7 +209,7 @@ class WorkerLane:
         now = time.perf_counter()
         with self._lock:
             held = self._held_s
-            if self._pending is not None:
+            if self._pending:
                 held += now - self._held_since
         elapsed = max(now - self._t0, 1e-9)
         return held / elapsed
@@ -357,6 +393,13 @@ class ProcessorRunner:
             except Exception:  # noqa: BLE001 — a bad hook must not kill
                 # the thread pumping all timeout flushing agent-wide
                 log.exception("timeout flush failed")
+            try:
+                # loongstream: the width auto-tuner re-reads the device
+                # utilization accounting on the same 1 s cadence and moves
+                # the lane-ring flush deadline (observe-only, fail-soft)
+                auto_tuner().maybe_adjust()
+            except Exception:  # noqa: BLE001
+                log.exception("stream tuner adjust failed")
 
     def _run_dispatch(self) -> None:
         """Sharded mode only: pop the queue manager, route by affinity.
@@ -423,6 +466,19 @@ class ProcessorRunner:
             return True
         return _relieve
 
+    def _advance_ring(self, lane: WorkerLane) -> None:
+        """loongstream ring discipline, shared by both loops: complete the
+        OLDEST pending group when the ring is at capacity (the span-return
+        stage of the pipeline: group N-depth+1 materialises while the
+        device computes the newer ones) or when it outlived the
+        auto-tuner's flush deadline (latency backstop for trickle
+        traffic)."""
+        while lane.full():
+            self._complete_oldest(lane)
+        age = lane.oldest_age()
+        if age is not None and age > auto_tuner().flush_deadline_s():
+            self._complete_oldest(lane)
+
     def _run_single(self, worker_id: int) -> None:
         """thread_count == 1: the reference shape — pop the queue manager
         directly, no dispatch hop."""
@@ -438,7 +494,7 @@ class ProcessorRunner:
                 item = self.pqm.pop_item(timeout=0.0 if lane.busy() else 0.2)
                 if item is None:
                     had_item = False
-                    self._complete_lane(lane)
+                    self._complete_oldest(lane)
                     continue
                 if had_item:
                     # two consecutive non-empty pops = sustained backlog on
@@ -447,9 +503,10 @@ class ProcessorRunner:
                     note_host_backlog()
                 had_item = True
                 nxt = self._dispatch_one(*item, lane=lane)
-                # dispatch-before-complete is the overlap: the device now
-                # holds group N+1 while we materialise + send group N
-                self._complete_lane(lane)
+                # dispatch-before-advance is the overlap: the device now
+                # holds group N+1 while we materialise + send the oldest
+                # ring entry (N-depth+1)
+                self._advance_ring(lane)
                 lane.put(nxt)
             self._complete_lane(lane)
             # drain remaining items on stop
@@ -464,7 +521,7 @@ class ProcessorRunner:
 
     def _run_worker(self, worker_id: int) -> None:
         """Sharded mode: consume this worker's inbox with the same
-        overlapped device lane as the single-thread loop."""
+        overlapped device lane ring as the single-thread loop."""
         lane = self._lanes[worker_id]
         inbox = self._inboxes[worker_id]
         set_budget_relief(self._make_relief(lane))
@@ -473,7 +530,7 @@ class ProcessorRunner:
             while True:
                 item = inbox.get(timeout=0.0 if lane.busy() else 0.2)
                 if item is None:
-                    self._complete_lane(lane)
+                    self._complete_oldest(lane)
                     if inbox.drained():
                         break
                     continue
@@ -483,7 +540,7 @@ class ProcessorRunner:
                     # "shard more vs device-bound" counter)
                     note_host_backlog()
                 nxt = self._dispatch_one(*item, lane=lane)
-                self._complete_lane(lane)
+                self._advance_ring(lane)
                 lane.put(nxt)
             self._complete_lane(lane)
         finally:
@@ -557,9 +614,21 @@ class ProcessorRunner:
                 tracer.pop_current(sp)
             sp.end(status)
 
-    def _complete_lane(self, lane: WorkerLane) -> None:
+    def _complete_oldest(self, lane: WorkerLane) -> None:
+        """Advance the lane ring one step: materialise + send its oldest
+        pending group (no-op when empty)."""
         p = lane.take()
         if p is not None:
+            self._complete(p)
+
+    def _complete_lane(self, lane: WorkerLane) -> None:
+        """Drain the WHOLE lane ring in FIFO order — required before any
+        inline (host-tier) send of a possibly-same-source group, and on
+        worker exit."""
+        while True:
+            p = lane.take()
+            if p is None:
+                return
             self._complete(p)
 
     def _complete(self, pending) -> None:

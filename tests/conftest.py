@@ -69,6 +69,20 @@ def pytest_sessionfinish(session, exitstatus):
     faulthandler.cancel_dump_traceback_later()
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_stream_state():
+    """loongstream isolation: the batch ring's slot pools and the width
+    auto-tuner's floors/flush deadline are process-global; a test must not
+    inherit another test's tuned geometry (a shrunken B floor changes the
+    chunk sizes the watermark/budget tests are calibrated to)."""
+    from loongcollector_tpu.ops import device_stream
+    device_stream.reset_for_testing()
+    yield
+
+
 def wait_for(cond, timeout=10.0, interval=0.05):
     """Shared sink-side poll helper: True iff cond() holds within timeout."""
     import time

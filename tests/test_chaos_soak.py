@@ -176,10 +176,12 @@ class TestDisabledPlane:
         import loongcollector_tpu.flusher.pulsar  # noqa: F401
         import loongcollector_tpu.flusher.sls  # noqa: F401
         import loongcollector_tpu.input.file.reader  # noqa: F401
+        import loongcollector_tpu.ops.device_stream  # noqa: F401
         pts = set(chaos.registered_points())
         assert {"http_sink.send", "kafka_client.produce", "pulsar.send",
                 "grpc_flusher.send", "sls_client.post", "disk_buffer.write",
                 "disk_buffer.replay", "device_plane.submit",
+                "device_plane.ring_advance", "device_plane.h2d",
                 "bounded_queue.push", "file_input.read"} <= pts
 
     def test_env_activation(self):

@@ -301,7 +301,10 @@ class TestBudgetLeakRegression:
                                   serialize=False))
         arena, offsets, lengths = _arena(b"abc 123", 1024)  # 4 chunks @256
 
-        real_pack = engine_mod.pack_rows
+        # loongstream: packing now goes through the batch ring
+        # (ops/device_stream.BatchSlot.pack) — fail at that seam
+        from loongcollector_tpu.ops import device_stream as stream_mod
+        real_pack = stream_mod.pack_rows
         calls = {"n": 0}
 
         def failing_pack(*args, **kwargs):
@@ -310,7 +313,7 @@ class TestBudgetLeakRegression:
                 raise RuntimeError("injected mid-loop pack failure")
             return real_pack(*args, **kwargs)
 
-        monkeypatch.setattr(engine_mod, "pack_rows", failing_pack)
+        monkeypatch.setattr(stream_mod, "pack_rows", failing_pack)
         try:
             with pytest.raises(RuntimeError, match="injected"):
                 eng.parse_batch_async(arena, offsets, lengths)

@@ -176,14 +176,43 @@ class TestWorkerLane:
             t.join()
         assert got == [("sentinel",)]
 
-    def test_lane_rejects_double_put(self):
-        lane = WorkerLane(2)
+    def test_lane_ring_rejects_overfill(self):
+        # loongstream: the lane is a FIFO ring of capacity depth-1
+        lane = WorkerLane(2, depth=3)
+        assert lane.capacity == 2
+        lane.put(("a",))
+        lane.put(("b",))
+        assert lane.full()
+        with pytest.raises(AssertionError):
+            lane.put(("c",))
+        assert lane.take() == ("a",), "ring advance must be FIFO"
+        assert lane.take() == ("b",)
+        lane.put(None)          # no-op
+        assert lane.take() is None
+
+    def test_lane_depth_one_is_synchronous(self):
+        # depth=1 (LOONG_STREAM_DEPTH=1) degenerates to capacity 1 — the
+        # pre-stream single-slot behaviour
+        lane = WorkerLane(0, depth=1)
+        assert lane.capacity == 1
         lane.put(("a",))
         with pytest.raises(AssertionError):
             lane.put(("b",))
+        assert lane.take() == ("a",)
+
+    def test_lane_oldest_age_tracks_ring_head(self):
+        lane = WorkerLane(1, depth=3)
+        assert lane.oldest_age() is None
+        lane.put(("a",))
+        time.sleep(0.25)
+        lane.put(("b",))
+        age_a = lane.oldest_age()
+        assert age_a is not None and age_a >= 0.25
         lane.take()
-        lane.put(None)          # no-op
-        assert lane.take() is None
+        age_b = lane.oldest_age()
+        # generous bound: "b" was just enqueued — only a pathological
+        # scheduler stall approaches the "a" entry's quarter second
+        assert age_b < age_a - 0.1, "head age must follow the ring"
 
 
 # ---------------------------------------------------------------------------

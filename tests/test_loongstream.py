@@ -1,0 +1,656 @@
+"""loongstream: streaming device pipeline (ISSUE 6).
+
+Covers the tentpole invariants:
+
+  * batch ring: slot lease/release pairing, pool reuse (no per-dispatch
+    allocation), stale-byte zeroing on slot reuse, padding-waste ledger;
+  * width auto-tuner: B floors walk down under sustained padding waste and
+    back up under dense traffic; flush deadline follows the
+    device-idle-while-backlogged accounting; LOONG_STREAM_TUNER=0 pins
+    the static policy;
+  * DeviceStream: strict submit-order results at depth 3, and a fault
+    mid-ring (device_plane.ring_advance / device_plane.h2d) errors ONLY
+    that batch — slot and budget released, no stall, no reorder;
+  * engine streaming: byte-identical parse output depth=1 vs depth=3, and
+    measured overlap ≥ 2.5× over the synchronous path at a 5 ms
+    round-trip (2 ms wire each way + 1 ms serialized execution —
+    concurrency-1 device);
+  * runner: span-return (send) order matches submit (pop) order per
+    source under depth=3 with 4 sharded workers;
+  * 8-seed chaos storm at depth 3 with ERROR+DELAY faults on the async
+    ring stages: zero loss, per-source order, inflight == 0 and
+    slot-lease conservation (ring.leased_total() == 0) post-storm.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import chaos, trace
+from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.monitor.alarms import AlarmManager
+from loongcollector_tpu.ops import device_stream as ds
+from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                 LatencyInjectedKernel)
+from loongcollector_tpu.ops.regex import engine as engine_mod
+from loongcollector_tpu.ops.regex.engine import RegexEngine, get_engine
+from loongcollector_tpu.pipeline.pipeline_manager import (
+    CollectionPipelineManager, ConfigDiff)
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
+from loongcollector_tpu.runner.processor_runner import (ProcessorRunner,
+                                                        WorkerLane)
+
+from conftest import wait_for
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    trace.disable()
+    yield
+    chaos.reset()
+    trace.disable()
+    AlarmManager.instance().flush()
+
+
+@pytest.fixture()
+def device_tier(monkeypatch):
+    """Force the device tier (not the native host walker) and small chunks
+    so a modest event count spans many device dispatches."""
+    monkeypatch.setenv("LOONG_NATIVE_T1", "0")
+    monkeypatch.setattr(engine_mod, "MAX_BATCH", 256)
+    yield
+    DevicePlane.reset_for_testing()
+
+
+def _arena(line: bytes, n: int):
+    arena = np.frombuffer(line * n, dtype=np.uint8).copy()
+    offsets = np.arange(n, dtype=np.int64) * len(line)
+    lengths = np.full(n, len(line), dtype=np.int32)
+    return arena, offsets, lengths
+
+
+def _group(payload: bytes, source: bytes = b"") -> PipelineEventGroup:
+    sb = SourceBuffer(len(payload) + 64)
+    g = PipelineEventGroup(sb)
+    g.add_raw_event(1).set_content(sb.copy_string(payload))
+    if source:
+        g.set_tag(b"__source__", source)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+class TestStreamDepthConfig:
+    def test_default_and_env(self):
+        assert ds.stream_depth({}) == 3
+        assert ds.stream_depth({"LOONG_STREAM_DEPTH": "2"}) == 2
+        assert ds.stream_depth({"LOONG_STREAM_DEPTH": "1"}) == 1
+
+    def test_clamped_and_invalid(self):
+        assert ds.stream_depth({"LOONG_STREAM_DEPTH": "99"}) == ds.MAX_DEPTH
+        assert ds.stream_depth({"LOONG_STREAM_DEPTH": "0"}) == 1
+        assert ds.stream_depth({"LOONG_STREAM_DEPTH": "soon"}) == 3
+
+
+# ---------------------------------------------------------------------------
+# batch ring
+
+
+class TestBatchRing:
+    def test_lease_release_pools_and_reuses(self):
+        ring = ds.BatchRing()
+        s1 = ring.lease(256, 128)
+        assert ring.leased_total() == 1
+        s1.release()
+        assert ring.leased_total() == 0
+        assert ring.pooled_total() == 1
+        s2 = ring.lease(256, 128)
+        assert s2 is s1, "same geometry must reuse the pooled slot"
+        s2.release()
+        st = ring.stats()["256x128"]
+        assert st["slot_allocs"] == 1 and st["slot_reuses"] == 1
+
+    def test_release_is_idempotent(self):
+        ring = ds.BatchRing()
+        s = ring.lease(32, 128)
+        s.release()
+        s.release()
+        assert ring.leased_total() == 0
+        assert ring.pooled_total() == 1, "double release must not double-pool"
+
+    def test_transient_slots_past_pool_cap(self):
+        ring = ds.BatchRing(slots_per_geometry=1)
+        a, b = ring.lease(32, 128), ring.lease(32, 128)
+        a.release()
+        b.release()
+        assert ring.pooled_total() == 1, "cap bounds the pool"
+        assert ring.leased_total() == 0
+
+    def test_slot_reuse_zeroes_stale_padding(self):
+        ring = ds.BatchRing()
+        slot = ring.lease(8, 16)
+        slot.rows.fill(0xAB)          # a previous generation's bytes
+        slot.lengths.fill(7)
+        arena = np.frombuffer(b"hello world!", dtype=np.uint8).copy()
+        batch = slot.pack(arena, np.array([0, 6], np.int64),
+                          np.array([5, 6], np.int32))
+        assert batch.n_real == 2
+        assert bytes(batch.rows[0, :5].tobytes()) == b"hello"
+        assert bytes(batch.rows[1, :6].tobytes()) == b"world!"
+        assert not batch.rows[0, 5:].any(), "row tail must be zeroed"
+        assert not batch.rows[2:].any(), "padding rows must be zeroed"
+        assert not batch.lengths[2:].any()
+        slot.release()
+
+    def test_padding_ledger(self):
+        ring = ds.BatchRing()
+        slot = ring.lease(256, 128)
+        arena = np.zeros(64, np.uint8)
+        slot.pack(arena, np.arange(8, dtype=np.int64) * 8,
+                  np.full(8, 8, np.int32))
+        slot.release()
+        t = ring.totals()
+        assert t["real_rows"] == 8 and t["padded_rows"] == 248
+        assert t["real_bytes"] == 64
+        assert t["padded_bytes"] == 256 * 128 - 64
+        assert t["padding_fraction"] > 0.99
+
+    def test_abandoned_slot_keeps_ledger_truthful(self):
+        import gc
+        ring = ds.BatchRing()
+        slot = ring.lease(32, 128)
+        assert ring.leased_total() == 1
+        del slot
+        gc.collect()
+        assert ring.leased_total() == 0, (
+            "GC'd leased slot must not strand the lease ledger")
+
+
+# ---------------------------------------------------------------------------
+# width auto-tuner
+
+
+class TestWidthAutoTuner:
+    def test_floor_shrinks_under_sustained_row_padding(self):
+        t = ds.WidthAutoTuner()
+        assert t.min_batch_for(128) == 256
+        for _ in range(64):
+            t.observe_pack(128, 256, 4)
+        assert t.min_batch_for(128) == 64, (
+            "two adjustment rounds of ~98% row padding must halve twice")
+
+    def test_floor_regrows_when_batches_run_dense(self):
+        t = ds.WidthAutoTuner()
+        for _ in range(64):
+            t.observe_pack(128, 256, 4)
+        floor = t.min_batch_for(128)
+        assert floor < 256
+        for _ in range(96):
+            t.observe_pack(128, 256, 256)
+        assert t.min_batch_for(128) > floor
+
+    def test_dense_short_rows_do_not_shrink_floor(self):
+        """Row occupancy, not byte occupancy, drives the floor: a full
+        batch of 50-byte lines in the 128 bucket wastes >60% of its BYTES
+        on row tails, but that is the L bucket's geometry cost — B must
+        stay put."""
+        t = ds.WidthAutoTuner()
+        for _ in range(64):
+            t.observe_pack(128, 256, 256)   # n_real == B, rows ~50 bytes
+        assert t.min_batch_for(128) == 256
+
+    def test_floor_never_below_min(self):
+        t = ds.WidthAutoTuner()
+        for _ in range(32 * 10):
+            t.observe_pack(128, 256, 1)
+        assert t.min_batch_for(128) >= ds.MIN_TUNED_FLOOR
+
+    def test_env_disable_pins_static_policy(self, monkeypatch):
+        monkeypatch.setenv("LOONG_STREAM_TUNER", "0")
+        t = ds.WidthAutoTuner()
+        for _ in range(64):
+            t.observe_pack(128, 256, 4)
+        assert t.min_batch_for(128) == 256
+
+    def test_deadline_follows_idle_while_backlogged(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1024)
+        t = ds.WidthAutoTuner()
+        base = t.flush_deadline_s()
+        plane._dispatched = 1
+        # first look only ARMS the window: a tuner created next to a
+        # long-lived plane must not charge lifetime idle history to its
+        # first period
+        plane._idle_backlogged_ms = 500.0
+        t.maybe_adjust()
+        assert t.flush_deadline_s() == pytest.approx(base), (
+            "first observation must arm, not adjust")
+        # device idled 100 ms MORE while the host had backlog → stretch
+        plane._idle_backlogged_ms = 600.0
+        t._last_adjust = 0.0
+        t.maybe_adjust()
+        assert t.flush_deadline_s() == pytest.approx(base * 2)
+        # next period: no new idle-while-backlogged → decay back
+        t._last_adjust = 0.0
+        t.maybe_adjust()
+        assert t.flush_deadline_s() == pytest.approx(base)
+
+    def test_engine_dispatch_uses_tuned_floor(self, device_tier):
+        """After the tuner shrinks the floor for sparse traffic, the
+        engine's next dispatch packs the smaller geometry."""
+        DevicePlane.reset_for_testing()
+        eng = RegexEngine(r"(\w+) (\d+)q")
+        assert eng._segment_kernel is not None
+        eng.set_device_kernel_override(
+            LatencyInjectedKernel(eng._segment_kernel, 0.0,
+                                  serialize=False))
+        try:
+            arena, offsets, lengths = _arena(b"abc 123q", 8)
+            for _ in range(40):
+                res = eng.parse_batch(arena, offsets, lengths)
+                assert res.ok.all()
+            assert ds.auto_tuner().min_batch_for(128) < 256
+            eng.parse_batch(arena, offsets, lengths)
+            geoms = set(ds.batch_ring().stats())
+            assert any(g != "256x128" for g in geoms), (
+                f"tuned floor never reached the pack path: {geoms}")
+        finally:
+            eng.set_device_kernel_override(None)
+
+
+# ---------------------------------------------------------------------------
+# DeviceStream: ordered window + fault isolation
+
+
+class TestDeviceStream:
+    def test_results_in_submit_order_with_overlap(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1 << 22)
+        kern = LatencyInjectedKernel(lambda x: x + 1, rtt_s=0.005,
+                                     serialize=False)
+        stream = plane.open_stream(depth=3)
+        t0 = time.perf_counter()
+        for i in range(9):
+            stream.submit(kern, (np.full(4, i),), nbytes=64, tag=i)
+        results = stream.drain()
+        elapsed = time.perf_counter() - t0
+        assert [t for t, _ in results] == list(range(9))
+        for t, out in results:
+            np.testing.assert_array_equal(np.asarray(out[0]),
+                                          np.full(4, t) + 1)
+        assert elapsed < 9 * 0.005, "depth-3 window must overlap RTTs"
+        assert plane.inflight_bytes() == 0
+
+    @pytest.mark.parametrize("point", ["device_plane.ring_advance",
+                                       "device_plane.h2d"])
+    def test_mid_ring_fault_errors_only_that_batch(self, point):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1 << 22)
+        ring = ds.batch_ring()
+        chaos.install(ChaosPlan(7, {point: FaultSpec(
+            prob=1.0, kinds=(chaos.ACTION_ERROR,), after_hits=2,
+            max_faults=1)}))
+        kern = LatencyInjectedKernel(lambda x: x * 2, rtt_s=0.0,
+                                     serialize=False)
+        stream = plane.open_stream(depth=3)
+        slots = []
+        for i in range(6):
+            slot = ring.lease(32, 128)
+            slots.append(slot)
+            stream.submit(kern, (np.full(3, i),), nbytes=64, tag=i,
+                          slot=slot)
+        results = stream.drain()
+        chaos.uninstall()
+        assert [t for t, _ in results] == list(range(6)), (
+            "a fault mid-ring must never reorder the window")
+        errored = [t for t, out in results if isinstance(out, BaseException)]
+        assert errored == [2], (
+            f"exactly hit #2 of {point} faults; got errors at {errored}")
+        for t, out in results:
+            if not isinstance(out, BaseException):
+                np.testing.assert_array_equal(np.asarray(out[0]),
+                                              np.full(3, t) * 2)
+        assert plane.inflight_bytes() == 0, "faulted batch leaked budget"
+        assert ring.leased_total() == 0, "faulted batch leaked its slot"
+
+
+# ---------------------------------------------------------------------------
+# engine streaming: correctness + overlap
+
+
+class TestEngineStreaming:
+    def test_byte_identical_depth1_vs_depth3(self, device_tier):
+        DevicePlane.reset_for_testing()
+        eng = RegexEngine(r"(\w+) (\d+)z")
+        assert eng._segment_kernel is not None
+        eng.set_device_kernel_override(
+            LatencyInjectedKernel(eng._segment_kernel, 0.001,
+                                  serialize=True, wire_s=0.0005))
+        try:
+            arena, offsets, lengths = _arena(b"abc 123z", 1024)  # 4 chunks
+            sync = eng.parse_batch_async(arena, offsets, lengths,
+                                         depth=1).result()
+            stream = eng.parse_batch_async(arena, offsets, lengths,
+                                           depth=3).result()
+            assert sync.ok.all()
+            np.testing.assert_array_equal(sync.ok, stream.ok)
+            np.testing.assert_array_equal(sync.cap_off, stream.cap_off)
+            np.testing.assert_array_equal(sync.cap_len, stream.cap_len)
+            assert ds.batch_ring().leased_total() == 0
+        finally:
+            eng.set_device_kernel_override(None)
+
+    def test_mid_dispatch_fallback_pins_later_chunks(self, device_tier):
+        """Review regression: when the ring advance inside dispatch() hits
+        a device-kernel failure and pins the engine to the XLA path, the
+        chunks not yet submitted must ride the NEW kernel (and record it),
+        not the stale one hoisted at dispatch start — otherwise their
+        materialise-time fallback check misfires and the whole parse
+        fails instead of costing throughput."""
+        DevicePlane.reset_for_testing()
+        eng = RegexEngine(r"(\w+) (\d+)p")
+        assert eng._segment_kernel is not None
+        calls = {"n": 0}
+
+        class _FlakyDeviceKernel:
+            def __call__(self, rows, lengths):
+                calls["n"] += 1
+                raise RuntimeError("mosaic lowering failed")
+        eng._sharded = False     # 8 virtual CPU devices would win otherwise
+        eng._pallas_kernel = _FlakyDeviceKernel()
+        eng._use_pallas = True
+        arena, offsets, lengths = _arena(b"abc 123p", 1024)  # 4 chunks
+        res = eng.parse_batch_async(arena, offsets, lengths,
+                                    depth=2).result()
+        assert res.ok.all(), "fallback must cost throughput, never the parse"
+        assert eng._use_pallas is False, "failed path must be pinned off"
+        assert calls["n"] <= 2, (
+            "chunks dispatched after the pin must use the XLA kernel, "
+            f"not re-hit the failed one ({calls['n']} calls)")
+        assert ds.batch_ring().leased_total() == 0
+
+    def test_overlap_2_5x_at_rtt5ms(self, device_tier):
+        """The tentpole number: a concurrency-1 device behind a 5 ms round
+        trip (2.25 ms wire each way + 0.5 ms serialized execution — a
+        tunneled TPU's profile: latency-dominated, execution fast).  The
+        synchronous path pays the full round trip per chunk; depth-3
+        streaming overlaps the wire legs of neighbouring batches and is
+        bounded by max((2w+x)/3, host pack) per chunk — ≥ 2.5× asserted,
+        ~3-3.5× nominal (the acceptance target recorded by bench.py)."""
+        DevicePlane.reset_for_testing(budget_bytes=1 << 26)
+        eng = RegexEngine(r"(\w+) (\d+)s")
+        assert eng._segment_kernel is not None
+        lat = LatencyInjectedKernel(eng._segment_kernel, rtt_s=0.0005,
+                                    serialize=True, wire_s=0.00225)
+        eng.set_device_kernel_override(lat)
+        try:
+            n_chunks = 24
+            arena, offsets, lengths = _arena(b"abc 123s", 256 * n_chunks)
+            # warm-up compiles the geometry outside both timed windows
+            eng.parse_batch(arena[: 8 * 8], offsets[:8], lengths[:8])
+
+            # best-of-3 per path, INTERLEAVED (the repo's bench idiom for
+            # comparing two configurations on the shared 2-vCPU host): a
+            # co-tenant steal burst then inflates both paths' same-round
+            # samples instead of sinking one side's whole block
+            def once(depth):
+                t0 = time.perf_counter()
+                r = eng.parse_batch_async(arena, offsets, lengths,
+                                          depth=depth).result()
+                return time.perf_counter() - t0, r
+
+            def measure():
+                t_sync = t_stream = None
+                sync = stream = None
+                for _ in range(3):
+                    dt, r = once(1)
+                    if t_sync is None or dt < t_sync:
+                        t_sync, sync = dt, r
+                    dt, r = once(3)
+                    if t_stream is None or dt < t_stream:
+                        t_stream, stream = dt, r
+                return t_sync, t_stream, sync, stream
+
+            # up to 3 whole measurement attempts: only SUSTAINED host
+            # saturation (which flattens any scheduling gain — the burn
+            # threads made both paths ~10× slower and the ratio ~1) fails
+            # all three; a transient steal window passes a later attempt
+            for _attempt in range(3):
+                t_sync, t_stream, sync, stream = measure()
+                ratio = t_sync / t_stream
+                assert sync.ok.all() and stream.ok.all()
+                np.testing.assert_array_equal(sync.cap_off, stream.cap_off)
+                if ratio >= 2.5:
+                    break
+            assert ratio >= 2.5, (
+                f"streaming overlap too low: sync={t_sync*1e3:.0f}ms "
+                f"stream={t_stream*1e3:.0f}ms ratio={ratio:.2f}")
+        finally:
+            eng.set_device_kernel_override(None)
+
+
+# ---------------------------------------------------------------------------
+# runner: lane ring ordering + flush deadline
+
+
+class TestRunnerDepth3Ordering:
+    def test_send_order_matches_submit_order_per_source(self, monkeypatch):
+        """Satellite contract: span-return order == submit order per source
+        at depth=3 with 4 sharded workers, device and host routes mixed."""
+        monkeypatch.setenv("LOONG_STREAM_DEPTH", "3")
+        plane = DevicePlane.reset_for_testing(budget_bytes=1 << 24)
+        kernel = LatencyInjectedKernel(lambda x: x, rtt_s=0.003,
+                                       serialize=False)
+        sent = []
+        lock = threading.Lock()
+
+        class _P:
+            name = "stream-ord"
+
+            def process_begin(self, groups):
+                g = groups[0]
+                seq = int(bytes(g.get_tag(b"seq")))
+                if seq % 4 == 3:
+                    return None     # host-tier group: sent inline
+                fut = plane.submit(kernel, (np.arange(2),), nbytes=64)
+                return lambda: fut.result()
+
+            def send(self, groups):
+                g = groups[0]
+                src = bytes(g.get_tag(b"__source__") or b"")
+                with lock:
+                    sent.append((src, int(bytes(g.get_tag(b"seq")))))
+
+        class _Mgr:
+            def find_pipeline_by_queue_key(self, key):
+                return _P()
+
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(1, capacity=300)
+        runner = ProcessorRunner(pqm, _Mgr(), thread_count=4)
+        runner.init()
+        try:
+            assert all(l.capacity == 2 for l in runner._lanes), (
+                "depth 3 ⇒ ring capacity 2 per lane")
+            n_src, per = 6, 20
+            for i in range(n_src * per):
+                g = _group(b"x", source=b"s%d" % (i % n_src))
+                g.set_tag(b"seq", b"%d" % (i // n_src))
+                assert pqm.push_queue(1, g)
+            assert wait_for(lambda: len(sent) >= n_src * per, timeout=30)
+        finally:
+            runner.stop()
+        per_src = {}
+        for src, seq in sent:
+            per_src.setdefault(src, []).append(seq)
+        assert len(per_src) == n_src
+        for src, seqs in per_src.items():
+            assert seqs == sorted(seqs), (
+                f"{src}: depth-3 ring reordered sends: {seqs}")
+            assert len(seqs) == per, f"{src}: lost groups"
+        assert plane.inflight_bytes() == 0
+
+    def test_flush_deadline_completes_overdue_group(self):
+        """A pending group older than the tuner's flush deadline completes
+        on the next ring advance even though the ring is not full."""
+        r = ProcessorRunner(ProcessQueueManager(), None, thread_count=2)
+        lane = WorkerLane(0, depth=3)
+        done = []
+
+        class _P:
+            name = "deadline"
+
+            def send(self, groups):
+                pass
+        pending = (_P(), [], lambda: done.append(1), None,
+                   time.perf_counter())
+        # widen the deadline so a loaded host cannot make the "fresh"
+        # probe observe an already-overdue group
+        ds.auto_tuner()._flush_deadline_s = 0.5
+        lane.put(pending)
+        r._advance_ring(lane)
+        assert done == [], "fresh group must keep riding the ring"
+        time.sleep(0.55)
+        r._advance_ring(lane)
+        assert done == [1], "overdue group must be force-completed"
+        r.metrics.mark_deleted()
+
+
+# ---------------------------------------------------------------------------
+# chaos storm at depth 3: the acceptance matrix
+
+
+SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240803)
+
+STORM_PATTERN = r"(\w+):(\d+)"
+
+
+def _build(tmp_path, name, thread_count, capacity=40):
+    pqm = ProcessQueueManager()
+    mgr = CollectionPipelineManager(pqm, SenderQueueManager())
+    runner = ProcessorRunner(pqm, mgr, thread_count=thread_count)
+    runner.init()
+    out = tmp_path / f"{name}.jsonl"
+    diff = ConfigDiff()
+    diff.added[name] = {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": capacity},
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": STORM_PATTERN, "Keys": ["src", "seq"]}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+    mgr.update_pipelines(diff)
+    return pqm, mgr, runner, mgr.find_pipeline(name), out
+
+
+def _push_all(pqm, key, sources, per_source, lines_per_group=8):
+    total = 0
+    for s_i, src in enumerate(sources):
+        seq = 0
+        for _ in range(per_source):
+            lines = []
+            for _ in range(lines_per_group):
+                lines.append(b"s%d:%d" % (s_i, seq))
+                seq += 1
+            g = _group(b"\n".join(lines) + b"\n", source=src)
+            deadline = time.monotonic() + 30
+            while not pqm.push_queue(key, g):
+                assert time.monotonic() < deadline, "push starved"
+                time.sleep(0.002)
+            total += lines_per_group
+    return total
+
+
+def _read_per_source(out_path):
+    per_source = {}
+    for line in out_path.read_text().splitlines():
+        obj = json.loads(line)
+        if "src" in obj and "seq" in obj:
+            per_source.setdefault(obj["src"], []).append(int(obj["seq"]))
+    return per_source
+
+
+def _stream_storm(seed, tmp_path, tag, monkeypatch):
+    """One seeded storm through the depth-3 streaming plane: ERROR+DELAY
+    faults at the async ring stages plus queue-push rejections, while 4
+    workers drain 6 sources through the device tier."""
+    monkeypatch.setenv("LOONG_STREAM_DEPTH", "3")
+    monkeypatch.setenv("LOONG_NATIVE_T1", "0")
+    plane = DevicePlane.reset_for_testing(budget_bytes=4 * 1024 * 1024)
+    eng = get_engine(STORM_PATTERN)
+    assert eng._segment_kernel is not None
+    lat = LatencyInjectedKernel(eng._segment_kernel, rtt_s=0.002,
+                                serialize=False)
+    eng.set_device_kernel_override(lat)
+    chaos.install(ChaosPlan(seed, {
+        "device_plane.h2d": FaultSpec(
+            prob=0.2, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+            delay_range=(0.0, 0.002), max_faults=40),
+        "device_plane.ring_advance": FaultSpec(
+            prob=0.2, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+            delay_range=(0.0, 0.002), max_faults=40),
+        "bounded_queue.push": FaultSpec(
+            prob=0.2, kinds=(chaos.ACTION_ERROR,), max_faults=30),
+    }))
+    sources = [b"p%d" % i for i in range(6)]
+    pqm, mgr, runner, p, out = _build(tmp_path, f"stream-storm-{tag}", 4)
+    try:
+        total = _push_all(pqm, p.process_queue_key, sources, 10)
+        assert wait_for(lambda: pqm.all_empty(), timeout=60)
+        time.sleep(0.3)
+    finally:
+        runner.stop()
+        mgr.stop_all()
+        eng.set_device_kernel_override(None)
+    schedule = {pt: list(evs)
+                for pt, evs in chaos.schedule_by_point().items()}
+    chaos.uninstall()
+    per_source = _read_per_source(out)
+    got = sum(len(v) for v in per_source.values())
+    assert got == total, (
+        f"seed {seed}: lost {total - got} events in the ring storm")
+    for src, seqs in per_source.items():
+        assert seqs == sorted(seqs), f"seed {seed}: {src} reordered"
+    assert plane.inflight_bytes() == 0, (
+        f"seed {seed}: device budget stranded post-storm")
+    assert ds.batch_ring().leased_total() == 0, (
+        f"seed {seed}: ring slots stranded post-storm "
+        f"(lease conservation broken)")
+    assert lat.calls > 0, "storm never exercised the device tier"
+    return per_source, schedule
+
+
+class TestStreamChaosStorm:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_zero_loss_order_and_slot_conservation(self, seed, tmp_path,
+                                                   monkeypatch):
+        per_source, schedule = _stream_storm(seed, tmp_path, f"a{seed}",
+                                             monkeypatch)
+        ring_points = {pt for pt in schedule
+                       if pt.startswith("device_plane.")}
+        # the matrix only proves the ring if some seeds actually hit it;
+        # across the 8 seeds the 0.2-prob specs make this near-certain,
+        # and per-seed determinism pins WHICH seeds do
+        if seed in (42, 1337):
+            assert ring_points, f"seed {seed}: no ring-stage faults fired"
+
+    def test_same_seed_reproduces_schedule_and_order(self, tmp_path,
+                                                     monkeypatch):
+        ps1, sched1 = _stream_storm(42, tmp_path, "r1", monkeypatch)
+        ds.reset_for_testing()
+        ps2, sched2 = _stream_storm(42, tmp_path, "r2", monkeypatch)
+        for pt in set(sched1) | set(sched2):
+            a, b = sched1.get(pt, []), sched2.get(pt, [])
+            short, long_ = (a, b) if len(a) <= len(b) else (b, a)
+            assert long_[:len(short)] == short, (
+                f"point {pt}: same-seed schedules diverge")
+        assert ps1 == ps2, (
+            "per-source delivery order must be deterministic per shard")

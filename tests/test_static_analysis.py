@@ -169,6 +169,82 @@ class TestAcquireRelease:
         """
         assert scan(src, AcquireReleaseChecker()) == []
 
+    # loongstream (ISSUE 6): batch-ring slot leases obey the same
+    # acquire/release pairing as plane budget.  The leak-on-exception
+    # shape: slots leased in a loop with no guard — a mid-loop failure
+    # strands every already-leased slot (ring.leased_total() never
+    # returns to 0, the storm conservation invariant).
+    RING_LEASE_LEAK = """
+    def pump(ring, arena, chunks, out):
+        for chunk in chunks:
+            slot = ring.lease(256, 128)
+            out.append(slot.pack(arena, chunk))
+    """
+
+    RING_LEASE_FIXED = """
+    def pump(ring, arena, chunks, out):
+        leased = []
+        try:
+            for chunk in chunks:
+                slot = ring.lease(256, 128)
+                leased.append(slot)
+                out.append(slot.pack(arena, chunk))
+        except BaseException:
+            for slot in leased:
+                slot.release()
+            raise
+    """
+
+    # the real streaming-dispatch shape (engine.PendingParse.dispatch):
+    # inner try releases the just-leased slot, outer except-drain releases
+    # everything already pending — both layers discharge the obligation
+    RING_LEASE_STREAMING = """
+    class PendingParse:
+        def dispatch(self, ring, plane, device_idx):
+            try:
+                for chunk in _chunks(device_idx, MAX_BATCH):
+                    slot = ring.lease(256, 128)
+                    try:
+                        batch = slot.pack(self.arena, chunk)
+                        fut = plane.submit(self.kern,
+                                           (batch.rows, batch.lengths),
+                                           batch.rows.nbytes)
+                    except BaseException:
+                        slot.release()
+                        raise
+                    self._chunks_pending.append((chunk, batch, slot, fut))
+            except BaseException:
+                for _, _, slot, fut in self._chunks_pending:
+                    fut.release()
+                    slot.release()
+                self._chunks_pending.clear()
+                raise
+    """
+
+    def test_ring_lease_leak_on_exception_flagged(self):
+        findings = scan(self.RING_LEASE_LEAK, AcquireReleaseChecker())
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.check == "acquire-release"
+        assert "ring slot leased" in f.message
+        assert "strands the leased ring slot" in f.message
+
+    def test_ring_lease_guarded_is_clean(self):
+        assert scan(self.RING_LEASE_FIXED, AcquireReleaseChecker()) == []
+
+    def test_streaming_dispatch_shape_is_clean(self):
+        assert scan(self.RING_LEASE_STREAMING, AcquireReleaseChecker()) == []
+
+    def test_unrelated_lease_receiver_ignored(self):
+        # `.lease()` on things that aren't rings (a DHCP client, say)
+        # stays out of scope — the receiver filter keeps precision
+        src = """
+        def renew(dhcp, ifaces, out):
+            for i in ifaces:
+                out.append(dhcp.lease(i))
+        """
+        assert scan(src, AcquireReleaseChecker()) == []
+
     def test_raw_acquire_in_loop_flagged(self):
         src = """
         def drain(plane, sizes):
