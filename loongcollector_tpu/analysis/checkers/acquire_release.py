@@ -28,6 +28,13 @@ Acquire APIs (attr call + receiver filter, to stay quiet on unrelated
                   mid-loop pack/submit exception that strands leased slots
                   starves the ring's pools and breaks the storm
                   conservation invariant (ring.leased_total() == 0)
+
+loongfuse compile-cache handles (modules under ops/regex/): `open(...)`
+and `np.load(...)` must be `with`-guarded (or try/finally-closed) — the
+fused-DFA persistence path runs at pipeline (re)load, where a half-written
+npz or a leaked handle survives for the process lifetime.  Stricter than
+the escape rules above on purpose: cache I/O has no hot-path excuse to
+hold a raw handle.
 """
 
 from __future__ import annotations
@@ -115,14 +122,51 @@ def _up_to(parents: ParentMap, node: ast.AST, func: ast.AST):
         yield anc
 
 
+def _is_cache_handle_call(node: ast.Call) -> bool:
+    """open() / np.load() in the fused compile-cache modules."""
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return True
+    if attr_tail(node) == "load":
+        recv = receiver_repr(node).lower()
+        return recv in ("np", "numpy")
+    return False
+
+
+def _is_with_item(parents: ParentMap, node: ast.AST) -> bool:
+    return isinstance(parents.parent(node), ast.withitem)
+
+
 class AcquireReleaseChecker(Checker):
     name = CHECK
     description = ("device-budget / slot acquisition must release on all "
-                   "paths (try/finally or except-drain)")
+                   "paths (try/finally or except-drain); fuse compile-"
+                   "cache file handles must be with-guarded")
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
         parents = ParentMap(mod.tree)
+        cache_scope = "ops/regex/" in mod.relpath
         for qualname, func in iter_functions(mod.tree):
+            if cache_scope:
+                for node in ast.walk(func):
+                    if not (isinstance(node, ast.Call)
+                            and _is_cache_handle_call(node)):
+                        continue
+                    owner = next(
+                        (a for a in parents.ancestors(node)
+                         if isinstance(a, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))), None)
+                    if owner is not func:
+                        continue
+                    if _is_with_item(parents, node) \
+                            or _guarding_try(parents, node, func):
+                        continue
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        "compile-cache file handle opened outside `with` "
+                        "and without try/finally: a failure mid-write "
+                        "leaks the handle (and can leave a torn cache "
+                        "entry) for the process lifetime",
+                        symbol=qualname)
             calls: List[Tuple[ast.Call, str]] = []
             for node in ast.walk(func):
                 if isinstance(node, ast.Call) and _is_acquire_call(node):

@@ -159,6 +159,12 @@ class Application:
         # the crash backtrace so one directory holds the whole post-mortem
         from .prof import flight
         flight.set_dump_dir(self.data_dir)
+        # loongfuse: fused multi-pattern automata persist under
+        # <data_dir>/dfa_cache/ — restarts and pipeline hot-reloads load
+        # the compiled DFA by pattern-set content hash instead of paying
+        # determinize+minimize again
+        from .ops.regex import fuse
+        fuse.set_cache_dir(self.data_dir)
         from .pipeline.plugin.checkpoint import (PluginCheckpointStore,
                                                  set_default_store)
         set_default_store(PluginCheckpointStore(

@@ -98,6 +98,7 @@ class AlarmType(str, enum.Enum):
     DEVICE_PARSE_FALLBACK = "DEVICE_PARSE_FALLBACK_ALARM"
     DEVICE_BACKEND_DEGRADED = "DEVICE_BACKEND_DEGRADED_ALARM"
     MESH_SHARD_FALLBACK = "MESH_SHARD_FALLBACK_ALARM"
+    REGEX_TIER_DEMOTED = "REGEX_TIER_DEMOTED_ALARM"
 
 
 class _AlarmRecord:

@@ -217,6 +217,16 @@ def collect_status() -> dict:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongfuse: fused-DFA compile stats — states/classes per set,
+        # cache hit/miss, per-pattern demotions (the "why is grok slow /
+        # did my pattern fall off the device tier" page)
+        import sys as _sys
+        _fuse = _sys.modules.get("loongcollector_tpu.ops.regex.fuse")
+        if _fuse is not None:
+            doc["fusion"] = _fuse.fusion_status()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..prof import flight as _flight
         rec = _flight.recorder()
         doc["flight"] = {"events": len(rec),

@@ -38,6 +38,8 @@ def build_dfa_match_fn(dfa: DFA):
         for k in range(K):
             T[k * S + s, int(dfa.transitions[s, k])] = 1.0
     T_dev = jnp.asarray(T, dtype=jnp.bfloat16)
+    # extend T with an identity block for the past-the-end freeze class
+    T_ext = jnp.concatenate([T_dev, jnp.eye(S, dtype=jnp.bfloat16)], axis=0)
     class_intervals = dfa.byte_class_intervals()
     accepting = jnp.asarray(dfa.accepting)
 
@@ -60,9 +62,6 @@ def build_dfa_match_fn(dfa: DFA):
         pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
         # past-the-end positions freeze the state: encode as class K (identity)
         cls = jnp.where(pos_valid, cls, K)
-        # extend T with an identity block for the freeze class
-        T_ext = jnp.concatenate(
-            [T_dev, jnp.tile(jnp.eye(S, dtype=jnp.bfloat16), (1, 1))], axis=0)
 
         state0 = jax.nn.one_hot(dfa.start, S, dtype=jnp.bfloat16)
         state0 = jnp.broadcast_to(state0, (B, S))
@@ -79,6 +78,103 @@ def build_dfa_match_fn(dfa: DFA):
         return jnp.take(accepting, final_state)
 
     return match
+
+
+def build_fused_scan_fn(fdfa):
+    """jit-able f(rows u8 [B,L], lengths i32 [B]) -> tags u32-as-i32 [B].
+
+    loongfuse: the lockstep advance is IDENTICAL to the single-pattern
+    match kernel (state one-hot ⊗ class one-hot contracted with the dense
+    transition tensor on the MXU) — the widening is in the EPILOGUE, a
+    multi-accept one-hot contraction: final [B,S] @ tag-bit matrix [S,P]
+    yields per-pattern indicators, folded into one accept-tag bitmask.
+    One device pass classifies every pattern of the fused set at once."""
+    S = fdfa.num_states
+    K = fdfa.num_classes
+    T = np.zeros((K * S, S), dtype=np.float32)
+    for s in range(S):
+        for k in range(K):
+            T[k * S + s, int(fdfa.transitions[s, k])] = 1.0
+    T_dev = jnp.asarray(T, dtype=jnp.bfloat16)
+    # extend T with an identity block for the past-the-end freeze class
+    T_ext = jnp.concatenate([T_dev, jnp.eye(S, dtype=jnp.bfloat16)], axis=0)
+    class_intervals = fdfa.byte_class_intervals()
+    P = max(int(fdfa.accept_tags.max()).bit_length(), 1)
+    tag_bits = np.zeros((S, P), dtype=np.float32)
+    for s in range(S):
+        for p in range(P):
+            if int(fdfa.accept_tags[s]) & (1 << p):
+                tag_bits[s, p] = 1.0
+    bits_dev = jnp.asarray(tag_bits, dtype=jnp.bfloat16)
+    # bit 31 (MAX_PATTERNS=32) does not fit a python-int->int32 cast;
+    # build u32 and bit-cast — callers read the result as uint32 anyway
+    pow2 = jnp.asarray(
+        np.array([1 << p for p in range(P)], dtype=np.uint32).view(np.int32))
+
+    def byte_classes(rows: jnp.ndarray) -> jnp.ndarray:
+        cls = jnp.zeros(rows.shape, dtype=jnp.int32)
+        for k in range(1, K):
+            m = jnp.zeros(rows.shape, dtype=bool)
+            for lo, hi in class_intervals[k]:
+                if lo == hi:
+                    m = m | (rows == lo)
+                else:
+                    m = m | ((rows >= lo) & (rows <= hi))
+            cls = jnp.where(m, k, cls)
+        return cls
+
+    def scan_tags(rows: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        B, L = rows.shape
+        cls = byte_classes(rows)
+        pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+        cls = jnp.where(pos_valid, cls, K)      # freeze class past the end
+
+        state0 = jax.nn.one_hot(fdfa.start, S, dtype=jnp.bfloat16)
+        state0 = jnp.broadcast_to(state0, (B, S))
+
+        def step(state, cls_t):
+            coh = jax.nn.one_hot(cls_t, K + 1, dtype=jnp.bfloat16)
+            z = (coh[:, :, None] * state[:, None, :]).reshape(B, (K + 1) * S)
+            nxt = jnp.dot(z, T_ext, preferred_element_type=jnp.bfloat16)
+            return nxt, None
+
+        final, _ = jax.lax.scan(step, state0, cls.T)
+        # multi-accept one-hot contraction: per-pattern indicator columns,
+        # folded to a bitmask on the VPU
+        ind = jnp.dot(final, bits_dev,
+                      preferred_element_type=jnp.float32)
+        ind_i = (ind > 0.5).astype(jnp.int32)
+        return jnp.sum(ind_i * pow2[None, :], axis=1)
+
+    return scan_tags
+
+
+class FusedScanKernel:
+    """Device execution of a fused multi-accept automaton.  One invocation
+    returns the accept-tag bitmask for every event in the batch —
+    `invocations` counts dispatches so tests can assert that a ≥4-pattern
+    set classifies in a SINGLE kernel pass."""
+
+    def __init__(self, fdfa):
+        self.fdfa = fdfa
+        self._fn = jax.jit(build_fused_scan_fn(fdfa))
+        self._fn_donated = None
+        self.invocations = 0
+
+    def __call__(self, rows, lengths) -> np.ndarray:
+        self.invocations += 1
+        return self._fn(rows, lengths)
+
+    def donated_call(self, rows, lengths) -> np.ndarray:
+        """Streaming-path variant (see ExtractKernel.donated_call)."""
+        from .field_extract import donation_supported
+        if not donation_supported():
+            return self.__call__(rows, lengths)
+        if self._fn_donated is None:
+            self._fn_donated = jax.jit(build_fused_scan_fn(self.fdfa),
+                                       donate_argnums=(0, 1))
+        self.invocations += 1
+        return self._fn_donated(rows, lengths)
 
 
 class DFAMatchKernel:

@@ -174,9 +174,27 @@ class DFA:
         return bool(self.accepting[s])
 
 
-def compile_dfa(pattern: Union[str, bytes],
-                max_states: int = MAX_DFA_STATES,
-                max_classes: int = MAX_BYTE_CLASSES) -> DFA:
+def strip_anchors(tokens: list) -> list:
+    """Drop leading ^/\\A and trailing $/\\Z anchor tokens — batch rows are
+    whole lines, so every scan is implicitly anchored (shared by the NFA
+    builder here and loongfuse's variant AST)."""
+    at_begin = (sre_c.AT_BEGINNING, sre_c.AT_BEGINNING_STRING)
+    at_end = (sre_c.AT_END, sre_c.AT_END_STRING)
+    while tokens and tokens[0][0] is sre_c.AT and tokens[0][1] in at_begin:
+        tokens = tokens[1:]
+    while tokens and tokens[-1][0] is sre_c.AT and tokens[-1][1] in at_end:
+        tokens = tokens[:-1]
+    return tokens
+
+
+def build_pattern_nfa(pattern: Union[str, bytes],
+                      nfa: Optional[_NFA] = None) -> Tuple[_NFA, int, int]:
+    """Thompson NFA for one pattern: returns (nfa, start, accept).
+
+    When `nfa` is given, the fragment is built INTO it (loongfuse product
+    construction: every pattern of a fused set shares one state space, and
+    the fused compiler adds a common start with epsilon edges to each
+    pattern's start)."""
     if isinstance(pattern, bytes):
         pattern = pattern.decode("latin-1")
     try:
@@ -184,16 +202,20 @@ def compile_dfa(pattern: Union[str, bytes],
     except Exception as e:  # noqa: BLE001
         raise DFAUnsupported(f"parse error: {e}") from e
 
-    tokens = list(tree)
-    at_begin = (sre_c.AT_BEGINNING, sre_c.AT_BEGINNING_STRING)
-    at_end = (sre_c.AT_END, sre_c.AT_END_STRING)
-    while tokens and tokens[0][0] is sre_c.AT and tokens[0][1] in at_begin:
-        tokens = tokens[1:]
-    while tokens and tokens[-1][0] is sre_c.AT and tokens[-1][1] in at_end:
-        tokens = tokens[:-1]
-    nfa = _NFA()
+    tokens = strip_anchors(list(tree))
+    if nfa is None:
+        nfa = _NFA()
     start = nfa.new_state()
     accept = _build(nfa, tokens, start)
+    return nfa, start, accept
+
+
+def compile_dfa(pattern: Union[str, bytes],
+                max_states: int = MAX_DFA_STATES,
+                max_classes: int = MAX_BYTE_CLASSES) -> DFA:
+    if isinstance(pattern, bytes):
+        pattern = pattern.decode("latin-1")
+    nfa, start, accept = build_pattern_nfa(pattern)
 
     # epsilon closures
     n = len(nfa.eps)

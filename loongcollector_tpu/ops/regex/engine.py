@@ -29,7 +29,8 @@ from ..device_stream import (FP_RING_ADVANCE, auto_tuner, batch_ring,
 from ..kernels.dfa_scan import DFAMatchKernel
 from ..kernels.field_extract import ExtractKernel
 from .dfa import DFAUnsupported, compile_dfa
-from .program import PatternTier, Tier1Unsupported, compile_tier1
+from .program import (Alt, Optional_, PatternTier, Tier1Unsupported,
+                      compile_tier1)
 
 
 def _pallas_enabled() -> Optional[bool]:
@@ -225,6 +226,7 @@ def get_engine(pattern: str,
     # compile outside the lock (jit can take seconds); races build the same
     # engine twice at worst
     eng = RegexEngine(pattern, force_tier)
+    eng.warm_host()
     with _engine_cache_lock:
         _engine_cache[key] = eng
         while len(_engine_cache) > _ENGINE_CACHE_MAX:
@@ -247,6 +249,9 @@ class RegexEngine:
         self._native_exec = None            # host C++ walker, built lazily
         self._native_tried = False
         self._dfa_kernel: Optional[DFAMatchKernel] = None
+        self._fused_single = None           # loongfuse host exec, lazy
+        self._fused_tried = False
+        self._dfa_scanner = None            # fused host scanner (DFA tier)
         self.tier = PatternTier.CPU
         if force_tier in (None, PatternTier.SEGMENT):
             try:
@@ -263,6 +268,19 @@ class RegexEngine:
         if force_tier is not None and self.tier is not force_tier \
                 and force_tier is not PatternTier.CPU:
             raise ValueError(f"pattern {pattern!r} cannot run at {force_tier}")
+        # demotion observability (loongfuse satellite): a pattern falling
+        # off the device tier used to be SILENT — a TPU collapse like
+        # multiline-java's 1.6 MB/s was invisible until a bench run
+        if force_tier is None:
+            from .fuse import note_demotion
+            if self.tier is PatternTier.CPU:
+                note_demotion(pattern,
+                              "no device tier (Tier-1 and DFA compile "
+                              "both refused)")
+            elif self.tier is PatternTier.DFA and self.num_caps > 0:
+                note_demotion(pattern,
+                              "capture-needing Tier-2 (device gates the "
+                              "match; captures extract on host)")
 
     # ------------------------------------------------------------------
 
@@ -350,6 +368,48 @@ class RegexEngine:
                 self._native_exec = try_build(self._segment_kernel.program)
         return self._native_exec
 
+    def warm_host(self) -> None:
+        """AOT-build the host execution artifacts (loongfuse variant
+        linearization, native walker, DFA byte-table scanner) at pipeline
+        start — get_engine calls this so the first data batch never stalls
+        on variant compilation.  Direct constructions (tests, ad-hoc) stay
+        cheap and build lazily."""
+        if self.tier is PatternTier.SEGMENT:
+            self._fused_exec()
+            self._host_walker()
+        elif self.tier is PatternTier.DFA:
+            self._dfa_host_scanner()
+
+    @staticmethod
+    def _ops_have_trials(ops) -> bool:
+        return any(isinstance(op, (Alt, Optional_)) for op in ops)
+
+    def _fused_exec(self):
+        """loongfuse host execution (AOT variant linearization + fused
+        classify), built lazily on first host parse.  Only trial-heavy
+        straight programs profit — a linear program IS the fast path
+        already, and pivot programs scan bidirectionally."""
+        if not self._fused_tried:
+            self._fused_tried = True
+            prog = self._segment_kernel.program \
+                if self._segment_kernel is not None else None
+            if prog is not None and prog.pivot is None \
+                    and prog.pivot2 is None \
+                    and self._ops_have_trials(prog.ops):
+                from .fuse import try_build_single
+                self._fused_single = try_build_single(self.pattern)
+        return self._fused_single
+
+    def _dfa_host_scanner(self):
+        """Fused byte-table scanner over the Tier-2 DFA: the host
+        match-gate (multiline classification) at table-walk speed instead
+        of a per-row Python `re` loop."""
+        if self._dfa_scanner is None and self._dfa_kernel is not None:
+            from .fuse import ByteTableScanner
+            self._dfa_scanner = ByteTableScanner.from_dfa(
+                self._dfa_kernel.dfa)
+        return self._dfa_scanner
+
     def parse_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> BatchParseResult:
         """Full-match + captures for N events over a shared arena."""
@@ -389,6 +449,11 @@ class RegexEngine:
                 use_host = (nat is not None
                             and int(lengths.sum()) < _device_min_bytes())
             if use_host:
+                fx = self._fused_exec()
+                if fx is not None:
+                    k_ok, k_off, k_len = fx.parse(arena, offsets, lengths)
+                    return PendingParse.ready(
+                        BatchParseResult(k_ok, k_off, k_len))
                 nat = self._host_walker()
                 if nat is not None:
                     k_ok, k_off, k_len = nat(arena, offsets, lengths)
@@ -439,19 +504,19 @@ class RegexEngine:
         if self.tier is PatternTier.SEGMENT:
             return self.parse_batch(arena, offsets, lengths).ok
         if self.tier is PatternTier.DFA:
-            # small batches: the fixed dispatch round trip dwarfs a host
-            # re loop (the DFA tier has no native walker; `re` is its host
-            # tier, worth ~50 MB/s — scale the crossover accordingly);
-            # explicit device-kernel forces win, as in parse_batch
-            if not _native_host_mode() and _pallas_enabled() is None \
+            # host route (loongfuse): the fused byte-table scanner walks
+            # the SAME automaton the device kernel runs, at native table
+            # speed — degraded mode, and small batches where the fixed
+            # dispatch round trip dwarfs any host scan; explicit
+            # device-kernel forces win, as in parse_batch
+            if _pallas_enabled() is None \
                     and os.environ.get("LOONG_NATIVE_T1") != "0" \
-                    and int(lengths.sum()) < _device_min_bytes() // 6:
-                ok = np.zeros(n, dtype=bool)
-                for i in range(n):
-                    o, ln = int(offsets[i]), int(lengths[i])
-                    ok[i] = self._re.fullmatch(
-                        bytes(arena[o : o + ln].tobytes())) is not None
-                return ok
+                    and (_native_host_mode()
+                         or int(lengths.sum()) < _device_min_bytes() // 6):
+                sc = self._dfa_host_scanner()
+                if sc is not None:
+                    tags = sc.scan(arena, offsets, lengths)
+                    return (tags & 1).astype(bool)
             ok = np.zeros(n, dtype=bool)
             max_bucket = LENGTH_BUCKETS[-1]
             over = lengths > max_bucket

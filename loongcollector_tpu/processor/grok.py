@@ -29,6 +29,7 @@ class ProcessorGrok(Processor):
         self.keep_source_on_fail = True
         self.renamed_source_key = RAW_LOG_KEY
         self._engines: List[Tuple[RegexEngine, List[str]]] = []
+        self._fused_set = None
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -51,6 +52,16 @@ class ProcessorGrok(Processor):
             # only NAMED groups become fields (grok semantics)
             keys = [engine.group_names.get(i, "") for i in range(engine.num_caps)]
             self._engines.append((engine, keys))
+        # loongfuse: with several Match patterns, one fused scan classifies
+        # them all — each event runs ONLY its first-matching pattern's
+        # extract program instead of trying every engine in order.  A lone
+        # pattern already fuses inside its own engine.
+        self._fused_set = None
+        if len(self._engines) > 1:
+            from ..ops.regex.fuse import try_build_set
+            self._fused_set = try_build_set(
+                [e.pattern for e, _ in self._engines],
+                names=[f"match{i}" for i in range(len(self._engines))])
         return True
 
     def process(self, group: PipelineEventGroup) -> None:
@@ -66,10 +77,25 @@ class ProcessorGrok(Processor):
             matched = np.zeros(n, dtype=bool)
             field_offs: Dict[str, np.ndarray] = {}
             field_lens: Dict[str, np.ndarray] = {}
-            for engine, keys in self._engines:
+            member_masks = None
+            if self._fused_set is not None:
+                tags = self._fused_set.classify(
+                    src.arena, src.offsets.astype(np.int64), src.lengths)
+                member_masks = self._fused_set.member_masks(tags)
+            for pat_i, (engine, keys) in enumerate(self._engines):
                 if not remaining.any():
                     break
-                idx = np.nonzero(remaining)[0]
+                if member_masks is not None \
+                        and member_masks[pat_i] is not None:
+                    # fused member: the scan already classified it — run
+                    # its extract program only on its matching rows.
+                    # Demoted members (mask None) keep the per-pattern
+                    # probe over everything still unmatched.
+                    idx = np.nonzero(remaining & member_masks[pat_i])[0]
+                    if not len(idx):
+                        continue
+                else:
+                    idx = np.nonzero(remaining)[0]
                 res = engine.parse_batch(src.arena, src.offsets[idx],
                                          src.lengths[idx])
                 hit = idx[res.ok]

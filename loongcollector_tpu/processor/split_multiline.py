@@ -64,7 +64,32 @@ class ProcessorSplitMultilineLogString(Processor):
         self.cont = get_engine(self._fullmatchify(cp)) if cp else None
         self.end = get_engine(self._fullmatchify(ep)) if ep else None
         self.unmatched = mcfg.get("UnmatchedContentTreatment", "single_line")
+        # loongfuse: classify start/continue/end in ONE scan (one device
+        # pass / one native table walk) instead of a match batch per
+        # pattern — the per-pattern round trips are what collapsed
+        # multiline on TPU (1.6 MB/s, ROADMAP item 3)
+        self._fused_set = None
+        self._fused_slots: Dict[str, int] = {}
+        pats = [(name, eng.pattern) for name, eng in
+                (("start", self.start), ("cont", self.cont),
+                 ("end", self.end)) if eng is not None]
+        if len(pats) > 1:
+            from ..ops.regex.fuse import try_build_set
+            self._fused_set = try_build_set([p for _, p in pats],
+                                            names=[n for n, _ in pats])
+            if self._fused_set is not None:
+                self._fused_slots = {n: i for i, (n, _) in enumerate(pats)}
         return self.start is not None or self.end is not None
+
+    @staticmethod
+    def _classify(masks, name, engine, arena, offs, lens) -> np.ndarray:
+        """Fused classification when the pattern joined the set; the
+        per-pattern match batch when it was demoted or the set didn't
+        fuse — identical booleans either way."""
+        got = masks.get(name)
+        if got is not None:
+            return got
+        return engine.match_batch(arena, offs, lens)
 
     @staticmethod
     def _fullmatchify(pattern: str) -> str:
@@ -83,11 +108,19 @@ class ProcessorSplitMultilineLogString(Processor):
         offs = cols.offsets.astype(np.int64)
         lens = cols.lengths
 
-        is_start = (self.start.match_batch(arena, offs, lens)
+        masks: Dict[str, Optional[np.ndarray]] = {}
+        if self._fused_set is not None:
+            member = self._fused_set.member_masks(
+                self._fused_set.classify(arena, offs, lens))
+            masks = {name: member[slot]
+                     for name, slot in self._fused_slots.items()}
+        is_start = (self._classify(masks, "start", self.start, arena, offs,
+                                   lens)
                     if self.start else np.zeros(n, dtype=bool))
-        is_end = (self.end.match_batch(arena, offs, lens)
+        is_end = (self._classify(masks, "end", self.end, arena, offs, lens)
                   if self.end else None)
-        is_cont = (self.cont.match_batch(arena, offs, lens)
+        is_cont = (self._classify(masks, "cont", self.cont, arena, offs,
+                                  lens)
                    if self.cont else None)
 
         # blocks as parallel arrays (first[k], last[k]) + sorted unmatched

@@ -483,6 +483,115 @@ static void crc32c_init() {
     crc32c_ready = true;
 }
 
+}  // extern "C" — the fused-scan core below is a C++ template
+
+// ---------------------------------------------------------------------------
+// loongfuse: fused multi-accept DFA scan.
+//
+// One pass classifies a whole pattern set: `t256` is a byte-indexed
+// transition table (class compression folded in at build time, so the
+// serial dependency is a single L1-resident load per byte), `accept_tags`
+// maps each state to the uint32 bitmask of patterns accepting in it.
+// Rows are independent, so four advance in lockstep to hide the
+// transition-load latency of each row's state chain (the PaREM-style
+// parallel split, applied across rows instead of within one input).
+// u8 state ids while S <= 256 (the whole table stays L1-resident for
+// typical fused sets), u16 above.  Negative lengths scan as empty rows;
+// out-of-arena spans classify as tag 0 rather than reading wild.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename StateT>
+inline void dfa_scan_rows(const uint8_t* arena, int64_t arena_len,
+                          const int64_t* offsets, const int32_t* lengths,
+                          int64_t n, const StateT* t, int32_t start,
+                          const uint32_t* accept_tags, uint32_t* tags_out) {
+    int64_t i = 0;
+    for (; i + 4 <= n; i += 4) {
+        const uint8_t* r0 = arena + offsets[i];
+        const uint8_t* r1 = arena + offsets[i + 1];
+        const uint8_t* r2 = arena + offsets[i + 2];
+        const uint8_t* r3 = arena + offsets[i + 3];
+        int32_t l0 = lengths[i] < 0 ? 0 : lengths[i];
+        int32_t l1 = lengths[i + 1] < 0 ? 0 : lengths[i + 1];
+        int32_t l2 = lengths[i + 2] < 0 ? 0 : lengths[i + 2];
+        int32_t l3 = lengths[i + 3] < 0 ? 0 : lengths[i + 3];
+        bool in0 = offsets[i] >= 0 && offsets[i] + l0 <= arena_len;
+        bool in1 = offsets[i + 1] >= 0 && offsets[i + 1] + l1 <= arena_len;
+        bool in2 = offsets[i + 2] >= 0 && offsets[i + 2] + l2 <= arena_len;
+        bool in3 = offsets[i + 3] >= 0 && offsets[i + 3] + l3 <= arena_len;
+        if (!(in0 && in1 && in2 && in3)) {
+            for (int64_t k = i; k < i + 4; ++k) {
+                int32_t l = lengths[k] < 0 ? 0 : lengths[k];
+                if (offsets[k] < 0 || offsets[k] + l > arena_len) {
+                    tags_out[k] = 0;
+                    continue;
+                }
+                const uint8_t* r = arena + offsets[k];
+                uint32_t s = (uint32_t)start;
+                for (int32_t p = 0; p < l; ++p)
+                    s = t[(s << 8) | r[p]];
+                tags_out[k] = accept_tags[s];
+            }
+            continue;
+        }
+        int32_t lmin = l0 < l1 ? l0 : l1;
+        if (l2 < lmin) lmin = l2;
+        if (l3 < lmin) lmin = l3;
+        uint32_t s0 = (uint32_t)start, s1 = s0, s2 = s0, s3 = s0;
+        for (int32_t p = 0; p < lmin; ++p) {
+            s0 = t[(s0 << 8) | r0[p]];
+            s1 = t[(s1 << 8) | r1[p]];
+            s2 = t[(s2 << 8) | r2[p]];
+            s3 = t[(s3 << 8) | r3[p]];
+        }
+        for (int32_t p = lmin; p < l0; ++p) s0 = t[(s0 << 8) | r0[p]];
+        for (int32_t p = lmin; p < l1; ++p) s1 = t[(s1 << 8) | r1[p]];
+        for (int32_t p = lmin; p < l2; ++p) s2 = t[(s2 << 8) | r2[p]];
+        for (int32_t p = lmin; p < l3; ++p) s3 = t[(s3 << 8) | r3[p]];
+        tags_out[i] = accept_tags[s0];
+        tags_out[i + 1] = accept_tags[s1];
+        tags_out[i + 2] = accept_tags[s2];
+        tags_out[i + 3] = accept_tags[s3];
+    }
+    for (; i < n; ++i) {
+        int32_t l = lengths[i] < 0 ? 0 : lengths[i];
+        if (offsets[i] < 0 || offsets[i] + l > arena_len) {
+            tags_out[i] = 0;
+            continue;
+        }
+        const uint8_t* r = arena + offsets[i];
+        uint32_t s = (uint32_t)start;
+        for (int32_t p = 0; p < l; ++p) s = t[(s << 8) | r[p]];
+        tags_out[i] = accept_tags[s];
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t lct_dfa_scan(const uint8_t* arena, int64_t arena_len,
+                     const int64_t* offsets, const int32_t* lengths,
+                     int64_t n, const void* t256, int32_t n_states,
+                     int32_t wide, int32_t start,
+                     const uint32_t* accept_tags, uint32_t* tags_out) {
+    if (n_states <= 0 || start < 0 || start >= n_states) return -1;
+    if (wide) {
+        if (n_states > 65536) return -1;
+        dfa_scan_rows(arena, arena_len, offsets, lengths, n,
+                      static_cast<const uint16_t*>(t256), start,
+                      accept_tags, tags_out);
+    } else {
+        if (n_states > 256) return -1;
+        dfa_scan_rows(arena, arena_len, offsets, lengths, n,
+                      static_cast<const uint8_t*>(t256), start,
+                      accept_tags, tags_out);
+    }
+    return 0;
+}
+
 uint32_t lct_crc32c(const uint8_t* data, int64_t len, uint32_t seed) {
     if (!crc32c_ready) crc32c_init();
     uint32_t crc = seed ^ 0xFFFFFFFFu;

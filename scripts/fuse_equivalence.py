@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""loongfuse equivalence gate (scripts/lint.sh + tier-1).
+
+Compiles the default grok vocabulary's composite patterns into fused
+multi-accept DFAs, scans a fixed corpus through the fused scanner AND
+through per-pattern Python `re`, and fails on ANY classification
+disagreement.  This is the hard line under the whole fusion design: the
+fused automaton must carry the ORIGINAL pattern semantics exactly —
+a drifted rewrite would silently mis-gate extraction for every event.
+
+Exit 0 = equivalent; exit 1 = disagreement (printed per line/pattern).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from loongcollector_tpu.ops.regex import fuse  # noqa: E402
+from loongcollector_tpu.ops.regex.grok import DEFAULT_PATTERNS, expand  # noqa: E402
+
+# The default grok set under test: every composite vocabulary entry plus
+# the multiline classics — the pattern shapes pipelines actually fuse.
+GROK_SET = [
+    expand("%{COMMONAPACHELOG}"),
+    expand("%{COMBINEDAPACHELOG}"),
+    expand("%{NGINXACCESS}"),
+    expand("%{HTTPDATE}"),
+    expand("%{TIMESTAMP_ISO8601}"),
+    expand("%{SYSLOGTIMESTAMP}"),
+    expand("%{LOGLEVEL}"),
+    expand("%{URI}"),
+    expand("%{DATESTAMP}"),
+    expand("%{HOSTPORT}"),
+]
+MULTILINE_SET = [
+    r"\d{4}-\d{2}-\d{2} .*",
+    r"\s+at .*",
+    r".*(?:Exception|Error).*",
+    r"Caused by: .*",
+]
+
+
+def corpus() -> list:
+    lines = [
+        b'1.2.3.4 - frank [10/Oct/2000:13:55:36 -0700] "GET /a.gif HTTP/1.0" 200 2326',
+        b'1.2.3.4 - frank [10/Oct/2000:13:55:36 -0700] "GET /a.gif HTTP/1.0" 200 2326 "http://r" "UA"',
+        b'8.8.8.8 - - [01/Jan/2024:00:00:00 +0000] "POST /api HTTP/2.0" 404 0 "-" "-"',
+        b'10/Oct/2000:13:55:36 -0700',
+        b"2024-01-02T03:04:05.123+08:00",
+        b"2024-01-02 03:04:05Z",
+        b"Oct 11 22:14:15",
+        b"Oct  1 02:04:05",
+        b"ERROR", b"warning", b"Info", b"CRITICAL", b"waring", b"eror",
+        b"http://user:pw@host.example.com:8080/path?q=1",
+        b"ftp://files.example.com/",
+        b"02/28/2024 13:55:36",
+        b"host.example.com:443",
+        b"2024-01-02 03:04:05 ERROR boom",
+        b"  at com.example.Foo(Foo.java:10)",
+        b"java.lang.IllegalStateException: bad",
+        b"Caused by: java.io.IOException",
+        b"plain text line",
+        b"", b"-", b"0", b"[]", b'"',
+    ]
+    rng = np.random.default_rng(11)
+    # byte fuzz: mutated copies catch boundary/class-compression drift
+    for i in range(200):
+        base = bytearray(lines[i % 28])
+        if base:
+            base[int(rng.integers(len(base)))] = int(rng.integers(256))
+        lines.append(bytes(base))
+    return lines
+
+
+def check_set(name: str, patterns: list) -> int:
+    lines = corpus()
+    blob = b"".join(lines)
+    arena = np.frombuffer(blob, dtype=np.uint8)
+    lens = np.array([len(l) for l in lines], dtype=np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+
+    fdfa = fuse.compile_fused(patterns, alarm_demotions=False)
+    scanner = fuse.ByteTableScanner.from_fused(fdfa)
+    tags = scanner.scan(arena, offs, lens)
+    # the numpy lockstep fallback must agree with the native walk too
+    tags_np = scanner._scan_numpy(
+        arena, offs, lens, np.zeros(len(lines), np.uint32))
+
+    res = [re.compile(p.encode("latin-1")) for p in fdfa.patterns]
+    bad = 0
+    for i, line in enumerate(lines):
+        want = 0
+        for b, r in enumerate(res):
+            if r.fullmatch(line) is not None:
+                want |= 1 << b
+        for got, how in ((int(tags[i]), "native"), (int(tags_np[i]), "numpy")):
+            if got != want:
+                bad += 1
+                print(f"FAIL[{name}/{how}] line {i!r}: fused tags "
+                      f"{got:#x} != re {want:#x} ({line[:60]!r})")
+    demoted = ", ".join(nm for nm, _, _ in fdfa.demoted) or "none"
+    print(f"{name}: {len(fdfa.patterns)} fused ({fdfa.num_states} states, "
+          f"{fdfa.num_classes} classes), demoted: {demoted}, "
+          f"{len(lines)} lines x native+numpy — "
+          f"{'OK' if not bad else f'{bad} DISAGREEMENTS'}")
+    return bad
+
+
+def main() -> int:
+    bad = check_set("grok-default", GROK_SET)
+    bad += check_set("multiline", MULTILINE_SET)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,12 @@ JAX_PLATFORMS=cpu LOONG_PROCESS_THREADS=4 python scripts/trace_overhead.py
 LOONG_PROCESS_THREADS=4 python -m loongcollector_tpu.analysis \
     --checks metric-naming
 
+echo "== fused-DFA equivalence gate (loongfuse) =="
+# the fused multi-accept automaton must classify EXACTLY like per-pattern
+# `re` over the default grok set + multiline classics — any disagreement
+# means fusion would mis-gate extraction (docs/performance.md)
+JAX_PLATFORMS=cpu python scripts/fuse_equivalence.py
+
 echo "== native lint =="
 make -C native lint
 

@@ -1,0 +1,553 @@
+"""loongfuse: AOT multi-pattern DFA fusion (ISSUE 7).
+
+Covers the compiler (product NFA → multi-accept subset construction →
+Hopcroft minimization, tiered caps + demotion), both scanners (native
+4-wide walk and numpy lockstep), the persisted compile cache, the fused
+single-pattern execution (variant linearization + regional validation —
+byte-identical to `re`), the fused pattern-set execution (grok Match
+lists, multiline), the device kernel's single-pass multi-accept contract,
+and the demotion counter/alarm observability."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu.ops.regex import fuse
+from loongcollector_tpu.ops.regex.dfa import compile_dfa
+from loongcollector_tpu.ops.regex.engine import RegexEngine
+from loongcollector_tpu.ops.regex.grok import expand
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fuse_state():
+    fuse.reset_for_testing()
+    yield
+    fuse.reset_for_testing()
+
+
+def _pack(lines):
+    blob = b"".join(lines)
+    arena = np.frombuffer(blob, dtype=np.uint8)
+    lens = np.array([len(l) for l in lines], dtype=np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
+    return arena, offs, lens
+
+
+def _apache_lines(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append(
+            b'%d.%d.%d.%d - user%d [10/Oct/2000:13:55:%02d -0700] '
+            b'"GET /p%d HTTP/1.1" %d %d'
+            % (rng.integers(1, 255), rng.integers(256), rng.integers(256),
+               rng.integers(1, 255), i, i % 60, i % 7,
+               rng.integers(100, 599), rng.integers(0, 10**6)))
+    return out
+
+
+MIXED = [
+    b"2024-01-02 03:04:05 ERROR boom",
+    b"  at com.example.Foo(Foo.java:10)",
+    b"k=12",
+    b"no match here [",
+    b"",
+    b"12345",
+    b"abcdef",
+]
+
+
+class TestFusedCompiler:
+    PATTERNS = [r"\d{4}-\d{2}-\d{2} .*", r"\s+at .*", r"(\w+)=(\d+)",
+                r"\d+", r"[a-z]+"]
+
+    def test_multi_accept_tags_agree_with_re(self):
+        fd = fuse.compile_fused(self.PATTERNS)
+        assert not fd.demoted
+        res = [re.compile(p.encode("latin-1")) for p in self.PATTERNS]
+        corpus = MIXED + _apache_lines(64)
+        for line in corpus:
+            want = sum(1 << i for i, r in enumerate(res)
+                       if r.fullmatch(line))
+            assert fd.match_cpu(line) == want, line
+
+    def test_minimization_preserves_tag_sets(self):
+        # un-minimized reference: per-pattern single DFAs
+        fd = fuse.compile_fused(self.PATTERNS)
+        singles = [compile_dfa(p, max_states=512, max_classes=96)
+                   for p in self.PATTERNS]
+        for line in MIXED + [b"x" * 40, b"99", b"zz=1"]:
+            want = sum(1 << i for i, d in enumerate(singles)
+                       if d.match_cpu(line))
+            assert fd.match_cpu(line) == want
+
+    def test_budget_demotion_names_the_culprit(self):
+        # a pattern that alone needs hundreds of states blows a tiny
+        # fused budget and must be demoted — the small ones still fuse
+        big = r"(?:ab){40,64}x"
+        fd = fuse.compile_fused([r"\d+", big, r"[a-z]+"],
+                                max_states=64, alarm_demotions=False)
+        assert [p for p in fd.patterns] == [r"\d+", r"[a-z]+"]
+        assert len(fd.demoted) == 1
+        assert fd.demoted[0][1] == big
+        assert "budget" in fd.demoted[0][2] \
+            or "unsupported" in fd.demoted[0][2]
+        # demoted members drop out of the bit mapping through the set
+        # exec (callers keep their per-pattern path for them)
+        fset = fuse.FusedSetExec([r"\d+", r"(?P<a>x)\1", r"[a-z]+"])
+        assert fset.bit_of.get(0) == 0 and fset.bit_of.get(2) == 1
+        assert 1 not in fset.bit_of
+        tags = fset.classify(np.frombuffer(b"7z", np.uint8),
+                             np.array([0, 1], np.int64),
+                             np.array([1, 1], np.int32), force="host")
+        masks = fset.member_masks(tags)
+        assert masks[1] is None
+        assert masks[0].tolist() == [True, False]
+        assert masks[2].tolist() == [False, True]
+
+    def test_unsupported_pattern_demotes_not_raises(self):
+        fd = fuse.compile_fused([r"\d+", r"(?P<a>x)\1"],
+                                alarm_demotions=False)
+        assert fd.patterns == [r"\d+"]
+        assert len(fd.demoted) == 1
+
+    def test_all_unsupported_raises(self):
+        with pytest.raises(fuse.FuseUnsupported):
+            fuse.compile_fused([r"(?P<a>x)\1"], alarm_demotions=False)
+
+    def test_device_caps_recorded(self):
+        small = fuse.compile_fused([r"\d+", r"[a-z]+"])
+        assert small.device_ok
+        assert small.num_states <= fuse.DEVICE_MAX_STATES
+
+
+class TestScanners:
+    def test_native_and_numpy_agree(self):
+        fd = fuse.compile_fused([expand("%{COMMONAPACHELOG}"),
+                                 r"\s+at .*"])
+        sc = fuse.ByteTableScanner.from_fused(fd)
+        lines = _apache_lines(128) + MIXED
+        arena, offs, lens = _pack(lines)
+        got = sc.scan(arena, offs, lens)
+        got_np = sc._scan_numpy(arena, offs, lens,
+                                np.zeros(len(lines), np.uint32))
+        want = np.array([fd.match_cpu(l) for l in lines], np.uint32)
+        assert np.array_equal(got, want)
+        assert np.array_equal(got_np, want)
+
+    def test_negative_length_scans_as_empty(self):
+        fd = fuse.compile_fused([r"\d*", r"x"])
+        sc = fuse.ByteTableScanner.from_fused(fd)
+        arena = np.frombuffer(b"xx", np.uint8)
+        tags = sc.scan(arena, np.array([0, 0], np.int64),
+                       np.array([-1, 1], np.int32))
+        assert tags[0] == 1          # empty string: \d* matches, x doesn't
+        assert tags[1] == 2
+
+    def test_out_of_bounds_rows_zero_on_both_scanners(self):
+        """A span outside the arena scans to tag 0 on BOTH fallbacks —
+        the numpy walk must not emit a partial-prefix accept state where
+        the native scan refuses the row."""
+        fd = fuse.compile_fused([r"a*", r"b"])
+        sc = fuse.ByteTableScanner.from_fused(fd)
+        arena = np.frombuffer(b"aaab", np.uint8)
+        offs = np.array([0, 1, -1, 2], np.int64)
+        lens = np.array([3, 9, 2, 2], np.int32)   # rows 1,2 out of bounds
+        want = [1, 0, 0, 0]          # row 3 "ab" matches neither fully
+        got = sc.scan(arena, offs, lens)
+        got_np = sc._scan_numpy(arena, offs, lens,
+                                np.zeros(len(offs), np.uint32))
+        assert got.tolist() == want
+        assert got_np.tolist() == want
+
+    def test_wide_tables_above_256_states(self):
+        pats = [rf"s{i}" + r"\d{%d}[a-f]{%d}x" % (8 + i, 6 + i)
+                for i in range(14)]
+        fd = fuse.compile_fused(pats)
+        assert fd.num_states > 256       # forces the u16 table layout
+        sc = fuse.ByteTableScanner.from_fused(fd)
+        assert sc.wide
+        lines = [b"s3" + b"1" * 11 + b"a" * 9 + b"x", b"nope"]
+        arena, offs, lens = _pack(lines)
+        got = sc.scan(arena, offs, lens)
+        got_np = sc._scan_numpy(arena, offs, lens,
+                                np.zeros(len(lines), np.uint32))
+        want = np.array([fd.match_cpu(l) for l in lines], np.uint32)
+        assert np.array_equal(got, want)
+        assert np.array_equal(got_np, want)
+
+
+class TestCompileCache:
+    PATTERNS = [r"\d{4}-\d{2}-\d{2} .*", r"\s+at .*"]
+
+    def test_second_start_hits_disk_cache(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fd1 = fuse.load_or_compile(self.PATTERNS)
+        assert fd1.stats["cache"] == "miss"
+        s0 = fuse.fusion_status()
+        assert s0["cache_misses"] == 1 and s0["cache_hits"] == 0
+        assert os.path.isdir(tmp_path / "dfa_cache")
+        # same pattern set, fresh process state = pipeline restart
+        fuse.reset_for_testing()
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fd2 = fuse.load_or_compile(self.PATTERNS)
+        assert fd2.stats["cache"] == "hit"
+        s1 = fuse.fusion_status()
+        assert s1["cache_hits"] == 1 and s1["cache_misses"] == 0
+        assert np.array_equal(fd1.transitions, fd2.transitions)
+        assert np.array_equal(fd1.accept_tags, fd2.accept_tags)
+        assert fd1.start == fd2.start
+
+    def test_mem_cache_within_process(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        a = fuse.load_or_compile(self.PATTERNS)
+        b = fuse.load_or_compile(self.PATTERNS)
+        assert a is b
+        assert fuse.fusion_status()["cache_hits"] == 1
+
+    def test_cache_versioned_and_content_guarded(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fuse.load_or_compile(self.PATTERNS)
+        # different set, same prefix → its OWN entry, never the stale one
+        fuse.reset_for_testing()
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fd = fuse.load_or_compile(self.PATTERNS + [r"\d+"])
+        assert fd.stats["cache"] == "miss"
+        assert len(fd.patterns) == 3
+
+    def test_demotions_survive_cache_round_trip(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        pats = [r"\d+", r"(?P<a>x)\1"]
+        fd1 = fuse.load_or_compile(pats)
+        assert len(fd1.demoted) == 1
+        fuse.reset_for_testing()
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fd2 = fuse.load_or_compile(pats)
+        assert fd2.stats["cache"] == "hit"
+        assert fd2.demoted == fd1.demoted
+        # the restarted process must NOT be silent about the demotion:
+        # counter replayed from the cached split, alarm re-armed
+        assert fuse.fusion_status()["demotions"] == 1
+
+
+class TestFusedSingleExec:
+    def _differential(self, pattern, corpus):
+        fx = fuse.try_build_single(pattern)
+        assert fx is not None
+        rx = re.compile(pattern.encode("latin-1"))
+        arena, offs, lens = _pack(corpus)
+        ok, co, cl = fx.parse(arena, offs, lens)
+        for i, line in enumerate(corpus):
+            m = rx.fullmatch(line)
+            assert bool(ok[i]) == (m is not None), (pattern, line)
+            if m is None:
+                continue
+            for g in range(rx.groups):
+                s, e = m.span(g + 1)
+                if s >= 0:
+                    assert co[i, g] == offs[i] + s, (pattern, line, g)
+                    assert cl[i, g] == e - s, (pattern, line, g)
+                else:
+                    assert cl[i, g] == -1, (pattern, line, g)
+        return fx
+
+    def test_commonapachelog_byte_identical(self):
+        corpus = _apache_lines(256) + [
+            b"bad",
+            b'1.2.3.4 - u [10/Oct/2000:13:55:36 -0700] "GET /x" 200 -',
+            b'1.2.3.4 - u [10/Oct/2000:13:55:36 -0700] "GET /x HTTP/2" 200 7',
+            b'1.2.3.4 - u [10/Zzz/2000:13:55:36 -0700] "GET /x HTTP/1.0" 200 5',
+            b'1.2.3.4 - u [99/Oct/2000:13:55:36 -0700] "G /x HTTP/1.0" 200 5',
+        ]
+        fx = self._differential(expand("%{COMMONAPACHELOG}"), corpus)
+        assert len(fx.variants) >= 2          # pinned choice points
+        assert fx.regions0                    # HTTPDATE relaxed
+
+    def test_nginxaccess_byte_identical(self):
+        corpus = [
+            b'1.2.3.4 - alice [10/Oct/2000:13:55:36 -0700] "GET /x HTTP/1.1" 200 512 "http://r" "UA/1.0"',
+            b'9.9.9.9 - - [01/Jan/2024:00:00:00 +0000] "POST /api HTTP/2.0" 404 0 "-" "-"',
+            b"junk",
+        ]
+        self._differential(expand("%{NGINXACCESS}"), corpus)
+
+    def test_unpinned_fallback_byte_identical(self):
+        # 5 binary choice points -> 32 variants > MAX_VARIANTS: the
+        # un-pinned relaxed walker with regional validation takes over
+        pat = expand('%{COMMONAPACHELOG} "(?P<a>[^"]*)" '
+                     '(?:%{POSINT:x}|-) (?:%{POSINT:y}|-) (?:%{POSINT:z}|-)')
+        corpus = [
+            b'1.2.3.4 - u [10/Oct/2000:13:55:36 -0700] "GET /x HTTP/1.1" 200 5 "r" 1 2 3',
+            b'1.2.3.4 - u [10/Oct/2000:13:55:36 -0700] "GET /x" 200 - "r" - 2 -',
+            b'1.2.3.4 - u [10/Zzz/2000:13:55:36 -0700] "GET /x" 200 - "r" - 2 -',
+            b"junk",
+        ]
+        fx = self._differential(pat, corpus)
+        assert fx.scanner is None             # unpinned mode
+
+    def test_unpinned_relaxed_region_inside_optional_validated(self):
+        """Regression: _relax_seq plants relaxed groups inside optionals /
+        alternations, so the unpinned walker must build regional
+        validators there too — without them a row whose relaxed span
+        violates the exact interior grammar is silently accepted."""
+        pat = (r"(\w\w\wx|\d\d\dy) (?:id=((?:ab|cd)(?:ab|cd)+) )?"
+               r"end(?:uv){1,9}w")
+        corpus = [
+            b"abcx id=abab enduvw",
+            b"abcx id=abca enduvw",     # 'abca' is not (ab|cd)-pairs
+            b"abcx id=ab enduvw",       # too short for the exact interior
+            b"abcx enduvuvw",           # optional absent: span -1
+            b"123y id=cdab enduvw",
+            b"abzx id=abab enduvw",     # first group violates its grammar
+        ]
+        fx = self._differential(pat, corpus)
+        assert fx.scanner is None             # unpinned mode
+        assert len(fx.regions0) == 2          # BOTH relaxed groups guarded
+
+    def test_linear_pattern_declines_fusion(self):
+        assert fuse.try_build_single(r"(\d+) (\w+)") is None
+
+    def test_variant_budget_demotion_is_silent(self, tmp_path, monkeypatch):
+        """A budget demotion among try_build_single's SYNTHETIC variant
+        regexes means only "no fused single-exec" — it must not bump
+        regex_tier_demotions or alarm a pattern the user never wrote,
+        on compile OR on the cache-hit replay after a restart."""
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        # note_demotions=False is the mechanism try_build_single rides:
+        # suppressed on the compile AND on the disk-cache-hit replay
+        pats = [r"\d+", r"(?P<a>x)\1"]
+        fd = fuse.load_or_compile(pats, note_demotions=False)
+        assert fd.demoted and fuse.fusion_status()["demotions"] == 0
+        fuse.reset_for_testing()                     # "restart"
+        monkeypatch.setenv("LOONG_DFA_CACHE", str(tmp_path))
+        fd2 = fuse.load_or_compile(pats, note_demotions=False)
+        assert fd2.stats["cache"] == "hit"
+        assert fuse.fusion_status()["demotions"] == 0
+        # integration: a variant set blowing the budget stays silent
+        fuse.reset_for_testing()
+        orig = fuse.compile_fused
+
+        def capped(p, **kw):
+            kw["max_states"] = 80
+            return orig(p, **kw)
+
+        monkeypatch.setattr(fuse, "compile_fused", capped)
+        assert fuse.try_build_single(expand("%{COMMONAPACHELOG}")) is None
+        assert fuse.fusion_status()["demotions"] == 0
+
+    def test_engine_routes_host_parse_through_fusion(self):
+        eng = RegexEngine(expand("%{COMMONAPACHELOG}"))
+        corpus = _apache_lines(64)
+        arena, offs, lens = _pack(corpus)
+        res = eng.parse_batch(arena, offs, lens)
+        assert eng._fused_single is not None
+        assert res.ok.all()
+        # linear patterns never pay for fusion machinery
+        eng2 = RegexEngine(r"(\S+) (\S+)")
+        eng2.parse_batch(arena, offs, lens)
+        assert eng2._fused_single is None
+
+
+class TestFusedSetExec:
+    def test_grok_processor_fused_equals_per_pattern(self):
+        from loongcollector_tpu.models import (PipelineEventGroup,
+                                               SourceBuffer)
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        from loongcollector_tpu.processor.grok import ProcessorGrok
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+
+        match = ["%{NGINXACCESS}", "%{COMMONAPACHELOG}",
+                 "%{WORD:w}=%{POSINT:v}",
+                 "%{TIMESTAMP_ISO8601:ts} %{GREEDYDATA:msg}"]
+        lines = (_apache_lines(64)
+                 + [b"k=12", b"2024-01-02T03:04:05Z hello world",
+                    b"unmatched ?!"] * 8)
+
+        def run(fused: bool):
+            ctx = PluginContext("t")
+            sp = ProcessorSplitLogString()
+            sp.init({}, ctx)
+            g = ProcessorGrok()
+            assert g.init({"Match": match}, ctx)
+            if not fused:
+                g._fused_set = None
+            data = b"\n".join(lines) + b"\n"
+            sb = SourceBuffer(len(data) + 64)
+            grp = PipelineEventGroup(sb)
+            grp.add_raw_event(1).set_content(sb.copy_string(data))
+            sp.process(grp)
+            g.process(grp)
+            cols = grp.columns
+            out = {}
+            arena = grp.source_buffer.as_array()
+            for name, (fo, fl) in sorted(cols.fields.items()):
+                vals = []
+                for i in range(len(cols)):
+                    if fl[i] < 0:
+                        vals.append(None)
+                    else:
+                        vals.append(bytes(
+                            arena[fo[i]:fo[i] + fl[i]].tobytes()))
+                out[name] = vals
+            return out, cols.parse_ok.copy()
+
+        fused_fields, fused_ok = run(True)
+        plain_fields, plain_ok = run(False)
+        assert np.array_equal(fused_ok, plain_ok)
+        assert fused_fields == plain_fields
+
+    def test_multiline_fused_equals_per_pattern(self):
+        from loongcollector_tpu.models import (PipelineEventGroup,
+                                               SourceBuffer)
+        from loongcollector_tpu.pipeline.plugin.interface import \
+            PluginContext
+        from loongcollector_tpu.processor.split_log_string import \
+            ProcessorSplitLogString
+        from loongcollector_tpu.processor.split_multiline import \
+            ProcessorSplitMultilineLogString
+
+        chunk = []
+        for i in range(64):
+            chunk.append(b"2024-01-02 03:04:%02d ERROR boom %d" % (i % 60, i))
+            chunk.append(b"  at com.example.Foo(Foo.java:10)")
+            chunk.append(b"  at com.example.Bar(Bar.java:20)")
+            chunk.append(b"END OF TRACE")
+        data = b"\n".join(chunk) + b"\n"
+
+        def run(fused: bool):
+            ctx = PluginContext("t")
+            sp = ProcessorSplitLogString()
+            sp.init({}, ctx)
+            ml = ProcessorSplitMultilineLogString()
+            assert ml.init({"Multiline": {
+                "StartPattern": r"\d{4}-\d{2}-\d{2} .*",
+                "EndPattern": r"END OF TRACE"}}, ctx)
+            if fused:
+                assert ml._fused_set is not None
+            else:
+                ml._fused_set = None
+            sb = SourceBuffer(len(data) + 64)
+            grp = PipelineEventGroup(sb)
+            grp.add_raw_event(1).set_content(sb.copy_string(data))
+            sp.process(grp)
+            ml.process(grp)
+            cols = grp.columns
+            arena = grp.source_buffer.as_array()
+            return [bytes(arena[cols.offsets[i]:
+                                cols.offsets[i] + cols.lengths[i]].tobytes())
+                    for i in range(len(cols))]
+
+        assert run(True) == run(False)
+
+    def test_classification_matches_re_on_fuzz(self):
+        pats = [expand("%{COMMONAPACHELOG}"), r"\d{4}-\d{2}-\d{2} .*",
+                r"\s+at .*", r"(\w+)=(\d+)"]
+        fset = fuse.FusedSetExec(pats)
+        res = [re.compile(p.encode("latin-1")) for p in pats]
+        rng = np.random.default_rng(5)
+        lines = _apache_lines(32)
+        for i in range(200):
+            base = bytearray(lines[i % len(lines)] if i % 3 else MIXED[i % len(MIXED)])
+            if base:
+                base[int(rng.integers(len(base)))] = int(rng.integers(256))
+            lines.append(bytes(base))
+        arena, offs, lens = _pack(lines)
+        tags = fset.classify(arena, offs, lens, force="host")
+        for i, line in enumerate(lines):
+            want = sum(1 << b for b, r in enumerate(res)
+                       if r.fullmatch(line))
+            assert int(tags[i]) == want, line
+
+
+class TestDeviceKernel:
+    def test_one_pass_classifies_four_patterns(self):
+        """Acceptance: a single device kernel invocation returns the
+        multi-accept tag bitmask for a ≥4-pattern fused set."""
+        pats = [r"\d+", r"[a-z]+", r"\d+[a-z]+", r"x.*", r"-"]
+        fset = fuse.FusedSetExec(pats)
+        assert fset.fdfa.device_ok and fset.n_fused >= 4
+        lines = [b"123", b"abc", b"12ab", b"xyz", b"-", b"??", b""] * 30
+        arena, offs, lens = _pack(lines)
+        tags = fset.classify(arena, offs, lens, force="device")
+        kern = fset._kernel
+        assert kern is not None
+        assert kern.invocations == 1          # ONE lockstep pass for all 5
+        want = np.array([fset.fdfa.match_cpu(l) for l in lines], np.uint32)
+        assert np.array_equal(tags, want)
+        # a second batch reuses the jitted kernel, one more invocation
+        fset.classify(arena, offs, lens, force="device")
+        assert kern.invocations == 2
+
+    def test_full_32_pattern_set_uses_tag_bit_31(self):
+        """MAX_PATTERNS=32 means accept-tag bit 31 is legal — the device
+        kernel's bitmask fold must survive it (u32 bit-cast, not a
+        python-int→int32 overflow)."""
+        from loongcollector_tpu.ops.kernels.dfa_scan import FusedScanKernel
+        pats = [chr(ord("a") + i % 26) * (1 + i // 26) + str(i)
+                for i in range(32)]
+        fd = fuse.compile_fused(pats)
+        assert len(fd.patterns) == 32 and not fd.demoted
+        kern = FusedScanKernel(fd)
+        lines = [pats[31].encode(), pats[0].encode(), b"nope"]
+        L = max(len(l) for l in lines)
+        rows = np.zeros((len(lines), L), np.uint8)
+        for i, l in enumerate(lines):
+            rows[i, :len(l)] = np.frombuffer(l, np.uint8)
+        lens = np.array([len(l) for l in lines], np.int32)
+        tags = np.asarray(kern(rows, lens)).astype(np.uint32)
+        assert tags[0] == np.uint32(1) << 31
+        assert tags[1] == 1 and tags[2] == 0
+
+
+class TestDemotionObservability:
+    def test_demotion_counter_and_one_shot_alarm(self):
+        from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+        mgr = AlarmManager.instance()
+        mgr.flush()
+        before = fuse.fusion_status()["demotions"]
+        pat = r"(?P<a>x)\1"                   # backreference: unfusable
+        fuse.note_demotion(pat, "test reason")
+        fuse.note_demotion(pat, "test reason")     # one-shot: no second alarm
+        assert fuse.fusion_status()["demotions"] == before + 2
+        alarms = [a for a in mgr.flush()
+                  if a.get("alarm_type") ==
+                  AlarmType.REGEX_TIER_DEMOTED.value]
+        assert len(alarms) == 1
+        assert pat[:20] in alarms[0]["alarm_message"]
+
+    def test_cpu_tier_engine_notes_demotion(self):
+        before = fuse.fusion_status()["demotions"]
+        RegexEngine(r"(?P<a>\w+) \1")         # backreference → CPU tier
+        assert fuse.fusion_status()["demotions"] == before + 1
+
+    def test_status_document_shape(self):
+        fuse.load_or_compile([r"\d+", r"[a-z]+"])
+        from loongcollector_tpu.monitor.exposition import collect_status
+        doc = collect_status()
+        assert "fusion" in doc
+        f = doc["fusion"]
+        assert {"compiles", "cache_hits", "cache_misses", "demotions",
+                "sets"} <= set(f)
+        assert f["sets"] and f["sets"][-1]["states"] >= 1
+
+
+class TestEquivalenceGate:
+    def test_lint_gate_passes(self):
+        """The scripts/fuse_equivalence.py contract, run in-process on
+        every tier-1 invocation."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "fuse_equivalence",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts",
+                "fuse_equivalence.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check_set("grok-default", mod.GROK_SET) == 0
+        assert mod.check_set("multiline", mod.MULTILINE_SET) == 0

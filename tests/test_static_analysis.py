@@ -245,6 +245,70 @@ class TestAcquireRelease:
         """
         assert scan(src, AcquireReleaseChecker()) == []
 
+    # loongfuse: the fused-kernel geometry-cache pattern — a lazily-built
+    # per-geometry kernel whose persistence layer touches cache files.
+    # The kernel build itself is clean (no obligations); the cache I/O
+    # must be with-guarded inside ops/regex/ modules.
+    FUSED_GEOMETRY_CACHE_CLEAN = """
+    import numpy as np
+
+    class FusedSetExecFx:
+        def _device_kernel(self):
+            with self._kernel_lock:
+                if self._kernel is None:
+                    self._kernel = build_kernel(self.fdfa)
+                return self._kernel
+
+        def _load_cache(self, path):
+            with np.load(path, allow_pickle=False) as z:
+                return dict(z)
+
+        def _save_cache(self, path, arrays):
+            with open(path + ".tmp", "wb") as f:
+                np.savez(f, **arrays)
+            replace(path + ".tmp", path)
+    """
+
+    FUSED_CACHE_RAW_HANDLE = """
+    import numpy as np
+
+    def save_cache(path, arrays):
+        f = open(path + ".tmp", "wb")
+        np.savez(f, **arrays)
+        f.close()
+    """
+
+    FUSED_CACHE_RAW_LOAD = """
+    import numpy as np
+
+    def load_cache(path):
+        z = np.load(path, allow_pickle=False)
+        return dict(z)
+    """
+
+    def test_fused_geometry_cache_pattern_is_clean(self):
+        assert scan(self.FUSED_GEOMETRY_CACHE_CLEAN, AcquireReleaseChecker(),
+                    relpath="loongcollector_tpu/ops/regex/fixture_fuse.py"
+                    ) == []
+
+    def test_fused_cache_raw_open_flagged(self):
+        findings = scan(self.FUSED_CACHE_RAW_HANDLE, AcquireReleaseChecker(),
+                        relpath="loongcollector_tpu/ops/regex/fixture_fuse.py")
+        assert len(findings) == 1
+        assert "compile-cache file handle" in findings[0].message
+
+    def test_fused_cache_raw_np_load_flagged(self):
+        findings = scan(self.FUSED_CACHE_RAW_LOAD, AcquireReleaseChecker(),
+                        relpath="loongcollector_tpu/ops/regex/fixture_fuse.py")
+        assert len(findings) == 1
+
+    def test_cache_handle_rule_scoped_to_regex_modules(self):
+        # the same raw open() OUTSIDE ops/regex/ is not this rule's
+        # business — general handle hygiene belongs to the
+        # ResourceWarning sweep
+        assert scan(self.FUSED_CACHE_RAW_HANDLE, AcquireReleaseChecker(),
+                    relpath="loongcollector_tpu/flusher/fixture.py") == []
+
     def test_raw_acquire_in_loop_flagged(self):
         src = """
         def drain(plane, sizes):
