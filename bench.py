@@ -406,151 +406,208 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         SenderQueueManager
     from loongcollector_tpu.runner.processor_runner import ProcessorRunner
 
+    # loongledger: the headline e2e run doubles as a live conservation
+    # audit — per-boundary totals + residual + worst queue lag are
+    # recorded under extra.conservation, and a nonzero post-quiesce
+    # residual FAILS the bench (sojourn mode only: the scaling sweep's
+    # short windows stay hook-free)
+    from loongcollector_tpu.monitor import ledger as _ledger
+    if sojourn:
+        _ledger.enable()
+        _ledger.reset()
+
     pqm = ProcessQueueManager()
     mgr = CollectionPipelineManager(pqm, SenderQueueManager())
     runner = ProcessorRunner(pqm, mgr, thread_count=thread_count)
     runner.init()
-    diff = ConfigDiff()
-    diff.added["bench-e2e"] = {
-        "inputs": [{"Type": "input_static_file_onetime",
-                    "FilePaths": ["/nonexistent"]}],
-        "global": {"ProcessQueueCapacity": 40},
-        "processors": [{"Type": "processor_parse_regex_tpu",
-                        "Regex": APACHE,
-                        "Keys": ["ip", "ident", "user", "time", "method",
-                                 "url", "proto", "status", "size"]}],
-        "flushers": [{"Type": "flusher_blackhole"}],
-    }
-    mgr.update_pipelines(diff)
-    p = mgr.find_pipeline("bench-e2e")
-    lines = gen_lines(4096)
-    chunk = b"\n".join(lines) + b"\n"
-    # affinity identity rides file-path METADATA (what real file pipelines
-    # carry): it routes groups to shards without entering the serialized
-    # payload the way a group tag would
-    from loongcollector_tpu.models import EventGroupMetaKey
-    sources = ["/var/log/bench/src-%d.log" % i for i in range(8)]
-    seq = [0]
+    try:
+        diff = ConfigDiff()
+        diff.added["bench-e2e"] = {
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "global": {"ProcessQueueCapacity": 40},
+            "processors": [{"Type": "processor_parse_regex_tpu",
+                            "Regex": APACHE,
+                            "Keys": ["ip", "ident", "user", "time", "method",
+                                     "url", "proto", "status", "size"]}],
+            "flushers": [{"Type": "flusher_blackhole"}],
+        }
+        mgr.update_pipelines(diff)
+        p = mgr.find_pipeline("bench-e2e")
+        lines = gen_lines(4096)
+        chunk = b"\n".join(lines) + b"\n"
+        # affinity identity rides file-path METADATA (what real file pipelines
+        # carry): it routes groups to shards without entering the serialized
+        # payload the way a group tag would
+        from loongcollector_tpu.models import EventGroupMetaKey
+        sources = ["/var/log/bench/src-%d.log" % i for i in range(8)]
+        seq = [0]
 
-    # warm-up: compile the kernel geometry outside the timed window
-    def _mk(payload: bytes):
-        sb0 = SourceBuffer(len(payload) + 64)
-        g0 = PipelineEventGroup(sb0)
-        g0.add_raw_event(1).set_content(sb0.copy_string(payload))
-        g0.set_metadata(EventGroupMetaKey.LOG_FILE_PATH,
-                        sources[seq[0] % len(sources)])
-        seq[0] += 1
-        return g0
+        # warm-up: compile the kernel geometry outside the timed window
+        def _mk(payload: bytes):
+            sb0 = SourceBuffer(len(payload) + 64)
+            g0 = PipelineEventGroup(sb0)
+            g0.add_raw_event(1).set_content(sb0.copy_string(payload))
+            g0.set_metadata(EventGroupMetaKey.LOG_FILE_PATH,
+                            sources[seq[0] % len(sources)])
+            seq[0] += 1
+            return g0
 
-    pqm.push_queue(p.process_queue_key, _mk(chunk))
-    bh = p.flushers[0].plugin
-    deadline = time.monotonic() + 120
-    # queue emptiness ≠ processed: wait until the warm-up group reached the
-    # sink (i.e. the kernel geometry is compiled) before starting the clock
-    while bh.total_events == 0 and time.monotonic() < deadline:
-        time.sleep(0.005)
-    if bh.total_events == 0:
-        raise RuntimeError("pipeline warm-up never completed")
-    # zero the process-global latency histograms AFTER warm-up so the
-    # reported trajectory describes THIS e2e run, not the microbenches
-    # (bench_regex etc.) that ran earlier in the same process
-    from loongcollector_tpu.ops.device_plane import roundtrip_histogram
-    from loongcollector_tpu.pipeline.queue.bounded_queue import \
-        queue_wait_histogram
-    runner.e2e_hist.snapshot(reset=True)
-    roundtrip_histogram().snapshot(reset=True)
-    queue_wait_histogram().snapshot(reset=True)
-    for inst in p.inner_processors + p.processors:
-        inst.stage_hist.snapshot(reset=True)
-    # best-of-3: the bench host is a shared single core — transient CPU
-    # steal (co-tenants, monitoring probes) halves a single sample; the
-    # least-contended trial is the honest machine capability
-    best_dt = None
-    pushed_bytes = 0
-    for _trial in range(3):
-        base_events = bh.total_events
-        t0 = time.perf_counter()
-        pushed_bytes = 0
-        push_deadline = time.monotonic() + 120
-        while pushed_bytes < n_lines * 90:
-            g = _mk(chunk)
-            while not pqm.push_queue(p.process_queue_key, g):
-                if time.monotonic() > push_deadline:
-                    raise RuntimeError(
-                        "pipeline stopped draining during bench")
-                time.sleep(0.001)
-            pushed_bytes += len(chunk)
-        want_events = base_events + 4096 * (pushed_bytes // len(chunk))
+        pqm.push_queue(p.process_queue_key, _mk(chunk))
+        bh = p.flushers[0].plugin
         deadline = time.monotonic() + 120
-        while bh.total_events < want_events and time.monotonic() < deadline:
-            time.sleep(0.001)
-        dt = time.perf_counter() - t0
-        # the throughput drain must be complete BEFORE the sojourn pushes
-        # add events, or an incomplete drain slips past the guard and
-        # corrupts the latency samples with backlog arrivals
-        if bh.total_events < want_events:
-            raise RuntimeError(
-                f"drain incomplete: {bh.total_events}/{want_events} events")
-        if best_dt is None or dt < best_dt:
-            best_dt = dt
-    dt = best_dt
-    if not sojourn:
-        # scaling-sweep mode: throughput only, keep the window short
-        mbps = pushed_bytes / dt / 1e6
+        # queue emptiness ≠ processed: wait until the warm-up group reached the
+        # sink (i.e. the kernel geometry is compiled) before starting the clock
+        while bh.total_events == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if bh.total_events == 0:
+            raise RuntimeError("pipeline warm-up never completed")
+        # zero the process-global latency histograms AFTER warm-up so the
+        # reported trajectory describes THIS e2e run, not the microbenches
+        # (bench_regex etc.) that ran earlier in the same process
+        from loongcollector_tpu.ops.device_plane import roundtrip_histogram
+        from loongcollector_tpu.pipeline.queue.bounded_queue import \
+            queue_wait_histogram
+        runner.e2e_hist.snapshot(reset=True)
+        roundtrip_histogram().snapshot(reset=True)
+        queue_wait_histogram().snapshot(reset=True)
+        for inst in p.inner_processors + p.processors:
+            inst.stage_hist.snapshot(reset=True)
+        # best-of-3: the bench host is a shared single core — transient CPU
+        # steal (co-tenants, monitoring probes) halves a single sample; the
+        # least-contended trial is the honest machine capability
+        best_dt = None
+        pushed_bytes = 0
+        max_lag_s = 0.0
+        for _trial in range(3):
+            base_events = bh.total_events
+            t0 = time.perf_counter()
+            pushed_bytes = 0
+            push_deadline = time.monotonic() + 120
+            while pushed_bytes < n_lines * 90:
+                g = _mk(chunk)
+                while not pqm.push_queue(p.process_queue_key, g):
+                    if time.monotonic() > push_deadline:
+                        raise RuntimeError(
+                            "pipeline stopped draining during bench")
+                    time.sleep(0.001)
+                pushed_bytes += len(chunk)
+            want_events = base_events + 4096 * (pushed_bytes // len(chunk))
+            deadline = time.monotonic() + 120
+            next_lag_sample = 0.0
+            while bh.total_events < want_events and time.monotonic() < deadline:
+                now = time.monotonic()
+                if sojourn and now >= next_lag_sample:
+                    # per-pipeline lag watermark, sampled while the backlog
+                    # drains — the max is the run's worst backpressure moment.
+                    # ~10 Hz: the watermark moves on tens-of-ms timescales and
+                    # each sample walks the manager + queue locks the workers'
+                    # hot path contends on — 1 kHz sampling would deflate the
+                    # throughput number being measured
+                    next_lag_sample = now + 0.1
+                    max_lag_s = max(max_lag_s, _ledger.max_lag_seconds())
+                time.sleep(0.001)
+            dt = time.perf_counter() - t0
+            # the throughput drain must be complete BEFORE the sojourn pushes
+            # add events, or an incomplete drain slips past the guard and
+            # corrupts the latency samples with backlog arrivals
+            if bh.total_events < want_events:
+                raise RuntimeError(
+                    f"drain incomplete: {bh.total_events}/{want_events} events")
+            if best_dt is None or dt < best_dt:
+                best_dt = dt
+        dt = best_dt
+        if not sojourn:
+            # scaling-sweep mode: throughput only, keep the window short
+            return (pushed_bytes / dt / 1e6, None, None, None, None, None)
+        make_group = _mk
+        # event→flush sojourn: push single-chunk groups one at a time and time
+        # arrival at the sink (the BASELINE p99 latency metric)
+        sojourns = []
+        small = b"\n".join(lines[:256]) + b"\n"
+        # warm the small-batch geometry (its first parse jit-compiles)
+        warm_base = bh.total_events
+        if not pqm.push_queue(p.process_queue_key, make_group(small)):
+            raise RuntimeError("small warm-up push rejected")
+        warm_deadline = time.monotonic() + 120
+        while bh.total_events < warm_base + 256 and \
+                time.monotonic() < warm_deadline:
+            time.sleep(0.002)
+        if bh.total_events < warm_base + 256:
+            raise RuntimeError("small warm-up never completed")
+        for _ in range(50):
+            base_events = bh.total_events
+            g = make_group(small)
+            t1 = time.perf_counter()
+            if not pqm.push_queue(p.process_queue_key, g):
+                raise RuntimeError("sojourn push rejected (queue full)")
+            lat_deadline = time.monotonic() + 10
+            while bh.total_events < base_events + 256 and \
+                    time.monotonic() < lat_deadline:
+                time.sleep(0.0005)
+            if bh.total_events < base_events + 256:
+                raise RuntimeError("sojourn group never reached the sink")
+            sojourns.append((time.perf_counter() - t1) * 1000)
+        sojourns.sort()
+        # the always-on latency histograms accumulated since the post-warm-up
+        # reset: per-group pop→sent latency, device submit→resolve round-trips
+        # and process-queue waits — the per-stage balance view next to
+        # throughput.  loongshard adds the per-plugin stage histograms so the
+        # trajectory shows WHERE recovered time came from (split vs parse).
+        trajectory = {
+            "pipeline_e2e": _hist_ms(runner.e2e_hist),
+            "device_roundtrip": _hist_ms(roundtrip_histogram()),
+            "queue_wait": _hist_ms(queue_wait_histogram()),
+            "stages": {
+                inst.plugin_id: _hist_ms(inst.stage_hist)
+                for inst in (p.inner_processors + p.processors)
+            },
+            "process_workers": runner.thread_count,
+        }
+        utilization = _collect_utilization(pqm, p, bh, runner)
+        conservation = _collect_conservation(_ledger, max_lag_s)
+        return (pushed_bytes / dt / 1e6,
+                sojourns[len(sojourns) // 2],
+                sojourns[int(len(sojourns) * 0.99)],
+                trajectory, utilization, conservation)
+    finally:
+        # ANY raise between init and the return (warm-up timeout,
+        # drain incomplete, failed audit) must not leak the worker
+        # threads or a still-enabled ledger into the following
+        # sub-benches (_safe() swallows the exception, so the leak
+        # would silently skew their numbers)
         runner.stop()
         mgr.stop_all()
-        return (mbps, None, None, None, None)
-    make_group = _mk
-    # event→flush sojourn: push single-chunk groups one at a time and time
-    # arrival at the sink (the BASELINE p99 latency metric)
-    sojourns = []
-    small = b"\n".join(lines[:256]) + b"\n"
-    # warm the small-batch geometry (its first parse jit-compiles)
-    warm_base = bh.total_events
-    if not pqm.push_queue(p.process_queue_key, make_group(small)):
-        raise RuntimeError("small warm-up push rejected")
-    warm_deadline = time.monotonic() + 120
-    while bh.total_events < warm_base + 256 and \
-            time.monotonic() < warm_deadline:
-        time.sleep(0.002)
-    if bh.total_events < warm_base + 256:
-        raise RuntimeError("small warm-up never completed")
-    for _ in range(50):
-        base_events = bh.total_events
-        g = make_group(small)
-        t1 = time.perf_counter()
-        if not pqm.push_queue(p.process_queue_key, g):
-            raise RuntimeError("sojourn push rejected (queue full)")
-        lat_deadline = time.monotonic() + 10
-        while bh.total_events < base_events + 256 and \
-                time.monotonic() < lat_deadline:
-            time.sleep(0.0005)
-        if bh.total_events < base_events + 256:
-            raise RuntimeError("sojourn group never reached the sink")
-        sojourns.append((time.perf_counter() - t1) * 1000)
-    sojourns.sort()
-    # the always-on latency histograms accumulated since the post-warm-up
-    # reset: per-group pop→sent latency, device submit→resolve round-trips
-    # and process-queue waits — the per-stage balance view next to
-    # throughput.  loongshard adds the per-plugin stage histograms so the
-    # trajectory shows WHERE recovered time came from (split vs parse).
-    trajectory = {
-        "pipeline_e2e": _hist_ms(runner.e2e_hist),
-        "device_roundtrip": _hist_ms(roundtrip_histogram()),
-        "queue_wait": _hist_ms(queue_wait_histogram()),
-        "stages": {
-            inst.plugin_id: _hist_ms(inst.stage_hist)
-            for inst in (p.inner_processors + p.processors)
-        },
-        "process_workers": runner.thread_count,
+        if sojourn:
+            _ledger.disable()
+
+
+def _collect_conservation(_ledger, max_lag_s: float) -> dict:
+    """Post-quiesce conservation audit of the e2e run: the full boundary
+    matrix, per-pipeline residuals, and the worst queue lag sampled during
+    the drain.  A nonzero residual at quiesce means the agent LOST events
+    mid-bench — that fails the whole run, loudly: SystemExit so the
+    _safe() sub-bench guard (which only swallows Exception) cannot turn
+    the loss into a one-line stderr note and a green exit code."""
+    snap = _ledger.wait_quiesced(timeout=30.0)
+    if snap is None:
+        raise SystemExit(
+            "conservation audit: ledger never quiesced "
+            f"(live_inflight={_ledger.live_inflight()})")
+    residuals = _ledger.residuals(snap)
+    bad = {pl: r for pl, r in residuals.items() if r != 0}
+    if bad:
+        raise SystemExit(
+            f"conservation audit FAILED: nonzero residual {bad}; "
+            f"boundary snapshot: {snap}")
+    return {
+        "residual": 0,
+        "residuals": residuals,
+        "max_queue_lag_seconds": round(max_lag_s, 4),
+        "boundaries": {
+            pl: {b: row["events"] for b, row in rows.items()}
+            for pl, rows in snap.items() if pl},
     }
-    utilization = _collect_utilization(pqm, p, bh, runner)
-    runner.stop()
-    mgr.stop_all()
-    return (pushed_bytes / dt / 1e6,
-            sojourns[len(sojourns) // 2],
-            sojourns[int(len(sojourns) * 0.99)],
-            trajectory, utilization)
 
 
 def _collect_utilization(pqm, p, bh, runner, n_groups=24, window_s=8.0):
@@ -628,7 +685,7 @@ def bench_scaling(n_lines=200000):
     2x, and that ceiling, not the sharding design, bounds the ratio."""
     out = {}
     for tc in (1, 2, 4):
-        mbps, _, _, _, _ = bench_pipeline_e2e(n_lines=n_lines,
+        mbps, _, _, _, _, _ = bench_pipeline_e2e(n_lines=n_lines,
                                               thread_count=tc, sojourn=False)
         out[f"threads_{tc}"] = round(mbps, 1)
     if out.get("threads_1"):
@@ -923,6 +980,11 @@ def main():
         # the per-scope top-5 self-cost — BENCH_*.json now records WHY a
         # run was slow, not just that it was (docs/observability.md)
         extra["utilization"] = e2e3[4]
+        # loongledger: per-boundary event totals, post-quiesce residual
+        # (always 0 — a nonzero residual raises and fails the bench), and
+        # the worst per-pipeline queue lag sampled during the drain
+        if e2e3[5] is not None:
+            extra["conservation"] = e2e3[5]
     # the headline pipeline_e2e_MBps stays the full default-config run —
     # the sweep uses shorter windows, so its numbers live under scaling
     # only and never replace the headline they would be inconsistent with

@@ -12,6 +12,7 @@ from .metric_naming import MetricNamingChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
+from .unledgered_drop import UnledgeredDropChecker
 
 _CHECKER_CLASSES = [
     AcquireReleaseChecker,
@@ -19,6 +20,7 @@ _CHECKER_CLASSES = [
     TracingHygieneChecker,
     RegistryConsistencyChecker,
     SwallowedFaultChecker,
+    UnledgeredDropChecker,
     MetricNamingChecker,
 ]
 

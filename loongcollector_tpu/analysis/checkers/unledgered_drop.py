@@ -1,0 +1,174 @@
+"""unledgered-drop: event discards invisible to the conservation ledger.
+
+The loongledger invariant (docs/observability.md#event-conservation-ledger)
+is that every event admitted at `ingest` leaves through a counted exit —
+``send_ok``, ``spill``, ``quarantine``, ``process_drop`` or a reason-tagged
+``drop``.  The ConservationAuditor enforces that at runtime; this checker
+is the static half of the same contract: a code path in the event-carrying
+scopes (``runner/``, ``flusher/``, ``input/`` and the hand-off queues in
+``pipeline/queue/``) that discards an event group without any ledger
+awareness in its function would show up, at runtime, as a nonzero residual
+with no reason bucket — the exact silent loss the ledger exists to rule
+out.
+
+Discard-site anchors (what marks a function as "this path discards"):
+
+  1. a logging/alarm call whose LITERAL text mentions drop/discard/
+     quarantine/shed — the repo's established idiom is to log every
+     intentional discard (swallowed-fault forces at least that much);
+  2. an augmented increment of a counter whose name contains ``drop``
+     (``self.total_dropped += 1`` — the CircularProcessQueue shape);
+  3. a broad except handler whose body ends in ``continue``/``return``
+     inside a loop — continue-after-except abandons the current item
+     (extends swallowed-fault: logging the fault is not enough when the
+     payload it carried vanishes too).
+
+A function containing an anchor must also contain a ledger touch: a call
+on a ``ledger`` receiver (``ledger.record``, ``ledger.is_on``), or a
+``self._ledger*`` helper.  Function granularity is deliberate — the record
+often lives in a sibling branch of the discard (verdict dispatch) — the
+rule is "this discard path knows the ledger exists", not "the record is
+adjacent".
+
+Escape: ``# loonglint: disable=unledgered-drop`` with a justification,
+for discards of things that are not events (metrics payloads, self-monitor
+internals, replay files whose events were never admitted this run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, attr_tail, call_name
+
+CHECK = "unledgered-drop"
+
+_SCOPES = ("/runner/", "/flusher/", "/input/", "/pipeline/queue/")
+_LOG_TAILS = {"debug", "info", "warning", "error", "exception", "critical",
+              "send_alarm"}
+_DROP_WORDS = ("drop", "discard", "quarantin", "shed")
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _literal_text(node: ast.AST) -> str:
+    """Every string literal reachable inside an expression (plain, f-string
+    parts, concatenations, %-format left sides), lowercased and joined."""
+    parts: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            parts.append(sub.value.lower())
+    return " ".join(parts)
+
+
+def _is_drop_log(call: ast.Call) -> bool:
+    if attr_tail(call) not in _LOG_TAILS:
+        return False
+    text = " ".join(_literal_text(a) for a in call.args)
+    return any(w in text for w in _DROP_WORDS)
+
+
+def _is_drop_counter(node: ast.AugAssign) -> bool:
+    if not isinstance(node.op, ast.Add):
+        return False
+    target = node.target
+    name = ""
+    if isinstance(target, ast.Attribute):
+        name = target.attr
+    elif isinstance(target, ast.Name):
+        name = target.id
+    return "drop" in name.lower()
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(el, ast.Name) and el.id in _BROAD_NAMES
+                   for el in t.elts)
+    return False
+
+
+def _abandons_item(handler: ast.ExceptHandler, in_loop: bool) -> bool:
+    """continue-after-except (or return-after-except in a loop body):
+    the handler runs, then the current item is never seen again."""
+    if not in_loop or not handler.body:
+        return False
+    last = handler.body[-1]
+    return isinstance(last, (ast.Continue, ast.Return))
+
+
+def _touches_ledger(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = call_name(node)
+        head = dotted.split(".", 1)[0]
+        if head == "ledger" or dotted.startswith("_ledger"):
+            return True
+        # self._ledger_pipeline() / self._ledger_error_drop(...)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr.startswith("_ledger"):
+            return True
+    return False
+
+
+class UnledgeredDropChecker(Checker):
+    name = CHECK
+    description = ("event discards in runner//flusher//input//pipeline/queue/"
+                   " must live in functions that record into the conservation"
+                   " ledger (the static half of the zero-loss audit)")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        relpath = "/" + mod.relpath
+        if not any(scope in relpath for scope in _SCOPES):
+            return
+        for qn, fn in _iter_functions(mod.tree):
+            anchors = list(self._anchors(fn))
+            if not anchors:
+                continue
+            if _touches_ledger(fn):
+                continue
+            for line, col, what in anchors:
+                yield Finding(
+                    CHECK, mod.relpath, line, col,
+                    f"{what} with no ledger.record/_ledger* call anywhere in "
+                    "the function: this discard is invisible to the "
+                    "conservation audit (an unattributed residual at "
+                    "runtime)",
+                    symbol=qn)
+
+    def _anchors(self, fn: ast.AST) -> Iterator[Tuple[int, int, str]]:
+
+        def visit(node: ast.AST, in_loop: bool) -> Iterator[
+                Tuple[int, int, str]]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue          # nested functions anchor themselves
+                child_in_loop = in_loop or isinstance(
+                    child, (ast.For, ast.While, ast.AsyncFor))
+                if isinstance(child, ast.Call) and _is_drop_log(child):
+                    yield (child.lineno, child.col_offset,
+                           "discard logged here")
+                elif isinstance(child, ast.AugAssign) \
+                        and _is_drop_counter(child):
+                    yield (child.lineno, child.col_offset,
+                           "drop counter incremented here")
+                elif isinstance(child, ast.ExceptHandler) \
+                        and _is_broad(child) \
+                        and _abandons_item(child, in_loop):
+                    yield (child.lineno, child.col_offset,
+                           "broad except abandons the current item "
+                           "(continue/return-after-except)")
+                yield from visit(child, child_in_loop)
+
+        yield from visit(fn, False)
+
+
+def _iter_functions(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    from ..core import iter_functions
+    return iter_functions(tree)

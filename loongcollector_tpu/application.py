@@ -101,6 +101,13 @@ class Application:
         if prof.install_from_env():
             log.info("loongprof ACTIVE (%.0f Hz)",
                      prof.active_profiler().hz)
+        # loongledger: LOONG_LEDGER=1 turns on event-conservation
+        # accounting; LOONG_LEDGER_AUDIT=1 additionally runs the
+        # continuous zero-loss auditor (docs/observability.md)
+        from .monitor import ledger
+        if ledger.install_from_env():
+            log.info("loongledger ACTIVE (audit=%s)",
+                     ledger.auditor() is not None)
         from .monitor.exposition import start_from_env as _expo_from_env
         self.exposition = _expo_from_env()
         from .runner.processor_runner import resolve_thread_count

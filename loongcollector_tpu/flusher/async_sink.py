@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
+from ..monitor import ledger
 from ..pipeline.plugin.interface import PluginContext
 from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..runner.circuit import BreakerState, SinkCircuitBreaker
@@ -63,7 +64,7 @@ class _ReplayTarget:
         self.queue_key = flusher.queue_key
 
     def push(self, item: SenderQueueItem) -> bool:
-        return self._flusher._requeue_payload(item.data)
+        return self._flusher._requeue_payload(item.data, item.event_cnt)
 
 
 class AsyncSinkFlusher(HttpSinkFlusher):
@@ -75,6 +76,9 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         self._queue: collections.deque = collections.deque()
         self._qlock = threading.Lock()
         self._qcv = threading.Condition(self._qlock)
+        # events claimed out of the queue but still mid-spill (the disk
+        # write can block for seconds): occupancy for inflight_events()
+        self._spilling_events = 0
         self._sender: Optional[threading.Thread] = None
         self._running = False
         self.circuit: Optional[SinkCircuitBreaker] = None
@@ -111,19 +115,32 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        n_events = sum(len(g) for g in groups)
         built = self.build_payload(groups)
         if built is None:
+            self._ledger_drop("payload_skipped", n_events)
             return
         body, _ = built
+        if ledger.is_on():
+            ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
+                          n_events, len(body))
+        shed = None
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
-                dropped = self._queue.popleft()   # oldest-first shedding
-                log.error("%s queue full; dropping oldest payload "
-                          "(%d bytes)", self.name, len(dropped[0]))
-            self._queue.append((body, time.monotonic()))
+                shed = self._queue.popleft()      # oldest-first shedding
+            self._queue.append((body, time.monotonic(), n_events))
             self._qcv.notify()
+        if shed is not None:
+            # ledger + log OUTSIDE the queue lock (the ledger takes its
+            # own lock).  The popper is the terminal authority for a
+            # payload, so this drop is the shed entry's ONLY terminal —
+            # the sender loop skips its record when the head it delivered
+            # was shed from under it
+            log.error("%s queue full; dropping oldest payload (%d bytes)",
+                      self.name, len(shed[0]))
+            self._ledger_drop("queue_shed", shed[2], len(shed[0]))
 
-    def _requeue_payload(self, body: bytes) -> bool:
+    def _requeue_payload(self, body: bytes, event_cnt: int = 0) -> bool:
         """Replayed disk-buffer payload re-enters the send queue with a
         fresh TTL (its on-disk wait must not count against it).  At
         capacity the replay is REFUSED (False) — shedding a live queued
@@ -132,7 +149,7 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
                 return False
-            self._queue.append((body, time.monotonic()))
+            self._queue.append((body, time.monotonic(), event_cnt))
             self._qcv.notify()
             return True
 
@@ -150,19 +167,33 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             with self._qcv:
                 if not self._queue:
                     break
-                body, born = self._queue[0]
+                # claim the head BEFORE spilling: queue-full shedding must
+                # never race the buffer write into a double terminal
+                # (drop(queue_shed) + spill) for the same payload.  The
+                # claimed payload moves to _spilling_events under the SAME
+                # lock — during the buffer write (which can block on fsync
+                # for whole auditor intervals) it is in no queue, and
+                # without this anchor a stable ledger + empty queue would
+                # read as a quiesce with a nonzero residual (false
+                # CONSERVATION_RESIDUAL alarm)
+                entry = self._queue.popleft()
+                self._spilling_events += entry[2]
+            body, born, events = entry
             item = SenderQueueItem(body, len(body), flusher=self,
-                                   queue_key=self.queue_key)
+                                   queue_key=self.queue_key,
+                                   event_cnt=events)
             if not self.disk_buffer.spill(item, identity):
+                with self._qcv:
+                    self._queue.appendleft(entry)   # buffer full: restore
+                    self._spilling_events -= events
                 break
+            with self._qcv:
+                # B_SPILL was recorded inside spill() — the terminal is on
+                # the books before the occupancy anchor drops
+                self._spilling_events -= events
             moved += 1
             if self.circuit is not None:
                 self.circuit.note_spilled()
-            with self._qcv:
-                # shedding may have rotated the deque while spilling: only
-                # drop the exact payload that reached disk
-                if self._queue and self._queue[0][0] is body:
-                    self._queue.popleft()
         if moved:
             log.warning("%s circuit open: spilled %d pending payloads to "
                         "disk buffer", self.name, moved)
@@ -214,7 +245,7 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 if not self._queue:
                     continue
                 item = self._queue[0]
-                body, born = item
+                body, born, n_events = item
             if self.breaker is not None and not self.breaker.allow():
                 time.sleep(min(delay, 1.0))
                 continue
@@ -230,6 +261,11 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 ok = True
             except Exception as e:  # noqa: BLE001
                 ok = False
+                if ledger.is_on():
+                    # informational, not a conservation term: one failed
+                    # attempt — the payload stays inflight
+                    ledger.record(self._ledger_pipeline(),
+                                  ledger.B_SEND_FAIL, n_events)
                 if not self.retryable(e) \
                         or time.monotonic() - born > RETRY_TTL_S:
                     log.error("%s delivery failed permanently, dropping "
@@ -266,8 +302,29 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 # pop by IDENTITY: queue-full shedding may have removed the
                 # in-flight head while the lock was released during deliver;
                 # popping by position would discard an undelivered payload
-                if self._queue and self._queue[0] is item:
+                owned = bool(self._queue) and self._queue[0] is item
+                if owned:
                     self._queue.popleft()
+            # the POPPER is the single terminal-ledger authority for a
+            # payload: if shedding raced the delivery and popped the head,
+            # it already recorded drop(queue_shed) — recording send_ok too
+            # would double-count the same events (negative residual, false
+            # CONSERVATION_RESIDUAL alarm)
+            if owned and ledger.is_on():
+                if ok:
+                    ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
+                                  n_events, len(body))
+                else:   # ok is None — permanent, reason-tagged discard
+                    ledger.record(self._ledger_pipeline(), ledger.B_DROP,
+                                  n_events, len(body), tag="delivery_failed")
+
+    def inflight_events(self) -> int:
+        """Events queued inside this sink's own sender hop (the payload
+        mid-delivery stays at the queue head; a payload mid-spill is in
+        _spilling_events) — the ledger's live-occupancy probe."""
+        with self._qlock:
+            return (sum(entry[2] for entry in self._queue)
+                    + self._spilling_events)
 
     def build_request(self, item):
         raise RuntimeError(f"{self.name} sends on its own connection")

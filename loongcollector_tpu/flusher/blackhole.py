@@ -13,6 +13,7 @@ from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
 
 class FlusherBlackHole(Flusher):
     name = "flusher_blackhole"
+    ledger_terminal = True  # loongledger: send() IS delivery
 
     def __init__(self) -> None:
         super().__init__()

@@ -16,6 +16,10 @@ from ..pipeline.serializer.json_serializer import JsonSerializer
 
 class FlusherFile(Flusher):
     name = "flusher_file"
+    # loongledger: NOT ledger_terminal — send() only stages into the
+    # batcher (whose occupancy the auditor counts); the terminal record
+    # lands in _flush_groups AFTER the write, so a failed write is a
+    # visible drop, never a pre-booked send_ok
 
     def __init__(self) -> None:
         super().__init__()
@@ -46,10 +50,12 @@ class FlusherFile(Flusher):
         return True
 
     def _flush_groups(self, groups: List[PipelineEventGroup]) -> None:
-        data = self.serializer.serialize(groups)
-        with self._lock:
-            with open(self.file_path, "ab") as f:
-                f.write(data)
+        def write():
+            data = self.serializer.serialize(groups)
+            with self._lock:
+                with open(self.file_path, "ab") as f:
+                    f.write(data)
+        self._ledger_terminal_write(groups, write)
 
     def flush_all(self) -> bool:
         self.batcher.flush_all()

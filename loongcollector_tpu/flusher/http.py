@@ -14,6 +14,7 @@ from urllib.parse import urlparse
 import time
 
 from ..models import EventGroupMetaKey, PipelineEventGroup
+from ..monitor import ledger
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
 from ..pipeline.compression import create_compressor
@@ -97,7 +98,9 @@ class FlusherHTTP(Flusher):
     def send(self, group: PipelineEventGroup) -> bool:
         if self.flush_interceptor is not None \
                 and not self.flush_interceptor.filter([group]):
-            return True                 # filtered out, not an error
+            # filtered out, not an error — but terminal for these events
+            self._ledger_drop("flush_filtered", group=group)
+            return True
         if self.eo_sender is not None:
             return self._send_exactly_once(group)
         self.batcher.add(group)
@@ -129,17 +132,29 @@ class FlusherHTTP(Flusher):
                 break
             time.sleep(0.005)
         if cp is None:
-            return False  # shutting down; range stays uncommitted → replay
+            # shutting down; range stays uncommitted → checkpoint replay
+            # re-reads the SOURCE bytes next start, so the events never
+            # entered the sink path — a terminal discard for THIS run
+            self._ledger_drop("eo_shutdown", group=group)
+            return False
         data = self.serializer.serialize([group])
+        if ledger.is_on():
+            ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
+                          len(group), len(data))
         payload = self.compressor.compress(data)
         item = SenderQueueItem(payload, len(data), flusher=self,
                                queue_key=self.queue_key,
-                               tag={"eo_cp": cp})
-        if self.sender_queue is not None:
-            self.sender_queue.push(item)
+                               tag={"eo_cp": cp}, event_cnt=len(group))
+        if self.sender_queue is None:
+            self._ledger_drop("no_sender_queue", len(group))
+        elif not self.sender_queue.push(item):
+            # refused push (queue retired mid-hot-reload): terminal —
+            # nothing downstream will ever dispatch or count this payload
+            self._ledger_drop("queue_retired", len(group))
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        n_events = sum(len(g) for g in groups)
         if self._encoder_ext is not None:
             data = self._encoder_ext.encode(groups)
         else:
@@ -147,11 +162,16 @@ class FlusherHTTP(Flusher):
             # directly (SLS returns a memoryview; others return bytes)
             data = self.serializer.serialize_view(groups)
         raw_size = len(data)
+        if ledger.is_on():
+            ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
+                          n_events, raw_size)
         payload = self.compressor.compress(data)
         item = SenderQueueItem(payload, raw_size, flusher=self,
-                               queue_key=self.queue_key)
-        if self.sender_queue is not None:
-            self.sender_queue.push(item)
+                               queue_key=self.queue_key, event_cnt=n_events)
+        if self.sender_queue is None:
+            self._ledger_drop("no_sender_queue", n_events)
+        elif not self.sender_queue.push(item):
+            self._ledger_drop("queue_retired", n_events)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
         from .http_base import check_breaker

@@ -14,6 +14,7 @@ import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
+from ..monitor import ledger
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
 from ..pipeline.compression import create_compressor
@@ -75,22 +76,35 @@ class HttpSinkFlusher(Flusher):
     def send(self, group: PipelineEventGroup) -> bool:
         if self.flush_interceptor is not None \
                 and not self.flush_interceptor.filter([group]):
-            return True                 # filtered out, not an error
+            # filtered out, not an error — but terminal for these events
+            self._ledger_drop("flush_filtered", group=group)
+            return True
         self.batcher.add(group)
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
+        n_events = sum(len(g) for g in groups)
         built = self.build_payload(groups)
         if built is None:
+            # the sink's payload builder skipped the whole batch: terminal
+            self._ledger_drop("payload_skipped", n_events)
             return
         body, item_headers = built
         raw_size = len(body)
+        if ledger.is_on():
+            ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
+                          n_events, raw_size)
         payload = self.compressor.compress(body)
         item = SenderQueueItem(payload, raw_size, flusher=self,
                                queue_key=self.queue_key,
-                               tag={"headers": item_headers})
-        if self.sender_queue is not None:
-            self.sender_queue.push(item)
+                               tag={"headers": item_headers},
+                               event_cnt=n_events)
+        if self.sender_queue is None:
+            # no sender queue wired (flusher stopped mid-flush): terminal
+            self._ledger_drop("no_sender_queue", n_events)
+        elif not self.sender_queue.push(item):
+            # refused push (queue retired mid-hot-reload): terminal
+            self._ledger_drop("queue_retired", n_events)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
         check_breaker(self)

@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..models import PipelineEventGroup
+from ..monitor import ledger
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
 from ..pipeline.plugin.interface import Flusher, PluginContext
@@ -48,6 +49,10 @@ class FlusherKafka(Flusher):
         self._running = False
         self.max_retries = 5
         self.circuit: Optional[SinkCircuitBreaker] = None
+        # loongledger live-occupancy probe: records handed to the sender
+        # thread but not yet terminally ledgered (send_ok or drop)
+        self._inflight_records = 0
+        self._inflight_lock = threading.Lock()
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -128,7 +133,27 @@ class FlusherKafka(Flusher):
         # processing thread (parity with the sender-queue path of the HTTP
         # flushers); bounded queue applies back-pressure at ~256 batches
         for topic, records in by_topic.items():
+            if ledger.is_on():
+                ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
+                              len(records),
+                              sum(len(line) for _k, line in records))
+            self._note_inflight(len(records))
             self._send_queue.put((topic, records, 0))
+
+    def _note_inflight(self, delta: int) -> None:
+        # tolerate partially-constructed instances (tests build the sender
+        # loop via __new__): no lock ⇒ no occupancy tracking, nothing else
+        lock = getattr(self, "_inflight_lock", None)
+        if lock is None:
+            return
+        with lock:
+            self._inflight_records += delta
+
+    def inflight_events(self) -> int:
+        """Records inside the sender hop (send queue + retry deque + the
+        batch mid-produce) — the ledger's live-occupancy probe."""
+        with self._inflight_lock:
+            return self._inflight_records
 
     def _send_loop(self) -> None:
         # Failed batches go to a consumer-local retry deque, drained before
@@ -166,6 +191,11 @@ class FlusherKafka(Flusher):
                 self.producer.send(topic, records)
                 if self.circuit is not None:
                     self.circuit.on_success()
+                if ledger.is_on():
+                    ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
+                                  len(records),
+                                  sum(len(line) for _k, line in records))
+                self._note_inflight(-len(records))
             except KafkaError as e:
                 if self.circuit is not None:
                     self.circuit.on_failure()
@@ -174,13 +204,30 @@ class FlusherKafka(Flusher):
                 # batches must not be duplicated by the retry
                 failed = getattr(e, "unacked", None)
                 if failed is not None:
+                    n_acked = len(records) - len(failed)
+                    if n_acked > 0 and ledger.is_on():
+                        # ack-window cut: the acked prefix IS delivered —
+                        # it ledgers as send_ok exactly once; only the
+                        # unacked tail stays inflight for the retry
+                        ledger.record(self._ledger_pipeline(),
+                                      ledger.B_SEND_OK, n_acked,
+                                      tag="partial_ack")
+                    self._note_inflight(-n_acked)
                     records = failed
+                if ledger.is_on():
+                    ledger.record(self._ledger_pipeline(),
+                                  ledger.B_SEND_FAIL, len(records))
                 if not records:
                     continue
                 if attempt + 1 >= self.max_retries:
                     log.error("kafka produce to %s failed after %d tries, "
                               "dropping %d records: %s",
                               topic, attempt + 1, len(records), e)
+                    if ledger.is_on():
+                        ledger.record(self._ledger_pipeline(), ledger.B_DROP,
+                                      len(records),
+                                      tag="kafka_retry_exhausted")
+                    self._note_inflight(-len(records))
                     continue
                 not_before = time.monotonic() + min(0.1 * (2 ** attempt), 5.0)
                 retry.append((topic, records, attempt + 1, not_before))

@@ -15,6 +15,9 @@ from ..pipeline.serializer.json_serializer import JsonSerializer
 
 class FlusherStdout(Flusher):
     name = "flusher_stdout"
+    # loongledger: NOT ledger_terminal — send() stages into the batcher;
+    # the terminal record lands in _flush_groups after the stream write
+    # (see FlusherFile for the rationale)
 
     def __init__(self) -> None:
         super().__init__()
@@ -38,9 +41,11 @@ class FlusherStdout(Flusher):
         return True
 
     def _flush_groups(self, groups: List[PipelineEventGroup]) -> None:
-        data = self.serializer.serialize(groups)
-        self._stream.write(data.decode("utf-8", "replace"))
-        self._stream.flush()
+        def write():
+            data = self.serializer.serialize(groups)
+            self._stream.write(data.decode("utf-8", "replace"))
+            self._stream.flush()
+        self._ledger_terminal_write(groups, write)
 
     def flush_all(self) -> bool:
         self.batcher.flush_all()

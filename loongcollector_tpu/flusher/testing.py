@@ -25,6 +25,7 @@ class FlusherChecker(Flusher):
     on counts and key/value pairs (flusher_checker.go:30-78)."""
 
     name = "flusher_checker"
+    ledger_terminal = True  # loongledger: retained in memory == delivered
 
     def __init__(self) -> None:
         super().__init__()
@@ -80,6 +81,7 @@ class FlusherSleep(Flusher):
     starvation scenarios (flusher_sleep.go)."""
 
     name = "flusher_sleep"
+    ledger_terminal = True  # loongledger: send() IS delivery
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -98,6 +100,7 @@ class FlusherStatistics(Flusher):
     measure the wire path."""
 
     name = "flusher_statistics"
+    ledger_terminal = True  # loongledger: send() IS delivery
 
     def __init__(self) -> None:
         super().__init__()

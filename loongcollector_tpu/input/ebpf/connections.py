@@ -234,6 +234,8 @@ class ConnectionManager:
             victim = min(self._conns.items(),
                          key=lambda kv: kv[1].last_seen)[0]
             del self._conns[victim]
+            # connection-table eviction, not an event discard: no events
+            # ride the evicted _Conn  # loonglint: disable=unledgered-drop
             self.dropped_conns += 1
         conn = _Conn(pid=raw.pid, fd=raw.fd, ktime=raw.ktime,
                      local_addr=raw.local_addr, remote_addr=raw.remote_addr,

@@ -186,6 +186,8 @@ class ProcessTreeCache:
                         del self._latest[ent.pid]
                     if ent.parent is not None:
                         ent.parent.refcnt -= 1
+                    # process-cache eviction, not an event discard
+                    # loonglint: disable=unledgered-drop
                     dropped += 1
         return dropped
 

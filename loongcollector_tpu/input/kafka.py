@@ -102,9 +102,11 @@ class InputKafka(Input):
         while self._running:
             try:
                 records = cons.poll(max_wait_ms=200)
-            except Exception as e:  # noqa: BLE001 — a malformed broker
-                # response (struct.error included) must retry, not kill
-                # the consume thread (reference retries Consume forever)
+            except Exception as e:  # noqa: BLE001 # loonglint: disable=unledgered-drop
+                # a malformed broker response (struct.error included) must
+                # retry, not kill the consume thread (reference retries
+                # Consume forever); nothing was consumed, so there is no
+                # event in hand for the ledger to account
                 log.warning("kafka consume error: %r (retrying)", e)
                 cons._joined = False
                 deadline = time.monotonic() + min(backoff, 5.0)

@@ -148,7 +148,10 @@ def parse_influx_lines(body: bytes, group: PipelineEventGroup,
                 ev.set_tag(sb.copy_string(("_string_" + k).encode()),
                            sb.copy_string(v.encode()))
             n += 1
-        except Exception:  # noqa: BLE001 — one bad point must not kill ingest
+        except Exception:  # noqa: BLE001 # loonglint: disable=unledgered-drop
+            # one bad point must not kill ingest; the reject happens while
+            # the group is still being BUILT — pre-admit, so the event
+            # never crossed the ledger's ingest boundary
             continue
     return n
 
@@ -204,6 +207,7 @@ def parse_statsd_packet(body: bytes, group: PipelineEventGroup) -> int:
                     ev.set_tag(sb.copy_string(k.encode()),
                                sb.copy_string(v.encode()))
                 n += 1
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 # loonglint: disable=unledgered-drop
+            # malformed sample skipped mid-build: pre-admit, never ledgered
             continue
     return n

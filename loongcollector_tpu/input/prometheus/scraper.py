@@ -21,12 +21,35 @@ from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
 from ...models import PipelineEventGroup
+from ...monitor import ledger
 from ...pipeline.plugin.interface import Input, PluginContext
 from ...utils.logger import get_logger
 from .relabel import RelabelConfigList, relabel_metric_event
 from .text_parser import parse_exposition
 
 log = get_logger("prometheus")
+
+
+def _ledger_scrape_drop(pqm, key: int, group: PipelineEventGroup,
+                        reason: str) -> None:
+    """A scrape group refused at the admit gate never crossed ``ingest``
+    (push_queue only ledgers admitted groups), so the discard records an
+    ingest+drop PAIR: the loss is visible in the boundary matrix and
+    reason-tagged while the conservation residual stays zero by design."""
+    if not ledger.is_on():
+        return
+    q = pqm.get_queue(key)
+    if q is not None:
+        pipeline = q.pipeline_name
+    else:
+        # pipeline removed mid-scrape: the queue is gone — the manager's
+        # tombstone keeps the loss attributable to the right pipeline
+        pipeline = getattr(pqm, "retired_pipeline_name",
+                           lambda _k: "")(key)
+    ledger.record(pipeline, ledger.B_INGEST, len(group), group.data_size(),
+                  tag="scrape_refused")
+    ledger.record(pipeline, ledger.B_DROP, len(group), group.data_size(),
+                  tag=reason)
 
 
 class ScrapeTarget:
@@ -320,9 +343,11 @@ class PrometheusInputRunner:
                     # pipeline removed mid-scrape: the queue is gone, not
                     # full — waiting would stall every job on this thread
                     self.dropped_groups += 1
+                    _ledger_scrape_drop(pqm, key, group, "pipeline_removed")
                     return
                 if time.monotonic() > deadline:
                     self.dropped_groups += 1
+                    _ledger_scrape_drop(pqm, key, group, "scrape_shed")
                     log.warning("scrape group dropped: queue %d full", key)
                     return
                 time.sleep(0.01)

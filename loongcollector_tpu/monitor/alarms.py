@@ -99,6 +99,9 @@ class AlarmType(str, enum.Enum):
     DEVICE_BACKEND_DEGRADED = "DEVICE_BACKEND_DEGRADED_ALARM"
     MESH_SHARD_FALLBACK = "MESH_SHARD_FALLBACK_ALARM"
     REGEX_TIER_DEMOTED = "REGEX_TIER_DEMOTED_ALARM"
+    # loongledger: a quiesced conservation snapshot balanced to nonzero —
+    # an event crossed into the agent and left without a ledgered exit
+    CONSERVATION_RESIDUAL = "CONSERVATION_RESIDUAL_ALARM"
 
 
 class _AlarmRecord:

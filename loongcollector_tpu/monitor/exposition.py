@@ -95,6 +95,14 @@ def _fmt(v: float) -> str:
 
 def render() -> str:
     """The whole live metric tree in text exposition format."""
+    try:
+        # loongledger gauges mirror on the self-monitor cadence; a scrape
+        # refreshes them too (cheap, idempotent) so the conservation
+        # series is live from the first scrape, not the first cadence
+        from . import ledger as _ledger
+        _ledger.export_refresh()
+    except Exception:  # noqa: BLE001
+        pass
     by_name: Dict[Tuple[str, str], List[str]] = {}
 
     def emit(name: str, typ: str, line: str) -> None:
@@ -169,6 +177,30 @@ def collect_status() -> dict:
                         entry["queue_depth"] = q.size()
                 pipelines[name] = entry
             doc["pipelines"] = pipelines
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongledger: per-pipeline conservation residual + lag watermarks
+        # inline in the status page (the full boundary matrix lives at
+        # /debug/ledger); absent while the ledger is off
+        from . import ledger as _ledger
+        led = _ledger.active_ledger()
+        if led is not None:
+            snap = led.snapshot()
+            lags = _ledger.lag_snapshot()
+            rows = doc.get("pipelines", {})
+            for pname, prow in snap.items():
+                if pname in rows:
+                    rows[pname]["conservation_residual"] = \
+                        _ledger.residual_of(prow)
+            for pname, ages in lags.items():
+                if pname in rows:
+                    rows[pname]["queue_lag_seconds"] = round(
+                        max(ages.values(), default=0.0), 3)
+            doc["ledger"] = {
+                "inflight_live": _ledger.live_inflight(),
+                "residuals": _ledger.residuals(snap),
+            }
     except Exception:  # noqa: BLE001
         pass
     try:
@@ -249,7 +281,8 @@ _INDEX = (b"loongcollector_tpu exposition endpoint\n"
           b"  /healthz       liveness (uptime + worker count)\n"
           b"  /debug/status  running-status JSON\n"
           b"  /debug/pprof   folded stacks (loongprof)\n"
-          b"  /debug/flight  flight-recorder ring JSON\n")
+          b"  /debug/flight  flight-recorder ring JSON\n"
+          b"  /debug/ledger  event-conservation ledger JSON (loongledger)\n")
 
 _PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_CT = "application/json; charset=utf-8"
@@ -277,6 +310,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 doc = _flight.recorder().snapshot(reason="live")
                 self._reply(200, _JSON_CT,
                             (json.dumps(doc, sort_keys=True,
+                                        default=str) + "\n").encode())
+            elif path == "/debug/ledger":
+                from . import ledger as _ledger
+                self._reply(200, _JSON_CT,
+                            (json.dumps(_ledger.debug_document(),
+                                        sort_keys=True,
                                         default=str) + "\n").encode())
             elif path == "/debug/pprof":
                 from .. import prof as _prof

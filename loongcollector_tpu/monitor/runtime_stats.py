@@ -140,6 +140,13 @@ def refresh() -> None:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongledger: mirror boundary totals + residual + lag watermarks
+        # into per-pipeline gauge records (no-op while the ledger is off)
+        from . import ledger
+        ledger.export_refresh()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..input.prometheus.scraper import PrometheusInputRunner
         runner = PrometheusInputRunner._instance
         if runner is not None:

@@ -46,6 +46,12 @@ class Batcher:
         self.strategy = strategy or FlushStrategy()
         self.on_flush = on_flush
         self._batches: Dict[Tuple, _BatchState] = {}
+        # events claimed out of _batches but still inside on_flush (the
+        # write can block): occupancy for pending_events() — without it a
+        # flush stalled mid-write leaves the events in no counter and the
+        # conservation auditor could read a stable ledger + zero occupancy
+        # as a quiesce with a nonzero residual (loongledger)
+        self._emitting_events = 0
         self._lock = threading.Lock()
         self.flusher_id = flusher_id
         self.pipeline_name = pipeline_name
@@ -54,7 +60,7 @@ class Batcher:
     def add(self, group: PipelineEventGroup) -> None:
         size = group.data_size()
         cnt = len(group)
-        to_flush: List[List[PipelineEventGroup]] = []
+        to_flush: List[Tuple[List[PipelineEventGroup], int]] = []
         with self._lock:
             key = _group_key(group)
             st = self._batches.get(key)
@@ -62,17 +68,26 @@ class Batcher:
                 st = _BatchState()
                 self._batches[key] = st
             if st.groups and self.strategy.size_would_exceed(st.size_bytes, size):
-                to_flush.append(st.groups)
+                to_flush.append((st.groups, st.event_cnt))
+                self._emitting_events += st.event_cnt
                 self._batches[key] = st = _BatchState()
             st.groups.append(group)
             st.event_cnt += cnt
             st.size_bytes += size
             if (self.strategy.need_flush_by_count(st.event_cnt)
                     or self.strategy.need_flush_by_size(st.size_bytes)):
-                to_flush.append(st.groups)
+                to_flush.append((st.groups, st.event_cnt))
+                self._emitting_events += st.event_cnt
                 del self._batches[key]
-        for groups in to_flush:
-            self._emit(groups)
+        self._emit_batches(to_flush)
+
+    def pending_events(self) -> int:
+        """Events currently held by open batches or mid-flush — the
+        ledger's live-occupancy probe (loongledger): an event parked here
+        is inflight, not lost."""
+        with self._lock:
+            return (sum(st.event_cnt for st in self._batches.values())
+                    + self._emitting_events)
 
     def flush_timeout(self) -> None:
         to_flush = []
@@ -80,21 +95,42 @@ class Batcher:
             for key in list(self._batches):
                 st = self._batches[key]
                 if st.groups and self.strategy.need_flush_by_time(st.create_time):
-                    to_flush.append(st.groups)
+                    to_flush.append((st.groups, st.event_cnt))
+                    self._emitting_events += st.event_cnt
                     del self._batches[key]
-        for groups in to_flush:
-            self._emit(groups)
+        self._emit_batches(to_flush)
 
     def flush_all(self) -> None:
         with self._lock:
-            pending = [st.groups for st in self._batches.values() if st.groups]
+            pending = [(st.groups, st.event_cnt)
+                       for st in self._batches.values() if st.groups]
+            self._emitting_events += sum(n for _, n in pending)
             self._batches.clear()
-        for groups in pending:
-            self._emit(groups)
+        self._emit_batches(pending)
 
-    def _emit(self, groups: List[PipelineEventGroup]) -> None:
-        if self.on_flush is not None and groups:
-            self.on_flush(groups)
+    def _emit_batches(self,
+                      batches: List[Tuple[List[PipelineEventGroup], int]]
+                      ) -> None:
+        for idx, (groups, n) in enumerate(batches):
+            try:
+                self._emit(groups, n)
+            except BaseException:
+                # the unemitted tail is genuinely lost with this raise —
+                # release its occupancy claim so the system can still
+                # quiesce; the loss then surfaces as a ledger residual
+                # (the auditor firing on it is by design)
+                with self._lock:
+                    self._emitting_events -= sum(
+                        m for _, m in batches[idx + 1:])
+                raise
+
+    def _emit(self, groups: List[PipelineEventGroup], n_events: int) -> None:
+        try:
+            if self.on_flush is not None and groups:
+                self.on_flush(groups)
+        finally:
+            with self._lock:
+                self._emitting_events -= n_events
 
     def close(self) -> None:
         TimeoutFlushManager.instance().unregister(self)

@@ -16,6 +16,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ..models import PipelineEventGroup
+from ..monitor import ledger
 from ..monitor.metrics import MetricsRecord
 from ..utils.logger import get_logger
 from .plugin.instance import FlusherInstance, InputInstance, ProcessorInstance
@@ -279,6 +280,11 @@ class CollectionPipeline:
         normal send path (aggregator + router + flushers)."""
         if not groups:
             return
+        if ledger.is_on():
+            # held events re-enter the chain: the matching credit for the
+            # process_drop their holding stage ledgered when it kept them
+            ledger.record(self.name, ledger.B_PROCESS_EXPAND,
+                          sum(len(g) for g in groups), tag="drain")
         chain = self.inner_processors + self.processors
         for g in groups:
             for inst in chain[chain_idx + 1:]:
@@ -304,6 +310,10 @@ class CollectionPipeline:
         stop/drain barrier (wait_all_items_in_process_finished)."""
         with self._in_process_zero:
             self._in_process_cnt += 1
+        if ledger.is_on():
+            ledger.record(self.name, ledger.B_PROCESS_IN,
+                          sum(len(g) for g in groups),
+                          sum(g.data_size() for g in groups))
         try:
             chain = self.inner_processors + self.processors
             for i, inst in enumerate(chain):
@@ -341,25 +351,64 @@ class CollectionPipeline:
                 self._in_process_zero.notify_all()
 
     def send(self, groups: List[PipelineEventGroup]) -> bool:
+        led = ledger.is_on()
+        if led:
+            ledger.record(self.name, ledger.B_PROCESS_OUT,
+                          sum(len(g) for g in groups))
         if self.aggregator is not None:
+            n_in = sum(len(g) for g in groups)
             staged: List[PipelineEventGroup] = []
             for g in groups:
                 staged.extend(self.aggregator.add(g))
             groups = staged
+            if led:
+                # a stateful aggregator holds (delta < 0, a process_drop it
+                # repays via _send_direct at flush) or mints rollup events
+                # (delta > 0, process_expand) — either way the chain stays
+                # balanced without instrumenting every aggregator plugin
+                delta = sum(len(g) for g in groups) - n_in
+                if delta < 0:
+                    ledger.record(self.name, ledger.B_PROCESS_DROP, -delta,
+                                  tag="aggregator")
+                elif delta > 0:
+                    ledger.record(self.name, ledger.B_PROCESS_EXPAND, delta,
+                                  tag="aggregator")
         ok = True
         for group in groups:
             if group.empty():
                 continue
-            for idx in self.router.route(group):
-                ok = self.flushers[idx].send(group) and ok
+            ok = self._route_group(group, led) and ok
+        return ok
+
+    def _route_group(self, group: PipelineEventGroup, led: bool) -> bool:
+        idxs = self.router.route(group)
+        if led:
+            if not idxs:
+                # no flusher matched: the group is terminally discarded
+                ledger.record(self.name, ledger.B_DROP, len(group),
+                              group.data_size(), tag="no_route")
+            elif len(idxs) > 1:
+                # every extra matching flusher mints a copy of the group's
+                # events — a conservation source, or send_ok would overrun
+                ledger.record(self.name, ledger.B_FANOUT,
+                              (len(idxs) - 1) * len(group))
+        ok = True
+        for idx in idxs:
+            ok = self.flushers[idx].send(group) and ok
         return ok
 
     def _send_direct(self, groups: List[PipelineEventGroup]) -> None:
+        led = ledger.is_on()
         for group in groups:
             if group.empty():
                 continue
-            for idx in self.router.route(group):
-                self.flushers[idx].send(group)
+            if led:
+                # aggregator-held events released by timeout/final flush:
+                # the credit matching the "aggregator"-tagged process_drop
+                ledger.record(self.name, ledger.B_PROCESS_EXPAND, len(group),
+                              tag="aggregator_flush")
+                ledger.record(self.name, ledger.B_PROCESS_OUT, len(group))
+            self._route_group(group, led)
 
     def flush_batch(self) -> None:
         if self.aggregator is not None:

@@ -12,6 +12,7 @@ from typing import Any, Dict, List
 
 from ... import prof, trace
 from ...models import PipelineEventGroup
+from ...monitor import ledger
 from ...monitor.metrics import MetricsRecord
 from .interface import Flusher, Input, PluginContext, Processor
 
@@ -20,6 +21,7 @@ class ProcessorInstance:
     def __init__(self, plugin: Processor, plugin_id: str = ""):
         self.plugin = plugin
         self.plugin_id = plugin_id
+        self._pipeline_name = ""
         self.metrics = MetricsRecord(
             category="plugin",
             labels={"plugin_type": plugin.name, "plugin_id": plugin_id})
@@ -34,7 +36,23 @@ class ProcessorInstance:
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         self.plugin.metrics_record = self.metrics
+        self._pipeline_name = getattr(context, "pipeline_name", "") or ""
         return self.plugin.init(config, context)
+
+    def _ledger_delta(self, n_in: int, groups: List[PipelineEventGroup]
+                      ) -> None:
+        """loongledger: a stage that changed the event population either
+        minted events (split: process_expand) or retired/held them
+        (filter, multiline carry: process_drop), attributed to this
+        plugin.  Runs from the stage's finally so a raising stage still
+        balances against whatever it left in the groups."""
+        delta = sum(len(g) for g in groups) - n_in
+        if delta > 0:
+            ledger.record(self._pipeline_name, ledger.B_PROCESS_EXPAND,
+                          delta, tag=self.plugin_id or self.plugin.name)
+        elif delta < 0:
+            ledger.record(self._pipeline_name, ledger.B_PROCESS_DROP,
+                          -delta, tag=self.plugin_id or self.plugin.name)
 
     def process(self, groups: List[PipelineEventGroup]) -> None:
         n_in = sum(len(g) for g in groups)
@@ -57,12 +75,15 @@ class ProcessorInstance:
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:
                 sp.end(None if ok else "error")
+            if ledger.is_on():
+                self._ledger_delta(n_in, groups)
         self.out_events.add(sum(len(g) for g in groups))
 
     # -- async device plane (split dispatch/complete) -----------------------
 
     def process_dispatch(self, groups: List[PipelineEventGroup]):
-        self.in_events.add(sum(len(g) for g in groups))
+        n_in = sum(len(g) for g in groups)
+        self.in_events.add(n_in)
         self.in_bytes.add(sum(g.data_size() for g in groups))
         tracer = trace.active_tracer()
         sp = (tracer.child_or_sampled("processor",
@@ -82,10 +103,13 @@ class ProcessorInstance:
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:
                 sp.end(None if ok else "error")
+            if ledger.is_on():
+                self._ledger_delta(n_in, groups)
         return tokens
 
     def process_complete(self, groups: List[PipelineEventGroup],
                          tokens) -> None:
+        n_in = sum(len(g) for g in groups)
         tracer = trace.active_tracer()
         sp = (tracer.child_or_sampled("processor",
                                       "processor." + self.plugin.name
@@ -105,6 +129,8 @@ class ProcessorInstance:
             self.cost_ms.add(int(dt * 1000))
             if sp is not None:
                 sp.end(None if ok else "error")
+            if ledger.is_on():
+                self._ledger_delta(n_in, groups)
         self.out_events.add(sum(len(g) for g in groups))
 
 
@@ -155,6 +181,16 @@ class FlusherInstance:
         try:
             result = self.plugin.send(group)
             ok = True
+            if ledger.is_on() and self.plugin.ledger_terminal:
+                # inline-terminal sink: delivery completed (or was refused)
+                # inside send() itself — ledger it here, once, centrally
+                pname = self.plugin._ledger_pipeline()
+                if result:
+                    ledger.record(pname, ledger.B_SEND_OK, len(group),
+                                  group.data_size(), tag=self.plugin.name)
+                else:
+                    ledger.record(pname, ledger.B_DROP, len(group),
+                                  group.data_size(), tag="send_rejected")
             return result
         finally:
             if sp is not None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ...models import PipelineEventGroup
+from ...monitor import ledger
 
 
 class PluginContext:
@@ -111,6 +112,64 @@ class Processor(Plugin):
 
 class Flusher(Plugin):
     name = "flusher_base"
+
+    #: loongledger: True for sinks whose ``send()`` terminates delivery
+    #: inline (local file, stdout, blackhole, test checkers) — the
+    #: FlusherInstance wrapper then ledgers ``send_ok`` centrally.  Sinks
+    #: that queue/batch toward a network hop keep False and ledger at
+    #: their real delivery boundary instead.
+    ledger_terminal = False
+
+    def _ledger_pipeline(self) -> str:
+        """Pipeline attribution for this sink's ledger records ("" when
+        the flusher was never init()ed — tests driving bare plugins)."""
+        return getattr(getattr(self, "context", None),
+                       "pipeline_name", "") or ""
+
+    def _ledger_drop(self, tag: str, n_events: int = 0, n_bytes: int = 0,
+                     group: Optional[PipelineEventGroup] = None) -> None:
+        """Reason-tagged terminal ``drop`` record for events this flusher
+        discards — the shared shape of the B_DROP boilerplate.  Pass
+        ``group`` to defer the O(events) count/size work until the ledger
+        is confirmed on (the disabled-hook idiom)."""
+        if not ledger.is_on():
+            return
+        if group is not None:
+            n_events, n_bytes = len(group), group.data_size()
+        ledger.record(self._ledger_pipeline(), ledger.B_DROP,
+                      n_events, n_bytes, tag=tag)
+
+    def _ledger_terminal_write(self, groups: List[PipelineEventGroup],
+                               write_fn) -> bool:
+        """Run ``write_fn()`` — the sink's actual write of ``groups`` —
+        with the write-through terminal accounting around it: B_SEND_OK
+        once the write lands, B_DROP tag=flush_write_failed when it
+        raises.  The failure is terminal HERE (recorded + logged, not
+        re-raised): the batch already left the batcher, nothing upstream
+        can retry it, and an exception propagating into
+        ProcessorRunner._send would record a second terminal
+        (``send_error``) for the triggering group — a double count the
+        auditor would report as a (negative) residual.  Returns False on
+        a failed write."""
+        led = ledger.is_on()
+        if led:
+            n_events = sum(len(g) for g in groups)
+            n_bytes = sum(g.data_size() for g in groups)
+        try:
+            write_fn()
+        except Exception:  # noqa: BLE001
+            from ...utils.logger import get_logger
+            get_logger("flusher").exception(
+                "%s flush write failed; %d events dropped", self.name,
+                sum(len(g) for g in groups))
+            if led:
+                ledger.record(self._ledger_pipeline(), ledger.B_DROP,
+                              n_events, n_bytes, tag="flush_write_failed")
+            return False
+        if led:
+            ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
+                          n_events, n_bytes, tag=self.name)
+        return True
 
     def __init__(self) -> None:
         super().__init__()

@@ -22,6 +22,7 @@ from typing import Callable, Deque, List, Optional
 
 from ... import chaos
 from ...models import PipelineEventGroup
+from ...monitor import ledger
 
 DEFAULT_CAPACITY = 20
 LOW_WATERMARK_RATIO = 2 / 3
@@ -79,6 +80,7 @@ class BoundedProcessQueue:
         self._not_empty = threading.Condition(self._lock)
         self._valid_to_push = True
         self._pop_enabled = True
+        self._retired = False
         self._feedback: List[FeedbackInterface] = []
         # metrics
         self.total_pushed = 0
@@ -97,7 +99,7 @@ class BoundedProcessQueue:
                 self.total_rejected += 1
             return False
         with self._lock:
-            if not self._valid_to_push:
+            if self._retired or not self._valid_to_push:
                 self.total_rejected += 1
                 return False
             self._items.append(group)
@@ -106,7 +108,12 @@ class BoundedProcessQueue:
             if len(self._items) >= self._cap_high:
                 self._valid_to_push = False
             self._not_empty.notify()
-            return True
+        # loongledger: queue admit == enqueue boundary (outside the lock —
+        # the ledger takes its own short lock)
+        if ledger.is_on():
+            ledger.record(self.pipeline_name, ledger.B_ENQUEUE,
+                          len(group), group.data_size())
+        return True
 
     def is_valid_to_push(self) -> bool:
         with self._lock:
@@ -128,13 +135,34 @@ class BoundedProcessQueue:
                 feedbacks = []
         if enq is not None:
             queue_wait_histogram().observe(time.perf_counter() - enq)
+        if ledger.is_on():
+            ledger.record(self.pipeline_name, ledger.B_DEQUEUE,
+                          len(item), item.data_size())
         for fb in feedbacks:
             fb.feedback(self.key)
         return item
 
+    def oldest_age(self) -> Optional[float]:
+        """Seconds the oldest queued group has waited (None when empty) —
+        the per-pipeline ``queue_lag_seconds`` watermark (loongledger)."""
+        with self._lock:
+            if not self._enq_ts:
+                return None
+            return time.perf_counter() - self._enq_ts[0]
+
     def set_pop_enabled(self, enabled: bool) -> None:
         with self._lock:
             self._pop_enabled = enabled
+
+    def retire(self) -> None:
+        """Deleted-queue gate (loongledger): refuse new pushes and stop
+        pops, under the same lock both check, so delete_queue's terminal
+        accounting of the remaining groups is the last word — a racing
+        push rolls back unledgered, a racing pop cannot re-terminate a
+        group already counted dead."""
+        with self._lock:
+            self._retired = True
+            self._pop_enabled = False
 
     def empty(self) -> bool:
         with self._lock:
@@ -158,17 +186,28 @@ class CircularProcessQueue(BoundedProcessQueue):
         self.total_dropped = 0
 
     def push(self, group: PipelineEventGroup) -> bool:
+        evicted = []
         with self._lock:
+            if self._retired:      # deleted queue: roll back, unledgered
+                return False
             self._items.append(group)
             self._enq_ts.append(time.perf_counter())
             self.total_pushed += 1
             while len(self._items) > self._cap_high:
-                self._items.popleft()
+                evicted.append(self._items.popleft())
                 if self._enq_ts:
                     self._enq_ts.popleft()
                 self.total_dropped += 1
             self._not_empty.notify()
-            return True
+        if ledger.is_on():
+            ledger.record(self.pipeline_name, ledger.B_ENQUEUE,
+                          len(group), group.data_size())
+            # drop-oldest shedding is a terminal discard: ledgered with a
+            # reason so the conservation residual stays zero by design
+            for old in evicted:
+                ledger.record(self.pipeline_name, ledger.B_DROP,
+                              len(old), old.data_size(), tag="circular_evict")
+        return True
 
     def is_valid_to_push(self) -> bool:
         return True

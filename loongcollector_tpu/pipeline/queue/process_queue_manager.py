@@ -11,6 +11,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ...models import PipelineEventGroup
+from ...monitor import ledger
 from .bounded_queue import BoundedProcessQueue, CircularProcessQueue
 
 PRIORITY_COUNT = 3  # 0 = highest
@@ -28,6 +29,10 @@ class ProcessQueueManager:
         self._version = 0
         self._snapshot_version = -1
         self._by_prio: Dict[int, list] = {}
+        # loongledger: deleted-key → pipeline-name tombstones so a group
+        # popped just before a hot-reload delete still attributes its drop
+        # to the pipeline that ingested it (bounded; see delete_queue)
+        self._retired_names: Dict[int, str] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -35,6 +40,7 @@ class ProcessQueueManager:
                               capacity: int = 20, pipeline_name: str = "",
                               circular: bool = False) -> BoundedProcessQueue:
         with self._lock:
+            self._retired_names.pop(key, None)   # key is live again
             q = self._queues.get(key)
             if q is None or isinstance(q, CircularProcessQueue) != circular:
                 cls = CircularProcessQueue if circular else BoundedProcessQueue
@@ -46,12 +52,39 @@ class ProcessQueueManager:
 
     def delete_queue(self, key: int) -> None:
         with self._lock:
-            if self._queues.pop(key, None) is not None:
+            q = self._queues.pop(key, None)
+            if q is not None:
                 self._version += 1
+                self._retired_names[key] = q.pipeline_name
+                while len(self._retired_names) > 1024:   # churn bound
+                    self._retired_names.pop(next(iter(self._retired_names)))
+        if q is None:
+            return
+        # the queue retires unconditionally (same lock push()/pop() check):
+        # an input thread holding a stale reference must have its push
+        # REFUSED — with or without the ledger, a group admitted into an
+        # orphaned queue object no worker polls is a silent loss
+        q.retire()
+        if ledger.is_on():
+            # groups still queued die with their queue (pipeline removed
+            # without drain): a terminal, reason-tagged discard.  retire()
+            # ran first, so a worker holding a stale priority snapshot
+            # cannot pop a group after we count it dead (two terminals)
+            with q._lock:
+                dead = list(q._items)
+            for g in dead:
+                ledger.record(q.pipeline_name, ledger.B_DROP,
+                              len(g), g.data_size(), tag="queue_deleted")
 
     def get_queue(self, key: int) -> Optional[BoundedProcessQueue]:
         with self._lock:
             return self._queues.get(key)
+
+    def retired_pipeline_name(self, key: int) -> str:
+        """Pipeline name a now-deleted queue key belonged to ("" when
+        unknown) — keeps post-delete drop records attributable."""
+        with self._lock:
+            return self._retired_names.get(key, "")
 
     # -- producer -----------------------------------------------------------
 
@@ -62,6 +95,14 @@ class ProcessQueueManager:
             return False
         pushed = q.push(group)
         if pushed:
+            # loongledger ingest boundary: every input funnels through this
+            # admit (file server, long-tail inputs, bench/test harnesses),
+            # so ONE hook covers them all; a rejected push is rolled back
+            # by the caller and never counted — the agent owns an event
+            # only once it is admitted
+            if ledger.is_on():
+                ledger.record(q.pipeline_name, ledger.B_INGEST,
+                              len(group), group.data_size())
             with self._data_cv:
                 self._data_cv.notify()
         return pushed

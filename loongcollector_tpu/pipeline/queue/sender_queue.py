@@ -26,10 +26,11 @@ class SendingStatus(enum.Enum):
 class SenderQueueItem:
     __slots__ = ("data", "raw_size", "flusher", "queue_key", "status",
                  "enqueue_time", "try_count", "last_send_time", "tag",
-                 "in_flight")
+                 "in_flight", "event_cnt")
 
     def __init__(self, data: bytes, raw_size: int, flusher=None,
-                 queue_key: int = 0, tag: Optional[dict] = None):
+                 queue_key: int = 0, tag: Optional[dict] = None,
+                 event_cnt: int = 0):
         self.data = data
         self.raw_size = raw_size
         self.flusher = flusher
@@ -40,6 +41,11 @@ class SenderQueueItem:
         self.last_send_time = 0.0
         self.tag = tag or {}
         self.in_flight = False
+        # loongledger: how many events this payload carries — serialization
+        # erases event identity, so the count rides the item to keep the
+        # send_ok/spill boundaries in event units (0 = unknown provenance,
+        # e.g. a pre-ledger disk-buffer file; ledgers as 0 on both sides)
+        self.event_cnt = event_cnt
 
 
 class SenderQueue:
@@ -51,6 +57,7 @@ class SenderQueue:
         self._items: Deque[SenderQueueItem] = deque()
         self._lock = threading.Lock()
         self._valid_to_push = True
+        self._retired = False
         self._feedback = []
         self.rate_limiter: Optional[RateLimiter] = None
         self.concurrency_limiters: List[ConcurrencyLimiter] = []
@@ -59,14 +66,25 @@ class SenderQueue:
 
     def push(self, item: SenderQueueItem) -> bool:
         with self._lock:
-            # Sender queues accept beyond the watermark (data already left the
-            # process stage and must not be lost); validity flag throttles the
-            # upstream instead (reference BoundedSenderQueueInterface).
-            self._items.append(item)
-            self.total_pushed += 1
-            if len(self._items) >= self._cap_high:
-                self._valid_to_push = False
-            return True
+            if not self._retired:
+                # Sender queues accept beyond the watermark (data already
+                # left the process stage and must not be lost); validity
+                # flag throttles the upstream instead (reference
+                # BoundedSenderQueueInterface).
+                self._items.append(item)
+                self.total_pushed += 1
+                if len(self._items) >= self._cap_high:
+                    self._valid_to_push = False
+                return True
+        # deleted queue: a stale-reference push (e.g. a timeout flush
+        # driving a removed pipeline's batcher mid-hot-reload) would
+        # strand the payload in an orphaned queue nothing dispatches,
+        # counts, or ledgers — refuse it, matching BoundedProcessQueue.
+        # retire()'s push gate.  False means the CALLER still owns the
+        # payload (disk-buffer replay keeps its file; flush paths record
+        # the terminal drop) — recording here would double-terminate a
+        # refused replay whose spill file survives.
+        return False
 
     def is_valid_to_push(self) -> bool:
         with self._lock:
@@ -75,6 +93,11 @@ class SenderQueue:
     def get_available_items(self, limit: int) -> List[SenderQueueItem]:
         out: List[SenderQueueItem] = []
         with self._lock:
+            if self._retired:
+                # deleted queue (loongledger): its remaining IDLE items
+                # were already counted drop(queue_deleted) — dispatching
+                # one now would give the same payload two terminals
+                return out
             for item in self._items:
                 if len(out) >= limit:
                     break
@@ -116,6 +139,14 @@ class SenderQueue:
     def size(self) -> int:
         with self._lock:
             return len(self._items)
+
+    def oldest_age(self) -> Optional[float]:
+        """Seconds the oldest queued payload has waited (None when empty)
+        — the ``sender_queue_lag_seconds`` watermark (loongledger)."""
+        with self._lock:
+            if not self._items:
+                return None
+            return max(0.0, time.monotonic() - self._items[0].enqueue_time)
 
     def empty(self) -> bool:
         with self._lock:
@@ -162,7 +193,27 @@ class SenderQueueManager:
 
     def delete_queue(self, key: int) -> None:
         with self._lock:
-            self._queues.pop(key, None)
+            q = self._queues.pop(key, None)
+        if q is not None:
+            from ...monitor import ledger
+            # serialized payloads still queued die with their queue
+            # (direct delete, not the drain-then-GC path): terminal.
+            # SENDING items are skipped — their delivery callback is
+            # still coming and ledgers the terminal outcome (send_ok /
+            # drop / retry_orphaned); counting them here too would
+            # double-terminate the same events.  The retired flag is
+            # raised under the SAME lock the dead snapshot is taken
+            # under — and unconditionally, so a FlusherRunner iterating
+            # a stale queue list cannot dispatch from a deleted queue
+            # whether or not the ledger is counting
+            led = ledger.is_on()
+            with q._lock:
+                q._retired = True
+                dead = ([(i.event_cnt, len(i.data)) for i in q._items
+                         if i.status is SendingStatus.IDLE] if led else [])
+            for events, nbytes in dead:
+                ledger.record(q.pipeline_name, ledger.B_DROP,
+                              events, nbytes, tag="queue_deleted")
 
     def get_available_items(self, limit_per_queue: int = 10
                             ) -> List[SenderQueueItem]:

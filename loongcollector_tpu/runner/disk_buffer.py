@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Tuple
 
 from .. import chaos, trace
 from ..chaos import ChaosFault
+from ..monitor import ledger
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..prof import flight
 from ..pipeline.queue.sender_queue import SenderQueueItem
@@ -50,6 +51,12 @@ class DiskBufferWriter:
         self._lock = threading.Lock()
         self._run_id = uuid.uuid4().hex[:8]  # filenames unique across restarts
         self._total = None  # lazily-initialized running byte total
+        # loongledger sidecar: path -> (pipeline, event_cnt) for files THIS
+        # process spilled (and thus ledgered as B_SPILL).  A quarantined
+        # file whose header is unreadable still settles its ledger balance
+        # through this map; files from earlier runs are not in it and were
+        # never counted, so their quarantine records nothing
+        self._spill_ledger: dict = {}
 
     # -- write --------------------------------------------------------------
 
@@ -71,6 +78,9 @@ class DiskBufferWriter:
         header = dict(identity)
         header["raw_size"] = item.raw_size
         header["enqueue_time"] = time.time()
+        # event provenance rides the spill so replay restores event-unit
+        # accounting (0 = unknown, e.g. a pre-ledger item)
+        header["event_cnt"] = getattr(item, "event_cnt", 0)
         payload = item.data
         if self.cipher is not None:
             payload = self.cipher.encrypt(payload)
@@ -113,6 +123,14 @@ class DiskBufferWriter:
                       pipeline=header.get("pipeline", ""),
                       flusher=header.get("flusher_type", ""),
                       nbytes=len(item.data))
+        if ledger.is_on():
+            # spill is a conservation SINK: the events are safely at rest;
+            # a later replay credits them back as a source
+            ledger.record(header.get("pipeline", ""), ledger.B_SPILL,
+                          header["event_cnt"], len(item.data))
+            with self._lock:
+                self._spill_ledger[path] = (header.get("pipeline", ""),
+                                            header["event_cnt"])
         return True
 
     # -- read / replay ------------------------------------------------------
@@ -184,11 +202,20 @@ class DiskBufferWriter:
                 continue
             item = SenderQueueItem(payload, header.get("raw_size", len(payload)),
                                    flusher=flusher,
-                                   queue_key=flusher.queue_key)
+                                   queue_key=flusher.queue_key,
+                                   event_cnt=int(header.get("event_cnt", 0)))
             if flusher.sender_queue.push(item) is False:
                 # target refused (replay adapter at capacity): the file is
                 # the only copy — keep it for a later round
                 continue
+            if ledger.is_on():
+                # replay is a conservation SOURCE: the events re-enter the
+                # live send path and will terminate again (send_ok, a
+                # re-spill, or a drop)
+                ledger.record(header.get("pipeline", ""), ledger.B_REPLAY,
+                              item.event_cnt, len(payload))
+            with self._lock:
+                self._spill_ledger.pop(path, None)
             self._remove(path)
             count += 1
             if trace.is_active():
@@ -216,6 +243,16 @@ class DiskBufferWriter:
         with self._lock:
             if self._total is not None:
                 self._total = max(0, self._total - size)
+            spilled = self._spill_ledger.pop(path, None)
+        if spilled is not None and ledger.is_on():
+            # the file was ledgered as B_SPILL when this process wrote it:
+            # credit it back out of the buffer (replay, tag=quarantine) and
+            # retire the events terminally at the quarantine boundary — the
+            # residual stays zero while `quarantine` names the loss bucket
+            pipeline, events = spilled
+            ledger.record(pipeline, ledger.B_REPLAY, events, size,
+                          tag="quarantine")
+            ledger.record(pipeline, ledger.B_QUARANTINE, events, size)
         log.error("malformed buffer file quarantined: %s.bad", path)
         if trace.is_active():
             trace.event("disk_buffer.quarantine", nbytes=size)

@@ -23,6 +23,7 @@ from typing import Dict, Optional, Tuple
 import heapq
 
 from .. import prof, trace
+from ..monitor import ledger
 from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
@@ -269,9 +270,22 @@ class FlusherRunner:
 
     # -- dispatch ------------------------------------------------------------
 
+    def _ledger_pipeline(self, item: SenderQueueItem) -> str:
+        q = self.sqm.get_queue(item.queue_key)
+        if q is not None:
+            return q.pipeline_name
+        flusher = item.flusher
+        if flusher is not None:
+            return flusher.spill_identity().get("pipeline", "")
+        return ""
+
     def _dispatch(self, item: SenderQueueItem) -> None:
         flusher = item.flusher
         if flusher is None or self.http_sink is None:
+            # nowhere to send: the payload leaves the queue terminally
+            if ledger.is_on():
+                ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
+                              item.event_cnt, len(item.data), tag="no_sink")
             self._release_limiters(item)
             self.sqm.remove_item(item)
             return
@@ -369,6 +383,14 @@ class FlusherRunner:
                  "payload dropped after permanent rejection ")
                 + f"(status {status})", AlarmLevel.ERROR)
         if verdict in ("retry", "retry_slow"):
+            # one failed attempt: the item stays inflight (retry heap or
+            # spill), never double-counted — send_fail is informational.
+            # is_on() guard: _ledger_pipeline takes the sqm lock, which a
+            # disabled ledger must never pay for on the retry path
+            if ledger.is_on():
+                ledger.record(self._ledger_pipeline(item),
+                              ledger.B_SEND_FAIL,
+                              item.event_cnt, len(item.data))
             # spill-on-open: an open breaker (or plain try-count exhaustion)
             # moves the payload to disk and frees the queue slot
             # (reference DiskBufferWriter semantics)
@@ -378,6 +400,16 @@ class FlusherRunner:
                     return
             self._backoff_retry(item)
             return
+        if ledger.is_on():
+            if verdict == "ok":
+                ledger.record(self._ledger_pipeline(item), ledger.B_SEND_OK,
+                              item.event_cnt, len(item.data))
+            else:
+                # permanent rejection / callback failure: terminal discard
+                ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
+                              item.event_cnt, len(item.data),
+                              tag=("callback_failed" if cb_failed
+                                   else "permanent_reject"))
         self.out_items.add(1)
         self.out_bytes.add(len(item.data))
         self.sqm.remove_item(item)
@@ -415,7 +447,11 @@ class FlusherRunner:
             q = self.sqm.get_queue(item.queue_key)
             if q is not None:
                 q.reset_item_status(item)
-            else:
+            elif not self._spill_item(item):
                 # queue deleted while the item waited out its backoff
-                # (pipeline swap): spill instead of silently vanishing
-                self._spill_item(item)
+                # (pipeline swap) AND the spill refused (no buffer / full):
+                # the payload is gone — ledger the loss, don't hide it
+                if ledger.is_on():
+                    ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
+                                  item.event_cnt, len(item.data),
+                                  tag="retry_orphaned")

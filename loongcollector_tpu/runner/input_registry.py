@@ -54,7 +54,9 @@ class InputRunnerRegistry:
         for e in cls.entries():
             try:
                 runner = e.instance()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 # loonglint: disable=unledgered-drop
+                # a runner that failed to INSTANTIATE never read an event —
+                # the continue abandons the registry entry, not a payload
                 log.exception("input runner %s instantiation failed", e.name)
                 continue
             if hasattr(runner, "process_queue_manager"):

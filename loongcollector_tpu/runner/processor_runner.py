@@ -52,6 +52,7 @@ from typing import List, Optional, Tuple
 
 from .. import prof, trace
 from ..models import EventGroupMetaKey, PipelineEventGroup
+from ..monitor import ledger
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops.device_plane import note_host_backlog, set_budget_relief
@@ -287,6 +288,19 @@ class ProcessorRunner:
         self._lanes: List[WorkerLane] = []
         self._inboxes: List[_ShardInbox] = []
         self._running = False
+        # loongledger: groups popped from a queue/inbox but not yet
+        # anchored in another occupancy counter (inbox / lane /
+        # _in_process_cnt) — covers the hop so a descheduled worker
+        # holding a group in a local variable cannot fake a quiesce.
+        # Known residual sliver: the increment runs just AFTER the pop
+        # returns (holding it across the blocking wait would count idle
+        # workers as inflight and the auditor would never quiesce), so a
+        # thread descheduled for 2+ audit intervals in the few
+        # instructions between B_DEQUEUE and _note_in_hand(1) could still
+        # slip the probe; the two-consecutive-quiesced-audits confirmation
+        # is the backstop for that nanosecond window
+        self._in_hand = 0
+        self._in_hand_lock = threading.Lock()
         self.metrics = MetricsRecord(category="runner",
                                      labels={"runner": "processor"})
         self.in_groups = self.metrics.counter("in_event_groups_total")
@@ -343,6 +357,18 @@ class ProcessorRunner:
         """Queued groups per worker inbox (empty list when single-worker:
         the reference shape has no dispatch hop to observe)."""
         return [len(ib) for ib in self._inboxes]
+
+    def _note_in_hand(self, delta: int) -> None:
+        # clamped at zero: the ledger can come on mid-run, making the
+        # first decrement unpaired — never let that offset real occupancy
+        with self._in_hand_lock:
+            self._in_hand = max(0, self._in_hand + delta)
+
+    def in_hand_count(self) -> int:
+        """Groups currently between a queue/inbox pop and their next
+        counted station — the ledger's live-occupancy probe."""
+        with self._in_hand_lock:
+            return self._in_hand
 
     def lane_overlap(self) -> List[float]:
         """Per-lane device-overlap ratio (loongprof utilization): the
@@ -409,16 +435,28 @@ class ProcessorRunner:
             item = self.pqm.pop_item(timeout=0.2)
             if item is None:
                 continue
-            self._route(item)
+            self._handle_routed(item)
         # drain remaining items on stop: keep affinity so ordering holds
         # through shutdown too
         while True:
             item = self.pqm.pop_item(timeout=0)
             if item is None:
                 break
-            self._route(item)
+            self._handle_routed(item)
         for ib in self._inboxes:
             ib.close()
+
+    def _handle_routed(self, item: Tuple[int, PipelineEventGroup]) -> None:
+        """Route one popped item while the in-hand counter covers the gap
+        until it lands in an inbox (or finishes inline)."""
+        if not ledger.is_on():
+            self._route(item)
+            return
+        self._note_in_hand(1)
+        try:
+            self._route(item)
+        finally:
+            self._note_in_hand(-1)
 
     def _route(self, item: Tuple[int, PipelineEventGroup]) -> None:
         key, group = item
@@ -502,19 +540,14 @@ class ProcessorRunner:
                     # (the sharded loop probes on inbox depth instead)
                     note_host_backlog()
                 had_item = True
-                nxt = self._dispatch_one(*item, lane=lane)
-                # dispatch-before-advance is the overlap: the device now
-                # holds group N+1 while we materialise + send the oldest
-                # ring entry (N-depth+1)
-                self._advance_ring(lane)
-                lane.put(nxt)
+                self._handle_one(item, lane)
             self._complete_lane(lane)
             # drain remaining items on stop
             while True:
                 item = self.pqm.pop_item(timeout=0)
                 if item is None:
                     break
-                self._process_one(*item)
+                self._handle_one(item, None)
         finally:
             prof.pop_marker()
             set_budget_relief(None)
@@ -539,13 +572,35 @@ class ProcessorRunner:
                     # device-idle gap (utilization accounting — the
                     # "shard more vs device-bound" counter)
                     note_host_backlog()
-                nxt = self._dispatch_one(*item, lane=lane)
-                self._advance_ring(lane)
-                lane.put(nxt)
+                self._handle_one(item, lane)
             self._complete_lane(lane)
         finally:
             prof.pop_marker()
             set_budget_relief(None)
+
+    def _handle_one(self, item: Tuple[int, PipelineEventGroup],
+                    lane: Optional[WorkerLane]) -> None:
+        """One popped item through dispatch → ring advance → lane, with
+        the in-hand counter covering the whole hop (a group anchored in
+        the lane ring or _in_process_cnt is visible to live_inflight;
+        this covers the slivers in between).  Lane-less (drain) items go
+        through the synchronous _process_one instead."""
+        led = ledger.is_on()
+        if led:
+            self._note_in_hand(1)
+        try:
+            if lane is None:
+                self._process_one(*item)
+                return
+            nxt = self._dispatch_one(*item, lane=lane)
+            # dispatch-before-advance is the overlap: the device now
+            # holds group N+1 while we materialise + send the oldest
+            # ring entry (N-depth+1)
+            self._advance_ring(lane)
+            lane.put(nxt)
+        finally:
+            if led:
+                self._note_in_hand(-1)
 
     def _dispatch_one(self, key: int, group: PipelineEventGroup,
                       lane: Optional[WorkerLane] = None):
@@ -563,6 +618,15 @@ class ProcessorRunner:
         pipeline = self.pipeline_manager.find_pipeline_by_queue_key(key)
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
+            if ledger.is_on():
+                q = self.pqm.get_queue(key)
+                # hot reload can delete the queue between pop and here:
+                # attribute the drop via the manager's tombstone so the
+                # ingesting pipeline's books still balance
+                name = (q.pipeline_name if q is not None
+                        else self.pqm.retired_pipeline_name(key))
+                ledger.record(name, ledger.B_DROP, len(group),
+                              group.data_size(), tag="no_pipeline")
             return None
         self.in_groups.add(1)
         self.in_events.add(len(group))
@@ -587,6 +651,7 @@ class ProcessorRunner:
                 finish = pipeline.process_begin(groups)
             except Exception:  # noqa: BLE001
                 log.exception("pipeline %s processing failed", pipeline.name)
+                self._ledger_error_drop(pipeline, groups)
                 self._finish_group(sp, t0, "error")
                 return None
             if finish is None:
@@ -604,7 +669,18 @@ class ProcessorRunner:
         # this thread so the NEXT group's dispatch does not nest under it
         if sp is not None:
             tracer.pop_current(sp)
-        return pipeline, groups, finish, sp, t0
+        lane_tag = (f"lane{lane.worker_id}" if lane is not None else "inline")
+        if ledger.is_on():
+            ledger.record(pipeline.name, ledger.B_DEVICE_SUBMIT,
+                          sum(len(g) for g in groups), tag=lane_tag)
+        return pipeline, groups, finish, sp, t0, lane_tag
+
+    def _ledger_error_drop(self, pipeline, groups) -> None:
+        """A processing exception terminally discards the group's events:
+        without this record the conservation residual would read the bug
+        as a silent loss instead of an attributed drop."""
+        ledger.record(pipeline.name, ledger.B_DROP,
+                      sum(len(g) for g in groups), tag="process_error")
 
     def _finish_group(self, sp, t0: float, status: str) -> None:
         self.e2e_hist.observe(time.perf_counter() - t0)
@@ -632,23 +708,39 @@ class ProcessorRunner:
             self._complete(p)
 
     def _complete(self, pending) -> None:
-        pipeline, groups, finish, sp, t0 = pending
+        pipeline, groups, finish, sp, t0, lane_tag = pending
         tracer = trace.active_tracer()
         if sp is not None and tracer is not None:
             # re-attach: device materialisation + downstream processors +
             # send events belong to this group's span
             tracer.push_current(sp)
         prof.push_marker("pipeline", pipeline.name or "pipeline")
+        # in-hand across the whole completion: the lane entry was already
+        # take()n and finish()'s exit drops _in_process_cnt BEFORE the
+        # send — without this, a sink write stalling mid-_send (NFS,
+        # loaded CI) leaves the group in no occupancy counter and a
+        # stable ledger, faking a quiesce into a false residual alarm
+        led = ledger.is_on()
+        if led:
+            self._note_in_hand(1)
         try:
             try:
                 finish()
             except Exception:  # noqa: BLE001
                 log.exception("pipeline %s processing failed", pipeline.name)
+                self._ledger_error_drop(pipeline, groups)
                 self._finish_group(sp, t0, "error")
                 return
+            if ledger.is_on():
+                # device work resolved: the group's spans are host-resident
+                # again — the submit→materialize gap is the ring occupancy
+                ledger.record(pipeline.name, ledger.B_DEVICE_MATERIALIZE,
+                              sum(len(g) for g in groups), tag=lane_tag)
             self._send(pipeline, groups)
             self._finish_group(sp, t0, "ok")
         finally:
+            if led:
+                self._note_in_hand(-1)
             prof.pop_marker()
 
     def _send(self, pipeline, groups) -> None:
@@ -656,6 +748,11 @@ class ProcessorRunner:
             pipeline.send(groups)
         except Exception:  # noqa: BLE001
             log.exception("pipeline %s send failed", pipeline.name)
+            # best-effort terminal record: send() may have routed part of
+            # the batch before raising, so a nonzero (negative) residual
+            # here is the auditor doing its job on a genuine bug path
+            ledger.record(pipeline.name, ledger.B_DROP,
+                          sum(len(g) for g in groups), tag="send_error")
 
     def _process_one(self, key: int, group: PipelineEventGroup) -> None:
         pending = self._dispatch_one(key, group)

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""loongledger overhead smoke gate (wired into scripts/lint.sh).
+
+The loongledger contract (docs/observability.md#event-conservation-ledger)
+follows the chaos/trace/prof idiom: with ``LOONG_LEDGER`` off, every hook
+— ``ledger.is_on`` and ``ledger.record`` — is one module-global read +
+branch.  Same two-layer proof as scripts/trace_overhead.py /
+prof_overhead.py, same paired-min method:
+
+1. **Per-hook microbench** — ns/call of the disabled hooks under a
+   generous absolute ceiling (a disabled path that allocates, locks or
+   formats blows through it immediately).
+
+2. **Synthetic pipeline** — the ledgered hot path (bounded-queue
+   push/pop + ProcessorInstance split stage + SLS serialization) timed
+   with hooks as shipped (ledger disabled) vs the same hooks
+   monkeypatched to bare no-ops, interleaved paired rounds; the gate is
+   the MINIMUM paired disabled/baseline ratio (>5% in EVERY round
+   fails).  The ledger-enabled time is reported informationally —
+   enabling MAY cost, disabling MUST NOT.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+N_GROUPS = 400
+EVENTS_PER_GROUP = 24
+REPEATS = 9
+MAX_DISABLED_OVER_BASELINE = 1.05      # the 5% gate
+MAX_HOOK_NS = 2_000                    # catastrophic-regression ceiling
+
+
+def bench_hooks():
+    from loongcollector_tpu.monitor import ledger
+    ledger.disable()
+    out = {}
+    for label, fn in (("is_on", ledger.is_on),
+                      ("record", lambda: ledger.record(
+                          "p", ledger.B_INGEST, 1, 64))):
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / n)
+        out[label] = best * 1e9
+    return out
+
+
+def make_runner():
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.pipeline.plugin.instance import ProcessorInstance
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.pipeline.queue.bounded_queue import \
+        BoundedProcessQueue
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+    inst = ProcessorInstance(ProcessorSplitLogString(),
+                             "split/ledger_overhead")
+    assert inst.init({}, PluginContext("ledger_overhead"))
+    ser = SLSEventGroupSerializer()
+    line = b"2024-01-02 03:04:05 INFO request handled ok\n"
+    data = line * EVENTS_PER_GROUP
+    q = BoundedProcessQueue(1, capacity=4, pipeline_name="ledger_overhead")
+
+    def run_timed():
+        t0 = time.perf_counter()
+        for _ in range(N_GROUPS):
+            sb = SourceBuffer(len(data) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(data))
+            # the ledgered hand-offs: queue admit → pop → stage → payload
+            assert q.push(g)
+            g = q.pop()
+            inst.process([g])
+            ser.serialize([g])
+            assert len(g) == EVENTS_PER_GROUP
+        return time.perf_counter() - t0
+
+    return inst, run_timed
+
+
+def main() -> int:
+    from loongcollector_tpu.monitor import ledger
+    hooks = bench_hooks()
+    print("disabled hook cost (ns/call): "
+          + ", ".join(f"{k}={v:.0f}" for k, v in hooks.items()))
+    bad = {k: v for k, v in hooks.items() if v > MAX_HOOK_NS}
+    if bad:
+        print(f"FAIL: disabled hooks over {MAX_HOOK_NS} ns: {bad}")
+        return 1
+
+    import gc
+    inst, run_timed = make_runner()
+    noop_false = lambda: False                        # noqa: E731
+    noop_none = lambda *a, **k: None                  # noqa: E731
+    real = (ledger.is_on, ledger.record)
+
+    def set_baseline():
+        ledger.disable()
+        ledger.is_on = noop_false
+        ledger.record = noop_none
+
+    def set_disabled():
+        (ledger.is_on, ledger.record) = real
+        ledger.disable()
+
+    def set_enabled():
+        (ledger.is_on, ledger.record) = real
+        ledger.enable()
+
+    # Paired rounds, min ratio across rounds: a REAL disabled-path
+    # regression is systematic and survives every pairing; co-tenant CPU
+    # steal on a shared core does not (see scripts/trace_overhead.py).
+    dis_ratios, en_ratios = [], []
+    try:
+        run_timed()                                   # warm the path
+        for i in range(REPEATS):
+            pair = [("baseline", set_baseline), ("disabled", set_disabled)]
+            if i % 2:                                 # kill position bias
+                pair.reverse()
+            times = {}
+            for name, setup in pair + [("enabled", set_enabled)]:
+                setup()
+                gc.collect()
+                times[name] = run_timed()
+                ledger.disable()
+            dis_ratios.append(times["disabled"] / times["baseline"])
+            en_ratios.append(times["enabled"] / times["baseline"])
+    finally:
+        (ledger.is_on, ledger.record) = real
+        ledger.disable()
+        inst.metrics.mark_deleted()
+
+    ratio = min(dis_ratios)
+    print(f"{N_GROUPS}x{EVENTS_PER_GROUP}-event synthetic pipeline, "
+          f"{REPEATS} paired rounds: "
+          f"disabled/baseline min={ratio:.3f} "
+          f"median={sorted(dis_ratios)[len(dis_ratios) // 2]:.3f}  "
+          f"enabled/baseline min={min(en_ratios):.3f}")
+    if ratio > MAX_DISABLED_OVER_BASELINE:
+        print(f"FAIL: disabled-path overhead {(ratio - 1) * 100:.1f}% "
+              f"> {(MAX_DISABLED_OVER_BASELINE - 1) * 100:.0f}% in every "
+              "round — the disabled ledger must stay one branch per hook")
+        return 1
+    print("ledger overhead OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,11 @@ echo "== profiler-overhead smoke (loongprof) =="
 # same disabled-vs-noop-baseline >5% paired-min gate as the trace smoke
 JAX_PLATFORMS=cpu python scripts/prof_overhead.py
 
+echo "== ledger-overhead smoke (loongledger) =="
+# with LOONG_LEDGER off the conservation-accounting hooks must stay one
+# branch per hook — same paired-min >5% gate as the trace/prof smokes
+JAX_PLATFORMS=cpu python scripts/ledger_overhead.py
+
 echo "== multi-worker smoke (loongshard) =="
 # the disabled-trace overhead gate and the metric-naming checker must hold
 # with the sharded plane active (LOONG_PROCESS_THREADS=4): the overhead

@@ -21,6 +21,7 @@ import pytest
 
 from loongcollector_tpu import chaos, trace
 from loongcollector_tpu.chaos import ChaosFault, ChaosPlan, FaultSpec
+from loongcollector_tpu.monitor import ledger
 from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
 from loongcollector_tpu.ops.device_plane import (DevicePlane,
                                                  LatencyInjectedKernel)
@@ -47,9 +48,11 @@ def _chaos_clean():
     test file's storm must not be visible here."""
     chaos.reset()
     trace.disable()
+    ledger.disable()
     yield
     chaos.reset()
     trace.disable()
+    ledger.disable()
     AlarmManager.instance().flush()
 
 
@@ -118,9 +121,16 @@ class _FakeFlusher:
 def _drive_sink_storm(seed, server, tmp_path, n_payloads=12,
                       max_faults=20, timeout=60.0):
     """One seeded storm through sender queue → FlusherRunner → HttpSink,
-    faults injected at http_sink.send.  Returns (payloads, runner)."""
+    faults injected at http_sink.send.  Runs with the conservation ledger
+    + auditor live (ISSUE 8): residual must read ZERO at a mid-storm
+    quiesce checkpoint, not only post-storm.  Returns (payloads, runner).
+    """
+    led = ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
     sqm = SenderQueueManager()
-    q = sqm.create_or_reuse_queue(1, capacity=n_payloads + 4)
+    q = sqm.create_or_reuse_queue(1, capacity=n_payloads + 4,
+                                  pipeline_name="t")
     sink = HttpSink(workers=2)
     sink.init()
     db = DiskBufferWriter(str(tmp_path / f"buf{seed}"))
@@ -133,17 +143,43 @@ def _drive_sink_storm(seed, server, tmp_path, n_payloads=12,
     flusher.queue_key = 1
     flusher.sender_queue = q
     payloads = {f"payload-{seed}-{i:03d}".encode() for i in range(n_payloads)}
+
+    def _push(batch):
+        for p in batch:
+            # the harness is the "input": it admits payloads straight into
+            # the sender hop, so it records their ingest itself
+            ledger.record("t", ledger.B_INGEST, 1, len(p))
+            q.push(SenderQueueItem(p, len(p), flusher=flusher, queue_key=1,
+                                   event_cnt=1))
+
+    def _checkpoint(label):
+        ledger.assert_conserved(timeout=timeout,
+                                label=f"seed {seed} {label}")
+
     try:
         chaos.install(ChaosPlan(seed, {
             "http_sink.send": FaultSpec(
                 prob=0.55, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
                 delay_range=(0.001, 0.005), max_faults=max_faults)}))
-        for p in sorted(payloads):
-            q.push(SenderQueueItem(p, len(p), flusher=flusher, queue_key=1))
+        ordered = sorted(payloads)
+        _push(ordered[:n_payloads // 2])
+        # live checkpoint MID-storm: faults are still armed, half the
+        # payloads are anywhere between queue, retry heap, disk spill and
+        # the wire — once movement stops, conservation must already hold
+        _checkpoint("at the mid-storm checkpoint")
+        _push(ordered[n_payloads // 2:])
         assert wait_for(lambda: payloads <= server.received,
                         timeout=timeout), (
             f"seed {seed}: lost {len(payloads - server.received)} payloads; "
             f"schedule={chaos.schedule()[:20]}")
+        _checkpoint("post-storm")
+        assert auditor.residual_alarms_total == 0, (
+            f"seed {seed}: the live auditor saw a conservation break")
+        assert not any(
+            a["alarm_type"] == AlarmType.CONSERVATION_RESIDUAL.value
+            for a in AlarmManager.instance().flush()), (
+            f"seed {seed}: CONSERVATION_RESIDUAL alarm raised mid-storm")
+        assert led.total("t", ledger.B_SEND_OK) >= n_payloads
         # faults cleared: every opened breaker must re-close
         assert wait_for(lambda: all(
             br.state is BreakerState.CLOSED

@@ -25,7 +25,8 @@ from loongcollector_tpu import chaos, trace
 from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
 from loongcollector_tpu.models import (EventGroupMetaKey, PipelineEventGroup,
                                        SourceBuffer)
-from loongcollector_tpu.monitor.alarms import AlarmManager
+from loongcollector_tpu.monitor import ledger
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
 from loongcollector_tpu.ops.device_plane import DevicePlane
 from loongcollector_tpu.pipeline.pipeline_manager import (
     CollectionPipelineManager, ConfigDiff)
@@ -45,9 +46,11 @@ from conftest import wait_for
 def _clean():
     chaos.reset()
     trace.disable()
+    ledger.disable()
     yield
     chaos.reset()
     trace.disable()
+    ledger.disable()
     AlarmManager.instance().flush()
 
 
@@ -145,7 +148,8 @@ class TestWorkerLane:
 
             def send(self, groups):
                 pass
-        return (_P(), [], lambda: done.append(1), None, time.perf_counter())
+        return (_P(), [], lambda: done.append(1), None, time.perf_counter(),
+                "lane0")
 
     def test_relief_completes_owning_lane_once(self):
         r = ProcessorRunner(ProcessQueueManager(), None, thread_count=2)
@@ -239,12 +243,15 @@ def _build(tmp_path, name, thread_count, capacity=40):
     return pqm, mgr, runner, mgr.find_pipeline(name), out
 
 
-def _push_all(pqm, key, sources, per_source, lines_per_group=8):
+def _push_all(pqm, key, sources, per_source, lines_per_group=8,
+              seq_base=0):
     """Per source s: groups of lines 's<g>:<seq>' with a strictly
-    increasing seq — readable back from the flushed JSON."""
+    increasing seq — readable back from the flushed JSON.  ``seq_base``
+    lets a second wave continue each source's sequence (the mid-storm
+    conservation checkpoints split one storm into waves)."""
     total = 0
     for s_i, src in enumerate(sources):
-        seq = 0
+        seq = seq_base
         for _ in range(per_source):
             lines = []
             for _ in range(lines_per_group):
@@ -420,8 +427,14 @@ SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240803)
 
 def _shard_storm(seed, tmp_path, tag):
     """One seeded storm through the sharded plane: queue-push rejections +
-    device dispatch delays while 4 workers drain 6 sources."""
+    device dispatch delays while 4 workers drain 6 sources.  The
+    conservation ledger + auditor run live: the push splits into two
+    waves with a quiesced residual==0 checkpoint between them (the
+    acceptance criterion's mid-storm audit)."""
     DevicePlane.reset_for_testing(budget_bytes=2 * 1024 * 1024)
+    ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
     chaos.install(ChaosPlan(seed, {
         "bounded_queue.push": FaultSpec(
             prob=0.25, kinds=(chaos.ACTION_ERROR,), max_faults=50),
@@ -430,11 +443,28 @@ def _shard_storm(seed, tmp_path, tag):
             delay_range=(0.0, 0.003), max_faults=50),
     }))
     sources = [b"p%d" % i for i in range(6)]
-    pqm, mgr, runner, p, out = _build(tmp_path, f"storm-{tag}", 4)
+    name = f"storm-{tag}"
+    pqm, mgr, runner, p, out = _build(tmp_path, name, 4)
     try:
-        total = _push_all(pqm, p.process_queue_key, sources, 12)
+        total = _push_all(pqm, p.process_queue_key, sources, 6)
+        # mid-storm: faults still armed, the backlog just drained — the
+        # books must already balance before the second wave lands
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} mid-storm")
+        total += _push_all(pqm, p.process_queue_key, sources, 6,
+                           seq_base=6 * 8)
         assert wait_for(lambda: pqm.all_empty(), timeout=60)
         time.sleep(0.3)
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} post-storm")
+        assert auditor.quiesced_audits_total > 0, (
+            f"seed {seed}: the continuous auditor never saw a quiesce")
+        assert auditor.residual_alarms_total == 0, (
+            f"seed {seed}: the live auditor saw a conservation break")
+        assert not any(
+            a["alarm_type"] == AlarmType.CONSERVATION_RESIDUAL.value
+            for a in AlarmManager.instance().flush()), (
+            f"seed {seed}: CONSERVATION_RESIDUAL alarm raised mid-storm")
     finally:
         runner.stop()
         mgr.stop_all()

@@ -32,7 +32,8 @@ import pytest
 from loongcollector_tpu import chaos, trace
 from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
 from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
-from loongcollector_tpu.monitor.alarms import AlarmManager
+from loongcollector_tpu.monitor import ledger
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
 from loongcollector_tpu.ops import device_stream as ds
 from loongcollector_tpu.ops.device_plane import (DevicePlane,
                                                  LatencyInjectedKernel)
@@ -53,9 +54,11 @@ from conftest import wait_for
 def _clean():
     chaos.reset()
     trace.disable()
+    ledger.disable()
     yield
     chaos.reset()
     trace.disable()
+    ledger.disable()
     AlarmManager.instance().flush()
 
 
@@ -509,7 +512,7 @@ class TestRunnerDepth3Ordering:
             def send(self, groups):
                 pass
         pending = (_P(), [], lambda: done.append(1), None,
-                   time.perf_counter())
+                   time.perf_counter(), "lane0")
         # widen the deadline so a loaded host cannot make the "fresh"
         # probe observe an already-overdue group
         ds.auto_tuner()._flush_deadline_s = 0.5
@@ -551,10 +554,11 @@ def _build(tmp_path, name, thread_count, capacity=40):
     return pqm, mgr, runner, mgr.find_pipeline(name), out
 
 
-def _push_all(pqm, key, sources, per_source, lines_per_group=8):
+def _push_all(pqm, key, sources, per_source, lines_per_group=8,
+              seq_base=0):
     total = 0
     for s_i, src in enumerate(sources):
-        seq = 0
+        seq = seq_base
         for _ in range(per_source):
             lines = []
             for _ in range(lines_per_group):
@@ -581,10 +585,16 @@ def _read_per_source(out_path):
 def _stream_storm(seed, tmp_path, tag, monkeypatch):
     """One seeded storm through the depth-3 streaming plane: ERROR+DELAY
     faults at the async ring stages plus queue-push rejections, while 4
-    workers drain 6 sources through the device tier."""
+    workers drain 6 sources through the device tier.  The conservation
+    ledger + auditor run live, with a quiesced residual==0 checkpoint
+    mid-storm (ISSUE 8: the depth-3 sharded storm of the acceptance
+    criterion)."""
     monkeypatch.setenv("LOONG_STREAM_DEPTH", "3")
     monkeypatch.setenv("LOONG_NATIVE_T1", "0")
     plane = DevicePlane.reset_for_testing(budget_bytes=4 * 1024 * 1024)
+    ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
     eng = get_engine(STORM_PATTERN)
     assert eng._segment_kernel is not None
     lat = LatencyInjectedKernel(eng._segment_kernel, rtt_s=0.002,
@@ -603,9 +613,23 @@ def _stream_storm(seed, tmp_path, tag, monkeypatch):
     sources = [b"p%d" % i for i in range(6)]
     pqm, mgr, runner, p, out = _build(tmp_path, f"stream-storm-{tag}", 4)
     try:
-        total = _push_all(pqm, p.process_queue_key, sources, 10)
+        total = _push_all(pqm, p.process_queue_key, sources, 5)
+        # mid-storm: ring faults still armed, the first wave just drained
+        # through the depth-3 ring — the books must already balance
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} mid-storm")
+        total += _push_all(pqm, p.process_queue_key, sources, 5,
+                           seq_base=5 * 8)
         assert wait_for(lambda: pqm.all_empty(), timeout=60)
         time.sleep(0.3)
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} post-storm")
+        assert auditor.residual_alarms_total == 0, (
+            f"seed {seed}: the live auditor saw a conservation break")
+        assert not any(
+            a["alarm_type"] == AlarmType.CONSERVATION_RESIDUAL.value
+            for a in AlarmManager.instance().flush()), (
+            f"seed {seed}: CONSERVATION_RESIDUAL alarm raised mid-storm")
     finally:
         runner.stop()
         mgr.stop_all()

@@ -83,12 +83,13 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_six_checkers_registered(self):
+    def test_all_seven_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
-                         "swallowed-fault", "metric-naming"]
-        assert len(all_checkers()) == 6
+                         "swallowed-fault", "unledgered-drop",
+                         "metric-naming"]
+        assert len(all_checkers()) == 7
 
 
 # ---------------------------------------------------------------------------
@@ -835,6 +836,139 @@ def send(x):
         from loongcollector_tpu.analysis.checkers.swallowed_fault import \
             SwallowedFaultChecker
         findings = list(SwallowedFaultChecker().check_module(mod))
+        assert len(findings) == 1
+        assert mod.suppressed(findings[0].line, findings[0].check)
+
+
+class TestUnledgeredDrop:
+    """unledgered-drop (ISSUE 8): event discards in runner//flusher//input//
+    pipeline/queue/ must live in functions that touch the conservation
+    ledger — the static half of the zero-loss audit."""
+
+    SCOPE = "loongcollector_tpu/runner/fixture.py"
+
+    def _scan(self, src, relpath=None):
+        from loongcollector_tpu.analysis.checkers.unledgered_drop import \
+            UnledgeredDropChecker
+        return scan(src, UnledgeredDropChecker(),
+                    relpath=relpath or self.SCOPE)
+
+    def test_flags_logged_drop_without_ledger(self):
+        findings = self._scan("""
+            def dispatch(self, item):
+                if item.flusher is None:
+                    log.error("no sink wired; dropping payload")
+                    self.sqm.remove_item(item)
+                    return
+        """)
+        assert checks_of(findings) == {"unledgered-drop"}
+        assert findings[0].symbol == "dispatch"
+        assert "discard logged here" in findings[0].message
+
+    def test_flags_drop_counter_without_ledger(self):
+        findings = self._scan("""
+            class Q:
+                def push(self, group):
+                    while len(self._items) > self._cap:
+                        self._items.popleft()
+                        self.total_dropped += 1
+        """, relpath="loongcollector_tpu/pipeline/queue/fixture.py")
+        assert checks_of(findings) == {"unledgered-drop"}
+        assert "drop counter" in findings[0].message
+
+    def test_flags_continue_after_broad_except(self):
+        findings = self._scan("""
+            def send_loop(self):
+                for item in self._queue:
+                    try:
+                        self.deliver(item)
+                    except Exception:
+                        log.exception("send failed")
+                        continue
+        """, relpath="loongcollector_tpu/flusher/fixture.py")
+        assert checks_of(findings) == {"unledgered-drop"}
+        assert "abandons the current item" in findings[0].message
+
+    def test_ledger_record_in_function_ok(self):
+        findings = self._scan("""
+            def dispatch(self, item):
+                if item.flusher is None:
+                    log.error("no sink wired; dropping payload")
+                    ledger.record(self._pipeline, ledger.B_DROP,
+                                  item.event_cnt, tag="no_sink")
+                    self.sqm.remove_item(item)
+                    return
+        """)
+        assert findings == []
+
+    def test_self_ledger_helper_ok(self):
+        findings = self._scan("""
+            def send_loop(self):
+                for item in self._queue:
+                    try:
+                        self.deliver(item)
+                    except Exception:
+                        self._ledger_drop(item, "send_failed")
+                        log.exception("send failed, dropping item")
+                        continue
+        """, relpath="loongcollector_tpu/flusher/fixture.py")
+        assert findings == []
+
+    def test_ledger_is_on_guard_counts_as_touch(self):
+        findings = self._scan("""
+            def shed(self, group):
+                if ledger.is_on():
+                    _note(group)
+                log.warning("queue full; shedding group")
+        """)
+        assert findings == []
+
+    def test_narrow_except_continue_ok(self):
+        findings = self._scan("""
+            def send_loop(self):
+                for item in self._queue:
+                    try:
+                        self.deliver(item)
+                    except KeyError:
+                        continue
+        """, relpath="loongcollector_tpu/flusher/fixture.py")
+        assert findings == []
+
+    def test_return_after_except_outside_loop_ok(self):
+        findings = self._scan("""
+            def probe(self):
+                try:
+                    return self.fetch()
+                except Exception:
+                    return None
+        """)
+        assert findings == []
+
+    def test_out_of_scope_paths_ignored(self):
+        findings = self._scan("""
+            def refresh(self):
+                log.warning("stale sample dropped")
+        """, relpath="loongcollector_tpu/monitor/fixture.py")
+        assert findings == []
+
+    def test_log_without_drop_words_ok(self):
+        findings = self._scan("""
+            def dispatch(self, item):
+                log.warning("send slow, backing off")
+        """)
+        assert findings == []
+
+    def test_inline_disable_suppresses(self):
+        src = """
+def evict(self):
+    # cache eviction, no events ride the entry
+    # loonglint: disable=unledgered-drop
+    self.dropped_conns += 1
+"""
+        mod = ModuleInfo("/fx/" + self.SCOPE, self.SCOPE, src)
+        from loongcollector_tpu.analysis.checkers.unledgered_drop import \
+            UnledgeredDropChecker
+        findings = list(UnledgeredDropChecker().check_module(mod))
         assert len(findings) == 1
         assert mod.suppressed(findings[0].line, findings[0].check)
 
