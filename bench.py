@@ -696,6 +696,115 @@ def bench_scaling(n_lines=200000):
     return out
 
 
+def bench_multichip(chip_counts=(1, 2, 4, 8), n_lines=60000):
+    """loongmesh chips=1/2/4/8 e2e scaling sweep (ROADMAP open item 2):
+    the SAME full pipeline as the headline e2e bench, with the device
+    plane capped to c chips per step.
+
+    * chips=1 baseline and **lane mode** for c>1: c affinity-sharded
+      workers, each bound to its home chip (source → worker → chip), so
+      every chip runs an independent dispatch stream — the production
+      multi-worker shape.  ``scaling_efficiency`` = MBps(c) / (c *
+      MBps(1)); on a CPU-virtual-device host all "chips" share the same
+      silicon so the efficiency mostly prices the orchestration overhead —
+      the real scaling number comes from a TPU slice run of the same
+      sweep.
+    * one **mesh mode** data point at max chips: a single worker sharding
+      every batch over the full mesh via shard_map (the one-stream-
+      saturates-the-slice shape), with the per-chip padding readout from
+      the sharded kernel's occupancy accounting.
+
+    Per-chip padding fractions come from the chip-lane row counters (lane
+    mode) / the sharded kernel status (mesh mode) — the
+    ``extra.multichip`` record is the chips sweep the thread sweep's
+    ``extra.scaling`` has always had for workers."""
+    import jax
+
+    from loongcollector_tpu.ops import chip_lanes as _cl
+    from loongcollector_tpu.ops import device_stream as _ds
+    from loongcollector_tpu.ops.device_plane import DevicePlane
+    from loongcollector_tpu.ops.regex.engine import clear_engine_cache
+    from loongcollector_tpu.parallel import mesh as _mesh
+
+    ndev = len(jax.devices())
+    counts = [c for c in chip_counts if c <= ndev]
+    out: dict = {"devices_attached": ndev,
+                 "device": str(jax.devices()[0]),
+                 "chips": {}}
+    if not counts:
+        out["skipped"] = "no devices attached"
+        return out
+
+    env_keys = ("LOONG_MESH_CHIPS", "LOONG_SHARDED", "LOONG_NATIVE_T1")
+    saved = {k: os.environ.get(k) for k in env_keys}
+
+    def _reset(chips):
+        os.environ["LOONG_MESH_CHIPS"] = str(chips)
+        os.environ["LOONG_SHARDED"] = "1"
+        os.environ["LOONG_NATIVE_T1"] = "0"
+        clear_engine_cache()
+        _ds.reset_for_testing()
+        DevicePlane.reset_for_testing()
+        return _cl.reset_for_testing()
+
+    def _lane_padding(router):
+        fracs = []
+        for lane in router.lanes:
+            st = lane.status()
+            rows = st["rows_real"] + st["rows_padded"]
+            fracs.append(round(st["rows_padded"] / rows, 4) if rows else 0.0)
+        return fracs
+
+    base = None
+    try:
+        for c in counts:
+            router = _reset(c)
+            mbps = bench_pipeline_e2e(n_lines=n_lines, thread_count=c,
+                                      sojourn=False)[0]
+            entry = {"pipeline_e2e_MBps": round(mbps, 1),
+                     "workers": c,
+                     "mode": "lanes" if router.lane_count() else "mesh"}
+            if router.lane_count():
+                entry["per_chip_padding_fraction"] = _lane_padding(router)
+            else:
+                ms = _mesh.mesh_status()
+                if ms and ms["kernels"]:
+                    entry["per_chip_padding_fraction"] = \
+                        ms["kernels"][0]["per_chip_padding_fraction"]
+            if base is None:
+                base = mbps
+            else:
+                entry["scaling_efficiency"] = round(mbps / (base * c), 3)
+            out["chips"][str(c)] = entry
+        # mesh mode: one worker, full-mesh shard_map per batch
+        cmax = counts[-1]
+        if cmax > 1:
+            _reset(cmax)
+            mbps = bench_pipeline_e2e(n_lines=n_lines, thread_count=1,
+                                      sojourn=False)[0]
+            entry = {"chips": cmax, "pipeline_e2e_MBps": round(mbps, 1),
+                     "workers": 1}
+            ms = _mesh.mesh_status()
+            if ms and ms["kernels"]:
+                k = ms["kernels"][0]
+                entry["per_chip_padding_fraction"] = \
+                    k["per_chip_padding_fraction"]
+                entry["mesh_totals"] = k["totals"]
+                entry["pad_fallbacks"] = k["pad_fallbacks"]
+            out["mesh_mode"] = entry
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_engine_cache()
+        _ds.reset_for_testing()
+        DevicePlane.reset_for_testing()
+        _cl.reset_for_testing()
+    return out
+
+
 def _device_lane_overlap(rtt_s=0.004, n_groups=40):
     """What the sharded plane buys on a REAL accelerator: N workers hide N
     device round-trips at once.  Measured with the latency-injection
@@ -924,6 +1033,37 @@ def _safe(fn, default=-1.0):
         return default
 
 
+def _multichip_main() -> int:
+    """``--multichip``: run ONLY the chips sweep and persist it as a real
+    end-to-end record (MULTICHIP_r09.json replaces the dry-run tails of
+    r01–r05 — full pipeline MB/s per chip count, scaling efficiency,
+    per-chip padding, both lane and mesh modes)."""
+    import datetime
+
+    res = bench_multichip()
+    chips = res.get("chips", {})
+    best = max((v["pipeline_e2e_MBps"] for v in chips.values()),
+               default=0.0)
+    doc = {
+        "metric": "multichip_pipeline_e2e",
+        "value": best,
+        "unit": "MB/s",
+        "n_devices": res.get("devices_attached", 0),
+        "dryrun": False,
+        "ts": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
+        "extra": res,
+    }
+    print(json.dumps(doc))
+    try:
+        with open("MULTICHIP_r09.json", "w") as f:
+            f.write(json.dumps(doc, indent=1) + "\n")
+    except OSError as e:
+        print(f"# could not persist MULTICHIP_r09.json: {e}",
+              file=sys.stderr)
+    return 0
+
+
 def main():
     import jax
     degraded = False
@@ -935,6 +1075,9 @@ def main():
         # subprocess with a deadline; on failure fall back to CPU + mark it.
         from loongcollector_tpu.utils.backend import ensure_live_backend
         degraded = ensure_live_backend()
+
+    if "--multichip" in sys.argv:
+        return _multichip_main()
 
     try:
         (mbps, e2e, ok_frac, mbps_xla, mbps_pallas,
@@ -1002,6 +1145,13 @@ def main():
     fusion = _safe(bench_fusion, default=None)
     if fusion is not None:
         extra["fusion"] = fusion
+    # loongmesh: the chips=1/2/4/8 e2e sweep next to the thread sweep —
+    # lane-mode scaling efficiency, per-chip padding, one full-mesh point.
+    # Runs after streaming (both reset the stream plane on exit) so its
+    # env/cache churn never leaks into the headline numbers.
+    multichip = _safe(bench_multichip, default=None)
+    if multichip is not None:
+        extra["multichip"] = multichip
     from loongcollector_tpu.runner.processor_runner import \
         resolve_thread_count
     extra["process_threads"] = resolve_thread_count()

@@ -22,10 +22,13 @@ Acquire APIs (attr call + receiver filter, to stay quiet on unrelated
   .submit(...)    when the receiver mentions a device plane, or the call
                   passes the plane-protocol kwargs (nbytes / on_wait)
   ._acquire(...)  the raw budget primitive, same escape rules
-  .lease(...)     loongstream batch-ring slots (receiver mentions a ring):
-                  a leased BatchSlot escaping the statement must be
-                  releasable on every path, exactly like plane budget — a
-                  mid-loop pack/submit exception that strands leased slots
+  .lease(...)     loongstream batch-ring slots (receiver mentions a ring
+                  OR a chip lane — loongmesh workers lease per-lane slots
+                  on the same API): a leased BatchSlot escaping the
+                  statement must be releasable on every path, exactly
+                  like plane budget — a mid-loop pack/submit exception
+                  (or an injected chip-lane fault raising between lease
+                  and the pending append) that strands leased slots
                   starves the ring's pools and breaks the storm
                   conservation invariant (ring.leased_total() == 0)
 
@@ -58,8 +61,11 @@ def _is_acquire_call(node: ast.Call) -> bool:
     if tail == "_acquire":
         return True
     if tail == "lease":
-        # ring-slot leases: `ring.lease(B, L)` / `batch_ring().lease(...)`
-        return "ring" in receiver_repr(node).lower()
+        # ring-slot leases: `ring.lease(B, L)` / `batch_ring().lease(...)`,
+        # and loongmesh per-lane leases (`lane.ring.lease(...)`, a
+        # lane-keyed pool, or a chip-lane wrapper exposing .lease)
+        recv = receiver_repr(node).lower()
+        return "ring" in recv or "lane" in recv
     if tail != "submit":
         return False
     recv = receiver_repr(node).lower()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import os
+import shutil
 import subprocess
 import time
 from typing import Any, Dict, Optional
@@ -25,12 +26,29 @@ from .polling_base import PollingInput
 
 log = get_logger("command")
 
+# (suffix, interpreter name, fallback absolute paths).  The interpreter is
+# resolved via PATH first — containers differ on where sh/bash live
+# (/usr/bin vs /bin vs busybox), and the reference only fixes the NAME of
+# the interpreter, not its location.
 SCRIPT_TYPES = {
-    "bash": ("sh", "/usr/bin/bash"),
-    "shell": ("sh", "/usr/bin/sh"),
-    "python2": ("py", "/usr/bin/python2"),
-    "python3": ("py", "/usr/bin/python3"),
+    "bash": ("sh", "bash", ("/usr/bin/bash", "/bin/bash")),
+    "shell": ("sh", "sh", ("/usr/bin/sh", "/bin/sh")),
+    "python2": ("py", "python2", ("/usr/bin/python2",)),
+    "python3": ("py", "python3", ("/usr/bin/python3",)),
 }
+
+
+def resolve_interpreter(script_type: str) -> Optional[str]:
+    """Absolute interpreter path for a script type: $PATH lookup first,
+    then the conventional locations.  None when nothing exists."""
+    _, name, candidates = SCRIPT_TYPES[script_type]
+    found = shutil.which(name)
+    if found:
+        return found
+    for cand in candidates:
+        if os.path.exists(cand):
+            return cand
+    return None
 
 
 class InputCommand(PollingInput):
@@ -68,12 +86,14 @@ class InputCommand(PollingInput):
                              int(config.get("IntervalMs", 5000))) / 1000.0
         self.environments = list(config.get("Environments") or [])
         self.ignore_error = bool(config.get("IgnoreError", False))
-        suffix, default_cmd = SCRIPT_TYPES[self.script_type]
-        self.cmd_path = str(config.get("CmdPath") or default_cmd)
-        if not os.path.exists(self.cmd_path):
-            log.error("input_command: CmdPath %s does not exist",
-                      self.cmd_path)
+        suffix = SCRIPT_TYPES[self.script_type][0]
+        cmd_path = config.get("CmdPath") or resolve_interpreter(
+            self.script_type)
+        if not cmd_path or not os.path.exists(str(cmd_path)):
+            log.error("input_command: no interpreter for %s (CmdPath=%r)",
+                      self.script_type, config.get("CmdPath"))
             return False
+        self.cmd_path = str(cmd_path)
         storage = os.path.join(
             os.environ.get("LOONG_CONF_DIR",
                            os.path.join(os.path.expanduser("~"),

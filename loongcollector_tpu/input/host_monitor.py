@@ -83,20 +83,35 @@ class DiskCollector:
                 if not dev.startswith("/dev/") or mnt in seen:
                     continue
                 seen.add(mnt)
-                try:
-                    st = os.statvfs(mnt)
-                except OSError:
-                    continue
-                total = st.f_blocks * st.f_frsize
-                free = st.f_bavail * st.f_frsize
-                if total == 0:
-                    continue
-                tags = {"device": dev, "mount": mnt, "fstype": fstype}
-                out.append(("disk_total_bytes", float(total), tags))
-                out.append(("disk_free_bytes", float(free), tags))
-                out.append(("disk_used_percent",
-                            100.0 * (total - free) / total, tags))
+                self._emit(out, dev, mnt, fstype)
+        if "/" not in seen:
+            # containers/VMs often mount the root fs from a non-/dev/
+            # source (overlayfs, 9p, virtiofs) — report the root volume
+            # even when /dev/-backed data volumes exist, so root-disk
+            # capacity alerting is never blind
+            with open("/proc/mounts") as f:
+                for line in f:
+                    dev, mnt, fstype = line.split()[:3]
+                    if mnt == "/":
+                        self._emit(out, dev, mnt, fstype)
+                        break
         return out
+
+    @staticmethod
+    def _emit(out, dev, mnt, fstype):
+        try:
+            st = os.statvfs(mnt)
+        except OSError:
+            return
+        total = st.f_blocks * st.f_frsize
+        free = st.f_bavail * st.f_frsize
+        if total == 0:
+            return
+        tags = {"device": dev, "mount": mnt, "fstype": fstype}
+        out.append(("disk_total_bytes", float(total), tags))
+        out.append(("disk_free_bytes", float(free), tags))
+        out.append(("disk_used_percent",
+                    100.0 * (total - free) / total, tags))
 
 
 class NetCollector:

@@ -98,6 +98,9 @@ class AlarmType(str, enum.Enum):
     DEVICE_PARSE_FALLBACK = "DEVICE_PARSE_FALLBACK_ALARM"
     DEVICE_BACKEND_DEGRADED = "DEVICE_BACKEND_DEGRADED_ALARM"
     MESH_SHARD_FALLBACK = "MESH_SHARD_FALLBACK_ALARM"
+    # loongmesh: a chip lane's circuit opened — its shard respills to host
+    # parsing while the rest of the mesh keeps running
+    CHIP_LANE_OPEN = "CHIP_LANE_OPEN_ALARM"
     REGEX_TIER_DEMOTED = "REGEX_TIER_DEMOTED_ALARM"
     # loongledger: a quiesced conservation snapshot balanced to nonzero —
     # an event crossed into the agent and left without a ledgered exit

@@ -249,6 +249,37 @@ def collect_status() -> dict:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongmesh: chip lanes (breaker state, respill/fault counters,
+        # per-chip occupancy and in-flight bytes) + every live sharded
+        # kernel's psum telemetry, materialised here — off the hot path —
+        # into the mesh_*_total counters.  The "which chip is sick / how
+        # is the slice loaded" page.  Observe-only: never constructs the
+        # router or a mesh.
+        import sys as _sys
+        _cl = _sys.modules.get("loongcollector_tpu.ops.chip_lanes")
+        _mesh = _sys.modules.get("loongcollector_tpu.parallel.mesh")
+        mesh_doc: dict = {}
+        if _cl is not None:
+            r = _cl.active_router()
+            if r is not None and r.lane_count():
+                mesh_doc.update(r.status())
+        if _mesh is not None:
+            ks = _mesh.mesh_status()
+            if ks is not None:
+                mesh_doc.update(ks)
+        runner = None
+        try:
+            from ..runner import processor_runner as _pr
+            runner = _pr._active_runner
+        except Exception:  # noqa: BLE001
+            pass
+        if runner is not None and mesh_doc:
+            mesh_doc["worker_chip_map"] = runner.chip_lane_map()
+        if mesh_doc:
+            doc["mesh"] = mesh_doc
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         # loongfuse: fused-DFA compile stats — states/classes per set,
         # cache hit/miss, per-pattern demotions (the "why is grok slow /
         # did my pattern fall off the device tier" page)

@@ -94,7 +94,25 @@ def refresh() -> None:
                 _mesh_rec.gauge("last_matched").set(int(stats["matched"]))
                 _mesh_rec.gauge("last_events").set(int(stats["events"]))
                 _mesh_rec.gauge("last_bytes").set(int(stats["bytes"]))
+                # loongmesh: the monitor cadence is an off-hot-path fold
+                # point for the queued psum stats (mesh_*_total counters)
+                sharded.materialize_stats()
                 break
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongmesh chip lanes: breaker states + respill totals in the
+        # same stream (observe-only — the per-lane counters/gauges export
+        # through each lane's own record; this is the fleet-level rollup)
+        from ..ops import chip_lanes as _cl
+        r = _cl.active_router()
+        if r is not None and r.lane_count():
+            _mesh_rec.gauge("chip_lanes").set(r.lane_count())
+            _mesh_rec.gauge("chip_lanes_open").set(sum(
+                1 for l in r.lanes
+                if l.breaker_state().name != "CLOSED"))
+            _mesh_rec.gauge("chip_lane_respilled_events").set(
+                sum(l.respilled_events() for l in r.lanes))
     except Exception:  # noqa: BLE001
         pass
     try:

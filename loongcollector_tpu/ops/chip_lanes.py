@@ -1,0 +1,342 @@
+"""loongmesh chip lanes: per-chip dispatch streams with affinity, budget
+shares, breakers and chaos.
+
+One agent process owning an ICI-connected multi-chip slice has two ways to
+use it (ROADMAP open item 2, "millions of users"):
+
+* **Full-mesh SPMD** (parallel/mesh.ShardedParsePlane): one dispatch
+  stream shards every batch row-wise over all chips via ``shard_map``.
+  The production default for a single dispatching worker — one stream
+  saturating the whole slice.
+
+* **Chip lanes** (this module): when the sharded processor runner has
+  multiple workers, each worker binds to a home chip — ``source →
+  worker`` is loongshard's CRC32 affinity hash, ``worker → chip`` is
+  ``worker_id % n_chips`` — and dispatches its batches as single-device
+  executions *placed* on that chip.  Distinct chips run truly independent
+  execution streams (no collectives on the batch path), per-source
+  ordering survives multi-device fan-out by construction (stable source →
+  worker → chip chain + FIFO worker lanes), and a chip is an isolated
+  fault domain:
+
+  - **chaos**: every lane dispatch passes the fault point
+    ``device_plane.chip_lane.<i>`` (the ``device_plane.chip_lane.*``
+    family in the catalogue) — an injected ERROR is a single-chip fault.
+  - **breaker**: each lane owns a three-state circuit
+    (:class:`ChipLaneBreaker`, the sink-breaker machine with a chip-lane
+    vocabulary).  Repeated lane faults trip it OPEN: the lane's shard
+    **respills to host parsing** (ledger-conserved — the events still
+    parse, synchronously, on the host tier) while every other chip keeps
+    running.  After the cooldown one half-open probe dispatch is
+    admitted; success re-closes the lane.
+  - **budget**: each lane accounts its own in-flight bytes against a
+    per-chip share of the DevicePlane budget, so one slow chip's backlog
+    drains through its own lane instead of starving the whole plane.
+
+Observability: per-chip MetricsRecords (category ``device_plane``,
+component ``chip_lane``) carry dispatch/respill counters, row
+occupancy/padding and in-flight gauges; breaker state/transition counters
+ride the breaker's own record (component ``chip_lane_circuit``); the
+router's :func:`status` feeds the ``mesh`` section of ``/debug/status``.
+
+``LOONG_MESH_LANES`` forces lane routing on (=1) or off (=0); default
+auto — on when more than one device is attached.  ``LOONG_MESH_CHIPS``
+caps how many devices the router (and the full-mesh plane) use, which is
+what the bench chips=1/2/4/8 sweep varies.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from .. import chaos
+from ..monitor.alarms import AlarmType
+from ..runner.circuit import BreakerState, SinkCircuitBreaker
+from ..utils.logger import get_logger
+
+log = get_logger("chip_lanes")
+
+ENV_LANES = "LOONG_MESH_LANES"
+ENV_CHIPS = "LOONG_MESH_CHIPS"
+ENV_TRIP = "LOONG_LANE_TRIP_THRESHOLD"
+ENV_COOLDOWN = "LOONG_LANE_COOLDOWN_S"
+
+#: catalogue name for the per-lane fault-point family (the concrete
+#: points are ``device_plane.chip_lane.<i>``, registered per lane so a
+#: plan can storm one chip, a subset, or the whole slice via fnmatch)
+FP_CHIP_LANE = chaos.register_point("device_plane.chip_lane")
+
+
+class ChipLaneFault(chaos.ChaosFault):
+    """Injected single-chip fault (``device_plane.chip_lane.<i>``).  Typed
+    so the engine's drain loop can tell "this chip is faulting" (breaker
+    feedback + host respill) apart from the generic async-stage chaos
+    that re-runs on the same kernel."""
+
+
+def mesh_chip_cap(env=os.environ) -> Optional[int]:
+    """LOONG_MESH_CHIPS: cap on how many devices the lanes/mesh use
+    (the bench sweep's knob).  None = all attached devices."""
+    raw = env.get(ENV_CHIPS)
+    if raw:
+        try:
+            n = int(raw)
+            if n >= 1:
+                return n
+        except ValueError:
+            pass
+    return None
+
+
+def lanes_enabled(env=os.environ) -> Optional[bool]:
+    """Tri-state: True forced on, False forced off, None auto (on when
+    more than one device is attached)."""
+    raw = env.get(ENV_LANES, "").strip()
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    return None
+
+
+def _trip_threshold(env=os.environ) -> int:
+    try:
+        return max(1, int(env.get(ENV_TRIP, "3")))
+    except ValueError:
+        return 3
+
+
+def _cooldown_s(env=os.environ) -> float:
+    try:
+        return max(0.05, float(env.get(ENV_COOLDOWN, "2.0")))
+    except ValueError:
+        return 2.0
+
+
+class ChipLaneBreaker(SinkCircuitBreaker):
+    """The three-state sink-breaker machine wearing a chip-lane identity:
+    its own metric component, CHIP_LANE_OPEN alarms, and
+    ``chip_lane.open/half_open/close`` flight/trace events.  OPEN means
+    "this chip's shard parses on the host" — a throughput degradation,
+    never a loss."""
+
+    COMPONENT = "chip_lane_circuit"
+    FLIGHT_PREFIX = "chip_lane"
+    KIND = "chip lane"
+    DEGRADE_NOTE = "respilling shard to host parsing"
+    ALARM_TYPE = AlarmType.CHIP_LANE_OPEN
+
+
+class ChipLane:
+    """One chip's dispatch lane: device handle, fault point, breaker,
+    per-chip telemetry and in-flight byte accounting."""
+
+    def __init__(self, index: int, device=None):
+        self.index = index
+        self.device = device
+        self.fault_point = chaos.register_point(
+            f"device_plane.chip_lane.{index}")
+        self.breaker = ChipLaneBreaker(
+            f"chip{index}",
+            failure_threshold=_trip_threshold(),
+            cooldown_s=_cooldown_s())
+        from ..monitor.metrics import MetricsRecord
+        self.metrics = MetricsRecord(
+            category="device_plane",
+            labels={"component": "chip_lane", "chip": str(index)})
+        self._dispatches = self.metrics.counter("lane_dispatches_total")
+        self._respill_batches = self.metrics.counter(
+            "lane_respilled_batches_total")
+        self._respill_events = self.metrics.counter(
+            "lane_respilled_events_total")
+        self._faults = self.metrics.counter("lane_faults_total")
+        self._rows_real = self.metrics.counter("lane_rows_real_total")
+        self._rows_padded = self.metrics.counter("lane_rows_padded_total")
+        self._inflight_gauge = self.metrics.gauge("lane_inflight_bytes")
+        self._occupancy_gauge = self.metrics.gauge("lane_row_occupancy")
+        self._state_gauge = self.metrics.gauge("lane_breaker_state")
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._respilled_events_n = 0
+
+    # -- dispatch accounting -------------------------------------------------
+
+    def note_pack(self, B: int, n_real: int) -> None:
+        self._dispatches.add(1)
+        self._rows_real.add(n_real)
+        self._rows_padded.add(B - n_real)
+        self._occupancy_gauge.set(n_real / B if B else 0.0)
+
+    def note_dispatch(self, nbytes: int) -> None:
+        with self._lock:
+            self._inflight += nbytes
+            self._inflight_gauge.set(float(self._inflight))
+
+    def note_done(self, nbytes: int) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - nbytes)
+            self._inflight_gauge.set(float(self._inflight))
+
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def note_fault(self) -> None:
+        self._faults.add(1)
+
+    def note_respill(self, n_events: int) -> None:
+        self._respill_batches.add(1)
+        self._respill_events.add(n_events)
+        with self._lock:
+            self._respilled_events_n += n_events
+
+    def respilled_events(self) -> int:
+        with self._lock:
+            return self._respilled_events_n
+
+    # -- budget share --------------------------------------------------------
+
+    def over_share(self, plane, lane_count: int) -> bool:
+        """True when this lane holds more than its per-chip share of the
+        plane budget — the dispatcher drains its own oldest chunk first
+        (same never-sleep-owning-budget discipline, per chip)."""
+        if lane_count <= 1 or not plane.budget_bytes:
+            return False
+        share = plane.budget_bytes // lane_count
+        with self._lock:
+            return self._inflight > share
+
+    def mark_deleted(self) -> None:
+        """Retire this lane's metric records (router rebuild) — they must
+        not accumulate in WriteMetrics across reconfigurations."""
+        self.metrics.mark_deleted()
+        self.breaker.mark_deleted()
+
+    # -- observability -------------------------------------------------------
+
+    def breaker_state(self) -> BreakerState:
+        st = self.breaker.state
+        self._state_gauge.set(float(st))
+        return st
+
+    def status(self) -> dict:
+        return {
+            "chip": self.index,
+            "device": str(self.device) if self.device is not None else None,
+            "breaker": self.breaker_state().name,
+            "inflight_bytes": self.inflight_bytes(),
+            "dispatches": self._dispatches.value,
+            "rows_real": self._rows_real.value,
+            "rows_padded": self._rows_padded.value,
+            "respilled_batches": self._respill_batches.value,
+            "respilled_events": self._respill_events.value,
+            "faults": self._faults.value,
+        }
+
+
+def lane_gated(lane: ChipLane, kernel):
+    """Wrap a lane's kernel call so dispatch passes the lane's chaos point
+    (an injected ERROR raises :class:`ChipLaneFault` — a single-chip fault
+    at dispatch).  Mirrors :func:`device_stream.h2d_gated`: the wrapper is
+    what the plane submits, the bare kernel is what re-runs use, so an
+    injected fault never re-fires on the recovery path."""
+    fp = lane.fault_point
+
+    def _gated(*args):
+        chaos.faultpoint(fp, exc=ChipLaneFault)
+        return kernel(*args)
+    return _gated
+
+
+class ChipLaneRouter:
+    """Process-wide chip-lane registry: device discovery, worker→lane
+    binding, and the status document."""
+
+    def __init__(self, devices: Optional[list] = None):
+        if devices is None:
+            devices = self._discover()
+        cap = mesh_chip_cap()
+        if cap is not None:
+            devices = devices[:cap]
+        forced = lanes_enabled()
+        active = forced if forced is not None else len(devices) > 1
+        self.lanes: List[ChipLane] = (
+            [ChipLane(i, d) for i, d in enumerate(devices)] if active
+            else [])
+
+    @staticmethod
+    def _discover() -> list:
+        try:
+            import jax
+            return list(jax.devices())
+        except Exception:  # noqa: BLE001 — no backend ⇒ no lanes
+            return []
+
+    def lane_count(self) -> int:
+        return len(self.lanes)
+
+    def lane_for_worker(self, worker_id: int) -> Optional[ChipLane]:
+        """The home chip of a processor worker (``worker_id % n_chips``).
+        None when lane routing is inactive (≤1 device, or forced off) —
+        the caller then stays on the full-mesh/single-device path."""
+        if len(self.lanes) <= 1:
+            return None
+        return self.lanes[worker_id % len(self.lanes)]
+
+    def lane_for_source(self, queue_key: int, source: Optional[bytes],
+                        n_workers: int) -> Optional[ChipLane]:
+        """source → worker → chip: the full affinity chain, exposed for
+        determinism assertions and operator tooling.  Same CRC32 hash as
+        loongshard's worker routing, so the chip a source lands on is
+        stable across runs and processes."""
+        from ..runner.processor_runner import shard_of
+        return self.lane_for_worker(shard_of(queue_key, source, n_workers))
+
+    def status(self) -> dict:
+        return {
+            "lane_count": self.lane_count(),
+            "lanes": [lane.status() for lane in self.lanes],
+        }
+
+
+_router: Optional[ChipLaneRouter] = None
+_router_lock = threading.Lock()
+_tls = threading.local()
+
+
+def router() -> ChipLaneRouter:
+    global _router
+    if _router is None:
+        with _router_lock:
+            if _router is None:
+                _router = ChipLaneRouter()
+    return _router
+
+
+def active_router() -> Optional[ChipLaneRouter]:
+    """Observe-only handle (never constructs): /debug/status uses this."""
+    return _router
+
+
+def reset_for_testing(devices: Optional[list] = None) -> ChipLaneRouter:
+    """Rebuild the router (env caps / thresholds re-read); retires the old
+    lanes' metric records so WriteMetrics does not accumulate them."""
+    global _router
+    with _router_lock:
+        if _router is not None:
+            for lane in _router.lanes:
+                lane.mark_deleted()
+        _router = ChipLaneRouter(devices)
+        return _router
+
+
+def set_thread_lane(lane: Optional[ChipLane]) -> None:
+    """Bind THIS thread's dispatches to a chip lane (processor workers do
+    this at loop entry; None unbinds on exit)."""
+    _tls.lane = lane
+
+
+def current_lane() -> Optional[ChipLane]:
+    return getattr(_tls, "lane", None)

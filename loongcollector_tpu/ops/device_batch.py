@@ -33,16 +33,35 @@ def pick_length_bucket(max_len: int) -> Optional[int]:
     return None  # overlong → CPU fallback
 
 
-def pad_batch(n: int, min_batch: Optional[int] = None) -> int:
+def pad_batch(n: int, min_batch: Optional[int] = None,
+              multiple_of: int = 1) -> int:
     """Power-of-two batch size ≥ n, capped at MAX_BATCH (callers must chunk
     inputs larger than MAX_BATCH).  ``min_batch`` lowers the floor below
     the static MIN_BATCH — the width auto-tuner
     (ops/device_stream.WidthAutoTuner) passes its per-length-bucket floor
-    here so sparse traffic stops paying 256-row tensors for 8 real rows."""
+    here so sparse traffic stops paying 256-row tensors for 8 real rows.
+
+    ``multiple_of`` (loongmesh) rounds the result up to a shard multiple —
+    the engine passes ``ShardedKernel.batch_multiple`` so mesh dispatches
+    arrive shard-aligned and never pay a host-side realign copy.  A
+    power-of-two mesh divides any pow2 B ≥ its size, so this only adds
+    rows for odd mesh widths."""
     b = min_batch if min_batch else MIN_BATCH
     while b < n:
         b *= 2
-    return min(b, MAX_BATCH)
+    b = min(b, MAX_BATCH)
+    if multiple_of > 1:
+        b = max(b, multiple_of)
+        if b % multiple_of:
+            b += multiple_of - (b % multiple_of)
+        if b > MAX_BATCH:
+            # the MAX_BATCH cap outranks alignment: take the largest
+            # in-cap multiple that still fits n, else plain MAX_BATCH
+            # (the sharded kernel's private pad fallback realigns the
+            # rare odd-width remainder)
+            floor_mult = (MAX_BATCH // multiple_of) * multiple_of
+            b = floor_mult if floor_mult >= n else MAX_BATCH
+    return b
 
 
 @dataclass

@@ -186,13 +186,16 @@ class BatchSlot:
         self._leased = False
 
     def pack(self, arena: np.ndarray, offsets: np.ndarray,
-             lengths: np.ndarray):
+             lengths: np.ndarray, lane: Optional[int] = None):
         """Pack rows into this slot's buffers; records padding waste and
-        feeds the auto-tuner."""
+        feeds the auto-tuner (per chip lane when the dispatching worker is
+        lane-bound — loongmesh keys the tuner's floors per chip so one
+        sparse chip cannot shrink every lane's geometry)."""
         batch = pack_rows(arena, offsets, lengths, self.L, self.B,
                           out=(self.rows, self.lengths, self.origins))
         self._ring.record_pack(self.B, self.L, batch.n_real,
-                               int(np.asarray(lengths, np.int64).sum()))
+                               int(np.asarray(lengths, np.int64).sum()),
+                               lane=lane)
         return batch
 
     def release(self) -> None:
@@ -260,7 +263,7 @@ class BatchRing:
             self._leased = max(0, self._leased - 1)
 
     def record_pack(self, B: int, L: int, n_real: int,
-                    real_bytes: int) -> None:
+                    real_bytes: int, lane: Optional[int] = None) -> None:
         total_bytes = B * L
         padded_bytes = max(0, total_bytes - real_bytes)
         with self._lock:
@@ -277,7 +280,7 @@ class BatchRing:
         rec.counter("batch_rows_padded_total").add(B - n_real)
         rec.counter("batch_bytes_real_total").add(real_bytes)
         rec.counter("batch_bytes_padded_total").add(padded_bytes)
-        auto_tuner().observe_pack(L, B, n_real)
+        auto_tuner().observe_pack(L, B, n_real, lane=lane)
 
     # -- observability ------------------------------------------------------
 
@@ -373,7 +376,10 @@ class WidthAutoTuner:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._buckets: Dict[int, _BucketState] = {}
+        # keyed (lane, L): lane None is the process-global stream; chip
+        # lanes (loongmesh) get their own floors so one sparse chip's
+        # traffic cannot shrink the geometry every other chip dispatches
+        self._buckets: Dict[Tuple[Optional[int], int], _BucketState] = {}
         self._flush_deadline_s = self.DEADLINE_DEFAULT_S
         self._last_adjust = 0.0
         # None = unarmed: the first look at the plane only records the
@@ -385,18 +391,19 @@ class WidthAutoTuner:
 
     # -- B floor ------------------------------------------------------------
 
-    def min_batch_for(self, L: int) -> int:
+    def min_batch_for(self, L: int, lane: Optional[int] = None) -> int:
         if not tuner_enabled():
             return MIN_BATCH
         with self._lock:
-            st = self._buckets.get(L)
+            st = self._buckets.get((lane, L))
             return st.floor if st is not None else MIN_BATCH
 
-    def observe_pack(self, L: int, B: int, n_real: int) -> None:
+    def observe_pack(self, L: int, B: int, n_real: int,
+                     lane: Optional[int] = None) -> None:
         # row occupancy, deliberately NOT bytes: see the class docstring
         frac = (B - n_real) / B if B else 0.0
         with self._lock:
-            st = self._buckets.setdefault(L, _BucketState())
+            st = self._buckets.setdefault((lane, L), _BucketState())
             st.packs_total += 1
             st.packs_since += 1
             st.ewma_pad += self.EWMA_ALPHA * (frac - st.ewma_pad)
@@ -452,19 +459,30 @@ class WidthAutoTuner:
     def chosen(self) -> dict:
         """The tuner's current decisions — /debug/status and bench.py
         record these so every geometry the auto-tuner picked is auditable."""
+        def _bucket(st: _BucketState) -> dict:
+            return {"floor": st.floor,
+                    "ewma_row_padding_fraction": round(st.ewma_pad, 4),
+                    "packs": st.packs_total}
+
         with self._lock:
-            return {
+            lanes: Dict[str, dict] = {}
+            glob: Dict[str, dict] = {}
+            for (lane, L), st in sorted(self._buckets.items(),
+                                        key=lambda kv: (kv[0][0] is not None,
+                                                        kv[0])):
+                if lane is None:
+                    glob[str(L)] = _bucket(st)
+                else:
+                    lanes.setdefault(str(lane), {})[str(L)] = _bucket(st)
+            out = {
                 "enabled": tuner_enabled(),
                 "flush_deadline_ms": round(self._flush_deadline_s * 1e3, 3),
                 "deadline_adjusts": self._deadline_adjusts,
-                "buckets": {
-                    str(L): {"floor": st.floor,
-                             "ewma_row_padding_fraction":
-                                 round(st.ewma_pad, 4),
-                             "packs": st.packs_total}
-                    for L, st in sorted(self._buckets.items())
-                },
+                "buckets": glob,
             }
+            if lanes:
+                out["lane_buckets"] = lanes
+            return out
 
 
 _tuner: Optional[WidthAutoTuner] = None

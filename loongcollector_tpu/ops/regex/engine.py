@@ -22,6 +22,8 @@ import numpy as np
 import threading
 
 from ... import chaos
+from .. import chip_lanes
+from ..chip_lanes import ChipLaneFault, lane_gated
 from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows, pad_batch,
                             pick_length_bucket)
 from ..device_stream import (FP_RING_ADVANCE, auto_tuner, batch_ring,
@@ -210,6 +212,15 @@ _engine_cache_lock = threading.Lock()
 _ENGINE_CACHE_MAX = 512
 
 
+def clear_engine_cache() -> None:
+    """Drop every cached engine.  Mesh width (``LOONG_MESH_CHIPS``), lane
+    routing and backend forces are resolved once per engine — tests and
+    the bench chips sweep clear the cache after changing them so the next
+    ``get_engine`` re-resolves against the new environment."""
+    with _engine_cache_lock:
+        _engine_cache.clear()
+
+
 def get_engine(pattern: str,
                force_tier: Optional[PatternTier] = None) -> "RegexEngine":
     """Process-wide engine cache: pipeline reloads and same-pattern plugins
@@ -234,6 +245,36 @@ def get_engine(pattern: str,
     return eng
 
 
+class _LanePlacedKernel:
+    """A single-device kernel pinned to one chip lane (loongmesh): inputs
+    are device_put onto the lane's chip, so the jitted step executes on
+    that chip's stream — distinct workers drive distinct chips with no
+    collectives on the batch path.  Exposes the same ``donated_call``
+    protocol as the base kernels (the placed copies are transient staging
+    buffers, safe to donate)."""
+
+    __slots__ = ("base", "lane")
+
+    def __init__(self, base, lane):
+        self.base = base
+        self.lane = lane
+
+    def _place(self, rows, lengths):
+        import jax
+        return (jax.device_put(rows, self.lane.device),
+                jax.device_put(lengths, self.lane.device))
+
+    def __call__(self, rows, lengths):
+        rows_d, lens_d = self._place(rows, lengths)
+        return self.base(rows_d, lens_d)
+
+    def donated_call(self, rows, lengths):
+        rows_d, lens_d = self._place(rows, lengths)
+        don = getattr(self.base, "donated_call", None)
+        return don(rows_d, lens_d) if don is not None \
+            else self.base(rows_d, lens_d)
+
+
 class RegexEngine:
     def __init__(self, pattern: str, force_tier: Optional[PatternTier] = None):
         if isinstance(pattern, bytes):
@@ -246,6 +287,7 @@ class RegexEngine:
         self._pallas_kernel = None          # built lazily on first use
         self._use_pallas: Optional[bool] = None
         self._sharded = None                # None=unresolved, False=off
+        self._lane_kernels = {}             # chip index -> _LanePlacedKernel
         self._native_exec = None            # host C++ walker, built lazily
         self._native_tried = False
         self._dfa_kernel: Optional[DFAMatchKernel] = None
@@ -332,17 +374,40 @@ class RegexEngine:
             self._use_pallas = False
         if self._sharded not in (None, False) and kern is self._sharded:
             self._sharded = False
+        if isinstance(kern, _LanePlacedKernel):
+            # a placed kernel's failure is usually the BASE kernel's
+            # (Mosaic bug, not chip health): pin the base path too, or
+            # every lane rebuilds a wrapper around the same failing
+            # kernel and healthy chips trip their breakers on software
+            if kern.base is self._pallas_kernel:
+                self._use_pallas = False
+            self._lane_kernels.pop(kern.lane.index, None)
 
-    def _device_kernel(self):
-        """Segment-tier kernel selection: sharded mesh plane when multiple
-        devices are attached, else fused Pallas on TPU (one VMEM pass per
-        row block), XLA fusion elsewhere. Resolved once per engine; the
-        paths are differentially fuzzed against each other."""
+    def _device_kernel(self, lane=None):
+        """Segment-tier kernel selection.  A lane-bound dispatch (sharded
+        processor worker on a multi-chip host) gets a single-device kernel
+        PLACED on its home chip — independent per-chip execution streams,
+        the loongmesh data plane.  Unbound dispatches shard over the full
+        mesh when multiple devices are attached, else fused Pallas on TPU
+        (one VMEM pass per row block), XLA fusion elsewhere.  Resolved
+        once per engine (per lane); the paths are differentially fuzzed
+        against each other."""
         if getattr(self, "_kernel_override", None) is not None:
             return self._kernel_override
+        if lane is not None:
+            k = self._lane_kernels.get(lane.index)
+            if k is None:
+                k = _LanePlacedKernel(self._single_device_kernel(), lane)
+                self._lane_kernels[lane.index] = k
+            return k
         sharded = self._maybe_sharded()
         if sharded is not None:
             return sharded
+        return self._single_device_kernel()
+
+    def _single_device_kernel(self):
+        """Pallas-vs-XLA choice for one device (shared by the default
+        path and every lane-placed wrapper)."""
         if self._use_pallas is None:
             forced = _pallas_enabled()
             if forced is not None:
@@ -493,6 +558,28 @@ class RegexEngine:
                         cap_off[i, g] = o + s
                         cap_len[i, g] = e - s
 
+    def _host_parse_rows(self, arena, offsets, lengths, idx,
+                         ok, cap_off, cap_len) -> None:
+        """Host-tier parse of selected rows, spans arena-absolute — the
+        chip-lane RESPILL path (loongmesh): a tripped lane's shard parses
+        here, synchronously, so a single-chip fault costs throughput on
+        that lane only — never events, never the rest of the mesh.  Tier
+        order mirrors the degraded-mode routing: fused exec → native
+        walker → CPU `re`."""
+        if len(idx) == 0:
+            return
+        fx = self._fused_exec()
+        nat = fx if fx is not None else self._host_walker()
+        if nat is not None:
+            run = nat.parse if fx is not None else nat
+            k_ok, k_off, k_len = run(arena, offsets[idx], lengths[idx])
+            ok[idx] = k_ok
+            cap_off[idx] = k_off
+            cap_len[idx] = k_len
+            return
+        self._cpu_fallback_rows(arena, offsets, lengths, idx,
+                                ok, cap_off, cap_len)
+
     def match_batch(self, arena: np.ndarray, offsets: np.ndarray,
                     lengths: np.ndarray) -> np.ndarray:
         """Full-match boolean only (filtering) — can use the DFA tier."""
@@ -561,6 +648,13 @@ class PendingParse:
     Pallas/Mosaic failure at materialisation pins the engine to the XLA
     path and re-runs that chunk synchronously; failures on the XLA kernel
     itself propagate.  Every path releases the chunk's slot and budget.
+
+    loongmesh: a lane-bound worker's chunks dispatch on its home chip
+    (``device_plane.chip_lane.<i>`` chaos point, per-chip budget share,
+    per-chip tuner floors).  An injected single-chip fault feeds the
+    lane's breaker and respills that chunk to host parsing; a tripped
+    (OPEN) lane respills its whole shard pre-dispatch until the half-open
+    probe re-closes it — the other chips' lanes keep running throughout.
     """
 
     __slots__ = ("engine", "arena", "offsets", "lengths", "ok", "cap_off",
@@ -600,13 +694,38 @@ class PendingParse:
         plane = DevicePlane.instance()
         ring = batch_ring()
         tuner = auto_tuner()
-        self.kern = self.engine._device_kernel()
+        # loongmesh: a lane-bound worker thread dispatches on its home
+        # chip (source → worker → chip affinity); unbound dispatch shards
+        # over the full mesh (or runs single-device)
+        lane = chip_lanes.current_lane()
+        lane_count = chip_lanes.router().lane_count() if lane is not None \
+            else 0
+        self.kern = self.engine._device_kernel(lane)
         max_bucket = LENGTH_BUCKETS[-1]
         try:
             for chunk in _chunks(device_idx, MAX_BATCH):
+                if lane is not None and not lane.breaker.allow_probe():
+                    # lane breaker OPEN (or the half-open probe slot is
+                    # already in flight): this chip is sick — respill its
+                    # shard to host parsing.  Events still parse, in
+                    # order, synchronously (ledger-conserved); the other
+                    # chips' lanes keep running untouched.
+                    lane.note_respill(len(chunk))
+                    self.engine._host_parse_rows(
+                        self.arena, self.offsets, self.lengths, chunk,
+                        self.ok, self.cap_off, self.cap_len)
+                    continue
                 # ring advance: a full window materialises its oldest chunk
                 # (span return of N-depth+1) before packing N+1
                 while len(self._chunks_pending) >= self.depth:
+                    self._drain_one()
+                # per-chip budget share: a lane holding more than its
+                # slice of the plane budget drains its own oldest chunk
+                # first — one slow chip backs up its own lane, not the
+                # whole plane (same never-sleep-owning-budget rule)
+                while lane is not None \
+                        and lane.over_share(plane, lane_count) \
+                        and self._chunks_pending:
                     self._drain_one()
                 # re-read the kernel PER CHUNK: the drain above (or the
                 # budget-wait hook inside submit) may have pinned the
@@ -619,14 +738,24 @@ class PendingParse:
                 # the outputs instead of allocating per dispatch.
                 sub_kern = self.kern
                 call = getattr(sub_kern, "donated_call", None) or sub_kern
+                if lane is not None:
+                    # chip-lane chaos: dispatch passes this lane's fault
+                    # point; the bare kernel stays in the pending tuple so
+                    # recovery re-runs never re-fire the injection
+                    call = lane_gated(lane, call)
                 d_off = self.offsets[chunk]
                 d_len = self.lengths[chunk]
                 L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
                     or max_bucket
-                B = pad_batch(len(chunk), min_batch=tuner.min_batch_for(L))
+                lane_idx = lane.index if lane is not None else None
+                B = pad_batch(len(chunk),
+                              min_batch=tuner.min_batch_for(L, lane_idx),
+                              multiple_of=getattr(sub_kern,
+                                                  "batch_multiple", 1))
                 slot = ring.lease(B, L)
                 try:
-                    batch = slot.pack(self.arena, d_off, d_len)
+                    batch = slot.pack(self.arena, d_off, d_len,
+                                      lane=lane_idx)
                     fut = plane.submit(h2d_gated(call),
                                        (batch.rows, batch.lengths),
                                        batch.rows.nbytes,
@@ -634,15 +763,25 @@ class PendingParse:
                 except BaseException:
                     slot.release()
                     raise
+                if lane is not None:
+                    lane.note_pack(B, batch.n_real)
+                    lane.note_dispatch(batch.rows.nbytes)
                 self._chunks_pending.append((chunk, batch, slot, fut,
-                                             sub_kern))
+                                             sub_kern, lane))
         except BaseException:
             # a failed pack/submit must not strand the budget (or the ring
-            # slots) the already-submitted futures hold (round-5 leak):
-            # force-release them — the caller abandons this parse, nobody
-            # will result() them
-            for _, _, slot, fut, _k in self._chunks_pending:
+            # slots, or the lanes' in-flight accounting) the
+            # already-submitted futures hold (round-5 leak): force-release
+            # them — the caller abandons this parse, nobody will result()
+            # them
+            for _, b, slot, fut, _k, ln in self._chunks_pending:
                 fut.release()
+                if ln is not None:
+                    ln.note_done(b.rows.nbytes)
+                    # an abandoned chunk may hold the lane's half-open
+                    # probe slot — release it (no health sample) so the
+                    # lane is not forced to respill until probe_timeout_s
+                    ln.breaker.on_inconclusive()
                 slot.release()
             self._chunks_pending.clear()
             raise
@@ -657,34 +796,73 @@ class PendingParse:
         return True
 
     def _drain_one(self) -> None:
-        chunk, batch, slot, fut, sub_kern = self._chunks_pending.pop(0)
+        chunk, batch, slot, fut, sub_kern, lane = self._chunks_pending.pop(0)
         try:
             try:
                 chaos.faultpoint(FP_RING_ADVANCE)
                 k_ok, k_off, k_len = fut.result()
+                if lane is not None:
+                    # healthy materialisation on this chip: breaker sample
+                    # (re-closes a half-open lane when this was the probe)
+                    lane.breaker.on_success()
+            except ChipLaneFault:
+                # injected SINGLE-CHIP fault (device_plane.chip_lane.<i>):
+                # feed the lane breaker — enough of these trip it OPEN and
+                # later chunks respill pre-dispatch — and respill THIS
+                # chunk's shard to host parsing.  Events conserved, order
+                # kept (results land in the same slots), the other chips'
+                # lanes never notice.
+                fut.release()
+                lane.breaker.on_failure()
+                lane.note_fault()
+                lane.note_respill(int(batch.n_real))
+                self.engine._host_parse_rows(
+                    self.arena, self.offsets, self.lengths, chunk,
+                    self.ok, self.cap_off, self.cap_len)
+                return
             except chaos.ChaosFault:
                 # injected async-stage fault (h2d / ring_advance / submit):
                 # it must error only THIS chunk — the slot still holds the
                 # packed rows, so re-run synchronously and keep the ring
                 # moving in order.  fut.release() is a no-op if result()
-                # already returned the budget.
+                # already returned the budget.  The chunk may hold the
+                # lane's half-open probe slot: its outcome MUST reach the
+                # breaker (success on a clean re-run, inconclusive on a
+                # re-run failure) or the slot wedges and the whole lane
+                # respills for probe_timeout_s.
                 fut.release()
-                k_ok, k_off, k_len = (np.asarray(a) for a in
-                                      sub_kern(batch.rows, batch.lengths))
+                try:
+                    outs = sub_kern(batch.rows, batch.lengths)
+                except BaseException:
+                    if lane is not None:
+                        lane.breaker.on_inconclusive()
+                    raise
+                if lane is not None:
+                    lane.breaker.on_success()
+                k_ok, k_off, k_len = (np.asarray(a) for a in outs)
             except Exception:  # noqa: BLE001
                 if sub_kern is self.engine._segment_kernel or \
                         getattr(self.engine, "_kernel_override",
                                 None) is not None:
                     raise
-                # Mosaic/mesh runtime failure must cost throughput, never
-                # liveness: pin this engine off the failed path and re-run
-                # the chunk on the proven XLA kernel
+                # Mosaic/mesh/chip runtime failure must cost throughput,
+                # never liveness: pin this engine off the failed path and
+                # re-run the chunk on the proven XLA kernel.  A lane
+                # kernel's REAL failure also counts against its chip's
+                # breaker — repeated ones trip the lane to host respill.
                 from ...utils.logger import get_logger
                 get_logger("regex").exception(
                     "device kernel failed for %r; falling back to XLA path",
                     self.engine.pattern)
+                if lane is not None:
+                    lane.breaker.on_failure()
+                    lane.note_fault()
                 self.engine._device_kernel_failed(sub_kern)
-                self.kern = self.engine._segment_kernel
+                # lane dispatches keep their placement (the pop above
+                # plus base pinning rebuilds a wrapper around the proven
+                # XLA kernel); unplaced dispatches fall to XLA directly
+                self.kern = self.engine._segment_kernel if lane is None \
+                    else self.engine._device_kernel(lane)
                 k_ok, k_off, k_len = (np.asarray(a) for a in
                                       self.kern(batch.rows, batch.lengths))
             k_ok = k_ok[: batch.n_real]
@@ -695,6 +873,8 @@ class PendingParse:
             self.cap_off[chunk] = k_off + batch.origins[: batch.n_real, None]
             self.cap_len[chunk] = k_len
         finally:
+            if lane is not None:
+                lane.note_done(batch.rows.nbytes)
             # the slot may be repacked the moment it returns to the ring:
             # release only after the spans were copied out above
             slot.release()
@@ -712,12 +892,15 @@ class PendingParse:
                 self._drain_one()
         except BaseException:
             # a failed chunk must not leak the others' in-flight budget —
-            # or their ring slots
-            for _, _, slot, fut, _k in self._chunks_pending:
+            # or their ring slots, or their lanes' in-flight accounting
+            for _, b, slot, fut, _k, ln in self._chunks_pending:
                 try:
                     fut.result()
                 except Exception:  # noqa: BLE001 — releasing, not consuming
                     pass
+                if ln is not None:
+                    ln.note_done(b.rows.nbytes)
+                    ln.breaker.on_inconclusive()   # see dispatch cleanup
                 slot.release()
             self._chunks_pending.clear()
             raise

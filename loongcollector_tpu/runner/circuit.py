@@ -49,7 +49,19 @@ class SinkCircuitBreaker:
     one of `on_success()` / `on_failure()` reports each send's outcome.
     `on_close` (if set) runs outside the lock whenever a half-open probe
     re-closes the breaker — owners hook disk-buffer replay there.
+
+    The emit vocabulary (metric component, alarm type, flight/trace event
+    prefix, degradation note) is class-level so other fault domains reuse
+    the exact three-state machine with their own observability identity —
+    loongmesh's per-chip lane breakers (ops/chip_lanes.ChipLaneBreaker)
+    subclass this instead of re-implementing trip/probe/re-close.
     """
+
+    COMPONENT = "sink_circuit"
+    ALARM_TYPE = AlarmType.SINK_CIRCUIT_OPEN
+    FLIGHT_PREFIX = "breaker"
+    KIND = "sink"
+    DEGRADE_NOTE = "degrading to disk buffer"
 
     def __init__(self, name: str,
                  failure_threshold: int = 5,
@@ -85,7 +97,7 @@ class SinkCircuitBreaker:
         self._pending_emits: List[Tuple[str, str]] = []
         self.metrics = MetricsRecord(
             category="component",
-            labels={"component": "sink_circuit", "sink": name})
+            labels={"component": self.COMPONENT, "sink": name})
         self._state_gauge = self.metrics.gauge("state")
         self._opened_total = self.metrics.counter("opened_total")
         self._reclosed_total = self.metrics.counter("reclosed_total")
@@ -235,24 +247,27 @@ class SinkCircuitBreaker:
             if not self._pending_emits:
                 return
             emits, self._pending_emits = self._pending_emits, []
+        pre = self.FLIGHT_PREFIX
         for kind, why in emits:
             if kind == "open":
                 if trace.is_active():
-                    trace.event("breaker.open", sink=self.name, why=why)
-                flight.record("breaker.open", sink=self.name, why=why)
-                log.warning("sink circuit %s opened: %s", self.name, why)
+                    trace.event(f"{pre}.open", sink=self.name, why=why)
+                flight.record(f"{pre}.open", sink=self.name, why=why)
+                log.warning("%s circuit %s opened: %s", self.KIND,
+                            self.name, why)
                 AlarmManager.instance().send_alarm(
-                    AlarmType.SINK_CIRCUIT_OPEN,
-                    f"sink {self.name} circuit opened: {why}; degrading to "
-                    "disk buffer", AlarmLevel.ERROR, pipeline=self.pipeline)
+                    self.ALARM_TYPE,
+                    f"{self.KIND} {self.name} circuit opened: {why}; "
+                    f"{self.DEGRADE_NOTE}",
+                    AlarmLevel.ERROR, pipeline=self.pipeline)
             elif kind == "half_open":
                 if trace.is_active():
-                    trace.event("breaker.half_open", sink=self.name)
-                flight.record("breaker.half_open", sink=self.name)
+                    trace.event(f"{pre}.half_open", sink=self.name)
+                flight.record(f"{pre}.half_open", sink=self.name)
             else:
                 if trace.is_active():
-                    trace.event("breaker.close", sink=self.name)
-                flight.record("breaker.close", sink=self.name)
-                log.info("sink circuit %s re-closed", self.name)
+                    trace.event(f"{pre}.close", sink=self.name)
+                flight.record(f"{pre}.close", sink=self.name)
+                log.info("%s circuit %s re-closed", self.KIND, self.name)
                 if self.on_close is not None:
                     self.on_close()

@@ -55,6 +55,7 @@ from ..models import EventGroupMetaKey, PipelineEventGroup
 from ..monitor import ledger
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
+from ..ops import chip_lanes
 from ..ops.device_plane import note_host_backlog, set_budget_relief
 from ..ops.device_stream import auto_tuner
 from ..prof import flight
@@ -552,12 +553,38 @@ class ProcessorRunner:
             prof.pop_marker()
             set_budget_relief(None)
 
+    def _chip_lane_for(self, worker_id: int):
+        """loongmesh: this worker's home chip lane (source → worker →
+        chip: the CRC32 affinity hash picked the worker, ``worker_id %
+        n_chips`` picks the chip).  None when ≤1 device is attached or
+        lane routing is off (``LOONG_MESH_LANES=0``) — dispatches then
+        stay on the full-mesh / single-device path.  Fail-soft: a missing
+        backend must never kill a worker thread."""
+        try:
+            return chip_lanes.router().lane_for_worker(worker_id)
+        except Exception:  # noqa: BLE001
+            log.exception("chip-lane routing unavailable; worker %d "
+                          "stays unbound", worker_id)
+            return None
+
+    def chip_lane_map(self) -> List[Optional[int]]:
+        """worker index -> bound chip index (None = unbound), for
+        /debug/status and the affinity-determinism tests."""
+        out: List[Optional[int]] = []
+        for i in range(self.thread_count if self.thread_count > 1 else 0):
+            lane = self._chip_lane_for(i)
+            out.append(lane.index if lane is not None else None)
+        return out
+
     def _run_worker(self, worker_id: int) -> None:
         """Sharded mode: consume this worker's inbox with the same
-        overlapped device lane ring as the single-thread loop."""
+        overlapped device lane ring as the single-thread loop.  The
+        worker binds to its home chip lane for the duration — every
+        device dispatch it makes lands on that chip."""
         lane = self._lanes[worker_id]
         inbox = self._inboxes[worker_id]
         set_budget_relief(self._make_relief(lane))
+        chip_lanes.set_thread_lane(self._chip_lane_for(worker_id))
         prof.push_marker("worker", f"processor-{worker_id}")
         try:
             while True:
@@ -576,6 +603,7 @@ class ProcessorRunner:
             self._complete_lane(lane)
         finally:
             prof.pop_marker()
+            chip_lanes.set_thread_lane(None)
             set_budget_relief(None)
 
     def _handle_one(self, item: Tuple[int, PipelineEventGroup],

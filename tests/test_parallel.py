@@ -119,6 +119,31 @@ class TestShardedEquivalence:
             assert bool(ok[i]) == bool(rx.fullmatch(ln)), (i, ln)
 
 
+class TestShardedKernelContract:
+    """loongmesh: the engine-facing adapter contract the production
+    dispatch path relies on."""
+
+    def test_batch_multiple_and_donated_protocol(self, mesh):
+        from loongcollector_tpu.parallel.mesh import ShardedKernel
+        kern = ShardedKernel(compile_tier1(r"(\d+)-(\w+)"), mesh)
+        assert kern.batch_multiple == 8
+        lines = [f"{i}-x{i}".encode() for i in range(64)]
+        batch = _pack(lines)
+        # the mesh_* counters are process totals per chip count: deltas
+        base = kern.status()
+        # donated_call is the streaming-path protocol PendingParse picks
+        # up; on CPU it falls back to the plain step — results identical
+        ok_d, off_d, len_d = kern.donated_call(batch.rows, batch.lengths)
+        ok_p, off_p, len_p = kern(batch.rows, batch.lengths)
+        np.testing.assert_array_equal(np.asarray(ok_d), np.asarray(ok_p))
+        np.testing.assert_array_equal(np.asarray(off_d), np.asarray(off_p))
+        # both dispatches queued psum stats; folding them off the hot
+        # path accounts every event exactly once
+        st = kern.status()
+        assert st["dispatches"] - base["dispatches"] == 2
+        assert st["totals"]["events"] - base["totals"]["events"] == 2 * 64
+
+
 class TestMeshBackedPipeline:
     def test_parse_regex_group_on_mesh(self, mesh):
         """A full PipelineEventGroup flows through split + a mesh-backed

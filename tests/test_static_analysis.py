@@ -246,6 +246,54 @@ class TestAcquireRelease:
         """
         assert scan(src, AcquireReleaseChecker()) == []
 
+    # loongmesh (ISSUE 9): per-lane slot leases.  The leak-on-chip-fault
+    # shape: a lane-bound dispatch loop leases slots and fires the
+    # chip-lane fault point BETWEEN the lease and the pending append — an
+    # injected single-chip fault (ChipLaneFault at dispatch) unwinds the
+    # loop with the fresh slot AND every already-pending one stranded.
+    LANE_LEASE_CHIP_FAULT_LEAK = """
+    def dispatch_on_lane(lane, plane, arena, chunks, pending):
+        for chunk in chunks:
+            slot = lane.ring.lease(256, 128)
+            batch = slot.pack(arena, chunk)
+            fut = plane.submit(lane_gated(lane, kern),
+                               (batch.rows, batch.lengths),
+                               batch.rows.nbytes)
+            pending.append((chunk, batch, slot, fut, lane))
+    """
+
+    LANE_LEASE_CHIP_FAULT_FIXED = """
+    def dispatch_on_lane(lane, plane, arena, chunks, pending):
+        try:
+            for chunk in chunks:
+                slot = lane.ring.lease(256, 128)
+                try:
+                    batch = slot.pack(arena, chunk)
+                    fut = plane.submit(lane_gated(lane, kern),
+                                       (batch.rows, batch.lengths),
+                                       batch.rows.nbytes)
+                except BaseException:
+                    slot.release()
+                    raise
+                pending.append((chunk, batch, slot, fut, lane))
+        except BaseException:
+            for _, b, slot, fut, ln in pending:
+                fut.release()
+                slot.release()
+            pending.clear()
+            raise
+    """
+
+    def test_lane_lease_leak_on_chip_fault_flagged(self):
+        findings = scan(self.LANE_LEASE_CHIP_FAULT_LEAK,
+                        AcquireReleaseChecker())
+        assert len(findings) >= 1
+        assert any("ring slot leased" in f.message for f in findings)
+
+    def test_lane_lease_guarded_is_clean(self):
+        assert scan(self.LANE_LEASE_CHIP_FAULT_FIXED,
+                    AcquireReleaseChecker()) == []
+
     # loongfuse: the fused-kernel geometry-cache pattern — a lazily-built
     # per-geometry kernel whose persistence layer touches cache files.
     # The kernel build itself is clean (no obligations); the cache I/O
