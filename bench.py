@@ -388,6 +388,40 @@ def _hist_ms(hist):
             "max_ms": round(s["max"] * 1000, 3)}
 
 
+def _alloc_snapshot():
+    """Allocation-churn baseline for extra.alloc: per-generation gc stats
+    plus the columnar plane's materialization counters."""
+    import gc
+
+    from loongcollector_tpu import models as _models
+    return (gc.get_stats(), _models.churn_stats())
+
+
+def _alloc_delta(before):
+    import gc
+
+    from loongcollector_tpu import models as _models
+    gc0, churn0 = before
+    gc1 = gc.get_stats()
+    churn1 = _models.churn_stats()
+    return {
+        "gc_collections": sum(s["collections"] for s in gc1)
+        - sum(s["collections"] for s in gc0),
+        "gc_collected": sum(s["collected"] for s in gc1)
+        - sum(s["collected"] for s in gc0),
+        "gc_uncollectable": sum(s["uncollectable"] for s in gc1)
+        - sum(s["uncollectable"] for s in gc0),
+        "materialized_events": churn1["materialized_events"]
+        - churn0["materialized_events"],
+        "materialized_groups": churn1["materialized_groups"]
+        - churn0["materialized_groups"],
+        "materialized_by_boundary": {
+            k: v - churn0["by_boundary"].get(k, 0)
+            for k, v in churn1["by_boundary"].items()
+            if v - churn0["by_boundary"].get(k, 0)},
+    }
+
+
 def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
     """Full-pipeline throughput: raw chunks → split → device regex parse →
     route → serialize (blackhole), through the real queue/runner machinery —
@@ -473,6 +507,10 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         queue_wait_histogram().snapshot(reset=True)
         for inst in p.inner_processors + p.processors:
             inst.stage_hist.snapshot(reset=True)
+        # loongcolumn: allocation churn around the measured window —
+        # extra.alloc makes materialization elimination visible in the
+        # bench trajectory, not just as throughput
+        alloc_before = _alloc_snapshot()
         # best-of-3: the bench host is a shared single core — transient CPU
         # steal (co-tenants, monitoring probes) halves a single sample; the
         # least-contended trial is the honest machine capability
@@ -517,9 +555,11 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
             if best_dt is None or dt < best_dt:
                 best_dt = dt
         dt = best_dt
+        alloc = _alloc_delta(alloc_before)
         if not sojourn:
             # scaling-sweep mode: throughput only, keep the window short
-            return (pushed_bytes / dt / 1e6, None, None, None, None, None)
+            return (pushed_bytes / dt / 1e6, None, None, None, None, None,
+                    alloc)
         make_group = _mk
         # event→flush sojourn: push single-chunk groups one at a time and time
         # arrival at the sink (the BASELINE p99 latency metric)
@@ -569,7 +609,7 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         return (pushed_bytes / dt / 1e6,
                 sojourns[len(sojourns) // 2],
                 sojourns[int(len(sojourns) * 0.99)],
-                trajectory, utilization, conservation)
+                trajectory, utilization, conservation, alloc)
     finally:
         # ANY raise between init and the return (warm-up timeout,
         # drain incomplete, failed audit) must not leak the worker
@@ -677,6 +717,236 @@ def _collect_utilization(pqm, p, bh, runner, n_groups=24, window_s=8.0):
     return util
 
 
+def _columnar_e2e_once(n_lines, columnar, with_ledger):
+    """One digest-instrumented e2e run on the requested event path.
+
+    ``columnar=False`` flips the whole agent to the dict path
+    (``models.set_columnar_enabled``): every instance boundary
+    materializes per-event LogEvents and the sinks serialize row objects
+    — the pre-loongcolumn shape the side-by-side prices."""
+    from loongcollector_tpu import models as _models
+    from loongcollector_tpu.models import (EventGroupMetaKey,
+                                           PipelineEventGroup, SourceBuffer)
+    from loongcollector_tpu.monitor import ledger as _ledger
+    from loongcollector_tpu.pipeline.pipeline_manager import (
+        CollectionPipelineManager, ConfigDiff)
+    from loongcollector_tpu.pipeline.queue.bounded_queue import \
+        queue_wait_histogram
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.queue.sender_queue import \
+        SenderQueueManager
+    from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+
+    prev_mode = _models.set_columnar_enabled(columnar)
+    if with_ledger:
+        _ledger.enable()
+        _ledger.reset()
+    pqm = ProcessQueueManager()
+    mgr = CollectionPipelineManager(pqm, SenderQueueManager())
+    runner = ProcessorRunner(pqm, mgr)
+    runner.init()
+    try:
+        diff = ConfigDiff()
+        diff.added["bench-col"] = {
+            "inputs": [{"Type": "input_static_file_onetime",
+                        "FilePaths": ["/nonexistent"]}],
+            "global": {"ProcessQueueCapacity": 40},
+            "processors": [{"Type": "processor_parse_regex_tpu",
+                            "Regex": APACHE,
+                            "Keys": ["ip", "ident", "user", "time", "method",
+                                     "url", "proto", "status", "size"]}],
+            "flushers": [{"Type": "flusher_blackhole", "Digest": True}],
+        }
+        mgr.update_pipelines(diff)
+        p = mgr.find_pipeline("bench-col")
+        bh = p.flushers[0].plugin
+        base = gen_lines(4096)
+        sources = ["/var/log/bench/col-%d.log" % i for i in range(8)]
+
+        def _mk(i):
+            # every chunk distinct (a per-group header line): the digest
+            # sums per-group payload hashes, and distinct payloads make
+            # it sensitive to any single-byte divergence
+            payload = (b"chunk-%d - marker" % i) + b"\n" \
+                + b"\n".join(base) + b"\n"
+            sb = SourceBuffer(len(payload) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(payload))
+            g.set_metadata(EventGroupMetaKey.LOG_FILE_PATH,
+                           sources[i % len(sources)])
+            return g, len(payload)
+
+        g0, chunk_len = _mk(0)
+        pqm.push_queue(p.process_queue_key, g0)
+        deadline = time.monotonic() + 120
+        while bh.total_events == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if bh.total_events == 0:
+            raise RuntimeError("columnar side-by-side warm-up never "
+                               "completed")
+        queue_wait_histogram().snapshot(reset=True)
+        alloc_before = _alloc_snapshot()
+        n_chunks = max(2, n_lines // 4096)
+        want = bh.total_events + n_chunks * 4097
+        t0 = time.perf_counter()
+        pushed_bytes = 0
+        push_deadline = time.monotonic() + 300
+        for i in range(1, n_chunks + 1):
+            g, ln = _mk(i)
+            while not pqm.push_queue(p.process_queue_key, g):
+                if time.monotonic() > push_deadline:
+                    raise RuntimeError("columnar side-by-side push starved")
+                time.sleep(0.001)
+            pushed_bytes += ln
+        deadline = time.monotonic() + 300
+        while bh.total_events < want and time.monotonic() < deadline:
+            time.sleep(0.001)
+        dt = time.perf_counter() - t0
+        if bh.total_events < want:
+            raise RuntimeError(
+                f"columnar side-by-side drain incomplete: "
+                f"{bh.total_events}/{want}")
+        # total_events increments BEFORE the sink serializes: wait until
+        # every send's digest landed too, or the read races the last
+        # group's hash fold
+        want_groups = n_chunks + 1
+        while bh.output_digest()["groups"] < want_groups \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+        if bh.output_digest()["groups"] < want_groups:
+            raise RuntimeError("columnar side-by-side digest incomplete")
+        qsnap = queue_wait_histogram().snapshot()
+        out = {
+            "MBps": round(pushed_bytes / dt / 1e6, 1),
+            "queue_wait_p50_ms": round(qsnap["p50"] * 1000, 3),
+            "queue_wait_p99_ms": round(qsnap["p99"] * 1000, 3),
+            "digest": bh.output_digest(),
+            "alloc": _alloc_delta(alloc_before),
+        }
+        if with_ledger:
+            snap = _ledger.wait_quiesced(timeout=30.0)
+            if snap is None:
+                raise SystemExit("columnar side-by-side: ledger never "
+                                 "quiesced")
+            bad = {pl: r for pl, r in _ledger.residuals(snap).items() if r}
+            if bad:
+                raise SystemExit(f"columnar side-by-side: nonzero "
+                                 f"conservation residual {bad}")
+            out["conservation_residual"] = 0
+        return out
+    finally:
+        runner.stop()
+        mgr.stop_all()
+        if with_ledger:
+            _ledger.disable()
+        _models.set_columnar_enabled(prev_mode)
+
+
+def _columnar_micro():
+    """Serialize-stage micro-sweep: the same parsed group serialized from
+    span columns vs from materialized row objects, per sink family."""
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.pipeline.serializer.json_serializer import \
+        JsonSerializer
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.processor.parse_regex import ProcessorParseRegex
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+
+    out = {}
+    ctx = PluginContext("col-micro")
+    for n in (256, 4096):
+        lines = gen_lines(n, seed=5)
+        payload = b"\n".join(lines) + b"\n"
+        sp = ProcessorSplitLogString(); sp.init({}, ctx)
+        pr = ProcessorParseRegex()
+        pr.init({"Regex": APACHE,
+                 "Keys": ["ip", "ident", "user", "time", "method", "url",
+                          "proto", "status", "size"]}, ctx)
+
+        def parsed_group():
+            sb = SourceBuffer(len(payload) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(payload))
+            sp.process(g)
+            pr.process(g)
+            return g
+
+        g_col = parsed_group()
+        g_dict = parsed_group()
+        g_dict.materialize("micro")
+        total = len(payload)
+
+        def best(fn, iters=5):
+            fn()
+            b = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn()
+                b = max(b, total * iters / (time.perf_counter() - t0))
+            return b / 1e6
+
+        sls, js = SLSEventGroupSerializer(), JsonSerializer()
+        col_sls = best(lambda: sls.serialize_view([g_col]))
+        dict_sls = best(lambda: sls.serialize_view([g_dict]))
+        col_js = best(lambda: js.serialize([g_col]))
+        dict_js = best(lambda: js.serialize([g_dict]))
+        out[f"rows_{n}"] = {
+            "sls_columnar_MBps": round(col_sls, 1),
+            "sls_dict_MBps": round(dict_sls, 1),
+            "sls_columnar_over_dict_x": round(col_sls / dict_sls, 2)
+            if dict_sls else None,
+            "json_columnar_MBps": round(col_js, 1),
+            "json_dict_MBps": round(dict_js, 1),
+            "json_columnar_over_dict_x": round(col_js / dict_js, 2)
+            if dict_js else None,
+        }
+    return out
+
+
+def bench_columnar(n_lines=200000):
+    """loongcolumn acceptance record: a same-host, same-run side-by-side
+    of the columnar fast path against the dict path through the FULL
+    runner/queue machinery, with the in-bench assertions the issue pins:
+    byte-identical sink output (order-independent payload digest),
+    columnar >= 2x dict throughput, queue_wait p50 <= 10 ms under load,
+    conservation residual 0 (columnar run audits live)."""
+    col = _columnar_e2e_once(n_lines, columnar=True, with_ledger=True)
+    dic = _columnar_e2e_once(n_lines, columnar=False, with_ledger=False)
+    identical = (col["digest"]["sum_sha256"] == dic["digest"]["sum_sha256"]
+                 and col["digest"]["events"] == dic["digest"]["events"]
+                 and col["digest"]["bytes"] == dic["digest"]["bytes"])
+    if not identical:
+        raise SystemExit(
+            f"columnar side-by-side output DIVERGED: {col['digest']} vs "
+            f"{dic['digest']}")
+    ratio = col["MBps"] / dic["MBps"] if dic["MBps"] else None
+    if ratio is None or ratio < 2.0:
+        raise SystemExit(
+            f"columnar side-by-side below the 2x acceptance floor: "
+            f"columnar {col['MBps']} MB/s vs dict {dic['MBps']} MB/s "
+            f"({ratio}x)")
+    if col["queue_wait_p50_ms"] > 10.0:
+        raise SystemExit(
+            f"columnar run queue_wait p50 {col['queue_wait_p50_ms']} ms "
+            "exceeds the 10 ms acceptance ceiling")
+    if col["alloc"]["materialized_events"]:
+        raise SystemExit(
+            f"columnar run materialized {col['alloc']} — the fast path "
+            "is not zero-materialization")
+    return {
+        "columnar": col,
+        "dict": dic,
+        "columnar_over_dict_x": round(ratio, 2),
+        "byte_identical": True,
+        "micro": _columnar_micro(),
+    }
+
+
 def bench_scaling(n_lines=200000):
     """loongshard worker-scaling sweep: the same e2e pipeline at
     threads=1/2/4 (affinity-sharded workers, 8 sources), plus the host's
@@ -685,8 +955,8 @@ def bench_scaling(n_lines=200000):
     2x, and that ceiling, not the sharding design, bounds the ratio."""
     out = {}
     for tc in (1, 2, 4):
-        mbps, _, _, _, _, _ = bench_pipeline_e2e(n_lines=n_lines,
-                                              thread_count=tc, sojourn=False)
+        mbps = bench_pipeline_e2e(n_lines=n_lines, thread_count=tc,
+                                  sojourn=False)[0]
         out[f"threads_{tc}"] = round(mbps, 1)
     if out.get("threads_1"):
         best = max(out[k] for k in list(out))
@@ -856,7 +1126,11 @@ def _device_lane_overlap(rtt_s=0.004, n_groups=40):
             g.add_raw_event(1).set_content(sb.copy_string(b"x"))
             g.set_tag(b"__source__", b"s%d" % (i % 8))
             pqm.push_queue(1, g)
-        runner = ProcessorRunner(pqm, _Mgr(), thread_count=tc)
+        # run_max_groups=1: this probe prices PER-GROUP device round-trip
+        # overlap across worker lanes; backlog-aware run batching would
+        # collapse the round trips themselves
+        runner = ProcessorRunner(pqm, _Mgr(), thread_count=tc,
+                                 run_max_groups=1)
         t0 = time.perf_counter()
         runner.init()
         deadline = time.monotonic() + 30
@@ -1128,6 +1402,17 @@ def main():
         # the worst per-pipeline queue lag sampled during the drain
         if e2e3[5] is not None:
             extra["conservation"] = e2e3[5]
+        # loongcolumn: allocation churn around the headline window — gc
+        # activity + materialized-object counters; 0 materialized events
+        # is the zero-materialization contract made visible
+        extra["alloc"] = e2e3[6]
+    # loongcolumn acceptance record: columnar-vs-dict side-by-side (same
+    # host, same run) with in-bench byte-identity / >=2x / queue-wait /
+    # conservation assertions (SystemExit on any miss), plus the
+    # serialize-stage micro-sweep
+    columnar = _safe(bench_columnar, default=None)
+    if columnar is not None:
+        extra["columnar"] = columnar
     # the headline pipeline_e2e_MBps stays the full default-config run —
     # the sweep uses shorter windows, so its numbers live under scaling
     # only and never replace the headline they would be inconsistent with

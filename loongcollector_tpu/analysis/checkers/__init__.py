@@ -8,6 +8,7 @@ from typing import List
 from ..core import Checker
 from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
+from .hot_path_materialize import HotPathMaterializeChecker
 from .metric_naming import MetricNamingChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .swallowed_fault import SwallowedFaultChecker
@@ -22,6 +23,7 @@ _CHECKER_CLASSES = [
     SwallowedFaultChecker,
     UnledgeredDropChecker,
     MetricNamingChecker,
+    HotPathMaterializeChecker,
 ]
 
 

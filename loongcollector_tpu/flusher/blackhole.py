@@ -4,6 +4,8 @@ counting bytes."""
 
 from __future__ import annotations
 
+import hashlib
+import threading
 from typing import Any, Dict, List
 
 from ..models import PipelineEventGroup
@@ -13,6 +15,7 @@ from ..pipeline.serializer.sls_serializer import SLSEventGroupSerializer
 
 class FlusherBlackHole(Flusher):
     name = "flusher_blackhole"
+    supports_columnar = True
     ledger_terminal = True  # loongledger: send() IS delivery
 
     def __init__(self) -> None:
@@ -21,18 +24,54 @@ class FlusherBlackHole(Flusher):
         self.total_bytes = 0
         self.total_events = 0
         self.serialize = True
+        # loongcolumn side-by-side bench: per-group payload digests folded
+        # order-independently (modular SUM — multiset-safe even when many
+        # groups serialize identically, unlike XOR), so two runs of the
+        # same input compare equal regardless of how the sharded runner
+        # interleaved sources — the in-bench byte-identity assertion
+        # between the columnar and dict paths
+        self.digest = False
+        self._digest_state = 0
+        self._digest_groups = 0
+        self._digest_lock = threading.Lock()
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
         self.serialize = bool(config.get("Serialize", True))
+        self.digest = bool(config.get("Digest", False))
         return True
 
     def send(self, group: PipelineEventGroup) -> bool:
-        self.total_events += len(group)
         if self.serialize:
             # serialize_view: measure the REAL wire cost without paying a
             # payload copy the blackhole would immediately discard
-            self.total_bytes += len(self.serializer.serialize_view([group]))
+            view = self.serializer.serialize_view([group])
+            if self.digest:
+                # digest mode: EXACT totals gate the side-by-side bench's
+                # equality assertion, and sharded workers send
+                # concurrently — fold and count under one lock (the hash
+                # itself is computed outside it)
+                h = int.from_bytes(hashlib.sha256(view).digest(), "big")
+                with self._digest_lock:
+                    self.total_events += len(group)
+                    self.total_bytes += len(view)
+                    self._digest_state = (self._digest_state + h) % (1 << 256)
+                    self._digest_groups += 1
+            else:
+                self.total_events += len(group)
+                self.total_bytes += len(view)
         else:
+            self.total_events += len(group)
             self.total_bytes += group.data_size()
         return True
+
+    def output_digest(self) -> Dict[str, object]:
+        """Order-independent fingerprint of everything this sink received:
+        modular sum of per-group payload SHA-256s + totals.  Equal
+        digests ⇒ the same multiset of serialized group payloads
+        arrived."""
+        with self._digest_lock:
+            return {"sum_sha256": f"{self._digest_state:064x}",
+                    "groups": self._digest_groups,
+                    "bytes": self.total_bytes,
+                    "events": self.total_events}

@@ -18,6 +18,7 @@ from .http_base import AddressRotator, HttpSinkFlusher, basic_auth_header
 
 class FlusherClickHouse(HttpSinkFlusher):
     name = "flusher_clickhouse"
+    supports_columnar = True
     content_type = "application/x-ndjson"
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:

@@ -23,6 +23,7 @@ _label_seq = itertools.count(1)
 
 class FlusherDoris(HttpSinkFlusher):
     name = "flusher_doris"
+    supports_columnar = True
     content_type = "application/x-ndjson"
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:

@@ -38,6 +38,7 @@ def resolve_dynamic(template: str, obj: Dict[str, object]) -> str:
 
 class FlusherElasticsearch(HttpSinkFlusher):
     name = "flusher_elasticsearch"
+    supports_columnar = True
     content_type = "application/x-ndjson"
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:

@@ -16,6 +16,7 @@ from ..pipeline.serializer.json_serializer import JsonSerializer
 
 class FlusherFile(Flusher):
     name = "flusher_file"
+    supports_columnar = True
     # loongledger: NOT ledger_terminal — send() only stages into the
     # batcher (whose occupancy the auditor counts); the terminal record
     # lands in _flush_groups AFTER the write, so a failed write is a

@@ -36,6 +36,7 @@ except ImportError:  # pragma: no cover
 
 class FlusherGrpc(AsyncSinkFlusher):
     name = "flusher_grpc"
+    supports_columnar = True
     content_type = "application/grpc"
 
     def __init__(self) -> None:

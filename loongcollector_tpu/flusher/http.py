@@ -38,6 +38,7 @@ class HttpRequest:
 
 class FlusherHTTP(Flusher):
     name = "flusher_http"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -31,6 +31,7 @@ log = get_logger("kafka")
 
 class FlusherKafka(Flusher):
     name = "flusher_kafka"
+    supports_columnar = True
     # class-level default: test rigs (and tools) that bypass __init__ via
     # __new__ still get a gate-free _send_loop
     circuit: Optional[SinkCircuitBreaker] = None

@@ -27,6 +27,7 @@ def _label_name(key: str) -> str:
 
 class FlusherLoki(HttpSinkFlusher):
     name = "flusher_loki"
+    supports_columnar = True
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:
         self.url = (config.get("URL") or "").rstrip("/")

@@ -31,6 +31,7 @@ def _attr(key: str, value: object) -> Dict[str, object]:
 
 class FlusherOTLP(HttpSinkFlusher):
     name = "flusher_otlp"
+    supports_columnar = True
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:
         self.endpoint = (config.get("Endpoint") or "").rstrip("/")

@@ -222,6 +222,7 @@ class FlusherPulsar(AsyncSinkFlusher):
     never blocks the pipeline's processing thread."""
 
     name = "flusher_pulsar"
+    supports_columnar = True
     content_type = "application/octet-stream"
 
     def __init__(self) -> None:

@@ -31,6 +31,7 @@ FP_POST = chaos.register_point("sls_client.post")
 
 class FlusherSLS(FlusherHTTP):
     name = "flusher_sls"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

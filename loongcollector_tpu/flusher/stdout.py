@@ -15,6 +15,7 @@ from ..pipeline.serializer.json_serializer import JsonSerializer
 
 class FlusherStdout(Flusher):
     name = "flusher_stdout"
+    supports_columnar = True
     # loongledger: NOT ledger_terminal — send() stages into the batcher;
     # the terminal record lands in _flush_groups after the stream write
     # (see FlusherFile for the rationale)

@@ -74,9 +74,13 @@ class _ConfigState:
         kwargs = {}
         if self.chunk_size:
             kwargs["chunk_size"] = self.chunk_size
+        # presplit (loongcolumn): file-pipeline groups are columnar from
+        # the read — the pipelines' inner split is always the default
+        # '\n' splitter and no-ops downstream
         return LogFileReader(path, multiline_start=self.multiline_start,
                              multiline_end=self.multiline_end,
-                             encoding=self.encoding, **kwargs)
+                             encoding=self.encoding, presplit_lines=True,
+                             **kwargs)
 
 
 class FileServer:

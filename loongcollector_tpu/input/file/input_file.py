@@ -94,7 +94,7 @@ class InputStaticFile(Input):
         fs = FileServer.instance()
         for pattern in self.paths:
             for path in glob.glob(pattern, recursive="**" in pattern):
-                reader = LogFileReader(path)
+                reader = LogFileReader(path, presplit_lines=True)
                 if not reader.open():
                     continue
                 while True:

@@ -24,8 +24,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
+import numpy as np
+
 from ... import chaos, trace
-from ...models import EventGroupMetaKey, PipelineEventGroup, SourceBuffer
+from ...models import (ColumnarLogs, EventGroupMetaKey, PipelineEventGroup,
+                       SourceBuffer, columnar_enabled)
 
 DEFAULT_CHUNK = 512 * 1024
 SIGNATURE_SIZE = 1024
@@ -70,8 +73,18 @@ class LogFileReader:
                  multiline_start: Optional[str] = None,
                  multiline_end: Optional[str] = None,
                  ml_flush_timeout: float = ML_FLUSH_TIMEOUT_S,
-                 encoding: str = "utf8"):
+                 encoding: str = "utf8",
+                 presplit_lines: bool = False):
         self.path = path
+        # loongcolumn: assemble the group COLUMNAR at read time — line
+        # spans over the chunk's arena, computed by the same
+        # split_chunk_spans pass the inner split processor runs (which
+        # then no-ops on the already-columnar group).  Off by default —
+        # the bare reader keeps the reference one-RawEvent-per-chunk
+        # contract; the file-pipeline wiring (FileServer / static input)
+        # opts in because THERE the inner split is always the default
+        # '\n' splitter.
+        self.presplit_lines = presplit_lines
         # "gbk" transcodes chunks to UTF-8 on read (reference ReadGBK,
         # LogFileReader.cpp:1807), holding a trailing partial multibyte
         # character in the file like the newline rollback does
@@ -284,8 +297,28 @@ class LogFileReader:
         sb = SourceBuffer(capacity=len(aligned) + 256)
         view = sb.copy_string(aligned)
         group = PipelineEventGroup(sb)
-        ev = group.add_raw_event(int(time.time()))
-        ev.set_content(view)
+        ts = int(time.time())
+        if self.presplit_lines and columnar_enabled():
+            # columnar group assembly (loongcolumn): the rows ARE line
+            # spans over this chunk's arena from the moment the group
+            # exists — the inner split processor no-ops downstream.
+            # Shares split_chunk_spans with that processor, so the two
+            # split implementations cannot diverge.  Gated on
+            # columnar_enabled(): in dict mode the chunk must ship as a
+            # RawEvent so the split/multiline chain runs its own course —
+            # a presplit group would be materialized at the split
+            # boundary and silently no-op the requires_columnar
+            # multiline stage.
+            from ...processor.split_log_string import split_chunk_spans
+            offs, lens = split_chunk_spans(sb.as_array(), view.offset,
+                                           view.length, ord("\n"))
+            group.set_columns(ColumnarLogs(
+                offsets=np.asarray(offs, dtype=np.int32),
+                lengths=lens,
+                timestamps=np.full(len(offs), ts, dtype=np.int64)))
+        else:
+            ev = group.add_raw_event(ts)
+            ev.set_content(view)
         group.set_metadata(EventGroupMetaKey.LOG_FILE_PATH, self.path)
         group.set_metadata(EventGroupMetaKey.LOG_FILE_INODE,
                            str(self.dev_inode.inode))

@@ -1,4 +1,6 @@
-from .event_group import ColumnarLogs, EventGroupMetaKey, PipelineEventGroup
+from .event_group import (ColumnarLogs, EventGroupMetaKey,
+                          PipelineEventGroup, churn_stats, columnar_enabled,
+                          reset_churn_stats, set_columnar_enabled)
 from .event_pool import EventPool, g_thread_event_pool
 from .events import (EventType, LogEvent, MetricEvent, MetricValue,
                      PipelineEvent, RawEvent, SpanEvent)

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import enum
 import json
+import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -25,6 +27,67 @@ from ..utils.stringview import AnyStr, StringView, as_bytes
 from .events import (EventType, LogEvent, MetricEvent, PipelineEvent,
                      RawEvent, SpanEvent, metric_name_str)
 from .source_buffer import SourceBuffer
+
+
+# -- columnar mode + materialization accounting (loongcolumn) ---------------
+#
+# The data plane keeps groups columnar end-to-end; per-event LogEvent
+# objects exist ONLY where a plugin that needs dict access forces them
+# (ProcessorInstance/FlusherInstance materialize at that boundary).  Every
+# such expansion is counted here so the bench (extra.alloc) and the
+# equivalence gate can assert the fast path really is zero-materialization.
+# ``LOONG_COLUMNAR=0`` disables the columnar fast path wholesale — every
+# stage boundary materializes — which is the "dict path" half of the
+# side-by-side bench and of scripts/columnar_equivalence.py.
+
+_churn_lock = threading.Lock()
+_materialized_events = 0
+_materialized_groups = 0
+_materialized_at: Dict[str, int] = {}
+
+_columnar_enabled = os.environ.get("LOONG_COLUMNAR", "1") != "0"
+
+
+def columnar_enabled() -> bool:
+    """False ⇒ dict mode: treat every plugin boundary as non-columnar."""
+    return _columnar_enabled
+
+
+def set_columnar_enabled(on: bool) -> bool:
+    """Flip the columnar fast path (bench side-by-side / equivalence gate);
+    returns the previous value."""
+    global _columnar_enabled
+    prev = _columnar_enabled
+    _columnar_enabled = bool(on)
+    return prev
+
+
+def _note_materialized(n_events: int, where: str) -> None:
+    global _materialized_events, _materialized_groups
+    with _churn_lock:
+        _materialized_events += n_events
+        _materialized_groups += 1
+        if where:
+            _materialized_at[where] = _materialized_at.get(where, 0) + n_events
+
+
+def churn_stats() -> Dict[str, object]:
+    """Process-lifetime materialization counters: how many per-event
+    Python objects the lazy boundary actually minted, and at which plugin
+    boundaries.  The columnar fast path's regression signal — see
+    bench.py extra.alloc and docs/performance.md."""
+    with _churn_lock:
+        return {"materialized_events": _materialized_events,
+                "materialized_groups": _materialized_groups,
+                "by_boundary": dict(_materialized_at)}
+
+
+def reset_churn_stats() -> None:
+    global _materialized_events, _materialized_groups
+    with _churn_lock:
+        _materialized_events = 0
+        _materialized_groups = 0
+        _materialized_at.clear()
 
 
 class EventGroupMetaKey(enum.Enum):
@@ -168,7 +231,7 @@ class PipelineEventGroup:
     @property
     def events(self) -> List[PipelineEvent]:
         if self._columns is not None and not self._events:
-            self.materialize()
+            self.materialize("events_property")
         return self._events
 
     def add_event(self, event: PipelineEvent) -> None:
@@ -220,11 +283,17 @@ class PipelineEventGroup:
     def is_columnar(self) -> bool:
         return self._columns is not None
 
-    def materialize(self) -> List[PipelineEvent]:
-        """Expand columns into per-event LogEvent objects (slow path)."""
+    def materialize(self, where: str = "") -> List[PipelineEvent]:
+        """Expand columns into per-event LogEvent objects (slow path).
+
+        ``where`` names the boundary that forced the expansion (plugin id /
+        ``"events_property"``) — every call is counted in churn_stats(), so
+        a hot path that silently falls off the columnar plane shows up in
+        bench extra.alloc instead of just running slow."""
         cols = self._columns
         if cols is None:
             return self._events
+        _note_materialized(len(cols), where)
         sb = self._source_buffer
         events: List[PipelineEvent] = []
         field_items = list(cols.fields.items())

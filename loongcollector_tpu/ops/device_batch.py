@@ -12,6 +12,14 @@ The hard part of putting a log parser on fixed-shape hardware (SURVEY.md §5.7,
   host-side analogue of the reference's single pread into the arena
   (reader/LogFileReader.cpp:1518); spans returned by the kernel are
   row-relative and are mapped back to arena offsets by adding row origins.
+
+loongcolumn contract: ``pack_rows`` consumes (arena, offsets, lengths)
+SPAN COLUMNS directly — the exact arrays a ``ColumnarLogs`` group carries
+— with NO per-row Python list or bytes intermediary anywhere on the H2D
+path (the native gather or the clipped index-matrix fallback read the
+arena in place).  The loonglint ``hot-path-materialize`` checker enforces
+this for all of ``ops/``: building row objects or lists here would
+reintroduce exactly the per-event churn the columnar plane removed.
 """
 
 from __future__ import annotations

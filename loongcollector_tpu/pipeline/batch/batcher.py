@@ -75,7 +75,13 @@ class Batcher:
             st.event_cnt += cnt
             st.size_bytes += size
             if (self.strategy.need_flush_by_count(st.event_cnt)
-                    or self.strategy.need_flush_by_size(st.size_bytes)):
+                    or self.strategy.need_flush_by_size(st.size_bytes)
+                    # backlog-aware hand-off (loongcolumn): while traffic
+                    # flows, a batch past its timeout flushes on the very
+                    # add that finds it due — the 1 s central pump is only
+                    # the idle-pipeline deadline fallback, so batch latency
+                    # tracks the configured timeout, not the pump cadence
+                    or self.strategy.need_flush_by_time(st.create_time)):
                 to_flush.append((st.groups, st.event_cnt))
                 self._emitting_events += st.event_cnt
                 del self._batches[key]

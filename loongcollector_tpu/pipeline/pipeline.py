@@ -210,12 +210,17 @@ class CollectionPipeline:
         self.context.process_queue_key = self.process_queue_key
         self.context.process_queue_manager = process_queue_manager
         if process_queue_manager is not None:
+            from ..pipeline.queue.bounded_queue import DEFAULT_MAX_BYTES
             priority = int(global_cfg.get("Priority", 1))
             capacity = int(global_cfg.get("ProcessQueueCapacity", 20))
             circular = bool(global_cfg.get("CircularProcessQueue", False))
+            # loongcolumn: byte watermark next to the group-count bound —
+            # 0 disables (docs/performance.md "Backlog-aware hand-off")
+            max_bytes = int(global_cfg.get("ProcessQueueMaxBytes",
+                                           DEFAULT_MAX_BYTES))
             q = process_queue_manager.create_or_reuse_queue(
                 self.process_queue_key, priority, capacity, name,
-                circular=circular)
+                circular=circular, max_bytes=max_bytes)
         return True
 
     def _abort_init(self) -> bool:

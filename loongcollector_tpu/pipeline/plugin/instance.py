@@ -11,7 +11,7 @@ import time
 from typing import Any, Dict, List
 
 from ... import prof, trace
-from ...models import PipelineEventGroup
+from ...models import PipelineEventGroup, columnar_enabled
 from ...monitor import ledger
 from ...monitor.metrics import MetricsRecord
 from .interface import Flusher, Input, PluginContext, Processor
@@ -21,6 +21,11 @@ class ProcessorInstance:
     def __init__(self, plugin: Processor, plugin_id: str = ""):
         self.plugin = plugin
         self.plugin_id = plugin_id
+        # loongcolumn: columnar groups pass through capable plugins
+        # unmaterialized; everything else pays the (counted) expansion at
+        # ITS boundary — never implicitly mid-plugin
+        self.columnar_capable = bool(getattr(plugin, "supports_columnar",
+                                             False))
         self._pipeline_name = ""
         self.metrics = MetricsRecord(
             category="plugin",
@@ -54,7 +59,27 @@ class ProcessorInstance:
             ledger.record(self._pipeline_name, ledger.B_PROCESS_DROP,
                           -delta, tag=self.plugin_id or self.plugin.name)
 
+    def _materialize_boundary(self, groups: List[PipelineEventGroup]) -> None:
+        """The lazy materialization boundary (loongcolumn): a plugin that
+        has not declared ``supports_columnar`` gets per-event objects,
+        minted HERE — explicitly, attributed to this plugin id in
+        models.churn_stats() — rather than implicitly wherever its body
+        first touches ``group.events``.  With ``LOONG_COLUMNAR=0`` every
+        boundary materializes: the dict path of the side-by-side bench."""
+        if self.columnar_capable and columnar_enabled():
+            return
+        if getattr(self.plugin, "requires_columnar", False):
+            # columnar-ONLY stage (multiline split/merge): materializing
+            # here would no-op the stage — the dict path materializes at
+            # the next row-capable boundary instead
+            return
+        where = self.plugin_id or self.plugin.name
+        for g in groups:
+            if g.is_columnar() and not g._events:
+                g.materialize(where)
+
     def process(self, groups: List[PipelineEventGroup]) -> None:
+        self._materialize_boundary(groups)
         n_in = sum(len(g) for g in groups)
         self.in_events.add(n_in)
         self.in_bytes.add(sum(g.data_size() for g in groups))
@@ -82,6 +107,7 @@ class ProcessorInstance:
     # -- async device plane (split dispatch/complete) -----------------------
 
     def process_dispatch(self, groups: List[PipelineEventGroup]):
+        self._materialize_boundary(groups)
         n_in = sum(len(g) for g in groups)
         self.in_events.add(n_in)
         self.in_bytes.add(sum(g.data_size() for g in groups))
@@ -168,6 +194,14 @@ class FlusherInstance:
         return self.plugin.init(config, context)
 
     def send(self, group: PipelineEventGroup) -> bool:
+        # loongcolumn: the sink-side lazy materialization boundary — a
+        # sink without columnar-capable serialization gets per-event
+        # objects here (counted), the NDJSON/SLS-riding family never does
+        if group.is_columnar() and not group._events \
+                and not (columnar_enabled()
+                         and getattr(self.plugin, "supports_columnar",
+                                     False)):
+            group.materialize(self.plugin_id or self.plugin.name)
         self.in_events.add(len(group))
         self.in_groups.add(1)
         # batch + serialize + sender-queue enqueue all live under the

@@ -93,6 +93,22 @@ class Processor(Plugin):
     name = "processor_base"
     supports_async_dispatch = False
 
+    #: loongcolumn capability flag: True ⇒ this plugin operates on
+    #: ColumnarLogs span columns directly and never needs per-event dict
+    #: access — columnar groups flow THROUGH it unmaterialized.  False ⇒
+    #: the ProcessorInstance wrapper materializes per-event objects at
+    #: this plugin's boundary (counted in models.churn_stats()) before
+    #: calling it.  Declare it only when BOTH code paths are exercised by
+    #: the columnar-vs-dict equivalence gate (docs/performance.md).
+    supports_columnar = False
+
+    #: True ⇒ this plugin ONLY understands span columns (no row path at
+    #: all: the multiline split/merge family) — the instance wrapper must
+    #: never materialize at its boundary, even in dict mode
+    #: (``LOONG_COLUMNAR=0``), or the stage silently no-ops.  Implies
+    #: supports_columnar.
+    requires_columnar = False
+
     def process(self, group: PipelineEventGroup) -> None:  # pragma: no cover
         raise NotImplementedError
 
@@ -119,6 +135,13 @@ class Flusher(Plugin):
     #: that queue/batch toward a network hop keep False and ledger at
     #: their real delivery boundary instead.
     ledger_terminal = False
+
+    #: loongcolumn capability flag (the flusher-side mirror of
+    #: Processor.supports_columnar): True ⇒ this sink's serialize path
+    #: consumes span columns directly (the NDJSON-riding family, SLS wire,
+    #: blackhole), so columnar groups reach the wire without ever minting
+    #: per-event objects.  False ⇒ FlusherInstance materializes at send().
+    supports_columnar = False
 
     def _ledger_pipeline(self) -> str:
         """Pipeline attribution for this sink's ledger records ("" when

@@ -8,13 +8,20 @@ consumer side blocks on a shared condition until any queue has data.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ...models import PipelineEventGroup
 from ...monitor import ledger
-from .bounded_queue import BoundedProcessQueue, CircularProcessQueue
+from .bounded_queue import (DEFAULT_MAX_BYTES, BoundedProcessQueue,
+                            CircularProcessQueue)
 
 PRIORITY_COUNT = 3  # 0 = highest
+
+# loongcolumn backlog-aware pop: default caps for one consumer run — a
+# trickle still pops single groups; a backlog hands the worker several
+# groups per lock/dispatch cycle (runner amortises the per-group hand-off)
+RUN_MAX_GROUPS = 8
+RUN_MAX_BYTES = 4 * 1024 * 1024
 
 
 class ProcessQueueManager:
@@ -38,13 +45,16 @@ class ProcessQueueManager:
 
     def create_or_reuse_queue(self, key: int, priority: int = 1,
                               capacity: int = 20, pipeline_name: str = "",
-                              circular: bool = False) -> BoundedProcessQueue:
+                              circular: bool = False,
+                              max_bytes: int = DEFAULT_MAX_BYTES
+                              ) -> BoundedProcessQueue:
         with self._lock:
             self._retired_names.pop(key, None)   # key is live again
             q = self._queues.get(key)
             if q is None or isinstance(q, CircularProcessQueue) != circular:
                 cls = CircularProcessQueue if circular else BoundedProcessQueue
-                q = cls(key, priority, capacity, pipeline_name)
+                q = cls(key, priority, capacity, pipeline_name,
+                        max_bytes=max_bytes)
                 q._manager_cv = self._data_cv
                 self._queues[key] = q
                 self._version += 1
@@ -124,7 +134,23 @@ class ProcessQueueManager:
             self._data_cv.wait(timeout)
         return self._try_pop()
 
-    def _try_pop(self) -> Optional[Tuple[int, PipelineEventGroup]]:
+    def pop_run(self, timeout: float = 0.2,
+                max_groups: int = RUN_MAX_GROUPS,
+                max_bytes: int = RUN_MAX_BYTES
+                ) -> Optional[Tuple[int, List[PipelineEventGroup]]]:
+        """Backlog-aware pop (loongcolumn): like pop_item, but drains a RUN
+        of consecutive groups from the selected queue — sized by what is
+        actually queued (occupancy/bytes caps), one group when traffic
+        trickles.  All groups of a run share one queue key (one pipeline),
+        so the consumer processes them through one chain invocation."""
+        run = self._try_pop_run(max_groups, max_bytes)
+        if run is not None:
+            return run
+        with self._data_cv:
+            self._data_cv.wait(timeout)
+        return self._try_pop_run(max_groups, max_bytes)
+
+    def _prio_snapshot(self):
         with self._lock:
             if self._snapshot_version != self._version:
                 self._by_prio = {p: [] for p in range(PRIORITY_COUNT)}
@@ -134,8 +160,10 @@ class ProcessQueueManager:
                     # its data instead
                     self._by_prio[q.priority].append(q)
                 self._snapshot_version = self._version
-            by_prio = self._by_prio
-            cursors = dict(self._rr_cursor)
+            return self._by_prio, dict(self._rr_cursor)
+
+    def _try_pop(self) -> Optional[Tuple[int, PipelineEventGroup]]:
+        by_prio, cursors = self._prio_snapshot()
         for prio in range(PRIORITY_COUNT):
             level = by_prio.get(prio)
             if not level:
@@ -148,6 +176,23 @@ class ProcessQueueManager:
                     with self._lock:
                         self._rr_cursor[prio] = (start + i + 1) % len(level)
                     return q.key, group
+        return None
+
+    def _try_pop_run(self, max_groups: int, max_bytes: int
+                     ) -> Optional[Tuple[int, List[PipelineEventGroup]]]:
+        by_prio, cursors = self._prio_snapshot()
+        for prio in range(PRIORITY_COUNT):
+            level = by_prio.get(prio)
+            if not level:
+                continue
+            start = cursors.get(prio, 0) % len(level)
+            for i in range(len(level)):
+                q = level[(start + i) % len(level)]
+                groups = q.pop_run(max_groups, max_bytes)
+                if groups:
+                    with self._lock:
+                        self._rr_cursor[prio] = (start + i + 1) % len(level)
+                    return q.key, groups
         return None
 
     def all_empty(self) -> bool:

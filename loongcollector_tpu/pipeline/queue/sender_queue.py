@@ -59,6 +59,10 @@ class SenderQueue:
         self._valid_to_push = True
         self._retired = False
         self._feedback = []
+        # backlog-aware hand-off (loongcolumn): the manager installs its
+        # data event here so a push wakes the FlusherRunner immediately
+        # instead of waiting out its poll interval
+        self._on_push = None
         self.rate_limiter: Optional[RateLimiter] = None
         self.concurrency_limiters: List[ConcurrencyLimiter] = []
         self.total_pushed = 0
@@ -66,25 +70,29 @@ class SenderQueue:
 
     def push(self, item: SenderQueueItem) -> bool:
         with self._lock:
-            if not self._retired:
-                # Sender queues accept beyond the watermark (data already
-                # left the process stage and must not be lost); validity
-                # flag throttles the upstream instead (reference
-                # BoundedSenderQueueInterface).
-                self._items.append(item)
-                self.total_pushed += 1
-                if len(self._items) >= self._cap_high:
-                    self._valid_to_push = False
-                return True
-        # deleted queue: a stale-reference push (e.g. a timeout flush
-        # driving a removed pipeline's batcher mid-hot-reload) would
-        # strand the payload in an orphaned queue nothing dispatches,
-        # counts, or ledgers — refuse it, matching BoundedProcessQueue.
-        # retire()'s push gate.  False means the CALLER still owns the
-        # payload (disk-buffer replay keeps its file; flush paths record
-        # the terminal drop) — recording here would double-terminate a
-        # refused replay whose spill file survives.
-        return False
+            if self._retired:
+                # deleted queue: a stale-reference push (e.g. a timeout
+                # flush driving a removed pipeline's batcher mid-hot-
+                # reload) would strand the payload in an orphaned queue
+                # nothing dispatches, counts, or ledgers — refuse it,
+                # matching BoundedProcessQueue.retire()'s push gate.
+                # False means the CALLER still owns the payload (disk-
+                # buffer replay keeps its file; flush paths record the
+                # terminal drop) — recording here would double-terminate
+                # a refused replay whose spill file survives.
+                return False
+            # Sender queues accept beyond the watermark (data already
+            # left the process stage and must not be lost); validity
+            # flag throttles the upstream instead (reference
+            # BoundedSenderQueueInterface).
+            self._items.append(item)
+            self.total_pushed += 1
+            if len(self._items) >= self._cap_high:
+                self._valid_to_push = False
+            notify = self._on_push
+        if notify is not None:
+            notify()        # outside the lock: wake the FlusherRunner
+        return True
 
     def is_valid_to_push(self) -> bool:
         with self._lock:
@@ -162,6 +170,17 @@ class SenderQueueManager:
         self._queues: Dict[int, SenderQueue] = {}
         self._marked: set = set()
         self._lock = threading.Lock()
+        # backlog-aware hand-off: pushes set this event; the FlusherRunner
+        # waits on it instead of sleeping out a fixed poll interval
+        self._data_event = threading.Event()
+
+    def wait_for_data(self, timeout: float) -> bool:
+        """Block until a push signals new payloads (or timeout — the
+        deadline fallback that keeps retry/replay cadences alive)."""
+        if self._data_event.wait(timeout):
+            self._data_event.clear()
+            return True
+        return False
 
     def mark_for_deletion(self, key: int) -> None:
         """Queue is deleted once its in-flight items drain (reference
@@ -184,6 +203,7 @@ class SenderQueueManager:
             q = self._queues.get(key)
             if q is None:
                 q = SenderQueue(key, capacity, pipeline_name)
+                q._on_push = self._data_event.set
                 self._queues[key] = q
             return q
 

@@ -39,7 +39,9 @@ def iter_event_dicts(group: PipelineEventGroup
                     obj[name] = raw[o:o + ln].decode("utf-8", "replace")
             yield int(tss[i]), obj
         return
-    for ev in group.events:
+    # canonical dict fallback: event groups / already-materialized rows —
+    # the one place the NDJSON family is ALLOWED to walk row objects
+    for ev in group.events:  # loonglint: disable=hot-path-materialize
         obj = dict(tags)
         ts = 0
         if isinstance(ev, LogEvent):

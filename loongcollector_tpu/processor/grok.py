@@ -22,6 +22,7 @@ from .common import RAW_LOG_KEY, extract_source
 
 class ProcessorGrok(Processor):
     name = "processor_grok"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

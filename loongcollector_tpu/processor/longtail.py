@@ -129,6 +129,7 @@ class ProcessorPickKey(Processor):
     fields are dropped (plugins/processor/pickkey/processor_pick_key.go)."""
 
     name = "processor_pick_key"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -424,6 +425,7 @@ class ProcessorRateLimit(Processor):
     (plugins/processor/ratelimit/processor_rate_limit.go)."""
 
     name = "processor_rate_limit"
+    supports_columnar = True
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -461,6 +463,7 @@ class ProcessorFieldsWithCondition(Processor):
     matching case applies its actions; optionally drop non-matching."""
 
     name = "processor_fields_with_condition"
+    supports_columnar = True
 
     _OPS = {
         "equals": lambda cond, val: val == cond,

@@ -20,6 +20,8 @@ PARTIAL_FLAG_FIELD = "_partial_"
 
 class ProcessorMergeMultilineLog(Processor):
     name = "processor_merge_multiline_log_native"
+    supports_columnar = True
+    requires_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -22,6 +22,7 @@ from .common import RAW_LOG_KEY, extract_source
 
 class ProcessorParseApsara(Processor):
     name = "processor_parse_apsara_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

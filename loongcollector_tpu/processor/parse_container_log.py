@@ -32,6 +32,8 @@ _STDERR = b"stderr"
 
 class ProcessorParseContainerLog(Processor):
     name = "processor_parse_container_log_native"
+    supports_columnar = True
+    requires_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

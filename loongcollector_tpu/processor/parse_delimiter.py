@@ -58,6 +58,7 @@ def _csv_fsm_split(data: bytes, sep: bytes, quote: int = 0x22) -> List[bytes]:
 
 class ProcessorParseDelimiter(Processor):
     name = "processor_parse_delimiter_tpu"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

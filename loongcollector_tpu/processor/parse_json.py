@@ -26,6 +26,7 @@ from .common import RAW_LOG_KEY, extract_source
 
 class ProcessorParseJson(Processor):
     name = "processor_parse_json_tpu"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -29,6 +29,7 @@ from .common import (RAW_LOG_KEY, apply_parse_spans,
 
 class ProcessorParseRegex(Processor):
     name = "processor_parse_regex_tpu"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -24,6 +24,7 @@ from .common import extract_source
 
 class ProcessorParseTimestamp(Processor):
     name = "processor_parse_timestamp_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -26,6 +26,7 @@ class ProcessorPromParseMetric(Processor):
     """Exposition text (raw events / log `content`) → MetricEvents."""
 
     name = "processor_prom_parse_metric_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()
@@ -70,6 +71,7 @@ class ProcessorPromRelabelMetric(Processor):
     """metric_relabel_configs inside the pipeline + meta-label scrub."""
 
     name = "processor_prom_relabel_metric_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

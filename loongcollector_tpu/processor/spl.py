@@ -777,6 +777,7 @@ def compile_spl(script: str) -> List[_Stage]:
 
 class ProcessorSPL(Processor):
     name = "processor_spl"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

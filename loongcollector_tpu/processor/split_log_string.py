@@ -22,8 +22,33 @@ from ..native import split_lines as native_split
 from ..pipeline.plugin.interface import PluginContext, Processor
 
 
+def split_chunk_spans(arena: np.ndarray, start: int, ln: int,
+                      split_char: int):
+    """Line spans (offsets int64, lengths int32) of one chunk at
+    [start, start+ln) in the arena — native pass with the vectorised
+    numpy fallback.  Shared with the file reader's columnar group
+    assembly (loongcolumn) so reader-side and processor-side splitting
+    cannot diverge."""
+    seg = arena[start : start + ln]
+    spans = native_split(seg, split_char, start)
+    if spans is not None:
+        offs, lens = spans
+        return offs.astype(np.int64), lens
+    nl = np.nonzero(seg == split_char)[0].astype(np.int64)
+    # line starts: 0 and nl+1; line ends: nl and ln (if trailing bytes)
+    starts = np.concatenate([[0], nl + 1])
+    ends = np.concatenate([nl, [ln]])
+    # empty lines between separators are kept (reference behaviour);
+    # only the zero-length tail produced by a trailing \n is dropped
+    if len(starts) > 1 and starts[-1] >= ln:
+        starts = starts[:-1]
+        ends = ends[:-1]
+    return starts + start, (ends - starts).astype(np.int32)
+
+
 class ProcessorSplitLogString(Processor):
     name = "processor_split_log_string_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()
@@ -51,29 +76,12 @@ class ProcessorSplitLogString(Processor):
             sv = ev.content
             if sv is None or sv.length == 0:
                 continue
-            start, ln = sv.offset, sv.length
-            seg = arena[start : start + ln]
-            spans = native_split(seg, self.split_char, start)
-            if spans is not None:
-                offs, lens = spans
-                all_offsets.append(offs.astype(np.int64))
-                all_lengths.append(lens)
-                ts = ev.timestamp if ev.timestamp else now
-                all_ts.append(np.full(len(offs), ts, dtype=np.int64))
-                continue
-            nl = np.nonzero(seg == self.split_char)[0].astype(np.int64)
-            # line starts: 0 and nl+1; line ends: nl and ln (if trailing bytes)
-            starts = np.concatenate([[0], nl + 1])
-            ends = np.concatenate([nl, [ln]])
-            # empty lines between separators are kept (reference behaviour);
-            # only the zero-length tail produced by a trailing \n is dropped
-            if len(starts) > 1 and starts[-1] >= ln:
-                starts = starts[:-1]
-                ends = ends[:-1]
-            all_offsets.append(starts + start)
-            all_lengths.append((ends - starts).astype(np.int32))
+            offs, lens = split_chunk_spans(arena, sv.offset, sv.length,
+                                           self.split_char)
+            all_offsets.append(offs)
+            all_lengths.append(lens)
             ts = ev.timestamp if ev.timestamp else now
-            all_ts.append(np.full(len(starts), ts, dtype=np.int64))
+            all_ts.append(np.full(len(offs), ts, dtype=np.int64))
         if not all_offsets:
             group.set_columns(ColumnarLogs(np.zeros(0, np.int32),
                                            np.zeros(0, np.int32)))

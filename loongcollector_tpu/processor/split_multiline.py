@@ -41,6 +41,8 @@ CARRY_TTL_S = 30.0          # orphaned stashes flush through the next group
 
 class ProcessorSplitMultilineLogString(Processor):
     name = "processor_split_multiline_log_string_native"
+    supports_columnar = True
+    requires_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -21,6 +21,7 @@ _DEFAULT_KEYS = {
 
 class ProcessorTag(Processor):
     name = "processor_tag_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

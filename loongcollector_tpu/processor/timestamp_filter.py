@@ -19,6 +19,7 @@ from .filter import compact_columns
 
 class ProcessorTimestampFilter(Processor):
     name = "processor_timestamp_filter_native"
+    supports_columnar = True
 
     def __init__(self) -> None:
         super().__init__()

@@ -200,7 +200,11 @@ class FlusherRunner:
                 self._replay_spilled()
             items = self.sqm.get_available_items()
             if not items:
-                time.sleep(0.02)
+                # backlog-aware hand-off (loongcolumn): a sender-queue push
+                # wakes this loop immediately; the 20 ms timeout is only
+                # the deadline fallback driving retry/replay cadences on
+                # an idle agent
+                self.sqm.wait_for_data(0.02)
                 continue
             for item in items:
                 if not self.rate_limiter.is_valid_to_pop():

@@ -60,7 +60,8 @@ from ..ops.device_plane import note_host_backlog, set_budget_relief
 from ..ops.device_stream import auto_tuner
 from ..prof import flight
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
-from ..pipeline.queue.process_queue_manager import ProcessQueueManager
+from ..pipeline.queue.process_queue_manager import (RUN_MAX_GROUPS,
+                                                    ProcessQueueManager)
 from ..utils import flags
 from ..utils.logger import get_logger
 
@@ -257,6 +258,26 @@ class _ShardInbox:
             self._not_full.notify()
             return item
 
+    def get_run(self, timeout: float = 0.2, max_groups: int = 8):
+        """Backlog-aware drain (loongcolumn): pop the head item plus any
+        consecutive items sharing its queue key, as one (key, groups) run —
+        FIFO order preserved, so per-source ordering is untouched.  A
+        trickle yields single-group runs; a backlog amortises the worker's
+        per-dispatch hand-off."""
+        with self._not_empty:
+            if not self._items:
+                if timeout > 0 and not self._closed:
+                    self._not_empty.wait(timeout)
+                if not self._items:
+                    return None
+            key, group = self._items.popleft()
+            groups = [group]
+            while self._items and len(groups) < max_groups \
+                    and self._items[0][0] == key:
+                groups.append(self._items.popleft()[1])
+            self._not_full.notify_all()
+            return key, groups
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -278,12 +299,23 @@ class _ShardInbox:
 
 class ProcessorRunner:
     def __init__(self, process_queue_manager: ProcessQueueManager,
-                 pipeline_manager, thread_count: Optional[int] = None):
+                 pipeline_manager, thread_count: Optional[int] = None,
+                 run_max_groups: Optional[int] = None):
         self.pqm = process_queue_manager
         self.pipeline_manager = pipeline_manager
         if thread_count is None:
             thread_count = resolve_thread_count()
         self.thread_count = max(1, int(thread_count))
+        # loongcolumn backlog-aware pops: how many same-pipeline groups one
+        # pop may hand a worker (1 = the pre-run per-group shape;
+        # LOONG_POP_RUN overrides for experiments)
+        if run_max_groups is None:
+            try:
+                run_max_groups = int(os.environ.get("LOONG_POP_RUN", "0")) \
+                    or RUN_MAX_GROUPS
+            except ValueError:
+                run_max_groups = RUN_MAX_GROUPS
+        self.run_max_groups = max(1, int(run_max_groups))
         self._threads: List[threading.Thread] = []
         self._dispatch_thread: Optional[threading.Thread] = None
         self._lanes: List[WorkerLane] = []
@@ -430,34 +462,47 @@ class ProcessorRunner:
 
     def _run_dispatch(self) -> None:
         """Sharded mode only: pop the queue manager, route by affinity.
-        Also pumps timeout flushes (the reference's thread-0 duty)."""
+        Also pumps timeout flushes (the reference's thread-0 duty).
+        Pops are backlog-aware runs (loongcolumn): one lock cycle hands
+        the dispatcher up to RUN_MAX_GROUPS same-pipeline groups, each
+        still routed to its affinity shard individually."""
         while self._running:
             self._pump_timeout_flush()
-            item = self.pqm.pop_item(timeout=0.2)
-            if item is None:
+            run = self.pqm.pop_run(timeout=0.2,
+                                   max_groups=self.run_max_groups)
+            if run is None:
                 continue
-            self._handle_routed(item)
+            self._handle_routed_run(run)
         # drain remaining items on stop: keep affinity so ordering holds
         # through shutdown too
         while True:
-            item = self.pqm.pop_item(timeout=0)
-            if item is None:
+            run = self.pqm.pop_run(timeout=0,
+                                   max_groups=self.run_max_groups)
+            if run is None:
                 break
-            self._handle_routed(item)
+            self._handle_routed_run(run)
         for ib in self._inboxes:
             ib.close()
 
-    def _handle_routed(self, item: Tuple[int, PipelineEventGroup]) -> None:
-        """Route one popped item while the in-hand counter covers the gap
-        until it lands in an inbox (or finishes inline)."""
+    def _handle_routed_run(self,
+                           run: Tuple[int, List[PipelineEventGroup]]) -> None:
+        """Route one popped run while the in-hand counter covers the gap
+        until each group lands in an inbox (or finishes inline)."""
+        key, groups = run
         if not ledger.is_on():
-            self._route(item)
+            for group in groups:
+                self._route((key, group))
             return
-        self._note_in_hand(1)
+        self._note_in_hand(len(groups))
+        left = len(groups)
         try:
-            self._route(item)
+            for group in groups:
+                self._route((key, group))
+                self._note_in_hand(-1)
+                left -= 1
         finally:
-            self._note_in_hand(-1)
+            if left:        # a raising route must not leave phantom in-hand
+                self._note_in_hand(-left)
 
     def _route(self, item: Tuple[int, PipelineEventGroup]) -> None:
         key, group = item
@@ -520,7 +565,9 @@ class ProcessorRunner:
 
     def _run_single(self, worker_id: int) -> None:
         """thread_count == 1: the reference shape — pop the queue manager
-        directly, no dispatch hop."""
+        directly, no dispatch hop.  Pops are backlog-aware runs
+        (loongcolumn): the per-pop/per-dispatch hand-off amortises over
+        whatever occupancy the queue actually holds."""
         lane = self._lanes[worker_id]
         set_budget_relief(self._make_relief(lane))
         prof.push_marker("worker", f"processor-{worker_id}")
@@ -530,25 +577,29 @@ class ProcessorRunner:
                 self._pump_timeout_flush()
                 # while device work is in flight, poll rather than sleep: an
                 # empty queue means the overlap window closes and we complete
-                item = self.pqm.pop_item(timeout=0.0 if lane.busy() else 0.2)
-                if item is None:
+                run = self.pqm.pop_run(
+                    timeout=0.0 if lane.busy() else 0.2,
+                    max_groups=self.run_max_groups)
+                if run is None:
                     had_item = False
                     self._complete_oldest(lane)
                     continue
-                if had_item:
-                    # two consecutive non-empty pops = sustained backlog on
-                    # the single worker: probe the device-idle accounting
-                    # (the sharded loop probes on inbox depth instead)
+                if had_item or len(run[1]) > 1:
+                    # sustained backlog on the single worker (consecutive
+                    # non-empty pops, or a multi-group run): probe the
+                    # device-idle accounting (the sharded loop probes on
+                    # inbox depth instead)
                     note_host_backlog()
                 had_item = True
-                self._handle_one(item, lane)
+                self._handle_run(run[0], run[1], lane)
             self._complete_lane(lane)
             # drain remaining items on stop
             while True:
-                item = self.pqm.pop_item(timeout=0)
-                if item is None:
+                run = self.pqm.pop_run(timeout=0,
+                                   max_groups=self.run_max_groups)
+                if run is None:
                     break
-                self._handle_one(item, None)
+                self._handle_run(run[0], run[1], None)
         finally:
             prof.pop_marker()
             set_budget_relief(None)
@@ -588,8 +639,10 @@ class ProcessorRunner:
         prof.push_marker("worker", f"processor-{worker_id}")
         try:
             while True:
-                item = inbox.get(timeout=0.0 if lane.busy() else 0.2)
-                if item is None:
+                run = inbox.get_run(
+                    timeout=0.0 if lane.busy() else 0.2,
+                    max_groups=self.run_max_groups)
+                if run is None:
                     self._complete_oldest(lane)
                     if inbox.drained():
                         break
@@ -599,42 +652,55 @@ class ProcessorRunner:
                     # device-idle gap (utilization accounting — the
                     # "shard more vs device-bound" counter)
                     note_host_backlog()
-                self._handle_one(item, lane)
+                self._handle_run(run[0], run[1], lane)
             self._complete_lane(lane)
         finally:
             prof.pop_marker()
             chip_lanes.set_thread_lane(None)
             set_budget_relief(None)
 
-    def _handle_one(self, item: Tuple[int, PipelineEventGroup],
+    def _handle_run(self, key: int, groups: List[PipelineEventGroup],
                     lane: Optional[WorkerLane]) -> None:
-        """One popped item through dispatch → ring advance → lane, with
+        """One popped run through dispatch → ring advance → lane, with
         the in-hand counter covering the whole hop (a group anchored in
         the lane ring or _in_process_cnt is visible to live_inflight;
-        this covers the slivers in between).  Lane-less (drain) items go
-        through the synchronous _process_one instead."""
+        this covers the slivers in between).
+
+        Dispatch is PER GROUP even though the pop was a run:
+         * the lane ring + budget-relief protocol is per pending entry —
+           a whole run inside ONE process_begin would let group N+1's
+           device dispatch wait on budget held by group N's pending,
+           which only materialises after the run returns (intra-run
+           budget deadlock the relief hook cannot see);
+         * sampled tracing draws one deterministic key per group
+           ("pipeline:N") — a replayed storm must trace the identical
+           population.
+        The run amortises the HAND-OFF (one queue lock/CV cycle, one
+        aggregated dequeue record, one inbox drain per run) — that, not
+        chain batching, was the measured cost."""
         led = ledger.is_on()
         if led:
-            self._note_in_hand(1)
+            self._note_in_hand(len(groups))
         try:
-            if lane is None:
-                self._process_one(*item)
-                return
-            nxt = self._dispatch_one(*item, lane=lane)
-            # dispatch-before-advance is the overlap: the device now
-            # holds group N+1 while we materialise + send the oldest
-            # ring entry (N-depth+1)
-            self._advance_ring(lane)
-            lane.put(nxt)
+            for group in groups:
+                if lane is None:
+                    self._process_one(key, group)
+                    continue
+                nxt = self._dispatch_one(key, group, lane=lane)
+                # dispatch-before-advance is the overlap: the device now
+                # holds group N+1 while we materialise + send the oldest
+                # ring entry (N-depth+1)
+                self._advance_ring(lane)
+                lane.put(nxt)
         finally:
             if led:
-                self._note_in_hand(-1)
+                self._note_in_hand(-len(groups))
 
     def _dispatch_one(self, key: int, group: PipelineEventGroup,
                       lane: Optional[WorkerLane] = None):
-        """Host pre-processing + device dispatch for one group.  Returns a
-        pending handle when device work stays in flight, else None (group
-        fully processed and sent).
+        """Host pre-processing + device dispatch for one group.  Returns
+        a pending handle when device work stays in flight, else None
+        (group fully processed and sent).
 
         Ordering invariant: when this group resolves on the host tier
         (finish is None) it is SENT here, inline — so the worker's lane
@@ -644,6 +710,7 @@ class ProcessorRunner:
         first group of a stream pays the XLA compile on the device path
         while later small groups take the native walker)."""
         pipeline = self.pipeline_manager.find_pipeline_by_queue_key(key)
+        n_events = len(group)
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
             if ledger.is_on():
@@ -653,12 +720,13 @@ class ProcessorRunner:
                 # ingesting pipeline's books still balance
                 name = (q.pipeline_name if q is not None
                         else self.pqm.retired_pipeline_name(key))
-                ledger.record(name, ledger.B_DROP, len(group),
+                ledger.record(name, ledger.B_DROP, n_events,
                               group.data_size(), tag="no_pipeline")
             return None
         self.in_groups.add(1)
-        self.in_events.add(len(group))
+        self.in_events.add(n_events)
         self.in_bytes.add(group.data_size())
+        groups = [group]
         t0 = time.perf_counter()
         sp = None
         tracer = trace.active_tracer()
@@ -670,9 +738,8 @@ class ProcessorRunner:
             if tracer.should_sample(gkey):
                 sp = tracer.start_span(
                     "pipeline.process", trace_id=gkey,
-                    attrs={"pipeline": pipeline.name, "events": len(group)})
+                    attrs={"pipeline": pipeline.name, "events": n_events})
                 tracer.push_current(sp)
-        groups = [group]
         prof.push_marker("pipeline", pipeline.name or "pipeline")
         try:
             try:

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""loongcolumn equivalence gate (scripts/lint.sh + tier-1).
+
+Runs default pipeline chains (line split → regex / JSON / delimiter /
+multiline parse) over fixed corpora through BOTH event paths —
+
+* **columnar**: groups stay arena-span columns end-to-end (the shipping
+  fast path; the run must mint ZERO per-event objects), and
+* **dict**: ``set_columnar_enabled(False)`` — every instance boundary
+  materializes per-event LogEvents and the sinks serialize row objects
+  (the pre-loongcolumn shape),
+
+then assembles every NDJSON/wire-riding sink payload (file/stdout/kafka
+JSON lines, SLS PB, ClickHouse/Doris JSONEachRow, Elasticsearch bulk,
+Loki push) from each and fails on ANY byte difference.  This is the hard
+line under the zero-materialization design: the columnar plane must be
+a pure representation change — byte-identical output, just without the
+per-event Python objects.
+
+Exit 0 = equivalent everywhere; exit 1 = at least one divergence.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from loongcollector_tpu import models  # noqa: E402
+from loongcollector_tpu.models import (PipelineEventGroup,  # noqa: E402
+                                       SourceBuffer)
+from loongcollector_tpu.pipeline.plugin.instance import \
+    ProcessorInstance  # noqa: E402
+from loongcollector_tpu.pipeline.plugin.interface import \
+    PluginContext  # noqa: E402
+from loongcollector_tpu.pipeline.serializer.batch_json import \
+    ndjson_payload  # noqa: E402
+from loongcollector_tpu.pipeline.serializer.json_serializer import \
+    JsonSerializer  # noqa: E402
+from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+    SLSEventGroupSerializer  # noqa: E402
+
+APACHE = (r'(\S+) (\S+) (\S+) \[([^\]]+)\] '
+          r'"(\S+) (\S+) ([^"]*)" (\d{3}) (\d+)')
+APACHE_KEYS = ["ip", "ident", "user", "time", "method", "url", "proto",
+               "status", "size"]
+
+
+def _corpus_apache() -> bytes:
+    rows = []
+    for i in range(200):
+        rows.append(
+            b'10.0.%d.%d - u%d [10/Oct/2000:13:55:%02d -0700] '
+            b'"GET /p%d HTTP/1.1" %d %d'
+            % (i % 256, (i * 7) % 256, i % 97, i % 60, i, 200 + i % 300,
+               i * 13))
+        if i % 9 == 0:
+            rows.append(b"!! unparseable line %d" % i)   # keep-as-rawLog
+    return b"\n".join(rows) + b"\n"
+
+
+def _corpus_json() -> bytes:
+    rows = [(b'{"ts": %d, "level": "info", "user": "u%d", "msg": "ok %d"}'
+             % (1700000000 + i, i % 31, i)) for i in range(150)]
+    rows.append(b"not json at all")
+    return b"\n".join(rows) + b"\n"
+
+
+def _corpus_delimiter() -> bytes:
+    rows = [b"f%d,bar%d,baz%d" % (i, i * 3, i * 7) for i in range(150)]
+    rows.append(b"short")
+    return b"\n".join(rows) + b"\n"
+
+
+def _corpus_multiline() -> bytes:
+    rows = []
+    for i in range(80):
+        rows.append(b"2024-01-02 03:04:%02d ERROR boom %d" % (i % 60, i))
+        rows.append(b"  at com.example.Foo(Foo.java:%d)" % i)
+        rows.append(b"  at com.example.Bar(Bar.java:%d)" % (i * 2))
+    return b"\n".join(rows) + b"\n"
+
+
+def _corpus_nonascii() -> bytes:
+    rows = [("naïve %d — ünïcode ✓" % i).encode("utf-8")
+            for i in range(40)]
+    return b"\n".join(rows) + b"\n"
+
+
+def _chains():
+    """(name, corpus, processor configs) — representative default
+    pipelines; fresh plugin instances per run (multiline carries state)."""
+    return [
+        ("plain", _corpus_apache(), []),
+        ("regex", _corpus_apache(),
+         [{"Type": "processor_parse_regex_tpu", "Regex": APACHE,
+           "Keys": APACHE_KEYS}]),
+        ("json", _corpus_json(), [{"Type": "processor_parse_json_tpu"}]),
+        ("delimiter", _corpus_delimiter(),
+         [{"Type": "processor_parse_delimiter_tpu", "Separator": ",",
+           "Keys": ["a", "b", "c"]}]),
+        ("multiline", _corpus_multiline(),
+         [{"Type": "processor_split_multiline_log_string_native",
+           "Multiline": {"StartPattern": r"\d{4}-\d{2}-\d{2} .*"}}]),
+        ("nonascii", _corpus_nonascii(), []),
+    ]
+
+
+def _build_chain(proc_cfgs):
+    from loongcollector_tpu.pipeline.plugin.registry import PluginRegistry
+    reg = PluginRegistry.instance()
+    reg.load_static_plugins()
+    ctx = PluginContext("columnar-equiv")
+    insts = []
+    split = reg.create_processor("processor_split_log_string_native")
+    assert split is not None and split.init({}, ctx)
+    insts.append(ProcessorInstance(split, "split/inner"))
+    for i, cfg in enumerate(proc_cfgs):
+        p = reg.create_processor(cfg["Type"])
+        assert p is not None, cfg["Type"]
+        assert p.init(cfg, ctx), cfg
+        insts.append(ProcessorInstance(p, f"{cfg['Type']}/{i}"))
+    return insts
+
+
+def _run_chain(corpus: bytes, proc_cfgs, columnar: bool
+               ) -> PipelineEventGroup:
+    prev = models.set_columnar_enabled(columnar)
+    try:
+        insts = _build_chain(proc_cfgs)
+        sb = SourceBuffer(len(corpus) + 128)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1700000001).set_content(sb.copy_string(corpus))
+        g.set_tag(b"host", b"equiv-host")
+        for inst in insts:
+            inst.process([g])
+        if not columnar and g.is_columnar() and not g._events:
+            # the FlusherInstance boundary of the dict path: sinks get
+            # per-event row objects
+            g.materialize("sink")
+        return g
+    finally:
+        models.set_columnar_enabled(prev)
+
+
+def _es_flusher():
+    from loongcollector_tpu.flusher.elasticsearch import FlusherElasticsearch
+    f = FlusherElasticsearch()
+    ok = f.init({"Addresses": ["http://localhost:9200"], "Index": "logs"},
+                PluginContext("columnar-equiv"))
+    assert ok
+    return f
+
+
+def _loki_flusher():
+    from loongcollector_tpu.flusher.loki import FlusherLoki
+    f = FlusherLoki()
+    ok = f.init({"URL": "http://localhost:3100"},
+                PluginContext("columnar-equiv"))
+    assert ok
+    return f
+
+
+def sink_payloads(group: PipelineEventGroup) -> dict:
+    """Every NDJSON/wire-riding sink family's payload bytes for one
+    group — the exact builders the flushers call."""
+    out = {}
+    out["file/stdout/kafka json"] = JsonSerializer().serialize([group])
+    out["blackhole/sls pb"] = bytes(
+        SLSEventGroupSerializer().serialize_view([group]))
+    out["clickhouse/doris ndjson"] = \
+        ndjson_payload([group], ts_key="_timestamp") or b""
+    es, loki = _es_flusher(), _loki_flusher()
+    try:
+        built = es.build_payload([group])
+        out["elasticsearch bulk"] = built[0] if built else b""
+        built = loki.build_payload([group])
+        out["loki push"] = built[0] if built else b""
+    finally:
+        es.batcher.close()
+        loki.batcher.close()
+    return out
+
+
+def main() -> int:
+    bad = 0
+    for name, corpus, cfgs in _chains():
+        chain_bad = 0
+        models.reset_churn_stats()
+        g_col = _run_chain(corpus, cfgs, columnar=True)
+        pay_col = sink_payloads(g_col)
+        churn = models.churn_stats()["materialized_events"]
+        if churn:
+            chain_bad += 1
+            print(f"FAIL[{name}] columnar run materialized {churn} events "
+                  f"at {models.churn_stats()['by_boundary']} — the fast "
+                  "path is not zero-materialization")
+        g_dict = _run_chain(corpus, cfgs, columnar=False)
+        pay_dict = sink_payloads(g_dict)
+        for sink in pay_col:
+            a, b = pay_col[sink], pay_dict[sink]
+            if bytes(a) != bytes(b):
+                chain_bad += 1
+                print(f"FAIL[{name}/{sink}] columnar != dict "
+                      f"({len(a)} vs {len(b)} bytes)")
+                for i, (x, y) in enumerate(zip(bytes(a), bytes(b))):
+                    if x != y:
+                        print(f"  first diff at byte {i}: "
+                              f"{bytes(a)[max(0,i-20):i+20]!r} vs "
+                              f"{bytes(b)[max(0,i-20):i+20]!r}")
+                        break
+        bad += chain_bad
+        print(f"{name}: {len(g_col)} events x {len(pay_col)} sink families "
+              f"— {'OK' if not chain_bad else f'{chain_bad} FAILURES'} "
+              f"(columnar materialized_events={churn})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

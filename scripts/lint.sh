@@ -40,6 +40,12 @@ JAX_PLATFORMS=cpu LOONG_PROCESS_THREADS=4 python scripts/trace_overhead.py
 LOONG_PROCESS_THREADS=4 python -m loongcollector_tpu.analysis \
     --checks metric-naming
 
+echo "== columnar equivalence gate (loongcolumn) =="
+# default pipeline chains through the columnar fast path AND the dict
+# path; any sink-payload byte difference (or any per-event object minted
+# on the columnar side) fails — docs/performance.md "Columnar event path"
+JAX_PLATFORMS=cpu python scripts/columnar_equivalence.py
+
 echo "== fused-DFA equivalence gate (loongfuse) =="
 # the fused multi-accept automaton must classify EXACTLY like per-pattern
 # `re` over the default grok set + multiline classics — any disagreement

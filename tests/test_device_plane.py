@@ -230,7 +230,10 @@ class TestRunnerOverlap:
             assert wait_for(lambda: not pqm.is_valid_to_push(key), timeout=10)
             # the plane bounds device-side work: at most budget + one chunk
             assert plane.inflight_bytes() <= plane.budget_bytes + 40 * 1024
-            assert pushed <= q._cap_high + 3
+            # loongcolumn: one backlog-aware run (<= run_max_groups) may sit
+            # in the blocked worker's hands beyond the queue bound — the
+            # buffering window is still hard-bounded, one run wider
+            assert pushed <= q._cap_high + 3 + runner.run_max_groups
 
             stall.unstall()
             assert wait_for(

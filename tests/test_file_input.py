@@ -15,6 +15,20 @@ from loongcollector_tpu.input.file.polling import (FileDiscoveryConfig,
 from loongcollector_tpu.input.file.reader import LogFileReader
 
 
+def _chunk_bytes(group):
+    """Chunk bytes of a file-server group — FileServer readers presplit
+    into line columns (loongcolumn), so newline-aligned chunks
+    reconstruct as line spans + '\\n' each; bare readers keep the
+    one-RawEvent shape."""
+    cols = group.columns
+    if cols is not None and not group._events:
+        raw = group.source_buffer.raw
+        return b"".join(
+            bytes(raw[int(o):int(o) + int(ln)]) + b"\n"
+            for o, ln in zip(cols.offsets, cols.lengths))
+    return group.events[0].content.to_bytes()
+
+
 class TestReader:
     def test_rollback_to_last_line(self, tmp_path):
         p = tmp_path / "a.log"
@@ -79,7 +93,7 @@ class TestRotation:
                 return True
 
             def push_queue(self, key, group):
-                pushed.append(group.events[0].content.to_bytes())
+                pushed.append(_chunk_bytes(group))
                 return True
 
         fs.process_queue_manager = FakePQM()
@@ -197,7 +211,7 @@ class TestGBKDecode:
         assert r.offset == 0
         fs._drain_reader(st, r)          # accepted
         assert pqm.groups
-        assert pqm.groups[0].events[0].content.to_bytes().decode() == text
+        assert _chunk_bytes(pqm.groups[0]).decode() == text
 
     def test_invalid_byte_before_newline_never_stalls(self, tmp_path):
         p = tmp_path / "l.log"

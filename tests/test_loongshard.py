@@ -308,10 +308,14 @@ class TestPerSourceOrdering:
                     name = "aff"
 
                     def process_begin(self, groups):
-                        src = group_source_id(groups[0])
+                        # backlog-aware pops hand the worker RUNS of
+                        # groups: record the worker for every group, not
+                        # just the head
+                        me = threading.current_thread().name
                         with lock:
-                            seen.setdefault(src, set()).add(
-                                threading.current_thread().name)
+                            for g in groups:
+                                seen.setdefault(group_source_id(g),
+                                                set()).add(me)
                         return None
 
                     def send(self, groups):
@@ -383,19 +387,22 @@ class TestMixedRoutingOrder:
             name = "mixed"
 
             def process_begin(self, groups):
-                g = groups[0]
-                tag = bytes(g.get_tag(b"seq") or b"")
-                if int(tag) % 3 == 0:
-                    # "device" group: slow async lane
-                    fut = plane.submit(kernel, (np.arange(2),), nbytes=64)
-                    return lambda: fut.result()
-                return None     # "host" group: resolved inline
+                # a run may mix "device" and "host" groups: any device
+                # member keeps the whole run in flight (the runner's run =
+                # one chain invocation), none ⇒ inline — same contract as
+                # the real pipeline's token list
+                futs = [plane.submit(kernel, (np.arange(2),), nbytes=64)
+                        for g in groups
+                        if int(bytes(g.get_tag(b"seq") or b"0")) % 3 == 0]
+                if not futs:
+                    return None     # all-host run: resolved inline
+                return lambda: [f.result() for f in futs]
 
             def send(self, groups):
-                g = groups[0]
-                src = bytes(g.get_tag(b"__source__") or b"")
                 with lock:
-                    sent.append((src, int(bytes(g.get_tag(b"seq")))))
+                    for g in groups:
+                        src = bytes(g.get_tag(b"__source__") or b"")
+                        sent.append((src, int(bytes(g.get_tag(b"seq")))))
 
         class _Mgr:
             def find_pipeline_by_queue_key(self, key):
@@ -526,11 +533,12 @@ class TestDeviceLaneScaling:
 
             def process_begin(self, groups):
                 fut = plane.submit(kernel, (np.arange(4),), nbytes=1024)
+                n_grp = len(groups)
 
                 def finish():
                     fut.result()
                     with lock:
-                        done.append(1)
+                        done.extend([1] * n_grp)
                 return finish
 
             def send(self, groups):
@@ -546,7 +554,12 @@ class TestDeviceLaneScaling:
             pqm.create_or_reuse_queue(1, capacity=n + 1)
             for i in range(n):
                 assert pqm.push_queue(1, _group(b"x", b"s%d" % (i % 8)))
-            runner = ProcessorRunner(pqm, _Mgr(), thread_count=tc)
+            # run_max_groups=1: this measures PER-GROUP device round-trip
+            # overlap across lanes — backlog-aware run batching would
+            # collapse the 40 round trips themselves (a different win,
+            # benched as the columnar hand-off)
+            runner = ProcessorRunner(pqm, _Mgr(), thread_count=tc,
+                                     run_max_groups=1)
             t0 = time.perf_counter()
             runner.init()
             assert wait_for(lambda: len(done) >= n, timeout=30)

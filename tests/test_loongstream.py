@@ -457,18 +457,21 @@ class TestRunnerDepth3Ordering:
             name = "stream-ord"
 
             def process_begin(self, groups):
-                g = groups[0]
-                seq = int(bytes(g.get_tag(b"seq")))
-                if seq % 4 == 3:
-                    return None     # host-tier group: sent inline
-                fut = plane.submit(kernel, (np.arange(2),), nbytes=64)
-                return lambda: fut.result()
+                # a backlog-aware run may carry several groups: any
+                # device-tier member keeps the run in flight, an all-host
+                # run resolves inline (the real pipeline's token contract)
+                futs = [plane.submit(kernel, (np.arange(2),), nbytes=64)
+                        for g in groups
+                        if int(bytes(g.get_tag(b"seq"))) % 4 != 3]
+                if not futs:
+                    return None     # host-tier run: sent inline
+                return lambda: [f.result() for f in futs]
 
             def send(self, groups):
-                g = groups[0]
-                src = bytes(g.get_tag(b"__source__") or b"")
                 with lock:
-                    sent.append((src, int(bytes(g.get_tag(b"seq")))))
+                    for g in groups:
+                        src = bytes(g.get_tag(b"__source__") or b"")
+                        sent.append((src, int(bytes(g.get_tag(b"seq")))))
 
         class _Mgr:
             def find_pipeline_by_queue_key(self, key):
