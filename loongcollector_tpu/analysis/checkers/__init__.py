@@ -10,6 +10,7 @@ from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
 from .hot_path_materialize import HotPathMaterializeChecker
 from .metric_naming import MetricNamingChecker
+from .per_row_parse import PerRowParseChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
@@ -24,6 +25,7 @@ _CHECKER_CLASSES = [
     UnledgeredDropChecker,
     MetricNamingChecker,
     HotPathMaterializeChecker,
+    PerRowParseChecker,
 ]
 
 

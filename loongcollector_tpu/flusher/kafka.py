@@ -120,6 +120,9 @@ class FlusherKafka(Flusher):
                 key = None
                 if self.topic_field or self.key_field:
                     try:
+                        # dynamic topic/key routing re-reads the serialized
+                        # row; only active when TopicField/KeyField is set
+                        # loonglint: disable=per-row-parse
                         obj = json.loads(line)
                         if self.topic_field:
                             topic = obj.get(self.topic_field.decode(), topic)

@@ -102,6 +102,10 @@ class AlarmType(str, enum.Enum):
     # parsing while the rest of the mesh keeps running
     CHIP_LANE_OPEN = "CHIP_LANE_OPEN_ALARM"
     REGEX_TIER_DEMOTED = "REGEX_TIER_DEMOTED_ALARM"
+    # loongstruct: a processor's sustained malformed-row rate pushed it
+    # onto the counted per-row fallback path — correctness holds, but the
+    # structural plane's throughput contract is broken for that pipeline
+    PARSE_FALLBACK_DEGRADED = "PARSE_FALLBACK_DEGRADED_ALARM"
     # loongledger: a quiesced conservation snapshot balanced to nonzero —
     # an event crossed into the agent and left without a ledgered exit
     CONSERVATION_RESIDUAL = "CONSERVATION_RESIDUAL_ALARM"

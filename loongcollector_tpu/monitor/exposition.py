@@ -290,6 +290,19 @@ def collect_status() -> dict:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongstruct: per-processor structural-parse fallback accounting
+        # (the "is JSON/CSV parsing quietly per-row again" page) — absent
+        # until a parse processor has processed rows
+        import sys as _sys
+        _pt = _sys.modules.get(
+            "loongcollector_tpu.processor.parse_telemetry")
+        if _pt is not None:
+            parse_doc = _pt.status()
+            if parse_doc:
+                doc["parse"] = parse_doc
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..prof import flight as _flight
         rec = _flight.recorder()
         doc["flight"] = {"events": len(rec),

@@ -62,7 +62,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
             log.warning("failed to load native library: %s", e)
             return None
         if not hasattr(lib, "lct_t1_exec") \
-                or not hasattr(lib, "lct_ndjson_serialize"):
+                or not hasattr(lib, "lct_ndjson_serialize") \
+                or not hasattr(lib, "lct_struct_index"):
             # stale build predating the newest entry point: rebuild + reload
             if _try_build():
                 try:
@@ -105,6 +106,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, ctypes.c_int64, ctypes.c_int32,
                 u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
                 u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        if hasattr(lib, "lct_struct_index"):
+            lib.lct_struct_index.restype = None
+            lib.lct_struct_index.argtypes = [
+                u8p, ctypes.c_int64, i64p, i32p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_uint8, ctypes.c_uint8,
+                ctypes.c_int64, u8p, u8p, u8p, u8p]
+        if hasattr(lib, "lct_json_struct_parse"):
+            lib.lct_json_struct_parse.restype = ctypes.c_int64
+            lib.lct_json_struct_parse.argtypes = [
+                u8p, ctypes.c_int64, i64p, i32p, ctypes.c_int64,
+                u8p, i32p, ctypes.c_int64, i32p, i32p, u8p,
+                u8p, ctypes.c_int64,
+                i32p, i32p, i32p, i32p, i32p, ctypes.c_int64, i64p]
+        if hasattr(lib, "lct_delim_struct_parse"):
+            lib.lct_delim_struct_parse.restype = ctypes.c_int64
+            lib.lct_delim_struct_parse.argtypes = [
+                u8p, ctypes.c_int64, i64p, i32p, ctypes.c_int64,
+                ctypes.c_uint8, ctypes.c_uint8, ctypes.c_int64,
+                i32p, i32p, i32p, u8p, ctypes.c_int64, i64p]
         for fn in ("lct_lz4_bound", "lct_lz4_compress", "lct_lz4_decompress",
                    "lct_snappy_bound", "lct_snappy_compress",
                    "lct_snappy_uncompressed_len", "lct_snappy_decompress"):
@@ -209,6 +229,119 @@ def json_extract(arena: np.ndarray, offsets: np.ndarray,
                          _i32(out_offs), _i32(out_lens), _u8(ok),
                          _u8(fallback))
     return out_offs, out_lens, ok.astype(bool), fallback.astype(bool)
+
+
+STRUCT_MODE_JSON = 0
+STRUCT_MODE_DELIM = 1
+
+
+def struct_index(arena: np.ndarray, offsets: np.ndarray,
+                 lengths: np.ndarray, mode: int = STRUCT_MODE_JSON,
+                 sep: int = 0x2C, quote: int = 0x22,
+                 W: Optional[int] = None):
+    """Per-row structural bitmaps (loongstruct stage 1): uint64 [n, W]
+    arrays (in_string, structural, escaped, quote) with row-local bit
+    positions — the host reference the device twin
+    (ops/kernels/struct_index.py) is differentially tested against.
+    Returns None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_struct_index"):
+        return None
+    arena = np.ascontiguousarray(arena)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    n = len(offsets)
+    if W is None:
+        W = max(1, (int(lengths.max()) + 63) // 64) if n else 1
+    shape = (n, W)
+    s_mask = np.zeros(shape, dtype=np.uint64)
+    t_mask = np.zeros(shape, dtype=np.uint64)
+    e_mask = np.zeros(shape, dtype=np.uint64)
+    q_mask = np.zeros(shape, dtype=np.uint64)
+    lib.lct_struct_index(_u8(arena), len(arena), _i64(offsets),
+                         _i32(lengths), n, mode, sep, quote, W,
+                         _u8(s_mask), _u8(t_mask), _u8(e_mask), _u8(q_mask))
+    return s_mask, t_mask, e_mask, q_mask
+
+
+def json_struct_parse(arena: np.ndarray, offsets: np.ndarray,
+                      lengths: np.ndarray, keys: list,
+                      extra_cap: Optional[int] = None):
+    """Structural-index JSON parse (loongstruct stage 2).  keys:
+    list[bytes] (<= 128).  Returns (offs [F,n] i32, lens [F,n] i32,
+    status [n] u8 (0 parsed / 1 fallback / 2 parsed-with-extras),
+    side bytes ndarray (the unescape arena, already right-sized),
+    extras tuple of 5 int32 arrays (row, key_off, key_len, val_off,
+    val_len)) or None when the native library is unavailable.  Span
+    offsets >= len(arena) index into `side` at offset - len(arena)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_json_struct_parse") \
+            or len(keys) > 128:
+        return None
+    arena = np.ascontiguousarray(arena)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    n = len(offsets)
+    # side spans encode as arena_len + side_off in an int32
+    total = int(lengths.clip(min=0).sum())
+    if len(arena) + total >= 2**31 - 16:
+        return None
+    keys_blob, key_lens = _key_struct(tuple(keys))
+    F = len(keys)
+    # np.empty throughout: the C side fully writes status and every
+    # out_lens slot (-1 default), and only the returned prefixes of the
+    # side/extras buffers are exposed — zeroing here costs ~1 MB of
+    # stores per group at bench rates for no observable difference
+    out_offs = np.empty((F, n), dtype=np.int32)
+    out_lens = np.empty((F, n), dtype=np.int32)
+    status = np.empty(n, dtype=np.uint8)
+    side = np.empty(max(total, 1), dtype=np.uint8)
+    if extra_cap is None:
+        extra_cap = 4 * n + 64
+    extras = tuple(np.empty(extra_cap, dtype=np.int32) for _ in range(5))
+    counts = np.zeros(4, dtype=np.int64)
+    rc = lib.lct_json_struct_parse(
+        _u8(arena), len(arena), _i64(offsets), _i32(lengths), n,
+        _u8(keys_blob), _i32(key_lens), F, _i32(out_offs), _i32(out_lens),
+        _u8(status), _u8(side), len(side),
+        _i32(extras[0]), _i32(extras[1]), _i32(extras[2]),
+        _i32(extras[3]), _i32(extras[4]), extra_cap, _i64(counts))
+    if rc != 0:
+        return None
+    e = int(counts[1])
+    return (out_offs, out_lens, status, side[: int(counts[0])],
+            tuple(a[:e] for a in extras))
+
+
+def delim_struct_parse(arena: np.ndarray, offsets: np.ndarray,
+                       lengths: np.ndarray, sep: int, quote: int,
+                       F: int):
+    """Structural-index quote-mode delimiter parse: event-major spans
+    (offs [n,F] i32, lens [n,F] i32, nfields [n] i32, side bytes).  Span
+    offsets >= len(arena) index into `side`.  Returns None when the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_delim_struct_parse") or F <= 0:
+        return None
+    arena = np.ascontiguousarray(arena)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+    n = len(offsets)
+    total = int(lengths.clip(min=0).sum())
+    if len(arena) + total >= 2**31 - 16:
+        return None
+    out_offs = np.zeros((n, F), dtype=np.int32)
+    out_lens = np.full((n, F), -1, dtype=np.int32)
+    nfields = np.zeros(n, dtype=np.int32)
+    side = np.empty(max(total, 1), dtype=np.uint8)
+    counts = np.zeros(2, dtype=np.int64)
+    rc = lib.lct_delim_struct_parse(
+        _u8(arena), len(arena), _i64(offsets), _i32(lengths), n,
+        sep, quote, F, _i32(out_offs), _i32(out_lens), _i32(nfields),
+        _u8(side), len(side), _i64(counts))
+    if rc != 0:
+        return None
+    return out_offs, out_lens, nfields, side[: int(counts[0])]
 
 
 _key_cache: dict = {}

@@ -1,0 +1,13 @@
+"""Device kernels: batched field extraction and structural indexing.
+
+Public surface:
+
+* ``field_extract`` / ``field_extract_pallas`` — Tier-1 segment-program
+  execution over [B, L] row tensors (the regex/grok/delimiter plane);
+* ``dfa_scan`` — fused multi-accept DFA classification (loongfuse);
+* ``struct_index`` — structural bitmaps for JSON / quote-mode delimiter
+  parsing (loongstruct): one dispatch indexes a whole batch-ring slot.
+"""
+
+from .struct_index import (MODE_DELIM, MODE_JSON,  # noqa: F401
+                           StructIndexKernel, struct_index_numpy)

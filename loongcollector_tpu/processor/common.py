@@ -144,6 +144,35 @@ def finish_row_keep(ev, raw, parse_ok: bool, source_key: bytes,
             ev.set_content(renamed, raw)
 
 
+def append_side_arena(source_buffer, side, arena_len: int) -> int:
+    """loongstruct side-arena install, shared by the JSON and delimiter
+    processors so the sentinel contract cannot diverge: the native parse
+    emits rewritten bytes (escape decodes, CSV collapses/joins) into a
+    side buffer with span offsets encoded as arena_len + side_offset;
+    append those bytes to the source buffer ONCE and return the rebase
+    delta for rebase_side_spans.  A zero return is valid (the side bytes
+    happened to land exactly at arena_len)."""
+    if not len(side):
+        return 0
+    base = source_buffer.allocate(len(side))
+    source_buffer.write_at(base, side.tobytes())
+    return base - arena_len
+
+
+def rebase_side_spans(offs: np.ndarray, lens: np.ndarray, arena_len: int,
+                      rebase: int) -> np.ndarray:
+    """Shift side-sentinel offsets (>= arena_len, len >= 0) by `rebase`,
+    vectorised; returns offs unchanged when nothing needs shifting.
+    Absent slots (len < 0) may hold uninitialised offsets and must never
+    be touched."""
+    if not rebase:
+        return offs
+    sidep = (lens >= 0) & (offs >= arena_len)
+    if not sidep.any():
+        return offs
+    return offs + np.where(sidep, np.int32(rebase), 0)
+
+
 def consume_named_source(cols, source_key, parsed_key_names) -> None:
     """Reference DelContent for a NAMED source field: drop it unless one of
     the parsed keys overwrote that very name.  Callers must run this

@@ -134,6 +134,10 @@ class ProcessorParseContainerLog(Processor):
         for i in range(n):
             o, ln = int(cols.offsets[i]), int(cols.lengths[i])
             try:
+                # docker json-file rows: schema {log,stream,time} is a
+                # loongstruct migration candidate (pay-down: route through
+                # native.json_struct_parse like processor_parse_json_tpu)
+                # loonglint: disable=per-row-parse
                 obj = json.loads(arena[o : o + ln].tobytes())
             except ValueError:
                 continue

@@ -6,12 +6,22 @@ core/parser/DelimiterModeFsmParser.h:27-56).
 
 TPU redesign: a non-quoted delimiter split IS a Tier-1 segment program —
 `([^d]*)d([^d]*)d...(.*)` — so it runs on the same gather-free extraction
-kernel as regex parse.  Quote mode falls back to a host CSV FSM with
-identical field semantics.
+kernel as regex parse.  Quote mode (loongstruct) runs on the
+structural-index plane: `lct_delim_struct_parse` derives field spans from
+quote/separator bitmaps with the doubled-quote rule resolved in the same
+carry pass, retiring the per-row Python FSM for columnar groups — fields
+needing byte rewrites (doubled quotes, quoted-head + tail) decode once
+into a per-group side arena.  Without the native library, the numpy twin
+(ops/kernels/struct_index.py) indexes the batch and a vectorised emitter
+covers the RFC4180-clean subset; only index-deviant rows walk the
+reference FSM per row (counted in `parse_fallback_rows_total`).
+`_csv_fsm_split` remains the per-row semantic reference and the row-group
+/ deviant-row tier.
 """
 
 from __future__ import annotations
 
+import os
 import re as _re
 from typing import Any, Dict, List
 
@@ -22,6 +32,17 @@ from ..ops.regex.engine import RegexEngine, get_engine
 from ..pipeline.plugin.interface import PluginContext, Processor
 from .common import (RAW_LOG_KEY, apply_parse_spans,
                      extract_source, finish_row_keep)
+
+
+class _SpanResult:
+    """BatchParseResult-shaped container for apply_parse_spans."""
+
+    __slots__ = ("ok", "cap_off", "cap_len")
+
+    def __init__(self, ok, cap_off, cap_len):
+        self.ok = ok
+        self.cap_off = cap_off
+        self.cap_len = cap_len
 
 
 def _csv_fsm_split(data: bytes, sep: bytes, quote: int = 0x22) -> List[bytes]:
@@ -71,6 +92,7 @@ class ProcessorParseDelimiter(Processor):
         self.renamed_source_key = RAW_LOG_KEY
         self.engine: RegexEngine = None  # type: ignore
         self.allow_not_enough = False
+        self._pipeline = ""
 
     def init(self, config: Dict[str, Any], context: PluginContext) -> bool:
         super().init(config, context)
@@ -84,6 +106,7 @@ class ProcessorParseDelimiter(Processor):
         self.keep_source_on_success = bool(config.get("KeepingSourceWhenParseSucceed", False))
         self.renamed_source_key = config.get("RenamedSourceKey", RAW_LOG_KEY)
         self.allow_not_enough = bool(config.get("AcceptNoEnoughKeys", False))
+        self._pipeline = getattr(context, "pipeline_name", "") or ""
         if not self.keys:
             return False
         if not self.quote_mode:
@@ -102,7 +125,23 @@ class ProcessorParseDelimiter(Processor):
     def process_dispatch(self, group: PipelineEventGroup):
         """Async device plane (same split as processor_parse_regex_tpu):
         the delimiter segment program dispatches now, the spans apply in
-        process_complete while the device moves on to the next group."""
+        process_complete while the device moves on to the next group.
+        Quote-mode columnar groups take the synchronous structural-index
+        plane instead (span derivation IS the whole computation there)."""
+        if self.quote_mode and len(self.separator) == 1 and self.keys:
+            # row groups skip the source pack entirely (extract_source
+            # would copy every event's bytes just to be discarded) and go
+            # straight to the per-event host tier
+            if group.columns is None or group._events:
+                self._process_host(group)
+                return None
+            src = extract_source(group, self.source_key)
+            if src is None:
+                return None
+            if src.columnar and self._process_quote_struct(group, src):
+                return None
+            self._process_host(group)
+            return None
         if self.engine is None or self.quote_mode or self.allow_not_enough:
             # configs that can never take the device path skip the source
             # row-pack entirely (extract_source copies every event's bytes
@@ -138,6 +177,90 @@ class ProcessorParseDelimiter(Processor):
                           self.renamed_source_key,
                           source_key=self.source_key)
 
+    # -- quote mode: structural-index plane ---------------------------------
+
+    def _process_quote_struct(self, group: PipelineEventGroup, src) -> bool:
+        """Quote-mode CSV from the structural index: native fused walk
+        when the library is loaded, else numpy-twin masks + the vectorised
+        clean-subset emitter with a counted per-row FSM tier for deviant
+        rows.  Returns False only when no structural tier applies (caller
+        falls back to the per-row host path wholesale)."""
+        if os.environ.get("LOONG_STRUCT", "1") == "0":
+            return False
+        from .. import native as _native
+        F = len(self.keys)
+        n = len(src.offsets)
+        sep = self.separator[0]
+        sb = group.source_buffer
+        arena_len = len(src.arena)
+        n_fallback = 0
+
+        res = _native.delim_struct_parse(src.arena, src.offsets,
+                                         src.lengths, sep, 0x22, F)
+        if res is not None:
+            from .common import append_side_arena, rebase_side_spans
+            cap_off, cap_len, nfields, side = res
+            rebase = append_side_arena(sb, side, arena_len)
+            cap_off = rebase_side_spans(cap_off, cap_len, arena_len,
+                                        rebase)
+        else:
+            emitted = self._quote_struct_numpy(group, src, F, sep)
+            if emitted is None:
+                return False
+            cap_off, cap_len, nfields, n_fallback = emitted
+        ok = nfields >= F
+        if self.allow_not_enough:
+            ok = nfields >= 1
+        self._apply_device(group, src,
+                           _SpanResult(ok & src.present, cap_off, cap_len))
+        from . import parse_telemetry
+        parse_telemetry.note_rows(self.name, self._pipeline,
+                                  int(src.present.sum()), n_fallback)
+        return True
+
+    def _quote_struct_numpy(self, group, src, F: int, sep: int):
+        """No-native tier: numpy-twin index + vectorised emission; rows
+        the clean-subset emitter cannot express (doubled quotes, literal
+        mid-field quotes, joins) run the reference FSM per row — counted.
+        Returns (cap_off, cap_len, nfields, n_fallback) or None."""
+        from ..ops.kernels import struct_index as _si
+        n = len(src.offsets)
+        lengths = np.asarray(src.lengths, dtype=np.int32)
+        L = max(1, int(lengths.max()) if n else 1)
+        rows = np.zeros((n, L), dtype=np.uint8)
+        arena = src.arena
+        for i in range(n):
+            o, ln = int(src.offsets[i]), int(lengths[i])
+            if ln > 0:
+                rows[i, :ln] = arena[o : o + ln]
+        masks = _si.struct_index_numpy(rows, lengths, mode=_si.MODE_DELIM,
+                                       sep=int(sep))
+        quote_bits = _si.unpack16(masks[3], L)
+        sep_bits = _si.unpack16(masks[1], L)
+        cap_off, cap_len, nfields, deviant = _si.emit_delim_spans(
+            arena, src.offsets, lengths, quote_bits, sep_bits, F)
+        sb = group.source_buffer
+        n_fallback = 0
+        sep_b = bytes([sep])
+        for i in np.nonzero(deviant & src.present)[0]:
+            n_fallback += 1
+            o, ln = int(src.offsets[i]), int(lengths[i])
+            # the counted deviant-row tier under the numpy index (no
+            # native library loaded) — parse_fallback_rows_total
+            # loonglint: disable=per-row-parse
+            fields = _csv_fsm_split(arena[o : o + ln].tobytes(), sep_b)
+            nfields[i] = len(fields)
+            if len(fields) > F:
+                fields = fields[: F - 1] + [sep_b.join(fields[F - 1:])]
+            for k in range(F):
+                if k < len(fields):
+                    view = sb.copy_string(fields[k])
+                    cap_off[i, k] = view.offset
+                    cap_len[i, k] = view.length
+                else:
+                    cap_len[i, k] = -1
+        return cap_off, cap_len, nfields, n_fallback
+
     def _process_host(self, group: PipelineEventGroup) -> None:
         # host path: quote-mode FSM or row groups.  Keep/discard follows
         # the reference ordering shared with apply_parse_spans: capture the
@@ -153,6 +276,9 @@ class ProcessorParseDelimiter(Processor):
             if raw is None:
                 continue
             data = raw.to_bytes()
+            # row-path groups (per-event plugins upstream) have no arena
+            # to index; the FSM is the semantic reference tier
+            # loonglint: disable=per-row-parse
             fields = (_csv_fsm_split(data, self.separator)
                       if self.quote_mode else data.split(self.separator))
             if len(fields) < len(self.keys) and not self.allow_not_enough:

@@ -2713,3 +2713,1045 @@ int64_t lct_aes_cbc_encrypt(const uint8_t* key, int64_t key_len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// loongstruct: structural-index parsing plane (JSON + quote-mode delimiter).
+//
+// ParPaRaw's formulation (PAPERS.md): classify the raw buffer into per-bit
+// structural bitmaps with branch-free whole-word passes, then derive field
+// spans from the index instead of walking bytes with a per-row state
+// machine.  Stage 1 (per row):
+//
+//   backslash / quote / structural-char / control-char masks
+//     64 bytes per step (AVX2 compare+movemask; scalar table fallback)
+//   escaped mask
+//     simdjson's odd-length backslash-run carry trick: odd-run *ends* are
+//     the escaped positions, with a 1-bit carry across 64-bit words so
+//     backslash runs crossing word boundaries resolve exactly
+//   in-string mask
+//     prefix-XOR (carry-less multiply by all-ones, as the 6-step SWAR
+//     shift cascade) over unescaped quotes, sign-propagated across words;
+//     the mask is INCLUSIVE: the opening quote and the string body are
+//     inside, the closing quote is outside
+//   structural index
+//     positions of (structural & ~in_string) | unescaped quotes, emitted
+//     in order via ctz iteration — the only per-byte-ish loop left, and
+//     it steps per *structural character*, not per byte
+//
+// Stage 2 walks the position index: a recursive-descent JSON validator /
+// span emitter (grammar-complete, so acceptance matches Python's json
+// module: anything the index walk cannot prove well-formed is flagged for
+// the counted per-row fallback) and a CSV walk that reproduces the
+// DelimiterModeFsmParser state table field-for-field at
+// structural-character granularity.  Values that need byte rewrites
+// (JSON escape sequences, CSV doubled quotes / quoted-then-tail fields)
+// are decoded into a caller-provided side arena exactly once; their spans
+// are emitted with offset >= arena_len (side sentinel) for the caller's
+// vectorised fix-up.
+// ---------------------------------------------------------------------------
+
+#include <cstdlib>
+
+namespace {
+
+struct BlockMasks {
+    uint64_t bs;          // escape character
+    uint64_t quote;
+    uint64_t structural;  // {}[]:, for JSON; the separator for delimiter
+    uint64_t ctrl;        // bytes < 0x20
+    uint64_t ws;          // JSON whitespace: space \t \n \r
+};
+
+// Scalar classifier: correctness floor for non-AVX2 hosts; the tail mask
+// is applied by the caller (shared with the AVX2 path).
+static void classify_block_scalar(const uint8_t* p, int esc_ch, int quote_ch,
+                                  const uint8_t* struct_tbl,
+                                  BlockMasks* out) {
+    uint64_t bs = 0, q = 0, st = 0, ct = 0, ws = 0;
+    for (int j = 0; j < 64; ++j) {
+        uint8_t c = p[j];
+        uint64_t b = 1ULL << j;
+        if ((int)c == esc_ch) bs |= b;
+        if ((int)c == quote_ch) q |= b;
+        if (struct_tbl[c]) st |= b;
+        if (c < 0x20) ct |= b;
+        if (c == ' ' || c == '\t' || c == '\n' || c == '\r') ws |= b;
+    }
+    out->bs = bs; out->quote = q; out->structural = st; out->ctrl = ct;
+    out->ws = ws;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx512bw,avx512f")))
+static void classify_block_avx512(const uint8_t* p, int64_t nbytes,
+                                  int esc_ch, int quote_ch,
+                                  int mode_json, int sep_ch,
+                                  BlockMasks* out) {
+    // masked load: the row tail needs no padded staging copy — lanes
+    // beyond nbytes read as zero without touching memory
+    __mmask64 lanes = nbytes >= 64 ? ~0ULL : ((1ULL << nbytes) - 1);
+    __m512i v = _mm512_maskz_loadu_epi8(lanes, (const void*)p);
+    out->bs = esc_ch >= 0
+        ? _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8((char)esc_ch)) : 0;
+    out->quote = quote_ch >= 0
+        ? _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8((char)quote_ch)) : 0;
+    if (mode_json) {
+        out->structural =
+              _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('{'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('}'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('['))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8(']'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8(':'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8(','));
+    } else {
+        out->structural =
+            _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8((char)sep_ch));
+    }
+    out->ctrl = _mm512_cmplt_epu8_mask(v, _mm512_set1_epi8(0x20));
+    out->ws = _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8(' '))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('\t'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('\n'))
+            | _mm512_cmpeq_epi8_mask(v, _mm512_set1_epi8('\r'));
+}
+
+__attribute__((target("avx2")))
+static inline uint64_t mm_eq64(__m256i lo, __m256i hi, uint8_t c) {
+    __m256i v = _mm256_set1_epi8((char)c);
+    uint32_t m0 = (uint32_t)_mm256_movemask_epi8(_mm256_cmpeq_epi8(lo, v));
+    uint32_t m1 = (uint32_t)_mm256_movemask_epi8(_mm256_cmpeq_epi8(hi, v));
+    return (uint64_t)m0 | ((uint64_t)m1 << 32);
+}
+
+__attribute__((target("avx2")))
+static void classify_block_avx2(const uint8_t* p, int esc_ch, int quote_ch,
+                                int mode_json, int sep_ch, BlockMasks* out) {
+    __m256i lo = _mm256_loadu_si256((const __m256i*)(const void*)p);
+    __m256i hi = _mm256_loadu_si256((const __m256i*)(const void*)(p + 32));
+    out->bs = esc_ch >= 0 ? mm_eq64(lo, hi, (uint8_t)esc_ch) : 0;
+    out->quote = quote_ch >= 0 ? mm_eq64(lo, hi, (uint8_t)quote_ch) : 0;
+    if (mode_json) {
+        out->structural = mm_eq64(lo, hi, '{') | mm_eq64(lo, hi, '}')
+                        | mm_eq64(lo, hi, '[') | mm_eq64(lo, hi, ']')
+                        | mm_eq64(lo, hi, ':') | mm_eq64(lo, hi, ',');
+    } else {
+        out->structural = mm_eq64(lo, hi, (uint8_t)sep_ch);
+    }
+    __m256i t = _mm256_set1_epi8(0x1F);
+    uint32_t c0 = (uint32_t)_mm256_movemask_epi8(
+        _mm256_cmpeq_epi8(_mm256_min_epu8(lo, t), lo));
+    uint32_t c1 = (uint32_t)_mm256_movemask_epi8(
+        _mm256_cmpeq_epi8(_mm256_min_epu8(hi, t), hi));
+    out->ctrl = (uint64_t)c0 | ((uint64_t)c1 << 32);
+    out->ws = mm_eq64(lo, hi, ' ') | mm_eq64(lo, hi, '\t')
+            | mm_eq64(lo, hi, '\n') | mm_eq64(lo, hi, '\r');
+}
+#endif
+
+static const uint8_t* json_struct_tbl() {
+    static uint8_t tbl[256];
+    static bool init = false;
+    if (!init) {
+        tbl['{'] = tbl['}'] = tbl['['] = tbl[']'] = tbl[':'] = tbl[','] = 1;
+        init = true;
+    }
+    return tbl;
+}
+
+// simdjson's odd-length backslash-run resolver: returns the mask of
+// positions preceded by an ODD number of consecutive backslashes (i.e.
+// escaped characters), carrying run parity across 64-bit words so a
+// trailing-backslash run crossing the boundary resolves exactly.
+static inline uint64_t find_escaped(uint64_t bs_bits, uint64_t* prev_odd) {
+    const uint64_t even_bits = 0x5555555555555555ULL;
+    const uint64_t odd_bits = ~even_bits;
+    uint64_t start_edges = bs_bits & ~(bs_bits << 1);
+    // a run continuing from the previous word flips the parity of a
+    // bit-0 start edge
+    uint64_t even_start_mask = even_bits ^ *prev_odd;
+    uint64_t even_starts = start_edges & even_start_mask;
+    uint64_t odd_starts = start_edges & ~even_start_mask;
+    uint64_t even_carries = bs_bits + even_starts;
+    uint64_t odd_carries;
+    bool ends_odd = __builtin_add_overflow(bs_bits, odd_starts, &odd_carries);
+    odd_carries |= *prev_odd;
+    *prev_odd = ends_odd ? 1 : 0;
+    uint64_t even_carry_ends = even_carries & ~bs_bits;
+    uint64_t odd_carry_ends = odd_carries & ~bs_bits;
+    return (even_carry_ends & odd_bits) | (odd_carry_ends & even_bits);
+}
+
+// prefix XOR (carry-less multiply by ~0): bit i of the result is the XOR
+// of bits [0, i] of x — the in-string parity transform.
+#if defined(__x86_64__)
+static const bool g_has_clmul = __builtin_cpu_supports("pclmul");
+
+__attribute__((target("pclmul")))
+static inline uint64_t prefix_xor_clmul(uint64_t x) {
+    __m128i v = _mm_set_epi64x(0, (long long)x);
+    __m128i ones = _mm_set1_epi8((char)0xFF);
+    return (uint64_t)_mm_cvtsi128_si64(_mm_clmulepi64_si128(v, ones, 0));
+}
+#endif
+
+static inline uint64_t prefix_xor(uint64_t x) {
+#if defined(__x86_64__)
+    if (g_has_clmul) return prefix_xor_clmul(x);
+#endif
+    x ^= x << 1;  x ^= x << 2;  x ^= x << 4;
+    x ^= x << 8;  x ^= x << 16; x ^= x << 32;
+    return x;
+}
+
+struct RowMasks {
+    uint64_t in_string;   // inclusive: opening quote .. last content byte
+    uint64_t escaped;
+    uint64_t quote_real;  // unescaped quotes
+    uint64_t structural;  // structural chars outside strings
+    uint64_t structural_raw;  // structural chars, unmasked (CSV stage 2)
+    uint64_t ctrl_in_str; // raw control bytes inside strings (strict JSON)
+    uint64_t bs;          // raw escape-char mask (row "has escapes" flag)
+    uint64_t ws_outside;  // JSON ws outside strings (the byte-ledger pool)
+};
+
+struct RowScanState {
+    uint64_t prev_odd;       // backslash-run parity carry
+    uint64_t prev_in_string; // 0 or ~0
+};
+
+static inline void scan_word(const uint8_t* p, int64_t nbytes, int esc_ch,
+                             int quote_ch, int mode_json, int sep_ch,
+                             RowScanState* st, RowMasks* out) {
+    BlockMasks bm;
+    uint8_t padded[64];
+    const uint8_t* src = p;
+#if defined(__x86_64__)
+    if (g_has_avx512) {
+        classify_block_avx512(src, nbytes, esc_ch, quote_ch, mode_json,
+                              sep_ch, &bm);
+    } else
+#endif
+    if (nbytes < 64) {
+        memset(padded, 0, sizeof(padded));
+        if (nbytes > 0) memcpy(padded, p, (size_t)nbytes);
+        src = padded;
+    }
+#if defined(__x86_64__)
+    if (g_has_avx512) {
+        // masks already computed above
+    } else if (g_has_avx2) {
+        classify_block_avx2(src, esc_ch, quote_ch, mode_json, sep_ch, &bm);
+    } else
+#endif
+    {
+        static const uint8_t no_struct[256] = {};
+        classify_block_scalar(src, esc_ch, quote_ch,
+                              mode_json ? json_struct_tbl() : no_struct, &bm);
+        if (!mode_json) {
+            uint64_t stm = 0;
+            for (int j = 0; j < 64; ++j)
+                if ((int)src[j] == sep_ch) stm |= 1ULL << j;
+            bm.structural = stm;
+        }
+    }
+    uint64_t valid = nbytes >= 64 ? ~0ULL : ((1ULL << nbytes) - 1);
+    bm.bs &= valid; bm.quote &= valid; bm.structural &= valid;
+    bm.ctrl &= valid;
+    uint64_t escaped = 0;
+    if (esc_ch >= 0 && (bm.bs | st->prev_odd))
+        escaped = find_escaped(bm.bs, &st->prev_odd);
+    uint64_t q_real = bm.quote & ~escaped;
+    uint64_t in_str = prefix_xor(q_real) ^ st->prev_in_string;
+    st->prev_in_string = (uint64_t)((int64_t)in_str >> 63);
+    out->in_string = in_str & valid;
+    out->escaped = escaped & valid;
+    out->quote_real = q_real;
+    out->structural = bm.structural & ~in_str;
+    out->structural_raw = bm.structural;
+    out->ctrl_in_str = bm.ctrl & in_str;
+    out->bs = bm.bs;
+    out->ws_outside = bm.ws & ~in_str & valid;
+}
+
+// Row index: ordered positions of (structural outside strings) and real
+// quotes.  Returns the count; flags get bit0 = raw control byte inside a
+// string (strict JSON rejects), bit1 = unterminated string.
+static int64_t build_row_index(const uint8_t* row, int64_t len, int esc_ch,
+                               int quote_ch, int mode_json, int sep_ch,
+                               uint32_t* pos_out, uint32_t* flags,
+                               int64_t* ws_out = nullptr) {
+    RowScanState st = {0, 0};
+    RowMasks m;
+    int64_t count = 0;
+    int64_t ws = 0;
+    uint32_t fl = 0;
+    for (int64_t base = 0; base < len; base += 64) {
+        scan_word(row + base, len - base, esc_ch, quote_ch, mode_json,
+                  sep_ch, &st, &m);
+        if (m.ctrl_in_str) fl |= 1;
+        if (m.bs) fl |= 4;  // row carries escape chars somewhere
+        ws += __builtin_popcountll(m.ws_outside);
+        uint64_t bits = m.structural | m.quote_real;
+        while (bits) {
+            int j = __builtin_ctzll(bits);
+            bits &= bits - 1;
+            pos_out[count++] = (uint32_t)(base + j);
+        }
+    }
+    if (st.prev_in_string) fl |= 2;
+    *flags = fl;
+    if (ws_out) *ws_out = ws;
+    return count;
+}
+
+// ---------------------------------------------------------------------------
+// Stage 2 (JSON): recursive-descent over the position index.
+// ---------------------------------------------------------------------------
+
+static inline bool jws_byte(uint8_t c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+static inline bool jws_only(const uint8_t* d, int64_t a, int64_t b) {
+    // token gaps are almost always 0 or 1 byte ("key": "v", ...)
+    if (a >= b) return true;
+    if (b - a == 1) return jws_byte(d[a]);
+    for (int64_t i = a; i < b; ++i)
+        if (!jws_byte(d[i])) return false;
+    return true;
+}
+
+struct JWalk {
+    const uint8_t* d;
+    int64_t len;
+    const uint32_t* pos;
+    int64_t cnt;
+};
+
+// forward decl
+static bool jwalk_value(const JWalk& w, int64_t from, int64_t* k,
+                        int64_t* vo, int64_t* vl, int* kind, int depth,
+                        bool has_bs, int64_t* acc);
+
+// container := object | array, fully validated over the index.  Token
+// bytes (string contents, scalar tokens, key contents) accumulate into
+// *acc for the caller's per-row byte ledger — inter-token gaps are NOT
+// scanned here; the ledger (entries + outside-string ws + tokens == row
+// length) rejects any row with unaccounted garbage in one compare.
+static bool jwalk_container(const JWalk& w, int64_t* k, int64_t* end_byte,
+                            int depth, bool has_bs, int64_t* acc) {
+    if (depth > 60 || *k >= w.cnt) return false;
+    int64_t open = w.pos[*k];
+    uint8_t oc = w.d[open];
+    uint8_t close_c = oc == '{' ? '}' : ']';
+    ++*k;
+    if (*k >= w.cnt) return false;
+    // empty container
+    if (w.d[w.pos[*k]] == close_c) {
+        *end_byte = w.pos[*k] + 1;
+        ++*k;
+        return true;
+    }
+    int64_t from = open + 1;
+    for (;;) {
+        if (oc == '{') {
+            // key string
+            if (*k + 1 >= w.cnt || w.d[w.pos[*k]] != '"'
+                    || w.d[w.pos[*k + 1]] != '"')
+                return false;
+            *acc += w.pos[*k + 1] - w.pos[*k] - 1;
+            *k += 2;
+            if (*k >= w.cnt || w.d[w.pos[*k]] != ':') return false;
+            from = w.pos[*k] + 1;
+            ++*k;
+        }
+        int64_t vo, vl;
+        int kind;
+        if (!jwalk_value(w, from, k, &vo, &vl, &kind, depth + 1, has_bs,
+                         acc))
+            return false;
+        if (*k >= w.cnt) return false;
+        uint8_t tc = w.d[w.pos[*k]];
+        if (tc != ',' && tc != close_c) return false;
+        if (kind == 0) {
+            // scalar token between from and the terminator
+            int64_t a = from, b = w.pos[*k];
+            while (a < b && jws_byte(w.d[a])) ++a;
+            while (b > a && jws_byte(w.d[b - 1])) --b;
+            if (b <= a || !json_scalar_valid(w.d + a, b - a)) return false;
+            *acc += b - a;
+        }
+        from = w.pos[*k] + 1;
+        bool done = tc == close_c;
+        ++*k;
+        if (done) { *end_byte = from; return true; }
+    }
+}
+
+// value at `from`; on success *k consumed past the value's index entries
+// (strings/containers) or left AT the terminator-to-be (scalar: kind 0,
+// and vo/vl are NOT set — the caller owns token trimming).  kind: 0
+// scalar, 1 string, 2 string-with-escapes, 3 container (vo/vl = raw
+// span; for strings the span is the content BETWEEN the quotes).
+static bool jwalk_value(const JWalk& w, int64_t from, int64_t* k,
+                        int64_t* vo, int64_t* vl, int* kind, int depth,
+                        bool has_bs, int64_t* acc) {
+    (void)from;
+    if (depth > 60) return false;
+    if (*k >= w.cnt) { *kind = 0; return true; }  // scalar up to terminator
+    int64_t e = w.pos[*k];
+    uint8_t c = w.d[e];
+    if (c == '"') {
+        if (*k + 1 >= w.cnt || w.d[w.pos[*k + 1]] != '"') return false;
+        int64_t close = w.pos[*k + 1];
+        *vo = e + 1;
+        *vl = close - e - 1;
+        *acc += *vl;
+        *kind = (has_bs && memchr(w.d + *vo, '\\', (size_t)*vl)) ? 2 : 1;
+        *k += 2;
+        return true;
+    }
+    if (c == '{' || c == '[') {
+        int64_t end_byte;
+        if (!jwalk_container(w, k, &end_byte, depth, has_bs, acc))
+            return false;
+        *vo = e;
+        *vl = end_byte - e;
+        *kind = 3;
+        return true;
+    }
+    *kind = 0;  // scalar: terminator is the entry at *k (validated by caller)
+    return true;
+}
+
+// JSON string unescape matching CPython json.loads (then utf-8 encode)
+// byte semantics.  Returns decoded length, or -1 when the escape sequence
+// is invalid / not UTF-8-encodable (lone surrogate) — callers route such
+// rows to the per-row fallback.
+static int64_t json_unescape(const uint8_t* s, int64_t len, uint8_t* dst) {
+    int64_t o = 0;
+    for (int64_t i = 0; i < len;) {
+        uint8_t c = s[i];
+        if (c != '\\') { dst[o++] = c; ++i; continue; }
+        if (i + 1 >= len) return -1;
+        uint8_t e = s[i + 1];
+        i += 2;
+        switch (e) {
+            case '"': dst[o++] = '"'; break;
+            case '\\': dst[o++] = '\\'; break;
+            case '/': dst[o++] = '/'; break;
+            case 'b': dst[o++] = '\b'; break;
+            case 'f': dst[o++] = '\f'; break;
+            case 'n': dst[o++] = '\n'; break;
+            case 'r': dst[o++] = '\r'; break;
+            case 't': dst[o++] = '\t'; break;
+            case 'u': {
+                if (i + 4 > len) return -1;
+                uint32_t cp = 0;
+                for (int h = 0; h < 4; ++h) {
+                    uint8_t x = s[i + h];
+                    cp <<= 4;
+                    if (x >= '0' && x <= '9') cp |= (uint32_t)(x - '0');
+                    else if (x >= 'a' && x <= 'f') cp |= (uint32_t)(x - 'a' + 10);
+                    else if (x >= 'A' && x <= 'F') cp |= (uint32_t)(x - 'A' + 10);
+                    else return -1;
+                }
+                i += 4;
+                if (cp >= 0xD800 && cp <= 0xDBFF) {
+                    // surrogate pair
+                    if (i + 6 > len || s[i] != '\\' || s[i + 1] != 'u')
+                        return -1;
+                    uint32_t lo = 0;
+                    for (int h = 0; h < 4; ++h) {
+                        uint8_t x = s[i + 2 + h];
+                        lo <<= 4;
+                        if (x >= '0' && x <= '9') lo |= (uint32_t)(x - '0');
+                        else if (x >= 'a' && x <= 'f')
+                            lo |= (uint32_t)(x - 'a' + 10);
+                        else if (x >= 'A' && x <= 'F')
+                            lo |= (uint32_t)(x - 'A' + 10);
+                        else return -1;
+                    }
+                    if (lo < 0xDC00 || lo > 0xDFFF) return -1;
+                    i += 6;
+                    cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+                    return -1;  // lone low surrogate: not UTF-8-encodable
+                }
+                if (cp < 0x80) {
+                    dst[o++] = (uint8_t)cp;
+                } else if (cp < 0x800) {
+                    dst[o++] = (uint8_t)(0xC0 | (cp >> 6));
+                    dst[o++] = (uint8_t)(0x80 | (cp & 0x3F));
+                } else if (cp < 0x10000) {
+                    dst[o++] = (uint8_t)(0xE0 | (cp >> 12));
+                    dst[o++] = (uint8_t)(0x80 | ((cp >> 6) & 0x3F));
+                    dst[o++] = (uint8_t)(0x80 | (cp & 0x3F));
+                } else {
+                    dst[o++] = (uint8_t)(0xF0 | (cp >> 18));
+                    dst[o++] = (uint8_t)(0x80 | ((cp >> 12) & 0x3F));
+                    dst[o++] = (uint8_t)(0x80 | ((cp >> 6) & 0x3F));
+                    dst[o++] = (uint8_t)(0x80 | (cp & 0x3F));
+                }
+                break;
+            }
+            default: return -1;
+        }
+    }
+    return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exported per-row structural bitmaps (the device twin's reference): each
+// row gets W 64-bit words, bit j of word w = byte w*64+j of the row.
+// mode 0 = JSON ({}[]:, structural, backslash escapes); mode 1 =
+// delimiter (separator structural, no escapes, plain quote parity).
+// Rows longer than W*64 bytes or out of arena bounds get zero masks.
+void lct_struct_index(const uint8_t* arena, int64_t arena_len,
+                      const int64_t* offsets, const int32_t* lengths,
+                      int64_t n, int32_t mode, uint8_t sep, uint8_t quote,
+                      int64_t W, uint64_t* out_string, uint64_t* out_struct,
+                      uint64_t* out_escaped, uint64_t* out_quote) {
+    int mode_json = mode == 0;
+    int esc_ch = mode_json ? '\\' : -1;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t* so = out_string + i * W;
+        uint64_t* st = out_struct + i * W;
+        uint64_t* eo = out_escaped + i * W;
+        uint64_t* qo = out_quote + i * W;
+        memset(so, 0, (size_t)W * 8);
+        memset(st, 0, (size_t)W * 8);
+        memset(eo, 0, (size_t)W * 8);
+        memset(qo, 0, (size_t)W * 8);
+        int64_t off = offsets[i];
+        int64_t len = lengths[i] < 0 ? 0 : lengths[i];
+        if (off < 0 || off + len > arena_len || len > W * 64) continue;
+        RowScanState rs = {0, 0};
+        RowMasks m;
+        for (int64_t w = 0; w * 64 < len; ++w) {
+            scan_word(arena + off + w * 64, len - w * 64, esc_ch, quote,
+                      mode_json, sep, &rs, &m);
+            so[w] = m.in_string;
+            st[w] = m.structural;
+            eo[w] = m.escaped;
+            qo[w] = m.quote_real;
+        }
+    }
+}
+
+// Structural-index JSON object parse: F known keys extracted into
+// field-major [F, n] span arrays; unknown keys appended to the CSR extras
+// arrays; escaped string values decoded into side_buf (span offsets
+// emitted as arena_len + side_offset).  row_status: 0 parsed, 1 fallback
+// (malformed / index-unprovable — caller re-parses per row), 2 parsed
+// with extras.  counts_out: [side_used, extra_used, n_fallback, n_drift].
+// Returns 0, or -1 on invalid arguments.
+int64_t lct_json_struct_parse(
+        const uint8_t* arena, int64_t arena_len, const int64_t* offsets,
+        const int32_t* lengths, int64_t n, const uint8_t* keys_blob,
+        const int32_t* key_lens, int64_t F, int32_t* out_offs,
+        int32_t* out_lens, uint8_t* row_status, uint8_t* side_buf,
+        int64_t side_cap, int32_t* extra_rows, int32_t* extra_key_off,
+        int32_t* extra_key_len, int32_t* extra_val_off,
+        int32_t* extra_val_len, int64_t extra_cap, int64_t* counts_out) {
+    if (F > 128 || n < 0) return -1;
+    int64_t key_starts[128];
+    // short keys (<= 8 bytes, the norm) compare as one masked u64 load
+    uint64_t key_w64[128];
+    uint64_t key_m64[128];
+    {
+        int64_t acc = 0;
+        for (int64_t f = 0; f < F; ++f) {
+            key_starts[f] = acc;
+            acc += key_lens[f];
+        }
+        for (int64_t f = 0; f < F; ++f) {
+            uint8_t pad[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+            int64_t kl = key_lens[f];
+            if (kl <= 8) {
+                memcpy(pad, keys_blob + key_starts[f], (size_t)kl);
+                memcpy(&key_w64[f], pad, 8);
+                key_m64[f] = kl == 8 ? ~0ULL : ((1ULL << (8 * kl)) - 1);
+            } else {
+                key_w64[f] = 0;
+                key_m64[f] = 0;  // long key: memcmp path
+            }
+        }
+    }
+    for (int64_t f = 0; f < F; ++f)
+        for (int64_t i = 0; i < n; ++i) out_lens[f * n + i] = -1;
+
+    int64_t max_len = 0;
+    for (int64_t i = 0; i < n; ++i)
+        if (lengths[i] > max_len) max_len = lengths[i];
+    uint32_t* posbuf = max_len
+        ? (uint32_t*)malloc((size_t)max_len * sizeof(uint32_t)) : nullptr;
+    if (max_len && !posbuf) return -1;
+
+    int64_t side_used = 0, extra_used = 0, n_fallback = 0, n_drift = 0;
+    // schema-order hint: stable-schema rows repeat key order, so try the
+    // slot that matched at this member position last time first
+    int32_t order_hint[128];
+    for (int64_t f = 0; f < F; ++f) order_hint[f] = (int32_t)f;
+
+    // Template replay (the steady-state fast path): machine-generated log
+    // streams repeat one member layout for thousands of rows.  After a
+    // generic row parses clean (no drift, no escapes, flat string/scalar
+    // values), record (kind, slot) per member; the next row with the same
+    // entry count replays that layout with direct char checks, masked-u64
+    // key compares, scalar validation and the byte ledger — no recursive
+    // walk.  ANY mismatch falls back to the generic walk for that row.
+    int tpl_valid = 0;
+    int64_t tpl_cnt = 0;
+    int tpl_nm = 0;
+    int8_t tpl_kind[64];
+    int16_t tpl_slot[64];
+
+    for (int64_t i = 0; i < n; ++i) {
+        row_status[i] = 0;
+        int64_t off = offsets[i];
+        int64_t len = lengths[i] < 0 ? 0 : lengths[i];
+        if (off < 0 || off + len > arena_len) {
+            row_status[i] = 1; ++n_fallback; continue;
+        }
+        const uint8_t* d = arena + off;
+        uint32_t flags = 0;
+        int64_t row_ws = 0;
+        int64_t cnt = build_row_index(d, len, '\\', '"', 1, 0, posbuf,
+                                      &flags, &row_ws);
+        int64_t side_mark = side_used, extra_mark = extra_used;
+        bool bad = (flags & 3) != 0;     // ctrl-in-string / unterminated
+        bool row_has_bs = (flags & 4) != 0;
+        bool drift = false;
+        if (!bad && tpl_valid && !row_has_bs && cnt == tpl_cnt
+                && d[posbuf[0]] == '{') {
+            bool okr = true;
+            int64_t k2 = 1;
+            int64_t acc2 = 0;
+            for (int m = 0; m < tpl_nm; ++m) {
+                if (d[posbuf[k2]] != '"' || d[posbuf[k2 + 1]] != '"') {
+                    okr = false; break;
+                }
+                int64_t ko = posbuf[k2] + 1;
+                int64_t kl2 = posbuf[k2 + 1] - ko;
+                int64_t slot = tpl_slot[m];
+                if (key_lens[slot] != kl2) { okr = false; break; }
+                if (kl2 <= 8 && off + ko + 8 <= arena_len && key_m64[slot]) {
+                    uint64_t rw;
+                    memcpy(&rw, d + ko, 8);
+                    if ((rw & key_m64[slot]) != key_w64[slot]) {
+                        okr = false; break;
+                    }
+                } else if (memcmp(keys_blob + key_starts[slot], d + ko,
+                                  (size_t)kl2) != 0) {
+                    okr = false; break;
+                }
+                if (d[posbuf[k2 + 2]] != ':') { okr = false; break; }
+                int64_t vo2, vl2, term;
+                if (tpl_kind[m] == 1) {
+                    if (d[posbuf[k2 + 3]] != '"'
+                            || d[posbuf[k2 + 4]] != '"') {
+                        okr = false; break;
+                    }
+                    vo2 = posbuf[k2 + 3] + 1;
+                    vl2 = posbuf[k2 + 4] - vo2;
+                    term = k2 + 5;
+                    k2 += 6;
+                } else {
+                    int64_t a = posbuf[k2 + 2] + 1;
+                    term = k2 + 3;
+                    int64_t b = posbuf[term];
+                    while (a < b && jws_byte(d[a])) ++a;
+                    while (b > a && jws_byte(d[b - 1])) --b;
+                    if (b <= a || !json_scalar_valid(d + a, b - a)) {
+                        okr = false; break;
+                    }
+                    vo2 = a; vl2 = b - a;
+                    k2 += 4;
+                }
+                uint8_t tc = d[posbuf[term]];
+                if (tc != (m == tpl_nm - 1 ? '}' : ',')) {
+                    okr = false; break;
+                }
+                acc2 += kl2 + vl2;
+                out_offs[slot * n + i] = (int32_t)(off + vo2);
+                out_lens[slot * n + i] = (int32_t)vl2;
+            }
+            if (okr && k2 == cnt && cnt + row_ws + acc2 == len) {
+                row_status[i] = 0;
+                continue;           // replay complete: next row
+            }
+            // replay rejected: reset partial emits, run the generic walk
+            for (int64_t f = 0; f < F; ++f) out_lens[f * n + i] = -1;
+        }
+        JWalk w = {d, len, posbuf, cnt};
+        int64_t k = 0;
+        int64_t member_idx = 0;
+        int tpl_build_nm = 0;
+        bool tpl_build_ok = true;
+        // byte ledger: every row byte must be an index entry, a token
+        // byte, or outside-string whitespace — one compare at the end
+        // replaces every inter-token whitespace scan
+        int64_t acc = 0;
+        if (!bad && (cnt == 0 || d[posbuf[0]] != '{'))
+            bad = true;
+        if (!bad) {
+            k = 1;
+            // empty object
+            if (k < cnt && d[posbuf[k]] == '}') {
+                k = 2;
+            } else {
+                for (;;) {
+                    // key
+                    if (k + 1 >= cnt || d[posbuf[k]] != '"'
+                            || d[posbuf[k + 1]] != '"') {
+                        bad = true; break;
+                    }
+                    int64_t ko = posbuf[k] + 1;
+                    int64_t kl = posbuf[k + 1] - ko;
+                    if (row_has_bs && memchr(d + ko, '\\', (size_t)kl)) {
+                        // escaped key: index-unprovable → counted fallback
+                        bad = true; break;
+                    }
+                    acc += kl;
+                    k += 2;
+                    if (k >= cnt || d[posbuf[k]] != ':') {
+                        bad = true; break;
+                    }
+                    int64_t from = posbuf[k] + 1;
+                    ++k;
+                    int64_t vo = 0, vl = 0;
+                    int kind = 0;
+                    if (!jwalk_value(w, from, &k, &vo, &vl, &kind, 0,
+                                     row_has_bs, &acc)) {
+                        bad = true; break;
+                    }
+                    if (k >= cnt) { bad = true; break; }
+                    uint8_t tc = d[posbuf[k]];
+                    if (tc != ',' && tc != '}') { bad = true; break; }
+                    if (kind == 0) {
+                        int64_t a = from, b = posbuf[k];
+                        while (a < b && jws_byte(d[a])) ++a;
+                        while (b > a && jws_byte(d[b - 1])) --b;
+                        if (b <= a || !json_scalar_valid(d + a, b - a)) {
+                            bad = true; break;
+                        }
+                        vo = a; vl = b - a;
+                        acc += vl;
+                    }
+                    // emit value span (decode escapes into the side arena)
+                    int64_t evo = off + vo, evl = vl;
+                    if (kind == 2) {
+                        if (side_used + vl > side_cap) { bad = true; break; }
+                        int64_t dl = json_unescape(d + vo, vl,
+                                                   side_buf + side_used);
+                        if (dl < 0) { bad = true; break; }
+                        evo = arena_len + side_used;
+                        evl = dl;
+                        side_used += dl;
+                    }
+                    // schema match (order-hint first, then linear);
+                    // the row key loads as a masked u64 when the 8-byte
+                    // read stays inside the arena
+                    int64_t slot = -1;
+                    uint64_t row_w64 = 0;
+                    bool fast_key = kl <= 8 && off + ko + 8 <= arena_len;
+                    if (fast_key) memcpy(&row_w64, d + ko, 8);
+                    if (member_idx < F) {
+                        int32_t h = order_hint[member_idx];
+                        if (key_lens[h] == kl
+                                && (fast_key && key_m64[h]
+                                    ? (row_w64 & key_m64[h]) == key_w64[h]
+                                    : memcmp(keys_blob + key_starts[h],
+                                             d + ko, (size_t)kl) == 0))
+                            slot = h;
+                    }
+                    if (slot < 0) {
+                        for (int64_t f = 0; f < F; ++f) {
+                            if (key_lens[f] != kl) continue;
+                            if (fast_key && key_m64[f]
+                                    ? (row_w64 & key_m64[f]) != key_w64[f]
+                                    : memcmp(keys_blob + key_starts[f],
+                                             d + ko, (size_t)kl) != 0)
+                                continue;
+                            slot = f;
+                            if (member_idx < F)
+                                order_hint[member_idx] = (int32_t)f;
+                            break;
+                        }
+                    }
+                    if (slot >= 0) {
+                        out_offs[slot * n + i] = (int32_t)evo;
+                        out_lens[slot * n + i] = (int32_t)evl;
+                        if (tpl_build_ok && member_idx < 64
+                                && (kind == 0 || kind == 1)) {
+                            tpl_kind[member_idx] = (int8_t)kind;
+                            tpl_slot[member_idx] = (int16_t)slot;
+                            tpl_build_nm = (int)member_idx + 1;
+                        } else {
+                            tpl_build_ok = false;
+                        }
+                    } else {
+                        tpl_build_ok = false;
+                        if (extra_used >= extra_cap) { bad = true; break; }
+                        extra_rows[extra_used] = (int32_t)i;
+                        extra_key_off[extra_used] = (int32_t)(off + ko);
+                        extra_key_len[extra_used] = (int32_t)kl;
+                        extra_val_off[extra_used] = (int32_t)evo;
+                        extra_val_len[extra_used] = (int32_t)evl;
+                        ++extra_used;
+                        drift = true;
+                    }
+                    ++member_idx;
+                    bool done = tc == '}';
+                    ++k;
+                    if (done) break;
+                }
+            }
+            // ledger + no trailing index entries after the closing brace
+            if (!bad && (k != cnt || cnt + row_ws + acc != len))
+                bad = true;
+        }
+        if (bad) {
+            row_status[i] = 1;
+            ++n_fallback;
+            side_used = side_mark;
+            extra_used = extra_mark;
+            for (int64_t f = 0; f < F; ++f) out_lens[f * n + i] = -1;
+        } else if (drift) {
+            row_status[i] = 2;
+            ++n_drift;
+        } else if (tpl_build_ok && !row_has_bs && tpl_build_nm > 0
+                   && member_idx == tpl_build_nm) {
+            tpl_valid = 1;
+            tpl_cnt = cnt;
+            tpl_nm = tpl_build_nm;
+        }
+    }
+    free(posbuf);
+    counts_out[0] = side_used;
+    counts_out[1] = extra_used;
+    counts_out[2] = n_fallback;
+    counts_out[3] = n_drift;
+    return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Stage 2 (quote-mode delimiter): position-stream walk reproducing the
+// DelimiterModeFsmParser state table (core/parser/DelimiterModeFsmParser.h)
+// field-for-field:
+//   * a quote OPENS a quoted section only as the field's first byte;
+//   * inside quotes, a doubled quote escapes to one literal quote and
+//     separators are content;
+//   * after the closing quote, bytes up to the next separator append
+//     literally (including quotes);
+//   * an unterminated quote consumes the rest of the row as content.
+// Fields needing byte rewrites (doubled quotes, quoted-head + literal
+// tail) are materialised in side_buf; clean fields stay pure spans.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CsvEmit {
+    int64_t off;   // arena offset, or arena_len + side offset
+    int64_t len;
+};
+
+// Decode ONE field starting at `start` (absolute row positions) given the
+// position stream (quotes + raw separators, ordered).  Advances *k past
+// the field's entries; returns the exclusive end (the separator position,
+// or row len).  If the field needs a rewrite, copies decoded bytes into
+// side_buf at *side_used (caller checks capacity beforehand: decoded
+// length never exceeds the raw field length).
+static int64_t csv_field(const uint8_t* d, int64_t len, const uint32_t* pos,
+                         int64_t cnt, int64_t* k, int64_t start,
+                         uint8_t quote, int64_t arena_off, int64_t arena_len,
+                         uint8_t* side_buf, int64_t* side_used,
+                         int64_t side_cap, CsvEmit* out) {
+    (void)side_cap;  // capacity is pre-checked per row by the caller
+    while (*k < cnt && (int64_t)pos[*k] < start) ++*k;
+    // unquoted field: up to the next raw separator; quotes are literal
+    if (start >= len || d[start] != quote) {
+        int64_t kk = *k;
+        int64_t end = len;
+        while (kk < cnt) {
+            if (d[pos[kk]] != quote) { end = pos[kk]; break; }
+            ++kk;
+        }
+        // consume entries inside the field plus the separator
+        while (*k < cnt && (int64_t)pos[*k] < end) ++*k;
+        out->off = arena_off + start;
+        out->len = end - start;
+        return end;
+    }
+    // quoted field: scan quote entries for the close, collapsing doubles
+    int64_t i = start + 1;      // content cursor (raw)
+    ++*k;                        // past the opening quote
+    bool doubled = false;
+    int64_t close = -1;
+    while (*k < cnt) {
+        int64_t p = pos[*k];
+        if (d[p] != quote) { ++*k; continue; }  // separator inside quotes
+        if (*k + 1 < cnt && (int64_t)pos[*k + 1] == p + 1
+                && d[pos[*k + 1]] == quote) {
+            doubled = true;
+            *k += 2;
+            continue;
+        }
+        close = p;
+        ++*k;
+        break;
+    }
+    if (close < 0) {
+        // unterminated: rest of row is content (with doubles collapsed)
+        if (!doubled) {
+            out->off = arena_off + i;
+            out->len = len - i;
+            return len;
+        }
+        int64_t so = *side_used;
+        int64_t o = so;
+        // capacity is guaranteed by the caller's per-row `len` pre-check
+        for (int64_t j = i; j < len; ++j) {
+            side_buf[o++] = d[j];
+            if (d[j] == quote && j + 1 < len && d[j + 1] == quote) ++j;
+        }
+        out->off = arena_len + so;
+        out->len = o - so;
+        *side_used = o;
+        return len;
+    }
+    // field end: next raw separator after the close
+    int64_t end = len;
+    while (*k < cnt) {
+        if (d[pos[*k]] != quote) { end = pos[*k]; break; }
+        ++*k;
+    }
+    while (*k < cnt && (int64_t)pos[*k] < end) ++*k;
+    bool tail = end > close + 1;
+    if (!doubled && !tail) {
+        out->off = arena_off + i;
+        out->len = close - i;
+        return end;
+    }
+    int64_t so = *side_used;
+    int64_t o = so;
+    for (int64_t j = i; j < close; ++j) {
+        side_buf[o++] = d[j];
+        if (d[j] == quote && j + 1 < close && d[j + 1] == quote) ++j;
+    }
+    for (int64_t j = close + 1; j < end; ++j) side_buf[o++] = d[j];
+    out->off = arena_len + so;
+    out->len = o - so;
+    *side_used = o;
+    return end;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Quote-mode delimiter parse from the structural index.  Emits the first
+// F-1 fields as spans and joins fields [F-1, nfields) with the separator
+// (the reference's "last key takes the rest" rule applied to PROCESSED
+// fields, matching the host FSM + join path byte-for-byte).  Output spans
+// are event-major [n, F]; len -1 = absent.  nfields_out[i] = total fields
+// the row splits into.  counts_out: [side_used, n_rewrites].
+// Returns 0, or -1 on invalid arguments / side buffer overflow.
+int64_t lct_delim_struct_parse(
+        const uint8_t* arena, int64_t arena_len, const int64_t* offsets,
+        const int32_t* lengths, int64_t n, uint8_t sep, uint8_t quote,
+        int64_t F, int32_t* out_offs, int32_t* out_lens,
+        int32_t* nfields_out, uint8_t* side_buf, int64_t side_cap,
+        int64_t* counts_out) {
+    if (F <= 0 || n < 0) return -1;
+    int64_t max_len = 0;
+    for (int64_t i = 0; i < n; ++i)
+        if (lengths[i] > max_len) max_len = lengths[i];
+    uint32_t* posbuf = max_len
+        ? (uint32_t*)malloc((size_t)max_len * sizeof(uint32_t)) : nullptr;
+    if (max_len && !posbuf) return -1;
+    int64_t side_used = 0, rewrites = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        for (int64_t f = 0; f < F; ++f) out_lens[i * F + f] = -1;
+        nfields_out[i] = 0;
+        int64_t off = offsets[i];
+        int64_t len = lengths[i] < 0 ? 0 : lengths[i];
+        if (off < 0 || off + len > arena_len) continue;
+        // every decoded byte lands in side_buf at most once and decoding
+        // never expands, so one row needs at most `len` bytes of side
+        if (side_used + len > side_cap) { free(posbuf); return -1; }
+        const uint8_t* d = arena + off;
+        // raw position stream (quotes + ALL separators): the FSM walk
+        // applies quote semantics itself, so the parity in-string mask —
+        // which a literal mid-field quote can desynchronise — is never
+        // trusted for field boundaries
+        int64_t cnt = 0;
+        {
+            RowScanState rs = {0, 0};
+            RowMasks m;
+            for (int64_t base = 0; base < len; base += 64) {
+                scan_word(d + base, len - base, -1, quote, 0, sep, &rs, &m);
+                uint64_t bits = m.quote_real | m.structural_raw;
+                while (bits) {
+                    int j = __builtin_ctzll(bits);
+                    bits &= bits - 1;
+                    posbuf[cnt++] = (uint32_t)(base + j);
+                }
+            }
+        }
+        int64_t k = 0, start = 0, fidx = 0;
+        int64_t side_mark = side_used;
+        bool joining = false;       // fields >= F merge into the last slot
+        int64_t join_start = 0;     // side offset of the merged value
+        for (;;) {
+            if (joining) side_buf[side_used++] = sep;
+            CsvEmit e;
+            int64_t end = csv_field(d, len, posbuf, cnt, &k, start, quote,
+                                    off, arena_len, side_buf, &side_used,
+                                    side_cap, &e);
+            if (joining) {
+                if (e.off < arena_len) {  // pure span: append bytes
+                    memcpy(side_buf + side_used, arena + e.off,
+                           (size_t)e.len);
+                    side_used += e.len;
+                }
+                // side spans were decoded in place at the join tail
+                out_lens[i * F + (F - 1)] =
+                    (int32_t)(side_used - join_start);
+            } else if (fidx < F) {
+                out_offs[i * F + fidx] = (int32_t)e.off;
+                out_lens[i * F + fidx] = (int32_t)e.len;
+            }
+            ++fidx;
+            if (end >= len) break;
+            start = end + 1;
+            if (!joining && fidx == F) {
+                // more fields follow: convert the last slot to join mode
+                int64_t slot = i * F + (F - 1);
+                if (out_offs[slot] >= arena_len) {
+                    join_start = out_offs[slot] - arena_len;
+                } else {
+                    join_start = side_used;
+                    memcpy(side_buf + side_used, arena + out_offs[slot],
+                           (size_t)out_lens[slot]);
+                    side_used += out_lens[slot];
+                    out_offs[slot] = (int32_t)(arena_len + join_start);
+                }
+                joining = true;
+            }
+        }
+        nfields_out[i] = (int32_t)fidx;
+        if (side_used != side_mark) ++rewrites;
+    }
+    free(posbuf);
+    counts_out[0] = side_used;
+    counts_out[1] = rewrites;
+    return 0;
+}
+
+}  // extern "C"

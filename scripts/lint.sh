@@ -52,6 +52,13 @@ echo "== fused-DFA equivalence gate (loongfuse) =="
 # means fusion would mis-gate extraction (docs/performance.md)
 JAX_PLATFORMS=cpu python scripts/fuse_equivalence.py
 
+echo "== structural-index equivalence gate (loongstruct) =="
+# the native/numpy/device structural bitmaps must be bit-identical, the
+# JSON plane must match Python `json` row-for-row, and quote-mode
+# delimiter parsing must reproduce the reference CSV FSM + python csv —
+# any span or byte diff fails (docs/performance.md)
+JAX_PLATFORMS=cpu python scripts/struct_equivalence.py
+
 echo "== native lint =="
 make -C native lint
 

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""loongstruct equivalence gate (scripts/lint.sh + tier-1).
+
+Three hard lines under the structural-index parsing plane:
+
+1. **Index equivalence** — the native `lct_struct_index` bitmaps, the
+   numpy twin, and the device kernel (jitted, CPU backend here) must be
+   bit-identical over an adversarial corpus, in both JSON and delimiter
+   modes.  Any differing word means the three substrates disagree about
+   where strings/structural characters are — the codesign contract is
+   "same index, different execution", never "similar index".
+
+2. **JSON differential** — `processor_parse_json_tpu` over the structural
+   plane must agree with Python's `json` module row for row: the same
+   accept/reject set, and byte-identical values for strings (including
+   escape decoding into the side arena), bools, nulls and
+   canonically-spelled numbers.  Nested containers compare semantically
+   (raw-span vs json.dumps spelling is the documented contract).
+
+3. **Delimiter differential** — quote-mode parsing (native fused AND the
+   no-native numpy tier) must reproduce the reference CSV FSM
+   (`_csv_fsm_split`) field-for-field, and agree with Python's `csv`
+   module on the well-formed subset.
+
+Exit 0 = equivalent; exit 1 = any span or byte diff (printed per row).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from loongcollector_tpu import native as nat  # noqa: E402
+from loongcollector_tpu.ops.kernels import struct_index as si  # noqa: E402
+
+
+def json_corpus() -> list:
+    rows = [
+        b'{"ts": 1700000000, "level": "info", "user": "u1", "msg": "hi"}',
+        b'{"ts": 1, "level": "in\\nfo", "user": "u\\u00e9", "msg": "\\"q\\""}',
+        b'{"ts": 2, "level": "ok", "user": "u", "msg": "m"}',
+        b'{"ts": 1, "extra_key": "boom", "level": "x"}',
+        b'{"nested": {"a": [1, 2, {"b": "c,{}"}]}, "ts": 3}',
+        b'{"ts": bad}', b'not json', b'{}', b'  { } ',
+        b'{"a": "unterminated', b'{"dup": 1, "dup": 2}',
+        b'{"sp" :  "v"  ,  "n" : -1.5e3  }',
+        b'{"surrogate": "\\ud83d\\ude00"}',
+        b'{"slash": "a\\/b"}', b'{"ctl": "a\tb"}',
+        b'{"a": 1} trailing', b'{"a": 1}}', b'{"a": 01}', b'{"a"::1}',
+        b'{"a": 1, }', b'{"a" x: 1}', b'{"a": "x" junk "y"}',
+        b'{"a": true, "b": null, "c": false}', b'[1, 2]', b'"str"', b'',
+        b'{"reorder": 1, "ts": 2, "level": "z", "user": "u", "msg": "m"}',
+        b'{"deep": ' + b'[' * 70 + b']' * 70 + b'}',
+    ]
+    # trailing-backslash runs crossing the 64-bit word boundary: the
+    # escape-carry resolution's hardest case
+    for k in range(1, 12):
+        pad = b'x' * (62 - k)
+        rows.append(b'{"e": "' + pad + b'\\' * k + b'n", "t": 1}')
+        rows.append(b'{"e": "' + pad + b'\\' * k + b'"}')  # some malformed
+    rng = np.random.default_rng(12)
+    for _ in range(300):
+        L = int(rng.integers(0, 150))
+        rows.append(bytes(rng.choice(
+            list(b'ab\\"{}[]:, \t019.e-u'), size=L).astype(np.uint8)))
+    return rows
+
+
+def csv_corpus() -> list:
+    rows = [
+        b'a,b,c', b'"a,b",c', b'"a""b",c', b'a"b,c"d,e', b'"x"tail,y',
+        b'"unterminated, z', b'', b',', b',,,', b'a,,b', b'"",x', b'""a,b',
+        b'"a","b","c","d"', b'q,"r,s,t', b'"dq""""x",y', b'one',
+        b'a,b,c,d,e,f,g,h', b'"j1,j2",k,"l,m",n,extra1,extra2',
+    ]
+    rng = np.random.default_rng(13)
+    for _ in range(300):
+        L = int(rng.integers(0, 80))
+        rows.append(bytes(rng.choice(
+            list(b'ab",x '), size=L).astype(np.uint8)))
+    return rows
+
+
+def pack(rows):
+    blob = b"".join(rows)
+    arena = np.frombuffer(blob, dtype=np.uint8) if blob \
+        else np.zeros(0, np.uint8)
+    lens = np.array([len(r) for r in rows], dtype=np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64) \
+        if rows else np.zeros(0, np.int64)
+    return blob, arena, offs, lens
+
+
+def check_index(rows, mode_i, mode_s, sep=0x2C) -> int:
+    """Native vs numpy vs device masks, bit for bit."""
+    blob, arena, offs, lens = pack(rows)
+    nm = nat.struct_index(arena, offs, lens, mode=mode_i, sep=sep)
+    if nm is None:
+        print(f"index[{mode_s}]: native library unavailable — SKIPPED")
+        return 0
+    L = max(1, int(lens.max()))
+    n = len(rows)
+    mat = np.zeros((n, L), dtype=np.uint8)
+    for i, r in enumerate(rows):
+        mat[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+    np16 = si.struct_index_numpy(mat, lens, mode=mode_s, sep=sep)
+    kern = si.StructIndexKernel(mode=mode_s, sep=sep)
+    dv = [np.asarray(x) for x in kern(mat, lens)]
+    W16 = np16[0].shape[1]
+    bad = 0
+    names = ("in_string", "structural", "escaped", "quote")
+    for mi, name in enumerate(names):
+        a = si.native_masks_as_words16(nm[mi])[:, :W16]
+        b, c = np16[mi], dv[mi]
+        if not (np.array_equal(a, b) and np.array_equal(b, c)):
+            for i in range(n):
+                if not (np.array_equal(a[i], b[i])
+                        and np.array_equal(b[i], c[i])):
+                    bad += 1
+                    print(f"FAIL index[{mode_s}/{name}] row {i} "
+                          f"{rows[i][:60]!r}: native/numpy/device disagree")
+    print(f"index[{mode_s}]: {n} rows x native+numpy+device — "
+          f"{'OK' if not bad else f'{bad} DISAGREEMENTS'} "
+          f"(device dispatches: {kern.dispatch_count})")
+    return bad
+
+
+def check_json(rows) -> int:
+    """Structural processor vs Python json over the corpus."""
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+
+    rows = [r for r in rows if b"\n" not in r]
+    data = b"\n".join(rows) + b"\n"
+    sb = SourceBuffer(len(data) + 64)
+    g = PipelineEventGroup(sb)
+    g.add_raw_event(1).set_content(sb.copy_string(data))
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+    ctx = PluginContext("struct-gate")
+    sp = ProcessorSplitLogString(); sp.init({}, ctx)
+    pj = ProcessorParseJson(); pj.init({}, ctx)
+    sp.process(g)
+    pj.process(g)
+    bad = 0
+    for i, ev in enumerate(g.events):
+        got = {str(k): str(v) for k, v in ev.contents if str(k) != "rawLog"}
+        try:
+            obj = json.loads(rows[i])
+            ok = isinstance(obj, dict)
+        except Exception:  # noqa: BLE001
+            ok = False
+        if not ok:
+            if got:
+                bad += 1
+                print(f"FAIL json row {i} {rows[i][:60]!r}: python rejects, "
+                      f"struct parsed {got}")
+            continue
+        for k, v in obj.items():
+            if k not in got:
+                bad += 1
+                print(f"FAIL json row {i} {rows[i][:60]!r}: missing {k!r}")
+                continue
+            if isinstance(v, str):
+                want = v
+            elif isinstance(v, bool):
+                want = "true" if v else "false"
+            elif v is None:
+                want = "null"
+            elif isinstance(v, (dict, list)):
+                # raw-span contract: compare semantically
+                try:
+                    if json.loads(got[k]) != v:
+                        bad += 1
+                        print(f"FAIL json row {i} key {k!r}: nested "
+                              f"{got[k]!r} != {v!r}")
+                except Exception:  # noqa: BLE001
+                    bad += 1
+                    print(f"FAIL json row {i} key {k!r}: nested span "
+                          f"unparseable {got[k]!r}")
+                continue
+            else:
+                continue  # numbers: raw-token spelling contract
+            if got[k] != want:
+                bad += 1
+                print(f"FAIL json row {i} {rows[i][:60]!r} key {k!r}: "
+                      f"{got[k]!r} != {want!r}")
+        for k in got:
+            if k not in obj:
+                bad += 1
+                print(f"FAIL json row {i}: phantom key {k!r}")
+    print(f"json: {len(rows)} rows vs Python json — "
+          f"{'OK' if not bad else f'{bad} DIFFS'}")
+    return bad
+
+
+def check_csv(rows) -> int:
+    """Native + numpy-tier quote-mode parse vs the FSM, and vs Python csv
+    on the well-formed subset."""
+    from loongcollector_tpu.processor.parse_delimiter import _csv_fsm_split
+    blob, arena, offs, lens = pack(rows)
+    bad = 0
+    for F in (2, 4, 6):
+        res = nat.delim_struct_parse(arena, offs, lens, 0x2C, 0x22, F)
+        if res is None:
+            print("csv: native library unavailable — SKIPPED")
+            break
+        o_, l_, nf, side = res
+        AL = len(arena)
+        for i, r in enumerate(rows):
+            fields = _csv_fsm_split(r, b",")
+            if int(nf[i]) != len(fields):
+                bad += 1
+                print(f"FAIL csv row {i} {r[:50]!r}: nfields {int(nf[i])} "
+                      f"!= {len(fields)}")
+            want = fields if len(fields) <= F \
+                else fields[: F - 1] + [b",".join(fields[F - 1:])]
+            for k in range(min(F, len(want))):
+                o2, l2 = int(o_[i, k]), int(l_[i, k])
+                got = None if l2 < 0 else (
+                    bytes(side[o2 - AL: o2 - AL + l2]) if o2 >= AL
+                    else blob[o2: o2 + l2])
+                if got != want[k]:
+                    bad += 1
+                    print(f"FAIL csv row {i} {r[:50]!r} F={F} field {k}: "
+                          f"{got!r} != {want[k]!r}")
+    # Python csv agreement on the well-formed subset (no stray quotes)
+    for r in rows:
+        try:
+            text = r.decode("utf-8")
+        except UnicodeDecodeError:
+            continue
+        fsm = [f.decode("utf-8", "replace")
+               for f in _csv_fsm_split(r, b",")]
+        try:
+            parsed = next(csv.reader(io.StringIO(text)))
+        except (csv.Error, StopIteration):
+            continue
+        # csv and the FSM agree exactly on RFC4180-clean rows; rows with
+        # literal mid-field quotes differ by documented design
+        clean = all(('"' not in f) or text.count('"') % 2 == 0
+                    for f in parsed) and '"' not in text.replace('""', '') \
+            .replace('","', ',').strip('"')
+        if clean and parsed != fsm and text:
+            bad += 1
+            print(f"FAIL csv-vs-python {r[:50]!r}: csv {parsed} fsm {fsm}")
+    print(f"csv: {len(rows)} rows x F=2/4/6 vs FSM + python csv — "
+          f"{'OK' if not bad else f'{bad} DIFFS'}")
+    return bad
+
+
+def main() -> int:
+    jrows = json_corpus()
+    crows = csv_corpus()
+    bad = check_index(jrows, 0, si.MODE_JSON)
+    bad += check_index(crows, 1, si.MODE_DELIM)
+    bad += check_json(jrows)
+    bad += check_csv(crows)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

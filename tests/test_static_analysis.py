@@ -83,13 +83,14 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_eight_checkers_registered(self):
+    def test_all_nine_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
                          "swallowed-fault", "unledgered-drop",
-                         "metric-naming", "hot-path-materialize"]
-        assert len(all_checkers()) == 8
+                         "metric-naming", "hot-path-materialize",
+                         "per-row-parse"]
+        assert len(all_checkers()) == 9
 
 
 # ---------------------------------------------------------------------------
@@ -1325,3 +1326,92 @@ class TestHotPathMaterialize:
         import inspect
         src = inspect.getsource(ed)
         assert "loonglint: disable=hot-path-materialize" in src
+
+
+# ---------------------------------------------------------------------------
+# 10. per-row-parse fixtures (loongstruct)
+
+
+class TestPerRowParse:
+    @staticmethod
+    def checker():
+        from loongcollector_tpu.analysis.checkers.per_row_parse import \
+            PerRowParseChecker
+        return PerRowParseChecker()
+
+    def test_json_loads_in_loop_flagged(self):
+        src = """
+        import json
+
+        class ProcessorFx:
+            supports_columnar = True
+
+            def process(self, group):
+                for i in idx:
+                    obj = json.loads(rows[i])
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/processor/fx.py")
+        assert checks_of(fs) == {"per-row-parse"}
+
+    def test_fsm_split_in_loop_flagged(self):
+        src = """
+        class ProcessorFx:
+            supports_columnar = True
+
+            def process(self, group):
+                while todo:
+                    fields = _csv_fsm_split(todo.pop(), b",")
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/processor/fx.py")
+        assert checks_of(fs) == {"per-row-parse"}
+
+    def test_json_loads_in_comprehension_flagged(self):
+        src = """
+        import json
+
+        class ProcessorFx:
+            supports_columnar = True
+
+            def process(self, group):
+                objs = [json.loads(rows[i]) for i in idx]
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/processor/fx.py")
+        assert checks_of(fs) == {"per-row-parse"}
+
+    def test_bounded_probe_outside_loop_ok(self):
+        src = """
+        import json
+
+        class ProcessorFx:
+            supports_columnar = True
+
+            def discover(self, row):
+                return json.loads(row)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/processor/fx.py") == []
+
+    def test_non_columnar_class_out_of_scope(self):
+        src = """
+        import json
+
+        class ProcessorFx:
+            def process(self, group):
+                for r in rows:
+                    json.loads(r)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/processor/fx.py") == []
+
+    def test_real_tree_fallbacks_are_suppressed_with_justification(self):
+        # the counted fallback tiers carry disable comments; the
+        # full-tree gate (TestTier1Gate) proves they are the ONLY hits
+        import inspect
+
+        import loongcollector_tpu.processor.parse_delimiter as pd
+        import loongcollector_tpu.processor.parse_json as pj
+        assert "loonglint: disable=per-row-parse" in inspect.getsource(pj)
+        assert "loonglint: disable=per-row-parse" in inspect.getsource(pd)

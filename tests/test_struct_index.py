@@ -1,0 +1,555 @@
+"""loongstruct: structural-index JSON & delimiter parsing.
+
+Layers under test (ISSUE 12):
+
+1. mask equivalence — native `lct_struct_index`, the numpy twin, and the
+   device kernel agree bit-for-bit with a brute-force Python reference
+   (escape-carry across 64-bit word boundaries included);
+2. differential goldens — parse_json vs Python `json`, parse_delimiter
+   quote-mode vs the reference FSM and Python `csv`, on native AND
+   numpy-fallback execution, over adversarial corpora;
+3. the device kernel indexes a whole batch in ONE dispatch;
+4. parse-fallback observability: counters, the one-shot
+   PARSE_FALLBACK_DEGRADED alarm, /debug/status `parse` section;
+5. an 8-seed chaos storm on a json→kafka chain with the live
+   conservation ledger asserting residual == 0.
+"""
+
+import csv
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import native as nat
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+from loongcollector_tpu.ops.kernels import struct_index as si
+from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+from loongcollector_tpu.processor import parse_telemetry
+from loongcollector_tpu.processor.parse_delimiter import (
+    ProcessorParseDelimiter, _csv_fsm_split)
+from loongcollector_tpu.processor.parse_json import ProcessorParseJson
+from loongcollector_tpu.processor.split_log_string import \
+    ProcessorSplitLogString
+
+NATIVE = nat.get_lib() is not None
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean():
+    parse_telemetry.reset_for_testing()
+    AlarmManager.instance().flush()
+    yield
+    parse_telemetry.reset_for_testing()
+    AlarmManager.instance().flush()
+
+
+def pack(rows):
+    blob = b"".join(rows)
+    arena = np.frombuffer(blob, dtype=np.uint8) if blob \
+        else np.zeros(0, np.uint8)
+    lens = np.array([len(r) for r in rows], dtype=np.int32)
+    offs = np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64) \
+        if rows else np.zeros(0, np.int64)
+    return blob, arena, offs, lens
+
+
+def row_matrix(rows):
+    lens = np.array([len(r) for r in rows], dtype=np.int32)
+    L = max(1, int(lens.max()) if len(rows) else 1)
+    mat = np.zeros((len(rows), L), dtype=np.uint8)
+    for i, r in enumerate(rows):
+        mat[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+    return mat, lens, L
+
+
+def group_of(lines):
+    data = b"\n".join(lines) + b"\n"
+    sb = SourceBuffer(len(data) + 64)
+    g = PipelineEventGroup(sb)
+    g.add_raw_event(1).set_content(sb.copy_string(data))
+    sp = ProcessorSplitLogString()
+    sp.init({}, PluginContext("t"))
+    sp.process(g)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# 1. mask equivalence vs a brute-force reference
+
+
+def ref_masks(row: bytes, mode: str, sep: int = 0x2C):
+    """Bit-level reference: escaped = simdjson odd-run-END semantics (a
+    non-backslash byte preceded by an odd-length backslash run);
+    in-string = inclusive parity of unescaped quotes."""
+    n = len(row)
+    esc = [False] * n
+    if mode == si.MODE_JSON:
+        run = 0
+        for i, b in enumerate(row):
+            if b != 0x5C and run % 2 == 1:
+                esc[i] = True
+            run = run + 1 if b == 0x5C else 0
+    qreal = [row[i] == 0x22 and not esc[i] for i in range(n)]
+    s = []
+    par = 0
+    for i in range(n):
+        if qreal[i]:
+            par ^= 1
+        s.append(par == 1)
+    structset = set(b'{}[]:,') if mode == si.MODE_JSON else {sep}
+    st = [(row[i] in structset) and not s[i] for i in range(n)]
+
+    def pack16(bits):
+        W = (max(n, 1) + 15) // 16
+        w = [0] * W
+        for i, b in enumerate(bits):
+            if b:
+                w[i // 16] |= 1 << (i % 16)
+        return w
+
+    return [pack16(x) for x in (s, st, esc, qreal)]
+
+
+def adversarial_rows():
+    rows = [b'{"a": "b"}', b'', b'{}', b'\\"x', b'"unterm',
+            b'a,b,"c,d",e', b'"a""b",c',
+            b'{"k": "v\\nw", "n": [1, {"m": "x,y"}]}']
+    for k in range(1, 10):
+        rows.append(b'x' * (63 - k) + b'\\' * k + b'n"q"')
+        rows.append(b'{"e": "' + b'x' * (55 - k) + b'\\' * k + b'n"}')
+    rng = np.random.default_rng(21)
+    for _ in range(250):
+        L = int(rng.integers(0, 150))
+        rows.append(bytes(rng.choice(
+            list(b'ab\\",{}[]: \t'), size=L).astype(np.uint8)))
+    return rows
+
+
+class TestMaskEquivalence:
+    @pytest.mark.parametrize("mode", [si.MODE_JSON, si.MODE_DELIM])
+    def test_three_substrates_match_reference(self, mode):
+        rows = adversarial_rows()
+        mat, lens, L = row_matrix(rows)
+        np16 = si.struct_index_numpy(mat, lens, mode=mode)
+        kern = si.StructIndexKernel(mode=mode)
+        dv = [np.asarray(x) for x in kern(mat, lens)]
+        W16 = np16[0].shape[1]
+        native16 = None
+        if NATIVE:
+            blob, arena, offs, lens2 = pack(rows)
+            nm = nat.struct_index(
+                arena, offs, lens2,
+                mode=0 if mode == si.MODE_JSON else 1)
+            native16 = [si.native_masks_as_words16(m)[:, :W16] for m in nm]
+        for i, r in enumerate(rows):
+            ref = ref_masks(r, mode)
+            for mi, name in enumerate(
+                    ("in_string", "structural", "escaped", "quote")):
+                want = ref[mi]
+                got_np = list(np16[mi][i][: len(want)])
+                got_dv = list(dv[mi][i][: len(want)])
+                assert got_np == want, (name, i, r)
+                assert got_dv == want, (name, i, r)
+                if native16 is not None:
+                    got_nat = list(native16[mi][i][: len(want)])
+                    assert got_nat == want, (name, i, r)
+
+    def test_escape_carry_across_word_boundary(self):
+        """Backslash runs ending exactly at bit 63: the carry must mark
+        (or not mark) bit 0 of the next word by run parity."""
+        odd = b'x' * 63 + b'\\' + b'n'       # run of 1 ends at the boundary
+        even = b'x' * 62 + b'\\\\' + b'n'    # run of 2
+        mat, lens, L = row_matrix([odd, even])
+        _, _, esc, _ = si.struct_index_numpy(mat, lens, mode=si.MODE_JSON)
+        bits = si.unpack16(esc, L)
+        assert bits[0, 64] and not bits[1, 64]
+        if NATIVE:
+            blob, arena, offs, lens2 = pack([odd, even])
+            nm = nat.struct_index(arena, offs, lens2, mode=0)
+            assert int(nm[2][0, 1]) & 1 == 1
+            assert int(nm[2][1, 1]) & 1 == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. differential goldens
+
+
+JSON_GOLDEN_ROWS = [
+    b'{"ts": 1700000000, "level": "info", "user": "u1", "msg": "hi"}',
+    b'{"ts": 1, "level": "in\\nfo", "user": "u\\u00e9", "msg": "\\"q\\""}',
+    b'{"ts": 2, "level": "\\u4f60\\u597d", "user": "\\ud83d\\ude00",'
+    b' "msg": "\\\\net\\\\share"}',
+    b'{"ts": 3, "drifted_key": "boom", "level": "x"}',
+    b'{"nested": {"a": [1, 2, {"b": "c,{}"}]}, "ts": 4}',
+    b'{"ts": bad}', b'not json', b'{}', b'{"a": "unterminated',
+    b'{"dup": 1, "dup": 2}', b'{"a": true, "b": null, "c": false}',
+    b'{"e": "' + b'\\\\' * 33 + b'"}',
+    b'{"e": "' + b'x' * 55 + b'\\\\\\"' + b'"}',
+    b'{"sp" :  "v"  ,  "n" : -1.5e3  }',
+    b'{"a": 1} trailing', b'{"a": 01}', b'{"a"::1}',
+]
+
+
+def _parse_json_group(lines, pipeline="gold"):
+    g = group_of(lines)
+    pj = ProcessorParseJson()
+    pj.init({}, PluginContext(pipeline))
+    pj.process(g)
+    return [{str(k): str(v) for k, v in ev.contents if str(k) != "rawLog"}
+            for ev in g.events]
+
+
+def _assert_json_golden(got_rows, lines):
+    for i, r in enumerate(lines):
+        got = got_rows[i]
+        try:
+            obj = json.loads(r)
+            ok = isinstance(obj, dict)
+        except Exception:  # noqa: BLE001
+            ok = False
+        if not ok:
+            assert not got, (i, r, got)
+            continue
+        assert set(got) == {str(k) for k in obj}, (i, r, got)
+        for k, v in obj.items():
+            if isinstance(v, str):
+                assert got[k] == v, (i, r, k)
+            elif isinstance(v, bool):
+                assert got[k] == ("true" if v else "false")
+            elif v is None:
+                assert got[k] == "null"
+            elif isinstance(v, (dict, list)):
+                assert json.loads(got[k]) == v, (i, r, k)
+
+
+class TestJsonGoldens:
+    def test_struct_plane_matches_python_json(self):
+        lines = [r for r in JSON_GOLDEN_ROWS if b"\n" not in r]
+        _assert_json_golden(_parse_json_group(lines), lines)
+
+    def test_numpy_fallback_execution_matches(self, monkeypatch):
+        """Without the native library the processor runs the r09-style /
+        per-row tier — output must be identical."""
+        lines = [r for r in JSON_GOLDEN_ROWS if b"\n" not in r]
+        want = _parse_json_group(lines)
+        monkeypatch.setenv("LOONG_DISABLE_NATIVE", "1")
+        monkeypatch.setattr(nat, "_lib", None)
+        monkeypatch.setattr(nat, "_load_attempted", False)
+        try:
+            got = _parse_json_group(lines)
+        finally:
+            monkeypatch.setenv("LOONG_DISABLE_NATIVE", "")
+            monkeypatch.setattr(nat, "_lib", None)
+            monkeypatch.setattr(nat, "_load_attempted", False)
+
+        def norm(rows):
+            # numbers: the struct plane keeps raw source spelling, the
+            # fallback canonicalises via str() — the documented contract;
+            # compare them numerically, everything else byte-exact
+            out = []
+            for row in rows:
+                nr = {}
+                for k, v in row.items():
+                    try:
+                        nr[k] = float(v)
+                    except ValueError:
+                        nr[k] = v
+                out.append(nr)
+            return out
+
+        assert norm(got) == norm(want)
+
+    @pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+    def test_side_arena_appended_once_not_per_event(self):
+        """Escape-bearing rows stay columnar: decoded bytes land in ONE
+        side-arena append, and the group never materializes."""
+        from loongcollector_tpu import models as models_mod
+        lines = [b'{"m": "a\\n%d"}' % i for i in range(64)]
+        g = group_of(lines)
+        sb_size_before = g.source_buffer.size
+        models_mod.reset_churn_stats()
+        pj = ProcessorParseJson()
+        pj.init({}, PluginContext("side"))
+        pj.process(g)
+        churn = models_mod.churn_stats()
+        assert churn["materialized_events"] == 0
+        # decoded values live in the arena tail, one allocation's worth
+        cols = g.columns
+        offs, lens = cols.fields["m"]
+        assert (lens >= 0).all()
+        assert (offs >= sb_size_before).all()
+        vals = [bytes(g.source_buffer.raw[int(o): int(o) + int(ln)])
+                for o, ln in zip(offs, lens)]
+        assert vals == [b"a\n%d" % i for i in range(64)]
+
+    @pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+    def test_schema_drift_stays_columnar(self):
+        from loongcollector_tpu import models as models_mod
+        lines = [b'{"a": "x", "b": "y"}'] * 8 + \
+                [b'{"a": "x", "b": "y", "c%d": "z"}' % i for i in range(4)]
+        g = group_of(lines)
+        models_mod.reset_churn_stats()
+        pj = ProcessorParseJson()
+        pj.init({}, PluginContext("drift"))
+        pj.process(g)
+        assert models_mod.churn_stats()["materialized_events"] == 0
+        cols = g.columns
+        assert cols.parse_ok.all()
+        for i in range(4):
+            offs, lens = cols.fields["c%d" % i]
+            assert int(lens[8 + i]) == 1
+        st = parse_telemetry.status()
+        row = st["processor_parse_json_tpu/drift"]
+        assert row["drift_rows"] == 4 and row["fallback_rows"] == 0
+
+
+CSV_GOLDEN_ROWS = [
+    b'a,b,c', b'"a,b",c,d', b'"a""b",c,x', b'a"b,c"d,e', b'"x"tail,y,z',
+    b'"unterminated, z', b'', b',', b'a,,b', b'"",x,y', b'""a,b,c',
+    b'"a","b","c","d"', b'"dq""""x",y,w', b'p,q,r,s,extra1,extra2',
+]
+
+
+def _parse_delim_group(lines, keys=("k1", "k2", "k3"), pipeline="csv"):
+    g = group_of(lines)
+    pd = ProcessorParseDelimiter()
+    pd.init({"Keys": list(keys), "Mode": "quote"}, PluginContext(pipeline))
+    pd.process(g)
+    return [{str(k): str(v) for k, v in ev.contents if str(k) != "rawLog"}
+            for ev in g.events]
+
+
+class TestDelimiterGoldens:
+    def test_quote_mode_matches_fsm_and_csv(self):
+        got = _parse_delim_group(CSV_GOLDEN_ROWS)
+        for i, r in enumerate(CSV_GOLDEN_ROWS):
+            fields = _csv_fsm_split(r, b",")
+            if len(fields) < 3:
+                assert not got[i], (i, r, got[i])
+                continue
+            if len(fields) > 3:
+                fields = fields[:2] + [b",".join(fields[2:])]
+            want = {"k%d" % (j + 1): fields[j].decode("utf-8", "replace")
+                    for j in range(3)}
+            assert got[i] == want, (i, r)
+            # python csv agreement on RFC4180-clean rows
+            text = r.decode()
+            if '"' not in text.replace('","', ',').strip('"'):
+                try:
+                    pycsv = next(csv.reader(io.StringIO(text)))
+                except (csv.Error, StopIteration):
+                    continue
+                if len(pycsv) == len(_csv_fsm_split(r, b",")):
+                    merged = pycsv[:2] + [",".join(pycsv[2:])] \
+                        if len(pycsv) > 3 else pycsv
+                    assert [want["k%d" % (j + 1)] for j in range(3)] \
+                        == merged[:3], (i, r)
+
+    def test_numpy_tier_matches_native(self, monkeypatch):
+        want = _parse_delim_group(CSV_GOLDEN_ROWS)
+        monkeypatch.setenv("LOONG_DISABLE_NATIVE", "1")
+        monkeypatch.setattr(nat, "_lib", None)
+        monkeypatch.setattr(nat, "_load_attempted", False)
+        try:
+            got = _parse_delim_group(CSV_GOLDEN_ROWS)
+        finally:
+            monkeypatch.setenv("LOONG_DISABLE_NATIVE", "")
+            monkeypatch.setattr(nat, "_lib", None)
+            monkeypatch.setattr(nat, "_load_attempted", False)
+        assert got == want
+
+    @pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+    def test_well_formed_input_zero_per_row_python(self):
+        """Clean quote-mode CSV through the native plane: zero fallback
+        rows counted, zero per-event materialization."""
+        from loongcollector_tpu import models as models_mod
+        lines = [b'srv%d,"us-east,1a",GET,"p%d"' % (i % 9, i)
+                 for i in range(256)]
+        g = group_of(lines)
+        models_mod.reset_churn_stats()
+        pd = ProcessorParseDelimiter()
+        pd.init({"Keys": ["a", "b", "c", "d"], "Mode": "quote"},
+                PluginContext("clean"))
+        pd.process(g)
+        assert models_mod.churn_stats()["materialized_events"] == 0
+        assert g.columns.parse_ok.all()
+        st = parse_telemetry.status()
+        assert st["processor_parse_delimiter_tpu/clean"][
+            "fallback_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. device: one dispatch per batch
+
+
+class TestDeviceSingleDispatch:
+    def test_index_batch_is_one_kernel_invocation(self):
+        lines = [b'{"a": "v%d", "n": %d}' % (i, i) for i in range(128)]
+        blob, arena, offs, lens = pack(lines)
+        kern = si.StructIndexKernel(mode=si.MODE_JSON)
+        out = kern.index_batch(arena, offs, lens)
+        assert out is not None
+        masks, L = out
+        assert kern.dispatch_count == 1, (
+            "a batch structural index must be ONE device dispatch")
+        assert masks[0].shape[0] == len(lines)
+        # and the dispatched masks equal the numpy twin's
+        mat = np.zeros((len(lines), L), dtype=np.uint8)
+        for i, r in enumerate(lines):
+            mat[i, : len(r)] = np.frombuffer(r, dtype=np.uint8)
+        np16 = si.struct_index_numpy(mat, lens, mode=si.MODE_JSON)
+        for a, b in zip(masks, np16):
+            assert np.array_equal(np.asarray(a), b)
+
+
+# ---------------------------------------------------------------------------
+# 4. fallback observability
+
+
+class TestFallbackObservability:
+    @pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+    def test_counters_and_one_shot_alarm(self, monkeypatch):
+        monkeypatch.setattr(parse_telemetry, "MIN_ROWS", 64)
+        good = b'{"a": "x", "b": 1}'
+        bad = b'{"a": broken'
+        lines = [good if i % 2 else bad for i in range(128)]
+        g = group_of(lines)
+        pj = ProcessorParseJson()
+        pj.init({}, PluginContext("storm-pipe"))
+        pj.process(g)
+        st = parse_telemetry.status()
+        row = st["processor_parse_json_tpu/storm-pipe"]
+        assert row["rows"] == 128
+        assert row["fallback_rows"] == 64
+        assert row["degraded"] is True
+        alarms = [a for a in AlarmManager.instance().flush()
+                  if a["alarm_type"]
+                  == AlarmType.PARSE_FALLBACK_DEGRADED.value]
+        assert len(alarms) == 1
+        assert alarms[0]["pipeline"] == "storm-pipe"
+        assert "processor_parse_json_tpu" in alarms[0]["alarm_message"]
+        # one-shot: a second degraded group must not re-alarm
+        g2 = group_of(lines)
+        pj.process(g2)
+        assert not [a for a in AlarmManager.instance().flush()
+                    if a["alarm_type"]
+                    == AlarmType.PARSE_FALLBACK_DEGRADED.value]
+
+    def test_status_page_section(self):
+        parse_telemetry.note_rows("processor_parse_json_tpu", "p1", 100, 3)
+        from loongcollector_tpu.monitor.exposition import collect_status
+        doc = collect_status()
+        assert "parse" in doc
+        row = doc["parse"]["processor_parse_json_tpu/p1"]
+        assert row == {"rows": 100, "fallback_rows": 3, "drift_rows": 0,
+                       "degraded": False}
+
+
+# ---------------------------------------------------------------------------
+# 5. equivalence gate (the scripts/struct_equivalence.py contract, run
+#    in-process on every tier-1 invocation)
+
+
+class TestEquivalenceGate:
+    def test_gate_passes(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "struct_equivalence",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts",
+                "struct_equivalence.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# 6. 8-seed chaos storm: json → kafka with the live ledger
+
+
+STORM_SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240804)
+
+
+def _drive_json_kafka_storm(seed, broker_port, n_groups=6, rows_per=16):
+    from loongcollector_tpu import chaos
+    from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+    from loongcollector_tpu.monitor import ledger
+    from loongcollector_tpu.pipeline.pipeline_manager import (
+        CollectionPipelineManager, ConfigDiff)
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.queue.sender_queue import \
+        SenderQueueManager
+    from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+
+    ledger.enable()
+    ledger.reset()
+    pqm = ProcessQueueManager()
+    mgr = CollectionPipelineManager(pqm, SenderQueueManager())
+    runner = ProcessorRunner(pqm, mgr, thread_count=2)
+    runner.init()
+    name = f"jk{seed}"
+    diff = ConfigDiff()
+    diff.added[name] = {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": 64},
+        "processors": [{"Type": "processor_parse_json_tpu"}],
+        "flushers": [{"Type": "flusher_kafka",
+                      "Brokers": [f"127.0.0.1:{broker_port}"],
+                      "Topic": "logs", "MinCnt": 4, "MinSizeBytes": 1,
+                      "MaxRetries": 8}],
+    }
+    mgr.update_pipelines(diff)
+    p = mgr.find_pipeline(name)
+    total = 0
+    try:
+        chaos.install(ChaosPlan(seed, {
+            "kafka_client.produce": FaultSpec(
+                prob=0.4, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+                delay_range=(0.001, 0.004), max_faults=10)}))
+        for gi in range(n_groups):
+            lines = b"\n".join(
+                b'{"seq": %d, "msg": "m\\n%d", "src": "s%d"}'
+                % (gi * rows_per + j, j, seed)
+                for j in range(rows_per)) + b"\n"
+            sb = SourceBuffer(len(lines) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(lines))
+            deadline = time.monotonic() + 20
+            while not pqm.push_queue(p.process_queue_key, g):
+                assert time.monotonic() < deadline
+                time.sleep(0.002)
+            total += rows_per
+        snap = ledger.assert_conserved(timeout=45,
+                                       label=f"seed {seed} json→kafka")
+        row = snap[name]
+        assert row[ledger.B_SEND_OK]["events"] == total
+        assert ledger.B_DROP not in row
+        assert ledger.residual_of(row) == 0
+    finally:
+        chaos.uninstall()
+        runner.stop()
+        mgr.stop_all()
+        ledger.disable()
+    return total
+
+
+@pytest.mark.parametrize("seed", STORM_SEEDS)
+def test_json_kafka_storm_conserves(seed):
+    from test_kafka import FakeBroker
+    broker = FakeBroker()
+    broker.start()
+    try:
+        total = _drive_json_kafka_storm(seed, broker.port)
+        assert total > 0
+        assert len(broker.produced) > 0
+    finally:
+        broker.stop()
