@@ -1424,6 +1424,219 @@ def bench_streaming(n_chunks=24):
         ds.reset_for_testing()
 
 
+def _agg_corpus(n_rows, n_keys, seed=5, emit_ts=True):
+    """Vectorised metric-batch builder: fixed-width name/host/value spans
+    in a row-major arena (the value grammar trims the space padding), so
+    corpus generation never bottlenecks the measurement.  Returns
+    (groups, bytes_total, row_tuples or None) — row_tuples feed the dict
+    path and the value-identity check."""
+    import numpy as np
+
+    from loongcollector_tpu.models import (ColumnarLogs,
+                                           PipelineEventGroup, SourceBuffer)
+    rng = np.random.default_rng(seed)
+    name_tbl = np.frombuffer(
+        b"".join(b"metric_%07d" % i for i in range(n_keys)),
+        dtype=np.uint8).reshape(n_keys, 14)
+    hosts = [b"host-a", b"host-b", b"host-c", b"host-d"]
+    host_tbl = np.frombuffer(b"".join(hosts), dtype=np.uint8).reshape(
+        len(hosts), 6)
+    vals = [b"1    ", b"2.5  ", b"17   ", b"0.125", b"300  ", b"-4   "]
+    val_tbl = np.frombuffer(b"".join(vals), dtype=np.uint8).reshape(
+        len(vals), 5)
+    W = 14 + 6 + 5
+    groups = []
+    rows_out = [] if n_rows <= 300000 else None
+    batch = 16384
+    bytes_total = 0
+    for start in range(0, n_rows, batch):
+        n = min(batch, n_rows - start)
+        kid = rng.integers(n_keys, size=n)
+        hid = rng.integers(len(hosts), size=n)
+        vid = rng.integers(len(vals), size=n)
+        arena = np.concatenate(
+            [name_tbl[kid], host_tbl[hid], val_tbl[vid]],
+            axis=1).reshape(-1).copy()
+        base = np.arange(n, dtype=np.int32) * W
+        ts = (1 + start // 32768) if emit_ts else 1
+        cols = ColumnarLogs(base, np.zeros(n, np.int32),
+                            np.full(n, ts, np.int64))
+        cols.content_consumed = True
+        cols.set_field("__name__", base, np.full(n, 14, np.int32))
+        cols.set_field("host", base + 14, np.full(n, 6, np.int32))
+        cols.set_field("value", base + 20, np.full(n, 5, np.int32))
+        sb = SourceBuffer(len(arena))
+        off0 = sb.allocate(len(arena))
+        sb.write_at(off0, arena.tobytes())
+        g = PipelineEventGroup(sb)
+        g.set_columns(cols)
+        groups.append(g)
+        bytes_total += len(arena)
+        if rows_out is not None:
+            nb = name_tbl[kid]
+            hb = host_tbl[hid]
+            vb = val_tbl[vid]
+            for i in range(n):
+                rows_out.append((nb[i].tobytes(), hb[i].tobytes(),
+                                 vb[i].tobytes(), ts))
+    return groups, bytes_total, rows_out
+
+
+def _agg_rows_digest(groups):
+    """Order-independent digest of emitted rollup rows (field name +
+    bytes per cell) — the value-identity instrument across paths."""
+    import hashlib
+    total = 0
+    n = 0
+    for g in groups:
+        cols = g.columns
+        raw = g.source_buffer.raw
+        names = sorted(cols.fields)
+        for r in range(len(cols)):
+            h = hashlib.sha256()
+            for f in names:
+                o, ln = cols.fields[f]
+                h.update(f.encode() + b"\0")
+                if ln[r] >= 0:
+                    h.update(bytes(raw[int(o[r]):int(o[r]) + int(ln[r])]))
+                h.update(b"\1")
+            total += int.from_bytes(h.digest()[:8], "little")
+            total &= (1 << 64) - 1
+            n += 1
+    return total, n
+
+
+def _agg_drive(groups, substrate, n_keys, histogram=True, track_close=None):
+    from loongcollector_tpu.aggregator.metric_rollup import \
+        AggregatorMetricRollup
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    agg = AggregatorMetricRollup()
+    assert agg.init({"WindowSecs": 2, "LabelKeys": ["host"],
+                     "Substrate": substrate, "MaxKeys": max(n_keys * 8, 64),
+                     "EmitHistogram": histogram},
+                    PluginContext("bench-agg"))
+    emitted = []
+    t0 = time.perf_counter()
+    for g in groups:
+        ta = time.perf_counter()
+        out = agg.add(g)
+        if out:
+            emitted.extend(out)
+            if track_close is not None:
+                track_close.append(
+                    {"at_s": round(time.perf_counter() - t0, 3),
+                     "close_ms": round(
+                         (time.perf_counter() - ta) * 1000, 3),
+                     "rollup_rows": sum(len(x) for x in out)})
+    emitted.extend(agg.flush())
+    dt = time.perf_counter() - t0
+    agg.metrics.mark_deleted()
+    return emitted, dt
+
+
+def bench_aggregation(n_rows=200000, n_keys=64):
+    """loongagg: the columnar windowed rollup fold vs the per-event dict
+    baseline, same host, same rows (docs/performance.md "Windowed
+    aggregation").  Measures the aggregation stage itself (groups built
+    outside the timed window): add() folds + watermark window closes +
+    emission.  In-bench asserts: all substrates emit the same rollups
+    (digest over every cell; device compared on the exact columns), the
+    dict path is VALUE-IDENTICAL to the columnar path, and the native
+    plane is >= 20x the dict baseline (SystemExit on a miss — the r11
+    acceptance line)."""
+    import numpy as np
+
+    from loongcollector_tpu.aggregator.metric_rollup import \
+        AggregatorMetricRollup
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.native import get_lib
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+
+    groups, bytes_total, rows = _agg_corpus(n_rows, n_keys)
+    res = {"rows": n_rows, "keys": n_keys, "bytes": bytes_total}
+    have_native = get_lib() is not None
+
+    closes = []
+    substr = {}
+    digests = {}
+    for sub in (["native"] if have_native else []) + ["numpy", "device"]:
+        emitted, dt = _agg_drive(
+            groups, sub, n_keys,
+            track_close=closes if sub in ("native", "numpy") and not closes
+            else None)
+        substr[sub] = round(bytes_total / dt / 1e6, 1)
+        digests[sub] = _agg_rows_digest(emitted)
+    base_sub = "native" if have_native else "numpy"
+    if digests.get("native") is not None and \
+            "numpy" in digests and have_native:
+        if digests["native"] != digests["numpy"]:
+            raise SystemExit("agg bench: native and numpy rollups differ")
+    # device sums are f32: row counts must match, cell digest may differ
+    if digests["device"][1] != digests[base_sub][1]:
+        raise SystemExit("agg bench: device rollup row count differs")
+    res["substrates_MBps"] = substr
+    res["substrates_value_identical"] = (
+        digests.get("native") == digests.get("numpy")
+        if have_native else True)
+    res["window_close_trajectory"] = closes[:24]
+
+    # -- per-event dict baseline (same logical rows, materialized) -------
+    # whole batches only: the identity re-generation below must replay
+    # the exact same per-batch rng draws
+    dict_rows = rows[:3 * 16384]
+    dict_groups = []
+    for lo in range(0, len(dict_rows), 4096):
+        sb = SourceBuffer(4096)
+        g = PipelineEventGroup(sb)
+        for nm, h, v, ts in dict_rows[lo:lo + 4096]:
+            ev = g.add_log_event(ts)
+            ev.set_content(b"__name__", sb.copy_string(nm))
+            ev.set_content(b"host", sb.copy_string(h))
+            ev.set_content(b"value", sb.copy_string(v))
+        dict_groups.append(g)
+    dict_bytes = len(dict_rows) * 25
+    emitted_d, dt_d = _agg_drive(dict_groups, "numpy", n_keys)
+    dict_mbps = dict_bytes / dt_d / 1e6
+    res["dict_path_MBps"] = round(dict_mbps, 1)
+
+    # value identity: columnar over the SAME 50k prefix == dict path
+    prefix_groups, _pb, _pr = _agg_corpus(len(dict_rows), n_keys)
+    emitted_c, _ = _agg_drive(prefix_groups, base_sub, n_keys)
+    if _agg_rows_digest(emitted_c) != _agg_rows_digest(emitted_d):
+        raise SystemExit(
+            "agg bench: columnar vs dict rollups are not value-identical")
+    res["columnar_vs_dict_value_identical"] = True
+    headline = substr[base_sub]
+    res["speedup_vs_dict"] = round(headline / max(dict_mbps, 1e-9), 1)
+    if have_native and headline < 20 * dict_mbps:
+        raise SystemExit(
+            f"agg bench: native rollup {headline} MB/s is under 20x the "
+            f"dict baseline {dict_mbps:.1f} MB/s")
+
+    # -- key-cardinality sweep (fold cost vs distinct keys) --------------
+    sweep = []
+    for K, nr in ((100, 200000), (10000, 200000), (1000000, 1000000)):
+        sgroups, sbytes, _ = _agg_corpus(nr, K, seed=K, emit_ts=False)
+        t0 = time.perf_counter()
+        agg = AggregatorMetricRollup()
+        assert agg.init({"WindowSecs": 10, "LabelKeys": ["host"],
+                         "Substrate": base_sub, "MaxKeys": 8 * K,
+                         "EmitHistogram": False},
+                        PluginContext("bench-agg-sweep"))
+        for g in sgroups:
+            agg.add(g)
+        dt = time.perf_counter() - t0
+        open_keys = agg.open_window_rows()
+        agg.flush()
+        agg.metrics.mark_deleted()
+        sweep.append({"keys": K, "rows": nr,
+                      "MBps": round(sbytes / dt / 1e6, 1),
+                      "Mrows_per_s": round(nr / dt / 1e6, 2),
+                      "open_keys": open_keys})
+    res["cardinality_sweep"] = sweep
+    return headline, res
+
+
 def bench_resource():
     """CPU% / RSS at 10 MB/s, the reference's regression-harness metric
     (BASELINE.md: 3.4 % CPU / 29 MB simple, 14.2 % / 34 MB regex).  Runs
@@ -1593,6 +1806,14 @@ def main():
     fusion = _safe(bench_fusion, default=None)
     if fusion is not None:
         extra["fusion"] = fusion
+    # loongagg: columnar windowed rollups — native fold headline (>=20x
+    # the per-event dict baseline asserted in-bench, value-identical by
+    # digest), substrate side-by-side, key-cardinality sweep and the
+    # window-close latency trajectory (docs/performance.md)
+    agg_res = _safe(bench_aggregation, default=None)
+    if isinstance(agg_res, tuple):
+        extra["metric_rollup_MBps"] = round(agg_res[0], 1)
+        extra["aggregation"] = agg_res[1]
     # loongmesh: the chips=1/2/4/8 e2e sweep next to the thread sweep —
     # lane-mode scaling efficiency, per-chip padding, one full-mesh point.
     # Runs after streaming (both reset the stream plane on exit) so its

@@ -22,3 +22,7 @@ def register_all(registry) -> None:
     registry.register_aggregator("aggregator_skywalking",
                                  AggregatorSkywalking)
     registry.register_aggregator("aggregator_default", AggregatorBase)
+
+    from .metric_rollup import AggregatorMetricRollup
+    registry.register_aggregator("aggregator_metric_rollup",
+                                 AggregatorMetricRollup)

@@ -45,6 +45,28 @@ class _Bucket:
         self.born = time.monotonic()
 
 
+_agg_metrics = None
+_agg_metrics_lock = threading.Lock()
+
+
+def _bucket_metrics():
+    """Process-lifetime aggregator-stage counters (module-level record by
+    design, like the chaos plane's): every bucket retirement path —
+    MaxLogCount completion, arena rotation, timeout flush — is counted,
+    which is what the ``unbounded-window`` loonglint rule requires of any
+    window state in this package.  Locked lazy init: add() runs on
+    multiple processor threads, and a racing double-construct would
+    register an orphaned record with WriteMetrics forever."""
+    global _agg_metrics
+    if _agg_metrics is None:
+        with _agg_metrics_lock:
+            if _agg_metrics is None:
+                from ..monitor.metrics import MetricsRecord
+                _agg_metrics = MetricsRecord(
+                    category="agent", labels={"component": "aggregator"})
+    return _agg_metrics
+
+
 class AggregatorBase(Aggregator):
     """Pack events into groups capped at MaxLogCount, keyed by topic tag
     (reference plugins/aggregator/baseagg: MaxLogCount=1024 per group)."""
@@ -109,6 +131,9 @@ class AggregatorBase(Aggregator):
                 if b.count >= self.max_count:
                     done.append(b.group)
                     del self._buckets[key]
+        if done:
+            _bucket_metrics().counter(
+                "agg_bucket_completions_total").add(len(done))
         return done
 
     def flush(self) -> List[PipelineEventGroup]:
@@ -128,6 +153,9 @@ class AggregatorBase(Aggregator):
                 if b.count and now - b.born >= self.timeout_s:
                     out.append(b.group)
                     del self._buckets[key]
+        if out:
+            _bucket_metrics().counter(
+                "agg_bucket_timeout_flushes_total").add(len(out))
         return out
 
 

@@ -14,6 +14,7 @@ from .per_row_parse import PerRowParseChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
+from .unbounded_window import UnboundedWindowChecker
 from .unledgered_drop import UnledgeredDropChecker
 
 _CHECKER_CLASSES = [
@@ -26,6 +27,7 @@ _CHECKER_CLASSES = [
     MetricNamingChecker,
     HotPathMaterializeChecker,
     PerRowParseChecker,
+    UnboundedWindowChecker,
 ]
 
 

@@ -1,0 +1,160 @@
+"""unbounded-window: aggregator window state must be bounded AND counted.
+
+The loongagg contract (docs/static_analysis.md#unbounded-window): any
+dict/map held as WINDOW STATE by a class in ``aggregator/`` accumulates
+one entry per distinct key — at production metric cardinalities that is
+an unbounded heap unless the class (a) evicts under a cap or TTL and
+(b) counts what it evicts.  A windowed aggregator that silently grows is
+the classic slow-OOM; one that evicts silently is the classic silent
+data-skew.  Both halves are therefore required, statically:
+
+For every ``self.<attr> = {}`` assignment in a class defined under
+``aggregator/``, the SAME class must contain all three of:
+
+  1. an **eviction site** on that attribute — ``del self.<attr>[...]``,
+     ``self.<attr>.pop(...)`` or ``self.<attr>.clear()``;
+  2. a **bound comparison** — any comparison referencing a name/attribute
+     whose (lowercased) name mentions a cap or TTL vocabulary token
+     (``max``/``cap``/``ttl``/``timeout``/``lateness``) — the evidence
+     that eviction is driven by a limit, not an incidental delete;
+  3. a **counted metric** — a ``....add(...)`` call whose receiver is a
+     ``.counter(...)`` registration or a ``self._m_*`` /
+     ``*counter*``-named attribute (the repo's two counter idioms), so
+     every eviction/rotation is visible in /metrics.
+
+Escape: ``# loonglint: disable=unbounded-window`` with a justification,
+for dicts that are not keyed by event-derived values (config tables,
+substrate caches with their own bounds elsewhere).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, attr_tail
+
+CHECK = "unbounded-window"
+
+_SCOPE = "/aggregator/"
+_BOUND_TOKENS = ("max", "cap", "ttl", "timeout", "lateness")
+_EVICT_TAILS = {"pop", "clear", "popitem"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'attr' for a `self.attr` expression, else ''."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _dict_state_attrs(cls: ast.ClassDef) -> List[Tuple[str, int, int]]:
+    """(attr, line, col) for every `self.X = {}` / `self.X: T = {}`
+    assignment anywhere in the class body (methods included)."""
+    out = []
+    seen = set()
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        if not isinstance(value, (ast.Dict,)) or value.keys:
+            continue
+        for t in targets:
+            attr = _self_attr(t)
+            if attr and attr not in seen:
+                seen.add(attr)
+                out.append((attr, node.lineno, node.col_offset))
+    return out
+
+
+def _has_evict_site(cls: ast.ClassDef, attr: str) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        _self_attr(t.value) == attr:
+                    return True
+        elif isinstance(node, ast.Call) and \
+                attr_tail(node) in _EVICT_TAILS and \
+                isinstance(node.func, ast.Attribute) and \
+                _self_attr(node.func.value) == attr:
+            return True
+    return False
+
+
+def _has_bound_compare(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Compare):
+            continue
+        for sub in ast.walk(node):
+            name = ""
+            if isinstance(sub, ast.Attribute):
+                name = sub.attr
+            elif isinstance(sub, ast.Name):
+                name = sub.id
+            low = name.lower()
+            if any(tok in low for tok in _BOUND_TOKENS):
+                return True
+    return False
+
+
+def _has_counter_add(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call) or attr_tail(node) != "add":
+            continue
+        recv = node.func.value if isinstance(node.func, ast.Attribute) \
+            else None
+        if recv is None:
+            continue
+        # metrics.counter("...").add(n)
+        if isinstance(recv, ast.Call) and attr_tail(recv) == "counter":
+            return True
+        # self._m_evicted.add(1) / self.evict_counter.add(1)
+        rname = _self_attr(recv) or (recv.attr if isinstance(
+            recv, ast.Attribute) else "")
+        if rname.startswith("_m_") or "counter" in rname.lower():
+            return True
+    return False
+
+
+class UnboundedWindowChecker(Checker):
+    name = CHECK
+    description = ("dict window state in aggregator/ must have cap/TTL "
+                   "eviction wired to a counted metric (slow-OOM and "
+                   "silent-skew are both findings)")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        relpath = "/" + mod.relpath
+        if _SCOPE not in relpath:
+            return
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attrs = _dict_state_attrs(cls)
+            if not attrs:
+                continue
+            bound = _has_bound_compare(cls)
+            counted = _has_counter_add(cls)
+            for attr, line, col in attrs:
+                missing = []
+                if not _has_evict_site(cls, attr):
+                    missing.append("an eviction site (del/pop/clear)")
+                if not bound:
+                    missing.append("a cap/TTL bound comparison")
+                if not counted:
+                    missing.append("a counted metric (.counter(...).add)")
+                if missing:
+                    yield Finding(
+                        CHECK, mod.relpath, line, col,
+                        f"dict window state self.{attr} in aggregator "
+                        f"class {cls.name} is missing "
+                        + " and ".join(missing)
+                        + ": unbounded key cardinality is a slow OOM, "
+                        "uncounted eviction is silent data skew",
+                        symbol=f"{cls.name}.{attr}")

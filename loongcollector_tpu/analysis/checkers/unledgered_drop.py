@@ -5,8 +5,10 @@ is that every event admitted at `ingest` leaves through a counted exit —
 ``send_ok``, ``spill``, ``quarantine``, ``process_drop`` or a reason-tagged
 ``drop``.  The ConservationAuditor enforces that at runtime; this checker
 is the static half of the same contract: a code path in the event-carrying
-scopes (``runner/``, ``flusher/``, ``input/`` and the hand-off queues in
-``pipeline/queue/``) that discards an event group without any ledger
+scopes (``runner/``, ``flusher/``, ``input/``, the hand-off queues in
+``pipeline/queue/``, and — since loongagg made the aggregator stage a
+counted N→M contraction — ``aggregator/``) that discards an event group
+without any ledger
 awareness in its function would show up, at runtime, as a nonzero residual
 with no reason bucket — the exact silent loss the ledger exists to rule
 out.
@@ -44,7 +46,8 @@ from ..core import Checker, Finding, ModuleInfo, attr_tail, call_name
 
 CHECK = "unledgered-drop"
 
-_SCOPES = ("/runner/", "/flusher/", "/input/", "/pipeline/queue/")
+_SCOPES = ("/runner/", "/flusher/", "/input/", "/pipeline/queue/",
+           "/aggregator/")
 _LOG_TAILS = {"debug", "info", "warning", "error", "exception", "critical",
               "send_alarm"}
 _DROP_WORDS = ("drop", "discard", "quarantin", "shed")

@@ -61,9 +61,18 @@ def encode_write_request(series: List[Tuple[List[Tuple[bytes, bytes]],
     return bytes(out)
 
 
+#: rollup columns that are NOT label columns in the remote-write mapping
+_AGG_VALUE_FIELDS = {"sum", "count", "min", "max", "last"}
+_AGG_META_FIELDS = _AGG_VALUE_FIELDS | {"hist", "window_start",
+                                        "window_end"}
+
+
 class FlusherPrometheus(HttpSinkFlusher):
     name = "flusher_prometheus"
     content_type = "application/x-protobuf"
+    #: loongagg: rollup groups arrive as span columns and serialize
+    #: straight into the WriteRequest — no per-event materialization
+    supports_columnar = True
 
     def _init_sink(self, config: Dict[str, Any]) -> bool:
         self.endpoint = config.get("Endpoint", "")
@@ -85,10 +94,106 @@ class FlusherPrometheus(HttpSinkFlusher):
             self.compressor = Compressor()
         return ok
 
+    def _columnar_series(self, g: PipelineEventGroup, series: list) -> bool:
+        """loongagg rollup groups: one sample per aggregate column per
+        row, named ``<metric>_sum`` / ``_count`` / ``_min`` / ``_max`` /
+        ``_last`` (the remote-write shape of a windowed rollup), labels
+        read as spans from the columnar arena.  Returns False when the
+        group is not a rollup (the caller falls back to the per-event
+        route).  Gated on the ``__rollup__`` tag the aggregator stamps —
+        shape-sniffing field names would misserialize ordinary columnar
+        log groups whose parsed fields happen to be called "count"."""
+        if g.get_tag(b"__rollup__") is None:
+            return False
+        cols = g.columns
+        if cols is None or g._events:
+            return self._rollup_series_from_events(g, series)
+        fields = cols.fields
+        name_pair = None
+        label_cols = []
+        agg_cols = []
+        for fname, pair in fields.items():
+            key = fname if isinstance(fname, str) else fname.decode(
+                "utf-8", "replace")
+            if key == "__name__":
+                name_pair = pair
+            elif key in _AGG_VALUE_FIELDS:
+                agg_cols.append((("_" + key).encode(), pair))
+            elif key not in _AGG_META_FIELDS:
+                label_cols.append((key.encode(), pair))
+        if name_pair is None or not agg_cols:
+            return False
+        raw = g.source_buffer.raw
+
+        def span(pair, r):
+            off, ln = int(pair[0][r]), int(pair[1][r])
+            if ln < 0:
+                return None
+            return bytes(raw[off:off + ln])
+
+        ts = cols.timestamps
+        for r in range(len(cols)):
+            name = span(name_pair, r)
+            if name is None:
+                continue
+            base = []
+            for lk, pair in label_cols:
+                lv = span(pair, r)
+                if lv is not None:
+                    base.append((lk, lv))
+            ts_ms = int(ts[r]) * 1000
+            for suffix, pair in agg_cols:
+                sv = span(pair, r)
+                if sv is None:
+                    continue
+                try:
+                    value = float(sv)
+                except ValueError:
+                    continue
+                series.append(([(b"__name__", name + suffix)] + base,
+                               value, ts_ms))
+        return True
+
+    def _rollup_series_from_events(self, g: PipelineEventGroup,
+                                   series: list) -> bool:
+        """Dict-mode route for the same rollup groups: the sink boundary
+        materialized the rows into LogEvents (``LOONG_COLUMNAR=0``), so
+        the per-event MetricEvent route would silently discard them —
+        read the rollup contents off the LogEvents instead."""
+        from ..models import LogEvent
+        for ev in g.events:
+            if not isinstance(ev, LogEvent):
+                continue
+            name = ev.get_content(b"__name__")
+            if name is None:
+                continue
+            name = bytes(name)
+            base = []
+            agg_vals = []
+            for k, v in ev.contents:
+                kb = bytes(k)
+                key = kb.decode("utf-8", "replace")
+                if kb == b"__name__":
+                    continue
+                if key in _AGG_VALUE_FIELDS:
+                    try:
+                        agg_vals.append((b"_" + kb, float(bytes(v))))
+                    except ValueError:
+                        continue
+                elif key not in _AGG_META_FIELDS:
+                    base.append((kb, bytes(v)))
+            ts_ms = int(ev.timestamp) * 1000
+            for suffix, value in agg_vals:
+                series.append(([(b"__name__", name + suffix)] + base,
+                               value, ts_ms))
+        return True
+
     def build_payload(self, groups: List[PipelineEventGroup]
                       ) -> Optional[Tuple[bytes, Dict[str, str]]]:
         series = []
         for g in groups:
+            if self._columnar_series(g, series):
+                continue
             for ev in g.events:
                 if not isinstance(ev, MetricEvent):
                     continue

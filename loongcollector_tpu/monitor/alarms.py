@@ -109,6 +109,10 @@ class AlarmType(str, enum.Enum):
     # loongledger: a quiesced conservation snapshot balanced to nonzero —
     # an event crossed into the agent and left without a ledgered exit
     CONSERVATION_RESIDUAL = "CONSERVATION_RESIDUAL_ALARM"
+    # loongagg: the rollup key population hit its cardinality cap and
+    # partials are being evicted (emitted early) — rollup windows for the
+    # evicted keys are split, not lost
+    AGG_WINDOW_EVICTION = "AGG_WINDOW_EVICTION_ALARM"
 
 
 class _AlarmRecord:

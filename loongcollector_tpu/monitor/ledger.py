@@ -43,6 +43,11 @@ Boundary catalogue (docs/observability.md#event-conservation-ledger):
   fanout               extra copies minted when the router matches more
                        than one flusher
   drop                 explicit terminal discard, reason-tagged
+  agg_in / agg_fold /  the loongagg windowed rollup contraction: rows in,
+  agg_emit             rows consumed by the fold (sink), rollup rows
+                       minted at window close (source); open windows are
+                       live occupancy via the aggregator's
+                       open_window_rows probe
 
 Chaos-plane idiom: the ledger is OFF by default and every hook is one
 module-global read (``ledger.is_on()``) + branch — gated at <=5% by
@@ -83,15 +88,28 @@ B_REPLAY = "replay"
 B_QUARANTINE = "quarantine"
 B_FANOUT = "fanout"
 B_DROP = "drop"
+# loongagg: the windowed fold is an N→M contraction with its own counted,
+# attributed boundaries — agg_in (rows entering the rollup aggregator,
+# informational like process_in), agg_fold (rows CONSUMED by the fold: a
+# residual sink — the events are accounted for, their content now lives
+# in open-window partials the auditor counts as live occupancy), agg_emit
+# (rollup rows MINTED at window close: a residual source that then flows
+# to the normal serialize/send_ok exits)
+B_AGG_IN = "agg_in"
+B_AGG_FOLD = "agg_fold"
+B_AGG_EMIT = "agg_emit"
 
 BOUNDARIES = (B_INGEST, B_ENQUEUE, B_DEQUEUE, B_PROCESS_IN, B_PROCESS_OUT,
               B_PROCESS_DROP, B_PROCESS_EXPAND, B_DEVICE_SUBMIT,
               B_DEVICE_MATERIALIZE, B_SERIALIZE, B_SEND_OK, B_SEND_FAIL,
-              B_SPILL, B_REPLAY, B_QUARANTINE, B_FANOUT, B_DROP)
+              B_SPILL, B_REPLAY, B_QUARANTINE, B_FANOUT, B_DROP,
+              B_AGG_IN, B_AGG_FOLD, B_AGG_EMIT)
 
 #: residual = sum(sources) - sum(sinks) - inflight
-SOURCE_BOUNDARIES = (B_INGEST, B_PROCESS_EXPAND, B_FANOUT, B_REPLAY)
-SINK_BOUNDARIES = (B_SEND_OK, B_PROCESS_DROP, B_SPILL, B_QUARANTINE, B_DROP)
+SOURCE_BOUNDARIES = (B_INGEST, B_PROCESS_EXPAND, B_FANOUT, B_REPLAY,
+                     B_AGG_EMIT)
+SINK_BOUNDARIES = (B_SEND_OK, B_PROCESS_DROP, B_SPILL, B_QUARANTINE, B_DROP,
+                   B_AGG_FOLD)
 
 
 class EventLedger:
@@ -259,6 +277,12 @@ def live_inflight() -> Optional[int]:
                     if q is not None:
                         total += q.size()
                 total += p._in_process_cnt
+                agg_probe = getattr(p.aggregator, "open_window_rows", None)
+                if agg_probe is not None:
+                    # loongagg: open-window partials are pending rollup
+                    # rows — occupancy, so the audit defers until the
+                    # windows flush (drain force-closes them)
+                    total += int(agg_probe())
                 for f in p.flushers:
                     probe = getattr(f.plugin, "inflight_events", None)
                     if probe is not None:

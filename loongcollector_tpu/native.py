@@ -63,7 +63,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
         if not hasattr(lib, "lct_t1_exec") \
                 or not hasattr(lib, "lct_ndjson_serialize") \
-                or not hasattr(lib, "lct_struct_index"):
+                or not hasattr(lib, "lct_struct_index") \
+                or not hasattr(lib, "lct_group_reduce"):
             # stale build predating the newest entry point: rebuild + reload
             if _try_build():
                 try:
@@ -119,6 +120,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 u8p, i32p, ctypes.c_int64, i32p, i32p, u8p,
                 u8p, ctypes.c_int64,
                 i32p, i32p, i32p, i32p, i32p, ctypes.c_int64, i64p]
+        if hasattr(lib, "lct_group_reduce"):
+            lib.lct_group_reduce.restype = ctypes.c_int64
+            lib.lct_group_reduce.argtypes = [
+                u8p, ctypes.c_int64,
+                i64p, i64p, i32p, i64p, i32p,
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_double, ctypes.c_int64,
+                i32p, i32p, u8p, i64p, u8p, u8p, u8p,
+                i64p, ctypes.c_int64]
         if hasattr(lib, "lct_delim_struct_parse"):
             lib.lct_delim_struct_parse.restype = ctypes.c_int64
             lib.lct_delim_struct_parse.argtypes = [
@@ -342,6 +352,60 @@ def delim_struct_parse(arena: np.ndarray, offsets: np.ndarray,
     if rc != 0:
         return None
     return out_offs, out_lens, nfields, side[: int(counts[0])]
+
+
+def group_reduce(arena: np.ndarray, slots: np.ndarray,
+                 key_offs: np.ndarray, key_lens: np.ndarray,
+                 val_offs: np.ndarray, val_lens: np.ndarray,
+                 hist_base: float = 1.0, n_hist: int = 41):
+    """loongagg fold (native substrate): hashed segment identity over
+    (window slot, K key spans) + row-order f64 reduction.
+
+    slots i64 [n]; key_offs i64 / key_lens i32 [n, K] (len -1 = absent);
+    val_offs i64 / val_lens i32 [n].  Returns (group_id i32 [n] with -1
+    marking invalid-value rows, rep_row i32 [G], sum f64 [G], count i64
+    [G], min f64 [G], max f64 [G], last f64 [G], hist i64 [G, n_hist]) —
+    group ids in first-seen row order, the same partition and the same
+    accumulation order as the numpy twin (bit-identical by the
+    scripts/agg_equivalence.py gate).  None when the native library is
+    unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "lct_group_reduce"):
+        return None
+    arena = np.ascontiguousarray(arena)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    key_offs = np.ascontiguousarray(key_offs, dtype=np.int64)
+    key_lens = np.ascontiguousarray(key_lens, dtype=np.int32)
+    val_offs = np.ascontiguousarray(val_offs, dtype=np.int64)
+    val_lens = np.ascontiguousarray(val_lens, dtype=np.int32)
+    n = len(slots)
+    K = key_offs.shape[1] if key_offs.ndim == 2 else 1
+    group_id = np.empty(max(n, 1), dtype=np.int32)
+    # start with a small group capacity (the common case: cardinality per
+    # batch << rows per batch) and retry once at the n ceiling on -1
+    cap = min(n, 4096) or 1
+    while True:
+        rep_row = np.empty(cap, dtype=np.int32)
+        sums = np.empty(cap, dtype=np.float64)
+        cnt = np.empty(cap, dtype=np.int64)
+        mn = np.empty(cap, dtype=np.float64)
+        mx = np.empty(cap, dtype=np.float64)
+        last = np.empty(cap, dtype=np.float64)
+        hist = np.empty((cap, n_hist), dtype=np.int64)
+        rc = lib.lct_group_reduce(
+            _u8(arena), len(arena), _i64(slots), _i64(key_offs),
+            _i32(key_lens), _i64(val_offs), _i32(val_lens), n, K,
+            ctypes.c_double(hist_base), n_hist,
+            _i32(group_id), _i32(rep_row), _u8(sums), _i64(cnt),
+            _u8(mn), _u8(mx), _u8(last), _i64(hist), cap)
+        if rc == -1 and cap < n:
+            cap = n
+            continue
+        if rc < 0:
+            return None
+        G = int(rc)
+        return (group_id[:n], rep_row[:G], sums[:G], cnt[:G], mn[:G],
+                mx[:G], last[:G], hist[:G])
 
 
 _key_cache: dict = {}

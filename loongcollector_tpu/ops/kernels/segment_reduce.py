@@ -1,0 +1,351 @@
+"""Segment-reduce twins for the loongagg metric fold.
+
+The native `lct_group_reduce` is the production substrate: hash the
+(window slot, key spans) identity per row, then fold the value column
+per group — sum/count/min/max/last plus the metrics.py-shaped log2-bucket
+histogram — in f64, in row order.  This module carries its two siblings:
+
+* the **numpy twin** — the no-native tier and the shared reference.  The
+  segment identity comes from one vectorised length-prefixed key-matrix
+  gather + ``np.unique`` remapped to first-seen order (the native group-id
+  order), and the fold accumulates with ``np.add.at`` — sequential adds in
+  row index order, the exact accumulation order of the native loop, so
+  sums are **bit-identical**, not merely close (min/max/count/hist are
+  order-free).  Value-span parsing is the one per-row loop in this tier
+  (no vectorised strtod exists); it is the degraded path by contract —
+  the native plane is the throughput claim;
+
+* the **device twin** (`SegmentReduceKernel`) — the wide data-parallel
+  half for the accelerator, `jax.ops.segment_*` over a padded batch slot:
+  ONE jitted dispatch per ``device_batch`` geometry computes every
+  aggregate including the histogram (a segment-sum over ``seg * NB +
+  bucket``).  Keying, value parsing and bucket ids stay on the host (f64,
+  shared helpers — frexp on f32 would disagree at power-of-two
+  boundaries); the device owns the reduction, ParPaRaw-style.  Sums
+  accumulate in f32 on default-precision backends, so the
+  ``scripts/agg_equivalence.py`` gate compares device sums with a stated
+  tolerance and everything else exactly.
+
+All three substrates are differentially gated (lint.sh + tier-1) — same
+partition, same aggregates, or the gate fails per row.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+#: metrics.py Histogram geometry applied to metric VALUES: base 1.0
+#: (values ≤ 1 land in bucket 0), 40 log2 buckets + the +Inf slot
+HIST_BASE = 1.0
+N_HIST = 41
+
+#: the strtod-subset value grammar shared with the native plane (see
+#: lct_group_reduce): sign, decimal digits with optional fraction and
+#: exponent, or inf/infinity.  NaN is invalid BY GRAMMAR — it would make
+#: min/max accumulation order-visible across substrates.
+_VALUE_RE = re.compile(
+    rb"^[+-]?(?:(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|"
+    rb"[iI][nN][fF](?:[iI][nN][iI][tT][yY])?)$")
+
+
+def hist_bucket(values: np.ndarray, base: float = HIST_BASE,
+                n_hist: int = N_HIST) -> np.ndarray:
+    """Vectorised metrics.py bucket shape on f64: v <= base (and
+    negatives) -> 0, +inf -> the last slot, else ceil(log2(v/base))
+    clamped.  Shared by the numpy twin and the device path (bucket ids
+    are computed on the host in f64 for all substrates)."""
+    v = np.asarray(values, dtype=np.float64)
+    m, e = np.frexp(np.where(v > base, v / base, 1.0))
+    idx = np.where(m == 0.5, e - 1, e).astype(np.int64)
+    idx = np.clip(idx, 0, n_hist - 1)
+    idx = np.where(v > base, idx, 0)
+    return np.where(np.isinf(v) & (v > 0), n_hist - 1, idx)
+
+
+def parse_values(arena: np.ndarray, val_offs: np.ndarray,
+                 val_lens: np.ndarray):
+    """(values f64 [n], valid bool [n]) from value text spans.
+
+    Degraded-tier loop by contract (documented above): validation is the
+    shared grammar regex, conversion is Python float() — correctly
+    rounded, so results are bit-identical to the native strtod."""
+    n = len(val_offs)
+    values = np.zeros(n, dtype=np.float64)
+    valid = np.zeros(n, dtype=bool)
+    buf = memoryview(np.ascontiguousarray(arena))
+    for i in range(n):
+        ln = int(val_lens[i])
+        if ln < 0:
+            continue
+        off = int(val_offs[i])
+        tok = bytes(buf[off:off + ln]).strip(b" \t")
+        if not _VALUE_RE.match(tok):
+            continue
+        values[i] = float(tok)
+        valid[i] = True
+    return values, valid
+
+
+def _key_matrix(arena: np.ndarray, slots: np.ndarray,
+                key_offs: np.ndarray, key_lens: np.ndarray) -> np.ndarray:
+    """Length-prefixed key bytes as one uint8 matrix [n, W] — the
+    vectorised identity the first-seen grouping runs np.unique over.
+    The i32 length prefix keeps absent (-1) distinct from empty and
+    ("ab","") distinct from ("a","b"); the slot rides as an i64 prefix
+    column so window identity is part of the segment key, exactly as in
+    the native hash."""
+    n, K = key_lens.shape
+    parts = [np.ascontiguousarray(slots, dtype="<i8").view(
+        np.uint8).reshape(n, 8)]
+    arena_hi = max(len(arena) - 1, 0)
+    for k in range(K):
+        lens = key_lens[:, k]
+        parts.append(np.ascontiguousarray(lens, dtype="<i4").view(
+            np.uint8).reshape(n, 4))
+        m = int(lens.max()) if n else 0
+        if m > 0:
+            idx = key_offs[:, k, None] + np.arange(m, dtype=np.int64)[None, :]
+            np.clip(idx, 0, arena_hi, out=idx)
+            body = (arena[idx] if len(arena)
+                    else np.zeros((n, m), np.uint8))
+            mask = np.arange(m, dtype=np.int32)[None, :] < lens[:, None]
+            parts.append(np.where(mask, body, 0).astype(np.uint8))
+    return np.concatenate(parts, axis=1)
+
+
+def _first_seen_ids(mat: np.ndarray):
+    """(group ids [rows] in first-seen order, representative row per
+    group) — np.unique is lexicographic, so remap through the argsort of
+    first occurrences to match the native assignment order."""
+    _uniq, first_idx, inv = np.unique(mat, axis=0, return_index=True,
+                                      return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    return remap[np.asarray(inv).reshape(-1)], first_idx[order]
+
+
+@dataclass
+class BatchFold:
+    """One batch's partial fold, identical shape across substrates."""
+
+    group_id: np.ndarray   # i32/i64 [n]; -1 = invalid-value row
+    rep_row: np.ndarray    # [G] first row index per group
+    sum: np.ndarray        # f64 [G]
+    count: np.ndarray      # i64 [G]
+    min: np.ndarray        # f64 [G]
+    max: np.ndarray        # f64 [G]
+    last: np.ndarray       # f64 [G]
+    hist: np.ndarray       # i64 [G, N_HIST]
+
+    @property
+    def n_groups(self) -> int:
+        return int(len(self.rep_row))
+
+    @property
+    def n_invalid(self) -> int:
+        return int(np.count_nonzero(self.group_id < 0))
+
+
+def fold_batch_numpy(arena: np.ndarray, slots: np.ndarray,
+                     key_offs: np.ndarray, key_lens: np.ndarray,
+                     val_offs: np.ndarray, val_lens: np.ndarray,
+                     hist_base: float = HIST_BASE,
+                     n_hist: int = N_HIST) -> BatchFold:
+    """The numpy substrate / shared reference (see module docstring)."""
+    n = len(slots)
+    values, valid = parse_values(arena, val_offs, val_lens)
+    group_id = np.full(n, -1, dtype=np.int32)
+    vrows = np.nonzero(valid)[0]
+    if len(vrows) == 0:
+        z = np.zeros(0)
+        return BatchFold(group_id, np.zeros(0, np.int32), z,
+                         np.zeros(0, np.int64), z, z, z,
+                         np.zeros((0, n_hist), np.int64))
+    mat = _key_matrix(arena, slots[vrows], key_offs[vrows],
+                      key_lens[vrows])
+    ids, first = _first_seen_ids(mat)
+    group_id[vrows] = ids
+    rep_row = vrows[first].astype(np.int32)
+    G = int(ids.max()) + 1
+    vv = values[vrows]
+    sums = np.zeros(G, dtype=np.float64)
+    # np.add.at applies adds in index order — the native loop's exact
+    # accumulation order, which is what makes sums bit-identical (np.sum
+    # style pairwise reduction would not be).  inf + -inf inside one key
+    # is legal (sum -> NaN on every substrate): silence the warning
+    with np.errstate(invalid="ignore"):
+        np.add.at(sums, ids, vv)
+    counts = np.bincount(ids, minlength=G).astype(np.int64)
+    order = np.argsort(ids, kind="stable")
+    sv = vv[order]
+    starts = np.searchsorted(ids[order], np.arange(G))
+    mins = np.minimum.reduceat(sv, starts)
+    maxs = np.maximum.reduceat(sv, starts)
+    ends = np.append(starts[1:], len(sv))
+    last = sv[ends - 1]
+    hist = np.zeros((G, n_hist), dtype=np.int64)
+    np.add.at(hist, (ids, hist_bucket(vv, hist_base, n_hist)), 1)
+    return BatchFold(group_id, rep_row, sums, counts, mins, maxs, last,
+                     hist)
+
+
+def fold_batch_native(arena: np.ndarray, slots: np.ndarray,
+                      key_offs: np.ndarray, key_lens: np.ndarray,
+                      val_offs: np.ndarray, val_lens: np.ndarray,
+                      hist_base: float = HIST_BASE,
+                      n_hist: int = N_HIST) -> Optional[BatchFold]:
+    """The native substrate; None when the library is unavailable."""
+    from ...native import group_reduce
+    res = group_reduce(arena, slots, key_offs, key_lens, val_offs,
+                       val_lens, hist_base=hist_base, n_hist=n_hist)
+    if res is None:
+        return None
+    return BatchFold(*res)
+
+
+# ---------------------------------------------------------------------------
+# device twin
+
+
+def build_reduce_fn(n_hist: int):
+    """Returns jit-able f(values f32 [B], seg i32 [B], buckets i32 [B],
+    valid bool [B], G static) -> (sum, count, min, max, last, hist).
+    Invalid/padding rows route to segment id G — out of range, dropped by
+    the scatter, never a branch."""
+    import jax
+    import jax.numpy as jnp
+
+    def reduce_fn(values, seg, buckets, valid, G):
+        seg = jnp.where(valid, seg, G)
+        data = jnp.where(valid, values, jnp.float32(0))
+        sums = jax.ops.segment_sum(data, seg, num_segments=G)
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int32), seg,
+                                  num_segments=G)
+        mins = jax.ops.segment_min(
+            jnp.where(valid, values, jnp.float32(jnp.inf)), seg,
+            num_segments=G)
+        maxs = jax.ops.segment_max(
+            jnp.where(valid, values, jnp.float32(-jnp.inf)), seg,
+            num_segments=G)
+        idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+        last_idx = jax.ops.segment_max(
+            jnp.where(valid, idx, jnp.int32(-1)), seg, num_segments=G)
+        last = jnp.where(last_idx >= 0,
+                         values[jnp.clip(last_idx, 0, None)],
+                         jnp.float32(0))
+        hist = jax.ops.segment_sum(
+            valid.astype(jnp.int32), seg * n_hist + buckets,
+            num_segments=G * n_hist).reshape(G, n_hist)
+        return sums, cnt, mins, maxs, last, hist
+
+    return reduce_fn
+
+
+class SegmentReduceKernel:
+    """Owns the jitted segment-reduce for one histogram geometry.
+
+    jit caches per (B, G) — `fold_batch` quantises B through
+    ``ops.device_batch.pad_batch`` and G to a power of two, so a batch
+    slot is ONE dispatch (`dispatch_count` asserted in the device test).
+    `donated_call` mirrors the loongstream donated-buffer contract for
+    the transient staging arrays."""
+
+    def __init__(self, n_hist: int = N_HIST):
+        import jax
+        self.n_hist = n_hist
+        self._fn = jax.jit(build_reduce_fn(n_hist), static_argnums=(4,))
+        self._fn_donated = None
+        self.dispatch_count = 0
+
+    def __call__(self, values, seg, buckets, valid, G: int):
+        self.dispatch_count += 1
+        return self._fn(values, seg, buckets, valid, G)
+
+    def donated_call(self, values, seg, buckets, valid, G: int):
+        from .field_extract import donation_supported
+        if not donation_supported():
+            return self(values, seg, buckets, valid, G)
+        if self._fn_donated is None:
+            import jax
+            self._fn_donated = jax.jit(build_reduce_fn(self.n_hist),
+                                       static_argnums=(4,),
+                                       donate_argnums=(0, 1, 2, 3))
+        self.dispatch_count += 1
+        return self._fn_donated(values, seg, buckets, valid, G)
+
+    def fold_batch(self, arena: np.ndarray, slots: np.ndarray,
+                   key_offs: np.ndarray, key_lens: np.ndarray,
+                   val_offs: np.ndarray, val_lens: np.ndarray,
+                   hist_base: float = HIST_BASE) -> BatchFold:
+        """Device substrate: host keying + bucketing (exact f64), padded
+        single-dispatch segment reduction on the accelerator."""
+        import jax
+
+        from ..device_batch import pad_batch
+        n_hist = self.n_hist
+        n = len(slots)
+        values, valid = parse_values(arena, val_offs, val_lens)
+        group_id = np.full(n, -1, dtype=np.int32)
+        vrows = np.nonzero(valid)[0]
+        if len(vrows) == 0:
+            z = np.zeros(0)
+            return BatchFold(group_id, np.zeros(0, np.int32), z,
+                             np.zeros(0, np.int64), z, z, z,
+                             np.zeros((0, n_hist), np.int64))
+        mat = _key_matrix(arena, slots[vrows], key_offs[vrows],
+                          key_lens[vrows])
+        ids, first = _first_seen_ids(mat)
+        group_id[vrows] = ids
+        rep_row = vrows[first].astype(np.int32)
+        G = int(ids.max()) + 1
+        B = pad_batch(n)
+        Gq = 16
+        while Gq < G:
+            Gq *= 2
+        vals = np.zeros(B, dtype=np.float32)
+        vals[:n] = values.astype(np.float32)
+        seg = np.full(B, Gq, dtype=np.int32)
+        seg[:n] = group_id.clip(min=0)
+        ok = np.zeros(B, dtype=bool)
+        ok[:n] = valid
+        buckets = np.zeros(B, dtype=np.int32)
+        buckets[:n] = hist_bucket(values, hist_base, n_hist)
+        out = self.donated_call(vals, seg, buckets, ok, Gq)
+        sums, cnt, mins, maxs, last, hist = (np.asarray(a) for a in
+                                             jax.device_get(out))
+        return BatchFold(group_id, rep_row,
+                         sums[:G].astype(np.float64),
+                         cnt[:G].astype(np.int64),
+                         mins[:G].astype(np.float64),
+                         maxs[:G].astype(np.float64),
+                         last[:G].astype(np.float64),
+                         hist[:G].astype(np.int64))
+
+
+_device_kernel: Optional[SegmentReduceKernel] = None
+
+
+def device_kernel() -> SegmentReduceKernel:
+    global _device_kernel
+    if _device_kernel is None:
+        _device_kernel = SegmentReduceKernel()
+    return _device_kernel
+
+
+def hist_bucket_scalar(v: float, base: float = HIST_BASE,
+                       n_hist: int = N_HIST) -> int:
+    """Scalar shape twin for the per-event dict path (exactly the
+    vectorised hist_bucket, which itself mirrors metrics.py)."""
+    if math.isinf(v) and v > 0:
+        return n_hist - 1
+    if not v > base:
+        return 0
+    m, e = math.frexp(v / base)
+    idx = e - 1 if m == 0.5 else e
+    return min(max(idx, 0), n_hist - 1)

@@ -366,11 +366,14 @@ class CollectionPipeline:
             for g in groups:
                 staged.extend(self.aggregator.add(g))
             groups = staged
-            if led:
+            if led and not getattr(self.aggregator,
+                                   "ledger_self_accounting", False):
                 # a stateful aggregator holds (delta < 0, a process_drop it
                 # repays via _send_direct at flush) or mints rollup events
                 # (delta > 0, process_expand) — either way the chain stays
-                # balanced without instrumenting every aggregator plugin
+                # balanced without instrumenting every aggregator plugin.
+                # Self-accounting aggregators (loongagg's fold) book their
+                # own agg_in/agg_fold/agg_emit boundaries instead.
                 delta = sum(len(g) for g in groups) - n_in
                 if delta < 0:
                     ledger.record(self.name, ledger.B_PROCESS_DROP, -delta,
@@ -404,14 +407,18 @@ class CollectionPipeline:
 
     def _send_direct(self, groups: List[PipelineEventGroup]) -> None:
         led = ledger.is_on()
+        self_acct = getattr(self.aggregator, "ledger_self_accounting", False)
         for group in groups:
             if group.empty():
                 continue
             if led:
-                # aggregator-held events released by timeout/final flush:
-                # the credit matching the "aggregator"-tagged process_drop
-                ledger.record(self.name, ledger.B_PROCESS_EXPAND, len(group),
-                              tag="aggregator_flush")
+                if not self_acct:
+                    # aggregator-held events released by timeout/final
+                    # flush: the credit matching the "aggregator"-tagged
+                    # process_drop (self-accounting aggregators booked
+                    # agg_emit at emission instead)
+                    ledger.record(self.name, ledger.B_PROCESS_EXPAND,
+                                  len(group), tag="aggregator_flush")
                 ledger.record(self.name, ledger.B_PROCESS_OUT, len(group))
             self._route_group(group, led)
 

@@ -3755,3 +3755,332 @@ int64_t lct_delim_struct_parse(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// loongagg: hashed segment-reduce over columnar metric batches.
+//
+// One call folds a whole batch: every row's segment identity is
+// (window slot, K key spans) — hashed span-wise (SSE4.2 CRC32 lanes when
+// the CPU has them, 8-byte-wide FNV-1a otherwise), resolved through an
+// open-addressing table with full byte verification on hash hits, so
+// collisions can regroup nothing.  Values parse from their text spans
+// under a strtod-subset grammar shared verbatim with the numpy twin
+// (ops/kernels/segment_reduce.py), and the per-group aggregates
+// (sum/count/min/max/last + the metrics.py-shaped log2-bucket histogram)
+// accumulate in f64 IN ROW ORDER — the property that makes the numpy twin
+// bit-identical and the per-event dict path value-identical.
+// Group ids are assigned in first-seen row order (deterministic across
+// substrates); rep_row[g] lets the caller read back the group's slot and
+// key spans without any per-row host work.
+// ---------------------------------------------------------------------------
+
+#include <cmath>
+
+namespace {
+
+// strtod-subset grammar shared with the numpy twin: optional sign, then
+// decimal digits[.digits] | .digits with optional exponent, or
+// inf/infinity/nan (case-insensitive).  Hex floats, underscores and
+// locale forms are invalid on EVERY substrate — the grammar, not the
+// host libc, defines validity.
+static bool agg_ci_word(const uint8_t* s, int64_t len, const char* w) {
+    for (int64_t i = 0; i < len; ++i) {
+        if (w[i] == 0) return false;
+        uint8_t c = s[i];
+        if (c >= 'A' && c <= 'Z') c = (uint8_t)(c + 32);
+        if (c != (uint8_t)w[i]) return false;
+    }
+    return w[len] == 0;
+}
+
+static bool agg_value_grammar(const uint8_t* s, int64_t len) {
+    int64_t i = 0;
+    if (i < len && (s[i] == '+' || s[i] == '-')) ++i;
+    if (i >= len) return false;
+    // inf folds fine (sum->inf, min/max compare); NaN would make min/max
+    // accumulation order-visible across substrates, so it is INVALID by
+    // grammar — rejected rows take the counted invalid path instead
+    if (agg_ci_word(s + i, len - i, "inf") ||
+        agg_ci_word(s + i, len - i, "infinity"))
+        return true;
+    bool digits = false;
+    while (i < len && s[i] >= '0' && s[i] <= '9') { ++i; digits = true; }
+    if (i < len && s[i] == '.') {
+        ++i;
+        while (i < len && s[i] >= '0' && s[i] <= '9') { ++i; digits = true; }
+    }
+    if (!digits) return false;
+    if (i < len && (s[i] == 'e' || s[i] == 'E')) {
+        ++i;
+        if (i < len && (s[i] == '+' || s[i] == '-')) ++i;
+        bool edigits = false;
+        while (i < len && s[i] >= '0' && s[i] <= '9') { ++i; edigits = true; }
+        if (!edigits) return false;
+    }
+    return i == len;
+}
+
+// Clinger fast path: mantissa <= 2^53 times an EXACT power of ten
+// (|e| <= 22) is one IEEE multiply/divide of exact operands — correctly
+// rounded, i.e. bit-identical to strtod and Python float().  Typical
+// metric values ("2.5", "17", "0.125") all land here; anything longer or
+// wider falls through to strtod.
+static const double kAggPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
+    1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
+    1e22};
+
+static bool agg_parse_fast(const uint8_t* s, int64_t len, double* out) {
+    int64_t i = 0;
+    bool neg = false;
+    if (s[i] == '+' || s[i] == '-') {
+        neg = (s[i] == '-');
+        ++i;
+    }
+    uint64_t mant = 0;
+    int digits = 0;
+    int frac = 0;
+    bool dot = false;
+    for (; i < len; ++i) {
+        uint8_t c = s[i];
+        if (c >= '0' && c <= '9') {
+            if (++digits > 17) return false;  // may exceed 2^53: slow path
+            mant = mant * 10 + (c - '0');
+            if (dot) ++frac;
+        } else if (c == '.' && !dot) {
+            dot = true;
+        } else {
+            return false;  // exponent / inf spellings: slow path
+        }
+    }
+    if (digits == 0) return false;
+    int e = -frac;
+    if (e < -22 || e > 22 || mant > (1ULL << 53)) return false;
+    double v = (double)mant;
+    v = (e < 0) ? v / kAggPow10[-e] : v * kAggPow10[e];
+    *out = neg ? -v : v;
+    return true;
+}
+
+static bool agg_parse_value(const uint8_t* s, int32_t vlen, double* out) {
+    int64_t len = vlen;
+    while (len > 0 && (*s == ' ' || *s == '\t')) { ++s; --len; }
+    while (len > 0 && (s[len - 1] == ' ' || s[len - 1] == '\t')) --len;
+    if (len <= 0) return false;
+    if (agg_parse_fast(s, len, out)) return true;
+    if (!agg_value_grammar(s, len)) return false;
+    char stack_buf[64];
+    char* buf = stack_buf;
+    char* heap = nullptr;
+    if (len >= 63) {
+        heap = (char*)malloc((size_t)len + 1);
+        if (!heap) return false;
+        buf = heap;
+    }
+    memcpy(buf, s, (size_t)len);
+    buf[len] = 0;
+    char* end = nullptr;
+    double v = strtod(buf, &end);
+    bool ok = (end == buf + len);
+    free(heap);
+    if (!ok) return false;
+    *out = v;
+    return true;
+}
+
+// The metrics.py Histogram bucket shape (log2 boundaries): v <= base (and
+// NaN, and negatives) land in bucket 0, otherwise ceil(log2(v/base))
+// clamped to the last slot; +inf goes to the last (+Inf) slot directly —
+// frexp(inf) is substrate-dependent, the explicit case is not.
+static int64_t agg_hist_bucket(double v, double base, int64_t nb) {
+    if (std::isinf(v) && v > 0.0) return nb - 1;
+    if (!(v > base)) return 0;
+    int e = 0;
+    double m = std::frexp(v / base, &e);
+    int64_t idx = (m == 0.5) ? (int64_t)e - 1 : (int64_t)e;
+    if (idx < 0) idx = 0;
+    if (idx > nb - 1) idx = nb - 1;
+    return idx;
+}
+
+static uint64_t agg_span_hash_fnv(uint64_t h, const uint8_t* p, int64_t len) {
+    // 8-byte-wide FNV-1a mix; identity across substrates is irrelevant
+    // (collisions byte-verify), only distribution matters
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        h = (h ^ w) * 0x100000001b3ULL;
+        p += 8;
+        len -= 8;
+    }
+    if (len > 0) {
+        uint64_t w = 0;
+        memcpy(&w, p, (size_t)len);
+        h = (h ^ (w | ((uint64_t)len << 56))) * 0x100000001b3ULL;
+    }
+    return h;
+}
+
+#if defined(__x86_64__)
+static const bool g_has_sse42 = __builtin_cpu_supports("sse4.2");
+
+// Two independent CRC32C lanes, 16 bytes per iteration (crc32q has a
+// 3-cycle latency; two chains hide it), folded with a golden-ratio mix.
+__attribute__((target("sse4.2"))) static uint64_t agg_span_hash_crc(
+        uint64_t h, const uint8_t* p, int64_t len) {
+    uint64_t c0 = (uint32_t)h;
+    uint64_t c1 = (uint32_t)(h >> 32) ^ 0x9e3779b9u;
+    while (len >= 16) {
+        uint64_t w0, w1;
+        memcpy(&w0, p, 8);
+        memcpy(&w1, p + 8, 8);
+        c0 = _mm_crc32_u64(c0, w0);
+        c1 = _mm_crc32_u64(c1, w1);
+        p += 16;
+        len -= 16;
+    }
+    while (len >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        c0 = _mm_crc32_u64(c0, w);
+        p += 8;
+        len -= 8;
+    }
+    if (len > 0) {
+        uint64_t w = 0;
+        memcpy(&w, p, (size_t)len);
+        c1 = _mm_crc32_u64(c1, w | ((uint64_t)len << 56));
+    }
+    return ((c1 << 32) | c0) * 0x9E3779B97F4A7C15ULL;
+}
+#endif
+
+static inline uint64_t agg_span_hash(uint64_t h, const uint8_t* p,
+                                     int64_t len) {
+#if defined(__x86_64__)
+    if (g_has_sse42) return agg_span_hash_crc(h, p, len);
+#endif
+    return agg_span_hash_fnv(h, p, len);
+}
+
+static bool agg_rows_equal(const uint8_t* arena, const int64_t* slots,
+                           const int64_t* key_offs, const int32_t* key_lens,
+                           int64_t K, int64_t a, int64_t b) {
+    if (slots[a] != slots[b]) return false;
+    for (int64_t k = 0; k < K; ++k) {
+        int32_t la = key_lens[a * K + k];
+        int32_t lb = key_lens[b * K + k];
+        if (la != lb) return false;
+        if (la > 0 && memcmp(arena + key_offs[a * K + k],
+                             arena + key_offs[b * K + k],
+                             (size_t)la) != 0)
+            return false;
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns n_groups (>= 0), -1 when cap was too small (caller grows cap and
+// retries; n_groups <= n so cap = n can never fail), -2 on OOM.
+// group_id[i]: the row's group in first-seen order, or -1 for rows whose
+// value span fails the shared grammar (the caller's counted invalid path).
+// out_hist is [cap, n_hist] row-major, metrics.py log2 bucket shape.
+int64_t lct_group_reduce(
+        const uint8_t* arena, int64_t arena_len,
+        const int64_t* slots,
+        const int64_t* key_offs, const int32_t* key_lens,
+        const int64_t* val_offs, const int32_t* val_lens,
+        int64_t n, int64_t K,
+        double hist_base, int64_t n_hist,
+        int32_t* group_id, int32_t* rep_row,
+        double* out_sum, int64_t* out_cnt,
+        double* out_min, double* out_max, double* out_last,
+        int64_t* out_hist, int64_t cap) {
+    (void)arena_len;
+    if (n <= 0) return 0;
+    int64_t tsize = 16;
+    while (tsize < 2 * n) tsize <<= 1;
+    int32_t* table = (int32_t*)malloc((size_t)tsize * sizeof(int32_t));
+    uint64_t* thash = (uint64_t*)malloc((size_t)tsize * sizeof(uint64_t));
+    if (!table || !thash) {
+        free(table);
+        free(thash);
+        return -2;
+    }
+    memset(table, 0xFF, (size_t)tsize * sizeof(int32_t));
+    const uint64_t mask = (uint64_t)tsize - 1;
+    int64_t n_groups = 0;
+    int64_t rc = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        double v = 0.0;
+        int32_t vl = val_lens[i];
+        if (vl < 0 || !agg_parse_value(arena + val_offs[i], vl, &v)) {
+            group_id[i] = -1;
+            continue;
+        }
+        uint64_t h = 0xcbf29ce484222325ULL ^
+                     ((uint64_t)slots[i] * 0x9E3779B97F4A7C15ULL);
+        h ^= h >> 29;
+        for (int64_t k = 0; k < K; ++k) {
+            int32_t kl = key_lens[i * K + k];
+            // the length term keeps absent (-1) distinct from empty, and
+            // ("ab","") distinct from ("a","b")
+            h = (h ^ ((uint64_t)(int64_t)kl + 2)) * 0x100000001b3ULL;
+            if (kl > 0)
+                h = agg_span_hash(h, arena + key_offs[i * K + k], kl);
+        }
+        // avalanche before masking: both span hashes leave LOW bits
+        // under-mixed (CRC lanes put one lane's bits only in the high
+        // half; FNV multiplies carry low bits upward only), and keys
+        // sharing an 8-byte prefix would otherwise cluster into a
+        // handful of buckets — O(G^2) probing at high cardinality
+        h ^= h >> 33;
+        h *= 0xff51afd7ed558ccdULL;
+        h ^= h >> 29;
+        uint64_t pos = h & mask;
+        int64_t g = -1;
+        for (;;) {
+            int32_t t = table[pos];
+            if (t < 0) {
+                if (n_groups >= cap) {
+                    rc = -1;
+                    goto done;
+                }
+                g = n_groups++;
+                table[pos] = (int32_t)g;
+                thash[pos] = h;
+                rep_row[g] = (int32_t)i;
+                out_sum[g] = 0.0;
+                out_cnt[g] = 0;
+                out_min[g] = v;
+                out_max[g] = v;
+                memset(out_hist + g * n_hist, 0,
+                       (size_t)n_hist * sizeof(int64_t));
+                break;
+            }
+            if (thash[pos] == h &&
+                agg_rows_equal(arena, slots, key_offs, key_lens, K,
+                               (int64_t)rep_row[t], i)) {
+                g = t;
+                break;
+            }
+            pos = (pos + 1) & mask;
+        }
+        group_id[i] = (int32_t)g;
+        out_sum[g] += v;
+        out_cnt[g] += 1;
+        if (v < out_min[g]) out_min[g] = v;
+        if (v > out_max[g]) out_max[g] = v;
+        out_last[g] = v;
+        out_hist[g * n_hist + agg_hist_bucket(v, hist_base, n_hist)] += 1;
+    }
+done:
+    free(table);
+    free(thash);
+    return rc < 0 ? rc : n_groups;
+}
+
+}  // extern "C"

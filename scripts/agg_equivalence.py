@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""loongagg equivalence gate (scripts/lint.sh + tier-1).
+
+Three hard lines under the windowed metric-rollup fold:
+
+1. **Substrate equivalence** — the native ``lct_group_reduce``, the numpy
+   twin and the device ``SegmentReduceKernel`` must agree over an
+   adversarial corpus: identical row→group partition (first-seen order),
+   identical invalid-row set, and identical aggregates.  Native vs numpy
+   is compared BIT-IDENTICAL for every output including f64 sums (same
+   accumulation order by construction).  The device twin reduces in f32
+   on default-precision backends, so its sums compare within a stated
+   tolerance; count/min/max/last/histogram compare exactly (min/max are
+   selections — monotone under the f64→f32 cast — and bucket ids are
+   computed host-side in f64 for every substrate).
+
+2. **Path identity** — the full aggregator over the columnar plane and
+   over the per-event dict path (the loongcolumn side-by-side contract)
+   must emit byte-identical rollup groups: same keys, same windows, same
+   formatted aggregate spans.  Both paths build per-batch partials first
+   and merge with the same operation, so this equality is exact.
+
+3. **Reference fold** — both paths must match a brute-force
+   pure-Python reference fold over the same rows (sum within 1e-12
+   relative — the reference accumulates in a different order — and
+   count/min/max/last exactly).
+
+Exit 0 = equivalent; exit 1 = any disagreement (printed per case).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from loongcollector_tpu.ops.kernels import segment_reduce as sr  # noqa: E402
+
+
+def batch_corpus():
+    """[(label, rows, device_ok)] — rows are (name, labels tuple, value
+    text, slot).  device_ok=False keeps f32-overflowing magnitudes out of
+    the device comparison (documented f32 range)."""
+    rng = np.random.default_rng(20260804)
+    cases = []
+
+    rows = [(b"reqs", (b"h1",), b"1", 0), (b"reqs", (b"h1",), b"2", 0),
+            (b"reqs", (b"h2",), b"3.5", 0), (b"lat", (None,), b"0.25", 0),
+            (b"reqs", (b"h1",), b"4", 1)]
+    cases.append(("basic", rows, True))
+
+    rows = [(b"m", (b"",), b"1", 0), (b"m", (None,), b"1", 0),
+            (b"", (b"x",), b"2", 0), (b"m" * 61, (b"y" * 67,), b"3", 0),
+            (b"ab", (b"",), b"5", 0), (b"a", (b"b",), b"5", 0)]
+    cases.append(("absent-vs-empty keys, word-boundary lengths", rows, True))
+
+    rows = [(b"v", (), b" 1.5 ", 0), (b"v", (), b"\t2e3\t", 0),
+            (b"v", (), b"+.5", 0), (b"v", (), b"-0.0", 0),
+            (b"v", (), b"1_0", 0), (b"v", (), b"0x10", 0),
+            (b"v", (), b"nan", 0), (b"v", (), b"inf", 0),
+            (b"v", (), b"-INF", 0), (b"v", (), b"Infinity", 0),
+            (b"v", (), b"", 0), (b"v", (), b"  ", 0),
+            (b"v", (), b"1e", 0), (b"v", (), b".", 0),
+            (b"v", (), b"5.", 0), (b"v", (), b".5e-2", 0),
+            (b"v", (), b"12345678901234567890", 0)]
+    cases.append(("value grammar edge cases", rows, False))
+
+    rows = [(b"big", (), b"1e300", 0), (b"big", (), b"1e300", 0),
+            (b"tiny", (), b"1e-300", 0),
+            (b"long", (), b"3." + b"1" * 120, 0)]
+    cases.append(("magnitude extremes (host substrates only)", rows, False))
+
+    names = [b"http_requests_total", b"cpu_seconds", b"gc_pause"]
+    hosts = [b"h%d" % i for i in range(17)] + [None]
+    rows = []
+    for _ in range(3000):
+        v = f"{rng.uniform(-100, 100):.6g}".encode()
+        rows.append((names[rng.integers(len(names))],
+                     (hosts[rng.integers(len(hosts))],
+                      b"az%d" % rng.integers(3)),
+                     v, int(rng.integers(0, 5))))
+    cases.append(("random 3000x(3 names x 18 hosts x 3 az x 5 slots)",
+                  rows, True))
+
+    rows = [(b"one", (), b"%d" % i, i % 7) for i in range(257)]
+    cases.append(("per-slot splits", rows, True))
+    return cases
+
+
+def pack_rows(rows):
+    blob = bytearray()
+
+    def put(b):
+        if b is None:
+            return (0, -1)
+        off = len(blob)
+        blob.extend(b)
+        return (off, len(b))
+
+    n = len(rows)
+    K = 1 + max((len(r[1]) for r in rows), default=0)
+    key_offs = np.zeros((n, K), np.int64)
+    key_lens = np.full((n, K), -1, np.int32)
+    val_offs = np.zeros(n, np.int64)
+    val_lens = np.zeros(n, np.int32)
+    slots = np.zeros(n, np.int64)
+    for i, (nm, labels, v, slot) in enumerate(rows):
+        key_offs[i, 0], key_lens[i, 0] = put(nm)
+        for k, lb in enumerate(labels):
+            key_offs[i, 1 + k], key_lens[i, 1 + k] = put(lb)
+        val_offs[i], val_lens[i] = put(v)
+        slots[i] = slot
+    arena = (np.frombuffer(bytes(blob), np.uint8) if blob
+             else np.zeros(0, np.uint8))
+    return arena, slots, key_offs, key_lens, val_offs, val_lens
+
+
+def check_substrates(cases) -> int:
+    bad = 0
+    kern = None
+    for label, rows, device_ok in cases:
+        args = pack_rows(rows)
+        nat = sr.fold_batch_native(*args)
+        ref = sr.fold_batch_numpy(*args)
+        if nat is None:
+            print(f"substrates[{label}]: native unavailable — SKIPPED")
+        else:
+            for field in ("group_id", "rep_row", "sum", "count", "min",
+                          "max", "last", "hist"):
+                a, b = getattr(nat, field), getattr(ref, field)
+                # sums can be NaN by arithmetic (inf + -inf in one key)
+                # even though NaN VALUES are grammar-invalid; bit-identity
+                # still holds, so compare with equal_nan on floats
+                eq = (np.array_equal(a, b, equal_nan=True)
+                      if np.issubdtype(np.asarray(a).dtype, np.floating)
+                      else np.array_equal(a, b))
+                if not eq:
+                    bad += 1
+                    print(f"FAIL substrates[{label}] native!=numpy on "
+                          f"{field}: {a[:8]} vs {b[:8]}")
+        if device_ok:
+            if kern is None:
+                kern = sr.SegmentReduceKernel()
+            dev = kern.fold_batch(*args[:6])
+            for field in ("group_id", "rep_row", "count", "hist"):
+                a, b = getattr(dev, field), getattr(ref, field)
+                if not np.array_equal(a, b):
+                    bad += 1
+                    print(f"FAIL substrates[{label}] device!=numpy on "
+                          f"{field}")
+            for field in ("min", "max", "last"):
+                a = getattr(dev, field)
+                b = getattr(ref, field).astype(np.float32).astype(
+                    np.float64)
+                if not np.array_equal(a, b):
+                    bad += 1
+                    print(f"FAIL substrates[{label}] device {field} != "
+                          f"f32(numpy {field})")
+            if not np.allclose(dev.sum, ref.sum, rtol=1e-5, atol=1e-5):
+                bad += 1
+                print(f"FAIL substrates[{label}] device sums out of "
+                      f"tolerance: max diff "
+                      f"{np.max(np.abs(dev.sum - ref.sum))}")
+    n_dev = sum(1 for c in cases if c[2])
+    print(f"substrates: {len(cases)} corpora x native+numpy"
+          f" (+device on {n_dev}) — {'OK' if not bad else f'{bad} DIFFS'}"
+          + (f" (device dispatches: {kern.dispatch_count})" if kern
+             else ""))
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# path identity: columnar vs per-event dict through the full aggregator
+
+
+def make_columnar_group(rows, label_keys):
+    from loongcollector_tpu.models import (ColumnarLogs, PipelineEventGroup,
+                                           SourceBuffer)
+    sb = SourceBuffer(4096)
+    n = len(rows)
+    cols_data = {k: ([0] * n, [-1] * n)
+                 for k in ["__name__", "value"] + list(label_keys)}
+    row_off = [0] * n
+    tss = [0] * n
+
+    def put(field, i, data):
+        if data is None:
+            return
+        off = sb.allocate(len(data))
+        sb.write_at(off, data)
+        cols_data[field][0][i] = off
+        cols_data[field][1][i] = len(data)
+
+    for i, (nm, labels, v, ts) in enumerate(rows):
+        put("__name__", i, nm)
+        for k, lb in zip(label_keys, labels):
+            put(k, i, lb)
+        put("value", i, v)
+        tss[i] = ts
+    cols = ColumnarLogs(np.array(row_off, np.int32),
+                        np.zeros(n, np.int32), np.array(tss, np.int64))
+    cols.content_consumed = True
+    for k, (o, ln) in cols_data.items():
+        cols.set_field(k, np.array(o, np.int32), np.array(ln, np.int32))
+    g = PipelineEventGroup(sb)
+    g.set_columns(cols)
+    return g
+
+
+def make_dict_group(rows, label_keys):
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    sb = SourceBuffer(4096)
+    g = PipelineEventGroup(sb)
+    for nm, labels, v, ts in rows:
+        ev = g.add_log_event(ts)
+        if nm is not None:
+            ev.set_content(b"__name__", sb.copy_string(nm))
+        for k, lb in zip(label_keys, labels):
+            if lb is not None:
+                ev.set_content(k.encode(), sb.copy_string(lb))
+        if v is not None:
+            ev.set_content(b"value", sb.copy_string(v))
+    return g
+
+
+def rollup_rows_of(groups):
+    """Canonical [(field, bytes...)] rows of emitted rollup groups, for
+    byte-identity comparison across paths."""
+    out = []
+    for g in groups:
+        cols = g.columns
+        raw = g.source_buffer.raw
+        names = sorted(cols.fields)
+        for r in range(len(cols)):
+            row = []
+            for f in names:
+                o, ln = cols.fields[f]
+                if ln[r] < 0:
+                    row.append((f, None))
+                else:
+                    row.append((f, bytes(raw[int(o[r]):
+                                             int(o[r]) + int(ln[r])])))
+            out.append(tuple(row))
+    return sorted(out, key=repr)
+
+
+def drive_path(rows, label_keys, columnar: bool, substrate: str):
+    from loongcollector_tpu.aggregator.metric_rollup import \
+        AggregatorMetricRollup
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    agg = AggregatorMetricRollup()
+    assert agg.init({"WindowSecs": 10, "SlideSecs": 5,
+                     "AllowedLatenessSecs": 5,
+                     "LabelKeys": list(label_keys),
+                     "Substrate": substrate}, PluginContext("agg-gate"))
+    emitted = []
+    # three event-time-ordered batches: cross-batch partial merging is
+    # exercised, while no row lands behind the watermark (the reference
+    # fold below is drop-free; late-drop semantics are unit-tested)
+    third = (max(r[3] for r in rows) + 1) // 3
+    for chunk in (
+            [r for r in rows if r[3] < third],
+            [r for r in rows if third <= r[3] < 2 * third],
+            [r for r in rows if r[3] >= 2 * third]):
+        grp = (make_columnar_group(chunk, label_keys) if columnar
+               else make_dict_group(chunk, label_keys))
+        emitted.extend(agg.add(grp))
+    emitted.extend(agg.flush())
+    agg.metrics.mark_deleted()
+    return rollup_rows_of(emitted)
+
+
+def reference_fold(rows, label_keys):
+    """Brute-force pure-Python fold (arbitrary but fixed accumulation
+    order) — the semantic anchor both real paths must match."""
+    state = {}
+    for nm, labels, v, ts in rows:
+        if nm is None or v is None:
+            continue
+        tok = v.strip(b" \t")
+        if not sr._VALUE_RE.match(tok):
+            continue
+        val = float(tok)
+        key = (nm, labels)
+        per = state.setdefault(key, [])
+        per.append((ts, val))
+    out = {}
+    for (nm, labels), pairs in state.items():
+        vals = [v for _, v in pairs]
+        out[(nm, labels)] = (math.fsum(vals), len(vals), min(vals),
+                             max(vals), vals[-1])
+    return out
+
+
+def check_paths() -> int:
+    rng = np.random.default_rng(7)
+    names = [b"reqs", b"lat", None]
+    hosts = [b"h1", b"h2", None]
+    vals = [b"1", b"2.5", b"-3", b"bad", None, b"1e2", b"0.125"]
+    rows = [(names[rng.integers(3)], (hosts[rng.integers(3)],),
+             vals[rng.integers(len(vals))], int(rng.integers(0, 40)))
+            for _ in range(800)]
+    bad = 0
+    from loongcollector_tpu.native import get_lib
+    subs = ["numpy", "device"] + (["native"] if get_lib() else [])
+    results = {}
+    for sub in subs:
+        results[("col", sub)] = drive_path(rows, ("host",), True, sub)
+    results[("dict", "-")] = drive_path(rows, ("host",), False, "numpy")
+    base = results[("col", "numpy")]
+    for k, res in results.items():
+        if k == ("col", "numpy"):
+            continue
+        exact = k != ("col", "device")
+        if exact and res != base:
+            bad += 1
+            print(f"FAIL paths: {k} differs from columnar/numpy "
+                  f"({len(res)} vs {len(base)} rows)")
+            for a, b in zip(res, base):
+                if a != b:
+                    print(f"  first diff:\n    {a}\n    {b}")
+                    break
+        elif not exact:
+            # device sums differ in f32; compare the exact columns only
+            strip = {"sum", "min", "max", "last"}
+            ra = [tuple((f, v) for f, v in row if f not in strip)
+                  for row in res]
+            rb = [tuple((f, v) for f, v in row if f not in strip)
+                  for row in base]
+            if ra != rb:
+                bad += 1
+                print(f"FAIL paths: {k} key/count/window columns differ")
+    # semantic anchor: merge emitted windows back per key == reference
+    ref = reference_fold(rows, ("host",))
+    got = {}
+    for row in base:
+        d = dict(row)
+        key = (d["__name__"], (d["host"],))
+        s, c, mn, mx, last = got.get(key, (0.0, 0, None, None, None))
+        got[key] = (s + float(d["sum"]), c + int(d["count"]),
+                    min(mn, float(d["min"])) if mn is not None
+                    else float(d["min"]),
+                    max(mx, float(d["max"])) if mx is not None
+                    else float(d["max"]), float(d["last"]))
+    # sliding windows emit each slot window_s/slide_s times
+    overlap = 2
+    for key, (s, c, mn, mx, _last) in got.items():
+        rs, rc, rmn, rmx, _rlast = ref[key]
+        if c != rc * overlap or abs(s - rs * overlap) > 1e-9 * max(
+                1.0, abs(rs)) or mn != rmn or mx != rmx:
+            bad += 1
+            print(f"FAIL reference fold mismatch for {key}: "
+                  f"got {(s, c, mn, mx)} want x{overlap} of "
+                  f"{(rs, rc, rmn, rmx)}")
+    missing = set(ref) - set(got)
+    if missing:
+        bad += 1
+        print(f"FAIL reference fold: keys never emitted: {missing}")
+    print(f"paths: columnar({'/'.join(subs)}) vs dict vs reference over "
+          f"{len(rows)} rows, sliding 10s/5s — "
+          f"{'OK' if not bad else f'{bad} DIFFS'}")
+    return bad
+
+
+def main() -> int:
+    bad = check_substrates(batch_corpus())
+    bad += check_paths()
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

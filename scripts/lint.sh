@@ -59,6 +59,13 @@ echo "== structural-index equivalence gate (loongstruct) =="
 # any span or byte diff fails (docs/performance.md)
 JAX_PLATFORMS=cpu python scripts/struct_equivalence.py
 
+echo "== aggregation equivalence gate (loongagg) =="
+# the native/numpy/device segment-reduce substrates must agree (numpy
+# bit-identical incl. f64 sums, device exact on selections/counts), and
+# the full rollup aggregator must emit byte-identical groups over the
+# columnar and per-event dict paths — docs/performance.md
+JAX_PLATFORMS=cpu python scripts/agg_equivalence.py
+
 echo "== native lint =="
 make -C native lint
 

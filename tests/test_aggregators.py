@@ -1,0 +1,213 @@
+"""Pass-through aggregator goldens (loongagg satellite).
+
+The regroup/pack family (aggregator_base, _context, _metadata_group,
+_content_value_group, _shardhash) predates loongagg but had no dedicated
+test file.  These pin the reference contracts (plugins/aggregator/*):
+MaxLogCount-capped packing, per-source grouping, field-value regrouping
+with values promoted to tags, the SLS shard-hash digest — and, the
+TPU-native invariant, that regrouping is SPAN BOOKKEEPING: output groups
+share the input group's SourceBuffer and re-reference the same event
+objects, never a byte copy.
+"""
+
+import hashlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from loongcollector_tpu.aggregator.base import (  # noqa: E402
+    AggregatorBase, AggregatorContentValueGroup, AggregatorContext,
+    AggregatorMetadataGroup, AggregatorShardHash)
+from loongcollector_tpu.models import (EventGroupMetaKey,  # noqa: E402
+                                       PipelineEventGroup, SourceBuffer)
+from loongcollector_tpu.pipeline.plugin.interface import (  # noqa: E402
+    PluginContext)
+
+
+def _group(n_events, tags=(), meta=(), sb=None, field=None):
+    g = PipelineEventGroup(sb if sb is not None else SourceBuffer(1024))
+    for k, v in tags:
+        g.set_tag(k, v)
+    for k, v in meta:
+        g.set_metadata(k, v)
+    for i in range(n_events):
+        ev = g.add_log_event(i)
+        ev.set_content(b"content", b"line %d" % i)
+        if field:
+            ev.set_content(field[0], field[1])
+    return g
+
+
+def _events_of(groups):
+    return [ev for g in groups for ev in g.events]
+
+
+class TestAggregatorBase:
+    def test_max_log_count_packs(self):
+        agg = AggregatorBase()
+        assert agg.init({"MaxLogCount": 3}, PluginContext("t"))
+        g = _group(7)
+        done = agg.add(g)
+        # 2 full groups of 3 complete; 1 event stays buffered
+        assert [len(d) for d in done] == [3, 3]
+        rest = agg.flush()
+        assert [len(d) for d in rest] == [1]
+        # golden regroup: same event OBJECTS in original order (no copy)
+        assert _events_of(done) + _events_of(rest) == g._events
+
+    def test_arena_shared_no_byte_copy(self):
+        agg = AggregatorBase()
+        assert agg.init({"MaxLogCount": 2}, PluginContext("t"))
+        g = _group(2, tags=((b"k", b"v"),))
+        (done,) = agg.add(g)
+        assert done.source_buffer is g.source_buffer
+        assert bytes(done.get_tag(b"k")) == b"v"
+        # the events reference THEIR arena through identical StringViews
+        assert done.events[0] is g._events[0]
+
+    def test_tag_fingerprint_separates_groups(self):
+        agg = AggregatorBase()
+        assert agg.init({}, PluginContext("t"))
+        sb = SourceBuffer(1024)
+        agg.add(_group(1, tags=((b"a", b"1"),), sb=sb))
+        agg.add(_group(1, tags=((b"a", b"2"),), sb=sb))
+        out = agg.flush()
+        assert len(out) == 2
+        assert sorted(bytes(g.get_tag(b"a")) for g in out) == [b"1", b"2"]
+
+    def test_arena_rotation_on_new_buffer(self):
+        agg = AggregatorBase()
+        assert agg.init({"MaxLogCount": 100}, PluginContext("t"))
+        g1 = _group(2)
+        g2 = _group(2)  # different SourceBuffer
+        assert agg.add(g1) == []
+        done = agg.add(g2)
+        # a bucket holds events of ONE arena: g1's bucket rotated out
+        assert len(done) == 1 and done[0].source_buffer is g1.source_buffer
+        (rest,) = agg.flush()
+        assert rest.source_buffer is g2.source_buffer
+
+    def test_timeout_flush(self):
+        agg = AggregatorBase()
+        assert agg.init({"TimeoutSecs": 0.0}, PluginContext("t"))
+        agg.add(_group(2))
+        out = agg.flush_timeout()
+        assert [len(g) for g in out] == [2]
+        assert agg.flush() == []
+
+
+class TestAggregatorContext:
+    def test_groups_by_source(self):
+        agg = AggregatorContext()
+        assert agg.init({}, PluginContext("t"))
+        sb = SourceBuffer(1024)
+        meta_a = ((EventGroupMetaKey.LOG_FILE_PATH, "/var/a.log"),
+                  (EventGroupMetaKey.LOG_FILE_INODE, "11"))
+        meta_b = ((EventGroupMetaKey.LOG_FILE_PATH, "/var/b.log"),
+                  (EventGroupMetaKey.LOG_FILE_INODE, "22"))
+        ga1 = _group(2, meta=meta_a, sb=sb)
+        gb = _group(1, meta=meta_b, sb=sb)
+        ga2 = _group(1, meta=meta_a, sb=sb)
+        assert agg.add(ga1) == [] and agg.add(gb) == []
+        assert agg.add(ga2) == []
+        out = agg.flush()
+        assert sorted(len(g) for g in out) == [1, 3]
+        big = max(out, key=len)
+        # per-source order preserved across input groups
+        assert big.events == ga1._events + ga2._events
+        assert str(big.get_metadata(EventGroupMetaKey.LOG_FILE_PATH)) \
+            == "/var/a.log"
+
+
+class TestAggregatorMetadataGroup:
+    def test_field_values_key_groups_and_become_tags(self):
+        agg = AggregatorMetadataGroup()
+        assert agg.init({"GroupMetadataKeys": ["svc"]}, PluginContext("t"))
+        sb = SourceBuffer(1024)
+        g = PipelineEventGroup(sb)
+        for i, svc in enumerate((b"api", b"web", b"api")):
+            ev = g.add_log_event(i)
+            ev.set_content(b"svc", svc)
+            ev.set_content(b"content", b"l%d" % i)
+        assert agg.add(g) == []
+        out = agg.flush()
+        by_tag = {bytes(grp.get_tag(b"svc")): grp for grp in out}
+        assert set(by_tag) == {b"api", b"web"}
+        assert len(by_tag[b"api"]) == 2 and len(by_tag[b"web"]) == 1
+        assert by_tag[b"api"].source_buffer is sb
+        # same objects, original relative order
+        assert by_tag[b"api"].events == [g._events[0], g._events[2]]
+
+    def test_missing_key_groups_under_empty(self):
+        agg = AggregatorMetadataGroup()
+        assert agg.init({"GroupMetadataKeys": ["svc"]}, PluginContext("t"))
+        g = _group(2)  # no svc field
+        agg.add(g)
+        (out,) = agg.flush()
+        assert bytes(out.get_tag(b"svc")) == b""
+
+    def test_init_requires_keys(self):
+        agg = AggregatorMetadataGroup()
+        assert not agg.init({}, PluginContext("t"))
+
+
+class TestAggregatorContentValueGroup:
+    def test_group_keys_and_topic(self):
+        agg = AggregatorContentValueGroup()
+        assert agg.init({"GroupKeys": ["region"], "Topic": "metrics"},
+                        PluginContext("t"))
+        g = _group(2, field=(b"region", b"eu"))
+        agg.add(g)
+        (out,) = agg.flush()
+        assert bytes(out.get_tag(b"region")) == b"eu"
+        assert bytes(out.get_tag(b"__topic__")) == b"metrics"
+        assert out.source_buffer is g.source_buffer
+
+
+class TestAggregatorShardHash:
+    def test_md5_digest_of_tag_values(self):
+        agg = AggregatorShardHash()
+        assert agg.init({"ShardHashKeys": ["host", "src"]},
+                        PluginContext("t"))
+        g = _group(1, tags=((b"host", b"h1"), (b"src", b"s9")))
+        (out,) = agg.add(g)
+        assert out is g  # pure pass-through, no regroup, no copy
+        want = hashlib.md5(b"h1_s9").hexdigest()
+        assert str(g.get_metadata(EventGroupMetaKey.SOURCE_ID)) == want
+        assert agg.flush() == []
+
+    def test_missing_tags_hash_empty(self):
+        agg = AggregatorShardHash()
+        assert agg.init({"ShardHashKeys": ["host"]}, PluginContext("t"))
+        g = _group(1)
+        agg.add(g)
+        want = hashlib.md5(b"").hexdigest()
+        assert str(g.get_metadata(EventGroupMetaKey.SOURCE_ID)) == want
+
+
+class TestColumnarPassThrough:
+    @pytest.mark.parametrize("cls,cfg", [
+        (AggregatorBase, {}),
+        (AggregatorContext, {}),
+        (AggregatorMetadataGroup, {"GroupMetadataKeys": ["k"]}),
+        (AggregatorContentValueGroup, {"GroupKeys": ["k"]}),
+    ])
+    def test_columnar_groups_pass_intact(self, cls, cfg):
+        import numpy as np
+
+        from loongcollector_tpu.models import ColumnarLogs
+        agg = cls()
+        assert agg.init(cfg, PluginContext("t"))
+        sb = SourceBuffer(64)
+        g = PipelineEventGroup(sb)
+        g.set_columns(ColumnarLogs(np.zeros(3, np.int32),
+                                   np.zeros(3, np.int32)))
+        out = agg.add(g)
+        # columnar batches are keyed by group-level tags only and pass
+        # through intact — splitting row-wise would defeat the
+        # device-batch geometry (module contract)
+        assert out == [g]
+        assert g._events == []

@@ -83,14 +83,14 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_nine_checkers_registered(self):
+    def test_all_ten_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
                          "swallowed-fault", "unledgered-drop",
                          "metric-naming", "hot-path-materialize",
-                         "per-row-parse"]
-        assert len(all_checkers()) == 9
+                         "per-row-parse", "unbounded-window"]
+        assert len(all_checkers()) == 10
 
 
 # ---------------------------------------------------------------------------
@@ -1415,3 +1415,112 @@ class TestPerRowParse:
         import loongcollector_tpu.processor.parse_json as pj
         assert "loonglint: disable=per-row-parse" in inspect.getsource(pj)
         assert "loonglint: disable=per-row-parse" in inspect.getsource(pd)
+
+
+class TestUnboundedWindow:
+    """unbounded-window (loongagg): dict window state in aggregator/ needs
+    cap/TTL eviction wired to a counted metric — slow-OOM and silent-skew
+    are both findings."""
+
+    SCOPE = "loongcollector_tpu/aggregator/fixture.py"
+
+    def _scan(self, src, relpath=None):
+        from loongcollector_tpu.analysis.checkers.unbounded_window import \
+            UnboundedWindowChecker
+        return scan(src, UnboundedWindowChecker(),
+                    relpath=relpath or self.SCOPE)
+
+    def test_flags_dict_state_with_no_eviction(self):
+        findings = self._scan("""
+            class AggregatorLeaky:
+                def __init__(self):
+                    self._windows = {}
+
+                def add(self, group):
+                    self._windows.setdefault(key(group), []).append(group)
+        """)
+        assert checks_of(findings) == {"unbounded-window"}
+        msg = findings[0].message
+        assert "eviction site" in msg and "bound" in msg \
+            and "counted metric" in msg
+        assert findings[0].symbol == "AggregatorLeaky._windows"
+
+    def test_flags_eviction_without_bound_or_counter(self):
+        findings = self._scan("""
+            class AggregatorHalf:
+                def __init__(self):
+                    self._state = {}
+
+                def rotate(self, key):
+                    self._state.pop(key, None)
+        """)
+        assert checks_of(findings) == {"unbounded-window"}
+        msg = findings[0].message
+        assert "eviction site" not in msg
+        assert "bound comparison" in msg and "counted metric" in msg
+
+    def test_clean_with_cap_eviction_and_counter(self):
+        findings = self._scan("""
+            class AggregatorBounded:
+                def __init__(self, metrics):
+                    self._windows = {}
+                    self._m_evicted = metrics.counter("evict_total")
+
+                def add(self, key, v):
+                    if len(self._windows) >= self.max_keys:
+                        self._windows.pop(next(iter(self._windows)))
+                        self._m_evicted.add(1)
+                    self._windows[key] = v
+        """)
+        assert findings == []
+
+    def test_counter_registration_call_chain_is_evidence(self):
+        findings = self._scan("""
+            class AggregatorChained:
+                def __init__(self):
+                    self._buckets = {}
+
+                def flush_timeout(self, now):
+                    for key in list(self._buckets):
+                        if now - self._buckets[key].born >= self.timeout_s:
+                            del self._buckets[key]
+                            _metrics().counter("timeout_total").add(1)
+        """)
+        assert findings == []
+
+    def test_outside_aggregator_scope_is_ignored(self):
+        findings = self._scan("""
+            class Cache:
+                def __init__(self):
+                    self._entries = {}
+        """, relpath="loongcollector_tpu/processor/fixture.py")
+        assert findings == []
+
+    def test_real_tree_aggregators_comply(self):
+        # base.py (bucket cap + TTL + counted completions) and
+        # metric_rollup.py (MaxKeys + counted eviction) both pass with
+        # zero suppressions
+        from loongcollector_tpu.analysis.checkers.unbounded_window import \
+            UnboundedWindowChecker
+        for rel in ("loongcollector_tpu/aggregator/base.py",
+                    "loongcollector_tpu/aggregator/metric_rollup.py"):
+            path = os.path.join(REPO, rel)
+            with open(path) as f:
+                mod = ModuleInfo(path, rel, f.read())
+            assert list(UnboundedWindowChecker().check_module(mod)) == []
+
+    def test_registered_in_tier1(self):
+        from loongcollector_tpu.analysis.checkers import checker_names
+        assert "unbounded-window" in checker_names()
+
+    def test_unledgered_drop_scope_covers_aggregator(self):
+        from loongcollector_tpu.analysis.checkers.unledgered_drop import \
+            UnledgeredDropChecker
+        findings = scan("""
+            def add(self, group):
+                for ev in group.events:
+                    if ev.bad:
+                        log.warning("dropping malformed metric row")
+                        continue
+        """, UnledgeredDropChecker(), relpath=self.SCOPE)
+        assert checks_of(findings) == {"unledgered-drop"}
