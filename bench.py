@@ -269,6 +269,222 @@ def bench_fusion(n=8192):
     return out
 
 
+def bench_stage_fusion(n_lines=2048, n_batches=6):
+    """loongresident (r12): single-dispatch pipeline fusion on a 3-stage
+    all-device pipeline (filter → parse_regex → filter-on-capture).
+
+    Two recorded sweeps: (1) dispatches-per-batch, fused vs the per-stage
+    path with device routing forced (the staged side must really pay one
+    dispatch per stage, or the count comparison is vacuous) — fused MUST
+    be exactly 1 per batch slot and byte-identical (SystemExit on either
+    miss); (2) the device round-trip model: both paths dispatched through
+    the DevicePlane under a LatencyInjectedKernel tunnel (5 ms exec,
+    2.25 ms wire each way, serialized execution stream), recording the
+    ``device.roundtrip`` p50/p99 trajectory before/after and the e2e win
+    (≥ 2× asserted in-bench — the ISSUE 14 acceptance bound)."""
+    import numpy as np
+
+    from loongcollector_tpu.models import (ColumnarLogs, PipelineEventGroup,
+                                           SourceBuffer)
+    from loongcollector_tpu.ops import device_stream
+    from loongcollector_tpu.ops import fused_pipeline as fp
+    from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                     LatencyInjectedKernel,
+                                                     roundtrip_histogram)
+    from loongcollector_tpu.ops.regex import engine as rengine
+    from loongcollector_tpu.pipeline.pipeline import CollectionPipeline
+
+    config = {
+        "inputs": [],
+        "processors": [
+            {"Type": "processor_filter_native",
+             "Include": {"content": r"[a-z]+ \d+ \S+"}},
+            {"Type": "processor_parse_regex_tpu",
+             "Regex": r"([a-z]+) (\d+) (\S+)",
+             "Keys": ["word", "num", "path"]},
+            {"Type": "processor_filter_native",
+             "Include": {"num": r"[1-4]\d*"}},
+        ],
+        "flushers": [{"Type": "flusher_stdout"}],
+    }
+    rng = np.random.default_rng(11)
+    words = [b"alpha", b"beta", b"gamma", b"delta", b"eps", b"zeta",
+             b"eta"]
+    lines = []
+    for i in range(n_lines):
+        k = int(rng.integers(4))
+        if k == 0:
+            lines.append(b"!!noise %d" % i)
+        else:
+            lines.append(b"%s %d /p/%d" % (words[i % 7], int(rng.integers(
+                1, 99999)), i))
+
+    def make_group():
+        blob = b"".join(lines)
+        sb = SourceBuffer(len(blob) + 256)
+        g = PipelineEventGroup(sb)
+        views = [sb.copy_string(ln) for ln in lines]
+        g.set_columns(ColumnarLogs(
+            offsets=np.array([v.offset for v in views], np.int32),
+            lengths=np.array([len(ln) for ln in lines], np.int32),
+            timestamps=np.full(len(lines), 1700000002, np.int64)))
+        return g
+
+    def digest(group):
+        import hashlib
+        cols = group.columns
+        arena = group.source_buffer.as_array()
+        h = hashlib.blake2b(digest_size=16)
+        for k, (offs, lens) in sorted(cols.fields.items()):
+            h.update(k.encode())
+            for i in range(len(cols)):
+                ln = int(lens[i])
+                # explicit per-row separator + out-of-band absent marker:
+                # without them adjacent rows' bytes (or a literal "-"
+                # value) could collide across paths and fake identity
+                h.update(b"\x00-" if ln < 0 else
+                         arena[int(offs[i]):int(offs[i]) + ln].tobytes())
+                h.update(b";")
+        return h.hexdigest()
+
+    def drive(pipeline, plane):
+        counts, digs = [], []
+        rows_out = 0
+        for _ in range(n_batches):
+            before = plane.dispatched_total()
+            g = make_group()
+            fin = pipeline.process_begin([g])
+            if fin is not None:
+                fin()
+            counts.append(plane.dispatched_total() - before)
+            digs.append(digest(g))
+            rows_out += len(g)
+        if rows_out == 0:
+            # identical-but-empty outputs would make the digest assert
+            # vacuous — the corpus must survive the filters
+            raise SystemExit("stage_fusion: no rows survived the chain")
+        return counts, digs
+
+    prev_env = {k: os.environ.get(k)
+                for k in ("LOONG_FUSED", "LOONG_NATIVE_T1")}
+    prev_min_bytes = rengine._device_min_bytes_cached
+    out = {}
+    try:
+        # the per-stage comparator must take the device tier per stage —
+        # that is the execution model whose round trips fusion removes
+        os.environ["LOONG_NATIVE_T1"] = "0"
+        rengine._device_min_bytes_cached = 0
+        fp.reset_for_testing()
+
+        os.environ["LOONG_FUSED"] = "1"
+        plane = DevicePlane.reset_for_testing()
+        p_fused = CollectionPipeline()
+        assert p_fused.init("bench-stage-fused", config)
+        fused_counts, fused_digs = drive(p_fused, plane)
+
+        os.environ["LOONG_FUSED"] = "0"
+        plane = DevicePlane.reset_for_testing()
+        p_staged = CollectionPipeline()
+        assert p_staged.init("bench-stage-staged", config)
+        staged_counts, staged_digs = drive(p_staged, plane)
+
+        if fused_digs != staged_digs:
+            raise SystemExit("stage_fusion: fused vs per-stage output "
+                             "is not byte-identical")
+        if any(c != 1 for c in fused_counts):
+            raise SystemExit(f"stage_fusion: fused path took "
+                             f"{fused_counts} dispatches per batch "
+                             f"(must be exactly 1 per batch slot)")
+        out["byte_identical"] = True
+        out["dispatches_per_batch"] = {
+            "fused": fused_counts, "staged": staged_counts}
+
+        # -- round-trip model -------------------------------------------
+        program = p_fused._fused_runs[0].program()
+        from loongcollector_tpu.processor.common import extract_source
+        from loongcollector_tpu.ops.device_batch import (pack_rows,
+                                                         pick_length_bucket)
+        src = extract_source(make_group(), b"content")
+        L = pick_length_bucket(int(src.lengths.max()))
+        batch = pack_rows(src.arena, src.offsets, src.lengths, L)
+        program.staged_run(batch.rows, batch.lengths)       # warm jits
+        staged_np = program.staged_run(batch.rows, batch.lengths)
+        p_off, p_len = staged_np[1][1], staged_np[1][2]
+        rtt_s, wire_s = 0.005, 0.00225
+        # one dispatchable callable per stage of the per-stage path; the
+        # span-bound filter receives the parse stage's MATERIALISED spans
+        # (exactly the host bounce the fused program removes)
+        stage_calls = [
+            lambda r, l: program.specs[0].payload[0].staged(r, l),
+            lambda r, l: program.specs[1].staged(r, l),
+            lambda r, l: program.specs[2].payload[0].staged(
+                r, l, p_off[:, 1], p_len[:, 1]),
+        ]
+        stage_kerns = [LatencyInjectedKernel(c, rtt_s, wire_s=wire_s)
+                       for c in stage_calls]
+        hist = roundtrip_histogram()
+        hist.snapshot(reset=True)
+        plane = DevicePlane.reset_for_testing()
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            for k in stage_kerns:
+                plane.submit(k, (batch.rows, batch.lengths),
+                             batch.rows.nbytes).result()
+        staged_s = time.perf_counter() - t0
+        staged_traj = hist.snapshot(reset=True)
+
+        fused_kern = LatencyInjectedKernel(program._fn, rtt_s,
+                                           serialize=True, wire_s=wire_s)
+        program.set_kernel_override(fused_kern)
+        try:
+            plane = DevicePlane.reset_for_testing()
+            t0 = time.perf_counter()
+            pend = [fp.FusedDispatch(program, src.arena, src.offsets,
+                                     src.lengths).dispatch()
+                    for _ in range(n_batches)]
+            for d in pend:
+                d.result()
+            fused_s = time.perf_counter() - t0
+        finally:
+            program.set_kernel_override(None)
+        fused_traj = hist.snapshot(reset=True)
+
+        win = staged_s / fused_s if fused_s else 0.0
+        out["roundtrip_model"] = {
+            "rtt_ms": rtt_s * 1e3, "wire_ms_each_way": wire_s * 1e3,
+            "batches": n_batches,
+            "staged_ms_per_batch": round(staged_s / n_batches * 1e3, 2),
+            "fused_ms_per_batch": round(fused_s / n_batches * 1e3, 2),
+            "e2e_win_x": round(win, 2),
+            "device_roundtrip": {
+                "staged": {"p50_ms": round(staged_traj["p50"] * 1e3, 2),
+                           "p99_ms": round(staged_traj["p99"] * 1e3, 2)},
+                "fused": {"p50_ms": round(fused_traj["p50"] * 1e3, 2),
+                          "p99_ms": round(fused_traj["p99"] * 1e3, 2)},
+            },
+        }
+        if win < 2.0:
+            raise SystemExit(f"stage_fusion: fused e2e win {win:.2f}x "
+                             "under the round-trip model (< 2x bound)")
+        status = fp.stage_fusion_status()
+        out["cache"] = {
+            "hits": status.get("fused_program_cache_hit_total"),
+            "misses": status.get("fused_program_cache_miss_total"),
+        }
+        out["demotions"] = status.get("fused_demotions_total")
+        out["programs"] = status.get("programs", [])
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        rengine._device_min_bytes_cached = prev_min_bytes
+        DevicePlane.reset_for_testing()
+        device_stream.reset_for_testing()
+    return out
+
+
 def bench_multiline(n_records=4096):
     """Java stacktrace assembly: device match batch + span merge."""
     from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
@@ -1580,6 +1796,34 @@ def bench_aggregation(n_rows=200000, n_keys=64):
         if have_native else True)
     res["window_close_trajectory"] = closes[:24]
 
+    # loongresident satellite (r12): the BENCH_r11 device-substrate cliff
+    # (device 2.1 vs native 110 MB/s) was host prep — the full-byte-matrix
+    # np.unique keying (~107 of 137 ms per 16k-row fold), the per-row
+    # float() parse loop, and fresh padded staging per batch — not the
+    # kernel.  Before = LOONG_AGG_PREP=0 (the r11 prep path); after = the
+    # hashed exact keying + vectorised Clinger parse + staging reuse +
+    # fold→merge key interning (the default above).  Both legs re-measured
+    # here so each runs against the warm jit cache (the substrates loop
+    # above paid the compile) — warm-vs-warm, or the compile cost masks
+    # the host-prep delta this records.
+    prev_prep = os.environ.get("LOONG_AGG_PREP")
+    os.environ["LOONG_AGG_PREP"] = "0"
+    try:
+        _emitted_b, dt_b = _agg_drive(groups, "device", n_keys)
+    finally:
+        if prev_prep is None:
+            os.environ.pop("LOONG_AGG_PREP", None)
+        else:
+            os.environ["LOONG_AGG_PREP"] = prev_prep
+    _emitted_a, dt_a = _agg_drive(groups, "device", n_keys)
+    before_mbps = round(bytes_total / dt_b / 1e6, 1)
+    after_mbps = round(bytes_total / dt_a / 1e6, 1)
+    res["device_prep"] = {
+        "r11_prep_MBps": before_mbps,
+        "fixed_prep_MBps": after_mbps,
+        "win_x": round(after_mbps / max(before_mbps, 1e-9), 2),
+    }
+
     # -- per-event dict baseline (same logical rows, materialized) -------
     # whole batches only: the identity re-generation below must replay
     # the exact same per-batch rng draws
@@ -1806,6 +2050,12 @@ def main():
     fusion = _safe(bench_fusion, default=None)
     if fusion is not None:
         extra["fusion"] = fusion
+    # loongresident: dispatches-per-batch sweep (fused vs per-stage on a
+    # 3-stage pipeline) + the device.roundtrip p50/p99 trajectory under
+    # the tunnel model, byte-identity and the >=2x win asserted in-bench
+    stage_fusion = _safe(bench_stage_fusion, default=None)
+    if stage_fusion is not None:
+        extra["stage_fusion"] = stage_fusion
     # loongagg: columnar windowed rollups — native fold headline (>=20x
     # the per-event dict baseline asserted in-bench, value-identical by
     # digest), substrate side-by-side, key-cardinality sweep and the

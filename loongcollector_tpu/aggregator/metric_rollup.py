@@ -158,6 +158,13 @@ class AggregatorMetricRollup(Aggregator):
         self._last_event_wall = 0.0
         self._evict_alarmed = False
         self._device_kern = None
+        # fold→merge key interning (BENCH_r11 device-cliff satellite):
+        # the numpy/device substrates hand back the representatives' raw
+        # key-matrix rows (BatchFold.rep_key_blob) — steady-state batches
+        # look their merge key tuple up by those hash-key bytes instead
+        # of re-slicing the arena and re-minting bytes per group per
+        # batch.  Bounded: cleared past 4×MaxKeys (churned label sets).
+        self._key_intern: Dict[bytes, Tuple] = {}
         # evicted partials staged between _merge_locked and the group
         # build at the end of the same add() call
         self._pending_evicted: List[Tuple[int, int, Tuple, _Partial]] = []
@@ -320,6 +327,10 @@ class AggregatorMetricRollup(Aggregator):
         hist = fold.hist if self.emit_histogram else None
         next_close = self._next_close
         merge = self._merge_locked
+        intern = self._key_intern
+        blob = fold.rep_key_blob
+        if blob is not None and len(intern) > 4 * self.max_keys:
+            self._key_intern.clear()
         for g in range(fold.n_groups):
             slot = rep_slots[g]
             cnt = cnts_l[g]
@@ -327,11 +338,26 @@ class AggregatorMetricRollup(Aggregator):
                 # every window this slot could feed has closed: late
                 n_late += cnt
                 continue
-            ko = rep_offs[g]
-            kl = rep_lens[g]
-            key = tuple(
-                (bytes(buf[ko[k]:ko[k] + kl[k]]) if kl[k] >= 0 else None)
-                for k in range(K))
+            key = None
+            bkey = None
+            if blob is not None:
+                # reuse the fold's hash-key bytes: the blob row carries
+                # (slot, lens, key bytes) — strip the 8-byte slot prefix
+                # so one metric series interns to ONE tuple across
+                # slots.  The per-key padded widths are part of the key:
+                # blob bytes alone are ambiguous across batches whose
+                # column widths differ (zero padding moves).
+                bkey = (fold.key_widths, blob[g, 8:].tobytes())
+                key = intern.get(bkey)
+            if key is None:
+                ko = rep_offs[g]
+                kl = rep_lens[g]
+                key = tuple(
+                    (bytes(buf[ko[k]:ko[k] + kl[k]]) if kl[k] >= 0
+                     else None)
+                    for k in range(K))
+                if bkey is not None:
+                    intern[bkey] = key
             merge(slot, key, sums_l[g], cnt, mins_l[g], maxs_l[g],
                   lasts_l[g], hist[g] if hist is not None else None)
         self._note_rows_locked(int(ts.max()) if n else None,

@@ -8,6 +8,7 @@ from typing import List
 from ..core import Checker
 from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
+from .host_bounce import HostBounceChecker
 from .hot_path_materialize import HotPathMaterializeChecker
 from .metric_naming import MetricNamingChecker
 from .per_row_parse import PerRowParseChecker
@@ -28,6 +29,7 @@ _CHECKER_CLASSES = [
     HotPathMaterializeChecker,
     PerRowParseChecker,
     UnboundedWindowChecker,
+    HostBounceChecker,
 ]
 
 

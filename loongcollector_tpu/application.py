@@ -172,6 +172,11 @@ class Application:
         # determinize+minimize again
         from .ops.regex import fuse
         fuse.set_cache_dir(self.data_dir)
+        # loongresident: fused pipeline-program plan records persist under
+        # <data_dir>/fused_cache/ — restarts skip plan construction and
+        # recover the observed jit geometries for AOT warm
+        from .ops import fused_pipeline
+        fused_pipeline.set_cache_dir(self.data_dir)
         from .pipeline.plugin.checkpoint import (PluginCheckpointStore,
                                                  set_default_store)
         set_default_store(PluginCheckpointStore(

@@ -106,6 +106,11 @@ class AlarmType(str, enum.Enum):
     # onto the counted per-row fallback path — correctness holds, but the
     # structural plane's throughput contract is broken for that pipeline
     PARSE_FALLBACK_DEGRADED = "PARSE_FALLBACK_DEGRADED_ALARM"
+    # loongresident: a fused pipeline program demoted a chunk to the
+    # per-stage dispatch path — answers identical, but that chunk paid N
+    # round trips instead of one (docs/performance.md "Single-dispatch
+    # pipeline fusion")
+    FUSED_DEMOTED = "FUSED_DISPATCH_DEMOTED_ALARM"
     # loongledger: a quiesced conservation snapshot balanced to nonzero —
     # an event crossed into the agent and left without a ledgered exit
     CONSERVATION_RESIDUAL = "CONSERVATION_RESIDUAL_ALARM"

@@ -290,6 +290,16 @@ def collect_status() -> dict:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongresident: per-program fused-dispatch rows (stages,
+        # dispatch/demotion counts, geometries, cache hit/miss) — the
+        # "is my pipeline really one dispatch per batch" page
+        import sys as _sys
+        _fp = _sys.modules.get("loongcollector_tpu.ops.fused_pipeline")
+        if _fp is not None:
+            doc["stage_fusion"] = _fp.stage_fusion_status()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         # loongstruct: per-processor structural-parse fallback accounting
         # (the "is JSON/CSV parsing quietly per-row again" page) — absent
         # until a parse processor has processed rows

@@ -60,6 +60,28 @@ def roundtrip_histogram():
     return _rtt_hist
 
 
+_dispatch_counter = None
+_dispatch_counter_lock = threading.Lock()
+
+
+def dispatch_counter():
+    """``device_dispatch_total``: every kernel dispatch admitted through
+    the plane budget, fused or per-stage — the loongresident
+    dispatch-count ledger (rate() against batch counts recovers
+    dispatches-per-batch, the number stage fusion collapses toward 1).
+    Double-checked lock: concurrent first dispatches must not
+    double-register the record (the aggregator-base race shape)."""
+    global _dispatch_counter
+    if _dispatch_counter is None:
+        with _dispatch_counter_lock:
+            if _dispatch_counter is None:
+                from ..monitor.metrics import MetricsRecord
+                rec = MetricsRecord(category="component",
+                                    labels={"component": "device_plane"})
+                _dispatch_counter = rec.counter("device_dispatch_total")
+    return _dispatch_counter
+
+
 _held_hist = None
 
 
@@ -409,6 +431,7 @@ class DevicePlane:
         its bookkeeping simple and errors surface at the (ordered)
         materialisation point."""
         inflight_now = self._acquire(nbytes, should_abort, on_wait)
+        dispatch_counter().add(1)
         if self.budget_bytes:
             held_fraction_histogram().observe(
                 inflight_now / self.budget_bytes)

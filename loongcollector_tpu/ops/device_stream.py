@@ -378,7 +378,10 @@ class WidthAutoTuner:
         self._lock = threading.Lock()
         # keyed (lane, L): lane None is the process-global stream; chip
         # lanes (loongmesh) get their own floors so one sparse chip's
-        # traffic cannot shrink the geometry every other chip dispatches
+        # traffic cannot shrink the geometry every other chip dispatches;
+        # fused pipeline programs (loongresident) key their floors per
+        # program as "fused:<sig>" pseudo-lanes — a sparse fused pipeline
+        # must not shrink the staged plane's geometry (or vice versa)
         self._buckets: Dict[Tuple[Optional[int], int], _BucketState] = {}
         self._flush_deadline_s = self.DEADLINE_DEFAULT_S
         self._last_adjust = 0.0
@@ -467,9 +470,17 @@ class WidthAutoTuner:
         with self._lock:
             lanes: Dict[str, dict] = {}
             glob: Dict[str, dict] = {}
+            # lane keys mix int chip indices with "fused:<sig>" program
+            # pseudo-lanes (loongresident): chip lanes sort numerically
+            # first, pseudo-lanes after them lexicographically
+            def _lane_sort(kv):
+                lane_k, L_k = kv[0]
+                return (lane_k is not None, isinstance(lane_k, str),
+                        lane_k if isinstance(lane_k, int) else -1,
+                        str(lane_k), L_k)
+
             for (lane, L), st in sorted(self._buckets.items(),
-                                        key=lambda kv: (kv[0][0] is not None,
-                                                        kv[0])):
+                                        key=_lane_sort):
                 if lane is None:
                     glob[str(L)] = _bucket(st)
                 else:

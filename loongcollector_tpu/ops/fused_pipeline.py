@@ -1,0 +1,896 @@
+"""loongresident: single-dispatch pipeline fusion — the AOT stage compiler.
+
+`BENCH_TPU_LAST_GOOD.json` shows the kernel at 128 GB/s while
+`pipeline_e2e_MBps` sits at 2.0: with every device-capable stage running
+its own pack → H2D → dispatch → materialise cycle, an N-stage pipeline
+pays N synchronous device round trips per batch.  ParPaRaw's whole
+contribution is never leaving the device between phases; the DFA
+processing literature composes automata passes into one resident
+execution.  This module does the same for a pipeline's consecutive
+device-capable stages:
+
+* **StageSpec / StageCond** — the declarative resident form of one stage
+  (Tier-1 extract, fused multi-accept scan, structural index, filter keep
+  mask).  A filter condition over a field the in-program extract stage
+  just captured binds to that stage's DEVICE-RESIDENT span columns
+  (``("capture", producer, cap)``) — no host bounce, no re-pack between
+  stages.
+
+* **FusedProgramKernel** — ONE jitted program per (stage list, B, L)
+  geometry composed from the existing kernel cores
+  (``build_extract_fn`` / ``build_fused_scan_fn`` / ``build_index_fn`` /
+  ``build_dfa_match_fn`` / ``build_dfa_span_match_fn``): inputs packed
+  once, inter-stage columns stay in HBM, every stage's outputs
+  materialise together in one D2H.  ``donated_call`` mirrors the
+  loongstream donated-buffer contract.
+
+* **FusedDispatch** — the dispatch handle riding the EXISTING machinery:
+  batch-ring slots (no allocator churn), the DevicePlane byte budget with
+  the never-sleep-owning-budget drain rule, ≤ depth chunks in flight
+  (loongstream window), WidthAutoTuner floors keyed per fused program
+  (``("fused", sig)`` pseudo-lane buckets; a real chip lane's per-chip
+  floors win on mesh hosts), chip-lane placement via the engine's
+  ``_LanePlacedKernel``.  Per-chunk fault isolation DEMOTES a failing
+  chunk to the per-stage dispatch path (each member stage's own kernel,
+  separate dispatches) — events are never lost; demotions are counted
+  (``fused_demotions_total``) and alarmed once per program.
+
+* **Program cache** — content-addressed like the DFA cache: in-process
+  LRU keyed by the sha256 of the stage identity list, plus
+  ``<data_dir>/fused_cache/`` plan records persisting the stage list and
+  the observed (B, L) geometries so a restart skips plan construction
+  (``fused_program_cache_{hit,miss}_total``) and can AOT-warm the jit
+  geometries (``LOONG_FUSED_WARM=1``).
+
+Chaos point ``device_plane.fused_dispatch`` faults the materialise edge:
+ERROR demotes that one chunk to the per-stage path, DELAY exercises the
+ring deadline.  ``stage_fusion_status()`` feeds the /debug/status
+``stage_fusion`` section and ``bench.py`` ``extra.stage_fusion``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import chaos
+from . import chip_lanes
+from .chip_lanes import ChipLaneFault, lane_gated
+from .device_batch import (LENGTH_BUCKETS, MAX_BATCH, pad_batch,
+                           pick_length_bucket)
+from .device_stream import auto_tuner, batch_ring, h2d_gated, stream_depth
+
+FP_FUSED_DISPATCH = chaos.register_point("device_plane.fused_dispatch")
+
+CACHE_VERSION = 1
+ENV_FUSED = "LOONG_FUSED"
+ENV_WARM = "LOONG_FUSED_WARM"
+ENV_CACHE = "LOONG_FUSED_CACHE"
+
+#: flat-output width per stage kind
+_STAGE_WIDTH = {"extract": 3, "scan": 1, "struct_index": 4, "keep": 1}
+
+
+def fusion_enabled() -> bool:
+    """Stage fusion routing: ``LOONG_FUSED=1`` forces, ``=0`` disables;
+    unset → auto, ON exactly when the engines' own routing default is the
+    device tier (an accelerator backend).  In host mode the per-stage
+    native walkers already skip the round trips fusion exists to remove,
+    so fusing there would only FORCE device dispatches the router proved
+    slower."""
+    env = os.environ.get(ENV_FUSED)
+    if env is not None:
+        return env != "0"
+    try:
+        from .regex.engine import _native_host_mode
+        return not _native_host_mode()
+    except Exception:  # noqa: BLE001 — no backend ⇒ no fusion
+        return False
+
+
+# ---------------------------------------------------------------------------
+# stage model
+# ---------------------------------------------------------------------------
+
+
+class StageCond:
+    """One boolean condition of a 'keep' stage (a filter Include/Exclude
+    entry in resident form).
+
+    kind: ``match`` (DFA full-match over the source rows), ``extract_ok``
+    (Tier-1 program ok bit over the source rows), ``span_match`` (DFA
+    full-match over a PRIOR stage's capture span, device-resident —
+    ``binding=(producer_stage_idx, cap_idx)``).  ``staged`` is the
+    condition's own kernel for the per-stage demotion path."""
+
+    __slots__ = ("kind", "payload", "binding", "negate", "staged", "ident")
+
+    def __init__(self, kind: str, payload, ident,
+                 binding: Optional[Tuple[int, int]] = None,
+                 negate: bool = False, staged: Optional[Callable] = None):
+        self.kind = kind
+        self.payload = payload
+        self.binding = binding
+        self.negate = negate
+        self.staged = staged
+        self.ident = ident
+
+
+class StageSpec:
+    """Declarative resident form of one device-capable pipeline stage.
+
+    kind: ``extract`` (Tier-1 segment program → ok + capture spans),
+    ``scan`` (fused multi-accept automaton → tag bitmask), ``struct_index``
+    (structural bitmaps), ``keep`` (filter mask over StageConds).
+
+    ``ident`` is the canonical content identity (pattern strings, mode)
+    the program cache hashes; ``staged`` is the stage's OWN kernel (the
+    existing per-stage dispatch path) used when a chunk demotes;
+    ``terminal`` marks stages that rebuild the row population (multiline
+    classify) and therefore must end a fused run."""
+
+    __slots__ = ("kind", "payload", "ident", "staged", "terminal", "label")
+
+    def __init__(self, kind: str, payload, ident, staged=None,
+                 terminal: bool = False, label: str = ""):
+        self.kind = kind
+        self.payload = payload
+        self.ident = ident
+        self.staged = staged
+        self.terminal = terminal
+        self.label = label or kind
+
+    @property
+    def width(self) -> int:
+        return _STAGE_WIDTH[self.kind]
+
+
+def build_fused_fn(specs: Sequence[StageSpec]):
+    """Compose the member stages' kernel cores into ONE jit-able
+    f(rows u8 [B,L], lengths i32 [B]) -> flat tuple of stage outputs.
+    Inter-stage values (capture spans feeding span-bound conditions) are
+    jnp values — XLA keeps them in HBM; nothing crosses back to the host
+    until the caller materialises the flat tuple once."""
+    from .kernels.dfa_scan import (build_dfa_match_fn,
+                                   build_dfa_span_match_fn,
+                                   build_fused_scan_fn)
+    from .kernels.field_extract import build_extract_fn
+    from .kernels.struct_index import build_index_fn
+
+    stage_fns: List = []
+    for spec in specs:
+        if spec.kind == "extract":
+            stage_fns.append(build_extract_fn(spec.payload))
+        elif spec.kind == "scan":
+            stage_fns.append(build_fused_scan_fn(spec.payload))
+        elif spec.kind == "struct_index":
+            mode, sep = spec.payload
+            stage_fns.append(build_index_fn(mode, sep))
+        elif spec.kind == "keep":
+            fns = []
+            for cond in spec.payload:
+                if cond.kind == "match":
+                    fns.append(build_dfa_match_fn(cond.payload))
+                elif cond.kind == "span_match":
+                    fns.append(build_dfa_span_match_fn(cond.payload))
+                elif cond.kind == "extract_ok":
+                    fns.append(build_extract_fn(cond.payload))
+                else:  # pragma: no cover
+                    raise AssertionError(cond.kind)
+            stage_fns.append(fns)
+        else:  # pragma: no cover
+            raise AssertionError(spec.kind)
+
+    def fused(rows, lengths):
+        stage_outs: List[Tuple] = []
+        flat: List = []
+        for spec, fn in zip(specs, stage_fns):
+            if spec.kind == "extract":
+                outs = tuple(fn(rows, lengths))
+            elif spec.kind == "scan":
+                outs = (fn(rows, lengths),)
+            elif spec.kind == "struct_index":
+                outs = tuple(fn(rows, lengths))
+            else:  # keep
+                keep = None
+                for cond, cfn in zip(spec.payload, fn):
+                    if cond.kind == "match":
+                        # absent named-source rows (length -1) never
+                        # match — the staged path's ``ok & src.present``
+                        ok = cfn(rows, lengths) & (lengths >= 0)
+                    elif cond.kind == "extract_ok":
+                        ok = cfn(rows, lengths)[0] & (lengths >= 0)
+                    else:  # span_match: prior stage's device-resident spans
+                        prod, cap = cond.binding
+                        _p_ok, p_off, p_len = stage_outs[prod]
+                        ok = cfn(rows, lengths, p_off[:, cap], p_len[:, cap])
+                    if cond.negate:
+                        ok = ~ok
+                    keep = ok if keep is None else (keep & ok)
+                outs = (keep,)
+            stage_outs.append(outs)
+            flat.extend(outs)
+        return tuple(flat)
+
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# the compiled program
+# ---------------------------------------------------------------------------
+
+
+class FusedProgramKernel:
+    """Owns the jitted fused program for one stage list.
+
+    jit caches per (B, L) geometry internally; the dispatcher quantises
+    shapes through the device_batch buckets and the tuner's per-program
+    floors so each geometry compiles once.  ``dispatch_count`` counts
+    fused dispatches — the single-dispatch-per-batch-slot acceptance
+    assertion reads it directly."""
+
+    def __init__(self, specs: Sequence[StageSpec], signature: str):
+        import jax
+        self.specs = list(specs)
+        self.signature = signature
+        self._fn = jax.jit(build_fused_fn(self.specs))
+        self._fn_donated = None
+        self._donated_lock = threading.Lock()
+        self._lane_kernels: Dict[int, object] = {}
+        self._kernel_override = None
+        self.dispatch_count = 0
+        self.demotions = 0
+        self.lane_respills = 0
+        self.roundtrip_ms_total = 0.0
+        self.idle_attr_ms = 0.0
+        self.geometries: set = set()
+        self._geom_dirty = False
+        self.layout: List[Tuple[int, int]] = []
+        i = 0
+        for spec in self.specs:
+            self.layout.append((i, spec.width))
+            i += spec.width
+        self.n_outputs = i
+
+    # -- dispatch entry points ---------------------------------------------
+
+    def __call__(self, rows, lengths):
+        self.dispatch_count += 1
+        return self._fn(rows, lengths)
+
+    def donated_call(self, rows, lengths):
+        """Streaming-path variant (see ExtractKernel.donated_call): the
+        batch-ring staging buffers are transient, so their device copies
+        are donated and XLA reuses that HBM for the outputs."""
+        from .kernels.field_extract import donation_supported
+        if not donation_supported():
+            return self.__call__(rows, lengths)
+        self.dispatch_count += 1
+        return self._donated_fn()(rows, lengths)
+
+    def _donated_fn(self):
+        if self._fn_donated is None:
+            with self._donated_lock:
+                if self._fn_donated is None:
+                    import jax
+                    self._fn_donated = jax.jit(build_fused_fn(self.specs),
+                                               donate_argnums=(0, 1))
+        return self._fn_donated
+
+    def set_kernel_override(self, kern) -> None:
+        """Test/bench hook (mirrors RegexEngine.set_device_kernel_override):
+        route this program's fused dispatches through ``kern`` — e.g. a
+        LatencyInjectedKernel wrapping the jitted program to model a
+        remote chip.  None restores normal selection."""
+        self._kernel_override = kern
+
+    def for_lane(self, lane):
+        """Chip-lane placement (loongmesh): the fused program executes on
+        the dispatching worker's home chip through the same placed-kernel
+        wrapper the engines use."""
+        k = self._lane_kernels.get(lane.index)
+        if k is None:
+            from .regex.engine import _LanePlacedKernel
+            k = _LanePlacedKernel(self, lane)
+            self._lane_kernels[lane.index] = k
+        return k
+
+    # -- per-stage demotion path -------------------------------------------
+
+    def staged_run(self, rows: np.ndarray, lengths: np.ndarray) -> List:
+        """The existing per-stage dispatch path over one packed chunk:
+        each member stage's OWN kernel runs as its own dispatch and its
+        outputs materialise before the next stage needs them (span-bound
+        conditions read the producer's materialised captures).  This is
+        the fault-isolation target — dispatch count N instead of 1,
+        answers identical; the host pulls between stages here are the
+        demotion tier by design."""
+        outs: List[Tuple[np.ndarray, ...]] = []
+        lens_np = np.asarray(lengths)
+        for spec in self.specs:
+            if spec.kind in ("extract", "scan", "struct_index"):
+                raw = spec.staged(rows, lengths)
+                if not isinstance(raw, (tuple, list)):
+                    raw = (raw,)
+                # demotion tier by design: per-stage dispatches with
+                # materialised hand-off IS the per-stage fallback path
+                # loonglint: disable=host-bounce
+                outs.append(tuple(np.asarray(a) for a in raw))
+            else:  # keep
+                keep: Optional[np.ndarray] = None
+                for cond in spec.payload:
+                    if cond.kind == "match":
+                        # loonglint: disable=host-bounce
+                        ok = np.asarray(cond.staged(rows, lengths)) \
+                            & (lens_np >= 0)
+                    elif cond.kind == "extract_ok":
+                        # loonglint: disable=host-bounce
+                        ok = np.asarray(cond.staged(rows, lengths)[0]) \
+                            & (lens_np >= 0)
+                    else:
+                        prod, cap = cond.binding
+                        _ok, p_off, p_len = outs[prod]
+                        # loonglint: disable=host-bounce
+                        ok = np.asarray(cond.staged(
+                            rows, lengths, p_off[:, cap], p_len[:, cap]))
+                    if cond.negate:
+                        ok = ~ok
+                    keep = ok if keep is None else (keep & ok)
+                outs.append((keep,))
+        return outs
+
+    # -- geometry ledger ----------------------------------------------------
+
+    def note_geometry(self, B: int, L: int) -> None:
+        if (B, L) not in self.geometries:
+            self.geometries.add((B, L))
+            self._geom_dirty = True
+            _persist_plan(self)
+
+    def warm(self) -> int:
+        """AOT-compile the persisted geometries (restart warm start): the
+        first data batch of a known shape then hits a ready executable.
+        Warms the DONATED variant where donation is real — that is the
+        jit the steady-state dispatch path actually runs — else the
+        plain one.  Returns the number of geometries compiled."""
+        from .kernels.field_extract import donation_supported
+        fn = self._donated_fn() if donation_supported() else self._fn
+        n = 0
+        for B, L in sorted(self.geometries):
+            rows = np.zeros((B, L), dtype=np.uint8)
+            lens = np.zeros(B, dtype=np.int32)
+            fn(rows, lens)
+            n += 1
+        return n
+
+    def status(self) -> dict:
+        return {
+            "signature": self.signature,
+            "stages": [s.label for s in self.specs],
+            "dispatches": self.dispatch_count,
+            "demotions": self.demotions,
+            "lane_respills": self.lane_respills,
+            "geometries": sorted(f"{b}x{l}" for b, l in self.geometries),
+            "roundtrip_ms_total": round(self.roundtrip_ms_total, 3),
+            "idle_while_backlogged_attr_ms": round(self.idle_attr_ms, 3),
+        }
+
+
+# ---------------------------------------------------------------------------
+# content-addressed program cache (mem LRU + <data_dir>/fused_cache/)
+# ---------------------------------------------------------------------------
+
+_mem_cache: "OrderedDict[str, FusedProgramKernel]" = OrderedDict()
+_mem_cache_lock = threading.Lock()
+_MEM_CACHE_MAX = 64
+_cache_dir: Optional[str] = None
+
+
+def set_cache_dir(path: Optional[str]) -> None:
+    """Application startup hook (mirrors fuse.set_cache_dir): fused plan
+    records persist under ``<data_dir>/fused_cache/``."""
+    global _cache_dir
+    _cache_dir = path
+
+
+def _resolved_cache_dir() -> Optional[str]:
+    env = os.environ.get(ENV_CACHE)
+    if env:
+        return env
+    return _cache_dir
+
+
+def program_signature(specs: Sequence[StageSpec]) -> str:
+    blob = json.dumps([CACHE_VERSION] + [_jsonable(s.ident) for s in specs],
+                      ensure_ascii=False)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:20]
+
+
+def _jsonable(ident):
+    if isinstance(ident, (list, tuple)):
+        return [_jsonable(x) for x in ident]
+    return ident
+
+
+def _plan_path(dirname: str, signature: str) -> str:
+    return os.path.join(dirname, "fused_cache",
+                        f"v{CACHE_VERSION}_{signature}.json")
+
+
+def _persist_plan(program: FusedProgramKernel) -> None:
+    dirname = _resolved_cache_dir()
+    if not dirname or not program._geom_dirty:
+        return
+    program._geom_dirty = False
+    path = _plan_path(dirname, program.signature)
+    doc = {
+        "version": CACHE_VERSION,
+        "stages": [_jsonable(s.ident) for s in program.specs],
+        "geometries": sorted([b, l] for b, l in program.geometries),
+    }
+    tmp = path + f".tmp.{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _load_plan(signature: str, specs: Sequence[StageSpec]) -> Optional[dict]:
+    dirname = _resolved_cache_dir()
+    if not dirname:
+        return None
+    try:
+        with open(_plan_path(dirname, signature), "r",
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("version") != CACHE_VERSION:
+        return None
+    # hash-collision / stale-content guard, like the DFA cache: the stage
+    # identity list as given must match the stored plan exactly
+    if doc.get("stages") != [_jsonable(s.ident) for s in specs]:
+        return None
+    return doc
+
+
+def get_fused_program(specs: Sequence[StageSpec]) -> FusedProgramKernel:
+    """The two-level content-addressed cache: in-process LRU (hot-reloads
+    reuse compiled programs) and the on-disk plan record (restarts skip
+    plan construction and recover the geometry set for AOT warm)."""
+    signature = program_signature(specs)
+    with _mem_cache_lock:
+        got = _mem_cache.get(signature)
+        if got is not None:
+            _mem_cache.move_to_end(signature)
+    if got is not None:
+        _count("fused_program_cache_hit_total")
+        return got
+    plan = _load_plan(signature, specs)
+    program = FusedProgramKernel(specs, signature)
+    if plan is not None:
+        _count("fused_program_cache_hit_total")
+        program.geometries = {(int(b), int(l))
+                              for b, l in plan.get("geometries", [])}
+        if os.environ.get(ENV_WARM) == "1":
+            try:
+                program.warm()
+            except Exception:  # noqa: BLE001 — warm is best-effort
+                pass
+    else:
+        _count("fused_program_cache_miss_total")
+        program._geom_dirty = True
+        _persist_plan(program)
+    with _mem_cache_lock:
+        # first-wins on a concurrent miss: every caller must share ONE
+        # kernel object or per-program dispatch/demotion accounting (and
+        # the jit cache) splits across losers — the aggregator-base
+        # lazy-init race shape.  Construction above is cheap (jit
+        # compiles lazily at first call), so a discarded loser wastes
+        # closures, not a compile.
+        existing = _mem_cache.get(signature)
+        if existing is not None:
+            program = existing
+        else:
+            _mem_cache[signature] = program
+        _mem_cache.move_to_end(signature)
+        while len(_mem_cache) > _MEM_CACHE_MAX:
+            _mem_cache.popitem(last=False)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# metrics / status / alarm
+# ---------------------------------------------------------------------------
+
+_metrics_rec = None
+_metrics_lock = threading.Lock()
+_alarmed_programs: set = set()
+
+
+def _metrics():
+    global _metrics_rec
+    if _metrics_rec is None:
+        with _metrics_lock:
+            if _metrics_rec is None:
+                from ..monitor.metrics import MetricsRecord
+                _metrics_rec = MetricsRecord(
+                    category="component",
+                    labels={"component": "loongresident"})
+    return _metrics_rec
+
+
+def _count(name: str, delta: int = 1) -> None:
+    try:
+        _metrics().counter(name).add(delta)
+    except Exception:  # noqa: BLE001 — stats must never break dispatch
+        pass
+
+
+def _note_demotion(program: FusedProgramKernel, reason: str) -> None:
+    """A chunk fell off the fused program to the per-stage path: counted
+    always, alarmed once per program — silent demotion would hide exactly
+    the round-trip regression this layer exists to remove."""
+    program.demotions += 1
+    _count("fused_demotions_total")
+    with _metrics_lock:
+        if program.signature in _alarmed_programs:
+            return
+        _alarmed_programs.add(program.signature)
+    try:
+        from ..monitor.alarms import AlarmManager, AlarmType
+        AlarmManager.instance().send_alarm(
+            AlarmType.FUSED_DEMOTED,
+            f"fused pipeline program {program.signature} demoted a chunk "
+            f"to per-stage dispatch ({reason}); stages="
+            f"{[s.label for s in program.specs]}")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def stage_fusion_status() -> dict:
+    """The /debug/status ``stage_fusion`` section and bench.py
+    ``extra.stage_fusion`` source: per-program dispatch/demotion rows plus
+    the cache counters."""
+    with _mem_cache_lock:
+        programs = [p.status() for p in _mem_cache.values()]
+    doc = {"enabled": fusion_enabled(), "programs": programs}
+    try:
+        rec = _metrics()
+        for name in ("fused_program_cache_hit_total",
+                     "fused_program_cache_miss_total",
+                     "fused_demotions_total", "fused_dispatch_total",
+                     "fused_lane_respill_total"):
+            doc[name] = int(rec.counter(name).value)
+    except Exception:  # noqa: BLE001
+        pass
+    return doc
+
+
+def reset_for_testing() -> None:
+    """Clear the in-process program cache and one-shot alarm state (tests
+    must not inherit another test's dispatch counters or cache hits).
+    Metrics records persist — process-lifetime instruments."""
+    global _cache_dir
+    with _mem_cache_lock:
+        _mem_cache.clear()
+    with _metrics_lock:
+        _alarmed_programs.clear()
+    _cache_dir = None
+
+
+# ---------------------------------------------------------------------------
+# the dispatch handle
+# ---------------------------------------------------------------------------
+
+
+class FusedBatchResult:
+    """Assembled per-stage outputs in original row order.
+
+    ``stages[i]`` for stage kind: extract → (ok bool [n], cap_off i32
+    [n, C] ARENA-ABSOLUTE, cap_len i32 [n, C]); scan → (tags uint32 [n],);
+    keep → (keep bool [n],); struct_index → (in_string, structural,
+    escaped, quote) bool [n, Lmax]."""
+
+    __slots__ = ("stages", "n")
+
+    def __init__(self, stages: List[Tuple[np.ndarray, ...]], n: int):
+        self.stages = stages
+        self.n = n
+
+
+class FusedDispatch:
+    """One group's fused parse in flight (the PendingParse of the fused
+    plane).  ``dispatch()`` packs chunks into leased batch-ring slots and
+    submits the ONE fused program per chunk under the DevicePlane budget
+    with ≤ depth chunks in flight; ``result()`` materialises in order and
+    assembles per-stage outputs.  Fault isolation mirrors PendingParse:
+    an injected ``device_plane.fused_dispatch`` (or h2d/submit) fault, a
+    chip-lane fault, or a real kernel failure costs that ONE chunk a
+    demotion to the per-stage dispatch path — never events, never ring
+    order.  Every path releases the chunk's slot, budget and lane bytes."""
+
+    __slots__ = ("program", "arena", "offsets", "lengths", "depth",
+                 "_pending", "_stage_bufs", "_struct_parts", "_result",
+                 "_n", "_idle_ms0", "_plane")
+
+    def __init__(self, program: FusedProgramKernel, arena: np.ndarray,
+                 offsets: np.ndarray, lengths: np.ndarray,
+                 depth: Optional[int] = None):
+        self.program = program
+        self.arena = arena
+        self.offsets = np.asarray(offsets, dtype=np.int64)
+        self.lengths = np.asarray(lengths, dtype=np.int32)
+        self.depth = max(1, depth if depth is not None else stream_depth())
+        self._n = len(self.offsets)
+        # [(chunk_idx, DeviceBatch, BatchSlot, DeviceFuture, lane)]
+        self._pending: List = []
+        self._stage_bufs = self._alloc_stage_bufs()
+        self._struct_parts: Dict[int, List] = {}
+        self._result: Optional[FusedBatchResult] = None
+        from .device_plane import DevicePlane
+        self._plane = DevicePlane.instance()
+        self._idle_ms0 = \
+            self._plane.utilization()["idle_while_backlogged_ms"]
+
+    # -- assembly buffers ---------------------------------------------------
+
+    def _alloc_stage_bufs(self) -> List:
+        n = self._n
+        bufs: List = []
+        for spec in self.program.specs:
+            if spec.kind == "extract":
+                C = max(spec.payload.num_caps, 1)
+                bufs.append((np.zeros(n, dtype=bool),
+                             np.zeros((n, C), dtype=np.int32),
+                             np.full((n, C), -1, dtype=np.int32)))
+            elif spec.kind == "scan":
+                bufs.append((np.zeros(n, dtype=np.uint32),))
+            elif spec.kind == "keep":
+                bufs.append((np.zeros(n, dtype=bool),))
+            else:  # struct_index: ragged per-chunk widths, assembled late
+                bufs.append(None)
+        return bufs
+
+    # -- dispatch -----------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._result is not None
+
+    def dispatch(self) -> "FusedDispatch":
+        ring = batch_ring()
+        tuner = auto_tuner()
+        program = self.program
+        lane = chip_lanes.current_lane()
+        lane_count = chip_lanes.router().lane_count() if lane is not None \
+            else 0
+        max_bucket = LENGTH_BUCKETS[-1]
+        device_idx = np.arange(self._n)
+        try:
+            for start in range(0, self._n, MAX_BATCH):
+                chunk = device_idx[start:start + MAX_BATCH]
+                if lane is not None and not lane.breaker.allow_probe():
+                    # lane OPEN (or the half-open probe is in flight): the
+                    # chip is sick — this chunk demotes to the per-stage
+                    # path on the base kernels until the probe re-closes
+                    # it.  Events still flow, counted as lane respill.
+                    lane.note_respill(len(chunk))
+                    program.lane_respills += 1
+                    _count("fused_lane_respill_total")
+                    self._staged_into(chunk)
+                    continue
+                while len(self._pending) >= self.depth:
+                    self._drain_one()
+                while lane is not None \
+                        and lane.over_share(self._plane, lane_count) \
+                        and self._pending:
+                    self._drain_one()
+                override = program._kernel_override
+                if override is not None:
+                    def call(r, l, _o=override, _p=program):
+                        _p.dispatch_count += 1
+                        return _o(r, l)
+                elif lane is None:
+                    call = program.donated_call
+                else:
+                    call = lane_gated(lane,
+                                      program.for_lane(lane).donated_call)
+                d_off = self.offsets[chunk]
+                d_len = self.lengths[chunk]
+                L = pick_length_bucket(int(d_len.max()) if len(d_len)
+                                       else 1) or max_bucket
+                lane_key = lane.index if lane is not None \
+                    else f"fused:{program.signature[:8]}"
+                B = pad_batch(len(chunk),
+                              min_batch=tuner.min_batch_for(L, lane_key))
+                program.note_geometry(B, L)
+                slot = ring.lease(B, L)
+                try:
+                    batch = slot.pack(self.arena, d_off, d_len,
+                                      lane=lane_key)
+                    fut = self._plane.submit(
+                        h2d_gated(call), (batch.rows, batch.lengths),
+                        batch.rows.nbytes, on_wait=self._drain_if_pending)
+                except BaseException:
+                    slot.release()
+                    raise
+                _count("fused_dispatch_total")
+                if lane is not None:
+                    lane.note_pack(B, batch.n_real)
+                    lane.note_dispatch(batch.rows.nbytes)
+                self._pending.append((chunk, batch, slot, fut, lane))
+        except BaseException:
+            # a failed pack/submit must not strand the budget, ring slots
+            # or lane accounting held by already-submitted chunks
+            for _c, b, slot, fut, ln in self._pending:
+                fut.release()
+                if ln is not None:
+                    ln.note_done(b.rows.nbytes)
+                    ln.breaker.on_inconclusive()
+                slot.release()
+            self._pending.clear()
+            raise
+        return self
+
+    def _drain_if_pending(self) -> bool:
+        if not self._pending:
+            return False
+        self._drain_one()
+        return True
+
+    # -- materialisation ----------------------------------------------------
+
+    def _drain_one(self) -> None:
+        chunk, batch, slot, fut, lane = self._pending.pop(0)
+        program = self.program
+        t0 = time.perf_counter()
+        try:
+            try:
+                chaos.faultpoint(FP_FUSED_DISPATCH)
+                flat = fut.result()
+                if lane is not None:
+                    lane.breaker.on_success()
+            except ChipLaneFault:
+                # injected single-chip fault: feed the lane breaker and
+                # demote THIS chunk to the per-stage path on the base
+                # kernels — the other chips' lanes never notice
+                fut.release()
+                lane.breaker.on_failure()
+                lane.note_fault()
+                lane.note_respill(int(batch.n_real))
+                program.lane_respills += 1
+                _count("fused_lane_respill_total")
+                flat = self._staged_flat(batch, lane)
+            except chaos.ChaosFault:
+                # injected fused-dispatch (or h2d/submit) fault: the slot
+                # still holds the packed rows — demote this ONE chunk to
+                # the existing per-stage dispatch path, keep ring order
+                fut.release()
+                _note_demotion(program, "chaos fault at materialise")
+                flat = self._staged_flat(batch, lane)
+            except Exception as e:  # noqa: BLE001
+                # real kernel failure (Mosaic/mesh/runtime): cost must be
+                # dispatch count, never liveness — demote the chunk; a
+                # failure on the per-stage path too propagates (that path
+                # is the proven one)
+                fut.release()
+                if lane is not None:
+                    lane.breaker.on_failure()
+                    lane.note_fault()
+                _note_demotion(program, f"kernel failure: {e!r}")
+                flat = self._staged_flat(batch, lane)
+            self._assemble(chunk, batch, flat)
+            program.roundtrip_ms_total += (time.perf_counter() - t0) * 1e3
+        finally:
+            if lane is not None:
+                lane.note_done(batch.rows.nbytes)
+            slot.release()
+
+    def _staged_flat(self, batch, lane) -> List[np.ndarray]:
+        """Per-stage re-run of a demoted chunk (already packed in its
+        slot).  The half-open probe outcome must reach the breaker: a
+        clean per-stage run closes it, a failing one is inconclusive."""
+        try:
+            outs = self.program.staged_run(batch.rows, batch.lengths)
+        except BaseException:
+            if lane is not None:
+                lane.breaker.on_inconclusive()
+            raise
+        if lane is not None:
+            lane.breaker.on_success()
+        return [a for tup in outs for a in tup]
+
+    def _staged_into(self, chunk: np.ndarray) -> None:
+        """Pre-dispatch demotion (lane OPEN): pack into a ring slot and
+        run the per-stage path synchronously."""
+        ring = batch_ring()
+        d_len = self.lengths[chunk]
+        L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
+            or LENGTH_BUCKETS[-1]
+        B = pad_batch(len(chunk))
+        slot = ring.lease(B, L)
+        try:
+            batch = slot.pack(self.arena, self.offsets[chunk], d_len)
+            flat = [a for tup in
+                    self.program.staged_run(batch.rows, batch.lengths)
+                    for a in tup]
+            self._assemble(chunk, batch, flat)
+        finally:
+            slot.release()
+
+    def _assemble(self, chunk: np.ndarray, batch, flat) -> None:
+        n_real = batch.n_real
+        flat = [np.asarray(a) for a in flat]
+        for si, spec in enumerate(self.program.specs):
+            start, width = self.program.layout[si]
+            outs = flat[start:start + width]
+            if spec.kind == "extract":
+                ok_b, off_b, len_b = self._stage_bufs[si]
+                ok_b[chunk] = outs[0][:n_real]
+                # row-relative -> arena-absolute via the pack origins
+                off_b[chunk] = (outs[1][:n_real]
+                                + batch.origins[:n_real, None])
+                len_b[chunk] = outs[2][:n_real]
+            elif spec.kind == "scan":
+                self._stage_bufs[si][0][chunk] = \
+                    outs[0][:n_real].astype(np.uint32)
+            elif spec.kind == "keep":
+                self._stage_bufs[si][0][chunk] = \
+                    np.asarray(outs[0][:n_real], dtype=bool)
+            else:  # struct_index: keep packed words per chunk, unpack late
+                self._struct_parts.setdefault(si, []).append(
+                    (chunk, [o[:n_real] for o in outs], batch.rows.shape[1]))
+
+    def result(self) -> FusedBatchResult:
+        if self._result is not None:
+            return self._result
+        try:
+            while self._pending:
+                self._drain_one()
+        except BaseException:
+            for _c, b, slot, fut, ln in self._pending:
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001 — releasing, not consuming
+                    pass
+                if ln is not None:
+                    ln.note_done(b.rows.nbytes)
+                    ln.breaker.on_inconclusive()
+                slot.release()
+            self._pending.clear()
+            raise
+        stages: List[Tuple[np.ndarray, ...]] = []
+        for si, spec in enumerate(self.program.specs):
+            if spec.kind == "struct_index":
+                stages.append(self._finish_struct(si))
+            else:
+                stages.append(self._stage_bufs[si])
+        idle_now = self._plane.utilization()["idle_while_backlogged_ms"]
+        self.program.idle_attr_ms += max(0.0, idle_now - self._idle_ms0)
+        self._result = FusedBatchResult(stages, self._n)
+        self.arena = None
+        return self._result
+
+    def _finish_struct(self, si: int) -> Tuple[np.ndarray, ...]:
+        from .kernels.struct_index import unpack16
+        parts = self._struct_parts.get(si, [])
+        Lmax = max((L for _c, _m, L in parts), default=0)
+        out = tuple(np.zeros((self._n, Lmax), dtype=bool) for _ in range(4))
+        for chunk, masks, L in parts:
+            for mi in range(4):
+                out[mi][chunk, :L] = unpack16(masks[mi], L)
+        return out

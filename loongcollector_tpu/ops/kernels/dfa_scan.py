@@ -28,20 +28,31 @@ import numpy as np
 from ..regex.dfa import DFA
 
 
-def build_dfa_match_fn(dfa: DFA):
-    """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) -> ok bool [B]."""
-    S = dfa.num_states
-    K = dfa.num_classes
+def _lockstep_core(automaton):
+    """The shared half of every lockstep matcher — works for a
+    single-pattern DFA and a fused multi-accept automaton alike (both
+    carry num_states/num_classes/transitions/start/byte_class_intervals).
+
+    Returns (K, byte_classes, run): ``byte_classes`` classifies a [B, L]
+    byte tensor via interval compares (no LUT gather); ``run(cls)``
+    advances all rows in lockstep — state carried ONE-HOT [B, S] in
+    bfloat16, each step contracting (state ⊗ class one-hot) with the
+    dense [(K+1)·S, S] transition tensor on the MXU, class K being the
+    identity freeze class — and returns the final one-hot states.  The
+    builders below differ only in how they VALIDITY-mask the class ids
+    (whole row vs span) and what they read off the final states (accept
+    bit vs tag bitmask)."""
+    S = automaton.num_states
+    K = automaton.num_classes
     # dense transition tensor T[k*S+s, s'] = 1 iff δ(s, k) = s'
     T = np.zeros((K * S, S), dtype=np.float32)
     for s in range(S):
         for k in range(K):
-            T[k * S + s, int(dfa.transitions[s, k])] = 1.0
+            T[k * S + s, int(automaton.transitions[s, k])] = 1.0
     T_dev = jnp.asarray(T, dtype=jnp.bfloat16)
-    # extend T with an identity block for the past-the-end freeze class
+    # extend T with an identity block for the freeze class
     T_ext = jnp.concatenate([T_dev, jnp.eye(S, dtype=jnp.bfloat16)], axis=0)
-    class_intervals = dfa.byte_class_intervals()
-    accepting = jnp.asarray(dfa.accepting)
+    class_intervals = automaton.byte_class_intervals()
 
     def byte_classes(rows: jnp.ndarray) -> jnp.ndarray:
         """uint8 [B, L] -> int32 [B, L] class ids via interval compares."""
@@ -56,14 +67,9 @@ def build_dfa_match_fn(dfa: DFA):
             cls = jnp.where(m, k, cls)
         return cls
 
-    def match(rows: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        B, L = rows.shape
-        cls = byte_classes(rows)                                   # [B, L]
-        pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
-        # past-the-end positions freeze the state: encode as class K (identity)
-        cls = jnp.where(pos_valid, cls, K)
-
-        state0 = jax.nn.one_hot(dfa.start, S, dtype=jnp.bfloat16)
+    def run(cls: jnp.ndarray) -> jnp.ndarray:
+        B = cls.shape[0]
+        state0 = jax.nn.one_hot(automaton.start, S, dtype=jnp.bfloat16)
         state0 = jnp.broadcast_to(state0, (B, S))
 
         def step(state, cls_t):
@@ -73,11 +79,92 @@ def build_dfa_match_fn(dfa: DFA):
             nxt = jnp.dot(z, T_ext, preferred_element_type=jnp.bfloat16)
             return nxt, None
 
-        final, _ = jax.lax.scan(step, state0, cls.T)               # scan over L
-        final_state = jnp.argmax(final, axis=1)
+        final, _ = jax.lax.scan(step, state0, cls.T)       # scan over L
+        return final
+
+    return K, byte_classes, run
+
+
+def build_dfa_match_fn(dfa: DFA):
+    """Returns jit-able f(rows u8 [B,L], lengths i32 [B]) -> ok bool [B]."""
+    K, byte_classes, run = _lockstep_core(dfa)
+    accepting = jnp.asarray(dfa.accepting)
+
+    def match(rows: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        L = rows.shape[1]
+        cls = byte_classes(rows)                                   # [B, L]
+        pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+        # past-the-end positions freeze the state: encode as class K (identity)
+        cls = jnp.where(pos_valid, cls, K)
+        final_state = jnp.argmax(run(cls), axis=1)
         return jnp.take(accepting, final_state)
 
     return match
+
+
+def build_dfa_span_match_fn(dfa: DFA):
+    """jit-able f(rows u8 [B,L], lengths i32 [B], starts i32 [B],
+    spanlens i32 [B]) -> ok bool [B]: full-match of the DFA against the
+    row-relative SPAN [starts, starts+spanlens) of each row instead of the
+    whole row.
+
+    loongresident: this is the inter-stage composition primitive of the
+    fused pipeline program — a filter condition on a field the in-program
+    extract stage just captured runs here with the capture spans still
+    DEVICE-RESIDENT (no host bounce, no re-pack).  The lockstep advance is
+    the single-pattern match kernel's; positions outside the span carry
+    the identity freeze class, so the automaton only consumes the field
+    bytes.  A row whose span is absent (spanlen < 0, the failed-parse
+    convention) never matches — mirroring the staged filter's
+    ``ok & src.present`` algebra."""
+    K, byte_classes, run = _lockstep_core(dfa)
+    accepting = jnp.asarray(dfa.accepting)
+
+    def match(rows: jnp.ndarray, lengths: jnp.ndarray,
+              starts: jnp.ndarray, spanlens: jnp.ndarray) -> jnp.ndarray:
+        L = rows.shape[1]
+        cls = byte_classes(rows)
+        pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+        span_end = starts + jnp.maximum(spanlens, 0)
+        inside = ((pos >= starts[:, None]) & (pos < span_end[:, None])
+                  & (pos < lengths[:, None]))
+        cls = jnp.where(inside, cls, K)    # freeze outside the span
+        final_state = jnp.argmax(run(cls), axis=1)
+        return jnp.take(accepting, final_state) & (spanlens >= 0)
+
+    return match
+
+
+class DFASpanMatchKernel:
+    """Owns the jitted span-bound match for one DFA — the per-stage
+    (demoted) twin of the in-program span condition: the fused dispatcher
+    re-runs a faulted chunk through this kernel with the producer stage's
+    materialised spans, so demotion costs dispatches, never answers."""
+
+    def __init__(self, dfa: DFA):
+        self.dfa = dfa
+        self._fn = jax.jit(build_dfa_span_match_fn(dfa))
+
+    def __call__(self, rows, lengths, starts, spanlens) -> np.ndarray:
+        return self._fn(rows, lengths, starts, spanlens)
+
+
+class LazySpanMatchKernel:
+    """DFASpanMatchKernel built on FIRST call.  The fused planner stores
+    this as a capture-bound keep-condition's staged twin, so pipeline
+    init never pays the transition-matrix build and host→device constant
+    transfer for a kernel only the (rare) demotion path runs."""
+
+    __slots__ = ("dfa", "_k")
+
+    def __init__(self, dfa: DFA):
+        self.dfa = dfa
+        self._k = None
+
+    def __call__(self, rows, lengths, starts, spanlens) -> np.ndarray:
+        if self._k is None:
+            self._k = DFASpanMatchKernel(self.dfa)
+        return self._k(rows, lengths, starts, spanlens)
 
 
 def build_fused_scan_fn(fdfa):
@@ -90,15 +177,7 @@ def build_fused_scan_fn(fdfa):
     yields per-pattern indicators, folded into one accept-tag bitmask.
     One device pass classifies every pattern of the fused set at once."""
     S = fdfa.num_states
-    K = fdfa.num_classes
-    T = np.zeros((K * S, S), dtype=np.float32)
-    for s in range(S):
-        for k in range(K):
-            T[k * S + s, int(fdfa.transitions[s, k])] = 1.0
-    T_dev = jnp.asarray(T, dtype=jnp.bfloat16)
-    # extend T with an identity block for the past-the-end freeze class
-    T_ext = jnp.concatenate([T_dev, jnp.eye(S, dtype=jnp.bfloat16)], axis=0)
-    class_intervals = fdfa.byte_class_intervals()
+    K, byte_classes, run = _lockstep_core(fdfa)
     P = max(int(fdfa.accept_tags.max()).bit_length(), 1)
     tag_bits = np.zeros((S, P), dtype=np.float32)
     for s in range(S):
@@ -111,34 +190,12 @@ def build_fused_scan_fn(fdfa):
     pow2 = jnp.asarray(
         np.array([1 << p for p in range(P)], dtype=np.uint32).view(np.int32))
 
-    def byte_classes(rows: jnp.ndarray) -> jnp.ndarray:
-        cls = jnp.zeros(rows.shape, dtype=jnp.int32)
-        for k in range(1, K):
-            m = jnp.zeros(rows.shape, dtype=bool)
-            for lo, hi in class_intervals[k]:
-                if lo == hi:
-                    m = m | (rows == lo)
-                else:
-                    m = m | ((rows >= lo) & (rows <= hi))
-            cls = jnp.where(m, k, cls)
-        return cls
-
     def scan_tags(rows: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-        B, L = rows.shape
+        L = rows.shape[1]
         cls = byte_classes(rows)
         pos_valid = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
         cls = jnp.where(pos_valid, cls, K)      # freeze class past the end
-
-        state0 = jax.nn.one_hot(fdfa.start, S, dtype=jnp.bfloat16)
-        state0 = jnp.broadcast_to(state0, (B, S))
-
-        def step(state, cls_t):
-            coh = jax.nn.one_hot(cls_t, K + 1, dtype=jnp.bfloat16)
-            z = (coh[:, :, None] * state[:, None, :]).reshape(B, (K + 1) * S)
-            nxt = jnp.dot(z, T_ext, preferred_element_type=jnp.bfloat16)
-            return nxt, None
-
-        final, _ = jax.lax.scan(step, state0, cls.T)
+        final = run(cls)
         # multi-accept one-hot contraction: per-pattern indicator columns,
         # folded to a bitmask on the VPU
         ind = jnp.dot(final, bits_dev,

@@ -33,6 +33,7 @@ partition, same aggregates, or the gate fails per row.
 from __future__ import annotations
 
 import math
+import os
 import re
 from dataclasses import dataclass
 from typing import Optional
@@ -67,18 +68,23 @@ def hist_bucket(values: np.ndarray, base: float = HIST_BASE,
     return np.where(np.isinf(v) & (v > 0), n_hist - 1, idx)
 
 
-def parse_values(arena: np.ndarray, val_offs: np.ndarray,
-                 val_lens: np.ndarray):
-    """(values f64 [n], valid bool [n]) from value text spans.
+#: vector parse only reads this many bytes per span; longer tokens (rare:
+#: huge paddings, absurd precision) take the per-row reference path
+_VEC_WIDTH = 32
+#: ≤ 15 decimal digits ⇒ the mantissa integer is exact in f64 and
+#: m / 10^frac is a single correctly-rounded division (Clinger) — the
+#: same fast-path argument the native strtod subset uses
+_VEC_MAX_DIGITS = 15
 
-    Degraded-tier loop by contract (documented above): validation is the
-    shared grammar regex, conversion is Python float() — correctly
-    rounded, so results are bit-identical to the native strtod."""
-    n = len(val_offs)
-    values = np.zeros(n, dtype=np.float64)
-    valid = np.zeros(n, dtype=bool)
+
+def _parse_values_rows(arena: np.ndarray, val_offs: np.ndarray,
+                       val_lens: np.ndarray, rows, values: np.ndarray,
+                       valid: np.ndarray) -> None:
+    """Reference per-row parse of selected rows: the shared grammar regex
+    gates, Python float() converts (correctly rounded ⇒ bit-identical to
+    the native strtod)."""
     buf = memoryview(np.ascontiguousarray(arena))
-    for i in range(n):
+    for i in rows:
         ln = int(val_lens[i])
         if ln < 0:
             continue
@@ -88,26 +94,114 @@ def parse_values(arena: np.ndarray, val_offs: np.ndarray,
             continue
         values[i] = float(tok)
         valid[i] = True
+
+
+def parse_values(arena: np.ndarray, val_offs: np.ndarray,
+                 val_lens: np.ndarray):
+    """(values f64 [n], valid bool [n]) from value text spans.
+
+    The common shape — optional sign, ≤ 15 digits, at most one '.' , no
+    exponent — parses VECTORISED: one byte-matrix gather, per-column
+    digit folds into an exact int64 mantissa, one correctly-rounded
+    division by an exact power of ten.  Clinger's fast-path argument
+    makes that bit-identical to Python float(), which the
+    scripts/agg_equivalence.py gate asserts against the reference loop.
+    Everything else (exponents, inf, over-long, malformed) drops to the
+    per-row reference path — the counted exception, not the steady
+    state.  Part of the BENCH_r11 device-substrate cliff fix: the per-row
+    float() loop priced every twin's fold, not the kernel
+    (``LOONG_AGG_PREP=0`` restores the r11 prep for the bench's
+    before/after)."""
+    n = len(val_offs)
+    values = np.zeros(n, dtype=np.float64)
+    valid = np.zeros(n, dtype=bool)
+    if n == 0:
+        return values, valid
+    if not _prep_opt_enabled():
+        _parse_values_rows(arena, val_offs, val_lens, range(n), values,
+                           valid)
+        return values, valid
+    offs = np.asarray(val_offs, dtype=np.int64)
+    lens = np.asarray(val_lens, dtype=np.int64)
+    W = min(int(lens.max()), _VEC_WIDTH)
+    if W <= 0:
+        # nothing with a positive length; empty spans are invalid by
+        # grammar, negative lengths are the absent convention
+        return values, valid
+    arena_hi = max(len(arena) - 1, 0)
+    idx = offs[:, None] + np.arange(W, dtype=np.int64)[None, :]
+    np.clip(idx, 0, arena_hi, out=idx)
+    mat = arena[idx] if len(arena) else np.zeros((n, W), np.uint8)
+    inrow = np.arange(W, dtype=np.int64)[None, :] < lens[:, None]
+    SPACE = np.uint8(0x20)
+    mat = np.where(inrow, mat, SPACE)      # pad reads as trimmable space
+    is_sp = (mat == 0x20) | (mat == 0x09)
+    nonsp = ~is_sp
+    any_ns = nonsp.any(axis=1)
+    first = np.argmax(nonsp, axis=1)
+    last = W - 1 - np.argmax(nonsp[:, ::-1], axis=1)
+    colpos = np.arange(W, dtype=np.int64)[None, :]
+    is_digit = (mat >= 0x30) & (mat <= 0x39)
+    is_dot = mat == 0x2E
+    sign_byte = mat[np.arange(n), first]
+    has_sign = (sign_byte == 0x2B) | (sign_byte == 0x2D)
+    body_lo = first + has_sign
+    within = (colpos >= body_lo[:, None]) & (colpos <= last[:, None])
+    digits = np.count_nonzero(is_digit & within, axis=1)
+    dots = np.count_nonzero(is_dot & within, axis=1)
+    clean = (within & ~(is_digit | is_dot)).sum(axis=1) == 0
+    fast = (any_ns & clean & (dots <= 1) & (digits >= 1)
+            & (digits <= _VEC_MAX_DIGITS) & (lens <= _VEC_WIDTH)
+            & (body_lo <= last))
+    # per-column mantissa fold: m = m*10 + d over the token's digit
+    # positions (int64-exact: ≤ 15 digits), frac counts digits after the
+    # dot — vector ops per COLUMN, never per row
+    m = np.zeros(n, dtype=np.int64)
+    frac = np.zeros(n, dtype=np.int64)
+    seen_dot = np.zeros(n, dtype=bool)
+    for c in range(W):
+        active = fast & within[:, c]
+        d = is_digit[:, c] & active
+        m = np.where(d, m * 10 + (mat[:, c].astype(np.int64) - 0x30), m)
+        frac = np.where(d & seen_dot, frac + 1, frac)
+        seen_dot = seen_dot | (is_dot[:, c] & active)
+    v = m.astype(np.float64) / np.power(10.0, frac)
+    v = np.where(sign_byte == 0x2D, -v, v)
+    values[fast] = v[fast]
+    valid[fast] = True
+    # rows longer than the window may hide their token past byte W (all
+    # leading spaces): they must take the reference path, not "invalid"
+    slow = np.nonzero((lens >= 0) & ~fast & (any_ns | (lens > W)))[0]
+    if len(slow):
+        _parse_values_rows(arena, val_offs, val_lens, slow, values, valid)
     return values, valid
 
 
 def _key_matrix(arena: np.ndarray, slots: np.ndarray,
-                key_offs: np.ndarray, key_lens: np.ndarray) -> np.ndarray:
+                key_offs: np.ndarray, key_lens: np.ndarray):
     """Length-prefixed key bytes as one uint8 matrix [n, W] — the
     vectorised identity the first-seen grouping runs np.unique over.
     The i32 length prefix keeps absent (-1) distinct from empty and
     ("ab","") distinct from ("a","b"); the slot rides as an i64 prefix
     column so window identity is part of the segment key, exactly as in
-    the native hash."""
+    the native hash.
+
+    Returns (mat, widths): ``widths`` is the per-key padded column width
+    (the batch max per key) — matrix rows are only comparable ACROSS
+    batches together with their widths, because the zero padding between
+    key segments is width-dependent (the merge-side intern cache keys on
+    both)."""
     n, K = key_lens.shape
     parts = [np.ascontiguousarray(slots, dtype="<i8").view(
         np.uint8).reshape(n, 8)]
     arena_hi = max(len(arena) - 1, 0)
+    widths = []
     for k in range(K):
         lens = key_lens[:, k]
         parts.append(np.ascontiguousarray(lens, dtype="<i4").view(
             np.uint8).reshape(n, 4))
         m = int(lens.max()) if n else 0
+        widths.append(max(m, 0))
         if m > 0:
             idx = key_offs[:, k, None] + np.arange(m, dtype=np.int64)[None, :]
             np.clip(idx, 0, arena_hi, out=idx)
@@ -115,19 +209,60 @@ def _key_matrix(arena: np.ndarray, slots: np.ndarray,
                     else np.zeros((n, m), np.uint8))
             mask = np.arange(m, dtype=np.int32)[None, :] < lens[:, None]
             parts.append(np.where(mask, body, 0).astype(np.uint8))
-    return np.concatenate(parts, axis=1)
+    return np.concatenate(parts, axis=1), tuple(widths)
 
 
-def _first_seen_ids(mat: np.ndarray):
-    """(group ids [rows] in first-seen order, representative row per
-    group) — np.unique is lexicographic, so remap through the argsort of
-    first occurrences to match the native assignment order."""
+def _prep_opt_enabled() -> bool:
+    """``LOONG_AGG_PREP=0`` restores the r11 host-prep path (per-row
+    float() parse + full-byte-matrix np.unique) — the bench's before/after
+    comparator for the device-substrate cliff fix."""
+    return os.environ.get("LOONG_AGG_PREP") != "0"
+
+
+def _first_seen_ids_exact(mat: np.ndarray):
+    """Reference grouping: np.unique over the whole byte matrix is
+    lexicographic, so remap through the argsort of first occurrences to
+    match the native assignment order.  This was the BENCH_r11 device
+    cliff's dominant term (~107 of 137 ms per 16 k-row fold)."""
     _uniq, first_idx, inv = np.unique(mat, axis=0, return_index=True,
                                       return_inverse=True)
     order = np.argsort(first_idx, kind="stable")
     remap = np.empty(len(order), dtype=np.int64)
     remap[order] = np.arange(len(order))
     return remap[np.asarray(inv).reshape(-1)], first_idx[order]
+
+
+def _first_seen_ids(mat: np.ndarray):
+    """(group ids [rows] in first-seen order, representative row per
+    group).
+
+    Fast path: a vectorised 64-bit FNV-1a over the matrix columns gives
+    one hash per row; np.unique on the [n] u64 vector replaces the
+    lexicographic sort of the full byte matrix.  Grouping stays EXACT —
+    every row's bytes are compared against its hash-group
+    representative's (one gather + one matrix compare); any mismatch (a
+    64-bit collision, astronomically rare) falls back to the byte-exact
+    reference, so the partition and the first-seen id order are always
+    identical to the native assignment."""
+    if not _prep_opt_enabled():
+        return _first_seen_ids_exact(mat)
+    n, W = mat.shape
+    if n == 0:
+        return _first_seen_ids_exact(mat)
+    h = np.full(n, 0xcbf29ce484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    for c in range(W):
+        h = (h ^ mat[:, c].astype(np.uint64)) * prime
+    _uniq, first_idx, inv = np.unique(h, return_index=True,
+                                      return_inverse=True)
+    inv = np.asarray(inv).reshape(-1)
+    rep_rows = first_idx[inv]
+    if not np.array_equal(mat, mat[rep_rows]):
+        return _first_seen_ids_exact(mat)
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    return remap[inv], first_idx[order]
 
 
 @dataclass
@@ -142,6 +277,17 @@ class BatchFold:
     max: np.ndarray        # f64 [G]
     last: np.ndarray       # f64 [G]
     hist: np.ndarray       # i64 [G, N_HIST]
+    #: [G, W] uint8 key-matrix rows of the representatives, when the
+    #: substrate already gathered them (numpy/device twins): the fold's
+    #: hash-key bytes, reusable by the window merge as interning keys so
+    #: steady-state batches never rebuild per-group key tuples
+    #: (BENCH_r11 device-cliff satellite).  None on the native substrate.
+    rep_key_blob: Optional[np.ndarray] = None
+    #: per-key padded widths of ``rep_key_blob`` (see _key_matrix): blob
+    #: rows are only comparable across batches together with these —
+    #: interning on the bytes alone would let two different key tuples
+    #: from different-width batches collide
+    key_widths: Optional[tuple] = None
 
     @property
     def n_groups(self) -> int:
@@ -167,8 +313,8 @@ def fold_batch_numpy(arena: np.ndarray, slots: np.ndarray,
         return BatchFold(group_id, np.zeros(0, np.int32), z,
                          np.zeros(0, np.int64), z, z, z,
                          np.zeros((0, n_hist), np.int64))
-    mat = _key_matrix(arena, slots[vrows], key_offs[vrows],
-                      key_lens[vrows])
+    mat, widths = _key_matrix(arena, slots[vrows], key_offs[vrows],
+                              key_lens[vrows])
     ids, first = _first_seen_ids(mat)
     group_id[vrows] = ids
     rep_row = vrows[first].astype(np.int32)
@@ -192,7 +338,7 @@ def fold_batch_numpy(arena: np.ndarray, slots: np.ndarray,
     hist = np.zeros((G, n_hist), dtype=np.int64)
     np.add.at(hist, (ids, hist_bucket(vv, hist_base, n_hist)), 1)
     return BatchFold(group_id, rep_row, sums, counts, mins, maxs, last,
-                     hist)
+                     hist, rep_key_blob=mat[first], key_widths=widths)
 
 
 def fold_batch_native(arena: np.ndarray, slots: np.ndarray,
@@ -262,6 +408,16 @@ class SegmentReduceKernel:
         self._fn = jax.jit(build_reduce_fn(n_hist), static_argnums=(4,))
         self._fn_donated = None
         self.dispatch_count = 0
+        # per-geometry staging buffers (the batch-slot idiom): the padded
+        # value/segment/bucket arrays are reused across folds instead of
+        # re-allocated per batch — part of the BENCH_r11 device-cliff fix
+        # (host prep must not price the kernel).  Buffers are LEASED out
+        # of the pool under the lock and returned after the fold, so two
+        # pipelines sharing the module-global kernel never race one
+        # tuple yet still overlap their device round trips.
+        import threading
+        self._staging: dict = {}
+        self._staging_lock = threading.Lock()
 
     def __call__(self, values, seg, buckets, valid, G: int):
         self.dispatch_count += 1
@@ -298,8 +454,8 @@ class SegmentReduceKernel:
             return BatchFold(group_id, np.zeros(0, np.int32), z,
                              np.zeros(0, np.int64), z, z, z,
                              np.zeros((0, n_hist), np.int64))
-        mat = _key_matrix(arena, slots[vrows], key_offs[vrows],
-                          key_lens[vrows])
+        mat, widths = _key_matrix(arena, slots[vrows], key_offs[vrows],
+                                  key_lens[vrows])
         ids, first = _first_seen_ids(mat)
         group_id[vrows] = ids
         rep_row = vrows[first].astype(np.int32)
@@ -308,24 +464,42 @@ class SegmentReduceKernel:
         Gq = 16
         while Gq < G:
             Gq *= 2
-        vals = np.zeros(B, dtype=np.float32)
-        vals[:n] = values.astype(np.float32)
-        seg = np.full(B, Gq, dtype=np.int32)
-        seg[:n] = group_id.clip(min=0)
-        ok = np.zeros(B, dtype=bool)
-        ok[:n] = valid
-        buckets = np.zeros(B, dtype=np.int32)
-        buckets[:n] = hist_bucket(values, hist_base, n_hist)
-        out = self.donated_call(vals, seg, buckets, ok, Gq)
-        sums, cnt, mins, maxs, last, hist = (np.asarray(a) for a in
-                                             jax.device_get(out))
+        # lease the geometry's staging tuple OUT of the pool (lock held
+        # only for the checkout/return, never across the device round
+        # trip — concurrent pipelines overlap their folds); a concurrent
+        # lease of the same geometry just allocates a transient tuple
+        # and the later return drops it
+        with self._staging_lock:
+            bufs = self._staging.pop(B, None)
+        if bufs is None:
+            bufs = (np.zeros(B, dtype=np.float32),
+                    np.zeros(B, dtype=np.int32),
+                    np.zeros(B, dtype=np.int32),
+                    np.zeros(B, dtype=bool))
+        try:
+            vals, seg, buckets, ok = bufs
+            vals[:n] = values.astype(np.float32)
+            vals[n:] = 0
+            seg[:n] = group_id.clip(min=0)
+            seg[n:] = Gq
+            ok[:n] = valid
+            ok[n:] = False
+            buckets[:n] = hist_bucket(values, hist_base, n_hist)
+            buckets[n:] = 0
+            out = self.donated_call(vals, seg, buckets, ok, Gq)
+            sums, cnt, mins, maxs, last, hist = (np.asarray(a) for a in
+                                                 jax.device_get(out))
+        finally:
+            with self._staging_lock:
+                self._staging.setdefault(B, bufs)
         return BatchFold(group_id, rep_row,
                          sums[:G].astype(np.float64),
                          cnt[:G].astype(np.int64),
                          mins[:G].astype(np.float64),
                          maxs[:G].astype(np.float64),
                          last[:G].astype(np.float64),
-                         hist[:G].astype(np.int64))
+                         hist[:G].astype(np.int64),
+                         rep_key_blob=mat[first], key_widths=widths)
 
 
 _device_kernel: Optional[SegmentReduceKernel] = None

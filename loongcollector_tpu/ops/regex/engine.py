@@ -613,6 +613,9 @@ class RegexEngine:
                 d_len = lengths[chunk]
                 L = pick_length_bucket(int(d_len.max())) or max_bucket
                 batch = pack_rows(arena, d_off, d_len, L)
+                # synchronous chunked match tier (DFA-tier match_batch):
+                # a standalone boolean gate, not a fusable stage run
+                # loonglint: disable=host-bounce
                 k_ok = np.asarray(self._dfa_kernel(batch.rows, batch.lengths))
                 ok[chunk] = k_ok[: batch.n_real]
             for i in np.nonzero(over)[0]:
@@ -839,6 +842,8 @@ class PendingParse:
                     raise
                 if lane is not None:
                     lane.breaker.on_success()
+                # chaos-fault recovery re-run: the designed exception path
+                # loonglint: disable=host-bounce
                 k_ok, k_off, k_len = (np.asarray(a) for a in outs)
             except Exception:  # noqa: BLE001
                 if sub_kern is self.engine._segment_kernel or \
@@ -863,6 +868,8 @@ class PendingParse:
                 # XLA kernel); unplaced dispatches fall to XLA directly
                 self.kern = self.engine._segment_kernel if lane is None \
                     else self.engine._device_kernel(lane)
+                # kernel-failure fallback re-run on the proven XLA path
+                # loonglint: disable=host-bounce
                 k_ok, k_off, k_len = (np.asarray(a) for a in
                                       self.kern(batch.rows, batch.lengths))
             k_ok = k_ok[: batch.n_real]

@@ -1449,6 +1449,9 @@ class FusedSetExec:
             L = pick_length_bucket(int(d_len.max()) if len(d_len) else 1) \
                 or max_bucket
             batch = pack_rows(arena, offsets[chunk], d_len, L)
+            # synchronous chunked classify tier — callers that want the
+            # resident form use the fused pipeline scan stage instead
+            # loonglint: disable=host-bounce
             k_tags = np.asarray(kern(batch.rows, batch.lengths))
             tags[chunk] = k_tags[: len(chunk)].astype(np.uint32)
         over_idx = np.nonzero(over)[0]

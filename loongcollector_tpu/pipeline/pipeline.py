@@ -79,6 +79,8 @@ class CollectionPipeline:
         self.aggregator = None
         self._agg_timeout_hook = _AggTimeoutHook(self)
         self.process_queue_key = 0
+        self._fused_runs = []
+        self._fused_by_head = {}
         self._in_process_cnt = 0
         self._in_process_zero = threading.Condition()
         self.metrics = None
@@ -202,6 +204,15 @@ class CollectionPipeline:
                 self._drain_hooks.append(hook)
                 TimeoutFlushManager.instance().register(hook)
 
+        # loongresident: plan fused device-stage runs over the final chain
+        # (pure description — programs compile on first dispatch / from
+        # the content-addressed cache).  LOONG_FUSED gates execution, not
+        # planning, so flipping it needs no pipeline reload.
+        from .fused_chain import plan_fusion
+        self._fused_runs = plan_fusion(self.inner_processors
+                                       + self.processors)
+        self._fused_by_head = {r.head: r for r in self._fused_runs}
+
         # process queue: a modified pipeline keeps its key so queued groups
         # survive the swap (reference ExactlyOnceQueueManager/QueueKeyManager
         # keep keys stable per config name)
@@ -320,33 +331,68 @@ class CollectionPipeline:
                           sum(len(g) for g in groups),
                           sum(g.data_size() for g in groups))
         try:
-            chain = self.inner_processors + self.processors
-            for i, inst in enumerate(chain):
-                if not getattr(inst.plugin, "supports_async_dispatch", False):
-                    inst.process(groups)
-                    continue
-                tokens = inst.process_dispatch(groups)
-                if all(t is None for t in tokens):
-                    # nothing stayed in flight (host-tier route / empty
-                    # groups): finish the chain inline — deferring would
-                    # only delay the send.  complete() still runs so the
-                    # instance's out_events/cost metrics stay truthful.
-                    inst.process_complete(groups, tokens)
-                    continue
-                rest = chain[i + 1:]
-
-                def finish(inst=inst, tokens=tokens, rest=rest):
-                    try:
-                        inst.process_complete(groups, tokens)
-                        for r in rest:
-                            r.process(groups)
-                    finally:
-                        self._exit_process()
-                return finish
+            cont = self._walk_chain(groups, 0, allow_async=True)
         except BaseException:
             self._exit_process()
             raise
-        self._exit_process()
+        if cont is None:
+            self._exit_process()
+            return None
+
+        def finish():
+            try:
+                cont()
+            finally:
+                self._exit_process()
+        return finish
+
+    def _walk_chain(self, groups: List[PipelineEventGroup], i: int,
+                    allow_async: bool):
+        """Index-walk the processor chain from ``i``.  A fused run
+        (loongresident) executes as ONE async stage; with ``allow_async``
+        the first stage that leaves device work in flight returns a
+        continuation (the runner's overlap window), which finishes that
+        stage and walks the REST of the chain inline — exactly the old
+        single-async-stage contract, now fusion-aware on both legs."""
+        chain = self.inner_processors + self.processors
+        while i < len(chain):
+            run = self._fused_by_head.get(i)
+            if run is not None and run.enabled():
+                tokens = run.dispatch(groups)
+                nxt = run.end
+                if any(t is not None for t in tokens):
+                    if allow_async:
+                        def finish_run(run=run, tokens=tokens, nxt=nxt):
+                            run.complete(groups, tokens)
+                            self._walk_chain(groups, nxt,
+                                             allow_async=False)
+                        return finish_run
+                    run.complete(groups, tokens)
+                i = nxt
+                continue
+            inst = chain[i]
+            if not getattr(inst.plugin, "supports_async_dispatch", False):
+                inst.process(groups)
+                i += 1
+                continue
+            tokens = inst.process_dispatch(groups)
+            if all(t is None for t in tokens):
+                # nothing stayed in flight (host-tier route / empty
+                # groups): finish the chain inline — deferring would
+                # only delay the send.  complete() still runs so the
+                # instance's out_events/cost metrics stay truthful.
+                inst.process_complete(groups, tokens)
+                i += 1
+                continue
+            if allow_async:
+                rest_idx = i + 1
+
+                def finish(inst=inst, tokens=tokens, rest_idx=rest_idx):
+                    inst.process_complete(groups, tokens)
+                    self._walk_chain(groups, rest_idx, allow_async=False)
+                return finish
+            inst.process_complete(groups, tokens)
+            i += 1
         return None
 
     def _exit_process(self) -> None:

@@ -122,6 +122,16 @@ class Processor(Plugin):
         self.process(group)
         return None
 
+    def fused_stage_spec(self, ctx):
+        """loongresident: this plugin's device work in resident stage form
+        (pipeline/fused_chain.FusedMemberStage), or None when it cannot
+        join a fused pipeline program — not device-tier, inputs not
+        statically bindable against ``ctx`` (FusionPlanContext), or the
+        plugin simply has no device half.  Returning a member DOES NOT
+        change the plugin's own process path: groups fusion cannot take
+        still run it per-stage."""
+        return None
+
     def process_complete(self, group: PipelineEventGroup, token) -> None:
         """Finish the work started by process_dispatch."""
 

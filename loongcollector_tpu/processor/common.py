@@ -76,6 +76,18 @@ def extract_source(group: PipelineEventGroup,
                          np.array(present, dtype=bool))
 
 
+def subset_source(src: SourceColumns, rowmap: np.ndarray) -> SourceColumns:
+    """Row-subset view of a SourceColumns (loongresident: a fused run's
+    member applies after a filter member compacted the group — the
+    original packed-row arrays re-index through the run's rowmap)."""
+    if len(rowmap) == len(src.offsets) \
+            and bool((rowmap == np.arange(len(rowmap))).all()):
+        return src
+    return SourceColumns(src.arena, src.offsets[rowmap],
+                         src.lengths[rowmap], src.columnar,
+                         src.present[rowmap], src.from_content)
+
+
 def apply_parse_spans(group, src, res, keys, keep_on_fail: bool,
                       keep_on_success: bool, renamed_source_key: str,
                       source_key=None) -> None:

@@ -48,6 +48,72 @@ class ProcessorFilter(Processor):
             self.exclude.append((k.encode(), get_engine(pattern)))
         return True
 
+    def fused_stage_spec(self, ctx):
+        """loongresident: the whole Include/Exclude condition set joins a
+        fused pipeline program as ONE ``keep`` stage — each condition a
+        DFA/Tier-1 match over the packed source rows or, for a field a
+        prior member's extract stage produced, a span-bound DFA over that
+        stage's DEVICE-RESIDENT capture column.  The combined keep mask
+        is computed on device; the apply is pure column compaction.  Any
+        condition that cannot bind statically (field minted outside the
+        run, consumed source, CPU-tier pattern with no DFA form) refuses
+        fusion and the filter keeps its per-stage path."""
+        if not self.include and not self.exclude:
+            return None
+        from ..ops import fused_pipeline as fp
+        from ..ops.regex.dfa import DFAUnsupported, compile_dfa
+        from ..ops.regex.program import PatternTier
+        from ..pipeline.fused_chain import FusedMemberStage
+        conds = []
+        for negate, pairs in ((False, self.include), (True, self.exclude)):
+            for key, engine in pairs:
+                binding = ctx.resolve(key)
+                if binding is None:
+                    return None
+                if binding == "source":
+                    if not ctx.bind_source(key):
+                        return None
+                    if engine.tier is PatternTier.SEGMENT:
+                        conds.append(fp.StageCond(
+                            "extract_ok", engine._segment_kernel.program,
+                            ["extract_ok", engine.pattern, negate],
+                            negate=negate, staged=engine._segment_kernel))
+                    elif engine.tier is PatternTier.DFA:
+                        conds.append(fp.StageCond(
+                            "match", engine._dfa_kernel.dfa,
+                            ["match", engine.pattern, negate],
+                            negate=negate, staged=engine._dfa_kernel))
+                    else:
+                        return None
+                else:
+                    _tag, prod, cap = binding
+                    try:
+                        dfa = compile_dfa(engine.pattern)
+                    except DFAUnsupported:
+                        return None
+                    from ..ops.kernels.dfa_scan import LazySpanMatchKernel
+                    conds.append(fp.StageCond(
+                        "span_match", dfa,
+                        ["span_match", engine.pattern, prod, cap, negate],
+                        binding=(prod, cap), negate=negate,
+                        staged=LazySpanMatchKernel(dfa)))
+        spec = fp.StageSpec("keep", conds,
+                            ["keep"] + [list(c.ident) for c in conds],
+                            label="filter")
+        return FusedMemberStage(spec, self._fused_apply)
+
+    def _fused_apply(self, group, src, out, rowmap):
+        keep = np.asarray(out[0], dtype=bool)[rowmap]
+        if keep.all():
+            return rowmap
+        cols = group.columns
+        if cols is not None and not group._events:
+            group.set_columns(compact_columns(cols, keep))
+        else:
+            group._events = [ev for i, ev in enumerate(group.events)
+                             if keep[i]]
+        return rowmap[keep]
+
     def _match_field(self, group: PipelineEventGroup, key: bytes,
                      engine: RegexEngine, n: int) -> np.ndarray:
         src = extract_source(group, key)

@@ -73,58 +73,94 @@ class ProcessorGrok(Processor):
         if n == 0:
             return
         if src.columnar:
-            cols = group.columns
-            remaining = src.present.copy()
-            matched = np.zeros(n, dtype=bool)
-            field_offs: Dict[str, np.ndarray] = {}
-            field_lens: Dict[str, np.ndarray] = {}
             member_masks = None
             if self._fused_set is not None:
                 tags = self._fused_set.classify(
                     src.arena, src.offsets.astype(np.int64), src.lengths)
                 member_masks = self._fused_set.member_masks(tags)
-            for pat_i, (engine, keys) in enumerate(self._engines):
-                if not remaining.any():
-                    break
-                if member_masks is not None \
-                        and member_masks[pat_i] is not None:
-                    # fused member: the scan already classified it — run
-                    # its extract program only on its matching rows.
-                    # Demoted members (mask None) keep the per-pattern
-                    # probe over everything still unmatched.
-                    idx = np.nonzero(remaining & member_masks[pat_i])[0]
-                    if not len(idx):
-                        continue
-                else:
-                    idx = np.nonzero(remaining)[0]
-                res = engine.parse_batch(src.arena, src.offsets[idx],
-                                         src.lengths[idx])
-                hit = idx[res.ok]
-                if not len(hit):
-                    continue
-                for g, key in enumerate(keys):
-                    if not key:
-                        continue
-                    if key not in field_offs:
-                        field_offs[key] = np.zeros(n, dtype=np.int32)
-                        field_lens[key] = np.full(n, -1, dtype=np.int32)
-                    field_offs[key][hit] = res.cap_off[res.ok, g]
-                    field_lens[key][hit] = res.cap_len[res.ok, g]
-                matched[hit] = True
-                remaining[hit] = False
-            for key in field_offs:
-                cols.set_field(key, field_offs[key], field_lens[key])
-            if self.keep_source_on_fail:
-                fail = (~matched) & src.present
-                if fail.any():
-                    cols.set_field(self.renamed_source_key,
-                                   src.offsets.astype(np.int32),
-                                   np.where(fail, src.lengths, -1).astype(np.int32))
-            cols.parse_ok = matched
-            if src.from_content:
-                cols.content_consumed = True
+            self._apply_columnar(group, src, member_masks)
             return
 
+        self._process_rows(group)
+
+    def fused_stage_spec(self, ctx):
+        """loongresident: the multi-pattern classify scan joins a fused
+        pipeline program as a ``scan`` stage (one tag bitmask per row);
+        extraction still runs per matching subset afterwards — the scan
+        is the stage that used to cost one dispatch per pattern.  Grok's
+        dynamic fields never register as capture bindings (they are
+        extracted host-side), so later members cannot bind them — by
+        design, not by accident."""
+        fs = self._fused_set
+        if fs is None or not fs.fdfa.device_ok:
+            return None
+        if not ctx.bind_source(self.source_key):
+            return None
+        from ..ops import fused_pipeline as fp
+        from ..pipeline.fused_chain import FusedMemberStage
+        spec = fp.StageSpec("scan", fs.fdfa,
+                            ["scan"] + list(fs.fdfa.patterns),
+                            staged=fs._device_kernel(),
+                            label="grok-classify")
+        ctx.note_consumed(self.source_key)
+        return FusedMemberStage(spec, self._fused_apply)
+
+    def _fused_apply(self, group, src, out, rowmap):
+        from .common import subset_source
+        tags = np.asarray(out[0]).astype(np.uint32)[rowmap]
+        masks = self._fused_set.member_masks(tags)
+        self._apply_columnar(group, subset_source(src, rowmap), masks)
+        return rowmap
+
+    def _apply_columnar(self, group, src, member_masks) -> None:
+        n = len(src.offsets)
+        cols = group.columns
+        remaining = src.present.copy()
+        matched = np.zeros(n, dtype=bool)
+        field_offs: Dict[str, np.ndarray] = {}
+        field_lens: Dict[str, np.ndarray] = {}
+        for pat_i, (engine, keys) in enumerate(self._engines):
+            if not remaining.any():
+                break
+            if member_masks is not None \
+                    and member_masks[pat_i] is not None:
+                # fused member: the scan already classified it — run
+                # its extract program only on its matching rows.
+                # Demoted members (mask None) keep the per-pattern
+                # probe over everything still unmatched.
+                idx = np.nonzero(remaining & member_masks[pat_i])[0]
+                if not len(idx):
+                    continue
+            else:
+                idx = np.nonzero(remaining)[0]
+            res = engine.parse_batch(src.arena, src.offsets[idx],
+                                     src.lengths[idx])
+            hit = idx[res.ok]
+            if not len(hit):
+                continue
+            for g, key in enumerate(keys):
+                if not key:
+                    continue
+                if key not in field_offs:
+                    field_offs[key] = np.zeros(n, dtype=np.int32)
+                    field_lens[key] = np.full(n, -1, dtype=np.int32)
+                field_offs[key][hit] = res.cap_off[res.ok, g]
+                field_lens[key][hit] = res.cap_len[res.ok, g]
+            matched[hit] = True
+            remaining[hit] = False
+        for key in field_offs:
+            cols.set_field(key, field_offs[key], field_lens[key])
+        if self.keep_source_on_fail:
+            fail = (~matched) & src.present
+            if fail.any():
+                cols.set_field(self.renamed_source_key,
+                               src.offsets.astype(np.int32),
+                               np.where(fail, src.lengths, -1).astype(np.int32))
+        cols.parse_ok = matched
+        if src.from_content:
+            cols.content_consumed = True
+
+    def _process_rows(self, group: PipelineEventGroup) -> None:
         # row path — shared reference keep/discard ordering
         from .common import finish_row_keep
         sb = group.source_buffer

@@ -122,6 +122,37 @@ class ProcessorParseDelimiter(Processor):
 
     supports_async_dispatch = True
 
+    def fused_stage_spec(self, ctx):
+        """loongresident: the non-quote delimiter split IS a Tier-1
+        segment program, so it joins a fused pipeline program exactly
+        like regex extraction — same stage kind, same content identity
+        (two plugins with the same derived pattern share one compiled
+        program).  Quote mode keeps the structural-index plane."""
+        from ..ops.regex.program import PatternTier
+        eng = self.engine
+        if self.quote_mode or self.allow_not_enough or eng is None \
+                or eng.tier is not PatternTier.SEGMENT \
+                or eng._segment_kernel is None:
+            return None
+        if not ctx.bind_source(self.source_key):
+            return None
+        from ..ops import fused_pipeline as fp
+        from ..pipeline.fused_chain import FusedMemberStage
+        spec = fp.StageSpec("extract", eng._segment_kernel.program,
+                            ["extract", eng.pattern],
+                            staged=eng._segment_kernel,
+                            label=f"extract:{self.name}")
+        ctx.note_fields(ctx.n_stages, self.keys[:eng.num_caps])
+        ctx.note_consumed(self.source_key)
+        return FusedMemberStage(spec, self._fused_apply)
+
+    def _fused_apply(self, group, src, out, rowmap):
+        from .common import subset_source
+        ok, off, ln = out
+        self._apply_device(group, subset_source(src, rowmap),
+                           _SpanResult(ok[rowmap], off[rowmap], ln[rowmap]))
+        return rowmap
+
     def process_dispatch(self, group: PipelineEventGroup):
         """Async device plane (same split as processor_parse_regex_tpu):
         the delimiter segment program dispatches now, the spans apply in

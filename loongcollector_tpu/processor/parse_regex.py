@@ -62,6 +62,39 @@ class ProcessorParseRegex(Processor):
 
     supports_async_dispatch = True
 
+    def fused_stage_spec(self, ctx):
+        """loongresident: SEGMENT-tier extraction joins a fused pipeline
+        program as an ``extract`` stage — one packed source column in,
+        capture spans out, device-resident for any later member (a filter
+        condition on a parsed key binds those spans without a host
+        bounce).  Parsed keys register as capture columns; the consumed
+        source key is retired from the run's static bindings exactly as
+        ``apply_parse_spans`` retires it at apply time."""
+        from ..ops.regex.program import PatternTier
+        eng = self.engine
+        if eng is None or eng.tier is not PatternTier.SEGMENT \
+                or eng._segment_kernel is None:
+            return None
+        if not ctx.bind_source(self.source_key):
+            return None
+        from ..ops import fused_pipeline as fp
+        from ..pipeline.fused_chain import FusedMemberStage
+        spec = fp.StageSpec("extract", eng._segment_kernel.program,
+                            ["extract", eng.pattern],
+                            staged=eng._segment_kernel,
+                            label=f"extract:{self.name}")
+        ctx.note_fields(ctx.n_stages, self.keys[:eng.num_caps])
+        ctx.note_consumed(self.source_key)
+        return FusedMemberStage(spec, self._fused_apply)
+
+    def _fused_apply(self, group, src, out, rowmap):
+        from ..ops.regex.engine import BatchParseResult
+        from .common import subset_source
+        ok, off, ln = out
+        self._apply(group, subset_source(src, rowmap),
+                    BatchParseResult(ok[rowmap], off[rowmap], ln[rowmap]))
+        return rowmap
+
     def process_dispatch(self, group: PipelineEventGroup):
         """Async device plane: dispatch the group's parse and return the
         pending handle; the device executes while the runner works on

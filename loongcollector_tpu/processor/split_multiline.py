@@ -116,6 +116,44 @@ class ProcessorSplitMultilineLogString(Processor):
                 self._fused_set.classify(arena, offs, lens))
             masks = {name: member[slot]
                      for name, slot in self._fused_slots.items()}
+        self._classify_blocks(group, cols, arena, offs, lens, masks)
+
+    def fused_stage_spec(self, ctx):
+        """loongresident: the start/continue/end classify scan joins a
+        fused pipeline program as its LAST stage (``terminal=True`` — the
+        block merge rebuilds the row population, so nothing downstream
+        can consume the packed rows).  The block walk and carry stitching
+        are unchanged host logic over the scan's tag bitmask."""
+        fs = self._fused_set
+        if fs is None or not fs.fdfa.device_ok:
+            return None
+        if not ctx.bind_source(b"content"):
+            return None
+        from ..ops import fused_pipeline as fp
+        from ..pipeline.fused_chain import FusedMemberStage
+        spec = fp.StageSpec("scan", fs.fdfa,
+                            ["scan"] + list(fs.fdfa.patterns),
+                            staged=fs._device_kernel(),
+                            terminal=True, label="multiline-classify")
+        return FusedMemberStage(spec, self._fused_apply)
+
+    def _fused_apply(self, group, src, out, rowmap):
+        cols = group.columns
+        if cols is None or group._events or len(rowmap) != len(cols):
+            return rowmap
+        arena = group.source_buffer.as_array()
+        tags = np.asarray(out[0]).astype(np.uint32)[rowmap]
+        member = self._fused_set.member_masks(tags)
+        masks = {name: member[slot]
+                 for name, slot in self._fused_slots.items()}
+        self._classify_blocks(group, cols, arena,
+                              cols.offsets.astype(np.int64), cols.lengths,
+                              masks)
+        return rowmap
+
+    def _classify_blocks(self, group, cols, arena, offs, lens,
+                         masks: Dict[str, Optional[np.ndarray]]) -> None:
+        n = len(cols)
         is_start = (self._classify(masks, "start", self.start, arena, offs,
                                    lens)
                     if self.start else np.zeros(n, dtype=bool))

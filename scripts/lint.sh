@@ -52,6 +52,13 @@ echo "== fused-DFA equivalence gate (loongfuse) =="
 # means fusion would mis-gate extraction (docs/performance.md)
 JAX_PLATFORMS=cpu python scripts/fuse_equivalence.py
 
+echo "== fused-pipeline equivalence gate (loongresident) =="
+# the same processor chain with stage fusion ON (one fused device program
+# per batch slot) and OFF (per-stage dispatch) must produce byte-identical
+# groups across the regex / grok / delimiter / json / multiline families —
+# fusion is an execution-plan change, never a semantics change
+JAX_PLATFORMS=cpu python scripts/fused_equivalence.py
+
 echo "== structural-index equivalence gate (loongstruct) =="
 # the native/numpy/device structural bitmaps must be bit-identical, the
 # JSON plane must match Python `json` row-for-row, and quote-mode

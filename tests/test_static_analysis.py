@@ -83,14 +83,15 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_ten_checkers_registered(self):
+    def test_all_eleven_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
                          "swallowed-fault", "unledgered-drop",
                          "metric-naming", "hot-path-materialize",
-                         "per-row-parse", "unbounded-window"]
-        assert len(all_checkers()) == 10
+                         "per-row-parse", "unbounded-window",
+                         "host-bounce"]
+        assert len(all_checkers()) == 11
 
 
 # ---------------------------------------------------------------------------
@@ -1524,3 +1525,131 @@ class TestUnboundedWindow:
                         continue
         """, UnledgeredDropChecker(), relpath=self.SCOPE)
         assert checks_of(findings) == {"unledgered-drop"}
+
+
+# ---------------------------------------------------------------------------
+# 12. host-bounce fixtures (loongresident)
+
+
+class TestHostBounce:
+    def checker(self):
+        from loongcollector_tpu.analysis.checkers.host_bounce import \
+            HostBounceChecker
+        return HostBounceChecker()
+
+    def test_pull_between_two_dispatches_flagged(self):
+        src = """
+        def two_stage(rows, lengths):
+            ok = np.asarray(index_kernel(rows, lengths))
+            masks = np.asarray(ok)
+            return np.asarray(match_kernel(rows, masks))
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"host-bounce"}
+        assert any(f.line == 4 for f in fs)
+
+    def test_pull_in_dispatch_loop_flagged(self):
+        src = """
+        def chunked(chunks):
+            out = []
+            for rows, lengths in chunks:
+                out.append(np.asarray(scan_kernel(rows, lengths)))
+            return out
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"host-bounce"}
+
+    def test_pull_wrapping_first_dispatch_flagged(self):
+        # the canonical straight-line bounce: materialise stage 1's
+        # output on its own dispatch line, re-pack into stage 2
+        src = """
+        def two_stage(rows, lengths):
+            a = np.asarray(index_kernel(rows, lengths))
+            return match_kernel(rows, a)
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"host-bounce"}
+        assert any(f.line == 3 for f in fs)
+
+    def test_single_dispatch_then_materialise_clean(self):
+        src = """
+        def one_shot(rows, lengths):
+            out = extract_kernel.donated_call(rows, lengths)
+            return [np.asarray(o) for o in out]
+        """
+        assert scan(src, self.checker()) == []
+
+    def test_donated_call_counts_as_dispatch(self):
+        src = """
+        def resident(rows, lengths):
+            a = kern.donated_call(rows, lengths)
+            host = np.asarray(a)
+            return kern.donated_call(host, lengths)
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"host-bounce"}
+
+    def test_future_result_between_dispatches_flagged(self):
+        src = """
+        def drain(self, chunks):
+            for batch, fut in chunks:
+                vals = fut.result()
+                self.sub_kern(batch.rows, batch.lengths)
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"host-bounce"}
+
+    def test_outside_scope_ignored(self):
+        src = """
+        def two_stage(rows, lengths):
+            a = np.asarray(index_kernel(rows, lengths))
+            return np.asarray(match_kernel(rows, a))
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/runner/fx.py") == []
+
+    def test_processor_scope_requires_columnar_capable(self):
+        body = """
+        class ProcessorFx:
+            supports_columnar = True
+
+            def process(self, rows, lengths):
+                a = np.asarray(self._dfa_kernel(rows, lengths))
+                b = np.asarray(a)
+                return self._seg_kernel(rows, b)
+        """
+        fs = scan(body, self.checker(),
+                  relpath="loongcollector_tpu/processor/fx.py")
+        assert checks_of(fs) == {"host-bounce"}
+        plain = body.replace("supports_columnar = True",
+                             "supports_columnar = False")
+        assert scan(plain, self.checker(),
+                    relpath="loongcollector_tpu/processor/fx.py") == []
+
+    def test_suppression_escapes(self):
+        src = textwrap.dedent("""
+        def demoted(rows, lengths):
+            # loonglint: disable=host-bounce
+            a = np.asarray(index_kernel(rows, lengths))
+            return match_kernel(rows, a)
+        """)
+        mod = ModuleInfo("/fx/loongcollector_tpu/ops/fixture.py",
+                         "loongcollector_tpu/ops/fixture.py", src)
+        fs = list(self.checker().check_module(mod))
+        # the bounce IS found (raw), and the comment-line suppression
+        # covers it at the runner layer — the designed-fallback escape
+        assert fs
+        assert all(mod.suppressed(f.line, "host-bounce") for f in fs)
+
+    def test_bare_asarray_helper_not_a_pull(self):
+        src = """
+        def two_stage(rows, lengths):
+            a = index_kernel(rows, lengths)
+            b = asarray(a)
+            return match_kernel(rows, b)
+        """
+        assert scan(src, self.checker()) == []
+
+    def test_registered_in_tier1(self):
+        from loongcollector_tpu.analysis.checkers import checker_names
+        assert "host-bounce" in checker_names()
