@@ -13,6 +13,7 @@ from .hot_path_materialize import HotPathMaterializeChecker
 from .metric_naming import MetricNamingChecker
 from .per_row_parse import PerRowParseChecker
 from .registry_consistency import RegistryConsistencyChecker
+from .reload_unsafe import ReloadUnsafeChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
 from .unbounded_window import UnboundedWindowChecker
@@ -30,6 +31,7 @@ _CHECKER_CLASSES = [
     PerRowParseChecker,
     UnboundedWindowChecker,
     HostBounceChecker,
+    ReloadUnsafeChecker,
 ]
 
 

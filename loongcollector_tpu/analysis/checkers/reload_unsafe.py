@@ -1,0 +1,233 @@
+"""reload-unsafe: pipeline-owned components must be fully retirable.
+
+The loongtenant contract (docs/static_analysis.md#reload-unsafe): a hot
+reload creates generation N+1 and DRAINS generation N — so every
+component a pipeline generation owns (plugins, batchers, queues, input
+adapters, dispatch helpers) dies many times over one agent lifetime, not
+once at exit.  A ``stop()``/``release()`` that leaves anything behind is
+no longer a shutdown quirk; it is a per-reload leak that accumulates
+with config churn:
+
+  1. **registration leak** — a class that calls ``<registry>.register(...)``
+     (TimeoutFlushManager hooks, input-runner jobs, JMX/telegraf
+     managers) must also call ``.unregister(...)`` somewhere in the SAME
+     class; otherwise the dead generation stays referenced (and keeps
+     being driven) forever.
+  2. **held device/ring/budget hold** — a class that parks the result of
+     a ``.submit(...)`` (DeviceFuture) or ``.lease(...)`` (ring slot) in
+     ``self``-held state (direct assignment, or appended/stored into a
+     ``self`` container, directly or via a local variable) must contain
+     a settle path — a ``.result()``, ``.release()`` or ``.take()`` call
+     — or the hold outlives the generation and strands plane budget /
+     ring slots (the round-5 PendingParse leak shape, cross-method).
+  3. **unretirable private record** — a class with a ``stop()`` or
+     ``release()`` lifecycle that creates a ``MetricsRecord`` into a
+     PRIVATE attribute (``self._x``) must call ``.mark_deleted()``
+     somewhere in the class: a private record cannot be retired by an
+     owner, so the class itself must do it (public ``self.metrics``
+     records may escape to an owning pipeline — metric-naming's
+     ownership rule covers those).
+
+Escape: ``# loonglint: disable=reload-unsafe`` with a justification, for
+process-lifetime singletons that genuinely outlive every generation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, attr_tail
+
+CHECK = "reload-unsafe"
+
+_SCOPE = ("/pipeline/", "/runner/", "/flusher/", "/aggregator/",
+          "/input/", "/processor/", "/ops/")
+_HOLD_TAILS = {"submit", "lease"}
+_SETTLE_TAILS = {"result", "release", "take", "mark_deleted"}
+_LIFECYCLE = {"stop", "release", "close", "mark_deleted"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _is_hold_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and attr_tail(node) in _HOLD_TAILS
+
+
+def _contains_any_name(node: ast.AST, names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+        if _is_hold_call(sub):
+            return True
+    return False
+
+
+def _walk_class(cls: ast.ClassDef):
+    """Walk a class WITHOUT descending into nested ClassDefs: an inner
+    class's sites belong to the inner class (which is scanned on its
+    own), never to the enclosing one."""
+    stack = list(cls.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _ClassScan:
+    """One pass over a class body collecting the evidence all three
+    rules need.  Sites are deduped: a closure nested in a method is
+    reachable both from the method walk and as its own FunctionDef."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.register_sites: List[ast.Call] = []
+        self.has_unregister = False
+        self.hold_sites: List[Tuple[int, int, str]] = []
+        self.has_settle = False
+        self.private_record_sites: List[Tuple[int, int, str]] = []
+        self.has_mark_deleted = False
+        self.lifecycle_methods: Set[str] = set()
+        self._seen_holds: Set[Tuple[int, int, str]] = set()
+        self._seen_records: Set[Tuple[int, int, str]] = set()
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in _LIFECYCLE:
+                self.lifecycle_methods.add(node.name)
+        for fn in _walk_class(cls):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(fn)
+        for node in _walk_class(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = attr_tail(node)
+            if tail == "register":
+                self.register_sites.append(node)
+            elif tail == "unregister":
+                self.has_unregister = True
+            elif tail in _SETTLE_TAILS:
+                self.has_settle = True
+                if tail == "mark_deleted":
+                    self.has_mark_deleted = True
+
+    def _note_hold(self, line: int, col: int, attr: str) -> None:
+        key = (line, col, attr)
+        if key not in self._seen_holds:
+            self._seen_holds.add(key)
+            self.hold_sites.append(key)
+
+    def _note_record(self, line: int, col: int, attr: str) -> None:
+        key = (line, col, attr)
+        if key not in self._seen_records:
+            self._seen_records.add(key)
+            self.private_record_sites.append(key)
+
+    def _scan_function(self, fn: ast.AST) -> None:
+        # local names assigned from a submit()/lease() call in this
+        # function — a self-container storing one of them is a held hold
+        hold_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_hold_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        hold_names.add(t.id)
+                    attr = _self_attr(t)
+                    if attr:
+                        self._note_hold(node.lineno, node.col_offset,
+                                        attr)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    attr_tail(node.value) == "MetricsRecord":
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr.startswith("_"):
+                        self._note_record(node.lineno, node.col_offset,
+                                          attr)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = attr_tail(node)
+            recv = node.func.value if isinstance(node.func, ast.Attribute) \
+                else None
+            if tail in ("append", "appendleft", "add", "put") \
+                    and recv is not None and _self_attr(recv):
+                for arg in node.args:
+                    if _contains_any_name(arg, hold_names):
+                        self._note_hold(node.lineno, node.col_offset,
+                                        _self_attr(recv))
+                        break
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    # self._slots[k] = fut AND the direct
+                    # self._slots[k] = plane.submit(...) both count —
+                    # _contains_any_name matches hold calls too
+                    if isinstance(t, ast.Subscript) and \
+                            _self_attr(t.value) and \
+                            _contains_any_name(node.value, hold_names):
+                        self._note_hold(node.lineno, node.col_offset,
+                                        _self_attr(t.value))
+
+
+class ReloadUnsafeChecker(Checker):
+    name = CHECK
+    description = ("pipeline-owned components' stop()/release() must "
+                   "unregister registry hooks, settle self-held device/"
+                   "ring holds, and retire private metric records — a "
+                   "hot reload retires components per generation, so "
+                   "any leak here accumulates with config churn")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        relpath = "/" + mod.relpath
+        if not any(s in relpath for s in _SCOPE):
+            return
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            scan = _ClassScan(cls)
+            if scan.register_sites and not scan.has_unregister \
+                    and not self._defines_register(cls):
+                site = scan.register_sites[0]
+                yield Finding(
+                    CHECK, mod.relpath, site.lineno, site.col_offset,
+                    f"class {cls.name} registers into a registry but "
+                    "never calls .unregister(...): a retired pipeline "
+                    "generation stays referenced (and driven) forever",
+                    symbol=cls.name)
+            if scan.hold_sites and not scan.has_settle:
+                for line, col, attr in scan.hold_sites:
+                    yield Finding(
+                        CHECK, mod.relpath, line, col,
+                        f"class {cls.name} parks a .submit()/.lease() "
+                        f"hold in self.{attr} but has no "
+                        ".result()/.release()/.take() settle path: the "
+                        "hold outlives the generation and strands plane "
+                        "budget / ring slots on every reload",
+                        symbol=f"{cls.name}.{attr}")
+            if scan.private_record_sites and scan.lifecycle_methods \
+                    and not scan.has_mark_deleted:
+                for line, col, attr in scan.private_record_sites:
+                    yield Finding(
+                        CHECK, mod.relpath, line, col,
+                        f"class {cls.name} owns a PRIVATE MetricsRecord "
+                        f"self.{attr} and has a "
+                        f"{sorted(scan.lifecycle_methods)} lifecycle but "
+                        "never mark_deleted()s it: every reload leaks a "
+                        "live record into WriteMetrics",
+                        symbol=f"{cls.name}.{attr}")
+
+    @staticmethod
+    def _defines_register(cls: ast.ClassDef) -> bool:
+        """The registry CLASS itself (defines register/unregister
+        methods) is the callee, not a leaking caller."""
+        names = {node.name for node in cls.body
+                 if isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        return "unregister" in names or "register" in names

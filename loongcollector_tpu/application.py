@@ -294,6 +294,9 @@ class Application:
                 diff = self.config_watcher.check_config_diff()
                 if not diff.empty():
                     self.pipeline_manager.update_pipelines(diff)
+                # a control-plane-faulted removal must complete even if
+                # the config dir never changes again (loongtenant)
+                self.pipeline_manager.retry_pending_removals()
                 idiff = self.instance_watcher.check_config_diff()
                 if not idiff.empty():
                     self.instance_manager.update(idiff)

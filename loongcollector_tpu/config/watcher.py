@@ -12,6 +12,7 @@ JSON always.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Dict, List, Optional, Tuple
@@ -42,11 +43,31 @@ def _expand_env(text: str) -> str:
 
 
 def load_config_file(path: str) -> Optional[dict]:
+    cfg, _digest = load_config_file_hashed(path)
+    return cfg
+
+
+def load_config_file_hashed(path: str) -> Tuple[Optional[dict], str]:
+    """(config, content digest).  The digest is over the env-EXPANDED
+    text — the content the pipeline would actually run — so the watcher
+    can tell an unchanged-content rewrite (mtime bumped, same effective
+    config) from a real edit, while a credential rotation (same file
+    bytes, ``${TOKEN}`` now expanding differently) still re-applies when
+    the file is re-pushed.  Returns (None, "") on read failure,
+    (None, digest) on a parse failure — the caller keeps the previous
+    generation either way."""
     try:
         with open(path) as f:
-            text = _expand_env(f.read())
+            raw = f.read()
     except OSError:
-        return None
+        return None, ""
+    text = _expand_env(raw)
+    digest = hashlib.sha256(
+        text.encode("utf-8", "surrogatepass")).hexdigest()
+    return _parse_config_text(path, text), digest
+
+
+def _parse_config_text(path: str, text: str) -> Optional[dict]:
     if path.endswith((".yaml", ".yml")):
         if _yaml is None:
             log.error("PyYAML unavailable; cannot load %s", path)
@@ -100,7 +121,15 @@ def unregister_builtin_pipeline(name: str) -> None:
 class PipelineConfigWatcher:
     def __init__(self) -> None:
         self._dirs: List[str] = []
-        self._state: Dict[str, Tuple[float, int]] = {}  # path -> (mtime, size)
+        # path -> ((mtime, size), content sha256) of the last APPLIED
+        # version; a malformed rewrite deliberately leaves the old entry
+        # (the previous generation keeps serving, the scan retries)
+        self._state: Dict[str, Tuple[Tuple[float, int], str]] = {}
+        # name -> path the name was last applied from: lets one scan
+        # classify remove+re-add (the config moved files, e.g. .yaml →
+        # .json) as a MODIFY, so the pipeline keeps its queue key and its
+        # queued groups survive the swap
+        self._names: Dict[str, str] = {}
         self._builtin_applied: Dict[str, int] = {}  # name -> id(config)
 
     def add_source(self, directory: str) -> None:
@@ -146,16 +175,35 @@ class PipelineConfigWatcher:
                     continue
                 sig = (st.st_mtime, st.st_size)
                 old = self._state.get(path)
-                if old == sig:
+                if old is not None and old[0] == sig:
+                    self._names.setdefault(name, path)
                     continue
-                cfg = load_config_file(path)
+                cfg, digest = load_config_file_hashed(path)
                 if cfg is None:
+                    # unreadable or MALFORMED: the previous generation
+                    # keeps serving — state is NOT updated, so a later
+                    # scan retries (and a fixed file applies normally);
+                    # never a removal, never a half-applied modify
                     continue
-                self._state[path] = sig
-                if old is None:
-                    diff.added[name] = cfg
-                else:
+                prev_path = self._names.get(name)
+                known = (old is not None
+                         or (prev_path is not None and prev_path != path))
+                if old is not None and old[1] == digest:
+                    # unchanged-content rewrite (touch, atomic re-write
+                    # with identical bytes): refresh the signature but do
+                    # NOT restart the pipeline over a no-op edit
+                    self._state[path] = (sig, digest)
+                    continue
+                self._state[path] = (sig, digest)
+                if prev_path is not None and prev_path != path:
+                    # the name moved files (remove + re-add seen in ONE
+                    # scan): a modify — the manager reuses the queue key
+                    self._state.pop(prev_path, None)
+                self._names[name] = path
+                if known:
                     diff.modified[name] = cfg
+                else:
+                    diff.added[name] = cfg
         # removals: tracked paths whose file vanished
         for path in list(self._state):
             if not os.path.exists(path):
@@ -163,4 +211,6 @@ class PipelineConfigWatcher:
                 name = os.path.splitext(os.path.basename(path))[0]
                 if name not in seen:
                     diff.removed.append(name)
+                    if self._names.get(name) == path:
+                        del self._names[name]
         return diff

@@ -29,6 +29,11 @@ class AlarmType(str, enum.Enum):
     USER_CONFIG = "USER_CONFIG_ALARM"
     GLOBAL_CONFIG = "GLOBAL_CONFIG_ALARM"
     CONFIG_UPDATE = "CONFIG_UPDATE_ALARM"
+    # loongtenant: a hot reload's new generation failed to init — the
+    # manager ROLLED BACK to the previous generation, which keeps serving
+    # (a bad fleet-wide YAML push degrades to "config not applied", never
+    # to a collection outage)
+    CONFIG_UPDATE_FAILED = "CONFIG_UPDATE_FAILED_ALARM"
     CATEGORY_CONFIG = "CATEGORY_CONFIG_ALARM"
     MULTI_CONFIG_MATCH = "MULTI_CONFIG_MATCH_ALARM"
     TOO_MANY_CONFIG = "TOO_MANY_CONFIG_ALARM"

@@ -177,6 +177,11 @@ def collect_status() -> dict:
                         entry["queue_depth"] = q.size()
                 pipelines[name] = entry
             doc["pipelines"] = pipelines
+            # loongtenant: per-tenant generation / last-reload / device-
+            # budget-share rows — the multi-tenant control-plane page
+            # (reload latency distributions live in the
+            # pipeline_reload_seconds histogram on /metrics)
+            doc["tenants"] = mgr.tenants_status()
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -271,6 +271,11 @@ def live_inflight() -> Optional[int]:
             pqm = mgr.process_queue_manager
             with mgr._lock:
                 pipelines = list(mgr._pipelines.values())
+                # loongtenant: old generations mid-drain left the name map
+                # but still hold in-process groups / open windows /
+                # flusher-local payloads — occupancy until the drain ends
+                # (getattr: duck-typed test managers carry no drain list)
+                pipelines.extend(getattr(mgr, "_draining", ()))
             for p in pipelines:
                 if pqm is not None:
                     q = pqm.get_queue(p.process_queue_key)

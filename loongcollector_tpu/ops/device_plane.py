@@ -29,7 +29,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -43,6 +43,118 @@ _DEFAULT_BUDGET = 64 * 1024 * 1024  # bytes of packed rows in flight
 FP_SUBMIT = chaos.register_point("device_plane.submit")
 
 _tls = threading.local()
+
+# ---------------------------------------------------------------------------
+# loongtenant: per-tenant (per-pipeline) shares of the in-flight byte budget.
+#
+# The chip-lane share mechanics (ops/chip_lanes.ChipLane.over_share),
+# re-keyed per pipeline: with N registered tenants each gets budget/N, and
+# a tenant dispatching past its share must drain ITS OWN oldest in-flight
+# chunk first (the caller's on_wait hook — the same never-sleep-owning-
+# budget discipline, per tenant).  Other tenants are untouched: they only
+# ever wait on the GLOBAL budget, so one hot pipeline's backlog drains
+# through its own lane instead of starving the other 255.
+#
+# The registry is module-level (not per-plane) so reset_for_testing()
+# cannot orphan accounting, and the worker binds its current tenant via
+# TLS (set_thread_tenant) exactly like chip_lanes.set_thread_lane.
+
+_tenant_lock = threading.Lock()
+_tenant_registered: set = set()            # tenant names holding a share
+_tenant_inflight: Dict[str, int] = {}      # name -> dispatched bytes in flight
+
+
+def set_thread_tenant(name: Optional[str]) -> None:
+    """Bind THIS thread's dispatches to a tenant (the processor runner
+    sets the owning pipeline's name around process/complete; None
+    unbinds)."""
+    _tls.tenant = name
+
+
+def current_tenant() -> Optional[str]:
+    return getattr(_tls, "tenant", None)
+
+
+def register_tenant(name: str) -> None:
+    """Grant `name` a share of the plane budget (pipeline manager, at
+    config apply).  Re-registering an existing tenant (a reload's next
+    generation) is a no-op — the share follows the NAME, not the
+    generation."""
+    if not name:
+        return
+    with _tenant_lock:
+        _tenant_registered.add(name)
+
+
+def unregister_tenant(name: str) -> None:
+    """Drop `name`'s share (pipeline removed).  In-flight accounting for
+    still-unresolved futures survives until they settle."""
+    with _tenant_lock:
+        _tenant_registered.discard(name)
+        if not _tenant_inflight.get(name):
+            _tenant_inflight.pop(name, None)
+
+
+def tenant_count() -> int:
+    with _tenant_lock:
+        return len(_tenant_registered)
+
+
+def _tenant_note(name: str, delta: int) -> None:
+    with _tenant_lock:
+        cur = max(0, _tenant_inflight.get(name, 0) + delta)
+        if cur == 0 and name not in _tenant_registered:
+            _tenant_inflight.pop(name, None)
+        else:
+            _tenant_inflight[name] = cur
+
+
+def tenant_inflight_bytes(name: str) -> int:
+    with _tenant_lock:
+        return _tenant_inflight.get(name, 0)
+
+
+def tenant_share_bytes(budget_bytes: int) -> int:
+    """One tenant's slice of the plane budget (0 = sharing inactive:
+    fewer than two tenants, or an unbounded plane)."""
+    with _tenant_lock:
+        n = len(_tenant_registered)
+    if n <= 1 or not budget_bytes:
+        return 0
+    return budget_bytes // n
+
+
+def tenant_over_share(name: str, nbytes: int, budget_bytes: int) -> bool:
+    """True when dispatching `nbytes` more would push `name` past its
+    per-tenant share.  Never true with <2 tenants (the single-tenant
+    agent keeps the whole budget — exactly the pre-tenant behaviour)."""
+    share = tenant_share_bytes(budget_bytes)
+    if not share:
+        return False
+    with _tenant_lock:
+        held = _tenant_inflight.get(name, 0)
+    return held > 0 and held + nbytes > share
+
+
+def tenant_snapshot(budget_bytes: Optional[int] = None) -> Dict[str, dict]:
+    """Per-tenant budget view for /debug/status (observe-only)."""
+    if budget_bytes is None:
+        plane = DevicePlane._instance
+        budget_bytes = plane.budget_bytes if plane is not None else 0
+    share = tenant_share_bytes(budget_bytes)
+    with _tenant_lock:
+        names = set(_tenant_registered) | set(_tenant_inflight)
+        rows = {n: _tenant_inflight.get(n, 0) for n in names}
+    return {n: {"inflight_bytes": held,
+                "share_bytes": share,
+                "over_share": bool(share and held > share)}
+            for n, held in sorted(rows.items())}
+
+
+def reset_tenants_for_testing() -> None:
+    with _tenant_lock:
+        _tenant_registered.clear()
+        _tenant_inflight.clear()
 
 # submit→resolve stopwatch sink: one shared histogram (lazy so importing
 # the plane never touches the metrics registry)
@@ -140,12 +252,12 @@ class DeviceFuture:
     """
 
     __slots__ = ("_plane", "_nbytes", "_outputs", "_error", "_done",
-                 "_materialised", "_t0", "_span", "__weakref__")
+                 "_materialised", "_t0", "_span", "_tenant", "__weakref__")
 
     def __init__(self, plane: "DevicePlane", nbytes: int,
                  outputs: Optional[Sequence] = None,
                  error: Optional[BaseException] = None,
-                 span=None):
+                 span=None, tenant: Optional[str] = None):
         self._plane = plane
         self._nbytes = nbytes
         self._outputs = outputs
@@ -156,6 +268,15 @@ class DeviceFuture:
         # future exists; result()/release() stops it exactly once
         self._t0 = time.perf_counter()
         self._span = span
+        # loongtenant: which tenant's share these bytes count against —
+        # credited back exactly once when the future settles
+        self._tenant = tenant
+
+    def _release_budget(self) -> None:
+        self._plane._release(self._nbytes)
+        if self._tenant is not None:
+            _tenant_note(self._tenant, -self._nbytes)
+            self._tenant = None
 
     def result(self) -> List[np.ndarray]:
         if self._done:
@@ -185,7 +306,7 @@ class DeviceFuture:
             self._done = True
             self._outputs = None
             self._span = None
-            self._plane._release(self._nbytes)
+            self._release_budget()
 
     def release(self) -> None:
         """Force-release without materialising: error-path cleanup for a
@@ -201,7 +322,7 @@ class DeviceFuture:
         if self._span is not None:
             self._span.end("released")
             self._span = None
-        self._plane._release(self._nbytes)
+        self._release_budget()
 
     def __del__(self):
         # Last-resort budget backstop: an abandoned in-flight future must
@@ -214,7 +335,7 @@ class DeviceFuture:
                 if self._span is not None:
                     self._span.end("abandoned")
                     self._span = None
-                self._plane._release(self._nbytes)
+                self._release_budget()
                 log.warning(
                     "DeviceFuture dropped without result()/release(); "
                     "budget (%d bytes) reclaimed by finaliser — fix the "
@@ -430,7 +551,18 @@ class DevicePlane:
         future rather than raising here, so a multi-chunk dispatch loop keeps
         its bookkeeping simple and errors surface at the (ordered)
         materialisation point."""
+        tenant = getattr(_tls, "tenant", None)
+        if tenant is not None and on_wait is not None:
+            # per-tenant budget share (loongtenant): a tenant already past
+            # budget/n_tenants drains ITS OWN oldest in-flight chunk before
+            # dispatching more.  Other tenants never enter this loop — one
+            # hot pipeline's backlog costs only that pipeline latency
+            while tenant_over_share(tenant, nbytes, self.budget_bytes):
+                if not on_wait():
+                    break
         inflight_now = self._acquire(nbytes, should_abort, on_wait)
+        if tenant is not None:
+            _tenant_note(tenant, nbytes)
         dispatch_counter().add(1)
         if self.budget_bytes:
             held_fraction_histogram().observe(
@@ -451,14 +583,18 @@ class DevicePlane:
                 prof.pop_marker()
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)
-            return DeviceFuture(self, nbytes, outputs=outputs, span=span)
+            return DeviceFuture(self, nbytes, outputs=outputs, span=span,
+                                tenant=tenant)
         except DispatchAborted:
             if span is not None:
                 span.end("aborted")
             self._release(nbytes)
+            if tenant is not None:
+                _tenant_note(tenant, -nbytes)
             raise
         except BaseException as e:  # noqa: BLE001 — deliver via result()
-            return DeviceFuture(self, nbytes, error=e, span=span)
+            return DeviceFuture(self, nbytes, error=e, span=span,
+                                tenant=tenant)
 
 
 class DispatchAborted(RuntimeError):

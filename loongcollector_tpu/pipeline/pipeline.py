@@ -69,6 +69,11 @@ class _ProcDrainHook:
 class CollectionPipeline:
     def __init__(self) -> None:
         self.name = ""
+        # loongtenant: reload generation stamp — the manager bumps it per
+        # applied config so /debug/status and the flight recorder can name
+        # WHICH incarnation of a pipeline an event belongs to.  0 = never
+        # managed (tests constructing pipelines directly)
+        self.generation = 0
         self.config: Dict[str, Any] = {}
         self.context = PluginContext()
         self.inputs: List[InputInstance] = []
@@ -264,8 +269,24 @@ class CollectionPipeline:
 
     def start(self) -> None:
         """Sink-to-source order (reference :393-417)."""
+        self.start_flushers()
+        self.start_inputs()
+
+    def start_flushers(self) -> None:
+        """Bring the sink side up.  During a hot reload the manager calls
+        this BEFORE the old generation stops: the moment the new
+        generation is registered under the name, groups popped from the
+        (shared) process queue route through a chain whose flushers are
+        already ready — generation N+1 admits before N stops."""
         for f in self.flushers:
             f.start()
+
+    def start_inputs(self) -> None:
+        """Bring the source side up.  Deliberately separate from
+        start_flushers: during a reload the OLD generation's inputs must
+        stop before the new generation's start (two live tails of one
+        file would double-read), so the manager sequences
+        start_flushers → drain old → start_inputs."""
         for i in self.inputs:
             i.start()
 

@@ -1,24 +1,120 @@
-"""Pipeline manager: named pipelines + atomic hot swap.
+"""Pipeline manager: named pipelines + generation-stamped hot swap.
 
 Reference: core/collection_pipeline/CollectionPipelineManager.cpp
-UpdatePipelines(diff) — per changed pipeline: stop old (drain), init + start
-new; removed pipelines stop with is_removing=True and their queues are GC'd.
+UpdatePipelines(diff) — the reference agent's defining production feature:
+configs swap on a RUNNING agent without dropping events.
+
+loongtenant rebuilds the swap as a **generation-stamped drain-and-handoff**
+(docs/robustness.md#hot-reload--tenant-isolation):
+
+  * each applied config creates generation N+1, which inits, brings its
+    sink side up and REGISTERS under the name BEFORE generation N stops —
+    the shared process queue key resolves to the new chain the moment it
+    flips, so admission never pauses;
+  * generation N then drains source-to-sink through the existing
+    watermark queues (inputs stop, in-process groups finish, held
+    processor state + batchers flush through N's own chain); serialized
+    payloads a WEDGED sink cannot drain within ``reload_drain_timeout``
+    spill to the disk buffer under ``enable_full_drain_mode`` (ledger
+    B_SPILL — replay re-delivers when the sink recovers);
+  * a failed N+1 init **rolls back**: generation N is never touched and
+    keeps serving traffic; the failure is alarmed
+    (``CONFIG_UPDATE_FAILED``), counted and flight-recorded.  This
+    replaces the pre-loongtenant behaviour that dropped the OLD pipeline
+    too ("keeping none") — the failure mode a fleet rollout of one bad
+    YAML turns into a total collection outage;
+  * every apply/remove passes the chaos point ``pipeline_manager.update``
+    — an injected ERROR is a failed apply (rollback) or a deferred
+    removal (the pipeline keeps running; retried on the next update);
+  * per-tenant device-budget shares register with the DevicePlane
+    (ops/device_plane.register_tenant) so hundreds of concurrent tenant
+    pipelines split the in-flight byte budget instead of starving each
+    other.
+
+Removed pipelines stop with is_removing=True and their queues are GC'd.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
+from .. import chaos
+from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from ..prof import flight
+from ..utils import flags
 from ..utils.logger import get_logger
 from .pipeline import CollectionPipeline
 
 log = get_logger("pipeline_manager")
 
+#: control-plane chaos point: one hit per pipeline apply/remove inside
+#: update_pipelines — an injected ERROR exercises the rollback / deferred-
+#: removal paths, DELAY models a slow control plane (docs/robustness.md)
+FP_UPDATE = chaos.register_point("pipeline_manager.update")
+
+# how long a hot reload waits for the OLD generation's sender queues to
+# drain before spilling the remainder to disk (enable_full_drain_mode)
+flags.DEFINE_FLAG_DOUBLE(
+    "reload_drain_timeout",
+    "seconds a reload waits for the old generation's sender queues "
+    "before spilling to the disk buffer", 2.0)
+
 # observe-only handle for /debug/status (monitor/exposition.py): the most
 # recently constructed manager — never constructed, never mutated through
 # this; stop_all() clears it (runner/processor_runner.py idiom)
 _active_manager = None
+
+# -- reload telemetry (module-shared: managers come and go in tests, the
+#    counters are process-lifetime) ------------------------------------------
+
+_reload_metrics = None
+_reload_metrics_lock = threading.Lock()
+
+
+def reload_metrics():
+    """``pipeline_reloads_total`` / ``config_update_failed_total`` /
+    ``pipeline_removals_total`` counters (component=pipeline_manager).
+    Double-checked lock: concurrent first reloads must not
+    double-register the record (the aggregator-base race shape)."""
+    global _reload_metrics
+    if _reload_metrics is None:
+        with _reload_metrics_lock:
+            if _reload_metrics is None:
+                from ..monitor.metrics import MetricsRecord
+                _reload_metrics = MetricsRecord(
+                    category="component",
+                    labels={"component": "pipeline_manager"})
+    return _reload_metrics
+
+
+_reload_hist = None
+_drain_hist = None
+
+
+def reload_histogram():
+    """``pipeline_reload_seconds``: wall time of one successful config
+    apply (init → handoff → old-generation drain → inputs started)."""
+    global _reload_hist
+    if _reload_hist is None:
+        from ..monitor.metrics import shared_histogram
+        _reload_hist = shared_histogram(
+            "pipeline_reload_seconds",
+            labels={"component": "pipeline_manager"})
+    return _reload_hist
+
+
+def drain_histogram():
+    """``pipeline_reload_drain_seconds``: the old-generation drain slice
+    of a reload — the number that grows when a sink wedges."""
+    global _drain_hist
+    if _drain_hist is None:
+        from ..monitor.metrics import shared_histogram
+        _drain_hist = shared_histogram(
+            "pipeline_reload_drain_seconds",
+            labels={"component": "pipeline_manager"})
+    return _drain_hist
 
 
 class ConfigDiff:
@@ -41,17 +137,32 @@ class CollectionPipelineManager:
         self._pending_onetime: Dict[str, dict] = {}
         # queue_key -> pipeline, rebuilt lazily after every topology change
         self._queue_key_cache: Dict[int, CollectionPipeline] = {}
+        # loongtenant bookkeeping -------------------------------------------
+        # name -> reload generation (monotone per name; survives rollback)
+        self._generations: Dict[str, int] = {}
+        # old generations mid-drain: still live occupancy for the ledger's
+        # quiesce probe even though the name already points at N+1
+        self._draining: List[CollectionPipeline] = []
+        # removals a chaos/control-plane fault deferred: retried at the
+        # head of every subsequent update (the pipeline keeps serving in
+        # the meantime — a deferred removal is never a loss)
+        self._pending_removals: set = set()
+        # name -> last reload outcome, for /debug/status tenants rows
+        self._last_reload: Dict[str, dict] = {}
         global _active_manager
         _active_manager = self
 
     def update_pipelines(self, diff: ConfigDiff) -> None:
-        # drop the hot-path queue-key cache for the duration of the update
-        # (consumers fall back to the locked scan) and rebuild it at the
-        # end — lazy filling DURING the mutation window could cache a
-        # pipeline this very update is replacing
+        self._mutate_topology(lambda: self._update_pipelines_inner(diff))
+
+    def _mutate_topology(self, fn) -> None:
+        """Run a topology mutation with the hot-path queue-key cache
+        dropped for its duration (consumers fall back to the locked
+        scan) and rebuilt at the end — lazy filling DURING the mutation
+        window could cache a pipeline the mutation is replacing."""
         self._queue_key_cache = {}
         try:
-            self._update_pipelines_inner(diff)
+            fn()
         finally:
             with self._lock:
                 self._queue_key_cache = {
@@ -59,51 +170,241 @@ class CollectionPipelineManager:
                     for p in self._pipelines.values()}
 
     def _update_pipelines_inner(self, diff: ConfigDiff) -> None:
-        for name in diff.removed:
-            old = self._pipelines.get(name)
-            if old is not None:
-                old.stop(is_removing=True)
-                old.release()
-                if self.process_queue_manager is not None:
-                    self.process_queue_manager.delete_queue(old.process_queue_key)
-                with self._lock:
-                    del self._pipelines[name]
-                log.info("pipeline %s removed", name)
+        with self._lock:
+            deferred = sorted(self._pending_removals
+                              - set(diff.added) - set(diff.modified))
+        for name in list(diff.removed) + deferred:
+            self._remove_pipeline(name)
         for name, cfg in list(diff.modified.items()) + list(diff.added.items()):
             if self._is_onetime(cfg) and self.onetime_manager is not None \
                     and self.onetime_manager.already_ran(cfg):
                 log.info("onetime config %s already completed; skipping", name)
                 continue
-            old = self._pipelines.get(name)
-            if old is not None:
-                old.stop(is_removing=False)
-                old.release()
-            p = CollectionPipeline()
-            try:
-                ok = p.init(name, cfg, self.process_queue_manager,
-                            self.sender_queue_manager,
-                            reuse_queue_key=(old.process_queue_key
-                                             if old else None))
-            except Exception:  # noqa: BLE001 - a bad config must not kill the agent
-                log.exception("pipeline %s init raised", name)
-                p.release()
-                ok = False
-            if not ok:
-                log.error("pipeline %s failed to init; keeping none", name)
-                with self._lock:
-                    self._pipelines.pop(name, None)
-                continue
-            # register BEFORE starting inputs (sink-to-source: the runner must
-            # be able to resolve the queue key as soon as data flows)
+            self._apply_config(name, cfg)
+
+    # -- removal -------------------------------------------------------------
+
+    def _remove_pipeline(self, name: str) -> None:
+        old = self._pipelines.get(name)
+        if old is None:
             with self._lock:
-                self._pipelines[name] = p
-            p.start()
-            log.info("pipeline %s %s", name, "updated" if old else "started")
-            if self._is_onetime(cfg) and self.onetime_manager is not None:
-                # ingestion finished inside start(), but completion is only
-                # durable once the data has drained through the pipeline —
-                # check_onetime_completion() marks it then
-                self._pending_onetime[name] = cfg
+                self._pending_removals.discard(name)
+            return
+        try:
+            chaos.faultpoint(FP_UPDATE)
+        except chaos.ChaosFault:
+            # injected control-plane fault: the removal DEFERS — the
+            # pipeline keeps serving (zero-loss beats promptness) and the
+            # next update retries it
+            with self._lock:
+                self._pending_removals.add(name)
+            log.warning("pipeline %s removal deferred (control-plane "
+                        "fault); retrying on the next update", name)
+            return
+        old.stop(is_removing=True)
+        old.release()
+        if self.process_queue_manager is not None:
+            self.process_queue_manager.delete_queue(old.process_queue_key)
+        from ..ops import device_plane
+        device_plane.unregister_tenant(name)
+        with self._lock:
+            del self._pipelines[name]
+            self._generations.pop(name, None)
+            self._last_reload.pop(name, None)
+            self._pending_removals.discard(name)
+        reload_metrics().counter("pipeline_removals_total").add(1)
+        log.info("pipeline %s removed", name)
+
+    # -- apply (add / modify) ------------------------------------------------
+
+    def _apply_config(self, name: str, cfg: dict) -> bool:
+        """Apply one config as generation N+1 with drain-and-handoff.
+        Returns False on a failed init — generation N (if any) keeps
+        serving, untouched."""
+        t0 = time.perf_counter()
+        with self._lock:
+            # a config for this name REAPPEARING supersedes any deferred
+            # removal, whether or not this apply succeeds — otherwise a
+            # failed re-apply would roll back to the old generation only
+            # for retry_pending_removals to stop it moments later
+            self._pending_removals.discard(name)
+        old = self._pipelines.get(name)
+        gen = self._generations.get(name, 0) + 1
+        p = CollectionPipeline()
+        p.generation = gen
+        try:
+            # the control-plane fault point sits INSIDE the guarded apply:
+            # an injected ERROR travels the exact rollback path a real
+            # bad-config init failure does
+            chaos.faultpoint(FP_UPDATE)
+            ok = p.init(name, cfg, self.process_queue_manager,
+                        self.sender_queue_manager,
+                        reuse_queue_key=(old.process_queue_key
+                                         if old else None))
+        except Exception:  # noqa: BLE001 - a bad config must not kill the agent
+            log.exception("pipeline %s generation %d init raised", name, gen)
+            try:
+                p.release()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                log.exception("release of failed generation %d raised", gen)
+            ok = False
+        if not ok:
+            self._note_update_failed(name, gen, old, t0)
+            return False
+        # -- handoff: generation N+1 admits BEFORE N stops ------------------
+        # sink side up first, then the name (and with it the shared queue
+        # key) flips to the new generation: a worker popping the queue in
+        # the very next instant walks the NEW chain into ready flushers
+        p.start_flushers()
+        if old is not None:
+            # flush N's batched-but-unsent events BEFORE the flip: once
+            # N+1 starts delivering, a partial batch still resident in
+            # N's batcher would ship AFTER newer events of the same
+            # source (batch residence can be seconds).  Groups still
+            # IN-PROCESS in N's chain at the flip can still land behind
+            # N+1's first sends on MinCnt>1 batched sinks — that residual
+            # window is the concurrency the pause-free handoff buys and
+            # is documented in docs/robustness.md; write-through sinks
+            # (MinCnt=1) keep strict per-source order either way
+            try:
+                old.flush_batch()
+            except Exception:  # noqa: BLE001 — a flush bug must not
+                # block the handoff; the drain's final flush retries
+                log.exception("pre-flip batch flush of %s failed", name)
+        with self._lock:
+            self._pipelines[name] = p
+            self._generations[name] = gen
+            if old is not None:
+                # the old generation stays visible to live-occupancy
+                # probes (ledger quiesce) until its drain completes
+                self._draining.append(old)
+        from ..ops import device_plane
+        device_plane.register_tenant(name)
+        drain_s = 0.0
+        if old is not None:
+            t_drain = time.perf_counter()
+            try:
+                self._drain_old_generation(old)
+            finally:
+                with self._lock:
+                    self._draining.remove(old)
+            drain_s = time.perf_counter() - t_drain
+            drain_histogram().observe(drain_s)
+        # inputs LAST: the old generation's tails closed during the drain,
+        # so the new generation never double-reads a source
+        p.start_inputs()
+        dt = time.perf_counter() - t0
+        reload_histogram().observe(dt)
+        reload_metrics().counter("pipeline_reloads_total").add(1)
+        flight.record("pipeline.reload", pipeline=name, generation=gen,
+                      ms=round(dt * 1000.0, 3))
+        with self._lock:
+            self._last_reload[name] = {
+                "generation": gen, "ok": True,
+                "ms": round(dt * 1000.0, 3),
+                "drain_ms": round(drain_s * 1000.0, 3)}
+        log.info("pipeline %s generation %d %s in %.1f ms", name, gen,
+                 "updated" if old else "started", dt * 1000.0)
+        if self._is_onetime(cfg) and self.onetime_manager is not None:
+            # ingestion finished inside start(), but completion is only
+            # durable once the data has drained through the pipeline —
+            # check_onetime_completion() marks it then
+            self._pending_onetime[name] = cfg
+        return True
+
+    def _note_update_failed(self, name: str, gen: int,
+                            old: Optional[CollectionPipeline],
+                            t0: float) -> None:
+        """Rollback: generation N keeps running exactly as it was.  The
+        failure is alarmed once per (name, message), counted, and lands in
+        the flight ring so a crash dump names the bad config."""
+        reload_metrics().counter("config_update_failed_total").add(1)
+        kept = (f"generation {gen - 1} keeps serving" if old is not None
+                else "no previous generation to keep")
+        AlarmManager.instance().send_alarm(
+            AlarmType.CONFIG_UPDATE_FAILED,
+            f"pipeline {name} generation {gen} failed to init; "
+            f"rolled back ({kept})",
+            AlarmLevel.ERROR, pipeline=name,
+            details={"generation": str(gen),
+                     "kept_old": str(old is not None)})
+        flight.record("pipeline.reload_failed", pipeline=name,
+                      generation=gen, kept_old=old is not None)
+        with self._lock:
+            self._last_reload[name] = {
+                "generation": gen, "ok": False,
+                "ms": round((time.perf_counter() - t0) * 1000.0, 3)}
+        log.error("pipeline %s generation %d failed to init; %s",
+                  name, gen, kept)
+
+    def _drain_old_generation(self, old: CollectionPipeline) -> None:
+        """Source-to-sink drain of generation N while N+1 already serves:
+        inputs stop, in-process groups finish, held processor state and
+        batchers flush through N's OWN chain, then N's global
+        registrations release.  Payloads a wedged sink cannot drain within
+        ``reload_drain_timeout`` spill to disk (enable_full_drain_mode) —
+        the reload never blocks on a dead endpoint and never drops."""
+        old.stop(is_removing=False)
+        old.release()
+        self._spill_wedged_queues(old)
+
+    def _spill_wedged_queues(self, old: CollectionPipeline) -> None:
+        # the import defines the enable_full_drain_mode flag (runner
+        # module owns it) — read it only after
+        from ..runner import flusher_runner as _fr
+        fr = _fr._active_runner
+        if fr is None or fr.disk_buffer is None \
+                or not flags.get_flag("enable_full_drain_mode"):
+            return
+        queues = [f.plugin.sender_queue for f in old.flushers
+                  if getattr(f.plugin, "sender_queue", None) is not None]
+        if not queues:
+            return
+        deadline = time.monotonic() + max(
+            0.0, float(flags.get_flag("reload_drain_timeout")))
+        while any(not q.empty() for q in queues):
+            if time.monotonic() < deadline:
+                time.sleep(0.02)
+                continue
+            # deadline hit: spill whatever is claimable now, then give
+            # items briefly in flight at the sink a few more rounds to
+            # land back (or out) before giving up on them — an item the
+            # rounds miss keeps retrying and exits through the normal
+            # try-count spill
+            spilled = 0
+            for _ in range(10):
+                for q in queues:
+                    spilled += fr.spill_queue(q)
+                if all(q.empty() for q in queues):
+                    break
+                time.sleep(0.05)
+            if spilled:
+                log.warning(
+                    "reload drain timed out; spilled %d payloads of "
+                    "retiring generation %d of %s to disk",
+                    spilled, old.generation, old.name)
+                flight.record("pipeline.reload_spill",
+                              pipeline=old.name,
+                              generation=old.generation, items=spilled)
+            break
+
+    def retry_pending_removals(self) -> None:
+        """Drive chaos/control-plane-deferred removals to completion.
+        Deferred removals normally retry at the head of the next
+        update_pipelines call, but a QUIET config dir may never produce
+        another diff — the application's supervision loop calls this
+        each scan round (no-op when nothing is pending)."""
+        with self._lock:
+            pending = sorted(self._pending_removals)
+        if not pending:
+            return
+
+        def _retry():
+            for name in pending:
+                self._remove_pipeline(name)
+        self._mutate_topology(_retry)
+
+    # -- onetime -------------------------------------------------------------
 
     def check_onetime_completion(self, process_queue_manager,
                                  sender_queue_manager=None) -> None:
@@ -136,9 +437,22 @@ class CollectionPipelineManager:
         return bool(inputs) and all(
             str(i.get("Type", "")).endswith("_onetime") for i in inputs)
 
+    # -- lookup --------------------------------------------------------------
+
     def find_pipeline(self, name: str) -> Optional[CollectionPipeline]:
         with self._lock:
             return self._pipelines.get(name)
+
+    def generation_of(self, name: str) -> int:
+        with self._lock:
+            return self._generations.get(name, 0)
+
+    def draining_pipelines(self) -> List[CollectionPipeline]:
+        """Old generations currently mid-drain — still live occupancy for
+        the conservation auditor even though the name already resolves to
+        the next generation."""
+        with self._lock:
+            return list(self._draining)
 
     def find_pipeline_by_queue_key(self, key: int) -> Optional[CollectionPipeline]:
         # hot path: the processor runner resolves this once per popped
@@ -159,14 +473,57 @@ class CollectionPipelineManager:
         with self._lock:
             return list(self._pipelines)
 
+    def tenants_status(self) -> dict:
+        """The /debug/status ``tenants`` section: per-pipeline generation,
+        queue depth, last reload outcome and device-budget share — the
+        one-page answer to "which tenants does this agent run and how did
+        their last reload go" (observe-only, fail-soft)."""
+        from ..ops import device_plane
+        shares = device_plane.tenant_snapshot()
+        pqm = self.process_queue_manager
+        with self._lock:
+            items = list(self._pipelines.items())
+            generations = dict(self._generations)
+            last = {n: dict(r) for n, r in self._last_reload.items()}
+            draining = [(p.name, p.generation) for p in self._draining]
+            pending_removals = sorted(self._pending_removals)
+        tenants = {}
+        for name, p in items:
+            row = {"generation": generations.get(name, p.generation),
+                   "queue_key": p.process_queue_key}
+            if pqm is not None:
+                q = pqm.get_queue(p.process_queue_key)
+                if q is not None:
+                    row["queue_depth"] = q.size()
+            if name in last:
+                row["last_reload"] = last[name]
+            if name in shares:
+                row["device_budget"] = shares[name]
+            tenants[name] = row
+        doc = {"count": len(tenants), "tenants": tenants}
+        if draining:
+            doc["draining"] = [{"pipeline": n, "generation": g}
+                               for n, g in draining]
+        if pending_removals:
+            doc["pending_removals"] = pending_removals
+        return doc
+
     def stop_all(self) -> None:
         global _active_manager
         if _active_manager is self:
             _active_manager = None
         with self._lock:
             pipelines = list(self._pipelines.values())
+            names = list(self._pipelines)
         for p in pipelines:
             p.stop(is_removing=False)
+        # release the device-budget shares too: a stopped manager's names
+        # must not linger in the module-level registry and shrink every
+        # later manager's per-tenant share (tests/benches build and
+        # discard managers freely)
+        from ..ops import device_plane
+        for name in names:
+            device_plane.unregister_tenant(name)
 
     def flush_all_batch(self) -> None:
         with self._lock:

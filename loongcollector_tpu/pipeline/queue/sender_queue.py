@@ -125,6 +125,21 @@ class SenderQueue:
                 out.append(item)
         return out
 
+    def claim_idle_items(self) -> List[SenderQueueItem]:
+        """Atomically claim every IDLE, not-in-flight item (status →
+        SENDING so the dispatch loop skips them).  The caller owns the
+        claimed items' terminal outcome — spill them or hand each back
+        via reset_item_status.  Used by the hot-reload drain spill
+        (loongtenant); keeps _items/_lock private to this class."""
+        out: List[SenderQueueItem] = []
+        with self._lock:
+            for item in self._items:
+                if item.status is SendingStatus.IDLE \
+                        and not item.in_flight:
+                    item.status = SendingStatus.SENDING
+                    out.append(item)
+        return out
+
     def remove(self, item: SenderQueueItem) -> bool:
         feedbacks = []
         with self._lock:

@@ -5,14 +5,22 @@ payloads that cannot be sent (endpoint down, agent exiting) spill to disk
 and replay on recovery; FlusherRunner spills SLS items at exit
 (FlusherRunner.cpp:223-227, enable_full_drain_mode).
 
-Format: one file per payload under <dir>/buffer_<ts>_<seq>.lcb with a JSON
-header line (flusher identity + raw size + metadata) followed by the
-payload bytes — ENCRYPTED at rest when a PayloadCipher is attached
+Format: one file per payload under <dir>/<tenant>/buffer_<ts>_<seq>.lcb
+with a JSON header line (flusher identity + raw size + metadata) followed
+by the payload bytes — ENCRYPTED at rest when a PayloadCipher is attached
 (reference DiskBufferWriter.h:56 treats buffer-file encryption as
 production-critical; a host-level reader of the spill directory must not
 recover log content).  Plaintext files from older runs still replay.
 Replay re-enqueues through the live flusher of the same pipeline/plugin
 identity when it exists.
+
+loongtenant namespace isolation: spills land in a per-pipeline
+subdirectory (``<dir>/<sanitized pipeline name>/``; legacy files in the
+root keep replaying) with a per-tenant byte quota — ``max_bytes`` split
+evenly over the namespaces present — so one tenant filling the buffer
+can refuse only ITS OWN spills, and ``pending()`` interleaves
+namespaces round-robin so one tenant's deep backlog cannot starve every
+other tenant's replay behind the per-round ``limit``.
 """
 
 from __future__ import annotations
@@ -39,6 +47,23 @@ MAX_BUFFER_BYTES = 512 * 1024 * 1024
 FP_WRITE = chaos.register_point("disk_buffer.write")
 FP_REPLAY = chaos.register_point("disk_buffer.replay")
 
+_NS_SAFE = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_."
+
+
+def _namespace_of(pipeline: str) -> str:
+    """Filesystem-safe per-tenant namespace ("" = legacy root for
+    unattributed payloads).  Collisions after sanitisation merge two
+    tenants' QUOTAS, never their bytes (each file's header still names
+    its true pipeline)."""
+    if not pipeline:
+        return ""
+    ns = "".join(c if c in _NS_SAFE else "_" for c in pipeline)[:120]
+    # ".."/"." are path traversal, a ".bad"-style suffix is quarantine
+    # vocabulary — none may become a directory name
+    if ns in (".", "..") or ns.startswith("."):
+        ns = "_" + ns.lstrip(".")
+    return ns or "_"
+
 
 class DiskBufferWriter:
     def __init__(self, directory: str,
@@ -50,7 +75,9 @@ class DiskBufferWriter:
         self._seq = 0
         self._lock = threading.Lock()
         self._run_id = uuid.uuid4().hex[:8]  # filenames unique across restarts
-        self._total = None  # lazily-initialized running byte total
+        # lazily-initialized running byte totals, keyed per tenant
+        # namespace ("" = legacy root files); None until first scanned
+        self._totals = None  # type: Optional[dict]
         # loongledger sidecar: path -> (pipeline, event_cnt) for files THIS
         # process spilled (and thus ledgered as B_SPILL).  A quarantined
         # file whose header is unreadable still settles its ledger balance
@@ -58,20 +85,69 @@ class DiskBufferWriter:
         # never counted, so their quarantine records nothing
         self._spill_ledger: dict = {}
 
+    # -- namespace accounting ------------------------------------------------
+
+    def _ns_of_path(self, path: str) -> str:
+        parent = os.path.dirname(os.path.abspath(path))
+        root = os.path.abspath(self.directory)
+        return "" if parent == root else os.path.basename(parent)
+
+    def _ensure_totals(self) -> dict:
+        """Lock held.  Per-namespace byte totals of files at rest."""
+        if self._totals is None:
+            totals: dict = {}
+            for ns, paths in self._pending_by_ns().items():
+                for path in paths:
+                    try:
+                        totals[ns] = totals.get(ns, 0) \
+                            + os.path.getsize(path)
+                    except OSError:
+                        pass
+            self._totals = totals
+        return self._totals
+
+    def _tenant_cap(self, totals: dict, ns: str) -> int:
+        """One namespace's byte quota: the global cap split evenly over
+        the namespaces present (this one included).  A single tenant
+        keeps the whole buffer — exactly the pre-tenant behaviour."""
+        n = len(set(totals) | {ns})
+        return self.max_bytes if n <= 1 else self.max_bytes // n
+
+    def tenant_usage(self) -> dict:
+        """Per-namespace bytes at rest (observe-only; "" = legacy root)."""
+        with self._lock:
+            return dict(self._ensure_totals())
+
     # -- write --------------------------------------------------------------
 
     def spill(self, item: SenderQueueItem, identity: dict) -> bool:
         """Persist one sender item.  identity: whatever the flusher needs to
         reclaim the payload (pipeline name, flusher type, plugin id...)."""
-        os.makedirs(self.directory, exist_ok=True)
+        ns = _namespace_of(identity.get("pipeline", ""))
+        ns_dir = (os.path.join(self.directory, ns) if ns
+                  else self.directory)
+        os.makedirs(ns_dir, exist_ok=True)
         with self._lock:
-            if self._total is None:
-                self._total = self._scan_size()
-            if self._total + len(item.data) > self.max_bytes:
+            totals = self._ensure_totals()
+            used = totals.get(ns, 0)
+            cap = self._tenant_cap(totals, ns)
+            if sum(totals.values()) + len(item.data) > self.max_bytes:
+                # the GLOBAL cap still binds: per-tenant quotas divide the
+                # buffer, they never let the sum overshoot it (tenants
+                # arriving one at a time would otherwise stack shrinking
+                # caps up to max_bytes * H(n))
                 log.warning("disk buffer full; dropping payload (%d bytes)",
                             len(item.data))
                 return False
-            self._total += len(item.data)
+            if used + len(item.data) > cap:
+                # per-tenant quota: only THIS tenant's spill refuses —
+                # other namespaces keep their headroom untouched
+                log.warning(
+                    "disk buffer tenant quota exceeded for %r "
+                    "(%d + %d > %d); dropping payload",
+                    ns or "<root>", used, len(item.data), cap)
+                return False
+            totals[ns] = used + len(item.data)
             self._seq += 1
             name = (f"buffer_{int(time.time())}_{self._run_id}"
                     f"_{self._seq}.lcb")
@@ -85,7 +161,7 @@ class DiskBufferWriter:
         if self.cipher is not None:
             payload = self.cipher.encrypt(payload)
             header["enc"] = "hmac-ctr-v1"
-        path = os.path.join(self.directory, name)
+        path = os.path.join(ns_dir, name)
         tmp = path + ".tmp"
         try:
             # injected OSError rides the real write-failure path below;
@@ -107,8 +183,7 @@ class DiskBufferWriter:
         except OSError as e:
             log.error("disk buffer write failed: %s", e)
             with self._lock:
-                if self._total is not None:
-                    self._total -= len(item.data)
+                self._note_removed(path, len(item.data))
             try:
                 os.remove(tmp)
             except OSError:
@@ -135,13 +210,48 @@ class DiskBufferWriter:
 
     # -- read / replay ------------------------------------------------------
 
-    def pending(self) -> List[str]:
+    def _walk_files(self, suffix: str) -> dict:
+        """{namespace: sorted matching paths} over the root (legacy ""
+        files) and every tenant subdirectory — the one traversal both
+        pending() and quarantined() ride."""
+        out: dict = {}
         try:
-            return sorted(os.path.join(self.directory, f)
-                          for f in os.listdir(self.directory)
-                          if f.endswith(".lcb"))
+            entries = sorted(os.listdir(self.directory))
         except OSError:
-            return []
+            return out
+        for e in entries:
+            full = os.path.join(self.directory, e)
+            if e.endswith(suffix):
+                out.setdefault("", []).append(full)
+            elif os.path.isdir(full):
+                try:
+                    files = sorted(os.path.join(full, f)
+                                   for f in os.listdir(full)
+                                   if f.endswith(suffix))
+                except OSError:
+                    continue
+                if files:
+                    out[e] = files
+        return out
+
+    def _pending_by_ns(self) -> dict:
+        return self._walk_files(".lcb")
+
+    def pending(self) -> List[str]:
+        """All buffered payload paths, interleaved ROUND-ROBIN across
+        tenant namespaces (oldest-first within each): replay's per-round
+        ``limit`` then advances every tenant's backlog instead of
+        serving one deep tenant exclusively."""
+        by_ns = self._pending_by_ns()
+        lanes = [by_ns[ns] for ns in sorted(by_ns)]
+        out: List[str] = []
+        i = 0
+        while lanes:
+            lanes = [lane for lane in lanes if i < len(lane)]
+            for lane in lanes:
+                out.append(lane[i])
+            i += 1
+        return out
 
     def read(self, path: str) -> Optional[Tuple[dict, bytes]]:
         status, header, payload = self._read_classified(path)
@@ -241,8 +351,7 @@ class DiskBufferWriter:
             log.error("quarantine of %s failed: %s", path, e)
             return
         with self._lock:
-            if self._total is not None:
-                self._total = max(0, self._total - size)
+            self._note_removed(path, size)
             spilled = self._spill_ledger.pop(path, None)
         if spilled is not None and ledger.is_on():
             # the file was ledgered as B_SPILL when this process wrote it:
@@ -262,12 +371,21 @@ class DiskBufferWriter:
             AlarmLevel.ERROR)
 
     def quarantined(self) -> List[str]:
-        try:
-            return sorted(os.path.join(self.directory, f)
-                          for f in os.listdir(self.directory)
-                          if f.endswith(".lcb.bad"))
-        except OSError:
-            return []
+        by_ns = self._walk_files(".lcb.bad")
+        return [p for ns in sorted(by_ns) for p in by_ns[ns]]
+
+    def _note_removed(self, path: str, size: int) -> None:
+        """Lock held: a file left its namespace — release quota bytes.
+        A namespace that drained to zero leaves the table entirely, so a
+        long-gone tenant does not keep shrinking every LIVE tenant's
+        quota share forever."""
+        if self._totals is not None:
+            ns = self._ns_of_path(path)
+            left = max(0, self._totals.get(ns, 0) - size)
+            if left:
+                self._totals[ns] = left
+            else:
+                self._totals.pop(ns, None)
 
     def _remove(self, path: str) -> None:
         try:
@@ -276,14 +394,4 @@ class DiskBufferWriter:
         except OSError:
             return
         with self._lock:
-            if self._total is not None:
-                self._total = max(0, self._total - size)
-
-    def _scan_size(self) -> int:
-        total = 0
-        for path in self.pending():
-            try:
-                total += os.path.getsize(path)
-            except OSError:
-                pass
-        return total
+            self._note_removed(path, size)

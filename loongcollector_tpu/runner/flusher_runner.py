@@ -26,8 +26,8 @@ from .. import prof, trace
 from ..monitor import ledger
 from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
-from ..pipeline.queue.sender_queue import (SenderQueueItem, SenderQueueManager,
-                                           SendingStatus)
+from ..pipeline.queue.sender_queue import (SenderQueueItem,
+                                           SenderQueueManager)
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..utils import flags
 from ..utils.logger import get_logger
@@ -122,6 +122,28 @@ class FlusherRunner:
         with self._breaker_lock:
             return dict(self._breakers)
 
+    def gc_breakers(self) -> int:
+        """loongtenant: every hot reload retires the old generation's
+        sender queues, but their breakers (and metric records) would
+        accumulate in ``_breakers`` forever under config churn.  Drop and
+        retire breakers whose queue no longer exists — queue keys are
+        never reused, so a dropped key can't come back.  Runs on the
+        runner loop's probe cadence."""
+        with self._breaker_lock:
+            keys = list(self._breakers)
+        dead_keys = [k for k in keys if self.sqm.get_queue(k) is None]
+        if not dead_keys:
+            return 0
+        dead = []
+        with self._breaker_lock:
+            for k in dead_keys:
+                br = self._breakers.pop(k, None)
+                if br is not None:
+                    dead.append(br)
+        for br in dead:
+            br.mark_deleted()
+        return len(dead)
+
     # -- lifecycle -----------------------------------------------------------
 
     def stop(self, drain: bool = True, timeout: float = 5.0) -> None:
@@ -192,12 +214,15 @@ class FlusherRunner:
             # has passed, pull spilled payloads back as probe traffic (a
             # failing probe just re-spills them)
             now = time.monotonic()
-            if (self.disk_buffer is not None
-                    and now - last_probe_replay >= self.breaker_cooldown_s
-                    and any(br.state is not BreakerState.CLOSED
-                            for br in self.breakers().values())):
+            if now - last_probe_replay >= self.breaker_cooldown_s:
                 last_probe_replay = now
-                self._replay_spilled()
+                # reload churn hygiene rides the same cadence: breakers
+                # of deleted sender queues retire instead of accumulating
+                self.gc_breakers()
+                if (self.disk_buffer is not None
+                        and any(br.state is not BreakerState.CLOSED
+                                for br in self.breakers().values())):
+                    self._replay_spilled()
             items = self.sqm.get_available_items()
             if not items:
                 # backlog-aware hand-off (loongcolumn): a sender-queue push
@@ -246,6 +271,48 @@ class FlusherRunner:
                                 identity.get("plugin_id", ""))] = flusher
         self.sqm.remove_item(item)
         return True
+
+    def spill_queue(self, queue) -> int:
+        """loongtenant reload drain fallback: spill a retiring sender
+        queue's idle payloads to the disk buffer so a wedged sink cannot
+        pin an old pipeline generation forever.  Items are CLAIMED
+        (status → SENDING) under the queue lock first, so the dispatch
+        loop can never pick one up concurrently — a double terminal
+        (spill + send_ok for the same events) would read as a negative
+        conservation residual.  Returns how many items spilled; items the
+        buffer refuses are restored to IDLE for the normal retry path."""
+        if self.disk_buffer is None:
+            return 0
+        # backoff-parked items first: a wedged sink's payloads spend most
+        # of their time in the retry HEAP (status SENDING while they wait
+        # out the backoff) — claim them out of the heap so the retry loop
+        # can never redispatch one we are spilling
+        heap_claimed = []
+        with self._retry_lock:
+            keep = []
+            for entry in self._retry_heap:
+                item = entry[2]
+                if item.queue_key == queue.key \
+                        and not getattr(item, "in_flight", False):
+                    heap_claimed.append(item)
+                else:
+                    keep.append(entry)
+            if heap_claimed:
+                self._retry_heap[:] = keep
+                heapq.heapify(self._retry_heap)
+        claimed = queue.claim_idle_items()
+        spilled = 0
+        for item in heap_claimed:
+            if self._spill_item(item):
+                spilled += 1
+            else:
+                self._backoff_retry(item)   # buffer refused: keep retrying
+        for item in claimed:
+            if self._spill_item(item):
+                spilled += 1
+            else:
+                queue.reset_item_status(item)
+        return spilled
 
     def _resolve_spilled(self, identity: dict):
         key = (identity.get("pipeline", ""),

@@ -56,7 +56,8 @@ from ..monitor import ledger
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops import chip_lanes
-from ..ops.device_plane import note_host_backlog, set_budget_relief
+from ..ops.device_plane import (current_tenant, note_host_backlog,
+                                set_budget_relief, set_thread_tenant)
 from ..ops.device_stream import auto_tuner
 from ..prof import flight
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
@@ -741,6 +742,13 @@ class ProcessorRunner:
                     attrs={"pipeline": pipeline.name, "events": n_events})
                 tracer.push_current(sp)
         prof.push_marker("pipeline", pipeline.name or "pipeline")
+        # loongtenant: device dispatches made inside this chain walk count
+        # against THIS pipeline's budget share (ops/device_plane).
+        # Save/restore, not set/clear: the budget-relief hook completes a
+        # lane group INSIDE another pipeline's submit wait on this same
+        # thread — clearing would strip the outer dispatch's binding
+        prev_tenant = current_tenant()
+        set_thread_tenant(pipeline.name or None)
         try:
             try:
                 finish = pipeline.process_begin(groups)
@@ -759,6 +767,7 @@ class ProcessorRunner:
                 self._finish_group(sp, t0, "ok")
                 return None
         finally:
+            set_thread_tenant(prev_tenant)
             prof.pop_marker()
         # the group's device work stays in flight: detach its span from
         # this thread so the NEXT group's dispatch does not nest under it
@@ -818,6 +827,12 @@ class ProcessorRunner:
         led = ledger.is_on()
         if led:
             self._note_in_hand(1)
+        # completion may re-dispatch (fused demotion re-runs, drain hops):
+        # those submits bill this pipeline's tenant share too.  _complete
+        # runs from the budget-relief hook inside ANOTHER pipeline's
+        # submit wait, so restore rather than clear
+        prev_tenant = current_tenant()
+        set_thread_tenant(pipeline.name or None)
         try:
             try:
                 finish()
@@ -836,6 +851,7 @@ class ProcessorRunner:
         finally:
             if led:
                 self._note_in_hand(-1)
+            set_thread_tenant(prev_tenant)
             prof.pop_marker()
 
     def _send(self, pipeline, groups) -> None:

@@ -73,6 +73,13 @@ echo "== aggregation equivalence gate (loongagg) =="
 # columnar and per-event dict paths — docs/performance.md
 JAX_PLATFORMS=cpu python scripts/agg_equivalence.py
 
+echo "== reload-soak smoke (loongtenant) =="
+# sustained config churn under sustained ingest with the live ledger +
+# auditor: any nonzero tenant residual, lost event, or failed reload of a
+# valid config exits nonzero (docs/robustness.md "Hot reload")
+JAX_PLATFORMS=cpu python scripts/reload_soak.py \
+    --tenants 4 --rate 5 --seconds 3
+
 echo "== native lint =="
 make -C native lint
 

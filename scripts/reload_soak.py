@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""loongtenant reload soak: sustained config churn under sustained ingest.
+
+Builds the real in-process stack (process queues → sharded ProcessorRunner
+→ CollectionPipelineManager pipelines → flusher_checker sinks) with the
+conservation ledger + live auditor ON, then:
+
+  * N tenant pipelines ingest continuously (per-tenant per-source
+    sequence-stamped rows);
+  * a churn loop hot-reloads tenants round-robin at ``--rate`` reloads/sec
+    for ``--seconds`` (optionally add/remove a rotating extra tenant with
+    ``--churn-topology``, and storm the control plane with
+    ``--chaos-seed``);
+  * at the end the stack quiesces and the script FAILS (exit 1) if ANY of:
+      - a tenant's conservation residual is nonzero at quiesce,
+      - the live auditor raised CONSERVATION_RESIDUAL mid-soak,
+      - ledger send_ok != pushed for any tenant (an unledgered loss a
+        residual of 0 could in principle mask),
+      - a CONFIG_UPDATE_FAILED alarm fired without --chaos-seed (reloads
+        of a valid config must never fail),
+      - any reload latency was never recorded.
+
+Wired into scripts/lint.sh as a short smoke and into scripts/soak.sh /
+the ``-m slow`` tier with longer parameters (docs/robustness.md).
+
+    python scripts/reload_soak.py --tenants 4 --rate 5 --seconds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _cfg():
+    return {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": 64},
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": r"(\w+):(\d+)", "Keys": ["src", "seq"]}],
+        "flushers": [{"Type": "flusher_checker"}],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--rate", type=float, default=5.0,
+                    help="hot reloads per second across the tenant set")
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--threads", type=int, default=2)
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="storm pipeline_manager.update while churning")
+    ap.add_argument("--churn-topology", action="store_true",
+                    help="also add/remove a rotating extra tenant")
+    args = ap.parse_args()
+
+    from loongcollector_tpu import chaos
+    from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.monitor import ledger
+    from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+    from loongcollector_tpu.pipeline.pipeline_manager import (
+        CollectionPipelineManager, ConfigDiff)
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.queue.sender_queue import \
+        SenderQueueManager
+    from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+
+    tenants = [f"soak{i:03d}" for i in range(args.tenants)]
+    failures = []
+
+    ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
+    pqm = ProcessQueueManager()
+    sqm = SenderQueueManager()
+    mgr = CollectionPipelineManager(pqm, sqm)
+    runner = ProcessorRunner(pqm, mgr, thread_count=args.threads)
+    runner.init()
+
+    def apply(added=None, modified=None, removed=()):
+        d = ConfigDiff()
+        d.added.update(added or {})
+        d.modified.update(modified or {})
+        d.removed.extend(removed)
+        mgr.update_pipelines(d)
+
+    apply(added={t: _cfg() for t in tenants})
+    for t in tenants:
+        if mgr.find_pipeline(t) is None:
+            print(f"FATAL: tenant {t} never initialised", file=sys.stderr)
+            return 1
+
+    if args.chaos_seed is not None:
+        chaos.install(ChaosPlan(args.chaos_seed, {
+            "pipeline_manager.update": FaultSpec(
+                prob=0.3, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+                delay_range=(0.001, 0.01), max_faults=16)}))
+
+    pushed = {t: 0 for t in tenants}
+    seqs = {}
+    stop = threading.Event()
+
+    def push_one(tenant: str, src: bytes, rows: int = 4) -> None:
+        p = mgr.find_pipeline(tenant)
+        if p is None:          # mid-remove window (--churn-topology)
+            return
+        key = (tenant, src)
+        s0 = seqs.get(key, 0)
+        payload = b"\n".join(b"%s:%d" % (src, s0 + j)
+                             for j in range(rows)) + b"\n"
+        sb = SourceBuffer(len(payload) + 64)
+        g = PipelineEventGroup(sb)
+        g.add_raw_event(1).set_content(sb.copy_string(payload))
+        g.set_tag(b"__source__", src)
+        deadline = time.monotonic() + 30
+        while not pqm.push_queue(p.process_queue_key, g):
+            if time.monotonic() > deadline or stop.is_set():
+                return
+            time.sleep(0.002)
+        seqs[key] = s0 + rows
+        pushed[tenant] += rows
+
+    def pusher():
+        i = 0
+        while not stop.is_set():
+            push_one(tenants[i % len(tenants)],
+                     b"s%d" % (i % 3))
+            i += 1
+            time.sleep(0.002)
+
+    push_thread = threading.Thread(target=pusher, daemon=True)
+    push_thread.start()
+
+    reloads = 0
+    extra_serial = 0
+    interval = 1.0 / max(args.rate, 0.1)
+    t_end = time.monotonic() + args.seconds
+    try:
+        while time.monotonic() < t_end:
+            target = tenants[reloads % len(tenants)]
+            apply(modified={target: _cfg()})
+            reloads += 1
+            if args.churn_topology and reloads % 7 == 0:
+                name = f"extra{extra_serial:03d}"
+                if mgr.find_pipeline(name) is None:
+                    apply(added={name: _cfg()})
+                else:
+                    apply(removed=[name])
+                    extra_serial += 1
+            time.sleep(interval)
+    finally:
+        stop.set()
+        push_thread.join(timeout=30)
+        chaos.uninstall()
+
+    snap = ledger.wait_quiesced(timeout=60)
+    if snap is None:
+        failures.append(f"ledger never quiesced "
+                        f"(live_inflight={ledger.live_inflight()})")
+        snap = ledger.active_ledger().snapshot()
+    residuals = ledger.residuals(snap)
+    for t, res in sorted(residuals.items()):
+        if res != 0:
+            failures.append(f"tenant {t}: residual {res:+d} at quiesce")
+    led = ledger.active_ledger()
+    for t, want in sorted(pushed.items()):
+        got = led.total(t, ledger.B_SEND_OK)
+        if got != want:
+            failures.append(f"tenant {t}: pushed {want} but send_ok {got}")
+    if auditor.residual_alarms_total:
+        failures.append(
+            f"auditor raised {auditor.residual_alarms_total} "
+            "CONSERVATION_RESIDUAL alarm(s) mid-soak")
+    alarms = AlarmManager.instance().flush()
+    bad_types = {AlarmType.CONSERVATION_RESIDUAL.value}
+    if args.chaos_seed is None:
+        bad_types.add(AlarmType.CONFIG_UPDATE_FAILED.value)
+    for a in alarms:
+        if a["alarm_type"] in bad_types:
+            failures.append(f"alarm {a['alarm_type']}: "
+                            f"{a['alarm_message']} x{a['alarm_count']}")
+    from loongcollector_tpu.pipeline.pipeline_manager import \
+        reload_histogram
+    hist = reload_histogram().snapshot()
+    if hist["count"] < reloads * 0.5 and args.chaos_seed is None:
+        failures.append(
+            f"only {hist['count']} reload latencies recorded for "
+            f"{reloads} reloads")
+
+    runner.stop()
+    mgr.stop_all()
+    ledger.stop_auditor()
+
+    report = {
+        "tenants": args.tenants,
+        "reloads": reloads,
+        "events_pushed": sum(pushed.values()),
+        "send_ok": sum(led.total(t, ledger.B_SEND_OK) for t in pushed),
+        "reload_ms_p50": round(hist["p50"] * 1000.0, 3),
+        "reload_ms_p99": round(hist["p99"] * 1000.0, 3),
+        "residual_alarms": auditor.residual_alarms_total,
+        "failures": failures,
+    }
+    print(json.dumps(report, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"reload soak OK: {reloads} reloads over {args.tenants} tenants, "
+          f"{report['events_pushed']} events conserved", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,4 +38,12 @@ echo "== chaos soak: extended seed matrix (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m slow -p no:cacheprovider
 
+echo "== reload soak: sustained config churn (loongtenant) =="
+# long churn with topology add/remove AND a control-plane chaos storm —
+# zero residual per tenant, send_ok == pushed, across hundreds of reloads
+JAX_PLATFORMS=cpu python scripts/reload_soak.py \
+    --tenants 8 --rate 10 --seconds 30 --churn-topology
+JAX_PLATFORMS=cpu python scripts/reload_soak.py \
+    --tenants 8 --rate 10 --seconds 30 --churn-topology --chaos-seed 1337
+
 echo "soak OK"

@@ -1,0 +1,1130 @@
+"""loongtenant: zero-loss hot pipeline reload + multi-tenant control plane.
+
+Covers (ISSUE 15):
+
+  * failed-reload ROLLBACK — a modified config whose init fails keeps the
+    OLD generation serving traffic (regression for the pre-loongtenant
+    "keeping none" total-outage bug), CONFIG_UPDATE_FAILED alarmed once,
+    flight-recorded, counted;
+  * generation-stamped drain-and-handoff under sustained ingest: ledger
+    residual==0 across the swap, per-source order preserved, the old
+    generation's metric records retired;
+  * config-watcher diff edges: malformed modified YAML keeps the previous
+    generation, unchanged-content rewrites are not modifies, remove+re-add
+    in one scan is a modify (queue key reused);
+  * per-tenant device-budget shares: an over-share tenant drains its own
+    oldest chunk, other tenants unaffected;
+  * per-tenant disk-buffer namespace isolation + wedged-sink reload spill;
+  * the 8-seed config-churn storm: add/modify/remove tenants mid-storm
+    under control-plane + sink chaos with the LIVE ledger asserting
+    residual==0 per tenant at mid-churn and post-storm quiesce, all live
+    breakers re-closed, schedule prefix-deterministic per seed;
+  * 256 concurrent tenants: shares registered, reloading one tenant does
+    not stall the others (cross-tenant p99 latency bounded).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from loongcollector_tpu import chaos, trace
+from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.monitor import ledger
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+from loongcollector_tpu.monitor.metrics import WriteMetrics
+from loongcollector_tpu.ops import device_plane
+from loongcollector_tpu.ops.device_plane import DevicePlane
+from loongcollector_tpu.pipeline import pipeline_manager as pm_mod
+from loongcollector_tpu.pipeline.pipeline_manager import (
+    CollectionPipelineManager, ConfigDiff)
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import SenderQueueManager
+from loongcollector_tpu.prof import flight
+from loongcollector_tpu.runner import flusher_runner as fr_mod
+from loongcollector_tpu.runner.circuit import BreakerState
+from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+from loongcollector_tpu.runner.flusher_runner import FlusherRunner
+from loongcollector_tpu.runner.http_sink import HttpSink
+from loongcollector_tpu.runner.processor_runner import ProcessorRunner
+from loongcollector_tpu.utils import flags
+
+from conftest import wait_for
+
+SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240804)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    trace.disable()
+    ledger.disable()
+    device_plane.reset_tenants_for_testing()
+    flags.set_flag("enable_full_drain_mode", True)
+    yield
+    chaos.reset()
+    trace.disable()
+    ledger.disable()
+    device_plane.reset_tenants_for_testing()
+    AlarmManager.instance().flush()
+    WriteMetrics.instance().gc_deleted()
+    # restore flags touched by tests
+    flags.set_flag("reload_drain_timeout", 2.0)
+    flags.set_flag("enable_full_drain_mode", True)
+
+
+@pytest.fixture()
+def fast_retries(monkeypatch):
+    monkeypatch.setattr(fr_mod, "RETRY_BASE_S", 0.02)
+    monkeypatch.setattr(fr_mod, "RETRY_MAX_S", 0.25)
+
+
+# ---------------------------------------------------------------------------
+# harness
+
+
+def _file_cfg(out_path, capacity=64):
+    return {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": capacity},
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": r"(\w+):(\d+)", "Keys": ["src", "seq"]}],
+        "flushers": [{"Type": "flusher_file", "FilePath": str(out_path),
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+
+
+def _http_cfg(url, min_size=1):
+    return {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": 64},
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": r"(\w+):(\d+)", "Keys": ["src", "seq"]}],
+        "flushers": [{"Type": "flusher_http", "RemoteURL": url,
+                      "MinCnt": 1, "MinSizeBytes": min_size,
+                      "TimeoutSecs": 0.2}],
+    }
+
+
+def _checker_cfg():
+    return {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "global": {"ProcessQueueCapacity": 64},
+        "processors": [{"Type": "processor_parse_regex_tpu",
+                        "Regex": r"(\w+):(\d+)", "Keys": ["src", "seq"]}],
+        "flushers": [{"Type": "flusher_checker"}],
+    }
+
+
+def _bad_cfg():
+    return {
+        "inputs": [{"Type": "input_static_file_onetime",
+                    "FilePaths": ["/nonexistent"]}],
+        "processors": [{"Type": "processor_that_does_not_exist"}],
+        "flushers": [{"Type": "flusher_file", "FilePath": "/dev/null",
+                      "MinCnt": 1, "MinSizeBytes": 1}],
+    }
+
+
+def _group(lines, source):
+    payload = b"\n".join(lines) + b"\n"
+    sb = SourceBuffer(len(payload) + 64)
+    g = PipelineEventGroup(sb)
+    g.add_raw_event(1).set_content(sb.copy_string(payload))
+    g.set_tag(b"__source__", source)
+    return g
+
+
+class _Counters:
+    """Per-(tenant, source) sequence counters; remembers everything
+    pushed so delivery can be checked exactly."""
+
+    def __init__(self, sources=(b"s0", b"s1")):
+        self.sources = sources
+        self.next_seq = {}
+        self.pushed = {}   # (tenant, src) -> list of seqs
+
+    def push(self, pqm, pipeline, tenant, n_groups=4, rows=4):
+        total = 0
+        for i in range(n_groups):
+            src = self.sources[i % len(self.sources)]
+            key = (tenant, src)
+            seq = self.next_seq.get(key, 0)
+            lines = [b"%s:%d" % (src, seq + j) for j in range(rows)]
+            self.next_seq[key] = seq + rows
+            self.pushed.setdefault(key, []).extend(
+                range(seq, seq + rows))
+            g = _group(lines, src)
+            deadline = time.monotonic() + 20
+            while not pqm.push_queue(pipeline.process_queue_key, g):
+                assert time.monotonic() < deadline, "push never admitted"
+                time.sleep(0.002)
+            total += rows
+        return total
+
+    def total_for(self, tenant):
+        return sum(len(v) for (t, _s), v in self.pushed.items()
+                   if t == tenant)
+
+
+def _stack(thread_count=2):
+    pqm = ProcessQueueManager()
+    sqm = SenderQueueManager()
+    mgr = CollectionPipelineManager(pqm, sqm)
+    runner = ProcessorRunner(pqm, mgr, thread_count=thread_count)
+    runner.init()
+    return pqm, sqm, mgr, runner
+
+
+def _apply(mgr, added=None, modified=None, removed=()):
+    diff = ConfigDiff()
+    diff.added.update(added or {})
+    diff.modified.update(modified or {})
+    diff.removed.extend(removed)
+    mgr.update_pipelines(diff)
+
+
+def _apply_until_live(mgr, cfgs, rounds=30):
+    """The watcher's retry role under control-plane chaos: re-apply until
+    every named tenant is live."""
+    for _ in range(rounds):
+        missing = {n: c for n, c in cfgs.items()
+                   if mgr.find_pipeline(n) is None}
+        if not missing:
+            return
+        _apply(mgr, added=missing)
+    raise AssertionError(f"tenants never came live: {sorted(missing)}")
+
+
+def _modify_until_applied(mgr, name, cfg, rounds=30):
+    want = mgr.generation_of(name)
+    for _ in range(rounds):
+        _apply(mgr, modified={name: cfg})
+        if mgr.generation_of(name) > want \
+                and mgr.find_pipeline(name) is not None:
+            return
+    raise AssertionError(f"modify of {name} never applied")
+
+
+def _remove_until_gone(mgr, name, rounds=30):
+    for _ in range(rounds):
+        _apply(mgr, removed=[name])
+        if mgr.find_pipeline(name) is None:
+            return
+    raise AssertionError(f"removal of {name} never applied")
+
+
+def _read_out(path):
+    """(tenant-agnostic) parsed rows of one flusher_file output."""
+    if not os.path.exists(path):
+        return []
+    rows = []
+    for line in open(path).read().splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if "src" in obj and "seq" in obj:
+            rows.append((obj["src"], int(obj["seq"])))
+    return rows
+
+
+def _per_source(paths):
+    """src -> seqs concatenated over `paths` IN ORDER (generation order:
+    the old generation's file first)."""
+    out = {}
+    for path in paths:
+        for src, seq in _read_out(str(path)):
+            out.setdefault(src, []).append(seq)
+    return out
+
+
+def _app_resolver(mgr):
+    """Application._resolve_buffered_flusher semantics for tests: resolve
+    a spilled payload's identity against the LIVE pipelines."""
+    def resolve(identity):
+        p = mgr.find_pipeline(identity.get("pipeline", ""))
+        if p is None:
+            return None
+        want = identity.get("plugin_id", "")
+        for f in p.flushers:
+            if want and f.plugin_id == want:
+                return f.plugin
+        if not want:
+            for f in p.flushers:
+                if f.plugin.name == identity.get("flusher_type"):
+                    return f.plugin
+        return None
+    return resolve
+
+
+# ---------------------------------------------------------------------------
+# failed-reload rollback (the "keeping none" regression)
+
+
+class TestFailedReloadRollback:
+    def test_modified_init_failure_keeps_old_serving(self, tmp_path):
+        ledger.enable()
+        ledger.reset()
+        pqm, sqm, mgr, runner = _stack()
+        out = tmp_path / "t1.jsonl"
+        counters = _Counters()
+        try:
+            _apply(mgr, added={"t1": _file_cfg(out)})
+            old = mgr.find_pipeline("t1")
+            assert old is not None and old.generation == 1
+            counters.push(pqm, old, "t1", n_groups=2)
+            assert wait_for(lambda: len(_read_out(str(out))) >= 8)
+            failed_before = pm_mod.reload_metrics().counter(
+                "config_update_failed_total").value
+
+            # a fleet rollout of one bad YAML: init fails → ROLLBACK
+            _apply(mgr, modified={"t1": _bad_cfg()})
+
+            assert mgr.find_pipeline("t1") is old, (
+                "failed reload dropped the old pipeline — the exact "
+                "'keeping none' outage this PR fixes")
+            assert mgr.generation_of("t1") == 1
+            # the old generation still DELIVERS (send_ok advancing)
+            before = ledger.active_ledger().total("t1", ledger.B_SEND_OK)
+            counters.push(pqm, old, "t1", n_groups=2)
+            assert wait_for(
+                lambda: ledger.active_ledger().total(
+                    "t1", ledger.B_SEND_OK) >= before + 8)
+            # alarmed once, counted, flight-recorded
+            assert pm_mod.reload_metrics().counter(
+                "config_update_failed_total").value == failed_before + 1
+            alarms = [a for a in AlarmManager.instance().flush()
+                      if a["alarm_type"]
+                      == AlarmType.CONFIG_UPDATE_FAILED.value]
+            assert len(alarms) == 1
+            assert alarms[0]["pipeline"] == "t1"
+            assert alarms[0]["alarm_count"] == "1"
+            fails = flight.recorder().events_by_kind().get(
+                "pipeline.reload_failed", [])
+            assert any(e[3].get("pipeline") == "t1" and e[3].get("kept_old")
+                       for e in fails)
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+    def test_added_init_failure_rolls_back_to_nothing(self):
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        try:
+            _apply(mgr, added={"newbie": _bad_cfg()})
+            assert mgr.find_pipeline("newbie") is None
+            alarms = [a for a in AlarmManager.instance().flush()
+                      if a["alarm_type"]
+                      == AlarmType.CONFIG_UPDATE_FAILED.value]
+            assert len(alarms) == 1
+            assert "no previous generation" in alarms[0]["alarm_message"]
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+    def test_chaos_fault_at_update_rolls_back(self, tmp_path):
+        """An injected control-plane ERROR travels the same rollback path
+        as a real bad-config init failure."""
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        out = tmp_path / "c1.jsonl"
+        try:
+            _apply(mgr, added={"c1": _file_cfg(out)})
+            old = mgr.find_pipeline("c1")
+            assert old is not None
+            chaos.install(ChaosPlan(7, {"pipeline_manager.update": FaultSpec(
+                prob=1.0, kinds=(chaos.ACTION_ERROR,), max_faults=1)}))
+            try:
+                _apply(mgr, modified={"c1": _file_cfg(out)})
+            finally:
+                chaos.uninstall()
+            assert mgr.find_pipeline("c1") is old
+            assert mgr.generation_of("c1") == 1
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+    def test_deferred_removal_retries_on_next_update(self, tmp_path):
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        out = tmp_path / "d1.jsonl"
+        try:
+            _apply(mgr, added={"d1": _file_cfg(out)})
+            chaos.install(ChaosPlan(11, {"pipeline_manager.update":
+                                         FaultSpec(prob=1.0,
+                                                   kinds=(chaos.ACTION_ERROR,),
+                                                   max_faults=1)}))
+            try:
+                _apply(mgr, removed=["d1"])
+                # fault deferred the removal: the pipeline keeps serving
+                assert mgr.find_pipeline("d1") is not None
+                assert "d1" in mgr.tenants_status().get(
+                    "pending_removals", [])
+                # the supervision loop's retry hook drives it home even
+                # with no further config diffs (quiet config dir)
+                mgr.retry_pending_removals()
+            finally:
+                chaos.uninstall()
+            assert mgr.find_pipeline("d1") is None
+            assert mgr.tenants_status().get("pending_removals") is None
+            # idempotent no-op afterwards
+            mgr.retry_pending_removals()
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+    def test_reappearing_config_supersedes_deferred_removal(self, tmp_path):
+        """A config for the name REAPPEARING cancels a deferred removal
+        even when the re-apply fails init — otherwise the rollback keeps
+        the old generation serving only for retry_pending_removals to
+        stop it moments later (a config on disk yielding no pipeline)."""
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        out = tmp_path / "sr.jsonl"
+        try:
+            _apply(mgr, added={"sr1": _file_cfg(out)})
+            old = mgr.find_pipeline("sr1")
+            chaos.install(ChaosPlan(23, {"pipeline_manager.update":
+                                         FaultSpec(prob=1.0,
+                                                   kinds=(chaos.ACTION_ERROR,),
+                                                   max_faults=1)}))
+            try:
+                _apply(mgr, removed=["sr1"])          # deferred (fault)
+                assert mgr.find_pipeline("sr1") is old
+            finally:
+                chaos.uninstall()
+            # the config reappears but fails init: rollback keeps old —
+            # AND the pending removal is superseded
+            _apply(mgr, modified={"sr1": _bad_cfg()})
+            assert mgr.find_pipeline("sr1") is old
+            mgr.retry_pending_removals()
+            assert mgr.find_pipeline("sr1") is old, (
+                "retry_pending_removals stopped the generation the "
+                "rollback promised to keep serving")
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# single reload under sustained ingest
+
+
+class TestReloadUnderIngest:
+    def test_zero_loss_order_and_record_retirement(self, tmp_path):
+        ledger.enable()
+        ledger.reset()
+        auditor = ledger.start_auditor(interval_s=0.05)
+        pqm, sqm, mgr, runner = _stack(thread_count=2)
+        out_a = tmp_path / "r1_a.jsonl"
+        out_b = tmp_path / "r1_b.jsonl"
+        counters = _Counters(sources=(b"s0", b"s1", b"s2"))
+        try:
+            _apply(mgr, added={"r1": _file_cfg(out_a)})
+            p = mgr.find_pipeline("r1")
+            stop_push = threading.Event()
+            pushed_total = [0]
+
+            def _pusher():
+                while not stop_push.is_set():
+                    live = mgr.find_pipeline("r1")
+                    pushed_total[0] += counters.push(
+                        pqm, live, "r1", n_groups=3, rows=4)
+                    time.sleep(0.004)
+
+            t = threading.Thread(target=_pusher, daemon=True)
+            t.start()
+            time.sleep(0.08)          # traffic established
+            gen_before = mgr.generation_of("r1")
+            _apply(mgr, modified={"r1": _file_cfg(out_b)})
+            assert mgr.generation_of("r1") == gen_before + 1
+            new_p = mgr.find_pipeline("r1")
+            assert new_p is not p
+            # queue key survives the swap (queued groups flowed across)
+            assert new_p.process_queue_key == p.process_queue_key
+            time.sleep(0.08)          # traffic through the new generation
+            stop_push.set()
+            t.join(timeout=10)
+
+            snap = ledger.assert_conserved(timeout=30,
+                                           label="single reload")
+            assert auditor.residual_alarms_total == 0
+            row = snap["r1"]
+            # every pushed event exited send_ok (zero loss, no drops)
+            per_src = _per_source([out_a, out_b])
+            got = sum(len(v) for v in per_src.values())
+            assert got == pushed_total[0], (
+                f"lost {pushed_total[0] - got} events across the reload")
+            assert ledger.B_DROP not in row
+            # per-source order: old generation's seqs strictly precede the
+            # new generation's, each internally ordered
+            for src, seqs in per_src.items():
+                assert seqs == sorted(seqs), f"{src} reordered by handoff"
+            # the old generation's metric records retired — no frozen
+            # per-pipeline gauges after a reload
+            WriteMetrics.instance().gc_deleted()
+            live = [r for r in WriteMetrics.instance().records()
+                    if r.category == "pipeline"
+                    and r.labels.get("pipeline_name") == "r1"]
+            assert len(live) == 1, (
+                f"{len(live)} live pipeline records after reload — old "
+                "generation's records must be retired")
+            # reload latency histogram observed the swap
+            hist = pm_mod.reload_histogram()
+            assert hist.snapshot()["count"] >= 2
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+    def test_tenants_status_document(self, tmp_path):
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        out = tmp_path / "ts.jsonl"
+        try:
+            _apply(mgr, added={"ts1": _file_cfg(out)})
+            _apply(mgr, modified={"ts1": _file_cfg(out)})
+            doc = mgr.tenants_status()
+            assert doc["count"] == 1
+            row = doc["tenants"]["ts1"]
+            assert row["generation"] == 2
+            assert row["last_reload"]["ok"] is True
+            assert row["last_reload"]["ms"] >= 0
+            # the exposition page carries the same section
+            from loongcollector_tpu.monitor.exposition import collect_status
+            status = collect_status()
+            assert status["tenants"]["tenants"]["ts1"]["generation"] == 2
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# config-watcher diff edges
+
+
+class TestWatcherDiffEdges:
+    def _watch(self, tmp_path):
+        from loongcollector_tpu.config.watcher import PipelineConfigWatcher
+        w = PipelineConfigWatcher()
+        w.add_source(str(tmp_path))
+        return w
+
+    def test_malformed_modified_yaml_keeps_previous_generation(self, tmp_path):
+        pytest.importorskip("yaml")
+        w = self._watch(tmp_path)
+        f = tmp_path / "keep.yaml"
+        f.write_text("inputs:\n  - Type: input_file\n")
+        d1 = w.check_config_diff()
+        assert set(d1.added) == {"keep"}
+        # malformed rewrite: neither modified nor removed — the previous
+        # generation keeps serving and the scan retries
+        f.write_text("inputs: [unclosed\n  broken: : :\n")
+        os.utime(f, (time.time() + 5, time.time() + 5))
+        d2 = w.check_config_diff()
+        assert d2.empty(), (d2.added, d2.modified, d2.removed)
+        # fixed file applies as a modify
+        f.write_text("inputs:\n  - Type: input_file\n    X: 1\n")
+        os.utime(f, (time.time() + 10, time.time() + 10))
+        d3 = w.check_config_diff()
+        assert set(d3.modified) == {"keep"} and not d3.removed
+
+    def test_unchanged_content_rewrite_is_not_modified(self, tmp_path):
+        w = self._watch(tmp_path)
+        f = tmp_path / "same.json"
+        f.write_text('{"inputs": [{"Type": "input_file"}]}')
+        assert set(w.check_config_diff().added) == {"same"}
+        # rewrite with IDENTICAL bytes, new mtime (config-management tools
+        # re-push unchanged files constantly)
+        f.write_text('{"inputs": [{"Type": "input_file"}]}')
+        os.utime(f, (time.time() + 7, time.time() + 7))
+        d = w.check_config_diff()
+        assert d.empty(), "unchanged-content rewrite restarted the pipeline"
+        # a REAL edit still applies
+        f.write_text('{"inputs": [{"Type": "input_file"}], "x": 1}')
+        os.utime(f, (time.time() + 14, time.time() + 14))
+        assert set(w.check_config_diff().modified) == {"same"}
+
+    def test_env_rotation_reapplies_on_rewrite(self, tmp_path, monkeypatch):
+        """The digest is over the env-EXPANDED text: same file bytes but
+        a rotated ${TOKEN} must re-apply when the file is re-pushed."""
+        monkeypatch.setenv("LOONG_TEST_TOKEN", "secret-one")
+        w = self._watch(tmp_path)
+        f = tmp_path / "env.json"
+        body = '{"inputs": [{"Type": "input_file", "Token": "${LOONG_TEST_TOKEN}"}]}'
+        f.write_text(body)
+        d1 = w.check_config_diff()
+        assert d1.added["env"]["inputs"][0]["Token"] == "secret-one"
+        # credential rotated; config management re-pushes IDENTICAL bytes
+        monkeypatch.setenv("LOONG_TEST_TOKEN", "secret-two")
+        f.write_text(body)
+        os.utime(f, (time.time() + 5, time.time() + 5))
+        d2 = w.check_config_diff()
+        assert set(d2.modified) == {"env"}, (
+            "rotated env var with a re-pushed file must re-apply")
+        assert d2.modified["env"]["inputs"][0]["Token"] == "secret-two"
+        # same env, same bytes: still not a modify
+        f.write_text(body)
+        os.utime(f, (time.time() + 10, time.time() + 10))
+        assert w.check_config_diff().empty()
+
+    def test_remove_and_readd_in_one_scan_is_a_modify(self, tmp_path):
+        w = self._watch(tmp_path)
+        f_old = tmp_path / "mv.json"
+        f_old.write_text('{"inputs": [{"Type": "input_file"}]}')
+        assert set(w.check_config_diff().added) == {"mv"}
+        # the config moved files between scans (yaml→json rename style)
+        f_new = tmp_path / "mv.yaml"
+        f_old.unlink()
+        pytest.importorskip("yaml")
+        f_new.write_text("inputs:\n  - Type: input_file\n    Y: 2\n")
+        d = w.check_config_diff()
+        assert set(d.modified) == {"mv"}, "remove+re-add must be a modify"
+        assert not d.removed and not d.added
+
+    def test_queue_key_reused_across_watcher_modify(self, tmp_path):
+        """The watcher's modify classification is what keeps the queue
+        key (and queued groups) across a file move."""
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        try:
+            cfgdir = tmp_path / "conf"
+            cfgdir.mkdir()
+            w = self._watch(cfgdir)
+            out = tmp_path / "qk.jsonl"
+            (cfgdir / "qk.json").write_text(json.dumps(_file_cfg(out)))
+            mgr.update_pipelines(w.check_config_diff())
+            key1 = mgr.find_pipeline("qk").process_queue_key
+            (cfgdir / "qk.json").unlink()
+            cfg2 = _file_cfg(out)
+            cfg2["global"]["ProcessQueueCapacity"] = 32
+            (cfgdir / "qk.yaml").write_text(json.dumps(cfg2))  # json ⊂ yaml
+            pytest.importorskip("yaml")
+            diff = w.check_config_diff()
+            assert set(diff.modified) == {"qk"}
+            mgr.update_pipelines(diff)
+            assert mgr.find_pipeline("qk").process_queue_key == key1
+            assert mgr.generation_of("qk") == 2
+        finally:
+            runner.stop()
+            mgr.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant device-budget shares
+
+
+class TestTenantBudgetShares:
+    def test_share_math(self):
+        assert device_plane.tenant_share_bytes(1000) == 0  # no tenants
+        device_plane.register_tenant("a")
+        assert device_plane.tenant_share_bytes(1000) == 0  # single tenant
+        device_plane.register_tenant("b")
+        assert device_plane.tenant_share_bytes(1000) == 500
+        device_plane.register_tenant("b")                  # re-register: noop
+        assert device_plane.tenant_count() == 2
+        device_plane.unregister_tenant("b")
+        assert device_plane.tenant_share_bytes(1000) == 0
+
+    def test_over_share_tenant_drains_own_oldest_others_unaffected(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1000)
+        device_plane.register_tenant("hot")
+        device_plane.register_tenant("cold")
+
+        def kernel(x):
+            return (np.asarray(x),)
+
+        drains = {"hot": 0, "cold": 0}
+        futs = {"hot": [], "cold": []}
+
+        def on_wait_for(tenant):
+            def _w():
+                drains[tenant] += 1
+                if futs[tenant]:
+                    futs[tenant].pop(0).result()
+                    return True
+                return False
+            return _w
+
+        try:
+            # hot dispatches up to (then past) its 500-byte share
+            device_plane.set_thread_tenant("hot")
+            for _ in range(2):
+                futs["hot"].append(plane.submit(
+                    kernel, (np.zeros(8),), 250,
+                    on_wait=on_wait_for("hot")))
+            assert device_plane.tenant_inflight_bytes("hot") == 500
+            assert drains["hot"] == 0
+            # the third 250-byte dispatch is over-share: the plane makes
+            # the HOT tenant drain its own oldest chunk first
+            futs["hot"].append(plane.submit(
+                kernel, (np.zeros(8),), 250, on_wait=on_wait_for("hot")))
+            assert drains["hot"] >= 1
+            assert device_plane.tenant_inflight_bytes("hot") <= 500
+            # cold dispatches without ever entering the share loop
+            device_plane.set_thread_tenant("cold")
+            futs["cold"].append(plane.submit(
+                kernel, (np.zeros(8),), 250, on_wait=on_wait_for("cold")))
+            assert drains["cold"] == 0
+            assert device_plane.tenant_inflight_bytes("cold") == 250
+        finally:
+            device_plane.set_thread_tenant(None)
+            for fs in futs.values():
+                for f in fs:
+                    f.result()
+        assert device_plane.tenant_inflight_bytes("hot") == 0
+        assert device_plane.tenant_inflight_bytes("cold") == 0
+        assert plane.inflight_bytes() == 0
+        snap = device_plane.tenant_snapshot(1000)
+        assert snap["hot"]["share_bytes"] == 500
+
+    def test_single_tenant_keeps_whole_budget(self):
+        plane = DevicePlane.reset_for_testing(budget_bytes=1000)
+        device_plane.register_tenant("solo")
+
+        def kernel(x):
+            return (np.asarray(x),)
+
+        device_plane.set_thread_tenant("solo")
+        try:
+            futs = [plane.submit(kernel, (np.zeros(4),), 300,
+                                 on_wait=lambda: (_ for _ in ()).throw(
+                                     AssertionError("share loop entered")))
+                    for _ in range(3)]
+        finally:
+            device_plane.set_thread_tenant(None)
+        for f in futs:
+            f.result()
+        assert plane.inflight_bytes() == 0
+
+    def test_manager_registers_and_unregisters_tenants(self, tmp_path):
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        try:
+            _apply(mgr, added={"ra": _file_cfg(tmp_path / "ra.jsonl"),
+                               "rb": _file_cfg(tmp_path / "rb.jsonl")})
+            assert device_plane.tenant_count() == 2
+            _apply(mgr, removed=["rb"])
+            assert device_plane.tenant_count() == 1
+        finally:
+            runner.stop()
+            mgr.stop_all()
+        # stop_all released the survivors' shares too: a discarded
+        # manager must not leave phantom registrations shrinking every
+        # later manager's per-tenant share
+        assert device_plane.tenant_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# disk-buffer namespace isolation + wedged-sink reload spill
+
+
+class _Item:
+    """Minimal SenderQueueItem stand-in for direct buffer tests."""
+
+    def __init__(self, data, event_cnt=1):
+        from loongcollector_tpu.pipeline.queue.sender_queue import \
+            SenderQueueItem
+        self.item = SenderQueueItem(data, len(data), event_cnt=event_cnt)
+
+
+class TestDiskBufferTenantIsolation:
+    def test_namespaced_spill_and_quota(self, tmp_path):
+        db = DiskBufferWriter(str(tmp_path / "buf"), max_bytes=1000)
+        blob = b"x" * 300
+        assert db.spill(_Item(blob).item, {"pipeline": "tenA",
+                                           "flusher_type": "f"})
+        assert db.spill(_Item(blob).item, {"pipeline": "tenB",
+                                           "flusher_type": "f"})
+        # two namespaces → 500-byte quota each: tenA's second 300-byte
+        # spill exceeds ITS quota and refuses...
+        assert not db.spill(_Item(blob).item, {"pipeline": "tenA",
+                                               "flusher_type": "f"})
+        # ...while tenB still has headroom for a small payload
+        assert db.spill(_Item(b"y" * 100).item, {"pipeline": "tenB",
+                                                 "flusher_type": "f"})
+        usage = db.tenant_usage()
+        assert usage["tenA"] == 300 and usage["tenB"] == 400
+        # files physically live under per-tenant directories
+        for path in db.pending():
+            assert os.path.basename(os.path.dirname(path)) in ("tenA",
+                                                               "tenB")
+
+    def test_global_cap_still_binds_across_tenants(self, tmp_path):
+        """Per-tenant quotas divide the buffer; they never let the SUM
+        overshoot max_bytes (tenants arriving one at a time would
+        otherwise stack shrinking caps up to max_bytes * H(n))."""
+        db = DiskBufferWriter(str(tmp_path / "buf"), max_bytes=1000)
+        # sole tenant fills the whole buffer (cap == max_bytes)
+        assert db.spill(_Item(b"a" * 900).item, {"pipeline": "first",
+                                                 "flusher_type": "f"})
+        # a second tenant's quota is now 500, but the GLOBAL cap has only
+        # 100 bytes left — a 200-byte spill must refuse
+        assert not db.spill(_Item(b"b" * 200).item, {"pipeline": "second",
+                                                     "flusher_type": "f"})
+        assert db.spill(_Item(b"b" * 80).item, {"pipeline": "second",
+                                                "flusher_type": "f"})
+        assert sum(db.tenant_usage().values()) <= 1000
+
+    def test_replay_round_robins_namespaces(self, tmp_path):
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        for i in range(3):
+            db.spill(_Item(b"deep-%d" % i).item,
+                     {"pipeline": "deep", "flusher_type": "f"})
+        db.spill(_Item(b"shallow-0").item,
+                 {"pipeline": "shallow", "flusher_type": "f"})
+        order = [os.path.basename(os.path.dirname(p)) for p in db.pending()]
+        # the shallow tenant's single file is served in the FIRST round,
+        # not behind the deep tenant's whole backlog
+        assert "shallow" in order[:2], order
+
+    def test_wedged_sink_reload_spills_old_generation(self, tmp_path,
+                                                      fast_retries):
+        """A modified tenant whose sink is dead: the old generation's
+        sender queue cannot drain, so the reload spills it to the tenant's
+        disk-buffer namespace instead of blocking or dropping."""
+        ledger.enable()
+        ledger.reset()
+        flags.set_flag("reload_drain_timeout", 0.25)
+        pqm, sqm, mgr, runner = _stack(thread_count=1)
+        sink = HttpSink(workers=1)
+        sink.init()
+        db = DiskBufferWriter(str(tmp_path / "buf"))
+        fr = FlusherRunner(sqm, sink, disk_buffer=db,
+                           breaker_failure_threshold=99,
+                           breaker_error_rate=1.01,
+                           breaker_cooldown_s=30.0)
+        fr.init()
+        counters = _Counters()
+        try:
+            # port 9 (discard) is closed: every send fails fast
+            _apply(mgr, added={"w1": _http_cfg("http://127.0.0.1:9/x")})
+            p = mgr.find_pipeline("w1")
+            counters.push(pqm, p, "w1", n_groups=2, rows=3)
+            assert wait_for(lambda: not sqm.all_empty(), timeout=20), (
+                "payloads never reached the sender queue")
+            _apply(mgr, modified={"w1": _http_cfg("http://127.0.0.1:9/x")})
+            assert mgr.generation_of("w1") == 2
+            assert wait_for(lambda: db.pending() != [], timeout=10), (
+                "wedged old-generation payloads were not spilled")
+            # spilled under the tenant's namespace
+            assert all(os.path.basename(os.path.dirname(pth)) == "w1"
+                       for pth in db.pending())
+            spills = flight.recorder().events_by_kind().get(
+                "pipeline.reload_spill", [])
+            assert any(e[3].get("pipeline") == "w1" for e in spills)
+            # conservation: spill is a counted sink — residual stays 0
+            # (retry traffic of the NEW generation keeps cycling, so only
+            # check the ledger's residual identity, not quiesce)
+            led = ledger.active_ledger()
+            assert led.total("w1", ledger.B_SPILL) > 0
+        finally:
+            fr.stop(drain=False)
+            sink.stop()
+            runner.stop()
+            mgr.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# the 8-seed config-churn storm
+
+
+import http.server
+
+
+class _PathRecordingHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.server.rec_lock:
+            self.server.received.append((self.path, bytes(body)))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def recording_server():
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _PathRecordingHandler)
+    server.received = []
+    server.rec_lock = threading.Lock()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server
+    server.shutdown()
+
+
+def _http_delivered(server, path):
+    """(src, seq) pairs delivered to one tenant's URL path (set — the
+    at-least-once contract allows duplicates, never holes)."""
+    out = set()
+    with server.rec_lock:
+        bodies = [b for p, b in server.received if p == path]
+    for body in bodies:
+        for line in body.splitlines():
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "src" in obj and "seq" in obj:
+                out.add((obj["src"], int(obj["seq"])))
+    return out
+
+
+def _churn_storm(seed, tmp_path, server, monkeypatch):
+    monkeypatch.setattr(fr_mod, "RETRY_BASE_S", 0.02)
+    monkeypatch.setattr(fr_mod, "RETRY_MAX_S", 0.25)
+    flags.set_flag("reload_drain_timeout", 0.5)
+    ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
+    pqm, sqm, mgr, runner = _stack(thread_count=2)
+    sink = HttpSink(workers=2)
+    sink.init()
+    db = DiskBufferWriter(str(tmp_path / f"buf{seed}"))
+    fr = FlusherRunner(sqm, sink, disk_buffer=db,
+                       breaker_failure_threshold=3,
+                       breaker_cooldown_s=0.15)
+    fr.init()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    counters = _Counters()
+    outs = {"f0": [tmp_path / f"f0a_{seed}.jsonl",
+                   tmp_path / f"f0b_{seed}.jsonl"],
+            "f1": [tmp_path / f"f1a_{seed}.jsonl",
+                   tmp_path / f"f1b_{seed}.jsonl"]}
+    try:
+        chaos.install(ChaosPlan(seed, {
+            "pipeline_manager.update": FaultSpec(
+                prob=0.3, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+                delay_range=(0.001, 0.01), max_faults=5),
+            "http_sink.send": FaultSpec(
+                prob=0.35, kinds=(chaos.ACTION_ERROR, chaos.ACTION_DELAY),
+                delay_range=(0.001, 0.005), max_faults=10)}))
+        # -- wave A: four tenants come live under control-plane chaos
+        _apply_until_live(mgr, {
+            "h0": _http_cfg(f"{base}/h0_{seed}"),
+            "h1": _http_cfg(f"{base}/h1_{seed}"),
+            "f0": _file_cfg(outs["f0"][0]),
+            "f1": _file_cfg(outs["f1"][0])})
+        for t in ("h0", "h1", "f0", "f1"):
+            counters.push(pqm, mgr.find_pipeline(t), t, n_groups=4, rows=4)
+        # -- wave B: modify under live traffic, then remove at a quiesce
+        _modify_until_applied(mgr, "f0", _file_cfg(outs["f0"][1]))
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} mid-churn #1")
+        _remove_until_gone(mgr, "f1")
+        for t in ("h0", "h1", "f0"):
+            counters.push(pqm, mgr.find_pipeline(t), t, n_groups=3, rows=4)
+        # -- wave C: re-add the removed tenant, reload an http tenant
+        #    with its traffic still in flight
+        _apply_until_live(mgr, {"f1": _file_cfg(outs["f1"][1])})
+        for t in ("h0", "h1", "f0", "f1"):
+            counters.push(pqm, mgr.find_pipeline(t), t, n_groups=3, rows=4)
+        _modify_until_applied(mgr, "h0",
+                              _http_cfg(f"{base}/h0_{seed}", min_size=2))
+        ledger.assert_conserved(timeout=60,
+                                label=f"seed {seed} mid-churn #2")
+        # -- recovery: trickle until every LIVE breaker re-closes
+        deadline = time.monotonic() + 45
+        while True:
+            ledger.assert_conserved(timeout=60,
+                                    label=f"seed {seed} re-close wave")
+            fr.gc_breakers()
+            open_live = [br for key, br in fr.breakers().items()
+                         if sqm.get_queue(key) is not None
+                         and br.state is not BreakerState.CLOSED]
+            if not open_live:
+                break
+            assert time.monotonic() < deadline, (
+                f"seed {seed}: live breakers never re-closed: "
+                f"{[br.name for br in open_live]}")
+            for t in ("h0", "h1"):
+                counters.push(pqm, mgr.find_pipeline(t), t,
+                              n_groups=1, rows=2)
+            time.sleep(0.2)
+        # -- replay every spilled payload through the application resolver
+        resolver = _app_resolver(mgr)
+        deadline = time.monotonic() + 30
+        while db.pending():
+            db.replay(resolver)
+            ledger.assert_conserved(timeout=60,
+                                    label=f"seed {seed} replay wave")
+            assert time.monotonic() < deadline, (
+                f"seed {seed}: spilled payloads never replayed: "
+                f"{db.pending()}")
+        snap = ledger.assert_conserved(timeout=60,
+                                       label=f"seed {seed} post-storm")
+        assert auditor.residual_alarms_total == 0, (
+            f"seed {seed}: live auditor saw a conservation break")
+        # file tenants: exact delivery, per-source order across generations
+        for t in ("f0", "f1"):
+            per_src = _per_source(outs[t])
+            got = sum(len(v) for v in per_src.values())
+            want = counters.total_for(t)
+            assert got == want, (
+                f"seed {seed}: tenant {t} lost {want - got} events")
+            for src, seqs in per_src.items():
+                assert seqs == sorted(seqs), (
+                    f"seed {seed}: {t}/{src} reordered across the churn")
+        # http tenants: at-least-once — the delivered SET matches pushed
+        for t in ("h0", "h1"):
+            want = {(src.decode(), seq)
+                    for (tt, src), seqs in counters.pushed.items()
+                    if tt == t for seq in seqs}
+            got = _http_delivered(server, f"/{t}_{seed}")
+            assert got == want, (
+                f"seed {seed}: tenant {t} holes="
+                f"{sorted(want - got)[:5]} extras={sorted(got - want)[:5]}")
+        # per-tenant residual rows all balanced (snap covers every tenant)
+        for t, res in ledger.residuals(snap).items():
+            assert res == 0, f"seed {seed}: tenant {t} residual {res}"
+        return {pt: list(evs)
+                for pt, evs in chaos.schedule_by_point().items()}
+    finally:
+        chaos.uninstall()
+        fr.stop(drain=False)
+        sink.stop()
+        runner.stop()
+        mgr.stop_all()
+        ledger.stop_auditor()
+
+
+class TestConfigChurnStorm:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_zero_loss_per_tenant(self, seed, tmp_path, recording_server,
+                                  monkeypatch):
+        schedule = _churn_storm(seed, tmp_path, recording_server,
+                                monkeypatch)
+        # per-seed determinism pins which seeds fault the control plane;
+        # these seeds are known to — the matrix only proves rollback /
+        # deferred-removal recovery if the point actually fires
+        if seed in (3, 42, 20240804):
+            assert schedule.get("pipeline_manager.update"), (
+                f"seed {seed}: the storm never hit the control-plane "
+                "point")
+
+    def test_same_seed_reproduces_schedule_prefix(self, tmp_path,
+                                                  recording_server,
+                                                  monkeypatch):
+        s1 = _churn_storm(42, tmp_path / "a", recording_server, monkeypatch)
+        chaos.reset()
+        ledger.disable()
+        s2 = _churn_storm(42, tmp_path / "b", recording_server, monkeypatch)
+        for pt in set(s1) | set(s2):
+            a, b = s1.get(pt, []), s2.get(pt, [])
+            short, long_ = (a, b) if len(a) <= len(b) else (b, a)
+            assert long_[:len(short)] == short, (
+                f"point {pt}: same-seed schedules diverge")
+
+
+# ---------------------------------------------------------------------------
+# reload soak (the lint.sh smoke, longer in the slow tier)
+
+
+class TestReloadSoak:
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _run(self, *args):
+        import subprocess
+        import sys
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, os.path.join(self.REPO, "scripts",
+                                          "reload_soak.py"), *args],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    @pytest.mark.slow
+    def test_long_churn_with_topology_and_chaos(self):
+        proc = self._run("--tenants", "6", "--rate", "10", "--seconds",
+                         "15", "--churn-topology", "--chaos-seed", "97")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout.splitlines()[-1])
+        assert report["failures"] == []
+        assert report["send_ok"] == report["events_pushed"]
+        assert report["reloads"] >= 50
+
+
+# ---------------------------------------------------------------------------
+# 256 concurrent tenants
+
+
+class TestManyTenants:
+    N = 256
+    OBSERVERS = ("t000", "t064", "t128", "t255")
+
+    @staticmethod
+    def _checker_of(mgr, name):
+        return mgr.find_pipeline(name).flushers[0].plugin
+
+    def test_256_tenants_isolated_reload(self, tmp_path):
+        pqm, sqm, mgr, runner = _stack(thread_count=2)
+        try:
+            _apply(mgr, added={f"t{i:03d}": _checker_cfg()
+                               for i in range(self.N)})
+            assert len(mgr.pipeline_names()) == self.N
+            assert device_plane.tenant_count() == self.N
+            # every tenant delivers
+            counters = _Counters(sources=(b"s0",))
+            for i in range(self.N):
+                name = f"t{i:03d}"
+                counters.push(pqm, mgr.find_pipeline(name), name,
+                              n_groups=1, rows=2)
+            assert wait_for(
+                lambda: all(self._checker_of(mgr, f"t{i:03d}")
+                            .get_log_count() >= 2
+                            for i in range(self.N)), timeout=60), (
+                "some tenant never delivered")
+
+            # reload ONE tenant continuously (with injected control-plane
+            # DELAY making each reload slow) while observers keep flowing;
+            # cross-tenant per-group latency must stay bounded
+            chaos.install(ChaosPlan(5, {"pipeline_manager.update":
+                                        FaultSpec(prob=1.0,
+                                                  kinds=(chaos.ACTION_DELAY,),
+                                                  delay_range=(0.05, 0.15),
+                                                  max_faults=None)}))
+            stop = threading.Event()
+            reloads = [0]
+
+            def _churner():
+                while not stop.is_set():
+                    _apply(mgr, modified={"t007": _checker_cfg()})
+                    reloads[0] += 1
+
+            churn = threading.Thread(target=_churner, daemon=True)
+            churn.start()
+            latencies = []
+            try:
+                for i in range(40):
+                    name = self.OBSERVERS[i % len(self.OBSERVERS)]
+                    p = mgr.find_pipeline(name)
+                    before = self._checker_of(mgr, name).get_log_count()
+                    t0 = time.monotonic()
+                    counters.push(pqm, p, name, n_groups=1, rows=2)
+                    assert wait_for(
+                        lambda: self._checker_of(mgr, name)
+                        .get_log_count() >= before + 2, timeout=20), (
+                        f"observer {name} stalled during t007's reload")
+                    latencies.append(time.monotonic() - t0)
+            finally:
+                stop.set()
+                churn.join(timeout=20)
+                chaos.uninstall()
+            assert reloads[0] >= 3, "the churner never actually reloaded"
+            latencies.sort()
+            p99 = latencies[int(len(latencies) * 0.99) - 1]
+            assert p99 < 2.0, (
+                f"cross-tenant p99 latency {p99:.3f}s during a tenant "
+                f"reload (latencies={latencies[-4:]})")
+            assert mgr.generation_of("t007") >= 4
+            # shares followed the tenant count the whole time
+            budget = DevicePlane.instance().budget_bytes
+            assert device_plane.tenant_share_bytes(budget) \
+                == budget // self.N
+        finally:
+            runner.stop()
+            mgr.stop_all()

@@ -83,15 +83,15 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_eleven_checkers_registered(self):
+    def test_all_twelve_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
                          "swallowed-fault", "unledgered-drop",
                          "metric-naming", "hot-path-materialize",
                          "per-row-parse", "unbounded-window",
-                         "host-bounce"]
-        assert len(all_checkers()) == 11
+                         "host-bounce", "reload-unsafe"]
+        assert len(all_checkers()) == 12
 
 
 # ---------------------------------------------------------------------------
@@ -1653,3 +1653,235 @@ class TestHostBounce:
     def test_registered_in_tier1(self):
         from loongcollector_tpu.analysis.checkers import checker_names
         assert "host-bounce" in checker_names()
+
+
+# ---------------------------------------------------------------------------
+# 13. reload-unsafe fixtures (loongtenant)
+
+
+class TestReloadUnsafe:
+    def checker(self):
+        from loongcollector_tpu.analysis.checkers.reload_unsafe import \
+            ReloadUnsafeChecker
+        return ReloadUnsafeChecker()
+
+    def test_register_without_unregister_flagged(self):
+        src = """
+        class LeakyHook:
+            def init(self, cfg, ctx):
+                TimeoutFlushManager.instance().register(self._hook)
+                return True
+
+            def stop(self, removing=False):
+                pass
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/pipeline/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+        assert any("unregister" in f.message for f in fs)
+
+    def test_register_with_unregister_clean(self):
+        src = """
+        class PairedHook:
+            def init(self, cfg, ctx):
+                TimeoutFlushManager.instance().register(self._hook)
+                return True
+
+            def release(self):
+                TimeoutFlushManager.instance().unregister(self._hook)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/pipeline/fixture.py") == []
+
+    def test_registry_class_itself_exempt(self):
+        src = """
+        class InputRunnerRegistry:
+            def register(self, name, job):
+                self._jobs[name] = job
+
+            def wire(self, name, job):
+                self._inner.register(name, job)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/runner/fixture.py") == []
+
+    def test_self_held_future_without_settle_flagged(self):
+        src = """
+        class LeakyDispatch:
+            def dispatch(self, kernel, args, nbytes):
+                self._fut = self._plane.submit(kernel, args, nbytes)
+
+            def stop(self):
+                self._fut = None
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/ops/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+        assert any("strands plane budget" in f.message for f in fs)
+
+    def test_self_held_future_with_result_clean(self):
+        src = """
+        class SettlingDispatch:
+            def dispatch(self, kernel, args, nbytes):
+                self._fut = self._plane.submit(kernel, args, nbytes)
+
+            def materialise(self):
+                return self._fut.result()
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/ops/fixture.py") == []
+
+    def test_container_held_future_via_local_flagged(self):
+        src = """
+        class RingLeak:
+            def dispatch(self, kernel, args, nbytes):
+                fut = self._plane.submit(kernel, args, nbytes)
+                self._pending.append((fut, nbytes))
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/ops/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+
+    def test_container_held_lease_with_release_clean(self):
+        src = """
+        class RingHolder:
+            def pack(self, ring, geometry):
+                slot = ring.lease(geometry)
+                self._slots.append(slot)
+
+            def advance(self):
+                self._slots.pop(0).release()
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/ops/fixture.py") == []
+
+    def test_subscript_held_future_flagged(self):
+        src = """
+        class SlotLeak:
+            def dispatch(self, key, kernel, args, nbytes):
+                fut = self._plane.submit(kernel, args, nbytes)
+                self._by_key[key] = fut
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/ops/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+
+    def test_nested_closure_hold_reported_once(self):
+        # the closure is reachable from the method walk AND as its own
+        # FunctionDef — the finding must not duplicate
+        src = """
+        class ClosureLeak:
+            def dispatch(self, chunks):
+                def _one(c):
+                    fut = self._plane.submit(c.kern, c.args, c.nbytes)
+                    self._pending.append(fut)
+                for c in chunks:
+                    _one(c)
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/ops/fixture.py")
+        assert len(fs) == 1, [f.format() for f in fs]
+
+    def test_inner_class_sites_not_charged_to_outer(self):
+        # the inner class's unbalanced register() is ITS finding alone
+        src = """
+        class Outer:
+            def stop(self):
+                pass
+
+            class Inner:
+                def init(self):
+                    TimeoutFlushManager.instance().register(self._hook)
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/pipeline/fixture.py")
+        assert len(fs) == 1
+        assert fs[0].symbol == "Inner"
+
+    def test_direct_subscript_store_of_hold_call_flagged(self):
+        # no intermediate local: the hold call stored straight into a
+        # self container must count too
+        src = """
+        class SlotLeakDirect:
+            def dispatch(self, key, kernel, args, nbytes):
+                self._by_key[key] = self._plane.submit(kernel, args,
+                                                       nbytes)
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/ops/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+
+    def test_private_record_with_stop_no_retire_flagged(self):
+        src = """
+        class LeakyComponent:
+            def __init__(self):
+                self._metrics = MetricsRecord(category="component",
+                                              labels={})
+
+            def stop(self):
+                self._running = False
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/runner/fixture.py")
+        assert checks_of(fs) == {"reload-unsafe"}
+        assert any("mark_deleted" in f.message for f in fs)
+
+    def test_private_record_with_retire_clean(self):
+        src = """
+        class RetiringComponent:
+            def __init__(self):
+                self._metrics = MetricsRecord(category="component",
+                                              labels={})
+
+            def stop(self):
+                self._metrics.mark_deleted()
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/runner/fixture.py") == []
+
+    def test_public_record_escapes_to_owner_clean(self):
+        # public self.metrics may escape to an owning pipeline, which
+        # retires it (the ProcessorInstance pattern) — metric-naming's
+        # ownership rule covers those; reload-unsafe stays silent
+        src = """
+        class PluginWrapper:
+            def __init__(self):
+                self.metrics = MetricsRecord(category="plugin", labels={})
+
+            def stop(self, removing=False):
+                pass
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/pipeline/fixture.py") == []
+
+    def test_outside_scope_ignored(self):
+        src = """
+        class Elsewhere:
+            def init(self):
+                TimeoutFlushManager.instance().register(self._hook)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/monitor/fixture.py") == []
+
+    def test_suppression_escapes(self):
+        src = textwrap.dedent("""
+        class Singleton:
+            def init(self):
+                # loonglint: disable=reload-unsafe
+                TimeoutFlushManager.instance().register(self._hook)
+        """)
+        mod = ModuleInfo("/fx/loongcollector_tpu/pipeline/fixture.py",
+                         "loongcollector_tpu/pipeline/fixture.py", src)
+        fs = list(self.checker().check_module(mod))
+        assert fs
+        assert all(mod.suppressed(f.line, "reload-unsafe") for f in fs)
+
+    def test_real_tree_clean(self):
+        from loongcollector_tpu.analysis.core import run_analysis
+        result = run_analysis(checkers=[self.checker()])
+        assert result.findings == [], [
+            f.format() for f in result.findings]
+
+    def test_registered_in_tier1(self):
+        from loongcollector_tpu.analysis.checkers import checker_names
+        assert "reload-unsafe" in checker_names()
