@@ -2108,6 +2108,34 @@ def bench_tenants(tenant_counts=(1, 16, 64, 256), total_rows=24000,
     return {"sweep": sweep, "reload": reload_probe}
 
 
+def bench_analysis():
+    """loongrace: one in-process loonglint sweep — the static plane's
+    checker count, finding disposition, allowlist debt and wall clock.
+    BENCH history then shows the analysis suite growing (or regressing)
+    run over run next to the numbers it guards."""
+    from loongcollector_tpu.analysis.checkers import all_checkers
+    from loongcollector_tpu.analysis.core import (load_allowlist,
+                                                  default_allowlist_path,
+                                                  run_analysis)
+    checkers = all_checkers()
+    result = run_analysis()
+    check_names = sorted(set().union(*(c.produces for c in checkers)))
+    slowest = max(result.checker_seconds.items(), key=lambda kv: kv[1],
+                  default=("", 0.0))
+    return {
+        "checkers": len(checkers),
+        "checks": len(check_names),
+        "files_scanned": result.files_scanned,
+        "findings": len(result.findings),
+        "suppressed": len(result.suppressed),
+        "allowlisted": len(result.allowlisted),
+        "allowlist_entries": len(load_allowlist(default_allowlist_path())),
+        "scan_seconds": round(result.total_seconds, 3),
+        "slowest_checker": slowest[0],
+        "slowest_checker_seconds": round(slowest[1], 3),
+    }
+
+
 def bench_resource():
     """CPU% / RSS at 10 MB/s, the reference's regression-harness metric
     (BASELINE.md: 3.4 % CPU / 29 MB simple, 14.2 % / 34 MB regex).  Runs
@@ -2304,6 +2332,13 @@ def main():
     tenants = _safe(bench_tenants, default=None)
     if tenants is not None:
         extra["tenants"] = tenants
+    # loongrace: the static plane's own vitals — checker count, finding
+    # disposition and the scan's wall clock — recorded per bench run so a
+    # checker-suite runtime regression shows up in BENCH history next to
+    # the throughput it protects (docs/static_analysis.md)
+    analysis = _safe(bench_analysis, default=None)
+    if analysis is not None:
+        extra["analysis"] = analysis
     from loongcollector_tpu.runner.processor_runner import \
         resolve_thread_count
     extra["process_threads"] = resolve_thread_count()

@@ -36,7 +36,15 @@ def main(argv=None) -> int:
                         help="comma-separated subset of checks to run")
     parser.add_argument("--list-checks", action="store_true",
                         help="list available checks and exit")
+    parser.add_argument("--budget", type=float, default=None, metavar="S",
+                        help="fail if the whole scan takes longer than S "
+                             "wall seconds (the lint gate caps loonglint's "
+                             "own runtime so the checker suite cannot "
+                             "quietly grow past its fast-feedback promise)")
     args = parser.parse_args(argv)
+    if args.budget is not None and args.budget <= 0:
+        print("--budget must be positive", file=sys.stderr)
+        return 2
 
     if args.list_checks:
         for checker in all_checkers():
@@ -72,12 +80,16 @@ def main(argv=None) -> int:
                               if f.check in wanted]
 
     over_budget = len(entries) > ALLOWLIST_BUDGET
+    over_time = args.budget is not None and \
+        result.total_seconds > args.budget
 
     if args.as_json:
         doc = result.to_dict()
         doc["allowlist_entries"] = len(entries)
         doc["allowlist_budget"] = ALLOWLIST_BUDGET
         doc["allowlist_over_budget"] = over_budget
+        doc["time_budget_seconds"] = args.budget
+        doc["over_time_budget"] = over_time
         print(json.dumps(doc, indent=2, sort_keys=True))
     else:
         for f in result.findings:
@@ -96,11 +108,21 @@ def main(argv=None) -> int:
             print(f"ALLOWLIST OVER BUDGET: {len(entries)} entries > "
                   f"{ALLOWLIST_BUDGET} allowed — pay down debt before "
                   "adding more")
-        status = "clean" if result.ok and not over_budget else "FAILED"
+        if over_time:
+            slowest = sorted(result.checker_seconds.items(),
+                             key=lambda kv: -kv[1])[:3]
+            detail = ", ".join(f"{name} {s:.2f}s" for name, s in slowest)
+            print(f"RUNTIME OVER BUDGET: scan took "
+                  f"{result.total_seconds:.2f}s > {args.budget:.2f}s "
+                  f"allowed (slowest: {detail}) — profile the checkers "
+                  "with --json checker_seconds")
+        status = "clean" if result.ok and not over_budget \
+            and not over_time else "FAILED"
         print(f"loonglint: {result.files_scanned} files, "
-              f"{len(result.findings)} violation(s) — {status}")
+              f"{len(result.findings)} violation(s) in "
+              f"{result.total_seconds:.2f}s — {status}")
 
-    return 0 if result.ok and not over_budget else 1
+    return 0 if result.ok and not over_budget and not over_time else 1
 
 
 if __name__ == "__main__":

@@ -6,6 +6,7 @@ from __future__ import annotations
 from typing import List
 
 from ..core import Checker
+from ..raceguard import RaceGuardChecker
 from .acquire_release import AcquireReleaseChecker
 from .blocking_locks import BlockingUnderLockChecker
 from .host_bounce import HostBounceChecker
@@ -32,6 +33,7 @@ _CHECKER_CLASSES = [
     UnboundedWindowChecker,
     HostBounceChecker,
     ReloadUnsafeChecker,
+    RaceGuardChecker,
 ]
 
 

@@ -9,16 +9,13 @@ And two threads that take the same two locks in opposite orders deadlock;
 with runner/, pipeline/queue/ and the device plane all cross-calling each
 other, that ordering is a whole-program property no single diff shows.
 
-Lock identification (deliberately syntactic, so the checker needs no
-imports of the checked code):
-
-  * attributes assigned from threading.Lock()/RLock()/Condition() anywhere
-    in the module, plus
-  * names matching the lock naming convention (_lock, _mutex, _cond,
-    _freed, _not_empty, ...).
-
-Held regions: ``with <lock>:`` bodies and ``<lock>.acquire()`` ..
-``<lock>.release()`` spans within one statement list.
+Lock identification and held-region tracking live in
+``analysis/locktrack.py`` (shared with raceguard's whole-program
+guarded-by inference, so the two checkers see locks identically):
+attributes assigned from threading.Lock()/RLock()/Condition() anywhere in
+the module, merged with the lock naming convention (_lock, _mutex, _cond,
+...); held regions are ``with <lock>:`` bodies and ``<lock>.acquire()``
+.. ``<lock>.release()`` spans within one statement list.
 
 Blocking calls flagged under a held lock: time.sleep, Future.result,
 Thread.join, blocking queue get/put, socket connect/accept/recv/sendall,
@@ -43,19 +40,15 @@ graph are reported on the finalize pass.
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from ..core import (Checker, Finding, ModuleInfo, Program, attr_tail,
                     call_name, iter_functions, receiver_repr)
+from ..locktrack import (LockRegionWalker, ModuleLocks, expr_text,
+                         tail_name)
 
 CHECK = "blocking-under-lock"
 CHECK_ORDER = "lock-ordering"
-
-_LOCK_NAME_RE = re.compile(
-    r"(^|_)(lock|mutex|mtx|cond|condition|freed|cv|not_empty|not_full)$")
-_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
-               "Lock", "RLock", "Condition"}
 
 _BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.call",
                     "subprocess.check_output", "subprocess.check_call",
@@ -70,39 +63,8 @@ _FLIGHT_RECV_TAILS = {"flight", "recorder", "flight_recorder",
                       "_flight", "_recorder", "_flight_recorder"}
 
 
-def _expr_text(node: ast.AST) -> str:
-    try:
-        return ast.unparse(node)
-    except Exception:  # pragma: no cover
-        return ""
-
-
-def _tail_name(text: str) -> str:
-    return text.rsplit(".", 1)[-1]
-
-
-class _ModuleLocks:
-    """Lock attributes discovered in one module: exact names assigned from
-    threading ctors, merged with the naming convention."""
-
-    def __init__(self, tree: ast.AST):
-        self.assigned: Set[str] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value,
-                                                           ast.Call):
-                if call_name(node.value) in _LOCK_CTORS:
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Attribute):
-                            self.assigned.add(tgt.attr)
-                        elif isinstance(tgt, ast.Name):
-                            self.assigned.add(tgt.id)
-
-    def is_lock_expr(self, node: ast.AST) -> bool:
-        text = _expr_text(node)
-        if not text or "(" in text:
-            return False
-        tail = _tail_name(text)
-        return tail in self.assigned or bool(_LOCK_NAME_RE.search(tail))
+_expr_text = expr_text
+_tail_name = tail_name
 
 
 def _blocking_queue_call(node: ast.Call) -> bool:
@@ -149,14 +111,15 @@ def _blocking_reason(node: ast.Call, held: List[str]) -> Optional[str]:
     return None
 
 
-class _FuncScan:
+class _FuncScan(LockRegionWalker):
     """One function's lock behaviour: findings + acquired-under-held edges
-    + calls made under each held lock (for the interprocedural hop)."""
+    + calls made under each held lock (for the interprocedural hop).
+    Traversal and held-region tracking come from locktrack."""
 
-    def __init__(self, mod: ModuleInfo, locks: _ModuleLocks, qualname: str,
+    def __init__(self, mod: ModuleInfo, locks: ModuleLocks, qualname: str,
                  func: ast.AST):
+        super().__init__(locks)
         self.mod = mod
-        self.locks = locks
         self.qualname = qualname
         self.findings: List[Finding] = []
         # (held_lock_text, acquired_lock_text, line)
@@ -164,70 +127,15 @@ class _FuncScan:
         # method names called while a lock is held: (held, callee, line)
         self.calls_under: List[Tuple[str, str, int]] = []
         self.acquires: Set[str] = set()
-        self._walk_body(list(getattr(func, "body", [])), [])
+        self.walk(func)
 
-    def _lock_of_with(self, item: ast.withitem) -> Optional[str]:
-        if self.locks.is_lock_expr(item.context_expr):
-            return _expr_text(item.context_expr)
-        return None
-
-    def _walk_body(self, body: List[ast.stmt], held: List[str]) -> None:
-        linear: List[str] = []   # locks taken via .acquire() in this block
-        for stmt in body:
-            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
-                                                         ast.Call):
-                node = stmt.value
-                tail = attr_tail(node)
-                recv = receiver_repr(node)
-                if tail == "acquire" and recv and \
-                        self.locks.is_lock_expr(node.func.value):  # type: ignore[union-attr]
-                    self._note_acquire(recv, held + linear, stmt.lineno)
-                    linear.append(recv)
-                    continue
-                if tail == "release" and recv in linear:
-                    linear.remove(recv)
-                    continue
-            self._walk_stmt(stmt, held + linear)
-
-    def _walk_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
-        if isinstance(stmt, (ast.With, ast.AsyncWith)):
-            newly = []
-            for item in stmt.items:
-                lk = self._lock_of_with(item)
-                if lk is not None:
-                    self._note_acquire(lk, held, stmt.lineno)
-                    newly.append(lk)
-                else:
-                    self._scan_expr(item.context_expr, held)
-            self._walk_body(stmt.body, held + newly)
-            return
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            return  # nested defs execute later, not under this lock
-        # expression fields first (loop iterables, if tests, call exprs),
-        # then each nested statement list exactly once
-        for name, value in ast.iter_fields(stmt):
-            if name in ("body", "orelse", "finalbody", "handlers"):
-                continue
-            items = value if isinstance(value, list) else [value]
-            for item in items:
-                if isinstance(item, ast.expr):
-                    self._scan_expr(item, held)
-        for attr in ("body", "orelse", "finalbody"):
-            sub = getattr(stmt, attr, None)
-            if isinstance(sub, list) and sub and \
-                    isinstance(sub[0], ast.stmt):
-                self._walk_body(sub, held)
-        for handler in getattr(stmt, "handlers", []) or []:
-            self._walk_body(handler.body, held)
-
-    def _note_acquire(self, lock: str, held: List[str], line: int) -> None:
+    def on_acquire(self, lock: str, held: List[str], line: int) -> None:
         self.acquires.add(lock)
         for h in held:
             if _tail_name(h) != _tail_name(lock):
                 self.edges.append((h, lock, line))
 
-    def _scan_expr(self, expr: ast.AST, held: List[str]) -> None:
+    def on_expr(self, expr: ast.AST, held: List[str]) -> None:
         if not held:
             return
         for node in ast.walk(expr):
@@ -257,7 +165,7 @@ class BlockingUnderLockChecker(Checker):
         self._scans: List[Tuple[ModuleInfo, _FuncScan]] = []
 
     def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
-        locks = _ModuleLocks(mod.tree)
+        locks = ModuleLocks(mod.tree)
         for qualname, func in iter_functions(mod.tree):
             scan = _FuncScan(mod, locks, qualname, func)
             self._scans.append((mod, scan))

@@ -18,6 +18,7 @@ from __future__ import annotations
 import ast
 import os
 import re
+import time
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 ALLOWLIST_BUDGET = 10
@@ -251,6 +252,10 @@ class AnalysisResult:
         self.allowlisted: List[Finding] = []  # parked debt
         self.parse_errors: List[str] = []
         self.files_scanned = 0
+        #: checker name -> wall seconds (check_module sweep + finalize);
+        #: the lint gate budgets the scan with these (scripts/lint.sh)
+        self.checker_seconds: Dict[str, float] = {}
+        self.total_seconds = 0.0
 
     @property
     def ok(self) -> bool:
@@ -264,6 +269,9 @@ class AnalysisResult:
             "suppressed": [f.to_dict() for f in self.suppressed],
             "allowlisted": [f.to_dict() for f in self.allowlisted],
             "parse_errors": self.parse_errors,
+            "checker_seconds": {name: round(s, 4) for name, s
+                                in sorted(self.checker_seconds.items())},
+            "total_seconds": round(self.total_seconds, 4),
         }
 
 
@@ -323,6 +331,7 @@ def run_analysis(root: Optional[str] = None,
         allowlist_path if allowlist_path is not None
         else default_allowlist_path())
 
+    started = time.perf_counter()
     result = AnalysisResult()
     modules = collect_modules(root, errors=result.parse_errors)
     result.files_scanned = len(modules)
@@ -330,11 +339,15 @@ def run_analysis(root: Optional[str] = None,
 
     raw: List[Tuple[Finding, ModuleInfo]] = []
     for checker in checkers:
+        t0 = time.perf_counter()
         for mod in modules:
             for finding in checker.check_module(mod):
                 raw.append((finding, mod))
         for finding in checker.finalize(program):
             raw.append((finding, program.by_relpath.get(finding.path)))
+        result.checker_seconds[checker.name] = \
+            result.checker_seconds.get(checker.name, 0.0) \
+            + (time.perf_counter() - t0)
 
     seen = set()
     for finding, mod in raw:
@@ -350,4 +363,5 @@ def run_analysis(root: Optional[str] = None,
         else:
             result.findings.append(finding)
     result.findings.sort(key=lambda f: (f.path, f.line, f.check))
+    result.total_seconds = time.perf_counter() - started
     return result

@@ -1,0 +1,190 @@
+"""Shared lock-tracking machinery for lock-aware checkers.
+
+Extracted from checkers/blocking_locks.py (which keeps its findings but
+now builds on this module) so whole-program passes — raceguard's
+guarded-by inference above all — see locks the SAME way the
+blocking-under-lock checker does.  One definition of "what is a lock"
+and "what region holds it" keeps the two checkers from disagreeing about
+the exact sites they reason over.
+
+Lock identification is deliberately syntactic, so the checkers need no
+imports of the checked code:
+
+  * attributes assigned from threading.Lock()/RLock()/Condition() anywhere
+    in the module, plus
+  * names matching the lock naming convention (_lock, _mutex, _cond,
+    _freed, _not_empty, ...).
+
+Held regions: ``with <lock>:`` bodies and ``<lock>.acquire()`` ..
+``<lock>.release()`` spans within one statement list.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from .core import attr_tail, call_name, receiver_repr
+
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|mutex|mtx|cond|condition|freed|cv|not_empty|not_full)$")
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+
+def expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def tail_name(text: str) -> str:
+    return text.rsplit(".", 1)[-1]
+
+
+class ModuleLocks:
+    """Lock attributes discovered in one module: exact names assigned from
+    threading ctors, merged with the naming convention."""
+
+    def __init__(self, tree: ast.AST):
+        self.assigned: Set[str] = set()
+        #: Condition-wrapping-lock aliases by tail name: ``self._freed =
+        #: threading.Condition(self._lock)`` means holding _freed IS
+        #: holding _lock — canon() folds the alias onto the wrapped lock
+        self._alias: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if call_name(node.value) in _LOCK_CTORS:
+                    names = []
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute):
+                            names.append(tgt.attr)
+                        elif isinstance(tgt, ast.Name):
+                            names.append(tgt.id)
+                    self.assigned.update(names)
+                    if tail_name(call_name(node.value)) == "Condition" \
+                            and node.value.args:
+                        src = tail_name(expr_text(node.value.args[0]))
+                        if src:
+                            for name in names:
+                                self._alias[name] = src
+
+    def canon(self, text: str) -> str:
+        """Canonical lock identity: Condition aliases fold onto the lock
+        they wrap (``self._freed`` -> ``self._lock``)."""
+        orig = tail_name(text)
+        tail, seen = orig, set()
+        while tail in self._alias and tail not in seen:
+            seen.add(tail)
+            tail = self._alias[tail]
+        if tail == orig:
+            return text
+        return text[: len(text) - len(orig)] + tail
+
+    def is_lock_expr(self, node: ast.AST) -> bool:
+        text = expr_text(node)
+        if not text or "(" in text:
+            return False
+        tail = tail_name(text)
+        return tail in self.assigned or bool(_LOCK_NAME_RE.search(tail))
+
+    def is_lock_name(self, name: str) -> bool:
+        tail = tail_name(name)
+        return tail in self.assigned or bool(_LOCK_NAME_RE.search(tail))
+
+
+class LockRegionWalker:
+    """Walk one function body tracking the held lock set.
+
+    Subclass hooks (all receive ``held``, the lock-expression texts held
+    at that point, innermost last):
+
+      * ``on_acquire(lock_text, held, line)`` — a lock is being taken
+        while ``held`` are already held (``with`` entry or ``.acquire()``);
+      * ``on_stmt(stmt, held)`` — every statement, before descent;
+      * ``on_expr(expr, held)`` — every expression field of a statement
+        (assignment targets/values, call expressions, loop iterables,
+        if/while tests, ...).
+
+    Nested function/class definitions are NOT descended into: their
+    bodies execute later, not under the enclosing lock.
+    """
+
+    def __init__(self, locks: ModuleLocks):
+        self.locks = locks
+
+    # -- hooks ---------------------------------------------------------
+
+    def on_acquire(self, lock: str, held: List[str], line: int) -> None:
+        pass
+
+    def on_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        pass
+
+    def on_expr(self, expr: ast.AST, held: List[str]) -> None:
+        pass
+
+    # -- traversal -----------------------------------------------------
+
+    def walk(self, func: ast.AST) -> None:
+        self._walk_body(list(getattr(func, "body", [])), [])
+
+    def _lock_of_with(self, item: ast.withitem) -> Optional[str]:
+        if self.locks.is_lock_expr(item.context_expr):
+            return expr_text(item.context_expr)
+        return None
+
+    def _walk_body(self, body: List[ast.stmt], held: List[str]) -> None:
+        linear: List[str] = []   # locks taken via .acquire() in this block
+        for stmt in body:
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Call):
+                node = stmt.value
+                tail = attr_tail(node)
+                recv = receiver_repr(node)
+                if tail == "acquire" and recv and \
+                        self.locks.is_lock_expr(node.func.value):  # type: ignore[union-attr]
+                    self.on_acquire(recv, held + linear, stmt.lineno)
+                    linear.append(recv)
+                    continue
+                if tail == "release" and recv in linear:
+                    linear.remove(recv)
+                    continue
+            self._walk_stmt(stmt, held + linear)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.on_stmt(stmt, held)
+            newly = []
+            for item in stmt.items:
+                lk = self._lock_of_with(item)
+                if lk is not None:
+                    self.on_acquire(lk, held, stmt.lineno)
+                    newly.append(lk)
+                else:
+                    self.on_expr(item.context_expr, held)
+            self._walk_body(stmt.body, held + newly)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs execute later, not under this lock
+        self.on_stmt(stmt, held)
+        # expression fields first (loop iterables, if tests, call exprs),
+        # then each nested statement list exactly once
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            items = value if isinstance(value, list) else [value]
+            for item in items:
+                if isinstance(item, ast.expr):
+                    self.on_expr(item, held)
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list) and sub and \
+                    isinstance(sub[0], ast.stmt):
+                self._walk_body(sub, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_body(handler.body, held)

@@ -1,0 +1,28 @@
+"""raceguard: whole-program thread-role race detection (loonglint #13).
+
+Two phases over the parsed tree (docs/static_analysis.md#race-detection):
+
+1. model extraction — a best-effort call graph (`callgraph.py`), a
+   thread-role graph seeded from every thread entry-point family and
+   propagated along call edges (`roles.py`), and a per-class shared-state
+   access map recording each ``self._attr`` read/write/mutation site with
+   the lock set held there (`accessmap.py`, lock semantics shared with
+   blocking-under-lock via ``analysis/locktrack.py``);
+
+2. reporting (`checker.py`) — guarded-by violations (mutations from
+   concurrent roles with no common lock), check-then-act atomicity
+   violations, and lock-scope escapes (mutable guarded containers
+   returned out of their locked region).
+"""
+
+from .accessmap import Access, AccessMap
+from .callgraph import CallGraph, FuncInfo
+from .checker import (CHECK_ATOMICITY, CHECK_GUARDED_BY, CHECK_LOCK_SCOPE,
+                      RaceGuardChecker)
+from .roles import RoleGraph
+
+__all__ = [
+    "Access", "AccessMap", "CallGraph", "FuncInfo", "RoleGraph",
+    "RaceGuardChecker", "CHECK_GUARDED_BY", "CHECK_ATOMICITY",
+    "CHECK_LOCK_SCOPE",
+]

@@ -1,0 +1,492 @@
+"""Shared-state access map: every ``self._attr`` site, with held locks.
+
+For each class, every method (and nested def) is walked with the SAME
+lock-region tracking blocking-under-lock uses (analysis/locktrack.py),
+recording each attribute access as one of:
+
+  * ``read``   — a plain Load (a single atomic op under the GIL);
+  * ``iter``   — a Load in an *iterating* position (``for x in self._d``,
+    ``list(self._d)``, ``self._d.items()``): the use spans many bytecodes,
+    so a concurrent mutation lands mid-iteration;
+  * ``write``  — a rebind (``self._x = v``) — GIL-atomic on its own, so a
+    rebind only races as part of a check-then-act;
+  * ``mutate`` — a single-op in-place mutation (``self._x[k] = v``,
+    ``del self._x[k]``, ``self._x.append(...)``): atomic at THIS class's
+    level (builtin container ops run under the GIL; a method call on a
+    typed component synchronises in ITS OWN class, which raceguard
+    analyses separately);
+  * ``rmw``    — a compound read-modify-write that is NOT atomic:
+    ``self._x += 1``, ``self._x[k] += v`` — the load and the store are
+    separate bytecodes, so two threads lose updates.
+
+Two interprocedural refinements keep the map honest:
+
+  * private helpers (``_record``, ``_shrink_locked``, ...) inherit the
+    INTERSECTION of the lock sets held at their ``self._helper()`` call
+    sites, to a fixpoint — the pervasive "call with lock held" idiom;
+  * in a function that constructs a thread at its top level
+    (``start()``-style), accesses lexically before the first
+    ``threading.Thread(...)`` statement happen before publication and are
+    treated like ``__init__`` sites.
+
+Attributes whose value is a known thread-safe type (Lock/Event/Queue/
+deque/...) are marked exempt: their methods synchronise internally.
+Container attributes (dict/list/set/defaultdict literals or ctors) are
+marked mutable_container — those are the ones whose *reference* must not
+escape a locked region.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..core import Program, call_name
+from ..locktrack import LockRegionWalker, ModuleLocks
+from .callgraph import CallGraph, FuncInfo, _own_nodes
+
+READ = "read"
+ITER = "iter"
+WRITE = "write"
+MUTATE = "mutate"
+RMW = "rmw"
+
+#: method names that mutate their receiver in place (non-atomic compound
+#: state transitions when the receiver is shared)
+MUTATORS = frozenset((
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popitem", "popleft", "remove",
+    "discard", "clear", "sort", "reverse", "rotate", "subtract",
+))
+
+#: ctor tails whose instances synchronise internally — never a guarded-by
+#: subject (deque's single-op append/pop are GIL-atomic, the documented
+#: CPython idiom this codebase relies on)
+THREADSAFE_CTORS = frozenset((
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "deque", "local",
+))
+
+_CONTAINER_CTORS = frozenset(("dict", "list", "set", "defaultdict",
+                              "OrderedDict", "Counter", "deque"))
+
+#: builtins whose call consumes the whole argument — an iterating use
+_ITER_CONSUMERS = frozenset((
+    "list", "tuple", "set", "frozenset", "sorted", "sum", "min", "max",
+    "any", "all", "enumerate", "zip", "iter", "dict", "map", "filter",
+))
+
+#: receiver methods that hand out a view/copy of the whole container
+_ITER_METHODS = frozenset(("items", "values", "keys", "copy"))
+
+_THREAD_CTOR_NAMES = frozenset(("threading.Thread", "Thread",
+                                "threading.Timer", "Timer"))
+
+
+class Access:
+    __slots__ = ("attr", "kind", "line", "col", "locks", "func_key",
+                 "in_init")
+
+    def __init__(self, attr: str, kind: str, line: int, col: int,
+                 locks: FrozenSet[str], func_key: Tuple[str, str],
+                 in_init: bool):
+        self.attr = attr
+        self.kind = kind
+        self.line = line
+        self.col = col
+        self.locks = locks          # lock expression texts held at the site
+        self.func_key = func_key    # (relpath, qualname) of enclosing func
+        self.in_init = in_init
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"<Access {self.attr} {self.kind}@{self.line} "
+                f"locks={sorted(self.locks)}>")
+
+
+class ClassAccesses:
+    """All shared-state facts for one class."""
+
+    def __init__(self, relpath: str, cls_name: str):
+        self.relpath = relpath
+        self.cls_name = cls_name
+        self.accesses: Dict[str, List[Access]] = {}     # attr -> sites
+        self.exempt: Set[str] = set()           # thread-safe-typed attrs
+        self.containers: Set[str] = set()       # mutable-container attrs
+        #: the class participates in locking at all (owns a Lock/RLock/
+        #: Condition or holds one at some access) — the gate for
+        #: guarded-by/atomicity inference: a class with NO locking is a
+        #: data-plane object whose instances are *handed off* between
+        #: threads (queue transfer is the synchronisation point), not
+        #: shared, and there is no candidate guard to infer
+        self.uses_locks = False
+        # check-then-act candidates: (attr, test_line, act_line,
+        #                             test_locks, act_locks, func_key)
+        self.check_acts: List[Tuple[str, int, int, FrozenSet[str],
+                                    FrozenSet[str], Tuple[str, str]]] = []
+        # returns of a guarded attr out of a locked region:
+        # (attr, line, col, lock_text, func_key)
+        self.escapes: List[Tuple[str, int, int, str, Tuple[str, str]]] = []
+
+    def add(self, acc: Access) -> None:
+        self.accesses.setdefault(acc.attr, []).append(acc)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> 'X' (only the direct attribute; deeper chains resolve
+    to their base via _base_self_attr)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _base_self_attr(node: ast.AST) -> Optional[str]:
+    """Base self-attribute of an access chain: ``self._a[k].b`` -> '_a'."""
+    cur = node
+    while True:
+        if isinstance(cur, ast.Attribute):
+            direct = _self_attr(cur)
+            if direct is not None:
+                return direct
+            cur = cur.value
+        elif isinstance(cur, ast.Subscript):
+            cur = cur.value
+        else:
+            return None
+
+
+def _iter_positions(func: ast.AST) -> Set[int]:
+    """id()s of ``self.X`` Load nodes used in iterating positions."""
+    ids: Set[int] = set()
+
+    def mark(expr: ast.AST) -> None:
+        if _self_attr(expr) is not None:
+            ids.add(id(expr))
+        elif isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr in _ITER_METHODS and \
+                _self_attr(expr.func.value) is not None:
+            ids.add(id(expr.func.value))
+
+    for node in _own_nodes(func):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            mark(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                mark(gen.iter)
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _ITER_CONSUMERS:
+                for arg in node.args:
+                    mark(arg)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _ITER_METHODS:
+                mark(node.func.value)
+    return ids
+
+
+def _prestart_line(func: ast.AST) -> Optional[int]:
+    """Line of the first top-level statement constructing a Thread/Timer,
+    or None.  Only TOP-LEVEL statements qualify: a ctor inside a loop
+    spawns per iteration, so earlier lines do NOT happen-before every
+    spawned thread."""
+    for stmt in getattr(func, "body", ()):
+        for node in _own_nodes(stmt):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in _THREAD_CTOR_NAMES:
+                return stmt.lineno
+    return None
+
+
+class _MethodScan(LockRegionWalker):
+    """Record one method's attribute accesses + atomicity/escape shapes."""
+
+    def __init__(self, locks: ModuleLocks, ca: ClassAccesses,
+                 fi: FuncInfo, cg: CallGraph):
+        super().__init__(locks)
+        self.ca = ca
+        self.fi = fi
+        self.cg = cg
+        self.in_init = fi.qualname.split(".")[-1] in ("__init__",
+                                                      "__new__")
+        self._aug_target: Optional[ast.AST] = None
+        self._iter_ids = _iter_positions(fi.node)
+        self._prestart = None if self.in_init else _prestart_line(fi.node)
+        #: private self-method call sites: (callee_key, held locks)
+        self.calls: List[Tuple[Tuple[str, str], FrozenSet[str]]] = []
+        self.walk(fi.node)
+
+    # -- recording helpers --------------------------------------------
+
+    def _rec(self, attr: str, kind: str, node: ast.AST,
+             held: List[str]) -> None:
+        if self.locks.is_lock_name(attr):
+            return      # the lock itself is not shared *state*
+        init_like = self.in_init or (
+            self._prestart is not None and node.lineno < self._prestart)
+        self.ca.add(Access(attr, kind, node.lineno, node.col_offset,
+                           frozenset(self.locks.canon(h) for h in held),
+                           self.fi.key, init_like))
+
+    # -- hooks ---------------------------------------------------------
+
+    def on_acquire(self, lock: str, held: List[str], line: int) -> None:
+        self.ca.uses_locks = True
+
+    def on_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, ast.AugAssign):
+            self._aug_target = stmt.target
+        if isinstance(stmt, (ast.If, ast.While)) and not self.in_init:
+            self._scan_check_act(stmt, held)
+        if isinstance(stmt, ast.Return) and held and \
+                stmt.value is not None:
+            for value in _return_parts(stmt.value):
+                attr = _self_attr(value)
+                if attr is not None and \
+                        not self.locks.is_lock_name(attr):
+                    self.ca.escapes.append(
+                        (attr, stmt.lineno, stmt.col_offset,
+                         self.locks.canon(held[-1]), self.fi.key))
+
+    def on_expr(self, expr: ast.AST, held: List[str]) -> None:
+        self._classify(expr, held)
+
+    # -- access classification ----------------------------------------
+
+    def _classify(self, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return      # separate FuncInfo / deferred execution
+        if isinstance(node, ast.Call):
+            attr = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                attr = _base_self_attr(node.func.value)
+            if attr is not None:
+                self._rec(attr, MUTATE, node, held)
+            else:
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in ("self", "cls") and \
+                        node.func.attr.startswith("_") and \
+                        not node.func.attr.startswith("__"):
+                    callee = self.cg.resolve_self_method(
+                        self.fi, node.func.attr)
+                    if callee is not None:
+                        self.calls.append((callee.key, frozenset(
+                            self.locks.canon(h) for h in held)))
+                self._classify(node.func, held)
+            for arg in node.args:
+                self._classify(arg, held)
+            for kw in node.keywords:
+                self._classify(kw.value, held)
+            return
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _base_self_attr(node.value)
+            if attr is not None:
+                kind = RMW if node is self._aug_target else MUTATE
+                self._rec(attr, kind, node, held)
+            self._classify(node.slice, held)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    kind = RMW if node is self._aug_target else WRITE
+                    self._rec(attr, kind, node, held)
+                else:
+                    kind = ITER if id(node) in self._iter_ids else READ
+                    self._rec(attr, kind, node, held)
+                return
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                # `self._a.b = v` / `self._a[k].b = v`: a field store on
+                # the object held by _a is a mutation of shared _a state
+                base = _base_self_attr(node.value)
+                if base is not None:
+                    self._rec(base, MUTATE, node, held)
+                    return
+            self._classify(node.value, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._classify(child, held)
+
+    # -- check-then-act -----------------------------------------------
+
+    def _scan_check_act(self, stmt: ast.stmt, held: List[str]) -> None:
+        tested: Set[str] = set()
+        for node in ast.walk(stmt.test):
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load) and \
+                    not self.locks.is_lock_name(attr):
+                tested.add(attr)
+        if not tested:
+            return
+        finder = _ActFinder(self.locks, tested)
+        finder._walk_body(list(stmt.body), list(held))
+        orelse = getattr(stmt, "orelse", None)
+        if orelse:
+            finder._walk_body(list(orelse), list(held))
+        for attr, line, act_locks in finder.acts:
+            self.ca.check_acts.append(
+                (attr, stmt.lineno, line,
+                 frozenset(self.locks.canon(h) for h in held),
+                 frozenset(self.locks.canon(h) for h in act_locks),
+                 self.fi.key))
+
+
+class _ActFinder(LockRegionWalker):
+    """Find writes/mutations of the tested attrs inside a check's body,
+    with the lock set actually held at the act site."""
+
+    def __init__(self, locks: ModuleLocks, attrs: Set[str]):
+        super().__init__(locks)
+        self.attrs = attrs
+        self.acts: List[Tuple[str, int, List[str]]] = []
+        self._aug_target: Optional[ast.AST] = None
+
+    def on_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, ast.AugAssign):
+            self._aug_target = stmt.target
+
+    def on_expr(self, expr: ast.AST, held: List[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr in self.attrs and \
+                        isinstance(node.ctx, (ast.Store, ast.Del)):
+                    self.acts.append((attr, node.lineno, list(held)))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = _base_self_attr(node.value)
+                if attr in self.attrs:
+                    self.acts.append((attr, node.lineno, list(held)))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                attr = _base_self_attr(node.func.value)
+                if attr in self.attrs:
+                    self.acts.append((attr, node.lineno, list(held)))
+
+
+def _return_parts(value: ast.expr) -> Iterator[ast.expr]:
+    if isinstance(value, ast.Tuple):
+        yield from value.elts
+    else:
+        yield value
+
+
+class AccessMap:
+    def __init__(self, program: Program, cg: CallGraph):
+        #: (relpath, cls_name) -> ClassAccesses
+        self.by_class: Dict[Tuple[str, str], ClassAccesses] = {}
+        mod_locks = {m.relpath: ModuleLocks(m.tree)
+                     for m in program.modules}
+        # (callee_key, locks held at the site, caller_key)
+        calls: List[Tuple[Tuple[str, str], FrozenSet[str],
+                          Tuple[str, str]]] = []
+        for fi in cg.functions:
+            if fi.cls_name is None:
+                continue
+            key = (fi.relpath, fi.cls_name)
+            ca = self.by_class.get(key)
+            if ca is None:
+                ca = self.by_class[key] = ClassAccesses(fi.relpath,
+                                                        fi.cls_name)
+            scan = _MethodScan(mod_locks[fi.relpath], ca, fi, cg)
+            calls.extend((callee, held, fi.key)
+                         for callee, held in scan.calls)
+        for (relpath, cls_name), ca in self.by_class.items():
+            ci = cg.classes.get((relpath, cls_name))
+            if ci is not None:
+                self._type_attrs(ci, ca)
+        self._apply_entry_locks(self._entry_locks(calls))
+
+    # -- interprocedural lock context ---------------------------------
+
+    @staticmethod
+    def _entry_locks(calls) -> Dict[Tuple[str, str], FrozenSet[str]]:
+        """Locks a private helper is guaranteed to hold on entry: the
+        intersection over all its ``self._helper()`` call sites of
+        (lexically held locks | the caller's own entry locks), iterated
+        to a fixpoint.  Helpers in a call cycle with no outside caller
+        resolve to the empty set."""
+        callers: Dict[Tuple[str, str],
+                      List[Tuple[Tuple[str, str], FrozenSet[str]]]] = {}
+        for callee, held, caller in calls:
+            callers.setdefault(callee, []).append((caller, held))
+        # None = "no information yet" (TOP); sets only ever shrink
+        entry: Dict[Tuple[str, str], Optional[FrozenSet[str]]] = {
+            k: None for k in callers}
+        changed = True
+        while changed:
+            changed = False
+            for callee, sites in callers.items():
+                vals = []
+                for caller, held in sites:
+                    caller_entry = entry.get(caller)
+                    if caller in entry and caller_entry is None:
+                        continue    # still TOP: identity for the meet
+                    vals.append(held | (caller_entry or frozenset()))
+                if not vals:
+                    continue
+                new = vals[0]
+                for v in vals[1:]:
+                    new &= v
+                cur = entry[callee]
+                merged = new if cur is None else (cur & new)
+                if merged != cur:
+                    entry[callee] = merged
+                    changed = True
+        return {k: v for k, v in entry.items() if v}
+
+    def _apply_entry_locks(self, entry) -> None:
+        if not entry:
+            return
+        empty: FrozenSet[str] = frozenset()
+        for ca in self.by_class.values():
+            for sites in ca.accesses.values():
+                for a in sites:
+                    extra = entry.get(a.func_key)
+                    if extra:
+                        a.locks = a.locks | extra
+            ca.check_acts = [
+                (attr, tl, al,
+                 tlk | entry.get(fk, empty), alk | entry.get(fk, empty),
+                 fk)
+                for (attr, tl, al, tlk, alk, fk) in ca.check_acts]
+
+    def _type_attrs(self, ci, ca: ClassAccesses) -> None:
+        """Classify attr value types from assignments in the class body:
+        thread-safe ctors -> exempt; container ctors/literals ->
+        mutable_container."""
+        for fi in ci.methods.values():
+            for node in ast.walk(fi.node):
+                value: Optional[ast.expr] = None
+                targets: List[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value, targets = node.value, [node.target]
+                if value is None:
+                    continue
+                attrs = [t.attr for t in targets
+                         if isinstance(t, ast.Attribute)
+                         and isinstance(t.value, ast.Name)
+                         and t.value.id == "self"]
+                if not attrs:
+                    continue
+                if isinstance(value, ast.Call):
+                    tail = call_name(value).rsplit(".", 1)[-1]
+                    if tail in ("Lock", "RLock", "Condition"):
+                        ca.uses_locks = True
+                    if tail in THREADSAFE_CTORS:
+                        ca.exempt.update(attrs)
+                    elif tail in _CONTAINER_CTORS:
+                        ca.containers.update(attrs)
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                        ast.DictComp, ast.ListComp,
+                                        ast.SetComp)):
+                    ca.containers.update(attrs)

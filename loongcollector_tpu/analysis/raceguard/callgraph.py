@@ -1,0 +1,271 @@
+"""Whole-program call graph, best effort and deliberately conservative.
+
+Resolution rules (anything else stays unresolved — no edge — because a
+wrong edge wires unrelated thread roles together and fabricates races):
+
+  * ``self.m(...)``            -> method ``m`` on the enclosing class or a
+                                  program-visible base class;
+  * ``self._a.m(...)``         -> method ``m`` of the class assigned to
+                                  ``self._a = ClassName(...)`` anywhere in
+                                  the owning class (unique class name);
+  * ``f(...)``                 -> a nested ``def f`` in the enclosing
+                                  function, else a module-level function of
+                                  the same module, else the unique global
+                                  function of that name;
+  * ``anything.m(...)``        -> the unique method named ``m`` in the
+                                  whole program (blocking-under-lock's
+                                  interprocedural-hop discipline).
+
+The same resolver also resolves *callable references* (``target=self._run``
+in a Thread ctor), which is how roles.py seeds thread entry points.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import Program, call_name
+
+
+class FuncInfo:
+    """One function/method (possibly nested) in the program."""
+
+    __slots__ = ("relpath", "qualname", "node", "cls_name", "module",
+                 "parent")
+
+    def __init__(self, relpath: str, qualname: str, node: ast.AST,
+                 cls_name: Optional[str], parent: Optional["FuncInfo"]):
+        self.relpath = relpath
+        self.qualname = qualname        # e.g. "Breaker.emit.inner"
+        self.node = node
+        self.cls_name = cls_name        # enclosing class simple name or None
+        self.parent = parent            # enclosing FuncInfo for nested defs
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.relpath, self.qualname)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<FuncInfo {self.relpath}:{self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("relpath", "name", "bases", "methods", "attr_types",
+                 "node")
+
+    def __init__(self, relpath: str, name: str, node: ast.ClassDef):
+        self.relpath = relpath
+        self.name = name                # simple name
+        self.node = node
+        self.bases: List[str] = []      # base simple names
+        self.methods: Dict[str, FuncInfo] = {}
+        self.attr_types: Dict[str, str] = {}   # self._a -> ClassName
+
+
+def _own_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function/class
+    definitions (those bodies belong to their own FuncInfo)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    def __init__(self, program: Program):
+        self.functions: List[FuncInfo] = []
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self._cls_by_name: Dict[str, List[ClassInfo]] = {}
+        self._func_by_name: Dict[str, List[FuncInfo]] = {}
+        self._method_by_name: Dict[str, List[FuncInfo]] = {}
+        self._modfuncs: Dict[Tuple[str, str], FuncInfo] = {}
+        self._nested: Dict[Tuple[str, str], Dict[str, FuncInfo]] = {}
+        self._edges: Dict[Tuple[str, str], List[FuncInfo]] = {}
+
+        for mod in program.modules:
+            self._index_module(mod.relpath, mod.tree)
+        for ci in self.classes.values():
+            self._infer_attr_types(ci)
+        for fi in self.functions:
+            self._edges[fi.key] = self._resolve_calls(fi)
+
+    # -- indexing ------------------------------------------------------
+
+    def _index_module(self, relpath: str, tree: ast.AST) -> None:
+        def walk(node: ast.AST, prefix: str, cls: Optional[ClassInfo],
+                 parent: Optional[FuncInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    fi = FuncInfo(relpath, qn, child,
+                                  cls.name if cls else None, parent)
+                    self.functions.append(fi)
+                    self._func_by_name.setdefault(child.name, []).append(fi)
+                    if cls is not None and parent is None:
+                        cls.methods[child.name] = fi
+                        self._method_by_name.setdefault(
+                            child.name, []).append(fi)
+                    elif cls is None and parent is None:
+                        self._modfuncs[(relpath, child.name)] = fi
+                    if parent is not None:
+                        self._nested.setdefault(
+                            parent.key, {})[child.name] = fi
+                    walk(child, qn + ".", cls, fi)
+                elif isinstance(child, ast.ClassDef):
+                    ci = ClassInfo(relpath, child.name, child)
+                    for base in child.bases:
+                        text = _base_tail(base)
+                        if text:
+                            ci.bases.append(text)
+                    self.classes[(relpath, child.name)] = ci
+                    self._cls_by_name.setdefault(child.name, []).append(ci)
+                    # methods of a nested class still attribute to it
+                    walk(child, f"{prefix}{child.name}.", ci, None)
+                else:
+                    walk(child, prefix, cls, parent)
+
+        walk(tree, "", None, None)
+
+    def _infer_attr_types(self, ci: ClassInfo) -> None:
+        for fi in ci.methods.values():
+            for node in _own_nodes(fi.node):
+                value: Optional[ast.expr] = None
+                targets: List[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value, targets = node.value, [node.target]
+                if not isinstance(value, ast.Call):
+                    continue
+                ctor = call_name(value).rsplit(".", 1)[-1]
+                if ctor not in self._cls_by_name:
+                    continue
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        self.attr_type_set(ci, tgt.attr, ctor)
+
+    def attr_type_set(self, ci: ClassInfo, attr: str, ctor: str) -> None:
+        # first assignment wins; conflicting ctors drop the mapping
+        prev = ci.attr_types.get(attr)
+        if prev is None:
+            ci.attr_types[attr] = ctor
+        elif prev != ctor:
+            ci.attr_types[attr] = ""
+
+    # -- resolution ----------------------------------------------------
+
+    def class_of(self, fi: FuncInfo) -> Optional[ClassInfo]:
+        if fi.cls_name is None:
+            return None
+        return self.classes.get((fi.relpath, fi.cls_name))
+
+    def _method_on(self, ci: Optional[ClassInfo], name: str,
+                   seen: Optional[set] = None) -> Optional[FuncInfo]:
+        """Method lookup through program-visible bases (by unique name)."""
+        if ci is None:
+            return None
+        if seen is None:
+            seen = set()
+        if id(ci) in seen:
+            return None
+        seen.add(id(ci))
+        if name in ci.methods:
+            return ci.methods[name]
+        for base in ci.bases:
+            cands = self._cls_by_name.get(base, [])
+            if len(cands) == 1:
+                hit = self._method_on(cands[0], name, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_self_method(self, ctx: FuncInfo,
+                            name: str) -> Optional[FuncInfo]:
+        """``self.name`` on ctx's own class (base classes included)."""
+        return self._method_on(self.class_of(ctx), name)
+
+    def resolve_ref(self, expr: ast.AST, ctx: FuncInfo) -> List[FuncInfo]:
+        """Resolve a callable reference/ call target to FuncInfos."""
+        if isinstance(expr, ast.Name):
+            # nested def in the enclosing function chain
+            cur: Optional[FuncInfo] = ctx
+            while cur is not None:
+                hit = self._nested.get(cur.key, {}).get(expr.id)
+                if hit is not None:
+                    return [hit]
+                cur = cur.parent
+            hit = self._modfuncs.get((ctx.relpath, expr.id))
+            if hit is not None:
+                return [hit]
+            cands = [f for f in self._func_by_name.get(expr.id, [])
+                     if f.cls_name is None and f.parent is None]
+            if len(cands) == 1:
+                return cands
+            return []
+        if not isinstance(expr, ast.Attribute):
+            return []
+        name = expr.attr
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            hit = self._method_on(self.class_of(ctx), name)
+            return [hit] if hit is not None else []
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id in ("self", "cls"):
+            ci = self.class_of(ctx)
+            if ci is not None:
+                tname = ci.attr_types.get(recv.attr)
+                if tname:
+                    cands = self._cls_by_name.get(tname, [])
+                    if len(cands) == 1:
+                        hit = self._method_on(cands[0], name)
+                        if hit is not None:
+                            return [hit]
+        if isinstance(recv, ast.Name) and len(
+                self._cls_by_name.get(recv.id, [])) == 1:
+            # ClassName.method(...) — explicit class receiver
+            hit = self._method_on(self._cls_by_name[recv.id][0], name)
+            if hit is not None:
+                return [hit]
+        # the unique-method-name interprocedural hop
+        cands = self._method_by_name.get(name, [])
+        if len(cands) == 1:
+            return cands
+        return []
+
+    def _resolve_calls(self, fi: FuncInfo) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        seen = set()
+        for node in _own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for target in self.resolve_ref(node.func, fi):
+                if target.key not in seen:
+                    seen.add(target.key)
+                    out.append(target)
+        return out
+
+    def callees(self, fi: FuncInfo) -> List[FuncInfo]:
+        return self._edges.get(fi.key, [])
+
+
+def _base_tail(base: ast.expr) -> str:
+    """Simple name of a base-class expression: ``threading.Thread`` ->
+    'Thread', ``Foo`` -> 'Foo', anything unresolvable -> ''."""
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return ""

@@ -1,0 +1,198 @@
+"""raceguard reporting: guarded-by, atomicity, lock-scope findings.
+
+All three reports key off the same extracted model (callgraph + roles +
+access map).  Precision levers, in order of load-bearing-ness:
+
+  * ``__init__``/``__new__`` sites never count — construction happens
+    before the object is published to other threads — and neither do
+    sites lexically before a top-level ``threading.Thread(...)`` ctor in
+    ``start()``-style methods (pre-publication);
+  * private helpers inherit the intersected lock set of their call sites
+    (the "call with lock held" idiom: ``_record``, ``_shrink_locked``);
+  * guarded-by fires only when the conflict set holds a NON-ATOMIC op —
+    an rmw (``+=``) or an iterating read; single-op container mutations,
+    rebinds, and plain loads are each GIL-atomic, and method calls on a
+    typed component synchronise inside that component's own class, which
+    raceguard analyses separately;
+  * thread-safe-typed attrs (Lock/Event/Queue/deque/...) are exempt;
+  * a conflict needs concurrent roles: >= 2 distinct thread families, or
+    one multi-instance family (N worker shards, thread-per-request HTTP).
+
+What stays inferential (documented in docs/static_analysis.md): role
+propagation over-approximates (a method callable from worker AND main
+carries both roles even if the program never overlaps them), and
+callback indirection the resolver can't see under-approximates.  Real
+hits get fixed; benign-but-unprovable ones live in the allowlist with a
+pay-down note.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, Program
+from .accessmap import ITER, MUTATE, READ, RMW, WRITE, AccessMap
+from .callgraph import CallGraph
+from .roles import ROLE_MAIN, RoleGraph
+
+CHECK_GUARDED_BY = "raceguard-guarded-by"
+CHECK_ATOMICITY = "raceguard-atomicity"
+CHECK_LOCK_SCOPE = "raceguard-lock-scope"
+
+
+def _fmt_roles(roles) -> str:
+    return "{" + ",".join(sorted(roles)) + "}"
+
+
+class RaceGuardChecker(Checker):
+    name = CHECK_GUARDED_BY
+    description = ("whole-program thread-role race detection: guarded-by "
+                   "inference, check-then-act atomicity, lock-scope "
+                   "escapes")
+
+    @property
+    def produces(self) -> frozenset:
+        return frozenset((CHECK_GUARDED_BY, CHECK_ATOMICITY,
+                          CHECK_LOCK_SCOPE))
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, program: Program) -> Iterator[Finding]:
+        cg = CallGraph(program)
+        rg = RoleGraph(program, cg)
+        am = AccessMap(program, cg)
+        # findings are emitted in (path, line) order per class for stable
+        # output; run_analysis re-sorts globally anyway
+        for key in sorted(am.by_class):
+            ca = am.by_class[key]
+            yield from self._guarded_by(ca, rg)
+            yield from self._atomicity(ca, rg)
+            yield from self._lock_scope(ca, rg)
+
+    # -- guarded-by ----------------------------------------------------
+
+    def _guarded_by(self, ca, rg: RoleGraph) -> Iterator[Finding]:
+        if not ca.uses_locks:
+            return
+        for attr in sorted(ca.accesses):
+            if attr in ca.exempt:
+                continue
+            sites = [a for a in ca.accesses[attr] if not a.in_init]
+            mutates = [a for a in sites if a.kind in (MUTATE, RMW)]
+            if not mutates:
+                continue
+            # a race needs at least one NON-ATOMIC op in the conflict set:
+            # an rmw (+=: load and store are separate bytecodes) or an
+            # iterating read (the dict/list snapshot shape).  Single-op
+            # container mutations, rebinds, and plain loads are each
+            # atomic under the GIL — races among only those can't corrupt
+            # anything at this class's level.
+            nonatomic = [a for a in sites if a.kind in (RMW, ITER)]
+            if not nonatomic:
+                continue
+            writes = [a for a in sites if a.kind in (WRITE, MUTATE, RMW)]
+            writer_roles = frozenset().union(
+                *(rg.effective_roles(a.func_key) for a in writes))
+            if not rg.concurrent(writer_roles):
+                continue
+            # the guard must cover every write/mutate AND every iterating
+            # read; plain loads stay out (GIL-atomic, benign)
+            guarded = writes + [a for a in nonatomic if a.kind == ITER]
+            common = frozenset(guarded[0].locks)
+            for a in guarded[1:]:
+                common &= a.locks
+            if common:
+                continue
+            unlocked = [a for a in guarded if not a.locks]
+            # anchor at the bug: the first unlocked non-atomic site if one
+            # exists, else the first unlocked mutation, else the first
+            anchor_pool = ([a for a in nonatomic if not a.locks]
+                           or [a for a in mutates if not a.locks]
+                           or mutates)
+            site = min(anchor_pool, key=lambda a: (a.line, a.col))
+            others = sorted({f"{a.kind}@{a.line}" for a in guarded
+                             if a is not site})
+            detail = ", ".join(others[:4]) + \
+                (", ..." if len(others) > 4 else "")
+            yield Finding(
+                CHECK_GUARDED_BY, ca.relpath, site.line, site.col,
+                f"self.{attr} is written from thread roles "
+                f"{_fmt_roles(writer_roles)} but its {len(guarded)} "
+                f"conflicting sites share no common lock "
+                f"({len(unlocked)} hold none; {detail}) — pick one lock "
+                "and hold it at every site",
+                symbol=f"{ca.cls_name}.{attr}")
+
+    # -- check-then-act ------------------------------------------------
+
+    def _atomicity(self, ca, rg: RoleGraph) -> Iterator[Finding]:
+        if not ca.uses_locks:
+            return
+        seen = set()
+        for (attr, test_line, act_line, test_locks, act_locks,
+             func_key) in ca.check_acts:
+            if attr in ca.exempt:
+                continue
+            if test_locks & act_locks:
+                continue    # check and act under one continuous region
+            sites = [a for a in ca.accesses.get(attr, ())
+                     if not a.in_init]
+            all_roles = frozenset().union(
+                frozenset(), *(rg.effective_roles(a.func_key)
+                               for a in sites))
+            if not rg.concurrent(all_roles):
+                continue
+            # single-role-single-instance functions can't interleave with
+            # themselves; require the acting function itself concurrent
+            # OR another function also writing the attr
+            other_writers = {a.func_key for a in sites
+                            if a.kind in (WRITE, MUTATE, RMW)
+                            and a.func_key != func_key}
+            if not rg.concurrent(rg.effective_roles(func_key)) \
+                    and not other_writers:
+                continue
+            dkey = (attr, test_line)    # one report per check site
+            if dkey in seen:
+                continue
+            seen.add(dkey)
+            locks_txt = "no lock" if not (test_locks | act_locks) else (
+                f"check holds {sorted(test_locks) or ['nothing']}, "
+                f"act holds {sorted(act_locks) or ['nothing']}")
+            yield Finding(
+                CHECK_ATOMICITY, ca.relpath, test_line, 0,
+                f"check-then-act on self.{attr} (checked at line "
+                f"{test_line}, acted on at line {act_line}) is not atomic:"
+                f" {locks_txt}; roles {_fmt_roles(all_roles)} can "
+                "interleave between check and act",
+                symbol=f"{ca.cls_name}.{attr}")
+
+    # -- lock-scope escape ---------------------------------------------
+
+    def _lock_scope(self, ca, rg: RoleGraph) -> Iterator[Finding]:
+        for attr, line, col, lock, func_key in ca.escapes:
+            if attr in ca.exempt or attr not in ca.containers:
+                continue
+            # only meaningful if the container is actually mutated
+            # somewhere under a lock (why else guard the read?)
+            locked_mut = [a for a in ca.accesses.get(attr, ())
+                          if a.kind in (MUTATE, RMW) and a.locks
+                          and not a.in_init]
+            if not locked_mut:
+                continue
+            sites = [a for a in ca.accesses.get(attr, ())
+                     if not a.in_init]
+            all_roles = frozenset().union(
+                frozenset(), *(rg.effective_roles(a.func_key)
+                               for a in sites))
+            if not rg.concurrent(all_roles):
+                continue
+            yield Finding(
+                CHECK_LOCK_SCOPE, ca.relpath, line, col,
+                f"returning mutable container self.{attr} out of the "
+                f"{lock} region publishes the guarded reference — the "
+                "caller iterates it after the lock is released while "
+                f"roles {_fmt_roles(all_roles)} keep mutating it; return "
+                "a copy (dict(...)/list(...)) instead",
+                symbol=f"{ca.cls_name}.{attr}")

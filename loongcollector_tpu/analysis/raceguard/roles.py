@@ -1,0 +1,202 @@
+"""Thread-role graph: which thread families can execute each function.
+
+Entry points are seeded from every way this codebase starts concurrent
+execution, classified into a small set of role families, then propagated
+along the call graph: if a worker thread can execute ``f`` and ``f`` calls
+``g``, a worker thread can execute ``g``.
+
+Seed families (docs/static_analysis.md#race-detection):
+
+  * ``threading.Thread(target=X)`` / ``threading.Timer(_, X)`` ctors —
+    the target resolves through the call-graph resolver, and the family
+    comes from the entry's module/name (worker loops in
+    runner/processor_runner, flusher senders in runner// flusher/,
+    watcher pumps in config//container_manager, timer pumps in monitor/,
+    the profiler sampler in prof/, input readers in input/);
+  * ``run()`` on classes deriving from ``threading.Thread``;
+  * ``do_*`` methods on ``BaseHTTPRequestHandler`` subclasses (the
+    exposition server and HTTP inputs are threading servers: every
+    request is its own thread) — family ``http``;
+  * ``signal.signal(SIG, handler)`` registrations — family ``signal``;
+  * lifecycle methods (``start``/``stop``/``shutdown``) and module-level
+    functions of application.py — family ``main``.
+
+A function reached by no seed is assumed main-thread only
+(``effective_roles`` returns {'main'}).  MULTI_INSTANCE families run more
+than one thread at once (N worker shards, thread-per-request HTTP,
+per-connection input loops), so shared state touched from a single such
+family is still concurrent with itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..core import Program, call_name
+from .callgraph import CallGraph, FuncInfo, _own_nodes
+
+ROLE_WORKER = "worker"
+ROLE_FLUSHER = "flusher"
+ROLE_WATCHER = "watcher"
+ROLE_TIMER = "timer"
+ROLE_HTTP = "http"
+ROLE_PROFILER = "profiler"
+ROLE_SIGNAL = "signal"
+ROLE_INPUT = "input"
+ROLE_THREAD = "thread"
+ROLE_MAIN = "main"
+
+#: families that run >1 thread concurrently, so shared state is racy even
+#: within the single family: N worker shards, thread-per-request HTTP,
+#: and the flusher plane (runner thread + retry thread + async senders).
+#: ``input`` is deliberately NOT here: one reader loop per plugin
+#: instance is the norm, and flagging a loop against itself drowned the
+#: report in single-thread noise.
+MULTI_INSTANCE = frozenset((ROLE_WORKER, ROLE_HTTP, ROLE_FLUSHER))
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
+_MAIN_METHODS = {"start", "stop", "shutdown"}
+_HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler",
+                       "SimpleHTTPRequestHandler"}
+
+
+def classify_entry(fi: FuncInfo, thread_name: str = "") -> str:
+    """Role family for a thread entry function, by module path first and
+    entry/thread name second."""
+    rel = fi.relpath
+    low = (fi.qualname + " " + thread_name).lower()
+    if "/prof/" in rel or rel.endswith("profiler.py") \
+            or "profiler" in low or "sampler" in low:
+        return ROLE_PROFILER
+    if rel.endswith(("monitor/watchdog.py", "monitor/ledger.py",
+                     "monitor/self_monitor.py")) \
+            or "watchdog" in low or "timer" in low or "timeout" in low \
+            or "flush_loop" in low:
+        return ROLE_TIMER
+    if "/config/" in rel or rel.endswith("container_manager.py") \
+            or "watch" in low or "refresh" in low:
+        return ROLE_WATCHER
+    if rel.endswith("runner/processor_runner.py") or "worker" in low:
+        return ROLE_WORKER
+    if "/flusher/" in rel or rel.endswith(("flusher_runner.py",
+                                           "http_sink.py", "kafka.py")) \
+            or "sender" in low or "flusher" in low:
+        return ROLE_FLUSHER
+    if "serve_forever" in low or "http" in low:
+        return ROLE_HTTP
+    if "/input/" in rel:
+        return ROLE_INPUT
+    return ROLE_THREAD
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class RoleGraph:
+    def __init__(self, program: Program, cg: CallGraph):
+        self.cg = cg
+        #: (relpath, qualname) -> role set
+        self._roles: Dict[Tuple[str, str], set] = {}
+        #: seeded entries for tests/debugging: (FuncInfo, role, reason)
+        self.entries: List[Tuple[FuncInfo, str, str]] = []
+        self._seed(program)
+        self._propagate()
+
+    # -- seeding -------------------------------------------------------
+
+    def _add_entry(self, fi: FuncInfo, role: str, reason: str) -> None:
+        self.entries.append((fi, role, reason))
+        self._roles.setdefault(fi.key, set()).add(role)
+
+    def _seed(self, program: Program) -> None:
+        for fi in self.cg.functions:
+            for node in _own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = call_name(node)
+                if dotted in _THREAD_CTORS:
+                    target = _kw(node, "target")
+                    if target is None:
+                        continue
+                    name_kw = _kw(node, "name")
+                    tname = name_kw.value if isinstance(
+                        name_kw, ast.Constant) and isinstance(
+                        name_kw.value, str) else ""
+                    for entry in self.cg.resolve_ref(target, fi):
+                        self._add_entry(entry, classify_entry(entry, tname),
+                                        "threading.Thread target")
+                elif dotted in _TIMER_CTORS:
+                    if len(node.args) >= 2:
+                        for entry in self.cg.resolve_ref(node.args[1], fi):
+                            self._add_entry(entry, ROLE_TIMER,
+                                            "threading.Timer callback")
+                elif dotted == "signal.signal" and len(node.args) == 2:
+                    for entry in self.cg.resolve_ref(node.args[1], fi):
+                        self._add_entry(entry, ROLE_SIGNAL,
+                                        "signal handler")
+
+        for ci in self.cg.classes.values():
+            bases = set(ci.bases)
+            if "Thread" in bases and "run" in ci.methods:
+                entry = ci.methods["run"]
+                self._add_entry(entry, classify_entry(entry),
+                                "threading.Thread subclass run()")
+            if bases & _HTTP_HANDLER_BASES:
+                for name, m in ci.methods.items():
+                    if name.startswith("do_"):
+                        self._add_entry(m, ROLE_HTTP,
+                                        "BaseHTTPRequestHandler do_*")
+
+        # main-thread seeds: lifecycle methods + the application module
+        for fi in self.cg.functions:
+            if fi.parent is None and fi.name in _MAIN_METHODS:
+                self._add_entry(fi, ROLE_MAIN, "lifecycle method")
+            elif fi.cls_name is None and fi.parent is None and \
+                    fi.relpath.endswith("application.py"):
+                self._add_entry(fi, ROLE_MAIN, "application module")
+
+    # -- propagation ---------------------------------------------------
+
+    def _propagate(self) -> None:
+        # successors = call edges + parent->nested-def edges (a closure
+        # passed as a callback is approximated by its parent's roles;
+        # Thread targets got their own seed already)
+        succ: Dict[Tuple[str, str], List[FuncInfo]] = {
+            fi.key: list(self.cg.callees(fi)) for fi in self.cg.functions}
+        for fi in self.cg.functions:
+            if fi.parent is not None:
+                succ.setdefault(fi.parent.key, []).append(fi)
+        work = [fi for fi in self.cg.functions if fi.key in self._roles]
+        while work:
+            fi = work.pop()
+            roles = self._roles.get(fi.key, set())
+            for callee in succ.get(fi.key, ()):
+                have = self._roles.setdefault(callee.key, set())
+                if not roles <= have:
+                    have |= roles
+                    work.append(callee)
+
+    # -- queries -------------------------------------------------------
+
+    def roles(self, fi: FuncInfo) -> FrozenSet[str]:
+        return frozenset(self._roles.get(fi.key, ()))
+
+    def effective_roles(self, key: Tuple[str, str]) -> FrozenSet[str]:
+        roles = self._roles.get(key)
+        return frozenset(roles) if roles else frozenset((ROLE_MAIN,))
+
+    @staticmethod
+    def concurrent(roles: FrozenSet[str]) -> bool:
+        """Can code running under these roles race with itself/another
+        site of the same role set?  >= 2 distinct roles, or one
+        multi-instance family."""
+        nonmain = roles - {ROLE_MAIN}
+        if len(roles) >= 2:
+            return True
+        return len(nonmain) == 1 and next(iter(nonmain)) in MULTI_INSTANCE

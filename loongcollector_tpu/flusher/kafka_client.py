@@ -299,8 +299,18 @@ class KafkaClient:
             ctx.load_cert_chain(cert, key)
         return ctx.wrap_socket(sock, server_hostname=host)
 
+    def _next_corr(self) -> int:
+        """Correlation-id allocation is a read-modify-write shared between
+        the sender thread and main-thread metadata/close paths — under the
+        lock, or two in-flight requests can claim the same id and fail
+        each other's correlation check."""
+        with self._lock:
+            self._corr += 1
+            return self._corr
+
     def _connect(self, addr: str) -> socket.socket:
-        sock = self._conns.get(addr)
+        with self._lock:
+            sock = self._conns.get(addr)
         if sock is not None:
             return sock
         host, _, port = addr.rpartition(":")
@@ -316,8 +326,17 @@ class KafkaClient:
             except OSError:
                 pass
             raise
-        self._conns[addr] = sock
-        return sock
+        with self._lock:
+            cur = self._conns.get(addr)
+            if cur is None:
+                self._conns[addr] = sock
+                return sock
+        # lost a connect race: keep the established entry, release ours
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return cur
 
     # -- SASL ---------------------------------------------------------------
 
@@ -325,8 +344,7 @@ class KafkaClient:
                      payload: bytes) -> bytes:
         """One request/response on an ALREADY-OPEN socket (the handshake
         must not recurse into _connect)."""
-        self._corr += 1
-        corr = self._corr
+        corr = self._next_corr()
         header = (struct.pack(">hhi", api, version, corr)
                   + _str(self.client_id))
         msg = header + payload
@@ -404,7 +422,8 @@ class KafkaClient:
             raise KafkaError("SCRAM server signature verification failed")
 
     def _drop(self, addr: str) -> None:
-        sock = self._conns.pop(addr, None)
+        with self._lock:
+            sock = self._conns.pop(addr, None)
         if sock is not None:
             try:
                 sock.close()
@@ -422,8 +441,7 @@ class KafkaClient:
             # keep the KafkaError contract: a refused/reset connect must
             # not escape raw and kill the caller's sender thread
             raise KafkaError(f"broker {addr}: {e}") from e
-        self._corr += 1
-        my_corr = self._corr
+        my_corr = self._next_corr()
         header = (struct.pack(">hhi", api_key, api_version, my_corr)
                   + _str(self.client_id))
         msg = header + payload
@@ -469,10 +487,10 @@ class KafkaClient:
                 corrs = []
                 buf = bytearray()
                 for api_key, api_version, payload in window:
-                    self._corr += 1
-                    corrs.append(self._corr)
+                    corr = self._next_corr()
+                    corrs.append(corr)
                     header = (struct.pack(">hhi", api_key, api_version,
-                                          self._corr)
+                                          corr)
                               + _str(self.client_id))
                     msg = header + payload
                     buf += struct.pack(">i", len(msg)) + msg
@@ -546,7 +564,9 @@ class KafkaClient:
         raise last_err or KafkaError("no brokers reachable")
 
     def close(self) -> None:
-        for addr in list(self._conns):
+        with self._lock:
+            addrs = list(self._conns)
+        for addr in addrs:
             self._drop(addr)
 
 

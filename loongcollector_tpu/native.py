@@ -31,6 +31,13 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _SO_PATH = os.path.join(_NATIVE_DIR, "libloongcollector_native.so")
 
 
+def _so_path() -> str:
+    """LOONG_NATIVE_LIB points the bridge at an alternate build — the
+    sanitizer harness (scripts/sanitize.sh) loads its ASan/TSan
+    instrumented library without touching the release artifact."""
+    return os.environ.get("LOONG_NATIVE_LIB") or _SO_PATH
+
+
 def _try_build() -> bool:
     makefile = os.path.join(_NATIVE_DIR, "Makefile")
     if not os.path.exists(makefile):
@@ -53,22 +60,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _load_attempted = True
         if os.environ.get("LOONG_DISABLE_NATIVE"):
             return None
-        if not os.path.exists(_SO_PATH) and not _try_build():
+        so_path = _so_path()
+        overridden = so_path != _SO_PATH
+        # an explicit override must load exactly what it names — never
+        # fall back to (or rebuild over) the release artifact
+        if not os.path.exists(so_path) and (overridden or not _try_build()):
             log.info("native library unavailable; using python fallbacks")
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so_path)
         except OSError as e:
             log.warning("failed to load native library: %s", e)
             return None
-        if not hasattr(lib, "lct_t1_exec") \
-                or not hasattr(lib, "lct_ndjson_serialize") \
-                or not hasattr(lib, "lct_struct_index") \
-                or not hasattr(lib, "lct_group_reduce"):
+        if not overridden and (
+                not hasattr(lib, "lct_t1_exec")
+                or not hasattr(lib, "lct_ndjson_serialize")
+                or not hasattr(lib, "lct_struct_index")
+                or not hasattr(lib, "lct_group_reduce")):
             # stale build predating the newest entry point: rebuild + reload
             if _try_build():
                 try:
-                    lib = ctypes.CDLL(_SO_PATH)
+                    lib = ctypes.CDLL(so_path)
                 except OSError:
                     pass
         # pointer params bind as c_void_p and calls pass raw addresses
@@ -147,7 +159,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
                           if not fn.endswith("uncompressed_len")
                           else [u8p, ctypes.c_int64])
         _lib = lib
-        log.info("native library loaded: %s", _SO_PATH)
+        log.info("native library loaded: %s", so_path)
         return _lib
 
 

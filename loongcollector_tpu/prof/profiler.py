@@ -163,9 +163,11 @@ class Profiler:
 
     def stop(self) -> None:
         self._running = False
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        # claim the thread in one load before joining: a concurrent stop
+        # would otherwise None the attr between our check and the join
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2)
         # retire the per-scope records: a stopped profiler exports nothing
         # further (loonglint metric-naming ownership rule)
         with self._records_lock:

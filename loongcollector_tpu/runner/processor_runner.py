@@ -348,6 +348,9 @@ class ProcessorRunner:
         # own histogram on the process-queue side
         self.e2e_hist = self.metrics.histogram("pipeline_e2e_seconds")
         self.last_flush = time.monotonic()
+        # every worker/dispatcher loop pumps the flush cadence: claiming
+        # the interval must be atomic or two shards double-flush
+        self._flush_claim = threading.Lock()
 
     # -- producer API -------------------------------------------------------
 
@@ -446,8 +449,13 @@ class ProcessorRunner:
 
     def _pump_timeout_flush(self) -> None:
         now = time.monotonic()
-        if now - self.last_flush >= BATCH_FLUSH_INTERVAL_S:
-            self.last_flush = now
+        with self._flush_claim:
+            claimed = now - self.last_flush >= BATCH_FLUSH_INTERVAL_S
+            if claimed:
+                self.last_flush = now
+        # flush outside the claim: only the interval arithmetic needs
+        # atomicity, the hooks below take their own locks
+        if claimed:
             try:
                 TimeoutFlushManager.instance().flush_timeout_batches()
             except Exception:  # noqa: BLE001 — a bad hook must not kill

@@ -16,7 +16,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== loonglint =="
-python -m loongcollector_tpu.analysis "$@"
+# --budget caps the 13-checker sweep's own wall clock: the static gate
+# stays a fast-feedback tool, and a checker that regresses to quadratic
+# work fails here before it annoys every future lint run (per-checker
+# timings: `python -m loongcollector_tpu.analysis --json` checker_seconds)
+python -m loongcollector_tpu.analysis --budget 30 "$@"
 
 echo "== tracing-overhead smoke =="
 JAX_PLATFORMS=cpu python scripts/trace_overhead.py
@@ -57,7 +61,7 @@ echo "== fused-pipeline equivalence gate (loongresident) =="
 # per batch slot) and OFF (per-stage dispatch) must produce byte-identical
 # groups across the regex / grok / delimiter / json / multiline families —
 # fusion is an execution-plan change, never a semantics change
-JAX_PLATFORMS=cpu python scripts/fused_equivalence.py
+JAX_PLATFORMS=cpu python scripts/resident_equivalence.py
 
 echo "== structural-index equivalence gate (loongstruct) =="
 # the native/numpy/device structural bitmaps must be bit-identical, the
@@ -82,6 +86,16 @@ JAX_PLATFORMS=cpu python scripts/reload_soak.py \
 
 echo "== native lint =="
 make -C native lint
+
+echo "== native sanitizer plane (ASan+UBSan) =="
+# instrumented rebuild of the data plane driven through the native test
+# corpus + the four equivalence gates (scripts/sanitize.sh); probe-gated
+# so boxes without g++/libasan still lint
+if scripts/sanitize.sh --probe >/dev/null 2>&1; then
+    scripts/sanitize.sh
+else
+    echo "no sanitizer toolchain; skipped (scripts/sanitize.sh --probe)"
+fi
 
 echo "== ResourceWarning sweep (concurrency stress) =="
 JAX_PLATFORMS=cpu python -X dev -W error::ResourceWarning -m pytest \

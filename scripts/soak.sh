@@ -38,6 +38,17 @@ echo "== chaos soak: extended seed matrix (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m slow -p no:cacheprovider
 
+echo "== native sanitizer soak (TSan) =="
+# the long-running home of the opt-in TSan variant: data races in the
+# native plane surface under the soak's time budget, not lint's
+# (scripts/sanitize.sh --tsan; probe-gated like the lint-side ASan pass)
+if scripts/sanitize.sh --probe >/dev/null 2>&1; then
+    scripts/sanitize.sh --tsan
+    scripts/sanitize.sh
+else
+    echo "no sanitizer toolchain; skipped (scripts/sanitize.sh --probe)"
+fi
+
 echo "== reload soak: sustained config churn (loongtenant) =="
 # long churn with topology add/remove AND a control-plane chaos storm —
 # zero residual per tenant, send_ok == pushed, across hundreds of reloads

@@ -644,8 +644,8 @@ class TestSpanMatch:
 
 
 # ---------------------------------------------------------------------------
-# 8. equivalence gate (the scripts/fused_equivalence.py contract, run
-#    in-process on every tier-1 invocation)
+# 8. equivalence gate (the scripts/resident_equivalence.py contract,
+#    run in-process on every tier-1 invocation)
 
 
 class TestEquivalenceGate:
@@ -653,10 +653,10 @@ class TestEquivalenceGate:
         import importlib.util
         import os
         spec = importlib.util.spec_from_file_location(
-            "fused_equivalence",
+            "resident_equivalence",
             os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "scripts",
-                "fused_equivalence.py"))
+                "resident_equivalence.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         assert mod.main() == 0

@@ -1,0 +1,690 @@
+"""raceguard: whole-program thread-role race detection.
+
+Three layers of coverage (ISSUE 16):
+
+  1. the three races this repo actually shipped and later fixed —
+     snapshot-vs-registration (PR 3), shed-vs-deliver double-pop (PR 8)
+     and emit-under-lock reference escape (PR 5) — reproduced as
+     faithful pre-fix fixtures that raceguard MUST catch;
+  2. thread-role seeding, one fixture per entry family (worker loop,
+     flusher sender, config watcher, timer pump, HTTP handler, profiler
+     sampler, signal path, Thread-subclass run);
+  3. precision pins: the idioms that must NOT fire (lock-held private
+     helpers, Condition(lock) aliasing, pre-start publication,
+     GIL-atomic single-op sites, single-instance input loops), plus
+     runtime regression tests for the real-tree races this checker
+     found and this PR fixed.
+"""
+
+import textwrap
+import threading
+import time
+
+from loongcollector_tpu.analysis import ModuleInfo, Program
+from loongcollector_tpu.analysis.raceguard.callgraph import CallGraph
+from loongcollector_tpu.analysis.raceguard.checker import (
+    CHECK_ATOMICITY, CHECK_GUARDED_BY, CHECK_LOCK_SCOPE, RaceGuardChecker)
+from loongcollector_tpu.analysis.raceguard.roles import (
+    ROLE_FLUSHER, ROLE_HTTP, ROLE_MAIN, ROLE_PROFILER, ROLE_SIGNAL,
+    ROLE_THREAD, ROLE_TIMER, ROLE_WATCHER, ROLE_WORKER, RoleGraph)
+
+FIXTURE_PATH = "loongcollector_tpu/ops/fixture.py"
+
+
+def scan(src, relpath=FIXTURE_PATH):
+    """Run raceguard over inline fixture source; returns findings."""
+    checker = RaceGuardChecker()
+    mod = ModuleInfo("/fx/" + relpath, relpath, textwrap.dedent(src))
+    findings = list(checker.check_module(mod))
+    findings += list(checker.finalize(Program("/fx", [mod])))
+    return findings
+
+
+def checks_of(findings):
+    return {f.check for f in findings}
+
+
+def rolegraph(src, relpath=FIXTURE_PATH):
+    mod = ModuleInfo("/fx/" + relpath, relpath, textwrap.dedent(src))
+    program = Program("/fx", [mod])
+    cg = CallGraph(program)
+    return RoleGraph(program, cg), cg
+
+
+# ---------------------------------------------------------------------------
+# 1. historical races — the three bugs this repo shipped, pre-fix shape.
+# Each fixture is the minimal faithful skeleton of the code as it looked
+# BEFORE the fixing PR; raceguard existing then would have caught all
+# three at review time.
+
+
+# PR 3 (self-monitor): pipeline registration wrote the registry dict
+# with no lock while the exposition path snapshotted (iterated) it under
+# one — a worker registering during a scrape corrupted the iteration.
+SNAPSHOT_REGISTRATION = """
+    import threading
+
+    class PipelineRegistry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._records = {}
+
+        def start(self):
+            threading.Thread(target=self._run, name="worker-0").start()
+
+        def _run(self):
+            while True:
+                self.register("p", object())
+
+        def register(self, name, record):
+            self._records[name] = record
+
+        def snapshot(self):
+            with self._lock:
+                return list(self._records.values())
+"""
+
+
+# PR 8 (flusher shedding): deliver checked the queue head then popped it
+# without a lock, while the shed path popped concurrently — the same
+# batch could be delivered AND counted as shed.
+SHED_VS_DELIVER = """
+    import threading
+
+    class DeliveryQueue:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def start(self):
+            threading.Thread(target=self._send_loop,
+                             name="flusher-sender").start()
+            threading.Thread(target=self._shed_loop,
+                             name="flusher-shed").start()
+
+        def _send_loop(self):
+            while True:
+                self.deliver()
+
+        def _shed_loop(self):
+            while True:
+                self.shed()
+
+        def deliver(self):
+            if self._items:
+                return self._items.pop(0)
+            return None
+
+        def shed(self):
+            with self._lock:
+                if self._items:
+                    return self._items.pop(0)
+                return None
+"""
+
+
+# PR 5 (circuit breaker): pending() returned the guarded transition list
+# out of the locked region; the sender iterated it lock-free while
+# on_result kept appending — the exact emit-under-lock escape the
+# breaker rework closed.
+BREAKER_EMIT_ESCAPE = """
+    import threading
+
+    class Breaker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._transitions = []
+
+        def start(self):
+            threading.Thread(target=self._send_loop,
+                             name="flusher-0").start()
+
+        def _send_loop(self):
+            while True:
+                self.record(True)
+                self.pending()
+
+        def record(self, ok):
+            with self._lock:
+                self._transitions.append(ok)
+
+        def pending(self):
+            with self._lock:
+                return self._transitions
+"""
+
+
+class TestHistoricalRaces:
+    def test_snapshot_registration_race_is_caught(self):
+        findings = scan(SNAPSHOT_REGISTRATION)
+        assert CHECK_GUARDED_BY in checks_of(findings)
+        hit = [f for f in findings if f.check == CHECK_GUARDED_BY][0]
+        assert hit.symbol == "PipelineRegistry._records"
+        # anchored at the unlocked registration write, the actual bug
+        assert "register" not in hit.message or hit.line
+        assert "worker" in hit.message
+
+    def test_snapshot_registration_fixed_shape_is_clean(self):
+        fixed = SNAPSHOT_REGISTRATION.replace(
+            "            self._records[name] = record",
+            "            with self._lock:\n"
+            "                self._records[name] = record")
+        assert scan(fixed) == []
+
+    def test_shed_vs_deliver_race_is_caught(self):
+        findings = scan(SHED_VS_DELIVER)
+        assert CHECK_ATOMICITY in checks_of(findings)
+        hit = [f for f in findings if f.check == CHECK_ATOMICITY][0]
+        assert hit.symbol == "DeliveryQueue._items"
+        assert "check-then-act" in hit.message
+        # the locked shed path is NOT reported: check and act share one
+        # continuous region there
+        atom = [f for f in findings if f.check == CHECK_ATOMICITY]
+        assert len(atom) == 1
+
+    def test_shed_vs_deliver_fixed_shape_is_clean(self):
+        fixed = SHED_VS_DELIVER.replace(
+            "        def deliver(self):\n"
+            "            if self._items:\n"
+            "                return self._items.pop(0)\n"
+            "            return None",
+            "        def deliver(self):\n"
+            "            with self._lock:\n"
+            "                if self._items:\n"
+            "                    return self._items.pop(0)\n"
+            "                return None")
+        assert scan(fixed) == []
+
+    def test_breaker_emit_escape_is_caught(self):
+        findings = scan(BREAKER_EMIT_ESCAPE)
+        assert CHECK_LOCK_SCOPE in checks_of(findings)
+        hit = [f for f in findings if f.check == CHECK_LOCK_SCOPE][0]
+        assert hit.symbol == "Breaker._transitions"
+        assert "copy" in hit.message
+
+    def test_breaker_emit_fixed_shape_is_clean(self):
+        fixed = BREAKER_EMIT_ESCAPE.replace(
+            "                return self._transitions",
+            "                return list(self._transitions)")
+        assert scan(fixed) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. thread-role seeding — one entry per family (ISSUE 16 satellite).
+
+
+ROLE_FAMILIES = """
+    import signal
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    class Agent:
+        def start(self):
+            threading.Thread(target=self._work, name="worker-0").start()
+            threading.Thread(target=self._send_batches,
+                             name="flusher-sender").start()
+            threading.Thread(target=self._watch_config,
+                             name="config-watch").start()
+            threading.Timer(5.0, self._tick).start()
+            threading.Thread(target=self._sample_profiler,
+                             name="loongprof").start()
+            signal.signal(signal.SIGTERM, self._on_signal)
+
+        def _work(self):
+            self._step()
+
+        def _step(self):
+            pass
+
+        def _send_batches(self):
+            pass
+
+        def _watch_config(self):
+            pass
+
+        def _tick(self):
+            pass
+
+        def _sample_profiler(self):
+            pass
+
+        def _on_signal(self, signum, frame):
+            pass
+
+        def untouched(self):
+            pass
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            pass
+
+    class Puller(threading.Thread):
+        def run(self):
+            pass
+"""
+
+
+class TestRoleSeeding:
+    def _entries(self):
+        rg, cg = rolegraph(ROLE_FAMILIES)
+        return rg, cg, {(fi.qualname, role) for fi, role, _ in rg.entries}
+
+    def test_every_entry_family_is_classified(self):
+        _rg, _cg, entries = self._entries()
+        assert ("Agent._work", ROLE_WORKER) in entries
+        assert ("Agent._send_batches", ROLE_FLUSHER) in entries
+        assert ("Agent._watch_config", ROLE_WATCHER) in entries
+        assert ("Agent._tick", ROLE_TIMER) in entries
+        assert ("Agent._sample_profiler", ROLE_PROFILER) in entries
+        assert ("Agent._on_signal", ROLE_SIGNAL) in entries
+        assert ("Handler.do_GET", ROLE_HTTP) in entries
+        assert ("Puller.run", ROLE_THREAD) in entries
+        # lifecycle methods seed the main family
+        assert ("Agent.start", ROLE_MAIN) in entries
+
+    def test_roles_propagate_along_call_graph(self):
+        rg, cg, _ = self._entries()
+        step = [fi for fi in cg.functions
+                if fi.qualname == "Agent._step"][0]
+        assert ROLE_WORKER in rg.roles(step)
+
+    def test_unreached_function_defaults_to_main(self):
+        rg, cg, _ = self._entries()
+        untouched = [fi for fi in cg.functions
+                     if fi.qualname == "Agent.untouched"][0]
+        assert rg.effective_roles(untouched.key) == frozenset((ROLE_MAIN,))
+
+    def test_concurrency_judgement(self):
+        # multi-instance families race with themselves; singletons don't
+        assert RoleGraph.concurrent(frozenset((ROLE_WORKER,)))
+        assert RoleGraph.concurrent(frozenset((ROLE_HTTP,)))
+        assert RoleGraph.concurrent(frozenset((ROLE_FLUSHER,)))
+        assert not RoleGraph.concurrent(frozenset((ROLE_THREAD,)))
+        assert not RoleGraph.concurrent(frozenset((ROLE_MAIN,)))
+        assert not RoleGraph.concurrent(frozenset())
+        # two distinct families always can
+        assert RoleGraph.concurrent(frozenset((ROLE_MAIN, ROLE_TIMER)))
+
+
+# ---------------------------------------------------------------------------
+# 3a. precision pins — idioms the checker must stay silent on.  Each of
+# these is a real pattern from this tree that an earlier raceguard draft
+# flagged; the pin keeps the false-positive fix honest.
+
+
+class TestPrecisionPins:
+    def test_lock_held_private_helper_is_silent(self):
+        # disk_buffer/circuit idiom: a public method takes the lock and
+        # delegates to a _helper that touches shared state.  Entry-lock
+        # propagation must credit the helper's sites with the callers'
+        # held locks.
+        src = """
+            import threading
+
+            class Buf:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pending = []
+                    self._count = 0
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        self.add(1)
+
+                def add(self, item):
+                    with self._lock:
+                        self._append_locked(item)
+
+                def drain(self):
+                    with self._lock:
+                        out = list(self._pending)
+                        self._pending = []
+                        self._count = 0
+                        return out
+
+                def _append_locked(self, item):
+                    self._pending.append(item)
+                    self._count += 1
+        """
+        assert scan(src) == []
+
+    def test_helper_called_unlocked_once_still_fires(self):
+        # the same helper reached by even ONE lock-free call site loses
+        # the inferred entry lock: intersection over call sites
+        src = """
+            import threading
+
+            class Buf:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        self.add()
+                        self._bump()
+
+                def add(self):
+                    with self._lock:
+                        self._bump()
+
+                def _bump(self):
+                    self._count += 1
+        """
+        assert CHECK_GUARDED_BY in checks_of(scan(src))
+
+    def test_condition_wrapping_the_lock_is_one_lock(self):
+        # device_plane idiom: self._freed = threading.Condition(self._lock)
+        # — holding either name holds the same underlying mutex
+        src = """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._freed = threading.Condition(self._lock)
+                    self._free = 0
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        self.release()
+
+                def acquire(self):
+                    with self._lock:
+                        self._free -= 1
+
+                def release(self):
+                    with self._freed:
+                        self._free += 1
+                        self._freed.notify()
+        """
+        assert scan(src) == []
+
+    def test_prestart_publication_is_silent(self):
+        # journal/file_server idiom: state written in start() BEFORE the
+        # thread constructor exists only single-threaded — publication,
+        # not a race
+        src = """
+            import threading
+
+            class Loader:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = []
+
+                def start(self):
+                    self._rows = ["seed"]
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._rows.append(1)
+                            snap = list(self._rows)
+        """
+        assert scan(src) == []
+
+    def test_poststart_publication_fires(self):
+        # ...but the same write AFTER the thread starts races with it
+        src = """
+            import threading
+
+            class Loader:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = []
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+                    self._rows = ["seed"]
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._rows.append(1)
+                            snap = list(self._rows)
+        """
+        assert CHECK_GUARDED_BY in checks_of(scan(src))
+
+    def test_gil_atomic_single_ops_are_silent(self):
+        # metrics/extension idiom: single-op dict store/get/pop sites are
+        # each one bytecode under the GIL — no lock needed until an
+        # iteration or read-modify-write enters the conflict set
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._entries = {}
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        self.put("k", 1)
+
+                def put(self, key, value):
+                    self._entries[key] = value
+
+                def get(self, key):
+                    return self._entries.get(key)
+
+                def forget(self, key):
+                    self._entries.pop(key, None)
+
+                def size(self):
+                    with self._lock:
+                        return len(self._entries)
+        """
+        assert scan(src) == []
+
+    def test_iterating_read_turns_single_ops_into_a_race(self):
+        # adding one unlocked iteration over the same dict must fire
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._entries = {}
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     name="worker-0").start()
+
+                def _run(self):
+                    while True:
+                        self.put("k", 1)
+
+                def put(self, key, value):
+                    self._entries[key] = value
+
+                def dump(self):
+                    with self._lock:
+                        pass
+                    return sorted(self._entries.values())
+        """
+        assert CHECK_GUARDED_BY in checks_of(scan(src))
+
+    def test_single_instance_input_loop_is_silent(self):
+        # one reader loop per input plugin instance: an unlocked += from
+        # the single input role cannot interleave with itself
+        src = """
+            import threading
+
+            class Reader:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._offset = 0
+
+                def start(self):
+                    threading.Thread(target=self._read_loop).start()
+
+                def _read_loop(self):
+                    while True:
+                        self._offset += 1
+
+                def position(self):
+                    with self._lock:
+                        return self._offset
+        """
+        assert scan(src, relpath="loongcollector_tpu/input/fixture.py") \
+            == []
+
+
+# ---------------------------------------------------------------------------
+# 3b. runtime regressions for the real-tree races raceguard found and
+# this PR fixed.  Each test exercises the FIXED code under contention.
+
+
+class TestFixedRacesRuntime:
+    def test_kafka_corr_ids_unique_under_contention(self):
+        # flusher/kafka_client.py: _corr += 1 from sender + main raced;
+        # duplicate correlation ids pair responses with wrong requests.
+        # _next_corr() must hand out distinct ids under contention.
+        from loongcollector_tpu.flusher.kafka_client import KafkaClient
+        client = KafkaClient(["broker:9092"])
+        out = []
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            ids = [client._next_corr() for _ in range(500)]
+            out.append(ids)
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = [i for ids in out for i in ids]
+        assert len(set(got)) == len(got) == 4000
+
+    def test_kafka_close_snapshots_connections(self):
+        # close() iterated _conns while _connect/_drop mutated it; the
+        # fix snapshots the address list under the lock first
+        from loongcollector_tpu.flusher.kafka_client import KafkaClient
+
+        class _Sock:
+            def __init__(self):
+                self.closed = 0
+
+            def close(self):
+                self.closed += 1
+
+        client = KafkaClient(["broker:9092"])
+        socks = {f"b{i}:9092": _Sock() for i in range(16)}
+        client._conns.update(socks)
+        errs = []
+        barrier = threading.Barrier(2)
+
+        def closer():
+            barrier.wait()
+            try:
+                client.close()
+            except Exception as exc:  # noqa: BLE001 — the assertion
+                errs.append(exc)
+
+        def dropper():
+            barrier.wait()
+            for addr in list(socks):
+                try:
+                    client._drop(addr)
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+        t1 = threading.Thread(target=closer)
+        t2 = threading.Thread(target=dropper)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert errs == []
+        assert client._conns == {}
+        assert all(s.closed >= 1 for s in socks.values())
+
+    def test_profiler_concurrent_stop_is_safe(self):
+        # prof/profiler.py: two stops raced between the None-check and
+        # the join; the fix claims the thread attr in one atomic swap
+        from loongcollector_tpu.prof.profiler import Profiler
+        prof = Profiler(hz=50)
+        prof.start()
+        errs = []
+        barrier = threading.Barrier(4)
+
+        def stopper():
+            barrier.wait()
+            try:
+                prof.stop()
+            except Exception as exc:  # noqa: BLE001 — the assertion
+                errs.append(exc)
+
+        threads = [threading.Thread(target=stopper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        assert prof._thread is None
+        prof.stop()     # and a later redundant stop stays a no-op
+
+    def test_timeout_flush_claim_has_single_winner(self, monkeypatch):
+        # runner/processor_runner.py: every worker shard compared
+        # last_flush against the interval unlocked, so several shards
+        # could claim the same interval and double-pump the flush
+        # manager.  The fix claims the interval under _flush_claim.
+        from loongcollector_tpu.runner import processor_runner as prmod
+        from loongcollector_tpu.runner.processor_runner import \
+            ProcessorRunner
+
+        flushes = []
+
+        class _FakeManager:
+            def flush_timeout_batches(self):
+                flushes.append(threading.get_ident())
+
+        class _FakeTuner:
+            def maybe_adjust(self):
+                pass
+
+        monkeypatch.setattr(prmod.TimeoutFlushManager, "instance",
+                            staticmethod(lambda: _FakeManager()))
+        monkeypatch.setattr(prmod, "auto_tuner", lambda: _FakeTuner())
+
+        runner = ProcessorRunner.__new__(ProcessorRunner)
+        runner.last_flush = 0.0     # interval long expired for everyone
+        runner._flush_claim = threading.Lock()
+
+        barrier = threading.Barrier(8)
+
+        def pump():
+            barrier.wait()
+            runner._pump_timeout_flush()
+
+        threads = [threading.Thread(target=pump) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(flushes) == 1, \
+            f"{len(flushes)} shards claimed one flush interval"
+        assert runner.last_flush > 0.0
+        # and the next interval is claimable again
+        runner.last_flush = 0.0
+        runner._pump_timeout_flush()
+        assert len(flushes) == 2

@@ -83,15 +83,16 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_twelve_checkers_registered(self):
+    def test_all_thirteen_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
                          "swallowed-fault", "unledgered-drop",
                          "metric-naming", "hot-path-materialize",
                          "per-row-parse", "unbounded-window",
-                         "host-bounce", "reload-unsafe"]
-        assert len(all_checkers()) == 12
+                         "host-bounce", "reload-unsafe",
+                         "raceguard-guarded-by"]
+        assert len(all_checkers()) == 13
 
 
 # ---------------------------------------------------------------------------
