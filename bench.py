@@ -2160,6 +2160,32 @@ def bench_resource():
     return json.loads(stdout)
 
 
+def bench_recovery():
+    """loongcrash: one kill-and-restart probe through the real agent
+    (scripts/crash_storm.py, seed 3 = SIGKILL at the send boundary) —
+    records how long the restarted agent took to recover, how much it
+    replayed, and how many duplicates the ack-to-crash window produced."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "crash_storm", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "crash_storm.py"))
+    storm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(storm)
+    res = storm.run_storm(3, n_lines=120)
+    return {
+        "recovery_wall_s": res["recovery_wall_s"],
+        "restart_to_converged_s": res["wall_s"],
+        "replayed_events": res["replay_duplicate_events"]
+        + res["duplicates_delivered"],
+        "duplicates_delivered": res["duplicates_delivered"],
+        "duplicates_suppressed": res["replay_duplicate_events"],
+        "recovered_from_buffer": res["recovered_events_total"],
+        "kill_point": f"{res['point']}:{res['nth']}",
+        "zero_loss": True,          # run_storm asserts it
+    }
+
+
 def _safe(fn, default=-1.0):
     """Sub-benchmarks must never take down the primary metric line."""
     try:
@@ -2345,6 +2371,11 @@ def main():
     res = _safe(bench_resource, default=None)
     if res is not None:
         extra["resource_10MBps"] = res
+    # loongcrash: kill-and-restart probe — recovery wall time, replayed
+    # events and the duplicate count from the ack-to-crash window
+    rec = _safe(bench_recovery, default=None)
+    if rec is not None:
+        extra["recovery"] = rec
     line = {
         "metric": "regex_parse_throughput",
         "value": round(mbps, 1),

@@ -43,7 +43,9 @@ log = get_logger("application")
 # process_thread_count is defined by runner.processor_runner (loongshard
 # default >1); app-config overrides still apply through the flag registry
 flags.DEFINE_FLAG_INT32("config_scan_interval", "config rescan seconds", 10)
-flags.DEFINE_FLAG_INT32("checkpoint_dump_interval", "checkpoint dump seconds", 5)
+# checkpoint_dump_interval is defined by input.file.file_server (the dump
+# cadence is the file server's knob); app-config overrides apply through
+# the flag registry as usual
 flags.DEFINE_FLAG_DOUBLE("exit_flush_timeout", "flush-out budget on exit (s)", 20.0)
 flags.DEFINE_FLAG_STRING("config_server_address", "remote ConfigServer endpoint", "")
 flags.DEFINE_FLAG_STRING("config_server_protocol",
@@ -166,6 +168,13 @@ class Application:
         # the crash backtrace so one directory holds the whole post-mortem
         from .prof import flight
         flight.set_dump_dir(self.data_dir)
+        # loongcrash: detect unclean shutdown, load the acked-span journal
+        # into the replay-duplicate window, sweep torn spill temps, and
+        # start journaling this run's acks — BEFORE any reader opens (the
+        # suppression window must be live when the first re-read arrives)
+        from . import recovery
+        recovery.begin(self.data_dir,
+                       os.path.join(self.data_dir, "buffer"))
         # loongfuse: fused multi-pattern automata persist under
         # <data_dir>/dfa_cache/ — restarts and pipeline hot-reloads load
         # the compiled DFA by pattern-set content hash instead of paying
@@ -353,6 +362,20 @@ class Application:
         prof.disable()                        # stop sampler, retire records
         from .pipeline.plugin.checkpoint import get_default_store
         get_default_store().flush()
+        # final checkpoint dump AFTER the flusher drain: FileServer.stop
+        # dumped before the send path quiesced, so the watermark on disk is
+        # stale by every ack the drain just completed — without this dump a
+        # clean restart would re-read (and have to dedup) the whole window
+        fs = FileServer.instance()
+        if fs.checkpoints.path:
+            try:
+                fs.checkpoints.dump()
+            except OSError:
+                log.exception("final checkpoint dump failed")
+        # everything drained and dumped: compact the ack journal and drop
+        # the crash marker — the next start is a clean start
+        from . import recovery
+        recovery.mark_clean_exit()
         log.info("exit complete")
 
     def _replay_exactly_once(self) -> None:

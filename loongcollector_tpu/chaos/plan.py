@@ -29,8 +29,14 @@ ACTION_ERROR = "error"      # raise the site's typed fault
 ACTION_DELAY = "delay"      # sleep in-line (slow network / device)
 ACTION_PARTIAL = "partial"  # partial ack: the site delivers a prefix only
 ACTION_CORRUPT = "corrupt"  # corrupt-at-rest: the site garbles its output
+ACTION_CRASH = "crash"      # process.crash: SIGKILL the process, no drain
 
+# crash is deliberately NOT in ALL_ACTIONS: specs built with
+# kinds=ALL_ACTIONS storm recoverable faults, and a probabilistic draw
+# must never SIGKILL the process — crash only fires via at_hits arming
 ALL_ACTIONS = (ACTION_ERROR, ACTION_DELAY, ACTION_PARTIAL, ACTION_CORRUPT)
+
+_VALID_ACTIONS = ALL_ACTIONS + (ACTION_CRASH,)
 
 
 class FaultSpec:
@@ -43,23 +49,29 @@ class FaultSpec:
                  "clears", letting recovery invariants be asserted);
                  None = never clears
     after_hits   first hits never fault (lets a system warm up)
+    at_hits      exact 0-based hit numbers that fault DETERMINISTICALLY
+                 (prob plays no part) with the FIRST action in `kinds` —
+                 the process.crash family: "SIGKILL at the 3rd spill"
     """
 
-    __slots__ = ("prob", "kinds", "delay_range", "max_faults", "after_hits")
+    __slots__ = ("prob", "kinds", "delay_range", "max_faults", "after_hits",
+                 "at_hits")
 
     def __init__(self, prob: float = 0.25,
                  kinds: Sequence[str] = (ACTION_ERROR,),
                  delay_range: Tuple[float, float] = (0.001, 0.02),
                  max_faults: Optional[int] = None,
-                 after_hits: int = 0):
+                 after_hits: int = 0,
+                 at_hits: Sequence[int] = ()):
         for k in kinds:
-            if k not in ALL_ACTIONS:
+            if k not in _VALID_ACTIONS:
                 raise ValueError(f"unknown fault action {k!r}")
         self.prob = float(prob)
         self.kinds = tuple(kinds)
         self.delay_range = (float(delay_range[0]), float(delay_range[1]))
         self.max_faults = max_faults
         self.after_hits = int(after_hits)
+        self.at_hits = frozenset(int(h) for h in at_hits)
 
 
 class Decision:
@@ -116,6 +128,15 @@ class ChaosPlan:
             prob=prob, kinds=(ACTION_ERROR, ACTION_DELAY),
             max_faults=max_faults)})
 
+    def crash(self, point: str, nth: int) -> "ChaosPlan":
+        """Arm the process.crash family: SIGKILL this process at the
+        `nth` (0-based) hit of `point`.  Exact-name rules override any
+        pattern rule, so a crash can ride on top of a default() storm.
+        Returns self for chaining."""
+        self.rules[point] = FaultSpec(prob=0.0, kinds=(ACTION_CRASH,),
+                                      at_hits=(nth,))
+        return self
+
     def spec_for(self, point: str) -> Optional[FaultSpec]:
         spec = self.rules.get(point)
         if spec is not None:
@@ -151,15 +172,24 @@ class ChaosPlan:
         kind_roll = rng.random()
         delay_roll = rng.random()
         magnitude = rng.random()
+        if hit in spec.at_hits:
+            # deterministic scheduled fault (process.crash): probability
+            # plays no part, the first kind is the armed action
+            self._faults_injected[point] = \
+                self._faults_injected.get(point, 0) + 1
+            return Decision(point, hit, spec.kinds[0], 0.0, magnitude)
         if hit < spec.after_hits or roll >= spec.prob:
             return None
         if spec.max_faults is not None and \
                 self._faults_injected.get(point, 0) >= spec.max_faults:
             return None
+        # crash never rides the probability roll — at_hits only (above)
+        kinds = tuple(k for k in spec.kinds if k != ACTION_CRASH)
+        if not kinds:
+            return None
         self._faults_injected[point] = \
             self._faults_injected.get(point, 0) + 1
-        action = spec.kinds[int(kind_roll * len(spec.kinds))
-                            % len(spec.kinds)]
+        action = kinds[int(kind_roll * len(kinds)) % len(kinds)]
         lo, hi = spec.delay_range
         delay_s = lo + (hi - lo) * delay_roll
         return Decision(point, hit, action, delay_s, magnitude)

@@ -27,10 +27,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
-from .plan import (ACTION_CORRUPT, ACTION_DELAY, ACTION_ERROR,
+from .plan import (ACTION_CORRUPT, ACTION_CRASH, ACTION_DELAY, ACTION_ERROR,
                    ACTION_PARTIAL, ChaosFault, ChaosPlan, Decision)
 
 ENV_SEED = "LOONG_CHAOS_SEED"
+ENV_CRASH = "LOONG_CHAOS_CRASH"   # "point:nth" — SIGKILL at hit nth of point
 
 _SCHEDULE_CAP = 100_000   # injected-fault log bound (soaks stay well under)
 
@@ -109,16 +110,32 @@ def active(plan: ChaosPlan):
 
 
 def install_from_env(env=os.environ) -> bool:
-    """Install ChaosPlan.default(seed) when LOONG_CHAOS_SEED is set.
+    """Install ChaosPlan.default(seed) when LOONG_CHAOS_SEED is set, and
+    arm the process.crash family when LOONG_CHAOS_CRASH="point:nth" is set
+    (with or without a seed storm — the crash harness usually wants ONLY
+    the kill, an exact-name rule riding an otherwise silent plan).
     Called once at application start; returns True when chaos went live."""
     raw = env.get(ENV_SEED)
-    if not raw:
+    crash_raw = env.get(ENV_CRASH)
+    plan: Optional[ChaosPlan] = None
+    if raw:
+        try:
+            plan = ChaosPlan.default(int(raw))
+        except ValueError:
+            plan = None
+    if crash_raw:
+        point, sep, nth = crash_raw.rpartition(":")
+        try:
+            if not sep:
+                raise ValueError(crash_raw)
+            if plan is None:
+                plan = ChaosPlan(0, {})
+            plan.crash(point, int(nth))
+        except ValueError:
+            pass
+    if plan is None:
         return False
-    try:
-        seed = int(raw)
-    except ValueError:
-        return False
-    install(ChaosPlan.default(seed))
+    install(plan)
     return True
 
 
@@ -194,6 +211,13 @@ def faultpoint(name: str, exc: Optional[type] = None,
     from ..prof import flight
     flight.record("chaos.inject", point=name, hit=decision.hit,
                   action=decision.action)
+    if decision.action == ACTION_CRASH:
+        # process.crash: die the way a real crash dies — SIGKILL, no
+        # drain, no flight dump, no atexit.  Anything recovery needs must
+        # already be durable; flushing state here would make the harness
+        # kinder than reality
+        os.kill(os.getpid(), 9)
+        time.sleep(60)    # SIGKILL is asynchronous; never fall through
     if decision.action == ACTION_DELAY:
         time.sleep(decision.delay_s)
         return None

@@ -32,6 +32,7 @@ from ..models import PipelineEventGroup
 from ..monitor import ledger
 from ..pipeline.plugin.interface import PluginContext
 from ..pipeline.queue.sender_queue import SenderQueueItem
+from ..runner import ack_watermark
 from ..runner.circuit import BreakerState, SinkCircuitBreaker
 from ..utils.logger import get_logger
 from .http_base import HttpSinkFlusher
@@ -124,11 +125,12 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         if ledger.is_on():
             ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
                           n_events, len(body))
+        spans = ack_watermark.spans_of(groups)
         shed = None
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
                 shed = self._queue.popleft()      # oldest-first shedding
-            self._queue.append((body, time.monotonic(), n_events))
+            self._queue.append((body, time.monotonic(), n_events, spans))
             self._qcv.notify()
         if shed is not None:
             # ledger + log OUTSIDE the queue lock (the ledger takes its
@@ -139,6 +141,7 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             log.error("%s queue full; dropping oldest payload (%d bytes)",
                       self.name, len(shed[0]))
             self._ledger_drop("queue_shed", shed[2], len(shed[0]))
+            ack_watermark.ack_spans(shed[3])    # terminal for this copy
 
     def _requeue_payload(self, body: bytes, event_cnt: int = 0) -> bool:
         """Replayed disk-buffer payload re-enters the send queue with a
@@ -149,7 +152,8 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
                 return False
-            self._queue.append((body, time.monotonic(), event_cnt))
+            # replayed payloads carry no spans: their spill already acked
+            self._queue.append((body, time.monotonic(), event_cnt, ()))
             self._qcv.notify()
             return True
 
@@ -178,15 +182,16 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 # CONSERVATION_RESIDUAL alarm)
                 entry = self._queue.popleft()
                 self._spilling_events += entry[2]
-            body, born, events = entry
+            body, born, events, spans = entry
             item = SenderQueueItem(body, len(body), flusher=self,
                                    queue_key=self.queue_key,
-                                   event_cnt=events)
+                                   event_cnt=events, spans=spans)
             if not self.disk_buffer.spill(item, identity):
                 with self._qcv:
                     self._queue.appendleft(entry)   # buffer full: restore
                     self._spilling_events -= events
                 break
+            ack_watermark.ack_spans(spans)    # durable spill = terminal
             with self._qcv:
                 # B_SPILL was recorded inside spill() — the terminal is on
                 # the books before the occupancy anchor drops
@@ -245,7 +250,7 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 if not self._queue:
                     continue
                 item = self._queue[0]
-                body, born, n_events = item
+                body, born, n_events, spans = item
             if self.breaker is not None and not self.breaker.allow():
                 time.sleep(min(delay, 1.0))
                 continue
@@ -310,13 +315,18 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             # it already recorded drop(queue_shed) — recording send_ok too
             # would double-count the same events (negative residual, false
             # CONSERVATION_RESIDUAL alarm)
-            if owned and ledger.is_on():
-                if ok:
-                    ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
-                                  n_events, len(body))
-                else:   # ok is None — permanent, reason-tagged discard
-                    ledger.record(self._ledger_pipeline(), ledger.B_DROP,
-                                  n_events, len(body), tag="delivery_failed")
+            if owned:
+                # delivered OR permanently discarded: terminal for the
+                # SOURCE spans — the checkpoint watermark advances
+                ack_watermark.ack_spans(spans)
+                if ledger.is_on():
+                    if ok:
+                        ledger.record(self._ledger_pipeline(),
+                                      ledger.B_SEND_OK, n_events, len(body))
+                    else:   # ok is None — permanent, reason-tagged discard
+                        ledger.record(self._ledger_pipeline(), ledger.B_DROP,
+                                      n_events, len(body),
+                                      tag="delivery_failed")
 
     def inflight_events(self) -> int:
         """Events queued inside this sink's own sender hop (the payload

@@ -1,20 +1,30 @@
-"""File read checkpoints (v1): JSON dump of per-file offsets.
+"""File read checkpoints: JSON dump of per-file offsets.
 
 Reference: core/file_server/checkpoint/CheckPointManager.{h,cpp} (h:99-140) —
 entries are keyed by DevInode (not path), carrying path + signature + offset,
 dumped periodically (application/Application.cpp:384) and restored on start.
 Keying by (dev, inode) is what makes rename+recreate rotation safe: the
 rotated reader and the new reader at the same path own distinct entries.
+
+v3 (loongcrash): `offset` is the *durable* offset — the acked-bytes
+low-watermark from runner/ack_watermark.py for file-server-registered
+sources, the read offset for everything else — and `read_offset` records
+where reading actually stood (rotation/backlog introspection).  Restoring
+seeks to `offset`, so a crash re-reads exactly the unacked window:
+at-least-once, never loss.  v1/v2 files load unchanged (offset doubles as
+read_offset).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import tempfile
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ...runner import ack_watermark
 from .reader import ReaderCheckpoint
 
 
@@ -24,6 +34,7 @@ class CheckPointManager:
         self._checkpoints: Dict[Tuple[int, int], ReaderCheckpoint] = {}
         self._lock = threading.Lock()
         self.last_dump = 0.0
+        self.quarantined_loads = 0
 
     @staticmethod
     def _key(cp: ReaderCheckpoint) -> Tuple[int, int]:
@@ -57,22 +68,40 @@ class CheckPointManager:
         if not self.path:
             return
         with self._lock:
-            data = {
-                "version": 2,
-                "check_point": {
-                    f"{dev}:{ino}": {
-                        "path": cp.path, "offset": cp.offset,
-                        "dev": cp.dev, "inode": cp.inode,
-                        "sig": cp.signature, "sig_size": cp.signature_size,
-                        "update_time": cp.update_time,
-                    } for (dev, ino), cp in self._checkpoints.items()
-                },
-            }
-        tmp = self.path + ".tmp"
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, self.path)
+            entries = {}
+            for (dev, ino), cp in self._checkpoints.items():
+                # the persisted offset is the acked-bytes low-watermark for
+                # sources the file server registered; bare readers fall back
+                # to the read offset (seed semantics) inside durable_offset
+                durable = ack_watermark.durable_offset(dev, ino, cp.offset)
+                entries[f"{dev}:{ino}"] = {
+                    "path": cp.path, "offset": durable,
+                    "read_offset": cp.offset,
+                    "dev": cp.dev, "inode": cp.inode,
+                    "sig": cp.signature, "sig_size": cp.signature_size,
+                    "update_time": cp.update_time,
+                }
+            data = {"version": 3, "check_point": entries}
+        dirname = os.path.dirname(self.path) or "."
+        os.makedirs(dirname, exist_ok=True)
+        # unique tmp per dumper (concurrent dumps can't truncate each
+        # other's file mid-write) + fsync before the atomic swap: a crash
+        # right after dump() must find either the old or the new file,
+        # never a torn one — this file is what recovery resumes from
+        fd, tmp = tempfile.mkstemp(prefix=".checkpoint-", suffix=".tmp",
+                                   dir=dirname)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         self.last_dump = time.monotonic()
 
     def load(self) -> None:
@@ -81,7 +110,10 @@ class CheckPointManager:
         try:
             with open(self.path) as f:
                 data = json.load(f)
-        except (OSError, ValueError):
+            if not isinstance(data, dict):
+                raise ValueError("checkpoint root is not an object")
+        except (OSError, ValueError) as e:
+            self._quarantine(e)
             return
         version = data.get("version", 1)
         with self._lock:
@@ -95,6 +127,24 @@ class CheckPointManager:
                     signature_size=d.get("sig_size", 0),
                     update_time=d.get("update_time", 0.0))
                 self._checkpoints[self._key(cp)] = cp
+
+    def _quarantine(self, err: Exception) -> None:
+        """Corrupt/torn checkpoint: preserve the evidence as `.bad` (the
+        next dump recreates the real file), alarm, and count — a silent
+        restart-from-zero with no trace is how loss hides."""
+        from ...monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+        bad = self.path + ".bad"
+        try:
+            os.replace(self.path, bad)
+        except OSError:
+            bad = "<unlinkable>"
+        self.quarantined_loads += 1
+        # what is discarded here is a metadata file, not events — the
+        # events re-read from offset 0 and re-enter the ledger normally
+        AlarmManager.instance().send_alarm(  # loonglint: disable=unledgered-drop
+            AlarmType.CHECKPOINT_FAIL,
+            f"corrupt checkpoint file quarantined to {bad}: {err}",
+            AlarmLevel.ERROR)
 
     def dump_periodically(self, interval: float = 5.0) -> None:
         if time.monotonic() - self.last_dump >= interval:

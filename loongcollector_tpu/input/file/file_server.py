@@ -17,7 +17,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ... import recovery
 from ...monitor.alarms import AlarmLevel, AlarmManager, AlarmType
+from ...runner import ack_watermark
 from ...utils import flags
 from ...utils.logger import get_logger
 from .checkpoint import CheckPointManager
@@ -39,6 +41,8 @@ flags.DEFINE_FLAG_INT32("read_delay_alarm_duration",
                         "seconds between repeated read-delay alarms", 60)
 flags.DEFINE_FLAG_INT32("max_file_reader_num",
                         "max simultaneously open log readers", 512)
+flags.DEFINE_FLAG_INT32("checkpoint_dump_interval",
+                        "checkpoint dump seconds", 5)
 IDLE_SLEEP_S = 0.05
 # with inotify the thread sleeps ON the fd, so the poll interval can relax:
 # events wake it instantly and polling is only the discovery/rotation net
@@ -204,7 +208,8 @@ class FileServer:
                 continue
             try:
                 busy = self._round()
-                self.checkpoints.dump_periodically()
+                self.checkpoints.dump_periodically(
+                    float(flags.get_flag("checkpoint_dump_interval")))
             except Exception:  # noqa: BLE001 - never kill the event thread
                 log.exception("file server round failed")
                 busy = False
@@ -306,11 +311,17 @@ class FileServer:
                     st.pending.discard(r.path)
             for r in list(st.rotated):
                 busy |= self._drain_reader(st, r, force_flush=True)
-                if not r.has_more():
-                    # remove only this reader's own inode entry — the live
+                if not r.has_more() and ack_watermark.fully_acked(
+                        r.dev_inode.dev, r.dev_inode.inode):
+                    # fully read AND every span terminally acked: only now
+                    # may the inode's books close — dropping the checkpoint
+                    # with spans still in flight would lose them on a crash.
+                    # Remove only this reader's own inode entry — the live
                     # reader at the same path owns a different (dev, inode)
                     self.checkpoints.remove(r.dev_inode.dev,
                                             r.dev_inode.inode)
+                    ack_watermark.tracker().forget(r.dev_inode.dev,
+                                                   r.dev_inode.inode)
                     r.close()
                     st.rotated.remove(r)
             if self._listener is not None:
@@ -443,6 +454,10 @@ class FileServer:
                 r.offset = os.fstat(r._fd).st_size
             except OSError:
                 pass
+        # from here this source's checkpoint dumps use the ACKED frontier,
+        # not the read offset (loongcrash at-least-once contract)
+        ack_watermark.register_source(r.dev_inode.dev, r.dev_inode.inode,
+                                      r.offset)
         st.readers[path] = r
 
     def _drain_reader(self, st: _ConfigState, reader: LogFileReader,
@@ -461,6 +476,13 @@ class FileServer:
                 break  # reader closed concurrently (config removal)
             if group is None or not reader.is_open:
                 break
+            if recovery.suppress_duplicate(group):
+                # previous run already delivered this exact span (acked
+                # after the last checkpoint dump): count it, advance the
+                # books, and never let it re-enter the pipeline
+                moved = True
+                self.checkpoints.update(reader.checkpoint())
+                continue
             if st.tag_provider is not None:
                 try:
                     tags = st.tag_provider(reader.path)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import re
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
@@ -29,6 +30,7 @@ import numpy as np
 from ... import chaos, trace
 from ...models import (ColumnarLogs, EventGroupMetaKey, PipelineEventGroup,
                        SourceBuffer, columnar_enabled)
+from ...runner import ack_watermark
 
 DEFAULT_CHUNK = 512 * 1024
 SIGNATURE_SIZE = 1024
@@ -280,12 +282,16 @@ class LogFileReader:
         if partial_tail or force_flush:
             self._ml_hold_size = -1
         read_offset = self.offset
+        src = aligned    # pre-transcode SOURCE bytes — what the crc covers
         if self.encoding == "gbk":
             aligned, consumed_src = self._transcode_gbk(aligned, force_flush)
             if not aligned:
                 return None
         else:
             consumed_src = len(aligned)
+        # crc of the consumed source span: loongcrash replay dedup verifies
+        # re-read content identity, not just [offset, length) containment
+        span_crc = zlib.crc32(src[:consumed_src])
         # snapshot for rollback_last(): a rejected queue push must restore
         # BOTH the offset and the multiline stitch state, or the re-read
         # chunk ships without its ML_CONTINUE marker
@@ -329,6 +335,11 @@ class LogFileReader:
         # exactly-once ranges and back-pressure rollback index the raw file
         group.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH,
                            str(consumed_src))
+        group.set_metadata(EventGroupMetaKey.LOG_FILE_CRC32, str(span_crc))
+        # the span is now in flight: the acked-offset watermark owes it a
+        # terminal ack before the checkpoint may advance past it
+        ack_watermark.note_read(self.dev_inode.dev, self.dev_inode.inode,
+                                read_offset, consumed_src, span_crc)
         # stitch markers for split_multiline's cross-group carry: this chunk
         # ends mid-record / continues the previous chunk's open record
         if partial_tail:

@@ -102,6 +102,9 @@ class EventGroupMetaKey(enum.Enum):
     ML_CONTINUE = "log.file.ml_continue"
     LOG_FILE_OFFSET = "log.file.offset"
     LOG_FILE_LENGTH = "log.file.length"
+    # crc32 of the SOURCE byte-span [offset, offset+length) — loongcrash
+    # replay dedup verifies content identity, not just span containment
+    LOG_FILE_CRC32 = "log.file.crc32"
     IS_REPLAY = "internal.is.replay"
     SOURCE_ID = "source_id"
     TOPIC = "topic"

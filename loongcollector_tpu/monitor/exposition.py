@@ -332,6 +332,13 @@ def collect_status() -> dict:
                            "samples": p.samples_total() if p else 0}
     except Exception:  # noqa: BLE001
         pass
+    try:
+        from .. import recovery as _recovery
+        rdoc = _recovery.status()
+        if rdoc is not None:
+            doc["recovery"] = rdoc
+    except Exception:  # noqa: BLE001
+        pass
     return doc
 
 

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 from ..models import PipelineEventGroup
 from ..monitor import ledger
 from ..monitor.metrics import MetricsRecord
+from ..runner import ack_watermark
 from ..utils.logger import get_logger
 from .plugin.instance import FlusherInstance, InputInstance, ProcessorInstance
 from .plugin.interface import PluginContext
@@ -432,6 +433,13 @@ class CollectionPipeline:
             staged: List[PipelineEventGroup] = []
             for g in groups:
                 staged.extend(self.aggregator.add(g))
+            # groups the aggregator absorbed (folded into rollup state, not
+            # passed through) lose span identity here: force-ack so their
+            # SOURCE bytes never pin the checkpoint watermark
+            staged_ids = {id(s) for s in staged}
+            consumed = [g for g in groups if id(g) not in staged_ids]
+            if consumed:
+                ack_watermark.ack_groups(consumed, force=True)
             groups = staged
             if led and not getattr(self.aggregator,
                                    "ledger_self_accounting", False):
@@ -451,20 +459,27 @@ class CollectionPipeline:
         ok = True
         for group in groups:
             if group.empty():
+                # filtered to nothing: terminal for its SOURCE span
+                ack_watermark.ack_groups([group], force=True)
                 continue
             ok = self._route_group(group, led) and ok
         return ok
 
     def _route_group(self, group: PipelineEventGroup, led: bool) -> bool:
         idxs = self.router.route(group)
-        if led:
-            if not idxs:
-                # no flusher matched: the group is terminally discarded
+        if not idxs:
+            # no flusher matched: the group is terminally discarded
+            ack_watermark.ack_groups([group], force=True)
+            if led:
                 ledger.record(self.name, ledger.B_DROP, len(group),
                               group.data_size(), tag="no_route")
-            elif len(idxs) > 1:
-                # every extra matching flusher mints a copy of the group's
-                # events — a conservation source, or send_ok would overrun
+        elif len(idxs) > 1:
+            # every extra matching flusher mints a copy of the group's
+            # events — raise the span's terminal refcount BEFORE any copy
+            # can ack, or a fast first sink advances the watermark while
+            # the second copy is still in flight
+            ack_watermark.note_fanout(group, len(idxs))
+            if led:
                 ledger.record(self.name, ledger.B_FANOUT,
                               (len(idxs) - 1) * len(group))
         ok = True
@@ -477,6 +492,7 @@ class CollectionPipeline:
         self_acct = getattr(self.aggregator, "ledger_self_accounting", False)
         for group in groups:
             if group.empty():
+                ack_watermark.ack_groups([group], force=True)
                 continue
             if led:
                 if not self_acct:

@@ -14,6 +14,7 @@ from ... import prof, trace
 from ...models import PipelineEventGroup, columnar_enabled
 from ...monitor import ledger
 from ...monitor.metrics import MetricsRecord
+from ...runner import ack_watermark
 from .interface import Flusher, Input, PluginContext, Processor
 
 
@@ -215,6 +216,10 @@ class FlusherInstance:
         try:
             result = self.plugin.send(group)
             ok = True
+            if getattr(self.plugin, "ledger_terminal", False):
+                # delivery (or refusal) completed inside send(): terminal
+                # for the SOURCE span regardless of ledger state
+                ack_watermark.ack_groups([group])
             if ledger.is_on() and self.plugin.ledger_terminal:
                 # inline-terminal sink: delivery completed (or was refused)
                 # inside send() itself — ledger it here, once, centrally

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional
 
 from ...models import PipelineEventGroup
 from ...monitor import ledger
+from ...runner import ack_watermark
 
 
 class PluginContext:
@@ -165,6 +166,10 @@ class Flusher(Plugin):
         discards — the shared shape of the B_DROP boilerplate.  Pass
         ``group`` to defer the O(events) count/size work until the ledger
         is confirmed on (the disabled-hook idiom)."""
+        if group is not None:
+            # a reasoned discard is terminal for the SOURCE span too: the
+            # checkpoint watermark must advance past it (ledger on or off)
+            ack_watermark.ack_groups([group], force=True)
         if not ledger.is_on():
             return
         if group is not None:
@@ -195,10 +200,15 @@ class Flusher(Plugin):
             get_logger("flusher").exception(
                 "%s flush write failed; %d events dropped", self.name,
                 sum(len(g) for g in groups))
+            # terminal either way (nothing upstream retries a failed
+            # write): the SOURCE spans are done — ack so the checkpoint
+            # can advance instead of pinning on a dead batch
+            ack_watermark.ack_groups(groups)
             if led:
                 ledger.record(self._ledger_pipeline(), ledger.B_DROP,
                               n_events, n_bytes, tag="flush_write_failed")
             return False
+        ack_watermark.ack_groups(groups)
         if led:
             ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
                           n_events, n_bytes, tag=self.name)

@@ -26,11 +26,11 @@ class SendingStatus(enum.Enum):
 class SenderQueueItem:
     __slots__ = ("data", "raw_size", "flusher", "queue_key", "status",
                  "enqueue_time", "try_count", "last_send_time", "tag",
-                 "in_flight", "event_cnt")
+                 "in_flight", "event_cnt", "spans")
 
     def __init__(self, data: bytes, raw_size: int, flusher=None,
                  queue_key: int = 0, tag: Optional[dict] = None,
-                 event_cnt: int = 0):
+                 event_cnt: int = 0, spans: tuple = ()):
         self.data = data
         self.raw_size = raw_size
         self.flusher = flusher
@@ -46,6 +46,11 @@ class SenderQueueItem:
         # send_ok/spill boundaries in event units (0 = unknown provenance,
         # e.g. a pre-ledger disk-buffer file; ledgers as 0 on both sides)
         self.event_cnt = event_cnt
+        # loongcrash: SOURCE (dev, inode, offset, length) spans this payload
+        # carries — the terminal boundary (send_ok / durable spill / tagged
+        # drop) acks them into the checkpoint watermark; () = no file
+        # provenance (http input, replay) and nothing to ack
+        self.spans = spans
 
 
 class SenderQueue:

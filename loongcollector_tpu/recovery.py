@@ -1,0 +1,385 @@
+"""loongcrash recovery manager: detect unclean shutdown, orchestrate the
+restart, and suppress the ack-to-crash duplicate window.
+
+A sentinel marker is written at startup and removed only by a clean exit:
+finding it at the NEXT start proves the previous process died without its
+drain (SIGKILL, OOM, power).  Recovery then
+
+  1. loads the ack journal (runner/ack_watermark.py) into a per-source
+     duplicate window — spans the previous run ACKED but whose checkpoint
+     dump never caught up.  The file server consults `suppress_duplicate`
+     on every fresh read: a re-read of an already-delivered span is
+     counted (`replay_duplicate_events`) and dropped BEFORE ingest, so
+     the at-least-once re-read window produces bounded duplicates at the
+     sink and zero ledger noise;
+  2. sweeps torn disk-buffer temp files (`*.tmp` strays a crash left
+     mid-spill — the committed `.lcb` rename is atomic, the tmp is junk);
+  3. counts the events waiting in committed spill files (they replay via
+     the normal DiskBufferWriter path) as `recovered_events_total`;
+  4. surfaces the previous run's flight dump path, so the post-mortem
+     (what the process was doing when it died) is one click away.
+
+`/debug/status` gets a `recovery` section; counters also export through
+monitor/metrics (category "agent", component "recovery").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .runner import ack_watermark
+from .utils.logger import get_logger
+
+log = get_logger("recovery")
+
+MARKER_NAME = "unclean.marker"
+STATE_NAME = "recovery_state.json"
+JOURNAL_NAME = "ack_journal.jsonl"
+
+# duplicate-window bound: per-source acked spans kept for suppression; a
+# window this size covers any realistic ack-to-dump gap (dump cadence is
+# seconds) while bounding recovery memory on a huge stale journal
+MAX_WINDOW_SPANS = 65536
+
+
+class _Window:
+    """Acked intervals of one (dev, inode) from the previous run's journal:
+    merged [start, end) list for containment, plus exact-span crcs for the
+    strong (byte-verified) match."""
+
+    __slots__ = ("ivals", "crcs")
+
+    def __init__(self) -> None:
+        self.ivals: List[List[int]] = []
+        self.crcs: Dict[Tuple[int, int], int] = {}
+
+    def add(self, off: int, length: int, crc: int) -> None:
+        if length <= 0:
+            return
+        self.crcs[(off, length)] = crc
+        start, end = off, off + length
+        iv = self.ivals
+        lo = 0
+        while lo < len(iv) and iv[lo][1] < start:
+            lo += 1
+        hi = lo
+        while hi < len(iv) and iv[hi][0] <= end:
+            start = min(start, iv[hi][0])
+            end = max(end, iv[hi][1])
+            hi += 1
+        iv[lo:hi] = [[start, end]]
+
+    def covers(self, off: int, length: int, crc: int) -> bool:
+        exact = self.crcs.get((off, length))
+        if exact is not None:
+            # byte-verified when both sides carry a crc; a mismatch means
+            # the file changed under the same offsets — deliver, don't drop
+            return not (exact and crc and exact != crc)
+        end = off + length
+        for start, stop in self.ivals:
+            if start <= off and end <= stop:
+                return True
+            if start > off:
+                break
+        return False
+
+
+class RecoveryManager:
+    def __init__(self, data_dir: str, buffer_dir: str = "") -> None:
+        self.data_dir = data_dir
+        self.buffer_dir = buffer_dir or os.path.join(data_dir, "buffer")
+        self.marker_path = os.path.join(data_dir, MARKER_NAME)
+        self.state_path = os.path.join(data_dir, STATE_NAME)
+        self.journal_path = os.path.join(data_dir, JOURNAL_NAME)
+        self.unclean = False
+        self.unclean_shutdown_total = 0
+        self.recovered_events_total = 0
+        self.replay_duplicate_events = 0
+        self.replay_duplicate_spans = 0
+        self.torn_spills_removed = 0
+        self.window_spans = 0
+        self.flight_dump: Optional[str] = None
+        self.recovery_wall_s = 0.0
+        self._windows: Dict[Tuple[int, int], _Window] = {}
+        self._lock = threading.Lock()
+        self._metrics = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def begin(self) -> None:
+        t0 = time.monotonic()
+        self.unclean = os.path.exists(self.marker_path)
+        self._load_state()
+        if self.unclean:
+            self.unclean_shutdown_total += 1
+            self._save_state()
+            self.flight_dump = self._find_flight_dump()
+            log.warning(
+                "unclean shutdown detected (marker %s); total=%d%s",
+                self.marker_path, self.unclean_shutdown_total,
+                f"; previous flight dump: {self.flight_dump}"
+                if self.flight_dump else "")
+            from .monitor.alarms import (AlarmLevel, AlarmManager, AlarmType)
+            AlarmManager.instance().send_alarm(
+                AlarmType.AGENT_RESTART,
+                "unclean shutdown: recovering from acked-offset checkpoints"
+                + (f" (flight dump: {self.flight_dump})"
+                   if self.flight_dump else ""),
+                AlarmLevel.ERROR)
+        self._load_window()
+        self._sweep_torn_spills()
+        self._count_buffered_events()
+        # the tracker journals future acks into the SAME file the window
+        # was loaded from: a second crash inside this run keeps both the
+        # old window's tail and this run's acks
+        ack_watermark.tracker().attach_journal(self.journal_path)
+        self._write_marker()
+        self.recovery_wall_s = time.monotonic() - t0
+        self._export_metrics()
+
+    def mark_clean_exit(self) -> None:
+        """Clean drain finished: compact the journal down to the live
+        window and drop the sentinel — the next start is a clean start."""
+        ack_watermark.tracker().compact_journal()
+        try:
+            os.unlink(self.marker_path)
+        except OSError:
+            pass
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            m, self._metrics = self._metrics, None
+        if m is not None:
+            m.mark_deleted()
+
+    # -- duplicate suppression -----------------------------------------------
+
+    def suppress_duplicate(self, group) -> bool:
+        """True ⇒ this freshly-read group's SOURCE span was fully acked by
+        the previous run — count it and drop it before ingest.  Called by
+        the file server on the read path; empty-window fast path is one
+        dict check."""
+        if not self._windows:
+            return False
+        span = ack_watermark.span_of(group)
+        if span is None:
+            return False
+        dev, ino, off, length = span
+        win = self._windows.get((dev, ino))
+        if win is None:
+            return False
+        crc = 0
+        raw = group.get_metadata(_CRC_KEY)
+        if raw is not None:
+            try:
+                crc = int(str(raw))
+            except ValueError:
+                crc = 0
+        if not win.covers(off, length, crc):
+            return False
+        with self._lock:
+            self.replay_duplicate_events += len(group)
+            self.replay_duplicate_spans += 1
+        if self._metrics is not None:
+            self._metrics.counter("replay_duplicate_events").add(len(group))
+        # the span is already delivered: fold it into the watermark so the
+        # checkpoint advances past it (and the journal re-records it for a
+        # second crash inside this run)
+        ack_watermark.ack_spans([span], force=True)
+        return True
+
+    # -- internals -----------------------------------------------------------
+
+    def _write_marker(self) -> None:
+        try:
+            with open(self.marker_path, "w") as f:
+                f.write(json.dumps({"pid": os.getpid(),
+                                    "start_time": time.time()}))
+                f.flush()
+        except OSError:
+            log.exception("cannot write crash marker %s", self.marker_path)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self.state_path) as f:
+                st = json.load(f)
+            self.unclean_shutdown_total = int(
+                st.get("unclean_shutdown_total", 0))
+        except (OSError, ValueError):
+            self.unclean_shutdown_total = 0
+
+    def _save_state(self) -> None:
+        try:
+            with open(self.state_path, "w") as f:
+                json.dump({"unclean_shutdown_total":
+                           self.unclean_shutdown_total}, f)
+        except OSError:
+            pass
+
+    def _load_window(self) -> None:
+        """Journal → per-source duplicate windows.  Loaded on every start
+        (not only unclean ones): after a clean exit the compacted journal
+        holds exactly the spans above the last checkpoint dump, and
+        suppressing those re-reads is what keeps a clean restart
+        duplicate-free even though the dump ran before the final drain."""
+        try:
+            with open(self.journal_path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        n = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                dev, ino = int(rec["d"]), int(rec["i"])
+                off, length = int(rec["o"]), int(rec["l"])
+                crc = int(rec.get("c", 0))
+            except (ValueError, KeyError, TypeError):
+                continue    # torn tail line (crash mid-append): ignore
+            win = self._windows.get((dev, ino))
+            if win is None:
+                win = self._windows[(dev, ino)] = _Window()
+            win.add(off, length, crc)
+            n += 1
+            if n >= MAX_WINDOW_SPANS:
+                log.warning("ack journal window capped at %d spans", n)
+                break
+        self.window_spans = n
+        if n:
+            log.info("duplicate-suppression window: %d spans over %d "
+                     "sources", n, len(self._windows))
+
+    def _sweep_torn_spills(self) -> None:
+        if not os.path.isdir(self.buffer_dir):
+            return
+        for root, _dirs, files in os.walk(self.buffer_dir):
+            for name in files:
+                if name.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(root, name))
+                        self.torn_spills_removed += 1
+                    except OSError:
+                        pass
+        if self.torn_spills_removed:
+            log.warning("removed %d torn spill temp files",
+                        self.torn_spills_removed)
+
+    def _count_buffered_events(self) -> None:
+        """Events sitting in committed spill files at startup: they WILL
+        replay through the normal disk-buffer path — this is the recovered
+        inventory an operator sees as `recovered_events_total`."""
+        if not os.path.isdir(self.buffer_dir):
+            return
+        total = 0
+        for root, _dirs, files in os.walk(self.buffer_dir):
+            for name in files:
+                if not name.endswith(".lcb"):
+                    continue
+                try:
+                    with open(os.path.join(root, name), "rb") as f:
+                        header = json.loads(f.readline().decode())
+                    total += int(header.get("event_cnt", 0))
+                except (OSError, ValueError, TypeError):
+                    continue    # corrupt file: replay() quarantines it
+        self.recovered_events_total = total
+        if total:
+            log.info("recovery: %d events pending in the disk buffer", total)
+
+    def _find_flight_dump(self) -> Optional[str]:
+        """Most recent flight dump in the data dir (prof/flight.py writes
+        flight.json / flight_*.json there on signal/crash/breach)."""
+        best, best_m = None, -1.0
+        try:
+            for name in os.listdir(self.data_dir):
+                if name.startswith("flight") and name.endswith(".json"):
+                    p = os.path.join(self.data_dir, name)
+                    m = os.path.getmtime(p)
+                    if m > best_m:
+                        best, best_m = p, m
+        except OSError:
+            return None
+        return best
+
+    def _export_metrics(self) -> None:
+        try:
+            from .monitor.metrics import MetricsRecord
+            self._metrics = MetricsRecord(
+                category="agent", labels={"component": "recovery"})
+            self._metrics.gauge("unclean_shutdown_total").set(
+                float(self.unclean_shutdown_total))
+            self._metrics.gauge("recovered_events_total").set(
+                float(self.recovered_events_total))
+            self._metrics.gauge("recovery_window_spans").set(
+                float(self.window_spans))
+        except Exception:   # noqa: BLE001 - metrics must not block recovery
+            self._metrics = None
+
+    def status(self) -> dict:
+        with self._lock:
+            doc = {
+                "unclean_shutdown": self.unclean,
+                "unclean_shutdown_total": self.unclean_shutdown_total,
+                "recovered_events_total": self.recovered_events_total,
+                "replay_duplicate_events": self.replay_duplicate_events,
+                "replay_duplicate_spans": self.replay_duplicate_spans,
+                "torn_spills_removed": self.torn_spills_removed,
+                "window_spans": self.window_spans,
+                "recovery_wall_s": round(self.recovery_wall_s, 4),
+            }
+        if self.flight_dump:
+            doc["previous_flight_dump"] = self.flight_dump
+        doc["watermark"] = ack_watermark.tracker().status()
+        return doc
+
+
+from .models import EventGroupMetaKey as _MetaKey  # noqa: E402
+
+_CRC_KEY = _MetaKey.LOG_FILE_CRC32
+
+_manager: Optional[RecoveryManager] = None
+
+
+def begin(data_dir: str, buffer_dir: str = "") -> RecoveryManager:
+    """Install + run the recovery manager for this process (application
+    init, before any reader opens)."""
+    global _manager
+    _manager = RecoveryManager(data_dir, buffer_dir)
+    _manager.begin()
+    return _manager
+
+
+def active_manager() -> Optional[RecoveryManager]:
+    return _manager
+
+
+def mark_clean_exit() -> None:
+    if _manager is not None:
+        _manager.mark_clean_exit()
+
+
+def suppress_duplicate(group) -> bool:
+    m = _manager
+    if m is None:
+        return False
+    return m.suppress_duplicate(group)
+
+
+def status() -> Optional[dict]:
+    m = _manager
+    return m.status() if m is not None else None
+
+
+def reset() -> None:
+    """Tests: drop the installed manager (the tracker resets separately)."""
+    global _manager
+    if _manager is not None:
+        _manager.close()
+    _manager = None

@@ -31,6 +31,7 @@ from ..pipeline.queue.sender_queue import (SenderQueueItem,
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..utils import flags
 from ..utils.logger import get_logger
+from . import ack_watermark
 from .circuit import BreakerState, SinkCircuitBreaker
 from .http_sink import HttpSink
 
@@ -263,6 +264,9 @@ class FlusherRunner:
         identity = flusher.spill_identity()
         if not self.disk_buffer.spill(item, identity):
             return False
+        # durable on disk IS a terminal for the SOURCE span: the replay
+        # path owns delivery from here, the checkpoint may advance
+        ack_watermark.ack_spans(item.spans)
         self.spilled_items.add(1)
         if breaker is not None:
             breaker.note_spilled()
@@ -357,6 +361,7 @@ class FlusherRunner:
             if ledger.is_on():
                 ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
                               item.event_cnt, len(item.data), tag="no_sink")
+            ack_watermark.ack_spans(item.spans)
             self._release_limiters(item)
             self.sqm.remove_item(item)
             return
@@ -481,6 +486,9 @@ class FlusherRunner:
                               item.event_cnt, len(item.data),
                               tag=("callback_failed" if cb_failed
                                    else "permanent_reject"))
+        # sink accepted (or permanently rejected) the payload: terminal
+        # for its SOURCE spans either way — the watermark moves
+        ack_watermark.ack_spans(item.spans)
         self.out_items.add(1)
         self.out_bytes.add(len(item.data))
         self.sqm.remove_item(item)
@@ -526,3 +534,4 @@ class FlusherRunner:
                     ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
                                   item.event_cnt, len(item.data),
                                   tag="retry_orphaned")
+                ack_watermark.ack_spans(item.spans)

@@ -59,6 +59,7 @@ from ..ops import chip_lanes
 from ..ops.device_plane import (current_tenant, note_host_backlog,
                                 set_budget_relief, set_thread_tenant)
 from ..ops.device_stream import auto_tuner
+from . import ack_watermark
 from ..prof import flight
 from ..pipeline.batch.timeout_flush_manager import TimeoutFlushManager
 from ..pipeline.queue.process_queue_manager import (RUN_MAX_GROUPS,
@@ -722,6 +723,7 @@ class ProcessorRunner:
         n_events = len(group)
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
+            ack_watermark.ack_groups([group], force=True)
             if ledger.is_on():
                 q = self.pqm.get_queue(key)
                 # hot reload can delete the queue between pop and here:
@@ -791,6 +793,7 @@ class ProcessorRunner:
         """A processing exception terminally discards the group's events:
         without this record the conservation residual would read the bug
         as a silent loss instead of an attributed drop."""
+        ack_watermark.ack_groups(groups, force=True)
         ledger.record(pipeline.name, ledger.B_DROP,
                       sum(len(g) for g in groups), tag="process_error")
 

@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""loongcrash storm: SIGKILL the REAL agent at a seeded fault point, restart
+it, and prove the at-least-once contract end to end.
+
+One seed = one kill site.  The harness
+
+  1. pre-writes a corpus file (fully written before the agent starts, so
+     reader chunk boundaries are deterministic across the original run and
+     the post-crash re-read — exact-span crc dedup applies);
+  2. boots `python -m loongcollector_tpu.application --cpu` with
+     ``LOONG_CHAOS_CRASH=<point>:<nth>`` armed — the chaos plane SIGKILLs
+     the process at the nth hit of that point (process.crash family), with
+     THIS harness process as the HTTP sink, so the exact set of lines
+     delivered before the kill is known to the assertion, not sampled;
+  3. restarts the agent clean (same data dir), waits until the sink holds
+     every corpus line, SIGTERM-drains it;
+  4. asserts: unique sink lines == corpus byte-for-byte (zero loss),
+     duplicates bounded by the unacked window (lines the first run
+     delivered + events it had spilled durably), the restarted agent's
+     /debug/status reports the unclean shutdown + its replay-duplicate
+     counters, and the post-restart ledger reconciles to residual 0.
+
+Seeds map deterministically onto (point, nth) pairs across the
+ingest/process/send/spill boundaries — `scripts/soak.sh` runs the 8-seed
+matrix, `scripts/lint.sh` runs seed 3 as a smoke.
+
+Usage:  python scripts/crash_storm.py [--seed N] [--lines N] [--json PATH]
+"""
+
+import argparse
+import http.server
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the 8-seed matrix: kill at the nth hit of each pipeline boundary.
+# file_input.read = ingest, bounded_queue.push = process handoff,
+# http_sink.send = the send path (pre-POST, so the in-flight payload is
+# unacked), disk_buffer.write = mid-spill.  disk_buffer.write may never
+# fire on a healthy run — the harness then kills AFTER full delivery,
+# which exercises the ack-to-checkpoint-dump window instead.
+SEED_MATRIX = [
+    ("file_input.read", 1),
+    ("file_input.read", 4),
+    ("bounded_queue.push", 2),
+    ("http_sink.send", 0),
+    ("http_sink.send", 2),
+    ("http_sink.send", 6),
+    ("disk_buffer.write", 0),
+    ("bounded_queue.push", 7),
+]
+
+
+class _Sink(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _SinkHandler)
+        self.lines = []          # (phase, content) in arrival order
+        self.phase = 1
+        self.lock = threading.Lock()
+
+
+class _SinkHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        rows = []
+        for line in body.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line).get("content", ""))
+            except ValueError:
+                rows.append(line)
+        with self.server.lock:
+            phase = self.server.phase
+            for r in rows:
+                self.server.lines.append((phase, r))
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, *a):      # noqa: D102 - silence request spam
+        pass
+
+
+def _spawn(conf, data, extra_env):
+    env = dict(os.environ)
+    env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "loongcollector_tpu.application", "--cpu",
+         "--config", conf, "--data-dir", data],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    # drain stdout continuously: a full pipe buffer would BLOCK the agent's
+    # logging mid-drive, and the retained lines carry the ephemeral
+    # exposition port + the post-mortem for convergence failures
+    lines = []
+
+    def _drain():
+        for raw in proc.stdout:
+            lines.append(raw)
+    threading.Thread(target=_drain, daemon=True).start()
+    proc.log_lines = lines
+    return proc
+
+
+_EXPO_RE = re.compile(rb"exposition endpoint on http://127\.0\.0\.1:(\d+)/")
+
+
+def _expo_port(proc, timeout=30):
+    """The agent binds LOONG_EXPO_PORT=0 to an ephemeral port (a
+    pre-probed 'free' port is a TOCTOU race against every other test on
+    the host) and logs it — parse it out of the drained log."""
+    found = []
+
+    def _probe():
+        for raw in list(proc.log_lines):
+            m = _EXPO_RE.search(raw)
+            if m:
+                found.append(int(m.group(1)))
+                return True
+        return proc.poll() is not None
+    _wait(_probe, timeout=timeout)
+    return found[0] if found else None
+
+
+def _wait(cond, timeout, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _scrape_status(port, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/status", timeout=3) as r:
+                return json.loads(r.read())
+        except (OSError, ValueError):
+            time.sleep(0.5)
+    return None
+
+
+def run_storm(seed, n_lines=160, workdir=None, verbose=False,
+              dump_interval=1):
+    """One seeded kill-restart-drain cycle; returns the result dict and
+    raises AssertionError on any contract violation."""
+    import tempfile
+    point, nth = SEED_MATRIX[seed % len(SEED_MATRIX)]
+    tmp = workdir or tempfile.mkdtemp(prefix=f"crash_storm_s{seed}_")
+    conf = os.path.join(tmp, "conf")
+    data = os.path.join(tmp, "data")
+    logs = os.path.join(tmp, "logs")
+    for d in (conf, data, logs):
+        os.makedirs(d, exist_ok=True)
+
+    sink = _Sink(("127.0.0.1", 0))
+    sink_port = sink.server_address[1]
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+
+    corpus = [f"s{seed}-{i:05d}-" + "x" * (17 + (i * 7 + seed) % 41)
+              for i in range(n_lines)]
+    logf = os.path.join(logs, "app.log")
+    with open(logf, "w") as f:            # fully pre-written: deterministic
+        f.write("\n".join(corpus) + "\n")  # chunk boundaries across re-reads
+
+    with open(os.path.join(conf, "storm.json"), "w") as f:
+        json.dump({
+            "inputs": [{"Type": "input_file", "FilePaths": [logf],
+                        "TailExisted": True}],
+            "flushers": [{"Type": "flusher_http",
+                          "RemoteURL":
+                          f"http://127.0.0.1:{sink_port}/ingest"}],
+        }, f)
+    # a short checkpoint cadence keeps the crash window realistic; the
+    # watermark (not the dump clock) is what durability rides on
+    with open(os.path.join(data, "loongcollector_config.json"), "w") as f:
+        json.dump({"checkpoint_dump_interval": dump_interval}, f)
+
+    t0 = time.monotonic()
+    # ---- phase 1: armed run — SIGKILL at the nth hit of `point` ----------
+    proc = _spawn(conf, data, {"LOONG_CHAOS_CRASH": f"{point}:{nth}",
+                               "LOONG_LEDGER": "1"})
+    _wait(lambda: proc.poll() is not None or len(sink.lines) >= n_lines,
+          timeout=60)
+    if proc.poll() is None:
+        # the armed point never reached hit nth (e.g. no spill happened on
+        # a healthy run): give the late hit a moment, then kill by hand
+        # AFTER delivery — the ack-to-checkpoint-dump window
+        _wait(lambda: proc.poll() is not None, timeout=2)
+    crash_fired = proc.poll() is not None
+    if not crash_fired:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    with sink.lock:
+        phase1_lines = [c for _, c in sink.lines]
+        sink.phase = 2
+    if verbose:
+        print(f"  phase1: crash_fired={crash_fired} rc={proc.returncode} "
+              f"delivered={len(phase1_lines)}")
+    assert proc.returncode == -signal.SIGKILL, \
+        f"agent exited {proc.returncode}, expected SIGKILL"
+    marker = os.path.join(data, "unclean.marker")
+    assert os.path.exists(marker), "crash marker missing after SIGKILL"
+
+    # durably spilled events at the kill: part of the duplicate bound
+    buffered = 0
+    bufdir = os.path.join(data, "buffer")
+    if os.path.isdir(bufdir):
+        for root, _dirs, files in os.walk(bufdir):
+            for name in files:
+                if name.endswith(".lcb"):
+                    try:
+                        with open(os.path.join(root, name), "rb") as f:
+                            buffered += int(json.loads(
+                                f.readline().decode()).get("event_cnt", 0))
+                    except (OSError, ValueError):
+                        pass
+
+    # ---- phase 2: clean restart — recover, re-read, drain ----------------
+    proc = _spawn(conf, data, {"LOONG_EXPO_PORT": "0",
+                               "LOONG_LEDGER": "1"})
+    status = {}
+    try:
+        ok = _wait(lambda: len({c for _, c in sink.lines}) >= n_lines,
+                   timeout=90)
+        if not ok:
+            out = b"".join(proc.log_lines)
+            raise AssertionError(
+                f"seed {seed} ({point}:{nth}): sink never converged — "
+                f"{len({c for _, c in sink.lines})}/{n_lines} unique lines; "
+                + out.decode(errors="replace")[-1500:])
+        # quiesce: no new arrivals for a full second, then scrape + drain
+        def _settled():
+            n = len(sink.lines)
+            time.sleep(1.0)
+            return len(sink.lines) == n
+        _wait(_settled, timeout=20, interval=0)
+        expo_port = _expo_port(proc)
+        status = (_scrape_status(expo_port)
+                  if expo_port is not None else None) or {}
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    wall = time.monotonic() - t0
+
+    # ---- assertions ------------------------------------------------------
+    with sink.lock:
+        all_lines = [c for _, c in sink.lines]
+    unique = set(all_lines)
+    missing = set(corpus) - unique
+    foreign = unique - set(corpus)
+    assert not missing, \
+        f"seed {seed} ({point}:{nth}): LOST {len(missing)} lines, " \
+        f"e.g. {sorted(missing)[:3]}"
+    assert not foreign, \
+        f"seed {seed} ({point}:{nth}): corrupt/foreign lines {list(foreign)[:3]}"
+    duplicates = len(all_lines) - len(unique)
+    window = len(phase1_lines) + buffered
+    assert duplicates <= max(window, 1), \
+        f"seed {seed} ({point}:{nth}): {duplicates} duplicates exceed the " \
+        f"unacked window ({len(phase1_lines)} delivered + {buffered} spilled)"
+
+    rec = status.get("recovery", {})
+    assert rec.get("unclean_shutdown") is True, \
+        f"seed {seed}: restart did not report unclean_shutdown: {rec}"
+    suppressed = int(rec.get("replay_duplicate_events", 0))
+    # every re-read of an already-delivered span is either suppressed
+    # (counted by the recovery window) or delivered as one of the bounded
+    # duplicates — nothing falls through uncounted
+    assert suppressed + duplicates <= window + len(corpus), \
+        f"seed {seed}: replay accounting off: suppressed={suppressed} " \
+        f"delivered_dup={duplicates} window={window}"
+
+    residuals = (status.get("ledger") or {}).get("residuals") or {}
+    bad = {k: v for k, v in residuals.items() if v != 0}
+    assert not bad, \
+        f"seed {seed} ({point}:{nth}): post-restart ledger residuals {bad}"
+
+    sink.shutdown()
+    return {
+        "seed": seed, "point": point, "nth": nth,
+        "crash_fired": crash_fired,
+        "corpus_lines": len(corpus),
+        "phase1_delivered": len(phase1_lines),
+        "buffered_at_kill": buffered,
+        "duplicates_delivered": duplicates,
+        "replay_duplicate_events": suppressed,
+        "unclean_shutdown_total": int(rec.get("unclean_shutdown_total", 0)),
+        "recovered_events_total": int(rec.get("recovered_events_total", 0)),
+        "recovery_wall_s": float(rec.get("recovery_wall_s", 0.0)),
+        "wall_s": round(wall, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=None,
+                    help="single seed (default: full 8-seed matrix)")
+    ap.add_argument("--lines", type=int, default=160)
+    ap.add_argument("--json", default="",
+                    help="write per-seed result records to this file")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    seeds = [args.seed] if args.seed is not None else list(
+        range(len(SEED_MATRIX)))
+    results = []
+    for seed in seeds:
+        point, nth = SEED_MATRIX[seed % len(SEED_MATRIX)]
+        print(f"== crash storm seed {seed}: SIGKILL at {point} hit {nth} ==")
+        res = run_storm(seed, n_lines=args.lines, verbose=args.verbose)
+        results.append(res)
+        print(f"   zero loss; {res['duplicates_delivered']} dup delivered, "
+              f"{res['replay_duplicate_events']} suppressed, "
+              f"{res['wall_s']}s")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    print(f"crash storm OK ({len(results)} seed(s))")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,12 @@ echo "== reload-soak smoke (loongtenant) =="
 JAX_PLATFORMS=cpu python scripts/reload_soak.py \
     --tenants 4 --rate 5 --seconds 3
 
+echo "== crash-storm smoke (loongcrash) =="
+# one seeded SIGKILL of the real agent at the send boundary, then restart
+# + drain: zero loss byte-for-byte, duplicates bounded, ledger residual 0
+# (docs/robustness.md "Crash durability"; full 8-seed matrix in soak.sh)
+JAX_PLATFORMS=cpu python scripts/crash_storm.py --seed 3 --lines 120
+
 echo "== native lint =="
 make -C native lint
 

@@ -38,6 +38,13 @@ echo "== chaos soak: extended seed matrix (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m slow -p no:cacheprovider
 
+echo "== crash storm: 8-seed SIGKILL matrix (loongcrash) =="
+# kill the real agent at every seeded pipeline boundary (ingest, queue
+# push, send, spill), restart, drain: sink ⊇ corpus byte-for-byte with
+# duplicates bounded by the unacked window and post-restart ledger
+# residual 0 (docs/robustness.md "Crash durability")
+JAX_PLATFORMS=cpu python scripts/crash_storm.py --lines 160
+
 echo "== native sanitizer soak (TSan) =="
 # the long-running home of the opt-in TSan variant: data races in the
 # native plane surface under the soak's time budget, not lint's

@@ -83,6 +83,19 @@ def _fresh_device_stream_state():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _fresh_ack_watermark_state():
+    """loongcrash isolation: the ack-watermark tracker and the recovery
+    manager are process-global; a (dev, inode) registered authoritative by
+    one test's FileServer must not skew another test's checkpoint dump if
+    the kernel recycles the inode for a new tmp file."""
+    yield
+    from loongcollector_tpu import recovery
+    from loongcollector_tpu.runner import ack_watermark
+    ack_watermark.tracker().reset()
+    recovery.reset()
+
+
 def wait_for(cond, timeout=10.0, interval=0.05):
     """Shared sink-side poll helper: True iff cond() holds within timeout."""
     import time

@@ -1,0 +1,443 @@
+"""loongcrash: acked-offset watermarks, the recovery manager, checkpoint
+hardening, the process.crash chaos family, and the 8-seed SIGKILL storm.
+
+The storm tests boot the REAL agent (`python -m loongcollector_tpu.application`)
+as a subprocess with ``LOONG_CHAOS_CRASH`` armed, SIGKILL it at a seeded
+pipeline boundary, restart it against the same data dir, and assert the
+at-least-once contract on sink-side evidence: zero loss byte-for-byte,
+duplicates bounded by the unacked window, replay suppression counted, and
+the post-restart ledger reconciling to residual 0.
+"""
+
+import importlib.util
+import json
+import os
+import zlib
+
+import pytest
+
+from loongcollector_tpu import recovery
+from loongcollector_tpu.chaos import plan as chaos_plan
+from loongcollector_tpu.chaos import plane as chaos_plane
+from loongcollector_tpu.input.file.checkpoint import CheckPointManager
+from loongcollector_tpu.input.file.reader import ReaderCheckpoint
+from loongcollector_tpu.models import (EventGroupMetaKey, PipelineEventGroup,
+                                       SourceBuffer)
+from loongcollector_tpu.runner import ack_watermark
+from loongcollector_tpu.runner.ack_watermark import AckWatermarkTracker
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _storm():
+    """scripts/crash_storm.py is a script, not a package module — load it
+    by path so the matrix test drives the exact harness CI runs."""
+    spec = importlib.util.spec_from_file_location(
+        "crash_storm", os.path.join(_REPO, "scripts", "crash_storm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _group(dev=5, ino=77, off=0, length=10, crc=0, n_events=1):
+    sb = SourceBuffer(256)
+    g = PipelineEventGroup(sb)
+    for i in range(n_events):
+        g.add_raw_event(1).set_content(sb.copy_string(b"x" * 4))
+    g.set_metadata(EventGroupMetaKey.LOG_FILE_DEV, str(dev))
+    g.set_metadata(EventGroupMetaKey.LOG_FILE_INODE, str(ino))
+    g.set_metadata(EventGroupMetaKey.LOG_FILE_OFFSET, str(off))
+    g.set_metadata(EventGroupMetaKey.LOG_FILE_LENGTH, str(length))
+    if crc:
+        g.set_metadata(EventGroupMetaKey.LOG_FILE_CRC32, str(crc))
+    return g
+
+
+# ---------------------------------------------------------------------------
+# acked-offset watermarks
+
+
+class TestAckWatermark:
+    def test_frontier_advances_only_through_contiguous_acks(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        for off in (0, 10, 20):
+            t.note_read(1, 2, off, 10, 0)
+        # out-of-order ack: held until the gap closes
+        t.ack_spans([(1, 2, 10, 10)])
+        assert t.durable_offset(1, 2, 30) == 0
+        t.ack_spans([(1, 2, 0, 10)])
+        assert t.durable_offset(1, 2, 30) == 20
+        t.ack_spans([(1, 2, 20, 10)])
+        assert t.durable_offset(1, 2, 30) == 30
+        assert t.fully_acked(1, 2)
+
+    def test_durable_offset_never_exceeds_read_offset(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.note_read(1, 2, 0, 10, 0)
+        t.ack_spans([(1, 2, 0, 10)])
+        # caller's fallback (read offset) below the frontier wins: a
+        # truncated restore can't be pushed past what was actually read
+        assert t.durable_offset(1, 2, 4) == 4
+
+    def test_unregistered_source_keeps_read_offset_semantics(self):
+        t = AckWatermarkTracker()
+        t.note_read(3, 4, 0, 50, 0)
+        assert t.durable_offset(3, 4, 50) == 50   # fallback: not registered
+
+    def test_fanout_needs_every_copy_acked(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.note_read(1, 2, 0, 10, 0)
+        g = _group(dev=1, ino=2, off=0, length=10)
+        t.note_fanout(g, 2)
+        t.ack_spans([(1, 2, 0, 10)])
+        assert t.durable_offset(1, 2, 10) == 0    # one copy still in flight
+        t.ack_spans([(1, 2, 0, 10)])
+        assert t.durable_offset(1, 2, 10) == 10
+
+    def test_force_ack_clears_regardless_of_refcount(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.note_read(1, 2, 0, 10, 0)
+        t.note_fanout(_group(dev=1, ino=2, off=0, length=10), 3)
+        t.ack_spans([(1, 2, 0, 10)], force=True)
+        assert t.durable_offset(1, 2, 10) == 10
+
+    def test_unknown_and_stale_acks_are_ignored(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.ack_spans([(1, 2, 0, 10)])            # never read
+        t.ack_spans([(9, 9, 0, 10)])            # unknown source
+        assert t.durable_offset(1, 2, 0) == 0
+
+    def test_truncation_resets_the_books(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.note_read(1, 2, 0, 100, 0)
+        t.ack_spans([(1, 2, 0, 100)])
+        assert t.durable_offset(1, 2, 100) == 100
+        t.note_read(1, 2, 0, 30, 0)             # off < base: truncated file
+        assert t.durable_offset(1, 2, 30) == 0  # old acks no longer apply
+        t.ack_spans([(1, 2, 0, 30)])
+        assert t.durable_offset(1, 2, 30) == 30
+
+    def test_rollback_reread_is_idempotent(self):
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        t.note_read(1, 2, 0, 10, 111)
+        t.note_read(1, 2, 0, 12, 222)           # re-read, longer span
+        t.ack_spans([(1, 2, 0, 12)])
+        assert t.durable_offset(1, 2, 12) == 12
+
+    def test_overflow_force_expires_oldest(self, monkeypatch):
+        monkeypatch.setattr(ack_watermark, "MAX_OUTSTANDING_SPANS", 8)
+        t = AckWatermarkTracker()
+        t.register_source(1, 2, 0)
+        for i in range(9):
+            t.note_read(1, 2, i * 10, 10, 0)
+        assert t.forced_expirations > 0
+        assert t.outstanding_count(1, 2) <= 8
+        # the watermark moved past the expired prefix: degraded, not pinned
+        assert t.durable_offset(1, 2, 90) > 0
+
+    def test_journal_roundtrip_and_compaction(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        t = AckWatermarkTracker()
+        t.attach_journal(path)
+        t.register_source(1, 2, 0)
+        for off in (0, 10, 20):
+            t.note_read(1, 2, off, 10, 100 + off)
+        t.ack_spans([(1, 2, 0, 10)])
+        t.ack_spans([(1, 2, 20, 10)])
+        recs = [json.loads(x) for x in open(path).read().splitlines()]
+        assert {(r["o"], r["l"], r["c"]) for r in recs} == \
+            {(0, 10, 100), (20, 10, 120)}
+        # dump recorded frontier 10 → compaction keeps everything above it
+        assert t.durable_offset(1, 2, 30) == 10
+        t.compact_journal()
+        kept = [json.loads(x) for x in open(path).read().splitlines()]
+        assert all(r["o"] + r["l"] > 10 for r in kept)
+        assert any(r["o"] == 20 for r in kept)
+        # journal still appendable after the compaction swap
+        t.ack_spans([(1, 2, 10, 10)])
+        assert any(json.loads(x)["o"] == 10
+                   for x in open(path).read().splitlines())
+
+    def test_span_of_requires_file_provenance(self):
+        sb = SourceBuffer(64)
+        bare = PipelineEventGroup(sb)
+        assert ack_watermark.span_of(bare) is None
+        g = _group(dev=4, ino=9, off=128, length=64)
+        assert ack_watermark.span_of(g) == (4, 9, 128, 64)
+
+
+# ---------------------------------------------------------------------------
+# recovery manager
+
+
+class TestRecoveryManager:
+    def test_marker_lifecycle(self, tmp_path):
+        d = str(tmp_path)
+        m = recovery.begin(d)
+        assert not m.unclean
+        assert os.path.exists(os.path.join(d, recovery.MARKER_NAME))
+        recovery.mark_clean_exit()
+        assert not os.path.exists(os.path.join(d, recovery.MARKER_NAME))
+        # clean exit ⇒ next start is clean
+        m2 = recovery.begin(d)
+        assert not m2.unclean
+
+    def test_unclean_shutdown_detected_and_persisted(self, tmp_path):
+        d = str(tmp_path)
+        recovery.begin(d)               # "crash": no mark_clean_exit
+        recovery.reset()
+        m2 = recovery.begin(d)
+        assert m2.unclean and m2.unclean_shutdown_total == 1
+        recovery.reset()
+        m3 = recovery.begin(d)          # second crash: the counter persists
+        assert m3.unclean_shutdown_total == 2
+        recovery.mark_clean_exit()
+
+    def test_window_suppresses_exact_crc_match(self, tmp_path):
+        d = str(tmp_path)
+        payload = b"hello crash line\n"
+        crc = zlib.crc32(payload)
+        with open(os.path.join(d, recovery.JOURNAL_NAME), "w") as f:
+            f.write(json.dumps({"d": 5, "i": 77, "o": 0, "l": len(payload),
+                                "c": crc}) + "\n")
+        m = recovery.begin(d)
+        assert m.window_spans == 1
+        assert recovery.suppress_duplicate(
+            _group(off=0, length=len(payload), crc=crc, n_events=3))
+        assert m.replay_duplicate_events == 3
+        # crc mismatch at the same offsets = file changed underneath:
+        # deliver, never drop
+        assert not recovery.suppress_duplicate(
+            _group(off=0, length=len(payload), crc=crc ^ 0xFFFF))
+        # unknown source / offset: deliver
+        assert not recovery.suppress_duplicate(
+            _group(ino=123, off=0, length=len(payload), crc=crc))
+        recovery.mark_clean_exit()
+
+    def test_window_containment_without_crc(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, recovery.JOURNAL_NAME), "w") as f:
+            f.write(json.dumps({"d": 5, "i": 77, "o": 0, "l": 100,
+                                "c": 0}) + "\n")
+            f.write(json.dumps({"d": 5, "i": 77, "o": 100, "l": 100,
+                                "c": 0}) + "\n")
+        recovery.begin(d)
+        # a re-read with different chunk boundaries is still inside the
+        # merged acked interval → suppressed by containment
+        assert recovery.suppress_duplicate(_group(off=40, length=120))
+        assert not recovery.suppress_duplicate(_group(off=150, length=100))
+        recovery.mark_clean_exit()
+
+    def test_suppression_advances_the_watermark(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, recovery.JOURNAL_NAME), "w") as f:
+            f.write(json.dumps({"d": 5, "i": 77, "o": 0, "l": 64,
+                                "c": 0}) + "\n")
+        recovery.begin(d)
+        ack_watermark.register_source(5, 77, 0)
+        ack_watermark.note_read(5, 77, 0, 64, 0)
+        assert recovery.suppress_duplicate(_group(off=0, length=64))
+        # suppressed span counts as delivered: checkpoint moves past it
+        assert ack_watermark.durable_offset(5, 77, 64) == 64
+        recovery.mark_clean_exit()
+
+    def test_torn_lines_in_journal_are_skipped(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, recovery.JOURNAL_NAME), "w") as f:
+            f.write(json.dumps({"d": 1, "i": 2, "o": 0, "l": 8,
+                                "c": 0}) + "\n")
+            f.write('{"d": 1, "i": 2, "o": 8, "l"')   # crash mid-append
+        m = recovery.begin(d)
+        assert m.window_spans == 1
+        recovery.mark_clean_exit()
+
+    def test_torn_spill_sweep_and_buffer_inventory(self, tmp_path):
+        d = str(tmp_path)
+        buf = os.path.join(d, "buffer")
+        os.makedirs(buf)
+        with open(os.path.join(buf, "0001.lcb"), "wb") as f:
+            f.write(json.dumps({"event_cnt": 42}).encode() + b"\npayload")
+        with open(os.path.join(buf, "0002.lcb.tmp"), "wb") as f:
+            f.write(b"torn half-written spill")
+        m = recovery.begin(d)
+        assert m.torn_spills_removed == 1
+        assert not os.path.exists(os.path.join(buf, "0002.lcb.tmp"))
+        assert os.path.exists(os.path.join(buf, "0001.lcb"))
+        assert m.recovered_events_total == 42
+        recovery.mark_clean_exit()
+
+    def test_status_shape(self, tmp_path):
+        m = recovery.begin(str(tmp_path))
+        doc = recovery.status()
+        for key in ("unclean_shutdown", "unclean_shutdown_total",
+                    "recovered_events_total", "replay_duplicate_events",
+                    "window_spans", "recovery_wall_s", "watermark"):
+            assert key in doc, key
+        assert doc["unclean_shutdown"] is False
+        assert m is recovery.active_manager()
+        recovery.mark_clean_exit()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (satellites: atomic dump, quarantine, version loads)
+
+
+class TestCheckpointHardening:
+    def _cp(self, path="/var/log/a.log", offset=100, dev=5, inode=9):
+        return ReaderCheckpoint(path=path, offset=offset, dev=dev,
+                                inode=inode, signature="sig", signature_size=3,
+                                update_time=1.5)
+
+    def test_dump_is_atomic_and_fsynced(self, tmp_path):
+        mgr = CheckPointManager(str(tmp_path / "checkpoint.json"))
+        mgr.update(self._cp())
+        mgr.dump()
+        assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+        data = json.load(open(mgr.path))
+        # golden v3 shape: version + dev:inode-keyed entries with both the
+        # durable offset and the raw read offset
+        assert data["version"] == 3
+        entry = data["check_point"]["5:9"]
+        assert entry["offset"] == 100 and entry["read_offset"] == 100
+        assert entry["path"] == "/var/log/a.log" and entry["sig"] == "sig"
+
+    def test_dump_persists_the_acked_watermark(self, tmp_path):
+        ack_watermark.register_source(5, 9, 0)
+        ack_watermark.note_read(5, 9, 0, 40, 0)
+        ack_watermark.note_read(5, 9, 40, 60, 0)
+        ack_watermark.ack_spans([(5, 9, 0, 40)])   # second span unacked
+        mgr = CheckPointManager(str(tmp_path / "checkpoint.json"))
+        mgr.update(self._cp(offset=100))
+        mgr.dump()
+        entry = json.load(open(mgr.path))["check_point"]["5:9"]
+        assert entry["offset"] == 40        # durable: acked frontier
+        assert entry["read_offset"] == 100  # where reading actually stood
+
+    def test_corrupt_checkpoint_quarantined_not_crashed(self, tmp_path):
+        path = str(tmp_path / "checkpoint.json")
+        with open(path, "w") as f:
+            f.write('{"version": 3, "check_point": {TORN')
+        mgr = CheckPointManager(path)
+        mgr.load()
+        assert mgr.quarantined_loads == 1
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".bad")
+        assert mgr.get(5, 9) is None
+        # a fresh dump recreates the real file alongside the evidence
+        mgr.update(self._cp())
+        mgr.dump()
+        assert json.load(open(path))["version"] == 3
+        assert os.path.exists(path + ".bad")
+
+    def test_v1_path_keyed_load(self, tmp_path):
+        path = str(tmp_path / "checkpoint.json")
+        with open(path, "w") as f:
+            json.dump({"check_point": {"/var/log/a.log": {
+                "offset": 77, "dev": 5, "inode": 9, "sig": "s",
+                "sig_size": 1, "update_time": 2.0}}}, f)
+        mgr = CheckPointManager(path)
+        mgr.load()
+        cp = mgr.get(5, 9)
+        assert cp.path == "/var/log/a.log" and cp.offset == 77
+
+    def test_v2_and_v3_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "checkpoint.json")
+        with open(path, "w") as f:
+            json.dump({"version": 2, "check_point": {"5:9": {
+                "path": "/var/log/a.log", "offset": 88, "dev": 5,
+                "inode": 9, "sig": "s", "sig_size": 1,
+                "update_time": 2.0}}}, f)
+        mgr = CheckPointManager(path)
+        mgr.load()
+        assert mgr.get(5, 9).offset == 88
+        mgr.dump()                          # v2 → v3 upgrade on next dump
+        mgr2 = CheckPointManager(path)
+        mgr2.load()
+        assert mgr2.get(5, 9).offset == 88
+        assert json.load(open(path))["version"] == 3
+
+    def test_rotation_resume_restores_both_incarnations(self, tmp_path):
+        """rename+recreate rotation: rotated file and fresh file share a
+        path but keep distinct (dev, inode) entries across a restart."""
+        path = str(tmp_path / "checkpoint.json")
+        mgr = CheckPointManager(path)
+        mgr.update(self._cp(offset=500, inode=9))            # rotated
+        mgr.update(ReaderCheckpoint(
+            path="/var/log/a.log", offset=20, dev=5, inode=10,
+            signature="new", signature_size=3, update_time=9.0))
+        mgr.dump()
+        mgr2 = CheckPointManager(path)
+        mgr2.load()
+        assert mgr2.get(5, 9).offset == 500
+        assert mgr2.get(5, 10).offset == 20
+        assert mgr2.get_by_path("/var/log/a.log").inode == 10  # newest wins
+
+
+# ---------------------------------------------------------------------------
+# process.crash chaos family
+
+
+class TestProcessCrashPlan:
+    def test_at_hits_fires_deterministically(self):
+        plan = chaos_plan.ChaosPlan(0, {}).crash("http_sink.send", 3)
+        for hit in range(6):
+            d = plan.decide("http_sink.send", hit)
+            if hit == 3:
+                assert d is not None and d.action == chaos_plan.ACTION_CRASH
+            else:
+                assert d is None            # prob=0: only the armed hit
+        assert plan.decide("other.point", 3) is None
+
+    def test_crash_rule_overrides_pattern_storm(self):
+        plan = chaos_plan.ChaosPlan.default(7).crash("disk_buffer.write", 0)
+        d = plan.decide("disk_buffer.write", 0)
+        assert d.action == chaos_plan.ACTION_CRASH
+
+    def test_install_from_env_arms_the_kill(self):
+        try:
+            assert chaos_plane.install_from_env(
+                {"LOONG_CHAOS_CRASH": "bounded_queue.push:2"})
+            plan = chaos_plane.current_plan()
+            d = plan.decide("bounded_queue.push", 2)
+            assert d is not None and d.action == chaos_plan.ACTION_CRASH
+            assert plan.decide("bounded_queue.push", 1) is None
+        finally:
+            chaos_plane.reset()
+
+    def test_install_from_env_rejects_garbage(self):
+        assert not chaos_plane.install_from_env(
+            {"LOONG_CHAOS_CRASH": "no-colon"})
+        assert not chaos_plane.install_from_env({})
+
+
+# ---------------------------------------------------------------------------
+# the storm: real agent, real SIGKILL, real restart
+
+
+class TestCrashStorm:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_seeded_kill_matrix(self, seed, tmp_path):
+        """Zero loss + bounded duplicates + ledger residual 0 across every
+        seeded kill site; assertions live in run_storm itself."""
+        res = _storm().run_storm(seed, n_lines=120, workdir=str(tmp_path))
+        assert res["corpus_lines"] == 120
+        assert res["unclean_shutdown_total"] >= 1
+
+    def test_ack_to_dump_window_is_deduplicated(self, tmp_path):
+        """Kill AFTER the sink acked everything but BEFORE any checkpoint
+        dump could run (dump interval pushed past the test horizon): the
+        restart re-reads the whole corpus and the journal window must
+        suppress every replayed event — zero duplicates at the sink."""
+        res = _storm().run_storm(6, n_lines=120, workdir=str(tmp_path),
+                                 dump_interval=3600)
+        assert res["crash_fired"] is False     # manual kill post-delivery
+        assert res["phase1_delivered"] == 120
+        assert res["replay_duplicate_events"] == 120
+        assert res["duplicates_delivered"] == 0
