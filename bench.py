@@ -788,6 +788,103 @@ def _alloc_delta(before):
     }
 
 
+def _collect_slo(pqm, p, bh, mk_small, small_events=256,
+                 sustained_groups=30, burst_factor=10):
+    """loongslo (docs/observability.md#freshness-slo-plane): the e2e bench
+    measures the PLANE's own end-to-end sojourn — ingest stamps minted at
+    the ProcessQueueManager admit hook, observed at the blackhole
+    terminal — under a paced sustained load and then a burst at
+    ``burst_factor``x that arrival rate, sampling the freshness watermark
+    through the burst drain and closing with the burn-rate verdict.  The
+    plane comes on only for this phase, so the headline throughput
+    windows stay on the disabled-hook path."""
+    from loongcollector_tpu.monitor import slo as _slo
+    from loongcollector_tpu.monitor.metrics import WriteMetrics
+
+    plane = _slo.enable()
+    _slo.reset()
+    name = "bench-e2e"
+
+    def _hist_snapshot(reset=False):
+        for rec in WriteMetrics.instance().records():
+            if (rec.category == "slo"
+                    and rec.labels.get("pipeline") == name
+                    and rec.labels.get("outcome") == _slo.OUTCOME_SEND_OK):
+                for h in rec.histograms():
+                    if h.name == "event_to_flush_ms":
+                        return h.snapshot(reset=reset)
+        return None
+
+    def _run_phase(n_groups, interval_s, sample_freshness=False):
+        base = bh.total_events
+        want = base + n_groups * small_events
+        freshness = []
+        next_sample = [0.0]
+
+        def _sample():
+            now = time.monotonic()
+            if sample_freshness and now >= next_sample[0] \
+                    and len(freshness) < 400:
+                next_sample[0] = now + 0.01
+                freshness.append(round(_slo.freshness(name), 4))
+
+        deadline = time.monotonic() + 120
+        for _ in range(n_groups):
+            g = mk_small()
+            while not pqm.push_queue(p.process_queue_key, g):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "slo phase: pipeline stopped draining")
+                time.sleep(0.001)
+            _sample()
+            if interval_s:
+                time.sleep(interval_s)
+        while bh.total_events < want and time.monotonic() < deadline:
+            _sample()
+            time.sleep(0.001)
+        if bh.total_events < want:
+            raise RuntimeError("slo phase: groups never reached the sink")
+        # the terminal observe runs just after the sink counter ticks —
+        # wait out the registry so freshness reads its hard zero
+        drain_deadline = time.monotonic() + 10
+        while plane.outstanding(name) and \
+                time.monotonic() < drain_deadline:
+            time.sleep(0.001)
+        return _hist_snapshot(reset=True), freshness
+
+    def _stat(s):
+        if not s or not s["count"]:
+            return None
+        # the slo histogram observes milliseconds directly
+        return {"count": s["count"], "p50_ms": round(s["p50"], 3),
+                "p99_ms": round(s["p99"], 3),
+                "max_ms": round(s["max"], 3)}
+
+    sustained, _ = _run_phase(sustained_groups, 0.05)
+    burst, freshness = _run_phase(sustained_groups * burst_factor,
+                                  0.05 / burst_factor,
+                                  sample_freshness=True)
+    res = plane.evaluate_once().get(name) or {}
+    return {
+        "event_to_flush_ms_p99_sustained":
+            round(sustained["p99"], 3) if sustained else None,
+        "event_to_flush_ms_p99_burst10x":
+            round(burst["p99"], 3) if burst else None,
+        "sustained": _stat(sustained),
+        "burst10x": _stat(burst),
+        "burst_factor": burst_factor,
+        "freshness_trajectory_s": freshness,
+        "freshness_final_s": round(_slo.freshness(name), 6),
+        "outstanding_final": plane.outstanding(name),
+        "verdict": {"firing": bool(res.get("firing")),
+                    "episodes": int(res.get("episodes", 0)),
+                    "burn": round(res.get("burn", 0.0), 3),
+                    "budget_remaining":
+                        round(res.get("budget_remaining", 1.0), 4)},
+        "objectives": plane.objectives.to_dict(),
+    }
+
+
 def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
     """Full-pipeline throughput: raw chunks → split → device regex parse →
     route → serialize (blackhole), through the real queue/runner machinery —
@@ -925,7 +1022,7 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         if not sojourn:
             # scaling-sweep mode: throughput only, keep the window short
             return (pushed_bytes / dt / 1e6, None, None, None, None, None,
-                    alloc)
+                    alloc, None)
         make_group = _mk
         # event→flush sojourn: push single-chunk groups one at a time and time
         # arrival at the sink (the BASELINE p99 latency metric)
@@ -970,12 +1067,18 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
             },
             "process_workers": runner.thread_count,
         }
+        # loongslo: the freshness SLO plane's own sojourn measurement —
+        # sustained pace + 10x burst through the REAL stamp/observe
+        # plumbing.  Runs AFTER the trajectory snapshot (its groups must
+        # not skew the historical histograms' comparison) and BEFORE the
+        # conservation audit, so residual 0 covers the stamped window too
+        slo_doc = _collect_slo(pqm, p, bh, lambda: make_group(small))
         utilization = _collect_utilization(pqm, p, bh, runner)
         conservation = _collect_conservation(_ledger, max_lag_s)
         return (pushed_bytes / dt / 1e6,
                 sojourns[len(sojourns) // 2],
                 sojourns[int(len(sojourns) * 0.99)],
-                trajectory, utilization, conservation, alloc)
+                trajectory, utilization, conservation, alloc, slo_doc)
     finally:
         # ANY raise between init and the return (warm-up timeout,
         # drain incomplete, failed audit) must not leak the worker
@@ -986,6 +1089,8 @@ def bench_pipeline_e2e(n_lines=600000, thread_count=None, sojourn=True):
         mgr.stop_all()
         if sojourn:
             _ledger.disable()
+            from loongcollector_tpu.monitor import slo as _slo
+            _slo.disable()
 
 
 def _collect_conservation(_ledger, max_lag_s: float) -> dict:
@@ -2307,6 +2412,16 @@ def main():
         # activity + materialized-object counters; 0 materialized events
         # is the zero-materialization contract made visible
         extra["alloc"] = e2e3[6]
+        # loongslo: the SLO plane's OWN ingest→flush sojourn (send_ok),
+        # promoted next to the headline — sustained pace and 10x burst —
+        # with the freshness trajectory + burn-rate verdict under
+        # extra.slo (docs/observability.md#freshness-slo-plane)
+        if e2e3[7] is not None:
+            extra["event_to_flush_ms_p99_sustained"] = \
+                e2e3[7]["event_to_flush_ms_p99_sustained"]
+            extra["event_to_flush_ms_p99_burst10x"] = \
+                e2e3[7]["event_to_flush_ms_p99_burst10x"]
+            extra["slo"] = e2e3[7]
     # loongcolumn acceptance record: columnar-vs-dict side-by-side (same
     # host, same run) with in-bench byte-identity / >=2x / queue-wait /
     # conservation assertions (SystemExit on any miss), plus the

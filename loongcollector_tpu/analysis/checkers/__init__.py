@@ -15,6 +15,7 @@ from .metric_naming import MetricNamingChecker
 from .per_row_parse import PerRowParseChecker
 from .registry_consistency import RegistryConsistencyChecker
 from .reload_unsafe import ReloadUnsafeChecker
+from .stamp_propagation import StampPropagationChecker
 from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
 from .unbounded_window import UnboundedWindowChecker
@@ -34,6 +35,7 @@ _CHECKER_CLASSES = [
     HostBounceChecker,
     ReloadUnsafeChecker,
     RaceGuardChecker,
+    StampPropagationChecker,
 ]
 
 

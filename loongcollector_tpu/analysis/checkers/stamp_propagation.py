@@ -1,0 +1,142 @@
+"""stamp-propagation: derived event groups must carry the ingest stamp.
+
+The loongslo invariant (docs/observability.md#freshness-slo-plane) is that
+every ``PipelineEventGroup`` admitted at the ledger's ``ingest`` boundary
+carries a monotonic-ns stamp in its group metadata
+(``EventGroupMetaKey.INGEST_NS``), and every group DERIVED from it — split,
+re-routed, re-bucketed — inherits that stamp, so the sojourn observed at
+the terminal ack is ingest→flush, not last-copy→flush.  A derived group
+constructed without the stamp silently exits the freshness books: its
+events deliver, but the SLO plane never sees them land, so the per-pipeline
+freshness watermark (and the burn-rate alerts keyed on it) go quietly
+blind for that traffic slice.
+
+What marks a construction as "derived": the argument expression of a
+``PipelineEventGroup(...)`` call mentions another group's ``.source_buffer``
+— borrowing an existing arena is what split/re-route/re-bucket sites do,
+and is exactly the shape where events admitted under one stamp re-emerge
+in a fresh group.  Constructions over a NEW ``SourceBuffer()`` (inputs
+minting groups, aggregator rollups emitting at window close, the multiline
+carry flush) are genuinely new admissions — they are stamped at the ingest
+hook or via ``slo.ensure_stamp`` at the rollup's send boundary, and are
+deliberately not this checker's business.
+
+A function containing a derived construction must also contain a stamp
+carrier — any of:
+
+  1. a ``copy_meta_to`` call (the models-layer metadata copier: carries
+     ALL group metadata, the stamp included);
+  2. a ``_group_meta``/``_copy_group_meta`` helper call (the aggregator
+     family's per-bucket metadata copier);
+  3. a ``set_metadata`` call whose arguments mention ``INGEST_NS``
+     (manual re-stamping);
+  4. a call into the ``slo`` module (``slo.ensure_stamp`` /
+     ``slo.stamp_ingest`` — the site mints its own stamp).
+
+Function granularity is deliberate (the unledgered-drop argument): the
+copier often runs a line or two after the constructor, sometimes behind a
+helper — the rule is "this derivation path knows the stamp exists", not
+"the copy is adjacent".
+
+Escape: ``# loonglint: disable=stamp-propagation`` with a justification,
+for derived groups that never cross a terminal ack (debug/test scaffolding,
+groups consumed before the sender path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from ..core import Checker, Finding, ModuleInfo, attr_tail, call_name, \
+    iter_functions
+
+CHECK = "stamp-propagation"
+
+_COPY_TAILS = {"copy_meta_to", "_group_meta", "_copy_group_meta"}
+
+
+def _is_derived_construction(node: ast.Call) -> Optional[ast.Call]:
+    """The call constructs a PipelineEventGroup over another group's
+    arena: ``PipelineEventGroup(<expr involving .source_buffer>)``."""
+    name = call_name(node)
+    if name.rsplit(".", 1)[-1] != "PipelineEventGroup":
+        return None
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr == "source_buffer":
+                return node
+    return None
+
+
+def _carries_stamp(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = attr_tail(node)
+        if tail in _COPY_TAILS:
+            return True
+        if tail == "set_metadata":
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr == "INGEST_NS":
+                        return True
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str) \
+                            and "ingest" in sub.value.lower():
+                        return True
+        dotted = call_name(node)
+        if dotted.split(".", 1)[0] == "slo":
+            return True
+    return False
+
+
+class StampPropagationChecker(Checker):
+    name = CHECK
+    description = ("groups constructed over another group's source_buffer"
+                   " (split/re-route/re-bucket) must carry the loongslo"
+                   " ingest-stamp metadata — copy_meta_to/_group_meta/"
+                   "explicit re-stamp — or the derived events exit the"
+                   " freshness SLO books unobserved")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for qn, fn in _derivation_scopes(mod.tree):
+            sites = [node for node in ast.walk(fn)
+                     if isinstance(node, ast.Call)
+                     and _is_derived_construction(node)
+                     and not _in_nested_function(fn, node)]
+            if not sites:
+                continue
+            if _carries_stamp(fn):
+                continue
+            for node in sites:
+                yield Finding(
+                    CHECK, mod.relpath, node.lineno, node.col_offset,
+                    "group derived from another group's source_buffer with "
+                    "no metadata carrier (copy_meta_to/_group_meta/"
+                    "set_metadata(INGEST_NS)/slo.ensure_stamp) anywhere in "
+                    "the function: the ingest stamp is lost and the events "
+                    "leave the freshness SLO books",
+                    symbol=qn)
+
+
+def _derivation_scopes(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Every function, plus the module itself for top-level code."""
+    yield "<module>", tree
+    yield from iter_functions(tree)
+
+
+def _in_nested_function(scope: ast.AST, node: ast.Call) -> bool:
+    """True when `node` lives inside a function nested under `scope`
+    (including, for the module pseudo-scope, any function at all) — the
+    inner function is its own derivation scope and anchors itself."""
+    for fn in ast.walk(scope):
+        if fn is scope or not isinstance(fn, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(fn):
+            if sub is node:
+                return True
+    return False

@@ -110,6 +110,13 @@ class Application:
         if ledger.install_from_env():
             log.info("loongledger ACTIVE (audit=%s)",
                      ledger.auditor() is not None)
+        # loongslo: LOONG_SLO=1 turns on the end-to-end freshness SLO
+        # plane — ingest-stamped sojourn, burn-rate alerts, /debug/slo
+        # (docs/observability.md)
+        from .monitor import slo
+        if slo.install_from_env():
+            log.info("loongslo ACTIVE (evaluator=%s)",
+                     slo.evaluator() is not None)
         from .monitor.exposition import start_from_env as _expo_from_env
         self.exposition = _expo_from_env()
         from .runner.processor_runner import resolve_thread_count
@@ -360,6 +367,8 @@ class Application:
             self.exposition.stop()
         from . import prof
         prof.disable()                        # stop sampler, retire records
+        from .monitor import slo
+        slo.stop_evaluator()                  # SLO burn-rate thread, if any
         from .pipeline.plugin.checkpoint import get_default_store
         get_default_store().flush()
         # final checkpoint dump AFTER the flusher drain: FileServer.stop

@@ -29,7 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..pipeline.plugin.interface import PluginContext
 from ..pipeline.queue.sender_queue import SenderQueueItem
 from ..runner import ack_watermark
@@ -126,11 +126,13 @@ class AsyncSinkFlusher(HttpSinkFlusher):
             ledger.record(self._ledger_pipeline(), ledger.B_SERIALIZE,
                           n_events, len(body))
         spans = ack_watermark.spans_of(groups)
+        stamps = slo.stamps_of(groups)
         shed = None
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
                 shed = self._queue.popleft()      # oldest-first shedding
-            self._queue.append((body, time.monotonic(), n_events, spans))
+            self._queue.append((body, time.monotonic(), n_events, spans,
+                                stamps))
             self._qcv.notify()
         if shed is not None:
             # ledger + log OUTSIDE the queue lock (the ledger takes its
@@ -142,6 +144,8 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                       self.name, len(shed[0]))
             self._ledger_drop("queue_shed", shed[2], len(shed[0]))
             ack_watermark.ack_spans(shed[3])    # terminal for this copy
+            slo.observe_stamps(self._ledger_pipeline(), shed[4],
+                               slo.OUTCOME_DROP)
 
     def _requeue_payload(self, body: bytes, event_cnt: int = 0) -> bool:
         """Replayed disk-buffer payload re-enters the send queue with a
@@ -152,8 +156,9 @@ class AsyncSinkFlusher(HttpSinkFlusher):
         with self._qcv:
             if len(self._queue) >= QUEUE_CAP:
                 return False
-            # replayed payloads carry no spans: their spill already acked
-            self._queue.append((body, time.monotonic(), event_cnt, ()))
+            # replayed payloads carry no spans and no stamps: their spill
+            # was already the terminal for both planes
+            self._queue.append((body, time.monotonic(), event_cnt, (), ()))
             self._qcv.notify()
             return True
 
@@ -182,16 +187,19 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 # CONSERVATION_RESIDUAL alarm)
                 entry = self._queue.popleft()
                 self._spilling_events += entry[2]
-            body, born, events, spans = entry
+            body, born, events, spans, stamps = entry
             item = SenderQueueItem(body, len(body), flusher=self,
                                    queue_key=self.queue_key,
-                                   event_cnt=events, spans=spans)
+                                   event_cnt=events, spans=spans,
+                                   stamps=stamps)
             if not self.disk_buffer.spill(item, identity):
                 with self._qcv:
                     self._queue.appendleft(entry)   # buffer full: restore
                     self._spilling_events -= events
                 break
             ack_watermark.ack_spans(spans)    # durable spill = terminal
+            slo.observe_stamps(self._ledger_pipeline(), stamps,
+                               slo.OUTCOME_SPILL)
             with self._qcv:
                 # B_SPILL was recorded inside spill() — the terminal is on
                 # the books before the occupancy anchor drops
@@ -250,7 +258,7 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 if not self._queue:
                     continue
                 item = self._queue[0]
-                body, born, n_events, spans = item
+                body, born, n_events, spans, stamps = item
             if self.breaker is not None and not self.breaker.allow():
                 time.sleep(min(delay, 1.0))
                 continue
@@ -319,6 +327,9 @@ class AsyncSinkFlusher(HttpSinkFlusher):
                 # delivered OR permanently discarded: terminal for the
                 # SOURCE spans — the checkpoint watermark advances
                 ack_watermark.ack_spans(spans)
+                slo.observe_stamps(self._ledger_pipeline(), stamps,
+                                   slo.OUTCOME_SEND_OK if ok
+                                   else slo.OUTCOME_DROP)
                 if ledger.is_on():
                     if ok:
                         ledger.record(self._ledger_pipeline(),

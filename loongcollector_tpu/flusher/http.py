@@ -14,7 +14,7 @@ from urllib.parse import urlparse
 import time
 
 from ..models import EventGroupMetaKey, PipelineEventGroup
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..runner import ack_watermark
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
@@ -147,15 +147,20 @@ class FlusherHTTP(Flusher):
         item = SenderQueueItem(payload, len(data), flusher=self,
                                queue_key=self.queue_key,
                                tag={"eo_cp": cp}, event_cnt=len(group),
-                               spans=ack_watermark.spans_of([group]))
+                               spans=ack_watermark.spans_of([group]),
+                               stamps=slo.stamps_of([group]))
         if self.sender_queue is None:
             self._ledger_drop("no_sender_queue", len(group))
             ack_watermark.ack_spans(item.spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), item.stamps,
+                               slo.OUTCOME_DROP)
         elif not self.sender_queue.push(item):
             # refused push (queue retired mid-hot-reload): terminal —
             # nothing downstream will ever dispatch or count this payload
             self._ledger_drop("queue_retired", len(group))
             ack_watermark.ack_spans(item.spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), item.stamps,
+                               slo.OUTCOME_DROP)
         return True
 
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
@@ -173,13 +178,18 @@ class FlusherHTTP(Flusher):
         payload = self.compressor.compress(data)
         item = SenderQueueItem(payload, raw_size, flusher=self,
                                queue_key=self.queue_key, event_cnt=n_events,
-                               spans=ack_watermark.spans_of(groups))
+                               spans=ack_watermark.spans_of(groups),
+                               stamps=slo.stamps_of(groups))
         if self.sender_queue is None:
             self._ledger_drop("no_sender_queue", n_events)
             ack_watermark.ack_spans(item.spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), item.stamps,
+                               slo.OUTCOME_DROP)
         elif not self.sender_queue.push(item):
             self._ledger_drop("queue_retired", n_events)
             ack_watermark.ack_spans(item.spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), item.stamps,
+                               slo.OUTCOME_DROP)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
         from .http_base import check_breaker

@@ -14,7 +14,7 @@ import itertools
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..models import PipelineEventGroup
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..runner import ack_watermark
 from ..pipeline.batch.batcher import Batcher
 from ..pipeline.batch.flush_strategy import FlushStrategy
@@ -86,11 +86,16 @@ class HttpSinkFlusher(Flusher):
     def _serialize_and_push(self, groups: List[PipelineEventGroup]) -> None:
         n_events = sum(len(g) for g in groups)
         spans = ack_watermark.spans_of(groups)
+        # serialization erases group identity: the ingest stamps ride the
+        # item (the spans shape) so the real terminal can observe sojourn
+        stamps = slo.stamps_of(groups)
         built = self.build_payload(groups)
         if built is None:
             # the sink's payload builder skipped the whole batch: terminal
             self._ledger_drop("payload_skipped", n_events)
             ack_watermark.ack_spans(spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), stamps,
+                               slo.OUTCOME_DROP)
             return
         body, item_headers = built
         raw_size = len(body)
@@ -101,15 +106,20 @@ class HttpSinkFlusher(Flusher):
         item = SenderQueueItem(payload, raw_size, flusher=self,
                                queue_key=self.queue_key,
                                tag={"headers": item_headers},
-                               event_cnt=n_events, spans=spans)
+                               event_cnt=n_events, spans=spans,
+                               stamps=stamps)
         if self.sender_queue is None:
             # no sender queue wired (flusher stopped mid-flush): terminal
             self._ledger_drop("no_sender_queue", n_events)
             ack_watermark.ack_spans(spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), stamps,
+                               slo.OUTCOME_DROP)
         elif not self.sender_queue.push(item):
             # refused push (queue retired mid-hot-reload): terminal
             self._ledger_drop("queue_retired", n_events)
             ack_watermark.ack_spans(spans, force=True)
+            slo.observe_stamps(self._ledger_pipeline(), stamps,
+                               slo.OUTCOME_DROP)
 
     def build_request(self, item: SenderQueueItem) -> HttpRequest:
         check_breaker(self)

@@ -176,6 +176,9 @@ class SharedUDPServer:
                 log.warning("no sink for dispatch tag %r", key)
                 continue
             out = PipelineEventGroup(group.source_buffer)
+            # derived groups inherit the parent's metadata — including the
+            # loongslo ingest stamp, which must survive the re-route
+            group.copy_meta_to(out)
             out.events.extend(events)
             sink(out)
 

@@ -106,6 +106,9 @@ class EventGroupMetaKey(enum.Enum):
     # replay dedup verifies content identity, not just span containment
     LOG_FILE_CRC32 = "log.file.crc32"
     IS_REPLAY = "internal.is.replay"
+    # loongslo: monotonic-ns ingest stamp minted at the B_INGEST admit —
+    # derived groups must carry it (loonglint: stamp-propagation)
+    INGEST_NS = "internal.ingest.ns"
     SOURCE_ID = "source_id"
     TOPIC = "topic"
     HOST_NAME = "host.name"

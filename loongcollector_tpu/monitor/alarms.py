@@ -123,6 +123,10 @@ class AlarmType(str, enum.Enum):
     # partials are being evicted (emitted early) — rollup windows for the
     # evicted keys are split, not lost
     AGG_WINDOW_EVICTION = "AGG_WINDOW_EVICTION_ALARM"
+    # loongslo: a pipeline's freshness error budget is burning faster than
+    # the multi-window multi-burn-rate policy tolerates — raised once per
+    # episode with the stage-attributed latency-budget breakdown attached
+    SLO_BURN_RATE = "SLO_BURN_RATE_ALARM"
 
 
 class _AlarmRecord:

@@ -103,6 +103,13 @@ def render() -> str:
         _ledger.export_refresh()
     except Exception:  # noqa: BLE001
         pass
+    try:
+        # loongslo freshness/burn gauges mirror the same way: a scrape is
+        # never staler than one render
+        from . import slo as _slo
+        _slo.export_refresh()
+    except Exception:  # noqa: BLE001
+        pass
     by_name: Dict[Tuple[str, str], List[str]] = {}
 
     def emit(name: str, typ: str, line: str) -> None:
@@ -348,7 +355,8 @@ _INDEX = (b"loongcollector_tpu exposition endpoint\n"
           b"  /debug/status  running-status JSON\n"
           b"  /debug/pprof   folded stacks (loongprof)\n"
           b"  /debug/flight  flight-recorder ring JSON\n"
-          b"  /debug/ledger  event-conservation ledger JSON (loongledger)\n")
+          b"  /debug/ledger  event-conservation ledger JSON (loongledger)\n"
+          b"  /debug/slo     freshness-SLO plane JSON (loongslo)\n")
 
 _PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_CT = "application/json; charset=utf-8"
@@ -381,6 +389,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 from . import ledger as _ledger
                 self._reply(200, _JSON_CT,
                             (json.dumps(_ledger.debug_document(),
+                                        sort_keys=True,
+                                        default=str) + "\n").encode())
+            elif path == "/debug/slo":
+                from . import slo as _slo
+                self._reply(200, _JSON_CT,
+                            (json.dumps(_slo.debug_document(),
                                         sort_keys=True,
                                         default=str) + "\n").encode())
             elif path == "/debug/pprof":

@@ -165,6 +165,13 @@ def refresh() -> None:
     except Exception:  # noqa: BLE001
         pass
     try:
+        # loongslo: mirror freshness/burn-rate gauges on the same cadence
+        # (no-op while the SLO plane is off)
+        from . import slo
+        slo.export_refresh()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..input.prometheus.scraper import PrometheusInputRunner
         runner = PrometheusInputRunner._instance
         if runner is not None:

@@ -16,7 +16,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ..models import PipelineEventGroup
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..monitor.metrics import MetricsRecord
 from ..runner import ack_watermark
 from ..utils.logger import get_logger
@@ -440,6 +440,12 @@ class CollectionPipeline:
             consumed = [g for g in groups if id(g) not in staged_ids]
             if consumed:
                 ack_watermark.ack_groups(consumed, force=True)
+                if slo.is_on():
+                    # absorbed into rollup state: the stamp retires WITHOUT
+                    # a sojourn sample — the rollup minted at window close
+                    # gets its own stamp (_send_direct) and carries the
+                    # delivery latency from there
+                    slo.retire_groups(consumed)
             groups = staged
             if led and not getattr(self.aggregator,
                                    "ledger_self_accounting", False):
@@ -461,6 +467,8 @@ class CollectionPipeline:
             if group.empty():
                 # filtered to nothing: terminal for its SOURCE span
                 ack_watermark.ack_groups([group], force=True)
+                if slo.is_on():
+                    slo.retire_groups([group])
                 continue
             ok = self._route_group(group, led) and ok
         return ok
@@ -473,6 +481,8 @@ class CollectionPipeline:
             if led:
                 ledger.record(self.name, ledger.B_DROP, len(group),
                               group.data_size(), tag="no_route")
+            if slo.is_on():
+                slo.observe_groups(self.name, [group], slo.OUTCOME_DROP)
         elif len(idxs) > 1:
             # every extra matching flusher mints a copy of the group's
             # events — raise the span's terminal refcount BEFORE any copy
@@ -482,6 +492,10 @@ class CollectionPipeline:
             if led:
                 ledger.record(self.name, ledger.B_FANOUT,
                               (len(idxs) - 1) * len(group))
+            if slo.is_on():
+                # the ingest stamp's refcount mirrors the span fanout: each
+                # copy's terminal observes its own sojourn
+                slo.note_fanout(group, len(idxs))
         ok = True
         for idx in idxs:
             ok = self.flushers[idx].send(group) and ok
@@ -493,7 +507,13 @@ class CollectionPipeline:
         for group in groups:
             if group.empty():
                 ack_watermark.ack_groups([group], force=True)
+                if slo.is_on():
+                    slo.retire_groups([group])
                 continue
+            if slo.is_on():
+                # aggregator rollups are minted stampless (the checker's
+                # explicit exemption): window close IS their ingest instant
+                slo.ensure_stamp(self.name, group)
             if led:
                 if not self_acct:
                     # aggregator-held events released by timeout/final

@@ -12,7 +12,7 @@ from typing import Any, Dict, List
 
 from ... import prof, trace
 from ...models import PipelineEventGroup, columnar_enabled
-from ...monitor import ledger
+from ...monitor import ledger, slo
 from ...monitor.metrics import MetricsRecord
 from ...runner import ack_watermark
 from .interface import Flusher, Input, PluginContext, Processor
@@ -220,6 +220,11 @@ class FlusherInstance:
                 # delivery (or refusal) completed inside send(): terminal
                 # for the SOURCE span regardless of ledger state
                 ack_watermark.ack_groups([group])
+                if slo.is_on():
+                    slo.observe_groups(
+                        self.plugin._ledger_pipeline(), [group],
+                        slo.OUTCOME_SEND_OK if result
+                        else slo.OUTCOME_DROP)
             if ledger.is_on() and self.plugin.ledger_terminal:
                 # inline-terminal sink: delivery completed (or was refused)
                 # inside send() itself — ledger it here, once, centrally

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ...models import PipelineEventGroup
-from ...monitor import ledger
+from ...monitor import ledger, slo
 from ...runner import ack_watermark
 
 
@@ -170,6 +170,9 @@ class Flusher(Plugin):
             # a reasoned discard is terminal for the SOURCE span too: the
             # checkpoint watermark must advance past it (ledger on or off)
             ack_watermark.ack_groups([group], force=True)
+            if slo.is_on():
+                slo.observe_groups(self._ledger_pipeline(), [group],
+                                   slo.OUTCOME_DROP)
         if not ledger.is_on():
             return
         if group is not None:
@@ -204,11 +207,17 @@ class Flusher(Plugin):
             # write): the SOURCE spans are done — ack so the checkpoint
             # can advance instead of pinning on a dead batch
             ack_watermark.ack_groups(groups)
+            if slo.is_on():
+                slo.observe_groups(self._ledger_pipeline(), groups,
+                                   slo.OUTCOME_DROP)
             if led:
                 ledger.record(self._ledger_pipeline(), ledger.B_DROP,
                               n_events, n_bytes, tag="flush_write_failed")
             return False
         ack_watermark.ack_groups(groups)
+        if slo.is_on():
+            slo.observe_groups(self._ledger_pipeline(), groups,
+                               slo.OUTCOME_SEND_OK)
         if led:
             ledger.record(self._ledger_pipeline(), ledger.B_SEND_OK,
                           n_events, n_bytes, tag=self.name)

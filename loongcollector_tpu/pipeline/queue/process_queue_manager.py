@@ -11,7 +11,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ...models import PipelineEventGroup
-from ...monitor import ledger
+from ...monitor import ledger, slo
 from .bounded_queue import (DEFAULT_MAX_BYTES, BoundedProcessQueue,
                             CircularProcessQueue)
 
@@ -75,16 +75,23 @@ class ProcessQueueManager:
         # REFUSED — with or without the ledger, a group admitted into an
         # orphaned queue object no worker polls is a silent loss
         q.retire()
-        if ledger.is_on():
+        led = ledger.is_on()
+        slo_on = slo.is_on()
+        if led or slo_on:
             # groups still queued die with their queue (pipeline removed
             # without drain): a terminal, reason-tagged discard.  retire()
             # ran first, so a worker holding a stale priority snapshot
             # cannot pop a group after we count it dead (two terminals)
             with q._lock:
                 dead = list(q._items)
-            for g in dead:
-                ledger.record(q.pipeline_name, ledger.B_DROP,
-                              len(g), g.data_size(), tag="queue_deleted")
+            if led:
+                for g in dead:
+                    ledger.record(q.pipeline_name, ledger.B_DROP,
+                                  len(g), g.data_size(), tag="queue_deleted")
+            if slo_on:
+                # their stamps terminate here too, or the dead pipeline's
+                # freshness watermark would age forever
+                slo.observe_groups(q.pipeline_name, dead, slo.OUTCOME_DROP)
 
     def get_queue(self, key: int) -> Optional[BoundedProcessQueue]:
         with self._lock:
@@ -103,6 +110,12 @@ class ProcessQueueManager:
             q = self._queues.get(key)
         if q is None:
             return False
+        # loongslo: the ingest stamp is minted at this same single admit
+        # hook, BEFORE the push — a consumer popping the group immediately
+        # must never race a post-push metadata write.  A refused push is
+        # cancelled below (the caller rolls the group back: not admitted)
+        if slo.is_on():
+            slo.stamp_ingest(q.pipeline_name, group)
         pushed = q.push(group)
         if pushed:
             # loongledger ingest boundary: every input funnels through this
@@ -115,6 +128,8 @@ class ProcessQueueManager:
                               len(group), group.data_size())
             with self._data_cv:
                 self._data_cv.notify()
+        elif slo.is_on():
+            slo.cancel_group(group)
         return pushed
 
     def is_valid_to_push(self, key: int) -> bool:

@@ -26,11 +26,12 @@ class SendingStatus(enum.Enum):
 class SenderQueueItem:
     __slots__ = ("data", "raw_size", "flusher", "queue_key", "status",
                  "enqueue_time", "try_count", "last_send_time", "tag",
-                 "in_flight", "event_cnt", "spans")
+                 "in_flight", "event_cnt", "spans", "stamps")
 
     def __init__(self, data: bytes, raw_size: int, flusher=None,
                  queue_key: int = 0, tag: Optional[dict] = None,
-                 event_cnt: int = 0, spans: tuple = ()):
+                 event_cnt: int = 0, spans: tuple = (),
+                 stamps: tuple = ()):
         self.data = data
         self.raw_size = raw_size
         self.flusher = flusher
@@ -51,6 +52,10 @@ class SenderQueueItem:
         # drop) acks them into the checkpoint watermark; () = no file
         # provenance (http input, replay) and nothing to ack
         self.spans = spans
+        # loongslo: ingest stamps (monotonic ns) of the groups serialized
+        # into this payload — the same terminal boundary observes their
+        # ingest→terminal sojourn; () = stampless (plane off, replay)
+        self.stamps = stamps
 
 
 class SenderQueue:
@@ -235,7 +240,7 @@ class SenderQueueManager:
         with self._lock:
             q = self._queues.pop(key, None)
         if q is not None:
-            from ...monitor import ledger
+            from ...monitor import ledger, slo
             # serialized payloads still queued die with their queue
             # (direct delete, not the drain-then-GC path): terminal.
             # SENDING items are skipped — their delivery callback is
@@ -247,13 +252,20 @@ class SenderQueueManager:
             # a stale queue list cannot dispatch from a deleted queue
             # whether or not the ledger is counting
             led = ledger.is_on()
+            slo_on = slo.is_on()
             with q._lock:
                 q._retired = True
-                dead = ([(i.event_cnt, len(i.data)) for i in q._items
-                         if i.status is SendingStatus.IDLE] if led else [])
-            for events, nbytes in dead:
-                ledger.record(q.pipeline_name, ledger.B_DROP,
-                              events, nbytes, tag="queue_deleted")
+                dead = ([(i.event_cnt, len(i.data), i.stamps)
+                         for i in q._items
+                         if i.status is SendingStatus.IDLE]
+                        if (led or slo_on) else [])
+            for events, nbytes, stamps in dead:
+                if led:
+                    ledger.record(q.pipeline_name, ledger.B_DROP,
+                                  events, nbytes, tag="queue_deleted")
+                if slo_on:
+                    slo.observe_stamps(q.pipeline_name, stamps,
+                                       slo.OUTCOME_DROP)
 
     def get_available_items(self, limit_per_queue: int = 10
                             ) -> List[SenderQueueItem]:

@@ -23,7 +23,7 @@ from typing import Dict, Optional, Tuple
 import heapq
 
 from .. import prof, trace
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..monitor.metrics import MetricsRecord
 from ..pipeline.queue.limiter import RateLimiter
 from ..pipeline.queue.sender_queue import (SenderQueueItem,
@@ -267,6 +267,9 @@ class FlusherRunner:
         # durable on disk IS a terminal for the SOURCE span: the replay
         # path owns delivery from here, the checkpoint may advance
         ack_watermark.ack_spans(item.spans)
+        if slo.is_on():
+            slo.observe_stamps(self._ledger_pipeline(item), item.stamps,
+                               slo.OUTCOME_SPILL)
         self.spilled_items.add(1)
         if breaker is not None:
             breaker.note_spilled()
@@ -362,6 +365,9 @@ class FlusherRunner:
                 ledger.record(self._ledger_pipeline(item), ledger.B_DROP,
                               item.event_cnt, len(item.data), tag="no_sink")
             ack_watermark.ack_spans(item.spans)
+            if slo.is_on():
+                slo.observe_stamps(self._ledger_pipeline(item), item.stamps,
+                                   slo.OUTCOME_DROP)
             self._release_limiters(item)
             self.sqm.remove_item(item)
             return
@@ -489,6 +495,10 @@ class FlusherRunner:
         # sink accepted (or permanently rejected) the payload: terminal
         # for its SOURCE spans either way — the watermark moves
         ack_watermark.ack_spans(item.spans)
+        if slo.is_on():
+            slo.observe_stamps(self._ledger_pipeline(item), item.stamps,
+                               slo.OUTCOME_SEND_OK if verdict == "ok"
+                               else slo.OUTCOME_DROP)
         self.out_items.add(1)
         self.out_bytes.add(len(item.data))
         self.sqm.remove_item(item)
@@ -535,3 +545,6 @@ class FlusherRunner:
                                   item.event_cnt, len(item.data),
                                   tag="retry_orphaned")
                 ack_watermark.ack_spans(item.spans)
+                if slo.is_on():
+                    slo.observe_stamps(self._ledger_pipeline(item),
+                                       item.stamps, slo.OUTCOME_DROP)

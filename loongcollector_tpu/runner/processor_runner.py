@@ -52,7 +52,7 @@ from typing import List, Optional, Tuple
 
 from .. import prof, trace
 from ..models import EventGroupMetaKey, PipelineEventGroup
-from ..monitor import ledger
+from ..monitor import ledger, slo
 from ..monitor.alarms import AlarmLevel, AlarmManager, AlarmType
 from ..monitor.metrics import MetricsRecord
 from ..ops import chip_lanes
@@ -724,15 +724,18 @@ class ProcessorRunner:
         if pipeline is None:
             log.warning("no pipeline for queue key %d; dropping group", key)
             ack_watermark.ack_groups([group], force=True)
-            if ledger.is_on():
+            if ledger.is_on() or slo.is_on():
                 q = self.pqm.get_queue(key)
                 # hot reload can delete the queue between pop and here:
                 # attribute the drop via the manager's tombstone so the
                 # ingesting pipeline's books still balance
                 name = (q.pipeline_name if q is not None
                         else self.pqm.retired_pipeline_name(key))
-                ledger.record(name, ledger.B_DROP, n_events,
-                              group.data_size(), tag="no_pipeline")
+                if ledger.is_on():
+                    ledger.record(name, ledger.B_DROP, n_events,
+                                  group.data_size(), tag="no_pipeline")
+                if slo.is_on():
+                    slo.observe_groups(name, [group], slo.OUTCOME_DROP)
             return None
         self.in_groups.add(1)
         self.in_events.add(n_events)
@@ -794,6 +797,8 @@ class ProcessorRunner:
         without this record the conservation residual would read the bug
         as a silent loss instead of an attributed drop."""
         ack_watermark.ack_groups(groups, force=True)
+        if slo.is_on():
+            slo.observe_groups(pipeline.name, groups, slo.OUTCOME_DROP)
         ledger.record(pipeline.name, ledger.B_DROP,
                       sum(len(g) for g in groups), tag="process_error")
 

@@ -16,7 +16,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== loonglint =="
-# --budget caps the 13-checker sweep's own wall clock: the static gate
+# --budget caps the 14-checker sweep's own wall clock: the static gate
 # stays a fast-feedback tool, and a checker that regresses to quadratic
 # work fails here before it annoys every future lint run (per-checker
 # timings: `python -m loongcollector_tpu.analysis --json` checker_seconds)
@@ -34,6 +34,11 @@ echo "== ledger-overhead smoke (loongledger) =="
 # with LOONG_LEDGER off the conservation-accounting hooks must stay one
 # branch per hook — same paired-min >5% gate as the trace/prof smokes
 JAX_PLATFORMS=cpu python scripts/ledger_overhead.py
+
+echo "== slo-overhead smoke (loongslo) =="
+# with LOONG_SLO off the ingest-stamp / terminal-observe hooks must stay
+# one branch per hook — same paired-min >5% gate as the other planes
+JAX_PLATFORMS=cpu python scripts/slo_overhead.py
 
 echo "== multi-worker smoke (loongshard) =="
 # the disabled-trace overhead gate and the metric-naming checker must hold

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""loongslo overhead smoke gate (wired into scripts/lint.sh).
+
+The loongslo contract (docs/observability.md#freshness-slo-plane) follows
+the chaos/trace/prof/ledger idiom: with ``LOONG_SLO`` off, every hook —
+``slo.is_on``, ``slo.stamp_ingest``, ``slo.stamps_of``,
+``slo.observe_stamps`` / ``observe_groups`` — is one module-global read +
+branch.  Same two-layer proof as scripts/ledger_overhead.py, same
+paired-min method:
+
+1. **Per-hook microbench** — ns/call of the disabled hooks under a
+   generous absolute ceiling (a disabled path that allocates, locks or
+   stamps metadata blows through it immediately).
+
+2. **Synthetic pipeline** — the stamp-hooked hot path (the
+   ProcessQueueManager B_INGEST admit + pop + ProcessorInstance split
+   stage + SLS serialization + the terminal observe hook) timed with
+   hooks as shipped (plane disabled) vs the same hooks monkeypatched to
+   bare no-ops, interleaved paired rounds; the gate is the MINIMUM paired
+   disabled/baseline ratio (>5% in EVERY round fails).  The enabled time
+   is reported informationally — enabling MAY cost, disabling MUST NOT.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+N_GROUPS = 400
+EVENTS_PER_GROUP = 24
+REPEATS = 9
+MAX_DISABLED_OVER_BASELINE = 1.05      # the 5% gate
+MAX_HOOK_NS = 2_000                    # catastrophic-regression ceiling
+
+
+def bench_hooks():
+    from loongcollector_tpu.monitor import slo
+    slo.disable()
+    out = {}
+    for label, fn in (("is_on", slo.is_on),
+                      ("stamp_ingest", lambda: slo.stamp_ingest("p", None)),
+                      ("stamps_of", lambda: slo.stamps_of(())),
+                      ("observe_stamps", lambda: slo.observe_stamps(
+                          "p", (), slo.OUTCOME_SEND_OK))):
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / n)
+        out[label] = best * 1e9
+    return out
+
+
+def make_runner():
+    from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+    from loongcollector_tpu.monitor import slo
+    from loongcollector_tpu.pipeline.plugin.instance import ProcessorInstance
+    from loongcollector_tpu.pipeline.plugin.interface import PluginContext
+    from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+        ProcessQueueManager
+    from loongcollector_tpu.pipeline.serializer.sls_serializer import \
+        SLSEventGroupSerializer
+    from loongcollector_tpu.processor.split_log_string import \
+        ProcessorSplitLogString
+    inst = ProcessorInstance(ProcessorSplitLogString(), "split/slo_overhead")
+    assert inst.init({}, PluginContext("slo_overhead"))
+    ser = SLSEventGroupSerializer()
+    line = b"2024-01-02 03:04:05 INFO request handled ok\n"
+    data = line * EVENTS_PER_GROUP
+    pqm = ProcessQueueManager()
+    pqm.create_or_reuse_queue(1, capacity=4, pipeline_name="slo_overhead")
+
+    def run_timed():
+        t0 = time.perf_counter()
+        for _ in range(N_GROUPS):
+            sb = SourceBuffer(len(data) + 64)
+            g = PipelineEventGroup(sb)
+            g.add_raw_event(1).set_content(sb.copy_string(data))
+            # the stamped admit (the single B_INGEST hook) → pop → stage →
+            # payload → terminal observe: every loongslo hook in path
+            assert pqm.push_queue(1, g)
+            _, g = pqm.pop_item(timeout=0)
+            inst.process([g])
+            ser.serialize([g])
+            if slo.is_on():
+                slo.observe_groups("slo_overhead", [g],
+                                   slo.OUTCOME_SEND_OK)
+            assert len(g) == EVENTS_PER_GROUP
+        return time.perf_counter() - t0
+
+    return inst, run_timed
+
+
+def main() -> int:
+    from loongcollector_tpu.monitor import slo
+    hooks = bench_hooks()
+    print("disabled hook cost (ns/call): "
+          + ", ".join(f"{k}={v:.0f}" for k, v in hooks.items()))
+    bad = {k: v for k, v in hooks.items() if v > MAX_HOOK_NS}
+    if bad:
+        print(f"FAIL: disabled hooks over {MAX_HOOK_NS} ns: {bad}")
+        return 1
+
+    import gc
+    inst, run_timed = make_runner()
+    noop_false = lambda: False                        # noqa: E731
+    noop_none = lambda *a, **k: None                  # noqa: E731
+    noop_empty = lambda *a, **k: ()                   # noqa: E731
+    real = (slo.is_on, slo.stamp_ingest, slo.cancel_group, slo.stamps_of,
+            slo.observe_stamps, slo.observe_groups)
+
+    def restore():
+        (slo.is_on, slo.stamp_ingest, slo.cancel_group, slo.stamps_of,
+         slo.observe_stamps, slo.observe_groups) = real
+
+    def set_baseline():
+        slo.disable()
+        slo.is_on = noop_false
+        slo.stamp_ingest = noop_none
+        slo.cancel_group = noop_none
+        slo.stamps_of = noop_empty
+        slo.observe_stamps = noop_none
+        slo.observe_groups = noop_none
+
+    def set_disabled():
+        restore()
+        slo.disable()
+
+    def set_enabled():
+        restore()
+        slo.enable()
+
+    # Paired rounds, min ratio across rounds: a REAL disabled-path
+    # regression is systematic and survives every pairing; co-tenant CPU
+    # steal on a shared core does not (see scripts/ledger_overhead.py).
+    dis_ratios, en_ratios = [], []
+    try:
+        run_timed()                                   # warm the path
+        for i in range(REPEATS):
+            pair = [("baseline", set_baseline), ("disabled", set_disabled)]
+            if i % 2:                                 # kill position bias
+                pair.reverse()
+            times = {}
+            for name, setup in pair + [("enabled", set_enabled)]:
+                setup()
+                gc.collect()
+                times[name] = run_timed()
+                slo.disable()
+            dis_ratios.append(times["disabled"] / times["baseline"])
+            en_ratios.append(times["enabled"] / times["baseline"])
+    finally:
+        restore()
+        slo.disable()
+        inst.metrics.mark_deleted()
+
+    ratio = min(dis_ratios)
+    print(f"{N_GROUPS}x{EVENTS_PER_GROUP}-event synthetic pipeline, "
+          f"{REPEATS} paired rounds: "
+          f"disabled/baseline min={ratio:.3f} "
+          f"median={sorted(dis_ratios)[len(dis_ratios) // 2]:.3f}  "
+          f"enabled/baseline min={min(en_ratios):.3f}")
+    if ratio > MAX_DISABLED_OVER_BASELINE:
+        print(f"FAIL: disabled-path overhead {(ratio - 1) * 100:.1f}% "
+              f"> {(MAX_DISABLED_OVER_BASELINE - 1) * 100:.0f}% in every "
+              "round — the disabled SLO plane must stay one branch per hook")
+        return 1
+    print("slo overhead OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

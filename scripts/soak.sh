@@ -38,6 +38,15 @@ echo "== chaos soak: extended seed matrix (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_soak.py \
     -q -m slow -p no:cacheprovider
 
+echo "== slo burn-rate storm: one seeded breaker-open episode (loongslo) =="
+# a seeded http_sink.send storm with the freshness SLO plane live: exactly
+# one SLO_BURN_RATE alarm per episode, sink hop dominant in the budget
+# breakdown, alert clears after the breaker re-closes (full 8-seed matrix
+# runs in tier-1 via tests/test_loongslo.py)
+JAX_PLATFORMS=cpu python -m pytest \
+    "tests/test_loongslo.py::TestSinkStormSLO" \
+    -q -p no:cacheprovider -k "[42]"
+
 echo "== crash storm: 8-seed SIGKILL matrix (loongcrash) =="
 # kill the real agent at every seeded pipeline boundary (ingest, queue
 # push, send, spill), restart, drain: sink ⊇ corpus byte-for-byte with

@@ -1,0 +1,761 @@
+"""loongslo: the end-to-end freshness SLO plane (ISSUE 18 acceptance).
+
+  * every group admitted at the single B_INGEST hook carries a
+    monotonic-ns ingest stamp; derived groups inherit it, fanout
+    refcounts it, and every terminal the ack watermark enumerates
+    (send_ok / spill / drop) observes the ingest→terminal sojourn;
+  * ``pipeline_freshness_seconds`` is EXACTLY 0.0 on an idle/drained
+    pipeline and survives a hot-reload generation handoff (name-keyed);
+  * the multi-window multi-burn-rate evaluator raises
+    ``AlarmType.SLO_BURN_RATE`` ONCE per episode with a stage-attributed
+    budget breakdown, and clears once the short windows calm down;
+  * an 8-seed breaker-open sink storm trips exactly one episode with the
+    sink hop dominant; the same storm without faults trips nothing and
+    conserves (ledger residual 0) with the plane live;
+  * the disabled plane is inert (the scripts/slo_overhead.py contract)
+    and the chaos schedule stays prefix-deterministic with SLO on.
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from loongcollector_tpu import chaos
+from loongcollector_tpu import trace
+from loongcollector_tpu.chaos import ChaosPlan, FaultSpec
+from loongcollector_tpu.models import PipelineEventGroup, SourceBuffer
+from loongcollector_tpu.models.event_group import EventGroupMetaKey
+from loongcollector_tpu.monitor import exposition, ledger, slo
+from loongcollector_tpu.monitor.alarms import AlarmManager, AlarmType
+from loongcollector_tpu.monitor.metrics import WriteMetrics
+from loongcollector_tpu.monitor.slo import SloObjectives
+from loongcollector_tpu.pipeline.queue.process_queue_manager import \
+    ProcessQueueManager
+from loongcollector_tpu.pipeline.queue.sender_queue import (
+    SenderQueueItem, SenderQueueManager)
+from loongcollector_tpu.prof import flight
+from loongcollector_tpu.runner import flusher_runner as fr_mod
+from loongcollector_tpu.runner.circuit import BreakerState
+from loongcollector_tpu.runner.disk_buffer import DiskBufferWriter
+from loongcollector_tpu.runner.flusher_runner import FlusherRunner
+from loongcollector_tpu.runner.http_sink import HttpSink
+
+from conftest import wait_for
+
+SEEDS = (3, 7, 11, 23, 42, 97, 1337, 20240803)
+
+
+@pytest.fixture(autouse=True)
+def _slo_clean():
+    """No plane, plan, tracer or ledger leaks between tests; the alarm
+    singleton and flight ring start (and end) drained."""
+    chaos.reset()
+    trace.disable()
+    ledger.disable()
+    slo.disable()
+    AlarmManager.instance().flush()
+    flight.recorder().reset()
+    yield
+    chaos.reset()
+    trace.disable()
+    ledger.disable()
+    slo.disable()
+    AlarmManager.instance().flush()
+    flight.recorder().reset()
+
+
+@pytest.fixture()
+def fast_retries(monkeypatch):
+    """Soak-speed backoff so a faulted storm resolves in seconds."""
+    monkeypatch.setattr(fr_mod, "RETRY_BASE_S", 0.02)
+    monkeypatch.setattr(fr_mod, "RETRY_MAX_S", 0.25)
+
+
+# ---------------------------------------------------------------------------
+# harness (the tests/test_chaos_soak.py storm shape, with the plane live)
+
+
+class _RecordingHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.server.rec_lock:
+            self.server.received.add(bytes(body))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def recording_server():
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                             _RecordingHandler)
+    server.received = set()
+    server.rec_lock = threading.Lock()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server
+    server.shutdown()
+
+
+class _FakeFlusher:
+    name = "flusher_fake"
+    plugin_id = "flusher_fake/0"
+    context = None
+    sender_queue = None
+    queue_key = 0
+
+    def __init__(self, url):
+        self.url = url
+
+    def build_request(self, item):
+        from loongcollector_tpu.flusher.http import HttpRequest
+        return HttpRequest("POST", self.url, {}, item.data, timeout=5)
+
+    def on_send_done(self, item, status, body):
+        if 200 <= status < 300:
+            return "ok"
+        if status in (429, 500, 502, 503, 504) or status <= 0:
+            return "retry"
+        return "drop"
+
+    def spill_identity(self):
+        return {"pipeline": "t", "flusher_type": self.name,
+                "plugin_id": self.plugin_id}
+
+
+def _mk_group(data: bytes = b"") -> PipelineEventGroup:
+    sb = SourceBuffer(len(data) + 64)
+    g = PipelineEventGroup(sb)
+    if data:
+        g.add_raw_event(1).set_content(sb.copy_string(data))
+    return g
+
+
+def _slo_hist_count(pipeline: str, outcome: str) -> int:
+    """Observed sample count in the per-(pipeline, outcome)
+    event_to_flush_ms histogram, via the public record registry."""
+    for rec in WriteMetrics.instance().records():
+        if (rec.category == "slo"
+                and rec.labels.get("pipeline") == pipeline
+                and rec.labels.get("outcome") == outcome):
+            for h in rec.histograms():
+                if h.name == "event_to_flush_ms":
+                    return h.snapshot()["count"]
+    return 0
+
+
+#: storm objectives: one long=short window pair covering the whole storm
+#: at a low burn threshold — any spilled/undelivered payload burns far
+#: past it, while a fault-free storm reads burn 0.0 under the same
+#: contract (sojourn bound generous enough for CI wall-clock jitter)
+_STORM_OBJECTIVES = dict(sojourn_p99_ms=60_000.0, freshness_s=120.0,
+                         target=0.999, fast=(600.0, 600.0, 2.0),
+                         slow=(600.0, 600.0, 2.0))
+
+
+def _drive_slo_storm(seed, server, tmp_path, faults: bool,
+                     n_payloads=12, timeout=60.0):
+    """One seeded storm through sender queue → FlusherRunner → HttpSink
+    with the SLO plane live: every payload carries a real ingest stamp,
+    terminals observe it.  With ``faults`` the first 8 http_sink.send
+    calls error deterministically — the breaker (threshold 3) is
+    GUARANTEED to open, so at least the three in-flight retries reach
+    their spill terminal.  Returns (plane, payloads, auditor, runner,
+    sink) with the runner still LIVE: the budget breakdown attributes
+    hop spend from the runner's histograms, so the caller evaluates the
+    trip first and stops the runner in its own finally."""
+    plane = slo.enable(SloObjectives(**_STORM_OBJECTIVES))
+    slo.reset()
+    plane.evaluate_once()       # healthy tick: hop-baseline for breakdown
+    ledger.enable()
+    ledger.reset()
+    auditor = ledger.start_auditor(interval_s=0.05)
+    sqm = SenderQueueManager()
+    q = sqm.create_or_reuse_queue(1, capacity=n_payloads + 4,
+                                  pipeline_name="t")
+    sink = HttpSink(workers=2)
+    sink.init()
+    db = DiskBufferWriter(str(tmp_path / f"slo{seed}"))
+    runner = FlusherRunner(sqm, sink, disk_buffer=db,
+                           breaker_failure_threshold=3,
+                           breaker_cooldown_s=0.15)
+    runner.init()
+    url = f"http://127.0.0.1:{server.server_address[1]}/slo{seed}"
+    flusher = _FakeFlusher(url)
+    flusher.queue_key = 1
+    flusher.sender_queue = q
+    payloads = {f"slo-{seed}-{i:03d}".encode() for i in range(n_payloads)}
+    try:
+        if faults:
+            chaos.install(ChaosPlan(seed, {
+                "http_sink.send": FaultSpec(
+                    prob=1.0, kinds=(chaos.ACTION_ERROR,),
+                    delay_range=(0.0, 0.0), max_faults=8)}))
+        for p in sorted(payloads):
+            # the harness is the "input": it admits payloads straight
+            # into the sender hop, so it mints their stamps itself (the
+            # pqm admit hook owns this for real pipelines)
+            g = _mk_group()
+            plane.stamp("t", g)
+            ledger.record("t", ledger.B_INGEST, 1, len(p))
+            q.push(SenderQueueItem(p, len(p), flusher=flusher, queue_key=1,
+                                   event_cnt=1,
+                                   stamps=slo.stamps_of([g])))
+        assert wait_for(lambda: payloads <= server.received,
+                        timeout=timeout), (
+            f"seed {seed}: lost {len(payloads - server.received)} payloads")
+        # every stamp must reach a terminal (send_ok or spill): the
+        # outstanding registry drains to zero, so freshness reads the
+        # by-construction hard zero
+        assert wait_for(lambda: plane.outstanding("t") == 0,
+                        timeout=timeout), (
+            f"seed {seed}: {plane.outstanding('t')} stamps never reached "
+            "a terminal")
+        ledger.assert_conserved(timeout=timeout,
+                                label=f"slo storm seed {seed}")
+        assert wait_for(lambda: all(
+            br.state is BreakerState.CLOSED
+            for br in runner.breakers().values()), timeout=20), (
+            f"seed {seed}: breaker stuck open after the faults cleared")
+        return plane, payloads, auditor, runner, sink
+    except BaseException:
+        runner.stop(drain=False)
+        sink.stop()
+        raise
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# disabled-plane contract
+
+
+class TestDisabledPlane:
+    def test_every_hook_is_inert(self):
+        assert not slo.is_on()
+        assert slo.active_plane() is None
+        g = _mk_group()
+        slo.stamp_ingest("p", g)
+        slo.ensure_stamp("p", g)
+        assert g.get_metadata(EventGroupMetaKey.INGEST_NS) is None
+        assert slo.stamps_of([g]) == ()
+        slo.note_fanout(g, 3)
+        slo.cancel_group(g)
+        slo.observe_stamps("p", (1, 2), slo.OUTCOME_SEND_OK)
+        slo.observe_groups("p", [g], slo.OUTCOME_DROP)
+        slo.retire_groups([g])
+        slo.export_refresh()
+        assert slo.freshness("p") == 0.0
+        assert slo.evaluate_once() == {}
+        assert slo.debug_document() == {"enabled": False}
+        assert slo.evaluator() is None
+
+    def test_env_activation(self):
+        assert not slo.install_from_env({})
+        assert not slo.install_from_env({"LOONG_SLO": "0"})
+        assert slo.install_from_env({
+            "LOONG_SLO": "1", "LOONG_SLO_INTERVAL": "0.05",
+            "LOONG_SLO_SOJOURN_P99_MS": "250",
+            "LOONG_SLO_FRESHNESS_S": "7",
+            "LOONG_SLO_TARGET": "0.99"})
+        assert slo.is_on()
+        plane = slo.active_plane()
+        assert plane.objectives.sojourn_p99_ms == 250.0
+        assert plane.objectives.freshness_s == 7.0
+        assert plane.objectives.target == 0.99
+        ev = slo.evaluator()
+        assert ev is not None and ev.interval_s == 0.05
+        assert wait_for(lambda: ev.ticks_total >= 1, timeout=5)
+        slo.disable()
+        assert slo.evaluator() is None and not slo.is_on()
+
+    def test_env_bad_values_fall_back_to_defaults(self):
+        assert slo.install_from_env({"LOONG_SLO": "1",
+                                     "LOONG_SLO_TARGET": "bogus",
+                                     "LOONG_SLO_INTERVAL": "bogus"})
+        assert slo.active_plane().objectives.target == 0.999
+
+
+# ---------------------------------------------------------------------------
+# stamp lifecycle: mint at the single admit, inherit, fanout, cancel
+
+
+class TestStampLifecycle:
+    def test_admit_hook_mints_and_refused_push_cancels(self):
+        slo.enable()
+        slo.reset()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(1, capacity=2, pipeline_name="p")
+        admitted = []
+        for i in range(2):
+            g = _mk_group(b"x\n")
+            assert pqm.push_queue(1, g)
+            admitted.append(g)
+        refused = _mk_group(b"x\n")
+        assert not pqm.push_queue(1, refused)
+        plane = slo.active_plane()
+        # admitted groups carry distinct stamps; the refused one was
+        # un-admitted (its stamp must not age the freshness watermark)
+        stamps = slo.stamps_of(admitted)
+        assert len(stamps) == len(set(stamps)) == 2
+        assert plane.outstanding("p") == 2
+        slo.observe_groups("p", admitted, slo.OUTCOME_SEND_OK)
+        assert plane.outstanding("p") == 0
+
+    def test_stamps_are_unique_under_burst(self):
+        plane = slo.enable()
+        slo.reset()
+        groups = [_mk_group() for _ in range(64)]
+        for g in groups:
+            plane.stamp("p", g)
+        stamps = slo.stamps_of(groups)
+        assert len(set(stamps)) == 64
+        assert plane.outstanding("p") == 64
+
+    def test_derived_group_inherits_stamp(self):
+        plane = slo.enable()
+        slo.reset()
+        parent = _mk_group(b"line\n")
+        plane.stamp("p", parent)
+        child = PipelineEventGroup(parent.source_buffer)
+        parent.copy_meta_to(child)
+        assert plane.stamp_of(child) == plane.stamp_of(parent)
+        # one terminal releases the single shared stamp
+        slo.observe_groups("p", [child], slo.OUTCOME_SEND_OK)
+        assert plane.outstanding("p") == 0
+
+    def test_ensure_stamp_only_stamps_when_missing(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.ensure_stamp("p", g)
+        first = plane.stamp_of(g)
+        assert first is not None
+        plane.ensure_stamp("p", g)
+        assert plane.stamp_of(g) == first
+
+    def test_fanout_refcounts_like_the_ack_watermark(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.stamp("p", g)
+        plane.note_fanout(g, 3)        # three flushers matched
+        for i in range(3):
+            assert plane.outstanding("p") == 1, f"released after {i} acks"
+            slo.observe_groups("p", [g], slo.OUTCOME_SEND_OK)
+        assert plane.outstanding("p") == 0
+        assert plane.debug_document()["pipelines"]["p"]["ok_total"] == 3
+
+    def test_retire_releases_without_a_sojourn_sample(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.stamp("p", g)
+        slo.retire_groups([g])
+        assert plane.outstanding("p") == 0
+        row = plane.debug_document()["pipelines"]["p"]
+        assert row["ok_total"] == 0 and row["bad_total"] == 0
+
+    def test_stale_terminal_is_counted_not_crashed(self):
+        plane = slo.enable()
+        slo.reset()
+        ns = time.monotonic_ns() - 1_000_000
+        plane.observe_stamps("p", (ns,), slo.OUTCOME_SEND_OK,
+                             now_ns=ns + 2_000_000)
+        row = plane.debug_document()["pipelines"]["p"]
+        assert row["stale_retires"] == 1
+        assert row["ok_total"] == 1    # 2ms sojourn, inside the bound
+
+    def test_force_expiry_bounds_the_registry(self):
+        plane = slo.enable()
+        slo.reset()
+        plane.max_outstanding = 8
+        for _ in range(9):
+            plane.stamp("p", _mk_group())
+        assert plane.outstanding("p") <= 8 // 2
+        row = plane.debug_document()["pipelines"]["p"]
+        assert row["forced_expirations"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# freshness watermark: hard zero, hot-reload generation handoff
+
+
+class TestFreshness:
+    def test_idle_pipeline_reads_exactly_zero(self):
+        slo.enable()
+        slo.reset()
+        assert slo.freshness("never_seen") == 0.0
+
+    def test_drained_pipeline_returns_to_exactly_zero(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.stamp("p", g)
+        time.sleep(0.01)
+        assert slo.freshness("p") > 0.0
+        slo.observe_groups("p", [g], slo.OUTCOME_SEND_OK)
+        # BY CONSTRUCTION zero — not epsilon, not now-minus-ancient
+        assert slo.freshness("p") == 0.0
+        assert _slo_hist_count("p", slo.OUTCOME_SEND_OK) == 1
+
+    def test_freshness_survives_reload_generation_handoff(self):
+        """Reload mid-burst: generation 1's in-flight stamps stay on the
+        SAME name-keyed series while generation 2 admits new ones; the
+        series only returns to zero when BOTH generations drain."""
+        slo.enable()
+        slo.reset()
+        plane = slo.active_plane()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(1, capacity=8, pipeline_name="p")
+        g1 = _mk_group(b"gen1\n")
+        assert pqm.push_queue(1, g1)
+        _, g1 = pqm.pop_item(timeout=0)
+        # hot reload mid-burst: old queue goes away with g1 in flight
+        pqm.delete_queue(1)
+        pqm.create_or_reuse_queue(2, capacity=8, pipeline_name="p")
+        g2 = _mk_group(b"gen2\n")
+        assert pqm.push_queue(2, g2)
+        _, g2 = pqm.pop_item(timeout=0)
+        assert plane.outstanding("p") == 2
+        time.sleep(0.01)
+        assert slo.freshness("p") > 0.0
+        slo.observe_groups("p", [g1], slo.OUTCOME_SEND_OK)
+        assert plane.outstanding("p") == 1     # gen2 still holds the series
+        slo.observe_groups("p", [g2], slo.OUTCOME_SEND_OK)
+        assert slo.freshness("p") == 0.0
+
+    def test_queue_deletion_is_a_terminal_for_queued_groups(self):
+        """Groups still queued when their queue dies (reload shrink) hit
+        the drop terminal — stamps must not leak into freshness."""
+        slo.enable()
+        slo.reset()
+        plane = slo.active_plane()
+        pqm = ProcessQueueManager()
+        pqm.create_or_reuse_queue(1, capacity=8, pipeline_name="p")
+        assert pqm.push_queue(1, _mk_group(b"doomed\n"))
+        pqm.delete_queue(1)
+        assert plane.outstanding("p") == 0
+        assert slo.freshness("p") == 0.0
+        assert plane.debug_document()["pipelines"]["p"]["bad_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# burn-rate episodes (synthetic rings, manually-driven clock)
+
+
+def _feed(plane, pipeline, n, sojourn_ms, outcome, now_s):
+    for _ in range(n):
+        plane.note_result(pipeline, sojourn_ms, outcome, now_s=now_s)
+
+
+class TestBurnRateEpisodes:
+    OBJ = dict(sojourn_p99_ms=100.0, freshness_s=30.0, target=0.99,
+               fast=(30.0, 5.0, 14.4), slow=(120.0, 30.0, 6.0))
+
+    def _plane(self):
+        plane = slo.enable(SloObjectives(**self.OBJ))
+        slo.reset()
+        return plane, time.monotonic() + 10_000.0
+
+    def test_healthy_traffic_never_trips(self):
+        plane, t0 = self._plane()
+        _feed(plane, "p", 200, 10.0, slo.OUTCOME_SEND_OK, t0)
+        res = plane.evaluate_once(now_s=t0 + 1)["p"]
+        assert not res["firing"] and res["episodes"] == 0
+        assert res["budget_remaining"] == 1.0
+        assert AlarmManager.instance().empty()
+
+    def test_trip_raises_exactly_once_per_episode(self):
+        plane, t0 = self._plane()
+        _feed(plane, "p", 200, 10.0, slo.OUTCOME_SEND_OK, t0)
+        # cliff: slow deliveries (over the sojourn bound) burn the budget
+        _feed(plane, "p", 100, 500.0, slo.OUTCOME_SEND_OK, t0 + 2)
+        res = plane.evaluate_once(now_s=t0 + 3)["p"]
+        assert res["firing"] and res["episodes"] == 1
+        assert res["burn_fast_long"] > 14.4
+        # still burning on the next ticks: NO second raise
+        plane.evaluate_once(now_s=t0 + 4)
+        plane.evaluate_once(now_s=t0 + 5)
+        alarms = [a for a in AlarmManager.instance().flush()
+                  if a["alarm_type"] == AlarmType.SLO_BURN_RATE.value]
+        assert len(alarms) == 1 and alarms[0]["alarm_count"] == "1"
+        assert alarms[0]["episode"] == "1"
+        assert alarms[0]["alarm_level"] == "error"
+        assert "breakdown" in alarms[0]
+        assert plane.episode_count("p") == 1
+
+    def test_clear_rearm_and_second_episode(self):
+        plane, t0 = self._plane()
+        _feed(plane, "p", 100, 500.0, slo.OUTCOME_SEND_OK, t0)
+        plane.evaluate_once(now_s=t0 + 1)
+        assert plane.is_firing("p")
+        AlarmManager.instance().flush()
+        # short windows (5s fast / 30s slow) drain → the episode clears
+        res = plane.evaluate_once(now_s=t0 + 40)["p"]
+        assert not res["firing"] and res["episodes"] == 1
+        clears = flight.recorder().events_by_kind().get("slo.burn_clear", [])
+        assert len(clears) == 1 and clears[0][3]["pipeline"] == "p"
+        # a NEW burst is a NEW episode with a NEW alarm
+        _feed(plane, "p", 100, 0.0, slo.OUTCOME_DROP, t0 + 50)
+        res = plane.evaluate_once(now_s=t0 + 51)["p"]
+        assert res["firing"] and res["episodes"] == 2
+        alarms = [a for a in AlarmManager.instance().flush()
+                  if a["alarm_type"] == AlarmType.SLO_BURN_RATE.value]
+        assert len(alarms) == 1 and alarms[0]["episode"] == "2"
+
+    def test_budget_remaining_hits_zero_under_sustained_burn(self):
+        plane, t0 = self._plane()
+        _feed(plane, "p", 200, 10.0, slo.OUTCOME_SEND_OK, t0)
+        _feed(plane, "p", 200, 0.0, slo.OUTCOME_DROP, t0 + 1)
+        res = plane.evaluate_once(now_s=t0 + 2)["p"]
+        assert res["budget_remaining"] == 0.0
+
+    def test_freshness_breach_trips_without_traffic(self):
+        plane = slo.enable(SloObjectives(**self.OBJ))
+        slo.reset()
+        plane.set_objectives("f", SloObjectives(freshness_s=0.0))
+        g = _mk_group()
+        plane.stamp("f", g)
+        time.sleep(0.005)
+        res = plane.evaluate_once()["f"]
+        assert res["firing"] and res["episodes"] == 1
+        # the stamp reaches its terminal → freshness 0.0 → episode clears
+        slo.observe_groups("f", [g], slo.OUTCOME_SEND_OK)
+        res = plane.evaluate_once()["f"]
+        assert not res["firing"]
+
+    def test_unattributed_results_have_no_contract(self):
+        plane, t0 = self._plane()
+        _feed(plane, "", 100, 0.0, slo.OUTCOME_DROP, t0)
+        assert plane.evaluate_once(now_s=t0 + 1) == {}
+        assert AlarmManager.instance().empty()
+
+
+# ---------------------------------------------------------------------------
+# stage-attributed budget breakdown
+
+
+class TestBudgetBreakdown:
+    def test_dominant_hop_is_the_one_that_ate_the_budget(self):
+        from loongcollector_tpu.monitor.metrics import MetricsRecord
+        plane = slo.enable()
+        slo.reset()
+        rec = MetricsRecord(category="test", labels={"pipeline": "p"})
+        try:
+            sink_h = rec.histogram("sink_rtt_seconds")
+            stage_h = rec.histogram("stage_seconds")
+            plane.evaluate_once()          # healthy tick → baseline
+            sink_h.observe(0.5)
+            sink_h.observe(0.4)
+            stage_h.observe(0.05)
+            bd = plane.budget_breakdown()
+            assert bd["dominant"] == "sink"
+            assert bd["hops"]["sink"] == pytest.approx(0.9, abs=1e-6)
+            assert bd["hops"]["stage"] == pytest.approx(0.05, abs=1e-6)
+            hist = bd["histograms"]["sink_rtt_seconds"]
+            assert hist["delta_count"] == 2
+        finally:
+            rec.mark_deleted()
+
+    def test_baseline_refreshes_on_healthy_ticks_only(self):
+        from loongcollector_tpu.monitor.metrics import MetricsRecord
+        plane = slo.enable(SloObjectives(sojourn_p99_ms=100.0, target=0.99))
+        slo.reset()
+        rec = MetricsRecord(category="test", labels={"pipeline": "p"})
+        t0 = time.monotonic() + 20_000.0
+        try:
+            h = rec.histogram("device_roundtrip_seconds")
+            plane.evaluate_once(now_s=t0)      # healthy → baseline here
+            h.observe(1.0)
+            _feed(plane, "p", 50, 0.0, slo.OUTCOME_DROP, t0 + 1)
+            plane.evaluate_once(now_s=t0 + 2)  # trips: baseline FROZEN
+            h.observe(1.0)
+            bd = plane.budget_breakdown()
+            # both observations since the last HEALTHY tick are attributed
+            assert bd["hops"]["device"] == pytest.approx(2.0, abs=1e-6)
+        finally:
+            rec.mark_deleted()
+
+
+# ---------------------------------------------------------------------------
+# the 8-seed storm matrix (breaker-open burn + fault-free control)
+
+
+class TestSinkStormSLO:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_breaker_open_storm_trips_once_sink_dominant(
+            self, seed, recording_server, tmp_path, fast_retries):
+        plane, payloads, auditor, runner, sink = _drive_slo_storm(
+            seed, recording_server, tmp_path, faults=True)
+        try:
+            self._assert_burn_episode(seed, plane, auditor)
+        finally:
+            runner.stop(drain=False)
+            sink.stop()
+
+    def _assert_burn_episode(self, seed, plane, auditor):
+        assert chaos.fault_counts().get("http_sink.send", 0) > 0
+        # at least the three breaker-opening retries reached the spill
+        # terminal: bad results exist, the budget burned
+        row = plane.debug_document()["pipelines"]["t"]
+        assert row["bad_total"] > 0, f"seed {seed}: storm burned nothing"
+        assert _slo_hist_count("t", slo.OUTCOME_SPILL) == row["bad_total"]
+        res = plane.evaluate_once()["t"]
+        assert res["firing"] and res["episodes"] == 1, (
+            f"seed {seed}: burn {res['burn_fast_long']:.1f}x did not trip")
+        plane.evaluate_once()          # still burning: no second raise
+        alarms = [a for a in AlarmManager.instance().flush()
+                  if a["alarm_type"] == AlarmType.SLO_BURN_RATE.value]
+        assert len(alarms) == 1 and alarms[0]["alarm_count"] == "1", (
+            f"seed {seed}: expected exactly one SLO_BURN_RATE raise")
+        assert alarms[0]["episode"] == "1"
+        assert alarms[0]["dominant_hop"] == "sink", (
+            f"seed {seed}: budget went to "
+            f"{alarms[0]['dominant_hop']!r}, not the sink hop")
+        assert json.loads(alarms[0]["breakdown"])["dominant"] == "sink"
+        # -- scrape UNDER the storm (episode still firing): the new
+        # series and the /debug/slo page must both serve it
+        text = exposition.render()
+        assert "loong_pipeline_freshness_seconds{" in text
+        assert "loong_slo_burn_rate{" in text
+        assert "loong_slo_burn_firing{" in text
+        assert "loong_event_to_flush_ms" in text
+        srv = exposition.ExpositionServer(port=0)
+        srv.start()
+        try:
+            port = srv._server.server_address[1]
+            doc = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/slo", timeout=5))
+            assert doc["enabled"] is True
+            assert doc["pipelines"]["t"]["firing"] is True
+            assert doc["pipelines"]["t"]["episodes"] == 1
+            assert doc["pipelines"]["t"]["last_breakdown"]["dominant"] \
+                == "sink"
+            idx = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=5).read()
+            assert b"/debug/slo" in idx
+        finally:
+            srv.stop()
+        # breaker re-closed and every payload delivered (the storm's
+        # wait_for already proved both): once the short windows drain,
+        # the episode CLEARS and re-arms — no new alarm, one clear event
+        res = plane.evaluate_once(now_s=time.monotonic() + 1300.0)["t"]
+        assert not res["firing"] and res["episodes"] == 1
+        clears = flight.recorder().events_by_kind().get("slo.burn_clear", [])
+        assert [e[3]["pipeline"] for e in clears] == ["t"]
+        assert not any(
+            a["alarm_type"] == AlarmType.SLO_BURN_RATE.value
+            for a in AlarmManager.instance().flush())
+        assert auditor.residual_alarms_total == 0
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_healthy_storm_zero_burn_alerts_and_residual_zero(
+            self, seed, recording_server, tmp_path, fast_retries):
+        plane, payloads, auditor, runner, sink = _drive_slo_storm(
+            seed, recording_server, tmp_path, faults=False)
+        runner.stop(drain=False)
+        sink.stop()
+        res = plane.evaluate_once()["t"]
+        assert not res["firing"] and res["episodes"] == 0
+        assert res["burn_fast_long"] == 0.0
+        row = plane.debug_document()["pipelines"]["t"]
+        assert row["ok_total"] == len(payloads)
+        assert row["bad_total"] == 0
+        assert _slo_hist_count("t", slo.OUTCOME_SEND_OK) == len(payloads)
+        assert slo.freshness("t") == 0.0
+        assert not any(
+            a["alarm_type"] == AlarmType.SLO_BURN_RATE.value
+            for a in AlarmManager.instance().flush()), (
+            f"seed {seed}: healthy storm raised a burn alert")
+        assert auditor.residual_alarms_total == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule prefix-determinism with the plane live
+
+
+class TestPrefixDeterminismWithSLO:
+    RULES = {
+        "http_sink.send": FaultSpec(prob=0.4, kinds=chaos.ALL_ACTIONS,
+                                    delay_range=(0.0, 0.0)),
+        "device_plane.submit": FaultSpec(prob=0.2, delay_range=(0.0, 0.0)),
+    }
+
+    def _drive(self, seed, with_slo):
+        """150 faultpoint rounds interleaved with live stamp traffic when
+        the plane is on — SLO work must never perturb the fault stream."""
+        if with_slo:
+            plane = slo.enable()
+            slo.reset()
+        chaos.install(ChaosPlan(seed, dict(self.RULES)))
+        try:
+            for i in range(150):
+                if with_slo:
+                    g = _mk_group()
+                    plane.stamp("p", g)
+                try:
+                    chaos.faultpoint("http_sink.send", exc=RuntimeError)
+                except RuntimeError:
+                    pass
+                chaos.faultpoint("device_plane.submit", raise_=False)
+                if with_slo:
+                    slo.observe_groups(
+                        "p", [g], slo.OUTCOME_SEND_OK if i % 3 else
+                        slo.OUTCOME_DROP)
+            return chaos.schedule_by_point()
+        finally:
+            chaos.uninstall()
+            slo.disable()
+
+    @pytest.mark.parametrize("seed", SEEDS[:4])
+    def test_schedule_identical_with_and_without_slo(self, seed):
+        s_off = self._drive(seed, with_slo=False)
+        s_on1 = self._drive(seed, with_slo=True)
+        s_on2 = self._drive(seed, with_slo=True)
+        assert s_on1 == s_on2, f"seed {seed}: not reproducible with SLO on"
+        assert s_on1 == s_off, f"seed {seed}: SLO perturbed the schedule"
+        assert s_on1, f"seed {seed}: injected nothing in 150 rounds"
+
+
+# ---------------------------------------------------------------------------
+# export lifecycle
+
+
+class TestExportLifecycle:
+    def test_disable_retires_every_slo_record(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.stamp("p", g)
+        slo.observe_groups("p", [g], slo.OUTCOME_SEND_OK)
+        slo.export_refresh()
+        assert _slo_hist_count("p", slo.OUTCOME_SEND_OK) == 1
+        slo.disable()
+        assert _slo_hist_count("p", slo.OUTCOME_SEND_OK) == 0
+        for rec in WriteMetrics.instance().records():
+            assert rec.category != "slo", "slo record survived disable()"
+        assert "loong_pipeline_freshness_seconds{" not in exposition.render()
+
+    def test_gauges_mirror_outstanding_and_freshness(self):
+        plane = slo.enable()
+        slo.reset()
+        g = _mk_group()
+        plane.stamp("p", g)
+        plane.note_result("p", 1.0, slo.OUTCOME_SEND_OK)
+        slo.export_refresh()
+        gauges = {}
+        for rec in WriteMetrics.instance().records():
+            if rec.category == "slo" and rec.labels.get("pipeline") == "p" \
+                    and "outcome" not in rec.labels:
+                gauges.update(rec.snapshot()["gauges"])
+        assert gauges["slo_outstanding_stamps"] == 1.0
+        assert gauges["pipeline_freshness_seconds"] >= 0.0
+        assert gauges["slo_burn_firing"] == 0.0

@@ -83,7 +83,7 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_thirteen_checkers_registered(self):
+    def test_all_fourteen_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
@@ -91,8 +91,8 @@ class TestTier1Gate:
                          "metric-naming", "hot-path-materialize",
                          "per-row-parse", "unbounded-window",
                          "host-bounce", "reload-unsafe",
-                         "raceguard-guarded-by"]
-        assert len(all_checkers()) == 13
+                         "raceguard-guarded-by", "stamp-propagation"]
+        assert len(all_checkers()) == 14
 
 
 # ---------------------------------------------------------------------------
@@ -1886,3 +1886,144 @@ class TestReloadUnsafe:
     def test_registered_in_tier1(self):
         from loongcollector_tpu.analysis.checkers import checker_names
         assert "reload-unsafe" in checker_names()
+
+
+# ---------------------------------------------------------------------------
+# 15. stamp-propagation fixtures (loongslo)
+
+
+class TestStampPropagation:
+    def checker(self):
+        from loongcollector_tpu.analysis.checkers.stamp_propagation import \
+            StampPropagationChecker
+        return StampPropagationChecker()
+
+    def test_derived_group_without_carrier_flagged(self):
+        # the pre-fix udpserver._dispatch shape: re-routed events re-emerge
+        # in a fresh group over the SAME arena, stamp left behind
+        src = """
+        class Dispatcher:
+            def _dispatch(self, group):
+                for key, events in self._route(group):
+                    out = PipelineEventGroup(group.source_buffer)
+                    out.events.extend(events)
+                    self._sinks[key](out)
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/input/fixture.py")
+        assert checks_of(fs) == {"stamp-propagation"}
+        assert any("ingest stamp is lost" in f.message for f in fs)
+
+    def test_copy_meta_to_clean(self):
+        src = """
+        class Dispatcher:
+            def _dispatch(self, group):
+                for key, events in self._route(group):
+                    out = PipelineEventGroup(group.source_buffer)
+                    group.copy_meta_to(out)
+                    out.events.extend(events)
+                    self._sinks[key](out)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/input/fixture.py") == []
+
+    def test_group_meta_helper_clean(self):
+        # the aggregator-family idiom: a _group_meta helper copies tags +
+        # metadata onto every fresh bucket group
+        src = """
+        class Aggregator:
+            def add(self, group):
+                for ev in group.events:
+                    out = PipelineEventGroup(group.source_buffer)
+                    self._group_meta(out, self._key(group, ev), group)
+                    out.events.append(ev)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/aggregator/fixture.py") == []
+
+    def test_explicit_restamp_clean(self):
+        src = """
+        class Splitter:
+            def split(self, group):
+                out = PipelineEventGroup(group.source_buffer)
+                v = group.get_metadata(EventGroupMetaKey.INGEST_NS)
+                if v is not None:
+                    out.set_metadata(EventGroupMetaKey.INGEST_NS, str(v))
+                return out
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/processor/fixture.py") == []
+
+    def test_slo_stamp_call_clean(self):
+        # a site that mints its own stamp (rollup emit at window close)
+        src = """
+        class Rollup:
+            def emit(self, group):
+                out = PipelineEventGroup(group.source_buffer)
+                slo.ensure_stamp(self._pipeline, out)
+                return out
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/aggregator/fixture.py") == []
+
+    def test_fresh_arena_not_derived(self):
+        # constructing over a NEW SourceBuffer is a fresh admission — the
+        # ingest hook stamps it; this checker must stay silent
+        src = """
+        class Input:
+            def _make_group(self, data):
+                sb = SourceBuffer(len(data) + 64)
+                group = PipelineEventGroup(sb)
+                group.events.append(self._parse(data))
+                return group
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/input/fixture.py") == []
+
+    def test_bare_construction_not_derived(self):
+        src = """
+        def make_group():
+            return PipelineEventGroup()
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/input/fixture.py") == []
+
+    def test_nested_function_owns_its_site(self):
+        # the closure is its own derivation scope: a carrier in the OUTER
+        # function must not excuse the inner bare construction
+        src = """
+        class Router:
+            def route(self, group):
+                def _make():
+                    return PipelineEventGroup(group.source_buffer)
+                keep = PipelineEventGroup(group.source_buffer)
+                group.copy_meta_to(keep)
+                return _make(), keep
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/input/fixture.py")
+        assert len(fs) == 1, [f.format() for f in fs]
+        assert fs[0].symbol.endswith("_make")
+
+    def test_suppression_escapes(self):
+        src = textwrap.dedent("""
+        class DebugProbe:
+            def sample(self, group):
+                # loonglint: disable=stamp-propagation
+                return PipelineEventGroup(group.source_buffer)
+        """)
+        mod = ModuleInfo("/fx/loongcollector_tpu/input/fixture.py",
+                         "loongcollector_tpu/input/fixture.py", src)
+        fs = list(self.checker().check_module(mod))
+        assert fs
+        assert all(mod.suppressed(f.line, "stamp-propagation") for f in fs)
+
+    def test_real_tree_clean(self):
+        from loongcollector_tpu.analysis.core import run_analysis
+        result = run_analysis(checkers=[self.checker()])
+        assert result.findings == [], [
+            f.format() for f in result.findings]
+
+    def test_registered_in_tier1(self):
+        from loongcollector_tpu.analysis.checkers import checker_names
+        assert "stamp-propagation" in checker_names()
