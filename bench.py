@@ -1111,9 +1111,20 @@ def _collect_conservation(_ledger, max_lag_s: float) -> dict:
         raise SystemExit(
             f"conservation audit FAILED: nonzero residual {bad}; "
             f"boundary snapshot: {snap}")
+    # loongxprof: the byte-conservation leg — with the event ledger
+    # quiesced the batch ring must hold zero leased slots, so the
+    # device-memory ledger's ring_slots family must read zero live bytes.
+    # Same SystemExit discipline: a leak mid-bench fails the run.
+    mem_res = _ledger.device_memory_residual()
+    if mem_res not in (None, 0):
+        from loongcollector_tpu.ops.device_plane import device_memory_status
+        raise SystemExit(
+            f"device-memory audit FAILED: ring_slots holds {mem_res} live "
+            f"bytes at quiesce; ledger: {device_memory_status()}")
     return {
         "residual": 0,
         "residuals": residuals,
+        "device_memory_residual_bytes": 0 if mem_res is None else mem_res,
         "max_queue_lag_seconds": round(max_lag_s, 4),
         "boundaries": {
             pl: {b: row["events"] for b, row in rows.items()}
@@ -2241,6 +2252,72 @@ def bench_analysis():
     }
 
 
+def bench_xprof(n_dispatch=12, rows=256, cols=64):
+    """loongxprof: enable the device timeline for a short synthetic
+    dispatch storm and record the per-leg decomposition (submit / exec /
+    d2h wall split per program:geometry) next to extra.utilization, plus
+    jit compile accounting — a dedicated first-dispatch-vs-steady probe
+    and every watched_jit family THIS bench process exercised (compile
+    counts, cache hits, total compile wall)."""
+    import jax
+    import numpy as np
+
+    from loongcollector_tpu.ops import compile_watch, xprof
+    from loongcollector_tpu.ops.device_plane import (DevicePlane,
+                                                     LatencyInjectedKernel)
+    # first-vs-steady: the first call at a geometry pays XLA compile
+    # (timed by the watched_jit wrapper), every later call is a cache hit
+    probe = compile_watch.watched_jit(lambda x: (x * 2 + 1).sum(),
+                                      "bench_probe")
+    x = np.arange(4096, dtype=np.int32)
+    t0 = time.perf_counter()
+    jax.block_until_ready(probe(x))
+    first_ms = (time.perf_counter() - t0) * 1000.0
+    steady_ms = float("inf")
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(probe(x))
+        steady_ms = min(steady_ms, (time.perf_counter() - t0) * 1000.0)
+
+    xprof.enable()
+    try:
+        plane = DevicePlane(budget_bytes=1 << 22)
+        kern = LatencyInjectedKernel(lambda a: (a,), rtt_s=0.002)
+        buf = np.zeros((rows, cols), dtype=np.uint8)
+        for _ in range(n_dispatch):
+            fut = plane.submit(kern, (buf,), buf.nbytes)
+            xprof.note_dispatch(fut, "bench", f"{rows}x{cols}")
+            fut.result()
+        t = xprof.active_timeline()
+        stats = t.stats()
+        decomp = t.decomposition()
+    finally:
+        xprof.disable()
+
+    cstat = compile_watch.compile_status()
+    families = {
+        fam: {"compiles": row["compiles"],
+              "cache_hits": row["cache_hits"],
+              "compile_ms_total": round(row["compile_ms_total"], 1),
+              "storm_episodes": row["storm_episodes"]}
+        for fam, row in sorted(cstat.items())}
+    return {
+        "device_timeline": {
+            "dispatches": stats["dispatches"],
+            "closed": stats["closed"],
+            "dropped": stats["dropped"],
+            "decomposition": decomp,
+        },
+        "compile": {
+            "first_dispatch_ms": round(first_ms, 2),
+            "steady_dispatch_ms": round(steady_ms, 3),
+            "compile_overhead_x": round(first_ms / steady_ms, 1)
+            if steady_ms > 0 else None,
+            "families": families,
+        },
+    }
+
+
 def bench_resource():
     """CPU% / RSS at 10 MB/s, the reference's regression-harness metric
     (BASELINE.md: 3.4 % CPU / 29 MB simple, 14.2 % / 34 MB regex).  Runs
@@ -2480,6 +2557,15 @@ def main():
     analysis = _safe(bench_analysis, default=None)
     if analysis is not None:
         extra["analysis"] = analysis
+    # loongxprof: the dispatch decomposition (submit/exec/d2h split) next
+    # to extra.utilization's occupancy view, and first-dispatch compile
+    # cost vs steady-state for every watched_jit family this run touched.
+    # Runs LAST among the in-process benches so compile accounting has
+    # accumulated every family the suite exercised.
+    xp = _safe(bench_xprof, default=None)
+    if isinstance(xp, dict):
+        extra["device_timeline"] = xp["device_timeline"]
+        extra["compile"] = xp["compile"]
     from loongcollector_tpu.runner.processor_runner import \
         resolve_thread_count
     extra["process_threads"] = resolve_thread_count()

@@ -20,6 +20,7 @@ from .swallowed_fault import SwallowedFaultChecker
 from .tracing_hygiene import TracingHygieneChecker
 from .unbounded_window import UnboundedWindowChecker
 from .unledgered_drop import UnledgeredDropChecker
+from .unwatched_jit import UnwatchedJitChecker
 
 _CHECKER_CLASSES = [
     AcquireReleaseChecker,
@@ -36,6 +37,7 @@ _CHECKER_CLASSES = [
     ReloadUnsafeChecker,
     RaceGuardChecker,
     StampPropagationChecker,
+    UnwatchedJitChecker,
 ]
 
 

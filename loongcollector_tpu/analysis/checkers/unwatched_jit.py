@@ -1,0 +1,80 @@
+"""unwatched-jit: every ``jax.jit`` under ops// parallel/ must go through
+``compile_watch.watched_jit``.
+
+loongxprof's compile observability (per-family compile counts, wall-ms
+histograms, cache hit/miss, the RECOMPILE_STORM alarm) only sees jit
+entry points wrapped by :func:`compile_watch.watched_jit`.  A raw
+``jax.jit(...)`` call or ``@jax.jit`` decorator in kernel code creates a
+blind spot: a flapping geometry can storm XLA recompiles there for hours
+and neither /debug/status compile accounting nor the storm alarm will
+name it.  This checker keeps the watch total — a new kernel cannot land
+with an invisible compile cache.
+
+Flagged shapes (syntactic, per module, ops/ and parallel/ only):
+
+  * ``jax.jit(f, ...)`` / ``jit(f, ...)`` call sites;
+  * ``@jax.jit`` / ``@jit`` bare decorators;
+  * ``functools.partial(jax.jit, ...)`` partial-application shapes.
+
+``ops/compile_watch.py`` itself is exempt — the wrapper owns the one
+legitimate raw ``jax.jit`` call.  A deliberately unwatched jit (e.g. a
+one-shot capability probe whose compile is not a recurring cost) carries
+an inline ``# loonglint: disable=unwatched-jit`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, Finding, ModuleInfo, call_name
+
+CHECK = "unwatched-jit"
+
+_JIT_NAMES = ("jax.jit", "jit")
+
+
+def _expr_is_jit(node: ast.expr) -> bool:
+    try:
+        return ast.unparse(node) in _JIT_NAMES
+    except Exception:  # pragma: no cover
+        return False
+
+
+class UnwatchedJitChecker(Checker):
+    name = CHECK
+    description = ("every jax.jit under ops/ and parallel/ must go "
+                   "through compile_watch.watched_jit so compile storms "
+                   "stay observable")
+
+    def check_module(self, mod: ModuleInfo) -> Iterator[Finding]:
+        rel = "/" + mod.relpath
+        if "/ops/" not in rel and "/parallel/" not in rel:
+            return
+        if rel.endswith("/ops/compile_watch.py"):
+            return      # the wrapper owns the one legitimate raw jax.jit
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) and _expr_is_jit(dec):
+                        yield Finding(
+                            CHECK, mod.relpath, dec.lineno, dec.col_offset,
+                            "`@jax.jit` decorator bypasses watched_jit — "
+                            "its compile cache is invisible to compile "
+                            "accounting and the RECOMPILE_STORM alarm",
+                            symbol=node.name)
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _JIT_NAMES:
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        "raw `jax.jit(...)` bypasses watched_jit — wrap "
+                        "with compile_watch.watched_jit(fn, family) so "
+                        "compiles are counted and storms alarm")
+                elif name in ("functools.partial", "partial") and \
+                        node.args and _expr_is_jit(node.args[0]):
+                    yield Finding(
+                        CHECK, mod.relpath, node.lineno, node.col_offset,
+                        "`functools.partial(jax.jit, ...)` bypasses "
+                        "watched_jit — wrap the jitted callable with "
+                        "compile_watch.watched_jit(fn, family)")

@@ -117,6 +117,12 @@ class Application:
         if slo.install_from_env():
             log.info("loongslo ACTIVE (evaluator=%s)",
                      slo.evaluator() is not None)
+        # loongxprof: LOONG_XPROF=1 records the per-dispatch device
+        # timeline (h2d/submit/exec/d2h legs, /debug/timeline); compile
+        # and device-memory accounting are always on (docs/observability.md)
+        from .ops import xprof
+        if xprof.install_from_env():
+            log.info("loongxprof ACTIVE")
         from .monitor.exposition import start_from_env as _expo_from_env
         self.exposition = _expo_from_env()
         from .runner.processor_runner import resolve_thread_count

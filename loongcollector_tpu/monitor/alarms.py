@@ -127,6 +127,11 @@ class AlarmType(str, enum.Enum):
     # the multi-window multi-burn-rate policy tolerates — raised once per
     # episode with the stage-attributed latency-budget breakdown attached
     SLO_BURN_RATE = "SLO_BURN_RATE_ALARM"
+    # loongxprof: a kernel family's jit compiles/minute crossed the storm
+    # threshold (geometry churn — e.g. a flapping WidthAutoTuner bucket
+    # forcing a fresh XLA compile per flap) — raised once per episode,
+    # naming the churning family and geometry
+    RECOMPILE_STORM = "RECOMPILE_STORM_ALARM"
 
 
 class _AlarmRecord:

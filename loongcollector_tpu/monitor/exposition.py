@@ -346,7 +346,51 @@ def collect_status() -> dict:
             doc["recovery"] = rdoc
     except Exception:  # noqa: BLE001
         pass
+    try:
+        # loongxprof: device-memory ledger — live/peak bytes per allocation
+        # family (ring slots, resident columns, DFA tables, sharded staging,
+        # side arenas).  Always-on (plain counters), so the section appears
+        # whenever the device plane module has been imported.
+        import sys as _sys
+        _dp = _sys.modules.get("loongcollector_tpu.ops.device_plane")
+        if _dp is not None:
+            doc["device_memory"] = _dp.device_memory_status()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongxprof: per-family jit compile/cache accounting + recompile-
+        # storm episodes — absent until the first watched_jit wrapper runs
+        import sys as _sys
+        _cw = _sys.modules.get("loongcollector_tpu.ops.compile_watch")
+        if _cw is not None:
+            cdoc = _cw.compile_status()
+            if cdoc:
+                doc["compile"] = cdoc
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongxprof: device timeline occupancy + per-(program, geometry)
+        # dispatch decomposition; absent while LOONG_XPROF is off
+        import sys as _sys
+        _xp = _sys.modules.get("loongcollector_tpu.ops.xprof")
+        if _xp is not None:
+            xdoc = _xp.status()
+            if xdoc is not None:
+                doc["xprof"] = xdoc
+    except Exception:  # noqa: BLE001
+        pass
     return doc
+
+
+#: every section collect_status() can emit — the parity contract the
+#: tests hold /debug/status to (a new subsystem page must register here)
+STATUS_SECTIONS = (
+    "time", "uptime_s", "pid",
+    "pipelines", "tenants", "ledger", "workers", "breakers",
+    "device", "streaming", "mesh", "fusion", "stage_fusion", "parse",
+    "flight", "profiler", "recovery",
+    "device_memory", "compile", "xprof",
+)
 
 
 _INDEX = (b"loongcollector_tpu exposition endpoint\n"
@@ -356,7 +400,9 @@ _INDEX = (b"loongcollector_tpu exposition endpoint\n"
           b"  /debug/pprof   folded stacks (loongprof)\n"
           b"  /debug/flight  flight-recorder ring JSON\n"
           b"  /debug/ledger  event-conservation ledger JSON (loongledger)\n"
-          b"  /debug/slo     freshness-SLO plane JSON (loongslo)\n")
+          b"  /debug/slo     freshness-SLO plane JSON (loongslo)\n"
+          b"  /debug/timeline  unified host/device Chrome-trace JSON "
+          b"(loongxprof)\n")
 
 _PROM_CT = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_CT = "application/json; charset=utf-8"
@@ -396,6 +442,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._reply(200, _JSON_CT,
                             (json.dumps(_slo.debug_document(),
                                         sort_keys=True,
+                                        default=str) + "\n").encode())
+            elif path == "/debug/timeline":
+                # loongxprof: the unified host/device execution timeline,
+                # loadable directly in Perfetto / chrome://tracing
+                from ..trace.export import chrome_trace
+                self._reply(200, _JSON_CT,
+                            (json.dumps(chrome_trace(), sort_keys=True,
                                         default=str) + "\n").encode())
             elif path == "/debug/pprof":
                 from .. import prof as _prof

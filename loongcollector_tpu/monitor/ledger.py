@@ -436,6 +436,23 @@ def assert_conserved(timeout: float = 30.0, label: str = "") -> dict:
     return snap
 
 
+def device_memory_residual() -> Optional[int]:
+    """loongxprof byte-conservation probe: ``ring_slots`` live bytes when
+    the batch ring holds zero leased slots, else None (not evaluable —
+    bytes are legitimately live while slots are leased).  Also None when
+    the device plane / stream modules were never imported: absence of the
+    subsystem is not evidence of a leak."""
+    import sys as _sys
+    _dp = _sys.modules.get("loongcollector_tpu.ops.device_plane")
+    if _dp is None:
+        return None
+    _ds = _sys.modules.get("loongcollector_tpu.ops.device_stream")
+    ring = getattr(_ds, "_ring", None) if _ds is not None else None
+    if ring is not None and ring.totals().get("leased", 0) != 0:
+        return None
+    return int(_dp.mem_live_bytes("ring_slots"))
+
+
 # ---------------------------------------------------------------------------
 # continuous auditor
 
@@ -462,6 +479,12 @@ class ConservationAuditor:
         self.audits_total = 0
         self.quiesced_audits_total = 0
         self.residual_alarms_total = 0
+        # loongxprof device-memory conservation: same two-consecutive-
+        # sightings discipline as event residuals (a slot freed between
+        # the ring read and the ledger read fakes a one-audit residual)
+        self._mem_suspect: Optional[int] = None
+        self._mem_alarmed = False
+        self.device_memory_alarms_total = 0
 
     # -- one audit step (tests drive this directly) -------------------------
 
@@ -476,8 +499,10 @@ class ConservationAuditor:
         self._prev = snap
         if not quiesced:
             self._suspect.clear()
+            self._mem_suspect = None
             return {}
         self.quiesced_audits_total += 1
+        self._audit_device_memory()
         rs = residuals(snap)
         suspects: Dict[str, int] = {}
         for pipeline, res in rs.items():
@@ -494,6 +519,41 @@ class ConservationAuditor:
             self._raise(pipeline, res, snap.get(pipeline, {}))
         self._suspect = suspects
         return rs
+
+    def _audit_device_memory(self) -> None:
+        """loongxprof: byte-conservation leg of a quiesced audit — with
+        the event ledger quiesced AND the batch ring holding zero leased
+        slots, the device-memory ledger's ``ring_slots`` family must read
+        zero live bytes (every lease was matched by a return/forget).
+        Other families legitimately hold pooled/cached footprint at
+        quiesce (DFA tables, staging pools), so only the ring ties."""
+        res = device_memory_residual()
+        if res is None:
+            self._mem_suspect = None
+            return
+        if res == 0:
+            self._mem_alarmed = False
+            self._mem_suspect = None
+            return
+        if self._mem_alarmed:
+            return
+        if self._mem_suspect != res:
+            self._mem_suspect = res        # first sighting: confirm next
+            return
+        self._mem_alarmed = True
+        self.device_memory_alarms_total += 1
+        from ..prof import flight
+        from .alarms import AlarmLevel, AlarmManager, AlarmType
+        AlarmManager.instance().send_alarm(
+            AlarmType.CONSERVATION_RESIDUAL,
+            f"device-memory conservation broken: ring_slots ledger holds "
+            f"{res} live bytes at quiesce with zero leased slots (an "
+            f"unledgered free path; see /debug/status device_memory)",
+            AlarmLevel.CRITICAL, pipeline="__device__",
+            details={"residual_bytes": str(res),
+                     "family": "ring_slots"})
+        flight.record("ledger.device_memory_residual",
+                      family="ring_slots", residual_bytes=res)
 
     def _raise(self, pipeline: str, res: int, rows: dict) -> None:
         from ..prof import flight

@@ -24,6 +24,8 @@ _shard_rec = MetricsRecord(category="processor_shards",
                            labels={"component": "loongshard"})
 _prof_rec = MetricsRecord(category="profiler",
                           labels={"component": "loongprof"})
+_xprof_rec = MetricsRecord(category="device_xprof",
+                           labels={"component": "loongxprof"})
 
 
 def refresh() -> None:
@@ -169,6 +171,43 @@ def refresh() -> None:
         # (no-op while the SLO plane is off)
         from . import slo
         slo.export_refresh()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # loongxprof: device-memory ledger + timeline occupancy + compile
+        # accounting rollup (per-family compile counters/histograms export
+        # through compile_watch's own shared records — this is the fleet-
+        # level "is anything storming / leaking" summary).  Observe-only:
+        # sys.modules probes, never an import that constructs a plane.
+        import sys as _sys
+        _dp = _sys.modules.get("loongcollector_tpu.ops.device_plane")
+        if _dp is not None:
+            mem = _dp.device_memory_status()
+            _xprof_rec.gauge("device_mem_live_bytes_total").set(
+                float(mem["total_live_bytes"]))
+            for fam, row in mem["families"].items():
+                _xprof_rec.gauge(f"device_mem_live_bytes_{fam}").set(
+                    float(row["live_bytes"]))
+                _xprof_rec.gauge(f"device_mem_peak_bytes_{fam}").set(
+                    float(row["peak_bytes"]))
+        _cw = _sys.modules.get("loongcollector_tpu.ops.compile_watch")
+        if _cw is not None:
+            cdoc = _cw.compile_status()
+            _xprof_rec.gauge("jit_families").set(float(len(cdoc)))
+            _xprof_rec.gauge("jit_storm_episodes_total").set(float(
+                sum(row["storm_episodes"] for row in cdoc.values())))
+        _xp = _sys.modules.get("loongcollector_tpu.ops.xprof")
+        if _xp is not None:
+            xdoc = _xp.status()
+            _xprof_rec.gauge("xprof_active").set(
+                1.0 if xdoc is not None else 0.0)
+            if xdoc is not None:
+                _xprof_rec.gauge("xprof_dispatches_recorded").set(
+                    float(xdoc["dispatches"]))
+                _xprof_rec.gauge("xprof_dispatches_closed").set(
+                    float(xdoc["closed"]))
+                _xprof_rec.gauge("xprof_dispatches_dropped").set(
+                    float(xdoc["dropped"]))
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -34,6 +34,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import chaos, prof, trace
+from . import xprof
 from ..utils.logger import get_logger
 
 log = get_logger("device_plane")
@@ -156,6 +157,78 @@ def reset_tenants_for_testing() -> None:
         _tenant_registered.clear()
         _tenant_inflight.clear()
 
+# ---------------------------------------------------------------------------
+# loongxprof: device-memory accounting — a ledger-style live/peak byte
+# ledger per allocation family.  Always on (unlike the timeline): the
+# hooks fire at lease/dispatch rate, not per-event rate, and every prior
+# device PR has needed exactly this number after the fact.  Families:
+#
+#   ring_slots       — leased batch-ring staging slots (device_stream)
+#   resident_columns — HBM-resident inter-stage columns held by in-flight
+#                      fused dispatches (fused_pipeline)
+#   dfa_tables       — memoized FusedDFA constant tables (regex/fuse)
+#   sharded_staging  — per-shard device_put staging (parallel/mesh)
+#   side_arenas      — kernel-side staging pools (segment_reduce etc.)
+#
+# Conservation contract: at quiesce, ``ring_slots`` live bytes must equal
+# the ring's leased bytes (both zero once every slot returned) — the
+# auditor folds the residual into its quiesced snapshot check.
+
+MEM_FAMILIES = ("ring_slots", "resident_columns", "dfa_tables",
+                "sharded_staging", "side_arenas")
+
+_mem_lock = threading.Lock()
+_mem: Dict[str, List[int]] = {}   # family -> [live, peak, allocs, frees]
+
+
+def mem_note_alloc(family: str, nbytes: int) -> None:
+    """Charge `nbytes` of device-resident memory to `family`."""
+    if nbytes <= 0:
+        return
+    with _mem_lock:
+        row = _mem.get(family)
+        if row is None:
+            row = _mem[family] = [0, 0, 0, 0]
+        row[0] += nbytes
+        if row[0] > row[1]:
+            row[1] = row[0]
+        row[2] += 1
+
+
+def mem_note_free(family: str, nbytes: int) -> None:
+    """Credit `nbytes` back to `family`.  Live bytes clamp at zero: a
+    double-free is an accounting bug upstream, never a negative gauge."""
+    if nbytes <= 0:
+        return
+    with _mem_lock:
+        row = _mem.get(family)
+        if row is None:
+            row = _mem[family] = [0, 0, 0, 0]
+        row[0] = max(0, row[0] - nbytes)
+        row[3] += 1
+
+
+def mem_live_bytes(family: str) -> int:
+    with _mem_lock:
+        row = _mem.get(family)
+        return row[0] if row is not None else 0
+
+
+def device_memory_status() -> dict:
+    """Per-family live/peak ledger — the /debug/status ``device_memory``
+    section and the auditor's conservation input."""
+    with _mem_lock:
+        fams = {f: {"live_bytes": row[0], "peak_bytes": row[1],
+                    "allocs": row[2], "frees": row[3]}
+                for f, row in sorted(_mem.items())}
+        total_live = sum(row[0] for row in _mem.values())
+    return {"families": fams, "total_live_bytes": total_live}
+
+
+def mem_reset_for_testing() -> None:
+    with _mem_lock:
+        _mem.clear()
+
 # submit→resolve stopwatch sink: one shared histogram (lazy so importing
 # the plane never touches the metrics registry)
 _rtt_hist = None
@@ -252,12 +325,13 @@ class DeviceFuture:
     """
 
     __slots__ = ("_plane", "_nbytes", "_outputs", "_error", "_done",
-                 "_materialised", "_t0", "_span", "_tenant", "__weakref__")
+                 "_materialised", "_t0", "_span", "_tenant", "_xid",
+                 "__weakref__")
 
     def __init__(self, plane: "DevicePlane", nbytes: int,
                  outputs: Optional[Sequence] = None,
                  error: Optional[BaseException] = None,
-                 span=None, tenant: Optional[str] = None):
+                 span=None, tenant: Optional[str] = None, xid: int = 0):
         self._plane = plane
         self._nbytes = nbytes
         self._outputs = outputs
@@ -271,12 +345,25 @@ class DeviceFuture:
         # loongtenant: which tenant's share these bytes count against —
         # credited back exactly once when the future settles
         self._tenant = tenant
+        # loongxprof: the dispatch id correlating this future's device
+        # legs with the host span that caused them (0 = plane off)
+        self._xid = xid
+
+    @property
+    def dispatch_id(self) -> int:
+        """loongxprof correlation id (0 when the timeline is off) — the
+        dispatch loops read this to attribute program/geometry/pack legs
+        via ``xprof.note_dispatch``."""
+        return self._xid
 
     def _release_budget(self) -> None:
         self._plane._release(self._nbytes)
         if self._tenant is not None:
             _tenant_note(self._tenant, -self._nbytes)
             self._tenant = None
+        # settle point: fold this dispatch's legs into the decomposition
+        # histograms exactly once (no-op for xid 0 / plane off)
+        xprof.close_dispatch(self._xid)
 
     def result(self) -> List[np.ndarray]:
         if self._done:
@@ -290,7 +377,25 @@ class DeviceFuture:
             # on the device — attribute that wall time to the device scope
             prof.push_marker("device", "materialise")
             try:
-                self._materialised = [np.asarray(o) for o in self._outputs]
+                xid = self._xid
+                if xid:
+                    # exec leg: dispatch return → first output ready (the
+                    # device-execution window the host can observe); d2h
+                    # leg: the numpy materialisation itself.  Without a
+                    # block_until_ready the split collapses into d2h.
+                    t_exec = time.perf_counter()
+                    first = self._outputs[0] if self._outputs else None
+                    if hasattr(first, "block_until_ready"):
+                        first.block_until_ready()
+                    t_d2h = time.perf_counter()
+                    xprof.leg(xid, "exec", t_exec, t_d2h - t_exec)
+                    self._materialised = [np.asarray(o)
+                                          for o in self._outputs]
+                    xprof.leg(xid, "d2h", t_d2h,
+                              time.perf_counter() - t_d2h)
+                else:
+                    self._materialised = [np.asarray(o)
+                                          for o in self._outputs]
             finally:
                 prof.pop_marker()
             roundtrip_histogram().observe(time.perf_counter() - self._t0)
@@ -571,30 +676,49 @@ class DevicePlane:
         span = (tracer.child_or_sampled("device", "device.roundtrip",
                                         {"nbytes": nbytes})
                 if tracer is not None else None)
+        # loongxprof: mint the dispatch id AFTER budget admission, so the
+        # submit leg measures the dispatch call, not the back-pressure
+        # wait (which the tracer's host span already covers).  0 when off.
+        xid = xprof.begin_dispatch(nbytes)
+        if xid and span is not None:
+            # the host/device correlation key the timeline export lines
+            # spans up by (volatile attr: excluded from structure)
+            span.set_attr("dispatch_id", xid)
         try:
             # after _acquire, inside the try: an injected fault behaves
             # exactly like a kernel raising at dispatch — errored future,
             # budget released at the consume point (result/release)
             chaos.faultpoint(FP_SUBMIT)
             prof.push_marker("device", "dispatch")
+            if xid:
+                # current-dispatch TLS: code running INSIDE the kernel
+                # call (ShardedKernel._dispatch) attaches its H2D legs to
+                # this dispatch
+                xprof.set_current_dispatch(xid)
+                t_submit = time.perf_counter()
             try:
                 outputs = kernel(*args)
             finally:
+                if xid:
+                    xprof.leg(xid, "submit", t_submit,
+                              time.perf_counter() - t_submit)
+                    xprof.set_current_dispatch(0)
                 prof.pop_marker()
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)
             return DeviceFuture(self, nbytes, outputs=outputs, span=span,
-                                tenant=tenant)
+                                tenant=tenant, xid=xid)
         except DispatchAborted:
             if span is not None:
                 span.end("aborted")
             self._release(nbytes)
             if tenant is not None:
                 _tenant_note(tenant, -nbytes)
+            xprof.close_dispatch(xid)
             raise
         except BaseException as e:  # noqa: BLE001 — deliver via result()
             return DeviceFuture(self, nbytes, error=e, span=span,
-                                tenant=tenant)
+                                tenant=tenant, xid=xid)
 
 
 class DispatchAborted(RuntimeError):

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import chaos
+from . import xprof
 from .device_batch import MIN_BATCH, pack_rows
 
 FP_RING_ADVANCE = chaos.register_point("device_plane.ring_advance")
@@ -174,7 +175,8 @@ class BatchSlot:
     dispatch that used it has materialised (the kernel may alias the
     buffers until then)."""
 
-    __slots__ = ("_ring", "B", "L", "rows", "lengths", "origins", "_leased")
+    __slots__ = ("_ring", "B", "L", "rows", "lengths", "origins", "_leased",
+                 "pack_t0", "pack_dur")
 
     def __init__(self, ring: "BatchRing", B: int, L: int):
         self._ring = ring
@@ -184,6 +186,11 @@ class BatchSlot:
         self.lengths = np.zeros(B, dtype=np.int32)
         self.origins = np.zeros(B, dtype=np.int32)
         self._leased = False
+        # loongxprof: last pack()'s stopwatch (perf_counter start, dur s)
+        # — the dispatch loop attaches it as the h2d leg.  None while the
+        # timeline is off (the pack pays no perf_counter calls then)
+        self.pack_t0: Optional[float] = None
+        self.pack_dur: Optional[float] = None
 
     def pack(self, arena: np.ndarray, offsets: np.ndarray,
              lengths: np.ndarray, lane: Optional[int] = None):
@@ -191,12 +198,24 @@ class BatchSlot:
         feeds the auto-tuner (per chip lane when the dispatching worker is
         lane-bound — loongmesh keys the tuner's floors per chip so one
         sparse chip cannot shrink every lane's geometry)."""
-        batch = pack_rows(arena, offsets, lengths, self.L, self.B,
-                          out=(self.rows, self.lengths, self.origins))
+        if xprof.is_active():
+            self.pack_t0 = time.perf_counter()
+            batch = pack_rows(arena, offsets, lengths, self.L, self.B,
+                              out=(self.rows, self.lengths, self.origins))
+            self.pack_dur = time.perf_counter() - self.pack_t0
+        else:
+            self.pack_t0 = self.pack_dur = None
+            batch = pack_rows(arena, offsets, lengths, self.L, self.B,
+                              out=(self.rows, self.lengths, self.origins))
         self._ring.record_pack(self.B, self.L, batch.n_real,
                                int(np.asarray(lengths, np.int64).sum()),
                                lane=lane)
         return batch
+
+    def nbytes(self) -> int:
+        """Host bytes this slot stages for H2D (rows + lengths + origins)
+        — the unit the ``ring_slots`` device-memory family accounts in."""
+        return self.rows.nbytes + self.lengths.nbytes + self.origins.nbytes
 
     def release(self) -> None:
         if not self._leased:
@@ -212,7 +231,7 @@ class BatchSlot:
         try:
             if self._leased:
                 self._leased = False
-                self._ring._forget()
+                self._ring._forget(self)
         except Exception:  # noqa: BLE001 — never raise from a finaliser
             pass
 
@@ -248,6 +267,12 @@ class BatchRing:
         if slot is None:
             slot = BatchSlot(self, B, L)
         slot._leased = True
+        # loongxprof device-memory ledger: a leased slot's bytes are live
+        # staging until the dispatch that used it materialises — the
+        # conservation residual at quiesce checks live==0 once every
+        # lease returned (pooled slots are idle host buffers, not leases)
+        from .device_plane import mem_note_alloc
+        mem_note_alloc("ring_slots", slot.nbytes())
         return slot
 
     def _return(self, slot: BatchSlot) -> None:
@@ -256,11 +281,15 @@ class BatchRing:
             pool = self._pools.setdefault((slot.B, slot.L), [])
             if len(pool) < self._cap():
                 pool.append(slot)
+        from .device_plane import mem_note_free
+        mem_note_free("ring_slots", slot.nbytes())
 
-    def _forget(self) -> None:
+    def _forget(self, slot: BatchSlot) -> None:
         """A leased slot died un-released (finaliser backstop)."""
         with self._lock:
             self._leased = max(0, self._leased - 1)
+        from .device_plane import mem_note_free
+        mem_note_free("ring_slots", slot.nbytes())
 
     def record_pack(self, B: int, L: int, n_real: int,
                     real_bytes: int, lane: Optional[int] = None) -> None:
@@ -572,6 +601,11 @@ class DeviceStream:
                 slot.release()
             raise
         self._window.append((tag, slot, fut))
+        if slot is not None:
+            xprof.note_dispatch(fut, "stream", f"{slot.B}x{slot.L}",
+                                slot.pack_t0, slot.pack_dur)
+        else:
+            xprof.note_dispatch(fut, "stream", "-")
 
     def _advance_if_any(self) -> bool:
         if not self._window:

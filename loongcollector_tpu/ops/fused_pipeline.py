@@ -61,10 +61,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import chaos
-from . import chip_lanes
+from . import chip_lanes, xprof
 from .chip_lanes import ChipLaneFault, lane_gated
 from .device_batch import (LENGTH_BUCKETS, MAX_BATCH, pad_batch,
                            pick_length_bucket)
+from .device_plane import mem_note_alloc, mem_note_free
 from .device_stream import auto_tuner, batch_ring, h2d_gated, stream_depth
 
 FP_FUSED_DISPATCH = chaos.register_point("device_plane.fused_dispatch")
@@ -237,10 +238,10 @@ class FusedProgramKernel:
     assertion reads it directly."""
 
     def __init__(self, specs: Sequence[StageSpec], signature: str):
-        import jax
+        from .compile_watch import watched_jit
         self.specs = list(specs)
         self.signature = signature
-        self._fn = jax.jit(build_fused_fn(self.specs))
+        self._fn = watched_jit(build_fused_fn(self.specs), "fused_program")
         self._fn_donated = None
         self._donated_lock = threading.Lock()
         self._lane_kernels: Dict[int, object] = {}
@@ -279,9 +280,10 @@ class FusedProgramKernel:
         if self._fn_donated is None:
             with self._donated_lock:
                 if self._fn_donated is None:
-                    import jax
-                    self._fn_donated = jax.jit(build_fused_fn(self.specs),
-                                               donate_argnums=(0, 1))
+                    from .compile_watch import watched_jit
+                    self._fn_donated = watched_jit(
+                        build_fused_fn(self.specs), "fused_program",
+                        donate_argnums=(0, 1))
         return self._fn_donated
 
     def set_kernel_override(self, kern) -> None:
@@ -729,6 +731,14 @@ class FusedDispatch:
                     slot.release()
                     raise
                 _count("fused_dispatch_total")
+                xprof.note_dispatch(fut, "fused", f"{B}x{L}",
+                                    slot.pack_t0, slot.pack_dur)
+                # loongxprof device-memory ledger: while this chunk is in
+                # flight its inter-stage columns live device-side (that
+                # residency is the whole point of fusion) — accounted at
+                # the input-bytes proxy the plane budget already uses,
+                # credited back when the chunk settles
+                mem_note_alloc("resident_columns", batch.rows.nbytes)
                 if lane is not None:
                     lane.note_pack(B, batch.n_real)
                     lane.note_dispatch(batch.rows.nbytes)
@@ -738,6 +748,7 @@ class FusedDispatch:
             # or lane accounting held by already-submitted chunks
             for _c, b, slot, fut, ln in self._pending:
                 fut.release()
+                mem_note_free("resident_columns", b.rows.nbytes)
                 if ln is not None:
                     ln.note_done(b.rows.nbytes)
                     ln.breaker.on_inconclusive()
@@ -796,6 +807,7 @@ class FusedDispatch:
             self._assemble(chunk, batch, flat)
             program.roundtrip_ms_total += (time.perf_counter() - t0) * 1e3
         finally:
+            mem_note_free("resident_columns", batch.rows.nbytes)
             if lane is not None:
                 lane.note_done(batch.rows.nbytes)
             slot.release()

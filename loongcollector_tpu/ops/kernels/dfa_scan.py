@@ -142,8 +142,10 @@ class DFASpanMatchKernel:
     materialised spans, so demotion costs dispatches, never answers."""
 
     def __init__(self, dfa: DFA):
+        from ..compile_watch import watched_jit
         self.dfa = dfa
-        self._fn = jax.jit(build_dfa_span_match_fn(dfa))
+        self._fn = watched_jit(build_dfa_span_match_fn(dfa),
+                               "dfa_span_match")
 
     def __call__(self, rows, lengths, starts, spanlens) -> np.ndarray:
         return self._fn(rows, lengths, starts, spanlens)
@@ -213,8 +215,9 @@ class FusedScanKernel:
     set classifies in a SINGLE kernel pass."""
 
     def __init__(self, fdfa):
+        from ..compile_watch import watched_jit
         self.fdfa = fdfa
-        self._fn = jax.jit(build_fused_scan_fn(fdfa))
+        self._fn = watched_jit(build_fused_scan_fn(fdfa), "fused_scan")
         self._fn_donated = None
         self.invocations = 0
 
@@ -228,16 +231,19 @@ class FusedScanKernel:
         if not donation_supported():
             return self.__call__(rows, lengths)
         if self._fn_donated is None:
-            self._fn_donated = jax.jit(build_fused_scan_fn(self.fdfa),
-                                       donate_argnums=(0, 1))
+            from ..compile_watch import watched_jit
+            self._fn_donated = watched_jit(build_fused_scan_fn(self.fdfa),
+                                           "fused_scan",
+                                           donate_argnums=(0, 1))
         self.invocations += 1
         return self._fn_donated(rows, lengths)
 
 
 class DFAMatchKernel:
     def __init__(self, dfa: DFA):
+        from ..compile_watch import watched_jit
         self.dfa = dfa
-        self._fn = jax.jit(build_dfa_match_fn(dfa))
+        self._fn = watched_jit(build_dfa_match_fn(dfa), "dfa_match")
         self._fn_donated = None
 
     def __call__(self, rows, lengths) -> np.ndarray:
@@ -251,6 +257,8 @@ class DFAMatchKernel:
         if not donation_supported():
             return self._fn(rows, lengths)
         if self._fn_donated is None:
-            self._fn_donated = jax.jit(build_dfa_match_fn(self.dfa),
-                                       donate_argnums=(0, 1))
+            from ..compile_watch import watched_jit
+            self._fn_donated = watched_jit(build_dfa_match_fn(self.dfa),
+                                           "dfa_match",
+                                           donate_argnums=(0, 1))
         return self._fn_donated(rows, lengths)

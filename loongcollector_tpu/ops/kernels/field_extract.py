@@ -519,8 +519,9 @@ class ExtractKernel:
     """
 
     def __init__(self, program: SegmentProgram):
+        from ..compile_watch import watched_jit
         self.program = program
-        self._fn = jax.jit(build_extract_fn(program))
+        self._fn = watched_jit(build_extract_fn(program), "extract")
         self._fn_donated = None
 
     def __call__(self, rows, lengths) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -537,8 +538,10 @@ class ExtractKernel:
         if not donation_supported():
             return self._fn(rows, lengths)
         if self._fn_donated is None:
-            self._fn_donated = jax.jit(build_extract_fn(self.program),
-                                       donate_argnums=(0, 1))
+            from ..compile_watch import watched_jit
+            self._fn_donated = watched_jit(build_extract_fn(self.program),
+                                           "extract",
+                                           donate_argnums=(0, 1))
         return self._fn_donated(rows, lengths)
 
     @property

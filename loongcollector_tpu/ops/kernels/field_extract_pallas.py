@@ -22,7 +22,6 @@ via device_batch LENGTH_BUCKETS), scalar-free control flow.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -72,7 +71,6 @@ def build_extract_fn_pallas(program: SegmentProgram,
         off_ref[...] = off
         cl_ref[...] = length
 
-    @functools.partial(jax.jit, static_argnums=())
     def extract(rows: jnp.ndarray, lengths: jnp.ndarray):
         B, L = rows.shape
         use_interpret = interpret
@@ -97,7 +95,8 @@ def build_extract_fn_pallas(program: SegmentProgram,
         )(rows, lengths.astype(jnp.int32)[:, None])
         return ok2[:, 0] != 0, off, length
 
-    return extract
+    from ..compile_watch import watched_jit
+    return watched_jit(extract, "extract_pallas", static_argnums=())
 
 
 class PallasExtractKernel:

@@ -403,9 +403,10 @@ class SegmentReduceKernel:
     the transient staging arrays."""
 
     def __init__(self, n_hist: int = N_HIST):
-        import jax
+        from ..compile_watch import watched_jit
         self.n_hist = n_hist
-        self._fn = jax.jit(build_reduce_fn(n_hist), static_argnums=(4,))
+        self._fn = watched_jit(build_reduce_fn(n_hist), "segment_reduce",
+                               static_argnums=(4,))
         self._fn_donated = None
         self.dispatch_count = 0
         # per-geometry staging buffers (the batch-slot idiom): the padded
@@ -428,10 +429,11 @@ class SegmentReduceKernel:
         if not donation_supported():
             return self(values, seg, buckets, valid, G)
         if self._fn_donated is None:
-            import jax
-            self._fn_donated = jax.jit(build_reduce_fn(self.n_hist),
-                                       static_argnums=(4,),
-                                       donate_argnums=(0, 1, 2, 3))
+            from ..compile_watch import watched_jit
+            self._fn_donated = watched_jit(build_reduce_fn(self.n_hist),
+                                           "segment_reduce",
+                                           static_argnums=(4,),
+                                           donate_argnums=(0, 1, 2, 3))
         self.dispatch_count += 1
         return self._fn_donated(values, seg, buckets, valid, G)
 
@@ -469,6 +471,7 @@ class SegmentReduceKernel:
         # trip — concurrent pipelines overlap their folds); a concurrent
         # lease of the same geometry just allocates a transient tuple
         # and the later return drops it
+        from ..device_plane import mem_note_alloc, mem_note_free
         with self._staging_lock:
             bufs = self._staging.pop(B, None)
         if bufs is None:
@@ -476,6 +479,11 @@ class SegmentReduceKernel:
                     np.zeros(B, dtype=np.int32),
                     np.zeros(B, dtype=np.int32),
                     np.zeros(B, dtype=bool))
+            # side_arenas ledger (loongxprof): a freshly allocated staging
+            # tuple joins the pool's live footprint; a transient tuple
+            # dropped at return (pool already holds this geometry) credits
+            # back below
+            mem_note_alloc("side_arenas", sum(a.nbytes for a in bufs))
         try:
             vals, seg, buckets, ok = bufs
             vals[:n] = values.astype(np.float32)
@@ -491,7 +499,9 @@ class SegmentReduceKernel:
                                                  jax.device_get(out))
         finally:
             with self._staging_lock:
-                self._staging.setdefault(B, bufs)
+                kept = self._staging.setdefault(B, bufs) is bufs
+            if not kept:
+                mem_note_free("side_arenas", sum(a.nbytes for a in bufs))
         return BatchFold(group_id, rep_row,
                          sums[:G].astype(np.float64),
                          cnt[:G].astype(np.int64),

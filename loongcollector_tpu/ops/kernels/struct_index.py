@@ -144,10 +144,10 @@ class StructIndexKernel:
     """
 
     def __init__(self, mode: str = MODE_JSON, sep: int = 0x2C):
-        import jax
+        from ..compile_watch import watched_jit
         self.mode = mode
         self.sep = sep
-        self._fn = jax.jit(build_index_fn(mode, sep))
+        self._fn = watched_jit(build_index_fn(mode, sep), "struct_index")
         self._fn_donated = None
         self.dispatch_count = 0
 
@@ -160,9 +160,10 @@ class StructIndexKernel:
         if not donation_supported():
             return self(rows, lengths)
         if self._fn_donated is None:
-            import jax
-            self._fn_donated = jax.jit(build_index_fn(self.mode, self.sep),
-                                       donate_argnums=(0, 1))
+            from ..compile_watch import watched_jit
+            self._fn_donated = watched_jit(
+                build_index_fn(self.mode, self.sep), "struct_index",
+                donate_argnums=(0, 1))
         self.dispatch_count += 1
         return self._fn_donated(rows, lengths)
 

@@ -22,7 +22,7 @@ import numpy as np
 import threading
 
 from ... import chaos
-from .. import chip_lanes
+from .. import chip_lanes, xprof
 from ..chip_lanes import ChipLaneFault, lane_gated
 from ..device_batch import (LENGTH_BUCKETS, MAX_BATCH, pack_rows, pad_batch,
                             pick_length_bucket)
@@ -99,6 +99,10 @@ def _run_dispatch_probe() -> int:
             import jax
             import jax.numpy as jnp_
             import numpy as np_
+            # not a kernel family: a once-per-process latency probe whose
+            # compile cost IS part of what it measures — compile_watch
+            # accounting would pollute the families it exists to audit
+            # loonglint: disable=unwatched-jit
             g = jax.jit(lambda r: r.astype(jnp_.int32).sum(axis=1))
             sizes = [(2048, 128), (8192, 512)]      # 256 KB, 4 MB
             times = []
@@ -766,6 +770,8 @@ class PendingParse:
                 except BaseException:
                     slot.release()
                     raise
+                xprof.note_dispatch(fut, "regex", f"{B}x{L}",
+                                    slot.pack_t0, slot.pack_dur)
                 if lane is not None:
                     lane.note_pack(B, batch.n_real)
                     lane.note_dispatch(batch.rows.nbytes)

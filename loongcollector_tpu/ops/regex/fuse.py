@@ -607,12 +607,33 @@ def load_or_compile(patterns: Sequence[str],
     return fdfa
 
 
+def _fdfa_nbytes(fdfa: FusedDFA) -> int:
+    """Device-constant footprint of one memoized automaton (the tables a
+    dispatch keeps resident): transition matrix + byte classes + accept
+    tags — the ``dfa_tables`` device-memory family's unit."""
+    total = 0
+    for name in ("transitions", "byte_class", "accept_tags"):
+        arr = getattr(fdfa, name, None)
+        total += getattr(arr, "nbytes", 0) or 0
+    return total
+
+
 def _memoize(key: str, fdfa: FusedDFA) -> None:
+    from ..device_plane import mem_note_alloc, mem_note_free
+    evicted: List[FusedDFA] = []
     with _mem_cache_lock:
+        fresh = key not in _mem_cache
         _mem_cache[key] = fdfa
         _mem_cache.move_to_end(key)
         while len(_mem_cache) > _MEM_CACHE_MAX:
-            _mem_cache.popitem(last=False)       # evict least-recently used
+            evicted.append(
+                _mem_cache.popitem(last=False)[1])   # evict LRU
+    # dfa_tables ledger (loongxprof): tables live while memoized, credit
+    # back on eviction — outside the cache lock
+    if fresh:
+        mem_note_alloc("dfa_tables", _fdfa_nbytes(fdfa))
+    for old in evicted:
+        mem_note_free("dfa_tables", _fdfa_nbytes(old))
 
 
 # ---------------------------------------------------------------------------
@@ -717,8 +738,12 @@ def reset_for_testing() -> None:
     status counters).  Metrics records persist — they are process-lifetime
     instruments like shared_histogram's."""
     global _cache_dir
+    from ..device_plane import mem_note_free
     with _mem_cache_lock:
+        dropped = list(_mem_cache.values())
         _mem_cache.clear()
+    for fdfa in dropped:
+        mem_note_free("dfa_tables", _fdfa_nbytes(fdfa))
     with _stats_lock:
         _alarmed.clear()
         _fusion_state.update(compiles=0, cache_hits=0, cache_misses=0,

@@ -43,6 +43,7 @@ ops/chip_lanes.py.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from collections import deque
 from typing import Dict, Optional
@@ -52,6 +53,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops import xprof
+from ..ops.compile_watch import watched_jit
+from ..ops.device_plane import mem_note_alloc, mem_note_free
 from ..ops.regex.program import SegmentProgram
 from ..ops.kernels.field_extract import build_extract_fn, donation_supported
 
@@ -105,12 +109,13 @@ class ShardedParsePlane:
             out_specs=(P(axis), P(axis, None), P(axis, None),
                        {"matched": P(), "events": P(), "bytes": P()}),
             **kw)
-        self._fn = jax.jit(sharded)
+        self._fn = watched_jit(sharded, "sharded_parse")
         # donated variant (loongmesh): inputs are per-dispatch staging
         # copies produced by put(), so XLA may alias their per-shard HBM
         # for the outputs.  CPU ignores donation with a per-call warning,
         # so the variant only exists where donation is real.
-        self._fn_donated = (jax.jit(sharded, donate_argnums=(0, 1))
+        self._fn_donated = (watched_jit(sharded, "sharded_parse",
+                                        donate_argnums=(0, 1))
                             if donation_supported() else None)
         ax = axis
         self._in_shardings = (NamedSharding(self.mesh, P(ax, None)),
@@ -323,9 +328,28 @@ class ShardedKernel:
             rows, lengths = self._pad_to_mesh(rows, lengths)
             self._note_per_chip(lengths)
             self._dispatches_total.add(1)
-            rows_d, lengths_d = self.plane.put(rows, lengths)
-            step = self.plane.donated if donate else self.plane
-            ok, off, length, stats = step(rows_d, lengths_d)
+            # loongxprof: this runs INSIDE DevicePlane.submit's kernel
+            # call when the engine dispatches the mesh, so the per-shard
+            # device_put is the enclosing dispatch's real H2D leg —
+            # attached via the current-dispatch TLS.  The staging copies'
+            # footprint is ledgered for the duration of the dispatch call
+            # (donation hands the same HBM to the outputs after that).
+            xid = xprof.current_dispatch()
+            staged = rows.nbytes + lengths.nbytes
+            mem_note_alloc("sharded_staging", staged)
+            try:
+                if xid:
+                    t_put = time.perf_counter()
+                    rows_d, lengths_d = self.plane.put(rows, lengths)
+                    xprof.leg(xid, "h2d", t_put,
+                              time.perf_counter() - t_put,
+                              chips=self.plane.num_devices)
+                else:
+                    rows_d, lengths_d = self.plane.put(rows, lengths)
+                step = self.plane.donated if donate else self.plane
+                ok, off, length, stats = step(rows_d, lengths_d)
+            finally:
+                mem_note_free("sharded_staging", staged)
         self.last_stats = stats
         self._queue_stats(stats)
         return ok, off, length

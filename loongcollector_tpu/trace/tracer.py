@@ -313,8 +313,11 @@ class Tracer:
 
 
 #: span attributes whose values are run-dependent (sizes are stable, ids
-#: and timings are not) — excluded from structure comparison
-_VOLATILE_ATTRS = frozenset({"duration_ms", "wall", "thread"})
+#: and timings are not) — excluded from structure comparison.
+#: dispatch_id is loongxprof's per-run correlation counter: interleaving
+#: under concurrency may renumber dispatches between identical runs
+_VOLATILE_ATTRS = frozenset({"duration_ms", "wall", "thread",
+                             "dispatch_id"})
 
 
 # ---------------------------------------------------------------------------

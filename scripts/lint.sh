@@ -40,6 +40,12 @@ echo "== slo-overhead smoke (loongslo) =="
 # one branch per hook — same paired-min >5% gate as the other planes
 JAX_PLATFORMS=cpu python scripts/slo_overhead.py
 
+echo "== xprof-overhead smoke (loongxprof) =="
+# with LOONG_XPROF off the device-timeline hooks must stay one branch per
+# hook on the dispatch hot path — same paired-min >5% gate, measured on a
+# real DevicePlane submit/result loop
+JAX_PLATFORMS=cpu python scripts/xprof_overhead.py
+
 echo "== multi-worker smoke (loongshard) =="
 # the disabled-trace overhead gate and the metric-naming checker must hold
 # with the sharded plane active (LOONG_PROCESS_THREADS=4): the overhead

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Dump the unified host/device execution timeline (loongxprof).
+
+Two modes:
+
+  * ``--url http://127.0.0.1:9400`` (or ``--port 9400``) — fetch
+    ``/debug/timeline`` from a running agent's exposition endpoint and
+    write it to ``--out`` (default ``timeline.json``).  Load the file in
+    Perfetto (ui.perfetto.dev) or chrome://tracing.
+
+  * ``--demo`` — no running agent: enable loongtrace + loongxprof in
+    process, run a short seeded synthetic dispatch storm through a
+    private DevicePlane, and dump ITS timeline.  The offline smoke test
+    for the export path, and a worked example of what the correlated
+    document looks like.
+
+``--canonical`` writes the canonicalize() reduction instead (the
+timing-independent structure two runs of the same seed must agree on).
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+
+def fetch(url: str) -> dict:
+    with urllib.request.urlopen(url + "/debug/timeline", timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def demo(seed: int) -> dict:
+    import numpy as np
+    from loongcollector_tpu import trace
+    from loongcollector_tpu.ops import xprof
+    from loongcollector_tpu.ops.device_plane import (
+        DevicePlane, LatencyInjectedKernel)
+    from loongcollector_tpu.trace.export import chrome_trace
+    from loongcollector_tpu.trace.tracer import TraceConfig
+
+    rng = np.random.default_rng(seed)
+    trace.enable(TraceConfig(seed=seed))
+    xprof.enable()
+    try:
+        plane = DevicePlane(budget_bytes=1 << 20)
+        kernel = LatencyInjectedKernel(lambda a: (a,), rtt_s=0.002)
+        for i in range(8):
+            rows = rng.integers(0, 255, size=(4, 64), dtype=np.uint8)
+            with trace.start_span("device.roundtrip"):
+                fut = plane.submit(kernel, (rows,), rows.nbytes)
+                xprof.note_dispatch(fut, "demo", f"{rows.shape[0]}x"
+                                    f"{rows.shape[1]}")
+                fut.result()
+        tracer = trace.active_tracer()
+        timeline = xprof.active_timeline()
+        return chrome_trace(tracer=tracer, timeline=timeline)
+    finally:
+        xprof.disable()
+        trace.disable()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", help="agent exposition base url")
+    ap.add_argument("--port", type=int,
+                    help="shorthand for --url http://127.0.0.1:PORT")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a synthetic seeded storm in-process")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", default="timeline.json",
+                    help="output path ('-' for stdout)")
+    ap.add_argument("--canonical", action="store_true",
+                    help="write the canonicalize() structure bytes instead")
+    args = ap.parse_args()
+
+    if args.demo:
+        doc = demo(args.seed)
+    else:
+        url = args.url or (args.port and f"http://127.0.0.1:{args.port}")
+        if not url:
+            ap.error("one of --url/--port/--demo is required")
+        doc = fetch(url)
+
+    if args.canonical:
+        from loongcollector_tpu.trace.export import canonicalize
+        body = canonicalize(doc)
+    else:
+        body = (json.dumps(doc, sort_keys=True) + "\n").encode("utf-8")
+
+    if args.out == "-":
+        sys.stdout.buffer.write(body)
+    else:
+        with open(args.out, "wb") as f:
+            f.write(body)
+        n = len(doc.get("traceEvents", []))
+        print(f"wrote {args.out}: {n} trace events ({len(body)} bytes)"
+              + ("" if args.canonical
+                 else " — load in ui.perfetto.dev or chrome://tracing"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""loongxprof-overhead smoke gate (wired into scripts/lint.sh).
+
+The loongxprof contract (docs/observability.md) is that the DISABLED
+device-timeline hooks cost one module-global read + branch per call —
+the dispatch hot path (DevicePlane.submit / DeviceFuture.result) must
+not slow down when the plane ships but stays off.  Same proof shape as
+trace_overhead.py / prof_overhead.py:
+
+1. **Per-hook microbench** — ns/call of the disabled hooks
+   (`xprof.is_active`, `xprof.begin_dispatch`, `xprof.close_dispatch`,
+   `xprof.current_dispatch`) under a generous absolute ceiling.
+
+2. **Synthetic dispatch loop** — N submit/result round-trips through a
+   private DevicePlane (trivial kernel, no threads), timed in two
+   configurations, interleaved, best-of-N each:
+
+     * ``disabled``  — hooks as shipped, LOONG_XPROF off (production);
+     * ``baseline``  — the same hooks monkeypatched to bare no-op
+       lambdas, i.e. the cheapest conceivable "xprof compiled out".
+
+   Gate: MIN paired disabled/baseline ratio ≤ 1.05.  The enabled time is
+   reported informationally — recording MAY cost; off MUST NOT.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__file__), ".."))
+
+N_DISPATCH = 3_000
+REPEATS = 9
+MAX_DISABLED_OVER_BASELINE = 1.05      # the 5% gate
+MAX_HOOK_NS = 2_000                    # catastrophic-regression ceiling
+
+
+def bench_hooks():
+    from loongcollector_tpu.ops import xprof
+    xprof.disable()
+    out = {}
+    for label, fn in (("is_active", xprof.is_active),
+                      ("begin_dispatch", lambda: xprof.begin_dispatch(128)),
+                      ("close_dispatch", lambda: xprof.close_dispatch(0)),
+                      ("current_dispatch", xprof.current_dispatch)):
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / n)
+        out[label] = best * 1e9
+    return out
+
+
+def make_runner():
+    import numpy as np
+    from loongcollector_tpu.ops.device_plane import DevicePlane
+    plane = DevicePlane(budget_bytes=1 << 24)
+    payload = np.arange(256, dtype=np.int32)
+
+    def kernel(a):
+        return (a,)
+
+    def run_timed():
+        t0 = time.perf_counter()
+        for _ in range(N_DISPATCH):
+            fut = plane.submit(kernel, (payload,), payload.nbytes)
+            fut.result()
+        return time.perf_counter() - t0
+
+    return plane, run_timed
+
+
+def main() -> int:
+    from loongcollector_tpu.ops import xprof
+    hooks = bench_hooks()
+    print("disabled hook cost (ns/call): "
+          + ", ".join(f"{k}={v:.0f}" for k, v in hooks.items()))
+    bad = {k: v for k, v in hooks.items() if v > MAX_HOOK_NS}
+    if bad:
+        print(f"FAIL: disabled hooks over {MAX_HOOK_NS} ns: {bad}")
+        return 1
+
+    import gc
+    plane, run_timed = make_runner()
+    noop_zero = lambda *a, **k: 0                     # noqa: E731
+    noop_none = lambda *a, **k: None                  # noqa: E731
+    noop_false = lambda: False                        # noqa: E731
+    real = (xprof.is_active, xprof.begin_dispatch, xprof.close_dispatch,
+            xprof.note_dispatch, xprof.set_current_dispatch,
+            xprof.current_dispatch, xprof.leg)
+
+    def set_baseline():
+        xprof.disable()
+        xprof.is_active = noop_false
+        xprof.begin_dispatch = noop_zero
+        xprof.close_dispatch = noop_none
+        xprof.note_dispatch = noop_none
+        xprof.set_current_dispatch = noop_none
+        xprof.current_dispatch = noop_zero
+        xprof.leg = noop_none
+
+    def restore():
+        (xprof.is_active, xprof.begin_dispatch, xprof.close_dispatch,
+         xprof.note_dispatch, xprof.set_current_dispatch,
+         xprof.current_dispatch, xprof.leg) = real
+
+    def set_disabled():
+        restore()
+        xprof.disable()
+
+    def set_enabled():
+        restore()
+        xprof.enable()
+
+    # Paired rounds, gate = MIN ratio (see trace_overhead.py for why:
+    # co-tenant steal drifts absolute timings past 5%, but a real
+    # disabled-path regression is systematic and fails every pair).
+    dis_ratios, en_ratios = [], []
+    try:
+        run_timed()                                   # warm the path
+        for i in range(REPEATS):
+            pair = [("baseline", set_baseline), ("disabled", set_disabled)]
+            if i % 2:                                 # kill position bias
+                pair.reverse()
+            times = {}
+            for name, setup in pair + [("enabled", set_enabled)]:
+                setup()
+                gc.collect()
+                times[name] = run_timed()
+                xprof.disable()
+            dis_ratios.append(times["disabled"] / times["baseline"])
+            en_ratios.append(times["enabled"] / times["baseline"])
+    finally:
+        restore()
+        xprof.disable()
+
+    ratio = min(dis_ratios)
+    print(f"{N_DISPATCH}-dispatch synthetic loop, {REPEATS} paired rounds: "
+          f"disabled/baseline min={ratio:.3f} "
+          f"median={sorted(dis_ratios)[len(dis_ratios) // 2]:.3f}  "
+          f"enabled/baseline min={min(en_ratios):.3f}")
+    if ratio > MAX_DISABLED_OVER_BASELINE:
+        print(f"FAIL: disabled-path overhead {(ratio - 1) * 100:.1f}% "
+              f"> {(MAX_DISABLED_OVER_BASELINE - 1) * 100:.0f}% in every "
+              "round — the disabled timeline must stay one branch per hook")
+        return 1
+    print("xprof overhead OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

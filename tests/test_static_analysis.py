@@ -83,7 +83,7 @@ class TestTier1Gate:
         assert doc["allowlist_entries"] <= doc["allowlist_budget"]
         assert doc["files_scanned"] > 100
 
-    def test_all_fourteen_checkers_registered(self):
+    def test_all_fifteen_checkers_registered(self):
         names = checker_names()
         assert names == ["acquire-release", "blocking-under-lock",
                          "tracing-hygiene", "registry-consistency",
@@ -91,8 +91,9 @@ class TestTier1Gate:
                          "metric-naming", "hot-path-materialize",
                          "per-row-parse", "unbounded-window",
                          "host-bounce", "reload-unsafe",
-                         "raceguard-guarded-by", "stamp-propagation"]
-        assert len(all_checkers()) == 14
+                         "raceguard-guarded-by", "stamp-propagation",
+                         "unwatched-jit"]
+        assert len(all_checkers()) == 15
 
 
 # ---------------------------------------------------------------------------
@@ -2027,3 +2028,110 @@ class TestStampPropagation:
     def test_registered_in_tier1(self):
         from loongcollector_tpu.analysis.checkers import checker_names
         assert "stamp-propagation" in checker_names()
+
+
+# ---------------------------------------------------------------------------
+# 16. unwatched-jit fixtures (loongxprof)
+
+
+class TestUnwatchedJit:
+    def checker(self):
+        from loongcollector_tpu.analysis.checkers.unwatched_jit import \
+            UnwatchedJitChecker
+        return UnwatchedJitChecker()
+
+    def test_raw_jit_call_site_flagged(self):
+        # the pre-loongxprof ExtractKernel shape: a raw jax.jit whose
+        # compile cache no counter and no storm alarm can see
+        src = """
+        class ExtractKernel:
+            def __init__(self, program):
+                self._fn = jax.jit(build_extract_fn(program))
+        """
+        fs = scan(src, self.checker())
+        assert checks_of(fs) == {"unwatched-jit"}
+        assert len(fs) == 1
+
+    def test_bare_decorator_flagged(self):
+        src = """
+        @jax.jit
+        def step(x):
+            return x + 1
+        """
+        fs = scan(src, self.checker())
+        assert len(fs) == 1
+        assert fs[0].symbol == "step"
+
+    def test_partial_decorator_flagged(self):
+        # the pre-fix field_extract_pallas shape
+        src = """
+        @functools.partial(jax.jit, static_argnums=())
+        def extract(rows, lengths):
+            return rows
+        """
+        fs = scan(src, self.checker())
+        assert len(fs) == 1
+
+    def test_watched_jit_is_clean(self):
+        src = """
+        from .compile_watch import watched_jit
+
+        class ExtractKernel:
+            def __init__(self, program):
+                self._fn = watched_jit(build_extract_fn(program), "extract")
+        """
+        assert scan(src, self.checker()) == []
+
+    def test_host_layer_out_of_scope(self):
+        # runner/-layer code may jit freely — compile watching targets the
+        # kernel planes under ops/ and parallel/
+        src = """
+        def probe():
+            return jax.jit(lambda x: x)(1)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/runner/fixture.py") == []
+
+    def test_compile_watch_itself_exempt(self):
+        src = """
+        def watched_jit(fn, family, **jit_kwargs):
+            return WatchedFn(jax.jit(fn, **jit_kwargs), family)
+        """
+        assert scan(src, self.checker(),
+                    relpath="loongcollector_tpu/ops/compile_watch.py") == []
+
+    def test_parallel_layer_in_scope(self):
+        src = """
+        class ShardedParsePlane:
+            def __init__(self, fn):
+                self._fn = jax.jit(fn)
+        """
+        fs = scan(src, self.checker(),
+                  relpath="loongcollector_tpu/parallel/fixture.py")
+        assert len(fs) == 1
+
+    def test_suppression_escapes(self):
+        # a one-shot capability probe is a legitimate unwatched jit when
+        # it carries a justification (engine.py's dispatch probe)
+        src = textwrap.dedent("""
+        def _run_dispatch_probe():
+            # probe compiles once per process; not a recurring cost
+            # loonglint: disable=unwatched-jit
+            g = jax.jit(lambda r: r.sum())
+            return g
+        """)
+        mod = ModuleInfo("/fx/loongcollector_tpu/ops/fixture.py",
+                         "loongcollector_tpu/ops/fixture.py", src)
+        fs = list(self.checker().check_module(mod))
+        assert fs
+        assert all(mod.suppressed(f.line, "unwatched-jit") for f in fs)
+
+    def test_real_tree_clean(self):
+        from loongcollector_tpu.analysis.core import run_analysis
+        result = run_analysis(checkers=[self.checker()])
+        assert result.findings == [], [
+            f.format() for f in result.findings]
+
+    def test_registered_in_tier1(self):
+        from loongcollector_tpu.analysis.checkers import checker_names
+        assert "unwatched-jit" in checker_names()
